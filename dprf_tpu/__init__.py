@@ -1,0 +1,18 @@
+"""DPRF-TPU: a TPU-native distributed password-recovery framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of the
+reference DPRF (Expertasif/dprf): HashEngine plugins (MD5, SHA-1,
+SHA-256, NTLM, bcrypt, WPA2-PMKID), mask and wordlist+rules candidate
+generation, a Dispatcher/WorkUnit keyspace splitter, and a coordinator
+that collects hits -- with the entire hot path (index -> candidate ->
+digest -> compare -> hit compaction) fused into a single jitted device
+program so candidates never leave HBM.
+
+Reference parity note: the reference mount was empty at survey time
+(SURVEY.md, "CRITICAL FINDING"); the public surface implemented here is
+pinned to the component names in BASELINE.json's north star.
+"""
+
+__version__ = "0.1.0"
+
+from dprf_tpu.engines import get_engine, engine_names  # noqa: F401

@@ -1,0 +1,59 @@
+"""Engine registry: (name, device) -> HashEngine class.
+
+Engines self-register at import time via the @register decorator, the
+same plugin pattern the reference's `--engine=<algo>` flag implies.
+Devices: "cpu" (oracle / reference path) and "jax" (TPU-native fused
+path; also runs on the CPU backend of XLA for tests).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple, Type
+
+from dprf_tpu.engines.base import HashEngine, DeviceHashEngine, Target  # noqa: F401
+
+_REGISTRY: Dict[Tuple[str, str], type] = {}
+
+
+def register(name: str, device: str = "cpu"):
+    def deco(cls):
+        key = (name.lower(), device)
+        if key in _REGISTRY and _REGISTRY[key] is not cls:
+            raise ValueError(f"duplicate engine registration: {key}")
+        _REGISTRY[key] = cls
+        return cls
+    return deco
+
+
+def get_engine(name: str, device: str = "cpu", **kwargs):
+    _ensure_imported(device)
+    key = (name.lower(), device)
+    if key not in _REGISTRY:
+        have = sorted(n for n, d in _REGISTRY if d == device)
+        raise KeyError(f"no engine {name!r} for device {device!r}; "
+                       f"available: {have}")
+    return _REGISTRY[key](**kwargs)
+
+
+def engine_names(device: str = "cpu") -> list[str]:
+    _ensure_imported(device)
+    return sorted(n for n, d in _REGISTRY if d == device)
+
+
+def _ensure_imported(device: str) -> None:
+    # Import engine modules lazily so `import dprf_tpu` stays light and the
+    # CPU oracle path never pulls in jax.
+    if device == "cpu":
+        import dprf_tpu.engines.cpu.engines  # noqa: F401
+    elif device == "jax":
+        try:
+            import dprf_tpu.engines.device.engines  # noqa: F401
+        except ModuleNotFoundError as e:
+            # Translate only a missing engines.device package into a friendly
+            # error; import failures *inside* it should surface as-is.
+            if e.name and e.name.startswith("dprf_tpu.engines.device"):
+                raise KeyError("jax device engines are not available in this "
+                               "build (dprf_tpu.engines.device missing)") from e
+            raise
+    else:
+        raise KeyError(f"unknown device {device!r} (expected 'cpu' or 'jax')")

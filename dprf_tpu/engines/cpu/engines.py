@@ -1,0 +1,140 @@
+"""CPU reference HashEngines -- the bit-exact oracles.
+
+These fill the role BASELINE.json config 1 calls the "CPU reference
+HashEngine": every device engine must match them exactly, and they are
+the `--device=cpu` execution path of the CLI.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import struct
+from typing import Optional, Sequence
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.base import HashEngine, Target
+from dprf_tpu.engines.cpu.md4 import md4
+from dprf_tpu.engines.cpu import bcrypt as _bcrypt
+
+
+class _HashlibEngine(HashEngine):
+    _algo: str
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        algo = self._algo
+        return [hashlib.new(algo, c).digest() for c in candidates]
+
+
+@register("md5")
+class Md5Engine(_HashlibEngine):
+    name = "md5"
+    digest_size = 16
+    _algo = "md5"
+
+
+@register("sha1")
+class Sha1Engine(_HashlibEngine):
+    name = "sha1"
+    digest_size = 20
+    _algo = "sha1"
+
+
+@register("sha256")
+class Sha256Engine(_HashlibEngine):
+    name = "sha256"
+    digest_size = 32
+    _algo = "sha256"
+
+
+@register("ntlm")
+class NtlmEngine(HashEngine):
+    """NTLM: MD4 over the UTF-16LE encoding of the password."""
+
+    name = "ntlm"
+    digest_size = 16
+    # 27 chars -> 54 UTF-16LE bytes, still a single MD4 block after padding.
+    max_candidate_len = 27
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        out = []
+        for c in candidates:
+            # Candidates are raw bytes; treat them as latin-1 text so the
+            # UTF-16LE widening is the byte-interleave NTLM expects for
+            # the ASCII masks (?l/?u/?d/?s/?a) used by the benchmarks.
+            out.append(md4(c.decode("latin-1").encode("utf-16-le")))
+        return out
+
+
+@register("bcrypt")
+class BcryptEngine(HashEngine):
+    """bcrypt (EksBlowfish).  Salted: digests are per-(candidate, target)."""
+
+    name = "bcrypt"
+    digest_size = 23
+    salted = True
+    max_candidate_len = 72
+
+    def parse_target(self, text: str) -> Target:
+        variant, cost, salt, digest = _bcrypt.parse_hash(text)
+        return Target(raw=text.strip(), digest=digest,
+                      params={"variant": variant, "cost": cost, "salt": salt})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError("bcrypt needs target params (salt, cost)")
+        salt, cost = params["salt"], params["cost"]
+        return [_bcrypt.bcrypt_raw(c, salt, cost) for c in candidates]
+
+
+@register("wpa2-pmkid")
+class Pmkid2Engine(HashEngine):
+    """WPA2-PMKID: PMK = PBKDF2-HMAC-SHA1(pass, essid, 4096, 32);
+    PMKID = HMAC-SHA1(PMK, "PMK Name" | MAC_AP | MAC_STA)[:16].
+
+    Target lines use the hashcat 16800 format:
+    ``pmkid*mac_ap*mac_sta*essid_hex`` (macs as 12 hex chars, no colons).
+    """
+
+    name = "wpa2-pmkid"
+    digest_size = 16
+    salted = True
+    max_candidate_len = 63    # WPA passphrase limit
+
+    def parse_target(self, text: str) -> Target:
+        parts = text.strip().split("*")
+        if len(parts) != 4:
+            raise ValueError(f"expected pmkid*mac_ap*mac_sta*essid, got {text!r}")
+        pmkid, mac_ap, mac_sta, essid_hex = parts
+        digest = bytes.fromhex(pmkid)
+        ap, sta = bytes.fromhex(mac_ap), bytes.fromhex(mac_sta)
+        if len(digest) != self.digest_size:
+            raise ValueError(f"PMKID must be {self.digest_size} bytes, "
+                             f"got {len(digest)} from {text!r}")
+        if len(ap) != 6 or len(sta) != 6:
+            raise ValueError(f"MACs must be 6 bytes each in {text!r}")
+        return Target(
+            raw=text.strip(),
+            digest=digest,
+            params={"essid": bytes.fromhex(essid_hex),
+                    "mac_ap": ap, "mac_sta": sta})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError("wpa2-pmkid needs target params (essid, macs)")
+        message = b"PMK Name" + params["mac_ap"] + params["mac_sta"]
+        out = []
+        for c in candidates:
+            pmk = hashlib.pbkdf2_hmac("sha1", c, params["essid"], 4096, 32)
+            out.append(hmac.new(pmk, message, hashlib.sha1).digest()[:16])
+        return out
+
+
+# Convenience aliases matching common reference spellings.
+register("pmkid")(Pmkid2Engine)
+register("sha-1")(Sha1Engine)
+register("sha-256")(Sha256Engine)

@@ -1,0 +1,14 @@
+"""Test configuration.
+
+Force JAX onto the CPU backend with 8 virtual devices so multi-chip
+sharding paths (shard_map over a Mesh) are exercised without TPU
+hardware, per SURVEY.md section 4.  Must run before jax is imported.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
