@@ -1,0 +1,75 @@
+"""JAX device engines: the TPU-native execution backends.
+
+Each engine exposes (a) `digest_packed` -- the raw jit-traceable digest
+over packed message words, used by the fused crack pipeline; and (b)
+`hash_batch` -- the HashEngine-compatible host API (used by tests and
+`--device=jax` verification paths), which round-trips bytes through the
+device.
+
+Digest word layouts match the CPU oracles bit-for-bit; tests/test_device_engines.py
+checks every engine against the oracle over random candidate batches.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.base import DeviceHashEngine, HashEngine
+from dprf_tpu.ops import pack as pack_ops
+from dprf_tpu.ops.md5 import md5_digest_words
+
+
+class JaxEngineBase(DeviceHashEngine, HashEngine):
+    """Shared packing + host-convenience layer for single-block engines."""
+
+    #: digest words are little-endian uint32 (MD4/MD5 family) or
+    #: big-endian (SHA family); drives target-table layout too.
+    little_endian: bool = True
+    max_candidate_len = 55
+
+    # -- device path -----------------------------------------------------
+
+    def pack(self, cand: jnp.ndarray, length: int) -> jnp.ndarray:
+        """uint8[B, length] candidates -> uint32[B, 16] message words."""
+        return pack_ops.pack_fixed(cand, length,
+                                   big_endian=not self.little_endian)
+
+    def pack_varlen(self, cand: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+        return pack_ops.pack_varlen(cand, lengths,
+                                    big_endian=not self.little_endian)
+
+    # -- host-facing HashEngine API --------------------------------------
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        maxlen = max((len(c) for c in candidates), default=1) or 1
+        if maxlen > self.max_candidate_len:
+            raise ValueError(f"{self.name}: candidate longer than "
+                             f"{self.max_candidate_len} bytes")
+        batch = len(candidates)
+        buf = np.zeros((batch, maxlen), dtype=np.uint8)
+        lengths = np.zeros((batch,), dtype=np.int32)
+        for i, c in enumerate(candidates):
+            buf[i, :len(c)] = np.frombuffer(c, dtype=np.uint8)
+            lengths[i] = len(c)
+        words = self.pack_varlen(jnp.asarray(buf), jnp.asarray(lengths))
+        digest = np.asarray(self.digest_packed(words))
+        dt = "<u4" if self.little_endian else ">u4"
+        return [digest[i].astype(dt).tobytes()[:self.digest_size]
+                for i in range(batch)]
+
+
+@register("md5", device="jax")
+class JaxMd5Engine(JaxEngineBase):
+    name = "md5"
+    digest_size = 16
+    digest_words = 4
+    little_endian = True
+
+    def digest_packed(self, blocks: jnp.ndarray,
+                      lengths=None) -> jnp.ndarray:
+        return md5_digest_words(blocks)

@@ -1,0 +1,2 @@
+from dprf_tpu.generators.base import CandidateGenerator  # noqa: F401
+from dprf_tpu.generators.mask import MaskGenerator  # noqa: F401

@@ -1,0 +1,103 @@
+"""On-device digest comparison and hit compaction.
+
+Single-target: direct word compare.  Multi-target (benchmark config 2):
+targets are pre-sorted by their first digest word on the host; on device
+a vectorized `searchsorted` narrows each candidate to a run of targets
+sharing that word, and a small static window of full-digest compares
+resolves it exactly.  The window size is computed on the host from the
+actual maximum duplicate-run length, so the device code is always
+correct, not just probabilistically so.
+
+Hit extraction is data-dependent-shape-free (SURVEY.md section 7): a
+fixed-capacity buffer filled by scatter, plus a total count.  Overflow
+beyond the capacity loses lane detail but never the count, and the host
+rescans the unit with the CPU oracle in that (pathological) case.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TargetTable:
+    """Host-prepared multi-target compare table (device arrays)."""
+
+    words: jnp.ndarray        # uint32[T, W] sorted digests
+    first: jnp.ndarray        # uint32[T] = words[:, 0] (sort key)
+    window: int               # max duplicate run of `first`, static
+    order: np.ndarray         # host: sorted position -> original target idx
+
+    @property
+    def num_targets(self) -> int:
+        return self.words.shape[0]
+
+
+def make_target_table(digests: list[bytes], word_bytes: int = 4,
+                      little_endian: bool = True) -> TargetTable:
+    """Build the device compare table from raw digest bytes.
+
+    word_bytes=4: digests are split into uint32 words matching the
+    engine's digest word layout (LE for MD4/MD5 family, BE for SHA).
+    """
+    if not digests:
+        raise ValueError("empty target list")
+    nwords = len(digests[0]) // word_bytes
+    rows = np.zeros((len(digests), nwords), dtype=np.uint32)
+    for i, d in enumerate(digests):
+        if len(d) != nwords * word_bytes:
+            raise ValueError("inconsistent digest sizes in target list")
+        rows[i] = np.frombuffer(d, dtype="<u4" if little_endian else ">u4")
+    order = np.lexsort(rows.T[::-1])   # sort by word0, then word1, ...
+    rows = rows[order]
+    first = rows[:, 0]
+    # Longest run of equal word0 values decides how many full compares the
+    # device needs per candidate.  For random hashes this is 1.
+    runs = np.diff(np.flatnonzero(
+        np.concatenate(([True], first[1:] != first[:-1], [True]))))
+    window = int(runs.max())
+    return TargetTable(words=jnp.asarray(rows), first=jnp.asarray(first),
+                      window=window, order=order)
+
+
+def compare_single(digest: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+    """uint32[B, W] vs uint32[W] -> bool[B]."""
+    return jnp.all(digest == target[None, :], axis=-1)
+
+
+def compare_multi(digest: jnp.ndarray, table: TargetTable):
+    """uint32[B, W] vs sorted table -> (found bool[B], target_pos int32[B]).
+
+    target_pos indexes the *sorted* table; map back through table.order
+    on the host.
+    """
+    t = table.num_targets
+    pos = jnp.searchsorted(table.first, digest[:, 0])      # int[B], leftmost
+    found = jnp.zeros(digest.shape[0], dtype=bool)
+    tpos = jnp.zeros(digest.shape[0], dtype=jnp.int32)
+    for k in range(table.window):
+        idx = jnp.minimum(pos + k, t - 1).astype(jnp.int32)
+        hit = jnp.all(table.words[idx] == digest, axis=-1)
+        tpos = jnp.where(hit & ~found, idx, tpos)
+        found = found | hit
+    return found, tpos
+
+
+def compact_hits(found: jnp.ndarray, lane_payload: jnp.ndarray,
+                 capacity: int):
+    """(found bool[B], payload int32[B]) -> fixed-size hit buffer.
+
+    Returns (count int32, lanes int32[capacity], payload int32[capacity]);
+    unused slots are -1.  Pure scatter -- no data-dependent shapes.
+    """
+    lane = jnp.arange(found.shape[0], dtype=jnp.int32)
+    slot = jnp.cumsum(found.astype(jnp.int32)) - 1
+    slot = jnp.where(found, slot, capacity)   # out-of-range -> dropped
+    lanes = jnp.full((capacity,), -1, jnp.int32).at[slot].set(
+        lane, mode="drop")
+    payload = jnp.full((capacity,), -1, jnp.int32).at[slot].set(
+        lane_payload, mode="drop")
+    return found.sum(dtype=jnp.int32), lanes, payload

@@ -1,0 +1,61 @@
+"""MD5 compression (RFC 1321) as vectorized uint32 jnp ops.
+
+The 64 steps are unrolled at trace time into straight-line int32 vector
+code over the batch dimension -- exactly the shape XLA's TPU backend
+vectorizes onto the VPU (8x128 lanes) with every temporary in registers
+/VMEM.  The sine-derived constants are computed here (math.sin), not
+copied from a listing.
+
+Also exports an initial state + compress pair so multi-block uses
+(HMAC, long inputs) can chain.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+K = np.array([int(abs(math.sin(i + 1)) * (1 << 32)) & 0xFFFFFFFF
+              for i in range(64)], dtype=np.uint32)
+_SHIFTS = ((7, 12, 17, 22), (5, 9, 14, 20), (4, 11, 16, 23), (6, 10, 15, 21))
+INIT = np.array([0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476],
+                dtype=np.uint32)
+
+
+def _rotl(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    return (x << jnp.uint32(n)) | (x >> jnp.uint32(32 - n))
+
+
+def md5_compress(state: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
+    """state uint32[..., 4] x words uint32[..., 16] -> uint32[..., 4]."""
+    a, b, c, d = (state[..., i] for i in range(4))
+    m = [words[..., i] for i in range(16)]
+
+    for i in range(64):
+        rnd = i // 16
+        if rnd == 0:
+            f = (b & c) | (~b & d)
+            g = i
+        elif rnd == 1:
+            f = (d & b) | (~d & c)
+            g = (5 * i + 1) % 16
+        elif rnd == 2:
+            f = b ^ c ^ d
+            g = (3 * i + 5) % 16
+        else:
+            f = c ^ (b | ~d)
+            g = (7 * i) % 16
+        tmp = a + f + jnp.uint32(int(K[i])) + m[g]
+        a, d, c, b = d, c, b, (b + _rotl(tmp, _SHIFTS[rnd][i % 4]))
+
+    out = jnp.stack([a, b, c, d], axis=-1)
+    return out + jnp.asarray(INIT)
+
+
+def md5_digest_words(words: jnp.ndarray) -> jnp.ndarray:
+    """Single-block MD5: uint32[B, 16] packed message -> uint32[B, 4]
+    little-endian digest words (word i = digest bytes 4i..4i+3 LE)."""
+    state = jnp.broadcast_to(jnp.asarray(INIT), words.shape[:-1] + (4,))
+    return md5_compress(state, words)

@@ -1,0 +1,88 @@
+"""Candidate packing: uint8 candidate bytes -> Merkle-Damgard message words.
+
+All functions are jit-traceable with static candidate length (the mask
+path -- every candidate in a batch shares one length) or traced lengths
+(the wordlist path).  Words are built with integer multiply-adds rather
+than bitcasts so behavior is identical on the TPU and CPU XLA backends.
+
+Single-block only: candidates up to 55 bytes (27 chars for NTLM's
+UTF-16LE widening), which covers every benchmark config; multi-block
+chaining for long inputs goes through the engines' `compress` functions
+directly (see HMAC in ops/sha1.py usage).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+_LE_COEF = np.array([1, 1 << 8, 1 << 16, 1 << 24], dtype=np.uint32)
+_BE_COEF = _LE_COEF[::-1].copy()
+
+
+def _words_from_bytes(msg: jnp.ndarray, big_endian: bool) -> jnp.ndarray:
+    """uint8[B, 64] -> uint32[B, 16]."""
+    coef = jnp.asarray(_BE_COEF if big_endian else _LE_COEF)
+    grouped = msg.reshape(*msg.shape[:-1], 16, 4).astype(jnp.uint32)
+    return (grouped * coef).sum(axis=-1, dtype=jnp.uint32)
+
+
+def _pad_const(length: int, big_endian: bool) -> np.ndarray:
+    """Static MD padding for a fixed message length: 0x80 marker + 64-bit
+    bit count (LE for MD4/MD5, BE for SHA-1/SHA-256)."""
+    if length > 55:
+        raise ValueError(f"single-block packing needs length <= 55, got {length}")
+    const = np.zeros(64, dtype=np.uint8)
+    const[length] = 0x80
+    bitlen = length * 8
+    if big_endian:
+        const[56:64] = np.frombuffer(bitlen.to_bytes(8, "big"), dtype=np.uint8)
+    else:
+        const[56:64] = np.frombuffer(bitlen.to_bytes(8, "little"), dtype=np.uint8)
+    return const
+
+
+def pack_fixed(cand: jnp.ndarray, length: int,
+               big_endian: bool = False) -> jnp.ndarray:
+    """Pack fixed-length candidates uint8[B, length] -> uint32[B, 16].
+
+    `length` is static, so the padding bytes are a compile-time constant
+    XLA folds straight into the fused kernel.
+    """
+    batch = cand.shape[0]
+    padded = jnp.zeros((batch, 64), dtype=jnp.uint8).at[:, :length].set(cand)
+    msg = padded + jnp.asarray(_pad_const(length, big_endian))
+    return _words_from_bytes(msg, big_endian)
+
+
+def pack_varlen(cand: jnp.ndarray, lengths: jnp.ndarray,
+                big_endian: bool = False) -> jnp.ndarray:
+    """Pack variable-length candidates uint8[B, maxlen] -> uint32[B, 16].
+
+    lengths: int32[B] actual byte counts (<= 55).  The 0x80 marker and
+    bit-count are placed per lane with vectorized selects -- no gathers,
+    no dynamic shapes.
+    """
+    batch, maxlen = cand.shape
+    if maxlen > 55:
+        raise ValueError("single-block packing needs maxlen <= 55")
+    pos = jnp.arange(64, dtype=jnp.int32)
+    lens = lengths[:, None]
+    padded = jnp.zeros((batch, 64), dtype=jnp.uint8).at[:, :maxlen].set(cand)
+    msg = jnp.where(pos < lens, padded, 0).astype(jnp.uint8)
+    msg = msg + jnp.where(pos == lens, jnp.uint8(0x80), jnp.uint8(0))
+    words = _words_from_bytes(msg, big_endian)
+    bits = (lengths.astype(jnp.uint32) * 8)
+    if big_endian:
+        # bit count < 2^32 always (len <= 55): high word 14 stays 0.
+        words = words.at[:, 15].set(bits)
+    else:
+        words = words.at[:, 14].set(bits)
+    return words
+
+
+def utf16le_widen(cand: jnp.ndarray) -> jnp.ndarray:
+    """uint8[B, L] latin-1 bytes -> uint8[B, 2L] UTF-16LE (NTLM input)."""
+    batch, length = cand.shape
+    wide = jnp.zeros((batch, length, 2), dtype=jnp.uint8).at[:, :, 0].set(cand)
+    return wide.reshape(batch, 2 * length)

@@ -1,0 +1,67 @@
+"""The fused crack step: index -> candidate -> digest -> compare -> hits.
+
+This is the framework's hot loop (SURVEY.md section 3): one jitted
+program in which candidates are materialized, hashed, and compared
+entirely on device.  Only a fixed-size hit buffer and a count ever cross
+back to the host.
+
+The step takes the work unit's base index as a mixed-radix digit vector
+(int32[L]) plus a valid-lane count, so a single compiled program serves
+every unit of a job regardless of keyspace size.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from dprf_tpu.engines.base import DeviceHashEngine
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.ops import compare as cmp_ops
+
+
+def make_mask_crack_step(engine, gen: MaskGenerator,
+                         targets: Union[jnp.ndarray, cmp_ops.TargetTable],
+                         batch: int, hit_capacity: int = 64,
+                         widen_utf16: bool = False):
+    """Build the jitted fused step for a mask attack.
+
+    engine: a DeviceHashEngine (jax device variant).
+    targets: uint32[W] single target words, or a TargetTable.
+    Returns step(base_digits int32[L], n_valid int32) ->
+        (count int32, lanes int32[cap], target_pos int32[cap]).
+    """
+    flat = gen.flat_charsets
+    length = gen.length
+    multi = isinstance(targets, cmp_ops.TargetTable)
+
+    @jax.jit
+    def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        if widen_utf16:
+            cand_bytes = jnp.reshape(
+                jnp.stack([cand, jnp.zeros_like(cand)], axis=-1),
+                (batch, 2 * length))
+            words = engine.pack(cand_bytes, 2 * length)
+        else:
+            words = engine.pack(cand, length)
+        digest = engine.digest_packed(words)
+        if multi:
+            found, tpos = cmp_ops.compare_multi(digest, targets)
+        else:
+            found = cmp_ops.compare_single(digest, targets)
+            tpos = jnp.zeros((batch,), jnp.int32)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, tpos, hit_capacity)
+
+    return step
+
+
+def target_words(digest: bytes, little_endian: bool = True) -> jnp.ndarray:
+    """Raw digest bytes -> uint32[W] in the engine's word layout."""
+    import numpy as np
+    return jnp.asarray(np.frombuffer(
+        digest, dtype="<u4" if little_endian else ">u4"))

@@ -1,14 +1,24 @@
-"""Test configuration.
+"""Test configuration: hermetic CPU-mesh execution.
 
-Force JAX onto the CPU backend with 8 virtual devices so multi-chip
-sharding paths (shard_map over a Mesh) are exercised without TPU
-hardware, per SURVEY.md section 4.  Must run before jax is imported.
+The session environment registers an axon TPU-tunnel PJRT plugin in
+every python process (sitecustomize on PYTHONPATH) and forces
+``jax_platforms`` to "axon,cpu" via jax.config.update -- so env vars
+alone cannot keep tests off the TPU tunnel (which serves one client at
+a time and wedges if a test run is killed).  Override the config back
+to plain CPU here, before any backend initializes, and give the CPU
+platform 8 virtual devices so multi-chip sharding paths run without
+hardware (SURVEY.md section 4's fake-mesh strategy).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+# For any subprocess a test might spawn:
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
