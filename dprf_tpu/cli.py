@@ -1,0 +1,247 @@
+"""The dprf command-line interface.
+
+Flag surface pinned to BASELINE.json's north star: ``dprf crack
+--engine=<algo> --device=tpu -a mask <mask> <hashfile>`` -- jobs that
+ran against the reference's CPU engines select the TPU backend with
+--device and otherwise run unchanged.  Subcommands: crack, bench,
+engines, keyspace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+from dprf_tpu import engine_names, get_engine
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.runtime.coordinator import Coordinator, JobSpec
+from dprf_tpu.runtime.dispatcher import Dispatcher
+from dprf_tpu.runtime.potfile import Potfile
+from dprf_tpu.runtime.session import SessionJournal, job_fingerprint
+from dprf_tpu.runtime.worker import CpuWorker, DeviceMaskWorker
+from dprf_tpu.utils.hashlist import load_hashlist
+from dprf_tpu.utils.logging import Log
+
+_DEVICE_ALIASES = {"tpu": "jax", "jax": "jax", "cpu": "cpu"}
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dprf", description="TPU-native distributed password recovery")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    c = sub.add_parser("crack", help="run a recovery job")
+    c.add_argument("attack_arg", help="mask string (mask attack) or "
+                   "wordlist path (wordlist attack)")
+    c.add_argument("hashfile", help="file of target hashes")
+    c.add_argument("--engine", "-m", required=True,
+                   help="hash algorithm (see `dprf engines`)")
+    c.add_argument("--device", default="tpu", choices=sorted(_DEVICE_ALIASES),
+                   help="execution backend (tpu == the JAX device path)")
+    c.add_argument("-a", "--attack", default="mask",
+                   choices=["mask", "wordlist"])
+    c.add_argument("--rules", default=None,
+                   help="rule set for wordlist attacks (e.g. best64)")
+    for i in range(1, 5):
+        c.add_argument(f"--custom{i}", default=None,
+                       help=f"custom charset ?{i}")
+    c.add_argument("--session", default=None,
+                   help="session journal path (enables checkpoint/resume)")
+    c.add_argument("--restore", action="store_true",
+                   help="resume from --session journal")
+    c.add_argument("--potfile", default="dprf.potfile")
+    c.add_argument("--no-potfile", action="store_true")
+    c.add_argument("--unit-size", type=int, default=1 << 22)
+    c.add_argument("--batch", type=int, default=1 << 18)
+    c.add_argument("--hit-cap", type=int, default=64)
+    c.add_argument("--quiet", "-q", action="store_true")
+
+    b = sub.add_parser("bench", help="measure engine throughput")
+    b.add_argument("--engine", "-m", default="md5")
+    b.add_argument("--device", default="tpu", choices=sorted(_DEVICE_ALIASES))
+    b.add_argument("--mask", default="?a?a?a?a?a?a?a?a")
+    b.add_argument("--batch", type=int, default=1 << 20)
+    b.add_argument("--seconds", type=float, default=5.0)
+    b.add_argument("--quiet", "-q", action="store_true")
+
+    e = sub.add_parser("engines", help="list available engines")
+    e.add_argument("--device", default=None)
+
+    k = sub.add_parser("keyspace", help="print keyspace size of a mask")
+    k.add_argument("mask")
+    for i in range(1, 5):
+        k.add_argument(f"--custom{i}", default=None)
+    return p
+
+
+def _customs(args) -> dict:
+    out = {}
+    for i in range(1, 5):
+        v = getattr(args, f"custom{i}", None)
+        if v is not None:
+            out[i] = v.encode("latin-1")
+    return out
+
+
+def cmd_crack(args, log: Log) -> int:
+    device = _DEVICE_ALIASES[args.device]
+    engine = get_engine(args.engine, device="cpu")   # parser/oracle always CPU
+    hl = load_hashlist(engine, args.hashfile)
+    for no, text, err in hl.skipped:
+        log.warn("skipping hashlist line", line=no, error=err)
+    if not hl.targets:
+        log.error("no valid targets in hashlist")
+        return 2
+    log.info("loaded targets", count=len(hl.targets),
+             duplicates=hl.duplicates, engine=engine.name)
+
+    if args.attack != "mask":
+        log.error("wordlist attacks land with the rules engine; "
+                  "only mask attacks are wired up so far")
+        return 2
+    customs = _customs(args)
+    gen = MaskGenerator(args.attack_arg, custom=customs or None)
+    log.info("keyspace", mask=args.attack_arg, size=gen.keyspace)
+
+    # Custom charsets change which candidate an index decodes to, so they
+    # are part of the job identity.
+    attack_desc = f"mask:{args.attack_arg}" + "".join(
+        f":{i}={customs[i].hex()}" for i in sorted(customs))
+    spec = JobSpec(engine=engine.name, device=device, attack="mask",
+                   attack_arg=args.attack_arg, keyspace=gen.keyspace,
+                   fingerprint=job_fingerprint(
+                       engine.name, attack_desc, gen.keyspace,
+                       [t.digest for t in hl.targets]))
+
+    # Session / resume
+    session = None
+    completed: list = []
+    restored_hits: list = []
+    if args.session:
+        session = SessionJournal(args.session)
+        prior = SessionJournal.load(args.session)
+        if args.restore:
+            if prior is None:
+                log.warn("no session to restore; starting fresh")
+            elif prior.spec.get("fingerprint") != spec.fingerprint:
+                log.error("session file belongs to a different job",
+                          theirs=prior.spec.get("fingerprint"),
+                          ours=spec.fingerprint)
+                return 2
+            else:
+                completed = prior.completed
+                restored_hits = prior.hits
+                done = sum(e - s for s, e in completed)
+                log.info("resuming session", covered=done,
+                         hits=len(restored_hits))
+        elif prior is not None:
+            log.error("session file exists; pass --restore to resume "
+                      "or remove it", path=args.session)
+            return 2
+
+    if completed:
+        dispatcher = Dispatcher.from_completed(
+            gen.keyspace, args.unit_size, completed)
+    else:
+        dispatcher = Dispatcher(gen.keyspace, args.unit_size)
+
+    # Worker selection: the device path covers unsalted mask attacks;
+    # salted engines fall back to the oracle until their device engines
+    # land (bcrypt/PBKDF2 tasks in flight).
+    if device == "jax" and not engine.salted:
+        try:
+            dev_engine = get_engine(args.engine, device="jax")
+        except KeyError:
+            dev_engine = None
+        if dev_engine is None:
+            log.warn("no jax engine for algorithm; using cpu oracle",
+                     engine=args.engine)
+            worker = CpuWorker(engine, gen, hl.targets)
+        else:
+            worker = DeviceMaskWorker(dev_engine, gen, hl.targets,
+                                      batch=args.batch,
+                                      hit_capacity=args.hit_cap,
+                                      oracle=engine)
+    else:
+        if device == "jax":
+            log.warn("salted engine on device path not yet wired; "
+                     "using cpu oracle", engine=args.engine)
+        worker = CpuWorker(engine, gen, hl.targets)
+
+    potfile = None if args.no_potfile else Potfile(args.potfile)
+
+    def progress(done, total, nfound, rate):
+        log.info("progress", pct=f"{100.0 * done / total:.2f}%",
+                 found=f"{nfound}/{len(hl.targets)}",
+                 rate=f"{rate:,.0f}/s")
+
+    coord = Coordinator(spec, hl.targets, dispatcher, worker,
+                        session=session, potfile=potfile,
+                        progress_cb=None if args.quiet else progress)
+    coord.preload_found()
+    coord.restore_hits(restored_hits)
+    if coord.found:
+        log.info("pre-cracked targets", count=len(coord.found))
+
+    result = coord.run()
+
+    for ti, plain in sorted(result.found.items()):
+        from dprf_tpu.runtime.potfile import encode_plain
+        print(f"{hl.targets[ti].raw}:{encode_plain(plain)}")
+    log.info("job finished",
+             found=f"{len(result.found)}/{len(hl.targets)}",
+             tested=result.tested, elapsed=f"{result.elapsed:.2f}s",
+             rate=f"{result.rate:,.0f}/s",
+             exhausted=result.exhausted)
+    return 0 if result.found else 1
+
+
+def cmd_bench(args, log: Log) -> int:
+    import json
+    from dprf_tpu.bench import run_bench
+    res = run_bench(engine=args.engine,
+                    device=_DEVICE_ALIASES[args.device],
+                    mask=args.mask, batch=args.batch,
+                    seconds=args.seconds, log=log)
+    print(json.dumps(res))
+    return 0
+
+
+def cmd_engines(args, log: Log) -> int:
+    devices = [args.device] if args.device else ["cpu", "jax"]
+    for dev in devices:
+        try:
+            names = engine_names(dev)
+        except KeyError:
+            names = []
+        print(f"{dev}: {', '.join(names)}")
+    return 0
+
+
+def cmd_keyspace(args, log: Log) -> int:
+    gen = MaskGenerator(args.mask, custom=_customs(args) or None)
+    print(gen.keyspace)
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    log = Log(quiet=getattr(args, "quiet", False))
+    try:
+        if args.command == "crack":
+            return cmd_crack(args, log)
+        if args.command == "bench":
+            return cmd_bench(args, log)
+        if args.command == "engines":
+            return cmd_engines(args, log)
+        if args.command == "keyspace":
+            return cmd_keyspace(args, log)
+    except (ValueError, KeyError, OSError) as e:
+        log.error(str(e))
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+from dprf_tpu.runtime.workunit import WorkUnit  # noqa: F401
+from dprf_tpu.runtime.dispatcher import Dispatcher  # noqa: F401
+from dprf_tpu.runtime.coordinator import Coordinator, JobSpec  # noqa: F401

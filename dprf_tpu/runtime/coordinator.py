@@ -1,0 +1,136 @@
+"""Coordinator: owns the job -- issues WorkUnits, collects hits,
+persists progress, decides when to stop.
+
+The control plane (SURVEY.md section 1): everything here is thin host
+code; the hot loop lives in the workers' fused device programs.  Hits
+are deduped per target, written to the potfile and the session journal,
+and the job stops when every target is cracked or the keyspace is
+exhausted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional, Sequence
+
+from dprf_tpu.engines.base import Target
+from dprf_tpu.runtime.dispatcher import Dispatcher
+from dprf_tpu.runtime.potfile import Potfile
+from dprf_tpu.runtime.session import SessionJournal
+from dprf_tpu.runtime.worker import Hit
+
+
+@dataclasses.dataclass
+class JobSpec:
+    engine: str
+    device: str
+    attack: str                 # "mask" | "wordlist"
+    attack_arg: str             # mask string or wordlist path
+    keyspace: int
+    fingerprint: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class JobResult:
+    found: dict                  # target_index -> plaintext bytes
+    tested: int
+    elapsed: float
+    exhausted: bool
+
+    @property
+    def rate(self) -> float:
+        return self.tested / self.elapsed if self.elapsed > 0 else 0.0
+
+
+class Coordinator:
+    def __init__(self, spec: JobSpec, targets: Sequence[Target],
+                 dispatcher: Dispatcher, worker,
+                 session: Optional[SessionJournal] = None,
+                 potfile: Optional[Potfile] = None,
+                 progress_cb: Optional[Callable] = None,
+                 progress_interval: float = 5.0):
+        self.spec = spec
+        self.targets = list(targets)
+        self.dispatcher = dispatcher
+        self.worker = worker
+        self.session = session
+        self.potfile = potfile
+        self.progress_cb = progress_cb
+        self.progress_interval = progress_interval
+        self.found: dict[int, bytes] = {}
+
+    # -- pre-run bookkeeping ---------------------------------------------
+
+    def preload_found(self) -> None:
+        """Mark targets already cracked (potfile) or recorded in a resumed
+        session so work stops early / never starts."""
+        if self.potfile is not None:
+            for i, t in enumerate(self.targets):
+                plain = self.potfile.get(t.raw)
+                if plain is not None:
+                    self.found.setdefault(i, plain)
+
+    def restore_hits(self, hits: list) -> None:
+        for h in hits:
+            try:
+                self.found.setdefault(int(h["target"]),
+                                      bytes.fromhex(h["plaintext"]))
+            except (KeyError, ValueError):
+                continue
+
+    # -- the run loop ----------------------------------------------------
+
+    def _all_found(self) -> bool:
+        return len(self.found) >= len(self.targets)
+
+    def _record(self, hit: Hit) -> None:
+        if hit.target_index in self.found:
+            return
+        self.found[hit.target_index] = hit.plaintext
+        target = self.targets[hit.target_index]
+        if self.potfile is not None:
+            self.potfile.add(target.raw, hit.plaintext)
+        if self.session is not None:
+            self.session.record_hit(hit.target_index, hit.cand_index,
+                                    hit.plaintext)
+
+    def run(self) -> JobResult:
+        t0 = time.perf_counter()
+        tested0 = self.dispatcher.progress()[0]
+        last_report = t0
+        if self.session is not None:
+            self.session.open(self.spec.as_dict())
+        try:
+            while not self._all_found() and not self.dispatcher.done():
+                unit = self.dispatcher.lease()
+                if unit is None:
+                    if self.dispatcher.outstanding_count() == 0:
+                        break        # exhausted
+                    time.sleep(0.01)
+                    continue
+                for hit in self.worker.process(unit):
+                    self._record(hit)
+                self.dispatcher.complete(unit.unit_id)
+                if self.session is not None:
+                    self.session.record_units(
+                        self.dispatcher.completed_intervals())
+                now = time.perf_counter()
+                if self.progress_cb and now - last_report >= self.progress_interval:
+                    last_report = now
+                    done, total = self.dispatcher.progress()
+                    self.progress_cb(done, total, len(self.found),
+                                     (done - tested0) / max(now - t0, 1e-9))
+        finally:
+            # Snapshot in finally: a Ctrl-C mid-job must not lose up to
+            # snapshot_every-1 units of journaled coverage.
+            if self.session is not None:
+                self.session.snapshot(self.dispatcher.completed_intervals())
+                self.session.close()
+        elapsed = time.perf_counter() - t0
+        done, total = self.dispatcher.progress()
+        return JobResult(found=dict(self.found), tested=done - tested0,
+                         elapsed=elapsed, exhausted=done >= total)

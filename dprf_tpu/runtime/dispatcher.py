@@ -1,0 +1,180 @@
+"""Dispatcher: keyspace splitter + work-unit lease ledger.
+
+Units are generated lazily (a keyspace of 95^7 would be ~66M units --
+never materialized).  The ledger tracks three populations:
+
+  - issued-and-outstanding units, each with a lease deadline;
+  - a reissue queue (failed or lease-expired units);
+  - a completed-interval set, kept as merged [start, end) ranges so the
+    resume journal stays tiny no matter how many units ran.
+
+Failure detection / elastic recovery (SURVEY.md section 5): a worker
+that stops heartbeating simply lets its lease expire; `reap_expired`
+moves the unit to the reissue queue and another worker picks it up.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+class IntervalSet:
+    """Sorted, merged set of [start, end) integer intervals."""
+
+    def __init__(self, intervals=()):
+        self._iv: list[list] = []
+        for s, e in intervals:
+            self.add(s, e)
+
+    def add(self, start: int, end: int) -> None:
+        if end <= start:
+            return
+        iv = self._iv
+        # binary search for insertion point by start
+        lo, hi = 0, len(iv)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if iv[mid][0] < start:
+                lo = mid + 1
+            else:
+                hi = mid
+        # merge with predecessor if touching
+        i = lo
+        if i > 0 and iv[i - 1][1] >= start:
+            i -= 1
+            iv[i][1] = max(iv[i][1], end)
+        else:
+            iv.insert(i, [start, end])
+        # absorb successors
+        j = i + 1
+        while j < len(iv) and iv[j][0] <= iv[i][1]:
+            iv[i][1] = max(iv[i][1], iv[j][1])
+            j += 1
+        del iv[i + 1:j]
+
+    def covered(self) -> int:
+        return sum(e - s for s, e in self._iv)
+
+    def contains_range(self, start: int, end: int) -> bool:
+        for s, e in self._iv:
+            if s <= start and end <= e:
+                return True
+        return False
+
+    def gaps(self, upto: int) -> list[tuple]:
+        """Uncovered ranges within [0, upto)."""
+        out, prev = [], 0
+        for s, e in self._iv:
+            if s >= upto:
+                break
+            if s > prev:
+                out.append((prev, min(s, upto)))
+            prev = max(prev, e)
+        if prev < upto:
+            out.append((prev, upto))
+        return out
+
+    def intervals(self) -> list[tuple]:
+        return [(s, e) for s, e in self._iv]
+
+
+class Dispatcher:
+    """Split [0, keyspace) into WorkUnits; lease, complete, reissue."""
+
+    def __init__(self, keyspace: int, unit_size: int,
+                 lease_timeout: float = 300.0,
+                 clock: Optional[Callable[[], float]] = None):
+        if unit_size <= 0:
+            raise ValueError("unit_size must be positive")
+        self.keyspace = keyspace
+        self.unit_size = unit_size
+        self.lease_timeout = lease_timeout
+        self._clock = clock or time.monotonic
+        self._next_start = 0
+        self._next_id = 0
+        self._pending: deque[WorkUnit] = deque()
+        self._outstanding: dict[int, tuple] = {}   # id -> (unit, worker, deadline)
+        self._done = IntervalSet()
+
+    # -- construction from a resume journal ------------------------------
+
+    @classmethod
+    def from_completed(cls, keyspace: int, unit_size: int,
+                       completed: list, **kw) -> "Dispatcher":
+        d = cls(keyspace, unit_size, **kw)
+        for s, e in completed:
+            d._done.add(s, e)
+        frontier = max((e for _, e in completed), default=0)
+        for s, e in d._done.gaps(frontier):
+            # re-split big gaps into unit-sized pieces
+            for u in range(s, e, unit_size):
+                d._pending.append(d._make_unit(u, min(unit_size, e - u)))
+        d._next_start = frontier
+        return d
+
+    def _make_unit(self, start: int, length: int) -> WorkUnit:
+        u = WorkUnit(self._next_id, start, length)
+        self._next_id += 1
+        return u
+
+    # -- the worker-facing API -------------------------------------------
+
+    def lease(self, worker_id: str = "local") -> Optional[WorkUnit]:
+        """Hand out the next unit, or None if nothing is leasable now
+        (either exhausted, or all remaining work is outstanding)."""
+        self.reap_expired()
+        if self._pending:
+            unit = self._pending.popleft()
+        elif self._next_start < self.keyspace:
+            length = min(self.unit_size, self.keyspace - self._next_start)
+            unit = self._make_unit(self._next_start, length)
+            self._next_start += length
+        else:
+            return None
+        self._outstanding[unit.unit_id] = (
+            unit, worker_id, self._clock() + self.lease_timeout)
+        return unit
+
+    def complete(self, unit_id: int) -> None:
+        entry = self._outstanding.pop(unit_id, None)
+        if entry is None:
+            return   # late completion of an already-reissued unit: idempotent
+        unit = entry[0]
+        self._done.add(unit.start, unit.end)
+
+    def fail(self, unit_id: int) -> None:
+        entry = self._outstanding.pop(unit_id, None)
+        if entry is not None:
+            self._pending.append(entry[0])
+
+    def reap_expired(self) -> int:
+        now = self._clock()
+        expired = [uid for uid, (_, _, dl) in self._outstanding.items()
+                   if dl < now]
+        for uid in expired:
+            self._pending.append(self._outstanding.pop(uid)[0])
+        return len(expired)
+
+    # -- status ----------------------------------------------------------
+
+    def done(self) -> bool:
+        return (self._done.covered() >= self.keyspace)
+
+    def idle(self) -> bool:
+        """Nothing leasable and nothing outstanding (but not done:
+        happens only transiently between reap and re-lease)."""
+        return (not self._pending and not self._outstanding
+                and self._next_start >= self.keyspace)
+
+    def progress(self) -> tuple:
+        return self._done.covered(), self.keyspace
+
+    def completed_intervals(self) -> list[tuple]:
+        return self._done.intervals()
+
+    def outstanding_count(self) -> int:
+        return len(self._outstanding)

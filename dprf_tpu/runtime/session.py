@@ -1,0 +1,112 @@
+"""Session journal: checkpoint/resume for crack jobs.
+
+Append-only JSONL (SURVEY.md section 5: "coordinator journals (unit
+ledger, cracked set) to disk; resume = reload ledger, re-dispatch
+incomplete units").  No device state is ever checkpointed -- units are
+pure functions of their index range, so the journal is just:
+
+  {"type": "header", "spec": {...}}          job identity (guards resume)
+  {"type": "units", "intervals": [[s,e],..]} completed-coverage snapshot
+  {"type": "hit", "target": t, "index": i, "plaintext": hex}
+
+Coverage is re-snapshotted (merged intervals) every `snapshot_every`
+completions, so the file stays small and resume cost is O(intervals),
+not O(units run).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class SessionState:
+    spec: dict
+    completed: list          # [(start, end), ...]
+    hits: list               # [{"target": int, "index": int, "plaintext": str}]
+
+
+class SessionJournal:
+    def __init__(self, path: str, snapshot_every: int = 64):
+        self.path = path
+        self.snapshot_every = snapshot_every
+        self._since_snapshot = 0
+        self._fh = None
+
+    # -- writing ---------------------------------------------------------
+
+    def open(self, spec: dict) -> None:
+        fresh = not os.path.exists(self.path)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        if fresh:
+            self._emit({"type": "header", "spec": spec})
+
+    def _emit(self, obj: dict) -> None:
+        self._fh.write(json.dumps(obj, separators=(",", ":")) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def record_units(self, intervals: list) -> None:
+        self._since_snapshot += 1
+        if self._since_snapshot >= self.snapshot_every:
+            self._since_snapshot = 0
+            self._emit({"type": "units",
+                        "intervals": [[s, e] for s, e in intervals]})
+
+    def snapshot(self, intervals: list) -> None:
+        self._emit({"type": "units",
+                    "intervals": [[s, e] for s, e in intervals]})
+
+    def record_hit(self, target_index: int, cand_index: int,
+                   plaintext: bytes) -> None:
+        self._emit({"type": "hit", "target": target_index,
+                    "index": cand_index, "plaintext": plaintext.hex()})
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+    # -- reading ---------------------------------------------------------
+
+    @staticmethod
+    def load(path: str) -> Optional[SessionState]:
+        if not os.path.exists(path):
+            return None
+        spec, completed, hits = {}, [], []
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    continue   # torn tail write from a killed run
+                t = obj.get("type")
+                if t == "header":
+                    spec = obj["spec"]
+                elif t == "units":
+                    completed = [(s, e) for s, e in obj["intervals"]]
+                elif t == "hit":
+                    hits.append(obj)
+        return SessionState(spec=spec, completed=completed, hits=hits)
+
+
+def job_fingerprint(engine: str, attack: str, keyspace: int,
+                    target_digests: list) -> str:
+    """Stable identity of a job; resuming with a different job on the
+    same session file is an error, not silent corruption.
+
+    Digest ORDER matters: session hits are journaled by positional
+    target index, so a reordered hashfile is a different job.
+    """
+    import hashlib
+    h = hashlib.sha256()
+    h.update(f"{engine}|{attack}|{keyspace}|".encode())
+    for d in target_digests:
+        h.update(d)
+    return h.hexdigest()[:16]

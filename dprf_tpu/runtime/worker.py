@@ -1,0 +1,127 @@
+"""Workers: fetch WorkUnit -> generate candidates -> hash -> report hits.
+
+DeviceMaskWorker is the TPU path: one fused jitted step per job
+(ops/pipeline.py), asynchronously dispatched per batch so the device
+pipeline never drains; results are resolved after the whole unit is
+queued.  Only hit buffers cross back to the host.
+
+CpuWorker is the reference path (`--device=cpu`): oracle engines over
+host-materialized candidates.  It is also the fallback that rescans a
+batch exactly if a device hit buffer ever overflows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from dprf_tpu.engines.base import HashEngine, Target
+from dprf_tpu.generators.base import CandidateGenerator
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+@dataclasses.dataclass(frozen=True)
+class Hit:
+    target_index: int      # position in the job's target list
+    cand_index: int        # global keyspace index
+    plaintext: bytes
+
+
+class CpuWorker:
+    """Oracle-engine worker; handles salted and unsalted engines."""
+
+    def __init__(self, engine: HashEngine, gen: CandidateGenerator,
+                 targets: Sequence[Target], chunk: int = 2048):
+        self.engine = engine
+        self.gen = gen
+        self.targets = list(targets)
+        self.chunk = chunk
+        self._digest_map = {t.digest: i for i, t in enumerate(self.targets)}
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        hits: list[Hit] = []
+        for start in range(unit.start, unit.end, self.chunk):
+            n = min(self.chunk, unit.end - start)
+            cands = self.gen.candidates(start, n)
+            if self.engine.salted:
+                for ti, t in enumerate(self.targets):
+                    for j, d in enumerate(self.engine.hash_batch(
+                            cands, params=t.params)):
+                        if d == t.digest:
+                            hits.append(Hit(ti, start + j, cands[j]))
+            else:
+                for j, d in enumerate(self.engine.hash_batch(cands)):
+                    ti = self._digest_map.get(d)
+                    if ti is not None:
+                        hits.append(Hit(ti, start + j, cands[j]))
+        return hits
+
+
+class DeviceMaskWorker:
+    """Fused-pipeline worker for mask attacks on fast (unsalted) hashes."""
+
+    def __init__(self, engine, gen, targets: Sequence[Target],
+                 batch: int = 1 << 18, hit_capacity: int = 64,
+                 oracle: Optional[HashEngine] = None):
+        import jax.numpy as jnp
+        from dprf_tpu.ops import compare as cmp_ops
+        from dprf_tpu.ops.pipeline import make_mask_crack_step, target_words
+
+        self._jnp = jnp
+        self.engine = engine
+        self.gen = gen
+        self.targets = list(targets)
+        self.batch = batch
+        self.hit_capacity = hit_capacity
+        self.oracle = oracle
+        digests = [t.digest for t in self.targets]
+        self.multi = len(digests) > 1
+        if self.multi:
+            table = cmp_ops.make_target_table(
+                digests, little_endian=engine.little_endian)
+            self._order = table.order
+            tgt = table
+        else:
+            self._order = np.zeros(1, dtype=np.int64)
+            tgt = target_words(digests[0], engine.little_endian)
+        self.step = make_mask_crack_step(
+            engine, gen, tgt, batch, hit_capacity,
+            widen_utf16=getattr(engine, "widen_utf16", False))
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        jnp = self._jnp
+        queued = []
+        for bstart in range(unit.start, unit.end, self.batch):
+            n_valid = min(self.batch, unit.end - bstart)
+            base = jnp.asarray(self.gen.digits(bstart), dtype=jnp.int32)
+            queued.append((bstart, self.step(base, jnp.int32(n_valid))))
+        hits: list[Hit] = []
+        for bstart, (count, lanes, tpos) in queued:
+            count = int(count)
+            if count == 0:
+                continue
+            if count > self.hit_capacity:
+                if self.oracle is None:
+                    raise RuntimeError(
+                        f"hit buffer overflow ({count} > {self.hit_capacity}) "
+                        "and no oracle engine to rescan with; raise hit_capacity")
+                hits.extend(self._rescan(bstart, unit))
+                continue
+            lanes_np = np.asarray(lanes)
+            tpos_np = np.asarray(tpos)
+            for lane, tp in zip(lanes_np, tpos_np):
+                if lane < 0:
+                    continue
+                gidx = bstart + int(lane)
+                ti = int(self._order[int(tp)]) if self.multi else 0
+                hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+    def _rescan(self, bstart: int, unit: WorkUnit) -> list[Hit]:
+        """Exact host rescan of one overflowed batch (pathological case:
+        more hits in a batch than the device hit buffer holds)."""
+        end = min(bstart + self.batch, unit.end)
+        sub = WorkUnit(-1, bstart, end - bstart)
+        return CpuWorker(self.oracle, self.gen, self.targets).process(sub)

@@ -1,0 +1,48 @@
+"""Hashlist parsing: target file -> list of Target.
+
+Lines are parsed by the selected engine (bare hex digests for fast
+hashes, modular-crypt strings for bcrypt, 16800-format for PMKID).
+Blank lines and '#' comments are skipped; duplicates are dropped
+preserving first occurrence; malformed lines are collected, not fatal
+-- a 1k-hash list with one bad line should still crack the other 999.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+from dprf_tpu.engines.base import HashEngine, Target
+
+
+@dataclasses.dataclass
+class HashlistResult:
+    targets: list
+    skipped: list        # (line_number, text, error)
+    duplicates: int
+
+
+def parse_lines(engine: HashEngine, lines: Sequence[str]) -> HashlistResult:
+    targets: list[Target] = []
+    seen: set[str] = set()
+    skipped, dups = [], 0
+    for no, raw in enumerate(lines, 1):
+        text = raw.strip()
+        if not text or text.startswith("#"):
+            continue
+        try:
+            t = engine.parse_target(text)
+        except ValueError as e:
+            skipped.append((no, text, str(e)))
+            continue
+        if t.raw in seen:
+            dups += 1
+            continue
+        seen.add(t.raw)
+        targets.append(t)
+    return HashlistResult(targets=targets, skipped=skipped, duplicates=dups)
+
+
+def load_hashlist(engine: HashEngine, path: str) -> HashlistResult:
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        return parse_lines(engine, fh.readlines())

@@ -1,0 +1,137 @@
+"""End-to-end CLI tests: plant a password, crack it, resume a session.
+
+SURVEY.md section 4: "plant a known password in a tiny keyspace; assert
+it is found and the session resumes correctly after a simulated kill."
+"""
+
+import hashlib
+import io
+import json
+
+import pytest
+
+from dprf_tpu.cli import main
+from dprf_tpu.runtime.potfile import Potfile
+
+
+def run_cli(argv, capsys):
+    rc = main(argv)
+    out = capsys.readouterr().out
+    return rc, out
+
+
+def _mk_hashfile(tmp_path, digests, name="hashes.txt"):
+    p = tmp_path / name
+    p.write_text("\n".join(digests) + "\n")
+    return str(p)
+
+
+@pytest.fixture
+def md5_of():
+    return lambda b: hashlib.md5(b).hexdigest()
+
+
+@pytest.mark.parametrize("device", ["cpu", "tpu"])
+def test_crack_planted_password(tmp_path, capsys, md5_of, device):
+    hashfile = _mk_hashfile(tmp_path, [md5_of(b"xyz")])
+    pot = str(tmp_path / "t.pot")
+    rc, out = run_cli(["crack", "?l?l?l", hashfile, "--engine", "md5",
+                       "--device", device, "--potfile", pot,
+                       "--unit-size", "4096", "--batch", "1024", "-q"], capsys)
+    assert rc == 0
+    assert f"{md5_of(b'xyz')}:xyz" in out
+    assert Potfile(pot).get(md5_of(b"xyz")) == b"xyz"
+
+
+def test_crack_multi_hash_list(tmp_path, capsys, md5_of):
+    words = [b"aa", b"mz", b"zz"]
+    digests = [md5_of(w) for w in words] + [md5_of(b"too-long-not-in-space")]
+    hashfile = _mk_hashfile(tmp_path, digests)
+    rc, out = run_cli(["crack", "?l?l", hashfile, "--engine", "md5",
+                       "--device", "tpu", "--no-potfile",
+                       "--unit-size", "256", "--batch", "128", "-q"], capsys)
+    # one target is uncrackable -> exhausted, rc 0 because others found
+    assert rc == 0
+    for w in words:
+        assert f"{md5_of(w)}:{w.decode()}" in out
+    assert md5_of(b"too-long-not-in-space") + ":" not in out
+
+
+def test_no_match_exhausts_with_rc1(tmp_path, capsys, md5_of):
+    hashfile = _mk_hashfile(tmp_path, [md5_of(b"NOPE")])
+    rc, out = run_cli(["crack", "?d?d", hashfile, "--engine", "md5",
+                       "--device", "cpu", "--no-potfile", "-q"], capsys)
+    assert rc == 1
+    assert out.strip() == ""
+
+
+def test_session_resume_skips_completed(tmp_path, capsys, md5_of):
+    # Plant the password near the END of the keyspace; first run covers
+    # only the beginning (simulated kill via tiny keyspace slicing is
+    # awkward, so instead resume from a synthetic journal that claims
+    # the first 60% is done).
+    from dprf_tpu.runtime.session import SessionJournal, job_fingerprint
+    from dprf_tpu.generators.mask import MaskGenerator
+
+    secret = b"zz"
+    gen = MaskGenerator("?l?l")
+    hashfile = _mk_hashfile(tmp_path, [md5_of(secret)])
+    session = str(tmp_path / "s.json")
+    fp = job_fingerprint("md5", "mask:?l?l", gen.keyspace,
+                         [hashlib.md5(secret).digest()])
+    j = SessionJournal(session)
+    j.open({"engine": "md5", "device": "cpu", "attack": "mask",
+            "attack_arg": "?l?l", "keyspace": gen.keyspace,
+            "fingerprint": fp})
+    j.snapshot([(0, 400)])
+    j.close()
+
+    rc, out = run_cli(["crack", "?l?l", hashfile, "--engine", "md5",
+                       "--device", "cpu", "--no-potfile",
+                       "--session", session, "--restore",
+                       "--unit-size", "64", "-q"], capsys)
+    assert rc == 0
+    assert f"{md5_of(secret)}:zz" in out
+    # journal now shows full coverage
+    st = SessionJournal.load(session)
+    assert st.completed == [(0, gen.keyspace)]
+
+
+def test_session_wrong_job_rejected(tmp_path, capsys, md5_of):
+    from dprf_tpu.runtime.session import SessionJournal
+
+    hashfile = _mk_hashfile(tmp_path, [md5_of(b"aa")])
+    session = str(tmp_path / "s.json")
+    j = SessionJournal(session)
+    j.open({"fingerprint": "something-else"})
+    j.close()
+    rc, _ = run_cli(["crack", "?l?l", hashfile, "--engine", "md5",
+                     "--device", "cpu", "--no-potfile",
+                     "--session", session, "--restore", "-q"], capsys)
+    assert rc == 2
+
+
+def test_potfile_precracked_skips_work(tmp_path, capsys, md5_of):
+    hashfile = _mk_hashfile(tmp_path, [md5_of(b"ab")])
+    pot = str(tmp_path / "t.pot")
+    Potfile(pot).add(md5_of(b"ab"), b"ab")
+    rc, out = run_cli(["crack", "?l?l", hashfile, "--engine", "md5",
+                       "--device", "cpu", "--potfile", pot, "-q"], capsys)
+    assert rc == 0
+    assert f"{md5_of(b'ab')}:ab" in out
+
+
+def test_keyspace_and_engines_commands(capsys):
+    rc, out = run_cli(["keyspace", "?l?l?l?l?l?l"], capsys)
+    assert rc == 0 and out.strip() == str(26 ** 6)
+    rc, out = run_cli(["engines"], capsys)
+    assert rc == 0 and "md5" in out
+
+
+def test_malformed_hashlist_line_skipped(tmp_path, capsys, md5_of):
+    p = tmp_path / "h.txt"
+    p.write_text(f"# comment\nnot-a-hash\n{md5_of(b'ok')}\n\n")
+    rc, out = run_cli(["crack", "?l?l", str(p), "--engine", "md5",
+                       "--device", "cpu", "--no-potfile", "-q"], capsys)
+    assert rc == 0
+    assert f"{md5_of(b'ok')}:ok" in out

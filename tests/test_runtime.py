@@ -1,0 +1,129 @@
+"""Dispatcher, session journal, potfile unit tests."""
+
+import json
+
+import pytest
+
+from dprf_tpu.runtime.dispatcher import Dispatcher, IntervalSet
+from dprf_tpu.runtime.potfile import Potfile, encode_plain, decode_plain
+from dprf_tpu.runtime.session import SessionJournal, job_fingerprint
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_interval_set_merge():
+    s = IntervalSet()
+    s.add(10, 20)
+    s.add(0, 5)
+    s.add(5, 10)          # bridges
+    assert s.intervals() == [(0, 20)]
+    s.add(30, 40)
+    assert s.gaps(50) == [(20, 30), (40, 50)]
+    assert s.covered() == 30
+    assert s.contains_range(3, 18)
+    assert not s.contains_range(18, 25)
+
+
+def test_dispatcher_full_sweep():
+    d = Dispatcher(keyspace=1000, unit_size=128)
+    seen = []
+    while True:
+        u = d.lease("w0")
+        if u is None:
+            break
+        seen.append((u.start, u.end))
+        d.complete(u.unit_id)
+    assert seen[0] == (0, 128)
+    assert seen[-1] == (896, 1000)       # tail unit is short
+    assert d.done()
+    assert d.progress() == (1000, 1000)
+
+
+def test_dispatcher_lease_expiry_reissues():
+    clk = FakeClock()
+    d = Dispatcher(keyspace=256, unit_size=128, lease_timeout=10.0, clock=clk)
+    u1 = d.lease("w0")
+    u2 = d.lease("w1")
+    assert d.lease("w2") is None          # everything outstanding
+    clk.t = 11.0                          # w0 and w1 die
+    u3 = d.lease("w2")                    # reissued unit
+    assert (u3.start, u3.end) in {(u1.start, u1.end), (u2.start, u2.end)}
+    # late completion by the dead worker is idempotent
+    d.complete(u1.unit_id)
+    d.complete(u3.unit_id)
+    u4 = d.lease("w2")
+    d.complete(u4.unit_id)
+    assert d.done()
+
+
+def test_dispatcher_resume_from_completed():
+    # covered: [0,100) and [200,300); frontier 300 -> gap [100,200) pending
+    d = Dispatcher.from_completed(keyspace=1000, unit_size=64,
+                                  completed=[(0, 100), (200, 300)])
+    first = d.lease()
+    second = d.lease()
+    assert (first.start, first.end) == (100, 164)
+    assert (second.start, second.end) == (164, 200)
+    third = d.lease()
+    assert third.start == 300             # continues at frontier
+    done, total = d.progress()
+    assert (done, total) == (200, 1000)
+
+
+def test_session_journal_roundtrip(tmp_path):
+    p = str(tmp_path / "job.session")
+    j = SessionJournal(p, snapshot_every=1)
+    j.open({"engine": "md5", "fingerprint": "abc"})
+    j.record_units([(0, 100)])
+    j.record_hit(0, 42, b"pass")
+    j.record_units([(0, 250)])
+    j.close()
+    st = SessionJournal.load(p)
+    assert st.spec["fingerprint"] == "abc"
+    assert st.completed == [(0, 250)]     # last snapshot wins
+    assert st.hits[0]["index"] == 42
+    assert bytes.fromhex(st.hits[0]["plaintext"]) == b"pass"
+
+
+def test_session_journal_torn_tail(tmp_path):
+    p = str(tmp_path / "job.session")
+    j = SessionJournal(p, snapshot_every=1)
+    j.open({"engine": "md5"})
+    j.record_units([(0, 64)])
+    j.close()
+    with open(p, "a") as fh:
+        fh.write('{"type": "units", "intervals": [[0, 9')   # torn write
+    st = SessionJournal.load(p)
+    assert st.completed == [(0, 64)]
+
+
+def test_fingerprint_sensitivity():
+    a = job_fingerprint("md5", "mask:?l?l", 676, [b"x" * 16])
+    assert a == job_fingerprint("md5", "mask:?l?l", 676, [b"x" * 16])
+    assert a != job_fingerprint("md5", "mask:?l?d", 676, [b"x" * 16])
+    assert a != job_fingerprint("md5", "mask:?l?l", 676, [b"y" * 16])
+
+
+def test_potfile_roundtrip(tmp_path):
+    p = str(tmp_path / "t.pot")
+    pot = Potfile(p)
+    pot.add("deadbeef", b"hello")
+    pot.add("cafebabe", b"\x01\xffbin:")
+    # reload from disk
+    pot2 = Potfile(p)
+    assert pot2.get("deadbeef") == b"hello"
+    assert pot2.get("cafebabe") == b"\x01\xffbin:"
+    assert "deadbeef" in pot2 and len(pot2) == 2
+
+
+@pytest.mark.parametrize("plain", [b"simple", b"", b"with:colon",
+                                   b"\x00\x01", "pässword".encode(),
+                                   b"$HEX[41]"])
+def test_plain_encoding_roundtrip(plain):
+    assert decode_plain(encode_plain(plain)) == plain
