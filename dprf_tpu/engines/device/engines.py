@@ -20,7 +20,10 @@ import jax.numpy as jnp
 from dprf_tpu.engines import register
 from dprf_tpu.engines.base import DeviceHashEngine, HashEngine
 from dprf_tpu.ops import pack as pack_ops
+from dprf_tpu.ops.md4 import md4_digest_words
 from dprf_tpu.ops.md5 import md5_digest_words
+from dprf_tpu.ops.sha1 import sha1_digest_words
+from dprf_tpu.ops.sha256 import sha256_digest_words
 
 
 class JaxEngineBase(DeviceHashEngine, HashEngine):
@@ -47,9 +50,12 @@ class JaxEngineBase(DeviceHashEngine, HashEngine):
     def hash_batch(self, candidates: Sequence[bytes],
                    params: Optional[dict] = None) -> list[bytes]:
         maxlen = max((len(c) for c in candidates), default=1) or 1
-        if maxlen > self.max_candidate_len:
+        # 55 is the single-block packing limit; engine-specific
+        # max_candidate_len (e.g. NTLM's 27 pre-widening chars) is
+        # enforced by callers/overrides on the raw candidate.
+        if maxlen > 55:
             raise ValueError(f"{self.name}: candidate longer than "
-                             f"{self.max_candidate_len} bytes")
+                             "the 55-byte single-block limit")
         batch = len(candidates)
         buf = np.zeros((batch, maxlen), dtype=np.uint8)
         lengths = np.zeros((batch,), dtype=np.int32)
@@ -73,3 +79,55 @@ class JaxMd5Engine(JaxEngineBase):
     def digest_packed(self, blocks: jnp.ndarray,
                       lengths=None) -> jnp.ndarray:
         return md5_digest_words(blocks)
+
+
+@register("sha1", device="jax")
+@register("sha-1", device="jax")
+class JaxSha1Engine(JaxEngineBase):
+    name = "sha1"
+    digest_size = 20
+    digest_words = 5
+    little_endian = False
+
+    def digest_packed(self, blocks: jnp.ndarray,
+                      lengths=None) -> jnp.ndarray:
+        return sha1_digest_words(blocks)
+
+
+@register("sha256", device="jax")
+@register("sha-256", device="jax")
+class JaxSha256Engine(JaxEngineBase):
+    name = "sha256"
+    digest_size = 32
+    digest_words = 8
+    little_endian = False
+
+    def digest_packed(self, blocks: jnp.ndarray,
+                      lengths=None) -> jnp.ndarray:
+        return sha256_digest_words(blocks)
+
+
+@register("ntlm", device="jax")
+class JaxNtlmEngine(JaxEngineBase):
+    """NTLM: MD4 over UTF-16LE.  The fused pipeline widens the latin-1
+    candidate bytes to UTF-16LE on device (widen_utf16); the host
+    hash_batch path widens here before packing."""
+
+    name = "ntlm"
+    digest_size = 16
+    digest_words = 4
+    little_endian = True
+    widen_utf16 = True
+    # 27 chars -> 54 UTF-16LE bytes: still one MD4 block.
+    max_candidate_len = 27
+
+    def digest_packed(self, blocks: jnp.ndarray,
+                      lengths=None) -> jnp.ndarray:
+        return md4_digest_words(blocks)
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if any(len(c) > self.max_candidate_len for c in candidates):
+            raise ValueError("ntlm: candidate longer than 27 chars")
+        widened = [bytes(b for ch in c for b in (ch, 0)) for c in candidates]
+        return super().hash_batch(widened, params=params)

@@ -50,7 +50,8 @@ def make_target_table(digests: list[bytes], word_bytes: int = 4,
     for i, d in enumerate(digests):
         if len(d) != nwords * word_bytes:
             raise ValueError("inconsistent digest sizes in target list")
-        rows[i] = np.frombuffer(d, dtype="<u4" if little_endian else ">u4")
+        rows[i] = np.frombuffer(
+            d, dtype="<u4" if little_endian else ">u4").astype(np.uint32)
     order = np.lexsort(rows.T[::-1])   # sort by word0, then word1, ...
     rows = rows[order]
     first = rows[:, 0]

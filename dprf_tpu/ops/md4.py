@@ -1,0 +1,44 @@
+"""MD4 compression (RFC 1320) as vectorized uint32 jnp ops -- the NTLM
+digest core (MD4 over UTF-16LE candidates).  Mirrors the pure-Python
+oracle in engines/cpu/md4.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+INIT = np.array([0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476],
+                dtype=np.uint32)
+_R2_ORDER = (0, 4, 8, 12, 1, 5, 9, 13, 2, 6, 10, 14, 3, 7, 11, 15)
+_R3_ORDER = (0, 8, 4, 12, 2, 10, 6, 14, 1, 9, 5, 13, 3, 11, 7, 15)
+_SHIFTS = ((3, 7, 11, 19), (3, 5, 9, 13), (3, 9, 11, 15))
+
+
+def _rotl(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    return (x << jnp.uint32(n)) | (x >> jnp.uint32(32 - n))
+
+
+def md4_compress(state: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
+    a, b, c, d = (state[..., i] for i in range(4))
+    m = [words[..., i] for i in range(16)]
+
+    for i in range(16):
+        f = (b & c) | (~b & d)
+        a = _rotl(a + f + m[i], _SHIFTS[0][i % 4])
+        a, b, c, d = d, a, b, c
+    for i, k in enumerate(_R2_ORDER):
+        g = (b & c) | (b & d) | (c & d)
+        a = _rotl(a + g + m[k] + jnp.uint32(0x5A827999), _SHIFTS[1][i % 4])
+        a, b, c, d = d, a, b, c
+    for i, k in enumerate(_R3_ORDER):
+        h = b ^ c ^ d
+        a = _rotl(a + h + m[k] + jnp.uint32(0x6ED9EBA1), _SHIFTS[2][i % 4])
+        a, b, c, d = d, a, b, c
+
+    return jnp.stack([a, b, c, d], axis=-1) + state
+
+
+def md4_digest_words(words: jnp.ndarray) -> jnp.ndarray:
+    state = jnp.broadcast_to(jnp.asarray(INIT), words.shape[:-1] + (4,))
+    return md4_compress(state, words)

@@ -50,8 +50,9 @@ def md5_compress(state: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
         tmp = a + f + jnp.uint32(int(K[i])) + m[g]
         a, d, c, b = d, c, b, (b + _rotl(tmp, _SHIFTS[rnd][i % 4]))
 
-    out = jnp.stack([a, b, c, d], axis=-1)
-    return out + jnp.asarray(INIT)
+    # Davies-Meyer feed-forward: add the *input* chaining state (not
+    # INIT -- they only coincide on the first block).
+    return jnp.stack([a, b, c, d], axis=-1) + state
 
 
 def md5_digest_words(words: jnp.ndarray) -> jnp.ndarray:

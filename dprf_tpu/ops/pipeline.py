@@ -63,5 +63,5 @@ def make_mask_crack_step(engine, gen: MaskGenerator,
 def target_words(digest: bytes, little_endian: bool = True) -> jnp.ndarray:
     """Raw digest bytes -> uint32[W] in the engine's word layout."""
     import numpy as np
-    return jnp.asarray(np.frombuffer(
-        digest, dtype="<u4" if little_endian else ">u4"))
+    words = np.frombuffer(digest, dtype="<u4" if little_endian else ">u4")
+    return jnp.asarray(words.astype(np.uint32))   # native byte order for jax

@@ -1,0 +1,52 @@
+"""SHA-1 compression (FIPS 180-4) as vectorized uint32 jnp ops.
+
+80 unrolled steps; the message schedule is kept as a rolling 16-entry
+list so only W[t-3]^W[t-8]^W[t-14]^W[t-16] rotations materialize --
+XLA keeps the whole schedule in registers/VMEM per batch tile.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+INIT = np.array([0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476,
+                 0xC3D2E1F0], dtype=np.uint32)
+_K = (0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xCA62C1D6)
+
+
+def _rotl(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    return (x << jnp.uint32(n)) | (x >> jnp.uint32(32 - n))
+
+
+def sha1_compress(state: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
+    """state uint32[..., 5] x words uint32[..., 16] (big-endian packed)
+    -> uint32[..., 5]."""
+    a, b, c, d, e = (state[..., i] for i in range(5))
+    w = [words[..., i] for i in range(16)]
+
+    for t in range(80):
+        if t >= 16:
+            nw = _rotl(w[(t - 3) % 16] ^ w[(t - 8) % 16]
+                       ^ w[(t - 14) % 16] ^ w[t % 16], 1)
+            w[t % 16] = nw
+        wt = w[t % 16]
+        if t < 20:
+            f = (b & c) | (~b & d)
+        elif t < 40:
+            f = b ^ c ^ d
+        elif t < 60:
+            f = (b & c) | (b & d) | (c & d)
+        else:
+            f = b ^ c ^ d
+        tmp = _rotl(a, 5) + f + e + jnp.uint32(_K[t // 20]) + wt
+        a, b, c, d, e = tmp, a, _rotl(b, 30), c, d
+
+    # Davies-Meyer feed-forward: add the *input* chaining state (not
+    # INIT -- they only coincide on the first block; HMAC chains).
+    return jnp.stack([a, b, c, d, e], axis=-1) + state
+
+
+def sha1_digest_words(words: jnp.ndarray) -> jnp.ndarray:
+    state = jnp.broadcast_to(jnp.asarray(INIT), words.shape[:-1] + (5,))
+    return sha1_compress(state, words)

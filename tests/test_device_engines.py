@@ -1,0 +1,78 @@
+"""SHA-1/SHA-256/NTLM device engines vs CPU oracles, plus fused-step
+end-to-end per engine (the device-vs-oracle property strategy of
+SURVEY.md section 4)."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dprf_tpu import get_engine
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.ops.pipeline import make_mask_crack_step, target_words
+
+ENGINES = ["md5", "sha1", "sha256", "ntlm"]
+
+
+@pytest.mark.parametrize("name", ENGINES)
+def test_device_matches_oracle_random(name):
+    dev = get_engine(name, "jax")
+    oracle = get_engine(name, "cpu")
+    rng = random.Random(hash(name) & 0xFFFF)
+    maxlen = dev.max_candidate_len
+    if name == "ntlm":
+        # oracle widens via latin-1 text; keep candidates ascii-safe
+        cands = [bytes(rng.randrange(0x20, 0x7F) for _ in range(rng.randrange(0, maxlen + 1)))
+                 for _ in range(150)]
+    else:
+        cands = [bytes(rng.randrange(256) for _ in range(rng.randrange(0, maxlen + 1)))
+                 for _ in range(150)]
+    assert dev.hash_batch(cands) == oracle.hash_batch(cands)
+
+
+def test_sha1_vector():
+    assert get_engine("sha1", "jax").hash_batch([b"abc"])[0].hex() == \
+        "a9993e364706816aba3e25717850c26c9cd0d89d"
+
+
+def test_sha256_vector():
+    assert get_engine("sha256", "jax").hash_batch([b"abc"])[0].hex() == \
+        "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
+
+
+def test_ntlm_vector():
+    assert get_engine("ntlm", "jax").hash_batch([b"password"])[0].hex() == \
+        "8846f7eaee8fb117ad06bdd830b7586c"
+
+
+@pytest.mark.parametrize("name,mask,secret", [
+    ("sha1", "?d?d?d?d", b"7319"),
+    ("sha256", "?l?d?l", b"a7z"),
+    ("ntlm", "?u?l?l", b"Pwd"),
+])
+def test_fused_step_each_engine(name, mask, secret):
+    dev = get_engine(name, "jax")
+    oracle = get_engine(name, "cpu")
+    gen = MaskGenerator(mask)
+    planted = gen.index_of(secret)
+    tgt = target_words(oracle.hash_batch([secret])[0], dev.little_endian)
+    batch = 512
+    step = make_mask_crack_step(dev, gen, tgt, batch,
+                                widen_utf16=getattr(dev, "widen_utf16", False))
+    found = []
+    for start in range(0, gen.keyspace, batch):
+        n_valid = min(batch, gen.keyspace - start)
+        base = jnp.asarray(gen.digits(start), dtype=jnp.int32)
+        count, lanes, _ = step(base, jnp.int32(n_valid))
+        if int(count):
+            found.extend(start + int(l) for l in np.asarray(lanes) if l >= 0)
+    assert found == [planted]
+
+
+def test_cli_engines_lists_device_engines(capsys):
+    from dprf_tpu.cli import main
+    main(["engines", "--device", "jax"])
+    out = capsys.readouterr().out
+    for n in ENGINES:
+        assert n in out
