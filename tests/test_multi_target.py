@@ -1,0 +1,89 @@
+"""Multi-target compare: benchmark config 2's shape (1k-hash NTLM list,
+batched compare) plus adversarial sort-key collisions."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dprf_tpu import get_engine
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops.pipeline import make_mask_crack_step
+
+
+def test_target_table_window_counts_duplicate_runs():
+    # three digests sharing word0, two sharing another word0
+    mk = lambda w0, tail: w0.to_bytes(4, "little") + tail.to_bytes(12, "little")
+    digests = [mk(5, 1), mk(5, 2), mk(5, 3), mk(9, 1), mk(9, 2), mk(2, 7)]
+    table = cmp_ops.make_target_table(digests)
+    assert table.window == 3
+    assert table.num_targets == 6
+
+
+def test_compare_multi_with_colliding_sort_keys():
+    mk = lambda w0, tail: w0.to_bytes(4, "little") + tail.to_bytes(12, "little")
+    digests = [mk(5, 1), mk(5, 2), mk(5, 3), mk(2, 7), mk(9, 1)]
+    table = cmp_ops.make_target_table(digests)
+    # probe batch: each target digest + near-misses sharing word0
+    probes = digests + [mk(5, 99), mk(9, 99), mk(1, 1), mk(10, 1)]
+    rows = np.stack([np.frombuffer(d, dtype="<u4") for d in probes])
+    found, tpos = cmp_ops.compare_multi(jnp.asarray(rows.astype(np.uint32)),
+                                        table)
+    found = np.asarray(found)
+    assert found.tolist() == [True] * 5 + [False] * 4
+    # each found probe maps back to its own digest
+    tpos = np.asarray(tpos)
+    for i in range(5):
+        orig = int(table.order[tpos[i]])
+        assert digests[orig] == probes[i]
+
+
+def test_thousand_hash_ntlm_crack_cli(tmp_path, capsys):
+    """Config 2 in miniature: 1000-target NTLM list, mask attack,
+    on-device multi-target compare, all planted targets found."""
+    from dprf_tpu.cli import main
+
+    rng = random.Random(42)
+    gen = MaskGenerator("?l?l?l")
+    oracle = get_engine("ntlm", "cpu")
+    planted_idx = sorted(rng.sample(range(gen.keyspace), 60))
+    planted = [gen.candidate(i) for i in planted_idx]
+    digests = [d.hex() for d in oracle.hash_batch(planted)]
+    # pad the list to 1000 with digests of passwords outside the keyspace
+    fillers = [f"xx{i:06d}".encode() for i in range(940)]
+    digests += [d.hex() for d in oracle.hash_batch(fillers)]
+    rng.shuffle(digests)
+    hashfile = tmp_path / "ntlm1k.txt"
+    hashfile.write_text("\n".join(digests) + "\n")
+
+    rc = main(["crack", "?l?l?l", str(hashfile), "--engine", "ntlm",
+               "--device", "tpu", "--no-potfile",
+               "--unit-size", "8192", "--batch", "2048", "-q"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    lines = dict(l.split(":", 1) for l in out.strip().splitlines())
+    assert len(lines) == 60
+    for p in planted:
+        d = oracle.hash_batch([p])[0].hex()
+        assert lines[d] == p.decode()
+
+
+def test_multi_target_hits_across_batches(tmp_path):
+    """Hits for different targets in the same batch resolve to the right
+    (target, plaintext) pairs through the sorted-table order mapping."""
+    from dprf_tpu.engines.base import Target
+    from dprf_tpu.runtime.worker import DeviceMaskWorker
+    from dprf_tpu.runtime.workunit import WorkUnit
+
+    gen = MaskGenerator("?d?d?d")
+    dev = get_engine("md5", "jax")
+    oracle = get_engine("md5", "cpu")
+    secrets = [b"007", b"008", b"123", b"999"]
+    targets = [Target(raw=f"t{i}", digest=oracle.hash_batch([s])[0])
+               for i, s in enumerate(secrets)]
+    w = DeviceMaskWorker(dev, gen, targets, batch=256, oracle=oracle)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    got = {h.target_index: h.plaintext for h in hits}
+    assert got == {0: b"007", 1: b"008", 2: b"123", 3: b"999"}
