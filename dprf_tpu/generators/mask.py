@@ -137,17 +137,19 @@ class MaskGenerator(CandidateGenerator):
         return jnp.asarray(self._flat_np)
 
     def decode_batch(self, base_digits: jnp.ndarray, flat: jnp.ndarray,
-                     batch: int) -> jnp.ndarray:
+                     batch: int, lane_offset=0) -> jnp.ndarray:
         """Materialize `batch` consecutive candidates on device.
 
         base_digits: int32[length] digit vector of the first candidate
         (from `digits()`, host-computed once per unit).  flat: the
-        uint8 flat charset table (device-resident).  Returns
-        uint8[batch, length].  jit-traceable; radices/offsets are baked
-        in as constants so the per-position mod/div lower to cheap
-        int32 vector ops.
+        uint8 flat charset table (device-resident).  lane_offset (int32
+        scalar, may be traced): decode candidates base+offset ..
+        base+offset+batch -- the sharded path passes each chip's lane
+        range start here.  Returns uint8[batch, length].  jit-traceable;
+        radices/offsets are baked in as constants so the per-position
+        mod/div lower to cheap int32 vector ops.
         """
-        carry = jnp.arange(batch, dtype=jnp.int32)
+        carry = lane_offset + jnp.arange(batch, dtype=jnp.int32)
         cols: list = [None] * self.length
         for p in range(self.length - 1, -1, -1):
             radix = self.radices[p]

@@ -1,0 +1,30 @@
+"""Device mesh construction for keyspace-parallel cracking.
+
+The framework's only sharded axis is the keyspace (candidate-index)
+dimension, so every mesh is 1-D with a single ``shard`` axis.  On a pod
+slice the axis rides ICI; across hosts, `jax.distributed` + the same
+mesh spans DCN with no code changes (XLA places the collectives).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build the 1-D keyspace mesh over `n_devices` (default: all)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} present")
+        devices = devices[:n_devices]
+    import numpy as np
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))

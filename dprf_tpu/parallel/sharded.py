@@ -1,0 +1,91 @@
+"""The multi-chip fused crack step: shard_map over the keyspace mesh.
+
+Each chip owns a contiguous `batch_per_device`-lane slice of every
+super-batch: chip c decodes candidates ``base + c*batch_per_device ..
+base + (c+1)*batch_per_device``, hashes and compares them locally, and
+compacts its own fixed-size hit buffer.  The only cross-chip traffic is
+one scalar `psum` of the per-chip hit counts (rides ICI); hit buffers
+come back per-shard, so host-side traffic stays O(capacity * n_dev)
+regardless of keyspace size.
+
+This is the framework's full distributed step (SURVEY.md section 1: the
+domain's parallelism is data parallelism over candidate-index ranges --
+there are no layers/sequences to shard, so the keyspace axis is the
+whole story).
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.parallel.mesh import SHARD_AXIS
+
+
+def make_sharded_mask_crack_step(
+        engine, gen: MaskGenerator,
+        targets: Union[jnp.ndarray, cmp_ops.TargetTable],
+        mesh: Mesh, batch_per_device: int, hit_capacity: int = 64,
+        widen_utf16: bool = False):
+    """Build the jitted multi-chip fused step for a mask attack.
+
+    Returns step(base_digits int32[L], n_valid int32) ->
+        (total int32,                       # psum'd hit count, replicated
+         counts int32[n_dev],               # per-chip hit counts
+         lanes int32[n_dev, cap],           # global super-batch lane idx, -1 pad
+         tpos  int32[n_dev, cap])           # sorted-table pos (multi-target)
+
+    The super-batch is ``n_dev * batch_per_device`` lanes starting at the
+    unit's base index; `n_valid` counts valid lanes over the whole
+    super-batch.
+    """
+    flat = gen.flat_charsets
+    length = gen.length
+    multi = isinstance(targets, cmp_ops.TargetTable)
+    n_dev = mesh.devices.size
+    batch = batch_per_device
+
+    def shard_fn(base_digits: jnp.ndarray, n_valid: jnp.ndarray):
+        dev = lax.axis_index(SHARD_AXIS)
+        offset = (dev * batch).astype(jnp.int32)
+        cand = gen.decode_batch(base_digits, flat, batch, lane_offset=offset)
+        if widen_utf16:
+            cand_bytes = jnp.reshape(
+                jnp.stack([cand, jnp.zeros_like(cand)], axis=-1),
+                (batch, 2 * length))
+            words = engine.pack(cand_bytes, 2 * length)
+        else:
+            words = engine.pack(cand, length)
+        digest = engine.digest_packed(words)
+        if multi:
+            found, tpos = cmp_ops.compare_multi(digest, targets)
+        else:
+            found = cmp_ops.compare_single(digest, targets)
+            tpos = jnp.zeros((batch,), jnp.int32)
+        lane_global = offset + jnp.arange(batch, dtype=jnp.int32)
+        found = found & (lane_global < n_valid)
+        count, lanes, tpos = cmp_ops.compact_hits(found, tpos, hit_capacity)
+        # Local lane -> super-batch lane (keep -1 padding).
+        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
+        total = lax.psum(count, SHARD_AXIS)
+        return (total[None], count[None], lanes[None, :], tpos[None, :])
+
+    sharded = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=(P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False)
+
+    @jax.jit
+    def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray):
+        total, counts, lanes, tpos = sharded(base_digits, n_valid)
+        return total[0], counts, lanes, tpos
+
+    step.super_batch = n_dev * batch
+    return step

@@ -1,0 +1,52 @@
+"""Multi-chip worker: drives the sharded fused step over WorkUnits.
+
+Shares all target setup and hit decoding with
+runtime.worker.DeviceMaskWorker via MaskWorkerBase; the only differences
+are the sharded step factory and that each step call covers an
+``n_dev * batch_per_device`` super-batch whose hit buffers come back
+per shard.  Lanes are super-batch-global, so ``bstart + lane`` is the
+keyspace index exactly as in the single-device path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from dprf_tpu.engines.base import HashEngine, Target
+from dprf_tpu.runtime.worker import Hit, MaskWorkerBase
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+class ShardedMaskWorker(MaskWorkerBase):
+    """Fused-pipeline worker spread over a device mesh."""
+
+    def __init__(self, engine, gen, targets: Sequence[Target], mesh,
+                 batch_per_device: int = 1 << 18, hit_capacity: int = 64,
+                 oracle: Optional[HashEngine] = None):
+        from dprf_tpu.parallel.sharded import make_sharded_mask_crack_step
+
+        tgt = self._setup_targets(engine, gen, targets, hit_capacity, oracle)
+        self.mesh = mesh
+        self.super_batch = self.stride = mesh.devices.size * batch_per_device
+        self.step = make_sharded_mask_crack_step(
+            engine, gen, tgt, mesh, batch_per_device, hit_capacity,
+            widen_utf16=getattr(engine, "widen_utf16", False))
+
+    def _batch_hits(self, bstart: int, result, unit: WorkUnit) -> list[Hit]:
+        total, counts, lanes, tpos = result
+        if int(total) == 0:
+            return []
+        counts_np = np.asarray(counts)
+        # Check every shard BEFORE decoding any: an overflow rescan
+        # replaces the whole super-batch, so mixing it with per-shard
+        # decoded hits would double-report the non-overflowed shards.
+        if (counts_np > self.hit_capacity).any():
+            return self._rescan(bstart, unit)
+        lanes_np = np.asarray(lanes)
+        tpos_np = np.asarray(tpos)
+        hits: list[Hit] = []
+        for d in range(lanes_np.shape[0]):
+            hits.extend(self._decode_lanes(bstart, lanes_np[d], tpos_np[d]))
+        return hits

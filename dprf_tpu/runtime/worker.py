@@ -59,21 +59,23 @@ class CpuWorker:
         return hits
 
 
-class DeviceMaskWorker:
-    """Fused-pipeline worker for mask attacks on fast (unsalted) hashes."""
+class MaskWorkerBase:
+    """Shared machinery for fused-pipeline mask workers.
 
-    def __init__(self, engine, gen, targets: Sequence[Target],
-                 batch: int = 1 << 18, hit_capacity: int = 64,
-                 oracle: Optional[HashEngine] = None):
-        import jax.numpy as jnp
+    Subclasses set ``self.step`` (the jitted crack step) and
+    ``self.stride`` (keyspace indices consumed per step call) in
+    __init__ after calling ``_setup_targets``, and implement
+    ``_batch_hits`` to decode one step result.
+    """
+
+    def _setup_targets(self, engine, gen, targets: Sequence[Target],
+                       hit_capacity: int, oracle: Optional[HashEngine]):
         from dprf_tpu.ops import compare as cmp_ops
-        from dprf_tpu.ops.pipeline import make_mask_crack_step, target_words
+        from dprf_tpu.ops.pipeline import target_words
 
-        self._jnp = jnp
         self.engine = engine
         self.gen = gen
         self.targets = list(targets)
-        self.batch = batch
         self.hit_capacity = hit_capacity
         self.oracle = oracle
         digests = [t.digest for t in self.targets]
@@ -82,46 +84,64 @@ class DeviceMaskWorker:
             table = cmp_ops.make_target_table(
                 digests, little_endian=engine.little_endian)
             self._order = table.order
-            tgt = table
-        else:
-            self._order = np.zeros(1, dtype=np.int64)
-            tgt = target_words(digests[0], engine.little_endian)
-        self.step = make_mask_crack_step(
-            engine, gen, tgt, batch, hit_capacity,
-            widen_utf16=getattr(engine, "widen_utf16", False))
+            return table
+        self._order = np.zeros(1, dtype=np.int64)
+        return target_words(digests[0], engine.little_endian)
 
     def process(self, unit: WorkUnit) -> list[Hit]:
-        jnp = self._jnp
+        import jax.numpy as jnp
         queued = []
-        for bstart in range(unit.start, unit.end, self.batch):
-            n_valid = min(self.batch, unit.end - bstart)
+        for bstart in range(unit.start, unit.end, self.stride):
+            n_valid = min(self.stride, unit.end - bstart)
             base = jnp.asarray(self.gen.digits(bstart), dtype=jnp.int32)
             queued.append((bstart, self.step(base, jnp.int32(n_valid))))
         hits: list[Hit] = []
-        for bstart, (count, lanes, tpos) in queued:
-            count = int(count)
-            if count == 0:
+        for bstart, result in queued:
+            hits.extend(self._batch_hits(bstart, result, unit))
+        return hits
+
+    def _decode_lanes(self, bstart: int, lanes_np, tpos_np) -> list[Hit]:
+        """Hit-buffer arrays -> Hit records (lane -1 = unused slot)."""
+        hits = []
+        for lane, tp in zip(lanes_np, tpos_np):
+            if lane < 0:
                 continue
-            if count > self.hit_capacity:
-                if self.oracle is None:
-                    raise RuntimeError(
-                        f"hit buffer overflow ({count} > {self.hit_capacity}) "
-                        "and no oracle engine to rescan with; raise hit_capacity")
-                hits.extend(self._rescan(bstart, unit))
-                continue
-            lanes_np = np.asarray(lanes)
-            tpos_np = np.asarray(tpos)
-            for lane, tp in zip(lanes_np, tpos_np):
-                if lane < 0:
-                    continue
-                gidx = bstart + int(lane)
-                ti = int(self._order[int(tp)]) if self.multi else 0
-                hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+            gidx = bstart + int(lane)
+            ti = int(self._order[int(tp)]) if self.multi else 0
+            hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
         return hits
 
     def _rescan(self, bstart: int, unit: WorkUnit) -> list[Hit]:
         """Exact host rescan of one overflowed batch (pathological case:
         more hits in a batch than the device hit buffer holds)."""
-        end = min(bstart + self.batch, unit.end)
+        if self.oracle is None:
+            raise RuntimeError(
+                f"hit buffer overflow (> {self.hit_capacity}) and no "
+                "oracle engine to rescan with; raise hit_capacity")
+        end = min(bstart + self.stride, unit.end)
         sub = WorkUnit(-1, bstart, end - bstart)
         return CpuWorker(self.oracle, self.gen, self.targets).process(sub)
+
+
+class DeviceMaskWorker(MaskWorkerBase):
+    """Fused-pipeline worker for mask attacks on fast (unsalted) hashes."""
+
+    def __init__(self, engine, gen, targets: Sequence[Target],
+                 batch: int = 1 << 18, hit_capacity: int = 64,
+                 oracle: Optional[HashEngine] = None):
+        from dprf_tpu.ops.pipeline import make_mask_crack_step
+
+        tgt = self._setup_targets(engine, gen, targets, hit_capacity, oracle)
+        self.batch = self.stride = batch
+        self.step = make_mask_crack_step(
+            engine, gen, tgt, batch, hit_capacity,
+            widen_utf16=getattr(engine, "widen_utf16", False))
+
+    def _batch_hits(self, bstart: int, result, unit: WorkUnit) -> list[Hit]:
+        count, lanes, tpos = result
+        count = int(count)
+        if count == 0:
+            return []
+        if count > self.hit_capacity:
+            return self._rescan(bstart, unit)
+        return self._decode_lanes(bstart, np.asarray(lanes), np.asarray(tpos))

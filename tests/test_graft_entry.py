@@ -1,0 +1,24 @@
+"""The driver's entry points must always compile and run."""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+import __graft_entry__ as graft
+
+
+def test_entry_compiles_and_runs():
+    fn, args = graft.entry()
+    jax.jit(fn).lower(*args).compile()
+    count, lanes, tpos = fn(*args)
+    # batch 8192 covers indices [0, 8192) of ?l^6: 'aaaaaa' is index 0.
+    assert int(count) >= 1
+    import numpy as np
+    assert 0 in np.asarray(lanes)
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)

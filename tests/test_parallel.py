@@ -1,0 +1,157 @@
+"""Multi-chip sharding tests on the 8-virtual-device CPU mesh.
+
+Validates that the shard_map crack step produces exactly the hits the
+single-device fused step (and the CPU oracle) produce, that the psum'd
+total matches per-shard counts, and that the sharded worker cracks an
+end-to-end planted-password job.
+"""
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.engines.base import Target
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops.pipeline import make_mask_crack_step, target_words
+from dprf_tpu.parallel import (ShardedMaskWorker, make_mesh,
+                               make_sharded_mask_crack_step)
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest should fake 8 CPU devices"
+    return make_mesh(8)
+
+
+def _ntlm(pw: bytes) -> bytes:
+    from dprf_tpu.engines.cpu.md4 import md4
+    return md4(bytes(b for ch in pw for b in (ch, 0)))
+
+
+def test_mesh_shape(mesh):
+    assert mesh.devices.shape == (8,)
+    assert mesh.axis_names == ("shard",)
+
+
+def test_sharded_md5_finds_planted_password(mesh):
+    gen = MaskGenerator("?l?l?l?l")
+    pw = b"crab"
+    idx = gen.index_of(pw)
+    tgt = target_words(hashlib.md5(pw).digest(), little_endian=True)
+    engine = get_engine("md5", device="jax")
+    step = make_sharded_mask_crack_step(engine, gen, tgt, mesh,
+                                        batch_per_device=1024)
+    super_batch = 8 * 1024
+    bstart = (idx // super_batch) * super_batch
+    base = jnp.asarray(gen.digits(bstart), dtype=jnp.int32)
+    total, counts, lanes, tpos = step(base, jnp.int32(super_batch))
+    assert int(total) == 1
+    assert int(counts.sum()) == 1
+    lanes_np = np.asarray(lanes)
+    hit_lanes = lanes_np[lanes_np >= 0]
+    assert list(hit_lanes) == [idx - bstart]
+
+
+def test_sharded_matches_single_device_step(mesh):
+    """Same super-batch through the 8-shard step and the 1-device step."""
+    gen = MaskGenerator("?l?l?l?l")
+    engine = get_engine("md5", device="jax")
+    # plant several targets inside one super-batch
+    super_batch = 8 * 512
+    bstart = 3 * super_batch
+    plant_idx = [bstart + 7, bstart + 600, bstart + 2048, bstart + 4095]
+    digests = [hashlib.md5(gen.candidate(i)).digest() for i in plant_idx]
+    table = cmp_ops.make_target_table(digests, little_endian=True)
+
+    sh_step = make_sharded_mask_crack_step(engine, gen, table, mesh,
+                                           batch_per_device=512)
+    single = make_mask_crack_step(engine, gen, table, batch=super_batch)
+
+    base = jnp.asarray(gen.digits(bstart), dtype=jnp.int32)
+    total, counts, lanes, tpos = sh_step(base, jnp.int32(super_batch))
+    s_count, s_lanes, s_tpos = single(base, jnp.int32(super_batch))
+
+    assert int(total) == int(s_count) == len(plant_idx)
+    sh_pairs = sorted((int(l), int(t))
+                      for l, t in zip(np.asarray(lanes).ravel(),
+                                      np.asarray(tpos).ravel()) if l >= 0)
+    s_pairs = sorted((int(l), int(t))
+                     for l, t in zip(np.asarray(s_lanes),
+                                     np.asarray(s_tpos)) if l >= 0)
+    assert sh_pairs == s_pairs
+    assert [p[0] + bstart for p in sh_pairs] == plant_idx
+
+
+def test_sharded_respects_n_valid(mesh):
+    """Lanes past n_valid must not report hits even if they match."""
+    gen = MaskGenerator("?d?d?d")
+    engine = get_engine("md5", device="jax")
+    idx = gen.index_of(b"777")
+    tgt = target_words(hashlib.md5(b"777").digest(), little_endian=True)
+    step = make_sharded_mask_crack_step(engine, gen, tgt, mesh,
+                                        batch_per_device=128)
+    base = jnp.asarray(gen.digits(0), dtype=jnp.int32)
+    total, *_ = step(base, jnp.int32(idx))       # 777 is lane idx: excluded
+    assert int(total) == 0
+    total, *_ = step(base, jnp.int32(idx + 1))   # included
+    assert int(total) == 1
+
+
+def test_sharded_ntlm_multi_target_worker(mesh):
+    """End-to-end: sharded NTLM worker over a unit spanning super-batches."""
+    gen = MaskGenerator("?l?l?l")
+    pws = [b"abc", b"xyz", b"qqq"]
+    targets = [Target(p.decode(), _ntlm(p)) for p in pws]
+    engine = get_engine("ntlm", device="jax")
+    w = ShardedMaskWorker(engine, gen, targets, mesh, batch_per_device=256)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert len(hits) == 3
+    got = {h.plaintext: h.target_index for h in hits}
+    assert got == {b"abc": 0, b"xyz": 1, b"qqq": 2}
+    for h in hits:
+        assert gen.candidate(h.cand_index) == h.plaintext
+
+
+def test_sharded_overflow_rescan_no_duplicates(mesh):
+    """An overflowing shard triggers a full super-batch rescan; hits from
+    non-overflowed shards must not be double-reported."""
+    gen = MaskGenerator("?d?d?d")
+    # hit_capacity=2: make shard 1 overflow (3 hits in its lane range)
+    # while shard 0 has a normal hit.
+    batch = 32
+    pws = [b"005",                        # shard 0 (lanes 0..31)
+           b"033", b"040", b"050",        # shard 1 (lanes 32..63): overflow
+           ]
+    targets = [Target(p.decode(), hashlib.md5(p).digest()) for p in pws]
+    w = ShardedMaskWorker(get_engine("md5", device="jax"), gen, targets,
+                          mesh, batch_per_device=batch, hit_capacity=2,
+                          oracle=get_engine("md5", device="cpu"))
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert sorted(h.plaintext for h in hits) == sorted(pws)
+    assert len(hits) == len(set(h.cand_index for h in hits)) == 4
+
+
+def test_sharded_worker_matches_cpu_worker(mesh):
+    from dprf_tpu.runtime.worker import CpuWorker
+    gen = MaskGenerator("?d?d?d?d")
+    pws = [b"0042", b"9999", b"1234"]
+    targets = [Target(p.decode(), hashlib.sha256(p).digest()) for p in pws]
+    dev = ShardedMaskWorker(get_engine("sha256", device="jax"), gen, targets,
+                            mesh, batch_per_device=128)
+    cpu = CpuWorker(get_engine("sha256", device="cpu"), gen, targets)
+    unit = WorkUnit(0, 0, gen.keyspace)
+    dev_hits = sorted((h.target_index, h.cand_index, h.plaintext)
+                      for h in dev.process(unit))
+    cpu_hits = sorted((h.target_index, h.cand_index, h.plaintext)
+                      for h in cpu.process(unit))
+    assert dev_hits == cpu_hits == [
+        (0, gen.index_of(b"0042"), b"0042"),
+        (1, gen.index_of(b"9999"), b"9999"),
+        (2, gen.index_of(b"1234"), b"1234"),
+    ]
