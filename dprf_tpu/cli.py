@@ -19,7 +19,7 @@ from dprf_tpu.runtime.coordinator import Coordinator, JobSpec
 from dprf_tpu.runtime.dispatcher import Dispatcher
 from dprf_tpu.runtime.potfile import Potfile
 from dprf_tpu.runtime.session import SessionJournal, job_fingerprint
-from dprf_tpu.runtime.worker import CpuWorker, DeviceMaskWorker
+from dprf_tpu.runtime.worker import CpuWorker
 from dprf_tpu.utils.hashlist import load_hashlist
 from dprf_tpu.utils.logging import Log
 
@@ -146,27 +146,23 @@ def cmd_crack(args, log: Log) -> int:
     else:
         dispatcher = Dispatcher(gen.keyspace, args.unit_size)
 
-    # Worker selection: the device path covers unsalted mask attacks;
-    # salted engines fall back to the oracle until their device engines
-    # land (bcrypt/PBKDF2 tasks in flight).
-    if device == "jax" and not engine.salted:
+    # Worker selection: each device engine builds its own fused worker
+    # (make_mask_worker), so salted pipelines (PMKID, bcrypt) plug in
+    # the same way the fast unsalted ones do.
+    worker = None
+    if device == "jax":
         try:
             dev_engine = get_engine(args.engine, device="jax")
         except KeyError:
             dev_engine = None
-        if dev_engine is None:
+        if dev_engine is None or not hasattr(dev_engine, "make_mask_worker"):
             log.warn("no jax engine for algorithm; using cpu oracle",
                      engine=args.engine)
-            worker = CpuWorker(engine, gen, hl.targets)
         else:
-            worker = DeviceMaskWorker(dev_engine, gen, hl.targets,
-                                      batch=args.batch,
-                                      hit_capacity=args.hit_cap,
-                                      oracle=engine)
-    else:
-        if device == "jax":
-            log.warn("salted engine on device path not yet wired; "
-                     "using cpu oracle", engine=args.engine)
+            worker = dev_engine.make_mask_worker(
+                gen, hl.targets, batch=args.batch,
+                hit_capacity=args.hit_cap, oracle=engine)
+    if worker is None:
         worker = CpuWorker(engine, gen, hl.targets)
 
     potfile = None if args.no_potfile else Potfile(args.potfile)

@@ -48,6 +48,7 @@ def _ensure_imported(device: str) -> None:
     elif device == "jax":
         try:
             import dprf_tpu.engines.device.engines  # noqa: F401
+            import dprf_tpu.engines.device.pmkid    # noqa: F401
         except ModuleNotFoundError as e:
             # Translate only a missing engines.device package into a friendly
             # error; import failures *inside* it should surface as-is.

@@ -45,6 +45,15 @@ class JaxEngineBase(DeviceHashEngine, HashEngine):
         return pack_ops.pack_varlen(cand, lengths,
                                     big_endian=not self.little_endian)
 
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        """Build the fused-pipeline worker for a mask attack on this
+        engine.  Engines with special pipelines (PMKID, bcrypt) override
+        this -- it is the CLI's single entry into the device path."""
+        from dprf_tpu.runtime.worker import DeviceMaskWorker
+        return DeviceMaskWorker(self, gen, targets, batch=batch,
+                                hit_capacity=hit_capacity, oracle=oracle)
+
     # -- host-facing HashEngine API --------------------------------------
 
     def hash_batch(self, candidates: Sequence[bytes],

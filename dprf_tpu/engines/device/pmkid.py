@@ -1,0 +1,118 @@
+"""WPA2-PMKID device engine: the iterated-KDF path (benchmark config 5).
+
+Unlike the fast unsalted engines, PMKID digests depend on per-target
+parameters (essid as the PBKDF2 salt; AP/STA MACs in the PMKID
+message).  The fused step exploits the job structure: the PMK depends
+only on (passphrase, essid), so targets are grouped by essid and the
+4096-iteration PBKDF2 runs once per unique essid per candidate; each
+target then costs only one extra HMAC (4 compressions) and a 4-word
+compare.
+
+A typical PMKID job has one essid and a handful of targets, so the cost
+is ~16.4k SHA-1 compressions per candidate -- the low-throughput path
+by design.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.base import Target
+from dprf_tpu.engines.cpu.engines import Pmkid2Engine
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops import pack as pack_ops
+from dprf_tpu.ops.hmac_sha1 import pbkdf2_sha1_pmk, pmkid_from_pmk
+from dprf_tpu.runtime.worker import DeviceMaskWorker
+
+
+@register("wpa2-pmkid", device="jax")
+@register("pmkid", device="jax")
+class JaxPmkidEngine(Pmkid2Engine):
+    """Device PMKID engine.  Inherits the CPU engine's target parsing
+    (hashcat 16800 lines) and oracle hash_batch; adds the device batch
+    computation and a fused-worker factory the CLI uses."""
+
+    iterations = 4096
+
+    def pmk_packed(self, key_words: jnp.ndarray, essid: bytes) -> jnp.ndarray:
+        """uint32[B, 16] zero-padded passphrase blocks -> uint32[B, 8] PMK."""
+        return pbkdf2_sha1_pmk(key_words, essid, self.iterations)
+
+    def pmkid_packed(self, pmk_words: jnp.ndarray,
+                     target: Target) -> jnp.ndarray:
+        return pmkid_from_pmk(pmk_words, target.params["mac_ap"],
+                              target.params["mac_sta"])
+
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        # PBKDF2 is ~16k compressions/candidate; a huge batch only adds
+        # latency per step, so cap it well below fast-hash batch sizes.
+        return PmkidDeviceWorker(self, gen, targets,
+                                 batch=min(batch, 1 << 14),
+                                 hit_capacity=hit_capacity, oracle=oracle)
+
+
+def make_pmkid_crack_step(engine: JaxPmkidEngine, gen: MaskGenerator,
+                          targets: Sequence[Target], batch: int,
+                          hit_capacity: int = 64):
+    """Fused step: index -> passphrase -> PMK (per essid) -> PMKID (per
+    target) -> hits.  tpos payload is the ORIGINAL target index."""
+    flat = gen.flat_charsets
+    length = gen.length
+    by_essid: dict[bytes, list[int]] = {}
+    for i, t in enumerate(targets):
+        by_essid.setdefault(t.params["essid"], []).append(i)
+    # uint32 target words per target (big-endian PMKID bytes).
+    twords = [np.frombuffer(t.digest, dtype=">u4").astype(np.uint32)
+              for t in targets]
+
+    @jax.jit
+    def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        key = pack_ops.pack_raw(cand, length, big_endian=True)
+        valid = jnp.arange(batch, dtype=jnp.int32) < n_valid
+        # One candidate may match SEVERAL targets (same passphrase under
+        # different essids), so hits are (target, lane) pairs: a [T*B]
+        # found-mask compacted with the target index as payload.
+        hit_rows = []
+        tpos_rows = []
+        for essid, tidx in by_essid.items():
+            pmk = engine.pmk_packed(key, essid)
+            for i in tidx:
+                pmkid = engine.pmkid_packed(pmk, targets[i])
+                hit = jnp.all(pmkid == jnp.asarray(twords[i]), axis=-1)
+                hit_rows.append(hit & valid)
+                tpos_rows.append(jnp.full((batch,), i, jnp.int32))
+        found = jnp.concatenate(hit_rows)
+        tpos = jnp.concatenate(tpos_rows)
+        count, flat_idx, tpos = cmp_ops.compact_hits(found, tpos,
+                                                     hit_capacity)
+        lanes = jnp.where(flat_idx >= 0, flat_idx % batch, flat_idx)
+        return count, lanes, tpos
+
+    return step
+
+
+class PmkidDeviceWorker(DeviceMaskWorker):
+    """Mask worker over the fused PMKID step (salted multi-target)."""
+
+    def __init__(self, engine, gen, targets: Sequence[Target],
+                 batch: int = 1 << 14, hit_capacity: int = 64,
+                 oracle=None):
+        self.engine = engine
+        self.gen = gen
+        self.targets = list(targets)
+        self.hit_capacity = hit_capacity
+        self.oracle = oracle
+        # tpos already carries original target indices: identity order.
+        self.multi = True
+        self._order = np.arange(max(1, len(self.targets)), dtype=np.int64)
+        self.batch = self.stride = batch
+        self.step = make_pmkid_crack_step(engine, gen, self.targets, batch,
+                                          hit_capacity)

@@ -1,0 +1,133 @@
+"""HMAC-SHA1 and PBKDF2-HMAC-SHA1 as jit-traceable device ops.
+
+The WPA2-PMKID path (benchmark config 5): PMK = PBKDF2-HMAC-SHA1(pass,
+essid, 4096, 32), PMKID = HMAC-SHA1(PMK, "PMK Name"|AP|STA)[:16].
+
+Structure exploited on device:
+
+- Passphrases (<= 63 bytes) and PMKs (32 bytes) are shorter than the
+  64-byte SHA-1 block, so the HMAC key pad is a single xor -- no key
+  hashing.  The two keyed chaining states (inner/outer) are computed
+  once per candidate and reused for all 4096 iterations.
+- Every PBKDF2 iteration after the first hashes a 20-byte U value, so
+  one iteration is exactly two sha1_compress calls on constant-padded
+  blocks.  The iteration loop is a `lax.fori_loop` (sequential by
+  definition; the batch dimension provides all the parallelism).
+- The per-block-index first message (salt || INT(i)) is a host-built
+  constant: the salt (essid) is shared by the whole job.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from dprf_tpu.ops.sha1 import INIT as SHA1_INIT, sha1_compress
+
+_IPAD = np.uint32(0x36363636)
+_OPAD = np.uint32(0x5C5C5C5C)
+
+
+def hmac_key_states(key_words: jnp.ndarray):
+    """Keyed chaining states from a zero-padded one-block key.
+
+    key_words: uint32[B, 16] big-endian packed key bytes (<= 64), raw
+    zero padding (NO 0x80 marker -- the key block is a full block).
+    Returns (istate uint32[B, 5], ostate uint32[B, 5]).
+    """
+    init = jnp.broadcast_to(jnp.asarray(SHA1_INIT),
+                            key_words.shape[:-1] + (5,))
+    istate = sha1_compress(init, key_words ^ _IPAD)
+    ostate = sha1_compress(init, key_words ^ _OPAD)
+    return istate, ostate
+
+
+def _block20(words5: jnp.ndarray) -> jnp.ndarray:
+    """Pad a 20-byte (5-word) message into a SHA-1 block that follows a
+    64-byte prefix block: 0x80 marker then bit length 64+20 bytes."""
+    batch = words5.shape[:-1]
+    block = jnp.zeros(batch + (16,), dtype=jnp.uint32)
+    block = block.at[..., :5].set(words5)
+    block = block.at[..., 5].set(jnp.uint32(0x80000000))
+    block = block.at[..., 15].set(jnp.uint32((64 + 20) * 8))
+    return block
+
+
+def hmac_sha1_20(istate: jnp.ndarray, ostate: jnp.ndarray,
+                 msg5: jnp.ndarray) -> jnp.ndarray:
+    """HMAC-SHA1 of a 20-byte message given keyed states.
+
+    msg5: uint32[B, 5].  Returns uint32[B, 5].  Two compressions.
+    """
+    inner = sha1_compress(istate, _block20(msg5))
+    return sha1_compress(ostate, _block20(inner))
+
+
+def salt_block(salt: bytes, block_index: int) -> np.ndarray:
+    """Host-built constant block for U1's message: salt || INT32BE(i),
+    padded as the second block of the inner hash (64-byte key prefix).
+
+    Requires len(salt) <= 51 so salt+4+1 marker+8 length fit one block
+    (an ESSID is at most 32 bytes)."""
+    msg = salt + int(block_index).to_bytes(4, "big")
+    if len(msg) > 55:
+        raise ValueError(f"salt too long for one block: {len(salt)} bytes")
+    buf = np.zeros(64, dtype=np.uint8)
+    buf[:len(msg)] = np.frombuffer(msg, dtype=np.uint8)
+    buf[len(msg)] = 0x80
+    bitlen = (64 + len(msg)) * 8
+    buf[56:] = np.frombuffer(bitlen.to_bytes(8, "big"), dtype=np.uint8)
+    return buf.reshape(16, 4).astype(np.uint32) @ \
+        np.array([1 << 24, 1 << 16, 1 << 8, 1], dtype=np.uint32)
+
+
+def pbkdf2_sha1_block(istate: jnp.ndarray, ostate: jnp.ndarray,
+                      salt: bytes, block_index: int,
+                      iterations: int) -> jnp.ndarray:
+    """One PBKDF2 output block T_i: uint32[B, 5].
+
+    U1 = HMAC(key, salt || INT(i)); U_j = HMAC(key, U_{j-1});
+    T_i = U1 ^ ... ^ U_iterations.
+    """
+    first = jnp.broadcast_to(jnp.asarray(salt_block(salt, block_index)),
+                             istate.shape[:-1] + (16,))
+    inner = sha1_compress(istate, first)
+    u = sha1_compress(ostate, _block20(inner))
+
+    def body(_, carry):
+        u, t = carry
+        u = hmac_sha1_20(istate, ostate, u)
+        return u, t ^ u
+
+    _, t = lax.fori_loop(1, iterations, body, (u, u))
+    return t
+
+
+def pbkdf2_sha1_pmk(key_words: jnp.ndarray, salt: bytes,
+                    iterations: int = 4096) -> jnp.ndarray:
+    """PBKDF2-HMAC-SHA1 with 32-byte output: uint32[B, 8] (T1 || T2[:3]).
+
+    key_words: uint32[B, 16] zero-padded packed passphrases.
+    """
+    istate, ostate = hmac_key_states(key_words)
+    t1 = pbkdf2_sha1_block(istate, ostate, salt, 1, iterations)
+    t2 = pbkdf2_sha1_block(istate, ostate, salt, 2, iterations)
+    return jnp.concatenate([t1, t2[..., :3]], axis=-1)
+
+
+def pmkid_from_pmk(pmk_words: jnp.ndarray, mac_ap: bytes,
+                   mac_sta: bytes) -> jnp.ndarray:
+    """PMKID = HMAC-SHA1(PMK, "PMK Name" | AP | STA)[:16]: uint32[B, 4].
+
+    The 32-byte PMK is the HMAC key (single xor pad); the 20-byte
+    message is a host constant per target.
+    """
+    batch = pmk_words.shape[:-1]
+    key = jnp.zeros(batch + (16,), dtype=jnp.uint32).at[..., :8].set(pmk_words)
+    istate, ostate = hmac_key_states(key)
+    msg = b"PMK Name" + mac_ap + mac_sta
+    assert len(msg) == 20
+    msg5 = np.frombuffer(msg, dtype=">u4").astype(np.uint32)
+    msg5 = jnp.broadcast_to(jnp.asarray(msg5), batch + (5,))
+    return hmac_sha1_20(istate, ostate, msg5)[..., :4]

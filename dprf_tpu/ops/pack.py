@@ -81,6 +81,18 @@ def pack_varlen(cand: jnp.ndarray, lengths: jnp.ndarray,
     return words
 
 
+def pack_raw(cand: jnp.ndarray, length: int,
+             big_endian: bool = True) -> jnp.ndarray:
+    """Pack bytes into a full 64-byte block with ZERO padding (no MD
+    marker/bit count) -- the HMAC key-block layout, where a short key is
+    zero-extended to the block size."""
+    if length > 64:
+        raise ValueError(f"key block packing needs length <= 64, got {length}")
+    batch = cand.shape[0]
+    padded = jnp.zeros((batch, 64), dtype=jnp.uint8).at[:, :length].set(cand)
+    return _words_from_bytes(padded, big_endian)
+
+
 def utf16le_widen(cand: jnp.ndarray) -> jnp.ndarray:
     """uint8[B, L] latin-1 bytes -> uint8[B, 2L] UTF-16LE (NTLM input)."""
     batch, length = cand.shape

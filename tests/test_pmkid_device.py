@@ -1,0 +1,141 @@
+"""Device PBKDF2-HMAC-SHA1 / WPA2-PMKID vs stdlib oracles.
+
+Covers: RFC 6070 PBKDF2 vectors, random-candidate equivalence with
+hashlib.pbkdf2_hmac, PMKID equivalence with the CPU oracle engine, and
+the fused PMKID worker end-to-end (planted passphrase, multi-essid).
+"""
+
+import hashlib
+import hmac as hmac_mod
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.engines.device.pmkid import (JaxPmkidEngine,
+                                           PmkidDeviceWorker)
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.ops import pack as pack_ops
+from dprf_tpu.ops.hmac_sha1 import (hmac_key_states, hmac_sha1_20,
+                                    pbkdf2_sha1_block, pbkdf2_sha1_pmk,
+                                    pmkid_from_pmk)
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+def _pack_keys(keys: list) -> jnp.ndarray:
+    maxlen = max(len(k) for k in keys)
+    buf = np.zeros((len(keys), maxlen), dtype=np.uint8)
+    for i, k in enumerate(keys):
+        buf[i, :len(k)] = np.frombuffer(k, dtype=np.uint8)
+    # zero padding beyond each key is exactly the HMAC key-block rule as
+    # long as every key has the same length; tests use equal lengths.
+    assert all(len(k) == maxlen for k in keys)
+    return pack_ops.pack_raw(jnp.asarray(buf), maxlen, big_endian=True)
+
+
+def _words_to_bytes(w: np.ndarray) -> bytes:
+    return np.asarray(w).astype(">u4").tobytes()
+
+
+def test_hmac_sha1_20_matches_stdlib():
+    keys = [bytes([random.randrange(256) for _ in range(16)])
+            for _ in range(32)]
+    msg = bytes(range(20))
+    kw = _pack_keys(keys)
+    istate, ostate = hmac_key_states(kw)
+    msg5 = jnp.broadcast_to(
+        jnp.asarray(np.frombuffer(msg, dtype=">u4").astype(np.uint32)),
+        (len(keys), 5))
+    got = hmac_sha1_20(istate, ostate, msg5)
+    for i, k in enumerate(keys):
+        want = hmac_mod.new(k, msg, hashlib.sha1).digest()
+        assert _words_to_bytes(got[i]) == want
+
+
+@pytest.mark.parametrize("password,salt,iters,dk20", [
+    # RFC 6070 test vectors (PBKDF2-HMAC-SHA1, dkLen=20)
+    (b"password", b"salt", 1,
+     "0c60c80f961f0e71f3a9b524af6012062fe037a6"),
+    (b"password", b"salt", 2,
+     "ea6c014dc72d6f8ccd1ed92ace1d41f0d8de8957"),
+    (b"password", b"salt", 4096,
+     "4b007901b765489abead49d926f721d065a429c1"),
+])
+def test_pbkdf2_rfc6070_vectors(password, salt, iters, dk20):
+    kw = _pack_keys([password])
+    istate, ostate = hmac_key_states(kw)
+    t1 = pbkdf2_sha1_block(istate, ostate, salt, 1, iters)
+    assert _words_to_bytes(t1[0]) == bytes.fromhex(dk20)
+
+
+def test_pbkdf2_pmk_matches_hashlib():
+    rng = random.Random(7)
+    pws = [bytes(rng.randrange(0x21, 0x7F) for _ in range(10))
+           for _ in range(8)]
+    essid = b"TestNet-5G"
+    got = pbkdf2_sha1_pmk(_pack_keys(pws), essid, iterations=128)
+    for i, pw in enumerate(pws):
+        want = hashlib.pbkdf2_hmac("sha1", pw, essid, 128, 32)
+        assert _words_to_bytes(got[i]) == want
+
+
+def test_full_4096_iteration_pmk():
+    pw = b"password"
+    essid = b"linksys"
+    got = pbkdf2_sha1_pmk(_pack_keys([pw]), essid, iterations=4096)
+    want = hashlib.pbkdf2_hmac("sha1", pw, essid, 4096, 32)
+    assert _words_to_bytes(got[0]) == want
+
+
+def test_pmkid_matches_cpu_oracle():
+    oracle = get_engine("wpa2-pmkid", device="cpu")
+    pw = b"hunter2hunter2"
+    essid, ap, sta = b"CoffeeShop", bytes(range(6)), bytes(range(6, 12))
+    pmk = hashlib.pbkdf2_hmac("sha1", pw, essid, 4096, 32)
+    pmk_words = jnp.asarray(
+        np.frombuffer(pmk, dtype=">u4").astype(np.uint32))[None, :]
+    got = pmkid_from_pmk(pmk_words, ap, sta)
+    want = oracle.hash_batch(
+        [pw], params={"essid": essid, "mac_ap": ap, "mac_sta": sta})[0]
+    assert _words_to_bytes(got[0]) == want
+
+
+def _target_line(pw: bytes, essid: bytes, ap: bytes, sta: bytes) -> str:
+    pmk = hashlib.pbkdf2_hmac("sha1", pw, essid, 4096, 32)
+    pmkid = hmac_mod.new(pmk, b"PMK Name" + ap + sta,
+                         hashlib.sha1).digest()[:16]
+    return f"{pmkid.hex()}*{ap.hex()}*{sta.hex()}*{essid.hex()}"
+
+
+def test_pmkid_device_worker_end_to_end():
+    """Planted passphrases in a 100-candidate keyspace, two essids."""
+    engine = get_engine("wpa2-pmkid", device="jax")
+    assert isinstance(engine, JaxPmkidEngine)
+    engine.iterations = 256     # keep the CPU-backend test quick
+    gen = MaskGenerator("secret?d?d")
+    ap, sta = bytes.fromhex("aabbccddeeff"), bytes.fromhex("112233445566")
+
+    def line(pw, essid):
+        pmk = hashlib.pbkdf2_hmac("sha1", pw, essid, 256, 32)
+        pmkid = hmac_mod.new(pmk, b"PMK Name" + ap + sta,
+                             hashlib.sha1).digest()[:16]
+        return f"{pmkid.hex()}*{ap.hex()}*{sta.hex()}*{essid.hex()}"
+
+    cpu = get_engine("wpa2-pmkid", device="cpu")
+    targets = [cpu.parse_target(line(b"secret42", b"NetA")),
+               cpu.parse_target(line(b"secret87", b"NetB")),
+               cpu.parse_target(line(b"secret87", b"NetA"))]
+    w = PmkidDeviceWorker(engine, gen, targets, batch=32)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    got = sorted((h.target_index, h.plaintext) for h in hits)
+    assert got == [(0, b"secret42"), (1, b"secret87"), (2, b"secret87")]
+    for h in hits:
+        assert gen.candidate(h.cand_index) == h.plaintext
+
+
+def test_jax_engine_registered_with_worker_factory():
+    engine = get_engine("pmkid", device="jax")
+    assert engine.salted
+    assert hasattr(engine, "make_mask_worker")
