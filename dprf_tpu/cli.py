@@ -10,6 +10,7 @@ engines, keyspace.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import Optional
 
@@ -96,19 +97,61 @@ def cmd_crack(args, log: Log) -> int:
     log.info("loaded targets", count=len(hl.targets),
              duplicates=hl.duplicates, engine=engine.name)
 
-    if args.attack != "mask":
-        log.error("wordlist attacks land with the rules engine; "
-                  "only mask attacks are wired up so far")
-        return 2
-    customs = _customs(args)
-    gen = MaskGenerator(args.attack_arg, custom=customs or None)
-    log.info("keyspace", mask=args.attack_arg, size=gen.keyspace)
+    unit_size = args.unit_size
+    if args.attack == "mask":
+        customs = _customs(args)
+        gen = MaskGenerator(args.attack_arg, custom=customs or None)
+        log.info("keyspace", mask=args.attack_arg, size=gen.keyspace)
+        # Custom charsets change which candidate an index decodes to, so
+        # they are part of the job identity.
+        attack_desc = f"mask:{args.attack_arg}" + "".join(
+            f":{i}={customs[i].hex()}" for i in sorted(customs))
+    else:
+        import hashlib as _hl
 
-    # Custom charsets change which candidate an index decodes to, so they
-    # are part of the job identity.
-    attack_desc = f"mask:{args.attack_arg}" + "".join(
-        f":{i}={customs[i].hex()}" for i in sorted(customs))
-    spec = JobSpec(engine=engine.name, device=device, attack="mask",
+        from dprf_tpu.generators.wordlist import (WordlistRulesGenerator,
+                                                  load_words)
+        from dprf_tpu.rules import load_rules, resolve_rules_path
+
+        # The 55-byte single-block limit only binds on the device packer;
+        # a CPU-oracle job (no device wordlist worker) keeps the engine's
+        # own limit (e.g. 63-byte WPA passphrases).
+        dev_capable = False
+        if device == "jax":
+            try:
+                dev_capable = hasattr(get_engine(args.engine, device="jax"),
+                                      "make_wordlist_worker")
+            except KeyError:
+                pass
+        max_len = (min(55, engine.max_candidate_len) if dev_capable
+                   else engine.max_candidate_len)
+        words, skipped_long = load_words(args.attack_arg, max_len)
+        if skipped_long:
+            log.warn("skipped overlong words", count=skipped_long,
+                     max_len=max_len)
+        rules = None
+        rules_id = "none"
+        if args.rules:
+            rules = load_rules(args.rules, on_error="skip")
+            with open(resolve_rules_path(args.rules), "rb") as fh:
+                rules_id = _hl.sha256(fh.read()).hexdigest()[:16]
+        gen = WordlistRulesGenerator(words, rules, max_len=max_len)
+        log.info("keyspace", words=gen.n_words, rules=gen.n_rules,
+                 size=gen.keyspace)
+        # Wordlist contents decide what an index decodes to: fingerprint
+        # the word stream, not the file path.
+        wl_id = _hl.sha256()
+        for w in words:
+            wl_id.update(w)
+            wl_id.update(b"\0")
+        attack_desc = (f"wordlist:{wl_id.hexdigest()[:16]}"
+                       f":rules={rules_id}")
+        # Units aligned to whole words: no candidate is ever rehashed at
+        # unit boundaries on the device path.
+        unit_size = max(gen.n_rules,
+                        (unit_size // gen.n_rules) * gen.n_rules)
+
+    spec = JobSpec(engine=engine.name, device=device, attack=args.attack,
                    attack_arg=args.attack_arg, keyspace=gen.keyspace,
                    fingerprint=job_fingerprint(
                        engine.name, attack_desc, gen.keyspace,
@@ -142,24 +185,26 @@ def cmd_crack(args, log: Log) -> int:
 
     if completed:
         dispatcher = Dispatcher.from_completed(
-            gen.keyspace, args.unit_size, completed)
+            gen.keyspace, unit_size, completed)
     else:
-        dispatcher = Dispatcher(gen.keyspace, args.unit_size)
+        dispatcher = Dispatcher(gen.keyspace, unit_size)
 
     # Worker selection: each device engine builds its own fused worker
     # (make_mask_worker), so salted pipelines (PMKID, bcrypt) plug in
     # the same way the fast unsalted ones do.
     worker = None
+    maker_name = ("make_mask_worker" if args.attack == "mask"
+                  else "make_wordlist_worker")
     if device == "jax":
         try:
             dev_engine = get_engine(args.engine, device="jax")
         except KeyError:
             dev_engine = None
-        if dev_engine is None or not hasattr(dev_engine, "make_mask_worker"):
-            log.warn("no jax engine for algorithm; using cpu oracle",
+        if dev_engine is None or not hasattr(dev_engine, maker_name):
+            log.warn("no jax engine for algorithm/attack; using cpu oracle",
                      engine=args.engine)
         else:
-            worker = dev_engine.make_mask_worker(
+            worker = getattr(dev_engine, maker_name)(
                 gen, hl.targets, batch=args.batch,
                 hit_capacity=args.hit_cap, oracle=engine)
     if worker is None:

@@ -54,6 +54,13 @@ class JaxEngineBase(DeviceHashEngine, HashEngine):
         return DeviceMaskWorker(self, gen, targets, batch=batch,
                                 hit_capacity=hit_capacity, oracle=oracle)
 
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        """Fused wordlist+rules worker (config 3's on-device expansion)."""
+        from dprf_tpu.runtime.worker import DeviceWordlistWorker
+        return DeviceWordlistWorker(self, gen, targets, batch=batch,
+                                    hit_capacity=hit_capacity, oracle=oracle)
+
     # -- host-facing HashEngine API --------------------------------------
 
     def hash_batch(self, candidates: Sequence[bytes],

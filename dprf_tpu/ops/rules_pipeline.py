@@ -1,0 +1,154 @@
+"""Fused wordlist+rules crack step (benchmark config 3).
+
+One jitted program per job: slice a word batch out of the HBM-resident
+packed wordlist, expand it through EVERY rule of the set *on device*
+(config 3's "on-device rule expansion"), pack, digest, compare, compact
+hits.  Rule application is trace-time-unrolled straight-line vector code
+(rules/device.py), and the R-fold expanded candidate block [R*B, L] goes
+through the engine's digest exactly once per step, so the hash — the
+actual hot loop — dominates.
+
+Index mapping (matches WordlistRulesGenerator): the concatenated
+candidate block is rule-major, flat lane = r*B + b, and global keyspace
+index = (w0 + b) * R + r.
+
+Multi-chip: the sharded variant gives each chip a contiguous
+`word_batch`-word slice of the super-batch; the wordlist array is
+replicated to every chip's HBM once per job and sliced locally, so the
+only steady-state cross-chip traffic is the psum'd hit count.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops import pack as pack_ops
+from dprf_tpu.rules.device import apply_rule as apply_rule_device
+
+
+def _expand_and_digest(engine, rules, wslice, lslice, base_valid,
+                       max_len: int, widen_utf16: bool):
+    """Apply every rule to the word slice, digest the whole block.
+
+    Returns (digest uint32[R*B, W], valid bool[R*B]) in rule-major
+    flat-lane order."""
+    cands, clens, cvalid = [], [], []
+    for rule in rules:
+        cw, cl, cv = apply_rule_device(wslice, lslice, base_valid,
+                                       rule, max_len)
+        cands.append(cw)
+        clens.append(cl)
+        cvalid.append(cv)
+    cw = jnp.concatenate(cands, axis=0)
+    cl = jnp.concatenate(clens, axis=0)
+    cv = jnp.concatenate(cvalid, axis=0)
+    if widen_utf16:
+        cw = pack_ops.utf16le_widen(cw)
+        cl = cl * 2
+    words = engine.pack_varlen(cw, cl)
+    return engine.digest_packed(words), cv
+
+
+def _compare(digest, targets, multi):
+    if multi:
+        return cmp_ops.compare_multi(digest, targets)
+    found = cmp_ops.compare_single(digest, targets)
+    return found, jnp.zeros(digest.shape[0], jnp.int32)
+
+
+def make_wordlist_crack_step(
+        engine, gen: WordlistRulesGenerator,
+        targets: Union[jnp.ndarray, cmp_ops.TargetTable],
+        word_batch: int, hit_capacity: int = 64,
+        widen_utf16: bool = False):
+    """Returns step(w0 int32, n_valid_words int32) ->
+    (count int32, lanes int32[cap], tpos int32[cap]); lanes are flat
+    r*B+b indices into the step's candidate block."""
+    B, L = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+    multi = isinstance(targets, cmp_ops.TargetTable)
+
+    @jax.jit
+    def step(w0: jnp.ndarray, n_valid_words: jnp.ndarray):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        digest, cv = _expand_and_digest(engine, rules, wslice, lslice,
+                                        base_valid, L, widen_utf16)
+        found, tpos = _compare(digest, targets, multi)
+        return cmp_ops.compact_hits(found & cv, tpos, hit_capacity)
+
+    return step
+
+
+def make_sharded_wordlist_crack_step(
+        engine, gen: WordlistRulesGenerator,
+        targets: Union[jnp.ndarray, cmp_ops.TargetTable],
+        mesh: Mesh, word_batch: int, hit_capacity: int = 64,
+        widen_utf16: bool = False):
+    """Multi-chip variant: chip c expands+hashes words
+    [w0 + c*word_batch, w0 + (c+1)*word_batch).
+
+    Returns step(w0 int32, n_valid_words int32) ->
+        (total int32, counts int32[n_dev], lanes int32[n_dev, cap],
+         tpos int32[n_dev, cap]); lanes are flat indices into the
+    *super-batch* candidate block, i.e. r*(n_dev*B) + (global word lane).
+    """
+    from dprf_tpu.parallel.mesh import SHARD_AXIS
+
+    n_dev = mesh.devices.size
+    B, L = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(
+        pad_to=n_dev * B, min_size=gen.n_words + n_dev * B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+    R = len(rules)
+    multi = isinstance(targets, cmp_ops.TargetTable)
+
+    def shard_fn(w0, n_valid_words):
+        dev = lax.axis_index(SHARD_AXIS)
+        my_w0 = w0 + (dev * B).astype(jnp.int32)
+        wslice = lax.dynamic_slice(words_dev, (my_w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (my_w0,), (B,))
+        word_lane = (dev * B).astype(jnp.int32) + jnp.arange(B, dtype=jnp.int32)
+        base_valid = word_lane < n_valid_words
+        digest, cv = _expand_and_digest(engine, rules, wslice, lslice,
+                                        base_valid, L, widen_utf16)
+        found, tpos = _compare(digest, targets, multi)
+        count, lanes, tpos = cmp_ops.compact_hits(
+            found & cv, tpos, hit_capacity)
+        # local flat lane r*B + b -> super-batch flat lane
+        # r*(n_dev*B) + dev*B + b, preserving -1 padding.
+        r = lanes // B
+        b = lanes % B
+        glanes = r * (n_dev * B) + dev * B + b
+        lanes = jnp.where(lanes >= 0, glanes, lanes)
+        total = lax.psum(count, SHARD_AXIS)
+        return (total[None], count[None], lanes[None, :], tpos[None, :])
+
+    sharded = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=(P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False)
+
+    @jax.jit
+    def step(w0: jnp.ndarray, n_valid_words: jnp.ndarray):
+        total, counts, lanes, tpos = sharded(w0, n_valid_words)
+        return total[0], counts, lanes, tpos
+
+    step.super_words = n_dev * B
+    step.n_rules = R
+    return step

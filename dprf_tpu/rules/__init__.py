@@ -1,0 +1,27 @@
+"""Rule engine for wordlist+rules attacks (benchmark config 3).
+
+The rule language is the de-facto standard hashcat/John syntax (a public
+specification): one rule per line, a rule being a sequence of
+single-character operations with positional/character parameters.  This
+package provides:
+
+- `parser`   — rule text -> op tuples (validated, with opcode table)
+- `cpu`      — host interpreter: the correctness oracle and CpuWorker path
+- `device`   — jit-traceable batch application: each rule's ops are baked
+               in as static constants so XLA sees straight-line vector code
+- `best64`   — a built-in 64-rule general-purpose set (authored here, in
+               the standard syntax) selectable as `--rules best64`
+
+SURVEY.md section 2 ("CandidateGenerator — wordlist+rules") and section 7
+item 7 ("on-device rule expansion") are the blueprint; no reference code
+existed to consult (SURVEY.md critical note).
+"""
+
+from dprf_tpu.rules.parser import (Op, OpSpec, OPS, parse_rule, parse_rules,
+                                   load_rules, resolve_rules_path,
+                                   builtin_ruleset, BUILTIN_RULESETS)
+from dprf_tpu.rules.cpu import apply_rule as apply_rule_cpu
+
+__all__ = ["Op", "OpSpec", "OPS", "parse_rule", "parse_rules", "load_rules",
+           "resolve_rules_path", "builtin_ruleset", "BUILTIN_RULESETS",
+           "apply_rule_cpu"]

@@ -1,0 +1,258 @@
+"""Device rule application: one rule, a whole word batch, pure vector ops.
+
+Each rule's operations are Python-level constants at trace time, so
+applying a rule to a batch lowers to straight-line uint8 vector code —
+selects, shifts, and per-lane gathers (`take_along_axis`) — that XLA
+fuses with the downstream pack/digest/compare pipeline.  There is no
+on-device bytecode interpreter loop: the "interpretation" happens once,
+at trace time, which is both faster (no lax.switch dispatch) and exactly
+as flexible because a job's rule set is static.
+
+Semantics mirror rules/cpu.py byte-for-byte (see its docstring for the
+no-op / reject conventions); tests/test_rules.py enforces equivalence on
+random words x the full op set.
+
+State per batch: (w uint8[B, L], lens int32[B], valid bool[B]).
+Invariant maintained after every op: bytes at positions >= lens are 0,
+and lens <= L even for rejected lanes (whose `valid` bit is cleared).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from dprf_tpu.rules.parser import Op, Opcode
+
+
+def _pos(L: int) -> jnp.ndarray:
+    return jnp.arange(L, dtype=jnp.int32)[None, :]
+
+
+def _gather(w: jnp.ndarray, src: jnp.ndarray) -> jnp.ndarray:
+    """Per-lane source-index gather, clamped so indices are always legal
+    (masks applied by callers make clamped lanes irrelevant)."""
+    L = w.shape[1]
+    return jnp.take_along_axis(w, jnp.clip(src, 0, L - 1), axis=1)
+
+
+def _lower(w):
+    return jnp.where((w >= 0x41) & (w <= 0x5A), w + 0x20, w)
+
+
+def _upper(w):
+    return jnp.where((w >= 0x61) & (w <= 0x7A), w - 0x20, w)
+
+
+def _togglec(w):
+    up = (w >= 0x41) & (w <= 0x5A)
+    lo = (w >= 0x61) & (w <= 0x7A)
+    return jnp.where(up, w + 0x20, jnp.where(lo, w - 0x20, w))
+
+
+def _contains(w, lens, ch: int):
+    return ((w == jnp.uint8(ch)) & (_pos(w.shape[1]) < lens[:, None])).any(1)
+
+
+def _count(w, lens, ch: int):
+    return ((w == jnp.uint8(ch))
+            & (_pos(w.shape[1]) < lens[:, None])).sum(1, dtype=jnp.int32)
+
+
+def _char_at(w, idx):
+    """Per-lane byte at (traced) index idx[B]; callers guard validity."""
+    return jnp.take_along_axis(
+        w, jnp.clip(idx, 0, w.shape[1] - 1)[:, None], axis=1)[:, 0]
+
+
+def apply_rule(w: jnp.ndarray, lens: jnp.ndarray, valid: jnp.ndarray,
+               ops: Sequence[Op], max_len: int):
+    """Apply one parsed rule to a batch.  jit-traceable; ops are static.
+
+    w: uint8[B, L] (L >= max_len), lens: int32[B], valid: bool[B].
+    Returns the new (w, lens, valid).
+    """
+    B, L = w.shape
+    pos = _pos(L)
+    for op in ops:
+        code, p1, p2 = op.opcode, op.p1, op.p2
+        lc = lens[:, None]          # broadcastable per-lane length
+        grow = None                 # (newlens,) set by growth ops
+
+        if code == Opcode.NOOP:
+            pass
+        elif code == Opcode.LOWER:
+            w = _lower(w)
+        elif code == Opcode.UPPER:
+            w = _upper(w)
+        elif code == Opcode.CAPITALIZE:
+            w = _lower(w)
+            w = jnp.where(pos == 0, _upper(w), w)
+        elif code == Opcode.INV_CAPITALIZE:
+            w = _upper(w)
+            w = jnp.where(pos == 0, _lower(w), w)
+        elif code == Opcode.TOGGLE_ALL:
+            w = _togglec(w)
+        elif code == Opcode.TOGGLE_AT:
+            if p1 < L:
+                w = jnp.where((pos == p1) & (p1 < lc), _togglec(w), w)
+        elif code == Opcode.REVERSE:
+            w = _gather(w, lc - 1 - pos)
+        elif code == Opcode.DUPLICATE:
+            w = jnp.where(pos < lc, w, _gather(w, pos - lc))
+            grow = 2 * lens
+        elif code == Opcode.DUPLICATE_N:
+            safe = jnp.maximum(lc, 1)
+            w = _gather(w, pos % safe)
+            grow = (p1 + 1) * lens
+        elif code == Opcode.REFLECT:
+            w = jnp.where(pos < lc, w, _gather(w, 2 * lc - 1 - pos))
+            grow = 2 * lens
+        elif code == Opcode.ROT_LEFT:
+            safe = jnp.maximum(lc, 1)
+            w = jnp.where(lc > 1, _gather(w, (pos + 1) % safe), w)
+        elif code == Opcode.ROT_RIGHT:
+            safe = jnp.maximum(lc, 1)
+            w = jnp.where(lc > 1, _gather(w, (pos - 1 + safe) % safe), w)
+        elif code == Opcode.DEL_FIRST:
+            w = _gather(w, pos + 1)
+            lens = jnp.maximum(lens - 1, 0)
+        elif code == Opcode.DEL_LAST:
+            lens = jnp.maximum(lens - 1, 0)
+        elif code == Opcode.DEL_AT:
+            hit = p1 < lens
+            w = jnp.where(hit[:, None],
+                          _gather(w, jnp.where(pos < p1, pos, pos + 1)), w)
+            lens = jnp.where(hit, lens - 1, lens)
+        elif code == Opcode.EXTRACT:
+            hit = p1 < lens
+            w = jnp.where(hit[:, None], _gather(w, pos + p1), w)
+            lens = jnp.where(hit, jnp.minimum(p2, lens - p1), lens)
+        elif code == Opcode.OMIT:
+            hit = p1 < lens
+            w = jnp.where(hit[:, None],
+                          _gather(w, jnp.where(pos < p1, pos, pos + p2)), w)
+            lens = jnp.where(hit, lens - jnp.minimum(p2, lens - p1), lens)
+        elif code == Opcode.INSERT:
+            hit = p1 <= lens
+            moved = _gather(w, jnp.where(pos < p1, pos, pos - 1))
+            moved = jnp.where(pos == p1, jnp.uint8(p2), moved)
+            w = jnp.where(hit[:, None], moved, w)
+            grow = jnp.where(hit, lens + 1, lens)
+        elif code == Opcode.OVERWRITE:
+            if p1 < L:
+                w = jnp.where((pos == p1) & (p1 < lc), jnp.uint8(p2), w)
+        elif code == Opcode.TRUNCATE:
+            lens = jnp.minimum(lens, p1)
+        elif code == Opcode.SUBSTITUTE:
+            w = jnp.where((w == jnp.uint8(p1)) & (pos < lc),
+                          jnp.uint8(p2), w)
+        elif code == Opcode.PURGE:
+            keep = (w != jnp.uint8(p1)) & (pos < lc)
+            key = jnp.where(keep, pos, pos + L)
+            order = jnp.argsort(key, axis=1)     # stable: keepers first
+            w = jnp.take_along_axis(w, order, axis=1)
+            lens = keep.sum(1, dtype=jnp.int32)
+        elif code == Opcode.DUP_FIRST:
+            nz = lens > 0
+            out = jnp.where(pos < p1, w[:, 0:1], _gather(w, pos - p1))
+            w = jnp.where(nz[:, None], out, w)
+            grow = jnp.where(nz, lens + p1, lens)
+        elif code == Opcode.DUP_LAST:
+            nz = lens > 0
+            last = _char_at(w, lens - 1)[:, None]
+            out = jnp.where(pos < lc, w, last)
+            w = jnp.where(nz[:, None], out, w)
+            grow = jnp.where(nz, lens + p1, lens)
+        elif code == Opcode.DUP_ALL:
+            w = _gather(w, pos // 2)
+            grow = 2 * lens
+        elif code == Opcode.SWAP_FRONT:
+            two = lens >= 2
+            src = jnp.where(pos == 0, 1, jnp.where(pos == 1, 0, pos))
+            w = jnp.where(two[:, None], _gather(w, src), w)
+        elif code == Opcode.SWAP_BACK:
+            two = lens >= 2
+            src = jnp.where(pos == lc - 1, lc - 2,
+                            jnp.where(pos == lc - 2, lc - 1, pos))
+            w = jnp.where(two[:, None], _gather(w, src), w)
+        elif code == Opcode.SWAP_AT:
+            hit = (p1 < lens) & (p2 < lens)
+            src = jnp.where(pos == p1, p2, jnp.where(pos == p2, p1, pos))
+            w = jnp.where(hit[:, None], _gather(w, src), w)
+        elif code == Opcode.SHIFT_LEFT:
+            if p1 < L:
+                w = jnp.where((pos == p1) & (p1 < lc), w << 1, w)
+        elif code == Opcode.SHIFT_RIGHT:
+            if p1 < L:
+                w = jnp.where((pos == p1) & (p1 < lc), w >> 1, w)
+        elif code == Opcode.INCR_AT:
+            if p1 < L:
+                w = jnp.where((pos == p1) & (p1 < lc), w + jnp.uint8(1), w)
+        elif code == Opcode.DECR_AT:
+            if p1 < L:
+                w = jnp.where((pos == p1) & (p1 < lc), w - jnp.uint8(1), w)
+        elif code == Opcode.REPL_NEXT:
+            if p1 + 1 < L:
+                w = jnp.where((pos == p1) & (p1 + 1 < lc),
+                              w[:, p1 + 1:p1 + 2], w)
+        elif code == Opcode.REPL_PREV:
+            if 1 <= p1 < L:
+                w = jnp.where((pos == p1) & (p1 < lc),
+                              w[:, p1 - 1:p1], w)
+        elif code == Opcode.DUP_BLOCK_FRONT:
+            hit = p1 <= lens
+            out = jnp.where(pos < p1, w, _gather(w, pos - p1))
+            w = jnp.where(hit[:, None], out, w)
+            grow = jnp.where(hit, lens + p1, lens)
+        elif code == Opcode.DUP_BLOCK_BACK:
+            hit = p1 <= lens
+            out = jnp.where(pos < lc, w, _gather(w, pos - p1))
+            w = jnp.where(hit[:, None], out, w)
+            grow = jnp.where(hit, lens + p1, lens)
+        elif code == Opcode.APPEND:
+            w = jnp.where(pos == lc, jnp.uint8(p1), w)
+            grow = lens + 1
+        elif code == Opcode.PREPEND:
+            w = _gather(w, pos - 1)
+            w = jnp.where(pos == 0, jnp.uint8(p1), w)
+            grow = lens + 1
+        elif code in (Opcode.TITLE, Opcode.TITLE_SEP):
+            sep = 0x20 if code == Opcode.TITLE else p1
+            prev = _gather(w, pos - 1)     # original bytes, shifted right
+            low = _lower(w)
+            up_here = (pos == 0) | (prev == jnp.uint8(sep))
+            w = jnp.where(up_here & (pos < lc), _upper(low), low)
+        elif code == Opcode.REJ_GT:
+            valid = valid & (lens <= p1)
+        elif code == Opcode.REJ_LT:
+            valid = valid & (lens >= p1)
+        elif code == Opcode.REJ_NEQ_LEN:
+            valid = valid & (lens == p1)
+        elif code == Opcode.REJ_CONTAIN:
+            valid = valid & ~_contains(w, lens, p1)
+        elif code == Opcode.REJ_NOT_CONTAIN:
+            valid = valid & _contains(w, lens, p1)
+        elif code == Opcode.REJ_NOT_FIRST:
+            valid = valid & (lens > 0) & (w[:, 0] == jnp.uint8(p1))
+        elif code == Opcode.REJ_NOT_LAST:
+            valid = valid & (lens > 0) & (
+                _char_at(w, lens - 1) == jnp.uint8(p1))
+        elif code == Opcode.REJ_NOT_AT:
+            if p1 < L:
+                valid = valid & (p1 < lens) & (w[:, p1] == jnp.uint8(p2))
+            else:
+                valid = valid & False
+        elif code == Opcode.REJ_LT_COUNT:
+            valid = valid & (_count(w, lens, p2) >= p1)
+        else:  # pragma: no cover
+            raise AssertionError(f"unhandled opcode {code}")
+
+        if grow is not None:
+            valid = valid & (grow <= max_len)
+            lens = jnp.minimum(grow, jnp.int32(max_len))
+        # Re-establish the zero-tail invariant (growth ops may have
+        # written garbage past a rejected lane's clamped length).
+        w = jnp.where(pos < lens[:, None], w, jnp.uint8(0))
+    return w, lens, valid

@@ -44,18 +44,26 @@ class CpuWorker:
         hits: list[Hit] = []
         for start in range(unit.start, unit.end, self.chunk):
             n = min(self.chunk, unit.end - start)
-            cands = self.gen.candidates(start, n)
+            # Rule-based generators may reject candidates (None): those
+            # keyspace indices are holes — never hashed.
+            pairs = [(start + j, c)
+                     for j, c in enumerate(self.gen.candidates(start, n))
+                     if c is not None]
+            if not pairs:
+                continue
+            cands = [c for _, c in pairs]
             if self.engine.salted:
                 for ti, t in enumerate(self.targets):
-                    for j, d in enumerate(self.engine.hash_batch(
+                    for (gidx, cand), d in zip(pairs, self.engine.hash_batch(
                             cands, params=t.params)):
                         if d == t.digest:
-                            hits.append(Hit(ti, start + j, cands[j]))
+                            hits.append(Hit(ti, gidx, cand))
             else:
-                for j, d in enumerate(self.engine.hash_batch(cands)):
+                for (gidx, cand), d in zip(pairs,
+                                           self.engine.hash_batch(cands)):
                     ti = self._digest_map.get(d)
                     if ti is not None:
-                        hits.append(Hit(ti, start + j, cands[j]))
+                        hits.append(Hit(ti, gidx, cand))
         return hits
 
 
@@ -120,6 +128,71 @@ class MaskWorkerBase:
                 "oracle engine to rescan with; raise hit_capacity")
         end = min(bstart + self.stride, unit.end)
         sub = WorkUnit(-1, bstart, end - bstart)
+        return CpuWorker(self.oracle, self.gen, self.targets).process(sub)
+
+
+class DeviceWordlistWorker(MaskWorkerBase):
+    """Fused-pipeline worker for wordlist+rules attacks (config 3).
+
+    Units are keyspace index ranges over words x rules (index = word *
+    n_rules + rule).  The step covers whole words, so a unit whose
+    boundaries are not rule-aligned is processed over the covering word
+    range with out-of-unit hits filtered — correct for any unit size,
+    though the CLI aligns unit_size to n_rules so nothing is rehashed.
+    """
+
+    def __init__(self, engine, gen, targets: Sequence[Target],
+                 batch: int = 1 << 18, hit_capacity: int = 64,
+                 oracle: Optional[HashEngine] = None):
+        from dprf_tpu.ops.rules_pipeline import make_wordlist_crack_step
+
+        tgt = self._setup_targets(engine, gen, targets, hit_capacity, oracle)
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self.step = make_wordlist_crack_step(
+            engine, gen, tgt, self.word_batch, hit_capacity,
+            widen_utf16=getattr(engine, "widen_utf16", False))
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        import jax.numpy as jnp
+        R = self.gen.n_rules
+        w_start = unit.start // R
+        w_end = -(-unit.end // R)          # ceil: covering word range
+        queued = []
+        for ws in range(w_start, w_end, self.word_batch):
+            nw = min(self.word_batch, w_end - ws, self.gen.n_words - ws)
+            if nw <= 0:
+                break
+            queued.append((ws, nw, self.step(jnp.int32(ws), jnp.int32(nw))))
+        hits: list[Hit] = []
+        for ws, nw, result in queued:
+            count, lanes, tpos = result
+            count = int(count)
+            if count == 0:
+                continue
+            if count > self.hit_capacity:
+                hits.extend(self._rescan_words(ws, nw, unit))
+                continue
+            for lane, tp in zip(np.asarray(lanes), np.asarray(tpos)):
+                if lane < 0:
+                    continue
+                r, b = divmod(int(lane), self.word_batch)
+                gidx = (ws + b) * R + r
+                if not unit.start <= gidx < unit.end:
+                    continue
+                ti = int(self._order[int(tp)]) if self.multi else 0
+                hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+    def _rescan_words(self, ws: int, nw: int, unit: WorkUnit) -> list[Hit]:
+        if self.oracle is None:
+            raise RuntimeError(
+                f"hit buffer overflow (> {self.hit_capacity}) and no "
+                "oracle engine to rescan with; raise hit_capacity")
+        R = self.gen.n_rules
+        start = max(unit.start, ws * R)
+        end = min(unit.end, (ws + nw) * R)
+        sub = WorkUnit(-1, start, end - start)
         return CpuWorker(self.oracle, self.gen, self.targets).process(sub)
 
 

@@ -121,6 +121,61 @@ def test_potfile_precracked_skips_work(tmp_path, capsys, md5_of):
     assert f"{md5_of(b'ab')}:ab" in out
 
 
+@pytest.mark.parametrize("device", ["cpu", "tpu"])
+def test_crack_wordlist_rules_sha256(tmp_path, capsys, device):
+    """Benchmark config 3: SHA-256 raw, wordlist + best64 rules."""
+    wl = tmp_path / "wl.txt"
+    wl.write_text("winter\nflower\ndragon\nsunshine\n")
+    secret = b"Dragon1"        # "dragon" via best64's "c $1"
+    digest = hashlib.sha256(secret).hexdigest()
+    hashfile = _mk_hashfile(tmp_path, [digest])
+    rc, out = run_cli(["crack", str(wl), hashfile, "--engine", "sha256",
+                       "-a", "wordlist", "--rules", "best64",
+                       "--device", device, "--no-potfile",
+                       "--batch", "256", "-q"], capsys)
+    assert rc == 0
+    assert f"{digest}:Dragon1" in out
+
+
+def test_crack_wordlist_no_rules_ntlm(tmp_path, capsys):
+    from dprf_tpu.engines.cpu.md4 import md4
+
+    wl = tmp_path / "wl.txt"
+    wl.write_text("alpha\nhunter2\nzulu\n")
+    ntlm = md4(bytes(b for ch in b"hunter2" for b in (ch, 0))).hex()
+    hashfile = _mk_hashfile(tmp_path, [ntlm])
+    rc, out = run_cli(["crack", str(wl), hashfile, "--engine", "ntlm",
+                       "-a", "wordlist", "--device", "tpu",
+                       "--no-potfile", "-q"], capsys)
+    assert rc == 0
+    assert f"{ntlm}:hunter2" in out
+
+
+def test_wordlist_session_resume(tmp_path, capsys):
+    """Kill-and-resume over a wordlist+rules keyspace: second run only
+    covers the remainder and still finds the planted password."""
+    from dprf_tpu.runtime.session import SessionJournal
+
+    wl = tmp_path / "wl.txt"
+    words = [f"word{i:03d}" for i in range(50)] + ["secret"]
+    wl.write_text("\n".join(words))
+    digest = hashlib.md5(b"SECRET").hexdigest()     # via rule "u"
+    hashfile = _mk_hashfile(tmp_path, [digest])
+    session = str(tmp_path / "s.json")
+    base = ["crack", str(wl), hashfile, "--engine", "md5",
+            "-a", "wordlist", "--rules", "toggle",
+            "--device", "cpu", "--no-potfile", "--session", session,
+            "--unit-size", "64", "-q"]
+    rc, out = run_cli(base, capsys)
+    assert rc == 0 and f"{digest}:SECRET" in out
+    st = SessionJournal.load(session)
+    keyspace = 51 * 17          # 51 words x 17 toggle rules
+    assert st.completed == [(0, keyspace)]
+    # resume a completed session: no work left, hit restored
+    rc, out = run_cli(base + ["--restore"], capsys)
+    assert rc == 0 and f"{digest}:SECRET" in out
+
+
 def test_keyspace_and_engines_commands(capsys):
     rc, out = run_cli(["keyspace", "?l?l?l?l?l?l"], capsys)
     assert rc == 0 and out.strip() == str(26 ** 6)

@@ -1,0 +1,417 @@
+"""Rule engine tests: parser, CPU oracle, CPU==device equivalence, the
+fused wordlist+rules pipeline, and the sharded variant (config 3).
+
+The equivalence test is the load-bearing one (SURVEY.md section 4:
+"rule engine vs a Python rule interpreter oracle"): every opcode is
+exercised on a word set chosen to hit the no-op / reject / overflow
+edges, and the device batch application must agree byte-for-byte.
+"""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dprf_tpu.rules import (parse_rule, parse_rules, load_rules,
+                            apply_rule_cpu)
+from dprf_tpu.rules.device import apply_rule as apply_rule_dev
+from dprf_tpu.rules.parser import Op, Opcode
+from dprf_tpu.generators.wordlist import WordlistRulesGenerator, NOOP_RULE
+
+
+# ---------------------------------------------------------------- parser
+
+def test_parse_simple_ops():
+    assert parse_rule(":") == (Op(Opcode.NOOP),)
+    assert parse_rule("l") == (Op(Opcode.LOWER),)
+    assert parse_rule("$1") == (Op(Opcode.APPEND, ord("1")),)
+    assert parse_rule("^a") == (Op(Opcode.PREPEND, ord("a")),)
+    assert parse_rule("sa@") == (Op(Opcode.SUBSTITUTE, ord("a"), ord("@")),)
+    assert parse_rule("T3") == (Op(Opcode.TOGGLE_AT, 3),)
+    assert parse_rule("TA") == (Op(Opcode.TOGGLE_AT, 10),)
+    assert parse_rule("x04") == (Op(Opcode.EXTRACT, 0, 4),)
+    assert parse_rule("i2!") == (Op(Opcode.INSERT, 2, ord("!")),)
+
+
+def test_parse_multi_op_rule_with_spaces():
+    ops = parse_rule("c se3 $1 $2")
+    assert [o.opcode for o in ops] == [
+        Opcode.CAPITALIZE, Opcode.SUBSTITUTE, Opcode.APPEND, Opcode.APPEND]
+
+
+def test_parse_space_as_char_param():
+    # '$ ' appends a space: space is a parameter here, not a separator.
+    assert parse_rule("$ ") == (Op(Opcode.APPEND, 0x20),)
+
+
+def test_parse_errors():
+    with pytest.raises(ValueError):
+        parse_rule("~")            # unknown op
+    with pytest.raises(ValueError):
+        parse_rule("T")            # missing param
+    with pytest.raises(ValueError):
+        parse_rule("Tz")           # bad position digit
+    with pytest.raises(ValueError):
+        parse_rule("")
+
+
+def test_parse_rules_skip_mode():
+    rules = parse_rules([":", "# comment", "", "~bogus", "u"],
+                        on_error="skip")
+    assert len(rules) == 2
+
+
+def test_builtin_rulesets_load():
+    for name in ("best64", "leetspeak", "toggle"):
+        rules = load_rules(name)
+        assert len(rules) >= 16
+    assert len(load_rules("best64")) == 64
+
+
+# ------------------------------------------------------------ CPU oracle
+
+CASES = [
+    (b"password", ":", b"password"),
+    (b"PassWord", "l", b"password"),
+    (b"password", "u", b"PASSWORD"),
+    (b"pASSWORD", "c", b"Password"),
+    (b"Password", "C", b"pASSWORD"),
+    (b"PaSsWoRd", "t", b"pAsSwOrD"),
+    (b"password", "T0", b"Password"),
+    (b"password", "T8", b"password"),      # out of range: no-op
+    (b"password", "r", b"drowssap"),
+    (b"pass", "d", b"passpass"),
+    (b"pass", "p2", b"passpasspass"),
+    (b"pass", "f", b"passssap"),
+    (b"password", "{", b"asswordp"),
+    (b"password", "}", b"dpasswor"),
+    (b"password", "[", b"assword"),
+    (b"password", "]", b"passwor"),
+    (b"password", "D3", b"pasword"),
+    (b"password", "x04", b"pass"),
+    (b"password", "x45", b"word"),
+    (b"password", "O24", b"pard"),
+    (b"password", "i2XY", None),           # parse err tested elsewhere
+    (b"password", "'4", b"pass"),
+    (b"password", "sa@", b"p@ssword"),
+    (b"password", "@s", b"paword"),
+    (b"pass", "z2", b"pppass"),
+    (b"pass", "Z2", b"passss"),
+    (b"ab", "q", b"aabb"),
+    (b"password", "k", b"apssword"),
+    (b"password", "K", b"passwodr"),
+    (b"password", "*07", b"dasswor" + b"p"),
+    (b"password", "+0", b"qassword"),
+    (b"password", "-0", b"oassword"),
+    (b"password", ".1", b"psssword"),
+    (b"password", ",1", b"ppssword"),
+    (b"password", "y2", b"papassword"),
+    (b"password", "Y2", b"passwordrd"),
+    (b"pass", "$1", b"pass1"),
+    (b"pass", "^1", b"1pass"),
+    (b"john smith", "E", b"John Smith"),
+    (b"john-smith", "e-", b"John-Smith"),
+    (b"pass", "i4!", b"pass!"),
+    (b"pass", "i9!", b"pass"),             # out of range: no-op
+    (b"pass", "o0P", b"Pass"),
+    (b"pass", "o9P", b"pass"),
+]
+
+
+@pytest.mark.parametrize("word,rule,want", CASES)
+def test_cpu_known_values(word, rule, want):
+    if want is None:
+        return
+    ops = parse_rule(rule)
+    assert apply_rule_cpu(word, ops, max_len=16) == want
+
+
+def test_cpu_reject_semantics():
+    assert apply_rule_cpu(b"longishword", parse_rule("d"), max_len=16) is None
+    assert apply_rule_cpu(b"pass", parse_rule("<3")) is None
+    assert apply_rule_cpu(b"pass", parse_rule("<4")) == b"pass"
+    assert apply_rule_cpu(b"pass", parse_rule(">5")) is None
+    assert apply_rule_cpu(b"pass", parse_rule(">4")) == b"pass"
+    assert apply_rule_cpu(b"pass", parse_rule("_4")) == b"pass"
+    assert apply_rule_cpu(b"pass", parse_rule("_5")) is None
+    assert apply_rule_cpu(b"pass", parse_rule("!a")) is None
+    assert apply_rule_cpu(b"pass", parse_rule("!z")) == b"pass"
+    assert apply_rule_cpu(b"pass", parse_rule("/q")) is None
+    assert apply_rule_cpu(b"pass", parse_rule("/s")) == b"pass"
+    assert apply_rule_cpu(b"pass", parse_rule("(p")) == b"pass"
+    assert apply_rule_cpu(b"pass", parse_rule("(a")) is None
+    assert apply_rule_cpu(b"pass", parse_rule(")s")) == b"pass"
+    assert apply_rule_cpu(b"pass", parse_rule(")p")) is None
+    assert apply_rule_cpu(b"pass", parse_rule("=1a")) == b"pass"
+    assert apply_rule_cpu(b"pass", parse_rule("=0a")) is None
+    assert apply_rule_cpu(b"pass", parse_rule("%2s")) == b"pass"
+    assert apply_rule_cpu(b"pass", parse_rule("%3s")) is None
+
+
+def test_cpu_append_overflow_rejects():
+    assert apply_rule_cpu(b"a" * 16, parse_rule("$1"), max_len=16) is None
+    assert apply_rule_cpu(b"a" * 15, parse_rule("$1"), max_len=16) == \
+        b"a" * 15 + b"1"
+
+
+# ------------------------------------------------- CPU == device property
+
+# One rule per opcode (several for the parameterized ones), chosen to
+# hit in-range, out-of-range, and overflow behavior on the word set.
+EQUIV_RULES = [
+    ":", "l", "u", "c", "C", "t", "T0", "T2", "T9", "TZ", "r",
+    "d", "p1", "p3", "f", "{", "}", "[", "]", "D0", "D4", "DZ",
+    "x02", "x25", "x90", "O13", "O05", "OZ1",
+    "i0^", "i3!", "i9#", "iZ@", "o0X", "o5Y", "oZ!",
+    "'0", "'3", "'Z", "sa@", "se3", "sss", "@a", "@z",
+    "z1", "z3", "Z1", "Z4", "q", "k", "K", "*05", "*50", "*28",
+    "L0", "L3", "R0", "R3", "+1", "-1", ".0", ".5", ",1", ",5",
+    "y2", "y5", "Y2", "Y5", "$1", "$ ", "^0", "^ ", "E", "e-", "e ",
+    "<5", "<9", ">3", ">7", "_4", "_6", "!a", "!q", "/a", "/q",
+    "(a", "(m", ")e", ")z", "=2s", "=9x", "%1a", "%2a", "%3a",
+    # multi-op rules: interactions and ordering
+    "c $1 $2", "u r", "d r ]", "f '6", "se3 sa@ so0", "l { } k",
+    "^x ^y $z", "r r", "t T0 T0", "[ [ [", "q d",
+]
+
+WORDS = [b"", b"a", b"ab", b"abc", b"Passw0rd", b"aaaa", b"MIXEDcase",
+         b"a b c", b"zzzzzzzzz", b"0123456789", b"sassafras",
+         b"Aa!Bb?Cc", b"mmmmmmmmmmmm", b"x" * 16, b"e3e3e3",
+         b"  lead", b"trail  ", b"@#$%^&*()", b"QqQqQq", b"longestwordhere!"]
+
+MAXLEN = 16
+
+
+def test_device_matches_cpu_all_ops():
+    rules = [parse_rule(r) for r in EQUIV_RULES]
+    B = len(WORDS)
+    buf = np.zeros((B, MAXLEN), dtype=np.uint8)
+    lens = np.zeros((B,), dtype=np.int32)
+    for i, w in enumerate(WORDS):
+        buf[i, :len(w)] = np.frombuffer(w, dtype=np.uint8)
+        lens[i] = len(w)
+    w_dev = jnp.asarray(buf)
+    l_dev = jnp.asarray(lens)
+    v_dev = jnp.ones((B,), dtype=bool)
+
+    for rtext, ops in zip(EQUIV_RULES, rules):
+        out_w, out_l, out_v = apply_rule_dev(w_dev, l_dev, v_dev, ops,
+                                             MAXLEN)
+        out_w, out_l, out_v = (np.asarray(out_w), np.asarray(out_l),
+                               np.asarray(out_v))
+        for i, word in enumerate(WORDS):
+            want = apply_rule_cpu(word, ops, max_len=MAXLEN)
+            got_valid = bool(out_v[i])
+            if want is None:
+                assert not got_valid, (
+                    f"rule {rtext!r} word {word!r}: device accepted, "
+                    f"oracle rejected")
+            else:
+                assert got_valid, (
+                    f"rule {rtext!r} word {word!r}: device rejected, "
+                    f"oracle gave {want!r}")
+                got = bytes(out_w[i, :out_l[i]])
+                assert got == want, (
+                    f"rule {rtext!r} word {word!r}: device {got!r} "
+                    f"!= oracle {want!r}")
+                # zero-tail invariant
+                assert not out_w[i, out_l[i]:].any()
+
+
+def test_device_matches_cpu_random_fuzz():
+    rng = random.Random(20260729)
+    charset = (b"abcdefghijklmnopqrstuvwxyz"
+               b"ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789 !@#$")
+    words = [bytes(rng.choice(charset) for _ in range(rng.randrange(0, 13)))
+             for _ in range(64)]
+    rule_pool = [parse_rule(r) for r in EQUIV_RULES]
+    B = len(words)
+    buf = np.zeros((B, MAXLEN), dtype=np.uint8)
+    lens = np.zeros((B,), dtype=np.int32)
+    for i, w in enumerate(words):
+        buf[i, :len(w)] = np.frombuffer(w, dtype=np.uint8)
+        lens[i] = len(w)
+    w_dev, l_dev = jnp.asarray(buf), jnp.asarray(lens)
+    v_dev = jnp.ones((B,), dtype=bool)
+
+    for _ in range(20):
+        ops = tuple(op for r in rng.sample(rule_pool, rng.randrange(1, 4))
+                    for op in r)
+        out_w, out_l, out_v = map(np.asarray,
+                                  apply_rule_dev(w_dev, l_dev, v_dev, ops,
+                                                 MAXLEN))
+        for i, word in enumerate(words):
+            want = apply_rule_cpu(word, ops, max_len=MAXLEN)
+            if want is None:
+                assert not out_v[i]
+            else:
+                assert out_v[i]
+                assert bytes(out_w[i, :out_l[i]]) == want
+
+
+# ----------------------------------------------------------- generator
+
+def test_wordlist_generator_keyspace_and_decode():
+    words = [b"alpha", b"beta", b"gamma"]
+    rules = [parse_rule(":"), parse_rule("u"), parse_rule("$1")]
+    gen = WordlistRulesGenerator(words, rules, max_len=16)
+    assert gen.keyspace == 9
+    assert gen.candidate(0) == b"alpha"
+    assert gen.candidate(1) == b"ALPHA"
+    assert gen.candidate(2) == b"alpha1"
+    assert gen.candidate(4) == b"BETA"
+    assert gen.candidate(8) == b"gamma1"
+    with pytest.raises(IndexError):
+        gen.candidate(9)
+
+
+def test_wordlist_generator_holes():
+    gen = WordlistRulesGenerator([b"abcdefgh"], [parse_rule("d")],
+                                 max_len=10)
+    assert gen.candidate(0) is None        # 16 > 10: rejected
+    assert gen.candidates(0, 1) == [None]
+
+
+def test_load_words(tmp_path):
+    from dprf_tpu.generators.wordlist import load_words
+    p = tmp_path / "wl.txt"
+    p.write_bytes(b"one\r\ntwo\n\nthree\n" + b"x" * 99 + b"\n")
+    words, skipped = load_words(str(p), max_len=16)
+    assert words == [b"one", b"two", b"three"]
+    assert skipped == 1
+
+
+# --------------------------------------------------- fused pipeline e2e
+
+def _plant_step_test(engine_name, hash_fn, widen=False):
+    from dprf_tpu.engines import get_engine
+    from dprf_tpu.ops import compare as cmp_ops
+    from dprf_tpu.ops.rules_pipeline import make_wordlist_crack_step
+
+    words = [b"winter", b"dragon", b"secret", b"letmein", b"monkey",
+             b"shadow", b"master", b"qwerty"]
+    rules = [parse_rule(r) for r in (":", "c", "u", "$1", "c $1", "se3")]
+    gen = WordlistRulesGenerator(words, rules, max_len=16)
+
+    # Plant: "Dragon1" = word 1 via rule "c $1" (index 1*6+4), and
+    # "s3cr3t" = word 2 via rule "se3" (index 2*6+5).
+    plants = {1 * 6 + 4: b"Dragon1", 2 * 6 + 5: b"s3cr3t"}
+    for idx, plain in plants.items():
+        assert gen.candidate(idx) == plain
+    table = cmp_ops.make_target_table(
+        [hash_fn(p) for p in plants.values()],
+        little_endian=get_engine(engine_name, device="jax").little_endian)
+
+    engine = get_engine(engine_name, device="jax")
+    step = make_wordlist_crack_step(engine, gen, table, word_batch=8,
+                                    hit_capacity=8, widen_utf16=widen)
+    count, lanes, tpos = step(jnp.int32(0), jnp.int32(len(words)))
+    assert int(count) == 2
+    got = set()
+    for lane in np.asarray(lanes):
+        if lane < 0:
+            continue
+        r, b = divmod(int(lane), 8)
+        got.add(b * 6 + r)
+    assert got == set(plants)
+
+
+def test_pipeline_md5_wordlist_rules():
+    _plant_step_test("md5", lambda p: hashlib.md5(p).digest())
+
+
+def test_pipeline_sha256_wordlist_rules():
+    # Benchmark config 3: SHA-256 raw, wordlist + rules.
+    _plant_step_test("sha256", lambda p: hashlib.sha256(p).digest())
+
+
+def test_pipeline_ntlm_wordlist_rules():
+    from dprf_tpu.engines.cpu.md4 import md4
+
+    def ntlm(pw):
+        return md4(bytes(b for ch in pw for b in (ch, 0)))
+    _plant_step_test("ntlm", ntlm, widen=True)
+
+
+def test_worker_and_noop_wordlist():
+    """Whole-worker path: wordlist only (NOOP rule), planted word."""
+    from dprf_tpu.engines import get_engine
+    from dprf_tpu.runtime.worker import DeviceWordlistWorker
+    from dprf_tpu.runtime.workunit import WorkUnit
+    from dprf_tpu.engines.base import Target
+
+    words = [f"word{i:04d}".encode() for i in range(500)]
+    words[321] = b"hunter2"
+    gen = WordlistRulesGenerator(words, None, max_len=16)
+    target = Target(raw=hashlib.md5(b"hunter2").hexdigest(),
+                    digest=hashlib.md5(b"hunter2").digest())
+    engine = get_engine("md5", device="jax")
+    worker = DeviceWordlistWorker(engine, gen, [target], batch=64,
+                                  hit_capacity=8,
+                                  oracle=get_engine("md5", device="cpu"))
+    hits = worker.process(WorkUnit(0, 0, gen.keyspace))
+    assert len(hits) == 1
+    assert hits[0].cand_index == 321
+    assert hits[0].plaintext == b"hunter2"
+
+
+def test_worker_unaligned_unit_no_duplicates():
+    """Units not aligned to rule boundaries must neither lose nor
+    duplicate hits across the boundary."""
+    from dprf_tpu.engines import get_engine
+    from dprf_tpu.runtime.worker import DeviceWordlistWorker
+    from dprf_tpu.runtime.workunit import WorkUnit
+    from dprf_tpu.engines.base import Target
+
+    words = [b"alpha", b"beta", b"gamma", b"delta"]
+    rules = [parse_rule(r) for r in (":", "u", "$1")]
+    gen = WordlistRulesGenerator(words, rules, max_len=16)
+    # plant: BETA (idx 1*3+1=4) and gamma1 (idx 2*3+2=8)
+    targets = [Target(raw="x", digest=hashlib.md5(b"BETA").digest()),
+               Target(raw="y", digest=hashlib.md5(b"gamma1").digest())]
+    engine = get_engine("md5", device="jax")
+    worker = DeviceWordlistWorker(engine, gen, targets, batch=6,
+                                  hit_capacity=8,
+                                  oracle=get_engine("md5", device="cpu"))
+    # split keyspace [0,12) at 5 — mid-word, between the two plants
+    hits = (worker.process(WorkUnit(0, 0, 5))
+            + worker.process(WorkUnit(1, 5, 7)))
+    assert sorted(h.cand_index for h in hits) == [4, 8]
+
+
+def test_sharded_wordlist_step():
+    import jax
+    from dprf_tpu.engines import get_engine
+    from dprf_tpu.ops import compare as cmp_ops
+    from dprf_tpu.ops.rules_pipeline import make_sharded_wordlist_crack_step
+    from dprf_tpu.parallel import make_mesh
+
+    n_dev = len(jax.devices())
+    assert n_dev >= 8
+    mesh = make_mesh(8)
+    B = 4                                   # words per device
+    words = [f"w{i:03d}".encode() for i in range(70)]
+    rules = [parse_rule(r) for r in (":", "u")]
+    gen = WordlistRulesGenerator(words, rules, max_len=16)
+    # plants on different chips and a later super-batch
+    plant_words = {3: b"w003", 17: b"W017", 40: b"w040", 69: b"W069"}
+    plant_idx = {3 * 2 + 0, 17 * 2 + 1, 40 * 2 + 0, 69 * 2 + 1}
+    table = cmp_ops.make_target_table(
+        [hashlib.md5(p).digest() for p in plant_words.values()])
+    engine = get_engine("md5", device="jax")
+    step = make_sharded_wordlist_crack_step(engine, gen, table, mesh, B,
+                                            hit_capacity=4)
+    super_words = step.super_words
+    found = set()
+    for w0 in range(0, len(words), super_words):
+        nw = min(super_words, len(words) - w0)
+        total, counts, lanes, tpos = step(jnp.int32(w0), jnp.int32(nw))
+        for lane in np.asarray(lanes).ravel():
+            if lane < 0:
+                continue
+            r, bglob = divmod(int(lane), super_words)
+            found.add((w0 + bglob) * 2 + r)
+    assert found == plant_idx
