@@ -10,7 +10,6 @@ engines, keyspace.
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 from typing import Optional
 

@@ -79,6 +79,8 @@ class BcryptEngine(HashEngine):
 
     def parse_target(self, text: str) -> Target:
         variant, cost, salt, digest = _bcrypt.parse_hash(text)
+        if not 4 <= cost <= 31:
+            raise ValueError(f"bcrypt cost out of range 4..31: {cost}")
         return Target(raw=text.strip(), digest=digest,
                       params={"variant": variant, "cost": cost, "salt": salt})
 

@@ -1,0 +1,244 @@
+"""Device bcrypt engine: the memory-hard / low-throughput path
+(benchmark config 4).
+
+bcrypt is salted with a per-target cost, so unlike the fast unsalted
+engines one digest computation cannot serve a target list: the fused
+step takes (salt_words, n_rounds, target_words) as *runtime* arguments
+and the worker sweeps the keyspace once per target.  One compiled
+program serves every bcrypt target of any cost.
+
+The heavy state (4 KB of S-boxes per candidate lane) and the serial
+EksBlowfish chains live in ops/blowfish.py; batches are kept small --
+at cost 12 each candidate is ~4.3M Blowfish encryptions, so a batch is
+seconds of device time and bigger batches only add latency, not
+throughput.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.base import Target
+from dprf_tpu.engines.cpu.engines import BcryptEngine
+from dprf_tpu.ops import blowfish as bf_ops
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops.rules_pipeline import expand_rules
+from dprf_tpu.runtime.worker import (Hit, CpuWorker, word_cover_range,
+                                     wordlist_lane_to_gidx)
+from dprf_tpu.runtime.workunit import WorkUnit
+
+#: default candidates per device step; bcrypt steps are seconds long
+#: even at this size, and 4 KB of S-box state per lane caps usefully
+#: large batches anyway (4096 lanes = 16 MB of mutating state).
+DEFAULT_BATCH = 1 << 12
+
+
+@register("bcrypt", device="jax")
+class JaxBcryptEngine(BcryptEngine):
+    """Device bcrypt.  Inherits hash parsing ($2a/$2b lines) from the
+    CPU engine; hash_batch runs the EksBlowfish pipeline on device."""
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError("bcrypt needs target params (salt, cost)")
+        if any(len(c) > self.max_candidate_len for c in candidates):
+            raise ValueError("bcrypt: candidate longer than 72 bytes")
+        B = len(candidates)
+        L = max(max((len(c) for c in candidates), default=1), 1)
+        buf = np.zeros((B, L), dtype=np.uint8)
+        lens = np.zeros((B,), dtype=np.int32)
+        for i, c in enumerate(candidates):
+            buf[i, :len(c)] = np.frombuffer(c, dtype=np.uint8)
+            lens[i] = len(c)
+        dw = _jit_bcrypt_batch(
+            jnp.asarray(buf), jnp.asarray(lens),
+            jnp.asarray(bf_ops.salt_to_words(params["salt"])),
+            _n_rounds(params["cost"]))
+        return bf_ops.words_to_digests(np.asarray(dw))
+
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return BcryptMaskWorker(self, gen, targets,
+                                batch=min(batch, DEFAULT_BATCH),
+                                hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return BcryptWordlistWorker(self, gen, targets,
+                                    batch=min(batch, DEFAULT_BATCH),
+                                    hit_capacity=hit_capacity, oracle=oracle)
+
+
+_jit_bcrypt_batch = jax.jit(bf_ops.bcrypt_batch)
+
+
+def _n_rounds(cost: int) -> jnp.ndarray:
+    """2**cost as the device loop trip count.  Cost 31 (valid in the
+    bcrypt format, ~2e9 rounds) would overflow the int32 loop bound --
+    reject it with a pointer to the CPU path rather than wrapping to a
+    zero-iteration loop that yields silent false negatives."""
+    if not 4 <= cost <= 30:
+        raise ValueError(
+            f"bcrypt cost {cost} outside the device engine's range 4..30 "
+            "(2**31 rounds exceeds the int32 loop bound; use --device=cpu)")
+    return jnp.int32(1 << cost)
+
+
+def _target_args(target: Target):
+    """Target -> (salt_words, n_rounds, target_words) device args."""
+    return (jnp.asarray(bf_ops.salt_to_words(target.params["salt"])),
+            _n_rounds(target.params["cost"]),
+            jnp.asarray(bf_ops.digest_to_words(target.digest)))
+
+
+def make_bcrypt_mask_step(gen, batch: int, hit_capacity: int = 64):
+    """step(base_digits int32[L], n_valid, salt_words uint32[4],
+    n_rounds int32, target uint32[6]) -> (count, lanes, _)."""
+    flat = gen.flat_charsets
+    length = gen.length
+
+    @jax.jit
+    def step(base_digits, n_valid, salt_words, n_rounds, target):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        lens = jnp.full((batch,), length, jnp.int32)
+        dwords = bf_ops.bcrypt_batch(cand, lens, salt_words, n_rounds)
+        found = bf_ops.compare_digest_words(dwords, target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def make_bcrypt_wordlist_step(gen, word_batch: int, hit_capacity: int = 64):
+    """Wordlist(+rules) variant; words are sliced from the HBM-resident
+    packed table and expanded through the rule set on device, exactly
+    like ops/rules_pipeline.py, then fed to EksBlowfish.
+
+    step(w0, n_valid_words, salt_words, n_rounds, target) ->
+        (count, lanes, _); lanes are flat r*B + b candidate indices.
+    """
+    B, L = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+
+    @jax.jit
+    def step(w0, n_valid_words, salt_words, n_rounds, target):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
+        dwords = bf_ops.bcrypt_batch(cw, cl, salt_words, n_rounds)
+        found = bf_ops.compare_digest_words(dwords, target) & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl), hit_capacity)
+
+    return step
+
+
+class _BcryptWorkerBase:
+    """Per-target keyspace sweep shared by the mask/wordlist workers."""
+
+    def __init__(self, engine, gen, targets: Sequence[Target],
+                 batch: int, hit_capacity: int, oracle):
+        self.engine = engine
+        self.gen = gen
+        self.targets = list(targets)
+        self.hit_capacity = hit_capacity
+        self.oracle = oracle
+        self.batch = batch
+        self._targs = [_target_args(t) for t in self.targets]
+
+    def _rescan(self, start: int, end: int, ti: int) -> list[Hit]:
+        if self.oracle is None:
+            raise RuntimeError(
+                f"hit buffer overflow (> {self.hit_capacity}) and no "
+                "oracle engine to rescan with; raise hit_capacity")
+        sub = WorkUnit(-1, start, end - start)
+        hits = CpuWorker(self.oracle, self.gen,
+                         [self.targets[ti]]).process(sub)
+        return [Hit(ti, h.cand_index, h.plaintext) for h in hits]
+
+
+class BcryptMaskWorker(_BcryptWorkerBase):
+    def __init__(self, engine, gen, targets, batch: int = DEFAULT_BATCH,
+                 hit_capacity: int = 64, oracle=None):
+        super().__init__(engine, gen, targets, batch, hit_capacity, oracle)
+        self.stride = batch
+        self.step = make_bcrypt_mask_step(gen, batch, hit_capacity)
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        hits: list[Hit] = []
+        for ti in range(len(self.targets)):
+            salt_w, n_rounds, tgt = self._targs[ti]
+            queued = []
+            for bstart in range(unit.start, unit.end, self.stride):
+                n_valid = min(self.stride, unit.end - bstart)
+                base = jnp.asarray(self.gen.digits(bstart), dtype=jnp.int32)
+                queued.append((bstart, self.step(
+                    base, jnp.int32(n_valid), salt_w, n_rounds, tgt)))
+            for bstart, (count, lanes, _) in queued:
+                count = int(count)
+                if count == 0:
+                    continue
+                if count > self.hit_capacity:
+                    hits.extend(self._rescan(
+                        bstart, min(bstart + self.stride, unit.end), ti))
+                    continue
+                for lane in np.asarray(lanes):
+                    if lane < 0:
+                        continue
+                    gidx = bstart + int(lane)
+                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+
+class BcryptWordlistWorker(_BcryptWorkerBase):
+    def __init__(self, engine, gen, targets, batch: int = DEFAULT_BATCH,
+                 hit_capacity: int = 64, oracle=None):
+        super().__init__(engine, gen, targets, batch, hit_capacity, oracle)
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self.step = make_bcrypt_wordlist_step(gen, self.word_batch,
+                                              hit_capacity)
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        R = self.gen.n_rules
+        w_start, w_end = word_cover_range(unit, R)
+        hits: list[Hit] = []
+        for ti in range(len(self.targets)):
+            salt_w, n_rounds, tgt = self._targs[ti]
+            queued = []
+            for ws in range(w_start, w_end, self.word_batch):
+                nw = min(self.word_batch, w_end - ws, self.gen.n_words - ws)
+                if nw <= 0:
+                    break
+                queued.append((ws, nw, self.step(
+                    jnp.int32(ws), jnp.int32(nw), salt_w, n_rounds, tgt)))
+            for ws, nw, (count, lanes, _) in queued:
+                count = int(count)
+                if count == 0:
+                    continue
+                if count > self.hit_capacity:
+                    start = max(unit.start, ws * R)
+                    end = min(unit.end, (ws + nw) * R)
+                    hits.extend(self._rescan(start, end, ti))
+                    continue
+                for lane in np.asarray(lanes):
+                    if lane < 0:
+                        continue
+                    gidx = wordlist_lane_to_gidx(int(lane), ws,
+                                                 self.word_batch, R)
+                    if not unit.start <= gidx < unit.end:
+                        continue
+                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits

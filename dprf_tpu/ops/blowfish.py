@@ -1,0 +1,217 @@
+"""EksBlowfish / bcrypt as vectorized JAX ops (benchmark config 4).
+
+bcrypt is the deliberately memory-hard, low-throughput path: every
+candidate carries 4 KB of *mutating* S-box state, and the key schedule
+is a long serial chain of Blowfish encryptions with data-dependent
+S-box lookups.  That maps to TPU as:
+
+- state kept as uint32[B, 1024] (4 boxes flat) + uint32[B, 18] P-array
+  in HBM/VMEM, one row per candidate lane;
+- the serial chains as `lax.fori_loop`s (they cannot be parallelized --
+  that is bcrypt's whole design), with the batch dimension providing
+  all the parallelism;
+- the four S-box reads per Feistel round as one batched gather
+  (`take_along_axis` over the flat 1024-entry axis).
+
+The cost parameter is a *runtime* argument (`fori_loop` with a traced
+trip count lowers to `while_loop`), so one compiled program serves any
+cost and every target of a job.
+
+Initial P/S constants come from engines/cpu/_blowfish_tables.py
+(hex digits of pi computed by tools/gen_blowfish_constants.py).
+Semantics match the CPU oracle in engines/cpu/bcrypt.py ($2a/$2b:
+NUL-terminated key, 72-byte cap) bit-for-bit; tests/test_bcrypt_device.py
+checks both the raw digest and the OpenBSD-style hash lines.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from dprf_tpu.engines.cpu._blowfish_tables import P_INIT, S_INIT
+
+P0 = np.array(P_INIT, dtype=np.uint32)                      # [18]
+S0 = np.array(S_INIT, dtype=np.uint32).reshape(-1)          # [1024]
+# "OrpheanBeholderScryDoubt" -- the fixed bcrypt ECB plaintext, as three
+# 64-bit blocks = six big-endian words.
+MAGIC_WORDS = np.frombuffer(b"OrpheanBeholderScryDoubt",
+                            dtype=">u4").astype(np.uint32)  # [6]
+_BOX_OFF = np.array([0, 256, 512, 768], dtype=np.int32)
+
+
+def _feistel(S: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """F(x) = ((S0[a] + S1[b]) ^ S2[c]) + S3[d], batched.
+
+    S: uint32[B, 1024] (per-candidate flat boxes), x: uint32[B].
+    The four per-lane reads are one gather of shape [B, 4].
+    """
+    idx = jnp.stack([x >> 24, (x >> 16) & 0xFF,
+                     (x >> 8) & 0xFF, x & 0xFF], axis=-1).astype(jnp.int32)
+    g = jnp.take_along_axis(S, idx + jnp.asarray(_BOX_OFF), axis=1)
+    return ((g[:, 0] + g[:, 1]) ^ g[:, 2]) + g[:, 3]
+
+
+def _encrypt(P: jnp.ndarray, S: jnp.ndarray,
+             l: jnp.ndarray, r: jnp.ndarray):
+    """One 16-round Blowfish ECB encryption, batched over lanes.
+
+    P: uint32[B, 18] (or [18] broadcastable), S: uint32[B, 1024],
+    l, r: uint32[B].  Rounds are unrolled at trace time.
+    """
+    for i in range(0, 16, 2):
+        l = l ^ P[..., i]
+        r = r ^ _feistel(S, l)
+        r = r ^ P[..., i + 1]
+        l = l ^ _feistel(S, r)
+    return r ^ P[..., 17], l ^ P[..., 16]
+
+
+def _salt_xor(i, l, r, salt_words):
+    """XOR the alternating 64-bit salt halves into (l, r) for chain step
+    i.  The CPU oracle's index pattern salt[(2n)%4], salt[(2n+1)%4]
+    reduces to: even n -> words (0,1), odd n -> words (2,3)."""
+    even = (i % 2) == 0
+    l = l ^ jnp.where(even, salt_words[0], salt_words[2])
+    r = r ^ jnp.where(even, salt_words[1], salt_words[3])
+    return l, r
+
+
+def expand_key(P: jnp.ndarray, S: jnp.ndarray, key_words: jnp.ndarray,
+               salt_words=None):
+    """One EksBlowfish ExpandKey: P ^= key, then regenerate P and S by
+    chained encryption (salt-perturbed when salt_words is given).
+
+    P uint32[B, 18], S uint32[B, 1024], key_words uint32[B, 18] or [18].
+    Returns the new (P, S).
+    """
+    P = P ^ key_words
+    B = P.shape[0]
+    zero = jnp.zeros((B,), jnp.uint32)
+
+    def p_body(i, carry):
+        P, l, r = carry
+        if salt_words is not None:
+            l, r = _salt_xor(i, l, r, salt_words)
+        l, r = _encrypt(P, S, l, r)
+        P = lax.dynamic_update_slice(
+            P, jnp.stack([l, r], axis=1), (0, 2 * i))
+        return P, l, r
+
+    P, l, r = lax.fori_loop(0, 9, p_body, (P, zero, zero))
+
+    def s_body(j, carry):
+        S, l, r = carry
+        if salt_words is not None:
+            l, r = _salt_xor(9 + j, l, r, salt_words)
+        l, r = _encrypt(P, S, l, r)
+        S = lax.dynamic_update_slice(
+            S, jnp.stack([l, r], axis=1), (0, 2 * j))
+        return S, l, r
+
+    # (l, r) carry over from the P phase -- the chain is continuous.
+    S, l, r = lax.fori_loop(0, 512, s_body, (S, l, r))
+    return P, S
+
+
+def key_words_from_candidates(cand: jnp.ndarray,
+                              lengths: jnp.ndarray) -> jnp.ndarray:
+    """uint8[B, L] candidates + int32[B] lengths -> uint32[B, 18] key
+    words: the NUL-terminated password cyclically extended over 72 bytes
+    and read as big-endian 32-bit words ($2a/$2b key semantics)."""
+    B, L = cand.shape
+    klen = lengths + 1                       # password + NUL terminator
+    pos = jnp.arange(72, dtype=jnp.int32)[None, :] % klen[:, None]
+    byte = jnp.take_along_axis(cand, jnp.minimum(pos, L - 1), axis=1)
+    byte = jnp.where(pos < lengths[:, None], byte, 0).astype(jnp.uint32)
+    b = byte.reshape(B, 18, 4)
+    return (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
+
+
+def eks_setup(key_words: jnp.ndarray, salt_words: jnp.ndarray,
+              n_rounds: jnp.ndarray):
+    """Full EksBlowfish setup for a batch of candidates.
+
+    key_words uint32[B, 18], salt_words uint32[4], n_rounds int32 scalar
+    (= 2**cost, a runtime value).  Returns the final (P, S) state.
+    """
+    B = key_words.shape[0]
+    P = jnp.broadcast_to(jnp.asarray(P0), (B, 18))
+    S = jnp.broadcast_to(jnp.asarray(S0), (B, 1024))
+    P, S = expand_key(P, S, key_words, salt_words)
+    # ExpandKey(salt): the 16-byte salt cyclically extended over 72
+    # bytes is word-periodic with period 4.
+    salt18 = jnp.tile(salt_words, 5)[:18]
+
+    def body(_, PS):
+        P, S = PS
+        P, S = expand_key(P, S, key_words)
+        P, S = expand_key(P, S, salt18)
+        return P, S
+
+    return lax.fori_loop(0, n_rounds, body, (P, S))
+
+
+def bcrypt_digest_words(P: jnp.ndarray, S: jnp.ndarray) -> jnp.ndarray:
+    """Final stage: encrypt the three magic blocks 64 times each.
+
+    Returns uint32[B, 6] big-endian digest words (the 23-byte bcrypt
+    digest is words[:5] plus the top 3 bytes of words[5])."""
+    B = P.shape[0]
+    out = []
+    for blk in range(0, 6, 2):
+        l = jnp.full((B,), MAGIC_WORDS[blk], jnp.uint32)
+        r = jnp.full((B,), MAGIC_WORDS[blk + 1], jnp.uint32)
+
+        def body(_, lr):
+            return _encrypt(P, S, lr[0], lr[1])
+
+        l, r = lax.fori_loop(0, 64, body, (l, r))
+        out.extend([l, r])
+    return jnp.stack(out, axis=1)
+
+
+def bcrypt_batch(cand: jnp.ndarray, lengths: jnp.ndarray,
+                 salt_words: jnp.ndarray,
+                 n_rounds: jnp.ndarray) -> jnp.ndarray:
+    """uint8[B, L] candidates -> uint32[B, 6] bcrypt digest words."""
+    kw = key_words_from_candidates(cand, lengths)
+    P, S = eks_setup(kw, salt_words, n_rounds)
+    return bcrypt_digest_words(P, S)
+
+
+# ---------------- host-side target preparation ----------------
+
+def salt_to_words(salt: bytes) -> np.ndarray:
+    """16-byte bcrypt salt -> uint32[4] big-endian words."""
+    if len(salt) != 16:
+        raise ValueError("bcrypt salt must be 16 bytes")
+    return np.frombuffer(salt, dtype=">u4").astype(np.uint32)
+
+
+def digest_to_words(digest: bytes) -> np.ndarray:
+    """23-byte bcrypt digest -> uint32[6]; word 5 holds only its top 3
+    bytes (low byte zero), matching `compare_digest_words`."""
+    if len(digest) != 23:
+        raise ValueError("bcrypt digest must be 23 bytes")
+    w = np.zeros(6, dtype=np.uint32)
+    w[:5] = np.frombuffer(digest[:20], dtype=">u4").astype(np.uint32)
+    w[5] = (digest[20] << 24) | (digest[21] << 16) | (digest[22] << 8)
+    return w
+
+
+def compare_digest_words(dwords: jnp.ndarray,
+                         target: jnp.ndarray) -> jnp.ndarray:
+    """uint32[B, 6] computed words vs uint32[6] target -> bool[B].
+    Only 23 of the 24 ciphertext bytes count (the last is discarded by
+    the bcrypt format), so word 5 compares its top 24 bits only."""
+    head = jnp.all(dwords[:, :5] == target[None, :5], axis=-1)
+    tail = (dwords[:, 5] & jnp.uint32(0xFFFFFF00)) == target[5]
+    return head & tail
+
+
+def words_to_digests(dwords: np.ndarray) -> list[bytes]:
+    """uint32[B, 6] -> 23-byte digests (host helper for hash_batch)."""
+    raw = np.ascontiguousarray(dwords.astype(np.uint32)).astype(">u4")
+    return [raw[i].tobytes()[:23] for i in range(raw.shape[0])]

@@ -33,12 +33,13 @@ from dprf_tpu.ops import pack as pack_ops
 from dprf_tpu.rules.device import apply_rule as apply_rule_device
 
 
-def _expand_and_digest(engine, rules, wslice, lslice, base_valid,
-                       max_len: int, widen_utf16: bool):
-    """Apply every rule to the word slice, digest the whole block.
+def expand_rules(rules, wslice, lslice, base_valid, max_len: int):
+    """Apply every rule to the word slice on device.
 
-    Returns (digest uint32[R*B, W], valid bool[R*B]) in rule-major
-    flat-lane order."""
+    Returns (cand uint8[R*B, L], lens int32[R*B], valid bool[R*B]) in
+    rule-major flat-lane order (lane = r*B + b) -- the contract every
+    wordlist worker's lane->keyspace-index decode relies on.
+    """
     cands, clens, cvalid = [], [], []
     for rule in rules:
         cw, cl, cv = apply_rule_device(wslice, lslice, base_valid,
@@ -46,9 +47,18 @@ def _expand_and_digest(engine, rules, wslice, lslice, base_valid,
         cands.append(cw)
         clens.append(cl)
         cvalid.append(cv)
-    cw = jnp.concatenate(cands, axis=0)
-    cl = jnp.concatenate(clens, axis=0)
-    cv = jnp.concatenate(cvalid, axis=0)
+    return (jnp.concatenate(cands, axis=0),
+            jnp.concatenate(clens, axis=0),
+            jnp.concatenate(cvalid, axis=0))
+
+
+def _expand_and_digest(engine, rules, wslice, lslice, base_valid,
+                       max_len: int, widen_utf16: bool):
+    """Apply every rule to the word slice, digest the whole block.
+
+    Returns (digest uint32[R*B, W], valid bool[R*B]) in rule-major
+    flat-lane order."""
+    cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, max_len)
     if widen_utf16:
         cw = pack_ops.utf16le_widen(cw)
         cl = cl * 2

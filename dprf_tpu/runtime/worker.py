@@ -29,6 +29,21 @@ class Hit:
     plaintext: bytes
 
 
+def word_cover_range(unit: WorkUnit, n_rules: int) -> tuple:
+    """Covering word range [w_start, w_end) of a keyspace-index unit
+    (index = word * n_rules + rule; ceil on the end)."""
+    return unit.start // n_rules, -(-unit.end // n_rules)
+
+
+def wordlist_lane_to_gidx(lane: int, ws: int, word_batch: int,
+                          n_rules: int) -> int:
+    """Rule-major flat step lane (r*B + b) -> global keyspace index for
+    a step whose word window starts at ws.  Single source of truth for
+    the decode every wordlist worker uses."""
+    r, b = divmod(lane, word_batch)
+    return (ws + b) * n_rules + r
+
+
 class CpuWorker:
     """Oracle-engine worker; handles salted and unsalted engines."""
 
@@ -156,8 +171,7 @@ class DeviceWordlistWorker(MaskWorkerBase):
     def process(self, unit: WorkUnit) -> list[Hit]:
         import jax.numpy as jnp
         R = self.gen.n_rules
-        w_start = unit.start // R
-        w_end = -(-unit.end // R)          # ceil: covering word range
+        w_start, w_end = word_cover_range(unit, R)
         queued = []
         for ws in range(w_start, w_end, self.word_batch):
             nw = min(self.word_batch, w_end - ws, self.gen.n_words - ws)
@@ -176,8 +190,8 @@ class DeviceWordlistWorker(MaskWorkerBase):
             for lane, tp in zip(np.asarray(lanes), np.asarray(tpos)):
                 if lane < 0:
                     continue
-                r, b = divmod(int(lane), self.word_batch)
-                gidx = (ws + b) * R + r
+                gidx = wordlist_lane_to_gidx(int(lane), ws,
+                                             self.word_batch, R)
                 if not unit.start <= gidx < unit.end:
                     continue
                 ti = int(self._order[int(tp)]) if self.multi else 0

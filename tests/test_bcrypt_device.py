@@ -1,0 +1,136 @@
+"""Device bcrypt (EksBlowfish) vs the CPU oracle and OpenBSD vectors.
+
+Covers: raw digest equivalence over random candidates, the device
+hash_batch against classic $2a$05 vectors, and both fused workers
+(wordlist+rules and mask) end-to-end with planted passwords.  Costs are
+kept at 4-5 (16-32 rounds) so the serial chains stay test-sized; the
+chain structure is identical at cost 12.
+"""
+
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.engines.cpu.bcrypt import bcrypt_hash, bcrypt_raw
+from dprf_tpu.ops import blowfish as bf_ops
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+def _pack(cands):
+    L = max(len(c) for c in cands)
+    buf = np.zeros((len(cands), L), np.uint8)
+    lens = np.zeros((len(cands),), np.int32)
+    for i, c in enumerate(cands):
+        buf[i, :len(c)] = np.frombuffer(c, np.uint8)
+        lens[i] = len(c)
+    return jnp.asarray(buf), jnp.asarray(lens)
+
+
+def test_bcrypt_batch_matches_oracle():
+    rng = random.Random(0xbc)
+    cands = [bytes(rng.randrange(1, 256) for _ in range(rng.randrange(0, 24)))
+             for _ in range(12)]
+    salt = bytes(rng.randrange(256) for _ in range(16))
+    cost = 4
+    cand, lens = _pack(cands)
+    dw = jax.jit(bf_ops.bcrypt_batch)(
+        cand, lens, jnp.asarray(bf_ops.salt_to_words(salt)),
+        jnp.int32(1 << cost))
+    got = bf_ops.words_to_digests(np.asarray(dw))
+    for g, c in zip(got, cands):
+        assert g == bcrypt_raw(c, salt, cost), c
+
+
+def test_cost_is_runtime_arg():
+    """One compiled program must serve different costs (the trip count
+    is a traced argument, not a constant baked into the executable)."""
+    fn = jax.jit(bf_ops.bcrypt_batch)
+    cand, lens = _pack([b"hunter2"])
+    salt = bytes(range(16))
+    sw = jnp.asarray(bf_ops.salt_to_words(salt))
+    for cost in (4, 5):
+        dw = fn(cand, lens, sw, jnp.int32(1 << cost))
+        assert bf_ops.words_to_digests(np.asarray(dw))[0] == \
+            bcrypt_raw(b"hunter2", salt, cost)
+
+
+@pytest.mark.parametrize("password,line", [
+    (b"U*U", "$2a$05$CCCCCCCCCCCCCCCCCCCCC.E5YPO9kmyuRGyh0XouQYb4YMJKvyOeW"),
+    (b"U*U*U", "$2a$05$XXXXXXXXXXXXXXXXXXXXXOAcXxm9kjPGEMsLznoKqmqw7tc8WCx4a"),
+])
+def test_device_hash_batch_openbsd_vectors(password, line):
+    eng = get_engine("bcrypt", device="jax")
+    t = eng.parse_target(line)
+    [digest] = eng.hash_batch([password], params=t.params)
+    assert digest == t.digest
+
+
+def test_device_hash_batch_vs_oracle_batch():
+    eng = get_engine("bcrypt", device="jax")
+    salt = b"0123456789abcdef"
+    params = {"salt": salt, "cost": 4}
+    cands = [b"", b"a", b"password", b"x" * 23]
+    got = eng.hash_batch(cands, params=params)
+    want = get_engine("bcrypt").hash_batch(cands, params=params)
+    assert got == want
+
+
+def test_device_rejects_cost_31():
+    """Cost 31 is legal bcrypt but 2**31 overflows the int32 loop
+    bound; the device engine must refuse loudly, not wrap to a
+    zero-iteration loop (silent false negatives)."""
+    from dprf_tpu.engines.device.bcrypt import _n_rounds
+    with pytest.raises(ValueError, match="4..30"):
+        _n_rounds(31)
+    with pytest.raises(ValueError):
+        get_engine("bcrypt", device="jax").hash_batch(
+            [b"x"], params={"salt": b"0123456789abcdef", "cost": 31})
+
+
+def test_parse_rejects_out_of_range_cost():
+    with pytest.raises(ValueError):
+        get_engine("bcrypt").parse_target(
+            "$2b$03$KBCwKxOzLha2MUDgW0PjXeFaAPh7cxmjSZ5c00P8D0A2tzxy8Lhdy")
+
+
+def test_bcrypt_wordlist_worker_finds_planted():
+    from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+    from dprf_tpu.rules.parser import parse_rule
+
+    words = [b"alpha", b"bravo", b"s3cret", b"delta", b"echo"]
+    rules = [parse_rule(":"), parse_rule("u"), parse_rule("$1")]
+    gen = WordlistRulesGenerator(words, rules, max_len=16)
+    cost = 4
+    salt = b"fedcba9876543210"
+    eng = get_engine("bcrypt", device="jax")
+    # plant "S3CRET" (rule u on word 2) and "echo1" (rule $1 on word 4)
+    targets = [eng.parse_target(bcrypt_hash(b"S3CRET", salt, cost)),
+               eng.parse_target(bcrypt_hash(b"echo1", salt, cost))]
+    worker = eng.make_wordlist_worker(gen, targets, batch=8,
+                                      hit_capacity=8,
+                                      oracle=get_engine("bcrypt"))
+    hits = worker.process(WorkUnit(0, 0, gen.keyspace))
+    got = {(h.target_index, h.plaintext) for h in hits}
+    assert got == {(0, b"S3CRET"), (1, b"echo1")}
+    assert {h.cand_index for h in hits} == \
+        {gen.index_of(2, 1), gen.index_of(4, 2)}
+
+
+def test_bcrypt_mask_worker_finds_planted():
+    from dprf_tpu.generators.mask import MaskGenerator
+
+    gen = MaskGenerator("?d?d")
+    cost = 4
+    salt = b"0123456789abcdef"
+    eng = get_engine("bcrypt", device="jax")
+    targets = [eng.parse_target(bcrypt_hash(b"42", salt, cost))]
+    worker = eng.make_mask_worker(gen, targets, batch=32, hit_capacity=8,
+                                  oracle=None)
+    hits = worker.process(WorkUnit(0, 0, gen.keyspace))
+    assert len(hits) == 1
+    assert hits[0].plaintext == b"42"
+    assert hits[0].target_index == 0
