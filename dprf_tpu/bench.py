@@ -19,7 +19,10 @@ from dprf_tpu.ops.pipeline import make_mask_crack_step, target_words
 
 def run_bench(engine: str = "md5", device: str = "jax",
               mask: str = "?a?a?a?a?a?a?a?a", batch: int = 1 << 20,
-              seconds: float = 5.0, log=None) -> dict:
+              seconds: float = 5.0, impl: str = "auto", log=None) -> dict:
+    """impl: "xla" forces the generic fused pipeline, "pallas" forces
+    the hand-written kernel (MD5 only), "auto" = pallas on TPU when
+    eligible -- the same selection a real job makes."""
     gen = MaskGenerator(mask)
     # An all-0xFF digest can't be produced by these hash functions'
     # outputs for in-keyspace candidates (and a false hit would only add
@@ -27,9 +30,23 @@ def run_bench(engine: str = "md5", device: str = "jax",
     if device == "jax":
         eng = get_engine(engine, device="jax")
         fake = bytes([0xFF]) * eng.digest_size
-        step = make_mask_crack_step(
-            eng, gen, target_words(fake, eng.little_endian), batch,
-            widen_utf16=getattr(eng, "widen_utf16", False))
+        use_pallas = False
+        if engine == "md5" and impl in ("auto", "pallas"):
+            from dprf_tpu.ops import pallas_md5
+            mode = ({"interpret": jax.default_backend() != "tpu"}
+                    if impl == "pallas" else pallas_md5.pallas_mode())
+            if mode is not None and pallas_md5.mask_supported(gen.charsets):
+                batch = max(pallas_md5.TILE,
+                            (batch // pallas_md5.TILE) * pallas_md5.TILE)
+                import numpy as np
+                step = pallas_md5.make_pallas_mask_crack_step(
+                    gen, np.frombuffer(fake, dtype="<u4").astype(np.uint32),
+                    batch, **mode)
+                use_pallas = True
+        if not use_pallas:
+            step = make_mask_crack_step(
+                eng, gen, target_words(fake, eng.little_endian), batch,
+                widen_utf16=getattr(eng, "widen_utf16", False))
         import jax.numpy as jnp
 
         def run_batch(i):
@@ -63,6 +80,7 @@ def run_bench(engine: str = "md5", device: str = "jax",
         elapsed = time.perf_counter() - t0
         batch = chunk
         compile_s = 0.0
+        use_pallas = False
 
     rate = n * batch / elapsed
     platform = jax.devices()[0].platform if device == "jax" else "cpu"
@@ -71,6 +89,7 @@ def run_bench(engine: str = "md5", device: str = "jax",
         "value": rate,
         "unit": "H/s",
         "engine": engine,
+        "impl": "pallas" if use_pallas else "xla",
         "device": platform,
         "mask": mask,
         "batch": batch,

@@ -108,9 +108,8 @@ def cmd_crack(args, log: Log) -> int:
     else:
         import hashlib as _hl
 
-        from dprf_tpu.generators.wordlist import (WordlistRulesGenerator,
-                                                  load_words)
-        from dprf_tpu.rules import load_rules, resolve_rules_path
+        from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+        from dprf_tpu.rules import resolve_rules_path
 
         # The 55-byte single-block limit only binds on the device packer;
         # a CPU-oracle job (no device wordlist worker) keeps the engine's
@@ -124,26 +123,24 @@ def cmd_crack(args, log: Log) -> int:
                 pass
         max_len = (min(55, engine.max_candidate_len) if dev_capable
                    else engine.max_candidate_len)
-        words, skipped_long = load_words(args.attack_arg, max_len)
-        if skipped_long:
-            log.warn("skipped overlong words", count=skipped_long,
-                     max_len=max_len)
-        rules = None
         rules_id = "none"
+        rules_spec = None
         if args.rules:
-            rules = load_rules(args.rules, on_error="skip")
+            rules_spec = args.rules
             with open(resolve_rules_path(args.rules), "rb") as fh:
                 rules_id = _hl.sha256(fh.read()).hexdigest()[:16]
-        gen = WordlistRulesGenerator(words, rules, max_len=max_len)
+        # from_files prefers the native (C++) loader: packed tables are
+        # built at memory bandwidth, never as a Python word list.
+        gen = WordlistRulesGenerator.from_files(args.attack_arg, rules_spec,
+                                                max_len=max_len)
+        if gen.n_skipped_long:
+            log.warn("skipped overlong words", count=gen.n_skipped_long,
+                     max_len=max_len)
         log.info("keyspace", words=gen.n_words, rules=gen.n_rules,
                  size=gen.keyspace)
         # Wordlist contents decide what an index decodes to: fingerprint
-        # the word stream, not the file path.
-        wl_id = _hl.sha256()
-        for w in words:
-            wl_id.update(w)
-            wl_id.update(b"\0")
-        attack_desc = (f"wordlist:{wl_id.hexdigest()[:16]}"
+        # the word content, not the file path.
+        attack_desc = (f"wordlist:{gen.content_id()}"
                        f":rules={rules_id}")
         # Units aligned to whole words: no candidate is ever rehashed at
         # unit boundaries on the device path.

@@ -60,38 +60,90 @@ def load_words(path: str, max_len: int,
 
 
 class WordlistRulesGenerator(CandidateGenerator):
-    """words x rules keyspace with host oracle + packed device tables."""
+    """words x rules keyspace with host oracle + packed device tables.
 
-    def __init__(self, words: Sequence[bytes],
+    Word storage is the packed pair (uint8[N, max_len] zero-padded rows,
+    int32[N] lengths) -- the exact layout the device consumes -- built
+    either from a list of words or directly by the native loader
+    (dprf_tpu/native/wordlist.cpp) without ever materializing Python
+    bytes objects.
+    """
+
+    def __init__(self, words: Optional[Sequence[bytes]] = None,
                  rules: Optional[Sequence[tuple[Op, ...]]] = None,
-                 max_len: int = 55):
-        if not words:
-            raise ValueError("empty wordlist")
-        self.words = list(words)
+                 max_len: int = 55,
+                 packed: Optional[tuple[np.ndarray, np.ndarray]] = None):
+        if (words is None) == (packed is None):
+            raise ValueError("pass exactly one of words / packed")
         self.rules = list(rules) if rules else [NOOP_RULE]
         self.max_len = self.max_length = max_len
-        self.n_words = len(self.words)
+        if packed is not None:
+            buf, lens = packed
+            if buf.ndim != 2 or buf.shape[1] != max_len or \
+                    len(lens) != buf.shape[0]:
+                raise ValueError("packed arrays disagree with max_len")
+            self._buf = np.ascontiguousarray(buf, dtype=np.uint8)
+            self._lens = np.asarray(lens, dtype=np.int32)
+        else:
+            if not words:
+                raise ValueError("empty wordlist")
+            if any(len(w) > max_len for w in words):
+                raise ValueError(f"word longer than max_len={max_len}")
+            self._buf = np.zeros((len(words), max_len), dtype=np.uint8)
+            self._lens = np.zeros((len(words),), dtype=np.int32)
+            for i, w in enumerate(words):
+                self._buf[i, :len(w)] = np.frombuffer(w, dtype=np.uint8)
+                self._lens[i] = len(w)
+        self.n_words = self._buf.shape[0]
+        if self.n_words == 0:
+            raise ValueError("empty wordlist")
         self.n_rules = len(self.rules)
         self.keyspace = self.n_words * self.n_rules
-        if any(len(w) > max_len for w in self.words):
-            raise ValueError(f"word longer than max_len={max_len}")
 
     @classmethod
     def from_files(cls, wordlist_path: str,
                    rules_spec: Optional[str] = None,
                    max_len: int = 55) -> "WordlistRulesGenerator":
-        words, _ = load_words(wordlist_path, max_len)
+        """Build from files, preferring the native (C++) loader.  The
+        count of skipped overlong lines lands on `gen.n_skipped_long`."""
         rules = load_rules(rules_spec, on_error="skip") if rules_spec else None
-        return cls(words, rules, max_len=max_len)
+        from dprf_tpu import native
+        loaded = native.load_words_packed(wordlist_path, max_len)
+        if loaded is not None:
+            buf, lens, skipped = loaded
+            if len(lens) == 0:
+                raise ValueError(
+                    f"wordlist {wordlist_path!r} contains no usable words")
+            gen = cls(rules=rules, max_len=max_len, packed=(buf, lens))
+        else:
+            words, skipped = load_words(wordlist_path, max_len)
+            gen = cls(words, rules, max_len=max_len)
+        gen.n_skipped_long = skipped
+        return gen
+
+    def content_id(self) -> str:
+        """Digest of the word *content* (what an index decodes to), for
+        job fingerprints: hashes the packed tables wholesale at memory
+        bandwidth instead of a per-word Python loop."""
+        import hashlib
+        h = hashlib.sha256()
+        h.update(b"dprf-wordlist-v2\0")
+        h.update(str(self.n_words).encode())
+        h.update(self._lens.tobytes())
+        h.update(self._buf.tobytes())
+        return h.hexdigest()[:16]
 
     # ---------------- host (oracle) path ----------------
+
+    def word(self, w: int) -> bytes:
+        return self._buf[w, :self._lens[w]].tobytes()
 
     def candidate(self, index: int) -> Optional[bytes]:
         """May return None: the (word, rule) pair rejected."""
         if not 0 <= index < self.keyspace:
             raise IndexError(f"index {index} outside keyspace {self.keyspace}")
         w, r = divmod(index, self.n_rules)
-        return apply_rule_cpu(self.words[w], self.rules[r], self.max_len)
+        return apply_rule_cpu(self.word(w), self.rules[r], self.max_len)
 
     def candidates(self, start: int, count: int) -> list:
         return [self.candidate(i)
@@ -116,9 +168,8 @@ class WordlistRulesGenerator(CandidateGenerator):
         n_pad = -(-n_pad // pad_to) * pad_to
         buf = np.zeros((n_pad, self.max_len), dtype=np.uint8)
         lens = np.zeros((n_pad,), dtype=np.int32)
-        for i, w in enumerate(self.words):
-            buf[i, :len(w)] = np.frombuffer(w, dtype=np.uint8)
-            lens[i] = len(w)
+        buf[:self.n_words] = self._buf
+        lens[:self.n_words] = self._lens
         return buf, lens
 
     def __repr__(self) -> str:  # pragma: no cover

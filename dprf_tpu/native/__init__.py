@@ -1,0 +1,116 @@
+"""Native (C++) host runtime components, bound via ctypes.
+
+The device compute path is JAX/XLA/Pallas; the host data plane around
+it is native where it matters.  First component: the wordlist
+loader/packer (wordlist.cpp) that turns line files into the fixed-width
+tables the device consumes at memory bandwidth instead of a Python
+per-line loop.
+
+The shared library is compiled on first use with the system compiler
+and cached next to the sources (keyed on source mtime).  Everything
+degrades gracefully: if no compiler is available the callers fall back
+to the pure-Python implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "wordlist.cpp")
+_LIB = os.path.join(_DIR, "libdprf_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> Optional[str]:
+    """(Re)build the shared library if stale; returns its path or None."""
+    try:
+        if (os.path.exists(_LIB)
+                and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+            return _LIB
+        for cc in ("c++", "g++", "cc", "gcc"):
+            # build to a temp name then rename: concurrent importers
+            # must never dlopen a half-written .so
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+            os.close(fd)
+            try:
+                res = subprocess.run(
+                    [cc, "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+                    capture_output=True, timeout=120)
+                if res.returncode == 0:
+                    os.replace(tmp, _LIB)
+                    return _LIB
+            except (OSError, subprocess.TimeoutExpired):
+                continue
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+    except OSError:
+        pass
+    return None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The bound library, or None if native support is unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("DPRF_NATIVE", "1") == "0":
+        return None
+    path = _compile()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.dprf_wordlist_scan.restype = ctypes.c_int
+    lib.dprf_wordlist_scan.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.dprf_wordlist_pack.restype = ctypes.c_int64
+    lib.dprf_wordlist_pack.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
+    _lib = lib
+    return _lib
+
+
+def load_words_packed(path: str, max_len: int):
+    """Native loader: file -> (uint8[N, max_len] zero-padded rows,
+    int32[N] lengths, n_skipped).  None if native is unavailable or the
+    file can't be read natively (caller falls back to Python)."""
+    lib = load()
+    if lib is None:
+        return None
+    n_words = ctypes.c_int64()
+    n_skipped = ctypes.c_int64()
+    max_seen = ctypes.c_int32()
+    enc = os.fsencode(path)
+    if lib.dprf_wordlist_scan(enc, max_len, ctypes.byref(n_words),
+                              ctypes.byref(n_skipped),
+                              ctypes.byref(max_seen)) != 0:
+        return None
+    n = n_words.value
+    buf = np.zeros((max(n, 1), max_len), dtype=np.uint8)
+    lens = np.zeros((max(n, 1),), dtype=np.int32)
+    if n:
+        wrote = lib.dprf_wordlist_pack(
+            enc, max_len,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            buf.strides[0],
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n)
+        if wrote != n:   # file changed between passes: be safe
+            return None
+    return buf[:n], lens[:n], n_skipped.value

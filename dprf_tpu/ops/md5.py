@@ -28,11 +28,13 @@ def _rotl(x: jnp.ndarray, n: int) -> jnp.ndarray:
     return (x << jnp.uint32(n)) | (x >> jnp.uint32(32 - n))
 
 
-def md5_compress(state: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
-    """state uint32[..., 4] x words uint32[..., 16] -> uint32[..., 4]."""
-    a, b, c, d = (state[..., i] for i in range(4))
-    m = [words[..., i] for i in range(16)]
+def md5_rounds(a, b, c, d, m):
+    """The 64 MD5 steps over any uint32 array shape (no feed-forward).
 
+    m: sequence of 16 message-word arrays.  Shared by the XLA path
+    (md5_compress) and the Pallas kernel (ops/pallas_md5.py) so the
+    round structure has a single source of truth.
+    """
     for i in range(64):
         rnd = i // 16
         if rnd == 0:
@@ -49,7 +51,13 @@ def md5_compress(state: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
             g = (7 * i) % 16
         tmp = a + f + jnp.uint32(int(K[i])) + m[g]
         a, d, c, b = d, c, b, (b + _rotl(tmp, _SHIFTS[rnd][i % 4]))
+    return a, b, c, d
 
+
+def md5_compress(state: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
+    """state uint32[..., 4] x words uint32[..., 16] -> uint32[..., 4]."""
+    a, b, c, d = md5_rounds(*(state[..., i] for i in range(4)),
+                            [words[..., i] for i in range(16)])
     # Davies-Meyer feed-forward: add the *input* chaining state (not
     # INIT -- they only coincide on the first block).
     return jnp.stack([a, b, c, d], axis=-1) + state

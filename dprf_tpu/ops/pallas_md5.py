@@ -1,0 +1,218 @@
+"""Fused mask->MD5->compare Pallas TPU kernel (benchmark config 1's
+hot loop as a single hand-scheduled kernel).
+
+Why a kernel at all: the XLA path (ops/pipeline.py) materializes the
+candidate block uint8[B, L] and the digest uint32[B, 4] in HBM between
+fusions.  At the throughputs this engine targets, those intermediate
+writes are the bandwidth floor.  This kernel keeps the whole chain --
+mixed-radix decode, charset lookup, message packing, 64 MD5 steps,
+compare, hit reduction -- in VMEM/registers, and writes only TWO int32
+scalars per grid cell (hit count + hit lane) back to HBM: the HBM
+traffic per candidate is ~8/TILE bytes instead of ~(L+16).
+
+Design choices forced by the VPU:
+- Charset lookup is arithmetic, not a gather: a charset in digit order
+  is piecewise byte = digit + delta, so the lookup is a few vectorized
+  `where` adds (7 segments for ?a, 1 for ?l/?u/?d).  Charsets needing
+  more than MAX_SEGMENTS segments fall back to the XLA path.
+- Hit extraction per tile is count + single-lane arithmetic max.  Two
+  hits in one TILE-candidate tile (vanishingly rare below ~2^-40 for
+  random targets; guaranteed visible in the count) force the caller's
+  exact host rescan, so correctness never depends on the rarity.
+- All lane arithmetic is int32, so a step's batch is capped below 2^31
+  candidates (the factory enforces it); larger sweeps are driven as
+  multiple steps by the worker, exactly like the XLA path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dprf_tpu.ops.md5 import INIT, md5_rounds
+
+#: sublane count per grid cell; TILE = SUB * 128 candidate lanes.
+SUB = 32
+TILE = SUB * 128
+#: charsets needing more piecewise segments than this use the XLA path.
+MAX_SEGMENTS = 16
+
+
+def pallas_mode() -> Optional[dict]:
+    """Whether the Pallas kernel path should be used, and how.
+
+    DPRF_PALLAS=0 disables it; =1 forces it (interpret mode off-TPU,
+    for tests); default "auto" uses it on real TPU only.  Returns
+    kwargs for the step factory, or None for the XLA path.
+    """
+    env = os.environ.get("DPRF_PALLAS", "auto")
+    if env == "0":
+        return None
+    import jax
+    if jax.default_backend() == "tpu":
+        return {"interpret": False}
+    if env == "1":
+        return {"interpret": True}
+    return None
+
+
+def charset_segments(charset: bytes):
+    """Charset (digit order) -> [(start_digit, byte_delta)] pieces where
+    byte = digit + delta for digit >= start_digit (until next piece)."""
+    segs = []
+    for d, byte in enumerate(charset):
+        delta = byte - d
+        if not segs or segs[-1][1] != delta:
+            segs.append((d, delta))
+    return segs
+
+
+def mask_supported(charsets: Sequence[bytes]) -> bool:
+    """True if every position's charset decodes in <= MAX_SEGMENTS
+    arithmetic pieces (all builtin charsets do)."""
+    return all(len(charset_segments(cs)) <= MAX_SEGMENTS
+               for cs in charsets)
+
+
+def _decode_byte(digit, segs):
+    """Vectorized piecewise charset lookup: digit array -> byte array."""
+    byte = digit + segs[0][1]
+    for start, delta in segs[1:]:
+        byte = jnp.where(digit >= start, digit + delta, byte)
+    return byte
+
+
+def _build_kernel(radices, seg_tables, length: int, target, sub: int):
+    """Kernel closure: radices/charset segments/target words are baked
+    in as constants (one compile per job, like the XLA step)."""
+    tile = sub * 128
+    # plain python ints: jnp scalars here would be captured closure
+    # constants, which pallas_call rejects
+    tw = [int(w) for w in target]
+    n_words = (length + 4) // 4 + 1      # data + 0x80 pad word, <= 15
+
+    def kernel(base_ref, nvalid_ref, counts_ref, hitlane_ref):
+        pid = pl.program_id(0)
+        lane = (jax.lax.broadcasted_iota(jnp.int32, (sub, 128), 0) * 128
+                + jax.lax.broadcasted_iota(jnp.int32, (sub, 128), 1))
+        # mixed-radix add (base digits + global offset), least
+        # significant (rightmost mask position) first, fused with the
+        # charset lookup.  The base index of this *tile* is folded into
+        # the scalar side (pid * tile) before vector carry propagation.
+        carry = lane + pid * tile
+        byts: list = [None] * length
+        for p in range(length - 1, -1, -1):
+            r = radices[p]
+            s = base_ref[p] + carry
+            byts[p] = _decode_byte(s % r, seg_tables[p]).astype(jnp.uint32)
+            carry = s // r
+        # pack bytes + Merkle-Damgard padding into the 16 message words
+        m = [jnp.zeros((sub, 128), jnp.uint32) for _ in range(16)]
+        for p in range(length):
+            m[p // 4] = m[p // 4] | (byts[p] << (8 * (p % 4)))
+        m[length // 4] = m[length // 4] | jnp.uint32(0x80 << (8 * (length % 4)))
+        m[14] = jnp.full((sub, 128), jnp.uint32(8 * length))
+        a, b, c, d = md5_rounds(
+            jnp.full((sub, 128), jnp.uint32(int(INIT[0]))),
+            jnp.full((sub, 128), jnp.uint32(int(INIT[1]))),
+            jnp.full((sub, 128), jnp.uint32(int(INIT[2]))),
+            jnp.full((sub, 128), jnp.uint32(int(INIT[3]))),
+            m)
+        a = a + jnp.uint32(int(INIT[0]))
+        b = b + jnp.uint32(int(INIT[1]))
+        c = c + jnp.uint32(int(INIT[2]))
+        d = d + jnp.uint32(int(INIT[3]))
+        valid = (lane + pid * tile) < nvalid_ref[0]
+        found = ((a == jnp.uint32(tw[0])) & (b == jnp.uint32(tw[1]))
+                 & (c == jnp.uint32(tw[2])) & (d == jnp.uint32(tw[3]))
+                 & valid)
+        counts_ref[0, 0] = jnp.sum(found.astype(jnp.int32))
+        # single-hit extraction: max lane among hits (-1 if none); the
+        # caller rescans any tile whose count exceeds 1.
+        hitlane_ref[0, 0] = jnp.max(jnp.where(found, lane, -1))
+
+    return kernel, n_words
+
+
+def make_md5_mask_pallas_fn(gen, target_words: np.ndarray, batch: int,
+                            sub: int = SUB, interpret: bool = False):
+    """Build fn(base_digits int32[L], n_valid int32[1]) ->
+    (counts int32[G, 1], hit_lanes int32[G, 1]) over a `batch`-lane
+    sweep.  batch must be a multiple of sub*128."""
+    tile = sub * 128
+    if batch % tile:
+        raise ValueError(f"batch {batch} not a multiple of tile {tile}")
+    if batch >= 1 << 31:
+        raise ValueError("batch must fit in int32 lane arithmetic")
+    if gen.length > 55:
+        raise ValueError("mask longer than the 55-byte single-block "
+                         "limit; use the XLA path")
+    grid = batch // tile
+    charsets = gen.charsets
+    if not mask_supported(charsets):
+        raise ValueError("charset needs too many segments for the "
+                         "arithmetic decode; use the XLA path")
+    seg_tables = [charset_segments(cs) for cs in charsets]
+    kernel, _ = _build_kernel(gen.radices, seg_tables, gen.length,
+                              target_words, sub)
+    L = gen.length
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((L,), lambda i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1,), lambda i: (0,), memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((grid, 1), jnp.int32),
+            jax.ShapeDtypeStruct((grid, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )
+
+
+def make_pallas_mask_crack_step(gen, target_words: np.ndarray, batch: int,
+                                hit_capacity: int = 64,
+                                interpret: bool = False):
+    """Drop-in replacement for ops/pipeline.make_mask_crack_step on the
+    single-target MD5 path: step(base_digits, n_valid) ->
+    (count, lanes, tpos).
+
+    Tile collisions (2+ hits in one tile) are folded into the overflow
+    convention: the returned count exceeds hit_capacity, which makes
+    the worker fall back to an exact host rescan of the batch.
+    """
+    from dprf_tpu.ops import compare as cmp_ops
+
+    tile = SUB * 128
+    fn = make_md5_mask_pallas_fn(gen, target_words, batch,
+                                 interpret=interpret)
+
+    @jax.jit
+    def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray):
+        counts, hit_lanes = fn(base_digits.astype(jnp.int32),
+                               jnp.reshape(n_valid, (1,)).astype(jnp.int32))
+        c = counts[:, 0]
+        total = jnp.sum(c)
+        collision = jnp.any(c > 1)
+        tcount, tiles, _ = cmp_ops.compact_hits(
+            c > 0, jnp.zeros_like(c), hit_capacity)
+        glanes = jnp.where(
+            tiles >= 0,
+            tiles * tile + hit_lanes[jnp.maximum(tiles, 0), 0], -1)
+        count = jnp.where(collision, jnp.int32(hit_capacity + 1), total)
+        return count, glanes, jnp.zeros_like(glanes)
+
+    return step

@@ -145,6 +145,15 @@ class MaskWorkerBase:
         sub = WorkUnit(-1, bstart, end - bstart)
         return CpuWorker(self.oracle, self.gen, self.targets).process(sub)
 
+    def _batch_hits(self, bstart: int, result, unit: WorkUnit) -> list[Hit]:
+        count, lanes, tpos = result
+        count = int(count)
+        if count == 0:
+            return []
+        if count > self.hit_capacity:
+            return self._rescan(bstart, unit)
+        return self._decode_lanes(bstart, np.asarray(lanes), np.asarray(tpos))
+
 
 class DeviceWordlistWorker(MaskWorkerBase):
     """Fused-pipeline worker for wordlist+rules attacks (config 3).
@@ -210,6 +219,32 @@ class DeviceWordlistWorker(MaskWorkerBase):
         return CpuWorker(self.oracle, self.gen, self.targets).process(sub)
 
 
+class PallasMd5MaskWorker(MaskWorkerBase):
+    """Mask worker over the hand-written Pallas MD5 kernel
+    (ops/pallas_md5.py) -- the single-target fast path where the whole
+    decode->hash->compare->reduce chain stays in VMEM.
+
+    Same hit-buffer interface as DeviceMaskWorker; tile collisions
+    surface as count > hit_capacity, which reuses the exact-rescan
+    fallback path.
+    """
+
+    def __init__(self, engine, gen, targets: Sequence[Target],
+                 batch: int = 1 << 18, hit_capacity: int = 64,
+                 oracle: Optional[HashEngine] = None,
+                 interpret: bool = False):
+        from dprf_tpu.ops.pallas_md5 import (TILE,
+                                             make_pallas_mask_crack_step)
+
+        tgt = self._setup_targets(engine, gen, targets, hit_capacity, oracle)
+        if self.multi:
+            raise ValueError("pallas mask worker is single-target only")
+        batch = max(TILE, (batch // TILE) * TILE)
+        self.batch = self.stride = batch
+        self.step = make_pallas_mask_crack_step(
+            gen, np.asarray(tgt), batch, hit_capacity, interpret=interpret)
+
+
 class DeviceMaskWorker(MaskWorkerBase):
     """Fused-pipeline worker for mask attacks on fast (unsalted) hashes."""
 
@@ -224,11 +259,3 @@ class DeviceMaskWorker(MaskWorkerBase):
             engine, gen, tgt, batch, hit_capacity,
             widen_utf16=getattr(engine, "widen_utf16", False))
 
-    def _batch_hits(self, bstart: int, result, unit: WorkUnit) -> list[Hit]:
-        count, lanes, tpos = result
-        count = int(count)
-        if count == 0:
-            return []
-        if count > self.hit_capacity:
-            return self._rescan(bstart, unit)
-        return self._decode_lanes(bstart, np.asarray(lanes), np.asarray(tpos))

@@ -1,0 +1,77 @@
+"""Native (C++) wordlist loader vs the pure-Python reference.
+
+The .so is compiled on first use by dprf_tpu/native; these tests skip
+only if no system compiler exists (the build image has g++).
+"""
+
+import numpy as np
+import pytest
+
+from dprf_tpu import native
+from dprf_tpu.generators.wordlist import (WordlistRulesGenerator,
+                                          load_words)
+
+
+CASES = {
+    "plain": b"alpha\nbravo\ncharlie\n",
+    "crlf": b"alpha\r\nbravo\r\n",
+    "no_trailing_newline": b"alpha\nbravo",
+    "empty_lines": b"\n\nalpha\n\n\nbravo\n\n",
+    "spaces_kept": b"  padded word \nx\n",
+    "long_skipped": b"ok\n" + b"x" * 200 + b"\nalso-ok\n",
+    "high_bytes": bytes(range(1, 10)) + b"\n" + b"caf\xe9\n",
+}
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.load()
+    if lib is None:
+        pytest.skip("no system compiler for the native loader")
+    return lib
+
+
+@pytest.mark.parametrize("name,data", list(CASES.items()))
+def test_native_matches_python(tmp_path, lib, name, data):
+    p = tmp_path / f"{name}.txt"
+    p.write_bytes(data)
+    got = native.load_words_packed(str(p), 55)
+    assert got is not None
+    buf, lens, skipped = got
+    want, want_skipped = load_words(str(p), 55)
+    assert skipped == want_skipped
+    assert len(lens) == len(want)
+    for i, w in enumerate(want):
+        assert lens[i] == len(w)
+        assert buf[i, :lens[i]].tobytes() == w
+        assert not buf[i, lens[i]:].any()          # zero padding
+
+
+def test_generator_from_files_uses_packed(tmp_path):
+    p = tmp_path / "w.txt"
+    p.write_bytes(CASES["long_skipped"])
+    gen = WordlistRulesGenerator.from_files(str(p))
+    assert gen.n_words == 2
+    assert gen.word(0) == b"ok"
+    assert gen.candidate(1) == b"also-ok"
+    buf, lens = gen.packed_words(pad_to=8)
+    assert buf.shape[0] % 8 == 0
+    assert lens[0] == 2 and lens[1] == 7
+
+
+def test_generator_packed_vs_list_equivalent(tmp_path):
+    p = tmp_path / "w.txt"
+    p.write_bytes(CASES["plain"])
+    g1 = WordlistRulesGenerator.from_files(str(p))
+    words, _ = load_words(str(p), 55)
+    g2 = WordlistRulesGenerator(words)
+    assert g1.keyspace == g2.keyspace
+    for i in range(g1.keyspace):
+        assert g1.candidate(i) == g2.candidate(i)
+    b1, l1 = g1.packed_words(pad_to=4)
+    b2, l2 = g2.packed_words(pad_to=4)
+    assert (b1 == b2).all() and (l1 == l2).all()
+
+
+def test_scan_missing_file():
+    assert native.load_words_packed("/nonexistent/x.txt", 55) is None

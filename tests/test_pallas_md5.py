@@ -1,0 +1,95 @@
+"""Pallas MD5 mask kernel vs the oracle (interpret mode on the CPU
+backend; the same kernel compiles natively on TPU).
+
+Covers: charset segment decomposition, planted-password extraction,
+n_valid masking, the tile-collision -> rescan overflow convention, and
+worker-level equivalence with the XLA pipeline path.
+"""
+
+import hashlib
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.generators.mask import BUILTIN_CHARSETS, MaskGenerator
+from dprf_tpu.ops.pallas_md5 import (MAX_SEGMENTS, TILE, charset_segments,
+                                     make_pallas_mask_crack_step,
+                                     mask_supported)
+from dprf_tpu.runtime.worker import PallasMd5MaskWorker
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+def _target(plain: bytes) -> np.ndarray:
+    return np.frombuffer(hashlib.md5(plain).digest(),
+                         dtype="<u4").astype(np.uint32)
+
+
+def test_charset_segments_reconstruct():
+    for name, cs in BUILTIN_CHARSETS.items():
+        segs = charset_segments(cs)
+        assert len(segs) <= MAX_SEGMENTS, name
+        # reconstruct every byte from the piecewise map
+        got = []
+        for d in range(len(cs)):
+            delta = [dl for s, dl in segs if s <= d][-1]
+            got.append(d + delta)
+        assert bytes(got) == cs, name
+    assert mask_supported(list(BUILTIN_CHARSETS.values()))
+
+
+@pytest.mark.parametrize("mask,plant", [
+    ("?l?l?l?l", b"crab"),
+    ("?d?d?d?d?d", b"90210"),
+    ("?a?a?a", b"X& "),
+    ("pre?l?d", b"prez7"),      # literals + mixed charsets
+])
+def test_kernel_finds_planted(mask, plant):
+    gen = MaskGenerator(mask)
+    pidx = gen.index_of(plant)
+    step = make_pallas_mask_crack_step(gen, _target(plant), batch=TILE,
+                                       interpret=True)
+    base = TILE * (pidx // TILE)
+    n_valid = min(TILE, gen.keyspace - base)
+    bd = jnp.asarray(gen.digits(base), dtype=jnp.int32)
+    count, lanes, _ = step(bd, jnp.int32(n_valid))
+    assert int(count) == 1
+    assert int(np.asarray(lanes)[0]) == pidx - base
+    # plant masked out by n_valid -> no hit
+    count2, _, _ = step(bd, jnp.int32(pidx - base))
+    assert int(count2) == 0
+
+
+def test_tile_collision_forces_rescan_convention():
+    """Two hits in one tile can't both be extracted; the step must
+    report count > hit_capacity so the worker rescans exactly."""
+    gen = MaskGenerator("?l?l?l")
+    # same digest can't come from two plaintexts; instead fabricate a
+    # collision by hashing a candidate and planting it -- single hit --
+    # then check the convention arithmetic with capacity=0.
+    plant = b"abc"
+    step = make_pallas_mask_crack_step(gen, _target(plant), batch=TILE,
+                                       hit_capacity=0, interpret=True)
+    bd = jnp.asarray(gen.digits(0), dtype=jnp.int32)
+    count, _, _ = step(bd, jnp.int32(min(TILE, gen.keyspace)))
+    assert int(count) == 1 > 0   # count still exact with tiny capacity
+
+
+def test_pallas_worker_matches_xla_worker():
+    gen = MaskGenerator("?l?l?l?l")
+    plant = b"wasp"
+    eng = get_engine("md5", device="jax")
+    targets = [eng.parse_target(hashlib.md5(plant).hexdigest())]
+    oracle = get_engine("md5")
+    pworker = PallasMd5MaskWorker(eng, gen, targets, batch=TILE,
+                                  hit_capacity=8, oracle=oracle,
+                                  interpret=True)
+    unit = WorkUnit(0, 0, gen.keyspace)
+    phits = pworker.process(unit)
+    xworker = eng.make_mask_worker(gen, targets, batch=1 << 14,
+                                   hit_capacity=8, oracle=oracle)
+    xhits = xworker.process(unit)
+    assert [(h.target_index, h.cand_index, h.plaintext) for h in phits] == \
+        [(h.target_index, h.cand_index, h.plaintext) for h in xhits]
+    assert phits[0].plaintext == plant

@@ -127,3 +127,44 @@ def test_potfile_roundtrip(tmp_path):
                                    b"$HEX[41]"])
 def test_plain_encoding_roundtrip(plain):
     assert decode_plain(encode_plain(plain)) == plain
+
+
+def test_dispatcher_chaos_full_coverage():
+    """Elastic-recovery stress (SURVEY.md section 5): workers randomly
+    crash (fail), stall (lease expiry), or double-report completions;
+    the ledger must still converge to exactly-full coverage."""
+    import random
+    rng = random.Random(7)
+    clk = FakeClock()
+    d = Dispatcher(keyspace=10_000, unit_size=37, lease_timeout=50.0,
+                   clock=clk)
+    held = []                      # units currently "running"
+    completed_ids = []
+    for _ in range(200_000):
+        if d.done():
+            break
+        clk.t += rng.uniform(0, 5)
+        action = rng.random()
+        if action < 0.45 or not held:
+            u = d.lease(f"w{rng.randrange(8)}")
+            if u is not None:
+                held.append(u)
+        elif action < 0.75:
+            u = held.pop(rng.randrange(len(held)))
+            d.complete(u.unit_id)
+            completed_ids.append(u.unit_id)
+        elif action < 0.85:
+            u = held.pop(rng.randrange(len(held)))
+            d.fail(u.unit_id)
+        elif action < 0.95:
+            # stalled worker: just sit on the unit past its lease;
+            # dispatcher reaps it and someone else finishes it
+            clk.t += 60.0
+            if held and rng.random() < 0.5:
+                held.pop(rng.randrange(len(held)))   # worker died silently
+        else:
+            # late/duplicate completion of an already-finished unit
+            if completed_ids:
+                d.complete(rng.choice(completed_ids))
+    assert d.done()
+    assert d.completed_intervals() == [(0, 10_000)]
