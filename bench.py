@@ -19,15 +19,38 @@ import subprocess
 import sys
 
 BASELINE_TARGET = 1.0e11   # MD5 H/s/chip north-star target
-TIMEOUT_S = 600
+TIMEOUT_S = 540
 
+_PROBE = "import jax; jax.devices()"
+
+# The tunnel serves one client at a time and wedges if a client dies
+# mid-session, so: probe first, keep all device work in watchdogged
+# subprocesses, and force the CPU backend via jax.config (env vars
+# alone cannot override the site-registered axon platform).
 _CHILD = r"""
 import json
+{force_cpu}
 from dprf_tpu.bench import run_bench
 res = run_bench(engine="md5", device="jax", mask="?a?a?a?a?a?a?a?a",
-                batch=1 << 22, seconds=10.0)
+                batch={batch}, seconds=10.0)
 print("BENCH_JSON:" + json.dumps(res))
 """
+_FORCE_CPU = 'import jax; jax.config.update("jax_platforms", "cpu")'
+
+
+def _run_child(env, force_cpu: bool, batch: int, timeout: int):
+    code = _CHILD.format(force_cpu=_FORCE_CPU if force_cpu else "",
+                         batch=batch)
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, "watchdog timeout"
+    for line in proc.stdout.splitlines():
+        if line.startswith("BENCH_JSON:"):
+            return json.loads(line[len("BENCH_JSON:"):]), None
+    return None, proc.stderr[-2000:]
 
 
 def main() -> int:
@@ -35,33 +58,31 @@ def main() -> int:
     env = dict(os.environ)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     res = None
+
+    # cheap tunnel-health probe before committing to a long device run
+    tpu_ok = False
     try:
-        proc = subprocess.run([sys.executable, "-c", _CHILD], env=env,
-                              capture_output=True, text=True,
-                              timeout=TIMEOUT_S)
-        for line in proc.stdout.splitlines():
-            if line.startswith("BENCH_JSON:"):
-                res = json.loads(line[len("BENCH_JSON:"):])
-        if res is None and proc.returncode != 0:
-            sys.stderr.write(proc.stderr[-2000:] + "\n")
+        tpu_ok = subprocess.run([sys.executable, "-c", _PROBE], env=env,
+                                capture_output=True,
+                                timeout=120).returncode == 0
     except subprocess.TimeoutExpired:
-        sys.stderr.write("bench: device run exceeded watchdog timeout "
-                         "(TPU tunnel wedged?); falling back to CPU\n")
+        sys.stderr.write("bench: TPU tunnel probe hung (wedged tunnel); "
+                         "using CPU backend\n")
+
+    if tpu_ok:
+        res, err = _run_child(env, force_cpu=False, batch=1 << 22,
+                              timeout=TIMEOUT_S)
+        if res is None:
+            sys.stderr.write(f"bench: device run failed ({err}); "
+                             "falling back to CPU\n")
 
     if res is None:
-        env["JAX_PLATFORMS"] = "cpu"
-        child = _CHILD.replace('batch=1 << 22', 'batch=1 << 16')
-        try:
-            proc = subprocess.run([sys.executable, "-c", child], env=env,
-                                  capture_output=True, text=True,
-                                  timeout=TIMEOUT_S)
-            for line in proc.stdout.splitlines():
-                if line.startswith("BENCH_JSON:"):
-                    res = json.loads(line[len("BENCH_JSON:"):])
-        except subprocess.TimeoutExpired:
-            sys.stderr.write("bench: CPU fallback also timed out\n")
+        res, err = _run_child(env, force_cpu=True, batch=1 << 16,
+                              timeout=TIMEOUT_S)
         if res is not None:
             res["note"] = "CPU fallback - TPU unavailable"
+        elif err:
+            sys.stderr.write(f"bench: CPU fallback failed ({err})\n")
 
     if res is None:
         print(json.dumps({"metric": "md5 candidates/sec/chip", "value": 0,

@@ -31,11 +31,17 @@ def run_bench(engine: str = "md5", device: str = "jax",
         eng = get_engine(engine, device="jax")
         fake = bytes([0xFF]) * eng.digest_size
         use_pallas = False
-        if engine == "md5" and impl in ("auto", "pallas"):
+        if impl != "xla":
             from dprf_tpu.ops import pallas_md5
+            eligible = (engine == "md5" and gen.length <= 55
+                        and pallas_md5.mask_supported(gen.charsets))
+            if impl == "pallas" and not eligible:
+                raise ValueError(
+                    "--impl pallas requires engine md5 and a mask the "
+                    "arithmetic charset decode supports")
             mode = ({"interpret": jax.default_backend() != "tpu"}
                     if impl == "pallas" else pallas_md5.pallas_mode())
-            if mode is not None and pallas_md5.mask_supported(gen.charsets):
+            if eligible and mode is not None:
                 batch = max(pallas_md5.TILE,
                             (batch // pallas_md5.TILE) * pallas_md5.TILE)
                 import numpy as np

@@ -55,6 +55,9 @@ def _build_parser() -> argparse.ArgumentParser:
     c.add_argument("--unit-size", type=int, default=1 << 22)
     c.add_argument("--batch", type=int, default=1 << 18)
     c.add_argument("--hit-cap", type=int, default=64)
+    c.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a jax.profiler trace of the run to DIR "
+                   "(view with tensorboard)")
     c.add_argument("--quiet", "-q", action="store_true")
 
     b = sub.add_parser("bench", help="measure engine throughput")
@@ -63,6 +66,10 @@ def _build_parser() -> argparse.ArgumentParser:
     b.add_argument("--mask", default="?a?a?a?a?a?a?a?a")
     b.add_argument("--batch", type=int, default=1 << 20)
     b.add_argument("--seconds", type=float, default=5.0)
+    b.add_argument("--impl", default="auto", choices=["auto", "xla", "pallas"],
+                   help="force the generic XLA pipeline or the Pallas "
+                   "kernel (md5) instead of automatic selection")
+    b.add_argument("--profile", default=None, metavar="DIR")
     b.add_argument("--quiet", "-q", action="store_true")
 
     e = sub.add_parser("engines", help="list available engines")
@@ -221,7 +228,15 @@ def cmd_crack(args, log: Log) -> int:
     if coord.found:
         log.info("pre-cracked targets", count=len(coord.found))
 
-    result = coord.run()
+    if args.profile:
+        # jax.profiler.trace captures device + host timelines for every
+        # step the coordinator drives (SURVEY.md section 5: tracing).
+        import jax
+        with jax.profiler.trace(args.profile):
+            result = coord.run()
+        log.info("profile written", dir=args.profile)
+    else:
+        result = coord.run()
 
     for ti, plain in sorted(result.found.items()):
         from dprf_tpu.runtime.potfile import encode_plain
@@ -235,12 +250,18 @@ def cmd_crack(args, log: Log) -> int:
 
 
 def cmd_bench(args, log: Log) -> int:
+    import contextlib
     import json
     from dprf_tpu.bench import run_bench
-    res = run_bench(engine=args.engine,
-                    device=_DEVICE_ALIASES[args.device],
-                    mask=args.mask, batch=args.batch,
-                    seconds=args.seconds, log=log)
+    ctx = contextlib.nullcontext()
+    if args.profile:
+        import jax
+        ctx = jax.profiler.trace(args.profile)
+    with ctx:
+        res = run_bench(engine=args.engine,
+                        device=_DEVICE_ALIASES[args.device],
+                        mask=args.mask, batch=args.batch,
+                        seconds=args.seconds, impl=args.impl, log=log)
     print(json.dumps(res))
     return 0
 

@@ -129,8 +129,10 @@ class WordlistRulesGenerator(CandidateGenerator):
         h = hashlib.sha256()
         h.update(b"dprf-wordlist-v2\0")
         h.update(str(self.n_words).encode())
-        h.update(self._lens.tobytes())
-        h.update(self._buf.tobytes())
+        # feed the arrays' buffers directly: tobytes() would copy the
+        # (potentially multi-GB) packed table just to hash it
+        h.update(np.ascontiguousarray(self._lens))
+        h.update(np.ascontiguousarray(self._buf))
         return h.hexdigest()[:16]
 
     # ---------------- host (oracle) path ----------------

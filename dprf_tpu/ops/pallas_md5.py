@@ -95,7 +95,6 @@ def _build_kernel(radices, seg_tables, length: int, target, sub: int):
     # plain python ints: jnp scalars here would be captured closure
     # constants, which pallas_call rejects
     tw = [int(w) for w in target]
-    n_words = (length + 4) // 4 + 1      # data + 0x80 pad word, <= 15
 
     def kernel(base_ref, nvalid_ref, counts_ref, hitlane_ref):
         pid = pl.program_id(0)
@@ -137,7 +136,7 @@ def _build_kernel(radices, seg_tables, length: int, target, sub: int):
         # caller rescans any tile whose count exceeds 1.
         hitlane_ref[0, 0] = jnp.max(jnp.where(found, lane, -1))
 
-    return kernel, n_words
+    return kernel
 
 
 def make_md5_mask_pallas_fn(gen, target_words: np.ndarray, batch: int,
@@ -159,8 +158,8 @@ def make_md5_mask_pallas_fn(gen, target_words: np.ndarray, batch: int,
         raise ValueError("charset needs too many segments for the "
                          "arithmetic decode; use the XLA path")
     seg_tables = [charset_segments(cs) for cs in charsets]
-    kernel, _ = _build_kernel(gen.radices, seg_tables, gen.length,
-                              target_words, sub)
+    kernel = _build_kernel(gen.radices, seg_tables, gen.length,
+                           target_words, sub)
     L = gen.length
     return pl.pallas_call(
         kernel,
@@ -194,8 +193,6 @@ def make_pallas_mask_crack_step(gen, target_words: np.ndarray, batch: int,
     convention: the returned count exceeds hit_capacity, which makes
     the worker fall back to an exact host rescan of the batch.
     """
-    from dprf_tpu.ops import compare as cmp_ops
-
     tile = SUB * 128
     fn = make_md5_mask_pallas_fn(gen, target_words, batch,
                                  interpret=interpret)
@@ -204,15 +201,26 @@ def make_pallas_mask_crack_step(gen, target_words: np.ndarray, batch: int,
     def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray):
         counts, hit_lanes = fn(base_digits.astype(jnp.int32),
                                jnp.reshape(n_valid, (1,)).astype(jnp.int32))
-        c = counts[:, 0]
-        total = jnp.sum(c)
-        collision = jnp.any(c > 1)
-        tcount, tiles, _ = cmp_ops.compact_hits(
-            c > 0, jnp.zeros_like(c), hit_capacity)
-        glanes = jnp.where(
-            tiles >= 0,
-            tiles * tile + hit_lanes[jnp.maximum(tiles, 0), 0], -1)
-        count = jnp.where(collision, jnp.int32(hit_capacity + 1), total)
-        return count, glanes, jnp.zeros_like(glanes)
+        return reduce_tile_hits(counts, hit_lanes, hit_capacity, tile)
 
     return step
+
+
+def reduce_tile_hits(counts: jnp.ndarray, hit_lanes: jnp.ndarray,
+                     hit_capacity: int, tile: int):
+    """Per-tile kernel outputs -> the worker's (count, lanes, tpos)
+    contract.  A tile holding 2+ hits can only report one lane, so any
+    such tile forces count > hit_capacity: the worker's exact host
+    rescan then recovers every hit."""
+    from dprf_tpu.ops import compare as cmp_ops
+
+    c = counts[:, 0]
+    total = jnp.sum(c)
+    collision = jnp.any(c > 1)
+    _, tiles, _ = cmp_ops.compact_hits(c > 0, jnp.zeros_like(c),
+                                       hit_capacity)
+    glanes = jnp.where(
+        tiles >= 0,
+        tiles * tile + hit_lanes[jnp.maximum(tiles, 0), 0], -1)
+    count = jnp.where(collision, jnp.int32(hit_capacity + 1), total)
+    return count, glanes, jnp.zeros_like(glanes)

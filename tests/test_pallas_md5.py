@@ -62,18 +62,50 @@ def test_kernel_finds_planted(mask, plant):
 
 
 def test_tile_collision_forces_rescan_convention():
-    """Two hits in one tile can't both be extracted; the step must
-    report count > hit_capacity so the worker rescans exactly."""
-    gen = MaskGenerator("?l?l?l")
-    # same digest can't come from two plaintexts; instead fabricate a
-    # collision by hashing a candidate and planting it -- single hit --
-    # then check the convention arithmetic with capacity=0.
-    plant = b"abc"
-    step = make_pallas_mask_crack_step(gen, _target(plant), batch=TILE,
-                                       hit_capacity=0, interpret=True)
-    bd = jnp.asarray(gen.digits(0), dtype=jnp.int32)
-    count, _, _ = step(bd, jnp.int32(min(TILE, gen.keyspace)))
-    assert int(count) == 1 > 0   # count still exact with tiny capacity
+    """Two hits in one tile can only report one lane, so the reducer
+    must return count > hit_capacity (the worker then rescans exactly).
+    Driven directly through reduce_tile_hits: an MD5 collision can't be
+    fabricated, but the kernel's counts output can."""
+    from dprf_tpu.ops.pallas_md5 import reduce_tile_hits
+
+    cap = 8
+    # tile 3 holds two hits; only lane 7 was extractable
+    counts = jnp.asarray([[0], [1], [0], [2]], jnp.int32)
+    lanes = jnp.asarray([[-1], [5], [-1], [7]], jnp.int32)
+    count, glanes, _ = reduce_tile_hits(counts, lanes, cap, tile=100)
+    assert int(count) == cap + 1          # forces worker rescan
+    # single-hit tiles still decode to global lanes
+    counts1 = jnp.asarray([[0], [1], [0], [1]], jnp.int32)
+    count1, glanes1, _ = reduce_tile_hits(counts1, lanes, cap, tile=100)
+    assert int(count1) == 2
+    got = sorted(int(x) for x in np.asarray(glanes1) if x >= 0)
+    assert got == [105, 307]
+    # capacity still exact when more hit-tiles than capacity slots
+    count0, _, _ = reduce_tile_hits(counts1, lanes, 0, tile=100)
+    assert int(count0) == 2
+
+
+def test_worker_rescan_on_fabricated_collision():
+    """End-to-end: a step reporting a tile collision must make the
+    worker fall back to the oracle rescan and recover every hit."""
+    gen = MaskGenerator("?l?l?l?l")
+    plant = b"wasp"
+    eng = get_engine("md5", device="jax")
+    targets = [eng.parse_target(hashlib.md5(plant).hexdigest())]
+    worker = PallasMd5MaskWorker(eng, gen, targets, batch=TILE,
+                                 hit_capacity=8,
+                                 oracle=get_engine("md5"), interpret=True)
+    real_step = worker.step
+
+    def lying_step(base, n_valid):
+        count, lanes, tpos = real_step(base, n_valid)
+        # pretend a tile had 2 hits: overflow convention
+        return jnp.int32(9), lanes, tpos
+
+    worker.step = lying_step
+    hits = worker.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.cand_index, h.plaintext) for h in hits] == \
+        [(gen.index_of(plant), plant)]
 
 
 def test_pallas_worker_matches_xla_worker():
