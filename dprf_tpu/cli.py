@@ -3,8 +3,11 @@
 Flag surface pinned to BASELINE.json's north star: ``dprf crack
 --engine=<algo> --device=tpu -a mask <mask> <hashfile>`` -- jobs that
 ran against the reference's CPU engines select the TPU backend with
---device and otherwise run unchanged.  Subcommands: crack, bench,
-engines, keyspace.
+--device and otherwise run unchanged.
+
+Subcommands: crack (local job), serve + worker (distributed job:
+coordinator RPC + remote workers, runtime/rpc.py), bench, engines,
+keyspace.
 """
 
 from __future__ import annotations
@@ -26,15 +29,12 @@ from dprf_tpu.utils.logging import Log
 _DEVICE_ALIASES = {"tpu": "jax", "jax": "jax", "cpu": "cpu"}
 
 
-def _build_parser() -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(
-        prog="dprf", description="TPU-native distributed password recovery")
-    sub = p.add_subparsers(dest="command", required=True)
-
-    c = sub.add_parser("crack", help="run a recovery job")
+def _add_job_args(c, with_hashfile: bool = True) -> None:
+    """Attack/job flags shared by crack and serve."""
     c.add_argument("attack_arg", help="mask string (mask attack) or "
                    "wordlist path (wordlist attack)")
-    c.add_argument("hashfile", help="file of target hashes")
+    if with_hashfile:
+        c.add_argument("hashfile", help="file of target hashes")
     c.add_argument("--engine", "-m", required=True,
                    help="hash algorithm (see `dprf engines`)")
     c.add_argument("--device", default="tpu", choices=sorted(_DEVICE_ALIASES),
@@ -55,10 +55,47 @@ def _build_parser() -> argparse.ArgumentParser:
     c.add_argument("--unit-size", type=int, default=1 << 22)
     c.add_argument("--batch", type=int, default=1 << 18)
     c.add_argument("--hit-cap", type=int, default=64)
+    c.add_argument("--quiet", "-q", action="store_true")
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dprf", description="TPU-native distributed password recovery")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    c = sub.add_parser("crack", help="run a recovery job locally")
+    _add_job_args(c)
+    c.add_argument("--devices", type=int, default=1,
+                   help="shard the job over N local chips via the mesh "
+                   "(fast unsalted engines)")
     c.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run to DIR "
                    "(view with tensorboard)")
-    c.add_argument("--quiet", "-q", action="store_true")
+
+    s = sub.add_parser("serve", help="run the coordinator for a "
+                       "distributed job (workers connect with "
+                       "`dprf worker`)")
+    _add_job_args(s)
+    s.add_argument("--bind", default="127.0.0.1:41715",
+                   metavar="HOST:PORT",
+                   help="listen address; the protocol is unauthenticated "
+                   "-- bind only to trusted networks")
+    s.add_argument("--lease-timeout", type=float, default=300.0,
+                   help="seconds before a silent worker's unit is "
+                   "reissued")
+
+    w = sub.add_parser("worker", help="process WorkUnits for a "
+                       "`dprf serve` coordinator")
+    w.add_argument("--connect", required=True, metavar="HOST:PORT")
+    w.add_argument("--device", default="tpu",
+                   choices=sorted(_DEVICE_ALIASES))
+    w.add_argument("--devices", type=int, default=1,
+                   help="shard each unit over N local chips")
+    w.add_argument("--id", default=None, help="worker id for the lease "
+                   "ledger (default: host:pid)")
+    w.add_argument("--batch", type=int, default=None,
+                   help="override the job's device batch size")
+    w.add_argument("--quiet", "-q", action="store_true")
 
     b = sub.add_parser("bench", help="measure engine throughput")
     b.add_argument("--engine", "-m", default="md5")
@@ -91,76 +128,134 @@ def _customs(args) -> dict:
     return out
 
 
-def cmd_crack(args, log: Log) -> int:
-    device = _DEVICE_ALIASES[args.device]
-    engine = get_engine(args.engine, device="cpu")   # parser/oracle always CPU
-    hl = load_hashlist(engine, args.hashfile)
+# ---------------------------------------------------------------------------
+# job construction (shared by crack / serve / worker)
+
+def _wordlist_max_len(engine_name: str, engine, device: str) -> int:
+    """The 55-byte single-block limit only binds on the device packer; a
+    CPU-oracle job keeps the engine's own limit (e.g. 63-byte WPA
+    passphrases)."""
+    if device == "jax":
+        try:
+            if hasattr(get_engine(engine_name, device="jax"),
+                       "make_wordlist_worker"):
+                return min(55, engine.max_candidate_len)
+        except KeyError:
+            pass
+    return engine.max_candidate_len
+
+
+def _build_gen(attack: str, attack_arg: str, customs: dict,
+               rules_spec, max_len: Optional[int], engine, device: str,
+               log: Log):
+    """Build the candidate generator + the attack identity string.
+
+    max_len: wordlist packing width; None = derive from engine/device
+    (the coordinator derives it and ships it to workers, who must use
+    the identical value or their keyspace would disagree).
+    Returns (gen, attack_desc, max_len).
+    """
+    if attack == "mask":
+        gen = MaskGenerator(attack_arg, custom=customs or None)
+        log.info("keyspace", mask=attack_arg, size=gen.keyspace)
+        # Custom charsets change which candidate an index decodes to, so
+        # they are part of the job identity.
+        attack_desc = f"mask:{attack_arg}" + "".join(
+            f":{i}={customs[i].hex()}" for i in sorted(customs))
+        return gen, attack_desc, None
+
+    import hashlib as _hl
+
+    from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+    from dprf_tpu.rules import resolve_rules_path
+
+    if max_len is None:
+        max_len = _wordlist_max_len(engine.name, engine, device)
+    rules_id = "none"
+    if rules_spec:
+        with open(resolve_rules_path(rules_spec), "rb") as fh:
+            rules_id = _hl.sha256(fh.read()).hexdigest()[:16]
+    # from_files prefers the native (C++) loader: packed tables are
+    # built at memory bandwidth, never as a Python word list.
+    gen = WordlistRulesGenerator.from_files(attack_arg, rules_spec,
+                                            max_len=max_len)
+    if gen.n_skipped_long:
+        log.warn("skipped overlong words", count=gen.n_skipped_long,
+                 max_len=max_len)
+    log.info("keyspace", words=gen.n_words, rules=gen.n_rules,
+             size=gen.keyspace)
+    # Wordlist contents decide what an index decodes to: fingerprint
+    # the word content, not the file path.
+    attack_desc = f"wordlist:{gen.content_id()}:rules={rules_id}"
+    return gen, attack_desc, max_len
+
+
+def _align_unit_size(unit_size: int, attack: str, gen) -> int:
+    """Units aligned to whole words: no candidate is ever rehashed at
+    unit boundaries on the device path."""
+    if attack != "wordlist":
+        return unit_size
+    return max(gen.n_rules, (unit_size // gen.n_rules) * gen.n_rules)
+
+
+def _select_worker(engine_name: str, device: str, attack: str, gen,
+                   targets, batch: int, hit_cap: int, oracle, n_devices: int,
+                   log: Log):
+    """Pick the execution backend for a job's WorkUnits.
+
+    Engine-specific device workers first (salted pipelines plug in the
+    same way fast ones do); the multi-chip mesh path for fast engines
+    when n_devices > 1; CPU oracle as the fallback.
+    """
+    maker_name = ("make_mask_worker" if attack == "mask"
+                  else "make_wordlist_worker")
+    dev_engine = None
+    if device == "jax":
+        try:
+            dev_engine = get_engine(engine_name, device="jax")
+        except KeyError:
+            pass
+    if dev_engine is not None and n_devices > 1:
+        if hasattr(dev_engine, "digest_packed"):
+            from dprf_tpu.parallel.mesh import make_mesh
+            from dprf_tpu.parallel.worker import (ShardedMaskWorker,
+                                                  ShardedWordlistWorker)
+            mesh = make_mesh(n_devices)
+            log.info("mesh", devices=n_devices)
+            if attack == "mask":
+                return ShardedMaskWorker(
+                    dev_engine, gen, targets, mesh,
+                    batch_per_device=batch, hit_capacity=hit_cap,
+                    oracle=oracle)
+            return ShardedWordlistWorker(
+                dev_engine, gen, targets, mesh,
+                word_batch_per_device=max(1, batch // gen.n_rules),
+                hit_capacity=hit_cap, oracle=oracle)
+        log.warn("engine has no multi-chip pipeline; using one chip",
+                 engine=engine_name)
+    if dev_engine is not None and hasattr(dev_engine, maker_name):
+        return getattr(dev_engine, maker_name)(
+            gen, targets, batch=batch, hit_capacity=hit_cap, oracle=oracle)
+    if device == "jax":
+        log.warn("no jax engine for algorithm/attack; using cpu oracle",
+                 engine=engine_name)
+    return CpuWorker(oracle, gen, targets)
+
+
+def _load_targets(engine, hashfile: str, log: Log):
+    hl = load_hashlist(engine, hashfile)
     for no, text, err in hl.skipped:
         log.warn("skipping hashlist line", line=no, error=err)
     if not hl.targets:
         log.error("no valid targets in hashlist")
-        return 2
+        return None
     log.info("loaded targets", count=len(hl.targets),
              duplicates=hl.duplicates, engine=engine.name)
+    return hl
 
-    unit_size = args.unit_size
-    if args.attack == "mask":
-        customs = _customs(args)
-        gen = MaskGenerator(args.attack_arg, custom=customs or None)
-        log.info("keyspace", mask=args.attack_arg, size=gen.keyspace)
-        # Custom charsets change which candidate an index decodes to, so
-        # they are part of the job identity.
-        attack_desc = f"mask:{args.attack_arg}" + "".join(
-            f":{i}={customs[i].hex()}" for i in sorted(customs))
-    else:
-        import hashlib as _hl
 
-        from dprf_tpu.generators.wordlist import WordlistRulesGenerator
-        from dprf_tpu.rules import resolve_rules_path
-
-        # The 55-byte single-block limit only binds on the device packer;
-        # a CPU-oracle job (no device wordlist worker) keeps the engine's
-        # own limit (e.g. 63-byte WPA passphrases).
-        dev_capable = False
-        if device == "jax":
-            try:
-                dev_capable = hasattr(get_engine(args.engine, device="jax"),
-                                      "make_wordlist_worker")
-            except KeyError:
-                pass
-        max_len = (min(55, engine.max_candidate_len) if dev_capable
-                   else engine.max_candidate_len)
-        rules_id = "none"
-        rules_spec = None
-        if args.rules:
-            rules_spec = args.rules
-            with open(resolve_rules_path(args.rules), "rb") as fh:
-                rules_id = _hl.sha256(fh.read()).hexdigest()[:16]
-        # from_files prefers the native (C++) loader: packed tables are
-        # built at memory bandwidth, never as a Python word list.
-        gen = WordlistRulesGenerator.from_files(args.attack_arg, rules_spec,
-                                                max_len=max_len)
-        if gen.n_skipped_long:
-            log.warn("skipped overlong words", count=gen.n_skipped_long,
-                     max_len=max_len)
-        log.info("keyspace", words=gen.n_words, rules=gen.n_rules,
-                 size=gen.keyspace)
-        # Wordlist contents decide what an index decodes to: fingerprint
-        # the word content, not the file path.
-        attack_desc = (f"wordlist:{gen.content_id()}"
-                       f":rules={rules_id}")
-        # Units aligned to whole words: no candidate is ever rehashed at
-        # unit boundaries on the device path.
-        unit_size = max(gen.n_rules,
-                        (unit_size // gen.n_rules) * gen.n_rules)
-
-    spec = JobSpec(engine=engine.name, device=device, attack=args.attack,
-                   attack_arg=args.attack_arg, keyspace=gen.keyspace,
-                   fingerprint=job_fingerprint(
-                       engine.name, attack_desc, gen.keyspace,
-                       [t.digest for t in hl.targets]))
-
-    # Session / resume
+def _setup_session(args, spec, log: Log):
+    """Returns (session, completed, restored_hits) or None on conflict."""
     session = None
     completed: list = []
     restored_hits: list = []
@@ -174,7 +269,7 @@ def cmd_crack(args, log: Log) -> int:
                 log.error("session file belongs to a different job",
                           theirs=prior.spec.get("fingerprint"),
                           ours=spec.fingerprint)
-                return 2
+                return None
             else:
                 completed = prior.completed
                 restored_hits = prior.hits
@@ -184,34 +279,85 @@ def cmd_crack(args, log: Log) -> int:
         elif prior is not None:
             log.error("session file exists; pass --restore to resume "
                       "or remove it", path=args.session)
-            return 2
+            return None
+    return session, completed, restored_hits
 
+
+def _print_results(found: dict, targets) -> None:
+    from dprf_tpu.runtime.potfile import encode_plain
+    for ti, plain in sorted(found.items()):
+        print(f"{targets[ti].raw}:{encode_plain(plain)}")
+
+
+# ---------------------------------------------------------------------------
+# crack (local)
+
+class _JobSetup:
+    """Everything the crack and serve front-ends share: targets,
+    generator, spec/fingerprint, session state, dispatcher."""
+
+    def __init__(self, engine, hl, gen, max_len, unit_size, spec,
+                 session, completed, restored_hits, dispatcher):
+        self.engine = engine
+        self.hl = hl
+        self.gen = gen
+        self.max_len = max_len
+        self.unit_size = unit_size
+        self.spec = spec
+        self.session = session
+        self.completed = completed
+        self.restored_hits = restored_hits
+        self.dispatcher = dispatcher
+
+
+def _setup_job(args, device: str, log: Log,
+               lease_timeout: Optional[float] = None):
+    """Build the full job state; None means a fatal setup error (already
+    logged).  Single source of truth for the fingerprint and session
+    wiring, so local and distributed jobs can never diverge."""
+    engine = get_engine(args.engine, device="cpu")   # parser/oracle always CPU
+    hl = _load_targets(engine, args.hashfile, log)
+    if hl is None:
+        return None
+
+    gen, attack_desc, max_len = _build_gen(args.attack, args.attack_arg,
+                                           _customs(args), args.rules, None,
+                                           engine, device, log)
+    unit_size = _align_unit_size(args.unit_size, args.attack, gen)
+
+    spec = JobSpec(engine=engine.name, device=device, attack=args.attack,
+                   attack_arg=args.attack_arg, keyspace=gen.keyspace,
+                   fingerprint=job_fingerprint(
+                       engine.name, attack_desc, gen.keyspace,
+                       [t.digest for t in hl.targets]))
+
+    sess = _setup_session(args, spec, log)
+    if sess is None:
+        return None
+    session, completed, restored_hits = sess
+
+    kw = {} if lease_timeout is None else {"lease_timeout": lease_timeout}
     if completed:
         dispatcher = Dispatcher.from_completed(
-            gen.keyspace, unit_size, completed)
+            gen.keyspace, unit_size, completed, **kw)
     else:
-        dispatcher = Dispatcher(gen.keyspace, unit_size)
+        dispatcher = Dispatcher(gen.keyspace, unit_size, **kw)
+    return _JobSetup(engine, hl, gen, max_len, unit_size, spec,
+                     session, completed, restored_hits, dispatcher)
 
-    # Worker selection: each device engine builds its own fused worker
-    # (make_mask_worker), so salted pipelines (PMKID, bcrypt) plug in
-    # the same way the fast unsalted ones do.
-    worker = None
-    maker_name = ("make_mask_worker" if args.attack == "mask"
-                  else "make_wordlist_worker")
-    if device == "jax":
-        try:
-            dev_engine = get_engine(args.engine, device="jax")
-        except KeyError:
-            dev_engine = None
-        if dev_engine is None or not hasattr(dev_engine, maker_name):
-            log.warn("no jax engine for algorithm/attack; using cpu oracle",
-                     engine=args.engine)
-        else:
-            worker = getattr(dev_engine, maker_name)(
-                gen, hl.targets, batch=args.batch,
-                hit_capacity=args.hit_cap, oracle=engine)
-    if worker is None:
-        worker = CpuWorker(engine, gen, hl.targets)
+
+def cmd_crack(args, log: Log) -> int:
+    device = _DEVICE_ALIASES[args.device]
+    job = _setup_job(args, device, log)
+    if job is None:
+        return 2
+    engine, hl, gen = job.engine, job.hl, job.gen
+    session, restored_hits = job.session, job.restored_hits
+    dispatcher, spec = job.dispatcher, job.spec
+
+    worker = _select_worker(args.engine, device, args.attack, gen,
+                            hl.targets, args.batch, args.hit_cap,
+                            engine, args.devices, log)
 
     potfile = None if args.no_potfile else Potfile(args.potfile)
 
@@ -238,9 +384,7 @@ def cmd_crack(args, log: Log) -> int:
     else:
         result = coord.run()
 
-    for ti, plain in sorted(result.found.items()):
-        from dprf_tpu.runtime.potfile import encode_plain
-        print(f"{hl.targets[ti].raw}:{encode_plain(plain)}")
+    _print_results(result.found, hl.targets)
     log.info("job finished",
              found=f"{len(result.found)}/{len(hl.targets)}",
              tested=result.tested, elapsed=f"{result.elapsed:.2f}s",
@@ -248,6 +392,142 @@ def cmd_crack(args, log: Log) -> int:
              exhausted=result.exhausted)
     return 0 if result.found else 1
 
+
+# ---------------------------------------------------------------------------
+# serve / worker (distributed)
+
+def _parse_hostport(s: str) -> tuple:
+    host, _, port = s.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def cmd_serve(args, log: Log) -> int:
+    from dprf_tpu.runtime.rpc import CoordinatorServer, CoordinatorState
+
+    device = _DEVICE_ALIASES[args.device]
+    job_setup = _setup_job(args, device, log,
+                           lease_timeout=args.lease_timeout)
+    if job_setup is None:
+        return 2
+    engine, hl, gen = job_setup.engine, job_setup.hl, job_setup.gen
+    session, restored_hits = job_setup.session, job_setup.restored_hits
+    dispatcher, spec = job_setup.dispatcher, job_setup.spec
+    unit_size, max_len = job_setup.unit_size, job_setup.max_len
+
+    potfile = None if args.no_potfile else Potfile(args.potfile)
+
+    # Everything a worker needs to rebuild the identical job.  max_len
+    # is shipped so worker-side keyspace/packing can't drift from ours.
+    job = {
+        "engine": engine.name,
+        "attack": args.attack,
+        "attack_arg": args.attack_arg,
+        "customs": {str(i): v.hex() for i, v in _customs(args).items()},
+        "rules": args.rules,
+        "max_len": max_len,
+        "targets": [t.raw for t in hl.targets],
+        "keyspace": gen.keyspace,
+        "unit_size": unit_size,
+        "batch": args.batch,
+        "hit_cap": args.hit_cap,
+        "fingerprint": spec.fingerprint,
+    }
+
+    state = CoordinatorState(job, dispatcher, len(hl.targets))
+    if session is not None:
+        session.open(spec.as_dict())
+
+    def on_hit(ti, cand, plain):
+        log.info("cracked", target=hl.targets[ti].raw[:32], lane=cand)
+        if potfile is not None:
+            potfile.add(hl.targets[ti].raw, plain)
+        if session is not None:
+            session.record_hit(ti, cand, plain)
+
+    def on_progress(done, total, nfound):
+        if session is not None:
+            session.record_units(dispatcher.completed_intervals())
+        if not args.quiet:
+            log.info("progress", pct=f"{100.0 * done / total:.2f}%",
+                     found=f"{nfound}/{len(hl.targets)}")
+
+    state.on_hit = on_hit
+    state.on_progress = on_progress
+    for h in restored_hits:
+        try:
+            state.found.setdefault(int(h["target"]),
+                                   bytes.fromhex(h["plaintext"]))
+        except (KeyError, ValueError):
+            continue
+    # Potfile preload, same as Coordinator.preload_found: already-
+    # cracked targets must not cost a keyspace sweep.
+    if potfile is not None:
+        for i, t in enumerate(hl.targets):
+            plain = potfile.get(t.raw)
+            if plain is not None:
+                state.found.setdefault(i, plain)
+        if state.found:
+            log.info("pre-cracked targets", count=len(state.found))
+
+    host, port = _parse_hostport(args.bind)
+    server = CoordinatorServer(state, host, port)
+    log.info("serving job", bind=f"{server.address[0]}:{server.address[1]}",
+             fingerprint=spec.fingerprint, keyspace=gen.keyspace)
+    try:
+        server.serve_until_done()
+    finally:
+        if session is not None:
+            session.snapshot(dispatcher.completed_intervals())
+            session.close()
+    _print_results(state.found, hl.targets)
+    log.info("job finished",
+             found=f"{len(state.found)}/{len(hl.targets)}")
+    return 0 if state.found else 1
+
+
+def cmd_worker(args, log: Log) -> int:
+    import os
+    import socket as _socket
+
+    from dprf_tpu.runtime.rpc import CoordinatorClient, worker_loop
+
+    device = _DEVICE_ALIASES[args.device]
+    host, port = _parse_hostport(args.connect)
+    client = CoordinatorClient(host, port)
+    job = client.call("hello")["job"]
+    log.info("job received", engine=job["engine"], attack=job["attack"],
+             keyspace=job["keyspace"], targets=len(job["targets"]))
+
+    engine = get_engine(job["engine"], device="cpu")
+    targets = [engine.parse_target(raw) for raw in job["targets"]]
+    customs = {int(i): bytes.fromhex(v)
+               for i, v in job.get("customs", {}).items()}
+    gen, attack_desc, _ = _build_gen(job["attack"], job["attack_arg"],
+                                     customs, job.get("rules"),
+                                     job.get("max_len"), engine, device, log)
+    # Recompute the full job fingerprint locally: a wordlist or rules
+    # file that differs in CONTENT (not just size) on this host would
+    # silently leave coverage holes -- the unit ledger marks ranges done
+    # that this worker decoded to different candidates.
+    ours = job_fingerprint(engine.name, attack_desc, gen.keyspace,
+                           [t.digest for t in targets])
+    if ours != job["fingerprint"]:
+        log.error("local job disagrees with coordinator (different "
+                  "wordlist/rules file content on this host?)",
+                  ours=ours, theirs=job["fingerprint"])
+        return 2
+
+    worker = _select_worker(job["engine"], device, job["attack"], gen,
+                            targets, args.batch or job["batch"],
+                            job["hit_cap"], engine, args.devices, log)
+    worker_id = args.id or f"{_socket.gethostname()}:{os.getpid()}"
+    done = worker_loop(client, worker, worker_id, log=log)
+    log.info("worker done", units=done)
+    client.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
 
 def cmd_bench(args, log: Log) -> int:
     import contextlib
@@ -283,22 +563,24 @@ def cmd_keyspace(args, log: Log) -> int:
     return 0
 
 
+_COMMANDS = {
+    "crack": cmd_crack,
+    "serve": cmd_serve,
+    "worker": cmd_worker,
+    "bench": cmd_bench,
+    "engines": cmd_engines,
+    "keyspace": cmd_keyspace,
+}
+
+
 def main(argv: Optional[list] = None) -> int:
     args = _build_parser().parse_args(argv)
     log = Log(quiet=getattr(args, "quiet", False))
     try:
-        if args.command == "crack":
-            return cmd_crack(args, log)
-        if args.command == "bench":
-            return cmd_bench(args, log)
-        if args.command == "engines":
-            return cmd_engines(args, log)
-        if args.command == "keyspace":
-            return cmd_keyspace(args, log)
+        return _COMMANDS[args.command](args, log)
     except (ValueError, KeyError, OSError) as e:
         log.error(str(e))
         return 2
-    return 0
 
 
 if __name__ == "__main__":

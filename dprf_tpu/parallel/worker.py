@@ -15,7 +15,8 @@ from typing import Optional, Sequence
 import numpy as np
 
 from dprf_tpu.engines.base import HashEngine, Target
-from dprf_tpu.runtime.worker import Hit, MaskWorkerBase
+from dprf_tpu.runtime.worker import (CpuWorker, Hit, MaskWorkerBase,
+                                     word_cover_range, wordlist_lane_to_gidx)
 from dprf_tpu.runtime.workunit import WorkUnit
 
 
@@ -50,3 +51,71 @@ class ShardedMaskWorker(MaskWorkerBase):
         for d in range(lanes_np.shape[0]):
             hits.extend(self._decode_lanes(bstart, lanes_np[d], tpos_np[d]))
         return hits
+
+
+class ShardedWordlistWorker(MaskWorkerBase):
+    """Wordlist+rules attack spread over a device mesh.
+
+    Each step covers ``n_dev * word_batch_per_device`` words; chip c
+    expands+hashes its contiguous word slice locally (the packed
+    wordlist is replicated to every chip's HBM once per job).  Hit
+    lanes come back super-batch-flat: lane = r * super_words + global
+    word lane, decoded with the same helper the single-chip worker
+    uses (word_batch = super_words).
+    """
+
+    def __init__(self, engine, gen, targets: Sequence[Target], mesh,
+                 word_batch_per_device: int = 1 << 14,
+                 hit_capacity: int = 64,
+                 oracle: Optional[HashEngine] = None):
+        from dprf_tpu.ops.rules_pipeline import (
+            make_sharded_wordlist_crack_step)
+
+        tgt = self._setup_targets(engine, gen, targets, hit_capacity, oracle)
+        self.mesh = mesh
+        self.step = make_sharded_wordlist_crack_step(
+            engine, gen, tgt, mesh, word_batch_per_device, hit_capacity,
+            widen_utf16=getattr(engine, "widen_utf16", False))
+        self.super_words = self.step.super_words
+        self.stride = self.super_words * gen.n_rules
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        import jax.numpy as jnp
+        R = self.gen.n_rules
+        w_start, w_end = word_cover_range(unit, R)
+        queued = []
+        for ws in range(w_start, w_end, self.super_words):
+            nw = min(self.super_words, w_end - ws, self.gen.n_words - ws)
+            if nw <= 0:
+                break
+            queued.append((ws, nw, self.step(jnp.int32(ws), jnp.int32(nw))))
+        hits: list[Hit] = []
+        for ws, nw, result in queued:
+            total, counts, lanes, tpos = result
+            if int(total) == 0:
+                continue
+            if (np.asarray(counts) > self.hit_capacity).any():
+                hits.extend(self._rescan_words(ws, nw, unit))
+                continue
+            for lane, tp in zip(np.asarray(lanes).ravel(),
+                                np.asarray(tpos).ravel()):
+                if lane < 0:
+                    continue
+                gidx = wordlist_lane_to_gidx(int(lane), ws,
+                                             self.super_words, R)
+                if not unit.start <= gidx < unit.end:
+                    continue
+                ti = int(self._order[int(tp)]) if self.multi else 0
+                hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+    def _rescan_words(self, ws: int, nw: int, unit: WorkUnit) -> list[Hit]:
+        if self.oracle is None:
+            raise RuntimeError(
+                f"hit buffer overflow (> {self.hit_capacity}) and no "
+                "oracle engine to rescan with; raise hit_capacity")
+        R = self.gen.n_rules
+        start = max(unit.start, ws * R)
+        end = min(unit.end, (ws + nw) * R)
+        sub = WorkUnit(-1, start, end - start)
+        return CpuWorker(self.oracle, self.gen, self.targets).process(sub)

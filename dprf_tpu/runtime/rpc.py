@@ -1,0 +1,272 @@
+"""Host-level distributed backend: coordinator RPC + remote workers.
+
+Inside one host/slice, parallelism is XLA collectives over ICI (the
+sharded steps in dprf_tpu/parallel) -- there is no NCCL/MPI analogue to
+manage.  ACROSS hosts, the control plane is deliberately tiny, exactly
+the Dispatcher surface: lease a WorkUnit, report hits, complete.  This
+module is that control plane: newline-delimited JSON over TCP.
+
+    coordinator (dprf serve):  owns Dispatcher + found set + potfile/
+        session persistence; hands out leases under a lock.
+    worker (dprf worker):      connects, receives the job description,
+        rebuilds engine/generator/targets locally, then loops
+        lease -> fused device sweep -> complete(hits).
+
+Fault model: a worker that dies simply stops leasing; its outstanding
+unit's lease expires and the Dispatcher reissues it (idempotent -- units
+are pure functions of the index range).  A worker that reports hits for
+an already-reissued unit is harmless: hits are deduped by target.
+
+Trust model: the protocol is unauthenticated; bind to localhost or a
+trusted network only (same stance as hashtopolis-style agents).  The
+job description includes the raw hashlist lines; wordlist files must
+exist on each worker host (they are referenced by path, never shipped).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Callable, Optional
+
+from dprf_tpu.runtime.dispatcher import Dispatcher
+from dprf_tpu.runtime.worker import Hit
+from dprf_tpu.runtime.workunit import WorkUnit
+
+MAX_LINE = 64 << 20   # hashlists can be large; candidates never cross
+
+
+# ---------------------------------------------------------------------------
+# framing
+
+def send_msg(sock: socket.socket, obj: dict) -> None:
+    sock.sendall(json.dumps(obj, separators=(",", ":")).encode() + b"\n")
+
+
+def recv_msg(fh) -> Optional[dict]:
+    line = fh.readline(MAX_LINE)
+    if not line:
+        return None
+    if not line.endswith(b"\n"):
+        # readline returned MAX_LINE bytes without a newline: reject
+        # loudly instead of parsing a truncated message and desyncing
+        # the framing on whatever bytes remain
+        raise ValueError(f"message exceeds the {MAX_LINE}-byte frame limit")
+    return json.loads(line)
+
+
+# ---------------------------------------------------------------------------
+# coordinator side
+
+class CoordinatorState:
+    """Shared, locked job state behind the RPC handlers."""
+
+    def __init__(self, job: dict, dispatcher: Dispatcher, n_targets: int,
+                 on_hit: Optional[Callable] = None,
+                 on_progress: Optional[Callable] = None):
+        self.job = job                    # serializable job description
+        self.dispatcher = dispatcher
+        self.n_targets = n_targets
+        self.found: dict[int, bytes] = {}
+        self.on_hit = on_hit              # (target_index, cand_index, plain)
+        self.on_progress = on_progress
+        self.lock = threading.Lock()
+        self.t0 = time.perf_counter()
+
+    # -- RPC ops ---------------------------------------------------------
+
+    def op_hello(self, msg: dict) -> dict:
+        return {"ok": True, "job": self.job}
+
+    def op_lease(self, msg: dict) -> dict:
+        with self.lock:
+            if self._stopped():
+                return {"unit": None, "stop": True}
+            unit = self.dispatcher.lease(str(msg.get("worker_id", "?")))
+            if unit is None:
+                # nothing leasable right now; workers retry unless done
+                return {"unit": None,
+                        "stop": self.dispatcher.outstanding_count() == 0}
+            return {"unit": {"id": unit.unit_id, "start": unit.start,
+                             "length": unit.length}}
+
+    def op_complete(self, msg: dict) -> dict:
+        hits = msg.get("hits", [])
+        with self.lock:
+            for h in hits:
+                ti = int(h["target"])
+                if ti in self.found or not 0 <= ti < self.n_targets:
+                    continue
+                plain = bytes.fromhex(h["plaintext"])
+                self.found[ti] = plain
+                if self.on_hit:
+                    self.on_hit(ti, int(h["cand"]), plain)
+            self.dispatcher.complete(int(msg["unit_id"]))
+            if self.on_progress:
+                done, total = self.dispatcher.progress()
+                self.on_progress(done, total, len(self.found))
+            return {"ok": True, "stop": self._stopped()}
+
+    def op_fail(self, msg: dict) -> dict:
+        with self.lock:
+            self.dispatcher.fail(int(msg["unit_id"]))
+        return {"ok": True}
+
+    def op_status(self, msg: dict) -> dict:
+        with self.lock:
+            done, total = self.dispatcher.progress()
+            return {"done": done, "total": total,
+                    "found": len(self.found), "stop": self._stopped(),
+                    "elapsed": time.perf_counter() - self.t0}
+
+    def _stopped(self) -> bool:
+        return (len(self.found) >= self.n_targets
+                or self.dispatcher.done())
+
+    def finished(self) -> bool:
+        with self.lock:
+            return self._stopped()
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        state: CoordinatorState = self.server.state   # type: ignore
+        while True:
+            try:
+                msg = recv_msg(self.rfile)
+            except (ValueError, OSError):
+                return
+            if msg is None:
+                return
+            op = getattr(state, f"op_{msg.get('op', '')}", None)
+            if op is None:
+                resp = {"error": f"unknown op {msg.get('op')!r}"}
+            else:
+                try:
+                    resp = op(msg)
+                except Exception as e:       # defensive: never kill server
+                    resp = {"error": f"{type(e).__name__}: {e}"}
+            try:
+                send_msg(self.connection, resp)
+            except OSError:
+                return
+
+
+class CoordinatorServer:
+    """Threaded TCP server around a CoordinatorState."""
+
+    def __init__(self, state: CoordinatorState, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.allow_reuse_address = True
+        self._srv.state = state            # type: ignore
+        self.state = state
+        self.address = self._srv.server_address
+
+    def serve_until_done(self, poll: float = 0.5,
+                         drain: float = 600.0) -> None:
+        """Run until the job finishes, then keep serving until every
+        outstanding lease resolves (workers mid-unit must be able to
+        report their final hits and see the stop flag -- a fixed grace
+        window would race against unit processing time).  `drain` caps
+        the wait so a worker that died holding a lease can't pin the
+        server forever."""
+        t = threading.Thread(target=self._srv.serve_forever,
+                             kwargs={"poll_interval": 0.1}, daemon=True)
+        t.start()
+        try:
+            while not self.state.finished():
+                time.sleep(poll)
+            deadline = time.monotonic() + drain
+            while time.monotonic() < deadline:
+                with self.state.lock:
+                    # expired leases (dead workers) won't be reaped by
+                    # lease() anymore -- nobody is leasing -- so reap
+                    # here or a dead worker would pin the drain loop
+                    self.state.dispatcher.reap_expired()
+                    outstanding = self.state.dispatcher.outstanding_count()
+                if outstanding == 0:
+                    break
+                time.sleep(poll)
+            time.sleep(poll)   # let final responses flush
+        finally:
+            self._srv.shutdown()
+            self._srv.server_close()
+
+    def start_background(self) -> threading.Thread:
+        t = threading.Thread(target=self._srv.serve_forever,
+                             kwargs={"poll_interval": 0.1}, daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# worker side
+
+class CoordinatorClient:
+    """Blocking JSON-RPC client used by remote workers."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._fh = self._sock.makefile("rb")
+
+    def call(self, op: str, **kw) -> dict:
+        kw["op"] = op
+        send_msg(self._sock, kw)
+        resp = recv_msg(self._fh)
+        if resp is None:
+            raise ConnectionError("coordinator closed the connection")
+        if "error" in resp:
+            raise RuntimeError(f"coordinator error: {resp['error']}")
+        return resp
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def worker_loop(client: CoordinatorClient, worker, worker_id: str,
+                idle_sleep: float = 0.5, log=None) -> int:
+    """Lease -> process -> complete until the coordinator says stop.
+
+    worker: any object with .process(WorkUnit) -> list[Hit] (the same
+    duck type the local Coordinator drives).  Returns units completed.
+    """
+    done_units = 0
+    while True:
+        resp = client.call("lease", worker_id=worker_id)
+        unit_d = resp.get("unit")
+        if unit_d is None:
+            if resp.get("stop"):
+                return done_units
+            time.sleep(idle_sleep)
+            continue
+        unit = WorkUnit(unit_d["id"], unit_d["start"], unit_d["length"])
+        try:
+            hits = worker.process(unit)
+        except Exception:
+            # release the lease for another worker, then surface the bug
+            try:
+                client.call("fail", unit_id=unit.unit_id)
+            except Exception:
+                pass
+            raise
+        payload = [{"target": h.target_index, "cand": h.cand_index,
+                    "plaintext": h.plaintext.hex()} for h in hits]
+        resp = client.call("complete", unit_id=unit.unit_id, hits=payload)
+        done_units += 1
+        if log and hits:
+            log.info("hits reported", count=len(hits))
+        if resp.get("stop"):
+            return done_units

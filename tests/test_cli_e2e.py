@@ -190,3 +190,28 @@ def test_malformed_hashlist_line_skipped(tmp_path, capsys, md5_of):
                        "--device", "cpu", "--no-potfile", "-q"], capsys)
     assert rc == 0
     assert f"{md5_of(b'ok')}:ok" in out
+
+
+def test_crack_mask_multichip(tmp_path, capsys, md5_of):
+    """--devices 8 shards the mask job over the virtual CPU mesh."""
+    hashes = _mk_hashfile(tmp_path, [md5_of(b"pod")])
+    rc, out = run_cli(["crack", "-m", "md5", "-a", "mask", "?l?l?l",
+                       str(hashes), "--device", "tpu", "--devices", "8",
+                       "--no-potfile", "--batch", "512", "-q"], capsys)
+    assert rc == 0
+    assert ":pod" in out
+
+
+def test_crack_wordlist_multichip(tmp_path, capsys):
+    """--devices 8 shards a wordlist+rules job over the mesh."""
+    import hashlib
+    wl = tmp_path / "w.txt"
+    wl.write_bytes(b"alpha\nbravo\nsecret\ndelta\n")
+    hashes = tmp_path / "h.txt"
+    hashes.write_text(hashlib.sha256(b"SECRET").hexdigest() + "\n")
+    rc, out = run_cli(["crack", "-m", "sha256", "-a", "wordlist",
+                       str(wl), str(hashes), "--rules", "toggle",
+                       "--device", "tpu", "--devices", "8",
+                       "--no-potfile", "--batch", "512", "-q"], capsys)
+    assert rc == 0
+    assert ":SECRET" in out

@@ -1,0 +1,179 @@
+"""Coordinator RPC + remote worker loop (runtime/rpc.py, CLI serve/
+worker).  Everything runs in-process over localhost sockets: real
+framing, real threads, fake clock only where lease expiry is tested.
+"""
+
+import hashlib
+import threading
+import time
+
+import pytest
+
+from dprf_tpu.cli import main as cli_main
+from dprf_tpu.engines import get_engine
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.runtime.dispatcher import Dispatcher
+from dprf_tpu.runtime.rpc import (CoordinatorClient, CoordinatorServer,
+                                  CoordinatorState, worker_loop)
+from dprf_tpu.runtime.worker import CpuWorker
+
+
+def _mask_job(mask: str, plants, engine="md5", unit_size=2000):
+    from dprf_tpu.runtime.session import job_fingerprint
+
+    eng = get_engine(engine)
+    gen = MaskGenerator(mask)
+    targets = [eng.parse_target(hashlib.md5(p).hexdigest()) for p in plants]
+    # identical composition to cli._build_gen/_setup_job
+    fp = job_fingerprint(engine, f"mask:{mask}", gen.keyspace,
+                         [t.digest for t in targets])
+    job = {"engine": engine, "attack": "mask", "attack_arg": mask,
+           "customs": {}, "rules": None, "max_len": None,
+           "targets": [t.raw for t in targets], "keyspace": gen.keyspace,
+           "unit_size": unit_size, "batch": 4096, "hit_cap": 8,
+           "fingerprint": fp}
+    return eng, gen, targets, job
+
+
+def _serve(job, gen, targets, lease_timeout=300.0, clock=None):
+    dispatcher = Dispatcher(gen.keyspace, job["unit_size"],
+                            lease_timeout=lease_timeout, clock=clock)
+    state = CoordinatorState(job, dispatcher, len(targets))
+    server = CoordinatorServer(state, "127.0.0.1", 0)
+    server.start_background()
+    return state, server, dispatcher
+
+
+def test_two_workers_crack_everything():
+    eng, gen, targets, job = _mask_job("?l?l?l", [b"cat", b"zzz"])
+    state, server, _ = _serve(job, gen, targets)
+    try:
+        results = []
+
+        def run_worker(wid):
+            client = CoordinatorClient(*server.address)
+            w = CpuWorker(eng, gen, targets)
+            results.append(worker_loop(client, w, wid, idle_sleep=0.01))
+            client.close()
+
+        ts = [threading.Thread(target=run_worker, args=(f"w{i}",))
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert state.finished()
+        assert state.found == {0: b"cat", 1: b"zzz"}
+        # every unit was processed exactly once across the worker pool
+        # ("zzz" is the last candidate, so no early stop): 26^3 / 2000
+        assert len(results) == 2
+        assert sum(results) == -(-gen.keyspace // 2000)
+    finally:
+        server.shutdown()
+
+
+def test_dead_worker_lease_reissued():
+    """A worker that leases a unit and dies must not stall the job: the
+    lease expires and another worker finishes the keyspace."""
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clk = Clock()
+    eng, gen, targets, job = _mask_job("?d?d?d", [b"042"], unit_size=300)
+    state, server, dispatcher = _serve(job, gen, targets,
+                                       lease_timeout=10.0, clock=clk)
+    try:
+        dead = CoordinatorClient(*server.address)
+        resp = dead.call("lease", worker_id="dead")
+        assert resp["unit"] is not None     # leased, never completed
+        dead.close()
+
+        clk.t += 60.0                       # lease expires
+
+        client = CoordinatorClient(*server.address)
+        w = CpuWorker(eng, gen, targets)
+        worker_loop(client, w, "alive", idle_sleep=0.01)
+        client.close()
+        assert state.found == {0: b"042"}
+        # the dead worker's unit [0, 300) was reissued and completed by
+        # the survivor (the job stops early once every target cracks)
+        assert dispatcher.completed_intervals()[0][0] == 0
+        assert dispatcher.completed_intervals()[0][1] >= 300
+    finally:
+        server.shutdown()
+
+
+def test_worker_exception_releases_lease():
+    eng, gen, targets, job = _mask_job("?d?d", [b"77"], unit_size=100)
+    state, server, dispatcher = _serve(job, gen, targets)
+    try:
+        class Boom(Exception):
+            pass
+
+        class BadWorker:
+            def process(self, unit):
+                raise Boom()
+
+        client = CoordinatorClient(*server.address)
+        with pytest.raises(Boom):
+            worker_loop(client, BadWorker(), "bad")
+        client.close()
+        # the failed unit went back on the queue, not into the void
+        client = CoordinatorClient(*server.address)
+        worker_loop(client, CpuWorker(eng, gen, targets), "good",
+                    idle_sleep=0.01)
+        client.close()
+        assert state.found == {0: b"77"}
+    finally:
+        server.shutdown()
+
+
+def test_cli_worker_end_to_end(capsys):
+    """`dprf worker` against a live coordinator: job rebuild from the
+    wire description, device-path worker selection, hit reporting."""
+    eng, gen, targets, job = _mask_job("?l?l?l", [b"dog"])
+    state, server, _ = _serve(job, gen, targets)
+    try:
+        host, port = server.address
+        rc = cli_main(["worker", "--connect", f"{host}:{port}",
+                       "--device", "tpu", "--quiet"])
+        assert rc == 0
+        assert state.found == {0: b"dog"}
+    finally:
+        server.shutdown()
+
+
+def test_cli_worker_fingerprint_mismatch_aborts(tmp_path):
+    """A worker whose local job content fingerprints differently (e.g.
+    divergent wordlist bytes on that host) must refuse to run -- a
+    same-size divergence would otherwise punch silent coverage holes."""
+    eng, gen, targets, job = _mask_job("?l?l?l", [b"dog"])
+    job["fingerprint"] = "0" * 16           # content divergence
+    state, server, _ = _serve(job, gen, targets)
+    try:
+        host, port = server.address
+        rc = cli_main(["worker", "--connect", f"{host}:{port}",
+                       "--device", "cpu", "--quiet"])
+        assert rc == 2
+        assert not state.found
+    finally:
+        server.shutdown()
+
+
+def test_status_op():
+    eng, gen, targets, job = _mask_job("?d?d", [b"11"])
+    state, server, _ = _serve(job, gen, targets)
+    try:
+        client = CoordinatorClient(*server.address)
+        st = client.call("status")
+        assert st["total"] == gen.keyspace and st["done"] == 0
+        worker_loop(client, CpuWorker(eng, gen, targets), "w",
+                    idle_sleep=0.01)
+        st = client.call("status")
+        assert st["done"] == gen.keyspace and st["found"] == 1
+        client.close()
+    finally:
+        server.shutdown()
