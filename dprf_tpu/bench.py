@@ -32,21 +32,23 @@ def run_bench(engine: str = "md5", device: str = "jax",
         fake = bytes([0xFF]) * eng.digest_size
         use_pallas = False
         if impl != "xla":
-            from dprf_tpu.ops import pallas_md5
-            eligible = (engine == "md5" and gen.length <= 55
-                        and pallas_md5.mask_supported(gen.charsets))
+            from dprf_tpu.ops import pallas_mask
+            eligible = pallas_mask.kernel_eligible(engine, gen, 1)
             if impl == "pallas" and not eligible:
                 raise ValueError(
-                    "--impl pallas requires engine md5 and a mask the "
-                    "arithmetic charset decode supports")
+                    "--impl pallas requires a kernel-capable engine "
+                    f"({', '.join(sorted(pallas_mask.CORES))}) and a mask "
+                    "the arithmetic charset decode supports")
             mode = ({"interpret": jax.default_backend() != "tpu"}
-                    if impl == "pallas" else pallas_md5.pallas_mode())
+                    if impl == "pallas" else pallas_mask.pallas_mode())
             if eligible and mode is not None:
-                batch = max(pallas_md5.TILE,
-                            (batch // pallas_md5.TILE) * pallas_md5.TILE)
+                batch = max(pallas_mask.TILE,
+                            (batch // pallas_mask.TILE) * pallas_mask.TILE)
                 import numpy as np
-                step = pallas_md5.make_pallas_mask_crack_step(
-                    gen, np.frombuffer(fake, dtype="<u4").astype(np.uint32),
+                dt = "<u4" if eng.little_endian else ">u4"
+                step = pallas_mask.make_pallas_mask_crack_step(
+                    engine, gen,
+                    np.frombuffer(fake, dtype=dt).astype(np.uint32),
                     batch, **mode)
                 use_pallas = True
         if not use_pallas:

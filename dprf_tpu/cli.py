@@ -453,21 +453,12 @@ def cmd_serve(args, log: Log) -> int:
 
     state.on_hit = on_hit
     state.on_progress = on_progress
-    for h in restored_hits:
-        try:
-            state.found.setdefault(int(h["target"]),
-                                   bytes.fromhex(h["plaintext"]))
-        except (KeyError, ValueError):
-            continue
-    # Potfile preload, same as Coordinator.preload_found: already-
-    # cracked targets must not cost a keyspace sweep.
-    if potfile is not None:
-        for i, t in enumerate(hl.targets):
-            plain = potfile.get(t.raw)
-            if plain is not None:
-                state.found.setdefault(i, plain)
-        if state.found:
-            log.info("pre-cracked targets", count=len(state.found))
+    from dprf_tpu.runtime.coordinator import (preload_potfile,
+                                              restore_hits_into)
+    restore_hits_into(state.found, restored_hits)
+    preload_potfile(state.found, hl.targets, potfile)
+    if state.found:
+        log.info("pre-cracked targets", count=len(state.found))
 
     host, port = _parse_hostport(args.bind)
     server = CoordinatorServer(state, host, port)

@@ -49,7 +49,20 @@ class JaxEngineBase(DeviceHashEngine, HashEngine):
                          oracle=None):
         """Build the fused-pipeline worker for a mask attack on this
         engine.  Engines with special pipelines (PMKID, bcrypt) override
-        this -- it is the CLI's single entry into the device path."""
+        this -- it is the CLI's single entry into the device path.
+
+        Single-target jobs on kernel-capable engines (MD5/SHA-1/NTLM)
+        route to the hand-written Pallas kernel when eligible (see
+        ops/pallas_mask.pallas_mode); anything else uses the generic
+        fused XLA pipeline."""
+        from dprf_tpu.ops.pallas_mask import kernel_eligible, pallas_mode
+        mode = pallas_mode()
+        if mode is not None and kernel_eligible(self.name, gen,
+                                                len(targets)):
+            from dprf_tpu.runtime.worker import PallasMaskWorker
+            return PallasMaskWorker(self, gen, targets, batch=batch,
+                                    hit_capacity=hit_capacity,
+                                    oracle=oracle, **mode)
         from dprf_tpu.runtime.worker import DeviceMaskWorker
         return DeviceMaskWorker(self, gen, targets, batch=batch,
                                 hit_capacity=hit_capacity, oracle=oracle)
@@ -95,23 +108,6 @@ class JaxMd5Engine(JaxEngineBase):
     def digest_packed(self, blocks: jnp.ndarray,
                       lengths=None) -> jnp.ndarray:
         return md5_digest_words(blocks)
-
-    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
-                         oracle=None):
-        """Single-target mask jobs route to the hand-written Pallas
-        kernel when eligible (see ops/pallas_md5.pallas_mode); anything
-        else uses the generic fused XLA pipeline."""
-        from dprf_tpu.ops.pallas_md5 import mask_supported, pallas_mode
-        mode = pallas_mode()
-        if (mode is not None and len(targets) == 1
-                and hasattr(gen, "charsets") and gen.length <= 55
-                and mask_supported(gen.charsets)):
-            from dprf_tpu.runtime.worker import PallasMd5MaskWorker
-            return PallasMd5MaskWorker(self, gen, targets, batch=batch,
-                                       hit_capacity=hit_capacity,
-                                       oracle=oracle, **mode)
-        return super().make_mask_worker(gen, targets, batch, hit_capacity,
-                                        oracle=oracle)
 
 
 @register("sha1", device="jax")

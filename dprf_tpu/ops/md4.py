@@ -19,10 +19,10 @@ def _rotl(x: jnp.ndarray, n: int) -> jnp.ndarray:
     return (x << jnp.uint32(n)) | (x >> jnp.uint32(32 - n))
 
 
-def md4_compress(state: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
-    a, b, c, d = (state[..., i] for i in range(4))
-    m = [words[..., i] for i in range(16)]
-
+def md4_rounds(a, b, c, d, m):
+    """The 48 MD4 steps over any uint32 array shape (no feed-forward).
+    m: sequence of 16 message-word arrays.  Shared by the XLA path and
+    the Pallas kernel (ops/pallas_mask.py)."""
     for i in range(16):
         f = (b & c) | (~b & d)
         a = _rotl(a + f + m[i], _SHIFTS[0][i % 4])
@@ -35,7 +35,12 @@ def md4_compress(state: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
         h = b ^ c ^ d
         a = _rotl(a + h + m[k] + jnp.uint32(0x6ED9EBA1), _SHIFTS[2][i % 4])
         a, b, c, d = d, a, b, c
+    return a, b, c, d
 
+
+def md4_compress(state: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
+    a, b, c, d = md4_rounds(*(state[..., i] for i in range(4)),
+                            [words[..., i] for i in range(16)])
     return jnp.stack([a, b, c, d], axis=-1) + state
 
 

@@ -32,7 +32,7 @@ def md5_rounds(a, b, c, d, m):
     """The 64 MD5 steps over any uint32 array shape (no feed-forward).
 
     m: sequence of 16 message-word arrays.  Shared by the XLA path
-    (md5_compress) and the Pallas kernel (ops/pallas_md5.py) so the
+    (md5_compress) and the Pallas kernel (ops/pallas_mask.py) so the
     round structure has a single source of truth.
     """
     for i in range(64):

@@ -1,14 +1,21 @@
-"""Fused mask->MD5->compare Pallas TPU kernel (benchmark config 1's
-hot loop as a single hand-scheduled kernel).
+"""Fused mask->hash->compare Pallas TPU kernels for the single-block
+unsalted engines (MD5, SHA-1, NTLM).
 
 Why a kernel at all: the XLA path (ops/pipeline.py) materializes the
-candidate block uint8[B, L] and the digest uint32[B, 4] in HBM between
-fusions.  At the throughputs this engine targets, those intermediate
+candidate block uint8[B, L] and the digest uint32[B, W] in HBM between
+fusions.  At the throughputs these engines target, those intermediate
 writes are the bandwidth floor.  This kernel keeps the whole chain --
-mixed-radix decode, charset lookup, message packing, 64 MD5 steps,
-compare, hit reduction -- in VMEM/registers, and writes only TWO int32
-scalars per grid cell (hit count + hit lane) back to HBM: the HBM
-traffic per candidate is ~8/TILE bytes instead of ~(L+16).
+mixed-radix decode, charset lookup, message packing (with UTF-16LE
+widening for NTLM), the full compression rounds, compare, hit
+reduction -- in VMEM/registers, and writes only TWO int32 scalars per
+grid cell (hit count + hit lane) back to HBM: the HBM traffic per
+candidate is ~8/TILE bytes instead of ~(L+4W).
+
+The compression rounds themselves are imported from the same modules
+the XLA path uses (md5_rounds/sha1_rounds/md4_rounds), so there is one
+source of truth per algorithm.  SHA-256 stays on the XLA path: its
+rolling message schedule is written as a fori_loop+concatenate carry
+(see ops/sha256.py) that does not lower to Mosaic.
 
 Design choices forced by the VPU:
 - Charset lookup is arithmetic, not a gather: a charset in digit order
@@ -16,9 +23,9 @@ Design choices forced by the VPU:
   `where` adds (7 segments for ?a, 1 for ?l/?u/?d).  Charsets needing
   more than MAX_SEGMENTS segments fall back to the XLA path.
 - Hit extraction per tile is count + single-lane arithmetic max.  Two
-  hits in one TILE-candidate tile (vanishingly rare below ~2^-40 for
-  random targets; guaranteed visible in the count) force the caller's
-  exact host rescan, so correctness never depends on the rarity.
+  hits in one TILE-candidate tile (vanishingly rare for random
+  targets; always visible in the count) force the caller's exact host
+  rescan, so correctness never depends on the rarity.
 - All lane arithmetic is int32, so a step's batch is capped below 2^31
   candidates (the factory enforces it); larger sweeps are driven as
   multiple steps by the worker, exactly like the XLA path.
@@ -35,13 +42,40 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from dprf_tpu.ops.md5 import INIT, md5_rounds
+from dprf_tpu.ops import md4 as md4_ops
+from dprf_tpu.ops import md5 as md5_ops
+from dprf_tpu.ops import sha1 as sha1_ops
 
 #: sublane count per grid cell; TILE = SUB * 128 candidate lanes.
 SUB = 32
 TILE = SUB * 128
 #: charsets needing more piecewise segments than this use the XLA path.
 MAX_SEGMENTS = 16
+
+
+def _make_core(rounds_fn, init_words):
+    """Wrap a shared rounds function into a kernel digest core:
+    broadcast the initial state, run the rounds, add the Davies-Meyer
+    feed-forward."""
+    def core(m, shape):
+        init = [jnp.uint32(int(w)) for w in init_words]
+        out = rounds_fn(*(jnp.full(shape, w) for w in init), m)
+        return tuple(x + i for x, i in zip(out, init))
+    return core
+
+
+_md5_core = _make_core(md5_ops.md5_rounds, md5_ops.INIT)
+_md4_core = _make_core(md4_ops.md4_rounds, md4_ops.INIT)
+_sha1_core = _make_core(sha1_ops.sha1_rounds, sha1_ops.INIT)
+
+#: engine name -> (rounds core, digest words, big-endian packing,
+#: UTF-16LE widening)
+CORES = {
+    "md5": (_md5_core, 4, False, False),
+    "sha1": (_sha1_core, 5, True, False),
+    "sha-1": (_sha1_core, 5, True, False),
+    "ntlm": (_md4_core, 4, False, True),
+}
 
 
 def pallas_mode() -> Optional[dict]:
@@ -80,6 +114,17 @@ def mask_supported(charsets: Sequence[bytes]) -> bool:
                for cs in charsets)
 
 
+def kernel_eligible(engine_name: str, gen, n_targets: int) -> bool:
+    """One kernel-eligibility predicate for engine selection and bench."""
+    if engine_name not in CORES or n_targets != 1:
+        return False
+    if not hasattr(gen, "charsets"):
+        return False
+    widen = CORES[engine_name][3]
+    max_len = 27 if widen else 55
+    return gen.length <= max_len and mask_supported(gen.charsets)
+
+
 def _decode_byte(digit, segs):
     """Vectorized piecewise charset lookup: digit array -> byte array."""
     byte = digit + segs[0][1]
@@ -88,18 +133,44 @@ def _decode_byte(digit, segs):
     return byte
 
 
-def _build_kernel(radices, seg_tables, length: int, target, sub: int):
+def _pack_message(byts, length: int, shape, big_endian: bool,
+                  widen_utf16: bool):
+    """Candidate bytes -> the 16 padded single-block message words."""
+    def put(m, q, byte):
+        shift = 8 * (3 - q % 4) if big_endian else 8 * (q % 4)
+        m[q // 4] = m[q // 4] | (byte << jnp.uint32(shift))
+
+    m = [jnp.zeros(shape, jnp.uint32) for _ in range(16)]
+    stride = 2 if widen_utf16 else 1        # UTF-16LE: byte p -> pos 2p
+    for p, byte in enumerate(byts):
+        put(m, stride * p, byte)
+    msg_len = stride * length
+    put(m, msg_len, jnp.uint32(0x80))
+    bitlen = jnp.full(shape, jnp.uint32(8 * msg_len))
+    if big_endian:
+        m[15] = bitlen       # 64-bit BE length, low word
+    else:
+        m[14] = bitlen       # 64-bit LE length, low word
+    return m
+
+
+def _build_kernel(engine_name: str, radices, seg_tables, length: int,
+                  target, sub: int):
     """Kernel closure: radices/charset segments/target words are baked
     in as constants (one compile per job, like the XLA step)."""
+    core, n_words, big_endian, widen = CORES[engine_name]
     tile = sub * 128
     # plain python ints: jnp scalars here would be captured closure
     # constants, which pallas_call rejects
     tw = [int(w) for w in target]
+    if len(tw) != n_words:
+        raise ValueError(f"{engine_name}: expected {n_words} target words")
 
     def kernel(base_ref, nvalid_ref, counts_ref, hitlane_ref):
         pid = pl.program_id(0)
-        lane = (jax.lax.broadcasted_iota(jnp.int32, (sub, 128), 0) * 128
-                + jax.lax.broadcasted_iota(jnp.int32, (sub, 128), 1))
+        shape = (sub, 128)
+        lane = (jax.lax.broadcasted_iota(jnp.int32, shape, 0) * 128
+                + jax.lax.broadcasted_iota(jnp.int32, shape, 1))
         # mixed-radix add (base digits + global offset), least
         # significant (rightmost mask position) first, fused with the
         # charset lookup.  The base index of this *tile* is folded into
@@ -111,26 +182,12 @@ def _build_kernel(radices, seg_tables, length: int, target, sub: int):
             s = base_ref[p] + carry
             byts[p] = _decode_byte(s % r, seg_tables[p]).astype(jnp.uint32)
             carry = s // r
-        # pack bytes + Merkle-Damgard padding into the 16 message words
-        m = [jnp.zeros((sub, 128), jnp.uint32) for _ in range(16)]
-        for p in range(length):
-            m[p // 4] = m[p // 4] | (byts[p] << (8 * (p % 4)))
-        m[length // 4] = m[length // 4] | jnp.uint32(0x80 << (8 * (length % 4)))
-        m[14] = jnp.full((sub, 128), jnp.uint32(8 * length))
-        a, b, c, d = md5_rounds(
-            jnp.full((sub, 128), jnp.uint32(int(INIT[0]))),
-            jnp.full((sub, 128), jnp.uint32(int(INIT[1]))),
-            jnp.full((sub, 128), jnp.uint32(int(INIT[2]))),
-            jnp.full((sub, 128), jnp.uint32(int(INIT[3]))),
-            m)
-        a = a + jnp.uint32(int(INIT[0]))
-        b = b + jnp.uint32(int(INIT[1]))
-        c = c + jnp.uint32(int(INIT[2]))
-        d = d + jnp.uint32(int(INIT[3]))
+        m = _pack_message(byts, length, shape, big_endian, widen)
+        digest = core(m, shape)
         valid = (lane + pid * tile) < nvalid_ref[0]
-        found = ((a == jnp.uint32(tw[0])) & (b == jnp.uint32(tw[1]))
-                 & (c == jnp.uint32(tw[2])) & (d == jnp.uint32(tw[3]))
-                 & valid)
+        found = valid
+        for got, want in zip(digest, tw):
+            found = found & (got == jnp.uint32(want))
         counts_ref[0, 0] = jnp.sum(found.astype(jnp.int32))
         # single-hit extraction: max lane among hits (-1 if none); the
         # caller rescans any tile whose count exceeds 1.
@@ -139,8 +196,9 @@ def _build_kernel(radices, seg_tables, length: int, target, sub: int):
     return kernel
 
 
-def make_md5_mask_pallas_fn(gen, target_words: np.ndarray, batch: int,
-                            sub: int = SUB, interpret: bool = False):
+def make_mask_pallas_fn(engine_name: str, gen, target_words: np.ndarray,
+                        batch: int, sub: int = SUB,
+                        interpret: bool = False):
     """Build fn(base_digits int32[L], n_valid int32[1]) ->
     (counts int32[G, 1], hit_lanes int32[G, 1]) over a `batch`-lane
     sweep.  batch must be a multiple of sub*128."""
@@ -149,17 +207,13 @@ def make_md5_mask_pallas_fn(gen, target_words: np.ndarray, batch: int,
         raise ValueError(f"batch {batch} not a multiple of tile {tile}")
     if batch >= 1 << 31:
         raise ValueError("batch must fit in int32 lane arithmetic")
-    if gen.length > 55:
-        raise ValueError("mask longer than the 55-byte single-block "
-                         "limit; use the XLA path")
+    if not kernel_eligible(engine_name, gen, 1):
+        raise ValueError(f"{engine_name} mask job not kernel-eligible; "
+                         "use the XLA path")
     grid = batch // tile
-    charsets = gen.charsets
-    if not mask_supported(charsets):
-        raise ValueError("charset needs too many segments for the "
-                         "arithmetic decode; use the XLA path")
-    seg_tables = [charset_segments(cs) for cs in charsets]
-    kernel = _build_kernel(gen.radices, seg_tables, gen.length,
-                           target_words, sub)
+    seg_tables = [charset_segments(cs) for cs in gen.charsets]
+    kernel = _build_kernel(engine_name, gen.radices, seg_tables,
+                           gen.length, target_words, sub)
     L = gen.length
     return pl.pallas_call(
         kernel,
@@ -182,20 +236,16 @@ def make_md5_mask_pallas_fn(gen, target_words: np.ndarray, batch: int,
     )
 
 
-def make_pallas_mask_crack_step(gen, target_words: np.ndarray, batch: int,
+def make_pallas_mask_crack_step(engine_name: str, gen,
+                                target_words: np.ndarray, batch: int,
                                 hit_capacity: int = 64,
                                 interpret: bool = False):
     """Drop-in replacement for ops/pipeline.make_mask_crack_step on the
-    single-target MD5 path: step(base_digits, n_valid) ->
-    (count, lanes, tpos).
-
-    Tile collisions (2+ hits in one tile) are folded into the overflow
-    convention: the returned count exceeds hit_capacity, which makes
-    the worker fall back to an exact host rescan of the batch.
-    """
+    single-target kernel path: step(base_digits, n_valid) ->
+    (count, lanes, tpos)."""
     tile = SUB * 128
-    fn = make_md5_mask_pallas_fn(gen, target_words, batch,
-                                 interpret=interpret)
+    fn = make_mask_pallas_fn(engine_name, gen, target_words, batch,
+                             interpret=interpret)
 
     @jax.jit
     def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray):

@@ -19,12 +19,11 @@ def _rotl(x: jnp.ndarray, n: int) -> jnp.ndarray:
     return (x << jnp.uint32(n)) | (x >> jnp.uint32(32 - n))
 
 
-def sha1_compress(state: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
-    """state uint32[..., 5] x words uint32[..., 16] (big-endian packed)
-    -> uint32[..., 5]."""
-    a, b, c, d, e = (state[..., i] for i in range(5))
-    w = [words[..., i] for i in range(16)]
-
+def sha1_rounds(a, b, c, d, e, m):
+    """The 80 SHA-1 steps over any uint32 array shape (no feed-forward).
+    m: sequence of 16 message-word arrays.  Shared by the XLA path and
+    the Pallas kernel (ops/pallas_mask.py)."""
+    w = list(m)
     for t in range(80):
         if t >= 16:
             nw = _rotl(w[(t - 3) % 16] ^ w[(t - 8) % 16]
@@ -41,7 +40,14 @@ def sha1_compress(state: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
             f = b ^ c ^ d
         tmp = _rotl(a, 5) + f + e + jnp.uint32(_K[t // 20]) + wt
         a, b, c, d, e = tmp, a, _rotl(b, 30), c, d
+    return a, b, c, d, e
 
+
+def sha1_compress(state: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
+    """state uint32[..., 5] x words uint32[..., 16] (big-endian packed)
+    -> uint32[..., 5]."""
+    a, b, c, d, e = sha1_rounds(*(state[..., i] for i in range(5)),
+                                [words[..., i] for i in range(16)])
     # Davies-Meyer feed-forward: add the *input* chaining state (not
     # INIT -- they only coincide on the first block; HMAC chains).
     return jnp.stack([a, b, c, d, e], axis=-1) + state

@@ -15,8 +15,8 @@ from typing import Optional, Sequence
 import numpy as np
 
 from dprf_tpu.engines.base import HashEngine, Target
-from dprf_tpu.runtime.worker import (CpuWorker, Hit, MaskWorkerBase,
-                                     word_cover_range, wordlist_lane_to_gidx)
+from dprf_tpu.runtime.worker import (Hit, MaskWorkerBase,
+                                     WordlistWorkerBase, word_cover_range)
 from dprf_tpu.runtime.workunit import WorkUnit
 
 
@@ -53,15 +53,15 @@ class ShardedMaskWorker(MaskWorkerBase):
         return hits
 
 
-class ShardedWordlistWorker(MaskWorkerBase):
+class ShardedWordlistWorker(WordlistWorkerBase):
     """Wordlist+rules attack spread over a device mesh.
 
     Each step covers ``n_dev * word_batch_per_device`` words; chip c
     expands+hashes its contiguous word slice locally (the packed
     wordlist is replicated to every chip's HBM once per job).  Hit
     lanes come back super-batch-flat: lane = r * super_words + global
-    word lane, decoded with the same helper the single-chip worker
-    uses (word_batch = super_words).
+    word lane, so the shared decode applies with word_batch =
+    super_words.
     """
 
     def __init__(self, engine, gen, targets: Sequence[Target], mesh,
@@ -76,13 +76,12 @@ class ShardedWordlistWorker(MaskWorkerBase):
         self.step = make_sharded_wordlist_crack_step(
             engine, gen, tgt, mesh, word_batch_per_device, hit_capacity,
             widen_utf16=getattr(engine, "widen_utf16", False))
-        self.super_words = self.step.super_words
+        self.word_batch = self.super_words = self.step.super_words
         self.stride = self.super_words * gen.n_rules
 
     def process(self, unit: WorkUnit) -> list[Hit]:
         import jax.numpy as jnp
-        R = self.gen.n_rules
-        w_start, w_end = word_cover_range(unit, R)
+        w_start, w_end = word_cover_range(unit, self.gen.n_rules)
         queued = []
         for ws in range(w_start, w_end, self.super_words):
             nw = min(self.super_words, w_end - ws, self.gen.n_words - ws)
@@ -97,25 +96,7 @@ class ShardedWordlistWorker(MaskWorkerBase):
             if (np.asarray(counts) > self.hit_capacity).any():
                 hits.extend(self._rescan_words(ws, nw, unit))
                 continue
-            for lane, tp in zip(np.asarray(lanes).ravel(),
-                                np.asarray(tpos).ravel()):
-                if lane < 0:
-                    continue
-                gidx = wordlist_lane_to_gidx(int(lane), ws,
-                                             self.super_words, R)
-                if not unit.start <= gidx < unit.end:
-                    continue
-                ti = int(self._order[int(tp)]) if self.multi else 0
-                hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+            hits.extend(self._collect_word_hits(
+                np.asarray(lanes).ravel(), np.asarray(tpos).ravel(),
+                ws, unit))
         return hits
-
-    def _rescan_words(self, ws: int, nw: int, unit: WorkUnit) -> list[Hit]:
-        if self.oracle is None:
-            raise RuntimeError(
-                f"hit buffer overflow (> {self.hit_capacity}) and no "
-                "oracle engine to rescan with; raise hit_capacity")
-        R = self.gen.n_rules
-        start = max(unit.start, ws * R)
-        end = min(unit.end, (ws + nw) * R)
-        sub = WorkUnit(-1, start, end - start)
-        return CpuWorker(self.oracle, self.gen, self.targets).process(sub)

@@ -46,6 +46,29 @@ class JobResult:
         return self.tested / self.elapsed if self.elapsed > 0 else 0.0
 
 
+def preload_potfile(found: dict, targets: Sequence[Target],
+                    potfile) -> None:
+    """Seed `found` with targets the potfile already cracked, so no
+    keyspace is spent rediscovering them.  Shared by the local
+    Coordinator and the distributed CoordinatorState (cli.cmd_serve)."""
+    if potfile is None:
+        return
+    for i, t in enumerate(targets):
+        plain = potfile.get(t.raw)
+        if plain is not None:
+            found.setdefault(i, plain)
+
+
+def restore_hits_into(found: dict, hits: list) -> None:
+    """Seed `found` from a session journal's hit records (tolerant of
+    malformed entries).  Shared by local and distributed resume paths."""
+    for h in hits:
+        try:
+            found.setdefault(int(h["target"]), bytes.fromhex(h["plaintext"]))
+        except (KeyError, ValueError):
+            continue
+
+
 class Coordinator:
     def __init__(self, spec: JobSpec, targets: Sequence[Target],
                  dispatcher: Dispatcher, worker,
@@ -68,19 +91,10 @@ class Coordinator:
     def preload_found(self) -> None:
         """Mark targets already cracked (potfile) or recorded in a resumed
         session so work stops early / never starts."""
-        if self.potfile is not None:
-            for i, t in enumerate(self.targets):
-                plain = self.potfile.get(t.raw)
-                if plain is not None:
-                    self.found.setdefault(i, plain)
+        preload_potfile(self.found, self.targets, self.potfile)
 
     def restore_hits(self, hits: list) -> None:
-        for h in hits:
-            try:
-                self.found.setdefault(int(h["target"]),
-                                      bytes.fromhex(h["plaintext"]))
-            except (KeyError, ValueError):
-                continue
+        restore_hits_into(self.found, hits)
 
     # -- the run loop ----------------------------------------------------
 

@@ -160,10 +160,18 @@ class CoordinatorServer:
 
     def __init__(self, state: CoordinatorState, host: str = "127.0.0.1",
                  port: int = 0):
+        # bind manually so allow_reuse_address is set BEFORE bind():
+        # otherwise a restart on the same port trips over TIME_WAIT
         self._srv = socketserver.ThreadingTCPServer(
-            (host, port), _Handler, bind_and_activate=True)
+            (host, port), _Handler, bind_and_activate=False)
         self._srv.daemon_threads = True
         self._srv.allow_reuse_address = True
+        try:
+            self._srv.server_bind()
+            self._srv.server_activate()
+        except BaseException:
+            self._srv.server_close()
+            raise
         self._srv.state = state            # type: ignore
         self.state = state
         self.address = self._srv.server_address

@@ -155,7 +155,41 @@ class MaskWorkerBase:
         return self._decode_lanes(bstart, np.asarray(lanes), np.asarray(tpos))
 
 
-class DeviceWordlistWorker(MaskWorkerBase):
+class WordlistWorkerBase(MaskWorkerBase):
+    """Wordlist-specific hit decoding + rescan shared by the single-
+    device and sharded wordlist workers.  Subclasses set
+    ``self.word_batch`` (words per step, = the step's flat-lane stride
+    divisor) before using these."""
+
+    def _collect_word_hits(self, lanes_np, tpos_np, ws: int,
+                           unit: WorkUnit) -> list[Hit]:
+        """Flat rule-major step lanes -> in-unit Hit records."""
+        R = self.gen.n_rules
+        hits: list[Hit] = []
+        for lane, tp in zip(lanes_np, tpos_np):
+            if lane < 0:
+                continue
+            gidx = wordlist_lane_to_gidx(int(lane), ws,
+                                         self.word_batch, R)
+            if not unit.start <= gidx < unit.end:
+                continue
+            ti = int(self._order[int(tp)]) if self.multi else 0
+            hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+    def _rescan_words(self, ws: int, nw: int, unit: WorkUnit) -> list[Hit]:
+        if self.oracle is None:
+            raise RuntimeError(
+                f"hit buffer overflow (> {self.hit_capacity}) and no "
+                "oracle engine to rescan with; raise hit_capacity")
+        R = self.gen.n_rules
+        start = max(unit.start, ws * R)
+        end = min(unit.end, (ws + nw) * R)
+        sub = WorkUnit(-1, start, end - start)
+        return CpuWorker(self.oracle, self.gen, self.targets).process(sub)
+
+
+class DeviceWordlistWorker(WordlistWorkerBase):
     """Fused-pipeline worker for wordlist+rules attacks (config 3).
 
     Units are keyspace index ranges over words x rules (index = word *
@@ -179,8 +213,7 @@ class DeviceWordlistWorker(MaskWorkerBase):
 
     def process(self, unit: WorkUnit) -> list[Hit]:
         import jax.numpy as jnp
-        R = self.gen.n_rules
-        w_start, w_end = word_cover_range(unit, R)
+        w_start, w_end = word_cover_range(unit, self.gen.n_rules)
         queued = []
         for ws in range(w_start, w_end, self.word_batch):
             nw = min(self.word_batch, w_end - ws, self.gen.n_words - ws)
@@ -196,33 +229,16 @@ class DeviceWordlistWorker(MaskWorkerBase):
             if count > self.hit_capacity:
                 hits.extend(self._rescan_words(ws, nw, unit))
                 continue
-            for lane, tp in zip(np.asarray(lanes), np.asarray(tpos)):
-                if lane < 0:
-                    continue
-                gidx = wordlist_lane_to_gidx(int(lane), ws,
-                                             self.word_batch, R)
-                if not unit.start <= gidx < unit.end:
-                    continue
-                ti = int(self._order[int(tp)]) if self.multi else 0
-                hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+            hits.extend(self._collect_word_hits(
+                np.asarray(lanes), np.asarray(tpos), ws, unit))
         return hits
 
-    def _rescan_words(self, ws: int, nw: int, unit: WorkUnit) -> list[Hit]:
-        if self.oracle is None:
-            raise RuntimeError(
-                f"hit buffer overflow (> {self.hit_capacity}) and no "
-                "oracle engine to rescan with; raise hit_capacity")
-        R = self.gen.n_rules
-        start = max(unit.start, ws * R)
-        end = min(unit.end, (ws + nw) * R)
-        sub = WorkUnit(-1, start, end - start)
-        return CpuWorker(self.oracle, self.gen, self.targets).process(sub)
 
-
-class PallasMd5MaskWorker(MaskWorkerBase):
-    """Mask worker over the hand-written Pallas MD5 kernel
-    (ops/pallas_md5.py) -- the single-target fast path where the whole
-    decode->hash->compare->reduce chain stays in VMEM.
+class PallasMaskWorker(MaskWorkerBase):
+    """Mask worker over the hand-written Pallas kernels
+    (ops/pallas_mask.py: MD5, SHA-1, NTLM) -- the single-target fast
+    path where the whole decode->hash->compare->reduce chain stays in
+    VMEM.
 
     Same hit-buffer interface as DeviceMaskWorker; tile collisions
     surface as count > hit_capacity, which reuses the exact-rescan
@@ -233,8 +249,8 @@ class PallasMd5MaskWorker(MaskWorkerBase):
                  batch: int = 1 << 18, hit_capacity: int = 64,
                  oracle: Optional[HashEngine] = None,
                  interpret: bool = False):
-        from dprf_tpu.ops.pallas_md5 import (TILE,
-                                             make_pallas_mask_crack_step)
+        from dprf_tpu.ops.pallas_mask import (TILE,
+                                              make_pallas_mask_crack_step)
 
         tgt = self._setup_targets(engine, gen, targets, hit_capacity, oracle)
         if self.multi:
@@ -242,7 +258,8 @@ class PallasMd5MaskWorker(MaskWorkerBase):
         batch = max(TILE, (batch // TILE) * TILE)
         self.batch = self.stride = batch
         self.step = make_pallas_mask_crack_step(
-            gen, np.asarray(tgt), batch, hit_capacity, interpret=interpret)
+            engine.name, gen, np.asarray(tgt), batch, hit_capacity,
+            interpret=interpret)
 
 
 class DeviceMaskWorker(MaskWorkerBase):

@@ -14,10 +14,10 @@ import pytest
 
 from dprf_tpu.engines import get_engine
 from dprf_tpu.generators.mask import BUILTIN_CHARSETS, MaskGenerator
-from dprf_tpu.ops.pallas_md5 import (MAX_SEGMENTS, TILE, charset_segments,
+from dprf_tpu.ops.pallas_mask import (MAX_SEGMENTS, TILE, charset_segments,
                                      make_pallas_mask_crack_step,
                                      mask_supported)
-from dprf_tpu.runtime.worker import PallasMd5MaskWorker
+from dprf_tpu.runtime.worker import PallasMaskWorker
 from dprf_tpu.runtime.workunit import WorkUnit
 
 
@@ -39,17 +39,31 @@ def test_charset_segments_reconstruct():
     assert mask_supported(list(BUILTIN_CHARSETS.values()))
 
 
+def _engine_target(engine_name: str, plain: bytes) -> np.ndarray:
+    """Target digest words in the engine's layout, via hashlib oracles."""
+    if engine_name == "md5":
+        d, dt = hashlib.md5(plain).digest(), "<u4"
+    elif engine_name == "sha1":
+        d, dt = hashlib.sha1(plain).digest(), ">u4"
+    else:   # ntlm: MD4 over UTF-16LE
+        from dprf_tpu.engines.cpu.md4 import md4
+        d, dt = md4(plain.decode("latin-1").encode("utf-16-le")), "<u4"
+    return np.frombuffer(d, dtype=dt).astype(np.uint32)
+
+
+@pytest.mark.parametrize("engine", ["md5", "sha1", "ntlm"])
 @pytest.mark.parametrize("mask,plant", [
     ("?l?l?l?l", b"crab"),
     ("?d?d?d?d?d", b"90210"),
     ("?a?a?a", b"X& "),
     ("pre?l?d", b"prez7"),      # literals + mixed charsets
 ])
-def test_kernel_finds_planted(mask, plant):
+def test_kernel_finds_planted(engine, mask, plant):
     gen = MaskGenerator(mask)
     pidx = gen.index_of(plant)
-    step = make_pallas_mask_crack_step(gen, _target(plant), batch=TILE,
-                                       interpret=True)
+    step = make_pallas_mask_crack_step(engine, gen,
+                                       _engine_target(engine, plant),
+                                       batch=TILE, interpret=True)
     base = TILE * (pidx // TILE)
     n_valid = min(TILE, gen.keyspace - base)
     bd = jnp.asarray(gen.digits(base), dtype=jnp.int32)
@@ -66,7 +80,7 @@ def test_tile_collision_forces_rescan_convention():
     must return count > hit_capacity (the worker then rescans exactly).
     Driven directly through reduce_tile_hits: an MD5 collision can't be
     fabricated, but the kernel's counts output can."""
-    from dprf_tpu.ops.pallas_md5 import reduce_tile_hits
+    from dprf_tpu.ops.pallas_mask import reduce_tile_hits
 
     cap = 8
     # tile 3 holds two hits; only lane 7 was extractable
@@ -92,7 +106,7 @@ def test_worker_rescan_on_fabricated_collision():
     plant = b"wasp"
     eng = get_engine("md5", device="jax")
     targets = [eng.parse_target(hashlib.md5(plant).hexdigest())]
-    worker = PallasMd5MaskWorker(eng, gen, targets, batch=TILE,
+    worker = PallasMaskWorker(eng, gen, targets, batch=TILE,
                                  hit_capacity=8,
                                  oracle=get_engine("md5"), interpret=True)
     real_step = worker.step
@@ -108,15 +122,17 @@ def test_worker_rescan_on_fabricated_collision():
         [(gen.index_of(plant), plant)]
 
 
-def test_pallas_worker_matches_xla_worker():
+@pytest.mark.parametrize("engine", ["md5", "sha1", "ntlm"])
+def test_pallas_worker_matches_xla_worker(engine):
     gen = MaskGenerator("?l?l?l?l")
     plant = b"wasp"
-    eng = get_engine("md5", device="jax")
-    targets = [eng.parse_target(hashlib.md5(plant).hexdigest())]
-    oracle = get_engine("md5")
-    pworker = PallasMd5MaskWorker(eng, gen, targets, batch=TILE,
-                                  hit_capacity=8, oracle=oracle,
-                                  interpret=True)
+    eng = get_engine(engine, device="jax")
+    targets = [eng.parse_target(_engine_target(engine, plant).astype(
+        "<u4" if eng.little_endian else ">u4").tobytes().hex())]
+    oracle = get_engine(engine)
+    pworker = PallasMaskWorker(eng, gen, targets, batch=TILE,
+                               hit_capacity=8, oracle=oracle,
+                               interpret=True)
     unit = WorkUnit(0, 0, gen.keyspace)
     phits = pworker.process(unit)
     xworker = eng.make_mask_worker(gen, targets, batch=1 << 14,
@@ -125,3 +141,22 @@ def test_pallas_worker_matches_xla_worker():
     assert [(h.target_index, h.cand_index, h.plaintext) for h in phits] == \
         [(h.target_index, h.cand_index, h.plaintext) for h in xhits]
     assert phits[0].plaintext == plant
+
+
+def test_make_mask_worker_routes_to_kernel(monkeypatch):
+    """With DPRF_PALLAS=1 a single-target sha1 mask job must select the
+    kernel worker; a multi-target one must not."""
+    monkeypatch.setenv("DPRF_PALLAS", "1")
+    gen = MaskGenerator("?l?l?l")
+    eng = get_engine("sha1", device="jax")
+    t1 = eng.parse_target(hashlib.sha1(b"abc").hexdigest())
+    t2 = eng.parse_target(hashlib.sha1(b"xyz").hexdigest())
+    w1 = eng.make_mask_worker(gen, [t1], batch=TILE, hit_capacity=8)
+    assert isinstance(w1, PallasMaskWorker)
+    w2 = eng.make_mask_worker(gen, [t1, t2], batch=TILE, hit_capacity=8)
+    assert not isinstance(w2, PallasMaskWorker)
+    # sha256 has no kernel core: always the XLA pipeline
+    e256 = get_engine("sha256", device="jax")
+    t3 = e256.parse_target(hashlib.sha256(b"abc").hexdigest())
+    w3 = e256.make_mask_worker(gen, [t3], batch=TILE, hit_capacity=8)
+    assert not isinstance(w3, PallasMaskWorker)
