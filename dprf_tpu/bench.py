@@ -105,3 +105,127 @@ def run_bench(engine: str = "md5", device: str = "jax",
         "elapsed_s": round(elapsed, 3),
         "compile_s": round(compile_s, 1),
     }
+
+
+# ---------------------------------------------------------------------------
+# the five BASELINE.json acceptance workloads, measured through the
+# REAL worker paths (engine.make_*_worker + worker.process), so the
+# number includes candidate generation, compare, and hit readback --
+# what a job sustains, not a stripped kernel.
+
+def _unmatchable(engine) -> str:
+    """A parseable target line no in-keyspace candidate can produce."""
+    return "ff" * engine.digest_size
+
+
+def _fake_bcrypt_line(cost: int) -> str:
+    from dprf_tpu.engines.cpu.bcrypt import b64_encode
+    salt = bytes(range(16))
+    digest = bytes((7 * i + 3) % 256 for i in range(23))
+    return (f"$2b${cost:02d}$" + b64_encode(salt)[:22]
+            + b64_encode(digest)[:31])
+
+
+def _fake_pmkid_line() -> str:
+    pmkid = bytes((5 * i + 1) % 256 for i in range(16))
+    return f"{pmkid.hex()}*0a1b2c3d4e5f*a0b1c2d3e4f5*{b'benchnet'.hex()}"
+
+
+def _synthetic_words(n: int, length: int = 8) -> list:
+    """Deterministic pseudo-wordlist (no RNG, no file I/O)."""
+    alpha = b"abcdefghijklmnopqrstuvwxyz"
+    out = []
+    x = 12345
+    for _ in range(n):
+        x = (1103515245 * x + 12345) & 0x7FFFFFFF
+        out.append(bytes(alpha[(x >> (3 * j)) % 26] for j in range(length)))
+    return out
+
+
+def _config_job(n: int, bcrypt_cost: int):
+    """config number -> (engine_name, attack, generator, target lines)."""
+    from dprf_tpu.generators.mask import MaskGenerator
+    from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+    from dprf_tpu.rules.parser import load_rules
+
+    if n == 1:     # MD5 single-hash, 6-char lowercase mask
+        return "md5", "mask", MaskGenerator("?l?l?l?l?l?l"), None
+    if n == 2:     # NTLM 1k-hash list, 7-char ?a mask, multi-target
+        lines = ["%032x" % ((0x9E3779B97F4A7C15 * (i + 1)) & ((1 << 128) - 1))
+                 for i in range(1000)]
+        return "ntlm", "mask", MaskGenerator("?a?a?a?a?a?a?a"), lines
+    if n == 3:     # SHA-256 wordlist + best64, on-device rule expansion
+        gen = WordlistRulesGenerator(_synthetic_words(1 << 17),
+                                     load_rules("best64"))
+        return "sha256", "wordlist", gen, None
+    if n == 4:     # bcrypt wordlist, memory-hard path
+        gen = WordlistRulesGenerator(_synthetic_words(1 << 12))
+        return "bcrypt", "wordlist", gen, [_fake_bcrypt_line(bcrypt_cost)]
+    if n == 5:     # WPA2-PMKID iterated-KDF sweep (8-char passphrases)
+        return "wpa2-pmkid", "mask", MaskGenerator("?l?l?l?l?l?l?l?l"), \
+            [_fake_pmkid_line()]
+    raise ValueError(f"unknown config {n} (1-5)")
+
+
+def run_config(config: int, device: str = "jax", seconds: float = 5.0,
+               batch: int = 1 << 18, bcrypt_cost: int = 12,
+               log=None) -> dict:
+    """Measure one acceptance workload end to end.  Returns the same
+    JSON shape as run_bench, plus the config number."""
+    import time as _time
+
+    from dprf_tpu.runtime.worker import CpuWorker
+    from dprf_tpu.runtime.workunit import WorkUnit
+
+    engine_name, attack, gen, lines = _config_job(config, bcrypt_cost)
+    oracle = get_engine(engine_name, device="cpu")
+    targets = [oracle.parse_target(s)
+               for s in (lines or [_unmatchable(oracle)])]
+    if device == "jax":
+        eng = get_engine(engine_name, device="jax")
+        maker = ("make_mask_worker" if attack == "mask"
+                 else "make_wordlist_worker")
+        worker = getattr(eng, maker)(gen, targets, batch=batch,
+                                     hit_capacity=64, oracle=oracle)
+        stride = worker.stride
+    else:
+        worker = CpuWorker(oracle, gen, targets)
+        stride = min(1 << 12, gen.keyspace)
+
+    # warmup/compile on one stride
+    t0 = _time.perf_counter()
+    worker.process(WorkUnit(-1, 0, min(stride, gen.keyspace)))
+    compile_s = _time.perf_counter() - t0
+    if log:
+        log.info("config compiled", config=config,
+                 seconds=f"{compile_s:.1f}")
+
+    tested = 0
+    start = 0
+    t0 = _time.perf_counter()
+    while _time.perf_counter() - t0 < seconds:
+        length = min(stride, gen.keyspace - start)
+        if length <= 0:
+            start = 0
+            continue
+        worker.process(WorkUnit(-1, start, length))
+        tested += length
+        start += length
+    elapsed = _time.perf_counter() - t0
+
+    import jax as _jax
+    platform = (_jax.devices()[0].platform if device == "jax" else "cpu")
+    return {
+        "metric": f"config{config} {engine_name} candidates/sec/chip",
+        "value": tested / elapsed,
+        "unit": "H/s",
+        "config": config,
+        "engine": engine_name,
+        "attack": attack,
+        "targets": len(targets),
+        "device": platform,
+        "batch": batch,
+        "tested": tested,
+        "elapsed_s": round(elapsed, 3),
+        "compile_s": round(compile_s, 1),
+    }

@@ -105,7 +105,13 @@ def _build_parser() -> argparse.ArgumentParser:
     b.add_argument("--seconds", type=float, default=5.0)
     b.add_argument("--impl", default="auto", choices=["auto", "xla", "pallas"],
                    help="force the generic XLA pipeline or the Pallas "
-                   "kernel (md5) instead of automatic selection")
+                   "kernel instead of automatic selection")
+    b.add_argument("--config", type=int, default=None, metavar="N",
+                   help="measure acceptance workload N (1-5, see "
+                   "BASELINE.md) through the real worker path instead "
+                   "of the raw engine loop")
+    b.add_argument("--bcrypt-cost", type=int, default=12,
+                   help="cost for --config 4 (lower it off-TPU)")
     b.add_argument("--profile", default=None, metavar="DIR")
     b.add_argument("--quiet", "-q", action="store_true")
 
@@ -523,16 +529,22 @@ def cmd_worker(args, log: Log) -> int:
 def cmd_bench(args, log: Log) -> int:
     import contextlib
     import json
-    from dprf_tpu.bench import run_bench
+    from dprf_tpu.bench import run_bench, run_config
     ctx = contextlib.nullcontext()
     if args.profile:
         import jax
         ctx = jax.profiler.trace(args.profile)
     with ctx:
-        res = run_bench(engine=args.engine,
-                        device=_DEVICE_ALIASES[args.device],
-                        mask=args.mask, batch=args.batch,
-                        seconds=args.seconds, impl=args.impl, log=log)
+        if args.config is not None:
+            res = run_config(args.config,
+                             device=_DEVICE_ALIASES[args.device],
+                             seconds=args.seconds, batch=args.batch,
+                             bcrypt_cost=args.bcrypt_cost, log=log)
+        else:
+            res = run_bench(engine=args.engine,
+                            device=_DEVICE_ALIASES[args.device],
+                            mask=args.mask, batch=args.batch,
+                            seconds=args.seconds, impl=args.impl, log=log)
     print(json.dumps(res))
     return 0
 
