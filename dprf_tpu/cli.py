@@ -21,6 +21,7 @@ from dprf_tpu.generators.mask import MaskGenerator
 from dprf_tpu.runtime.coordinator import Coordinator, JobSpec
 from dprf_tpu.runtime.dispatcher import Dispatcher
 from dprf_tpu.runtime.potfile import Potfile
+from dprf_tpu.runtime.rpc import RpcError
 from dprf_tpu.runtime.session import SessionJournal, job_fingerprint
 from dprf_tpu.runtime.worker import CpuWorker
 from dprf_tpu.utils.hashlist import load_hashlist
@@ -83,6 +84,9 @@ def _build_parser() -> argparse.ArgumentParser:
     s.add_argument("--lease-timeout", type=float, default=300.0,
                    help="seconds before a silent worker's unit is "
                    "reissued")
+    s.add_argument("--token", default=None,
+                   help="shared secret workers must prove on connect "
+                   "(default: $DPRF_TOKEN; unset = unauthenticated)")
 
     w = sub.add_parser("worker", help="process WorkUnits for a "
                        "`dprf serve` coordinator")
@@ -95,6 +99,9 @@ def _build_parser() -> argparse.ArgumentParser:
                    "ledger (default: host:pid)")
     w.add_argument("--batch", type=int, default=None,
                    help="override the job's device batch size")
+    w.add_argument("--token", default=None,
+                   help="shared secret for an authenticated coordinator "
+                   "(default: $DPRF_TOKEN)")
     w.add_argument("--quiet", "-q", action="store_true")
 
     b = sub.add_parser("bench", help="measure engine throughput")
@@ -138,16 +145,20 @@ def _customs(args) -> dict:
 # job construction (shared by crack / serve / worker)
 
 def _wordlist_max_len(engine_name: str, engine, device: str) -> int:
-    """The 55-byte single-block limit only binds on the device packer; a
-    CPU-oracle job keeps the engine's own limit (e.g. 63-byte WPA
-    passphrases)."""
+    """The 55-byte single-block limit binds only on device engines whose
+    packer lays words out as single-block uint32 messages (the
+    digest_packed fast path).  bcrypt's device path packs its own uint8
+    tables with no single-block constraint, so it keeps the engine's own
+    72-byte limit; CPU-oracle jobs keep the engine limit too (e.g.
+    63-byte WPA passphrases)."""
     if device == "jax":
         try:
-            if hasattr(get_engine(engine_name, device="jax"),
-                       "make_wordlist_worker"):
-                return min(55, engine.max_candidate_len)
+            dev = get_engine(engine_name, device="jax")
         except KeyError:
-            pass
+            return engine.max_candidate_len
+        if (hasattr(dev, "make_wordlist_worker")
+                and hasattr(dev, "digest_packed")):
+            return min(55, engine.max_candidate_len)
     return engine.max_candidate_len
 
 
@@ -439,7 +450,21 @@ def cmd_serve(args, log: Log) -> int:
         "fingerprint": spec.fingerprint,
     }
 
-    state = CoordinatorState(job, dispatcher, len(hl.targets))
+    def verify_hit(ti, plain):
+        # Re-hash with the coordinator's CPU oracle before accepting: a
+        # worker with a divergent device path must not poison the
+        # potfile or halt the search for a target it did not crack.
+        if engine.verify(plain, hl.targets[ti]):
+            return True
+        log.warn("rejected unverifiable hit", target=hl.targets[ti].raw[:32])
+        return False
+
+    import os as _os
+    token = args.token or _os.environ.get("DPRF_TOKEN") or None
+    state = CoordinatorState(job, dispatcher, len(hl.targets),
+                             verifier=verify_hit, token=token)
+    if token:
+        log.info("worker authentication enabled")
     if session is not None:
         session.open(spec.as_dict())
 
@@ -490,8 +515,9 @@ def cmd_worker(args, log: Log) -> int:
 
     device = _DEVICE_ALIASES[args.device]
     host, port = _parse_hostport(args.connect)
-    client = CoordinatorClient(host, port)
-    job = client.call("hello")["job"]
+    token = args.token or os.environ.get("DPRF_TOKEN") or None
+    client = CoordinatorClient(host, port, token=token)
+    job = client.hello()["job"]
     log.info("job received", engine=job["engine"], attack=job["attack"],
              keyspace=job["keyspace"], targets=len(job["targets"]))
 
@@ -518,6 +544,9 @@ def cmd_worker(args, log: Log) -> int:
                             targets, args.batch or job["batch"],
                             job["hit_cap"], engine, args.devices, log)
     worker_id = args.id or f"{_socket.gethostname()}:{os.getpid()}"
+    # worker_loop exits cleanly if the coordinator closes at a lease
+    # boundary (drained job); a close mid-complete propagates as an
+    # error so a coordinator crash cannot read as success.
     done = worker_loop(client, worker, worker_id, log=log)
     log.info("worker done", units=done)
     client.close()
@@ -581,7 +610,7 @@ def main(argv: Optional[list] = None) -> int:
     log = Log(quiet=getattr(args, "quiet", False))
     try:
         return _COMMANDS[args.command](args, log)
-    except (ValueError, KeyError, OSError) as e:
+    except (ValueError, KeyError, OSError, RpcError) as e:
         log.error(str(e))
         return 2
 

@@ -205,8 +205,12 @@ def make_mask_pallas_fn(engine_name: str, gen, target_words: np.ndarray,
     tile = sub * 128
     if batch % tile:
         raise ValueError(f"batch {batch} not a multiple of tile {tile}")
-    if batch >= 1 << 31:
-        raise ValueError("batch must fit in int32 lane arithmetic")
+    if batch > (1 << 31) - 256:
+        # the first mixed-radix addition computes base_digit + lane with
+        # base_digit <= 255, so the lane index needs 256 of headroom
+        # below 2^31 or the last lanes wrap and decode wrong candidates
+        raise ValueError("batch must fit in int32 lane arithmetic "
+                         "(max 2**31 - 256)")
     if not kernel_eligible(engine_name, gen, 1):
         raise ValueError(f"{engine_name} mask job not kernel-eligible; "
                          "use the XLA path")

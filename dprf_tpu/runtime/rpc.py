@@ -17,15 +17,21 @@ unit's lease expires and the Dispatcher reissues it (idempotent -- units
 are pure functions of the index range).  A worker that reports hits for
 an already-reissued unit is harmless: hits are deduped by target.
 
-Trust model: the protocol is unauthenticated; bind to localhost or a
-trusted network only (same stance as hashtopolis-style agents).  The
+Trust model: optional shared-secret authentication (--token).  When the
+coordinator has a token, every connection must answer an HMAC-SHA256
+challenge on hello before any other op is served; without one the
+protocol is open -- bind to localhost or a trusted network only (same
+stance as hashtopolis-style agents).  The transport is cleartext either
+way: the token authenticates peers, it does not encrypt the job.  The
 job description includes the raw hashlist lines; wordlist files must
 exist on each worker host (they are referenced by path, never shipped).
 """
 
 from __future__ import annotations
 
+import hmac as hmac_mod
 import json
+import secrets
 import socket
 import socketserver
 import threading
@@ -37,6 +43,12 @@ from dprf_tpu.runtime.worker import Hit
 from dprf_tpu.runtime.workunit import WorkUnit
 
 MAX_LINE = 64 << 20   # hashlists can be large; candidates never cross
+
+
+class RpcError(RuntimeError):
+    """Protocol-level failure talking to the coordinator (error
+    response, auth failure).  Distinct from RuntimeError so the CLI can
+    report it cleanly without swallowing unrelated internal errors."""
 
 
 # ---------------------------------------------------------------------------
@@ -66,13 +78,22 @@ class CoordinatorState:
 
     def __init__(self, job: dict, dispatcher: Dispatcher, n_targets: int,
                  on_hit: Optional[Callable] = None,
-                 on_progress: Optional[Callable] = None):
+                 on_progress: Optional[Callable] = None,
+                 verifier: Optional[Callable] = None,
+                 token: Optional[str] = None):
         self.job = job                    # serializable job description
         self.dispatcher = dispatcher
         self.n_targets = n_targets
         self.found: dict[int, bytes] = {}
         self.on_hit = on_hit              # (target_index, cand_index, plain)
         self.on_progress = on_progress
+        #: (target_index, plaintext) -> bool.  A worker with a buggy or
+        #: malicious device path could report a wrong plaintext; accepting
+        #: it would permanently mark the target found and poison the
+        #: potfile/session journal.  One oracle hash per hit is negligible.
+        self.verifier = verifier
+        self.rejected = 0
+        self.token = token                # None = unauthenticated protocol
         self.lock = threading.Lock()
         self.t0 = time.perf_counter()
 
@@ -94,21 +115,45 @@ class CoordinatorState:
                              "length": unit.length}}
 
     def op_complete(self, msg: dict) -> dict:
+        unit_id = int(msg["unit_id"])
         hits = msg.get("hits", [])
+        # Parse + verify OUTSIDE the lock: the oracle re-hash takes
+        # seconds for bcrypt/PBKDF2, and holding the lock there would
+        # stall every other worker's lease/complete (and hand any buggy
+        # worker a coordinator-wide DoS).
         with self.lock:
-            for h in hits:
-                ti = int(h["target"])
-                if ti in self.found or not 0 <= ti < self.n_targets:
+            already = set(self.found)
+        verified = []
+        rejected = 0
+        for h in hits:
+            ti = int(h["target"])
+            if ti in already or not 0 <= ti < self.n_targets:
+                continue
+            plain = bytes.fromhex(h["plaintext"])
+            if self.verifier is not None and not self.verifier(ti, plain):
+                rejected += 1
+                continue
+            verified.append((ti, int(h["cand"]), plain))
+        with self.lock:
+            for ti, cand, plain in verified:
+                if ti in self.found:
                     continue
-                plain = bytes.fromhex(h["plaintext"])
                 self.found[ti] = plain
                 if self.on_hit:
-                    self.on_hit(ti, int(h["cand"]), plain)
-            self.dispatcher.complete(int(msg["unit_id"]))
+                    self.on_hit(ti, cand, plain)
+            if rejected:
+                # The reporting worker's device path is suspect: requeue
+                # the range instead of marking it done, or a wrong
+                # plaintext would punch a permanent silent coverage hole
+                # where the true crack may live.
+                self.rejected += rejected
+                self.dispatcher.fail(unit_id)
+            else:
+                self.dispatcher.complete(unit_id)
             if self.on_progress:
                 done, total = self.dispatcher.progress()
                 self.on_progress(done, total, len(self.found))
-            return {"ok": True, "stop": self._stopped()}
+            return {"ok": rejected == 0, "stop": self._stopped()}
 
     def op_fail(self, msg: dict) -> dict:
         with self.lock:
@@ -131,9 +176,17 @@ class CoordinatorState:
             return self._stopped()
 
 
+def challenge_response(token: str, nonce_hex: str) -> str:
+    """The proof a client sends for a hello challenge."""
+    return hmac_mod.new(token.encode(), bytes.fromhex(nonce_hex),
+                        "sha256").hexdigest()
+
+
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         state: CoordinatorState = self.server.state   # type: ignore
+        nonce = secrets.token_hex(16)      # per-connection challenge
+        authed = state.token is None
         while True:
             try:
                 msg = recv_msg(self.rfile)
@@ -141,6 +194,26 @@ class _Handler(socketserver.StreamRequestHandler):
                 return
             if msg is None:
                 return
+            if not authed:
+                if msg.get("op") == "hello":
+                    mac = msg.get("hmac")
+                    if (isinstance(mac, str) and hmac_mod.compare_digest(
+                            mac, challenge_response(state.token, nonce))):
+                        authed = True      # fall through to op_hello
+                    else:
+                        try:
+                            send_msg(self.connection,
+                                     {"ok": False, "challenge": nonce})
+                        except OSError:
+                            return
+                        continue
+                else:
+                    try:
+                        send_msg(self.connection,
+                                 {"error": "unauthenticated"})
+                    except OSError:
+                        return
+                    continue
             op = getattr(state, f"op_{msg.get('op', '')}", None)
             if op is None:
                 resp = {"error": f"unknown op {msg.get('op')!r}"}
@@ -223,9 +296,25 @@ class CoordinatorServer:
 class CoordinatorClient:
     """Blocking JSON-RPC client used by remote workers."""
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 token: Optional[str] = None):
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._fh = self._sock.makefile("rb")
+        self._token = token
+
+    def hello(self) -> dict:
+        """Fetch the job, answering the coordinator's auth challenge if
+        it has one."""
+        resp = self.call("hello")
+        if resp.get("challenge"):
+            if not self._token:
+                raise RpcError(
+                    "coordinator requires authentication; pass --token")
+            resp = self.call("hello", hmac=challenge_response(
+                self._token, resp["challenge"]))
+            if resp.get("challenge"):
+                raise RpcError("authentication failed (wrong token?)")
+        return resp
 
     def call(self, op: str, **kw) -> dict:
         kw["op"] = op
@@ -234,7 +323,7 @@ class CoordinatorClient:
         if resp is None:
             raise ConnectionError("coordinator closed the connection")
         if "error" in resp:
-            raise RuntimeError(f"coordinator error: {resp['error']}")
+            raise RpcError(f"coordinator error: {resp['error']}")
         return resp
 
     def close(self) -> None:
@@ -253,7 +342,19 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
     """
     done_units = 0
     while True:
-        resp = client.call("lease", worker_id=worker_id)
+        try:
+            resp = client.call("lease", worker_id=worker_id)
+        except ConnectionError:
+            # Clean exit ONLY at the lease boundary: nothing is held,
+            # and after the coordinator finishes draining and closes
+            # this is how an idle worker learns the job is over.  A
+            # close during complete/fail propagates as an error -- the
+            # worker was holding results, so a silent exit would look
+            # like success after a coordinator crash.
+            if log:
+                log.info("coordinator closed at lease (job finished?); "
+                         "exiting cleanly")
+            return done_units
         unit_d = resp.get("unit")
         if unit_d is None:
             if resp.get("stop"):

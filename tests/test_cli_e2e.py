@@ -215,3 +215,18 @@ def test_crack_wordlist_multichip(tmp_path, capsys):
                        "--no-potfile", "--batch", "512", "-q"], capsys)
     assert rc == 0
     assert ":SECRET" in out
+
+
+def test_wordlist_max_len_is_engine_specific():
+    """The 55-byte device packing limit binds only on single-block
+    digest_packed engines; bcrypt's device path accepts its full
+    72-byte limit (ADVICE r1)."""
+    from dprf_tpu.cli import _wordlist_max_len
+    from dprf_tpu.engines import get_engine
+
+    md5 = get_engine("md5")
+    assert _wordlist_max_len("md5", md5, "jax") == 55
+    bc = get_engine("bcrypt")
+    assert _wordlist_max_len("bcrypt", bc, "jax") == 72
+    pk = get_engine("wpa2-pmkid")
+    assert _wordlist_max_len("wpa2-pmkid", pk, "cpu") == 63

@@ -163,6 +163,43 @@ def test_cli_worker_fingerprint_mismatch_aborts(tmp_path):
         server.shutdown()
 
 
+def test_bogus_hit_rejected_by_verifier():
+    """A worker reporting a plaintext that does not hash to the target
+    must not poison the found set (ADVICE r1: verify hits with the
+    coordinator's CPU oracle before accepting)."""
+    eng, gen, targets, job = _mask_job("?l?l?l", [b"cat"])
+    dispatcher = Dispatcher(gen.keyspace, job["unit_size"])
+
+    def verifier(ti, plain):
+        return eng.verify(plain, targets[ti])
+
+    state = CoordinatorState(job, dispatcher, len(targets),
+                             verifier=verifier)
+    server = CoordinatorServer(state, "127.0.0.1", 0)
+    server.start_background()
+    try:
+        client = CoordinatorClient(*server.address)
+        unit = client.call("lease", worker_id="liar")["unit"]
+        resp = client.call("complete", unit_id=unit["id"],
+                           hits=[{"target": 0, "cand": 0,
+                                  "plaintext": b"WRONG".hex()}])
+        assert not resp["ok"] and not resp["stop"]
+        assert state.found == {} and state.rejected == 1
+        # the unit was requeued, not marked done: the range may hold the
+        # real crack the lying worker missed
+        assert dispatcher.progress()[0] == 0
+        reissued = client.call("lease", worker_id="honest")["unit"]
+        assert reissued["start"] == unit["start"]
+        client.call("complete", unit_id=reissued["id"],
+                    hits=[{"target": 0, "cand": 1,
+                           "plaintext": b"cat".hex()}])
+        assert state.found == {0: b"cat"}
+        assert dispatcher.progress()[0] == unit["length"]
+        client.close()
+    finally:
+        server.shutdown()
+
+
 def test_status_op():
     eng, gen, targets, job = _mask_job("?d?d", [b"11"])
     state, server, _ = _serve(job, gen, targets)
@@ -175,5 +212,106 @@ def test_status_op():
         st = client.call("status")
         assert st["done"] == gen.keyspace and st["found"] == 1
         client.close()
+    finally:
+        server.shutdown()
+
+
+def test_auth_bad_token_rejected_good_token_accepted():
+    """Challenge-response on hello: a client without the shared secret
+    gets no job and no ops; the right token unlocks the connection."""
+    eng, gen, targets, job = _mask_job("?l?l?l", [b"cat"])
+    dispatcher = Dispatcher(gen.keyspace, job["unit_size"])
+    state = CoordinatorState(job, dispatcher, len(targets), token="s3cret")
+    server = CoordinatorServer(state, "127.0.0.1", 0)
+    server.start_background()
+    try:
+        # no token: hello yields a challenge, other ops are refused
+        anon = CoordinatorClient(*server.address)
+        resp = anon.call("hello")
+        assert resp.get("challenge") and "job" not in resp
+        with pytest.raises(RuntimeError, match="unauthenticated"):
+            anon.call("lease", worker_id="anon")
+        with pytest.raises(RuntimeError, match="requires authentication"):
+            anon.hello()
+        anon.close()
+
+        # wrong token: the proof fails, the challenge repeats
+        bad = CoordinatorClient(*server.address, token="wrong")
+        with pytest.raises(RuntimeError, match="authentication failed"):
+            bad.hello()
+        bad.close()
+
+        # right token: full worker loop runs
+        good = CoordinatorClient(*server.address, token="s3cret")
+        assert good.hello()["job"]["engine"] == "md5"
+        worker_loop(good, CpuWorker(eng, gen, targets), "w",
+                    idle_sleep=0.01)
+        good.close()
+        assert state.found == {0: b"cat"}
+    finally:
+        server.shutdown()
+
+
+def test_cli_worker_with_token(capsys):
+    eng, gen, targets, job = _mask_job("?l?l?l", [b"fox"])
+    dispatcher = Dispatcher(gen.keyspace, job["unit_size"])
+    state = CoordinatorState(job, dispatcher, len(targets), token="tk")
+    server = CoordinatorServer(state, "127.0.0.1", 0)
+    server.start_background()
+    try:
+        host, port = server.address
+        rc = cli_main(["worker", "--connect", f"{host}:{port}",
+                       "--device", "cpu", "--quiet", "--token", "bad"])
+        assert rc == 2 and not state.found
+        rc = cli_main(["worker", "--connect", f"{host}:{port}",
+                       "--device", "cpu", "--quiet", "--token", "tk"])
+        assert rc == 0 and state.found == {0: b"fox"}
+    finally:
+        server.shutdown()
+
+
+@pytest.mark.parametrize("msg", [
+    {"op": "complete"},                                  # missing unit_id
+    {"op": "complete", "unit_id": "zap", "hits": []},    # non-int id
+    {"op": "complete", "unit_id": 0,
+     "hits": [{"target": 0, "cand": 0, "plaintext": "zz"}]},  # bad hex
+    {"op": "complete", "unit_id": 0, "hits": [{}]},      # empty hit
+    {"op": "complete", "unit_id": 0,
+     "hits": [{"target": "x", "cand": 0, "plaintext": ""}]},
+    {"op": "fail"},
+    {"op": "fail", "unit_id": None},
+    {"op": "lease", "worker_id": {"nested": "junk"}},
+    {"op": "__init__"},
+    {"op": None},
+    {"no_op_at_all": 1},
+])
+def test_malformed_requests_never_kill_server(msg):
+    """Every malformed request yields an error response (or a clean
+    drop), never a dead coordinator: the job must finish afterwards."""
+    from dprf_tpu.runtime.rpc import send_msg, recv_msg
+    import socket as _socket
+
+    eng, gen, targets, job = _mask_job("?d?d", [b"42"])
+    # short lease: the {"op": "lease"} case grabs the only unit and never
+    # completes it; the cleanup worker must not wait out a 300 s lease
+    state, server, _ = _serve(job, gen, targets, lease_timeout=0.5)
+    try:
+        raw = _socket.create_connection(server.address, timeout=10)
+        fh = raw.makefile("rb")
+        send_msg(raw, msg)
+        resp = recv_msg(fh)
+        assert resp is not None           # server answered, didn't die
+        raw.close()
+
+        # a raw non-JSON line drops the connection but not the server
+        raw2 = _socket.create_connection(server.address, timeout=10)
+        raw2.sendall(b"\x00garbage, not json\n")
+        raw2.close()
+
+        client = CoordinatorClient(*server.address)
+        worker_loop(client, CpuWorker(eng, gen, targets), "w",
+                    idle_sleep=0.01)
+        client.close()
+        assert state.found == {0: b"42"}
     finally:
         server.shutdown()

@@ -1,0 +1,42 @@
+"""Cooperative TPU-tunnel probe.
+
+Attempts axon TPU init + one tiny computation and exits 0 on success.
+NEVER kill this process externally: the one-client tunnel wedges when a
+client dies mid-handshake (VERDICT.md r1, weakness 2).  Run it in the
+background and read its status file instead.
+"""
+import json
+import os
+import sys
+import time
+
+STATUS = os.environ.get("TPU_PROBE_STATUS", "/tmp/tpu_probe_status.json")
+
+
+def write(stage, **kw):
+    # atomic replace: a poller must never read a truncated document
+    tmp = STATUS + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"stage": stage, "t": time.time(), **kw}, f)
+        f.write("\n")
+    os.replace(tmp, STATUS)
+
+
+def main():
+    write("starting", pid=os.getpid())
+    import jax  # site registers the axon platform
+    write("jax_imported")
+    devs = jax.devices()  # may hang on a wedged tunnel
+    write("devices", devices=[str(d) for d in devs],
+          platform=devs[0].platform if devs else None)
+    import jax.numpy as jnp
+    x = jnp.arange(8 * 128, dtype=jnp.int32).reshape(8, 128)
+    y = (x * 3 + 1).sum()
+    val = int(y)
+    write("compute_ok", value=val,
+          expected=sum(i * 3 + 1 for i in range(8 * 128)))
+    print("TPU probe OK:", devs)
+
+
+if __name__ == "__main__":
+    main()
