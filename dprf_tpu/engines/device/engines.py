@@ -51,14 +51,16 @@ class JaxEngineBase(DeviceHashEngine, HashEngine):
         engine.  Engines with special pipelines (PMKID, bcrypt) override
         this -- it is the CLI's single entry into the device path.
 
-        Single-target jobs on kernel-capable engines (MD5/SHA-1/NTLM)
-        route to the hand-written Pallas kernel when eligible (see
-        ops/pallas_mask.pallas_mode); anything else uses the generic
-        fused XLA pipeline."""
+        Kernel-capable engines route to the hand-written Pallas kernel
+        when eligible (see ops/pallas_mask.pallas_mode): exact
+        single-target compare, or the Bloom-prefilter multi-target path
+        (which needs an oracle to verify maybes -- without one the job
+        stays on the generic fused XLA pipeline)."""
         from dprf_tpu.ops.pallas_mask import kernel_eligible, pallas_mode
         mode = pallas_mode()
-        if mode is not None and kernel_eligible(self.name, gen,
-                                                len(targets)):
+        if (mode is not None and kernel_eligible(self.name, gen,
+                                                 len(targets))
+                and (len(targets) == 1 or oracle is not None)):
             from dprf_tpu.runtime.worker import PallasMaskWorker
             return PallasMaskWorker(self, gen, targets, batch=batch,
                                     hit_capacity=hit_capacity,

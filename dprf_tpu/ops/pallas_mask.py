@@ -45,12 +45,29 @@ from jax.experimental.pallas import tpu as pltpu
 from dprf_tpu.ops import md4 as md4_ops
 from dprf_tpu.ops import md5 as md5_ops
 from dprf_tpu.ops import sha1 as sha1_ops
+from dprf_tpu.ops import sha256 as sha256_ops
 
 #: sublane count per grid cell; TILE = SUB * 128 candidate lanes.
 SUB = 32
 TILE = SUB * 128
 #: charsets needing more piecewise segments than this use the XLA path.
 MAX_SEGMENTS = 16
+
+# -- multi-target Bloom prefilter parameters --------------------------------
+#: probes per target set; each probe consumes 12 digest bits (7 bits
+#: word index into a 128-word bitmap row + 5 bits bit index), so 8
+#: probes use 96 bits -- available in every CORES digest (>= 128 bits).
+K_PROBES = 8
+#: targets per Bloom set.  Fill factor per 4096-bit set bitmap is
+#: <= 1024/4096 = 0.25, so a non-matching lane passes all 8 probes of
+#: one set with p <= 0.25**8 ~ 1.5e-5: ~0.06 false maybe-lanes per
+#: 4096-lane tile per set.  False maybes cost one host oracle hash
+#: (single) or one 4096-candidate tile rescan (collision) -- both
+#: negligible at those rates.
+SET_SIZE = 1024
+#: hard cap on kernel-path targets (gather cost grows one probe row per
+#: set: ceil(N/1024) * 8 gathers per tile).
+MAX_TARGETS = 8192
 
 
 def _make_core(rounds_fn, init_words):
@@ -67,6 +84,7 @@ def _make_core(rounds_fn, init_words):
 _md5_core = _make_core(md5_ops.md5_rounds, md5_ops.INIT)
 _md4_core = _make_core(md4_ops.md4_rounds, md4_ops.INIT)
 _sha1_core = _make_core(sha1_ops.sha1_rounds, sha1_ops.INIT)
+_sha256_core = _make_core(sha256_ops.sha256_rounds, sha256_ops.INIT)
 
 #: engine name -> (rounds core, digest words, big-endian packing,
 #: UTF-16LE widening)
@@ -74,6 +92,8 @@ CORES = {
     "md5": (_md5_core, 4, False, False),
     "sha1": (_sha1_core, 5, True, False),
     "sha-1": (_sha1_core, 5, True, False),
+    "sha256": (_sha256_core, 8, True, False),
+    "sha-256": (_sha256_core, 8, True, False),
     "ntlm": (_md4_core, 4, False, True),
 }
 
@@ -116,13 +136,59 @@ def mask_supported(charsets: Sequence[bytes]) -> bool:
 
 def kernel_eligible(engine_name: str, gen, n_targets: int) -> bool:
     """One kernel-eligibility predicate for engine selection and bench."""
-    if engine_name not in CORES or n_targets != 1:
+    if engine_name not in CORES or not 1 <= n_targets <= MAX_TARGETS:
         return False
     if not hasattr(gen, "charsets"):
         return False
+    if engine_name in ("sha256", "sha-256"):
+        # The statically-unrolled SHA-256 graph compiles fine through
+        # Mosaic's path but takes XLA:CPU many minutes, so the kernel
+        # is TPU-only; off-TPU (tests, --device cpu fallback) SHA-256
+        # uses the XLA pipeline.  The kernel body itself is validated
+        # eagerly via emulate_mask_kernel.
+        import jax as _jax
+        if _jax.default_backend() != "tpu":
+            return False
     widen = CORES[engine_name][3]
     max_len = 27 if widen else 55
     return gen.length <= max_len and mask_supported(gen.charsets)
+
+
+def bloom_tables(twords: np.ndarray) -> np.ndarray:
+    """Target digest words uint32[N, W] -> Bloom bitmap rows
+    uint32[n_sets * K_PROBES, 128].
+
+    Set s, probe p lives in row s*K_PROBES + p: a 4096-bit bitmap over
+    128 uint32 words, with one bit set per target in the set, keyed by
+    12 bits of the target's own digest (targets ARE uniform hash
+    outputs, so no extra hashing is needed).
+    """
+    N = twords.shape[0]
+    if N > MAX_TARGETS:
+        raise ValueError(f"kernel path supports <= {MAX_TARGETS} targets")
+    n_sets = -(-N // SET_SIZE)
+    T = np.zeros((n_sets * K_PROBES, 128), np.uint32)
+    for s in range(n_sets):
+        chunk = twords[s * SET_SIZE:(s + 1) * SET_SIZE]
+        for p in range(K_PROBES):
+            o = 12 * p
+            j, sh = divmod(o, 32)
+            bits = (chunk[:, j] >> np.uint32(sh)).astype(np.uint64)
+            if sh > 20:
+                bits |= chunk[:, j + 1].astype(np.uint64) << np.uint64(32 - sh)
+            bits = (bits & np.uint64(0xFFF)).astype(np.uint32)
+            np.bitwise_or.at(T[s * K_PROBES + p], bits >> 5,
+                             np.uint32(1) << (bits & np.uint32(31)))
+    return T
+
+
+def _probe_bits(digest, p: int):
+    """12 Bloom-probe bits [12p, 12p+12) of the digest bit string."""
+    j, sh = divmod(12 * p, 32)
+    bits = digest[j] >> jnp.uint32(sh)
+    if sh > 20:
+        bits = bits | (digest[j + 1] << jnp.uint32(32 - sh))
+    return bits & jnp.uint32(0xFFF)
 
 
 def _decode_byte(digit, segs):
@@ -154,20 +220,29 @@ def _pack_message(byts, length: int, shape, big_endian: bool,
     return m
 
 
-def _build_kernel(engine_name: str, radices, seg_tables, length: int,
-                  target, sub: int):
-    """Kernel closure: radices/charset segments/target words are baked
-    in as constants (one compile per job, like the XLA step)."""
+def _build_kernel_body(engine_name: str, radices, seg_tables, length: int,
+                       target, sub: int):
+    """The kernel math as a PURE function of (pid, base digits, n_valid)
+    -> (count, hit_lane) scalars.  Shared verbatim by the pallas_call
+    wrapper (TPU) and by emulate_mask_kernel (eager CPU validation --
+    XLA:CPU cannot compile the statically-unrolled SHA-256 graph in
+    reasonable time, so correctness tests drive this body op-by-op)."""
     core, n_words, big_endian, widen = CORES[engine_name]
     tile = sub * 128
-    # plain python ints: jnp scalars here would be captured closure
-    # constants, which pallas_call rejects
-    tw = [int(w) for w in target]
-    if len(tw) != n_words:
-        raise ValueError(f"{engine_name}: expected {n_words} target words")
+    target = np.asarray(target)
+    multi = target.ndim == 2 and target.shape[0] > 1
+    if multi:
+        n_sets = -(-target.shape[0] // SET_SIZE)
+        tw = None
+    else:
+        # plain python ints: jnp scalars here would be captured closure
+        # constants, which pallas_call rejects
+        tw = [int(w) for w in target.reshape(-1)]
+        if len(tw) != n_words:
+            raise ValueError(f"{engine_name}: expected {n_words} "
+                             "target words")
 
-    def kernel(base_ref, nvalid_ref, counts_ref, hitlane_ref):
-        pid = pl.program_id(0)
+    def kernel_body(pid, base, n_valid, tables=None):
         shape = (sub, 128)
         lane = (jax.lax.broadcasted_iota(jnp.int32, shape, 0) * 128
                 + jax.lax.broadcasted_iota(jnp.int32, shape, 1))
@@ -179,21 +254,92 @@ def _build_kernel(engine_name: str, radices, seg_tables, length: int,
         byts: list = [None] * length
         for p in range(length - 1, -1, -1):
             r = radices[p]
-            s = base_ref[p] + carry
+            s = base[p] + carry
             byts[p] = _decode_byte(s % r, seg_tables[p]).astype(jnp.uint32)
             carry = s // r
         m = _pack_message(byts, length, shape, big_endian, widen)
         digest = core(m, shape)
-        valid = (lane + pid * tile) < nvalid_ref[0]
-        found = valid
-        for got, want in zip(digest, tw):
-            found = found & (got == jnp.uint32(want))
-        counts_ref[0, 0] = jnp.sum(found.astype(jnp.int32))
+        valid = (lane + pid * tile) < n_valid
+        if not multi:
+            found = valid
+            for got, want in zip(digest, tw):
+                found = found & (got == jnp.uint32(want))
+        else:
+            # Bloom prefilter: a lane survives if it passes ALL K_PROBES
+            # of ANY target set.  Real hits always survive (their probe
+            # bits come from the matching target's own digest); false
+            # maybes are rare enough that the caller verifies single
+            # maybes with one host oracle hash and exactly rescans
+            # collided tiles (see reduce_tile_maybes).
+            probes = []
+            for p in range(K_PROBES):
+                bits = _probe_bits(digest, p)
+                probes.append(((bits >> jnp.uint32(5)).astype(jnp.int32),
+                               (bits & jnp.uint32(31))))
+            found = jnp.zeros(shape, jnp.bool_)
+            for s in range(n_sets):
+                m_set = valid
+                for p, (idx7, bit5) in enumerate(probes):
+                    row = jnp.broadcast_to(
+                        tables[s * K_PROBES + p][None, :], shape)
+                    word = jnp.take_along_axis(row, idx7, axis=1)
+                    m_set = m_set & (((word >> bit5) & jnp.uint32(1)) == 1)
+                found = found | m_set
+        count = jnp.sum(found.astype(jnp.int32))
         # single-hit extraction: max lane among hits (-1 if none); the
         # caller rescans any tile whose count exceeds 1.
-        hitlane_ref[0, 0] = jnp.max(jnp.where(found, lane, -1))
+        hit_lane = jnp.max(jnp.where(found, lane, -1))
+        return count, hit_lane
+
+    return kernel_body
+
+
+def _build_kernel(engine_name: str, radices, seg_tables, length: int,
+                  target, sub: int, multi: bool = False):
+    """pallas_call kernel wrapper around the pure body."""
+    body = _build_kernel_body(engine_name, radices, seg_tables, length,
+                              target, sub)
+
+    if multi:
+        def kernel(base_ref, nvalid_ref, tables_ref, counts_ref,
+                   hitlane_ref):
+            count, hit_lane = body(pl.program_id(0), base_ref,
+                                   nvalid_ref[0], tables_ref)
+            counts_ref[0, 0] = count
+            hitlane_ref[0, 0] = hit_lane
+    else:
+        def kernel(base_ref, nvalid_ref, counts_ref, hitlane_ref):
+            count, hit_lane = body(pl.program_id(0), base_ref,
+                                   nvalid_ref[0])
+            counts_ref[0, 0] = count
+            hitlane_ref[0, 0] = hit_lane
 
     return kernel
+
+
+def emulate_mask_kernel(engine_name: str, gen, target_words: np.ndarray,
+                        batch: int, base_digits, n_valid: int,
+                        sub: int = SUB):
+    """Run the kernel body eagerly (no pallas_call, no jit) over every
+    grid cell; returns (counts int32[G,1], hit_lanes int32[G,1]) with
+    the exact layout pallas_call produces.  Test/validation vehicle."""
+    tile = sub * 128
+    if batch % tile:
+        raise ValueError(f"batch {batch} not a multiple of tile {tile}")
+    target_words = np.asarray(target_words)
+    multi = target_words.ndim == 2 and target_words.shape[0] > 1
+    tables = jnp.asarray(bloom_tables(target_words)) if multi else None
+    seg_tables = [charset_segments(cs) for cs in gen.charsets]
+    body = _build_kernel_body(engine_name, gen.radices, seg_tables,
+                              gen.length, target_words, sub)
+    base = jnp.asarray(base_digits, jnp.int32)
+    counts, lanes = [], []
+    for pid in range(batch // tile):
+        c, l = body(jnp.int32(pid), base, jnp.int32(n_valid), tables)
+        counts.append(int(c))
+        lanes.append(int(l))
+    return (np.asarray(counts, np.int32)[:, None],
+            np.asarray(lanes, np.int32)[:, None])
 
 
 def make_mask_pallas_fn(engine_name: str, gen, target_words: np.ndarray,
@@ -201,7 +347,12 @@ def make_mask_pallas_fn(engine_name: str, gen, target_words: np.ndarray,
                         interpret: bool = False):
     """Build fn(base_digits int32[L], n_valid int32[1]) ->
     (counts int32[G, 1], hit_lanes int32[G, 1]) over a `batch`-lane
-    sweep.  batch must be a multiple of sub*128."""
+    sweep.  batch must be a multiple of sub*128.
+
+    target_words uint32[W] (single target: counts are exact hit counts)
+    or uint32[N, W] (multi target: counts are Bloom maybe-counts; see
+    reduce_tile_maybes for the caller contract).
+    """
     tile = sub * 128
     if batch % tile:
         raise ValueError(f"batch {batch} not a multiple of tile {tile}")
@@ -211,21 +362,29 @@ def make_mask_pallas_fn(engine_name: str, gen, target_words: np.ndarray,
         # below 2^31 or the last lanes wrap and decode wrong candidates
         raise ValueError("batch must fit in int32 lane arithmetic "
                          "(max 2**31 - 256)")
-    if not kernel_eligible(engine_name, gen, 1):
+    target_words = np.asarray(target_words)
+    multi = target_words.ndim == 2 and target_words.shape[0] > 1
+    n_targets = target_words.shape[0] if multi else 1
+    if not kernel_eligible(engine_name, gen, n_targets):
         raise ValueError(f"{engine_name} mask job not kernel-eligible; "
                          "use the XLA path")
     grid = batch // tile
     seg_tables = [charset_segments(cs) for cs in gen.charsets]
     kernel = _build_kernel(engine_name, gen.radices, seg_tables,
-                           gen.length, target_words, sub)
+                           gen.length, target_words, sub, multi=multi)
     L = gen.length
-    return pl.pallas_call(
+    in_specs = [
+        pl.BlockSpec((L,), lambda i: (0,), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1,), lambda i: (0,), memory_space=pltpu.SMEM),
+    ]
+    if multi:
+        tables = bloom_tables(target_words)
+        R = tables.shape[0]
+        in_specs.append(pl.BlockSpec((R, 128), lambda i: (0, 0)))
+    fn = pl.pallas_call(
         kernel,
         grid=(grid,),
-        in_specs=[
-            pl.BlockSpec((L,), lambda i: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1,), lambda i: (0,), memory_space=pltpu.SMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1), lambda i: (i, 0),
                          memory_space=pltpu.SMEM),
@@ -238,6 +397,14 @@ def make_mask_pallas_fn(engine_name: str, gen, target_words: np.ndarray,
         ],
         interpret=interpret,
     )
+    if not multi:
+        return fn
+    tables_dev = jnp.asarray(tables)
+
+    def fn_multi(base_digits, n_valid):
+        return fn(base_digits, n_valid, tables_dev)
+
+    return fn_multi
 
 
 def make_pallas_mask_crack_step(engine_name: str, gen,
@@ -258,6 +425,57 @@ def make_pallas_mask_crack_step(engine_name: str, gen,
         return reduce_tile_hits(counts, hit_lanes, hit_capacity, tile)
 
     return step
+
+
+def make_pallas_multi_crack_step(engine_name: str, gen,
+                                 target_words: np.ndarray, batch: int,
+                                 hit_capacity: int = 64,
+                                 rescan_capacity: int = 16,
+                                 interpret: bool = False):
+    """Multi-target kernel step: step(base_digits, n_valid) ->
+    (n_single, maybe_lanes int32[hit_capacity],
+     n_collided, collided_tiles int32[rescan_capacity]).
+
+    Contract (see PallasMaskWorker): each maybe lane holds >= 0
+    candidates that passed the Bloom prefilter and must be verified by
+    ONE host oracle hash; each collided tile (>= 2 maybes) must be
+    exactly rescanned over its TILE-candidate range.  n_single >
+    hit_capacity or n_collided > rescan_capacity means the whole batch
+    needs the exact rescan (astronomically rare at the Bloom FP rates
+    documented at SET_SIZE)."""
+    tile = SUB * 128
+    fn = make_mask_pallas_fn(engine_name, gen, target_words, batch,
+                             interpret=interpret)
+
+    @jax.jit
+    def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray):
+        counts, hit_lanes = fn(base_digits.astype(jnp.int32),
+                               jnp.reshape(n_valid, (1,)).astype(jnp.int32))
+        return reduce_tile_maybes(counts, hit_lanes, hit_capacity,
+                                  rescan_capacity, tile)
+
+    return step
+
+
+def reduce_tile_maybes(counts: jnp.ndarray, hit_lanes: jnp.ndarray,
+                       hit_capacity: int, rescan_capacity: int, tile: int):
+    """Per-tile Bloom maybe-counts -> (n_single, maybe_lanes,
+    n_collided, collided_tiles) for the multi-target worker."""
+    from dprf_tpu.ops import compare as cmp_ops
+
+    c = counts[:, 0]
+    single = c == 1
+    collided = c > 1
+    n_single = jnp.sum(single.astype(jnp.int32))
+    n_collided = jnp.sum(collided.astype(jnp.int32))
+    _, stiles, _ = cmp_ops.compact_hits(single, jnp.zeros_like(c),
+                                        hit_capacity)
+    maybe_lanes = jnp.where(
+        stiles >= 0,
+        stiles * tile + hit_lanes[jnp.maximum(stiles, 0), 0], -1)
+    _, ctiles, _ = cmp_ops.compact_hits(collided, jnp.zeros_like(c),
+                                        rescan_capacity)
+    return n_single, maybe_lanes, n_collided, ctiles
 
 
 def reduce_tile_hits(counts: jnp.ndarray, hit_lanes: jnp.ndarray,

@@ -61,6 +61,30 @@ def _round(vars8: tuple, wt: jnp.ndarray, kt) -> tuple:
     return (t1 + S0 + maj, a, b, c, d + t1, e, f, g)
 
 
+def sha256_rounds(a, b, c, d, e, f, g, h, m):
+    """The 64 SHA-256 rounds over any uint32 array shape (no
+    feed-forward), STATICALLY unrolled with a rolling 16-word schedule
+    so every W[t] lives in registers -- the form the Pallas kernel
+    needs (fori_loop + concatenate does not lower to Mosaic; see
+    ops/pallas_mask.py).  m: sequence of 16 message-word arrays.
+
+    The XLA path (sha256_compress below) keeps the fori_loop form
+    instead: on XLA:CPU the flat ~3k-op unrolled graph compiles for
+    minutes, and under jit there is no throughput difference.
+    """
+    w = list(m)
+    vars8 = (a, b, c, d, e, f, g, h)
+    for t in range(64):
+        if t >= 16:
+            w15 = w[(t - 15) % 16]
+            w2 = w[(t - 2) % 16]
+            s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> jnp.uint32(3))
+            s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> jnp.uint32(10))
+            w[t % 16] = w[t % 16] + s0 + w[(t - 7) % 16] + s1
+        vars8 = _round(vars8, w[t % 16], jnp.uint32(int(K[t])))
+    return vars8
+
+
 def sha256_compress(state: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
     """state uint32[..., 8] x words uint32[..., 16] (big-endian packed)
     -> uint32[..., 8].

@@ -236,30 +236,79 @@ class DeviceWordlistWorker(WordlistWorkerBase):
 
 class PallasMaskWorker(MaskWorkerBase):
     """Mask worker over the hand-written Pallas kernels
-    (ops/pallas_mask.py: MD5, SHA-1, NTLM) -- the single-target fast
-    path where the whole decode->hash->compare->reduce chain stays in
-    VMEM.
+    (ops/pallas_mask.py) -- the fast path where the whole
+    decode->hash->compare->reduce chain stays in VMEM.
 
-    Same hit-buffer interface as DeviceMaskWorker; tile collisions
-    surface as count > hit_capacity, which reuses the exact-rescan
-    fallback path.
+    Single target: exact in-kernel compare; tile collisions surface as
+    count > hit_capacity, which reuses the exact-rescan fallback path.
+
+    Multi target (config 2's 1k-hash list): the kernel runs a Bloom
+    prefilter (ops/pallas_mask.bloom_tables); each single-maybe lane is
+    verified here with ONE oracle hash against the target digest map,
+    and each collided tile (>= 2 maybes, including any tile with two
+    real hits) is exactly rescanned over its TILE-candidate range.
     """
+
+    RESCAN_CAPACITY = 16
 
     def __init__(self, engine, gen, targets: Sequence[Target],
                  batch: int = 1 << 18, hit_capacity: int = 64,
                  oracle: Optional[HashEngine] = None,
                  interpret: bool = False):
         from dprf_tpu.ops.pallas_mask import (TILE,
-                                              make_pallas_mask_crack_step)
+                                              make_pallas_mask_crack_step,
+                                              make_pallas_multi_crack_step)
 
         tgt = self._setup_targets(engine, gen, targets, hit_capacity, oracle)
-        if self.multi:
-            raise ValueError("pallas mask worker is single-target only")
         batch = max(TILE, (batch // TILE) * TILE)
         self.batch = self.stride = batch
-        self.step = make_pallas_mask_crack_step(
-            engine.name, gen, np.asarray(tgt), batch, hit_capacity,
-            interpret=interpret)
+        self._tile = TILE
+        if self.multi:
+            if oracle is None:
+                raise ValueError("multi-target pallas worker needs an "
+                                 "oracle engine to verify Bloom maybes")
+            dt = "<u4" if engine.little_endian else ">u4"
+            twords = np.stack([np.frombuffer(t.digest, dtype=dt)
+                               .astype(np.uint32) for t in self.targets])
+            self._digest_map = {t.digest: i
+                                for i, t in enumerate(self.targets)}
+            self.step = make_pallas_multi_crack_step(
+                engine.name, gen, twords, batch, hit_capacity,
+                self.RESCAN_CAPACITY, interpret=interpret)
+        else:
+            self.step = make_pallas_mask_crack_step(
+                engine.name, gen, np.asarray(tgt), batch, hit_capacity,
+                interpret=interpret)
+
+    def _batch_hits(self, bstart: int, result, unit: WorkUnit) -> list[Hit]:
+        if not self.multi:
+            return super()._batch_hits(bstart, result, unit)
+        n_single, lanes, n_collided, ctiles = result
+        n_single, n_collided = int(n_single), int(n_collided)
+        if n_single == 0 and n_collided == 0:
+            return []
+        if n_single > self.hit_capacity or n_collided > self.RESCAN_CAPACITY:
+            return self._rescan(bstart, unit)      # pathological overflow
+        hits: list[Hit] = []
+        for lane in np.asarray(lanes):
+            if lane < 0:
+                continue
+            # one oracle hash verifies a Bloom maybe exactly (and
+            # resolves its target index); false positives drop here
+            gidx = bstart + int(lane)
+            plain = self.gen.candidate(gidx)
+            ti = self._digest_map.get(self.oracle.hash_batch([plain])[0])
+            if ti is not None:
+                hits.append(Hit(ti, gidx, plain))
+        for t in np.asarray(ctiles):
+            if t < 0:
+                continue
+            start = bstart + int(t) * self._tile
+            end = min(start + self._tile, unit.end)
+            sub = WorkUnit(-1, start, end - start)
+            hits.extend(CpuWorker(self.oracle, self.gen,
+                                  self.targets).process(sub))
+        return hits
 
 
 class DeviceMaskWorker(MaskWorkerBase):
