@@ -233,20 +233,16 @@ def _select_worker(engine_name: str, device: str, attack: str, gen,
         except KeyError:
             pass
     if dev_engine is not None and n_devices > 1:
-        if hasattr(dev_engine, "digest_packed"):
+        smaker = ("make_sharded_mask_worker" if attack == "mask"
+                  else "make_sharded_wordlist_worker")
+        if hasattr(dev_engine, smaker):
             from dprf_tpu.parallel.mesh import make_mesh
-            from dprf_tpu.parallel.worker import (ShardedMaskWorker,
-                                                  ShardedWordlistWorker)
             mesh = make_mesh(n_devices)
             log.info("mesh", devices=n_devices)
-            if attack == "mask":
-                return ShardedMaskWorker(
-                    dev_engine, gen, targets, mesh,
-                    batch_per_device=batch, hit_capacity=hit_cap,
-                    oracle=oracle)
-            return ShardedWordlistWorker(
-                dev_engine, gen, targets, mesh,
-                word_batch_per_device=max(1, batch // gen.n_rules),
+            per_dev = (batch if attack == "mask"
+                       else max(1, batch // gen.n_rules))
+            return getattr(dev_engine, smaker)(
+                gen, targets, mesh, per_dev,
                 hit_capacity=hit_cap, oracle=oracle)
         log.warn("engine has no multi-chip pipeline; using one chip",
                  engine=engine_name)
@@ -385,7 +381,12 @@ def cmd_crack(args, log: Log) -> int:
 
     coord = Coordinator(spec, hl.targets, dispatcher, worker,
                         session=session, potfile=potfile,
-                        progress_cb=None if args.quiet else progress)
+                        progress_cb=None if args.quiet else progress,
+                        # device jobs verify every hit against the CPU
+                        # oracle before the potfile (mirrors the
+                        # distributed CoordinatorState verifier); the CPU
+                        # worker IS the oracle, so no double hashing there
+                        oracle=engine if device != "cpu" else None)
     coord.preload_found()
     coord.restore_hits(restored_hits)
     if coord.found:
@@ -608,6 +609,9 @@ _COMMANDS = {
 def main(argv: Optional[list] = None) -> int:
     args = _build_parser().parse_args(argv)
     log = Log(quiet=getattr(args, "quiet", False))
+    # library code logs through the module-level DEFAULT; mirror -q
+    from dprf_tpu.utils.logging import DEFAULT
+    DEFAULT.quiet = log.quiet
     try:
         return _COMMANDS[args.command](args, log)
     except (ValueError, KeyError, OSError, RpcError) as e:

@@ -75,6 +75,23 @@ class JaxBcryptEngine(BcryptEngine):
                                     batch=min(batch, DEFAULT_BATCH),
                                     hit_capacity=hit_capacity, oracle=oracle)
 
+    def make_sharded_mask_worker(self, gen, targets, mesh,
+                                 batch_per_device: int, hit_capacity: int,
+                                 oracle=None):
+        return ShardedBcryptMaskWorker(
+            self, gen, targets, mesh,
+            batch_per_device=min(batch_per_device, DEFAULT_BATCH),
+            hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_sharded_wordlist_worker(self, gen, targets, mesh,
+                                     word_batch_per_device: int,
+                                     hit_capacity: int, oracle=None):
+        return ShardedBcryptWordlistWorker(
+            self, gen, targets, mesh,
+            word_batch_per_device=max(1, min(word_batch_per_device,
+                                             DEFAULT_BATCH // gen.n_rules)),
+            hit_capacity=hit_capacity, oracle=oracle)
+
 
 _jit_bcrypt_batch = jax.jit(bf_ops.bcrypt_batch)
 
@@ -114,6 +131,107 @@ def make_bcrypt_mask_step(gen, batch: int, hit_capacity: int = 64):
         return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
                                     hit_capacity)
 
+    return step
+
+
+def make_sharded_bcrypt_mask_step(gen, mesh, batch_per_device: int,
+                                  hit_capacity: int = 64):
+    """Multi-chip bcrypt mask step (config 4 at pod scale): chip c owns
+    lane slice [c*B, (c+1)*B) of the super-batch and runs the full
+    EksBlowfish chain locally; only the scalar hit count psums over ICI.
+
+    step(base_digits, n_valid, salt_words, n_rounds, target) ->
+        (total, counts[n_dev], lanes[n_dev, cap] super-batch-global, _).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from dprf_tpu.parallel.mesh import SHARD_AXIS
+
+    flat = gen.flat_charsets
+    length = gen.length
+    B = batch_per_device
+
+    def shard_fn(base_digits, n_valid, salt_words, n_rounds, target):
+        dev = lax.axis_index(SHARD_AXIS)
+        offset = (dev * B).astype(jnp.int32)
+        cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
+        lens = jnp.full((B,), length, jnp.int32)
+        dwords = bf_ops.bcrypt_batch(cand, lens, salt_words, n_rounds)
+        lane_global = offset + jnp.arange(B, dtype=jnp.int32)
+        found = (bf_ops.compare_digest_words(dwords, target)
+                 & (lane_global < n_valid))
+        count, lanes, tpos = cmp_ops.compact_hits(
+            found, jnp.zeros((B,), jnp.int32), hit_capacity)
+        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
+        total = lax.psum(count, SHARD_AXIS)
+        return (total[None], count[None], lanes[None, :], tpos[None, :])
+
+    sharded = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(),) * 5,
+        out_specs=(P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False)
+
+    @jax.jit
+    def step(base_digits, n_valid, salt_words, n_rounds, target):
+        total, counts, lanes, tpos = sharded(base_digits, n_valid,
+                                             salt_words, n_rounds, target)
+        return total[0], counts, lanes, tpos
+
+    step.super_batch = mesh.devices.size * B
+    return step
+
+
+def make_sharded_bcrypt_wordlist_step(gen, mesh, word_batch: int,
+                                      hit_capacity: int = 64):
+    """Multi-chip bcrypt wordlist step: chip c expands+hashes words
+    [w0 + c*B, w0 + (c+1)*B).  Lanes come back as super-batch flat
+    indices r*(n_dev*B) + global word lane (the same convention as
+    ops/rules_pipeline.make_sharded_wordlist_crack_step).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from dprf_tpu.parallel.mesh import SHARD_AXIS
+
+    n_dev = mesh.devices.size
+    B, L = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(
+        pad_to=n_dev * B, min_size=gen.n_words + n_dev * B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+
+    def shard_fn(w0, n_valid_words, salt_words, n_rounds, target):
+        dev = lax.axis_index(SHARD_AXIS)
+        my_w0 = w0 + (dev * B).astype(jnp.int32)
+        wslice = lax.dynamic_slice(words_dev, (my_w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (my_w0,), (B,))
+        word_lane = (dev * B).astype(jnp.int32) + jnp.arange(
+            B, dtype=jnp.int32)
+        base_valid = word_lane < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
+        dwords = bf_ops.bcrypt_batch(cw, cl, salt_words, n_rounds)
+        found = bf_ops.compare_digest_words(dwords, target) & cv
+        count, lanes, tpos = cmp_ops.compact_hits(
+            found, jnp.zeros_like(cl), hit_capacity)
+        r = lanes // B
+        b = lanes % B
+        glanes = r * (n_dev * B) + dev * B + b
+        lanes = jnp.where(lanes >= 0, glanes, lanes)
+        total = lax.psum(count, SHARD_AXIS)
+        return (total[None], count[None], lanes[None, :], tpos[None, :])
+
+    sharded = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(),) * 5,
+        out_specs=(P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False)
+
+    @jax.jit
+    def step(w0, n_valid_words, salt_words, n_rounds, target):
+        total, counts, lanes, tpos = sharded(w0, n_valid_words,
+                                             salt_words, n_rounds, target)
+        return total[0], counts, lanes, tpos
+
+    step.super_words = n_dev * B
     return step
 
 
@@ -198,6 +316,95 @@ class BcryptMaskWorker(_BcryptWorkerBase):
                     if lane < 0:
                         continue
                     gidx = bstart + int(lane)
+                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+
+class ShardedBcryptMaskWorker(_BcryptWorkerBase):
+    """Multi-chip bcrypt mask worker (keyspace DP over the mesh)."""
+
+    def __init__(self, engine, gen, targets, mesh,
+                 batch_per_device: int = DEFAULT_BATCH,
+                 hit_capacity: int = 64, oracle=None):
+        super().__init__(engine, gen, targets,
+                         mesh.devices.size * batch_per_device,
+                         hit_capacity, oracle)
+        self.mesh = mesh
+        self.stride = self.batch          # one super-batch per step
+        self.step = make_sharded_bcrypt_mask_step(
+            gen, mesh, batch_per_device, hit_capacity)
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        hits: list[Hit] = []
+        for ti in range(len(self.targets)):
+            salt_w, n_rounds, tgt = self._targs[ti]
+            queued = []
+            for bstart in range(unit.start, unit.end, self.stride):
+                n_valid = min(self.stride, unit.end - bstart)
+                base = jnp.asarray(self.gen.digits(bstart), dtype=jnp.int32)
+                queued.append((bstart, self.step(
+                    base, jnp.int32(n_valid), salt_w, n_rounds, tgt)))
+            for bstart, (total, counts, lanes, _) in queued:
+                if int(total) == 0:
+                    continue
+                if (np.asarray(counts) > self.hit_capacity).any():
+                    hits.extend(self._rescan(
+                        bstart, min(bstart + self.stride, unit.end), ti))
+                    continue
+                for lane in np.asarray(lanes).ravel():
+                    if lane < 0:
+                        continue
+                    gidx = bstart + int(lane)
+                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+
+class ShardedBcryptWordlistWorker(_BcryptWorkerBase):
+    """Multi-chip bcrypt wordlist worker.  Super-batch lanes follow the
+    sharded wordlist convention: lane = r * super_words + word lane."""
+
+    def __init__(self, engine, gen, targets, mesh,
+                 word_batch_per_device: int = 1 << 9,
+                 hit_capacity: int = 64, oracle=None):
+        super().__init__(engine, gen, targets,
+                         mesh.devices.size * word_batch_per_device
+                         * gen.n_rules, hit_capacity, oracle)
+        self.mesh = mesh
+        self.step = make_sharded_bcrypt_wordlist_step(
+            gen, mesh, word_batch_per_device, hit_capacity)
+        self.super_words = self.step.super_words
+        self.word_batch = self.super_words
+        self.stride = self.super_words * gen.n_rules
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        R = self.gen.n_rules
+        w_start, w_end = word_cover_range(unit, R)
+        hits: list[Hit] = []
+        for ti in range(len(self.targets)):
+            salt_w, n_rounds, tgt = self._targs[ti]
+            queued = []
+            for ws in range(w_start, w_end, self.super_words):
+                nw = min(self.super_words, w_end - ws,
+                         self.gen.n_words - ws)
+                if nw <= 0:
+                    break
+                queued.append((ws, nw, self.step(
+                    jnp.int32(ws), jnp.int32(nw), salt_w, n_rounds, tgt)))
+            for ws, nw, (total, counts, lanes, _) in queued:
+                if int(total) == 0:
+                    continue
+                if (np.asarray(counts) > self.hit_capacity).any():
+                    start = max(unit.start, ws * R)
+                    end = min(unit.end, (ws + nw) * R)
+                    hits.extend(self._rescan(start, end, ti))
+                    continue
+                for lane in np.asarray(lanes).ravel():
+                    if lane < 0:
+                        continue
+                    gidx = wordlist_lane_to_gidx(int(lane), ws,
+                                                 self.super_words, R)
+                    if not unit.start <= gidx < unit.end:
+                        continue
                     hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
         return hits
 

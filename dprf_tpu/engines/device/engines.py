@@ -55,16 +55,40 @@ class JaxEngineBase(DeviceHashEngine, HashEngine):
         when eligible (see ops/pallas_mask.pallas_mode): exact
         single-target compare, or the Bloom-prefilter multi-target path
         (which needs an oracle to verify maybes -- without one the job
-        stays on the generic fused XLA pipeline)."""
+        stays on the generic fused XLA pipeline).
+
+        A kernel that fails to build or compile (a Mosaic lowering
+        regression, an unexpected shape) must not abort the job: the
+        construction + warmup compile is wrapped, and on failure the
+        job degrades to the generic XLA pipeline with a loud warning.
+        """
         from dprf_tpu.ops.pallas_mask import kernel_eligible, pallas_mode
+        from dprf_tpu.utils.logging import DEFAULT as log
         mode = pallas_mode()
-        if (mode is not None and kernel_eligible(self.name, gen,
-                                                 len(targets))
-                and (len(targets) == 1 or oracle is not None)):
+        if mode is not None and not kernel_eligible(self.name, gen,
+                                                    len(targets)):
+            # weak-spot visibility: `--impl auto` users otherwise can't
+            # tell which path ran without reading the result JSON
+            log.info("pallas kernel not eligible for this job; "
+                     "using the XLA pipeline", engine=self.name,
+                     targets=len(targets))
+        elif mode is not None and len(targets) > 1 and oracle is None:
+            log.info("pallas multi-target kernel needs an oracle to "
+                     "verify Bloom maybes; using the XLA pipeline",
+                     engine=self.name, targets=len(targets))
+        elif mode is not None:
             from dprf_tpu.runtime.worker import PallasMaskWorker
-            return PallasMaskWorker(self, gen, targets, batch=batch,
-                                    hit_capacity=hit_capacity,
-                                    oracle=oracle, **mode)
+            try:
+                worker = PallasMaskWorker(self, gen, targets, batch=batch,
+                                          hit_capacity=hit_capacity,
+                                          oracle=oracle, **mode)
+                worker.warmup()
+                return worker
+            except Exception as e:
+                log.warn("pallas kernel failed to build/compile; "
+                         "falling back to the XLA pipeline",
+                         engine=self.name,
+                         error=f"{type(e).__name__}: {e}")
         from dprf_tpu.runtime.worker import DeviceMaskWorker
         return DeviceMaskWorker(self, gen, targets, batch=batch,
                                 hit_capacity=hit_capacity, oracle=oracle)
@@ -75,6 +99,28 @@ class JaxEngineBase(DeviceHashEngine, HashEngine):
         from dprf_tpu.runtime.worker import DeviceWordlistWorker
         return DeviceWordlistWorker(self, gen, targets, batch=batch,
                                     hit_capacity=hit_capacity, oracle=oracle)
+
+    # -- multi-chip factories (keyspace DP over a 1-D mesh) --------------
+    # Salted engines (bcrypt, PMKID) override these with their own
+    # sharded pipelines, so every engine exposes the same multi-chip
+    # surface and `--devices N` never silently degrades to one chip.
+
+    def make_sharded_mask_worker(self, gen, targets, mesh,
+                                 batch_per_device: int, hit_capacity: int,
+                                 oracle=None):
+        from dprf_tpu.parallel.worker import ShardedMaskWorker
+        return ShardedMaskWorker(self, gen, targets, mesh,
+                                 batch_per_device=batch_per_device,
+                                 hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_sharded_wordlist_worker(self, gen, targets, mesh,
+                                     word_batch_per_device: int,
+                                     hit_capacity: int, oracle=None):
+        from dprf_tpu.parallel.worker import ShardedWordlistWorker
+        return ShardedWordlistWorker(
+            self, gen, targets, mesh,
+            word_batch_per_device=word_batch_per_device,
+            hit_capacity=hit_capacity, oracle=oracle)
 
     # -- host-facing HashEngine API --------------------------------------
 

@@ -209,7 +209,7 @@ def parse_rules(lines: Iterable[str],
 
 _RULES_DIR = os.path.join(os.path.dirname(__file__), "data")
 
-BUILTIN_RULESETS = ("best64", "leetspeak", "toggle")
+BUILTIN_RULESETS = ("best64", "dprf64", "leetspeak", "toggle")
 
 
 def builtin_ruleset(name: str) -> str:

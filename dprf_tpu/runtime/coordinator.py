@@ -75,7 +75,8 @@ class Coordinator:
                  session: Optional[SessionJournal] = None,
                  potfile: Optional[Potfile] = None,
                  progress_cb: Optional[Callable] = None,
-                 progress_interval: float = 5.0):
+                 progress_interval: float = 5.0,
+                 oracle=None):
         self.spec = spec
         self.targets = list(targets)
         self.dispatcher = dispatcher
@@ -84,6 +85,14 @@ class Coordinator:
         self.potfile = potfile
         self.progress_cb = progress_cb
         self.progress_interval = progress_interval
+        #: CPU oracle HashEngine.  Device hits are re-hashed on the host
+        #: before they reach the potfile -- the same guard the distributed
+        #: path applies in rpc.CoordinatorState (a kernel/XLA bug must
+        #: not poison the potfile or silently end the search for a
+        #: target it did not crack).  None = trust the worker (CPU path,
+        #: where the worker IS the oracle).
+        self.oracle = oracle
+        self.rejected = 0
         self.found: dict[int, bytes] = {}
 
     # -- pre-run bookkeeping ---------------------------------------------
@@ -101,16 +110,42 @@ class Coordinator:
     def _all_found(self) -> bool:
         return len(self.found) >= len(self.targets)
 
-    def _record(self, hit: Hit) -> None:
+    def _record(self, hit: Hit) -> bool:
+        """Record one verified hit; returns False (and records nothing)
+        if the oracle re-hash rejects it."""
         if hit.target_index in self.found:
-            return
-        self.found[hit.target_index] = hit.plaintext
+            return True
         target = self.targets[hit.target_index]
+        if self.oracle is not None and not self.oracle.verify(hit.plaintext,
+                                                              target):
+            from dprf_tpu.utils.logging import DEFAULT as log
+            self.rejected += 1
+            log.warn("rejected unverifiable device hit; rescanning unit "
+                     "with the CPU oracle", target=target.raw[:32],
+                     cand_index=hit.cand_index)
+            return False
+        self.found[hit.target_index] = hit.plaintext
         if self.potfile is not None:
             self.potfile.add(target.raw, hit.plaintext)
         if self.session is not None:
             self.session.record_hit(hit.target_index, hit.cand_index,
                                     hit.plaintext)
+        return True
+
+    def _process_unit(self, unit) -> None:
+        """Run one unit through the worker; any rejected hit means the
+        device path is suspect for this range, so the whole unit is
+        exactly rescanned with the CPU oracle (whose hits verify by
+        construction) before the unit may count as covered."""
+        rejected = False
+        for hit in self.worker.process(unit):
+            rejected |= not self._record(hit)
+        if rejected:
+            from dprf_tpu.runtime.worker import CpuWorker
+            rescan = CpuWorker(self.oracle, self.worker.gen,
+                               self.worker.targets)
+            for hit in rescan.process(unit):
+                self._record(hit)   # oracle-produced: verifies trivially
 
     def run(self) -> JobResult:
         t0 = time.perf_counter()
@@ -126,8 +161,7 @@ class Coordinator:
                         break        # exhausted
                     time.sleep(0.01)
                     continue
-                for hit in self.worker.process(unit):
-                    self._record(hit)
+                self._process_unit(unit)
                 self.dispatcher.complete(unit.unit_id)
                 if self.session is not None:
                     self.session.record_units(

@@ -111,6 +111,16 @@ class MaskWorkerBase:
         self._order = np.zeros(1, dtype=np.int64)
         return target_words(digests[0], engine.little_endian)
 
+    def warmup(self) -> None:
+        """Force the step's compile now (jit is lazy).  The engine
+        factory calls this so a Mosaic/XLA compile failure surfaces at
+        worker construction -- where it can fall back to another path --
+        instead of mid-job."""
+        import jax
+        import jax.numpy as jnp
+        base = jnp.asarray(self.gen.digits(0), dtype=jnp.int32)
+        jax.block_until_ready(self.step(base, jnp.int32(0)))
+
     def process(self, unit: WorkUnit) -> list[Hit]:
         import jax.numpy as jnp
         queued = []

@@ -9,8 +9,14 @@ import time
 class Log:
     def __init__(self, quiet: bool = False, stream=None):
         self.quiet = quiet
-        self.stream = stream or sys.stderr
+        # None = resolve sys.stderr at emit time (so redirection and
+        # test capture see module-level loggers created at import)
+        self._stream = stream
         self._t0 = time.monotonic()
+
+    @property
+    def stream(self):
+        return self._stream or sys.stderr
 
     def _emit(self, level: str, msg: str, **kv) -> None:
         if self.quiet and level == "info":
@@ -29,3 +35,8 @@ class Log:
 
     def error(self, msg: str, **kv) -> None:
         self._emit("error", msg, **kv)
+
+
+#: module-level logger for library code with no Log threaded through
+#: (engine factories, workers); the CLI's Log instances stay canonical.
+DEFAULT = Log()

@@ -126,7 +126,7 @@ def test_crack_wordlist_rules_sha256(tmp_path, capsys, device):
     """Benchmark config 3: SHA-256 raw, wordlist + best64 rules."""
     wl = tmp_path / "wl.txt"
     wl.write_text("winter\nflower\ndragon\nsunshine\n")
-    secret = b"Dragon1"        # "dragon" via best64's "c $1"
+    secret = b"dragon123"      # "dragon" via best64's "$1 $2 $3"
     digest = hashlib.sha256(secret).hexdigest()
     hashfile = _mk_hashfile(tmp_path, [digest])
     rc, out = run_cli(["crack", str(wl), hashfile, "--engine", "sha256",
@@ -134,7 +134,7 @@ def test_crack_wordlist_rules_sha256(tmp_path, capsys, device):
                        "--device", device, "--no-potfile",
                        "--batch", "256", "-q"], capsys)
     assert rc == 0
-    assert f"{digest}:Dragon1" in out
+    assert f"{digest}:dragon123" in out
 
 
 def test_crack_wordlist_no_rules_ntlm(tmp_path, capsys):

@@ -279,3 +279,52 @@ def test_pallas_multi_target_matches_xla(engine):
     assert phits == xhits
     assert [c for _, c, _ in phits] == plant_idx
     assert [p for _, _, p in phits] == plants
+
+
+def test_make_mask_worker_falls_back_on_kernel_failure(monkeypatch, capsys):
+    """A kernel that fails to build/compile (Mosaic regression) must
+    degrade to the XLA DeviceMaskWorker with a warning, not abort."""
+    import dprf_tpu.runtime.worker as worker_mod
+    from dprf_tpu.runtime.worker import DeviceMaskWorker
+
+    monkeypatch.setenv("DPRF_PALLAS", "1")
+
+    class Boom(worker_mod.PallasMaskWorker):
+        def __init__(self, *a, **kw):
+            raise RuntimeError("injected Mosaic lowering failure")
+
+    monkeypatch.setattr(worker_mod, "PallasMaskWorker", Boom)
+    gen = MaskGenerator("?l?l?l")
+    eng = get_engine("sha1", device="jax")
+    t1 = eng.parse_target(hashlib.sha1(b"abc").hexdigest())
+    w = eng.make_mask_worker(gen, [t1], batch=TILE, hit_capacity=8)
+    assert isinstance(w, DeviceMaskWorker)
+    err = capsys.readouterr().err
+    assert "falling back to the XLA pipeline" in err
+    # and the fallback worker actually cracks
+    planted = gen.index_of(b"dog")
+    tdog = eng.parse_target(hashlib.sha1(b"dog").hexdigest())
+    w = eng.make_mask_worker(gen, [tdog], batch=TILE, hit_capacity=8)
+    hits = w.process(WorkUnit(-1, 0, gen.keyspace))
+    assert [h.cand_index for h in hits] == [planted]
+
+
+def test_make_mask_worker_warmup_failure_falls_back(monkeypatch, capsys):
+    """A compile failure at first call (not construction) is also
+    caught: warmup() forces the compile inside the factory's guard."""
+    import dprf_tpu.runtime.worker as worker_mod
+    from dprf_tpu.runtime.worker import DeviceMaskWorker
+
+    monkeypatch.setenv("DPRF_PALLAS", "1")
+
+    class LateBoom(worker_mod.PallasMaskWorker):
+        def warmup(self):
+            raise RuntimeError("injected compile failure")
+
+    monkeypatch.setattr(worker_mod, "PallasMaskWorker", LateBoom)
+    gen = MaskGenerator("?l?l?l")
+    eng = get_engine("sha1", device="jax")
+    t1 = eng.parse_target(hashlib.sha1(b"abc").hexdigest())
+    w = eng.make_mask_worker(gen, [t1], batch=TILE, hit_capacity=8)
+    assert isinstance(w, DeviceMaskWorker)
+    assert "falling back" in capsys.readouterr().err

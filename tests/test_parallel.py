@@ -155,3 +155,82 @@ def test_sharded_worker_matches_cpu_worker(mesh):
         (1, gen.index_of(b"9999"), b"9999"),
         (2, gen.index_of(b"1234"), b"1234"),
     ]
+
+
+# ------------------------------------------------- salted engines (r3)
+
+def test_sharded_bcrypt_mask_worker(mesh):
+    """Config 4's engine on the 8-chip mesh: planted password found,
+    hits identical to the single-chip worker."""
+    from dprf_tpu.engines.cpu.bcrypt import bcrypt_hash
+    from dprf_tpu.engines.device.bcrypt import (BcryptMaskWorker,
+                                                ShardedBcryptMaskWorker)
+
+    eng = get_engine("bcrypt", device="jax")
+    cpu = get_engine("bcrypt", device="cpu")
+    gen = MaskGenerator("?d?d?l")
+    pw = b"42x"
+    line = bcrypt_hash(pw, bytes(range(16)), cost=4)
+    targets = [cpu.parse_target(line)]
+    sharded = ShardedBcryptMaskWorker(eng, gen, targets, mesh,
+                                      batch_per_device=32)
+    hits = sharded.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, pw)]
+    single = BcryptMaskWorker(eng, gen, targets, batch=256)
+    assert ([(h.target_index, h.cand_index, h.plaintext)
+             for h in single.process(WorkUnit(0, 0, gen.keyspace))]
+            == [(h.target_index, h.cand_index, h.plaintext) for h in hits])
+
+
+def test_sharded_bcrypt_wordlist_worker(mesh):
+    from dprf_tpu.engines.cpu.bcrypt import bcrypt_hash
+    from dprf_tpu.engines.device.bcrypt import ShardedBcryptWordlistWorker
+    from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+    from dprf_tpu.rules.parser import parse_rule
+
+    eng = get_engine("bcrypt", device="jax")
+    cpu = get_engine("bcrypt", device="cpu")
+    words = [b"alpha", b"beta", b"gamma", b"delta", b"omega"]
+    rules = [parse_rule(":"), parse_rule("u"), parse_rule("$1")]
+    gen = WordlistRulesGenerator(words, rules)
+    pw = b"GAMMA"        # gamma + 'u' rule
+    line = bcrypt_hash(pw, bytes(range(16)), cost=4)
+    targets = [cpu.parse_target(line)]
+    w = ShardedBcryptWordlistWorker(eng, gen, targets, mesh,
+                                    word_batch_per_device=2)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, pw)]
+    assert gen.candidate(hits[0].cand_index) == pw
+
+
+def test_sharded_pmkid_worker(mesh):
+    """Config 5's pod-scale path on the fake mesh, including the
+    multi-match lane (same passphrase cracking two captures)."""
+    import hashlib as _hl
+    import hmac as _hmac
+    from dprf_tpu.engines.device.pmkid import ShardedPmkidWorker
+
+    eng = get_engine("wpa2-pmkid", device="jax")
+    cpu = get_engine("wpa2-pmkid", device="cpu")
+    eng.iterations = cpu.iterations = 64
+    try:
+        gen = MaskGenerator("pw?d?d")
+        ap = bytes.fromhex("aabbccddeeff")
+        sta = bytes.fromhex("112233445566")
+
+        def line(pw, essid):
+            pmk = _hl.pbkdf2_hmac("sha1", pw, essid, 64, 32)
+            pmkid = _hmac.new(pmk, b"PMK Name" + ap + sta,
+                              _hl.sha1).digest()[:16]
+            return f"{pmkid.hex()}*{ap.hex()}*{sta.hex()}*{essid.hex()}"
+
+        targets = [cpu.parse_target(line(b"pw37", b"NetA")),
+                   cpu.parse_target(line(b"pw55", b"NetB")),
+                   cpu.parse_target(line(b"pw55", b"NetA"))]
+        w = ShardedPmkidWorker(eng, gen, targets, mesh,
+                               batch_per_device=8, oracle=cpu)
+        hits = w.process(WorkUnit(0, 0, gen.keyspace))
+        got = sorted((h.target_index, h.plaintext) for h in hits)
+        assert got == [(0, b"pw37"), (1, b"pw55"), (2, b"pw55")]
+    finally:
+        del eng.iterations, cpu.iterations     # restore class attrs

@@ -415,3 +415,35 @@ def test_sharded_wordlist_step():
             r, bglob = divmod(int(lane), super_words)
             found.add((w0 + bglob) * 2 + r)
     assert found == plant_idx
+
+
+def test_builtin_rulesets_device_equivalence():
+    """Every rule line of every builtin set (incl. the published best64
+    reconstruction) produces identical words/rejections through the
+    device compiler and the CPU oracle interpreter."""
+    from dprf_tpu.rules.parser import BUILTIN_RULESETS, load_rules
+
+    words = [b"password", b"Summer", b"a", b"", b"Pa55 word!", b"qwertyuiop"]
+    ML = 20
+    B = len(words)
+    buf = np.zeros((B, ML), dtype=np.uint8)
+    lens = np.zeros((B,), dtype=np.int32)
+    for i, w in enumerate(words):
+        buf[i, :len(w)] = np.frombuffer(w, dtype=np.uint8)
+        lens[i] = len(w)
+    w_dev, l_dev = jnp.asarray(buf), jnp.asarray(lens)
+    v_dev = jnp.ones((B,), dtype=bool)
+
+    for name in BUILTIN_RULESETS:
+        for ops in load_rules(name):
+            out_w, out_l, out_v = map(np.asarray,
+                                      apply_rule_dev(w_dev, l_dev, v_dev,
+                                                     ops, ML))
+            for i, word in enumerate(words):
+                want = apply_rule_cpu(word, ops, max_len=ML)
+                if want is None:
+                    assert not out_v[i], (name, ops, word)
+                else:
+                    assert out_v[i], (name, ops, word)
+                    assert bytes(out_w[i, :out_l[i]]) == want, \
+                        (name, ops, word)

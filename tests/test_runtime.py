@@ -168,3 +168,67 @@ def test_dispatcher_chaos_full_coverage():
                 d.complete(rng.choice(completed_ids))
     assert d.done()
     assert d.completed_intervals() == [(0, 10_000)]
+
+
+def test_coordinator_rejects_unverifiable_hit_and_rescans(tmp_path):
+    """A buggy device worker reporting a wrong plaintext must not poison
+    the potfile: the local Coordinator re-hashes hits with the CPU
+    oracle, rejects the fake, and exactly rescans the unit -- finding
+    the true crack the buggy worker missed (VERDICT r2 weak #3)."""
+    from dprf_tpu.engines import get_engine
+    from dprf_tpu.generators.mask import MaskGenerator
+    from dprf_tpu.runtime.coordinator import Coordinator, JobSpec
+    from dprf_tpu.runtime.worker import Hit
+    from dprf_tpu.runtime.workunit import WorkUnit
+
+    oracle = get_engine("md5", device="cpu")
+    gen = MaskGenerator("?l?l?l")
+    secret = b"fox"
+    target = oracle.parse_target(
+        __import__("hashlib").md5(secret).hexdigest())
+
+    class BuggyWorker:
+        """Claims a wrong plaintext for the target, never the real one."""
+        def __init__(self):
+            self.gen = gen
+            self.targets = [target]
+
+        def process(self, unit: WorkUnit):
+            if unit.start <= gen.index_of(secret) < unit.end:
+                return [Hit(0, unit.start, b"zzz")]   # fake plaintext
+            return []
+
+    pot = Potfile(str(tmp_path / "pot"))
+    spec = JobSpec(engine="md5", device="jax", attack="mask",
+                   attack_arg="?l?l?l", keyspace=gen.keyspace,
+                   fingerprint="t")
+    disp = Dispatcher(gen.keyspace, 26 * 26)
+    coord = Coordinator(spec, [target], disp, BuggyWorker(),
+                        potfile=pot, oracle=oracle)
+    result = coord.run()
+    assert coord.rejected >= 1
+    assert result.found == {0: secret}          # rescan found the truth
+    assert pot.get(target.raw) == secret        # potfile never poisoned
+
+
+def test_coordinator_cpu_path_trusts_worker(tmp_path):
+    """oracle=None (the CPU path) records hits directly -- no double
+    hashing of every CpuWorker hit."""
+    from dprf_tpu.engines import get_engine
+    from dprf_tpu.generators.mask import MaskGenerator
+    from dprf_tpu.runtime.coordinator import Coordinator, JobSpec
+    from dprf_tpu.runtime.worker import CpuWorker
+
+    oracle = get_engine("md5", device="cpu")
+    gen = MaskGenerator("?l?l")
+    secret = b"ok"
+    target = oracle.parse_target(
+        __import__("hashlib").md5(secret).hexdigest())
+    spec = JobSpec(engine="md5", device="cpu", attack="mask",
+                   attack_arg="?l?l", keyspace=gen.keyspace,
+                   fingerprint="t")
+    disp = Dispatcher(gen.keyspace, 64)
+    coord = Coordinator(spec, [target], disp,
+                        CpuWorker(oracle, gen, [target]))
+    result = coord.run()
+    assert result.found == {0: secret} and coord.rejected == 0

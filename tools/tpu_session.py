@@ -1,0 +1,165 @@
+"""One TPU-client session: Mosaic lowering proof + kernel benches.
+
+The axon tunnel serves ONE client at a time and wedges if a client is
+killed mid-handshake (see tools/tpu_probe.py).  So this script does all
+real-TPU work for a round in a single process, reports progress through
+a status file (atomic replace, poll it -- NEVER kill this process), and
+exits cleanly whatever happens.
+
+Stages:
+  1. lowering -- compile + run every Pallas kernel variant on the real
+     chip with a planted target; record compile time and correctness.
+  2. bench    -- sustained H/s for the MD5 kernel and the XLA pipeline
+     (the BENCH north-star paths), plus NTLM multi-target and SHA-256.
+
+Results land in TPU_SESSION_OUT (default /tmp/tpu_session_results.json).
+"""
+
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STATUS = os.environ.get("TPU_SESSION_STATUS", "/tmp/tpu_session_status.json")
+OUT = os.environ.get("TPU_SESSION_OUT", "/tmp/tpu_session_results.json")
+
+RESULTS = {"stages": {}, "started": time.time()}
+
+
+def write_status(stage, **kw):
+    tmp = STATUS + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"stage": stage, "t": time.time(), **kw}, f)
+        f.write("\n")
+    os.replace(tmp, STATUS)
+
+
+def flush_results():
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(RESULTS, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, OUT)
+
+
+def plant_target(engine_name, gen, index):
+    """CPU-oracle digest words for the candidate at `index`."""
+    import numpy as np
+    from dprf_tpu import get_engine
+    oracle = get_engine(engine_name, device="cpu")
+    cand = gen.candidate(index)
+    digest = oracle.hash_batch([cand])[0]
+    dt = "<u4" if engine_name in ("md5", "ntlm") else ">u4"
+    return np.frombuffer(digest, dtype=dt).astype(np.uint32), cand
+
+
+def check_lowering():
+    import numpy as np
+    import jax
+    from dprf_tpu.generators.mask import MaskGenerator
+    from dprf_tpu.ops import pallas_mask as pm
+
+    cases = [
+        ("md5", "?l?l?l?l?l?l", 1),
+        ("sha1", "?l?l?l?l?l?l", 1),
+        ("ntlm", "?a?a?a?a?a?a?a", 1),
+        ("sha256", "?l?l?l?l?l?l?l?l", 1),
+        ("md5", "?a?a?a?a?a?a?a", 1000),   # Bloom multi-target gather
+        ("ntlm", "?a?a?a?a?a?a?a", 1000),
+    ]
+    out = {}
+    for engine, mask, n_targets in cases:
+        name = f"{engine}/{n_targets}t"
+        write_status("lowering", case=name)
+        rec = {"engine": engine, "mask": mask, "targets": n_targets}
+        try:
+            gen = MaskGenerator(mask)
+            batch = pm.TILE * 4
+            plant_idx = pm.TILE + 7   # tile 1, lane 7
+            tw, cand = plant_target(engine, gen, plant_idx)
+            if n_targets > 1:
+                rng = np.random.RandomState(42)
+                tws = rng.randint(0, 2**32, (n_targets, tw.shape[0]),
+                                  dtype=np.uint32)
+                tws[313] = tw   # bury the real target mid-list
+                tw = tws
+            t0 = time.perf_counter()
+            fn = pm.make_mask_pallas_fn(engine, gen, tw, batch)
+            import jax.numpy as jnp
+            base = jnp.asarray(gen.digits(0), jnp.int32)
+            counts, lanes = jax.block_until_ready(
+                fn(base, jnp.asarray([batch], jnp.int32)))
+            rec["compile_s"] = round(time.perf_counter() - t0, 2)
+            counts = np.asarray(counts)[:, 0]
+            lanes = np.asarray(lanes)[:, 0]
+            hits = [(t * pm.TILE + lanes[t]) for t in np.nonzero(counts)[0]]
+            if n_targets > 1:
+                # multi-target counts are Bloom MAYBE counts: the planted
+                # hit must be present; a stray false maybe (p ~ 1.5e-5 per
+                # lane) is tolerated, not a failure.
+                rec["ok"] = (plant_idx in hits and int(counts.sum()) <= 3)
+            else:
+                rec["ok"] = (int(counts.sum()) == 1 and hits == [plant_idx])
+            rec["hits"] = [int(h) for h in hits]
+            if not rec["ok"]:
+                rec["counts_nonzero"] = int((counts > 0).sum())
+        except Exception as e:  # record, keep going
+            rec["ok"] = False
+            rec["error"] = f"{type(e).__name__}: {e}"
+            rec["traceback"] = traceback.format_exc()[-1500:]
+        out[name] = rec
+        RESULTS["stages"]["lowering"] = out
+        flush_results()
+    return out
+
+
+def bench_all():
+    from dprf_tpu.bench import run_bench
+    out = {}
+    runs = [
+        ("md5-pallas", dict(engine="md5", impl="pallas", batch=1 << 24)),
+        ("md5-xla", dict(engine="md5", impl="xla", batch=1 << 22)),
+        ("ntlm-pallas", dict(engine="ntlm", impl="pallas",
+                             mask="?a?a?a?a?a?a?a", batch=1 << 24)),
+        ("sha1-pallas", dict(engine="sha1", impl="pallas", batch=1 << 24)),
+        ("sha256-pallas", dict(engine="sha256", impl="pallas",
+                               batch=1 << 23)),
+        ("sha256-xla", dict(engine="sha256", impl="xla", batch=1 << 21)),
+    ]
+    for name, kw in runs:
+        write_status("bench", case=name)
+        try:
+            out[name] = run_bench(device="jax", seconds=10.0, **kw)
+        except Exception as e:
+            out[name] = {"error": f"{type(e).__name__}: {e}",
+                         "traceback": traceback.format_exc()[-1500:]}
+        RESULTS["stages"]["bench"] = out
+        flush_results()
+    return out
+
+
+def main():
+    write_status("starting", pid=os.getpid())
+    import jax
+    devs = jax.devices()
+    RESULTS["devices"] = [str(d) for d in devs]
+    RESULTS["platform"] = devs[0].platform
+    write_status("devices", devices=RESULTS["devices"])
+    flush_results()
+    if devs[0].platform != "tpu":
+        write_status("done", ok=False, note="no TPU")
+        return 1
+    check_lowering()
+    bench_all()
+    RESULTS["finished"] = time.time()
+    flush_results()
+    write_status("done", ok=True)
+    print("TPU session complete")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
