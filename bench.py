@@ -1,12 +1,24 @@
 #!/usr/bin/env python
 """Driver benchmark entry: prints ONE JSON line.
 
-Runs the MD5 mask-attack fused pipeline on the real TPU (config 1's
-throughput path).  The TPU is reached through a one-client-at-a-time
-tunnel that can wedge if a previous client died mid-session, so the
-device run happens in a subprocess under a watchdog; if it can't
-complete, we emit a CPU-measured line tagged accordingly rather than
-hanging the driver.
+Runs the MD5 mask-attack pipeline on the real TPU (config 1's
+throughput path), measuring BOTH implementations -- the hand-written
+Pallas kernel and the generic fused XLA pipeline -- and reporting the
+better one as the headline number.
+
+Wedge-safety (VERDICT r2 weak #1): the TPU is reached through a
+one-client-at-a-time tunnel that WEDGES if a client process is killed
+mid-session.  So nothing here ever kills a TPU client:
+
+- the tunnel probe is tools/tpu_probe.py run detached, reporting
+  through a status file; on deadline we fall back to CPU and simply
+  stop watching it (the probe exits on its own whenever the tunnel
+  answers);
+- the device bench is likewise a detached child reporting through a
+  result file, abandoned -- never killed -- on deadline.
+
+The CPU fallback child never touches the tunnel (jax.config forces the
+CPU backend before any device init), so it is safe to wait on directly.
 
 vs_baseline is measured rate / the BASELINE.json north-star target of
 1e11 MD5 candidates/sec/chip (no published reference numbers exist;
@@ -17,72 +29,162 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 BASELINE_TARGET = 1.0e11   # MD5 H/s/chip north-star target
-TIMEOUT_S = 540
+PROBE_DEADLINE_S = 240     # tunnel handshake + one tiny computation
+DEVICE_DEADLINE_S = 600    # two compiles + two 10 s timed runs
+CPU_TIMEOUT_S = 300
 
-_PROBE = "import jax; jax.devices()"
+_DEVICE_CHILD = r"""
+import json, os
+out = {{}}
+from dprf_tpu.bench import run_bench
+for impl, batch in (("pallas", 1 << 24), ("xla", 1 << 22)):
+    try:
+        out[impl] = run_bench(engine="md5", device="jax",
+                              mask="?a?a?a?a?a?a?a?a", batch=batch,
+                              seconds=10.0, impl=impl)
+    except Exception as e:
+        out[impl] = {{"error": f"{{type(e).__name__}}: {{e}}"}}
+    tmp = {path!r} + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f)
+    os.replace(tmp, {path!r})
+out["done"] = True
+tmp = {path!r} + ".tmp"
+with open(tmp, "w") as f:
+    json.dump(out, f)
+os.replace(tmp, {path!r})
+"""
 
-# The tunnel serves one client at a time and wedges if a client dies
-# mid-session, so: probe first, keep all device work in watchdogged
-# subprocesses, and force the CPU backend via jax.config (env vars
-# alone cannot override the site-registered axon platform).
-_CHILD = r"""
+_CPU_CHILD = r"""
 import json
-{force_cpu}
+import jax
+jax.config.update("jax_platforms", "cpu")
 from dprf_tpu.bench import run_bench
 res = run_bench(engine="md5", device="jax", mask="?a?a?a?a?a?a?a?a",
-                batch={batch}, seconds=10.0)
+                batch=1 << 16, seconds=10.0, impl="xla")
 print("BENCH_JSON:" + json.dumps(res))
 """
-_FORCE_CPU = 'import jax; jax.config.update("jax_platforms", "cpu")'
 
 
-def _run_child(env, force_cpu: bool, batch: int, timeout: int):
-    code = _CHILD.format(force_cpu=_FORCE_CPU if force_cpu else "",
-                         batch=batch)
+def _read_json(path):
     try:
-        proc = subprocess.run([sys.executable, "-c", code], env=env,
+        with open(path) as f:
+            return json.load(f)
+    except (FileNotFoundError, ValueError):
+        return None
+
+
+def _spawn_detached(cmd, env, log_path):
+    """Start a child we will poll via files and NEVER kill."""
+    with open(log_path, "ab") as log:
+        return subprocess.Popen(cmd, env=env, stdout=log, stderr=log,
+                                start_new_session=True)
+
+
+def _poll(path, deadline_s, done):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        doc = _read_json(path)
+        if doc is not None and done(doc):
+            return doc
+        time.sleep(2)
+    return _read_json(path)   # last look; may still satisfy done()
+
+
+def _tpu_available(env, workdir) -> bool:
+    """Cooperative probe: detached tools/tpu_probe.py + status file."""
+    status = os.path.join(workdir, "bench_probe_status.json")
+    try:
+        os.unlink(status)
+    except FileNotFoundError:
+        pass
+    probe_env = dict(env, TPU_PROBE_STATUS=status)
+    _spawn_detached(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "tpu_probe.py")],
+        probe_env, os.path.join(workdir, "bench_probe.log"))
+    doc = _poll(status, PROBE_DEADLINE_S,
+                lambda d: d.get("stage") == "compute_ok")
+    if doc is None or doc.get("stage") != "compute_ok":
+        stage = (doc or {}).get("stage", "no status")
+        sys.stderr.write(
+            f"bench: TPU probe did not complete within "
+            f"{PROBE_DEADLINE_S}s (stage: {stage}); probe left running, "
+            "falling back to CPU\n")
+        return False
+    # give the probe process a moment to exit and release the tunnel's
+    # single client slot before the bench child connects
+    time.sleep(5)
+    return True
+
+
+def _run_device(env, workdir):
+    result = os.path.join(workdir, "bench_device_result.json")
+    try:
+        os.unlink(result)
+    except FileNotFoundError:
+        pass
+    code = _DEVICE_CHILD.format(path=result)
+    _spawn_detached([sys.executable, "-c", code], env,
+                    os.path.join(workdir, "bench_device.log"))
+    doc = _poll(result, DEVICE_DEADLINE_S, lambda d: d.get("done"))
+    if doc is None or not doc.get("done"):
+        sys.stderr.write(
+            f"bench: device run incomplete after {DEVICE_DEADLINE_S}s "
+            f"(partial: {list((doc or {}))}); child left running, "
+            "falling back to CPU\n")
+        # a partial result with a finished impl is still usable
+        if doc and any(isinstance(v, dict) and "value" in v
+                       for v in doc.values()):
+            return doc
+        return None
+    return doc
+
+
+def _run_cpu(env):
+    try:
+        proc = subprocess.run([sys.executable, "-c", _CPU_CHILD], env=env,
                               capture_output=True, text=True,
-                              timeout=timeout)
+                              timeout=CPU_TIMEOUT_S)
     except subprocess.TimeoutExpired:
-        return None, "watchdog timeout"
+        return None
     for line in proc.stdout.splitlines():
         if line.startswith("BENCH_JSON:"):
-            return json.loads(line[len("BENCH_JSON:"):]), None
-    return None, proc.stderr[-2000:]
+            return json.loads(line[len("BENCH_JSON:"):])
+    sys.stderr.write(f"bench: CPU fallback failed "
+                     f"({proc.stderr[-2000:]})\n")
+    return None
 
 
 def main() -> int:
     repo = os.path.dirname(os.path.abspath(__file__))
+    workdir = os.environ.get("DPRF_BENCH_DIR", "/tmp")
     env = dict(os.environ)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    res = None
 
-    # cheap tunnel-health probe before committing to a long device run
-    tpu_ok = False
-    try:
-        tpu_ok = subprocess.run([sys.executable, "-c", _PROBE], env=env,
-                                capture_output=True,
-                                timeout=120).returncode == 0
-    except subprocess.TimeoutExpired:
-        sys.stderr.write("bench: TPU tunnel probe hung (wedged tunnel); "
-                         "using CPU backend\n")
-
-    if tpu_ok:
-        res, err = _run_child(env, force_cpu=False, batch=1 << 22,
-                              timeout=TIMEOUT_S)
-        if res is None:
-            sys.stderr.write(f"bench: device run failed ({err}); "
-                             "falling back to CPU\n")
+    res, extras = None, {}
+    if _tpu_available(env, workdir):
+        device_doc = _run_device(env, workdir)
+        if device_doc:
+            impls = {k: v for k, v in device_doc.items()
+                     if isinstance(v, dict) and "value" in v}
+            if impls:
+                best = max(impls, key=lambda k: impls[k]["value"])
+                res = impls[best]
+                for k, v in impls.items():
+                    extras[f"{k}_hs"] = v["value"]
+                for k, v in device_doc.items():
+                    if isinstance(v, dict) and "error" in v:
+                        extras[f"{k}_error"] = v["error"]
 
     if res is None:
-        res, err = _run_child(env, force_cpu=True, batch=1 << 16,
-                              timeout=TIMEOUT_S)
+        res = _run_cpu(env)
         if res is not None:
             res["note"] = "CPU fallback - TPU unavailable"
-        elif err:
-            sys.stderr.write(f"bench: CPU fallback failed ({err})\n")
 
     if res is None:
         print(json.dumps({"metric": "md5 candidates/sec/chip", "value": 0,
@@ -90,12 +192,13 @@ def main() -> int:
                           "note": "bench failed"}))
         return 1
 
-    out = {"metric": res["metric"], "value": res["value"],
-           "unit": res["unit"],
-           "vs_baseline": res["value"] / BASELINE_TARGET}
-    for k in ("device", "batch", "batches", "elapsed_s", "compile_s", "note"):
+    out = {"metric": "md5 candidates/sec/chip", "value": res["value"],
+           "unit": "H/s", "vs_baseline": res["value"] / BASELINE_TARGET}
+    for k in ("impl", "device", "batch", "batches", "elapsed_s",
+              "compile_s", "note"):
         if k in res:
             out[k] = res[k]
+    out.update(extras)
     print(json.dumps(out))
     return 0
 

@@ -117,6 +117,9 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="measure acceptance workload N (1-5, see "
                    "BASELINE.md) through the real worker path instead "
                    "of the raw engine loop")
+    b.add_argument("--devices", type=int, default=1, metavar="N",
+                   help="scaling mode: measure the sharded step at 1 "
+                   "and N chips and report per-chip rate + efficiency")
     b.add_argument("--bcrypt-cost", type=int, default=12,
                    help="cost for --config 4 (lower it off-TPU)")
     b.add_argument("--profile", default=None, metavar="DIR")
@@ -565,7 +568,13 @@ def cmd_bench(args, log: Log) -> int:
         import jax
         ctx = jax.profiler.trace(args.profile)
     with ctx:
-        if args.config is not None:
+        if args.devices > 1:
+            from dprf_tpu.bench import run_scaling
+            res = run_scaling(engine=args.engine, mask=args.mask,
+                              n_devices=args.devices,
+                              batch_per_device=args.batch,
+                              seconds=args.seconds, log=log)
+        elif args.config is not None:
             res = run_config(args.config,
                              device=_DEVICE_ALIASES[args.device],
                              seconds=args.seconds, batch=args.batch,

@@ -1,0 +1,42 @@
+"""Benchmark-mode smoke tests on the CPU backend: every mode produces
+a well-formed result dict with a positive rate.  Short runs -- these
+validate plumbing and output schema, not performance."""
+
+import jax
+import pytest
+
+from dprf_tpu.bench import run_bench, run_config, run_scaling
+
+
+def test_run_bench_xla_schema():
+    res = run_bench(engine="md5", device="jax", mask="?l?l?l?l?l?l",
+                    batch=4096, seconds=0.3, impl="xla")
+    assert res["value"] > 0
+    assert res["impl"] == "xla"
+    assert res["unit"] == "H/s"
+    assert res["device"] == jax.devices()[0].platform
+    assert res["batches"] >= 1
+
+
+def test_run_bench_cpu_oracle():
+    res = run_bench(engine="md5", device="cpu", mask="?l?l?l?l?l",
+                    batch=2048, seconds=0.3)
+    assert res["value"] > 0 and res["device"] == "cpu"
+
+
+def test_run_config_1_worker_path():
+    res = run_config(1, device="jax", seconds=0.3, batch=4096)
+    assert res["config"] == 1 and res["engine"] == "md5"
+    assert res["value"] > 0 and res["targets"] == 1
+
+
+def test_run_scaling_plumbing():
+    assert len(jax.devices()) >= 2, "conftest fakes 8 CPU devices"
+    res = run_scaling(engine="md5", mask="?l?l?l?l?l?l", n_devices=2,
+                      batch_per_device=2048, seconds=0.3)
+    assert res["n_devices"] == 2
+    assert res["rate_1chip"] > 0 and res["rate_ndev"] > 0
+    assert res["per_chip"] == pytest.approx(res["rate_ndev"] / 2)
+    assert res["efficiency"] == pytest.approx(
+        res["rate_ndev"] / (2 * res["rate_1chip"]))
+    assert "note" in res      # CPU mesh must be labeled plumbing-only
