@@ -548,9 +548,9 @@ def cmd_worker(args, log: Log) -> int:
                             targets, args.batch or job["batch"],
                             job["hit_cap"], engine, args.devices, log)
     worker_id = args.id or f"{_socket.gethostname()}:{os.getpid()}"
-    # worker_loop exits cleanly if the coordinator closes at a lease
-    # boundary (drained job); a close mid-complete propagates as an
-    # error so a coordinator crash cannot read as success.
+    # worker_loop exits cleanly only on an explicit stop signal; any
+    # bare connection drop (coordinator crash) or quarantine raises and
+    # surfaces through main()'s error handler as a nonzero exit.
     done = worker_loop(client, worker, worker_id, log=log)
     log.info("worker done", units=done)
     client.close()

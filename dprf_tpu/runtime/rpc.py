@@ -19,12 +19,17 @@ an already-reissued unit is harmless: hits are deduped by target.
 
 Trust model: optional shared-secret authentication (--token).  When the
 coordinator has a token, every connection must answer an HMAC-SHA256
-challenge on hello before any other op is served; without one the
-protocol is open -- bind to localhost or a trusted network only (same
-stance as hashtopolis-style agents).  The transport is cleartext either
-way: the token authenticates peers, it does not encrypt the job.  The
-job description includes the raw hashlist lines; wordlist files must
-exist on each worker host (they are referenced by path, never shipped).
+challenge on hello before any other op is served (the challenge nonce
+rotates after every failed attempt and a connection is dropped after a
+few failures, so a connection cannot grind guesses against one nonce);
+the worker may send its own nonce in hello, and the coordinator's reply
+proves knowledge of the token over it -- mutual authentication.
+Without a token the protocol is open -- bind to localhost or a trusted
+network only (same stance as hashtopolis-style agents).  The transport
+is cleartext either way: the token authenticates peers, it does not
+encrypt the job.  The job description includes the raw hashlist lines;
+wordlist files must exist on each worker host (they are referenced by
+path, never shipped).
 """
 
 from __future__ import annotations
@@ -93,9 +98,26 @@ class CoordinatorState:
         #: potfile/session journal.  One oracle hash per hit is negligible.
         self.verifier = verifier
         self.rejected = 0
+        #: a worker whose hits keep failing verification has a broken
+        #: (or malicious) device path; quarantining it stops the
+        #: lease -> reject -> requeue livelock (same unit bouncing to
+        #: the same worker forever).
+        self.worker_rejects: dict[str, int] = {}
+        self.unit_reject_workers: dict[int, set] = {}
+        self.quarantined: set[str] = set()
         self.token = token                # None = unauthenticated protocol
         self.lock = threading.Lock()
         self.t0 = time.perf_counter()
+
+    #: rejected completions before a worker is quarantined.  Lower than
+    #: the unit threshold so a single bad worker is benched while its
+    #: unit can still requeue to an honest one.
+    MAX_WORKER_REJECTS = 2
+    #: DISTINCT workers whose reports on one unit were all rejected
+    #: before the unit is force-completed (a logged potential coverage
+    #: hole beats a job that can never terminate when every worker's
+    #: device path is divergent)
+    MAX_UNIT_REJECT_WORKERS = 3
 
     # -- RPC ops ---------------------------------------------------------
 
@@ -106,7 +128,10 @@ class CoordinatorState:
         with self.lock:
             if self._stopped():
                 return {"unit": None, "stop": True}
-            unit = self.dispatcher.lease(str(msg.get("worker_id", "?")))
+            wid = str(msg.get("worker_id", "?"))
+            if wid in self.quarantined:
+                return {"unit": None, "stop": False, "quarantined": True}
+            unit = self.dispatcher.lease(wid)
             if unit is None:
                 # nothing leasable right now; workers retry unless done
                 return {"unit": None,
@@ -146,8 +171,31 @@ class CoordinatorState:
                 # the range instead of marking it done, or a wrong
                 # plaintext would punch a permanent silent coverage hole
                 # where the true crack may live.
+                from dprf_tpu.utils.logging import DEFAULT as log
                 self.rejected += rejected
-                self.dispatcher.fail(unit_id)
+                wid = str(msg.get("worker_id", "?"))
+                self.worker_rejects[wid] = \
+                    self.worker_rejects.get(wid, 0) + 1
+                if (self.worker_rejects[wid] >= self.MAX_WORKER_REJECTS
+                        and wid not in self.quarantined):
+                    self.quarantined.add(wid)
+                    log.warn("quarantined worker after repeated "
+                             "unverifiable hits", worker=wid,
+                             rejects=self.worker_rejects[wid])
+                rejecters = self.unit_reject_workers.setdefault(
+                    unit_id, set())
+                rejecters.add(wid)
+                if len(rejecters) >= self.MAX_UNIT_REJECT_WORKERS:
+                    # several DIFFERENT workers all produced unverifiable
+                    # hits for this unit; requeueing again would livelock
+                    # the job -- complete it, record the possible hole
+                    log.warn("completing unit after rejected reports "
+                             "from several workers; range may hold an "
+                             "unrecovered crack", unit=unit_id,
+                             workers=len(rejecters))
+                    self.dispatcher.complete(unit_id)
+                else:
+                    self.dispatcher.fail(unit_id)
             else:
                 self.dispatcher.complete(unit_id)
             if self.on_progress:
@@ -183,9 +231,13 @@ def challenge_response(token: str, nonce_hex: str) -> str:
 
 
 class _Handler(socketserver.StreamRequestHandler):
+    #: failed auth attempts before the connection is dropped
+    MAX_AUTH_FAILURES = 3
+
     def handle(self):
         state: CoordinatorState = self.server.state   # type: ignore
-        nonce = secrets.token_hex(16)      # per-connection challenge
+        nonce = secrets.token_hex(16)      # challenge, rotated per failure
+        auth_failures = 0
         authed = state.token is None
         while True:
             try:
@@ -201,11 +253,17 @@ class _Handler(socketserver.StreamRequestHandler):
                             mac, challenge_response(state.token, nonce))):
                         authed = True      # fall through to op_hello
                     else:
+                        # a fresh nonce per attempt: a failed guess
+                        # teaches nothing about the next challenge
+                        auth_failures += 1
+                        nonce = secrets.token_hex(16)
                         try:
                             send_msg(self.connection,
                                      {"ok": False, "challenge": nonce})
                         except OSError:
                             return
+                        if auth_failures >= self.MAX_AUTH_FAILURES:
+                            return          # drop the connection
                         continue
                 else:
                     try:
@@ -222,6 +280,16 @@ class _Handler(socketserver.StreamRequestHandler):
                     resp = op(msg)
                 except Exception as e:       # defensive: never kill server
                     resp = {"error": f"{type(e).__name__}: {e}"}
+            if (msg.get("op") == "hello" and state.token
+                    and isinstance(msg.get("cnonce"), str)):
+                # mutual auth: prove WE know the token over the
+                # client's nonce, so a worker with --token refuses a
+                # spoofed coordinator (and the job it would hand out)
+                try:
+                    resp["coordinator_hmac"] = challenge_response(
+                        state.token, msg["cnonce"])
+                except ValueError:
+                    resp = {"error": "bad cnonce (want hex)"}
             try:
                 send_msg(self.connection, resp)
             except OSError:
@@ -304,16 +372,27 @@ class CoordinatorClient:
 
     def hello(self) -> dict:
         """Fetch the job, answering the coordinator's auth challenge if
-        it has one."""
-        resp = self.call("hello")
+        it has one.  When this client holds a token, the coordinator
+        must in turn prove it knows the token over OUR nonce (mutual
+        auth): a spoofed coordinator cannot hand this worker a job."""
+        cnonce = secrets.token_hex(16)
+        resp = self.call("hello", cnonce=cnonce)
         if resp.get("challenge"):
             if not self._token:
                 raise RpcError(
                     "coordinator requires authentication; pass --token")
-            resp = self.call("hello", hmac=challenge_response(
-                self._token, resp["challenge"]))
+            resp = self.call("hello", cnonce=cnonce,
+                             hmac=challenge_response(
+                                 self._token, resp["challenge"]))
             if resp.get("challenge"):
                 raise RpcError("authentication failed (wrong token?)")
+        if self._token:
+            proof = resp.get("coordinator_hmac")
+            if not (isinstance(proof, str) and hmac_mod.compare_digest(
+                    proof, challenge_response(self._token, cnonce))):
+                raise RpcError("coordinator failed mutual authentication "
+                               "(spoofed coordinator, or it has no/other "
+                               "token)")
         return resp
 
     def call(self, op: str, **kw) -> dict:
@@ -345,16 +424,21 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
         try:
             resp = client.call("lease", worker_id=worker_id)
         except ConnectionError:
-            # Clean exit ONLY at the lease boundary: nothing is held,
-            # and after the coordinator finishes draining and closes
-            # this is how an idle worker learns the job is over.  A
-            # close during complete/fail propagates as an error -- the
-            # worker was holding results, so a silent exit would look
-            # like success after a coordinator crash.
-            if log:
-                log.info("coordinator closed at lease (job finished?); "
-                         "exiting cleanly")
-            return done_units
+            # The coordinator serves through its drain window and
+            # answers every lease poll with an explicit stop flag once
+            # the job is over, so a worker always learns completion
+            # in-band and returns below.  A bare connection drop here
+            # therefore means the coordinator crashed mid-job: surface
+            # it so scripted workers don't report success on unfinished
+            # work (a clean rc used to hide exactly that).
+            raise ConnectionError(
+                "coordinator connection dropped before any stop signal "
+                "(coordinator crash mid-job?)")
+        if resp.get("quarantined"):
+            raise RpcError(
+                "coordinator quarantined this worker: its reported hits "
+                "repeatedly failed oracle verification (divergent device "
+                "path?)")
         unit_d = resp.get("unit")
         if unit_d is None:
             if resp.get("stop"):
@@ -373,7 +457,8 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
             raise
         payload = [{"target": h.target_index, "cand": h.cand_index,
                     "plaintext": h.plaintext.hex()} for h in hits]
-        resp = client.call("complete", unit_id=unit.unit_id, hits=payload)
+        resp = client.call("complete", unit_id=unit.unit_id, hits=payload,
+                           worker_id=worker_id)
         done_units += 1
         if log and hits:
             log.info("hits reported", count=len(hits))

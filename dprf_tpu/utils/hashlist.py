@@ -22,9 +22,19 @@ class HashlistResult:
     duplicates: int
 
 
+def _dedup_key(t: Target):
+    """Duplicates are duplicate TARGETS, not duplicate lines: the same
+    digest written twice (e.g. in different hex case) is one target, or
+    the engines' digest->index maps would be ambiguous and one copy
+    could never be reported cracked.  Salted targets are distinct
+    unless digest AND params match."""
+    params = tuple(sorted((t.params or {}).items()))
+    return (t.digest, params)
+
+
 def parse_lines(engine: HashEngine, lines: Sequence[str]) -> HashlistResult:
     targets: list[Target] = []
-    seen: set[str] = set()
+    seen: set = set()
     skipped, dups = [], 0
     for no, raw in enumerate(lines, 1):
         text = raw.strip()
@@ -35,10 +45,11 @@ def parse_lines(engine: HashEngine, lines: Sequence[str]) -> HashlistResult:
         except ValueError as e:
             skipped.append((no, text, str(e)))
             continue
-        if t.raw in seen:
+        key = _dedup_key(t)
+        if key in seen:
             dups += 1
             continue
-        seen.add(t.raw)
+        seen.add(key)
         targets.append(t)
     return HashlistResult(targets=targets, skipped=skipped, duplicates=dups)
 

@@ -315,3 +315,195 @@ def test_malformed_requests_never_kill_server(msg):
         assert state.found == {0: b"42"}
     finally:
         server.shutdown()
+
+
+# ----------------------------------------------------- r3 robustness
+
+def test_repeated_rejections_quarantine_worker_and_complete_unit():
+    """A worker whose hits always fail verification must not livelock
+    the job: after MAX_WORKER_REJECTS it is quarantined (refused
+    leases), and a unit rejected MAX_UNIT_REJECTS times is completed
+    with a logged warning so the job can terminate."""
+    from dprf_tpu.runtime.rpc import RpcError
+
+    eng, gen, targets, job = _mask_job("?l?l", [b"ok"], unit_size=1000)
+    dispatcher = Dispatcher(gen.keyspace, job["unit_size"])
+    state = CoordinatorState(
+        job, dispatcher, len(targets),
+        verifier=lambda ti, plain: eng.verify(plain, targets[ti]))
+    server = CoordinatorServer(state, "127.0.0.1", 0)
+    server.start_background()
+    try:
+        class LiarWorker:
+            def process(self, unit):
+                from dprf_tpu.runtime.worker import Hit
+                return [Hit(0, unit.start, b"zz")]   # always wrong
+
+        client = CoordinatorClient(*server.address)
+        with pytest.raises(RpcError, match="quarantined"):
+            worker_loop(client, LiarWorker(), "liar", idle_sleep=0.01)
+        client.close()
+        assert "liar" in state.quarantined
+        assert state.rejected >= CoordinatorState.MAX_WORKER_REJECTS
+        # a second divergent worker is likewise benched; the unit is
+        # still requeued (only 2 distinct rejecters < 3)
+        client = CoordinatorClient(*server.address)
+        with pytest.raises(RpcError, match="quarantined"):
+            worker_loop(client, LiarWorker(), "liar2", idle_sleep=0.01)
+        client.close()
+        assert not state.finished()
+        # an honest worker now takes the requeued unit and cracks it
+        client = CoordinatorClient(*server.address)
+        worker_loop(client, CpuWorker(eng, gen, targets), "honest",
+                    idle_sleep=0.01)
+        client.close()
+        assert state.finished()
+        assert state.found == {0: b"ok"}
+    finally:
+        server.shutdown()
+
+
+def test_unit_force_completes_after_distinct_worker_rejections():
+    """When MAX_UNIT_REJECT_WORKERS distinct workers all produce
+    unverifiable hits for one unit, it completes with a logged hole so
+    the job can terminate (no honest worker exists to save it)."""
+    from dprf_tpu.runtime.rpc import RpcError
+
+    eng, gen, targets, job = _mask_job("?l?l", [b"ok"], unit_size=1000)
+    dispatcher = Dispatcher(gen.keyspace, job["unit_size"])
+    state = CoordinatorState(job, dispatcher, len(targets),
+                             verifier=lambda ti, plain: False)
+    server = CoordinatorServer(state, "127.0.0.1", 0)
+    server.start_background()
+    try:
+        class LiarWorker:
+            def process(self, unit):
+                from dprf_tpu.runtime.worker import Hit
+                return [Hit(0, unit.start, b"zz")]
+
+        for i in range(CoordinatorState.MAX_UNIT_REJECT_WORKERS):
+            client = CoordinatorClient(*server.address)
+            try:
+                worker_loop(client, LiarWorker(), f"liar{i}",
+                            idle_sleep=0.01)
+            except RpcError:
+                pass      # quarantined after its rejections
+            client.close()
+        # keyspace exhausted via the force-complete: job terminates
+        # with the target uncracked (the logged coverage hole)
+        assert state.finished()
+        assert state.found == {}
+    finally:
+        server.shutdown()
+
+
+def test_connection_drop_without_stop_raises():
+    """A coordinator crash mid-job must NOT look like a clean drain:
+    a connection closed at the lease boundary with no stop signal seen
+    raises instead of returning success."""
+    import json as _json
+    import socket as _socket
+
+    srv = _socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+
+    def fake_coordinator():
+        conn, _ = srv.accept()
+        fh = conn.makefile("rb")
+        fh.readline()                       # first lease poll
+        conn.sendall(_json.dumps(
+            {"unit": None, "stop": False}).encode() + b"\n")
+        fh.readline()                       # second lease poll
+        conn.close()                        # "crash": bare drop, no stop
+
+    t = threading.Thread(target=fake_coordinator, daemon=True)
+    t.start()
+    client = CoordinatorClient(*srv.getsockname())
+
+    class NeverCalled:
+        def process(self, unit):
+            raise AssertionError("no unit should ever be leased")
+
+    with pytest.raises(ConnectionError, match="before any stop"):
+        worker_loop(client, NeverCalled(), "w", idle_sleep=0.01)
+    client.close()
+    srv.close()
+
+
+def test_auth_nonce_rotates_and_connection_drops():
+    """Each failed hello gets a FRESH challenge, and the connection is
+    dropped after MAX_AUTH_FAILURES failed guesses."""
+    import json as _json
+    import socket as _socket
+
+    eng, gen, targets, job = _mask_job("?l?l", [b"aa"])
+    dispatcher = Dispatcher(gen.keyspace, job["unit_size"])
+    state = CoordinatorState(job, dispatcher, len(targets), token="tk")
+    server = CoordinatorServer(state, "127.0.0.1", 0)
+    server.start_background()
+    try:
+        sock = _socket.create_connection(server.address, timeout=10)
+        fh = sock.makefile("rb")
+        challenges = []
+        for _ in range(3):
+            sock.sendall(b'{"op": "hello", "hmac": "00"}\n')
+            line = fh.readline()
+            if not line:
+                break
+            resp = _json.loads(line)
+            assert resp.get("ok") is False
+            challenges.append(resp["challenge"])
+        # every challenge distinct: no fixed nonce to grind against
+        assert len(challenges) == len(set(challenges)) == 3
+        # 4th attempt: server has dropped the connection
+        try:
+            sock.sendall(b'{"op": "hello", "hmac": "00"}\n')
+            assert fh.readline() == b""
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        sock.close()
+    finally:
+        server.shutdown()
+
+
+def test_mutual_auth_worker_rejects_tokenless_coordinator():
+    """A worker holding --token must refuse a coordinator that cannot
+    prove knowledge of the same token (spoofed-coordinator defense)."""
+    from dprf_tpu.runtime.rpc import RpcError
+
+    eng, gen, targets, job = _mask_job("?l?l", [b"aa"])
+    # coordinator WITHOUT a token (stands in for a spoofed one)
+    state, server, _ = _serve(job, gen, targets)
+    try:
+        client = CoordinatorClient(*server.address, token="tk")
+        with pytest.raises(RpcError, match="mutual"):
+            client.hello()
+        client.close()
+    finally:
+        server.shutdown()
+
+
+def test_mutual_auth_good_token_passes():
+    eng, gen, targets, job = _mask_job("?l?l", [b"aa"])
+    dispatcher = Dispatcher(gen.keyspace, job["unit_size"])
+    state = CoordinatorState(job, dispatcher, len(targets), token="tk")
+    server = CoordinatorServer(state, "127.0.0.1", 0)
+    server.start_background()
+    try:
+        client = CoordinatorClient(*server.address, token="tk")
+        resp = client.hello()
+        assert resp["ok"] and "job" in resp
+        client.close()
+    finally:
+        server.shutdown()
+
+
+def test_hashlist_dedupes_same_digest_different_case():
+    from dprf_tpu.utils.hashlist import parse_lines
+
+    eng = get_engine("md5")
+    d = hashlib.md5(b"pw").hexdigest()
+    res = parse_lines(eng, [d, d.upper(), d])
+    assert len(res.targets) == 1
+    assert res.duplicates == 2
