@@ -67,8 +67,19 @@ def _build_parser() -> argparse.ArgumentParser:
     c = sub.add_parser("crack", help="run a recovery job locally")
     _add_job_args(c)
     c.add_argument("--devices", type=int, default=1,
-                   help="shard the job over N local chips via the mesh "
-                   "(fast unsalted engines)")
+                   help="shard the job over N chips via the mesh "
+                   "(any engine; with --multihost, N counts GLOBAL "
+                   "devices across all hosts)")
+    c.add_argument("--multihost", action="store_true",
+                   help="join a cross-host device mesh via "
+                   "jax.distributed (run the SAME command on every "
+                   "host of the slice; TPU pods auto-detect the "
+                   "coordinator)")
+    c.add_argument("--coordinator-address", default=None, metavar="H:P",
+                   help="multihost coordinator address (auto-detected "
+                   "on TPU pods)")
+    c.add_argument("--num-processes", type=int, default=None)
+    c.add_argument("--process-id", type=int, default=None)
     c.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run to DIR "
                    "(view with tensorboard)")
@@ -364,6 +375,23 @@ def _setup_job(args, device: str, log: Log,
 
 def cmd_crack(args, log: Log) -> int:
     device = _DEVICE_ALIASES[args.device]
+    if getattr(args, "multihost", False):
+        # One mesh across hosts (DCN): every host runs this same
+        # command; the job is deterministic (same fingerprint, same
+        # Dispatcher order), so all processes drive identical step
+        # sequences -- SPMD -- and the replicated hit buffers mean every
+        # host observes every hit.  Only process 0 owns the potfile and
+        # session journal to avoid duplicate writes.
+        from dprf_tpu.parallel.mesh import init_multihost
+        import jax as _jax
+        init_multihost(args.coordinator_address, args.num_processes,
+                       args.process_id)
+        log.info("multihost mesh", process=_jax.process_index(),
+                 n_processes=_jax.process_count(),
+                 global_devices=len(_jax.devices()))
+        if _jax.process_index() != 0:
+            args.no_potfile = True
+            args.session = None
     job = _setup_job(args, device, log)
     if job is None:
         return 2

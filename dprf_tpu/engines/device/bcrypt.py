@@ -164,11 +164,15 @@ def make_sharded_bcrypt_mask_step(gen, mesh, batch_per_device: int,
             found, jnp.zeros((B,), jnp.int32), hit_capacity)
         lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
         total = lax.psum(count, SHARD_AXIS)
-        return (total[None], count[None], lanes[None, :], tpos[None, :])
+        # replicated hit buffers (see parallel/sharded.py)
+        return (total[None],
+                lax.all_gather(count, SHARD_AXIS),
+                lax.all_gather(lanes, SHARD_AXIS),
+                lax.all_gather(tpos, SHARD_AXIS))
 
     sharded = jax.shard_map(
         shard_fn, mesh=mesh, in_specs=(P(),) * 5,
-        out_specs=(P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(), P(), P(), P()),
         check_vma=False)
 
     @jax.jit
@@ -218,11 +222,15 @@ def make_sharded_bcrypt_wordlist_step(gen, mesh, word_batch: int,
         glanes = r * (n_dev * B) + dev * B + b
         lanes = jnp.where(lanes >= 0, glanes, lanes)
         total = lax.psum(count, SHARD_AXIS)
-        return (total[None], count[None], lanes[None, :], tpos[None, :])
+        # replicated hit buffers (see parallel/sharded.py)
+        return (total[None],
+                lax.all_gather(count, SHARD_AXIS),
+                lax.all_gather(lanes, SHARD_AXIS),
+                lax.all_gather(tpos, SHARD_AXIS))
 
     sharded = jax.shard_map(
         shard_fn, mesh=mesh, in_specs=(P(),) * 5,
-        out_specs=(P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(), P(), P(), P()),
         check_vma=False)
 
     @jax.jit

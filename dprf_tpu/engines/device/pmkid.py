@@ -166,12 +166,16 @@ def make_sharded_pmkid_crack_step(engine: JaxPmkidEngine,
         total = lax.psum(count, SHARD_AXIS)
         n_multi = lax.psum(jnp.sum((nmatch > 1).astype(jnp.int32)),
                            SHARD_AXIS)
-        return (total[None], count[None], lanes[None, :], tpos[None, :],
+        # replicated hit buffers (see parallel/sharded.py)
+        return (total[None],
+                lax.all_gather(count, SHARD_AXIS),
+                lax.all_gather(lanes, SHARD_AXIS),
+                lax.all_gather(tpos, SHARD_AXIS),
                 n_multi[None])
 
     sharded = _jax.shard_map(
         shard_fn, mesh=mesh, in_specs=(P(), P()),
-        out_specs=(P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        out_specs=(P(), P(), P(), P(), P()),
         check_vma=False)
 
     @_jax.jit

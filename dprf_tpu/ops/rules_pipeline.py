@@ -146,12 +146,17 @@ def make_sharded_wordlist_crack_step(
         glanes = r * (n_dev * B) + dev * B + b
         lanes = jnp.where(lanes >= 0, glanes, lanes)
         total = lax.psum(count, SHARD_AXIS)
-        return (total[None], count[None], lanes[None, :], tpos[None, :])
+        # replicated hit buffers (see parallel/sharded.py): every host
+        # of a multi-host mesh can read them from local devices
+        return (total[None],
+                lax.all_gather(count, SHARD_AXIS),
+                lax.all_gather(lanes, SHARD_AXIS),
+                lax.all_gather(tpos, SHARD_AXIS))
 
     sharded = jax.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(), P()),
-        out_specs=(P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(), P(), P(), P()),
         check_vma=False)
 
     @jax.jit

@@ -28,3 +28,38 @@ def make_mesh(n_devices: Optional[int] = None,
         devices = devices[:n_devices]
     import numpy as np
     return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+def init_multihost(coordinator: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> bool:
+    """Form one device mesh ACROSS hosts (a pod slice spanning DCN).
+
+    Wraps `jax.distributed.initialize`: after it, `jax.devices()` on
+    every participating process reports the global device set, so the
+    same `make_mesh()` + shard_map code shards a job over the whole
+    slice with XLA placing the collectives (ICI within a host's chips,
+    DCN across hosts).  This is the SINGLE-MESH multi-host mode; the
+    WorkUnit RPC control plane (runtime/rpc.py) remains the loosely-
+    coupled alternative where hosts lease independent keyspace ranges.
+
+    On TPU pods the three arguments are auto-detected from the
+    environment, so `init_multihost()` with no arguments is the normal
+    call; on CPU/GPU fleets pass them explicitly.  Returns True if
+    initialization ran, False if it was skipped because this process is
+    already initialized (idempotent -- safe to call from the CLI on
+    every invocation).
+    """
+    import jax as _jax
+
+    if _jax.distributed.is_initialized():
+        return False      # already initialized: idempotent no-op
+    kwargs = {}
+    if coordinator is not None:
+        kwargs["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    _jax.distributed.initialize(**kwargs)
+    return True

@@ -74,12 +74,20 @@ def make_sharded_mask_crack_step(
         # Local lane -> super-batch lane (keep -1 padding).
         lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
         total = lax.psum(count, SHARD_AXIS)
-        return (total[None], count[None], lanes[None, :], tpos[None, :])
+        # Hit buffers are all_gathered to every shard (a few hundred
+        # bytes over ICI) so the outputs are REPLICATED: on a multi-host
+        # mesh every process can read the full buffers from its local
+        # devices -- per-shard outputs would only be addressable on the
+        # host that owns the shard.
+        return (total[None],
+                lax.all_gather(count, SHARD_AXIS),
+                lax.all_gather(lanes, SHARD_AXIS),
+                lax.all_gather(tpos, SHARD_AXIS))
 
     sharded = jax.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(), P()),
-        out_specs=(P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(), P(), P(), P()),
         check_vma=False)
 
     @jax.jit

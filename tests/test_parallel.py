@@ -234,3 +234,54 @@ def test_sharded_pmkid_worker(mesh):
         assert got == [(0, b"pw37"), (1, b"pw55"), (2, b"pw55")]
     finally:
         del eng.iterations, cpu.iterations     # restore class attrs
+
+
+def test_multihost_init_and_crack_subprocess():
+    """init_multihost (jax.distributed) with an explicit 1-process
+    coordinator, then a sharded crack over the virtual mesh -- run in a
+    subprocess so the distributed global state can't leak into other
+    tests.  Exercises the same code path a real pod slice uses."""
+    import os
+    import subprocess
+    import sys
+
+    code = r"""
+import hashlib
+import jax
+jax.config.update("jax_platforms", "cpu")
+from dprf_tpu.parallel.mesh import init_multihost
+assert init_multihost("localhost:12757", 1, 0) is True
+assert init_multihost() is False          # idempotent second call
+assert jax.process_index() == 0 and jax.process_count() == 1
+import jax.numpy as jnp
+import numpy as np
+from dprf_tpu.engines import get_engine
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.ops.pipeline import target_words
+from dprf_tpu.parallel import make_mesh, make_sharded_mask_crack_step
+gen = MaskGenerator("?l?l?l")
+pw = b"fox"
+idx = gen.index_of(pw)
+tgt = target_words(hashlib.md5(pw).digest(), little_endian=True)
+step = make_sharded_mask_crack_step(get_engine("md5", device="jax"),
+                                    gen, tgt, make_mesh(8), 64)
+base = jnp.asarray(gen.digits(0), dtype=jnp.int32)
+for bstart in range(0, gen.keyspace, 512):
+    base = jnp.asarray(gen.digits(bstart), dtype=jnp.int32)
+    total, counts, lanes, tpos = step(base, jnp.int32(
+        min(512, gen.keyspace - bstart)))
+    if int(total):
+        lanes_np = np.asarray(lanes)
+        assert bstart + int(lanes_np[lanes_np >= 0][0]) == idx
+        print("MULTIHOST_OK")
+        break
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "MULTIHOST_OK" in proc.stdout
