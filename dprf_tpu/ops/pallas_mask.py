@@ -48,7 +48,10 @@ from dprf_tpu.ops import sha1 as sha1_ops
 from dprf_tpu.ops import sha256 as sha256_ops
 
 #: sublane count per grid cell; TILE = SUB * 128 candidate lanes.
-SUB = 32
+#: DPRF_PALLAS_SUB overrides for tuning (tools/tpu_session.py sweeps
+#: it on real hardware); 32 showed no regressions in interpret mode
+#: and keeps the per-cell register/VMEM footprint modest.
+SUB = int(os.environ.get("DPRF_PALLAS_SUB", "32"))
 TILE = SUB * 128
 #: charsets needing more piecewise segments than this use the XLA path.
 MAX_SEGMENTS = 16

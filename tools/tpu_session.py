@@ -141,6 +141,49 @@ def bench_all():
     return out
 
 
+def sweep_sub():
+    """Raw kernel throughput vs SUB (sublanes per grid cell): the main
+    tuning knob.  Times the bare pallas fn (no worker machinery) on an
+    unmatchable target so the number is pure kernel rate."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from dprf_tpu.generators.mask import MaskGenerator
+    from dprf_tpu.ops import pallas_mask as pm
+
+    gen = MaskGenerator("?a?a?a?a?a?a?a?a")
+    tw = np.full((4,), 0xFFFFFFFF, np.uint32)   # unmatchable
+    out = {}
+    for sub in (8, 16, 32, 64, 128):
+        name = f"sub{sub}"
+        write_status("sweep", case=name)
+        try:
+            tile = sub * 128
+            batch = max(1 << 23, tile)
+            batch = (batch // tile) * tile
+            fn = pm.make_mask_pallas_fn("md5", gen, tw, batch, sub=sub)
+            base = jnp.asarray(gen.digits(0), jnp.int32)
+            nv = jnp.asarray([batch], jnp.int32)
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(base, nv))
+            compile_s = time.perf_counter() - t0
+            n, t0, last = 0, time.perf_counter(), None
+            while time.perf_counter() - t0 < 5.0:
+                last = fn(base, nv)
+                n += 1
+            jax.block_until_ready(last)
+            dt = time.perf_counter() - t0
+            out[name] = {"sub": sub, "hs": n * batch / dt,
+                         "batch": batch, "batches": n,
+                         "compile_s": round(compile_s, 2)}
+        except Exception as e:
+            out[name] = {"sub": sub,
+                         "error": f"{type(e).__name__}: {e}"}
+        RESULTS["stages"]["sweep"] = out
+        flush_results()
+    return out
+
+
 def main():
     write_status("starting", pid=os.getpid())
     import jax
@@ -153,6 +196,7 @@ def main():
         write_status("done", ok=False, note="no TPU")
         return 1
     check_lowering()
+    sweep_sub()
     bench_all()
     RESULTS["finished"] = time.time()
     flush_results()
