@@ -48,6 +48,22 @@ class Sha256Engine(_HashlibEngine):
     _algo = "sha256"
 
 
+@register("sha512")
+class Sha512Engine(_HashlibEngine):
+    name = "sha512"
+    digest_size = 64
+    max_candidate_len = 111    # single-block limit of the device engine
+    _algo = "sha512"
+
+
+@register("sha384")
+class Sha384Engine(_HashlibEngine):
+    name = "sha384"
+    digest_size = 48
+    max_candidate_len = 111
+    _algo = "sha384"
+
+
 @register("ntlm")
 class NtlmEngine(HashEngine):
     """NTLM: MD4 over the UTF-16LE encoding of the password."""

@@ -24,6 +24,7 @@ from dprf_tpu.ops.md4 import md4_digest_words
 from dprf_tpu.ops.md5 import md5_digest_words
 from dprf_tpu.ops.sha1 import sha1_digest_words
 from dprf_tpu.ops.sha256 import sha256_digest_words
+from dprf_tpu.ops.sha512 import sha384_digest_words, sha512_digest_words
 
 
 class JaxEngineBase(DeviceHashEngine, HashEngine):
@@ -33,6 +34,9 @@ class JaxEngineBase(DeviceHashEngine, HashEngine):
     #: big-endian (SHA family); drives target-table layout too.
     little_endian: bool = True
     max_candidate_len = 55
+    #: single-block packing limit (55 for 64-byte blocks; 111 for the
+    #: SHA-512 family's 128-byte blocks)
+    _block_limit = 55
 
     # -- device path -----------------------------------------------------
 
@@ -127,12 +131,13 @@ class JaxEngineBase(DeviceHashEngine, HashEngine):
     def hash_batch(self, candidates: Sequence[bytes],
                    params: Optional[dict] = None) -> list[bytes]:
         maxlen = max((len(c) for c in candidates), default=1) or 1
-        # 55 is the single-block packing limit; engine-specific
+        # _block_limit is the single-block packing limit; engine-specific
         # max_candidate_len (e.g. NTLM's 27 pre-widening chars) is
         # enforced by callers/overrides on the raw candidate.
-        if maxlen > 55:
-            raise ValueError(f"{self.name}: candidate longer than "
-                             "the 55-byte single-block limit")
+        if maxlen > self._block_limit:
+            raise ValueError(
+                f"{self.name}: candidate longer than the "
+                f"{self._block_limit}-byte single-block limit")
         batch = len(candidates)
         buf = np.zeros((batch, maxlen), dtype=np.uint8)
         lengths = np.zeros((batch,), dtype=np.int32)
@@ -182,6 +187,43 @@ class JaxSha256Engine(JaxEngineBase):
     def digest_packed(self, blocks: jnp.ndarray,
                       lengths=None) -> jnp.ndarray:
         return sha256_digest_words(blocks)
+
+
+@register("sha512", device="jax")
+@register("sha-512", device="jax")
+class JaxSha512Engine(JaxEngineBase):
+    """SHA-512 over 128-byte blocks; 64-bit words emulated as uint32
+    (hi, lo) lane pairs (see ops/sha512.py)."""
+
+    name = "sha512"
+    digest_size = 64
+    digest_words = 16
+    little_endian = False
+    max_candidate_len = 111
+    _block_limit = 111
+
+    def pack(self, cand: jnp.ndarray, length: int) -> jnp.ndarray:
+        return pack_ops.pack_fixed_wide(cand, length)
+
+    def pack_varlen(self, cand: jnp.ndarray,
+                    lengths: jnp.ndarray) -> jnp.ndarray:
+        return pack_ops.pack_varlen_wide(cand, lengths)
+
+    def digest_packed(self, blocks: jnp.ndarray,
+                      lengths=None) -> jnp.ndarray:
+        return sha512_digest_words(blocks)
+
+
+@register("sha384", device="jax")
+@register("sha-384", device="jax")
+class JaxSha384Engine(JaxSha512Engine):
+    name = "sha384"
+    digest_size = 48
+    digest_words = 12
+
+    def digest_packed(self, blocks: jnp.ndarray,
+                      lengths=None) -> jnp.ndarray:
+        return sha384_digest_words(blocks)
 
 
 @register("ntlm", device="jax")

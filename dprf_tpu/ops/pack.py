@@ -81,6 +81,47 @@ def pack_varlen(cand: jnp.ndarray, lengths: jnp.ndarray,
     return words
 
 
+def _words_from_bytes_wide(msg: jnp.ndarray) -> jnp.ndarray:
+    """uint8[B, 128] -> uint32[B, 32] big-endian (SHA-512 block)."""
+    grouped = msg.reshape(*msg.shape[:-1], 32, 4).astype(jnp.uint32)
+    return (grouped * jnp.asarray(_BE_COEF)).sum(axis=-1, dtype=jnp.uint32)
+
+
+def pack_fixed_wide(cand: jnp.ndarray, length: int) -> jnp.ndarray:
+    """Fixed-length candidates uint8[B, length] -> one 128-byte SHA-512
+    block as uint32[B, 32] (big-endian words; 128-bit length field, of
+    which only the low 32 bits can be nonzero for single-block input).
+    """
+    if length > 111:
+        raise ValueError(
+            f"single-block SHA-512 packing needs length <= 111, "
+            f"got {length}")
+    batch = cand.shape[0]
+    const = np.zeros(128, dtype=np.uint8)
+    const[length] = 0x80
+    const[120:128] = np.frombuffer((length * 8).to_bytes(8, "big"),
+                                   dtype=np.uint8)
+    padded = jnp.zeros((batch, 128),
+                       dtype=jnp.uint8).at[:, :length].set(cand)
+    return _words_from_bytes_wide(padded + jnp.asarray(const))
+
+
+def pack_varlen_wide(cand: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Variable-length candidates uint8[B, maxlen] (lengths <= 111) ->
+    uint32[B, 32] SHA-512 blocks, vectorized like pack_varlen."""
+    batch, maxlen = cand.shape
+    if maxlen > 111:
+        raise ValueError("single-block SHA-512 packing needs maxlen <= 111")
+    pos = jnp.arange(128, dtype=jnp.int32)
+    lens = lengths[:, None]
+    padded = jnp.zeros((batch, 128),
+                       dtype=jnp.uint8).at[:, :maxlen].set(cand)
+    msg = jnp.where(pos < lens, padded, 0).astype(jnp.uint8)
+    msg = msg + jnp.where(pos == lens, jnp.uint8(0x80), jnp.uint8(0))
+    words = _words_from_bytes_wide(msg)
+    return words.at[:, 31].set(lengths.astype(jnp.uint32) * 8)
+
+
 def pack_raw(cand: jnp.ndarray, length: int,
              big_endian: bool = True) -> jnp.ndarray:
     """Pack bytes into a full 64-byte block with ZERO padding (no MD

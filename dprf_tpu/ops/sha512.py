@@ -1,0 +1,192 @@
+"""SHA-512 / SHA-384 compression (FIPS 180-4) as vectorized jnp ops.
+
+TPU-first design problem: the VPU has no 64-bit integer lanes (JAX's
+x64 mode is off and TPUs lower int64 poorly anyway), so every 64-bit
+word lives as an (hi, lo) pair of uint32 lanes.  Adds propagate one
+carry via an unsigned compare; rotations decompose into cross-word
+shift/or pairs.  That costs ~3x the int32 op count of SHA-256 per
+round, which is the honest price of SHA-512 on this hardware -- the
+batch dimension still vectorizes perfectly.
+
+Round constants (fractional cube roots of the first 80 primes) and
+initial states (fractional square roots of primes 1-8 for SHA-512,
+9-16 for SHA-384) are computed with exact integer arithmetic, not
+copied from a listing, with FIPS 180-4 spot-check asserts.
+
+Message layout: a 128-byte block is uint32[..., 32] big-endian words;
+64-bit word i is (words[2i], words[2i+1]) = (hi, lo).  Digests use the
+same interleaved layout, so ">u4" serialization yields standard bytes.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+_MASK64 = (1 << 64) - 1
+
+
+def _primes(n: int) -> list[int]:
+    out, cand = [], 2
+    while len(out) < n:
+        if all(cand % p for p in out if p * p <= cand):
+            out.append(cand)
+        cand += 1
+    return out
+
+
+def _icbrt(n: int) -> int:
+    lo, hi = 0, 1 << ((n.bit_length() + 2) // 3 + 1)
+    while lo < hi:
+        mid = (lo + hi + 1) // 2
+        if mid ** 3 <= n:
+            lo = mid
+        else:
+            hi = mid - 1
+    return lo
+
+
+def _frac64(p: int, root: int) -> int:
+    """First 64 fractional bits of p**(1/root)."""
+    if root == 2:
+        return math.isqrt(p << 128) & _MASK64
+    return _icbrt(p << 192) & _MASK64
+
+
+_PRIMES = _primes(80)
+K = [_frac64(p, 3) for p in _PRIMES]
+INIT512 = [_frac64(p, 2) for p in _PRIMES[:8]]
+INIT384 = [_frac64(p, 2) for p in _PRIMES[8:16]]
+# FIPS 180-4 spot checks
+assert K[0] == 0x428A2F98D728AE22 and K[79] == 0x6C44198C4A475817
+assert INIT512[0] == 0x6A09E667F3BCC908
+assert INIT384[0] == 0xCBBB9D5DC1059ED8
+
+
+def _split(v: int):
+    return jnp.uint32(v >> 32), jnp.uint32(v & 0xFFFFFFFF)
+
+
+def _add64(a, b):
+    """(hi, lo) + (hi, lo) with one carry propagate."""
+    lo = a[1] + b[1]
+    carry = (lo < a[1]).astype(jnp.uint32)   # unsigned wrap detection
+    return a[0] + b[0] + carry, lo
+
+
+def _rotr64(x, n: int):
+    h, l = x
+    if n == 0:
+        return x
+    if n == 32:
+        return l, h
+    if n > 32:
+        return _rotr64((l, h), n - 32)
+    nh = (h >> jnp.uint32(n)) | (l << jnp.uint32(32 - n))
+    nl = (l >> jnp.uint32(n)) | (h << jnp.uint32(32 - n))
+    return nh, nl
+
+
+def _shr64(x, n: int):
+    h, l = x
+    if n >= 32:
+        return jnp.zeros_like(h), h >> jnp.uint32(n - 32)
+    return (h >> jnp.uint32(n),
+            (l >> jnp.uint32(n)) | (h << jnp.uint32(32 - n)))
+
+
+def _xor64(*xs):
+    h = xs[0][0]
+    l = xs[0][1]
+    for x in xs[1:]:
+        h = h ^ x[0]
+        l = l ^ x[1]
+    return h, l
+
+
+def _round(vars8, wt, kt):
+    """One SHA-512 round; kt is an (hi, lo) pair (scalar constants or
+    gathered arrays -- the fori_loop body passes lane-broadcast
+    gathers)."""
+    a, b, c, d, e, f, g, h = vars8
+    S1 = _xor64(_rotr64(e, 14), _rotr64(e, 18), _rotr64(e, 41))
+    ch = ((e[0] & f[0]) ^ (~e[0] & g[0]), (e[1] & f[1]) ^ (~e[1] & g[1]))
+    t1 = _add64(_add64(_add64(h, S1), _add64(ch, kt)), wt)
+    S0 = _xor64(_rotr64(a, 28), _rotr64(a, 34), _rotr64(a, 39))
+    maj = ((a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+           (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]))
+    return (_add64(t1, _add64(S0, maj)), a, b, c, _add64(d, t1), e, f, g)
+
+
+def _schedule_ext(w15, w2, w0, w7):
+    """W[t] = s1(W[t-2]) + W[t-7] + s0(W[t-15]) + W[t-16]."""
+    s0 = _xor64(_rotr64(w15, 1), _rotr64(w15, 8), _shr64(w15, 7))
+    s1 = _xor64(_rotr64(w2, 19), _rotr64(w2, 61), _shr64(w2, 6))
+    return _add64(_add64(s1, w7), _add64(s0, w0))
+
+
+_KH = np.array([k >> 32 for k in K], dtype=np.uint32)
+_KL = np.array([k & 0xFFFFFFFF for k in K], dtype=np.uint32)
+
+
+def sha512_compress(init, words: jnp.ndarray) -> jnp.ndarray:
+    """init: 8 python ints (64-bit state); words: uint32[..., 32]
+    big-endian interleaved (hi, lo) pairs -> uint32[..., 16] digest
+    words in the same interleaved layout.
+
+    The first 16 rounds are unrolled (static message indexing, static
+    round constants); rounds 16..80 run under lax.fori_loop with a
+    rolling (hi, lo) schedule pair.  A fully-unrolled 80x(~35 op)
+    graph hits the same XLA:CPU compile-time pathology the unrolled
+    SHA-256 does (minutes), and the loop form costs no throughput
+    under jit -- the body is batch-vectorized either way.  There is no
+    Pallas kernel for this engine, so Mosaic's dislike of the loop
+    form (see ops/sha256.py) is moot.
+    """
+    from jax import lax
+
+    shape = words.shape[:-1]
+    vars8 = tuple(
+        (jnp.broadcast_to(jnp.uint32(v >> 32), shape),
+         jnp.broadcast_to(jnp.uint32(v & 0xFFFFFFFF), shape))
+        for v in init)
+    wh = words[..., 0::2]
+    wl = words[..., 1::2]
+    for t in range(16):
+        vars8 = _round(vars8, (wh[..., t], wl[..., t]), _split(K[t]))
+
+    kh_arr = jnp.asarray(_KH)
+    kl_arr = jnp.asarray(_KL)
+
+    def body(t, carry):
+        vars8, wh, wl = carry
+        wn = _schedule_ext((wh[..., 1], wl[..., 1]),
+                           (wh[..., 14], wl[..., 14]),
+                           (wh[..., 0], wl[..., 0]),
+                           (wh[..., 9], wl[..., 9]))
+        vars8 = _round(vars8, wn, (kh_arr[t], kl_arr[t]))
+        wh = jnp.concatenate([wh[..., 1:], wn[0][..., None]], axis=-1)
+        wl = jnp.concatenate([wl[..., 1:], wn[1][..., None]], axis=-1)
+        return vars8, wh, wl
+
+    vars8, _, _ = lax.fori_loop(16, 80, body, (vars8, wh, wl))
+    out = []
+    for v, i in zip(vars8, init):
+        h, l = _add64(v, (jnp.broadcast_to(jnp.uint32(i >> 32), shape),
+                          jnp.broadcast_to(jnp.uint32(i & 0xFFFFFFFF),
+                                           shape)))
+        out.extend([h, l])
+    return jnp.stack(out, axis=-1)
+
+
+def sha512_digest_words(words: jnp.ndarray) -> jnp.ndarray:
+    """uint32[..., 32] packed block -> uint32[..., 16] digest words."""
+    return sha512_compress(INIT512, words)
+
+
+def sha384_digest_words(words: jnp.ndarray) -> jnp.ndarray:
+    """SHA-384: SHA-512 with its own IV, digest truncated to 48 bytes
+    (the first six 64-bit words = 12 uint32 words)."""
+    return sha512_compress(INIT384, words)[..., :12]

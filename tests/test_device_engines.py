@@ -12,7 +12,7 @@ from dprf_tpu import get_engine
 from dprf_tpu.generators.mask import MaskGenerator
 from dprf_tpu.ops.pipeline import make_mask_crack_step, target_words
 
-ENGINES = ["md5", "sha1", "sha256", "ntlm"]
+ENGINES = ["md5", "sha1", "sha256", "sha512", "sha384", "ntlm"]
 
 
 @pytest.mark.parametrize("name", ENGINES)
@@ -41,6 +41,19 @@ def test_sha256_vector():
         "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
 
 
+def test_sha512_vector():
+    # FIPS 180-4 "abc" vector
+    assert get_engine("sha512", "jax").hash_batch([b"abc"])[0].hex() == (
+        "ddaf35a193617abacc417349ae20413112e6fa4e89a97ea20a9eeee64b55d39a"
+        "2192992a274fc1a836ba3c23a3feebbd454d4423643ce80e2a9ac94fa54ca49f")
+
+
+def test_sha384_vector():
+    assert get_engine("sha384", "jax").hash_batch([b"abc"])[0].hex() == (
+        "cb00753f45a35e8bb5a03d699ac65007272c32ab0eded163"
+        "1a8b605a43ff5bed8086072ba1e7cc2358baeca134c825a7")
+
+
 def test_ntlm_vector():
     assert get_engine("ntlm", "jax").hash_batch([b"password"])[0].hex() == \
         "8846f7eaee8fb117ad06bdd830b7586c"
@@ -49,6 +62,8 @@ def test_ntlm_vector():
 @pytest.mark.parametrize("name,mask,secret", [
     ("sha1", "?d?d?d?d", b"7319"),
     ("sha256", "?l?d?l", b"a7z"),
+    ("sha512", "?l?d?l", b"k3y"),
+    ("sha384", "?d?l?d", b"4q2"),
     ("ntlm", "?u?l?l", b"Pwd"),
 ])
 def test_fused_step_each_engine(name, mask, secret):
