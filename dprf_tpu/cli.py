@@ -83,6 +83,12 @@ def _build_parser() -> argparse.ArgumentParser:
     c.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run to DIR "
                    "(view with tensorboard)")
+    c.add_argument("--increment", action="store_true",
+                   help="mask attacks: sweep prefix lengths from "
+                   "--increment-min to --increment-max (default: the "
+                   "full mask length)")
+    c.add_argument("--increment-min", type=int, default=1, metavar="N")
+    c.add_argument("--increment-max", type=int, default=None, metavar="N")
 
     s = sub.add_parser("serve", help="run the coordinator for a "
                        "distributed job (workers connect with "
@@ -135,6 +141,16 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="cost for --config 4 (lower it off-TPU)")
     b.add_argument("--profile", default=None, metavar="DIR")
     b.add_argument("--quiet", "-q", action="store_true")
+
+    for name, helptext in (("show", "print potfile-cracked targets of a "
+                            "hashlist as hash:plain"),
+                           ("left", "print targets of a hashlist NOT yet "
+                            "in the potfile")):
+        v = sub.add_parser(name, help=helptext)
+        v.add_argument("hashfile")
+        v.add_argument("--engine", "-m", required=True)
+        v.add_argument("--potfile", default="dprf.potfile")
+        v.add_argument("--quiet", "-q", action="store_true")
 
     e = sub.add_parser("engines", help="list available engines")
     e.add_argument("--device", default=None)
@@ -392,9 +408,73 @@ def cmd_crack(args, log: Log) -> int:
         if _jax.process_index() != 0:
             args.no_potfile = True
             args.session = None
+    if getattr(args, "increment", False):
+        return _crack_increment(args, device, log)
+    rc, _, _ = _crack_single(args, device, log)
+    return rc
+
+
+def _mask_positions(mask: str) -> list[str]:
+    """Mask string -> per-position token list ('?l', '??', literals)."""
+    toks, i = [], 0
+    while i < len(mask):
+        if mask[i] == "?":
+            if i + 1 >= len(mask):
+                raise ValueError(f"dangling '?' at end of mask {mask!r}")
+            toks.append(mask[i:i + 2])
+            i += 2
+        else:
+            toks.append(mask[i])
+            i += 1
+    return toks
+
+
+def _crack_increment(args, device: str, log: Log) -> int:
+    """--increment: sweep mask prefix lengths min..max (hashcat
+    semantics).  Each length is an independent job sharing the potfile,
+    so already-cracked targets are skipped at later lengths and the
+    sweep stops as soon as everything is found."""
+    import copy
+
+    if args.attack != "mask":
+        log.error("--increment applies to mask attacks only")
+        return 2
+    try:
+        toks = _mask_positions(args.attack_arg)
+    except ValueError as e:
+        log.error(str(e))
+        return 2
+    lo = args.increment_min
+    hi = args.increment_max or len(toks)
+    if not 1 <= lo <= hi <= len(toks):
+        log.error(f"increment range {lo}..{hi} outside mask's "
+                  f"1..{len(toks)} positions")
+        return 2
+    any_found = False
+    for length in range(lo, hi + 1):
+        sub = copy.copy(args)
+        sub.increment = False
+        sub.attack_arg = "".join(toks[:length])
+        if args.session:
+            # per-length journals: lengths are distinct keyspaces with
+            # distinct fingerprints, so they cannot share one ledger
+            sub.session = f"{args.session}-len{length}"
+        log.info("increment", length=length, mask=sub.attack_arg)
+        rc, result, n_targets = _crack_single(sub, device, log)
+        if rc == 2:
+            return 2
+        if result is not None:
+            any_found |= bool(result.found)
+            if len(result.found) >= n_targets:
+                break      # everything cracked; skip longer lengths
+    return 0 if any_found else 1
+
+
+def _crack_single(args, device: str, log: Log):
+    """One crack job; returns (rc, JobResult | None, n_targets)."""
     job = _setup_job(args, device, log)
     if job is None:
-        return 2
+        return 2, None, 0
     engine, hl, gen = job.engine, job.hl, job.gen
     session, restored_hits = job.session, job.restored_hits
     dispatcher, spec = job.dispatcher, job.spec
@@ -439,7 +519,7 @@ def cmd_crack(args, log: Log) -> int:
              tested=result.tested, elapsed=f"{result.elapsed:.2f}s",
              rate=f"{result.rate:,.0f}/s",
              exhausted=result.exhausted)
-    return 0 if result.found else 1
+    return (0 if result.found else 1), result, len(hl.targets)
 
 
 # ---------------------------------------------------------------------------
@@ -616,6 +696,42 @@ def cmd_bench(args, log: Log) -> int:
     return 0
 
 
+def cmd_show(args, log: Log) -> int:
+    """hashcat --show parity: hash:plain for every potfile-cracked
+    target of the hashlist."""
+    from dprf_tpu.runtime.potfile import encode_plain
+
+    engine = get_engine(args.engine, device="cpu")
+    hl = _load_targets(engine, args.hashfile, log)
+    if hl is None:
+        return 2
+    pot = Potfile(args.potfile)
+    n = 0
+    for t in hl.targets:
+        plain = pot.get(t.raw)
+        if plain is not None:
+            print(f"{t.raw}:{encode_plain(plain)}")
+            n += 1
+    log.info("cracked", count=f"{n}/{len(hl.targets)}")
+    return 0
+
+
+def cmd_left(args, log: Log) -> int:
+    """hashcat --left parity: targets still missing from the potfile."""
+    engine = get_engine(args.engine, device="cpu")
+    hl = _load_targets(engine, args.hashfile, log)
+    if hl is None:
+        return 2
+    pot = Potfile(args.potfile)
+    n = 0
+    for t in hl.targets:
+        if pot.get(t.raw) is None:
+            print(t.raw)
+            n += 1
+    log.info("uncracked", count=f"{n}/{len(hl.targets)}")
+    return 0
+
+
 def cmd_engines(args, log: Log) -> int:
     devices = [args.device] if args.device else ["cpu", "jax"]
     for dev in devices:
@@ -638,6 +754,8 @@ _COMMANDS = {
     "serve": cmd_serve,
     "worker": cmd_worker,
     "bench": cmd_bench,
+    "show": cmd_show,
+    "left": cmd_left,
     "engines": cmd_engines,
     "keyspace": cmd_keyspace,
 }
