@@ -41,7 +41,11 @@ def _add_job_args(c, with_hashfile: bool = True) -> None:
     c.add_argument("--device", default="tpu", choices=sorted(_DEVICE_ALIASES),
                    help="execution backend (tpu == the JAX device path)")
     c.add_argument("-a", "--attack", default="mask",
-                   choices=["mask", "wordlist"])
+                   choices=["mask", "wordlist", "combinator",
+                            "hybrid-wm", "hybrid-mw"],
+                   help="mask, wordlist(+rules), combinator "
+                   "('left.txt,right.txt'), or hybrid word+mask / "
+                   "mask+word ('words.txt,?d?d' / '?d?d,words.txt')")
     c.add_argument("--rules", default=None,
                    help="rule set for wordlist attacks (e.g. best64)")
     for i in range(1, 5):
@@ -211,6 +215,10 @@ def _build_gen(attack: str, attack_arg: str, customs: dict,
             f":{i}={customs[i].hex()}" for i in sorted(customs))
         return gen, attack_desc, None
 
+    if attack in ("combinator", "hybrid-wm", "hybrid-mw"):
+        return _build_combinator_gen(attack, attack_arg, customs,
+                                     max_len, engine, device, log)
+
     import hashlib as _hl
 
     from dprf_tpu.generators.wordlist import WordlistRulesGenerator
@@ -237,6 +245,54 @@ def _build_gen(attack: str, attack_arg: str, customs: dict,
     return gen, attack_desc, max_len
 
 
+#: largest mask keyspace a hybrid attack will materialize as a word
+#: table (the mask side of -a 6/7 is typically a short digit/symbol
+#: suffix; a full-size mask belongs in a plain mask attack instead)
+_HYBRID_MASK_CAP = 1 << 20
+
+
+def _build_combinator_gen(attack: str, attack_arg: str, customs: dict,
+                          max_len: Optional[int], engine, device: str,
+                          log: Log):
+    """Combinator (-a combinator: 'left.txt,right.txt') and hybrid
+    modes (-a hybrid-wm: 'words.txt,MASK'; -a hybrid-mw:
+    'MASK,words.txt').  The mask side of a hybrid is materialized as a
+    word table (capped -- see _HYBRID_MASK_CAP)."""
+    from dprf_tpu.generators.combinator import CombinatorGenerator
+    from dprf_tpu.generators.wordlist import load_words
+
+    parts = attack_arg.split(",")
+    if len(parts) != 2:
+        raise ValueError(f"{attack} needs 'LEFT,RIGHT', got {attack_arg!r}")
+    if max_len is None:
+        max_len = _wordlist_max_len(engine.name, engine, device)
+
+    def side(spec: str, is_mask: bool) -> list:
+        if not is_mask:
+            words, skipped = load_words(spec, max_len)
+            if skipped:
+                log.warn("skipped overlong words", file=spec,
+                         count=skipped, max_len=max_len)
+            return words
+        mgen = MaskGenerator(spec, custom=customs or None)
+        if mgen.keyspace > _HYBRID_MASK_CAP:
+            raise ValueError(
+                f"hybrid mask {spec!r} expands to {mgen.keyspace} words "
+                f"(cap {_HYBRID_MASK_CAP}); use a shorter mask or a "
+                "plain mask attack")
+        return [mgen.candidate(i) for i in range(mgen.keyspace)]
+
+    left_mask = attack == "hybrid-mw"
+    right_mask = attack == "hybrid-wm"
+    gen = CombinatorGenerator(side(parts[0], left_mask),
+                              side(parts[1], right_mask),
+                              max_len=max_len)
+    log.info("keyspace", left=gen.n_left, right=gen.n_right,
+             size=gen.keyspace)
+    attack_desc = f"{attack}:{gen.content_id()}"
+    return gen, attack_desc, max_len
+
+
 def _align_unit_size(unit_size: int, attack: str, gen) -> int:
     """Units aligned to whole words: no candidate is ever rehashed at
     unit boundaries on the device path."""
@@ -254,8 +310,12 @@ def _select_worker(engine_name: str, device: str, attack: str, gen,
     same way fast ones do); the multi-chip mesh path for fast engines
     when n_devices > 1; CPU oracle as the fallback.
     """
-    maker_name = ("make_mask_worker" if attack == "mask"
-                  else "make_wordlist_worker")
+    _MAKERS = {"mask": "make_mask_worker",
+               "wordlist": "make_wordlist_worker",
+               "combinator": "make_combinator_worker",
+               "hybrid-wm": "make_combinator_worker",
+               "hybrid-mw": "make_combinator_worker"}
+    maker_name = _MAKERS[attack]
     dev_engine = None
     if device == "jax":
         try:
@@ -263,14 +323,13 @@ def _select_worker(engine_name: str, device: str, attack: str, gen,
         except KeyError:
             pass
     if dev_engine is not None and n_devices > 1:
-        smaker = ("make_sharded_mask_worker" if attack == "mask"
-                  else "make_sharded_wordlist_worker")
+        smaker = maker_name.replace("make_", "make_sharded_")
         if hasattr(dev_engine, smaker):
             from dprf_tpu.parallel.mesh import make_mesh
             mesh = make_mesh(n_devices)
             log.info("mesh", devices=n_devices)
-            per_dev = (batch if attack == "mask"
-                       else max(1, batch // gen.n_rules))
+            per_dev = (max(1, batch // gen.n_rules)
+                       if attack == "wordlist" else batch)
             return getattr(dev_engine, smaker)(
                 gen, targets, mesh, per_dev,
                 hit_capacity=hit_cap, oracle=oracle)

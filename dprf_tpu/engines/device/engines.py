@@ -104,6 +104,14 @@ class JaxEngineBase(DeviceHashEngine, HashEngine):
         return DeviceWordlistWorker(self, gen, targets, batch=batch,
                                     hit_capacity=hit_capacity, oracle=oracle)
 
+    def make_combinator_worker(self, gen, targets, batch: int,
+                               hit_capacity: int, oracle=None):
+        """Fused combinator/hybrid worker (left x right word tables)."""
+        from dprf_tpu.runtime.worker import DeviceCombinatorWorker
+        return DeviceCombinatorWorker(self, gen, targets, batch=batch,
+                                      hit_capacity=hit_capacity,
+                                      oracle=oracle)
+
     # -- multi-chip factories (keyspace DP over a 1-D mesh) --------------
     # Salted engines (bcrypt, PMKID) override these with their own
     # sharded pipelines, so every engine exposes the same multi-chip
@@ -124,6 +132,15 @@ class JaxEngineBase(DeviceHashEngine, HashEngine):
         return ShardedWordlistWorker(
             self, gen, targets, mesh,
             word_batch_per_device=word_batch_per_device,
+            hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_sharded_combinator_worker(self, gen, targets, mesh,
+                                       batch_per_device: int,
+                                       hit_capacity: int, oracle=None):
+        from dprf_tpu.parallel.worker import ShardedCombinatorWorker
+        return ShardedCombinatorWorker(
+            self, gen, targets, mesh,
+            batch_per_device=batch_per_device,
             hit_capacity=hit_capacity, oracle=oracle)
 
     # -- host-facing HashEngine API --------------------------------------

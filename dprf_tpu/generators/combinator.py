@@ -1,0 +1,106 @@
+"""Combinator attack candidate generation (hashcat -a 1, plus the
+hybrid modes built on it).
+
+Keyspace layout: index = left_index * n_right + right_index, a 2-digit
+mixed-radix system (radices [n_left, n_right]) -- the same digit-vector
+convention the mask generator uses, so workers drive combinator steps
+with the identical (base_digits, n_valid) contract and 64-bit keyspaces
+never need 64-bit device arithmetic.
+
+A combined candidate longer than max_len is a *hole* (candidate() ->
+None), exactly like a rejected rule in the wordlist path: device steps
+mask those lanes invalid, host oracles skip them, and resume
+bookkeeping stays pure index ranges.
+
+Both word tables live packed in HBM (uint8[N, L] + int32[N]); the
+device step gathers rows by index, so after the one-time upload no
+candidate material crosses the host boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from dprf_tpu.generators.base import CandidateGenerator
+
+
+def _pack_table(words: Sequence[bytes]):
+    if not words:
+        raise ValueError("empty word table")
+    width = max(1, max(len(w) for w in words))
+    buf = np.zeros((len(words), width), dtype=np.uint8)
+    lens = np.zeros((len(words),), dtype=np.int32)
+    for i, w in enumerate(words):
+        buf[i, :len(w)] = np.frombuffer(w, dtype=np.uint8)
+        lens[i] = len(w)
+    return buf, lens
+
+
+class CombinatorGenerator(CandidateGenerator):
+    """left words x right words -> left+right concatenations."""
+
+    def __init__(self, left: Sequence[bytes], right: Sequence[bytes],
+                 max_len: int = 55):
+        self._lbuf, self._llens = _pack_table(left)
+        self._rbuf, self._rlens = _pack_table(right)
+        self.n_left = self._lbuf.shape[0]
+        self.n_right = self._rbuf.shape[0]
+        self.max_len = self.max_length = max_len
+        self.keyspace = self.n_left * self.n_right
+        #: mixed-radix radices, most-significant first (mask convention)
+        self.radices = (self.n_left, self.n_right)
+
+    # ---------------- host (oracle) path ----------------
+
+    def digits(self, index: int) -> list[int]:
+        if not 0 <= index < self.keyspace:
+            raise IndexError(
+                f"index {index} outside keyspace {self.keyspace}")
+        li, ri = divmod(index, self.n_right)
+        return [li, ri]
+
+    def candidate(self, index: int) -> Optional[bytes]:
+        li, ri = self.digits(index)
+        w = (self._lbuf[li, :self._llens[li]].tobytes()
+             + self._rbuf[ri, :self._rlens[ri]].tobytes())
+        return w if len(w) <= self.max_len else None
+
+    def candidates(self, start: int, count: int) -> list:
+        return [self.candidate(i)
+                for i in range(start, min(start + count, self.keyspace))]
+
+    def index_of(self, candidate: bytes) -> int:
+        """First (left, right) split producing `candidate` (test helper;
+        splits are not necessarily unique)."""
+        for li in range(self.n_left):
+            lw = self._lbuf[li, :self._llens[li]].tobytes()
+            if not candidate.startswith(lw):
+                continue
+            rest = candidate[len(lw):]
+            for ri in range(self.n_right):
+                if self._rbuf[ri, :self._rlens[ri]].tobytes() == rest:
+                    return li * self.n_right + ri
+        raise ValueError(f"{candidate!r} not in combinator keyspace")
+
+    def content_id(self) -> str:
+        import hashlib
+        h = hashlib.sha256()
+        h.update(b"dprf-combinator-v1\0")
+        for buf, lens in ((self._lbuf, self._llens),
+                          (self._rbuf, self._rlens)):
+            h.update(str(len(lens)).encode() + b"\0")
+            h.update(np.ascontiguousarray(lens))
+            h.update(np.ascontiguousarray(buf))
+        return h.hexdigest()[:16]
+
+    # ---------------- device path ----------------
+
+    def tables(self):
+        """The packed (left_buf, left_lens, right_buf, right_lens)."""
+        return self._lbuf, self._llens, self._rbuf, self._rlens
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"<CombinatorGenerator {self.n_left}x{self.n_right} "
+                f"keyspace={self.keyspace}>")

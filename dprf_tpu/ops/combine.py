@@ -1,0 +1,135 @@
+"""Fused combinator crack steps: (left x right) -> concat -> digest ->
+compare -> hits, entirely on device.
+
+The decode is two gathers (one row per side) plus a vectorized
+variable-shift concatenation -- out[b, p] = left[b, p] for p < llen[b],
+else right[b, p - llen[b]] -- followed by the engines' varlen packing.
+Lanes whose combined length exceeds the single-block limit are masked
+invalid (keyspace holes, same contract as rejected rules).
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dprf_tpu.ops import compare as cmp_ops
+
+
+def _decode_combine(gen, lbuf, llens, rbuf, rlens, base_digits,
+                    batch: int, lane_offset=0):
+    """base_digits int32[2] + lane -> (cand uint8[B, W], lengths
+    int32[B], within-block bool[B]).  W = min(max_len, Lw + Rw)."""
+    R = gen.n_right
+    lane = lane_offset + jnp.arange(batch, dtype=jnp.int32)
+    s = base_digits[1] + lane
+    ri = s % R
+    li = base_digits[0] + s // R
+    lw = jnp.take(lbuf, li, axis=0)          # [B, Lw]
+    ll = jnp.take(llens, li)
+    rw = jnp.take(rbuf, ri, axis=0)          # [B, Rw]
+    rl = jnp.take(rlens, ri)
+    width = min(gen.max_len, lbuf.shape[1] + rbuf.shape[1])
+    pos = jnp.arange(width, dtype=jnp.int32)[None, :]
+    lpad = jnp.zeros((batch, width), jnp.uint8).at[
+        :, :min(width, lbuf.shape[1])].set(
+            lw[:, :min(width, lbuf.shape[1])])
+    ridx = jnp.clip(pos - ll[:, None], 0, rbuf.shape[1] - 1)
+    rshift = jnp.take_along_axis(rw, ridx, axis=1)
+    cand = jnp.where(pos < ll[:, None], lpad, rshift)
+    lengths = ll + rl
+    fits = lengths <= gen.max_len
+    return cand, jnp.minimum(lengths, gen.max_len), fits
+
+
+def make_combinator_crack_step(engine, gen,
+                               targets: Union[jnp.ndarray,
+                                              cmp_ops.TargetTable],
+                               batch: int, hit_capacity: int = 64,
+                               widen_utf16: bool = False):
+    """step(base_digits int32[2], n_valid int32) ->
+    (count, lanes int32[cap], tpos int32[cap]) -- the DeviceMaskWorker
+    contract, so the standard worker machinery drives it unchanged."""
+    from dprf_tpu.ops import pack as pack_ops
+
+    lbuf, llens, rbuf, rlens = map(jnp.asarray, gen.tables())
+    multi = isinstance(targets, cmp_ops.TargetTable)
+
+    @jax.jit
+    def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray):
+        cand, lengths, fits = _decode_combine(
+            gen, lbuf, llens, rbuf, rlens, base_digits, batch)
+        if widen_utf16:
+            cand = pack_ops.utf16le_widen(cand)
+            lengths = lengths * 2
+        words = engine.pack_varlen(cand, lengths)
+        digest = engine.digest_packed(words)
+        if multi:
+            found, tpos = cmp_ops.compare_multi(digest, targets)
+        else:
+            found = cmp_ops.compare_single(digest, targets)
+            tpos = jnp.zeros((batch,), jnp.int32)
+        found = found & fits & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, tpos, hit_capacity)
+
+    return step
+
+
+def make_sharded_combinator_crack_step(
+        engine, gen, targets: Union[jnp.ndarray, cmp_ops.TargetTable],
+        mesh, batch_per_device: int, hit_capacity: int = 64,
+        widen_utf16: bool = False):
+    """Multi-chip combinator step; same output contract as
+    parallel/sharded.make_sharded_mask_crack_step (replicated buffers).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from dprf_tpu.ops import pack as pack_ops
+    from dprf_tpu.parallel.mesh import SHARD_AXIS
+
+    lbuf, llens, rbuf, rlens = map(jnp.asarray, gen.tables())
+    multi = isinstance(targets, cmp_ops.TargetTable)
+    B = batch_per_device
+
+    def shard_fn(base_digits, n_valid):
+        dev = lax.axis_index(SHARD_AXIS)
+        offset = (dev * B).astype(jnp.int32)
+        cand, lengths, fits = _decode_combine(
+            gen, lbuf, llens, rbuf, rlens, base_digits, B,
+            lane_offset=offset)
+        if widen_utf16:
+            cand = pack_ops.utf16le_widen(cand)
+            lengths = lengths * 2
+        words = engine.pack_varlen(cand, lengths)
+        digest = engine.digest_packed(words)
+        if multi:
+            found, tpos = cmp_ops.compare_multi(digest, targets)
+        else:
+            found = cmp_ops.compare_single(digest, targets)
+            tpos = jnp.zeros((B,), jnp.int32)
+        lane_global = offset + jnp.arange(B, dtype=jnp.int32)
+        found = found & fits & (lane_global < n_valid)
+        count, lanes, tpos = cmp_ops.compact_hits(found, tpos,
+                                                  hit_capacity)
+        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
+        total = lax.psum(count, SHARD_AXIS)
+        # replicated hit buffers (see parallel/sharded.py)
+        return (total[None],
+                lax.all_gather(count, SHARD_AXIS),
+                lax.all_gather(lanes, SHARD_AXIS),
+                lax.all_gather(tpos, SHARD_AXIS))
+
+    sharded = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(), P()),
+        out_specs=(P(), P(), P(), P()), check_vma=False)
+
+    @jax.jit
+    def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray):
+        total, counts, lanes, tpos = sharded(base_digits, n_valid)
+        return total[0], counts, lanes, tpos
+
+    step.super_batch = mesh.devices.size * B
+    return step

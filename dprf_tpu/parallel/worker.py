@@ -53,6 +53,26 @@ class ShardedMaskWorker(MaskWorkerBase):
         return hits
 
 
+class ShardedCombinatorWorker(ShardedMaskWorker):
+    """Combinator / hybrid attack spread over a device mesh: the
+    sharded combinator step with ShardedMaskWorker's hit decoding."""
+
+    def __init__(self, engine, gen, targets: Sequence[Target], mesh,
+                 batch_per_device: int = 1 << 18, hit_capacity: int = 64,
+                 oracle: Optional[HashEngine] = None):
+        from dprf_tpu.ops.combine import (
+            make_sharded_combinator_crack_step)
+
+        tgt = self._setup_targets(engine, gen, targets, hit_capacity,
+                                  oracle)
+        self.mesh = mesh
+        self.super_batch = self.stride = (mesh.devices.size
+                                          * batch_per_device)
+        self.step = make_sharded_combinator_crack_step(
+            engine, gen, tgt, mesh, batch_per_device, hit_capacity,
+            widen_utf16=getattr(engine, "widen_utf16", False))
+
+
 class ShardedWordlistWorker(WordlistWorkerBase):
     """Wordlist+rules attack spread over a device mesh.
 

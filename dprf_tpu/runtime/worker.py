@@ -321,6 +321,24 @@ class PallasMaskWorker(MaskWorkerBase):
         return hits
 
 
+class DeviceCombinatorWorker(MaskWorkerBase):
+    """Fused-pipeline worker for combinator / hybrid attacks: same
+    (base_digits, n_valid) step contract as the mask workers (the
+    combinator keyspace is a 2-digit mixed-radix system)."""
+
+    def __init__(self, engine, gen, targets: Sequence[Target],
+                 batch: int = 1 << 18, hit_capacity: int = 64,
+                 oracle: Optional[HashEngine] = None):
+        from dprf_tpu.ops.combine import make_combinator_crack_step
+
+        tgt = self._setup_targets(engine, gen, targets, hit_capacity,
+                                  oracle)
+        self.batch = self.stride = batch
+        self.step = make_combinator_crack_step(
+            engine, gen, tgt, batch, hit_capacity,
+            widen_utf16=getattr(engine, "widen_utf16", False))
+
+
 class DeviceMaskWorker(MaskWorkerBase):
     """Fused-pipeline worker for mask attacks on fast (unsalted) hashes."""
 
