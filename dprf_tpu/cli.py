@@ -60,6 +60,10 @@ def _add_job_args(c, with_hashfile: bool = True) -> None:
     c.add_argument("--unit-size", type=int, default=1 << 22)
     c.add_argument("--batch", type=int, default=1 << 18)
     c.add_argument("--hit-cap", type=int, default=64)
+    c.add_argument("--skip", type=int, default=0, metavar="N",
+                   help="skip the first N keyspace indices")
+    c.add_argument("--limit", type=int, default=None, metavar="N",
+                   help="restrict the sweep to N indices after --skip")
     c.add_argument("--quiet", "-q", action="store_true")
 
 
@@ -159,10 +163,18 @@ def _build_parser() -> argparse.ArgumentParser:
     e = sub.add_parser("engines", help="list available engines")
     e.add_argument("--device", default=None)
 
-    k = sub.add_parser("keyspace", help="print keyspace size of a mask")
-    k.add_argument("mask")
+    k = sub.add_parser("keyspace", help="print the keyspace size of "
+                       "an attack (mask, wordlist+rules, combinator, "
+                       "hybrid)")
+    k.add_argument("attack_arg", metavar="mask_or_files")
+    k.add_argument("-a", "--attack", default="mask",
+                   choices=["mask", "wordlist", "combinator",
+                            "hybrid-wm", "hybrid-mw"])
+    k.add_argument("--rules", default=None)
+    k.add_argument("--max-len", type=int, default=55)
     for i in range(1, 5):
         k.add_argument(f"--custom{i}", default=None)
+    k.add_argument("--quiet", "-q", action="store_true")
     return p
 
 
@@ -439,9 +451,25 @@ def _setup_job(args, device: str, log: Log,
     session, completed, restored_hits = sess
 
     kw = {} if lease_timeout is None else {"lease_timeout": lease_timeout}
-    if completed:
+    # --skip/--limit restrict THIS run's sweep by pre-marking the
+    # excluded ranges done (run-scoped: not part of the job identity,
+    # exactly like resuming a partially-covered session)
+    skip = min(getattr(args, "skip", 0) or 0, gen.keyspace)
+    limit = getattr(args, "limit", None)
+    restricted = list(completed)
+    if skip:
+        restricted.append((0, skip))
+        log.info("skipping keyspace prefix", skip=skip)
+    if limit is not None and skip + limit < gen.keyspace:
+        restricted.append((skip + limit, gen.keyspace))
+        log.info("limiting sweep", limit=limit)
+    if (skip or limit is not None) and session is not None:
+        log.warn("--skip/--limit ranges will be journaled as covered "
+                 "in this session; resume without them will NOT sweep "
+                 "the excluded ranges")
+    if restricted:
         dispatcher = Dispatcher.from_completed(
-            gen.keyspace, unit_size, completed, **kw)
+            gen.keyspace, unit_size, restricted, **kw)
     else:
         dispatcher = Dispatcher(gen.keyspace, unit_size, **kw)
     return _JobSetup(engine, hl, gen, max_len, unit_size, spec,
@@ -545,9 +573,11 @@ def _crack_single(args, device: str, log: Log):
     potfile = None if args.no_potfile else Potfile(args.potfile)
 
     def progress(done, total, nfound, rate):
+        eta = (total - done) / rate if rate > 0 else float("inf")
         log.info("progress", pct=f"{100.0 * done / total:.2f}%",
                  found=f"{nfound}/{len(hl.targets)}",
-                 rate=f"{rate:,.0f}/s")
+                 rate=f"{rate:,.0f}/s",
+                 eta=(f"{eta:,.0f}s" if eta != float("inf") else "?"))
 
     coord = Coordinator(spec, hl.targets, dispatcher, worker,
                         session=session, potfile=potfile,
@@ -803,7 +833,17 @@ def cmd_engines(args, log: Log) -> int:
 
 
 def cmd_keyspace(args, log: Log) -> int:
-    gen = MaskGenerator(args.mask, custom=_customs(args) or None)
+    customs = _customs(args)
+    if args.attack == "mask":
+        gen = MaskGenerator(args.attack_arg, custom=customs or None)
+    elif args.attack == "wordlist":
+        from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+        gen = WordlistRulesGenerator.from_files(
+            args.attack_arg, args.rules, max_len=args.max_len)
+    else:
+        gen, _, _ = _build_combinator_gen(
+            args.attack, args.attack_arg, customs, args.max_len,
+            None, "cpu", log)
     print(gen.keyspace)
     return 0
 

@@ -273,3 +273,36 @@ def test_show_and_left(tmp_path, capsys, md5_of):
                        "--potfile", pot, "-q"], capsys)
     assert rc == 0
     assert out.strip() == md5_of(b"zz")
+
+
+def test_skip_limit_restricts_sweep(tmp_path, capsys, md5_of):
+    """--skip/--limit sweep only the requested index window."""
+    # "ab" is index 0*26+1 = 1; "zz" is index 675 in ?l?l
+    hashfile = _mk_hashfile(tmp_path, [md5_of(b"ab"), md5_of(b"zz")])
+    rc, out = run_cli(["crack", "?l?l", hashfile, "--engine", "md5",
+                       "--device", "cpu", "--no-potfile",
+                       "--skip", "0", "--limit", "100",
+                       "--unit-size", "32", "-q"], capsys)
+    assert rc == 0
+    assert f"{md5_of(b'ab')}:ab" in out
+    assert "zz" not in out                    # index 675 outside limit
+    rc, out = run_cli(["crack", "?l?l", hashfile, "--engine", "md5",
+                       "--device", "cpu", "--no-potfile",
+                       "--skip", "600", "--unit-size", "32", "-q"],
+                      capsys)
+    assert rc == 0
+    assert f"{md5_of(b'zz')}:zz" in out
+    assert ":ab" not in out                   # index 1 skipped
+
+
+def test_keyspace_modes(tmp_path, capsys):
+    rc, out = run_cli(["keyspace", "?l?d"], capsys)
+    assert rc == 0 and out.strip() == "260"
+    wl = tmp_path / "w.txt"
+    wl.write_text("a\nb\nc\n")
+    rc, out = run_cli(["keyspace", str(wl), "-a", "wordlist",
+                       "--rules", "best64"], capsys)
+    assert rc == 0 and out.strip() == str(3 * 64)
+    rc, out = run_cli(["keyspace", f"{wl},?d?d", "-a", "hybrid-wm"],
+                      capsys)
+    assert rc == 0 and out.strip() == "300"
