@@ -507,3 +507,37 @@ def test_hashlist_dedupes_same_digest_different_case():
     res = parse_lines(eng, [d, d.upper(), d])
     assert len(res.targets) == 1
     assert res.duplicates == 2
+
+
+def test_cli_worker_combinator_job(tmp_path, capsys):
+    """A distributed combinator job: the worker rebuilds the left/right
+    tables from the wire description (files must exist on its host)
+    and cracks the planted concatenation."""
+    from dprf_tpu.generators.combinator import CombinatorGenerator
+    from dprf_tpu.runtime.session import job_fingerprint
+
+    lp = tmp_path / "l.txt"
+    lp.write_text("red\nblue\n")
+    rp = tmp_path / "r.txt"
+    rp.write_text("fish\nbird\n")
+    eng = get_engine("md5")
+    gen = CombinatorGenerator([b"red", b"blue"], [b"fish", b"bird"],
+                              max_len=55)
+    targets = [eng.parse_target(hashlib.md5(b"bluebird").hexdigest())]
+    attack_arg = f"{lp},{rp}"
+    fp = job_fingerprint("md5", f"combinator:{gen.content_id()}",
+                         gen.keyspace, [t.digest for t in targets])
+    job = {"engine": "md5", "attack": "combinator",
+           "attack_arg": attack_arg, "customs": {}, "rules": None,
+           "max_len": 55, "targets": [t.raw for t in targets],
+           "keyspace": gen.keyspace, "unit_size": 4, "batch": 64,
+           "hit_cap": 8, "fingerprint": fp}
+    state, server, _ = _serve(job, gen, targets)
+    try:
+        host, port = server.address
+        rc = cli_main(["worker", "--connect", f"{host}:{port}",
+                       "--device", "tpu", "--quiet"])
+        assert rc == 0
+        assert state.found == {0: b"bluebird"}
+    finally:
+        server.shutdown()
