@@ -336,7 +336,7 @@ def _select_worker(engine_name: str, device: str, attack: str, gen,
             pass
     if dev_engine is not None and n_devices > 1:
         smaker = maker_name.replace("make_", "make_sharded_")
-        if hasattr(dev_engine, smaker):
+        if callable(getattr(dev_engine, smaker, None)):
             from dprf_tpu.parallel.mesh import make_mesh
             mesh = make_mesh(n_devices)
             log.info("mesh", devices=n_devices)
@@ -347,7 +347,7 @@ def _select_worker(engine_name: str, device: str, attack: str, gen,
                 hit_capacity=hit_cap, oracle=oracle)
         log.warn("engine has no multi-chip pipeline; using one chip",
                  engine=engine_name)
-    if dev_engine is not None and hasattr(dev_engine, maker_name):
+    if dev_engine is not None and callable(getattr(dev_engine, maker_name, None)):
         return getattr(dev_engine, maker_name)(
             gen, targets, batch=batch, hit_capacity=hit_cap, oracle=oracle)
     if device == "jax":

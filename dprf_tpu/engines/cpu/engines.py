@@ -64,6 +64,75 @@ class Sha384Engine(_HashlibEngine):
     _algo = "sha384"
 
 
+#: fixed device salt buffer width; also bounds parseable salt length
+SALT_MAX = 32
+
+_SALT_HEX_RE = None
+
+
+def parse_salted_line(text: str, digest_size: int):
+    """hashcat-convention 'hexdigest:salt' -> (digest, salt bytes);
+    '$HEX[..]' decodes hex salts.  Shared by CPU and device engines."""
+    import re
+    global _SALT_HEX_RE
+    if _SALT_HEX_RE is None:
+        _SALT_HEX_RE = re.compile(r"^\$HEX\[([0-9a-fA-F]*)\]$")
+    digest_hex, sep, salt_text = text.strip().partition(":")
+    if not sep:
+        raise ValueError(f"expected 'digest:salt', got {text!r}")
+    digest = bytes.fromhex(digest_hex)
+    if len(digest) != digest_size:
+        raise ValueError(f"expected {digest_size}-byte digest in {text!r}")
+    m = _SALT_HEX_RE.match(salt_text)
+    salt = bytes.fromhex(m.group(1)) if m else salt_text.encode("latin-1")
+    if len(salt) > SALT_MAX:
+        raise ValueError(f"salt longer than {SALT_MAX} bytes in {text!r}")
+    return digest, salt
+
+
+class _SaltedCpuMixin(HashEngine):
+    """CPU oracle for the salted fast modes: md5/sha1/sha256 over
+    $pass.$salt ('ps', hashcat 10/110/1410) and $salt.$pass ('sp',
+    hashcat 20/120/1420)."""
+
+    salted = True
+    _order: str
+
+    def parse_target(self, text: str) -> Target:
+        digest, salt = parse_salted_line(text, self.digest_size)
+        return Target(raw=text.strip(), digest=digest,
+                      params={"salt": salt})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError(f"{self.name} needs target params (salt)")
+        salt = params["salt"]
+        if self._order == "ps":
+            return [hashlib.new(self._algo, c + salt).digest()
+                    for c in candidates]
+        return [hashlib.new(self._algo, salt + c).digest()
+                for c in candidates]
+
+
+def _register_salted_cpu(algo: str, digest_size: int):
+    for order in ("ps", "sp"):
+        name = f"{algo}-{order}"
+        cls = type(f"{algo.title()}{order.title()}Engine",
+                   (_SaltedCpuMixin,),
+                   {"name": name, "digest_size": digest_size,
+                    "_algo": algo, "_order": order,
+                    # leave headroom for any parseable salt in the
+                    # single 64-byte block
+                    "max_candidate_len": 55 - SALT_MAX})
+        register(name, device="cpu")(cls)
+
+
+_register_salted_cpu("md5", 16)
+_register_salted_cpu("sha1", 20)
+_register_salted_cpu("sha256", 32)
+
+
 @register("ntlm")
 class NtlmEngine(HashEngine):
     """NTLM: MD4 over the UTF-16LE encoding of the password."""

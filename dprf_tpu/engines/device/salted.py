@@ -1,0 +1,391 @@
+"""Salted fast-hash engines: md5/sha1/sha256 over $pass.$salt and
+$salt.$pass (hashcat modes 10/20, 110/120, 1410/1420).
+
+Target lines use the hashcat convention ``hexdigest:salt`` (the salt is
+the literal bytes after the first colon; ``$HEX[..]`` decodes hex
+salts).  Salted sweeps are inherently per-target -- each salt reshapes
+the digest of every candidate -- so the workers sweep the keyspace once
+per target, exactly like bcrypt's; unlike bcrypt, ONE compiled step
+serves every target because the salt is a runtime argument (a fixed
+buffer + length), not a trace-time constant.
+
+On device the salt is appended (ps) or prepended (sp) to the candidate
+with the same vectorized variable-shift select the combinator decode
+uses, then flows through the engines' varlen packing -- no new hash
+code at all; the compression functions are the ones every other path
+shares.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.base import Target
+from dprf_tpu.engines.cpu.engines import SALT_MAX, parse_salted_line
+from dprf_tpu.engines.device.engines import (JaxMd5Engine, JaxSha1Engine,
+                                             JaxSha256Engine)
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.runtime.worker import (Hit, CpuWorker, word_cover_range,
+                                     wordlist_lane_to_gidx)
+from dprf_tpu.runtime.workunit import WorkUnit
+
+def _salted_concat(cand, length: int, salt, salt_len, order: str,
+                   batch: int):
+    """cand uint8[B, L] + salt uint8[SALT_MAX] (salt_len valid) ->
+    (bytes uint8[B, L + SALT_MAX], lengths int32[B])."""
+    width = length + SALT_MAX
+    pos = jnp.arange(width, dtype=jnp.int32)[None, :]
+    if order == "ps":
+        out = jnp.zeros((batch, width), jnp.uint8).at[:, :length].set(cand)
+        sidx = jnp.clip(pos - length, 0, SALT_MAX - 1)
+        svals = jnp.broadcast_to(salt[None, :], (batch, SALT_MAX))
+        out = jnp.where(pos < length, out,
+                        jnp.take_along_axis(svals, sidx, axis=1))
+    else:
+        cpad = jnp.zeros((batch, width), jnp.uint8).at[:, :length].set(cand)
+        cidx = jnp.clip(pos - salt_len, 0, width - 1)
+        cshift = jnp.take_along_axis(cpad, cidx, axis=1)
+        svals = jnp.broadcast_to(
+            jnp.pad(salt, (0, width - SALT_MAX))[None, :], (batch, width))
+        out = jnp.where(pos < salt_len, svals, cshift)
+    return out, jnp.full((batch,), length, jnp.int32) + salt_len
+
+
+def make_salted_mask_step(engine, gen, batch: int, order: str,
+                          hit_capacity: int = 64):
+    """step(base_digits, n_valid, salt uint8[SALT_MAX], salt_len int32,
+    target uint32[W]) -> (count, lanes, _)."""
+    flat = gen.flat_charsets
+    length = gen.length
+
+    @jax.jit
+    def step(base_digits, n_valid, salt, salt_len, target):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        byts, lengths = _salted_concat(cand, length, salt, salt_len,
+                                       order, batch)
+        words = engine.pack_varlen(byts, lengths)
+        digest = engine.digest_packed(words)
+        found = cmp_ops.compare_single(digest, target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def make_salted_wordlist_step(engine, gen, word_batch: int, order: str,
+                              hit_capacity: int = 64):
+    """Wordlist(+rules) variant; lanes are flat r*B + b indices."""
+    from jax import lax
+
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, L = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+
+    @jax.jit
+    def step(w0, n_valid_words, salt, salt_len, target):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
+        RB = cw.shape[0]
+        width = L + SALT_MAX
+        pos = jnp.arange(width, dtype=jnp.int32)[None, :]
+        if order == "ps":
+            out = jnp.zeros((RB, width), jnp.uint8).at[:, :L].set(cw)
+            sidx = jnp.clip(pos - cl[:, None], 0, SALT_MAX - 1)
+            svals = jnp.broadcast_to(salt[None, :], (RB, SALT_MAX))
+            out = jnp.where(pos < cl[:, None], out,
+                            jnp.take_along_axis(svals, sidx, axis=1))
+        else:
+            cpad = jnp.zeros((RB, width), jnp.uint8).at[:, :L].set(cw)
+            cidx = jnp.clip(pos - salt_len, 0, width - 1)
+            out = jnp.where(
+                pos < salt_len,
+                jnp.broadcast_to(jnp.pad(salt, (0, width - SALT_MAX))[None, :],
+                                 (RB, width)),
+                jnp.take_along_axis(cpad, cidx, axis=1))
+        lengths = cl + salt_len
+        words = engine.pack_varlen(out, lengths)
+        digest = engine.digest_packed(words)
+        found = cmp_ops.compare_single(digest, target) & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
+                                    hit_capacity)
+
+    return step
+
+
+def make_sharded_salted_mask_step(engine, gen, mesh, batch_per_device: int,
+                                  order: str, hit_capacity: int = 64):
+    """Multi-chip salted mask step: the usual keyspace-DP shape
+    (lane-slice per chip, psum'd count, replicated hit buffers)."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from dprf_tpu.parallel.mesh import SHARD_AXIS
+
+    flat = gen.flat_charsets
+    length = gen.length
+    B = batch_per_device
+
+    def shard_fn(base_digits, n_valid, salt, salt_len, target):
+        dev = lax.axis_index(SHARD_AXIS)
+        offset = (dev * B).astype(jnp.int32)
+        cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
+        byts, lengths = _salted_concat(cand, length, salt, salt_len,
+                                       order, B)
+        digest = engine.digest_packed(engine.pack_varlen(byts, lengths))
+        lane_global = offset + jnp.arange(B, dtype=jnp.int32)
+        found = cmp_ops.compare_single(digest, target) & \
+            (lane_global < n_valid)
+        count, lanes, tpos = cmp_ops.compact_hits(
+            found, jnp.zeros((B,), jnp.int32), hit_capacity)
+        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
+        total = lax.psum(count, SHARD_AXIS)
+        # replicated hit buffers (see parallel/sharded.py)
+        return (total[None],
+                lax.all_gather(count, SHARD_AXIS),
+                lax.all_gather(lanes, SHARD_AXIS),
+                lax.all_gather(tpos, SHARD_AXIS))
+
+    sharded = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(),) * 5,
+        out_specs=(P(), P(), P(), P()), check_vma=False)
+
+    @jax.jit
+    def step(base_digits, n_valid, salt, salt_len, target):
+        total, counts, lanes, tpos = sharded(base_digits, n_valid, salt,
+                                             salt_len, target)
+        return total[0], counts, lanes, tpos
+
+    step.super_batch = mesh.devices.size * B
+    return step
+
+
+class _SaltedWorkerBase:
+    """Per-target sweep shared by the salted mask/wordlist workers."""
+
+    def __init__(self, engine, gen, targets: Sequence[Target],
+                 batch: int, hit_capacity: int, oracle):
+        self.engine = engine
+        self.gen = gen
+        self.targets = list(targets)
+        self.hit_capacity = hit_capacity
+        self.oracle = oracle
+        self.batch = batch
+        dt = "<u4" if engine.little_endian else ">u4"
+        self._targs = []
+        for t in self.targets:
+            salt = t.params["salt"]
+            buf = np.zeros((SALT_MAX,), np.uint8)
+            buf[:len(salt)] = np.frombuffer(salt, np.uint8)
+            self._targs.append((
+                jnp.asarray(buf), jnp.int32(len(salt)),
+                jnp.asarray(np.frombuffer(t.digest, dtype=dt)
+                            .astype(np.uint32))))
+
+    def _rescan(self, start: int, end: int, ti: int) -> list[Hit]:
+        if self.oracle is None:
+            raise RuntimeError(
+                f"hit buffer overflow (> {self.hit_capacity}) and no "
+                "oracle engine to rescan with; raise hit_capacity")
+        sub = WorkUnit(-1, start, end - start)
+        hits = CpuWorker(self.oracle, self.gen,
+                         [self.targets[ti]]).process(sub)
+        return [Hit(ti, h.cand_index, h.plaintext) for h in hits]
+
+
+class SaltedMaskWorker(_SaltedWorkerBase):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 18,
+                 hit_capacity: int = 64, oracle=None):
+        super().__init__(engine, gen, targets, batch, hit_capacity, oracle)
+        self.stride = batch
+        self.step = make_salted_mask_step(engine, gen, batch,
+                                          engine.order, hit_capacity)
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        hits: list[Hit] = []
+        for ti in range(len(self.targets)):
+            salt, salt_len, tgt = self._targs[ti]
+            queued = []
+            for bstart in range(unit.start, unit.end, self.stride):
+                n_valid = min(self.stride, unit.end - bstart)
+                base = jnp.asarray(self.gen.digits(bstart), dtype=jnp.int32)
+                queued.append((bstart, self.step(
+                    base, jnp.int32(n_valid), salt, salt_len, tgt)))
+            for bstart, (count, lanes, _) in queued:
+                count = int(count)
+                if count == 0:
+                    continue
+                if count > self.hit_capacity:
+                    hits.extend(self._rescan(
+                        bstart, min(bstart + self.stride, unit.end), ti))
+                    continue
+                for lane in np.asarray(lanes):
+                    if lane < 0:
+                        continue
+                    gidx = bstart + int(lane)
+                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+
+class SaltedWordlistWorker(_SaltedWorkerBase):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 18,
+                 hit_capacity: int = 64, oracle=None):
+        super().__init__(engine, gen, targets, batch, hit_capacity, oracle)
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self.step = make_salted_wordlist_step(engine, gen, self.word_batch,
+                                              engine.order, hit_capacity)
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        R = self.gen.n_rules
+        w_start, w_end = word_cover_range(unit, R)
+        hits: list[Hit] = []
+        for ti in range(len(self.targets)):
+            salt, salt_len, tgt = self._targs[ti]
+            queued = []
+            for ws in range(w_start, w_end, self.word_batch):
+                nw = min(self.word_batch, w_end - ws, self.gen.n_words - ws)
+                if nw <= 0:
+                    break
+                queued.append((ws, nw, self.step(
+                    jnp.int32(ws), jnp.int32(nw), salt, salt_len, tgt)))
+            for ws, nw, (count, lanes, _) in queued:
+                count = int(count)
+                if count == 0:
+                    continue
+                if count > self.hit_capacity:
+                    start = max(unit.start, ws * R)
+                    end = min(unit.end, (ws + nw) * R)
+                    hits.extend(self._rescan(start, end, ti))
+                    continue
+                for lane in np.asarray(lanes):
+                    if lane < 0:
+                        continue
+                    gidx = wordlist_lane_to_gidx(int(lane), ws,
+                                                 self.word_batch, R)
+                    if not unit.start <= gidx < unit.end:
+                        continue
+                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+
+class ShardedSaltedMaskWorker(SaltedMaskWorker):
+    """SaltedMaskWorker over a device mesh: super-batch strides, the
+    per-shard overflow check, super-batch-global lanes."""
+
+    def __init__(self, engine, gen, targets, mesh,
+                 batch_per_device: int = 1 << 18, hit_capacity: int = 64,
+                 oracle=None):
+        _SaltedWorkerBase.__init__(self, engine, gen, targets,
+                                   mesh.devices.size * batch_per_device,
+                                   hit_capacity, oracle)
+        self.mesh = mesh
+        self.stride = self.batch
+        self.step = make_sharded_salted_mask_step(
+            engine, gen, mesh, batch_per_device, engine.order,
+            hit_capacity)
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        hits: list[Hit] = []
+        for ti in range(len(self.targets)):
+            salt, salt_len, tgt = self._targs[ti]
+            queued = []
+            for bstart in range(unit.start, unit.end, self.stride):
+                n_valid = min(self.stride, unit.end - bstart)
+                base = jnp.asarray(self.gen.digits(bstart),
+                                   dtype=jnp.int32)
+                queued.append((bstart, self.step(
+                    base, jnp.int32(n_valid), salt, salt_len, tgt)))
+            for bstart, (total, counts, lanes, _) in queued:
+                if int(total) == 0:
+                    continue
+                if (np.asarray(counts) > self.hit_capacity).any():
+                    hits.extend(self._rescan(
+                        bstart, min(bstart + self.stride, unit.end), ti))
+                    continue
+                for lane in np.asarray(lanes).ravel():
+                    if lane < 0:
+                        continue
+                    gidx = bstart + int(lane)
+                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+
+class _SaltedDeviceMixin:
+    """Device engine for one (algo, order): the base engine's packing
+    and digest with the salted worker factories."""
+
+    salted = True
+    order: str
+    #: leave headroom for any parseable salt in the single 64-byte
+    #: block; the worker factories additionally check ACTUAL salts
+    max_candidate_len = 55 - SALT_MAX
+
+    def parse_target(self, text: str) -> Target:
+        digest, salt = parse_salted_line(text, self.digest_size)
+        return Target(raw=text.strip(), digest=digest,
+                      params={"salt": salt})
+
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        self._check_lengths(gen.length, targets)
+        return SaltedMaskWorker(self, gen, targets, batch=batch,
+                                hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        self._check_lengths(gen.max_len, targets)
+        return SaltedWordlistWorker(self, gen, targets, batch=batch,
+                                    hit_capacity=hit_capacity,
+                                    oracle=oracle)
+
+    def make_sharded_mask_worker(self, gen, targets, mesh,
+                                 batch_per_device: int, hit_capacity: int,
+                                 oracle=None):
+        self._check_lengths(gen.length, targets)
+        return ShardedSaltedMaskWorker(self, gen, targets, mesh,
+                                       batch_per_device=batch_per_device,
+                                       hit_capacity=hit_capacity,
+                                       oracle=oracle)
+
+    # the generic unsalted sharded wordlist step must NOT be inherited
+    # (it would silently ignore the salt); shadow it so the CLI
+    # degrades to the single-chip salted worker with a warning instead
+    make_sharded_wordlist_worker = None
+
+    # likewise the generic combinator worker compares unsalted digests
+    make_combinator_worker = None
+    make_sharded_combinator_worker = None
+
+    def _check_lengths(self, cand_len: int, targets) -> None:
+        worst = cand_len + max(len(t.params["salt"]) for t in targets)
+        if worst > 55:
+            raise ValueError(
+                f"candidate+salt can reach {worst} bytes, over the "
+                "55-byte single-block limit; shorten the mask/words")
+
+
+def _register_device(base_cls, algo: str):
+    for order in ("ps", "sp"):
+        name = f"{algo}-{order}"
+        cls = type(f"Jax{algo.title()}{order.title()}Engine",
+                   (_SaltedDeviceMixin, base_cls),
+                   {"name": name, "order": order})
+        register(name, device="jax")(cls)
+
+
+_register_device(JaxMd5Engine, "md5")
+_register_device(JaxSha1Engine, "sha1")
+_register_device(JaxSha256Engine, "sha256")
