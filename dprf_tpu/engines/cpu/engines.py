@@ -115,7 +115,8 @@ class _SaltedCpuMixin(HashEngine):
                 for c in candidates]
 
 
-def _register_salted_cpu(algo: str, digest_size: int):
+def _register_salted_cpu(algo: str, digest_size: int,
+                         block_limit: int = 55):
     for order in ("ps", "sp"):
         name = f"{algo}-{order}"
         cls = type(f"{algo.title()}{order.title()}Engine",
@@ -123,14 +124,45 @@ def _register_salted_cpu(algo: str, digest_size: int):
                    {"name": name, "digest_size": digest_size,
                     "_algo": algo, "_order": order,
                     # leave headroom for any parseable salt in the
-                    # single 64-byte block
-                    "max_candidate_len": 55 - SALT_MAX})
+                    # single block
+                    "max_candidate_len": block_limit - SALT_MAX})
         register(name, device="cpu")(cls)
 
 
 _register_salted_cpu("md5", 16)
 _register_salted_cpu("sha1", 20)
 _register_salted_cpu("sha256", 32)
+_register_salted_cpu("sha512", 64, block_limit=111)
+
+
+class _NestedCpuMixin(HashEngine):
+    """CPU oracle for nested modes: outer(hex(inner(password)))."""
+
+    _outer: str
+    _inner: str
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        return [hashlib.new(
+            self._outer,
+            hashlib.new(self._inner, c).hexdigest().encode()).digest()
+            for c in candidates]
+
+
+def _register_nested_cpu():
+    sizes = {"md5": 16, "sha1": 20, "sha256": 32}
+    for outer, inner in (("md5", "md5"), ("sha1", "sha1"),
+                         ("md5", "sha1"), ("sha1", "md5"),
+                         ("sha256", "md5"), ("sha256", "sha1")):
+        name = f"{outer}({inner})"
+        cls = type(f"{outer.title()}Of{inner.title()}Engine",
+                   (_NestedCpuMixin,),
+                   {"name": name, "digest_size": sizes[outer],
+                    "_outer": outer, "_inner": inner})
+        register(name, device="cpu")(cls)
+
+
+_register_nested_cpu()
 
 
 @register("ntlm")

@@ -1,0 +1,104 @@
+"""Nested (double-hash) engines: outer(hex(inner(password))).
+
+Covers hashcat's md5(md5($p)) 2600, sha1(sha1($p)) 4500, md5(sha1($p))
+4400, sha1(md5($p)) 4700, sha256(md5($p)) 20800, sha256(sha1($p))
+20700.  The outer hash consumes the lowercase-hex ASCII of the inner
+digest (the convention those modes define), produced on device by a
+vectorized nibble->char map -- no host round trip between the stages.
+
+Because the whole chain is expressed as `digest_packed` over the
+candidate's packed words, every existing execution path -- fused mask
+pipeline, Pallas-ineligible fallback, wordlist+rules, combinator,
+multi-target tables, sharded workers -- drives nested engines with no
+new worker code.
+
+Inner hex lengths must fit one outer block: md5 (32 hex bytes) and
+sha1 (40) do; sha256's 64-byte hex would need two-block chaining, so
+it is supported as an OUTER stage only.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.device.engines import JaxEngineBase
+from dprf_tpu.ops import pack as pack_ops
+from dprf_tpu.ops.md5 import md5_digest_words
+from dprf_tpu.ops.sha1 import sha1_digest_words
+from dprf_tpu.ops.sha256 import sha256_digest_words
+
+
+def words_to_hex_bytes(words: jnp.ndarray,
+                       little_endian: bool) -> jnp.ndarray:
+    """Digest words uint32[B, W] -> lowercase hex uint8[B, 8W] in the
+    digest's canonical byte order."""
+    shifts = (0, 8, 16, 24) if little_endian else (24, 16, 8, 0)
+    byts = jnp.stack([(words >> jnp.uint32(s)) & jnp.uint32(0xFF)
+                      for s in shifts], axis=-1)
+    byts = byts.reshape(words.shape[0], -1)          # [B, 4W]
+    nibbles = jnp.stack([byts >> jnp.uint32(4),
+                         byts & jnp.uint32(0xF)], axis=-1)
+    nibbles = nibbles.reshape(words.shape[0], -1)    # [B, 8W]
+    return (nibbles + jnp.where(nibbles < 10, jnp.uint32(ord("0")),
+                                jnp.uint32(ord("a") - 10))
+            ).astype(jnp.uint8)
+
+
+_STAGES = {
+    # algo -> (digest fn, words, little_endian)
+    "md5": (md5_digest_words, 4, True),
+    "sha1": (sha1_digest_words, 5, False),
+    "sha256": (sha256_digest_words, 8, False),
+}
+_DIGEST_SIZE = {"md5": 16, "sha1": 20, "sha256": 32}
+
+
+class _NestedDeviceMixin(JaxEngineBase):
+    _outer: str
+    _inner: str
+
+    # Candidate blocks feed the INNER hash, so packing follows the
+    # inner algorithm's endianness; the class's little_endian attr
+    # stays the OUTER digest layout (it drives target-table compare).
+
+    def pack(self, cand: jnp.ndarray, length: int) -> jnp.ndarray:
+        return pack_ops.pack_fixed(
+            cand, length, big_endian=not _STAGES[self._inner][2])
+
+    def pack_varlen(self, cand: jnp.ndarray,
+                    lengths: jnp.ndarray) -> jnp.ndarray:
+        return pack_ops.pack_varlen(
+            cand, lengths, big_endian=not _STAGES[self._inner][2])
+
+    def digest_packed(self, blocks: jnp.ndarray,
+                      lengths=None) -> jnp.ndarray:
+        inner_fn, _, inner_le = _STAGES[self._inner]
+        outer_fn, _, _ = _STAGES[self._outer]
+        hexb = words_to_hex_bytes(inner_fn(blocks), inner_le)
+        words2 = pack_ops.pack_fixed(hexb, 2 * _DIGEST_SIZE[self._inner],
+                                     big_endian=not _STAGES[
+                                         self._outer][2])
+        return outer_fn(words2)
+
+
+#: (outer, inner) -> engine name; hashcat mode in the comment
+COMBOS = [
+    ("md5", "md5"),        # 2600
+    ("sha1", "sha1"),      # 4500
+    ("md5", "sha1"),       # 4400
+    ("sha1", "md5"),       # 4700
+    ("sha256", "md5"),     # 20800
+    ("sha256", "sha1"),    # 20700
+]
+
+for outer, inner in COMBOS:
+    name = f"{outer}({inner})"
+    cls = type(f"Jax{outer.title()}Of{inner.title()}Engine",
+               (_NestedDeviceMixin,),
+               {"name": name,
+                "digest_size": _DIGEST_SIZE[outer],
+                "digest_words": _STAGES[outer][1],
+                "little_endian": _STAGES[outer][2],
+                "_outer": outer, "_inner": inner})
+    register(name, device="jax")(cls)

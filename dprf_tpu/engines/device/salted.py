@@ -28,7 +28,8 @@ from dprf_tpu.engines import register
 from dprf_tpu.engines.base import Target
 from dprf_tpu.engines.cpu.engines import SALT_MAX, parse_salted_line
 from dprf_tpu.engines.device.engines import (JaxMd5Engine, JaxSha1Engine,
-                                             JaxSha256Engine)
+                                             JaxSha256Engine,
+                                             JaxSha512Engine)
 from dprf_tpu.ops import compare as cmp_ops
 from dprf_tpu.runtime.worker import (Hit, CpuWorker, word_cover_range,
                                      wordlist_lane_to_gidx)
@@ -329,8 +330,9 @@ class _SaltedDeviceMixin:
 
     salted = True
     order: str
-    #: leave headroom for any parseable salt in the single 64-byte
-    #: block; the worker factories additionally check ACTUAL salts
+    #: leave headroom for any parseable salt in the single block;
+    #: the worker factories additionally check ACTUAL salts.  Set per
+    #: class in _register_device from the base engine's block limit.
     max_candidate_len = 55 - SALT_MAX
 
     def parse_target(self, text: str) -> Target:
@@ -371,10 +373,11 @@ class _SaltedDeviceMixin:
 
     def _check_lengths(self, cand_len: int, targets) -> None:
         worst = cand_len + max(len(t.params["salt"]) for t in targets)
-        if worst > 55:
+        if worst > self._block_limit:
             raise ValueError(
                 f"candidate+salt can reach {worst} bytes, over the "
-                "55-byte single-block limit; shorten the mask/words")
+                f"{self._block_limit}-byte single-block limit; "
+                "shorten the mask/words")
 
 
 def _register_device(base_cls, algo: str):
@@ -382,10 +385,13 @@ def _register_device(base_cls, algo: str):
         name = f"{algo}-{order}"
         cls = type(f"Jax{algo.title()}{order.title()}Engine",
                    (_SaltedDeviceMixin, base_cls),
-                   {"name": name, "order": order})
+                   {"name": name, "order": order,
+                    "max_candidate_len":
+                        base_cls._block_limit - SALT_MAX})
         register(name, device="jax")(cls)
 
 
 _register_device(JaxMd5Engine, "md5")
 _register_device(JaxSha1Engine, "sha1")
 _register_device(JaxSha256Engine, "sha256")
+_register_device(JaxSha512Engine, "sha512")

@@ -122,3 +122,18 @@ def test_length_guard_rejects_overflow():
     t = dev.parse_target(_line("md5", b"x" * 40, b"s" * 20, "ps"))
     with pytest.raises(ValueError, match="single-block"):
         dev.make_mask_worker(gen, [t], batch=128, hit_capacity=8)
+
+
+def test_sha512_salted_crack():
+    """sha512-ps/sp (hashcat 1710/1720): 128-byte block, wider salt
+    headroom (111 - SALT_MAX)."""
+    dev = get_engine("sha512-sp", "jax")
+    cpu = get_engine("sha512-sp", "cpu")
+    assert dev.max_candidate_len == 111 - 32
+    salt = b"m1neral"
+    gen = MaskGenerator("?d?l?d")
+    t = dev.parse_target(_line("sha512", b"4x2", salt, "sp"))
+    w = dev.make_mask_worker(gen, [t], batch=512, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, b"4x2")]
