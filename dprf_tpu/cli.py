@@ -204,7 +204,10 @@ def _wordlist_max_len(engine_name: str, engine, device: str) -> int:
             return engine.max_candidate_len
         if (hasattr(dev, "make_wordlist_worker")
                 and hasattr(dev, "digest_packed")):
-            return min(55, engine.max_candidate_len)
+            # single-block limit of the DEVICE engine (55 for 64-byte
+            # blocks, 111 for the SHA-512 family's 128-byte blocks)
+            return min(getattr(dev, "_block_limit", 55),
+                       engine.max_candidate_len)
     return engine.max_candidate_len
 
 

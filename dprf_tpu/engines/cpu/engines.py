@@ -135,6 +135,20 @@ _register_salted_cpu("sha256", 32)
 _register_salted_cpu("sha512", 64, block_limit=111)
 
 
+#: nested double-hash combinations (outer, inner) with their hashcat
+#: modes -- the ONE list device/nested.py and the oracles share (this
+#: module stays jax-free, so it is the importable-everywhere home)
+NESTED_COMBOS = [
+    ("md5", "md5"),        # 2600
+    ("sha1", "sha1"),      # 4500
+    ("md5", "sha1"),       # 4400
+    ("sha1", "md5"),       # 4700
+    ("sha256", "md5"),     # 20800
+    ("sha256", "sha1"),    # 20700
+]
+NESTED_DIGEST_SIZE = {"md5": 16, "sha1": 20, "sha256": 32}
+
+
 class _NestedCpuMixin(HashEngine):
     """CPU oracle for nested modes: outer(hex(inner(password)))."""
 
@@ -150,14 +164,12 @@ class _NestedCpuMixin(HashEngine):
 
 
 def _register_nested_cpu():
-    sizes = {"md5": 16, "sha1": 20, "sha256": 32}
-    for outer, inner in (("md5", "md5"), ("sha1", "sha1"),
-                         ("md5", "sha1"), ("sha1", "md5"),
-                         ("sha256", "md5"), ("sha256", "sha1")):
+    for outer, inner in NESTED_COMBOS:
         name = f"{outer}({inner})"
         cls = type(f"{outer.title()}Of{inner.title()}Engine",
                    (_NestedCpuMixin,),
-                   {"name": name, "digest_size": sizes[outer],
+                   {"name": name,
+                    "digest_size": NESTED_DIGEST_SIZE[outer],
                     "_outer": outer, "_inner": inner})
         register(name, device="cpu")(cls)
 

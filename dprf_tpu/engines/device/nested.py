@@ -22,6 +22,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from dprf_tpu.engines import register
+from dprf_tpu.engines.cpu.engines import (NESTED_COMBOS,
+                                          NESTED_DIGEST_SIZE)
 from dprf_tpu.engines.device.engines import JaxEngineBase
 from dprf_tpu.ops import pack as pack_ops
 from dprf_tpu.ops.md5 import md5_digest_words
@@ -51,7 +53,7 @@ _STAGES = {
     "sha1": (sha1_digest_words, 5, False),
     "sha256": (sha256_digest_words, 8, False),
 }
-_DIGEST_SIZE = {"md5": 16, "sha1": 20, "sha256": 32}
+
 
 
 class _NestedDeviceMixin(JaxEngineBase):
@@ -76,28 +78,18 @@ class _NestedDeviceMixin(JaxEngineBase):
         inner_fn, _, inner_le = _STAGES[self._inner]
         outer_fn, _, _ = _STAGES[self._outer]
         hexb = words_to_hex_bytes(inner_fn(blocks), inner_le)
-        words2 = pack_ops.pack_fixed(hexb, 2 * _DIGEST_SIZE[self._inner],
+        words2 = pack_ops.pack_fixed(hexb, 2 * NESTED_DIGEST_SIZE[self._inner],
                                      big_endian=not _STAGES[
                                          self._outer][2])
         return outer_fn(words2)
 
 
-#: (outer, inner) -> engine name; hashcat mode in the comment
-COMBOS = [
-    ("md5", "md5"),        # 2600
-    ("sha1", "sha1"),      # 4500
-    ("md5", "sha1"),       # 4400
-    ("sha1", "md5"),       # 4700
-    ("sha256", "md5"),     # 20800
-    ("sha256", "sha1"),    # 20700
-]
-
-for outer, inner in COMBOS:
+for outer, inner in NESTED_COMBOS:
     name = f"{outer}({inner})"
     cls = type(f"Jax{outer.title()}Of{inner.title()}Engine",
                (_NestedDeviceMixin,),
                {"name": name,
-                "digest_size": _DIGEST_SIZE[outer],
+                "digest_size": NESTED_DIGEST_SIZE[outer],
                 "digest_words": _STAGES[outer][1],
                 "little_endian": _STAGES[outer][2],
                 "_outer": outer, "_inner": inner})

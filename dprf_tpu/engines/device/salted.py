@@ -1,5 +1,5 @@
-"""Salted fast-hash engines: md5/sha1/sha256 over $pass.$salt and
-$salt.$pass (hashcat modes 10/20, 110/120, 1410/1420).
+"""Salted fast-hash engines: md5/sha1/sha256/sha512 over $pass.$salt
+and $salt.$pass (hashcat modes 10/20, 110/120, 1410/1420, 1710/1720).
 
 Target lines use the hashcat convention ``hexdigest:salt`` (the salt is
 the literal bytes after the first colon; ``$HEX[..]`` decodes hex
