@@ -221,6 +221,33 @@ class BcryptEngine(HashEngine):
         return [_bcrypt.bcrypt_raw(c, salt, cost) for c in candidates]
 
 
+@register("phpass")
+class PhpassEngine(HashEngine):
+    """phpass portable hashes ($P$/$H$, WordPress/phpBB; hashcat 400):
+    h = md5(salt+pass), then count x h = md5(h+pass)."""
+
+    name = "phpass"
+    digest_size = 16
+    salted = True
+
+    from dprf_tpu.engines.cpu.phpass import MAX_PASS_LEN as \
+        max_candidate_len  # noqa: F401  (39: digest+pass in one block)
+
+    def parse_target(self, text: str) -> Target:
+        from dprf_tpu.engines.cpu.phpass import parse_phpass
+        count, salt, digest = parse_phpass(text)
+        return Target(raw=text.strip(), digest=digest,
+                      params={"salt": salt, "count": count})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        from dprf_tpu.engines.cpu.phpass import phpass_raw
+        if not params:
+            raise ValueError("phpass needs target params (salt, count)")
+        return [phpass_raw(c, params["salt"], params["count"])
+                for c in candidates]
+
+
 @register("wpa2-pmkid")
 class Pmkid2Engine(HashEngine):
     """WPA2-PMKID: PMK = PBKDF2-HMAC-SHA1(pass, essid, 4096, 32);

@@ -1,0 +1,94 @@
+"""phpass "portable" hashes (WordPress/phpBB; hashcat mode 400).
+
+Format: ``$P$`` or ``$H$`` + one itoa64 char encoding log2(count) +
+8-char salt + 22 itoa64 chars encoding the 16-byte digest.
+
+Algorithm: h = md5(salt + password); repeat count times:
+h = md5(h + password).  Pure Python here (the oracle); the device
+engine runs the same chain as a fori_loop over the shared MD5
+compression (engines/device/phpass.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+ITOA64 = "./0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ" \
+    "abcdefghijklmnopqrstuvwxyz"
+_ITOA64_INV = {c: i for i, c in enumerate(ITOA64)}
+
+#: password length cap so digest(16) + password stays one MD5 block
+MAX_PASS_LEN = 55 - 16
+
+
+def encode64(data: bytes) -> str:
+    """phpass itoa64 encoding: 3-byte little-endian groups -> 4 chars,
+    6 bits each, LSB first (matches PHP's encode64)."""
+    out = []
+    i = 0
+    while i < len(data):
+        value = data[i]
+        i += 1
+        out.append(ITOA64[value & 0x3F])
+        if i < len(data):
+            value |= data[i] << 8
+        out.append(ITOA64[(value >> 6) & 0x3F])
+        if i >= len(data):
+            break
+        i += 1
+        if i < len(data):
+            value |= data[i] << 16
+        out.append(ITOA64[(value >> 12) & 0x3F])
+        if i >= len(data):
+            break
+        i += 1
+        out.append(ITOA64[(value >> 18) & 0x3F])
+    return "".join(out)
+
+
+def decode64(text: str, n_bytes: int) -> bytes:
+    """Inverse of encode64 for a known byte count."""
+    out = bytearray()
+    i = 0
+    while len(out) < n_bytes:
+        chunk = text[i:i + 4]
+        i += 4
+        value = 0
+        for j, c in enumerate(chunk):
+            if c not in _ITOA64_INV:
+                raise ValueError(f"bad itoa64 char {c!r}")
+            value |= _ITOA64_INV[c] << (6 * j)
+        out.append(value & 0xFF)
+        if len(out) < n_bytes and len(chunk) > 2:
+            out.append((value >> 8) & 0xFF)
+        if len(out) < n_bytes and len(chunk) > 3:
+            out.append((value >> 16) & 0xFF)
+    return bytes(out)
+
+
+def parse_phpass(text: str):
+    """'$P$Bsalt8chr...' -> (count, salt bytes, digest bytes)."""
+    text = text.strip()
+    if len(text) != 34 or text[:3] not in ("$P$", "$H$"):
+        raise ValueError(f"not a phpass hash: {text!r}")
+    log2count = _ITOA64_INV.get(text[3])
+    if log2count is None or not 7 <= log2count <= 30:
+        raise ValueError(f"bad phpass cost char {text[3]!r}")
+    salt = text[4:12].encode("latin-1")
+    digest = decode64(text[12:34], 16)
+    return 1 << log2count, salt, digest
+
+
+def phpass_raw(password: bytes, salt: bytes, count: int) -> bytes:
+    h = hashlib.md5(salt + password).digest()
+    for _ in range(count):
+        h = hashlib.md5(h + password).digest()
+    return h
+
+
+def phpass_hash(password: bytes, salt: bytes, log2count: int,
+                tag: str = "$P$") -> str:
+    """Full crypt string (test helper)."""
+    digest = phpass_raw(password, salt, 1 << log2count)
+    return (tag + ITOA64[log2count] + salt.decode("latin-1")
+            + encode64(digest))

@@ -1,0 +1,333 @@
+"""Device phpass engine (iterated MD5; hashcat 400).
+
+The chain h = md5(salt+pass); count x h = md5(h+pass) maps cleanly onto
+the TPU: because MD5's digest is the little-endian serialization of its
+4 state words and messages pack little-endian, the iteration block's
+first four words ARE the previous digest words -- so each step is one
+`concatenate` and one shared-md5 compression under `lax.fori_loop`,
+with the password's words 4..15 precomputed once per batch.  count is
+a runtime argument: one compiled step serves every target/cost.
+
+Password limit: 16 (digest) + len <= 55 one-block bytes -> 39 bytes.
+Like bcrypt/PMKID this is a slow per-target sweep; the workers mirror
+the salted-engine per-target structure.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.base import Target
+from dprf_tpu.engines.cpu.engines import PhpassEngine
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops.md5 import md5_digest_words
+from dprf_tpu.runtime.worker import (Hit, CpuWorker, word_cover_range,
+                                     wordlist_lane_to_gidx)
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+def _le_words(msg: jnp.ndarray) -> jnp.ndarray:
+    """uint8[B, 64] -> uint32[B, 16] little-endian."""
+    coef = jnp.asarray(np.array([1, 1 << 8, 1 << 16, 1 << 24],
+                                dtype=np.uint32))
+    grouped = msg.reshape(msg.shape[0], 16, 4).astype(jnp.uint32)
+    return (grouped * coef).sum(axis=-1, dtype=jnp.uint32)
+
+
+def _prefixed_block(cand, lens, prefix_len: int):
+    """Candidate bytes placed at a fixed offset in an MD5 block, with
+    per-lane 0x80 marker and bit length; words [B, 16] with words
+    [0, prefix_len/4) left ZERO for the caller to fill."""
+    B, maxlen = cand.shape
+    pos = jnp.arange(64, dtype=jnp.int32)[None, :]
+    body = jnp.zeros((B, 64), jnp.uint8)
+    body = body.at[:, prefix_len:prefix_len + maxlen].set(cand)
+    end = prefix_len + lens[:, None]
+    msg = jnp.where((pos >= prefix_len) & (pos < end), body, 0)
+    msg = (msg + jnp.where(pos == end, jnp.uint8(0x80), jnp.uint8(0))
+           ).astype(jnp.uint8)
+    words = _le_words(msg)
+    return words.at[:, 14].set((prefix_len + lens).astype(jnp.uint32) * 8)
+
+
+def phpass_digest_batch(cand: jnp.ndarray, lens: jnp.ndarray,
+                        salt: jnp.ndarray, count) -> jnp.ndarray:
+    """cand uint8[B, maxlen] (lens <= 39) + salt uint8[8] + count ->
+    uint32[B, 4] digest words."""
+    # initial block: salt(8) + password
+    w0 = _prefixed_block(cand, lens, 8)
+    salt_words = _le_words(
+        jnp.zeros((1, 64), jnp.uint8).at[0, :8].set(salt))[0, :2]
+    w0 = w0.at[:, 0].set(salt_words[0]).at[:, 1].set(salt_words[1])
+    h = md5_digest_words(w0)
+    # iteration block: digest(16) + password; words 4..15 constant
+    wp = _prefixed_block(cand, lens, 16)
+
+    def body(_, h):
+        w = jnp.concatenate([h, wp[:, 4:]], axis=-1)
+        return md5_digest_words(w)
+
+    return lax.fori_loop(0, count, body, h)
+
+
+def make_phpass_mask_step(gen, batch: int, hit_capacity: int = 64):
+    """step(base_digits, n_valid, salt uint8[8], count int32,
+    target uint32[4]) -> (count, lanes, _)."""
+    flat = gen.flat_charsets
+    length = gen.length
+
+    @jax.jit
+    def step(base_digits, n_valid, salt, count, target):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        lens = jnp.full((batch,), length, jnp.int32)
+        digest = phpass_digest_batch(cand, lens, salt, count)
+        found = cmp_ops.compare_single(digest, target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def make_phpass_wordlist_step(gen, word_batch: int, hit_capacity: int = 64):
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, L = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+
+    @jax.jit
+    def step(w0, n_valid_words, salt, count, target):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
+        digest = phpass_digest_batch(cw, cl, salt, count)
+        found = cmp_ops.compare_single(digest, target) & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
+                                    hit_capacity)
+
+    return step
+
+
+def make_sharded_phpass_mask_step(gen, mesh, batch_per_device: int,
+                                  hit_capacity: int = 64):
+    """Multi-chip variant (keyspace DP, replicated hit buffers)."""
+    from jax.sharding import PartitionSpec as P
+
+    from dprf_tpu.parallel.mesh import SHARD_AXIS
+
+    flat = gen.flat_charsets
+    length = gen.length
+    B = batch_per_device
+
+    def shard_fn(base_digits, n_valid, salt, count, target):
+        dev = lax.axis_index(SHARD_AXIS)
+        offset = (dev * B).astype(jnp.int32)
+        cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
+        lens = jnp.full((B,), length, jnp.int32)
+        digest = phpass_digest_batch(cand, lens, salt, count)
+        lane_global = offset + jnp.arange(B, dtype=jnp.int32)
+        found = cmp_ops.compare_single(digest, target) & \
+            (lane_global < n_valid)
+        cnt, lanes, tpos = cmp_ops.compact_hits(
+            found, jnp.zeros((B,), jnp.int32), hit_capacity)
+        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
+        total = lax.psum(cnt, SHARD_AXIS)
+        # replicated hit buffers (see parallel/sharded.py)
+        return (total[None],
+                lax.all_gather(cnt, SHARD_AXIS),
+                lax.all_gather(lanes, SHARD_AXIS),
+                lax.all_gather(tpos, SHARD_AXIS))
+
+    sharded = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(),) * 5,
+        out_specs=(P(), P(), P(), P()), check_vma=False)
+
+    @jax.jit
+    def step(base_digits, n_valid, salt, count, target):
+        total, counts, lanes, tpos = sharded(base_digits, n_valid, salt,
+                                             count, target)
+        return total[0], counts, lanes, tpos
+
+    step.super_batch = mesh.devices.size * B
+    return step
+
+
+class _PhpassWorkerBase:
+    def __init__(self, engine, gen, targets: Sequence[Target],
+                 batch: int, hit_capacity: int, oracle):
+        self.engine = engine
+        self.gen = gen
+        self.targets = list(targets)
+        self.hit_capacity = hit_capacity
+        self.oracle = oracle
+        self.batch = batch
+        self._targs = []
+        for t in self.targets:
+            self._targs.append((
+                jnp.asarray(np.frombuffer(t.params["salt"], np.uint8)),
+                jnp.int32(t.params["count"]),
+                jnp.asarray(np.frombuffer(t.digest, dtype="<u4")
+                            .astype(np.uint32))))
+
+    def _rescan(self, start: int, end: int, ti: int) -> list[Hit]:
+        if self.oracle is None:
+            raise RuntimeError(
+                f"hit buffer overflow (> {self.hit_capacity}) and no "
+                "oracle engine to rescan with; raise hit_capacity")
+        sub = WorkUnit(-1, start, end - start)
+        hits = CpuWorker(self.oracle, self.gen,
+                         [self.targets[ti]]).process(sub)
+        return [Hit(ti, h.cand_index, h.plaintext) for h in hits]
+
+
+class PhpassMaskWorker(_PhpassWorkerBase):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 14,
+                 hit_capacity: int = 64, oracle=None):
+        super().__init__(engine, gen, targets, batch, hit_capacity, oracle)
+        self.stride = batch
+        self.step = make_phpass_mask_step(gen, batch, hit_capacity)
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        hits: list[Hit] = []
+        for ti in range(len(self.targets)):
+            salt, count, tgt = self._targs[ti]
+            queued = []
+            for bstart in range(unit.start, unit.end, self.stride):
+                n_valid = min(self.stride, unit.end - bstart)
+                base = jnp.asarray(self.gen.digits(bstart),
+                                   dtype=jnp.int32)
+                queued.append((bstart, self.step(
+                    base, jnp.int32(n_valid), salt, count, tgt)))
+            for bstart, (cnt, lanes, _) in queued:
+                cnt = int(cnt)
+                if cnt == 0:
+                    continue
+                if cnt > self.hit_capacity:
+                    hits.extend(self._rescan(
+                        bstart, min(bstart + self.stride, unit.end), ti))
+                    continue
+                for lane in np.asarray(lanes):
+                    if lane < 0:
+                        continue
+                    gidx = bstart + int(lane)
+                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+
+class PhpassWordlistWorker(_PhpassWorkerBase):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 14,
+                 hit_capacity: int = 64, oracle=None):
+        super().__init__(engine, gen, targets, batch, hit_capacity, oracle)
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self.step = make_phpass_wordlist_step(gen, self.word_batch,
+                                              hit_capacity)
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        R = self.gen.n_rules
+        w_start, w_end = word_cover_range(unit, R)
+        hits: list[Hit] = []
+        for ti in range(len(self.targets)):
+            salt, count, tgt = self._targs[ti]
+            queued = []
+            for ws in range(w_start, w_end, self.word_batch):
+                nw = min(self.word_batch, w_end - ws,
+                         self.gen.n_words - ws)
+                if nw <= 0:
+                    break
+                queued.append((ws, nw, self.step(
+                    jnp.int32(ws), jnp.int32(nw), salt, count, tgt)))
+            for ws, nw, (cnt, lanes, _) in queued:
+                cnt = int(cnt)
+                if cnt == 0:
+                    continue
+                if cnt > self.hit_capacity:
+                    start = max(unit.start, ws * R)
+                    end = min(unit.end, (ws + nw) * R)
+                    hits.extend(self._rescan(start, end, ti))
+                    continue
+                for lane in np.asarray(lanes):
+                    if lane < 0:
+                        continue
+                    gidx = wordlist_lane_to_gidx(int(lane), ws,
+                                                 self.word_batch, R)
+                    if not unit.start <= gidx < unit.end:
+                        continue
+                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+
+class ShardedPhpassMaskWorker(PhpassMaskWorker):
+    def __init__(self, engine, gen, targets, mesh,
+                 batch_per_device: int = 1 << 13, hit_capacity: int = 64,
+                 oracle=None):
+        _PhpassWorkerBase.__init__(self, engine, gen, targets,
+                                   mesh.devices.size * batch_per_device,
+                                   hit_capacity, oracle)
+        self.mesh = mesh
+        self.stride = self.batch
+        self.step = make_sharded_phpass_mask_step(
+            gen, mesh, batch_per_device, hit_capacity)
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        hits: list[Hit] = []
+        for ti in range(len(self.targets)):
+            salt, count, tgt = self._targs[ti]
+            queued = []
+            for bstart in range(unit.start, unit.end, self.stride):
+                n_valid = min(self.stride, unit.end - bstart)
+                base = jnp.asarray(self.gen.digits(bstart),
+                                   dtype=jnp.int32)
+                queued.append((bstart, self.step(
+                    base, jnp.int32(n_valid), salt, count, tgt)))
+            for bstart, (total, counts, lanes, _) in queued:
+                if int(total) == 0:
+                    continue
+                if (np.asarray(counts) > self.hit_capacity).any():
+                    hits.extend(self._rescan(
+                        bstart, min(bstart + self.stride, unit.end), ti))
+                    continue
+                for lane in np.asarray(lanes).ravel():
+                    if lane < 0:
+                        continue
+                    gidx = bstart + int(lane)
+                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+
+@register("phpass", device="jax")
+class JaxPhpassEngine(PhpassEngine):
+    """Device phpass: parsing/oracle from the CPU engine, fused
+    iterated-MD5 workers for execution."""
+
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return PhpassMaskWorker(self, gen, targets,
+                                batch=min(batch, 1 << 14),
+                                hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return PhpassWordlistWorker(self, gen, targets,
+                                    batch=min(batch, 1 << 14),
+                                    hit_capacity=hit_capacity,
+                                    oracle=oracle)
+
+    def make_sharded_mask_worker(self, gen, targets, mesh,
+                                 batch_per_device: int, hit_capacity: int,
+                                 oracle=None):
+        return ShardedPhpassMaskWorker(
+            self, gen, targets, mesh,
+            batch_per_device=min(batch_per_device, 1 << 13),
+            hit_capacity=hit_capacity, oracle=oracle)

@@ -1,0 +1,131 @@
+"""phpass (WordPress/phpBB portable hashes): itoa64 codec round-trips,
+a published vector, device-vs-oracle digests, worker end-to-end, and
+the CLI surface.  Costs are kept at 2^7 (the format's minimum) so the
+serial chains stay test-sized; the chain structure is identical at the
+production 2^13."""
+
+import hashlib
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.engines.cpu.phpass import (decode64, encode64, parse_phpass,
+                                         phpass_hash, phpass_raw)
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+def test_encode64_roundtrip():
+    for data in (b"\x00" * 16, bytes(range(16)), b"\xff" * 16,
+                 hashlib.md5(b"x").digest()):
+        assert decode64(encode64(data), 16) == data
+
+
+def test_published_vector():
+    """The reference phpass test vector (Openwall's phpass 0.3 README):
+    'test12345' with $P$9IQRaTwm... verifies."""
+    line = "$P$9IQRaTwmfeRo7ud9Fh4E2PdI0S3r.L0"
+    count, salt, digest = parse_phpass(line)
+    assert count == 1 << 11
+    assert phpass_raw(b"test12345", salt, count) == digest
+
+
+def test_hash_roundtrip_and_parse():
+    line = phpass_hash(b"hunter2", b"saltsalt", 7)
+    count, salt, digest = parse_phpass(line)
+    assert count == 128 and salt == b"saltsalt"
+    assert phpass_raw(b"hunter2", salt, count) == digest
+
+
+def test_device_digest_matches_oracle():
+    import random
+    from dprf_tpu.engines.device.phpass import phpass_digest_batch
+
+    rng = random.Random(400)
+    cands = [bytes(rng.randrange(1, 256)
+                   for _ in range(rng.randrange(0, 24)))
+             for _ in range(16)]
+    salt = b"NaClNaCl"
+    count = 128
+    maxlen = max(len(c) for c in cands)
+    buf = np.zeros((len(cands), maxlen), np.uint8)
+    lens = np.zeros((len(cands),), np.int32)
+    for i, c in enumerate(cands):
+        buf[i, :len(c)] = np.frombuffer(c, np.uint8)
+        lens[i] = len(c)
+    dw = phpass_digest_batch(jnp.asarray(buf), jnp.asarray(lens),
+                             jnp.asarray(np.frombuffer(salt, np.uint8)),
+                             jnp.int32(count))
+    got = [np.asarray(dw)[i].astype("<u4").tobytes() for i in
+           range(len(cands))]
+    want = [phpass_raw(c, salt, count) for c in cands]
+    assert got == want
+
+
+def test_mask_worker_end_to_end():
+    dev = get_engine("phpass", "jax")
+    cpu = get_engine("phpass", "cpu")
+    gen = MaskGenerator("?l?d?l")
+    secret = b"k9q"
+    t = dev.parse_target(phpass_hash(secret, b"abcdefgh", 7))
+    w = dev.make_mask_worker(gen, [t], batch=1024, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
+
+
+def test_wordlist_worker_with_rules():
+    from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+    from dprf_tpu.rules.parser import parse_rule
+
+    dev = get_engine("phpass", "jax")
+    cpu = get_engine("phpass", "cpu")
+    words = [b"winter", b"spring", b"summer"]
+    rules = [parse_rule(":"), parse_rule("c"), parse_rule("$1")]
+    gen = WordlistRulesGenerator(words, rules, max_len=20)
+    secret = b"Spring"
+    t = dev.parse_target(phpass_hash(secret, b"12345678", 7, tag="$H$"))
+    w = dev.make_wordlist_worker(gen, [t], batch=64, hit_capacity=8,
+                                 oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
+
+
+def test_sharded_phpass_worker():
+    import jax
+    from dprf_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) >= 8
+    dev = get_engine("phpass", "jax")
+    cpu = get_engine("phpass", "cpu")
+    gen = MaskGenerator("?d?d?l")
+    secret = b"77z"
+    t = dev.parse_target(phpass_hash(secret, b"qrstuvwx", 7))
+    w = dev.make_sharded_mask_worker(gen, [t], make_mesh(8),
+                                     batch_per_device=64,
+                                     hit_capacity=8, oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
+
+
+def test_cli_phpass_crack(tmp_path, capsys):
+    from dprf_tpu.cli import main
+
+    line = phpass_hash(b"za9", b"ABCDEFGH", 7)
+    hf = tmp_path / "h.txt"
+    hf.write_text(line + "\n")
+    rc = main(["crack", "?l?l?d", str(hf), "--engine", "phpass",
+               "--device", "tpu", "--no-potfile", "--batch", "2048",
+               "-q"])
+    out = capsys.readouterr().out
+    assert rc == 0 and f"{line}:za9" in out
+
+
+def test_parse_rejects_garbage():
+    cpu = get_engine("phpass", "cpu")
+    for bad in ("$P$", "$X$9IQRaTwmfeRo7ud9Fh4E2PdI0S3r.L0",
+                "$P$!IQRaTwmfeRo7ud9Fh4E2PdI0S3r.L0"):
+        with pytest.raises(ValueError):
+            cpu.parse_target(bad)
