@@ -177,6 +177,33 @@ def _register_nested_cpu():
 _register_nested_cpu()
 
 
+def parse_mysql41(text: str) -> Target:
+    """MySQL 4.1+ hash line: '*' + 40 uppercase hex chars (the '*' is
+    part of the stored format; bare hex is accepted too)."""
+    t = text.strip()
+    hexpart = t[1:] if t.startswith("*") else t
+    digest = bytes.fromhex(hexpart)
+    if len(digest) != 20:
+        raise ValueError(f"mysql41 wants 20 digest bytes, got {text!r}")
+    return Target(raw=t, digest=digest)
+
+
+@register("mysql41")
+class Mysql41Engine(HashEngine):
+    """MySQL 4.1+ PASSWORD() = sha1(sha1(password)), raw inner digest."""
+
+    name = "mysql41"
+    digest_size = 20
+
+    def parse_target(self, text: str) -> Target:
+        return parse_mysql41(text)
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        return [hashlib.sha1(hashlib.sha1(c).digest()).digest()
+                for c in candidates]
+
+
 @register("ntlm")
 class NtlmEngine(HashEngine):
     """NTLM: MD4 over the UTF-16LE encoding of the password."""

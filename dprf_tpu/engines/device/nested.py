@@ -94,3 +94,34 @@ for outer, inner in NESTED_COMBOS:
                 "little_endian": _STAGES[outer][2],
                 "_outer": outer, "_inner": inner})
     register(name, device="jax")(cls)
+
+
+@register("mysql41", device="jax")
+class JaxMysql41Engine(JaxEngineBase):
+    """MySQL 4.1+ PASSWORD(): sha1(sha1(password)) over the RAW inner
+    digest (no hex stage; hashcat 300).  Target lines are '*' + 40
+    uppercase hex chars.
+
+    Composition is free on device: SHA-1's big-endian digest words ARE
+    the big-endian message words of the outer block, so the second
+    stage is five word copies plus the padding constants.
+    """
+
+    name = "mysql41"
+    digest_size = 20
+    digest_words = 5
+    little_endian = False
+
+    def parse_target(self, text: str):
+        from dprf_tpu.engines.cpu.engines import parse_mysql41
+        return parse_mysql41(text)
+
+    def digest_packed(self, blocks: jnp.ndarray,
+                      lengths=None) -> jnp.ndarray:
+        inner = sha1_digest_words(blocks)          # uint32[B, 5] BE
+        B = inner.shape[0]
+        block2 = jnp.zeros((B, 16), jnp.uint32)
+        block2 = block2.at[:, :5].set(inner)
+        block2 = block2.at[:, 5].set(jnp.uint32(0x80000000))
+        block2 = block2.at[:, 15].set(jnp.uint32(160))   # 20 bytes
+        return sha1_digest_words(block2)

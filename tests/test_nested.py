@@ -85,3 +85,35 @@ def test_cli_nested_crack(tmp_path, capsys):
                "-q"])
     out = capsys.readouterr().out
     assert rc == 0 and f"{digest}:za9" in out
+
+
+def test_mysql41_matches_oracle_and_cracks(tmp_path, capsys):
+    """MySQL 4.1+ (*HEX double-SHA1 over RAW bytes): oracle match,
+    '*'-prefixed parsing, CLI crack."""
+    import random
+    from dprf_tpu.cli import main
+
+    dev = get_engine("mysql41", "jax")
+    cpu = get_engine("mysql41", "cpu")
+    rng = random.Random(301)
+    cands = [bytes(rng.randrange(256) for _ in range(rng.randrange(0, 30)))
+             for _ in range(32)]
+    want = [hashlib.sha1(hashlib.sha1(c).digest()).digest()
+            for c in cands]
+    assert cpu.hash_batch(cands) == want
+    assert dev.hash_batch(cands) == want
+
+    # the classic published example: PASSWORD('password')
+    line = "*2470C0C06DEE42FD1618BB99005ADCA2EC9D1E19"
+    t = cpu.parse_target(line)
+    assert cpu.verify(b"password", t)
+
+    secret = b"pw7"
+    digest = hashlib.sha1(hashlib.sha1(secret).digest()).hexdigest()
+    hf = tmp_path / "h.txt"
+    hf.write_text("*" + digest.upper() + "\n")
+    rc = main(["crack", "?l?l?d", str(hf), "--engine", "mysql41",
+               "--device", "tpu", "--no-potfile", "--batch", "1024",
+               "-q"])
+    out = capsys.readouterr().out
+    assert rc == 0 and ":pw7" in out
