@@ -248,6 +248,29 @@ class BcryptEngine(HashEngine):
         return [_bcrypt.bcrypt_raw(c, salt, cost) for c in candidates]
 
 
+@register("md5crypt")
+class Md5cryptEngine(HashEngine):
+    """$1$ modular crypt (FreeBSD md5crypt; hashcat 500)."""
+
+    name = "md5crypt"
+    digest_size = 16
+    salted = True
+    max_candidate_len = 15    # device single-block budget: 16+2L+8 <= 55
+
+    def parse_target(self, text: str) -> Target:
+        from dprf_tpu.engines.cpu.md5crypt import parse_md5crypt
+        salt, digest = parse_md5crypt(text)
+        return Target(raw=text.strip(), digest=digest,
+                      params={"salt": salt})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        from dprf_tpu.engines.cpu.md5crypt import md5crypt_raw
+        if not params:
+            raise ValueError("md5crypt needs target params (salt)")
+        return [md5crypt_raw(c, params["salt"]) for c in candidates]
+
+
 @register("phpass")
 class PhpassEngine(HashEngine):
     """phpass portable hashes ($P$/$H$, WordPress/phpBB; hashcat 400):

@@ -1,0 +1,82 @@
+"""md5crypt ($1$ modular crypt; hashcat 500) reference implementation.
+
+The classic FreeBSD-derived scheme: an "alternate" digest
+md5(pw+salt+pw), a bit-walked initial context, then 1000 rounds whose
+message composition cycles with i mod 2/3/7.  Digest bytes are emitted
+in the scheme's permuted base64 order; decoding recovers the raw
+16-byte digest so engines can compare in digest space.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from dprf_tpu.engines.cpu.phpass import ITOA64, decode64, encode64
+
+#: device path packs pw+salt+pw and the round messages in one MD5
+#: block; pw <= 15 with salt <= 8 keeps every message <= 55 bytes
+MAX_SALT_LEN = 8
+
+#: byte order in which md5crypt emits the digest through itoa64.
+#: crypt feeds to64 24-bit groups (d[a]<<16 | d[b]<<8 | d[c]) over the
+#: index triplets (0,6,12)(1,7,13)(2,8,14)(3,9,15)(4,10,5) + d[11];
+#: our shared encode64 packs groups little-endian, so each triplet is
+#: listed reversed here.
+_PERM = [12, 6, 0, 13, 7, 1, 14, 8, 2, 15, 9, 3, 5, 10, 4, 11]
+
+
+def md5crypt_raw(password: bytes, salt: bytes) -> bytes:
+    """The raw (unpermuted) 16-byte md5crypt digest."""
+    alt = hashlib.md5(password + salt + password).digest()
+    ctx = password + b"$1$" + salt
+    ctx += alt[:len(password)]
+    i = len(password)
+    while i > 0:
+        ctx += b"\0" if i & 1 else password[:1]
+        i >>= 1
+    inter = hashlib.md5(ctx).digest()
+    for i in range(1000):
+        msg = password if i & 1 else inter
+        if i % 3:
+            msg += salt
+        if i % 7:
+            msg += password
+        msg += inter if i & 1 else password
+        inter = hashlib.md5(msg).digest()
+    return inter
+
+
+def encode_digest(digest: bytes) -> str:
+    """Raw digest -> the 22-char itoa64 text of a $1$ line."""
+    return encode64(bytes(digest[p] for p in _PERM))
+
+
+def decode_digest(text: str) -> bytes:
+    """22-char itoa64 text -> raw 16-byte digest."""
+    permuted = decode64(text, 16)
+    out = bytearray(16)
+    for where, src in zip(_PERM, permuted):
+        out[where] = src
+    return bytes(out)
+
+
+def parse_md5crypt(text: str):
+    """'$1$salt$hash' -> (salt bytes, raw digest bytes)."""
+    t = text.strip()
+    if not t.startswith("$1$"):
+        raise ValueError(f"not an md5crypt hash: {text!r}")
+    rest = t[3:]
+    salt_text, sep, digest_text = rest.partition("$")
+    if not sep or len(digest_text) != 22:
+        raise ValueError(f"malformed md5crypt hash: {text!r}")
+    salt = salt_text.encode("latin-1")
+    if len(salt) > MAX_SALT_LEN:
+        raise ValueError(f"md5crypt salt longer than {MAX_SALT_LEN}: "
+                         f"{text!r}")
+    return salt, decode_digest(digest_text)
+
+
+def md5crypt_hash(password: bytes, salt: bytes) -> str:
+    """Full '$1$salt$...' string (test helper)."""
+    return ("$1$" + salt.decode("latin-1") + "$"
+            + encode_digest(md5crypt_raw(password, salt)))

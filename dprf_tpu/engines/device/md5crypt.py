@@ -1,0 +1,299 @@
+"""Device md5crypt engine ($1$; hashcat 500).
+
+md5crypt's 1000 rounds compose each message from (prev digest,
+password, salt) in an order cycling with i mod 2/3/7 -- data-dependent
+LENGTHS, which are hostile to fixed-shape compilation.  The TPU answer:
+every message is built at the BYTE level inside the round body with
+clipped take_along_axis gathers and boundary masks over a 64-byte
+window (per-lane password lengths included), then packed to words and
+fed to the shared MD5 compression under `lax.fori_loop`.  The round
+index only enters through three scalars (i&1, i%3!=0, i%7!=0), so one
+compiled step serves every target; salt bytes/length are runtime
+arguments.
+
+Length budget: messages reach 16 + 2*len(pw) + len(salt) bytes and
+must stay in one 55-byte block, so the device path caps passwords at
+15 bytes (salt <= 8 per the format).  Longer passwords run on the CPU
+oracle path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.cpu.engines import Md5cryptEngine
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops.md5 import md5_digest_words
+from dprf_tpu.engines.device.phpass import (_le_words, PhpassMaskWorker,
+                                            PhpassWordlistWorker,
+                                            ShardedPhpassMaskWorker,
+                                            make_sharded_phpass_mask_step)
+from dprf_tpu.runtime.worker import Hit
+
+#: device-path password cap (16 + 2L + 8 <= 55)
+MAX_PASS_LEN = 15
+
+
+def _gat(src_pad, idx):
+    """Clipped per-lane gather over a [B, 64]-padded source."""
+    return jnp.take_along_axis(src_pad, jnp.clip(idx, 0, 63), axis=1)
+
+
+def _pad64(x):
+    B, w = x.shape
+    return jnp.zeros((B, 64), jnp.uint8).at[:, :w].set(x)
+
+
+def _finish(msg, total):
+    """Add the 0x80 marker + bit length, pack to words."""
+    pos = jnp.arange(64, dtype=jnp.int32)[None, :]
+    msg = (msg + jnp.where(pos == total[:, None], jnp.uint8(0x80),
+                           jnp.uint8(0))).astype(jnp.uint8)
+    words = _le_words(msg)
+    return words.at[:, 14].set(total.astype(jnp.uint32) * 8)
+
+
+def _digest_bytes(words):
+    """MD5 digest words uint32[B, 4] -> bytes uint8[B, 16] (LE)."""
+    shifts = jnp.asarray([0, 8, 16, 24], jnp.uint32)
+    b = (words[:, :, None] >> shifts[None, None, :]) & jnp.uint32(0xFF)
+    return b.reshape(words.shape[0], 16).astype(jnp.uint8)
+
+
+def md5crypt_digest_batch(cand: jnp.ndarray, lens: jnp.ndarray,
+                          salt: jnp.ndarray, salt_len) -> jnp.ndarray:
+    """cand uint8[B, maxlen] (lens <= 15) + salt uint8[8]/salt_len ->
+    raw digest words uint32[B, 4]."""
+    B = cand.shape[0]
+    pos = jnp.arange(64, dtype=jnp.int32)[None, :]
+    pw = _pad64(cand)
+    L = lens[:, None]
+    S = jnp.broadcast_to(salt_len, (B,))[:, None].astype(jnp.int32)
+    salt_pad = jnp.broadcast_to(
+        jnp.pad(salt, (0, 64 - salt.shape[0]))[None, :], (B, 64)
+    ).astype(jnp.uint8)
+
+    # -- alt = md5(pw + salt + pw) ---------------------------------------
+    msg = jnp.where(pos < L, _gat(pw, pos), 0)
+    msg = jnp.where((pos >= L) & (pos < L + S), _gat(salt_pad, pos - L),
+                    msg)
+    msg = jnp.where((pos >= L + S) & (pos < 2 * L + S),
+                    _gat(pw, pos - L - S), msg).astype(jnp.uint8)
+    alt = md5_digest_words(_finish(msg, (2 * lens
+                                         + S[:, 0]).astype(jnp.int32)))
+
+    # -- initial context: pw + "$1$" + salt + alt[:len(pw)] + bitwalk ----
+    magic = jnp.broadcast_to(
+        jnp.pad(jnp.asarray(np.frombuffer(b"$1$", np.uint8)),
+                (0, 61))[None, :], (B, 64)).astype(jnp.uint8)
+    altb = _pad64(_digest_bytes(alt))
+    # bit-walk bytes: for j while (L >> j) > 0: (L>>j)&1 ? 0 : pw[0]
+    walk = jnp.stack(
+        [jnp.where((lens >> j) & 1 == 1, jnp.uint8(0), cand[:, 0])
+         for j in range(4)], axis=1).astype(jnp.uint8)
+    wlen = sum(((lens >> j) > 0).astype(jnp.int32) for j in range(4))
+    o1, o2 = L, L + 3
+    o3, o4 = L + 3 + S, 2 * L + 3 + S
+    total = (o4 + wlen[:, None])[:, 0]
+    msg = jnp.where(pos < o1, _gat(pw, pos), 0)
+    msg = jnp.where((pos >= o1) & (pos < o2), _gat(magic, pos - o1), msg)
+    msg = jnp.where((pos >= o2) & (pos < o3), _gat(salt_pad, pos - o2),
+                    msg)
+    msg = jnp.where((pos >= o3) & (pos < o4), _gat(altb, pos - o3), msg)
+    msg = jnp.where((pos >= o4) & (pos < total[:, None]),
+                    _gat(_pad64(walk), pos - o4), msg).astype(jnp.uint8)
+    inter = md5_digest_words(_finish(msg, total))
+
+    # -- 1000 rounds -----------------------------------------------------
+    def body(i, inter):
+        odd = (i & 1) == 1
+        s3 = (i % 3) != 0
+        s7 = (i % 7) != 0
+        d = _pad64(_digest_bytes(inter))
+        l1 = jnp.where(odd, L, 16)
+        l4 = jnp.where(odd, 16, L)
+        c1 = l1
+        c2 = c1 + jnp.where(s3, S, 0)
+        c3 = c2 + jnp.where(s7, L, 0)
+        total = (c3 + l4)[:, 0]
+        src1 = jnp.where(odd, _gat(pw, pos), _gat(d, pos))
+        src4 = jnp.where(odd, _gat(d, pos - c3), _gat(pw, pos - c3))
+        msg = jnp.where(pos < c1, src1, 0)
+        msg = jnp.where((pos >= c1) & (pos < c2),
+                        _gat(salt_pad, pos - c1), msg)
+        msg = jnp.where((pos >= c2) & (pos < c3),
+                        _gat(pw, pos - c2), msg)
+        msg = jnp.where((pos >= c3) & (pos < total[:, None]), src4,
+                        msg).astype(jnp.uint8)
+        return md5_digest_words(_finish(msg, total))
+
+    return lax.fori_loop(0, 1000, body, inter)
+
+
+def make_md5crypt_mask_step(gen, batch: int, hit_capacity: int = 64):
+    """step(base_digits, n_valid, salt uint8[8], salt_len int32,
+    target uint32[4]) -> (count, lanes, _)."""
+    flat = gen.flat_charsets
+    length = gen.length
+
+    @jax.jit
+    def step(base_digits, n_valid, salt, salt_len, target):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        lens = jnp.full((batch,), length, jnp.int32)
+        digest = md5crypt_digest_batch(cand, lens, salt, salt_len)
+        found = cmp_ops.compare_single(digest, target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def make_md5crypt_wordlist_step(gen, word_batch: int,
+                                hit_capacity: int = 64):
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, Lw = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+
+    @jax.jit
+    def step(w0, n_valid_words, salt, salt_len, target):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, Lw))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, Lw)
+        digest = md5crypt_digest_batch(cw, cl, salt, salt_len)
+        found = cmp_ops.compare_single(digest, target) & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
+                                    hit_capacity)
+
+    return step
+
+
+def make_sharded_md5crypt_mask_step(gen, mesh, batch_per_device: int,
+                                    hit_capacity: int = 64):
+    from jax.sharding import PartitionSpec as P
+
+    from dprf_tpu.parallel.mesh import SHARD_AXIS
+
+    flat = gen.flat_charsets
+    length = gen.length
+    B = batch_per_device
+
+    def shard_fn(base_digits, n_valid, salt, salt_len, target):
+        dev = lax.axis_index(SHARD_AXIS)
+        offset = (dev * B).astype(jnp.int32)
+        cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
+        lens = jnp.full((B,), length, jnp.int32)
+        digest = md5crypt_digest_batch(cand, lens, salt, salt_len)
+        lane_global = offset + jnp.arange(B, dtype=jnp.int32)
+        found = cmp_ops.compare_single(digest, target) & \
+            (lane_global < n_valid)
+        cnt, lanes, tpos = cmp_ops.compact_hits(
+            found, jnp.zeros((B,), jnp.int32), hit_capacity)
+        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
+        total = lax.psum(cnt, SHARD_AXIS)
+        # replicated hit buffers (see parallel/sharded.py)
+        return (total[None],
+                lax.all_gather(cnt, SHARD_AXIS),
+                lax.all_gather(lanes, SHARD_AXIS),
+                lax.all_gather(tpos, SHARD_AXIS))
+
+    sharded = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(),) * 5,
+        out_specs=(P(), P(), P(), P()), check_vma=False)
+
+    @jax.jit
+    def step(base_digits, n_valid, salt, salt_len, target):
+        total, counts, lanes, tpos = sharded(base_digits, n_valid, salt,
+                                             salt_len, target)
+        return total[0], counts, lanes, tpos
+
+    step.super_batch = mesh.devices.size * B
+    return step
+
+
+def _md5crypt_targs(targets):
+    out = []
+    for t in targets:
+        s = t.params["salt"]
+        buf = np.zeros((8,), np.uint8)
+        buf[:len(s)] = np.frombuffer(s, np.uint8)
+        out.append((jnp.asarray(buf), jnp.int32(len(s)),
+                    jnp.asarray(np.frombuffer(t.digest, dtype="<u4")
+                                .astype(np.uint32))))
+    return out
+
+
+class Md5cryptMaskWorker(PhpassMaskWorker):
+    """Reuses the phpass per-target sweep (same step arity: two salt
+    args + target); only the step factory and target args differ."""
+
+    def __init__(self, engine, gen, targets, batch: int = 1 << 13,
+                 hit_capacity: int = 64, oracle=None):
+        self.engine, self.gen = engine, gen
+        self.targets = list(targets)
+        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self.batch = self.stride = batch
+        self._targs = _md5crypt_targs(self.targets)
+        self.step = make_md5crypt_mask_step(gen, batch, hit_capacity)
+
+
+class Md5cryptWordlistWorker(PhpassWordlistWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 13,
+                 hit_capacity: int = 64, oracle=None):
+        self.engine, self.gen = engine, gen
+        self.targets = list(targets)
+        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self.batch = batch
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self._targs = _md5crypt_targs(self.targets)
+        self.step = make_md5crypt_wordlist_step(gen, self.word_batch,
+                                                hit_capacity)
+
+
+class ShardedMd5cryptMaskWorker(ShardedPhpassMaskWorker):
+    def __init__(self, engine, gen, targets, mesh,
+                 batch_per_device: int = 1 << 12, hit_capacity: int = 64,
+                 oracle=None):
+        self.engine, self.gen = engine, gen
+        self.targets = list(targets)
+        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self.mesh = mesh
+        self.batch = self.stride = mesh.devices.size * batch_per_device
+        self._targs = _md5crypt_targs(self.targets)
+        self.step = make_sharded_md5crypt_mask_step(
+            gen, mesh, batch_per_device, hit_capacity)
+
+
+@register("md5crypt", device="jax")
+class JaxMd5cryptEngine(Md5cryptEngine):
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return Md5cryptMaskWorker(self, gen, targets,
+                                  batch=min(batch, 1 << 13),
+                                  hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return Md5cryptWordlistWorker(self, gen, targets,
+                                      batch=min(batch, 1 << 13),
+                                      hit_capacity=hit_capacity,
+                                      oracle=oracle)
+
+    def make_sharded_mask_worker(self, gen, targets, mesh,
+                                 batch_per_device: int, hit_capacity: int,
+                                 oracle=None):
+        return ShardedMd5cryptMaskWorker(
+            self, gen, targets, mesh,
+            batch_per_device=min(batch_per_device, 1 << 12),
+            hit_capacity=hit_capacity, oracle=oracle)
