@@ -271,6 +271,31 @@ class Md5cryptEngine(HashEngine):
         return [md5crypt_raw(c, params["salt"]) for c in candidates]
 
 
+@register("sha512crypt")
+class Sha512cryptEngine(HashEngine):
+    """$6$ modular crypt (Linux shadow default; hashcat 1800)."""
+
+    name = "sha512crypt"
+    digest_size = 64
+    salted = True
+    max_candidate_len = 15    # device budget: 64 + 2L + 16 <= 111
+
+    def parse_target(self, text: str) -> Target:
+        from dprf_tpu.engines.cpu.sha512crypt import parse_sha512crypt
+        rounds, salt, digest = parse_sha512crypt(text)
+        return Target(raw=text.strip(), digest=digest,
+                      params={"salt": salt, "rounds": rounds})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        from dprf_tpu.engines.cpu.sha512crypt import sha512crypt_raw
+        if not params:
+            raise ValueError("sha512crypt needs target params "
+                             "(salt, rounds)")
+        return [sha512crypt_raw(c, params["salt"], params["rounds"])
+                for c in candidates]
+
+
 @register("phpass")
 class PhpassEngine(HashEngine):
     """phpass portable hashes ($P$/$H$, WordPress/phpBB; hashcat 400):

@@ -1,0 +1,112 @@
+"""sha512crypt ($6$ modular crypt, the Linux shadow default;
+hashcat 1800) reference implementation, following the public
+crypt(3)/glibc algorithm description.
+
+Structure: an alternate digest B = sha512(pw+salt+pw); a bit-walked
+initial digest A; the P and S byte sequences derived from digests of
+repeated password/salt; then `rounds` (default 5000) iterations whose
+message composition cycles with i mod 2/3/7.  The emitted base64 text
+permutes digest bytes in 21 rotating (i, i+21, i+42) triplets.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from dprf_tpu.engines.cpu.phpass import ITOA64, decode64, encode64
+
+MAX_SALT_LEN = 16
+DEFAULT_ROUNDS = 5000
+MIN_ROUNDS, MAX_ROUNDS = 1000, 999999999
+
+
+def _perm_rows():
+    rows = []
+    a, b, c = 0, 21, 42
+    for _ in range(21):
+        rows.append((a, b, c))
+        a, b, c = b + 1, c + 1, a + 1
+    return rows
+
+
+#: digest byte order fed to the shared little-endian encode64: glibc
+#: emits (d[a]<<16 | d[b]<<8 | d[c]) per rotating triplet, so each
+#: triplet is listed reversed; d[63] rides alone in the final group.
+_PERM = [i for (a, b, c) in _perm_rows() for i in (c, b, a)] + [63]
+
+
+def sha512crypt_raw(password: bytes, salt: bytes,
+                    rounds: int = DEFAULT_ROUNDS) -> bytes:
+    """The raw (unpermuted) 64-byte digest."""
+    sha = lambda d: hashlib.sha512(d).digest()  # noqa: E731
+    B = sha(password + salt + password)
+    ctx = password + salt
+    # append B cycled to len(password) bytes
+    for i in range(len(password)):
+        ctx += B[i % 64:i % 64 + 1]
+    # bit-walk: FULL B or FULL password per bit of len(password)
+    cnt = len(password)
+    while cnt > 0:
+        ctx += B if cnt & 1 else password
+        cnt >>= 1
+    A = sha(ctx)
+    # P sequence: digest of password repeated len(password) times,
+    # cycled out to len(password) bytes
+    DP = sha(password * len(password))
+    P = bytes(DP[i % 64] for i in range(len(password)))
+    # S sequence: digest of salt repeated (16 + A[0]) times, cycled to
+    # len(salt) bytes
+    DS = sha(salt * (16 + A[0]))
+    S = bytes(DS[i % 64] for i in range(len(salt)))
+    prev = A
+    for i in range(rounds):
+        msg = P if i & 1 else prev
+        if i % 3:
+            msg += S
+        if i % 7:
+            msg += P
+        msg += prev if i & 1 else P
+        prev = sha(msg)
+    return prev
+
+
+def encode_digest(digest: bytes) -> str:
+    return encode64(bytes(digest[p] for p in _PERM))
+
+
+def decode_digest(text: str) -> bytes:
+    permuted = decode64(text, 64)
+    out = bytearray(64)
+    for where, src in zip(_PERM, permuted):
+        out[where] = src
+    return bytes(out)
+
+
+def parse_sha512crypt(text: str):
+    """'$6$[rounds=N$]salt$hash' -> (rounds, salt bytes, raw digest)."""
+    t = text.strip()
+    if not t.startswith("$6$"):
+        raise ValueError(f"not a sha512crypt hash: {text!r}")
+    rest = t[3:]
+    rounds = DEFAULT_ROUNDS
+    if rest.startswith("rounds="):
+        spec, sep, rest = rest.partition("$")
+        if not sep:
+            raise ValueError(f"malformed sha512crypt hash: {text!r}")
+        rounds = int(spec[len("rounds="):])
+        if not MIN_ROUNDS <= rounds <= MAX_ROUNDS:
+            raise ValueError(f"sha512crypt rounds out of range: {rounds}")
+    salt_text, sep, digest_text = rest.partition("$")
+    if not sep or len(digest_text) != 86:
+        raise ValueError(f"malformed sha512crypt hash: {text!r}")
+    salt = salt_text.encode("latin-1")[:MAX_SALT_LEN]
+    return rounds, salt, decode_digest(digest_text)
+
+
+def sha512crypt_hash(password: bytes, salt: bytes,
+                     rounds: int = DEFAULT_ROUNDS) -> str:
+    prefix = "$6$"
+    if rounds != DEFAULT_ROUNDS:
+        prefix += f"rounds={rounds}$"
+    return (prefix + salt.decode("latin-1") + "$"
+            + encode_digest(sha512crypt_raw(password, salt, rounds)))

@@ -1,0 +1,397 @@
+"""Device sha512crypt engine ($6$, the Linux shadow default;
+hashcat 1800).
+
+The scheme's setup phase hashes VARIABLE-length, multi-block inputs
+(the bit-walked A context reaches ~300 bytes; the S-sequence source is
+the salt repeated 16+A[0] times, up to ~4.3 KB), and the `rounds` loop
+hashes one ~110-byte message per iteration.  TPU mapping:
+
+- a generic multi-block SHA-512 over a fixed-width byte buffer: blocks
+  are compressed in a static unroll with per-lane `where`-masked state
+  updates, so lanes with fewer blocks simply stop advancing;
+- the repeated-salt source is never materialized at its worst-case
+  4.3 KB: each 128-byte block is generated on the fly as
+  salt[(k*128 + j) mod salt_len] and fed to the chained compression;
+- round messages are built at the byte level (clipped gathers +
+  boundary masks over a 128-byte window, per-lane password lengths)
+  exactly like the md5crypt kernel, under `lax.fori_loop` with
+  `rounds` as a runtime argument -- one compiled step serves every
+  target, salt, and rounds value.
+
+Password cap: 64 + 2L + 16 <= 111 single-block bytes -> L <= 15 on the
+device path (the CPU oracle handles longer).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.cpu.engines import Sha512cryptEngine
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops.sha512 import (INIT512, init_state,
+                                 sha512_compress_state)
+
+#: device-path password cap
+MAX_PASS_LEN = 15
+#: worst-case bytes of the A context: L + S + L + 4 walk segments of
+#: max(64, L) -> 15 + 16 + 15 + 256 = 302; padded fits 3 blocks
+A_CTX_BLOCKS = 3
+#: worst-case blocks of the repeated-salt S source:
+#: (16 + 255) * 16 = 4336 bytes (+17 padding) -> 35 blocks
+DS_BLOCKS = 35
+
+
+def _be_words(msg: jnp.ndarray) -> jnp.ndarray:
+    """uint8[B, 128k] -> uint32[B, 32k] big-endian."""
+    coef = jnp.asarray(np.array([1 << 24, 1 << 16, 1 << 8, 1],
+                                dtype=np.uint32))
+    grouped = msg.reshape(msg.shape[0], -1, 4).astype(jnp.uint32)
+    return (grouped * coef).sum(axis=-1, dtype=jnp.uint32)
+
+
+def _sha512_multiblock(msg: jnp.ndarray, lens: jnp.ndarray,
+                       n_blocks_max: int) -> jnp.ndarray:
+    """SHA-512 of per-lane `lens` bytes inside msg uint8[B, 128*max]
+    (bytes beyond lens must be zero) -> uint32[B, 16] digest words."""
+    B = msg.shape[0]
+    pos = jnp.arange(msg.shape[1], dtype=jnp.int32)[None, :]
+    msg = (msg + jnp.where(pos == lens[:, None], jnp.uint8(0x80),
+                           jnp.uint8(0))).astype(jnp.uint8)
+    words = _be_words(msg)
+    n_blocks = (lens + 17 + 127) // 128
+    # 128-bit big-endian length field: low 32 bits live in the last
+    # word of the final block (lens <= ~4 KB, so higher bits are 0)
+    widx = n_blocks * 32 - 1
+    warange = jnp.arange(words.shape[1], dtype=jnp.int32)[None, :]
+    words = jnp.where(warange == widx[:, None],
+                      (lens[:, None].astype(jnp.uint32) * 8), words)
+    state = init_state(INIT512, (B,))
+    for k in range(n_blocks_max):
+        new = sha512_compress_state(state, words[:, k * 32:(k + 1) * 32])
+        state = jnp.where((k < n_blocks)[:, None], new, state)
+    return state
+
+
+def _digest_bytes(state: jnp.ndarray) -> jnp.ndarray:
+    """uint32[B, 16] interleaved words -> uint8[B, 64] digest bytes."""
+    shifts = jnp.asarray(np.array([24, 16, 8, 0], np.uint32))
+    b = (state[:, :, None] >> shifts[None, None, :]) & jnp.uint32(0xFF)
+    return b.reshape(state.shape[0], 64).astype(jnp.uint8)
+
+
+def _pad_to(x: jnp.ndarray, width: int) -> jnp.ndarray:
+    B, w = x.shape
+    return jnp.zeros((B, width), jnp.uint8).at[:, :w].set(x)
+
+
+def _gat(src_pad, idx):
+    return jnp.take_along_axis(src_pad,
+                               jnp.clip(idx, 0, src_pad.shape[1] - 1),
+                               axis=1)
+
+
+def sha512crypt_digest_batch(cand: jnp.ndarray, lens: jnp.ndarray,
+                             salt: jnp.ndarray, salt_len,
+                             rounds) -> jnp.ndarray:
+    """cand uint8[B, maxlen] (lens <= 15) + salt uint8[16]/salt_len +
+    rounds -> uint32[B, 16] raw digest words."""
+    B = cand.shape[0]
+    L = lens[:, None]
+    S = jnp.broadcast_to(salt_len, (B,))[:, None].astype(jnp.int32)
+    Ls = lens
+    Ss = S[:, 0]
+
+    W1 = 128
+    pos1 = jnp.arange(W1, dtype=jnp.int32)[None, :]
+    pw1 = _pad_to(cand, W1)
+    salt1 = jnp.broadcast_to(
+        jnp.pad(salt, (0, W1 - salt.shape[0]))[None, :],
+        (B, W1)).astype(jnp.uint8)
+
+    # -- B_alt = sha512(pw + salt + pw) ---------------------------------
+    msg = jnp.where(pos1 < L, _gat(pw1, pos1), 0)
+    msg = jnp.where((pos1 >= L) & (pos1 < L + S),
+                    _gat(salt1, pos1 - L), msg)
+    msg = jnp.where((pos1 >= L + S) & (pos1 < 2 * L + S),
+                    _gat(pw1, pos1 - L - S), msg).astype(jnp.uint8)
+    B_alt = _sha512_multiblock(msg, 2 * Ls + Ss, 1)
+    Bb = _digest_bytes(B_alt)
+
+    # -- A context: pw + salt + B[:L] + bit-walk of full B/pw -----------
+    WA = A_CTX_BLOCKS * 128
+    posA = jnp.arange(WA, dtype=jnp.int32)[None, :]
+    pwA = _pad_to(cand, WA)
+    saltA = _pad_to(salt[None, :].astype(jnp.uint8), WA)
+    saltA = jnp.broadcast_to(saltA, (B, WA))
+    BbA = _pad_to(Bb, WA)
+    msg = jnp.where(posA < L, _gat(pwA, posA), 0)
+    msg = jnp.where((posA >= L) & (posA < L + S),
+                    _gat(saltA, posA - L), msg)
+    o = L + S
+    msg = jnp.where((posA >= o) & (posA < o + L), _gat(BbA, posA - o),
+                    msg)
+    off = o + L
+    for j in range(4):
+        seg_present = (Ls >> j) > 0
+        bit = ((Ls >> j) & 1) == 1
+        seg_len = jnp.where(seg_present,
+                            jnp.where(bit, 64, Ls), 0)[:, None]
+        src = jnp.where(bit[:, None], _gat(BbA, posA - off),
+                        _gat(pwA, posA - off))
+        msg = jnp.where((posA >= off) & (posA < off + seg_len), src, msg)
+        off = off + seg_len
+    msg = msg.astype(jnp.uint8)
+    A = _sha512_multiblock(msg, off[:, 0], A_CTX_BLOCKS)
+
+    # -- P sequence: sha512(pw * L)[:L] ---------------------------------
+    WP = 256        # 15 * 15 = 225 bytes max
+    posP = jnp.arange(WP, dtype=jnp.int32)[None, :]
+    Lsafe = jnp.maximum(Ls, 1)[:, None]
+    rep = _gat(_pad_to(cand, WP), posP % Lsafe)
+    msg = jnp.where(posP < L * L, rep, 0).astype(jnp.uint8)
+    DP = _sha512_multiblock(msg, Ls * Ls, 2)
+    Pb = _digest_bytes(DP)     # P = Pb[:L]
+
+    # -- S sequence: sha512(salt * (16 + A[0]))[:salt_len] --------------
+    # chained on the fly: block k's bytes are salt[(128k + j) % S]
+    A0 = (A[:, 0] >> jnp.uint32(24)).astype(jnp.int32)   # first byte
+    ds_len = (16 + A0) * Ss
+    n_blocks = (ds_len + 17 + 127) // 128
+    Ssafe = jnp.maximum(Ss, 1)[:, None]
+    state0 = init_state(INIT512, (B,))
+
+    def ds_block(k, state):
+        gpos = k * 128 + pos1                    # [B, 128] global pos
+        blk = _gat(salt1, gpos % Ssafe)
+        blk = jnp.where(gpos < ds_len[:, None], blk, 0)
+        blk = (blk + jnp.where(gpos == ds_len[:, None], jnp.uint8(0x80),
+                               jnp.uint8(0))).astype(jnp.uint8)
+        words = _be_words(blk)
+        # the 128-bit length field lands in this block iff it is the
+        # last one; low word = bits at local word index 31
+        is_last = (n_blocks - 1) == k
+        words = words.at[:, 31].set(
+            jnp.where(is_last, ds_len.astype(jnp.uint32) * 8,
+                      words[:, 31]))
+        new = sha512_compress_state(state, words)
+        return jnp.where((k < n_blocks)[:, None], new, state)
+
+    DS = lax.fori_loop(0, DS_BLOCKS, ds_block, state0)
+    Sb = _digest_bytes(DS)     # S = Sb[:salt_len]
+
+    # -- rounds ----------------------------------------------------------
+    pw128 = pw1                      # P bytes == pw-derived, width 128
+    P128 = _pad_to(Pb, W1)
+    S128 = _pad_to(Sb, W1)
+    del pw128
+
+    def body(i, prev):
+        odd = (i & 1) == 1
+        s3 = (i % 3) != 0
+        s7 = (i % 7) != 0
+        d = _pad_to(_digest_bytes(prev), W1)
+        l1 = jnp.where(odd, L, 64)
+        l4 = jnp.where(odd, 64, L)
+        c1 = l1
+        c2 = c1 + jnp.where(s3, S, 0)
+        c3 = c2 + jnp.where(s7, L, 0)
+        total = (c3 + l4)[:, 0]
+        src1 = jnp.where(odd, _gat(P128, pos1), _gat(d, pos1))
+        src4 = jnp.where(odd, _gat(d, pos1 - c3), _gat(P128, pos1 - c3))
+        msg = jnp.where(pos1 < c1, src1, 0)
+        msg = jnp.where((pos1 >= c1) & (pos1 < c2),
+                        _gat(S128, pos1 - c1), msg)
+        msg = jnp.where((pos1 >= c2) & (pos1 < c3),
+                        _gat(P128, pos1 - c2), msg)
+        msg = jnp.where((pos1 >= c3) & (pos1 < total[:, None]), src4,
+                        msg).astype(jnp.uint8)
+        return _sha512_multiblock(msg, total, 1)
+
+    return lax.fori_loop(0, rounds, body, A)
+
+
+def make_sha512crypt_mask_step(gen, batch: int, hit_capacity: int = 64):
+    """step(base_digits, n_valid, salt uint8[16], salt_len, rounds,
+    target uint32[16]) -> (count, lanes, _)."""
+    flat = gen.flat_charsets
+    length = gen.length
+
+    @jax.jit
+    def step(base_digits, n_valid, salt, salt_len, rounds, target):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        lens = jnp.full((batch,), length, jnp.int32)
+        digest = sha512crypt_digest_batch(cand, lens, salt, salt_len,
+                                          rounds)
+        found = cmp_ops.compare_single(digest, target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def make_sha512crypt_wordlist_step(gen, word_batch: int,
+                                   hit_capacity: int = 64):
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, Lw = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+
+    @jax.jit
+    def step(w0, n_valid_words, salt, salt_len, rounds, target):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, Lw))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, Lw)
+        digest = sha512crypt_digest_batch(cw, cl, salt, salt_len, rounds)
+        found = cmp_ops.compare_single(digest, target) & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
+                                    hit_capacity)
+
+    return step
+
+
+def _targs(targets):
+    out = []
+    for t in targets:
+        s = t.params["salt"]
+        buf = np.zeros((16,), np.uint8)
+        buf[:len(s)] = np.frombuffer(s, np.uint8)
+        out.append((jnp.asarray(buf), jnp.int32(len(s)),
+                    jnp.int32(t.params["rounds"]),
+                    jnp.asarray(np.frombuffer(t.digest, dtype=">u4")
+                                .astype(np.uint32))))
+    return out
+
+
+class _ShacryptWorkerMixin:
+    """Per-target sweep driving 6-arg steps (salt, salt_len, rounds)."""
+
+    def _sweep_mask(self, unit, step, stride):
+        from dprf_tpu.runtime.worker import Hit
+        hits = []
+        for ti in range(len(self.targets)):
+            salt, salt_len, rounds, tgt = self._targs[ti]
+            queued = []
+            for bstart in range(unit.start, unit.end, stride):
+                n_valid = min(stride, unit.end - bstart)
+                base = jnp.asarray(self.gen.digits(bstart),
+                                   dtype=jnp.int32)
+                queued.append((bstart, step(
+                    base, jnp.int32(n_valid), salt, salt_len, rounds,
+                    tgt)))
+            for bstart, (cnt, lanes, _) in queued:
+                cnt = int(cnt)
+                if cnt == 0:
+                    continue
+                if cnt > self.hit_capacity:
+                    hits.extend(self._rescan(
+                        bstart, min(bstart + stride, unit.end), ti))
+                    continue
+                for lane in np.asarray(lanes):
+                    if lane < 0:
+                        continue
+                    gidx = bstart + int(lane)
+                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+
+class Sha512cryptMaskWorker(_ShacryptWorkerMixin):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 12,
+                 hit_capacity: int = 64, oracle=None):
+        self.engine, self.gen = engine, gen
+        self.targets = list(targets)
+        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self.batch = self.stride = batch
+        self._targs = _targs(self.targets)
+        self.step = make_sha512crypt_mask_step(gen, batch, hit_capacity)
+
+    def _rescan(self, start, end, ti):
+        from dprf_tpu.runtime.worker import CpuWorker, Hit
+        from dprf_tpu.runtime.workunit import WorkUnit
+        if self.oracle is None:
+            raise RuntimeError("hit buffer overflow and no oracle")
+        hits = CpuWorker(self.oracle, self.gen,
+                         [self.targets[ti]]).process(
+            WorkUnit(-1, start, end - start))
+        return [Hit(ti, h.cand_index, h.plaintext) for h in hits]
+
+    def process(self, unit):
+        return self._sweep_mask(unit, self.step, self.stride)
+
+
+class Sha512cryptWordlistWorker(Sha512cryptMaskWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 12,
+                 hit_capacity: int = 64, oracle=None):
+        from dprf_tpu.runtime.worker import (word_cover_range,
+                                             wordlist_lane_to_gidx)
+        self.engine, self.gen = engine, gen
+        self.targets = list(targets)
+        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self.batch = batch
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self._targs = _targs(self.targets)
+        self.step = make_sha512crypt_wordlist_step(gen, self.word_batch,
+                                                   hit_capacity)
+
+    def process(self, unit):
+        from dprf_tpu.runtime.worker import (Hit, word_cover_range,
+                                             wordlist_lane_to_gidx)
+        R = self.gen.n_rules
+        w_start, w_end = word_cover_range(unit, R)
+        hits = []
+        for ti in range(len(self.targets)):
+            salt, salt_len, rounds, tgt = self._targs[ti]
+            queued = []
+            for ws in range(w_start, w_end, self.word_batch):
+                nw = min(self.word_batch, w_end - ws,
+                         self.gen.n_words - ws)
+                if nw <= 0:
+                    break
+                queued.append((ws, nw, self.step(
+                    jnp.int32(ws), jnp.int32(nw), salt, salt_len,
+                    rounds, tgt)))
+            for ws, nw, (cnt, lanes, _) in queued:
+                cnt = int(cnt)
+                if cnt == 0:
+                    continue
+                if cnt > self.hit_capacity:
+                    start = max(unit.start, ws * R)
+                    end = min(unit.end, (ws + nw) * R)
+                    hits.extend(self._rescan(start, end, ti))
+                    continue
+                for lane in np.asarray(lanes):
+                    if lane < 0:
+                        continue
+                    gidx = wordlist_lane_to_gidx(int(lane), ws,
+                                                 self.word_batch, R)
+                    if not unit.start <= gidx < unit.end:
+                        continue
+                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+
+@register("sha512crypt", device="jax")
+class JaxSha512cryptEngine(Sha512cryptEngine):
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return Sha512cryptMaskWorker(self, gen, targets,
+                                     batch=min(batch, 1 << 12),
+                                     hit_capacity=hit_capacity,
+                                     oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return Sha512cryptWordlistWorker(self, gen, targets,
+                                         batch=min(batch, 1 << 12),
+                                         hit_capacity=hit_capacity,
+                                         oracle=oracle)

@@ -131,10 +131,11 @@ _KH = np.array([k >> 32 for k in K], dtype=np.uint32)
 _KL = np.array([k & 0xFFFFFFFF for k in K], dtype=np.uint32)
 
 
-def sha512_compress(init, words: jnp.ndarray) -> jnp.ndarray:
-    """init: 8 python ints (64-bit state); words: uint32[..., 32]
-    big-endian interleaved (hi, lo) pairs -> uint32[..., 16] digest
-    words in the same interleaved layout.
+def sha512_compress_state(state: jnp.ndarray,
+                          words: jnp.ndarray) -> jnp.ndarray:
+    """One SHA-512 compression: state uint32[..., 16] (interleaved
+    (hi, lo) pairs) x message words uint32[..., 32] -> uint32[..., 16].
+    The multi-block primitive sha512crypt-style schemes chain.
 
     The first 16 rounds are unrolled (static message indexing, static
     round constants); rounds 16..80 run under lax.fori_loop with a
@@ -147,11 +148,8 @@ def sha512_compress(init, words: jnp.ndarray) -> jnp.ndarray:
     """
     from jax import lax
 
-    shape = words.shape[:-1]
-    vars8 = tuple(
-        (jnp.broadcast_to(jnp.uint32(v >> 32), shape),
-         jnp.broadcast_to(jnp.uint32(v & 0xFFFFFFFF), shape))
-        for v in init)
+    vars8 = tuple((state[..., 2 * i], state[..., 2 * i + 1])
+                  for i in range(8))
     wh = words[..., 0::2]
     wl = words[..., 1::2]
     for t in range(16):
@@ -173,12 +171,25 @@ def sha512_compress(init, words: jnp.ndarray) -> jnp.ndarray:
 
     vars8, _, _ = lax.fori_loop(16, 80, body, (vars8, wh, wl))
     out = []
-    for v, i in zip(vars8, init):
-        h, l = _add64(v, (jnp.broadcast_to(jnp.uint32(i >> 32), shape),
-                          jnp.broadcast_to(jnp.uint32(i & 0xFFFFFFFF),
-                                           shape)))
+    for v, i in zip(vars8, range(8)):
+        h, l = _add64(v, (state[..., 2 * i], state[..., 2 * i + 1]))
         out.extend([h, l])
     return jnp.stack(out, axis=-1)
+
+
+def init_state(init, shape) -> jnp.ndarray:
+    """8 python ints -> uint32[shape + (16,)] interleaved state."""
+    flat = []
+    for v in init:
+        flat.extend([v >> 32, v & 0xFFFFFFFF])
+    return jnp.broadcast_to(
+        jnp.asarray(np.array(flat, dtype=np.uint32)), shape + (16,))
+
+
+def sha512_compress(init, words: jnp.ndarray) -> jnp.ndarray:
+    """init: 8 python ints; words uint32[..., 32] -> uint32[..., 16]."""
+    return sha512_compress_state(init_state(init, words.shape[:-1]),
+                                 words)
 
 
 def sha512_digest_words(words: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,113 @@
+"""sha512crypt ($6$): reference vs system crypt, device digests vs
+reference (multi-block A-context, on-the-fly repeated-salt chaining,
+runtime rounds), worker end-to-end, CLI.  Rounds kept at the format
+minimum (1000) so test sweeps stay small."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.engines.cpu.sha512crypt import (parse_sha512crypt,
+                                              sha512crypt_hash,
+                                              sha512crypt_raw)
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+def test_against_system_crypt_if_available():
+    try:
+        import crypt
+    except ImportError:
+        pytest.skip("no crypt module")
+    for pw, salt, rounds in ((b"password", b"saltstring", 5000),
+                             (b"", b"zz", 5000),
+                             (b"hello", b"salt", 1000),
+                             (b"pw15bytes_reach", b"0123456789abcdef",
+                              7777)):
+        spec = "$6$" + (f"rounds={rounds}$" if rounds != 5000 else "") \
+            + salt.decode() + "$"
+        want = crypt.crypt(pw.decode(), spec)
+        if want is None:
+            pytest.skip("system crypt lacks sha512crypt")
+        assert sha512crypt_hash(pw, salt, rounds) == want
+
+
+def test_parse_variants():
+    line = sha512crypt_hash(b"abc", b"mysalt", 1000)
+    rounds, salt, digest = parse_sha512crypt(line)
+    assert rounds == 1000 and salt == b"mysalt"
+    assert sha512crypt_raw(b"abc", salt, rounds) == digest
+    line5k = sha512crypt_hash(b"abc", b"mysalt")
+    assert "rounds=" not in line5k
+    assert parse_sha512crypt(line5k)[0] == 5000
+    with pytest.raises(ValueError):
+        parse_sha512crypt("$5$notsix$x")
+
+
+def test_device_digest_matches_reference():
+    import random
+    from dprf_tpu.engines.device.sha512crypt import \
+        sha512crypt_digest_batch
+
+    rng = random.Random(6)
+    cands = [b"", b"abcdefghijklmno"] + [
+        bytes(rng.randrange(1, 256) for _ in range(rng.randrange(0, 16)))
+        for _ in range(6)]
+    salt = b"Xy7"
+    maxlen = max((len(c) for c in cands), default=1) or 1
+    buf = np.zeros((len(cands), maxlen), np.uint8)
+    lens = np.zeros((len(cands),), np.int32)
+    for i, c in enumerate(cands):
+        buf[i, :len(c)] = np.frombuffer(c, np.uint8)
+        lens[i] = len(c)
+    sbuf = np.zeros((16,), np.uint8)
+    sbuf[:len(salt)] = np.frombuffer(salt, np.uint8)
+    dw = sha512crypt_digest_batch(jnp.asarray(buf), jnp.asarray(lens),
+                                  jnp.asarray(sbuf),
+                                  jnp.int32(len(salt)), jnp.int32(1000))
+    got = [np.asarray(dw)[i].astype(">u4").tobytes()
+           for i in range(len(cands))]
+    assert got == [sha512crypt_raw(c, salt, 1000) for c in cands]
+
+
+def test_mask_worker_end_to_end():
+    dev = get_engine("sha512crypt", "jax")
+    cpu = get_engine("sha512crypt", "cpu")
+    gen = MaskGenerator("?l?d")
+    secret = b"k7"
+    t = dev.parse_target(sha512crypt_hash(secret, b"NaCl", 1000))
+    w = dev.make_mask_worker(gen, [t], batch=512, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
+
+
+def test_wordlist_worker():
+    from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+    from dprf_tpu.rules.parser import parse_rule
+
+    dev = get_engine("sha512crypt", "jax")
+    cpu = get_engine("sha512crypt", "cpu")
+    words = [b"red", b"green", b"blue"]
+    rules = [parse_rule(":"), parse_rule("u")]
+    gen = WordlistRulesGenerator(words, rules, max_len=15)
+    secret = b"GREEN"
+    t = dev.parse_target(sha512crypt_hash(secret, b"pepper", 1000))
+    w = dev.make_wordlist_worker(gen, [t], batch=8, hit_capacity=8,
+                                 oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
+
+
+def test_cli_sha512crypt_crack(tmp_path, capsys):
+    from dprf_tpu.cli import main
+
+    line = sha512crypt_hash(b"q7", b"grain", 1000)
+    hf = tmp_path / "h.txt"
+    hf.write_text(line + "\n")
+    rc = main(["crack", "?l?d", str(hf), "--engine", "sha512crypt",
+               "--device", "tpu", "--no-potfile", "--batch", "512",
+               "-q"])
+    out = capsys.readouterr().out
+    assert rc == 0 and f"{line}:q7" in out
