@@ -296,6 +296,31 @@ class Sha512cryptEngine(HashEngine):
                 for c in candidates]
 
 
+@register("sha256crypt")
+class Sha256cryptEngine(HashEngine):
+    """$5$ modular crypt (hashcat 7400)."""
+
+    name = "sha256crypt"
+    digest_size = 32
+    salted = True
+    max_candidate_len = 15
+
+    def parse_target(self, text: str) -> Target:
+        from dprf_tpu.engines.cpu.sha256crypt import parse_sha256crypt
+        rounds, salt, digest = parse_sha256crypt(text)
+        return Target(raw=text.strip(), digest=digest,
+                      params={"salt": salt, "rounds": rounds})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        from dprf_tpu.engines.cpu.sha256crypt import sha256crypt_raw
+        if not params:
+            raise ValueError("sha256crypt needs target params "
+                             "(salt, rounds)")
+        return [sha256crypt_raw(c, params["salt"], params["rounds"])
+                for c in candidates]
+
+
 @register("phpass")
 class PhpassEngine(HashEngine):
     """phpass portable hashes ($P$/$H$, WordPress/phpBB; hashcat 400):

@@ -1,0 +1,97 @@
+"""sha256crypt ($5$ modular crypt; hashcat 7400) reference, following
+the public crypt(3)/glibc algorithm.  Identical structure to
+sha512crypt (see sha512crypt.py) with SHA-256 and its own base64
+permutation (10 rotating triplets + a 2-byte tail)."""
+
+from __future__ import annotations
+
+import hashlib
+
+from dprf_tpu.engines.cpu.phpass import decode64, encode64
+
+MAX_SALT_LEN = 16
+DEFAULT_ROUNDS = 5000
+MIN_ROUNDS, MAX_ROUNDS = 1000, 999999999
+
+
+def _perm_rows():
+    rows = []
+    a, b, c = 0, 10, 20
+    for _ in range(10):
+        rows.append((a, b, c))
+        a, b, c = c + 1, a + 1, b + 1
+    return rows
+
+
+#: see sha512crypt._PERM; the final group is (0, d[31], d[30]) -> the
+#: little-endian encode64 pair [30, 31]
+_PERM = [i for (a, b, c) in _perm_rows() for i in (c, b, a)] + [30, 31]
+
+
+def sha256crypt_raw(password: bytes, salt: bytes,
+                    rounds: int = DEFAULT_ROUNDS) -> bytes:
+    sha = lambda d: hashlib.sha256(d).digest()  # noqa: E731
+    B = sha(password + salt + password)
+    ctx = password + salt
+    for i in range(len(password)):
+        ctx += B[i % 32:i % 32 + 1]
+    cnt = len(password)
+    while cnt > 0:
+        ctx += B if cnt & 1 else password
+        cnt >>= 1
+    A = sha(ctx)
+    DP = sha(password * len(password))
+    P = bytes(DP[i % 32] for i in range(len(password)))
+    DS = sha(salt * (16 + A[0]))
+    S = bytes(DS[i % 32] for i in range(len(salt)))
+    prev = A
+    for i in range(rounds):
+        msg = P if i & 1 else prev
+        if i % 3:
+            msg += S
+        if i % 7:
+            msg += P
+        msg += prev if i & 1 else P
+        prev = sha(msg)
+    return prev
+
+
+def encode_digest(digest: bytes) -> str:
+    return encode64(bytes(digest[p] for p in _PERM))
+
+
+def decode_digest(text: str) -> bytes:
+    permuted = decode64(text, 32)
+    out = bytearray(32)
+    for where, src in zip(_PERM, permuted):
+        out[where] = src
+    return bytes(out)
+
+
+def parse_sha256crypt(text: str):
+    t = text.strip()
+    if not t.startswith("$5$"):
+        raise ValueError(f"not a sha256crypt hash: {text!r}")
+    rest = t[3:]
+    rounds = DEFAULT_ROUNDS
+    if rest.startswith("rounds="):
+        spec, sep, rest = rest.partition("$")
+        if not sep:
+            raise ValueError(f"malformed sha256crypt hash: {text!r}")
+        rounds = int(spec[len("rounds="):])
+        if not MIN_ROUNDS <= rounds <= MAX_ROUNDS:
+            raise ValueError(f"sha256crypt rounds out of range: {rounds}")
+    salt_text, sep, digest_text = rest.partition("$")
+    if not sep or len(digest_text) != 43:
+        raise ValueError(f"malformed sha256crypt hash: {text!r}")
+    salt = salt_text.encode("latin-1")[:MAX_SALT_LEN]
+    return rounds, salt, decode_digest(digest_text)
+
+
+def sha256crypt_hash(password: bytes, salt: bytes,
+                     rounds: int = DEFAULT_ROUNDS) -> str:
+    prefix = "$5$"
+    if rounds != DEFAULT_ROUNDS:
+        prefix += f"rounds={rounds}$"
+    return (prefix + salt.decode("latin-1") + "$"
+            + encode_digest(sha256crypt_raw(password, salt, rounds)))

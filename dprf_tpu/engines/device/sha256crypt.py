@@ -1,0 +1,277 @@
+"""Device sha256crypt engine ($5$; hashcat 7400).
+
+Same TPU mapping as the sha512crypt engine (byte-level message
+construction, multi-block compression with where-masked state
+advance, on-the-fly repeated-salt blocks, runtime rounds) with
+SHA-256's 64-byte blocks -- round messages reach 78 bytes, so each
+round chains TWO compressions.  See engines/device/sha512crypt.py for
+the design commentary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.cpu.engines import Sha256cryptEngine
+from dprf_tpu.engines.device.sha512crypt import (Sha512cryptMaskWorker,
+                                                 Sha512cryptWordlistWorker,
+                                                 _targs)
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops.sha256 import INIT, sha256_compress
+
+MAX_PASS_LEN = 15
+A_CTX_BLOCKS = 3      # 15+16+15+4*32 = 174 (+9 pad) -> 3 x 64
+DP_BLOCKS = 4         # 15*15 = 225 (+9) -> 4 x 64
+DS_BLOCKS = 68        # (16+255)*16 = 4336 (+9) -> 68 x 64
+ROUND_BLOCKS = 2      # 32+15+16+15 = 78 (+9) -> 2 x 64
+
+
+def _be_words(msg: jnp.ndarray) -> jnp.ndarray:
+    coef = jnp.asarray(np.array([1 << 24, 1 << 16, 1 << 8, 1],
+                                dtype=np.uint32))
+    grouped = msg.reshape(msg.shape[0], -1, 4).astype(jnp.uint32)
+    return (grouped * coef).sum(axis=-1, dtype=jnp.uint32)
+
+
+def _init_state(B: int) -> jnp.ndarray:
+    return jnp.broadcast_to(jnp.asarray(INIT), (B, 8))
+
+
+def _sha256_multiblock(msg: jnp.ndarray, lens: jnp.ndarray,
+                       n_blocks_max: int) -> jnp.ndarray:
+    """SHA-256 of per-lane `lens` bytes in msg uint8[B, 64*max] (bytes
+    beyond lens zero) -> uint32[B, 8]."""
+    B = msg.shape[0]
+    pos = jnp.arange(msg.shape[1], dtype=jnp.int32)[None, :]
+    msg = (msg + jnp.where(pos == lens[:, None], jnp.uint8(0x80),
+                           jnp.uint8(0))).astype(jnp.uint8)
+    words = _be_words(msg)
+    n_blocks = (lens + 9 + 63) // 64
+    widx = n_blocks * 16 - 1
+    warange = jnp.arange(words.shape[1], dtype=jnp.int32)[None, :]
+    words = jnp.where(warange == widx[:, None],
+                      (lens[:, None].astype(jnp.uint32) * 8), words)
+    state = _init_state(B)
+    for k in range(n_blocks_max):
+        new = sha256_compress(state, words[:, k * 16:(k + 1) * 16])
+        state = jnp.where((k < n_blocks)[:, None], new, state)
+    return state
+
+
+def _digest_bytes(state: jnp.ndarray) -> jnp.ndarray:
+    shifts = jnp.asarray(np.array([24, 16, 8, 0], np.uint32))
+    b = (state[:, :, None] >> shifts[None, None, :]) & jnp.uint32(0xFF)
+    return b.reshape(state.shape[0], 32).astype(jnp.uint8)
+
+
+def _pad_to(x: jnp.ndarray, width: int) -> jnp.ndarray:
+    B, w = x.shape
+    return jnp.zeros((B, width), jnp.uint8).at[:, :w].set(x)
+
+
+def _gat(src_pad, idx):
+    return jnp.take_along_axis(src_pad,
+                               jnp.clip(idx, 0, src_pad.shape[1] - 1),
+                               axis=1)
+
+
+def sha256crypt_digest_batch(cand: jnp.ndarray, lens: jnp.ndarray,
+                             salt: jnp.ndarray, salt_len,
+                             rounds) -> jnp.ndarray:
+    B = cand.shape[0]
+    L = lens[:, None]
+    S = jnp.broadcast_to(salt_len, (B,))[:, None].astype(jnp.int32)
+    Ls, Ss = lens, S[:, 0]
+
+    W1 = 64
+    pos1 = jnp.arange(W1, dtype=jnp.int32)[None, :]
+    pw1 = _pad_to(cand, W1)
+    salt1 = jnp.broadcast_to(
+        jnp.pad(salt, (0, W1 - salt.shape[0]))[None, :],
+        (B, W1)).astype(jnp.uint8)
+
+    # -- B_alt = sha256(pw + salt + pw): 46 bytes max, one block --------
+    msg = jnp.where(pos1 < L, _gat(pw1, pos1), 0)
+    msg = jnp.where((pos1 >= L) & (pos1 < L + S),
+                    _gat(salt1, pos1 - L), msg)
+    msg = jnp.where((pos1 >= L + S) & (pos1 < 2 * L + S),
+                    _gat(pw1, pos1 - L - S), msg).astype(jnp.uint8)
+    Bb = _digest_bytes(_sha256_multiblock(msg, 2 * Ls + Ss, 1))
+
+    # -- A context ------------------------------------------------------
+    WA = A_CTX_BLOCKS * 64
+    posA = jnp.arange(WA, dtype=jnp.int32)[None, :]
+    pwA = _pad_to(cand, WA)
+    saltA = jnp.broadcast_to(
+        _pad_to(salt[None, :].astype(jnp.uint8), WA), (B, WA))
+    BbA = _pad_to(Bb, WA)
+    msg = jnp.where(posA < L, _gat(pwA, posA), 0)
+    msg = jnp.where((posA >= L) & (posA < L + S),
+                    _gat(saltA, posA - L), msg)
+    o = L + S
+    msg = jnp.where((posA >= o) & (posA < o + L), _gat(BbA, posA - o),
+                    msg)
+    off = o + L
+    for j in range(4):
+        seg_present = (Ls >> j) > 0
+        bit = ((Ls >> j) & 1) == 1
+        seg_len = jnp.where(seg_present,
+                            jnp.where(bit, 32, Ls), 0)[:, None]
+        src = jnp.where(bit[:, None], _gat(BbA, posA - off),
+                        _gat(pwA, posA - off))
+        msg = jnp.where((posA >= off) & (posA < off + seg_len), src, msg)
+        off = off + seg_len
+    A = _sha256_multiblock(msg.astype(jnp.uint8), off[:, 0],
+                           A_CTX_BLOCKS)
+
+    # -- P sequence -----------------------------------------------------
+    WP = DP_BLOCKS * 64
+    posP = jnp.arange(WP, dtype=jnp.int32)[None, :]
+    Lsafe = jnp.maximum(Ls, 1)[:, None]
+    rep = _gat(_pad_to(cand, WP), posP % Lsafe)
+    msg = jnp.where(posP < L * L, rep, 0).astype(jnp.uint8)
+    Pb = _digest_bytes(_sha256_multiblock(msg, Ls * Ls, DP_BLOCKS))
+
+    # -- S sequence (on-the-fly repeated salt) --------------------------
+    A0 = (A[:, 0] >> jnp.uint32(24)).astype(jnp.int32)
+    ds_len = (16 + A0) * Ss
+    n_blocks = (ds_len + 9 + 63) // 64
+    Ssafe = jnp.maximum(Ss, 1)[:, None]
+
+    def ds_block(k, state):
+        gpos = k * 64 + pos1
+        blk = _gat(salt1, gpos % Ssafe)
+        blk = jnp.where(gpos < ds_len[:, None], blk, 0)
+        blk = (blk + jnp.where(gpos == ds_len[:, None], jnp.uint8(0x80),
+                               jnp.uint8(0))).astype(jnp.uint8)
+        words = _be_words(blk)
+        is_last = (n_blocks - 1) == k
+        words = words.at[:, 15].set(
+            jnp.where(is_last, ds_len.astype(jnp.uint32) * 8,
+                      words[:, 15]))
+        new = sha256_compress(state, words)
+        return jnp.where((k < n_blocks)[:, None], new, state)
+
+    Sb = _digest_bytes(lax.fori_loop(0, DS_BLOCKS, ds_block,
+                                     _init_state(B)))
+
+    # -- rounds (two-block messages) ------------------------------------
+    WR = ROUND_BLOCKS * 64
+    posR = jnp.arange(WR, dtype=jnp.int32)[None, :]
+    pwR = _pad_to(cand, WR)
+    P_R = _pad_to(Pb, WR)
+    S_R = _pad_to(Sb, WR)
+    del pwR
+
+    def body(i, prev):
+        odd = (i & 1) == 1
+        s3 = (i % 3) != 0
+        s7 = (i % 7) != 0
+        d = _pad_to(_digest_bytes(prev), WR)
+        l1 = jnp.where(odd, L, 32)
+        l4 = jnp.where(odd, 32, L)
+        c1 = l1
+        c2 = c1 + jnp.where(s3, S, 0)
+        c3 = c2 + jnp.where(s7, L, 0)
+        total = (c3 + l4)[:, 0]
+        src1 = jnp.where(odd, _gat(P_R, posR), _gat(d, posR))
+        src4 = jnp.where(odd, _gat(d, posR - c3), _gat(P_R, posR - c3))
+        msg = jnp.where(posR < c1, src1, 0)
+        msg = jnp.where((posR >= c1) & (posR < c2),
+                        _gat(S_R, posR - c1), msg)
+        msg = jnp.where((posR >= c2) & (posR < c3),
+                        _gat(P_R, posR - c2), msg)
+        msg = jnp.where((posR >= c3) & (posR < total[:, None]), src4,
+                        msg).astype(jnp.uint8)
+        return _sha256_multiblock(msg, total, ROUND_BLOCKS)
+
+    return lax.fori_loop(0, rounds, body, A)
+
+
+def make_sha256crypt_mask_step(gen, batch: int, hit_capacity: int = 64):
+    flat = gen.flat_charsets
+    length = gen.length
+
+    @jax.jit
+    def step(base_digits, n_valid, salt, salt_len, rounds, target):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        lens = jnp.full((batch,), length, jnp.int32)
+        digest = sha256crypt_digest_batch(cand, lens, salt, salt_len,
+                                          rounds)
+        found = cmp_ops.compare_single(digest, target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def make_sha256crypt_wordlist_step(gen, word_batch: int,
+                                   hit_capacity: int = 64):
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, Lw = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+
+    @jax.jit
+    def step(w0, n_valid_words, salt, salt_len, rounds, target):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, Lw))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, Lw)
+        digest = sha256crypt_digest_batch(cw, cl, salt, salt_len, rounds)
+        found = cmp_ops.compare_single(digest, target) & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
+                                    hit_capacity)
+
+    return step
+
+
+class Sha256cryptMaskWorker(Sha512cryptMaskWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 12,
+                 hit_capacity: int = 64, oracle=None):
+        self.engine, self.gen = engine, gen
+        self.targets = list(targets)
+        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self.batch = self.stride = batch
+        self._targs = _targs(self.targets)
+        self.step = make_sha256crypt_mask_step(gen, batch, hit_capacity)
+
+
+class Sha256cryptWordlistWorker(Sha512cryptWordlistWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 12,
+                 hit_capacity: int = 64, oracle=None):
+        self.engine, self.gen = engine, gen
+        self.targets = list(targets)
+        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self.batch = batch
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self._targs = _targs(self.targets)
+        self.step = make_sha256crypt_wordlist_step(gen, self.word_batch,
+                                                   hit_capacity)
+
+
+@register("sha256crypt", device="jax")
+class JaxSha256cryptEngine(Sha256cryptEngine):
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return Sha256cryptMaskWorker(self, gen, targets,
+                                     batch=min(batch, 1 << 12),
+                                     hit_capacity=hit_capacity,
+                                     oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return Sha256cryptWordlistWorker(self, gen, targets,
+                                         batch=min(batch, 1 << 12),
+                                         hit_capacity=hit_capacity,
+                                         oracle=oracle)

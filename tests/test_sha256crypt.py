@@ -1,0 +1,80 @@
+"""sha256crypt ($5$): reference vs system crypt, device vs reference
+(two-block round messages), worker end-to-end, CLI."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.engines.cpu.sha256crypt import (parse_sha256crypt,
+                                              sha256crypt_hash,
+                                              sha256crypt_raw)
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+def test_against_system_crypt_if_available():
+    try:
+        import crypt
+    except ImportError:
+        pytest.skip("no crypt module")
+    for pw, salt, rounds in ((b"password", b"saltstring", 5000),
+                             (b"", b"zz", 5000),
+                             (b"hello", b"salt", 1000)):
+        spec = "$5$" + (f"rounds={rounds}$" if rounds != 5000 else "") \
+            + salt.decode() + "$"
+        want = crypt.crypt(pw.decode(), spec)
+        if want is None:
+            pytest.skip("system crypt lacks sha256crypt")
+        assert sha256crypt_hash(pw, salt, rounds) == want
+
+
+def test_device_digest_matches_reference():
+    import random
+    from dprf_tpu.engines.device.sha256crypt import \
+        sha256crypt_digest_batch
+
+    rng = random.Random(74)
+    cands = [b"", b"abcdefghijklmno"] + [
+        bytes(rng.randrange(1, 256) for _ in range(rng.randrange(0, 16)))
+        for _ in range(5)]
+    salt = b"mZ"
+    maxlen = max((len(c) for c in cands), default=1) or 1
+    buf = np.zeros((len(cands), maxlen), np.uint8)
+    lens = np.zeros((len(cands),), np.int32)
+    for i, c in enumerate(cands):
+        buf[i, :len(c)] = np.frombuffer(c, np.uint8)
+        lens[i] = len(c)
+    sbuf = np.zeros((16,), np.uint8)
+    sbuf[:len(salt)] = np.frombuffer(salt, np.uint8)
+    dw = sha256crypt_digest_batch(jnp.asarray(buf), jnp.asarray(lens),
+                                  jnp.asarray(sbuf),
+                                  jnp.int32(len(salt)), jnp.int32(1000))
+    got = [np.asarray(dw)[i].astype(">u4").tobytes()
+           for i in range(len(cands))]
+    assert got == [sha256crypt_raw(c, salt, 1000) for c in cands]
+
+
+def test_mask_worker_end_to_end():
+    dev = get_engine("sha256crypt", "jax")
+    cpu = get_engine("sha256crypt", "cpu")
+    gen = MaskGenerator("?l?d")
+    secret = b"r3"
+    t = dev.parse_target(sha256crypt_hash(secret, b"NaCl", 1000))
+    w = dev.make_mask_worker(gen, [t], batch=512, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
+
+
+def test_cli_sha256crypt_crack(tmp_path, capsys):
+    from dprf_tpu.cli import main
+
+    line = sha256crypt_hash(b"w9", b"grain", 1000)
+    hf = tmp_path / "h.txt"
+    hf.write_text(line + "\n")
+    rc = main(["crack", "?l?d", str(hf), "--engine", "sha256crypt",
+               "--device", "tpu", "--no-potfile", "--batch", "512",
+               "-q"])
+    out = capsys.readouterr().out
+    assert rc == 0 and f"{line}:w9" in out
