@@ -321,6 +321,65 @@ class Sha256cryptEngine(HashEngine):
                 for c in candidates]
 
 
+#: pbkdf2 salt + INT(4) + 0x80 + length must fit the U1 block
+PBKDF2_SALT_MAX = 51
+
+
+def parse_pbkdf2_sha256(text: str):
+    """-> (iterations, salt bytes, dk bytes).  Accepts Django's
+    'pbkdf2_sha256$iter$salt$b64' and hashcat 10900's
+    'sha256:iter:b64salt:b64dk'."""
+    import base64
+    t = text.strip()
+    if t.startswith("pbkdf2_sha256$"):
+        parts = t.split("$")
+        if len(parts) != 4:
+            raise ValueError(f"malformed Django pbkdf2 line: {text!r}")
+        iters = int(parts[1])
+        salt = parts[2].encode("latin-1")
+        dk = base64.b64decode(parts[3])
+    elif t.startswith("sha256:"):
+        parts = t.split(":")
+        if len(parts) != 4:
+            raise ValueError(f"malformed pbkdf2 line: {text!r}")
+        iters = int(parts[1])
+        salt = base64.b64decode(parts[2])
+        dk = base64.b64decode(parts[3])
+    else:
+        raise ValueError(f"not a pbkdf2-sha256 line: {text!r}")
+    if not 1 <= iters <= (1 << 31) - 1:
+        raise ValueError(f"iterations out of range in {text!r}")
+    if len(salt) > PBKDF2_SALT_MAX:
+        raise ValueError(f"salt longer than {PBKDF2_SALT_MAX} bytes: "
+                         f"{text!r}")
+    if len(dk) != 32:
+        raise ValueError(f"expected a 32-byte derived key: {text!r}")
+    return iters, salt, dk
+
+
+@register("pbkdf2-sha256")
+class Pbkdf2Sha256Engine(HashEngine):
+    """PBKDF2-HMAC-SHA256 (Django default hasher; hashcat 10900)."""
+
+    name = "pbkdf2-sha256"
+    digest_size = 32
+    salted = True
+    max_candidate_len = 64    # single-block HMAC key
+
+    def parse_target(self, text: str) -> Target:
+        iters, salt, dk = parse_pbkdf2_sha256(text)
+        return Target(raw=text.strip(), digest=dk,
+                      params={"salt": salt, "iterations": iters})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError("pbkdf2-sha256 needs target params")
+        return [hashlib.pbkdf2_hmac("sha256", c, params["salt"],
+                                    params["iterations"], 32)
+                for c in candidates]
+
+
 @register("phpass")
 class PhpassEngine(HashEngine):
     """phpass portable hashes ($P$/$H$, WordPress/phpBB; hashcat 400):

@@ -1,0 +1,256 @@
+"""PBKDF2-HMAC-SHA256 engine (Django's default hasher; hashcat 10900).
+
+Accepted target lines:
+  ``pbkdf2_sha256$<iterations>$<salt>$<base64 dk>``   (Django)
+  ``sha256:<iterations>:<b64 salt>:<b64 dk>``         (hashcat 10900)
+
+Unlike PMKID (one essid shared by a job), PBKDF2 dumps give every row
+its own salt -- so the salt is a RUNTIME argument here (the U1 message
+block is assembled on device from salt bytes + INT(1)), and one
+compiled step serves every target and iteration count.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dprf_tpu.engines import register
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops import pack as pack_ops
+from dprf_tpu.ops.hmac_sha256 import (hmac256_key_states,
+                                      pbkdf2_sha256_block)
+from dprf_tpu.ops.sha256 import sha256_compress
+from dprf_tpu.runtime.worker import Hit, CpuWorker
+from dprf_tpu.runtime.workunit import WorkUnit
+
+from dprf_tpu.engines.cpu.engines import (PBKDF2_SALT_MAX as SALT_MAX,
+                                           Pbkdf2Sha256Engine)
+
+
+def _u1_block(salt: jnp.ndarray, salt_len) -> jnp.ndarray:
+    """Runtime U1 message block: salt || INT32BE(1), padded as the
+    second block of the inner hash.  salt uint8[SALT_MAX] -> uint32[16].
+    """
+    buf = jnp.zeros((64,), jnp.uint8).at[:SALT_MAX].set(salt)
+    pos = jnp.arange(64, dtype=jnp.int32)
+    msg_len = salt_len + 4
+    # INT32BE(1) = 0,0,0,1 directly after the salt
+    buf = jnp.where(pos < salt_len, buf, 0)
+    buf = buf + jnp.where(pos == salt_len + 3, jnp.uint8(1),
+                          jnp.uint8(0))
+    buf = (buf + jnp.where(pos == msg_len, jnp.uint8(0x80),
+                           jnp.uint8(0))).astype(jnp.uint8)
+    coef = jnp.asarray(np.array([1 << 24, 1 << 16, 1 << 8, 1],
+                                dtype=np.uint32))
+    words = (buf.reshape(16, 4).astype(jnp.uint32) * coef).sum(
+        axis=-1, dtype=jnp.uint32)
+    return words.at[15].set(((64 + msg_len) * 8).astype(jnp.uint32))
+
+
+def pbkdf2_sha256_runtime_salt(key_words: jnp.ndarray,
+                               salt: jnp.ndarray, salt_len,
+                               iterations) -> jnp.ndarray:
+    """PBKDF2-HMAC-SHA256, 32-byte dk, with the salt as a runtime
+    argument: uint32[B, 8]."""
+    from jax import lax
+
+    from dprf_tpu.ops.hmac_sha256 import _block32, hmac_sha256_32
+
+    istate, ostate = hmac256_key_states(key_words)
+    first = jnp.broadcast_to(_u1_block(salt, salt_len)[None, :],
+                             istate.shape[:-1] + (16,))
+    inner = sha256_compress(istate, first)
+    u = sha256_compress(ostate, _block32(inner))
+
+    def body(_, carry):
+        u, t = carry
+        u = hmac_sha256_32(istate, ostate, u)
+        return u, t ^ u
+
+    _, t = lax.fori_loop(1, iterations, body, (u, u))
+    return t
+
+
+def make_pbkdf2_mask_step(gen, batch: int, hit_capacity: int = 64):
+    """step(base_digits, n_valid, salt uint8[SALT_MAX], salt_len,
+    iterations, target uint32[8]) -> (count, lanes, _)."""
+    flat = gen.flat_charsets
+    length = gen.length
+
+    @jax.jit
+    def step(base_digits, n_valid, salt, salt_len, iterations, target):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        key = pack_ops.pack_raw(cand, length, big_endian=True)
+        dk = pbkdf2_sha256_runtime_salt(key, salt, salt_len, iterations)
+        found = cmp_ops.compare_single(dk, target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def make_pbkdf2_wordlist_step(gen, word_batch: int,
+                              hit_capacity: int = 64):
+    from jax import lax
+
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, Lw = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+
+    @jax.jit
+    def step(w0, n_valid_words, salt, salt_len, iterations, target):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, Lw))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, Lw)
+        # HMAC key block: raw zero padding (NO MD marker/bit length),
+        # masked per lane to the rule-expanded length
+        pos = jnp.arange(64, dtype=jnp.int32)[None, :]
+        raw = jnp.where(pos < cl[:, None],
+                        jnp.zeros((cw.shape[0], 64),
+                                  jnp.uint8).at[:, :Lw].set(cw), 0)
+        coef = jnp.asarray(np.array([1 << 24, 1 << 16, 1 << 8, 1],
+                                    dtype=np.uint32))
+        key = (raw.reshape(cw.shape[0], 16, 4).astype(jnp.uint32)
+               * coef).sum(axis=-1, dtype=jnp.uint32)
+        dk = pbkdf2_sha256_runtime_salt(key, salt, salt_len, iterations)
+        found = cmp_ops.compare_single(dk, target) & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
+                                    hit_capacity)
+
+    return step
+
+
+def _targs(targets):
+    out = []
+    for t in targets:
+        s = t.params["salt"]
+        buf = np.zeros((SALT_MAX,), np.uint8)
+        buf[:len(s)] = np.frombuffer(s, np.uint8)
+        out.append((jnp.asarray(buf), jnp.int32(len(s)),
+                    jnp.int32(t.params["iterations"]),
+                    jnp.asarray(np.frombuffer(t.digest, dtype=">u4")
+                                .astype(np.uint32))))
+    return out
+
+
+class Pbkdf2MaskWorker:
+    def __init__(self, engine, gen, targets, batch: int = 1 << 13,
+                 hit_capacity: int = 64, oracle=None):
+        self.engine, self.gen = engine, gen
+        self.targets = list(targets)
+        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self.batch = self.stride = batch
+        self._targs = _targs(self.targets)
+        self.step = make_pbkdf2_mask_step(gen, batch, hit_capacity)
+
+    def _rescan(self, start, end, ti):
+        if self.oracle is None:
+            raise RuntimeError("hit buffer overflow and no oracle")
+        hits = CpuWorker(self.oracle, self.gen,
+                         [self.targets[ti]]).process(
+            WorkUnit(-1, start, end - start))
+        return [Hit(ti, h.cand_index, h.plaintext) for h in hits]
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        hits: list[Hit] = []
+        for ti in range(len(self.targets)):
+            salt, salt_len, iters, tgt = self._targs[ti]
+            queued = []
+            for bstart in range(unit.start, unit.end, self.stride):
+                n_valid = min(self.stride, unit.end - bstart)
+                base = jnp.asarray(self.gen.digits(bstart),
+                                   dtype=jnp.int32)
+                queued.append((bstart, self.step(
+                    base, jnp.int32(n_valid), salt, salt_len, iters,
+                    tgt)))
+            for bstart, (cnt, lanes, _) in queued:
+                cnt = int(cnt)
+                if cnt == 0:
+                    continue
+                if cnt > self.hit_capacity:
+                    hits.extend(self._rescan(
+                        bstart, min(bstart + self.stride, unit.end), ti))
+                    continue
+                for lane in np.asarray(lanes):
+                    if lane < 0:
+                        continue
+                    gidx = bstart + int(lane)
+                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+
+class Pbkdf2WordlistWorker(Pbkdf2MaskWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 13,
+                 hit_capacity: int = 64, oracle=None):
+        self.engine, self.gen = engine, gen
+        self.targets = list(targets)
+        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self.batch = batch
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self._targs = _targs(self.targets)
+        self.step = make_pbkdf2_wordlist_step(gen, self.word_batch,
+                                              hit_capacity)
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        from dprf_tpu.runtime.worker import (word_cover_range,
+                                             wordlist_lane_to_gidx)
+        R = self.gen.n_rules
+        w_start, w_end = word_cover_range(unit, R)
+        hits: list[Hit] = []
+        for ti in range(len(self.targets)):
+            salt, salt_len, iters, tgt = self._targs[ti]
+            queued = []
+            for ws in range(w_start, w_end, self.word_batch):
+                nw = min(self.word_batch, w_end - ws,
+                         self.gen.n_words - ws)
+                if nw <= 0:
+                    break
+                queued.append((ws, nw, self.step(
+                    jnp.int32(ws), jnp.int32(nw), salt, salt_len,
+                    iters, tgt)))
+            for ws, nw, (cnt, lanes, _) in queued:
+                cnt = int(cnt)
+                if cnt == 0:
+                    continue
+                if cnt > self.hit_capacity:
+                    start = max(unit.start, ws * R)
+                    end = min(unit.end, (ws + nw) * R)
+                    hits.extend(self._rescan(start, end, ti))
+                    continue
+                for lane in np.asarray(lanes):
+                    if lane < 0:
+                        continue
+                    gidx = wordlist_lane_to_gidx(int(lane), ws,
+                                                 self.word_batch, R)
+                    if not unit.start <= gidx < unit.end:
+                        continue
+                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+
+@register("pbkdf2-sha256", device="jax")
+class JaxPbkdf2Sha256Engine(Pbkdf2Sha256Engine):
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return Pbkdf2MaskWorker(self, gen, targets,
+                                batch=min(batch, 1 << 13),
+                                hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return Pbkdf2WordlistWorker(self, gen, targets,
+                                    batch=min(batch, 1 << 13),
+                                    hit_capacity=hit_capacity,
+                                    oracle=oracle)

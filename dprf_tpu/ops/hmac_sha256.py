@@ -1,0 +1,90 @@
+"""HMAC-SHA256 and PBKDF2-HMAC-SHA256 as jit-traceable device ops
+(Django's default password hasher; hashcat 10900).
+
+Same structure as ops/hmac_sha1.py: keys fit one block so the pad is a
+single xor, keyed inner/outer states are computed once per candidate,
+and every iteration after the first is exactly two sha256_compress
+calls over a constant-padded 32-byte message under `lax.fori_loop`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from dprf_tpu.ops.sha256 import INIT as SHA256_INIT, sha256_compress
+
+_IPAD = np.uint32(0x36363636)
+_OPAD = np.uint32(0x5C5C5C5C)
+
+
+def hmac256_key_states(key_words: jnp.ndarray):
+    """key_words uint32[B, 16] (zero-padded one-block key) ->
+    (istate, ostate) uint32[B, 8] each."""
+    init = jnp.broadcast_to(jnp.asarray(SHA256_INIT),
+                            key_words.shape[:-1] + (8,))
+    istate = sha256_compress(init, key_words ^ _IPAD)
+    ostate = sha256_compress(init, key_words ^ _OPAD)
+    return istate, ostate
+
+
+def _block32(words8: jnp.ndarray) -> jnp.ndarray:
+    """Pad a 32-byte (8-word) message into the block following a
+    64-byte prefix: 0x80 marker, bit length (64+32)*8."""
+    batch = words8.shape[:-1]
+    block = jnp.zeros(batch + (16,), dtype=jnp.uint32)
+    block = block.at[..., :8].set(words8)
+    block = block.at[..., 8].set(jnp.uint32(0x80000000))
+    block = block.at[..., 15].set(jnp.uint32((64 + 32) * 8))
+    return block
+
+
+def hmac_sha256_32(istate: jnp.ndarray, ostate: jnp.ndarray,
+                   msg8: jnp.ndarray) -> jnp.ndarray:
+    """HMAC-SHA256 of a 32-byte message: two compressions."""
+    inner = sha256_compress(istate, _block32(msg8))
+    return sha256_compress(ostate, _block32(inner))
+
+
+def salt_block256(salt: bytes, block_index: int) -> np.ndarray:
+    """Host-built U1 message block: salt || INT32BE(i), padded as the
+    second block of the inner hash."""
+    msg = salt + int(block_index).to_bytes(4, "big")
+    if len(msg) > 55:
+        raise ValueError(f"salt too long for one block: {len(salt)} bytes")
+    buf = np.zeros(64, dtype=np.uint8)
+    buf[:len(msg)] = np.frombuffer(msg, dtype=np.uint8)
+    buf[len(msg)] = 0x80
+    bitlen = (64 + len(msg)) * 8
+    buf[56:] = np.frombuffer(bitlen.to_bytes(8, "big"), dtype=np.uint8)
+    return buf.reshape(16, 4).astype(np.uint32) @ \
+        np.array([1 << 24, 1 << 16, 1 << 8, 1], dtype=np.uint32)
+
+
+def pbkdf2_sha256_block(istate: jnp.ndarray, ostate: jnp.ndarray,
+                        salt: bytes, block_index: int,
+                        iterations) -> jnp.ndarray:
+    """One PBKDF2 output block T_i: uint32[B, 8].  `iterations` may be
+    a traced scalar (runtime argument)."""
+    first = jnp.broadcast_to(
+        jnp.asarray(salt_block256(salt, block_index)),
+        istate.shape[:-1] + (16,))
+    inner = sha256_compress(istate, first)
+    u = sha256_compress(ostate, _block32(inner))
+
+    def body(_, carry):
+        u, t = carry
+        u = hmac_sha256_32(istate, ostate, u)
+        return u, t ^ u
+
+    _, t = lax.fori_loop(1, iterations, body, (u, u))
+    return t
+
+
+def pbkdf2_sha256(key_words: jnp.ndarray, salt: bytes,
+                  iterations) -> jnp.ndarray:
+    """PBKDF2-HMAC-SHA256 with 32-byte output (Django's dklen):
+    uint32[B, 8] = T1."""
+    istate, ostate = hmac256_key_states(key_words)
+    return pbkdf2_sha256_block(istate, ostate, salt, 1, iterations)
