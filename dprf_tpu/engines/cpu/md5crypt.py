@@ -29,7 +29,9 @@ def md5crypt_raw(password: bytes, salt: bytes) -> bytes:
     """The raw (unpermuted) 16-byte md5crypt digest."""
     alt = hashlib.md5(password + salt + password).digest()
     ctx = password + b"$1$" + salt
-    ctx += alt[:len(password)]
+    # alt CYCLES for passwords longer than one digest (glibc appends it
+    # per 16-byte block of the password length)
+    ctx += (alt * (len(password) // 16 + 1))[:len(password)]
     i = len(password)
     while i > 0:
         ctx += b"\0" if i & 1 else password[:1]

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import hashlib
 
-from dprf_tpu.engines.cpu.phpass import ITOA64, decode64, encode64
+from dprf_tpu.engines.cpu.phpass import decode64, encode64
 
 MAX_SALT_LEN = 16
 DEFAULT_ROUNDS = 5000

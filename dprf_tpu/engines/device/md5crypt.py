@@ -30,9 +30,7 @@ from dprf_tpu.ops import compare as cmp_ops
 from dprf_tpu.ops.md5 import md5_digest_words
 from dprf_tpu.engines.device.phpass import (_le_words, PhpassMaskWorker,
                                             PhpassWordlistWorker,
-                                            ShardedPhpassMaskWorker,
-                                            make_sharded_phpass_mask_step)
-from dprf_tpu.runtime.worker import Hit
+                                            ShardedPhpassMaskWorker)
 
 #: device-path password cap (16 + 2L + 8 <= 55)
 MAX_PASS_LEN = 15
@@ -139,6 +137,10 @@ def make_md5crypt_mask_step(gen, batch: int, hit_capacity: int = 64):
     target uint32[4]) -> (count, lanes, _)."""
     flat = gen.flat_charsets
     length = gen.length
+    if length > MAX_PASS_LEN:
+        raise ValueError(
+            f"candidates of {length} bytes exceed this engine's "
+            f"{MAX_PASS_LEN}-byte single-block budget")
 
     @jax.jit
     def step(base_digits, n_valid, salt, salt_len, target):
@@ -158,6 +160,10 @@ def make_md5crypt_wordlist_step(gen, word_batch: int,
     from dprf_tpu.ops.rules_pipeline import expand_rules
 
     B, Lw = word_batch, gen.max_len
+    if gen.max_len > MAX_PASS_LEN:
+        raise ValueError(
+            f"wordlist max_len {gen.max_len} exceeds this engine's "
+            f"{MAX_PASS_LEN}-byte single-block budget")
     words_np, lens_np = gen.packed_words(pad_to=B,
                                          min_size=gen.n_words + B - 1)
     words_dev = jnp.asarray(words_np)
@@ -186,6 +192,10 @@ def make_sharded_md5crypt_mask_step(gen, mesh, batch_per_device: int,
 
     flat = gen.flat_charsets
     length = gen.length
+    if length > MAX_PASS_LEN:
+        raise ValueError(
+            f"candidates of {length} bytes exceed this engine's "
+            f"{MAX_PASS_LEN}-byte single-block budget")
     B = batch_per_device
 
     def shard_fn(base_digits, n_valid, salt, salt_len, target):

@@ -12,8 +12,6 @@ compiled step serves every target and iteration count.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
-
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -21,14 +19,13 @@ import jax.numpy as jnp
 from dprf_tpu.engines import register
 from dprf_tpu.ops import compare as cmp_ops
 from dprf_tpu.ops import pack as pack_ops
-from dprf_tpu.ops.hmac_sha256 import (hmac256_key_states,
-                                      pbkdf2_sha256_block)
+from dprf_tpu.ops.hmac_sha256 import hmac256_key_states
 from dprf_tpu.ops.sha256 import sha256_compress
-from dprf_tpu.runtime.worker import Hit, CpuWorker
-from dprf_tpu.runtime.workunit import WorkUnit
 
 from dprf_tpu.engines.cpu.engines import (PBKDF2_SALT_MAX as SALT_MAX,
                                            Pbkdf2Sha256Engine)
+from dprf_tpu.engines.device.phpass import (PhpassMaskWorker,
+                                            PhpassWordlistWorker)
 
 
 def _u1_block(salt: jnp.ndarray, salt_len) -> jnp.ndarray:
@@ -144,7 +141,11 @@ def _targs(targets):
     return out
 
 
-class Pbkdf2MaskWorker:
+# The per-target sweep bodies are the phpass workers' (they splat
+# whatever per-target argument tuple _targs built); only the step
+# factories and target args differ.
+
+class Pbkdf2MaskWorker(PhpassMaskWorker):
     def __init__(self, engine, gen, targets, batch: int = 1 << 13,
                  hit_capacity: int = 64, oracle=None):
         self.engine, self.gen = engine, gen
@@ -154,43 +155,8 @@ class Pbkdf2MaskWorker:
         self._targs = _targs(self.targets)
         self.step = make_pbkdf2_mask_step(gen, batch, hit_capacity)
 
-    def _rescan(self, start, end, ti):
-        if self.oracle is None:
-            raise RuntimeError("hit buffer overflow and no oracle")
-        hits = CpuWorker(self.oracle, self.gen,
-                         [self.targets[ti]]).process(
-            WorkUnit(-1, start, end - start))
-        return [Hit(ti, h.cand_index, h.plaintext) for h in hits]
 
-    def process(self, unit: WorkUnit) -> list[Hit]:
-        hits: list[Hit] = []
-        for ti in range(len(self.targets)):
-            salt, salt_len, iters, tgt = self._targs[ti]
-            queued = []
-            for bstart in range(unit.start, unit.end, self.stride):
-                n_valid = min(self.stride, unit.end - bstart)
-                base = jnp.asarray(self.gen.digits(bstart),
-                                   dtype=jnp.int32)
-                queued.append((bstart, self.step(
-                    base, jnp.int32(n_valid), salt, salt_len, iters,
-                    tgt)))
-            for bstart, (cnt, lanes, _) in queued:
-                cnt = int(cnt)
-                if cnt == 0:
-                    continue
-                if cnt > self.hit_capacity:
-                    hits.extend(self._rescan(
-                        bstart, min(bstart + self.stride, unit.end), ti))
-                    continue
-                for lane in np.asarray(lanes):
-                    if lane < 0:
-                        continue
-                    gidx = bstart + int(lane)
-                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
-        return hits
-
-
-class Pbkdf2WordlistWorker(Pbkdf2MaskWorker):
+class Pbkdf2WordlistWorker(PhpassWordlistWorker):
     def __init__(self, engine, gen, targets, batch: int = 1 << 13,
                  hit_capacity: int = 64, oracle=None):
         self.engine, self.gen = engine, gen
@@ -202,42 +168,6 @@ class Pbkdf2WordlistWorker(Pbkdf2MaskWorker):
         self._targs = _targs(self.targets)
         self.step = make_pbkdf2_wordlist_step(gen, self.word_batch,
                                               hit_capacity)
-
-    def process(self, unit: WorkUnit) -> list[Hit]:
-        from dprf_tpu.runtime.worker import (word_cover_range,
-                                             wordlist_lane_to_gidx)
-        R = self.gen.n_rules
-        w_start, w_end = word_cover_range(unit, R)
-        hits: list[Hit] = []
-        for ti in range(len(self.targets)):
-            salt, salt_len, iters, tgt = self._targs[ti]
-            queued = []
-            for ws in range(w_start, w_end, self.word_batch):
-                nw = min(self.word_batch, w_end - ws,
-                         self.gen.n_words - ws)
-                if nw <= 0:
-                    break
-                queued.append((ws, nw, self.step(
-                    jnp.int32(ws), jnp.int32(nw), salt, salt_len,
-                    iters, tgt)))
-            for ws, nw, (cnt, lanes, _) in queued:
-                cnt = int(cnt)
-                if cnt == 0:
-                    continue
-                if cnt > self.hit_capacity:
-                    start = max(unit.start, ws * R)
-                    end = min(unit.end, (ws + nw) * R)
-                    hits.extend(self._rescan(start, end, ti))
-                    continue
-                for lane in np.asarray(lanes):
-                    if lane < 0:
-                        continue
-                    gidx = wordlist_lane_to_gidx(int(lane), ws,
-                                                 self.word_batch, R)
-                    if not unit.start <= gidx < unit.end:
-                        continue
-                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
-        return hits
 
 
 @register("pbkdf2-sha256", device="jax")

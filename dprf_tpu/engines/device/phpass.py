@@ -25,6 +25,7 @@ from jax import lax
 from dprf_tpu.engines import register
 from dprf_tpu.engines.base import Target
 from dprf_tpu.engines.cpu.engines import PhpassEngine
+from dprf_tpu.engines.cpu.phpass import MAX_PASS_LEN
 from dprf_tpu.ops import compare as cmp_ops
 from dprf_tpu.ops.md5 import md5_digest_words
 from dprf_tpu.runtime.worker import (Hit, CpuWorker, word_cover_range,
@@ -81,6 +82,10 @@ def make_phpass_mask_step(gen, batch: int, hit_capacity: int = 64):
     target uint32[4]) -> (count, lanes, _)."""
     flat = gen.flat_charsets
     length = gen.length
+    if length > MAX_PASS_LEN:
+        raise ValueError(
+            f"candidates of {length} bytes exceed this engine's "
+            f"{MAX_PASS_LEN}-byte single-block budget")
 
     @jax.jit
     def step(base_digits, n_valid, salt, count, target):
@@ -99,6 +104,10 @@ def make_phpass_wordlist_step(gen, word_batch: int, hit_capacity: int = 64):
     from dprf_tpu.ops.rules_pipeline import expand_rules
 
     B, L = word_batch, gen.max_len
+    if gen.max_len > MAX_PASS_LEN:
+        raise ValueError(
+            f"wordlist max_len {gen.max_len} exceeds this engine's "
+            f"{MAX_PASS_LEN}-byte single-block budget")
     words_np, lens_np = gen.packed_words(pad_to=B,
                                          min_size=gen.n_words + B - 1)
     words_dev = jnp.asarray(words_np)
@@ -128,6 +137,10 @@ def make_sharded_phpass_mask_step(gen, mesh, batch_per_device: int,
 
     flat = gen.flat_charsets
     length = gen.length
+    if length > MAX_PASS_LEN:
+        raise ValueError(
+            f"candidates of {length} bytes exceed this engine's "
+            f"{MAX_PASS_LEN}-byte single-block budget")
     B = batch_per_device
 
     def shard_fn(base_digits, n_valid, salt, count, target):
@@ -201,14 +214,14 @@ class PhpassMaskWorker(_PhpassWorkerBase):
     def process(self, unit: WorkUnit) -> list[Hit]:
         hits: list[Hit] = []
         for ti in range(len(self.targets)):
-            salt, count, tgt = self._targs[ti]
+            targ = self._targs[ti]
             queued = []
             for bstart in range(unit.start, unit.end, self.stride):
                 n_valid = min(self.stride, unit.end - bstart)
                 base = jnp.asarray(self.gen.digits(bstart),
                                    dtype=jnp.int32)
                 queued.append((bstart, self.step(
-                    base, jnp.int32(n_valid), salt, count, tgt)))
+                    base, jnp.int32(n_valid), *targ)))
             for bstart, (cnt, lanes, _) in queued:
                 cnt = int(cnt)
                 if cnt == 0:
@@ -239,7 +252,7 @@ class PhpassWordlistWorker(_PhpassWorkerBase):
         w_start, w_end = word_cover_range(unit, R)
         hits: list[Hit] = []
         for ti in range(len(self.targets)):
-            salt, count, tgt = self._targs[ti]
+            targ = self._targs[ti]
             queued = []
             for ws in range(w_start, w_end, self.word_batch):
                 nw = min(self.word_batch, w_end - ws,
@@ -247,7 +260,7 @@ class PhpassWordlistWorker(_PhpassWorkerBase):
                 if nw <= 0:
                     break
                 queued.append((ws, nw, self.step(
-                    jnp.int32(ws), jnp.int32(nw), salt, count, tgt)))
+                    jnp.int32(ws), jnp.int32(nw), *targ)))
             for ws, nw, (cnt, lanes, _) in queued:
                 cnt = int(cnt)
                 if cnt == 0:
@@ -283,14 +296,14 @@ class ShardedPhpassMaskWorker(PhpassMaskWorker):
     def process(self, unit: WorkUnit) -> list[Hit]:
         hits: list[Hit] = []
         for ti in range(len(self.targets)):
-            salt, count, tgt = self._targs[ti]
+            targ = self._targs[ti]
             queued = []
             for bstart in range(unit.start, unit.end, self.stride):
                 n_valid = min(self.stride, unit.end - bstart)
                 base = jnp.asarray(self.gen.digits(bstart),
                                    dtype=jnp.int32)
                 queued.append((bstart, self.step(
-                    base, jnp.int32(n_valid), salt, count, tgt)))
+                    base, jnp.int32(n_valid), *targ)))
             for bstart, (total, counts, lanes, _) in queued:
                 if int(total) == 0:
                     continue

@@ -162,10 +162,8 @@ def sha256crypt_digest_batch(cand: jnp.ndarray, lens: jnp.ndarray,
     # -- rounds (two-block messages) ------------------------------------
     WR = ROUND_BLOCKS * 64
     posR = jnp.arange(WR, dtype=jnp.int32)[None, :]
-    pwR = _pad_to(cand, WR)
     P_R = _pad_to(Pb, WR)
     S_R = _pad_to(Sb, WR)
-    del pwR
 
     def body(i, prev):
         odd = (i & 1) == 1
@@ -195,6 +193,10 @@ def sha256crypt_digest_batch(cand: jnp.ndarray, lens: jnp.ndarray,
 def make_sha256crypt_mask_step(gen, batch: int, hit_capacity: int = 64):
     flat = gen.flat_charsets
     length = gen.length
+    if length > MAX_PASS_LEN:
+        raise ValueError(
+            f"candidates of {length} bytes exceed this engine's "
+            f"{MAX_PASS_LEN}-byte single-block budget")
 
     @jax.jit
     def step(base_digits, n_valid, salt, salt_len, rounds, target):
@@ -215,6 +217,10 @@ def make_sha256crypt_wordlist_step(gen, word_batch: int,
     from dprf_tpu.ops.rules_pipeline import expand_rules
 
     B, Lw = word_batch, gen.max_len
+    if gen.max_len > MAX_PASS_LEN:
+        raise ValueError(
+            f"wordlist max_len {gen.max_len} exceeds this engine's "
+            f"{MAX_PASS_LEN}-byte single-block budget")
     words_np, lens_np = gen.packed_words(pad_to=B,
                                          min_size=gen.n_words + B - 1)
     words_dev = jnp.asarray(words_np)

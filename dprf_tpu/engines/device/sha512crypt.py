@@ -31,6 +31,8 @@ from jax import lax
 
 from dprf_tpu.engines import register
 from dprf_tpu.engines.cpu.engines import Sha512cryptEngine
+from dprf_tpu.engines.device.phpass import (PhpassMaskWorker,
+                                            PhpassWordlistWorker)
 from dprf_tpu.ops import compare as cmp_ops
 from dprf_tpu.ops.sha512 import (INIT512, init_state,
                                  sha512_compress_state)
@@ -184,10 +186,8 @@ def sha512crypt_digest_batch(cand: jnp.ndarray, lens: jnp.ndarray,
     Sb = _digest_bytes(DS)     # S = Sb[:salt_len]
 
     # -- rounds ----------------------------------------------------------
-    pw128 = pw1                      # P bytes == pw-derived, width 128
     P128 = _pad_to(Pb, W1)
     S128 = _pad_to(Sb, W1)
-    del pw128
 
     def body(i, prev):
         odd = (i & 1) == 1
@@ -219,6 +219,10 @@ def make_sha512crypt_mask_step(gen, batch: int, hit_capacity: int = 64):
     target uint32[16]) -> (count, lanes, _)."""
     flat = gen.flat_charsets
     length = gen.length
+    if length > MAX_PASS_LEN:
+        raise ValueError(
+            f"candidates of {length} bytes exceed this engine's "
+            f"{MAX_PASS_LEN}-byte single-block budget")
 
     @jax.jit
     def step(base_digits, n_valid, salt, salt_len, rounds, target):
@@ -239,6 +243,10 @@ def make_sha512crypt_wordlist_step(gen, word_batch: int,
     from dprf_tpu.ops.rules_pipeline import expand_rules
 
     B, Lw = word_batch, gen.max_len
+    if gen.max_len > MAX_PASS_LEN:
+        raise ValueError(
+            f"wordlist max_len {gen.max_len} exceeds this engine's "
+            f"{MAX_PASS_LEN}-byte single-block budget")
     words_np, lens_np = gen.packed_words(pad_to=B,
                                          min_size=gen.n_words + B - 1)
     words_dev = jnp.asarray(words_np)
@@ -272,39 +280,11 @@ def _targs(targets):
     return out
 
 
-class _ShacryptWorkerMixin:
-    """Per-target sweep driving 6-arg steps (salt, salt_len, rounds)."""
+# The per-target sweep bodies are the phpass workers' (they splat the
+# (salt, salt_len, rounds, target) tuple _targs built); only the step
+# factories differ.
 
-    def _sweep_mask(self, unit, step, stride):
-        from dprf_tpu.runtime.worker import Hit
-        hits = []
-        for ti in range(len(self.targets)):
-            salt, salt_len, rounds, tgt = self._targs[ti]
-            queued = []
-            for bstart in range(unit.start, unit.end, stride):
-                n_valid = min(stride, unit.end - bstart)
-                base = jnp.asarray(self.gen.digits(bstart),
-                                   dtype=jnp.int32)
-                queued.append((bstart, step(
-                    base, jnp.int32(n_valid), salt, salt_len, rounds,
-                    tgt)))
-            for bstart, (cnt, lanes, _) in queued:
-                cnt = int(cnt)
-                if cnt == 0:
-                    continue
-                if cnt > self.hit_capacity:
-                    hits.extend(self._rescan(
-                        bstart, min(bstart + stride, unit.end), ti))
-                    continue
-                for lane in np.asarray(lanes):
-                    if lane < 0:
-                        continue
-                    gidx = bstart + int(lane)
-                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
-        return hits
-
-
-class Sha512cryptMaskWorker(_ShacryptWorkerMixin):
+class Sha512cryptMaskWorker(PhpassMaskWorker):
     def __init__(self, engine, gen, targets, batch: int = 1 << 12,
                  hit_capacity: int = 64, oracle=None):
         self.engine, self.gen = engine, gen
@@ -314,25 +294,10 @@ class Sha512cryptMaskWorker(_ShacryptWorkerMixin):
         self._targs = _targs(self.targets)
         self.step = make_sha512crypt_mask_step(gen, batch, hit_capacity)
 
-    def _rescan(self, start, end, ti):
-        from dprf_tpu.runtime.worker import CpuWorker, Hit
-        from dprf_tpu.runtime.workunit import WorkUnit
-        if self.oracle is None:
-            raise RuntimeError("hit buffer overflow and no oracle")
-        hits = CpuWorker(self.oracle, self.gen,
-                         [self.targets[ti]]).process(
-            WorkUnit(-1, start, end - start))
-        return [Hit(ti, h.cand_index, h.plaintext) for h in hits]
 
-    def process(self, unit):
-        return self._sweep_mask(unit, self.step, self.stride)
-
-
-class Sha512cryptWordlistWorker(Sha512cryptMaskWorker):
+class Sha512cryptWordlistWorker(PhpassWordlistWorker):
     def __init__(self, engine, gen, targets, batch: int = 1 << 12,
                  hit_capacity: int = 64, oracle=None):
-        from dprf_tpu.runtime.worker import (word_cover_range,
-                                             wordlist_lane_to_gidx)
         self.engine, self.gen = engine, gen
         self.targets = list(targets)
         self.hit_capacity, self.oracle = hit_capacity, oracle
@@ -342,42 +307,6 @@ class Sha512cryptWordlistWorker(Sha512cryptMaskWorker):
         self._targs = _targs(self.targets)
         self.step = make_sha512crypt_wordlist_step(gen, self.word_batch,
                                                    hit_capacity)
-
-    def process(self, unit):
-        from dprf_tpu.runtime.worker import (Hit, word_cover_range,
-                                             wordlist_lane_to_gidx)
-        R = self.gen.n_rules
-        w_start, w_end = word_cover_range(unit, R)
-        hits = []
-        for ti in range(len(self.targets)):
-            salt, salt_len, rounds, tgt = self._targs[ti]
-            queued = []
-            for ws in range(w_start, w_end, self.word_batch):
-                nw = min(self.word_batch, w_end - ws,
-                         self.gen.n_words - ws)
-                if nw <= 0:
-                    break
-                queued.append((ws, nw, self.step(
-                    jnp.int32(ws), jnp.int32(nw), salt, salt_len,
-                    rounds, tgt)))
-            for ws, nw, (cnt, lanes, _) in queued:
-                cnt = int(cnt)
-                if cnt == 0:
-                    continue
-                if cnt > self.hit_capacity:
-                    start = max(unit.start, ws * R)
-                    end = min(unit.end, (ws + nw) * R)
-                    hits.extend(self._rescan(start, end, ti))
-                    continue
-                for lane in np.asarray(lanes):
-                    if lane < 0:
-                        continue
-                    gidx = wordlist_lane_to_gidx(int(lane), ws,
-                                                 self.word_batch, R)
-                    if not unit.start <= gidx < unit.end:
-                        continue
-                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
-        return hits
 
 
 @register("sha512crypt", device="jax")

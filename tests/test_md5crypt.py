@@ -117,3 +117,43 @@ def test_cli_md5crypt_crack(tmp_path, capsys):
                "-q"])
     out = capsys.readouterr().out
     assert rc == 0 and f"{line}:xy7" in out
+
+
+def test_length_guard_rejects_over_budget_masks():
+    """Masks beyond the single-block budget must fail loudly at worker
+    construction, never silently compute garbage digests."""
+    from dprf_tpu.engines.cpu.md5crypt import md5crypt_hash
+
+    dev = get_engine("md5crypt", "jax")
+    t = dev.parse_target(md5crypt_hash(b"x" * 16, b"salt"))
+    gen = MaskGenerator("?l" * 16)
+    with pytest.raises(ValueError, match="single-block budget"):
+        dev.make_mask_worker(gen, [t], batch=128, hit_capacity=8)
+
+
+def test_cpu_reference_handles_long_passwords():
+    """Passwords > 16 bytes cycle the alt digest (glibc semantics) --
+    regression test for the alt-slicing bug."""
+    import hashlib
+    from dprf_tpu.engines.cpu.md5crypt import md5crypt_raw
+
+    # independent reimplementation of the glibc ctx construction
+    pw, salt = b"a" * 23, b"saltsalt"
+    alt = hashlib.md5(pw + salt + pw).digest()
+    ctx = pw + b"$1$" + salt
+    for i in range(len(pw)):
+        ctx += alt[i % 16:i % 16 + 1]
+    i = len(pw)
+    while i > 0:
+        ctx += b"\0" if i & 1 else pw[:1]
+        i >>= 1
+    inter = hashlib.md5(ctx).digest()
+    for i in range(1000):
+        msg = pw if i & 1 else inter
+        if i % 3:
+            msg += salt
+        if i % 7:
+            msg += pw
+        msg += inter if i & 1 else pw
+        inter = hashlib.md5(msg).digest()
+    assert md5crypt_raw(pw, salt) == inter

@@ -111,3 +111,11 @@ def test_cli_sha512crypt_crack(tmp_path, capsys):
                "-q"])
     out = capsys.readouterr().out
     assert rc == 0 and f"{line}:q7" in out
+
+
+def test_length_guard_rejects_over_budget_masks():
+    dev = get_engine("sha512crypt", "jax")
+    t = dev.parse_target(sha512crypt_hash(b"x" * 16, b"salt", 1000))
+    gen = MaskGenerator("?l" * 16)
+    with pytest.raises(ValueError, match="single-block budget"):
+        dev.make_mask_worker(gen, [t], batch=128, hit_capacity=8)
