@@ -25,7 +25,8 @@ from dprf_tpu.ops.sha256 import sha256_compress
 from dprf_tpu.engines.cpu.engines import (PBKDF2_SALT_MAX as SALT_MAX,
                                            Pbkdf2Sha256Engine)
 from dprf_tpu.engines.device.phpass import (PhpassMaskWorker,
-                                            PhpassWordlistWorker)
+                                            PhpassWordlistWorker,
+                                            ShardedPhpassMaskWorker)
 
 
 def _u1_block(salt: jnp.ndarray, salt_len) -> jnp.ndarray:
@@ -170,6 +171,29 @@ class Pbkdf2WordlistWorker(PhpassWordlistWorker):
                                               hit_capacity)
 
 
+class ShardedPbkdf2MaskWorker(ShardedPhpassMaskWorker):
+    def __init__(self, engine, gen, targets, mesh,
+                 batch_per_device: int = 1 << 12, hit_capacity: int = 64,
+                 oracle=None):
+        from dprf_tpu.parallel.sharded import \
+            make_sharded_pertarget_mask_step
+        self.engine, self.gen = engine, gen
+        self.targets = list(targets)
+        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self.mesh = mesh
+        self.batch = self.stride = mesh.devices.size * batch_per_device
+        self._targs = _targs(self.targets)
+        length = gen.length
+
+        def digest_fn(cand, lens, salt, salt_len, iterations):
+            key = pack_ops.pack_raw(cand, length, big_endian=True)
+            return pbkdf2_sha256_runtime_salt(key, salt, salt_len,
+                                              iterations)
+
+        self.step = make_sharded_pertarget_mask_step(
+            gen, mesh, batch_per_device, digest_fn, 3, hit_capacity)
+
+
 @register("pbkdf2-sha256", device="jax")
 class JaxPbkdf2Sha256Engine(Pbkdf2Sha256Engine):
     def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
@@ -177,6 +201,14 @@ class JaxPbkdf2Sha256Engine(Pbkdf2Sha256Engine):
         return Pbkdf2MaskWorker(self, gen, targets,
                                 batch=min(batch, 1 << 13),
                                 hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_sharded_mask_worker(self, gen, targets, mesh,
+                                 batch_per_device: int, hit_capacity: int,
+                                 oracle=None):
+        return ShardedPbkdf2MaskWorker(
+            self, gen, targets, mesh,
+            batch_per_device=min(batch_per_device, 1 << 12),
+            hit_capacity=hit_capacity, oracle=oracle)
 
     def make_wordlist_worker(self, gen, targets, batch: int,
                              hit_capacity: int, oracle=None):

@@ -17,6 +17,7 @@ from jax import lax
 
 from dprf_tpu.engines import register
 from dprf_tpu.engines.cpu.engines import Sha256cryptEngine
+from dprf_tpu.engines.device.phpass import ShardedPhpassMaskWorker
 from dprf_tpu.engines.device.sha512crypt import (Sha512cryptMaskWorker,
                                                  Sha512cryptWordlistWorker,
                                                  _targs)
@@ -266,6 +267,27 @@ class Sha256cryptWordlistWorker(Sha512cryptWordlistWorker):
                                                    hit_capacity)
 
 
+class ShardedSha256cryptMaskWorker(ShardedPhpassMaskWorker):
+    def __init__(self, engine, gen, targets, mesh,
+                 batch_per_device: int = 1 << 11, hit_capacity: int = 64,
+                 oracle=None):
+        from dprf_tpu.parallel.sharded import \
+            make_sharded_pertarget_mask_step
+        self.engine, self.gen = engine, gen
+        self.targets = list(targets)
+        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self.mesh = mesh
+        self.batch = self.stride = mesh.devices.size * batch_per_device
+        self._targs = _targs(self.targets)
+        if gen.length > MAX_PASS_LEN:
+            raise ValueError(
+                f"candidates of {gen.length} bytes exceed this engine's "
+                f"{MAX_PASS_LEN}-byte single-block budget")
+        self.step = make_sharded_pertarget_mask_step(
+            gen, mesh, batch_per_device, sha256crypt_digest_batch, 3,
+            hit_capacity)
+
+
 @register("sha256crypt", device="jax")
 class JaxSha256cryptEngine(Sha256cryptEngine):
     def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
@@ -281,3 +303,11 @@ class JaxSha256cryptEngine(Sha256cryptEngine):
                                          batch=min(batch, 1 << 12),
                                          hit_capacity=hit_capacity,
                                          oracle=oracle)
+
+    def make_sharded_mask_worker(self, gen, targets, mesh,
+                                 batch_per_device: int, hit_capacity: int,
+                                 oracle=None):
+        return ShardedSha256cryptMaskWorker(
+            self, gen, targets, mesh,
+            batch_per_device=min(batch_per_device, 1 << 11),
+            hit_capacity=hit_capacity, oracle=oracle)

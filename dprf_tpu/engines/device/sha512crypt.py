@@ -32,7 +32,8 @@ from jax import lax
 from dprf_tpu.engines import register
 from dprf_tpu.engines.cpu.engines import Sha512cryptEngine
 from dprf_tpu.engines.device.phpass import (PhpassMaskWorker,
-                                            PhpassWordlistWorker)
+                                            PhpassWordlistWorker,
+                                            ShardedPhpassMaskWorker)
 from dprf_tpu.ops import compare as cmp_ops
 from dprf_tpu.ops.sha512 import (INIT512, init_state,
                                  sha512_compress_state)
@@ -309,6 +310,30 @@ class Sha512cryptWordlistWorker(PhpassWordlistWorker):
                                                    hit_capacity)
 
 
+class ShardedSha512cryptMaskWorker(ShardedPhpassMaskWorker):
+    """Multi-chip variant via the generic per-target sharded step;
+    the sharded phpass worker's result decoding applies unchanged."""
+
+    def __init__(self, engine, gen, targets, mesh,
+                 batch_per_device: int = 1 << 11, hit_capacity: int = 64,
+                 oracle=None):
+        from dprf_tpu.parallel.sharded import \
+            make_sharded_pertarget_mask_step
+        self.engine, self.gen = engine, gen
+        self.targets = list(targets)
+        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self.mesh = mesh
+        self.batch = self.stride = mesh.devices.size * batch_per_device
+        self._targs = _targs(self.targets)
+        if gen.length > MAX_PASS_LEN:
+            raise ValueError(
+                f"candidates of {gen.length} bytes exceed this engine's "
+                f"{MAX_PASS_LEN}-byte single-block budget")
+        self.step = make_sharded_pertarget_mask_step(
+            gen, mesh, batch_per_device, sha512crypt_digest_batch, 3,
+            hit_capacity)
+
+
 @register("sha512crypt", device="jax")
 class JaxSha512cryptEngine(Sha512cryptEngine):
     def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
@@ -324,3 +349,11 @@ class JaxSha512cryptEngine(Sha512cryptEngine):
                                          batch=min(batch, 1 << 12),
                                          hit_capacity=hit_capacity,
                                          oracle=oracle)
+
+    def make_sharded_mask_worker(self, gen, targets, mesh,
+                                 batch_per_device: int, hit_capacity: int,
+                                 oracle=None):
+        return ShardedSha512cryptMaskWorker(
+            self, gen, targets, mesh,
+            batch_per_device=min(batch_per_device, 1 << 11),
+            hit_capacity=hit_capacity, oracle=oracle)

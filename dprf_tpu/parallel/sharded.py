@@ -28,6 +28,56 @@ from dprf_tpu.ops import compare as cmp_ops
 from dprf_tpu.parallel.mesh import SHARD_AXIS
 
 
+def make_sharded_pertarget_mask_step(gen, mesh, batch_per_device: int,
+                                     digest_fn, n_params: int,
+                                     hit_capacity: int = 64):
+    """Generic multi-chip mask step for per-target-sweep engines
+    (phpass/crypt-family/pbkdf2 style): chip c owns lane slice
+    [c*B, (c+1)*B); `digest_fn(cand, lens, *params)` computes the
+    digest words; the LAST step argument is the target word vector.
+
+    step(base_digits, n_valid, *params, target) ->
+        (total, counts[n_dev], lanes[n_dev, cap] super-batch-global, _)
+    with replicated hit buffers (see module docstring).
+    """
+    from dprf_tpu.parallel.mesh import SHARD_AXIS
+
+    flat = gen.flat_charsets
+    length = gen.length
+    B = batch_per_device
+
+    def shard_fn(base_digits, n_valid, *args):
+        *params, target = args
+        dev = lax.axis_index(SHARD_AXIS)
+        offset = (dev * B).astype(jnp.int32)
+        cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
+        lens = jnp.full((B,), length, jnp.int32)
+        digest = digest_fn(cand, lens, *params)
+        lane_global = offset + jnp.arange(B, dtype=jnp.int32)
+        found = cmp_ops.compare_single(digest, target) & \
+            (lane_global < n_valid)
+        cnt, lanes, tpos = cmp_ops.compact_hits(
+            found, jnp.zeros((B,), jnp.int32), hit_capacity)
+        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
+        total = lax.psum(cnt, SHARD_AXIS)
+        return (total[None],
+                lax.all_gather(cnt, SHARD_AXIS),
+                lax.all_gather(lanes, SHARD_AXIS),
+                lax.all_gather(tpos, SHARD_AXIS))
+
+    sharded = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(),) * (3 + n_params),
+        out_specs=(P(), P(), P(), P()), check_vma=False)
+
+    @jax.jit
+    def step(base_digits, n_valid, *args):
+        total, counts, lanes, tpos = sharded(base_digits, n_valid, *args)
+        return total[0], counts, lanes, tpos
+
+    step.super_batch = mesh.devices.size * B
+    return step
+
+
 def make_sharded_mask_crack_step(
         engine, gen: MaskGenerator,
         targets: Union[jnp.ndarray, cmp_ops.TargetTable],

@@ -103,3 +103,20 @@ def test_cli_pbkdf2_crack(tmp_path, capsys):
                "-q"])
     out = capsys.readouterr().out
     assert rc == 0 and f"{line}:x9" in out
+
+
+def test_sharded_pbkdf2_worker():
+    import jax
+    from dprf_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) >= 8
+    dev = get_engine("pbkdf2-sha256", "jax")
+    cpu = get_engine("pbkdf2-sha256", "cpu")
+    gen = MaskGenerator("?l?d")
+    secret = b"p7"
+    t = dev.parse_target(_django_line(secret, b"mesa", 100))
+    w = dev.make_sharded_mask_worker(gen, [t], make_mesh(8),
+                                     batch_per_device=16, hit_capacity=8,
+                                     oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]

@@ -119,3 +119,21 @@ def test_length_guard_rejects_over_budget_masks():
     gen = MaskGenerator("?l" * 16)
     with pytest.raises(ValueError, match="single-block budget"):
         dev.make_mask_worker(gen, [t], batch=128, hit_capacity=8)
+
+
+def test_sharded_sha512crypt_worker():
+    import jax
+    from dprf_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) >= 8
+    dev = get_engine("sha512crypt", "jax")
+    cpu = get_engine("sha512crypt", "cpu")
+    gen = MaskGenerator("?d?l")
+    secret = b"7k"
+    t = dev.parse_target(sha512crypt_hash(secret, b"mesa", 1000))
+    w = dev.make_sharded_mask_worker(gen, [t], make_mesh(8),
+                                     batch_per_device=16, hit_capacity=8,
+                                     oracle=cpu)
+    from dprf_tpu.runtime.workunit import WorkUnit
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
