@@ -204,6 +204,63 @@ class Mysql41Engine(HashEngine):
                 for c in candidates]
 
 
+def _md4_utf16(password: bytes) -> bytes:
+    return md4(password.decode("latin-1").encode("utf-16-le"))
+
+
+def netntlmv2_proof(password: bytes, user: str, domain: str,
+                    challenge: bytes, blob: bytes) -> bytes:
+    """NetNTLMv2 reference: nt = MD4(UTF16LE(pw)); key2 = HMAC-MD5(nt,
+    UTF16LE(upper(user)+domain)); proof = HMAC-MD5(key2, chal+blob)."""
+    nt = _md4_utf16(password)
+    ident = (user.upper() + domain).encode("utf-16-le")
+    key2 = hmac.new(nt, ident, "md5").digest()
+    return hmac.new(key2, challenge + blob, "md5").digest()
+
+
+def parse_netntlmv2(text: str):
+    """'USER::DOMAIN:chal:proof:blob' (hex fields) ->
+    (user, domain, challenge, proof, blob)."""
+    t = text.strip()
+    user, sep, rest = t.partition("::")
+    if not sep:
+        raise ValueError(f"not a NetNTLMv2 line (no '::'): {text!r}")
+    parts = rest.split(":")
+    if len(parts) != 4:
+        raise ValueError(f"malformed NetNTLMv2 line: {text!r}")
+    domain, chal_hex, proof_hex, blob_hex = parts
+    challenge = bytes.fromhex(chal_hex)
+    proof = bytes.fromhex(proof_hex)
+    blob = bytes.fromhex(blob_hex)
+    if len(challenge) != 8 or len(proof) != 16:
+        raise ValueError(f"bad challenge/proof length in {text!r}")
+    return user, domain, challenge, proof, blob
+
+
+@register("netntlmv2")
+class NetNtlmV2Engine(HashEngine):
+    """NetNTLMv2 challenge-response (hashcat 5600)."""
+
+    name = "netntlmv2"
+    digest_size = 16
+    salted = True
+    max_candidate_len = 27     # NTLM single-block UTF-16LE limit
+
+    def parse_target(self, text: str) -> Target:
+        user, domain, challenge, proof, blob = parse_netntlmv2(text)
+        return Target(raw=text.strip(), digest=proof,
+                      params={"user": user, "domain": domain,
+                              "challenge": challenge, "blob": blob})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError("netntlmv2 needs target params")
+        return [netntlmv2_proof(c, params["user"], params["domain"],
+                                params["challenge"], params["blob"])
+                for c in candidates]
+
+
 @register("ntlm")
 class NtlmEngine(HashEngine):
     """NTLM: MD4 over the UTF-16LE encoding of the password."""

@@ -1,0 +1,227 @@
+"""NetNTLMv2 engine (challenge-response; hashcat 5600).
+
+Line format: ``USER::DOMAIN:challenge:NTproofstr:blob`` (hex fields).
+Algorithm: nt = MD4(UTF16LE(pw)); key2 = HMAC-MD5(nt,
+UTF16LE(upper(USER) + DOMAIN)); proof = HMAC-MD5(key2,
+challenge || blob); match proof against NTproofstr.
+
+TPU mapping: both HMAC messages are per-TARGET constants, so they are
+pre-padded into MD5 blocks on the host and shipped as RUNTIME
+arguments (uint32[MAXB, 16] + block count) -- the device just chains
+`md5_compress` over them per candidate under a masked static unroll.
+Only the 16-byte keys vary per candidate, so the HMAC pads are single
+xors.  One compiled step serves every target.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.cpu.engines import (NetNtlmV2Engine,
+                                           parse_netntlmv2)
+from dprf_tpu.engines.device.phpass import (PhpassMaskWorker,
+                                            PhpassWordlistWorker,
+                                            ShardedPhpassMaskWorker)
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops import pack as pack_ops
+from dprf_tpu.ops.md4 import md4_digest_words
+from dprf_tpu.ops.md5 import INIT as MD5_INIT, md5_compress
+
+#: static cap on pre-padded HMAC message blocks (challenge+blob; blobs
+#: carry timestamps/target-info lists and are typically 100-400 bytes)
+MAX_MSG_BLOCKS = 20
+
+_IPAD = np.uint32(0x36363636)
+_OPAD = np.uint32(0x5C5C5C5C)
+
+
+def hmac_msg_blocks(msg: bytes, max_blocks: int) -> tuple:
+    """Pre-pad an HMAC message (which follows the 64-byte key block)
+    into MD5 blocks: (uint32[max_blocks, 16] LE words, n_blocks)."""
+    total = 64 + len(msg)
+    padded = msg + b"\x80"
+    padded += b"\x00" * ((56 - len(padded) % 64) % 64)
+    padded += (total * 8).to_bytes(8, "little")
+    n_blocks = len(padded) // 64
+    if n_blocks > max_blocks:
+        raise ValueError(
+            f"HMAC message needs {n_blocks} blocks, cap {max_blocks} "
+            "(blob too long)")
+    buf = np.zeros((max_blocks, 64), np.uint8)
+    buf[:n_blocks] = np.frombuffer(padded, np.uint8).reshape(n_blocks, 64)
+    words = buf.reshape(max_blocks, 16, 4).astype(np.uint32) @ \
+        np.array([1, 1 << 8, 1 << 16, 1 << 24], dtype=np.uint32)
+    return words, n_blocks
+
+
+def _hmac_md5_const_msg(key4: jnp.ndarray, msg_blocks: jnp.ndarray,
+                        n_blocks) -> jnp.ndarray:
+    """HMAC-MD5 with per-candidate 16-byte keys (uint32[B, 4]) over a
+    constant pre-padded message (uint32[MAXB, 16], n_blocks valid) ->
+    uint32[B, 4]."""
+    B = key4.shape[0]
+    key_block = jnp.zeros((B, 16), jnp.uint32).at[:, :4].set(key4)
+    init = jnp.broadcast_to(jnp.asarray(MD5_INIT), (B, 4))
+    istate = md5_compress(init, key_block ^ _IPAD)
+    ostate = md5_compress(init, key_block ^ _OPAD)
+    state = istate
+    for k in range(msg_blocks.shape[0]):
+        blk = jnp.broadcast_to(msg_blocks[k][None, :], (B, 16))
+        new = md5_compress(state, blk)
+        state = jnp.where(k < n_blocks, new, state)
+    # outer: 16-byte inner digest, padded (64 key + 16 msg)
+    inner_block = jnp.zeros((B, 16), jnp.uint32)
+    inner_block = inner_block.at[:, :4].set(state)
+    inner_block = inner_block.at[:, 4].set(jnp.uint32(0x80))
+    inner_block = inner_block.at[:, 14].set(jnp.uint32((64 + 16) * 8))
+    return md5_compress(ostate, inner_block)
+
+
+def netntlmv2_digest_batch(cand: jnp.ndarray, lens: jnp.ndarray,
+                           ident_blocks, ident_n, msg_blocks,
+                           msg_n) -> jnp.ndarray:
+    """Candidates -> NetNTLMv2 proof words uint32[B, 4]."""
+    wide = pack_ops.utf16le_widen(cand)
+    nt = md4_digest_words(pack_ops.pack_varlen(wide, lens * 2,
+                                               big_endian=False))
+    key2 = _hmac_md5_const_msg(nt, ident_blocks, ident_n)
+    return _hmac_md5_const_msg(key2, msg_blocks, msg_n)
+
+
+def make_netntlmv2_mask_step(gen, batch: int, hit_capacity: int = 64):
+    """step(base_digits, n_valid, ident_blocks, ident_n, msg_blocks,
+    msg_n, target uint32[4]) -> (count, lanes, _)."""
+    flat = gen.flat_charsets
+    length = gen.length
+    if length > 27:
+        raise ValueError("netntlmv2 passwords cap at 27 chars "
+                         "(single-block UTF-16LE NTLM)")
+
+    @jax.jit
+    def step(base_digits, n_valid, ident_blocks, ident_n, msg_blocks,
+             msg_n, target):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        lens = jnp.full((batch,), length, jnp.int32)
+        digest = netntlmv2_digest_batch(cand, lens, ident_blocks,
+                                        ident_n, msg_blocks, msg_n)
+        found = cmp_ops.compare_single(digest, target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def make_netntlmv2_wordlist_step(gen, word_batch: int,
+                                 hit_capacity: int = 64):
+    from jax import lax
+
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, Lw = word_batch, gen.max_len
+    if Lw > 27:
+        raise ValueError("netntlmv2 passwords cap at 27 chars")
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+
+    @jax.jit
+    def step(w0, n_valid_words, ident_blocks, ident_n, msg_blocks,
+             msg_n, target):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, Lw))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, Lw)
+        digest = netntlmv2_digest_batch(cw, cl, ident_blocks, ident_n,
+                                        msg_blocks, msg_n)
+        found = cmp_ops.compare_single(digest, target) & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
+                                    hit_capacity)
+
+    return step
+
+
+def _targs(targets):
+    out = []
+    for t in targets:
+        p = t.params
+        ident = (p["user"].upper() + p["domain"]).encode("utf-16-le")
+        iw, inb = hmac_msg_blocks(ident, 8)
+        mw, mnb = hmac_msg_blocks(p["challenge"] + p["blob"],
+                                  MAX_MSG_BLOCKS)
+        out.append((jnp.asarray(iw), jnp.int32(inb),
+                    jnp.asarray(mw), jnp.int32(mnb),
+                    jnp.asarray(np.frombuffer(t.digest, dtype="<u4")
+                                .astype(np.uint32))))
+    return out
+
+
+class NetNtlmV2MaskWorker(PhpassMaskWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 16,
+                 hit_capacity: int = 64, oracle=None):
+        self.engine, self.gen = engine, gen
+        self.targets = list(targets)
+        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self.batch = self.stride = batch
+        self._targs = _targs(self.targets)
+        self.step = make_netntlmv2_mask_step(gen, batch, hit_capacity)
+
+
+class NetNtlmV2WordlistWorker(PhpassWordlistWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 16,
+                 hit_capacity: int = 64, oracle=None):
+        self.engine, self.gen = engine, gen
+        self.targets = list(targets)
+        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self.batch = batch
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self._targs = _targs(self.targets)
+        self.step = make_netntlmv2_wordlist_step(gen, self.word_batch,
+                                                 hit_capacity)
+
+
+class ShardedNetNtlmV2MaskWorker(ShardedPhpassMaskWorker):
+    def __init__(self, engine, gen, targets, mesh,
+                 batch_per_device: int = 1 << 14, hit_capacity: int = 64,
+                 oracle=None):
+        from dprf_tpu.parallel.sharded import \
+            make_sharded_pertarget_mask_step
+        self.engine, self.gen = engine, gen
+        self.targets = list(targets)
+        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self.mesh = mesh
+        self.batch = self.stride = mesh.devices.size * batch_per_device
+        self._targs = _targs(self.targets)
+        if gen.length > 27:
+            raise ValueError("netntlmv2 passwords cap at 27 chars")
+        self.step = make_sharded_pertarget_mask_step(
+            gen, mesh, batch_per_device, netntlmv2_digest_batch, 4,
+            hit_capacity)
+
+
+@register("netntlmv2", device="jax")
+class JaxNetNtlmV2Engine(NetNtlmV2Engine):
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return NetNtlmV2MaskWorker(self, gen, targets, batch=batch,
+                                   hit_capacity=hit_capacity,
+                                   oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return NetNtlmV2WordlistWorker(self, gen, targets, batch=batch,
+                                       hit_capacity=hit_capacity,
+                                       oracle=oracle)
+
+    def make_sharded_mask_worker(self, gen, targets, mesh,
+                                 batch_per_device: int, hit_capacity: int,
+                                 oracle=None):
+        return ShardedNetNtlmV2MaskWorker(
+            self, gen, targets, mesh, batch_per_device=batch_per_device,
+            hit_capacity=hit_capacity, oracle=oracle)
