@@ -38,21 +38,32 @@ _IPAD = np.uint32(0x36363636)
 _OPAD = np.uint32(0x5C5C5C5C)
 
 
-def hmac_msg_blocks(msg: bytes, max_blocks: int) -> tuple:
-    """Pre-pad an HMAC message (which follows the 64-byte key block)
-    into MD5 blocks: (uint32[max_blocks, 16] LE words, n_blocks)."""
+def _hmac_padded(msg: bytes) -> bytes:
+    """MD5 padding for a message that follows the 64-byte key block."""
     total = 64 + len(msg)
     padded = msg + b"\x80"
     padded += b"\x00" * ((56 - len(padded) % 64) % 64)
-    padded += (total * 8).to_bytes(8, "little")
+    return padded + (total * 8).to_bytes(8, "little")
+
+
+def blocks_needed(msg: bytes) -> int:
+    return len(_hmac_padded(msg)) // 64
+
+
+def hmac_msg_blocks(msg: bytes, width_blocks: int,
+                    what: str = "message") -> tuple:
+    """Pre-pad an HMAC message (which follows the 64-byte key block)
+    into MD5 blocks: (uint32[width_blocks, 16] LE words, n_blocks).
+    `width_blocks` is the JOB-wide static width (max over targets), so
+    the compiled unroll never exceeds the job's real block count."""
+    padded = _hmac_padded(msg)
     n_blocks = len(padded) // 64
-    if n_blocks > max_blocks:
+    if n_blocks > width_blocks:
         raise ValueError(
-            f"HMAC message needs {n_blocks} blocks, cap {max_blocks} "
-            "(blob too long)")
-    buf = np.zeros((max_blocks, 64), np.uint8)
+            f"{what} needs {n_blocks} HMAC blocks, cap {width_blocks}")
+    buf = np.zeros((width_blocks, 64), np.uint8)
     buf[:n_blocks] = np.frombuffer(padded, np.uint8).reshape(n_blocks, 64)
-    words = buf.reshape(max_blocks, 16, 4).astype(np.uint32) @ \
+    words = buf.reshape(width_blocks, 16, 4).astype(np.uint32) @ \
         np.array([1, 1 << 8, 1 << 16, 1 << 24], dtype=np.uint32)
     return words, n_blocks
 
@@ -147,13 +158,23 @@ def make_netntlmv2_wordlist_step(gen, word_batch: int,
 
 
 def _targs(targets):
-    out = []
+    """Per-target step args, with the block-array widths sized to the
+    JOB maximum (not the format cap) so the compiled unroll pays only
+    for blocks some target actually uses."""
+    idents, msgs = [], []
     for t in targets:
         p = t.params
-        ident = (p["user"].upper() + p["domain"]).encode("utf-16-le")
-        iw, inb = hmac_msg_blocks(ident, 8)
-        mw, mnb = hmac_msg_blocks(p["challenge"] + p["blob"],
-                                  MAX_MSG_BLOCKS)
+        idents.append((p["user"].upper() + p["domain"]).encode("utf-16-le"))
+        msgs.append(p["challenge"] + p["blob"])
+    ident_w = max(blocks_needed(i) for i in idents)
+    msg_w = max(blocks_needed(m) for m in msgs)
+    if msg_w > MAX_MSG_BLOCKS:
+        raise ValueError(f"a blob needs {msg_w} HMAC blocks "
+                         f"(cap {MAX_MSG_BLOCKS})")
+    out = []
+    for t, ident, msg in zip(targets, idents, msgs):
+        iw, inb = hmac_msg_blocks(ident, ident_w, what="user+domain")
+        mw, mnb = hmac_msg_blocks(msg, msg_w, what="challenge+blob")
         out.append((jnp.asarray(iw), jnp.int32(inb),
                     jnp.asarray(mw), jnp.int32(mnb),
                     jnp.asarray(np.frombuffer(t.digest, dtype="<u4")
@@ -192,9 +213,7 @@ class ShardedNetNtlmV2MaskWorker(ShardedPhpassMaskWorker):
                  oracle=None):
         from dprf_tpu.parallel.sharded import \
             make_sharded_pertarget_mask_step
-        self.engine, self.gen = engine, gen
-        self.targets = list(targets)
-        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
         self.mesh = mesh
         self.batch = self.stride = mesh.devices.size * batch_per_device
         self._targs = _targs(self.targets)

@@ -130,60 +130,35 @@ def make_phpass_wordlist_step(gen, word_batch: int, hit_capacity: int = 64):
 
 def make_sharded_phpass_mask_step(gen, mesh, batch_per_device: int,
                                   hit_capacity: int = 64):
-    """Multi-chip variant (keyspace DP, replicated hit buffers)."""
-    from jax.sharding import PartitionSpec as P
+    """Multi-chip variant: the generic per-target sharded step driving
+    phpass_digest_batch (salt, count params)."""
+    from dprf_tpu.parallel.sharded import make_sharded_pertarget_mask_step
 
-    from dprf_tpu.parallel.mesh import SHARD_AXIS
-
-    flat = gen.flat_charsets
-    length = gen.length
-    if length > MAX_PASS_LEN:
+    if gen.length > MAX_PASS_LEN:
         raise ValueError(
-            f"candidates of {length} bytes exceed this engine's "
+            f"candidates of {gen.length} bytes exceed this engine's "
             f"{MAX_PASS_LEN}-byte single-block budget")
-    B = batch_per_device
-
-    def shard_fn(base_digits, n_valid, salt, count, target):
-        dev = lax.axis_index(SHARD_AXIS)
-        offset = (dev * B).astype(jnp.int32)
-        cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
-        lens = jnp.full((B,), length, jnp.int32)
-        digest = phpass_digest_batch(cand, lens, salt, count)
-        lane_global = offset + jnp.arange(B, dtype=jnp.int32)
-        found = cmp_ops.compare_single(digest, target) & \
-            (lane_global < n_valid)
-        cnt, lanes, tpos = cmp_ops.compact_hits(
-            found, jnp.zeros((B,), jnp.int32), hit_capacity)
-        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
-        total = lax.psum(cnt, SHARD_AXIS)
-        # replicated hit buffers (see parallel/sharded.py)
-        return (total[None],
-                lax.all_gather(cnt, SHARD_AXIS),
-                lax.all_gather(lanes, SHARD_AXIS),
-                lax.all_gather(tpos, SHARD_AXIS))
-
-    sharded = jax.shard_map(
-        shard_fn, mesh=mesh, in_specs=(P(),) * 5,
-        out_specs=(P(), P(), P(), P()), check_vma=False)
-
-    @jax.jit
-    def step(base_digits, n_valid, salt, count, target):
-        total, counts, lanes, tpos = sharded(base_digits, n_valid, salt,
-                                             count, target)
-        return total[0], counts, lanes, tpos
-
-    step.super_batch = mesh.devices.size * B
-    return step
+    return make_sharded_pertarget_mask_step(
+        gen, mesh, batch_per_device, phpass_digest_batch, 2,
+        hit_capacity)
 
 
-class _PhpassWorkerBase:
-    def __init__(self, engine, gen, targets: Sequence[Target],
-                 batch: int, hit_capacity: int, oracle):
+class PerTargetSweepSetup:
+    """Shared field setup for every per-target-sweep worker (phpass,
+    crypt family, pbkdf2, netntlmv2, ...)."""
+
+    def _setup_sweep(self, engine, gen, targets, hit_capacity, oracle):
         self.engine = engine
         self.gen = gen
         self.targets = list(targets)
         self.hit_capacity = hit_capacity
         self.oracle = oracle
+
+
+class _PhpassWorkerBase(PerTargetSweepSetup):
+    def __init__(self, engine, gen, targets: Sequence[Target],
+                 batch: int, hit_capacity: int, oracle):
+        self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
         self.batch = batch
         self._targs = []
         for t in self.targets:

@@ -319,9 +319,7 @@ class ShardedSha512cryptMaskWorker(ShardedPhpassMaskWorker):
                  oracle=None):
         from dprf_tpu.parallel.sharded import \
             make_sharded_pertarget_mask_step
-        self.engine, self.gen = engine, gen
-        self.targets = list(targets)
-        self.hit_capacity, self.oracle = hit_capacity, oracle
+        self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
         self.mesh = mesh
         self.batch = self.stride = mesh.devices.size * batch_per_device
         self._targs = _targs(self.targets)

@@ -40,8 +40,6 @@ def make_sharded_pertarget_mask_step(gen, mesh, batch_per_device: int,
         (total, counts[n_dev], lanes[n_dev, cap] super-batch-global, _)
     with replicated hit buffers (see module docstring).
     """
-    from dprf_tpu.parallel.mesh import SHARD_AXIS
-
     flat = gen.flat_charsets
     length = gen.length
     B = batch_per_device

@@ -78,3 +78,21 @@ def test_cli_sha256crypt_crack(tmp_path, capsys):
                "-q"])
     out = capsys.readouterr().out
     assert rc == 0 and f"{line}:w9" in out
+
+
+def test_sharded_sha256crypt_worker():
+    import jax
+    from dprf_tpu.parallel.mesh import make_mesh
+    from dprf_tpu.runtime.workunit import WorkUnit
+
+    assert len(jax.devices()) >= 8
+    dev = get_engine("sha256crypt", "jax")
+    cpu = get_engine("sha256crypt", "cpu")
+    gen = MaskGenerator("?d?l")
+    secret = b"3m"
+    t = dev.parse_target(sha256crypt_hash(secret, b"mesa", 1000))
+    w = dev.make_sharded_mask_worker(gen, [t], make_mesh(8),
+                                     batch_per_device=16, hit_capacity=8,
+                                     oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
