@@ -437,6 +437,47 @@ class Pbkdf2Sha256Engine(HashEngine):
                 for c in candidates]
 
 
+@register("pbkdf2-sha1")
+class Pbkdf2Sha1Engine(HashEngine):
+    """Generic PBKDF2-HMAC-SHA1 (hashcat 12000:
+    'sha1:iter:b64salt:b64dk', dk 4..40 bytes in 4-byte steps)."""
+
+    name = "pbkdf2-sha1"
+    digest_size = 20           # nominal; per-target dk width may differ
+    salted = True
+    max_candidate_len = 64
+
+    def parse_target(self, text: str) -> Target:
+        import base64
+        t = text.strip()
+        parts = t.split(":")
+        if len(parts) != 4 or parts[0] != "sha1":
+            raise ValueError(f"not a pbkdf2-sha1 line: {text!r}")
+        iters = int(parts[1])
+        salt = base64.b64decode(parts[2])
+        dk = base64.b64decode(parts[3])
+        if not 1 <= iters <= (1 << 31) - 1:
+            raise ValueError(f"iterations out of range in {text!r}")
+        if len(salt) > PBKDF2_SALT_MAX:
+            raise ValueError(f"salt longer than {PBKDF2_SALT_MAX}: "
+                             f"{text!r}")
+        if not 4 <= len(dk) <= 40 or len(dk) % 4:
+            raise ValueError("derived key must be 4..40 bytes in 4-byte "
+                             f"steps: {text!r}")
+        return Target(raw=t, digest=dk,
+                      params={"salt": salt, "iterations": iters,
+                              "dklen": len(dk)})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError("pbkdf2-sha1 needs target params")
+        return [hashlib.pbkdf2_hmac("sha1", c, params["salt"],
+                                    params["iterations"],
+                                    params.get("dklen", 20))
+                for c in candidates]
+
+
 @register("phpass")
 class PhpassEngine(HashEngine):
     """phpass portable hashes ($P$/$H$, WordPress/phpBB; hashcat 400):

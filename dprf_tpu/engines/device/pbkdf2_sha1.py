@@ -1,0 +1,137 @@
+"""Generic PBKDF2-HMAC-SHA1 engine (hashcat 12000:
+``sha1:<iterations>:<b64 salt>:<b64 dk>``).
+
+Same runtime-salt design as the pbkdf2-sha256 engine: the U1 block is
+assembled on device from salt bytes, so one compiled step serves every
+target and iteration count.  Derived keys of 4..40 bytes (multiples of
+4) are supported; up to two output blocks are computed as needed and
+the compare truncates to the target's dk width.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.cpu.engines import (PBKDF2_SALT_MAX as SALT_MAX,
+                                          Pbkdf2Sha1Engine)
+from dprf_tpu.engines.device.phpass import (PhpassMaskWorker,
+                                            PhpassWordlistWorker,
+                                            ShardedPhpassMaskWorker)
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops import pack as pack_ops
+from dprf_tpu.ops.hmac_sha1 import _block20, hmac_key_states, hmac_sha1_20
+from dprf_tpu.ops.sha1 import sha1_compress
+
+
+def _u1_block_sha1(salt: jnp.ndarray, salt_len, block_index: int):
+    """Runtime U1 message block: salt || INT32BE(i) padded as the
+    second block of the inner hash; salt uint8[SALT_MAX] -> uint32[16].
+    """
+    buf = jnp.zeros((64,), jnp.uint8).at[:SALT_MAX].set(salt)
+    pos = jnp.arange(64, dtype=jnp.int32)
+    msg_len = salt_len + 4
+    buf = jnp.where(pos < salt_len, buf, 0)
+    buf = buf + jnp.where(pos == salt_len + 3, jnp.uint8(block_index),
+                          jnp.uint8(0))
+    buf = (buf + jnp.where(pos == msg_len, jnp.uint8(0x80),
+                           jnp.uint8(0))).astype(jnp.uint8)
+    coef = jnp.asarray(np.array([1 << 24, 1 << 16, 1 << 8, 1],
+                                dtype=np.uint32))
+    words = (buf.reshape(16, 4).astype(jnp.uint32) * coef).sum(
+        axis=-1, dtype=jnp.uint32)
+    return words.at[15].set(((64 + msg_len) * 8).astype(jnp.uint32))
+
+
+def _pbkdf2_sha1_t(istate, ostate, salt, salt_len, block_index: int,
+                   iterations):
+    from jax import lax
+
+    first = jnp.broadcast_to(
+        _u1_block_sha1(salt, salt_len, block_index)[None, :],
+        istate.shape[:-1] + (16,))
+    inner = sha1_compress(istate, first)
+    u = sha1_compress(ostate, _block20(inner))
+
+    def body(_, carry):
+        u, t = carry
+        u = hmac_sha1_20(istate, ostate, u)
+        return u, t ^ u
+
+    _, t = lax.fori_loop(1, iterations, body, (u, u))
+    return t
+
+
+def pbkdf2_sha1_runtime_salt(key_words, salt, salt_len, iterations,
+                             dk_words: int):
+    """PBKDF2-HMAC-SHA1 with runtime salt; dk_words (static, <= 10)
+    output words -> uint32[B, dk_words]."""
+    istate, ostate = hmac_key_states(key_words)
+    t1 = _pbkdf2_sha1_t(istate, ostate, salt, salt_len, 1, iterations)
+    if dk_words <= 5:
+        return t1[:, :dk_words]
+    t2 = _pbkdf2_sha1_t(istate, ostate, salt, salt_len, 2, iterations)
+    return jnp.concatenate([t1, t2[:, :dk_words - 5]], axis=-1)
+
+
+def make_pbkdf2_sha1_mask_step(gen, batch: int, dk_words: int,
+                               hit_capacity: int = 64):
+    flat = gen.flat_charsets
+    length = gen.length
+
+    @jax.jit
+    def step(base_digits, n_valid, salt, salt_len, iterations, target):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        key = pack_ops.pack_raw(cand, length, big_endian=True)
+        dk = pbkdf2_sha1_runtime_salt(key, salt, salt_len, iterations,
+                                      dk_words)
+        # per-target dk widths may differ: the target's (static) shape
+        # drives the compare width; jit re-specializes per width
+        found = cmp_ops.compare_single(dk[:, :target.shape[0]], target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def _targs(targets):
+    out = []
+    for t in targets:
+        s = t.params["salt"]
+        buf = np.zeros((SALT_MAX,), np.uint8)
+        buf[:len(s)] = np.frombuffer(s, np.uint8)
+        out.append((jnp.asarray(buf), jnp.int32(len(s)),
+                    jnp.int32(t.params["iterations"]),
+                    jnp.asarray(np.frombuffer(t.digest, dtype=">u4")
+                                .astype(np.uint32))))
+    return out
+
+
+class Pbkdf2Sha1MaskWorker(PhpassMaskWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 13,
+                 hit_capacity: int = 64, oracle=None):
+        self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
+        self.batch = self.stride = batch
+        self._targs = _targs(self.targets)
+        dk_words = max(len(t.digest) // 4 for t in self.targets)
+        self.step = make_pbkdf2_sha1_mask_step(gen, batch, dk_words,
+                                               hit_capacity)
+
+    def process(self, unit):
+        # dk widths can differ per target; compare_single truncates to
+        # each target's word count because the TARGET drives the shape
+        # (jit specializes per distinct width -- rare in practice)
+        return super().process(unit)
+
+
+@register("pbkdf2-sha1", device="jax")
+class JaxPbkdf2Sha1Engine(Pbkdf2Sha1Engine):
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return Pbkdf2Sha1MaskWorker(self, gen, targets,
+                                    batch=min(batch, 1 << 13),
+                                    hit_capacity=hit_capacity,
+                                    oracle=oracle)

@@ -120,3 +120,37 @@ def test_sharded_pbkdf2_worker():
                                      oracle=cpu)
     hits = w.process(WorkUnit(0, 0, gen.keyspace))
     assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
+
+
+def test_pbkdf2_sha1_engine(tmp_path, capsys):
+    """Generic PBKDF2-HMAC-SHA1 (12000): parse, oracle, device crack,
+    truncated derived keys."""
+    from dprf_tpu.cli import main
+
+    def line(pw, salt, iters, dklen):
+        dk = hashlib.pbkdf2_hmac("sha1", pw, salt, iters, dklen)
+        return (f"sha1:{iters}:" + base64.b64encode(salt).decode()
+                + ":" + base64.b64encode(dk).decode())
+
+    cpu = get_engine("pbkdf2-sha1", "cpu")
+    t = cpu.parse_target(line(b"pw", b"salty", 100, 16))
+    assert t.params["dklen"] == 16 and cpu.verify(b"pw", t)
+
+    dev = get_engine("pbkdf2-sha1", "jax")
+    gen = MaskGenerator("?l?d")
+    secret = b"z7"
+    for dklen in (16, 20, 32):
+        t = dev.parse_target(line(secret, b"mesa", 100, dklen))
+        w = dev.make_mask_worker(gen, [t], batch=512, hit_capacity=8,
+                                 oracle=cpu)
+        hits = w.process(WorkUnit(0, 0, gen.keyspace))
+        assert [(h.target_index, h.plaintext)
+                for h in hits] == [(0, secret)], dklen
+
+    hf = tmp_path / "h.txt"
+    hf.write_text(line(b"m3", b"grain", 100, 20) + "\n")
+    rc = main(["crack", "?l?d", str(hf), "--engine", "pbkdf2-sha1",
+               "--device", "tpu", "--no-potfile", "--batch", "512",
+               "-q"])
+    out = capsys.readouterr().out
+    assert rc == 0 and ":m3" in out
