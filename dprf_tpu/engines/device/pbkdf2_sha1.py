@@ -110,6 +110,57 @@ def _targs(targets):
     return out
 
 
+def make_pbkdf2_sha1_wordlist_step(gen, word_batch: int, dk_words: int,
+                                   hit_capacity: int = 64):
+    from jax import lax
+
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, Lw = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+
+    @jax.jit
+    def step(w0, n_valid_words, salt, salt_len, iterations, target):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, Lw))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, Lw)
+        # raw (markerless) HMAC key block, masked to per-lane length
+        pos = jnp.arange(64, dtype=jnp.int32)[None, :]
+        raw = jnp.where(pos < cl[:, None],
+                        jnp.zeros((cw.shape[0], 64),
+                                  jnp.uint8).at[:, :Lw].set(cw), 0)
+        coef = jnp.asarray(np.array([1 << 24, 1 << 16, 1 << 8, 1],
+                                    dtype=np.uint32))
+        key = (raw.reshape(cw.shape[0], 16, 4).astype(jnp.uint32)
+               * coef).sum(axis=-1, dtype=jnp.uint32)
+        dk = pbkdf2_sha1_runtime_salt(key, salt, salt_len, iterations,
+                                      dk_words)
+        found = cmp_ops.compare_single(dk[:, :target.shape[0]],
+                                       target) & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
+                                    hit_capacity)
+
+    return step
+
+
+class Pbkdf2Sha1WordlistWorker(PhpassWordlistWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 13,
+                 hit_capacity: int = 64, oracle=None):
+        self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
+        self.batch = batch
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self._targs = _targs(self.targets)
+        dk_words = max(len(t.digest) // 4 for t in self.targets)
+        self.step = make_pbkdf2_sha1_wordlist_step(
+            gen, self.word_batch, dk_words, hit_capacity)
+
+
 class Pbkdf2Sha1MaskWorker(PhpassMaskWorker):
     def __init__(self, engine, gen, targets, batch: int = 1 << 13,
                  hit_capacity: int = 64, oracle=None):
@@ -135,3 +186,10 @@ class JaxPbkdf2Sha1Engine(Pbkdf2Sha1Engine):
                                     batch=min(batch, 1 << 13),
                                     hit_capacity=hit_capacity,
                                     oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return Pbkdf2Sha1WordlistWorker(self, gen, targets,
+                                        batch=min(batch, 1 << 13),
+                                        hit_capacity=hit_capacity,
+                                        oracle=oracle)

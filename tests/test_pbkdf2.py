@@ -154,3 +154,25 @@ def test_pbkdf2_sha1_engine(tmp_path, capsys):
                "-q"])
     out = capsys.readouterr().out
     assert rc == 0 and ":m3" in out
+
+
+def test_pbkdf2_sha1_wordlist_worker():
+    from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+    from dprf_tpu.rules.parser import parse_rule
+
+    def line(pw, salt, iters, dklen):
+        dk = hashlib.pbkdf2_hmac("sha1", pw, salt, iters, dklen)
+        return (f"sha1:{iters}:" + base64.b64encode(salt).decode()
+                + ":" + base64.b64encode(dk).decode())
+
+    dev = get_engine("pbkdf2-sha1", "jax")
+    cpu = get_engine("pbkdf2-sha1", "cpu")
+    words = [b"monday", b"friday"]
+    rules = [parse_rule(":"), parse_rule("u")]
+    gen = WordlistRulesGenerator(words, rules, max_len=12)
+    secret = b"FRIDAY"
+    t = dev.parse_target(line(secret, b"saltX", 100, 20))
+    w = dev.make_wordlist_worker(gen, [t], batch=8, hit_capacity=8,
+                                 oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
