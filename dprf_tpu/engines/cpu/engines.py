@@ -437,6 +437,63 @@ class Pbkdf2Sha256Engine(HashEngine):
                 for c in candidates]
 
 
+_CISCO_ITOA64 = ("./0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+                 "abcdefghijklmnopqrstuvwxyz")
+_STD_B64 = ("ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+            "abcdefghijklmnopqrstuvwxyz0123456789+/")
+_TO_STD = str.maketrans(_CISCO_ITOA64, _STD_B64)
+_FROM_STD = str.maketrans(_STD_B64, _CISCO_ITOA64)
+
+
+def cisco8_encode(dk: bytes) -> str:
+    """Cisco type 8 digest text: standard base64 bit order, itoa64
+    alphabet, no padding (verified against the published mode-9200
+    example hash)."""
+    import base64
+    return base64.b64encode(dk).decode().rstrip("=").translate(_FROM_STD)
+
+
+def cisco8_decode(text: str) -> bytes:
+    import base64
+    std = text.translate(_TO_STD)
+    return base64.b64decode(std + "=" * (-len(std) % 4))
+
+
+@register("cisco8")
+@register("cisco-ios-8")
+class Cisco8Engine(HashEngine):
+    """Cisco IOS type 8 ($8$salt$hash): PBKDF2-HMAC-SHA256, 20000
+    iterations, 32-byte dk (hashcat 9200).  Execution is the
+    pbkdf2-sha256 path; only the line format differs."""
+
+    name = "cisco8"
+    digest_size = 32
+    salted = True
+    max_candidate_len = 64
+
+    def parse_target(self, text: str) -> Target:
+        t = text.strip()
+        parts = t.split("$")
+        if len(parts) != 4 or parts[0] != "" or parts[1] != "8":
+            raise ValueError(f"not a Cisco type 8 hash: {text!r}")
+        salt = parts[2].encode("latin-1")
+        if not salt or len(salt) > PBKDF2_SALT_MAX:
+            raise ValueError(f"bad Cisco type 8 salt in {text!r}")
+        dk = cisco8_decode(parts[3])
+        if len(dk) != 32:
+            raise ValueError(f"Cisco type 8 wants a 32-byte dk: {text!r}")
+        return Target(raw=t, digest=dk,
+                      params={"salt": salt, "iterations": 20000})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError("cisco8 needs target params")
+        return [hashlib.pbkdf2_hmac("sha256", c, params["salt"],
+                                    params["iterations"], 32)
+                for c in candidates]
+
+
 @register("pbkdf2-sha1")
 class Pbkdf2Sha1Engine(HashEngine):
     """Generic PBKDF2-HMAC-SHA1 (hashcat 12000:

@@ -23,6 +23,7 @@ from dprf_tpu.ops.hmac_sha256 import hmac256_key_states
 from dprf_tpu.ops.sha256 import sha256_compress
 
 from dprf_tpu.engines.cpu.engines import (PBKDF2_SALT_MAX as SALT_MAX,
+                                           Cisco8Engine,
                                            Pbkdf2Sha256Engine)
 from dprf_tpu.engines.device.phpass import (PhpassMaskWorker,
                                             PhpassWordlistWorker,
@@ -214,3 +215,15 @@ class JaxPbkdf2Sha256Engine(Pbkdf2Sha256Engine):
                                     batch=min(batch, 1 << 13),
                                     hit_capacity=hit_capacity,
                                     oracle=oracle)
+
+
+@register("cisco8", device="jax")
+@register("cisco-ios-8", device="jax")
+class JaxCisco8Engine(Cisco8Engine):
+    """Cisco IOS type 8 on device: the pbkdf2-sha256 workers with the
+    $8$ line format (same params shape: salt + iterations)."""
+
+    make_mask_worker = JaxPbkdf2Sha256Engine.make_mask_worker
+    make_wordlist_worker = JaxPbkdf2Sha256Engine.make_wordlist_worker
+    make_sharded_mask_worker = \
+        JaxPbkdf2Sha256Engine.make_sharded_mask_worker

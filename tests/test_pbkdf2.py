@@ -176,3 +176,31 @@ def test_pbkdf2_sha1_wordlist_worker():
                                  oracle=cpu)
     hits = w.process(WorkUnit(0, 0, gen.keyspace))
     assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
+
+
+def test_cisco8_published_vector_and_crack(tmp_path, capsys):
+    """The published Cisco type 8 example (password 'hashcat')
+    verifies, and a planted $8$ target cracks via the device path."""
+    from dprf_tpu.cli import main
+    from dprf_tpu.engines.cpu.engines import cisco8_encode
+
+    cpu = get_engine("cisco8", "cpu")
+    example = ("$8$TnGX/fE4KGHOVU$"
+               "pEhnEvxrvaynpi8j4f.EMHr6M.FzU8xnZnBr/tJdFWk")
+    t = cpu.parse_target(example)
+    assert cpu.verify(b"hashcat", t)
+    assert not cpu.verify(b"wrong", t)
+    # encode round-trip
+    assert cisco8_encode(t.digest) == example.split("$")[3]
+
+    # planted crack (small iteration count is not possible in the $8$
+    # format -- iterations are fixed 20000 -- so keep the keyspace tiny)
+    dk = hashlib.pbkdf2_hmac("sha256", b"z7", b"saltsaltsalts", 20000, 32)
+    line = "$8$saltsaltsalts$" + cisco8_encode(dk)
+    hf = tmp_path / "h.txt"
+    hf.write_text(line + "\n")
+    rc = main(["crack", "?l?d", str(hf), "--engine", "cisco8",
+               "--device", "tpu", "--no-potfile", "--batch", "512",
+               "--unit-size", "512", "-q"])
+    out = capsys.readouterr().out
+    assert rc == 0 and ":z7" in out
