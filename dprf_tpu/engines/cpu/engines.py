@@ -456,7 +456,9 @@ def cisco8_encode(dk: bytes) -> str:
 def cisco8_decode(text: str) -> bytes:
     import base64
     std = text.translate(_TO_STD)
-    return base64.b64decode(std + "=" * (-len(std) % 4))
+    # validate=True: a char outside the itoa64 alphabet must raise, not
+    # silently decode into a wrong digest
+    return base64.b64decode(std + "=" * (-len(std) % 4), validate=True)
 
 
 @register("cisco8")

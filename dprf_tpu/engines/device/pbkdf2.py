@@ -30,16 +30,17 @@ from dprf_tpu.engines.device.phpass import (PhpassMaskWorker,
                                             ShardedPhpassMaskWorker)
 
 
-def _u1_block(salt: jnp.ndarray, salt_len) -> jnp.ndarray:
-    """Runtime U1 message block: salt || INT32BE(1), padded as the
-    second block of the inner hash.  salt uint8[SALT_MAX] -> uint32[16].
-    """
+def u1_block(salt: jnp.ndarray, salt_len,
+             block_index: int = 1) -> jnp.ndarray:
+    """Runtime U1 message block for any 64-byte-block HMAC hash:
+    salt || INT32BE(block_index), padded as the second block of the
+    inner hash.  salt uint8[SALT_MAX] -> uint32[16] big-endian.
+    Shared by the pbkdf2-sha256 and pbkdf2-sha1 engines."""
     buf = jnp.zeros((64,), jnp.uint8).at[:SALT_MAX].set(salt)
     pos = jnp.arange(64, dtype=jnp.int32)
     msg_len = salt_len + 4
-    # INT32BE(1) = 0,0,0,1 directly after the salt
     buf = jnp.where(pos < salt_len, buf, 0)
-    buf = buf + jnp.where(pos == salt_len + 3, jnp.uint8(1),
+    buf = buf + jnp.where(pos == salt_len + 3, jnp.uint8(block_index),
                           jnp.uint8(0))
     buf = (buf + jnp.where(pos == msg_len, jnp.uint8(0x80),
                            jnp.uint8(0))).astype(jnp.uint8)
@@ -60,7 +61,7 @@ def pbkdf2_sha256_runtime_salt(key_words: jnp.ndarray,
     from dprf_tpu.ops.hmac_sha256 import _block32, hmac_sha256_32
 
     istate, ostate = hmac256_key_states(key_words)
-    first = jnp.broadcast_to(_u1_block(salt, salt_len)[None, :],
+    first = jnp.broadcast_to(u1_block(salt, salt_len)[None, :],
                              istate.shape[:-1] + (16,))
     inner = sha256_compress(istate, first)
     u = sha256_compress(ostate, _block32(inner))

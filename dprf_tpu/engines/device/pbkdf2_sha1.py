@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from dprf_tpu.engines import register
 from dprf_tpu.engines.cpu.engines import (PBKDF2_SALT_MAX as SALT_MAX,
                                           Pbkdf2Sha1Engine)
+from dprf_tpu.engines.device.pbkdf2 import _targs, u1_block
 from dprf_tpu.engines.device.phpass import (PhpassMaskWorker,
                                             PhpassWordlistWorker,
                                             ShardedPhpassMaskWorker)
@@ -26,31 +27,12 @@ from dprf_tpu.ops.hmac_sha1 import _block20, hmac_key_states, hmac_sha1_20
 from dprf_tpu.ops.sha1 import sha1_compress
 
 
-def _u1_block_sha1(salt: jnp.ndarray, salt_len, block_index: int):
-    """Runtime U1 message block: salt || INT32BE(i) padded as the
-    second block of the inner hash; salt uint8[SALT_MAX] -> uint32[16].
-    """
-    buf = jnp.zeros((64,), jnp.uint8).at[:SALT_MAX].set(salt)
-    pos = jnp.arange(64, dtype=jnp.int32)
-    msg_len = salt_len + 4
-    buf = jnp.where(pos < salt_len, buf, 0)
-    buf = buf + jnp.where(pos == salt_len + 3, jnp.uint8(block_index),
-                          jnp.uint8(0))
-    buf = (buf + jnp.where(pos == msg_len, jnp.uint8(0x80),
-                           jnp.uint8(0))).astype(jnp.uint8)
-    coef = jnp.asarray(np.array([1 << 24, 1 << 16, 1 << 8, 1],
-                                dtype=np.uint32))
-    words = (buf.reshape(16, 4).astype(jnp.uint32) * coef).sum(
-        axis=-1, dtype=jnp.uint32)
-    return words.at[15].set(((64 + msg_len) * 8).astype(jnp.uint32))
-
-
 def _pbkdf2_sha1_t(istate, ostate, salt, salt_len, block_index: int,
                    iterations):
     from jax import lax
 
     first = jnp.broadcast_to(
-        _u1_block_sha1(salt, salt_len, block_index)[None, :],
+        u1_block(salt, salt_len, block_index)[None, :],
         istate.shape[:-1] + (16,))
     inner = sha1_compress(istate, first)
     u = sha1_compress(ostate, _block20(inner))
@@ -95,19 +77,6 @@ def make_pbkdf2_sha1_mask_step(gen, batch: int, dk_words: int,
                                     hit_capacity)
 
     return step
-
-
-def _targs(targets):
-    out = []
-    for t in targets:
-        s = t.params["salt"]
-        buf = np.zeros((SALT_MAX,), np.uint8)
-        buf[:len(s)] = np.frombuffer(s, np.uint8)
-        out.append((jnp.asarray(buf), jnp.int32(len(s)),
-                    jnp.int32(t.params["iterations"]),
-                    jnp.asarray(np.frombuffer(t.digest, dtype=">u4")
-                                .astype(np.uint32))))
-    return out
 
 
 def make_pbkdf2_sha1_wordlist_step(gen, word_batch: int, dk_words: int,
@@ -167,15 +136,12 @@ class Pbkdf2Sha1MaskWorker(PhpassMaskWorker):
         self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
         self.batch = self.stride = batch
         self._targs = _targs(self.targets)
+        # dk widths can differ per target: the step computes the job
+        # maximum and the compare truncates to each target's (static)
+        # word count -- jit specializes per distinct width
         dk_words = max(len(t.digest) // 4 for t in self.targets)
         self.step = make_pbkdf2_sha1_mask_step(gen, batch, dk_words,
                                                hit_capacity)
-
-    def process(self, unit):
-        # dk widths can differ per target; compare_single truncates to
-        # each target's word count because the TARGET drives the shape
-        # (jit specializes per distinct width -- rare in practice)
-        return super().process(unit)
 
 
 @register("pbkdf2-sha1", device="jax")
@@ -193,3 +159,38 @@ class JaxPbkdf2Sha1Engine(Pbkdf2Sha1Engine):
                                         batch=min(batch, 1 << 13),
                                         hit_capacity=hit_capacity,
                                         oracle=oracle)
+
+    def make_sharded_mask_worker(self, gen, targets, mesh,
+                                 batch_per_device: int, hit_capacity: int,
+                                 oracle=None):
+        return ShardedPbkdf2Sha1MaskWorker(
+            self, gen, targets, mesh,
+            batch_per_device=min(batch_per_device, 1 << 12),
+            hit_capacity=hit_capacity, oracle=oracle)
+
+
+class ShardedPbkdf2Sha1MaskWorker(ShardedPhpassMaskWorker):
+    def __init__(self, engine, gen, targets, mesh,
+                 batch_per_device: int = 1 << 12, hit_capacity: int = 64,
+                 oracle=None):
+        from dprf_tpu.parallel.sharded import \
+            make_sharded_pertarget_mask_step
+        self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
+        self.mesh = mesh
+        self.batch = self.stride = mesh.devices.size * batch_per_device
+        self._targs = _targs(self.targets)
+        widths = {len(t.digest) for t in self.targets}
+        if len(widths) != 1:
+            raise ValueError(
+                "the sharded pbkdf2-sha1 path needs one dk width per "
+                "job; split the hashlist or run single-chip")
+        dk_words = widths.pop() // 4
+        length = gen.length
+
+        def digest_fn(cand, lens, salt, salt_len, iterations):
+            key = pack_ops.pack_raw(cand, length, big_endian=True)
+            return pbkdf2_sha1_runtime_salt(key, salt, salt_len,
+                                            iterations, dk_words)
+
+        self.step = make_sharded_pertarget_mask_step(
+            gen, mesh, batch_per_device, digest_fn, 3, hit_capacity)
