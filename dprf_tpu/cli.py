@@ -162,6 +162,8 @@ def _build_parser() -> argparse.ArgumentParser:
 
     e = sub.add_parser("engines", help="list available engines")
     e.add_argument("--device", default=None)
+    e.add_argument("--verbose", "-v", action="store_true",
+                   help="one line per engine with its description")
 
     k = sub.add_parser("keyspace", help="print the keyspace size of "
                        "an attack (mask, wordlist+rules, combinator, "
@@ -831,7 +833,15 @@ def cmd_engines(args, log: Log) -> int:
             names = engine_names(dev)
         except KeyError:
             names = []
-        print(f"{dev}: {', '.join(names)}")
+        if not getattr(args, "verbose", False):
+            print(f"{dev}: {', '.join(names)}")
+            continue
+        from dprf_tpu.engines import engine_class
+        print(f"{dev}:")
+        for n in names:
+            doc = (engine_class(n, dev).__doc__ or "").strip()
+            first = doc.splitlines()[0] if doc else ""
+            print(f"  {n:14s} {first}")
     return 0
 
 

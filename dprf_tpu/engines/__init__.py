@@ -40,6 +40,12 @@ def engine_names(device: str = "cpu") -> list[str]:
     return sorted(n for n, d in _REGISTRY if d == device)
 
 
+def engine_class(name: str, device: str = "cpu") -> type:
+    """The registered class without instantiating it (for listings)."""
+    _ensure_imported(device)
+    return _REGISTRY[(name.lower(), device)]
+
+
 def _ensure_imported(device: str) -> None:
     # Import engine modules lazily so `import dprf_tpu` stays light and the
     # CPU oracle path never pulls in jax.

@@ -123,6 +123,10 @@ def _register_salted_cpu(algo: str, digest_size: int,
                    (_SaltedCpuMixin,),
                    {"name": name, "digest_size": digest_size,
                     "_algo": algo, "_order": order,
+                    "__doc__": (f"Salted {algo}: "
+                                + ("$pass.$salt" if order == "ps"
+                                   else "$salt.$pass")
+                                + " ('hexdigest:salt' lines)."),
                     # leave headroom for any parseable salt in the
                     # single block
                     "max_candidate_len": block_limit - SALT_MAX})
@@ -170,6 +174,7 @@ def _register_nested_cpu():
                    (_NestedCpuMixin,),
                    {"name": name,
                     "digest_size": NESTED_DIGEST_SIZE[outer],
+                    "__doc__": f"Nested {outer}(hex({inner}(password))).",
                     "_outer": outer, "_inner": inner})
         register(name, device="cpu")(cls)
 

@@ -92,6 +92,8 @@ for outer, inner in NESTED_COMBOS:
                 "digest_size": NESTED_DIGEST_SIZE[outer],
                 "digest_words": _STAGES[outer][1],
                 "little_endian": _STAGES[outer][2],
+                "__doc__": (f"Nested {outer}(hex({inner}(password))), "
+                            "fused on device."),
                 "_outer": outer, "_inner": inner})
     register(name, device="jax")(cls)
 

@@ -386,6 +386,10 @@ def _register_device(base_cls, algo: str):
         cls = type(f"Jax{algo.title()}{order.title()}Engine",
                    (_SaltedDeviceMixin, base_cls),
                    {"name": name, "order": order,
+                    "__doc__": (f"Salted {algo}: "
+                                + ("$pass.$salt" if order == "ps"
+                                   else "$salt.$pass")
+                                + " appended on device."),
                     "max_candidate_len":
                         base_cls._block_limit - SALT_MAX})
         register(name, device="jax")(cls)
