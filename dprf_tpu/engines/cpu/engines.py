@@ -64,6 +64,13 @@ class Sha384Engine(_HashlibEngine):
     _algo = "sha384"
 
 
+@register("sha224")
+class Sha224Engine(_HashlibEngine):
+    name = "sha224"
+    digest_size = 28
+    _algo = "sha224"
+
+
 #: fixed device salt buffer width; also bounds parseable salt length
 SALT_MAX = 32
 

@@ -23,7 +23,8 @@ from dprf_tpu.ops import pack as pack_ops
 from dprf_tpu.ops.md4 import md4_digest_words
 from dprf_tpu.ops.md5 import md5_digest_words
 from dprf_tpu.ops.sha1 import sha1_digest_words
-from dprf_tpu.ops.sha256 import sha256_digest_words
+from dprf_tpu.ops.sha256 import (sha224_digest_words,
+                                 sha256_digest_words)
 from dprf_tpu.ops.sha512 import sha384_digest_words, sha512_digest_words
 
 
@@ -204,6 +205,20 @@ class JaxSha256Engine(JaxEngineBase):
     def digest_packed(self, blocks: jnp.ndarray,
                       lengths=None) -> jnp.ndarray:
         return sha256_digest_words(blocks)
+
+
+@register("sha224", device="jax")
+class JaxSha224Engine(JaxEngineBase):
+    """SHA-224: SHA-256 with its own IV, truncated to 28 bytes."""
+
+    name = "sha224"
+    digest_size = 28
+    digest_words = 7
+    little_endian = False
+
+    def digest_packed(self, blocks: jnp.ndarray,
+                      lengths=None) -> jnp.ndarray:
+        return sha224_digest_words(blocks)
 
 
 @register("sha512", device="jax")

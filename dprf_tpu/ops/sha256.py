@@ -46,6 +46,13 @@ INIT = np.array([_frac_root_word(p, 2) for p in _PRIMES[:8]],
                 dtype=np.uint32)
 assert K[0] == 0x428A2F98 and INIT[0] == 0x6A09E667   # FIPS 180-4 spot check
 
+# SHA-224 IV: the SECOND 32 fractional bits of sqrt of primes 9..16
+# (the low half of SHA-384's 64-bit IV words; FIPS 180-4)
+INIT224 = np.array(
+    [__import__("math").isqrt(p << 128) & 0xFFFFFFFF
+     for p in _primes(16)[8:]], dtype=np.uint32)
+assert INIT224[0] == 0xC1059ED8   # FIPS 180-4 spot check
+
 
 def _rotr(x: jnp.ndarray, n: int) -> jnp.ndarray:
     return (x >> jnp.uint32(n)) | (x << jnp.uint32(32 - n))
@@ -123,3 +130,10 @@ def sha256_compress(state: jnp.ndarray, words: jnp.ndarray) -> jnp.ndarray:
 def sha256_digest_words(words: jnp.ndarray) -> jnp.ndarray:
     state = jnp.broadcast_to(jnp.asarray(INIT), words.shape[:-1] + (8,))
     return sha256_compress(state, words)
+
+
+def sha224_digest_words(words: jnp.ndarray) -> jnp.ndarray:
+    """SHA-224: SHA-256 with its own IV, digest truncated to 7 words."""
+    state = jnp.broadcast_to(jnp.asarray(INIT224),
+                             words.shape[:-1] + (8,))
+    return sha256_compress(state, words)[..., :7]

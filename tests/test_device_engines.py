@@ -91,3 +91,24 @@ def test_cli_engines_lists_device_engines(capsys):
     out = capsys.readouterr().out
     for n in ENGINES:
         assert n in out
+
+
+def test_sha224_vector_and_crack():
+    import hashlib as _hl
+    assert get_engine("sha224", "jax").hash_batch([b"abc"])[0].hex() == \
+        _hl.sha224(b"abc").hexdigest()
+    dev = get_engine("sha224", "jax")
+    oracle = get_engine("sha224", "cpu")
+    gen = MaskGenerator("?l?d?l")
+    secret = b"w7q"
+    tgt = target_words(oracle.hash_batch([secret])[0], False)
+    step = make_mask_crack_step(dev, gen, tgt, 512)
+    found = []
+    for start in range(0, gen.keyspace, 512):
+        base = jnp.asarray(gen.digits(start), dtype=jnp.int32)
+        count, lanes, _ = step(base,
+                               jnp.int32(min(512, gen.keyspace - start)))
+        if int(count):
+            found.extend(start + int(l) for l in np.asarray(lanes)
+                         if l >= 0)
+    assert found == [gen.index_of(secret)]

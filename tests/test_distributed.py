@@ -541,3 +541,33 @@ def test_cli_worker_combinator_job(tmp_path, capsys):
         assert state.found == {0: b"bluebird"}
     finally:
         server.shutdown()
+
+
+def test_cli_worker_phpass_job(capsys):
+    """A distributed slow-hash job (phpass): the worker rebuilds the
+    salted engine from the wire description and cracks the target."""
+    from dprf_tpu.engines.cpu.phpass import phpass_hash
+    from dprf_tpu.generators.mask import MaskGenerator
+    from dprf_tpu.runtime.session import job_fingerprint
+
+    eng = get_engine("phpass")
+    gen = MaskGenerator("?l?d")
+    secret = b"k7"
+    line = phpass_hash(secret, b"abcdefgh", 7)
+    targets = [eng.parse_target(line)]
+    fp = job_fingerprint("phpass", "mask:?l?d", gen.keyspace,
+                         [t.digest for t in targets])
+    job = {"engine": "phpass", "attack": "mask", "attack_arg": "?l?d",
+           "customs": {}, "rules": None, "max_len": None,
+           "targets": [t.raw for t in targets], "keyspace": gen.keyspace,
+           "unit_size": 128, "batch": 256, "hit_cap": 8,
+           "fingerprint": fp}
+    state, server, _ = _serve(job, gen, targets)
+    try:
+        host, port = server.address
+        rc = cli_main(["worker", "--connect", f"{host}:{port}",
+                       "--device", "tpu", "--quiet"])
+        assert rc == 0
+        assert state.found == {0: secret}
+    finally:
+        server.shutdown()
