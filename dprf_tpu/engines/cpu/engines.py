@@ -576,6 +576,35 @@ class PhpassEngine(HashEngine):
                 for c in candidates]
 
 
+@register("wpa2-eapol")
+@register("wpa2")
+class Wpa2EapolEngine(HashEngine):
+    """WPA2 4-way-handshake MIC (hc22000 WPA*02 lines; hashcat 22000).
+    Same PBKDF2 cost as PMKID plus PRF-512 and the EAPOL HMAC."""
+
+    name = "wpa2-eapol"
+    digest_size = 16
+    salted = True
+    max_candidate_len = 63    # WPA passphrase limit
+    iterations = 4096         # PBKDF2 rounds; tests lower it
+
+    def parse_target(self, text: str) -> Target:
+        from dprf_tpu.engines.cpu.wpa2 import parse_wpa02
+        f = parse_wpa02(text)
+        return Target(raw=text.strip(), digest=f.pop("mic"), params=f)
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        from dprf_tpu.engines.cpu.wpa2 import wpa2_mic
+        if not params:
+            raise ValueError("wpa2-eapol needs target params")
+        return [wpa2_mic(c, params["essid"], params["mac_ap"],
+                         params["mac_sta"], params["anonce"],
+                         params["eapol"], params["keyver"],
+                         self.iterations)
+                for c in candidates]
+
+
 @register("wpa2-pmkid")
 class Pmkid2Engine(HashEngine):
     """WPA2-PMKID: PMK = PBKDF2-HMAC-SHA1(pass, essid, 4096, 32);

@@ -1,0 +1,95 @@
+"""WPA2 4-way-handshake MIC verification (hashcat 22000, WPA*02 lines).
+
+Chain: PMK = PBKDF2-HMAC-SHA1(passphrase, essid, 4096, 32);
+KCK = PRF-512(PMK, "Pairwise key expansion",
+              min(MACs)||max(MACs)||min(nonces)||max(nonces))[:16]
+      (802.11i PRF: HMAC-SHA1(PMK, label || 0x00 || data || counter));
+MIC = HMAC-SHA1(KCK, eapol_frame_with_zeroed_mic)[:16]  (key version 2)
+   or HMAC-MD5(KCK, eapol)                               (key version 1).
+
+hc22000 WPA*02 fields: WPA*02*mic*mac_ap*mac_sta*essid*anonce*eapol*mp;
+the SNonce lives inside the stored EAPOL frame (key-nonce field at
+offset 17), and the stored frame already has its MIC field zeroed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+
+PRF_LABEL = b"Pairwise key expansion"
+
+
+def prf512_block0(pmk: bytes, data: bytes) -> bytes:
+    """First 20 bytes of the 802.11i PRF-512 (enough for the KCK)."""
+    return hmac.new(pmk, PRF_LABEL + b"\x00" + data + b"\x00",
+                    hashlib.sha1).digest()
+
+
+def ptk_data(mac_ap: bytes, mac_sta: bytes, anonce: bytes,
+             snonce: bytes) -> bytes:
+    return (min(mac_ap, mac_sta) + max(mac_ap, mac_sta)
+            + min(anonce, snonce) + max(anonce, snonce))
+
+
+def wpa2_mic(passphrase: bytes, essid: bytes, mac_ap: bytes,
+             mac_sta: bytes, anonce: bytes, eapol: bytes,
+             keyver: int, iterations: int = 4096) -> bytes:
+    """CPU reference: the 16-byte MIC for one candidate."""
+    pmk = hashlib.pbkdf2_hmac("sha1", passphrase, essid, iterations, 32)
+    snonce = eapol[17:49]
+    kck = prf512_block0(pmk, ptk_data(mac_ap, mac_sta, anonce,
+                                      snonce))[:16]
+    if keyver == 1:
+        return hmac.new(kck, eapol, hashlib.md5).digest()
+    return hmac.new(kck, eapol, hashlib.sha1).digest()[:16]
+
+
+def parse_wpa02(text: str):
+    """'WPA*02*mic*ap*sta*essid*anonce*eapol*mp' -> dict of fields."""
+    t = text.strip()
+    parts = t.split("*")
+    if len(parts) < 8 or parts[0] != "WPA" or parts[1] != "02":
+        raise ValueError(f"not a WPA*02 (EAPOL) line: {text!r}")
+    mic = bytes.fromhex(parts[2])
+    mac_ap = bytes.fromhex(parts[3])
+    mac_sta = bytes.fromhex(parts[4])
+    essid = bytes.fromhex(parts[5])
+    anonce = bytes.fromhex(parts[6])
+    eapol = bytes.fromhex(parts[7])
+    if len(mic) != 16 or len(mac_ap) != 6 or len(mac_sta) != 6:
+        raise ValueError(f"bad field lengths in {text!r}")
+    if len(anonce) != 32 or len(eapol) < 95:
+        raise ValueError(f"bad anonce/eapol in {text!r}")
+    key_info = int.from_bytes(eapol[5:7], "big")
+    keyver = key_info & 0x7
+    if keyver not in (1, 2):
+        raise ValueError(f"unsupported EAPOL key version {keyver} "
+                         f"in {text!r}")
+    return {"mic": mic, "mac_ap": mac_ap, "mac_sta": mac_sta,
+            "essid": essid, "anonce": anonce, "eapol": eapol,
+            "keyver": keyver}
+
+
+def make_wpa02_line(passphrase: bytes, essid: bytes, mac_ap: bytes,
+                    mac_sta: bytes, anonce: bytes, snonce: bytes,
+                    keyver: int = 2, iterations: int = 4096) -> str:
+    """Synthesize a WPA*02 line with a minimal message-2 EAPOL frame
+    (test helper)."""
+    key_info = 0x0100 | keyver        # MIC bit + key version
+    body = (bytes([1]) +                     # key descriptor type
+            key_info.to_bytes(2, "big") +
+            (16).to_bytes(2, "big") +        # key length
+            b"\x00" * 8 +                    # replay counter
+            snonce +                         # key nonce (offset 17)
+            b"\x00" * 16 +                   # key IV
+            b"\x00" * 8 +                    # key RSC
+            b"\x00" * 8 +                    # key ID
+            b"\x00" * 16 +                   # MIC (zeroed in storage)
+            (0).to_bytes(2, "big"))          # key data length
+    eapol = bytes([2, 3]) + len(body).to_bytes(2, "big") + body
+    mic = wpa2_mic(passphrase, essid, mac_ap, mac_sta, anonce, eapol,
+                   keyver, iterations)
+    return "*".join(["WPA", "02", mic.hex(), mac_ap.hex(),
+                     mac_sta.hex(), essid.hex(), anonce.hex(),
+                     eapol.hex(), "02"])

@@ -1,0 +1,298 @@
+"""Device WPA2 handshake-MIC engine (hc22000 WPA*02; hashcat 22000).
+
+All heavy lifting reuses existing device ops: the PMK is the
+runtime-salt PBKDF2-HMAC-SHA1 (one compiled step serves every essid);
+the PRF-512 block and the EAPOL MIC are HMACs whose MESSAGES are
+per-target constants -- pre-padded on the host and chained through the
+shared compressions with only the (per-candidate) keys varying, the
+same trick as the NetNTLMv2 engine.  Key version 2 MICs use HMAC-SHA1,
+key version 1 uses HMAC-MD5; the worker picks the compiled step per
+target's key version.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.cpu.engines import Wpa2EapolEngine
+from dprf_tpu.engines.cpu.wpa2 import PRF_LABEL, ptk_data
+from dprf_tpu.engines.device.netntlmv2 import (_hmac_md5_const_msg,
+                                               hmac_msg_blocks)
+from dprf_tpu.engines.device.pbkdf2_sha1 import pbkdf2_sha1_runtime_salt
+from dprf_tpu.engines.device.phpass import (PhpassMaskWorker,
+                                            PhpassWordlistWorker)
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops.sha1 import INIT as SHA1_INIT, sha1_compress
+from dprf_tpu.ops.hmac_sha1 import _block20
+
+_IPAD = np.uint32(0x36363636)
+_OPAD = np.uint32(0x5C5C5C5C)
+
+#: static caps on pre-padded HMAC message blocks
+PRF_BLOCKS = 2      # 22+1+76+1 = 100 bytes (+9 pad) -> 2 x 64
+EAPOL_BLOCKS = 8    # EAPOL frames up to ~440 bytes
+
+
+def sha1_msg_blocks(msg: bytes, width_blocks: int, what: str) -> tuple:
+    """Pre-pad an HMAC-SHA1 message (after the 64-byte key block) into
+    big-endian blocks: (uint32[width, 16], n_blocks)."""
+    total = 64 + len(msg)
+    padded = msg + b"\x80"
+    padded += b"\x00" * ((56 - len(padded) % 64) % 64)
+    padded += (total * 8).to_bytes(8, "big")
+    n_blocks = len(padded) // 64
+    if n_blocks > width_blocks:
+        raise ValueError(f"{what} needs {n_blocks} HMAC blocks, "
+                         f"cap {width_blocks}")
+    buf = np.zeros((width_blocks, 64), np.uint8)
+    buf[:n_blocks] = np.frombuffer(padded, np.uint8).reshape(n_blocks, 64)
+    words = buf.reshape(width_blocks, 16, 4).astype(np.uint32) @ \
+        np.array([1 << 24, 1 << 16, 1 << 8, 1], dtype=np.uint32)
+    return words, n_blocks
+
+
+def _hmac_sha1_const_msg(key_words: jnp.ndarray, n_key_words: int,
+                         msg_blocks: jnp.ndarray,
+                         n_blocks) -> jnp.ndarray:
+    """HMAC-SHA1 with per-candidate keys (uint32[B, n_key_words],
+    <= 16) over a constant pre-padded big-endian message ->
+    uint32[B, 5]."""
+    B = key_words.shape[0]
+    key_block = jnp.zeros((B, 16),
+                          jnp.uint32).at[:, :n_key_words].set(key_words)
+    init = jnp.broadcast_to(jnp.asarray(SHA1_INIT), (B, 5))
+    istate = sha1_compress(init, key_block ^ _IPAD)
+    ostate = sha1_compress(init, key_block ^ _OPAD)
+    state = istate
+    for k in range(msg_blocks.shape[0]):
+        blk = jnp.broadcast_to(msg_blocks[k][None, :], (B, 16))
+        new = sha1_compress(state, blk)
+        state = jnp.where(k < n_blocks, new, state)
+    return sha1_compress(ostate, _block20(state))
+
+
+def wpa2_mic_batch(cand, lens, essid, essid_len, iterations,
+                   prf_blocks, prf_n, eapol_blocks, eapol_n,
+                   keyver: int) -> jnp.ndarray:
+    """Candidates -> MIC words uint32[B, 4] (keyver static: 1 = MD5
+    MIC, 2 = SHA-1 MIC truncated to 16 bytes)."""
+    # HMAC key = raw zero-padded passphrase block, per-lane lengths
+    pos = jnp.arange(64, dtype=jnp.int32)[None, :]
+    raw = jnp.where(pos < lens[:, None],
+                    jnp.zeros((cand.shape[0], 64),
+                              jnp.uint8).at[:, :cand.shape[1]].set(cand),
+                    0)
+    coef = jnp.asarray(np.array([1 << 24, 1 << 16, 1 << 8, 1],
+                                dtype=np.uint32))
+    key = (raw.reshape(cand.shape[0], 16, 4).astype(jnp.uint32)
+           * coef).sum(axis=-1, dtype=jnp.uint32)
+    pmk = pbkdf2_sha1_runtime_salt(key, essid, essid_len, iterations, 8)
+    kck5 = _hmac_sha1_const_msg(pmk, 8, prf_blocks, prf_n)
+    kck = kck5[:, :4]                 # first 16 bytes of PRF-512
+    if keyver == 1:
+        # HMAC-MD5 keys/messages are little-endian words: byte-swap the
+        # big-endian KCK words
+        kck_le = ((kck >> jnp.uint32(24))
+                  | ((kck >> jnp.uint32(8)) & jnp.uint32(0xFF00))
+                  | ((kck << jnp.uint32(8)) & jnp.uint32(0xFF0000))
+                  | (kck << jnp.uint32(24)))
+        return _hmac_md5_const_msg(kck_le, eapol_blocks, eapol_n)
+    return _hmac_sha1_const_msg(kck, 4, eapol_blocks, eapol_n)[:, :4]
+
+
+def make_wpa2_mask_step(gen, batch: int, keyver: int,
+                        hit_capacity: int = 64):
+    """step(base_digits, n_valid, essid, essid_len, iterations,
+    prf_blocks, prf_n, eapol_blocks, eapol_n, target) ->
+    (count, lanes, _)."""
+    flat = gen.flat_charsets
+    length = gen.length
+
+    @jax.jit
+    def step(base_digits, n_valid, essid, essid_len, iterations,
+             prf_blocks, prf_n, eapol_blocks, eapol_n, target):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        lens = jnp.full((batch,), length, jnp.int32)
+        mic = wpa2_mic_batch(cand, lens, essid, essid_len, iterations,
+                             prf_blocks, prf_n, eapol_blocks, eapol_n,
+                             keyver)
+        found = cmp_ops.compare_single(mic, target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def make_wpa2_wordlist_step(gen, word_batch: int, keyver: int,
+                            hit_capacity: int = 64):
+    from jax import lax
+
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, Lw = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+
+    @jax.jit
+    def step(w0, n_valid_words, essid, essid_len, iterations,
+             prf_blocks, prf_n, eapol_blocks, eapol_n, target):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, Lw))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, Lw)
+        mic = wpa2_mic_batch(cw, cl, essid, essid_len, iterations,
+                             prf_blocks, prf_n, eapol_blocks, eapol_n,
+                             keyver)
+        found = cmp_ops.compare_single(mic, target) & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
+                                    hit_capacity)
+
+    return step
+
+
+def _wpa2_targs(targets, iterations: int):
+    """Per-target (essid, essid_len, iterations, prf blocks/count,
+    eapol blocks/count, mic words, keyver)."""
+    out = []
+    for t in targets:
+        p = t.params
+        ebuf = np.zeros((51,), np.uint8)     # pbkdf2 SALT_MAX width
+        ebuf[:len(p["essid"])] = np.frombuffer(p["essid"], np.uint8)
+        snonce = p["eapol"][17:49]
+        prf_msg = (PRF_LABEL + b"\x00"
+                   + ptk_data(p["mac_ap"], p["mac_sta"], p["anonce"],
+                              snonce) + b"\x00")
+        pw, pn = sha1_msg_blocks(prf_msg, PRF_BLOCKS, "PRF data")
+        if p["keyver"] == 1:
+            ew, en = hmac_msg_blocks(p["eapol"], EAPOL_BLOCKS,
+                                     what="EAPOL frame")
+        else:
+            ew, en = sha1_msg_blocks(p["eapol"], EAPOL_BLOCKS,
+                                     "EAPOL frame")
+        dt = "<u4" if p["keyver"] == 1 else ">u4"
+        out.append(((jnp.asarray(ebuf), jnp.int32(len(p["essid"])),
+                     jnp.int32(iterations), jnp.asarray(pw),
+                     jnp.int32(pn), jnp.asarray(ew), jnp.int32(en),
+                     jnp.asarray(np.frombuffer(t.digest, dtype=dt)
+                                 .astype(np.uint32))),
+                    p["keyver"]))
+    return out
+
+
+class Wpa2MaskWorker(PhpassMaskWorker):
+    """Per-target sweep with a per-keyver compiled step."""
+
+    def __init__(self, engine, gen, targets, batch: int = 1 << 13,
+                 hit_capacity: int = 64, oracle=None):
+        self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
+        self.batch = self.stride = batch
+        pairs = _wpa2_targs(self.targets, engine.iterations)
+        self._targs = [targ for targ, _ in pairs]
+        self._keyvers = [kv for _, kv in pairs]
+        self._steps = {kv: make_wpa2_mask_step(gen, batch, kv,
+                                               hit_capacity)
+                       for kv in set(self._keyvers)}
+
+    def process(self, unit):
+        hits = []
+        for ti in range(len(self.targets)):
+            self.step = self._steps[self._keyvers[ti]]
+            hits.extend(self._sweep_one(unit, ti))
+        return hits
+
+    def _sweep_one(self, unit, ti):
+        from dprf_tpu.runtime.worker import Hit
+        targ = self._targs[ti]
+        hits = []
+        queued = []
+        for bstart in range(unit.start, unit.end, self.stride):
+            n_valid = min(self.stride, unit.end - bstart)
+            base = jnp.asarray(self.gen.digits(bstart), dtype=jnp.int32)
+            queued.append((bstart, self.step(
+                base, jnp.int32(n_valid), *targ)))
+        for bstart, (cnt, lanes, _) in queued:
+            cnt = int(cnt)
+            if cnt == 0:
+                continue
+            if cnt > self.hit_capacity:
+                hits.extend(self._rescan(
+                    bstart, min(bstart + self.stride, unit.end), ti))
+                continue
+            for lane in np.asarray(lanes):
+                if lane < 0:
+                    continue
+                gidx = bstart + int(lane)
+                hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+
+class Wpa2WordlistWorker(Wpa2MaskWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 13,
+                 hit_capacity: int = 64, oracle=None):
+        self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
+        self.batch = batch
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        pairs = _wpa2_targs(self.targets, engine.iterations)
+        self._targs = [targ for targ, _ in pairs]
+        self._keyvers = [kv for _, kv in pairs]
+        self._steps = {kv: make_wpa2_wordlist_step(
+            gen, self.word_batch, kv, hit_capacity)
+            for kv in set(self._keyvers)}
+
+    def _sweep_one(self, unit, ti):
+        from dprf_tpu.runtime.worker import (Hit, word_cover_range,
+                                             wordlist_lane_to_gidx)
+        R = self.gen.n_rules
+        w_start, w_end = word_cover_range(unit, R)
+        targ = self._targs[ti]
+        hits = []
+        queued = []
+        for ws in range(w_start, w_end, self.word_batch):
+            nw = min(self.word_batch, w_end - ws, self.gen.n_words - ws)
+            if nw <= 0:
+                break
+            queued.append((ws, nw, self.step(
+                jnp.int32(ws), jnp.int32(nw), *targ)))
+        for ws, nw, (cnt, lanes, _) in queued:
+            cnt = int(cnt)
+            if cnt == 0:
+                continue
+            if cnt > self.hit_capacity:
+                start = max(unit.start, ws * R)
+                end = min(unit.end, (ws + nw) * R)
+                hits.extend(self._rescan(start, end, ti))
+                continue
+            for lane in np.asarray(lanes):
+                if lane < 0:
+                    continue
+                gidx = wordlist_lane_to_gidx(int(lane), ws,
+                                             self.word_batch, R)
+                if not unit.start <= gidx < unit.end:
+                    continue
+                hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+
+@register("wpa2-eapol", device="jax")
+@register("wpa2", device="jax")
+class JaxWpa2EapolEngine(Wpa2EapolEngine):
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return Wpa2MaskWorker(self, gen, targets,
+                              batch=min(batch, 1 << 13),
+                              hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return Wpa2WordlistWorker(self, gen, targets,
+                                  batch=min(batch, 1 << 13),
+                                  hit_capacity=hit_capacity,
+                                  oracle=oracle)
