@@ -7,9 +7,10 @@ fusions.  At the throughputs these engines target, those intermediate
 writes are the bandwidth floor.  This kernel keeps the whole chain --
 mixed-radix decode, charset lookup, message packing (with UTF-16LE
 widening for NTLM), the full compression rounds, compare, hit
-reduction -- in VMEM/registers, and writes only TWO int32 scalars per
-grid cell (hit count + hit lane) back to HBM: the HBM traffic per
-candidate is ~8/TILE bytes instead of ~(L+4W).
+reduction -- in VMEM/registers, and writes one packed int32 per grid
+cell -- (count << 16) | (hit_lane + 1), splatted over the minimum
+(8, 128) Mosaic output block -- back to HBM: ~4096/TILE bytes per
+candidate (1 byte at sub=32) instead of ~(L+4W).
 
 The compression rounds themselves are imported from the same modules
 the XLA path uses (md5_rounds/sha1_rounds/md4_rounds), so there is one
@@ -303,19 +304,24 @@ def _build_kernel(engine_name: str, radices, seg_tables, length: int,
     body = _build_kernel_body(engine_name, radices, seg_tables, length,
                               target, sub)
 
+    # Mosaic requires output blocks of (8k, 128m) lanes (or whole-array),
+    # so the two per-tile scalars are packed into one int32 --
+    # (count << 16) | (hit_lane + 1) -- splat across a full (8, 128)
+    # block per grid cell (~1 byte/candidate of HBM traffic at sub=32;
+    # noise next to the compression rounds).  count and hit_lane+1 both
+    # fit 15/16 bits because tile = sub*128 <= 16384 (sub <= 128).
     if multi:
-        def kernel(base_ref, nvalid_ref, tables_ref, counts_ref,
-                   hitlane_ref):
+        def kernel(base_ref, nvalid_ref, tables_ref, out_ref):
             count, hit_lane = body(pl.program_id(0), base_ref,
                                    nvalid_ref[0], tables_ref)
-            counts_ref[0, 0] = count
-            hitlane_ref[0, 0] = hit_lane
+            packed = (count << 16) | (hit_lane + 1)
+            out_ref[...] = jnp.full((8, 128), packed, jnp.int32)
     else:
-        def kernel(base_ref, nvalid_ref, counts_ref, hitlane_ref):
+        def kernel(base_ref, nvalid_ref, out_ref):
             count, hit_lane = body(pl.program_id(0), base_ref,
                                    nvalid_ref[0])
-            counts_ref[0, 0] = count
-            hitlane_ref[0, 0] = hit_lane
+            packed = (count << 16) | (hit_lane + 1)
+            out_ref[...] = jnp.full((8, 128), packed, jnp.int32)
 
     return kernel
 
@@ -371,6 +377,9 @@ def make_mask_pallas_fn(engine_name: str, gen, target_words: np.ndarray,
     if not kernel_eligible(engine_name, gen, n_targets):
         raise ValueError(f"{engine_name} mask job not kernel-eligible; "
                          "use the XLA path")
+    if sub > 128:
+        raise ValueError("sub > 128 overflows the packed 16-bit "
+                         "count/lane output fields")
     grid = batch // tile
     seg_tables = [charset_segments(cs) for cs in gen.charsets]
     kernel = _build_kernel(engine_name, gen.radices, seg_tables,
@@ -384,30 +393,28 @@ def make_mask_pallas_fn(engine_name: str, gen, target_words: np.ndarray,
         tables = bloom_tables(target_words)
         R = tables.shape[0]
         in_specs.append(pl.BlockSpec((R, 128), lambda i: (0, 0)))
-    fn = pl.pallas_call(
+    raw = pl.pallas_call(
         kernel,
         grid=(grid,),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, 1), lambda i: (i, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1), lambda i: (i, 0),
-                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, 128), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((grid, 1), jnp.int32),
-            jax.ShapeDtypeStruct((grid, 1), jnp.int32),
+            jax.ShapeDtypeStruct((grid * 8, 128), jnp.int32),
         ],
         interpret=interpret,
     )
-    if not multi:
-        return fn
-    tables_dev = jnp.asarray(tables)
+    tables_dev = jnp.asarray(tables) if multi else None
 
-    def fn_multi(base_digits, n_valid):
-        return fn(base_digits, n_valid, tables_dev)
+    def fn(base_digits, n_valid):
+        args = (base_digits, n_valid, tables_dev) if multi else \
+            (base_digits, n_valid)
+        (packed,) = raw(*args)
+        p = packed[::8, 0:1]          # row 0 of each tile's block
+        return p >> 16, (p & 0xFFFF) - 1
 
-    return fn_multi
+    return fn
 
 
 def make_pallas_mask_crack_step(engine_name: str, gen,
