@@ -655,3 +655,92 @@ class Pmkid2Engine(HashEngine):
 register("pmkid")(Pmkid2Engine)
 register("sha-1")(Sha1Engine)
 register("sha-256")(Sha256Engine)
+
+
+class _HmacCpuMixin(HashEngine):
+    """CPU oracle for the HMAC fast modes over ``hexdigest:salt`` lines:
+    key = $pass, message = $salt (hashcat 50/150/1450) or key = $salt,
+    message = $pass (60/160/1460)."""
+
+    salted = True
+    _algo: str
+    _key_is_pass: bool
+
+    def parse_target(self, text: str) -> Target:
+        digest, salt = parse_salted_line(text, self.digest_size)
+        return Target(raw=text.strip(), digest=digest,
+                      params={"salt": salt})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError(f"{self.name} needs target params (salt)")
+        salt = params["salt"]
+        if self._key_is_pass:
+            return [hmac.new(c, salt, self._algo).digest()
+                    for c in candidates]
+        return [hmac.new(salt, c, self._algo).digest()
+                for c in candidates]
+
+
+def _register_hmac_cpu(algo: str, digest_size: int):
+    for key_is_pass in (True, False):
+        name = f"hmac-{algo}" + ("" if key_is_pass else "-salt")
+        key, msg = (("$pass", "$salt") if key_is_pass
+                    else ("$salt", "$pass"))
+        cls = type(f"Hmac{algo.title()}{'Pass' if key_is_pass else 'Salt'}"
+                   "Engine", (_HmacCpuMixin,),
+                   {"name": name, "digest_size": digest_size,
+                    "_algo": algo, "_key_is_pass": key_is_pass,
+                    "__doc__": (f"HMAC-{algo.upper()} (key = {key}, "
+                                f"message = {msg}); 'hexdigest:salt' "
+                                "lines."),
+                    # key = $pass: candidate must fit one key block;
+                    # key = $salt: candidate is a one-block message.
+                    "max_candidate_len": 64 if key_is_pass else 55})
+        register(name, device="cpu")(cls)
+
+
+_register_hmac_cpu("md5", 16)
+_register_hmac_cpu("sha1", 20)
+_register_hmac_cpu("sha256", 32)
+
+
+@register("jwt-hs256")
+@register("jwt")
+class JwtHs256Engine(HashEngine):
+    """JWT HS256 (hashcat 16500): HMAC-SHA256(secret, signing input)
+    where a target line is the full ``header.payload.signature`` token
+    (base64url) and the signing input ``header.payload`` is a per-target
+    message constant."""
+
+    name = "jwt-hs256"
+    digest_size = 32
+    salted = True
+    max_candidate_len = 64
+
+    @staticmethod
+    def _b64url(text: str) -> bytes:
+        import base64
+        pad = "=" * (-len(text) % 4)
+        return base64.urlsafe_b64decode(text + pad)
+
+    def parse_target(self, text: str) -> Target:
+        parts = text.strip().split(".")
+        if len(parts) != 3:
+            raise ValueError(f"expected header.payload.signature JWT, "
+                             f"got {text!r}")
+        sig = self._b64url(parts[2])
+        if len(sig) != self.digest_size:
+            raise ValueError(
+                f"JWT signature must be {self.digest_size} bytes "
+                f"(HS256), got {len(sig)} from {text!r}")
+        msg = (parts[0] + "." + parts[1]).encode("ascii")
+        return Target(raw=text.strip(), digest=sig, params={"msg": msg})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError("jwt-hs256 needs target params (msg)")
+        return [hmac.new(c, params["msg"], hashlib.sha256).digest()
+                for c in candidates]

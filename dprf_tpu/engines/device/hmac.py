@@ -1,0 +1,390 @@
+"""Device HMAC engines: hmac-md5/sha1/sha256 with key = $pass
+(hashcat 50/150/1450) or key = $salt (60/160/1460), and JWT HS256
+(16500).
+
+Same per-target sweep shape as the salted fast modes (the salt -- here
+the HMAC message or key -- is a runtime argument, so ONE compiled step
+serves every target); the digest chain is ops/hmac.py's generalized
+two-compression-keyed HMAC.  JWT differs: its message (the signing
+input ``header.payload``) is a per-target constant that may span
+several blocks, so JWT steps are compiled per target with the message
+baked in as constant blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.base import Target
+from dprf_tpu.engines.cpu.engines import (SALT_MAX, JwtHs256Engine,
+                                          parse_salted_line)
+from dprf_tpu.engines.device.engines import (JaxMd5Engine, JaxSha1Engine,
+                                             JaxSha256Engine)
+from dprf_tpu.engines.device.salted import (SaltedMaskWorker,
+                                            SaltedWordlistWorker,
+                                            ShardedSaltedMaskWorker,
+                                            _SaltedWorkerBase)
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops import pack as pack_ops
+from dprf_tpu.ops.hmac import (hmac_const_msg, hmac_one_block_msg,
+                               key_states, md_pad_blocks,
+                               msg_block_after_prefix, pack_raw_varlen)
+
+
+def _hmac_digest(algo: str, key_is_pass: bool, cand, lengths,
+                 salt, salt_len, big_endian: bool):
+    """The shared digest chain: cand uint8[B, L] + per-lane lengths +
+    runtime salt buffer -> HMAC digest uint32[B, W]."""
+    if key_is_pass:
+        kw = pack_raw_varlen(cand, lengths, big_endian)
+        istate, ostate = key_states(algo, kw)
+        msg = msg_block_after_prefix(salt[None, :], salt_len[None],
+                                     big_endian)
+        return hmac_one_block_msg(algo, istate, ostate, msg[0])
+    salt64 = jnp.pad(salt, (0, 64 - SALT_MAX))
+    kw = pack_ops._words_from_bytes(salt64[None, :], big_endian)
+    istate, ostate = key_states(algo, kw)
+    msg = msg_block_after_prefix(cand, lengths, big_endian)
+    return hmac_one_block_msg(algo, istate, ostate, msg)
+
+
+def make_hmac_mask_step(engine, gen, batch: int, hit_capacity: int = 64):
+    """step(base_digits, n_valid, salt, salt_len, target) ->
+    (count, lanes, _): the salted-step contract, HMAC digest chain."""
+    flat = gen.flat_charsets
+    length = gen.length
+    algo, key_is_pass = engine._algo, engine._key_is_pass
+    big_endian = not engine.little_endian
+
+    @jax.jit
+    def step(base_digits, n_valid, salt, salt_len, target):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        lengths = jnp.full((batch,), length, jnp.int32)
+        digest = _hmac_digest(algo, key_is_pass, cand, lengths,
+                              salt, salt_len, big_endian)
+        found = cmp_ops.compare_single(digest, target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def make_hmac_wordlist_step(engine, gen, word_batch: int,
+                            hit_capacity: int = 64):
+    from jax import lax
+
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, L = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+    algo, key_is_pass = engine._algo, engine._key_is_pass
+    big_endian = not engine.little_endian
+
+    @jax.jit
+    def step(w0, n_valid_words, salt, salt_len, target):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
+        digest = _hmac_digest(algo, key_is_pass, cw, cl,
+                              salt, salt_len, big_endian)
+        found = cmp_ops.compare_single(digest, target) & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
+                                    hit_capacity)
+
+    return step
+
+
+def make_sharded_hmac_mask_step(engine, gen, mesh, batch_per_device: int,
+                                hit_capacity: int = 64):
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from dprf_tpu.parallel.mesh import SHARD_AXIS
+
+    flat = gen.flat_charsets
+    length = gen.length
+    B = batch_per_device
+    algo, key_is_pass = engine._algo, engine._key_is_pass
+    big_endian = not engine.little_endian
+
+    def shard_fn(base_digits, n_valid, salt, salt_len, target):
+        dev = lax.axis_index(SHARD_AXIS)
+        offset = (dev * B).astype(jnp.int32)
+        cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
+        lengths = jnp.full((B,), length, jnp.int32)
+        digest = _hmac_digest(algo, key_is_pass, cand, lengths,
+                              salt, salt_len, big_endian)
+        lane_global = offset + jnp.arange(B, dtype=jnp.int32)
+        found = cmp_ops.compare_single(digest, target) & \
+            (lane_global < n_valid)
+        count, lanes, tpos = cmp_ops.compact_hits(
+            found, jnp.zeros((B,), jnp.int32), hit_capacity)
+        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
+        total = lax.psum(count, SHARD_AXIS)
+        return (total[None],
+                lax.all_gather(count, SHARD_AXIS),
+                lax.all_gather(lanes, SHARD_AXIS),
+                lax.all_gather(tpos, SHARD_AXIS))
+
+    sharded = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(),) * 5,
+        out_specs=(P(), P(), P(), P()), check_vma=False)
+
+    @jax.jit
+    def step(base_digits, n_valid, salt, salt_len, target):
+        total, counts, lanes, tpos = sharded(base_digits, n_valid, salt,
+                                             salt_len, target)
+        return total[0], counts, lanes, tpos
+
+    step.super_batch = mesh.devices.size * B
+    return step
+
+
+class HmacMaskWorker(SaltedMaskWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 18,
+                 hit_capacity: int = 64, oracle=None):
+        _SaltedWorkerBase.__init__(self, engine, gen, targets, batch,
+                                   hit_capacity, oracle)
+        self.stride = batch
+        self.step = make_hmac_mask_step(engine, gen, batch, hit_capacity)
+
+
+class HmacWordlistWorker(SaltedWordlistWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 18,
+                 hit_capacity: int = 64, oracle=None):
+        _SaltedWorkerBase.__init__(self, engine, gen, targets, batch,
+                                   hit_capacity, oracle)
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self.step = make_hmac_wordlist_step(engine, gen, self.word_batch,
+                                            hit_capacity)
+
+
+class ShardedHmacMaskWorker(ShardedSaltedMaskWorker):
+    def __init__(self, engine, gen, targets, mesh,
+                 batch_per_device: int = 1 << 18, hit_capacity: int = 64,
+                 oracle=None):
+        _SaltedWorkerBase.__init__(self, engine, gen, targets,
+                                   mesh.devices.size * batch_per_device,
+                                   hit_capacity, oracle)
+        self.mesh = mesh
+        self.stride = self.batch
+        self.step = make_sharded_hmac_mask_step(
+            engine, gen, mesh, batch_per_device, hit_capacity)
+
+
+class _HmacDeviceMixin:
+    """Device engine for one (algo, key side): parsing from the CPU
+    convention, workers over the runtime-salt HMAC steps."""
+
+    salted = True
+    _algo: str
+    _key_is_pass: bool
+
+    def parse_target(self, text: str) -> Target:
+        digest, salt = parse_salted_line(text, self.digest_size)
+        return Target(raw=text.strip(), digest=digest,
+                      params={"salt": salt})
+
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        self._check_len(gen.length)
+        return HmacMaskWorker(self, gen, targets, batch=batch,
+                              hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        self._check_len(gen.max_len)
+        return HmacWordlistWorker(self, gen, targets, batch=batch,
+                                  hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_sharded_mask_worker(self, gen, targets, mesh,
+                                 batch_per_device: int, hit_capacity: int,
+                                 oracle=None):
+        self._check_len(gen.length)
+        return ShardedHmacMaskWorker(self, gen, targets, mesh,
+                                     batch_per_device=batch_per_device,
+                                     hit_capacity=hit_capacity,
+                                     oracle=oracle)
+
+    # message/key structure is keyed per candidate: the generic unsalted
+    # workers would compare plain digests -- shadow them (CLI degrades
+    # with a warning exactly as for the salted modes)
+    make_sharded_wordlist_worker = None
+    make_combinator_worker = None
+    make_sharded_combinator_worker = None
+
+    def _check_len(self, cand_len: int) -> None:
+        if cand_len > self.max_candidate_len:
+            raise ValueError(
+                f"{self.name}: candidates up to {cand_len} bytes exceed "
+                f"the {self.max_candidate_len}-byte limit "
+                + ("(one HMAC key block)" if self._key_is_pass
+                   else "(one message block)"))
+
+
+def _register_hmac_device(base_cls, algo: str):
+    for key_is_pass in (True, False):
+        name = f"hmac-{algo}" + ("" if key_is_pass else "-salt")
+        key, msg = (("$pass", "$salt") if key_is_pass
+                    else ("$salt", "$pass"))
+        cls = type(f"JaxHmac{algo.title()}"
+                   f"{'Pass' if key_is_pass else 'Salt'}Engine",
+                   (_HmacDeviceMixin, base_cls),
+                   {"name": name, "_algo": algo,
+                    "_key_is_pass": key_is_pass,
+                    "__doc__": (f"Device HMAC-{algo.upper()} "
+                                f"(key = {key}, message = {msg})."),
+                    "max_candidate_len": 64 if key_is_pass else 55})
+        register(name, device="jax")(cls)
+
+
+_register_hmac_device(JaxMd5Engine, "md5")
+_register_hmac_device(JaxSha1Engine, "sha1")
+_register_hmac_device(JaxSha256Engine, "sha256")
+
+
+# -- JWT HS256 ---------------------------------------------------------------
+
+def make_jwt_mask_step(gen, msg: bytes, target_words: np.ndarray,
+                       batch: int, hit_capacity: int = 64):
+    """Per-target step: the signing input is baked in as constant
+    blocks.  step(base_digits, n_valid) -> (count, lanes, _)."""
+    flat = gen.flat_charsets
+    length = gen.length
+    blocks = md_pad_blocks(msg, big_endian=True)
+    target = jnp.asarray(target_words)
+
+    @jax.jit
+    def step(base_digits, n_valid):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        kw = pack_ops.pack_raw(cand, length, big_endian=True)
+        istate, ostate = key_states("sha256", kw)
+        digest = hmac_const_msg("sha256", istate, ostate, blocks)
+        found = cmp_ops.compare_single(digest, target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def make_jwt_wordlist_step(gen, msg: bytes, target_words: np.ndarray,
+                           word_batch: int, hit_capacity: int = 64):
+    from jax import lax
+
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, L = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+    blocks = md_pad_blocks(msg, big_endian=True)
+    target = jnp.asarray(target_words)
+
+    @jax.jit
+    def step(w0, n_valid_words):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
+        kw = pack_raw_varlen(cw, cl, big_endian=True)
+        istate, ostate = key_states("sha256", kw)
+        digest = hmac_const_msg("sha256", istate, ostate, blocks)
+        found = cmp_ops.compare_single(digest, target) & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
+                                    hit_capacity)
+
+    return step
+
+
+def _jwt_setup(worker, engine, gen, targets, batch, hit_capacity, oracle):
+    """Shared field setup for the JWT workers (their per-target state is
+    a compiled step, not a (salt, target) pair, so _SaltedWorkerBase's
+    __init__ does not apply)."""
+    worker.engine = engine
+    worker.gen = gen
+    worker.targets = list(targets)
+    worker.hit_capacity = hit_capacity
+    worker.oracle = oracle
+    worker.batch = batch
+
+
+def _jwt_twords(t) -> np.ndarray:
+    return np.frombuffer(t.digest, dtype=">u4").astype(np.uint32)
+
+
+class JwtMaskWorker(SaltedMaskWorker):
+    """Per-target sweep with per-target compiled steps (the signing
+    input is a trace-time constant); hit extraction is inherited from
+    the salted worker via the _invoke override point."""
+
+    def __init__(self, engine, gen, targets, batch: int = 1 << 18,
+                 hit_capacity: int = 64, oracle=None):
+        _jwt_setup(self, engine, gen, targets, batch, hit_capacity,
+                   oracle)
+        self.stride = batch
+        self._steps = [
+            make_jwt_mask_step(gen, t.params["msg"], _jwt_twords(t),
+                               batch, hit_capacity)
+            for t in self.targets]
+
+    def _invoke(self, ti: int, base, n):
+        return self._steps[ti](base, n)
+
+
+class JwtWordlistWorker(SaltedWordlistWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 18,
+                 hit_capacity: int = 64, oracle=None):
+        _jwt_setup(self, engine, gen, targets, batch, hit_capacity,
+                   oracle)
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self._steps = [
+            make_jwt_wordlist_step(gen, t.params["msg"], _jwt_twords(t),
+                                   self.word_batch, hit_capacity)
+            for t in self.targets]
+
+    def _invoke(self, ti: int, base, n):
+        return self._steps[ti](base, n)
+
+
+@register("jwt-hs256", device="jax")
+@register("jwt", device="jax")
+class JaxJwtHs256Engine(JwtHs256Engine):
+    """Device JWT HS256: per-target constant signing input, candidate
+    secret as the HMAC key.  Inherits parsing and the oracle hash_batch
+    from the CPU engine (the PMKID pattern -- one definition, so oracle
+    and device can never silently diverge) and adds the device worker
+    factories."""
+
+    little_endian = False
+    digest_words = 8
+
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return JwtMaskWorker(self, gen, targets, batch=batch,
+                             hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return JwtWordlistWorker(self, gen, targets, batch=batch,
+                                 hit_capacity=hit_capacity, oracle=oracle)
+
+    make_sharded_mask_worker = None
+    make_sharded_wordlist_worker = None
+    make_combinator_worker = None
+    make_sharded_combinator_worker = None

@@ -214,16 +214,22 @@ class SaltedMaskWorker(_SaltedWorkerBase):
         self.step = make_salted_mask_step(engine, gen, batch,
                                           engine.order, hit_capacity)
 
+    def _invoke(self, ti: int, base, n):
+        """One step call for target ti -- the override point for worker
+        families whose per-target state isn't a (salt, target) pair
+        (e.g. JWT's per-target compiled steps)."""
+        salt, salt_len, tgt = self._targs[ti]
+        return self.step(base, n, salt, salt_len, tgt)
+
     def process(self, unit: WorkUnit) -> list[Hit]:
         hits: list[Hit] = []
         for ti in range(len(self.targets)):
-            salt, salt_len, tgt = self._targs[ti]
             queued = []
             for bstart in range(unit.start, unit.end, self.stride):
                 n_valid = min(self.stride, unit.end - bstart)
                 base = jnp.asarray(self.gen.digits(bstart), dtype=jnp.int32)
-                queued.append((bstart, self.step(
-                    base, jnp.int32(n_valid), salt, salt_len, tgt)))
+                queued.append((bstart, self._invoke(
+                    ti, base, jnp.int32(n_valid))))
             for bstart, (count, lanes, _) in queued:
                 count = int(count)
                 if count == 0:
@@ -249,19 +255,20 @@ class SaltedWordlistWorker(_SaltedWorkerBase):
         self.step = make_salted_wordlist_step(engine, gen, self.word_batch,
                                               engine.order, hit_capacity)
 
+    _invoke = SaltedMaskWorker._invoke
+
     def process(self, unit: WorkUnit) -> list[Hit]:
         R = self.gen.n_rules
         w_start, w_end = word_cover_range(unit, R)
         hits: list[Hit] = []
         for ti in range(len(self.targets)):
-            salt, salt_len, tgt = self._targs[ti]
             queued = []
             for ws in range(w_start, w_end, self.word_batch):
                 nw = min(self.word_batch, w_end - ws, self.gen.n_words - ws)
                 if nw <= 0:
                     break
-                queued.append((ws, nw, self.step(
-                    jnp.int32(ws), jnp.int32(nw), salt, salt_len, tgt)))
+                queued.append((ws, nw, self._invoke(
+                    ti, jnp.int32(ws), jnp.int32(nw))))
             for ws, nw, (count, lanes, _) in queued:
                 count = int(count)
                 if count == 0:
@@ -301,14 +308,13 @@ class ShardedSaltedMaskWorker(SaltedMaskWorker):
     def process(self, unit: WorkUnit) -> list[Hit]:
         hits: list[Hit] = []
         for ti in range(len(self.targets)):
-            salt, salt_len, tgt = self._targs[ti]
             queued = []
             for bstart in range(unit.start, unit.end, self.stride):
                 n_valid = min(self.stride, unit.end - bstart)
                 base = jnp.asarray(self.gen.digits(bstart),
                                    dtype=jnp.int32)
-                queued.append((bstart, self.step(
-                    base, jnp.int32(n_valid), salt, salt_len, tgt)))
+                queued.append((bstart, self._invoke(
+                    ti, base, jnp.int32(n_valid))))
             for bstart, (total, counts, lanes, _) in queued:
                 if int(total) == 0:
                     continue

@@ -1,0 +1,143 @@
+"""Generic HMAC over the shared 64-byte-block compression cores.
+
+Powers the keyed-digest engine family (SURVEY.md §A fixes the
+HashEngine plugin surface; these are the hashcat-class keyed modes on
+top of the same cores every other path uses):
+
+- hmac-md5 / hmac-sha1 / hmac-sha256, key = $pass (hashcat 50/150/1450)
+  and key = $salt (60/160/1460), line format ``hexdigest:salt``.
+- JWT HS256 (hashcat 16500): HMAC-SHA256 over the signing input
+  ``b64url(header).b64url(payload)`` -- a per-target host constant that
+  may span several blocks.
+
+Device shape: the HMAC key pad is one xor when the key fits one block
+(keys here are candidates <= 64 bytes or salts <= 32), so the keyed
+chaining states cost two compressions per candidate and every message
+block after them is either a runtime-built single block (salt/candidate
+message) or a host-built constant chain (JWT signing input).  This is
+the same structure ops/hmac_sha1.py exploits for PBKDF2; this module
+generalizes it over {md5, sha1, sha256} without touching the SHA-1
+specialization the PMKID hot loop uses.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from dprf_tpu.ops.md5 import INIT as MD5_INIT, md5_compress
+from dprf_tpu.ops.sha1 import INIT as SHA1_INIT, sha1_compress
+from dprf_tpu.ops.sha256 import INIT as SHA256_INIT, sha256_compress
+from dprf_tpu.ops.pack import _words_from_bytes
+
+_IPAD = np.uint32(0x36363636)
+_OPAD = np.uint32(0x5C5C5C5C)
+
+#: algo -> (compress(state, words16) -> state, init words, state words,
+#: big-endian word packing)
+ALGOS = {
+    "md5": (md5_compress, MD5_INIT, 4, False),
+    "sha1": (sha1_compress, SHA1_INIT, 5, True),
+    "sha256": (sha256_compress, SHA256_INIT, 8, True),
+}
+
+
+def key_states(algo: str, key_words: jnp.ndarray):
+    """Keyed chaining states from zero-padded one-block keys.
+
+    key_words: uint32[..., 16], raw zero padding (no MD marker).
+    Returns (istate, ostate) uint32[..., W].
+    """
+    compress, init, W, _ = ALGOS[algo]
+    init_b = jnp.broadcast_to(jnp.asarray(init),
+                              key_words.shape[:-1] + (W,))
+    return (compress(init_b, key_words ^ _IPAD),
+            compress(init_b, key_words ^ _OPAD))
+
+
+def msg_block_after_prefix(msg: jnp.ndarray, lengths: jnp.ndarray,
+                           big_endian: bool) -> jnp.ndarray:
+    """Variable-length message bytes -> the MD-padded block that FOLLOWS
+    a single 64-byte prefix block (the xored key block): bit count is
+    (64 + len) * 8.
+
+    msg: uint8[B, maxlen <= 55]; lengths: int32[B].  Bytes at or beyond
+    each lane's length may be garbage -- they are masked here.
+    """
+    batch, maxlen = msg.shape
+    if maxlen > 55:
+        raise ValueError("one-block message needs maxlen <= 55")
+    pos = jnp.arange(64, dtype=jnp.int32)
+    lens = lengths[:, None]
+    padded = jnp.zeros((batch, 64), dtype=jnp.uint8).at[:, :maxlen].set(msg)
+    buf = jnp.where(pos < lens, padded, 0).astype(jnp.uint8)
+    buf = buf + jnp.where(pos == lens, jnp.uint8(0x80), jnp.uint8(0))
+    words = _words_from_bytes(buf, big_endian)
+    bits = (lengths.astype(jnp.uint32) + 64) * 8
+    return words.at[:, 15 if big_endian else 14].set(bits)
+
+
+def pack_raw_varlen(cand: jnp.ndarray, lengths: jnp.ndarray,
+                    big_endian: bool) -> jnp.ndarray:
+    """Variable-length HMAC keys -> zero-extended full blocks
+    uint32[B, 16] (no MD marker; bytes beyond each length are masked)."""
+    batch, maxlen = cand.shape
+    if maxlen > 64:
+        raise ValueError("key block packing needs maxlen <= 64")
+    pos = jnp.arange(64, dtype=jnp.int32)
+    padded = jnp.zeros((batch, 64), dtype=jnp.uint8).at[:, :maxlen].set(cand)
+    buf = jnp.where(pos < lengths[:, None], padded, 0).astype(jnp.uint8)
+    return _words_from_bytes(buf, big_endian)
+
+
+def digest_tail_block(algo: str, dwords: jnp.ndarray) -> jnp.ndarray:
+    """Inner-hash digest -> the outer hash's message block (digest bytes
+    after the 64-byte opad block): uint32[..., 16]."""
+    _, _, W, big_endian = ALGOS[algo]
+    batch = dwords.shape[:-1]
+    block = jnp.zeros(batch + (16,), jnp.uint32).at[..., :W].set(dwords)
+    marker = jnp.uint32(0x80000000 if big_endian else 0x80)
+    block = block.at[..., W].set(marker)
+    bits = jnp.uint32((64 + 4 * W) * 8)
+    return block.at[..., 15 if big_endian else 14].set(bits)
+
+
+def hmac_one_block_msg(algo: str, istate: jnp.ndarray, ostate: jnp.ndarray,
+                       msg_block: jnp.ndarray) -> jnp.ndarray:
+    """HMAC digest when the whole padded message fits one block after
+    the key block.  msg_block: uint32[B, 16] or [16] (broadcast)."""
+    compress = ALGOS[algo][0]
+    if msg_block.ndim == 1:
+        msg_block = jnp.broadcast_to(msg_block, istate.shape[:-1] + (16,))
+    inner = compress(istate, msg_block)
+    return compress(ostate, digest_tail_block(algo, inner))
+
+
+def hmac_const_msg(algo: str, istate: jnp.ndarray, ostate: jnp.ndarray,
+                   blocks: np.ndarray) -> jnp.ndarray:
+    """HMAC digest of a host-constant message (pre-padded blocks from
+    md_pad_blocks) -- the JWT signing-input shape."""
+    compress = ALGOS[algo][0]
+    state = istate
+    for i in range(blocks.shape[0]):
+        blk = jnp.broadcast_to(jnp.asarray(blocks[i]),
+                               state.shape[:-1] + (16,))
+        state = compress(state, blk)
+    return compress(ostate, digest_tail_block(algo, state))
+
+
+def md_pad_blocks(msg: bytes, big_endian: bool,
+                  prefix_bytes: int = 64) -> np.ndarray:
+    """Host-side MD padding of a constant message that follows
+    `prefix_bytes` of already-hashed input -> uint32[N, 16] blocks."""
+    total = prefix_bytes + len(msg)
+    buf = bytearray(msg)
+    buf.append(0x80)
+    while (prefix_bytes + len(buf)) % 64 != 56:
+        buf.append(0)
+    buf += (total * 8).to_bytes(8, "big" if big_endian else "little")
+    arr = np.frombuffer(bytes(buf), dtype=np.uint8).reshape(-1, 16, 4)
+    coef = (np.array([1 << 24, 1 << 16, 1 << 8, 1], dtype=np.uint32)
+            if big_endian else
+            np.array([1, 1 << 8, 1 << 16, 1 << 24], dtype=np.uint32))
+    return (arr.astype(np.uint32) * coef).sum(axis=-1, dtype=np.uint32)

@@ -1,0 +1,197 @@
+"""HMAC engine family (hashcat 50/60/150/160/1450/1460) and JWT HS256
+(16500): CPU oracles vs stdlib hmac, device workers vs oracles, and the
+runtime-salt block builders vs hashlib constructions."""
+
+import base64
+import hashlib
+import hmac as hmod
+import json
+
+import numpy as np
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+from dprf_tpu.runtime.workunit import WorkUnit
+
+ALGOS = ["md5", "sha1", "sha256"]
+
+
+def _mk_jwt(secret: bytes, payload: dict) -> str:
+    b64 = lambda b: base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+    h = b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    p = b64(json.dumps(payload).encode())
+    sig = b64(hmod.new(secret, (h + "." + p).encode(),
+                       hashlib.sha256).digest())
+    return h + "." + p + "." + sig
+
+
+@pytest.mark.parametrize("algo", ALGOS)
+@pytest.mark.parametrize("key_is_pass", [True, False])
+def test_cpu_oracle_matches_stdlib(algo, key_is_pass):
+    name = f"hmac-{algo}" + ("" if key_is_pass else "-salt")
+    eng = get_engine(name)
+    rng = np.random.RandomState(7)
+    cands = [bytes(rng.randint(1, 255, rng.randint(1, 40),
+                               dtype=np.uint8).tolist())
+             for _ in range(16)]
+    salt = b"pepper-01"
+    got = eng.hash_batch(cands, params={"salt": salt})
+    for c, d in zip(cands, got):
+        want = (hmod.new(c, salt, algo) if key_is_pass
+                else hmod.new(salt, c, algo)).digest()
+        assert d == want
+
+
+@pytest.mark.parametrize("algo", ALGOS)
+@pytest.mark.parametrize("key_is_pass", [True, False])
+def test_device_mask_worker_cracks(algo, key_is_pass):
+    name = f"hmac-{algo}" + ("" if key_is_pass else "-salt")
+    cpu = get_engine(name)
+    dev = get_engine(name, device="jax")
+    gen = MaskGenerator("?l?l?l")
+    digest = cpu.hash_batch([b"fox"], params={"salt": b"mysalt99"})[0]
+    t = cpu.parse_target(digest.hex() + ":mysalt99")
+    w = dev.make_mask_worker(gen, [t], batch=4096, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [b"fox"]
+
+
+def test_device_mask_hex_salt_and_two_targets():
+    cpu = get_engine("hmac-sha256")
+    dev = get_engine("hmac-sha256", device="jax")
+    gen = MaskGenerator("?d?d?d")
+    salt_a, salt_b = b"\x00\x01\xff", b"plain"
+    da = cpu.hash_batch([b"042"], params={"salt": salt_a})[0]
+    db = cpu.hash_batch([b"777"], params={"salt": salt_b})[0]
+    ta = cpu.parse_target(da.hex() + ":$HEX[0001ff]")
+    tb = cpu.parse_target(db.hex() + ":plain")
+    assert ta.params["salt"] == salt_a
+    w = dev.make_mask_worker(gen, [ta, tb], batch=1024, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert {(h.target_index, h.plaintext) for h in hits} == \
+        {(0, b"042"), (1, b"777")}
+
+
+@pytest.mark.parametrize("key_is_pass", [True, False])
+def test_device_wordlist_rules_worker(key_is_pass):
+    name = "hmac-sha1" + ("" if key_is_pass else "-salt")
+    cpu = get_engine(name)
+    dev = get_engine(name, device="jax")
+    from dprf_tpu.rules.parser import parse_rule
+
+    gen = WordlistRulesGenerator(
+        words=[b"apple", b"Banana", b"zebra"],
+        rules=[parse_rule(":"), parse_rule("l"), parse_rule("u")])
+    # candidate 'banana' only exists via the lowercase rule on 'Banana'
+    digest = cpu.hash_batch([b"banana"], params={"salt": b"s4lt"})[0]
+    t = cpu.parse_target(digest.hex() + ":s4lt")
+    w = dev.make_wordlist_worker(gen, [t], batch=256, hit_capacity=8,
+                                 oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert b"banana" in {h.plaintext for h in hits}
+
+
+def test_sharded_mask_worker():
+    from dprf_tpu.parallel import make_mesh
+
+    cpu = get_engine("hmac-md5")
+    dev = get_engine("hmac-md5", device="jax")
+    gen = MaskGenerator("?l?l?l")
+    digest = cpu.hash_batch([b"dog"], params={"salt": b"m"})[0]
+    t = cpu.parse_target(digest.hex() + ":m")
+    w = dev.make_sharded_mask_worker(gen, [t], make_mesh(8),
+                                     batch_per_device=512,
+                                     hit_capacity=8, oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [b"dog"]
+
+
+def test_jwt_parse_and_oracle():
+    eng = get_engine("jwt-hs256")
+    tok = _mk_jwt(b"hunter2", {"sub": "alice", "iat": 1516239022})
+    t = eng.parse_target(tok)
+    assert len(t.digest) == 32
+    assert eng.hash_batch([b"hunter2"], params=t.params)[0] == t.digest
+    assert eng.hash_batch([b"hunter3"], params=t.params)[0] != t.digest
+    with pytest.raises(ValueError):
+        eng.parse_target("only.twoparts")
+
+
+def test_jwt_device_mask_cracks():
+    cpu = get_engine("jwt-hs256")
+    dev = get_engine("jwt", device="jax")
+    # long payload -> multi-block constant signing input
+    tok = _mk_jwt(b"abc", {"sub": "1234567890", "name": "John Doe",
+                           "admin": True, "iat": 1516239022,
+                           "scope": "read write delete admin audit"})
+    t = cpu.parse_target(tok)
+    gen = MaskGenerator("?l?l?l")
+    w = dev.make_mask_worker(gen, [t], batch=4096, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [b"abc"]
+
+
+def test_jwt_device_wordlist_cracks():
+    cpu = get_engine("jwt-hs256")
+    dev = get_engine("jwt-hs256", device="jax")
+    tok = _mk_jwt(b"correcthorse", {"sub": "x"})
+    t = cpu.parse_target(tok)
+    gen = WordlistRulesGenerator(
+        words=[b"password", b"correcthorse", b"letmein"])
+    w = dev.make_wordlist_worker(gen, [t], batch=256, hit_capacity=8,
+                                 oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [b"correcthorse"]
+
+
+def test_msg_block_after_prefix_matches_reference():
+    """The runtime-built message block must equal hashlib's result:
+    HMAC with a one-block message computed via the ops chain equals
+    stdlib hmac for every salt length 0..32."""
+    import jax.numpy as jnp
+
+    from dprf_tpu.ops.hmac import (hmac_one_block_msg, key_states,
+                                   msg_block_after_prefix)
+    from dprf_tpu.ops.pack import pack_raw
+
+    key = b"k3y"
+    kw = pack_raw(jnp.asarray(np.frombuffer(key, np.uint8)[None, :]),
+                  len(key), big_endian=True)
+    ist, ost = key_states("sha256", kw)
+    for n in (0, 1, 31, 32):
+        salt = bytes(range(n))
+        buf = np.zeros(32, np.uint8)
+        buf[:n] = np.frombuffer(salt, np.uint8)
+        blk = msg_block_after_prefix(
+            jnp.asarray(np.pad(buf, (0, 32))[None, :32]),
+            jnp.asarray([n], np.int32), True)
+        got = np.asarray(hmac_one_block_msg("sha256", ist, ost, blk[0]))
+        want = np.frombuffer(hmod.new(key, salt, "sha256").digest(),
+                             ">u4")
+        assert (got[0] == want).all(), n
+
+
+def test_md_pad_blocks_matches_reference():
+    """Constant-message padding vs hashlib over 1..3 block messages."""
+    import jax.numpy as jnp
+
+    from dprf_tpu.ops.hmac import (hmac_const_msg, key_states,
+                                   md_pad_blocks)
+    from dprf_tpu.ops.pack import pack_raw
+
+    key = b"jwtsecret"
+    kw = pack_raw(jnp.asarray(np.frombuffer(key, np.uint8)[None, :]),
+                  len(key), big_endian=True)
+    ist, ost = key_states("sha256", kw)
+    for n in (0, 55, 56, 64, 119, 130):
+        msg = bytes(i & 0xFF for i in range(n))
+        blocks = md_pad_blocks(msg, big_endian=True)
+        got = np.asarray(hmac_const_msg("sha256", ist, ost, blocks))
+        want = np.frombuffer(hmod.new(key, msg, "sha256").digest(),
+                             ">u4")
+        assert (got[0] == want).all(), n
