@@ -165,18 +165,24 @@ def _build_parser() -> argparse.ArgumentParser:
     e.add_argument("--verbose", "-v", action="store_true",
                    help="one line per engine with its description")
 
-    k = sub.add_parser("keyspace", help="print the keyspace size of "
-                       "an attack (mask, wordlist+rules, combinator, "
-                       "hybrid)")
-    k.add_argument("attack_arg", metavar="mask_or_files")
-    k.add_argument("-a", "--attack", default="mask",
-                   choices=["mask", "wordlist", "combinator",
-                            "hybrid-wm", "hybrid-mw"])
-    k.add_argument("--rules", default=None)
-    k.add_argument("--max-len", type=int, default=55)
-    for i in range(1, 5):
-        k.add_argument(f"--custom{i}", default=None)
-    k.add_argument("--quiet", "-q", action="store_true")
+    for name, helptext in (
+            ("keyspace", "print the keyspace size of an attack (mask, "
+             "wordlist+rules, combinator, hybrid)"),
+            ("stdout", "print the attack's candidates, one per line, "
+             "without hashing (pipe to other tools)")):
+        k = sub.add_parser(name, help=helptext)
+        k.add_argument("attack_arg", metavar="mask_or_files")
+        k.add_argument("-a", "--attack", default="mask",
+                       choices=["mask", "wordlist", "combinator",
+                                "hybrid-wm", "hybrid-mw"])
+        k.add_argument("--rules", default=None)
+        k.add_argument("--max-len", type=int, default=55)
+        for i in range(1, 5):
+            k.add_argument(f"--custom{i}", default=None)
+        if name == "stdout":
+            k.add_argument("--skip", type=int, default=0, metavar="N")
+            k.add_argument("--limit", type=int, default=None, metavar="N")
+        k.add_argument("--quiet", "-q", action="store_true")
     return p
 
 
@@ -845,19 +851,47 @@ def cmd_engines(args, log: Log) -> int:
     return 0
 
 
-def cmd_keyspace(args, log: Log) -> int:
+def _attack_gen(args, log: Log):
+    """Engine-free generator from an attack spec (keyspace / stdout)."""
     customs = _customs(args)
     if args.attack == "mask":
-        gen = MaskGenerator(args.attack_arg, custom=customs or None)
-    elif args.attack == "wordlist":
+        return MaskGenerator(args.attack_arg, custom=customs or None)
+    if args.attack == "wordlist":
         from dprf_tpu.generators.wordlist import WordlistRulesGenerator
-        gen = WordlistRulesGenerator.from_files(
+        return WordlistRulesGenerator.from_files(
             args.attack_arg, args.rules, max_len=args.max_len)
-    else:
-        gen, _, _ = _build_combinator_gen(
-            args.attack, args.attack_arg, customs, args.max_len,
-            None, "cpu", log)
-    print(gen.keyspace)
+    gen, _, _ = _build_combinator_gen(
+        args.attack, args.attack_arg, customs, args.max_len,
+        None, "cpu", log)
+    return gen
+
+
+def cmd_keyspace(args, log: Log) -> int:
+    print(_attack_gen(args, log).keyspace)
+    return 0
+
+
+def cmd_stdout(args, log: Log) -> int:
+    """Stream the attack's candidate bytes, one per line, without
+    hashing -- for piping into other tools and for debugging what a
+    mask/rule spec actually expands to (hashcat's --stdout)."""
+    gen = _attack_gen(args, log)
+    start = max(0, args.skip)
+    end = gen.keyspace if args.limit is None else \
+        min(gen.keyspace, start + args.limit)
+    out = sys.stdout.buffer
+    try:
+        for s in range(start, end, 8192):
+            n = min(8192, end - s)
+            for c in gen.candidates(s, n):
+                if c is None:        # rule-rejected keyspace hole
+                    continue
+                out.write(c)
+                out.write(b"\n")
+        out.flush()
+    except BrokenPipeError:          # |head is normal use, not an error
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), out.fileno())
     return 0
 
 
@@ -870,6 +904,7 @@ _COMMANDS = {
     "left": cmd_left,
     "engines": cmd_engines,
     "keyspace": cmd_keyspace,
+    "stdout": cmd_stdout,
 }
 
 

@@ -306,3 +306,22 @@ def test_keyspace_modes(tmp_path, capsys):
     rc, out = run_cli(["keyspace", f"{wl},?d?d", "-a", "hybrid-wm"],
                       capsys)
     assert rc == 0 and out.strip() == "300"
+
+
+def test_stdout_mode(tmp_path, capsys):
+    """stdout streams candidates without hashing (hashcat --stdout)."""
+    rc, out = run_cli(["stdout", "?d?d", "--limit", "3"], capsys)
+    assert rc == 0 and out.split() == ["00", "01", "02"]
+    rc, out = run_cli(["stdout", "?l?l", "--skip", "2", "--limit", "2"],
+                      capsys)
+    assert rc == 0 and out.split() == ["ac", "ad"]
+    wl = tmp_path / "w.txt"
+    wl.write_text("cat\ndog\n")
+    rules = tmp_path / "r.rule"
+    rules.write_text("$1\nu\n")
+    rc, out = run_cli(["stdout", str(wl), "-a", "wordlist",
+                       "--rules", str(rules)], capsys)
+    assert rc == 0 and out.split() == ["cat1", "CAT", "dog1", "DOG"]
+    rc, out = run_cli(["stdout", f"{wl},?d", "-a", "hybrid-wm",
+                       "--limit", "2"], capsys)
+    assert rc == 0 and out.split() == ["cat0", "cat1"]
