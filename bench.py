@@ -33,29 +33,44 @@ import time
 
 BASELINE_TARGET = 1.0e11   # MD5 H/s/chip north-star target
 PROBE_DEADLINE_S = 240     # tunnel handshake + one tiny computation
-DEVICE_DEADLINE_S = 600    # two compiles + two 10 s timed runs
+DEVICE_DEADLINE_S = 900    # two compiles + calibrated timed runs
 CPU_TIMEOUT_S = 300
 
+# Each impl: calibrate with one 16-iteration device-side loop, then
+# measure with an inner loop sized to ~5 s of compute per dispatch.
+# The axon tunnel costs ~0.4 s per host round trip, so per-dispatch
+# batches would measure the link, not the chip (BENCH_r02's md5-xla
+# drained 16k queued dispatches for 108 min); run_bench(inner=N) loops
+# on device instead.
 _DEVICE_CHILD = r"""
 import json, os
 out = {{}}
 from dprf_tpu.bench import run_bench
-for impl, batch in (("pallas", 1 << 24), ("xla", 1 << 22)):
-    try:
-        out[impl] = run_bench(engine="md5", device="jax",
-                              mask="?a?a?a?a?a?a?a?a", batch=batch,
-                              seconds=10.0, impl=impl)
-    except Exception as e:
-        out[impl] = {{"error": f"{{type(e).__name__}}: {{e}}"}}
+
+def save(done=False):
+    if done:
+        out["done"] = True
     tmp = {path!r} + ".tmp"
     with open(tmp, "w") as f:
         json.dump(out, f)
     os.replace(tmp, {path!r})
-out["done"] = True
-tmp = {path!r} + ".tmp"
-with open(tmp, "w") as f:
-    json.dump(out, f)
-os.replace(tmp, {path!r})
+
+from dprf_tpu.bench import calibrated_inner
+
+for impl, batch in (("pallas", 1 << 22), ("xla", 1 << 22)):
+    try:
+        cal = run_bench(engine="md5", device="jax",
+                        mask="?a?a?a?a?a?a?a?a", batch=batch,
+                        seconds=0.1, inner=16, impl=impl)
+        inner = calibrated_inner(cal["value"], batch)
+        out[impl] = run_bench(engine="md5", device="jax",
+                              mask="?a?a?a?a?a?a?a?a", batch=batch,
+                              seconds=15.0, inner=inner, impl=impl)
+        out[impl]["calibrate_hs"] = cal["value"]
+    except Exception as e:
+        out[impl] = {{"error": f"{{type(e).__name__}}: {{e}}"}}
+    save()
+save(done=True)
 """
 
 _CPU_CHILD = r"""

@@ -17,12 +17,54 @@ from dprf_tpu.generators.mask import MaskGenerator
 from dprf_tpu.ops.pipeline import make_mask_crack_step, target_words
 
 
+def calibrated_inner(probe_rate: float, batch: int,
+                     target_s: float = 5.0, cap: int = 1 << 20) -> int:
+    """Inner-loop length so one dispatch computes ~target_s of work.
+    The cap only guards against a nonsense probe; fori_loop length does
+    not affect compile time (the loop is not unrolled)."""
+    want = max(1, int(probe_rate * target_s / batch))
+    return min(cap, 1 << (want.bit_length() - 1))
+
+
+def make_looped_step(step, inner: int):
+    """Wrap a (base_digits, n_valid) crack step in a device-side
+    fori_loop of `inner` iterations, returning only two accumulated
+    scalars.  One host dispatch then covers inner*batch candidates --
+    essential when the host<->device link is high-latency (the axon
+    tunnel adds ~0.4 s per round trip, which would otherwise bound the
+    measured rate at batch/latency regardless of chip speed).  The base
+    digits are perturbed per iteration (the decoders renormalize any
+    digit overflow) and both step outputs feed the carry, so XLA can
+    neither hoist the body out of the loop nor dead-code the hit
+    compaction."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def run(base, nv):
+        def body(i, carry):
+            c, l = carry
+            out = step(base.at[-1].add(i), nv)
+            return c + out[0].astype(jnp.int32), \
+                l + out[1].sum().astype(jnp.int32)
+        return lax.fori_loop(0, inner, body,
+                             (jnp.int32(0), jnp.int32(0)))
+
+    return run
+
+
 def run_bench(engine: str = "md5", device: str = "jax",
               mask: str = "?a?a?a?a?a?a?a?a", batch: int = 1 << 20,
-              seconds: float = 5.0, impl: str = "auto", log=None) -> dict:
+              seconds: float = 5.0, impl: str = "auto",
+              inner: int = 1, log=None) -> dict:
     """impl: "xla" forces the generic fused pipeline, "pallas" forces
     the hand-written kernel (MD5 only), "auto" = pallas on TPU when
-    eligible -- the same selection a real job makes."""
+    eligible -- the same selection a real job makes.
+
+    inner > 1 loops the step on device (see make_looped_step) and is
+    the honest way to measure chip throughput over a high-latency
+    link; inner = 1 measures the per-dispatch production path."""
     gen = MaskGenerator(mask)
     # An all-0xFF digest can't be produced by these hash functions'
     # outputs for in-keyspace candidates (and a false hit would only add
@@ -57,10 +99,12 @@ def run_bench(engine: str = "md5", device: str = "jax",
                 widen_utf16=getattr(eng, "widen_utf16", False))
         import jax.numpy as jnp
 
+        fn = make_looped_step(step, inner) if inner > 1 else step
+
         def run_batch(i):
             base = jnp.asarray(gen.digits((i * batch) % max(
                 gen.keyspace - batch, 1)), dtype=jnp.int32)
-            return step(base, jnp.int32(batch))
+            return fn(base, jnp.int32(batch))
 
         # Warmup / compile
         t0 = time.perf_counter()
@@ -68,13 +112,18 @@ def run_bench(engine: str = "md5", device: str = "jax",
         compile_s = time.perf_counter() - t0
         if log:
             log.info("bench compiled", seconds=f"{compile_s:.1f}")
-        # Timed: queue batches asynchronously, sync once at the end.
+        # Timed with BOUNDED queue depth: sync every few dispatches so
+        # the wall-time window reflects sustained throughput rather
+        # than enqueue speed (an unbounded async queue over a slow link
+        # once enqueued 16k batches in 10 s and drained for 108 min).
         n, t0 = 0, time.perf_counter()
-        last = None
+        depth = 1 if inner > 1 else 8
         while time.perf_counter() - t0 < seconds:
-            last = run_batch(n)
-            n += 1
-        jax.block_until_ready(last)
+            last = None
+            for _ in range(depth):
+                last = run_batch(n)
+                n += 1
+            jax.block_until_ready(last)
         elapsed = time.perf_counter() - t0
     else:
         eng = get_engine(engine, device="cpu")
@@ -92,7 +141,7 @@ def run_bench(engine: str = "md5", device: str = "jax",
         compile_s = 0.0
         use_pallas = False
 
-    rate = n * batch / elapsed
+    rate = n * batch * max(1, inner if device == "jax" else 1) / elapsed
     platform = jax.devices()[0].platform if device == "jax" else "cpu"
     return {
         "metric": f"{engine} candidates/sec/chip",
@@ -104,6 +153,7 @@ def run_bench(engine: str = "md5", device: str = "jax",
         "mask": mask,
         "batch": batch,
         "batches": n,
+        "inner": inner,
         "elapsed_s": round(elapsed, 3),
         "compile_s": round(compile_s, 1),
     }
@@ -111,7 +161,7 @@ def run_bench(engine: str = "md5", device: str = "jax",
 
 def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
                 n_devices: int = 8, batch_per_device: int = 1 << 20,
-                seconds: float = 5.0, log=None) -> dict:
+                seconds: float = 5.0, inner: int = 1, log=None) -> dict:
     """Scaling-efficiency mode (the second north-star number:
     >= 95% efficiency at pod scale).  Measures the sharded fused step
     at 1 chip and at n_devices chips and reports per-chip rate and
@@ -139,12 +189,13 @@ def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
             eng, gen, tgt, mesh, batch_per_device,
             widen_utf16=getattr(eng, "widen_utf16", False))
         sb = step.super_batch
+        fn = make_looped_step(step, inner) if inner > 1 else step
 
         def run_batch(i):
             base = jnp.asarray(
                 gen.digits((i * sb) % max(gen.keyspace - sb, 1)),
                 dtype=jnp.int32)
-            return step(base, jnp.int32(sb))
+            return fn(base, jnp.int32(sb))
 
         t0 = time.perf_counter()
         jax.block_until_ready(run_batch(0))
@@ -152,13 +203,17 @@ def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
         if log:
             log.info("scaling bench compiled", devices=n,
                      seconds=f"{compile_s:.1f}")
-        k, t0, last = 0, time.perf_counter(), None
+        k, t0 = 0, time.perf_counter()
+        depth = 1 if inner > 1 else 8
         while time.perf_counter() - t0 < seconds:
-            last = run_batch(k)
-            k += 1
-        jax.block_until_ready(last)
+            last = None
+            for _ in range(depth):
+                last = run_batch(k)
+                k += 1
+            jax.block_until_ready(last)
         elapsed = time.perf_counter() - t0
-        return {"rate": k * sb / elapsed, "compile_s": round(compile_s, 1),
+        return {"rate": k * sb * max(1, inner) / elapsed,
+                "compile_s": round(compile_s, 1),
                 "batches": k, "elapsed_s": round(elapsed, 3)}
 
     one = measure(1)

@@ -744,3 +744,54 @@ class JwtHs256Engine(HashEngine):
             raise ValueError("jwt-hs256 needs target params (msg)")
         return [hmac.new(c, params["msg"], hashlib.sha256).digest()
                 for c in candidates]
+
+
+@register("scrypt")
+class ScryptEngine(HashEngine):
+    """scrypt (RFC 7914; hashcat 8900): memory-hard KDF with
+    ``SCRYPT:N:r:p:<b64 salt>:<b64 dk>`` target lines.  N, r, p are
+    per-target parameters; the derived key is 32 bytes."""
+
+    name = "scrypt"
+    digest_size = 32
+    salted = True
+    max_candidate_len = 64     # one HMAC-SHA256 key block
+
+    def parse_target(self, text: str) -> Target:
+        import base64
+        parts = text.strip().split(":")
+        if len(parts) != 6 or parts[0].upper() != "SCRYPT":
+            raise ValueError(
+                f"expected SCRYPT:N:r:p:salt:dk, got {text!r}")
+        n, r, p = (int(x) for x in parts[1:4])
+        if n < 2 or n & (n - 1):
+            raise ValueError(f"scrypt N must be a power of two: {n}")
+        if n > 1 << 24:
+            # V alone would be 128*r*N bytes per candidate; an absurd N
+            # in one hostile line must not OOM the process
+            raise ValueError(f"scrypt N={n} over the 2^24 limit")
+        if not (1 <= r <= 32 and 1 <= p <= 16):
+            raise ValueError(f"unsupported scrypt r={r} p={p}")
+        salt = base64.b64decode(parts[4])
+        digest = base64.b64decode(parts[5])
+        if len(digest) != self.digest_size:
+            raise ValueError(
+                f"scrypt dk must be {self.digest_size} bytes, got "
+                f"{len(digest)}")
+        if len(salt) > PBKDF2_SALT_MAX:
+            raise ValueError(
+                f"salt longer than {PBKDF2_SALT_MAX} bytes")
+        return Target(raw=text.strip(), digest=digest,
+                      params={"salt": salt, "n": n, "r": r, "p": p})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError("scrypt needs target params (salt, n, r, p)")
+        n, r, p = params["n"], params["r"], params["p"]
+        # maxmem: V alone is 128*r*N bytes; give the libcrypto check
+        # ample headroom.
+        mem = 128 * r * n * max(1, p) * 2 + (1 << 20)
+        return [hashlib.scrypt(c, salt=params["salt"], n=n, r=r, p=p,
+                               dklen=self.digest_size, maxmem=mem)
+                for c in candidates]

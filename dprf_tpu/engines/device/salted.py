@@ -176,6 +176,10 @@ def make_sharded_salted_mask_step(engine, gen, mesh, batch_per_device: int,
 class _SaltedWorkerBase:
     """Per-target sweep shared by the salted mask/wordlist workers."""
 
+    #: device salt-buffer width; families whose step consumes a wider
+    #: runtime salt (e.g. scrypt's 51-byte PBKDF2 buffer) override it
+    SALT_WIDTH = SALT_MAX
+
     def __init__(self, engine, gen, targets: Sequence[Target],
                  batch: int, hit_capacity: int, oracle):
         self.engine = engine
@@ -188,7 +192,7 @@ class _SaltedWorkerBase:
         self._targs = []
         for t in self.targets:
             salt = t.params["salt"]
-            buf = np.zeros((SALT_MAX,), np.uint8)
+            buf = np.zeros((self.SALT_WIDTH,), np.uint8)
             buf[:len(salt)] = np.frombuffer(salt, np.uint8)
             self._targs.append((
                 jnp.asarray(buf), jnp.int32(len(salt)),

@@ -1,0 +1,158 @@
+"""scrypt (RFC 7914) as a jit-traceable device pipeline.
+
+The second memory-hard path next to bcrypt (SURVEY.md §2 class), and
+the one that actually stresses HBM: ROMix keeps V = N x 128r bytes PER
+CANDIDATE resident (16 MB at the common 16384:8:1), so the batch is
+bounded by HBM, not lanes, and throughput is bandwidth-bound by
+design -- each candidate writes V once and gathers it back once in
+data-dependent order.
+
+Device mapping:
+- Both PBKDF2-HMAC-SHA256 passes (c=1) ride the shared sha256 core:
+  pass 1 is one U1 HMAC per 32-byte output block (runtime salt via
+  u1_block); pass 2 chains the compression over B's 64-byte sub-blocks
+  (each is exactly one SHA-256 message block) plus one host-constant
+  tail block -- no byte shuffling on device.
+- Salsa20/8 and BlockMix are pure int32 vector ops over uint32[B,16]
+  lanes.
+- ROMix phase 1 is a fori_loop carrying V uint32[B, N, 128r/4] via
+  dynamic_update_slice; phase 2 gathers V rows per lane with
+  take_along_axis (Integerify is just word 0 of the last 64-byte
+  sub-block, & (N-1), already in little-endian word domain).
+- X lives in the Salsa word domain (little-endian words of the byte
+  stream); the two byteswaps at the PBKDF2 boundaries are the only
+  endianness work.
+
+N, r, p are trace-time constants (shapes depend on them); the salt is
+a runtime argument, so one compiled step serves every target sharing
+one parameter tuple.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from dprf_tpu.ops.hmac import digest_tail_block
+from dprf_tpu.ops.hmac_sha256 import hmac256_key_states
+from dprf_tpu.ops.sha256 import sha256_compress
+
+
+def bswap32(x: jnp.ndarray) -> jnp.ndarray:
+    """Byte-reverse uint32 lanes (BE digest words <-> LE Salsa words)."""
+    return ((x << 24) | ((x & jnp.uint32(0xFF00)) << 8)
+            | ((x >> 8) & jnp.uint32(0xFF00)) | (x >> 24))
+
+
+def _rotl(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    return (x << n) | (x >> (32 - n))
+
+
+# Salsa20 quarter-round index schedule (RFC 7914 / Salsa20 spec): four
+# column quarter-rounds then four row quarter-rounds per double round.
+_SALSA_QROUNDS = [
+    (4, 0, 12, 7), (8, 4, 0, 9), (12, 8, 4, 13), (0, 12, 8, 18),
+    (9, 5, 1, 7), (13, 9, 5, 9), (1, 13, 9, 13), (5, 1, 13, 18),
+    (14, 10, 6, 7), (2, 14, 10, 9), (6, 2, 14, 13), (10, 6, 2, 18),
+    (3, 15, 11, 7), (7, 3, 15, 9), (11, 7, 3, 13), (15, 11, 7, 18),
+    (1, 0, 3, 7), (2, 1, 0, 9), (3, 2, 1, 13), (0, 3, 2, 18),
+    (6, 5, 4, 7), (7, 6, 5, 9), (4, 7, 6, 13), (5, 4, 7, 18),
+    (11, 10, 9, 7), (8, 11, 10, 9), (9, 8, 11, 13), (10, 9, 8, 18),
+    (12, 15, 14, 7), (13, 12, 15, 9), (14, 13, 12, 13), (15, 14, 13, 18),
+]
+
+
+def salsa8(x: jnp.ndarray) -> jnp.ndarray:
+    """Salsa20/8 core: uint32[..., 16] -> uint32[..., 16]."""
+    w = [x[..., i] for i in range(16)]
+    for _ in range(4):      # 8 rounds = 4 double rounds
+        for dst, a, b, rot in _SALSA_QROUNDS:
+            w[dst] = w[dst] ^ _rotl(w[a] + w[b], rot)
+    return jnp.stack(w, axis=-1) + x
+
+
+def blockmix(x: jnp.ndarray) -> jnp.ndarray:
+    """scrypt BlockMix: uint32[B, 2r, 16] -> uint32[B, 2r, 16]."""
+    two_r = x.shape[-2]
+    t = x[:, -1]
+    ys = []
+    for i in range(two_r):
+        t = salsa8(t ^ x[:, i])
+        ys.append(t)
+    # even-index outputs first, then odd (the RFC's shuffle)
+    return jnp.stack(ys[0::2] + ys[1::2], axis=1)
+
+
+def romix(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """scrypt ROMix: uint32[B, 2r, 16], V of n rows per lane."""
+    B, two_r, _ = x.shape
+    F = two_r * 16
+
+    def fill(i, carry):
+        v, x = carry
+        v = lax.dynamic_update_slice(
+            v, x.reshape(B, 1, F), (0, i, 0))
+        return v, blockmix(x)
+
+    v0 = jnp.zeros((B, n, F), jnp.uint32)
+    v, x = lax.fori_loop(0, n, fill, (v0, x))
+
+    def mix(_, x):
+        j = (x[:, -1, 0] & jnp.uint32(n - 1)).astype(jnp.int32)
+        vj = jnp.take_along_axis(v, j[:, None, None], axis=1)
+        return blockmix(x ^ vj.reshape(B, two_r, 16))
+
+    return lax.fori_loop(0, n, mix, x)
+
+
+def _final_tail_block(m: int) -> np.ndarray:
+    """Host-constant last inner block of PBKDF2 pass 2: the message is
+    B (m bytes, a whole number of 64-byte blocks) || INT32BE(1), so the
+    tail holds INT(1), the 0x80 marker, and the bit length of
+    (keyblock + m + 4) bytes."""
+    buf = np.zeros(64, np.uint8)
+    buf[3] = 1          # INT32BE(1)
+    buf[4] = 0x80
+    bitlen = (64 + m + 4) * 8
+    buf[56:] = np.frombuffer(bitlen.to_bytes(8, "big"), np.uint8)
+    return (buf.reshape(16, 4).astype(np.uint32)
+            @ np.array([1 << 24, 1 << 16, 1 << 8, 1], np.uint32))
+
+
+def scrypt_dk(key_words: jnp.ndarray, salt: jnp.ndarray, salt_len,
+              n: int, r: int, p: int) -> jnp.ndarray:
+    """scrypt derived key (32 bytes): uint32[B, 8] big-endian words.
+
+    key_words: uint32[B, 16] zero-padded packed passwords (<= 64 bytes);
+    salt: uint8[SALT_MAX] runtime buffer + salt_len; n, r, p static.
+    """
+    from dprf_tpu.engines.device.pbkdf2 import u1_block
+
+    if n & (n - 1) or n < 2:
+        raise ValueError("scrypt N must be a power of two >= 2")
+    istate, ostate = hmac256_key_states(key_words)
+    B = key_words.shape[0]
+
+    # PBKDF2 pass 1, c=1: p*4r output blocks of 8 BE words each.
+    ts = []
+    for i in range(1, p * 4 * r + 1):
+        inner = sha256_compress(istate, u1_block(salt, salt_len, i))
+        ts.append(sha256_compress(ostate, digest_tail_block("sha256",
+                                                            inner)))
+    x = bswap32(jnp.concatenate(ts, axis=-1)).reshape(B, p, 2 * r, 16)
+
+    # ROMix each of the p blocks independently (p is 1 in practice).
+    mixed = [romix(x[:, pi], n) for pi in range(p)]
+    x = jnp.stack(mixed, axis=1)
+
+    # PBKDF2 pass 2, c=1, dkLen=32: message is B' || INT(1); every
+    # 64-byte sub-block of B' is exactly one SHA-256 message block.
+    blocks = bswap32(x).reshape(B, p * 2 * r, 16)
+    state = istate
+    for i in range(p * 2 * r):
+        state = sha256_compress(state, blocks[:, i])
+    tail = jnp.broadcast_to(jnp.asarray(_final_tail_block(p * 128 * r)),
+                            (B, 16))
+    inner = sha256_compress(state, tail)
+    return sha256_compress(ostate, digest_tail_block("sha256", inner))

@@ -1,0 +1,151 @@
+"""scrypt: device pipeline vs hashlib.scrypt (RFC 7914 vectors by
+construction), the engine's parse/oracle, and worker cracks with small
+N/r/p so the CPU-mesh suite stays fast."""
+
+import base64
+import hashlib
+
+import numpy as np
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+def _line(pw: bytes, salt: bytes, n: int, r: int, p: int) -> str:
+    dk = hashlib.scrypt(pw, salt=salt, n=n, r=r, p=p, dklen=32,
+                        maxmem=1 << 27)
+    return "SCRYPT:%d:%d:%d:%s:%s" % (
+        n, r, p, base64.b64encode(salt).decode(),
+        base64.b64encode(dk).decode())
+
+
+@pytest.mark.parametrize("n,r,p", [(16, 1, 1), (8, 2, 2), (32, 4, 1)])
+def test_scrypt_dk_matches_hashlib(n, r, p):
+    import jax.numpy as jnp
+
+    from dprf_tpu.ops.hmac import pack_raw_varlen
+    from dprf_tpu.ops.scrypt import scrypt_dk
+
+    pws = [b"pleaseletmein", b"", b"pw0123456789"]
+    buf = np.zeros((len(pws), 64), np.uint8)
+    lens = []
+    for i, c in enumerate(pws):
+        buf[i, :len(c)] = np.frombuffer(c, np.uint8)
+        lens.append(len(c))
+    kw = pack_raw_varlen(jnp.asarray(buf), jnp.asarray(lens, jnp.int32),
+                         True)
+    salt = b"SodiumChloride"
+    sbuf = np.zeros(51, np.uint8)
+    sbuf[:len(salt)] = np.frombuffer(salt, np.uint8)
+    dk = np.asarray(scrypt_dk(kw, jnp.asarray(sbuf),
+                              jnp.int32(len(salt)), n, r, p))
+    for i, c in enumerate(pws):
+        want = np.frombuffer(
+            hashlib.scrypt(c, salt=salt, n=n, r=r, p=p, dklen=32,
+                           maxmem=1 << 27), ">u4")
+        assert (dk[i] == want).all(), (n, r, p, c)
+
+
+def test_parse_and_oracle():
+    eng = get_engine("scrypt")
+    t = eng.parse_target(_line(b"password", b"NaCl", 16, 8, 1))
+    assert (t.params["n"], t.params["r"], t.params["p"]) == (16, 8, 1)
+    assert eng.hash_batch([b"password"], params=t.params)[0] == t.digest
+    with pytest.raises(ValueError):
+        eng.parse_target("SCRYPT:15:8:1:AA==:AA==")   # N not a power of 2
+    with pytest.raises(ValueError):
+        eng.parse_target("nonsense")
+
+
+def test_device_mask_worker_cracks():
+    cpu = get_engine("scrypt")
+    dev = get_engine("scrypt", device="jax")
+    gen = MaskGenerator("?l?l?l")
+    t = cpu.parse_target(_line(b"fox", b"pepper", 16, 1, 1))
+    w = dev.make_mask_worker(gen, [t], batch=512, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [b"fox"]
+
+
+def test_device_mixed_params_two_targets():
+    """Targets with different (N, r, p) share a worker; steps are
+    compiled per parameter tuple."""
+    cpu = get_engine("scrypt")
+    dev = get_engine("scrypt", device="jax")
+    gen = MaskGenerator("?d?d")
+    ta = cpu.parse_target(_line(b"42", b"saltA", 16, 1, 1))
+    tb = cpu.parse_target(_line(b"77", b"saltB", 8, 2, 1))
+    w = dev.make_mask_worker(gen, [ta, tb], batch=128, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert {(h.target_index, h.plaintext) for h in hits} == \
+        {(0, b"42"), (1, b"77")}
+
+
+def test_device_wordlist_worker_cracks():
+    from dprf_tpu.rules.parser import parse_rule
+
+    cpu = get_engine("scrypt")
+    dev = get_engine("scrypt", device="jax")
+    gen = WordlistRulesGenerator(
+        words=[b"apple", b"Banana", b"zebra"],
+        rules=[parse_rule(":"), parse_rule("l")])
+    t = cpu.parse_target(_line(b"banana", b"s4lt", 16, 1, 1))
+    w = dev.make_wordlist_worker(gen, [t], batch=64, hit_capacity=8,
+                                 oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert b"banana" in {h.plaintext for h in hits}
+
+
+def test_sharded_mask_worker_cracks():
+    from dprf_tpu.parallel import make_mesh
+
+    cpu = get_engine("scrypt")
+    dev = get_engine("scrypt", device="jax")
+    gen = MaskGenerator("?l?l?l")
+    t = cpu.parse_target(_line(b"dog", b"m", 8, 1, 1))
+    w = dev.make_sharded_mask_worker(gen, [t], make_mesh(8),
+                                     batch_per_device=64,
+                                     hit_capacity=8, oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [b"dog"]
+
+
+def test_batch_clamped_to_memory_cap(monkeypatch):
+    monkeypatch.setenv("DPRF_SCRYPT_MEM", str(1 << 20))   # 1 MiB cap
+    cpu = get_engine("scrypt")
+    dev = get_engine("scrypt", device="jax")
+    gen = MaskGenerator("?d?d")
+    t = cpu.parse_target(_line(b"11", b"s", 64, 1, 1))    # 8 KiB/cand
+    w = dev.make_mask_worker(gen, [t], batch=1 << 16, hit_capacity=8,
+                             oracle=cpu)
+    assert w.batch == (1 << 20) // (128 * 64)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [b"11"]
+
+
+def test_parse_rejects_huge_n():
+    eng = get_engine("scrypt")
+    with pytest.raises(ValueError):
+        eng.parse_target("SCRYPT:33554432:8:1:AA==:" +
+                         base64.b64encode(bytes(32)).decode())
+
+
+def test_wordlist_rejects_rules_over_memory_budget(monkeypatch):
+    from dprf_tpu.rules.parser import parse_rule
+
+    monkeypatch.setenv("DPRF_SCRYPT_MEM", str(1 << 16))   # 64 KiB
+    cpu = get_engine("scrypt")
+    dev = get_engine("scrypt", device="jax")
+    # 64 KiB / (128*16) = 32 candidates max; 40 rules can't fit
+    gen = WordlistRulesGenerator(
+        words=[b"a"], rules=[parse_rule(f"${c}") for c in
+                             "abcdefghijklmnopqrstuvwxyz0123456789!@#$"])
+    t = cpu.parse_target(_line(b"x", b"s", 16, 1, 1))
+    with pytest.raises(ValueError, match="memory budget"):
+        dev.make_wordlist_worker(gen, [t], batch=1 << 10,
+                                 hit_capacity=8, oracle=cpu)

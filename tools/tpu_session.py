@@ -116,23 +116,36 @@ def check_lowering():
     return out
 
 
+from dprf_tpu.bench import calibrated_inner as _calibrated_inner
+
+
 def bench_all():
+    """Each case: calibrate with a short inner loop (one dispatch, so
+    the ~0.4 s/round-trip tunnel latency can't dominate), then measure
+    ~3 dispatches at a ~5 s inner loop.  run_bench(inner=...) does the
+    device-side looping."""
     from dprf_tpu.bench import run_bench
     out = {}
     runs = [
-        ("md5-pallas", dict(engine="md5", impl="pallas", batch=1 << 24)),
+        ("md5-pallas", dict(engine="md5", impl="pallas", batch=1 << 22)),
         ("md5-xla", dict(engine="md5", impl="xla", batch=1 << 22)),
         ("ntlm-pallas", dict(engine="ntlm", impl="pallas",
-                             mask="?a?a?a?a?a?a?a", batch=1 << 24)),
-        ("sha1-pallas", dict(engine="sha1", impl="pallas", batch=1 << 24)),
+                             mask="?a?a?a?a?a?a?a", batch=1 << 22)),
+        ("sha1-pallas", dict(engine="sha1", impl="pallas", batch=1 << 22)),
         ("sha256-pallas", dict(engine="sha256", impl="pallas",
-                               batch=1 << 23)),
+                               batch=1 << 22)),
         ("sha256-xla", dict(engine="sha256", impl="xla", batch=1 << 21)),
     ]
     for name, kw in runs:
-        write_status("bench", case=name)
+        write_status("bench", case=name, phase="calibrate")
         try:
-            out[name] = run_bench(device="jax", seconds=10.0, **kw)
+            cal = run_bench(device="jax", seconds=0.1, inner=16, **kw)
+            inner = _calibrated_inner(cal["value"], kw["batch"])
+            write_status("bench", case=name, phase="measure",
+                         inner=inner, cal_hs=cal["value"])
+            out[name] = run_bench(device="jax", seconds=15.0,
+                                  inner=inner, **kw)
+            out[name]["calibrate_hs"] = cal["value"]
         except Exception as e:
             out[name] = {"error": f"{type(e).__name__}: {e}",
                          "traceback": traceback.format_exc()[-1500:]}
@@ -144,10 +157,12 @@ def bench_all():
 def sweep_sub():
     """Raw kernel throughput vs SUB (sublanes per grid cell): the main
     tuning knob.  Times the bare pallas fn (no worker machinery) on an
-    unmatchable target so the number is pure kernel rate."""
+    unmatchable target, with a device-side fori_loop per dispatch so
+    tunnel latency can't mask the differences between SUB values."""
     import numpy as np
     import jax
     import jax.numpy as jnp
+    from jax import lax
     from dprf_tpu.generators.mask import MaskGenerator
     from dprf_tpu.ops import pallas_mask as pm
 
@@ -159,23 +174,40 @@ def sweep_sub():
         write_status("sweep", case=name)
         try:
             tile = sub * 128
-            batch = max(1 << 23, tile)
-            batch = (batch // tile) * tile
+            batch = (max(1 << 22, tile) // tile) * tile
             fn = pm.make_mask_pallas_fn("md5", gen, tw, batch, sub=sub)
-            base = jnp.asarray(gen.digits(0), jnp.int32)
             nv = jnp.asarray([batch], jnp.int32)
+
+            def looped(inner, fn=fn, nv=nv):
+                @jax.jit
+                def run(base):
+                    def body(i, acc):
+                        c, l = fn(base.at[-1].add(i), nv)
+                        return acc + c.sum() + l.sum()
+                    return lax.fori_loop(0, inner, body, jnp.int32(0))
+                return run
+
+            base = jnp.asarray(gen.digits(0), jnp.int32)
+            # calibrate: compile first, then time ONE 16-iter dispatch
+            # (timing the compile here would collapse `inner` and
+            # re-measure tunnel latency -- the bug this sweep fixes)
+            cal = looped(16)
+            jax.block_until_ready(cal(base))
             t0 = time.perf_counter()
-            jax.block_until_ready(fn(base, nv))
-            compile_s = time.perf_counter() - t0
-            n, t0, last = 0, time.perf_counter(), None
-            while time.perf_counter() - t0 < 5.0:
-                last = fn(base, nv)
+            jax.block_until_ready(cal(base))
+            cal_s = time.perf_counter() - t0
+            rate = 16 * batch / max(cal_s, 1e-3)
+            inner = _calibrated_inner(rate, batch)
+            run = looped(inner)
+            jax.block_until_ready(run(base))       # compile
+            n, t0 = 0, time.perf_counter()
+            while time.perf_counter() - t0 < 10.0:
+                jax.block_until_ready(run(base))
                 n += 1
-            jax.block_until_ready(last)
             dt = time.perf_counter() - t0
-            out[name] = {"sub": sub, "hs": n * batch / dt,
-                         "batch": batch, "batches": n,
-                         "compile_s": round(compile_s, 2)}
+            out[name] = {"sub": sub, "hs": n * inner * batch / dt,
+                         "batch": batch, "inner": inner,
+                         "dispatches": n, "cal_hs": rate}
         except Exception as e:
             out[name] = {"sub": sub,
                          "error": f"{type(e).__name__}: {e}"}
