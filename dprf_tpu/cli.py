@@ -48,6 +48,9 @@ def _add_job_args(c, with_hashfile: bool = True) -> None:
                    "mask+word ('words.txt,?d?d' / '?d?d,words.txt')")
     c.add_argument("--rules", default=None,
                    help="rule set for wordlist attacks (e.g. best64)")
+    c.add_argument("--markov", default=None, metavar="STATS",
+                   help="mask attacks: visit each position's charset in "
+                   "trained-frequency order (stats from `dprf markov`)")
     for i in range(1, 5):
         c.add_argument(f"--custom{i}", default=None,
                        help=f"custom charset ?{i}")
@@ -176,6 +179,7 @@ def _build_parser() -> argparse.ArgumentParser:
                        choices=["mask", "wordlist", "combinator",
                                 "hybrid-wm", "hybrid-mw"])
         k.add_argument("--rules", default=None)
+        k.add_argument("--markov", default=None, metavar="STATS")
         k.add_argument("--max-len", type=int, default=55)
         for i in range(1, 5):
             k.add_argument(f"--custom{i}", default=None)
@@ -183,6 +187,15 @@ def _build_parser() -> argparse.ArgumentParser:
             k.add_argument("--skip", type=int, default=0, metavar="N")
             k.add_argument("--limit", type=int, default=None, metavar="N")
         k.add_argument("--quiet", "-q", action="store_true")
+
+    from dprf_tpu.generators.markov import MAX_LEN as _MARKOV_MAX_LEN
+    t = sub.add_parser("markov", help="train per-position Markov stats "
+                       "from a wordlist (for crack --markov)")
+    t.add_argument("wordlist")
+    t.add_argument("-o", "--out", required=True, metavar="STATS",
+                   help="output stats file (.dprfstat)")
+    t.add_argument("--max-len", type=int, default=_MARKOV_MAX_LEN)
+    t.add_argument("--quiet", "-q", action="store_true")
     return p
 
 
@@ -221,7 +234,7 @@ def _wordlist_max_len(engine_name: str, engine, device: str) -> int:
 
 def _build_gen(attack: str, attack_arg: str, customs: dict,
                rules_spec, max_len: Optional[int], engine, device: str,
-               log: Log):
+               log: Log, markov: Optional[str] = None):
     """Build the candidate generator + the attack identity string.
 
     max_len: wordlist packing width; None = derive from engine/device
@@ -230,13 +243,25 @@ def _build_gen(attack: str, attack_arg: str, customs: dict,
     Returns (gen, attack_desc, max_len).
     """
     if attack == "mask":
-        gen = MaskGenerator(attack_arg, custom=customs or None)
+        counts = None
+        markov_id = ""
+        if markov:
+            from dprf_tpu.generators.markov import load_stats, stats_digest
+            counts = load_stats(markov)
+            # stats permute the index->candidate map: part of the job
+            # identity, so divergent stats files fail the fingerprint
+            markov_id = f":markov={stats_digest(counts)}"
+            log.info("markov ordering", stats=markov)
+        gen = MaskGenerator(attack_arg, custom=customs or None,
+                            markov_counts=counts)
         log.info("keyspace", mask=attack_arg, size=gen.keyspace)
         # Custom charsets change which candidate an index decodes to, so
         # they are part of the job identity.
         attack_desc = f"mask:{attack_arg}" + "".join(
-            f":{i}={customs[i].hex()}" for i in sorted(customs))
+            f":{i}={customs[i].hex()}" for i in sorted(customs)) + markov_id
         return gen, attack_desc, None
+    if markov:
+        raise ValueError("--markov applies to mask attacks only")
 
     if attack in ("combinator", "hybrid-wm", "hybrid-mw"):
         return _build_combinator_gen(attack, attack_arg, customs,
@@ -447,7 +472,9 @@ def _setup_job(args, device: str, log: Log,
 
     gen, attack_desc, max_len = _build_gen(args.attack, args.attack_arg,
                                            _customs(args), args.rules, None,
-                                           engine, device, log)
+                                           engine, device, log,
+                                           markov=getattr(args, "markov",
+                                                          None))
     unit_size = _align_unit_size(args.unit_size, args.attack, gen)
 
     spec = JobSpec(engine=engine.name, device=device, attack=args.attack,
@@ -653,6 +680,7 @@ def cmd_serve(args, log: Log) -> int:
         "attack_arg": args.attack_arg,
         "customs": {str(i): v.hex() for i, v in _customs(args).items()},
         "rules": args.rules,
+        "markov": args.markov,
         "max_len": max_len,
         "targets": [t.raw for t in hl.targets],
         "keyspace": gen.keyspace,
@@ -739,7 +767,8 @@ def cmd_worker(args, log: Log) -> int:
                for i, v in job.get("customs", {}).items()}
     gen, attack_desc, _ = _build_gen(job["attack"], job["attack_arg"],
                                      customs, job.get("rules"),
-                                     job.get("max_len"), engine, device, log)
+                                     job.get("max_len"), engine, device, log,
+                                     markov=job.get("markov"))
     # Recompute the full job fingerprint locally: a wordlist or rules
     # file that differs in CONTENT (not just size) on this host would
     # silently leave coverage holes -- the unit ledger marks ranges done
@@ -855,7 +884,16 @@ def _attack_gen(args, log: Log):
     """Engine-free generator from an attack spec (keyspace / stdout)."""
     customs = _customs(args)
     if args.attack == "mask":
-        return MaskGenerator(args.attack_arg, custom=customs or None)
+        counts = None
+        if getattr(args, "markov", None):
+            from dprf_tpu.generators.markov import load_stats
+            counts = load_stats(args.markov)
+        return MaskGenerator(args.attack_arg, custom=customs or None,
+                             markov_counts=counts)
+    if getattr(args, "markov", None):
+        # same contract as crack: silently unordered output would be
+        # worse than the error
+        raise ValueError("--markov applies to mask attacks only")
     if args.attack == "wordlist":
         from dprf_tpu.generators.wordlist import WordlistRulesGenerator
         return WordlistRulesGenerator.from_files(
@@ -868,6 +906,17 @@ def _attack_gen(args, log: Log):
 
 def cmd_keyspace(args, log: Log) -> int:
     print(_attack_gen(args, log).keyspace)
+    return 0
+
+
+def cmd_markov(args, log: Log) -> int:
+    from dprf_tpu.generators.markov import (save_stats, stats_digest,
+                                            train_file)
+    counts = train_file(args.wordlist, max_len=args.max_len)
+    save_stats(args.out, counts)
+    log.info("markov stats written", out=args.out,
+             words_weight=int(counts[0].sum()),
+             digest=stats_digest(counts))
     return 0
 
 
@@ -905,6 +954,7 @@ _COMMANDS = {
     "engines": cmd_engines,
     "keyspace": cmd_keyspace,
     "stdout": cmd_stdout,
+    "markov": cmd_markov,
 }
 
 

@@ -84,9 +84,16 @@ class MaskGenerator(CandidateGenerator):
     """index -> fixed-length candidate via mixed-radix decode."""
 
     def __init__(self, mask: str,
-                 custom: Optional[Dict[int, bytes]] = None):
+                 custom: Optional[Dict[int, bytes]] = None,
+                 markov_counts: Optional[np.ndarray] = None):
         self.mask = mask
         self.charsets = parse_mask(mask, custom)
+        if markov_counts is not None:
+            # permute each position's charset into trained-frequency
+            # order: low indices decode to likely candidates, keyspace
+            # and bijection unchanged (generators/markov.py)
+            from dprf_tpu.generators.markov import reorder_charsets
+            self.charsets = reorder_charsets(self.charsets, markov_counts)
         self.length = len(self.charsets)
         self.max_length = self.length
         self.radices = tuple(len(cs) for cs in self.charsets)

@@ -1,0 +1,147 @@
+"""Per-position Markov mask ordering: training, stats round-trip,
+charset permutation (bijection preserved), CLI train + ordered crack,
+and the job-identity fingerprint."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from dprf_tpu.generators.markov import (load_stats, reorder_charsets,
+                                        save_stats, stats_digest,
+                                        train_stats)
+from dprf_tpu.generators.mask import MaskGenerator
+
+
+CORPUS = [b"password", b"pass123", b"panda", b"qwerty"]
+
+
+def test_train_counts():
+    c = train_stats(CORPUS)
+    assert c[0, ord("p")] == 3 and c[0, ord("q")] == 1
+    assert c[1, ord("a")] == 3 and c[1, ord("w")] == 1
+    assert c[7, ord("d")] == 1      # only 'password' is 8 long
+
+
+def test_stats_roundtrip(tmp_path):
+    c = train_stats(CORPUS)
+    path = tmp_path / "s.dprfstat"
+    save_stats(str(path), c)
+    back = load_stats(str(path))
+    assert (back == c).all()
+    assert stats_digest(back) == stats_digest(c)
+    with pytest.raises(ValueError):
+        load_stats(__file__)        # not a stats file
+
+
+def test_reorder_is_permutation_and_frequency_ordered():
+    c = train_stats(CORPUS)
+    base = MaskGenerator("?l?l")
+    ordered = reorder_charsets(base.charsets, c)
+    for orig, new in zip(base.charsets, ordered):
+        assert sorted(orig) == sorted(new)      # same charset, permuted
+    assert ordered[0][0] == ord("p")
+    assert ordered[1][0] == ord("a")
+
+
+def test_generator_bijection_preserved():
+    c = train_stats(CORPUS)
+    plain = MaskGenerator("?l?d")
+    ordered = MaskGenerator("?l?d", markov_counts=c)
+    assert ordered.keyspace == plain.keyspace
+    all_plain = {plain.candidate(i) for i in range(plain.keyspace)}
+    all_ordered = [ordered.candidate(i) for i in range(ordered.keyspace)]
+    assert set(all_ordered) == all_plain
+    assert len(set(all_ordered)) == len(all_ordered)
+    assert all_ordered[0][0] == ord("p")
+
+
+def test_positions_past_training_reuse_last_row():
+    c = train_stats(CORPUS, max_len=2)
+    gen = MaskGenerator("?l?l?l?l", markov_counts=c)
+    assert gen.charsets[2] == gen.charsets[1] == gen.charsets[3]
+
+
+def test_cli_train_and_markov_crack(tmp_path, capsys):
+    from tests.test_cli_e2e import run_cli
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_bytes(b"\n".join(CORPUS) + b"\n")
+    stats = tmp_path / "s.dprfstat"
+    rc, _ = run_cli(["markov", str(corpus), "-o", str(stats)], capsys)
+    assert rc == 0
+
+    hashes = tmp_path / "h.txt"
+    hashes.write_text(hashlib.md5(b"pat").hexdigest() + "\n")
+    pot = tmp_path / "pot"
+    rc, _ = run_cli(["crack", "?l?l?l", str(hashes), "--engine", "md5",
+                     "--device", "cpu", "--markov", str(stats),
+                     "--potfile", str(pot), "-q"], capsys)
+    assert rc == 0
+    assert pot.read_text().strip().endswith(":pat")
+
+    # ordered stdout leads with the trained most-likely prefix
+    rc, out = run_cli(["stdout", "?l?l", "--limit", "1",
+                       "--markov", str(stats)], capsys)
+    assert rc == 0 and out.split() == ["pa"]
+
+
+def test_markov_changes_job_fingerprint(tmp_path, capsys):
+    """Divergent stats reorder the keyspace, so they MUST change the
+    job identity (a worker with other stats would mark wrong ranges
+    done)."""
+    from dprf_tpu.cli import _build_gen
+    from dprf_tpu.utils.logging import Log
+
+    log = Log(quiet=True)
+    stats_a = tmp_path / "a.dprfstat"
+    stats_b = tmp_path / "b.dprfstat"
+    save_stats(str(stats_a), train_stats(CORPUS))
+    save_stats(str(stats_b), train_stats([b"zzz"]))
+    _, desc_none, _ = _build_gen("mask", "?l?l", {}, None, None, None,
+                                 "cpu", log)
+    _, desc_a, _ = _build_gen("mask", "?l?l", {}, None, None, None,
+                              "cpu", log, markov=str(stats_a))
+    _, desc_b, _ = _build_gen("mask", "?l?l", {}, None, None, None,
+                              "cpu", log, markov=str(stats_b))
+    assert len({desc_none, desc_a, desc_b}) == 3
+
+
+def test_markov_rejected_for_wordlist_attack(tmp_path):
+    from dprf_tpu.cli import _build_gen
+    from dprf_tpu.engines import get_engine
+    from dprf_tpu.utils.logging import Log
+
+    stats = tmp_path / "s.dprfstat"
+    save_stats(str(stats), train_stats(CORPUS))
+    wl = tmp_path / "w.txt"
+    wl.write_text("a\n")
+    with pytest.raises(ValueError, match="mask attacks only"):
+        _build_gen("wordlist", str(wl), {}, None, 16,
+                   get_engine("md5"), "cpu", Log(quiet=True),
+                   markov=str(stats))
+
+
+def test_zero_position_stats_rejected(tmp_path):
+    import struct
+
+    from dprf_tpu.generators.markov import MAGIC
+
+    with pytest.raises(ValueError):
+        train_stats(CORPUS, max_len=0)
+    bad = tmp_path / "zero.dprfstat"
+    bad.write_bytes(MAGIC + struct.pack("<H", 0))
+    with pytest.raises(ValueError, match="no positions"):
+        load_stats(str(bad))
+
+
+def test_stdout_rejects_markov_for_wordlist(tmp_path, capsys):
+    from tests.test_cli_e2e import run_cli
+
+    stats = tmp_path / "s.dprfstat"
+    save_stats(str(stats), train_stats(CORPUS))
+    wl = tmp_path / "w.txt"
+    wl.write_text("a\n")
+    rc, _ = run_cli(["stdout", str(wl), "-a", "wordlist",
+                     "--markov", str(stats)], capsys)
+    assert rc == 2      # ValueError -> CLI error exit
