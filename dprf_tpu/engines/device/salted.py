@@ -188,16 +188,24 @@ class _SaltedWorkerBase:
         self.hit_capacity = hit_capacity
         self.oracle = oracle
         self.batch = batch
-        dt = "<u4" if engine.little_endian else ">u4"
-        self._targs = []
+        self._targs = self._prep_targets()
+
+    def _prep_targets(self):
+        """Per-target device state for _invoke: (salt buffer, salt len,
+        digest words).  Families whose per-target state is something
+        else entirely (zip2's per-target compiled steps over a 10-byte
+        auth digest) override this alongside _invoke."""
+        dt = "<u4" if self.engine.little_endian else ">u4"
+        targs = []
         for t in self.targets:
             salt = t.params["salt"]
             buf = np.zeros((self.SALT_WIDTH,), np.uint8)
             buf[:len(salt)] = np.frombuffer(salt, np.uint8)
-            self._targs.append((
+            targs.append((
                 jnp.asarray(buf), jnp.int32(len(salt)),
                 jnp.asarray(np.frombuffer(t.digest, dtype=dt)
                             .astype(np.uint32))))
+        return targs
 
     def _rescan(self, start: int, end: int, ti: int) -> list[Hit]:
         if self.oracle is None:
@@ -209,6 +217,20 @@ class _SaltedWorkerBase:
                          [self.targets[ti]]).process(sub)
         return [Hit(ti, h.cand_index, h.plaintext) for h in hits]
 
+    def _invoke(self, ti: int, base, n):
+        """One step call for target ti -- the override point for worker
+        families whose per-target state isn't a (salt, target) pair
+        (e.g. JWT's per-target compiled steps)."""
+        salt, salt_len, tgt = self._targs[ti]
+        return self.step(base, n, salt, salt_len, tgt)
+
+    def _accept(self, ti: int, gidx: int, plain: bytes) -> bool:
+        """Final say on a device-reported lane.  Workers whose device
+        compare is a narrow prefilter (e.g. zip2's 2-byte password
+        verification value) override this with an oracle confirmation
+        so ~1/2^16 false maybes never leave the worker."""
+        return True
+
 
 class SaltedMaskWorker(_SaltedWorkerBase):
     def __init__(self, engine, gen, targets, batch: int = 1 << 18,
@@ -217,13 +239,6 @@ class SaltedMaskWorker(_SaltedWorkerBase):
         self.stride = batch
         self.step = make_salted_mask_step(engine, gen, batch,
                                           engine.order, hit_capacity)
-
-    def _invoke(self, ti: int, base, n):
-        """One step call for target ti -- the override point for worker
-        families whose per-target state isn't a (salt, target) pair
-        (e.g. JWT's per-target compiled steps)."""
-        salt, salt_len, tgt = self._targs[ti]
-        return self.step(base, n, salt, salt_len, tgt)
 
     def process(self, unit: WorkUnit) -> list[Hit]:
         hits: list[Hit] = []
@@ -246,7 +261,9 @@ class SaltedMaskWorker(_SaltedWorkerBase):
                     if lane < 0:
                         continue
                     gidx = bstart + int(lane)
-                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+                    plain = self.gen.candidate(gidx)
+                    if self._accept(ti, gidx, plain):
+                        hits.append(Hit(ti, gidx, plain))
         return hits
 
 
@@ -259,7 +276,6 @@ class SaltedWordlistWorker(_SaltedWorkerBase):
         self.step = make_salted_wordlist_step(engine, gen, self.word_batch,
                                               engine.order, hit_capacity)
 
-    _invoke = SaltedMaskWorker._invoke
 
     def process(self, unit: WorkUnit) -> list[Hit]:
         R = self.gen.n_rules
@@ -289,7 +305,9 @@ class SaltedWordlistWorker(_SaltedWorkerBase):
                                                  self.word_batch, R)
                     if not unit.start <= gidx < unit.end:
                         continue
-                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+                    plain = self.gen.candidate(gidx)
+                    if self._accept(ti, gidx, plain):
+                        hits.append(Hit(ti, gidx, plain))
         return hits
 
 
@@ -330,7 +348,9 @@ class ShardedSaltedMaskWorker(SaltedMaskWorker):
                     if lane < 0:
                         continue
                     gidx = bstart + int(lane)
-                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+                    plain = self.gen.candidate(gidx)
+                    if self._accept(ti, gidx, plain):
+                        hits.append(Hit(ti, gidx, plain))
         return hits
 
 

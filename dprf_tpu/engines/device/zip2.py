@@ -1,0 +1,152 @@
+"""Device WinZip AES engine ($zip2$, hashcat 13600).
+
+Device work per candidate is ONE PBKDF2-HMAC-SHA1 output block (the
+one holding the 2-byte password verification value): 2 key-pad
+compressions + 1000 x 2 iteration compressions -- the archive salt is
+a per-target trace-time constant, exactly the shape
+ops/hmac_sha1.pbkdf2_sha1_block already implements for PMKID.  The
+2-byte compare is a 1/2^16 prefilter, so every reported lane is
+confirmed against the stored HMAC-SHA1 auth code with the CPU oracle
+(the _accept hook) before it leaves the worker.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.cpu.engines import Zip2Engine
+from dprf_tpu.engines.device.salted import (SaltedMaskWorker,
+                                            SaltedWordlistWorker,
+                                            _SaltedWorkerBase)
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops import pack as pack_ops
+from dprf_tpu.ops.hmac_sha1 import hmac_key_states, pbkdf2_sha1_block
+
+
+def make_zip2_mask_step(gen, target, batch: int, iterations: int,
+                        hit_capacity: int = 64):
+    """Per-target step: the verification value lives in PBKDF2 block
+    T_{mode+1}, big-endian word {4-mode}, top 16 bits.
+    step(base_digits, n_valid) -> (count, lanes, _)."""
+    flat = gen.flat_charsets
+    length = gen.length
+    mode = target.params["mode"]
+    salt = target.params["salt"]
+    pwv = int.from_bytes(target.params["verify"], "big")
+
+    @jax.jit
+    def step(base_digits, n_valid):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        key = pack_ops.pack_raw(cand, length, big_endian=True)
+        istate, ostate = hmac_key_states(key)
+        t = pbkdf2_sha1_block(istate, ostate, salt, mode + 1, iterations)
+        found = (t[:, 4 - mode] >> 16) == jnp.uint32(pwv)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def make_zip2_wordlist_step(gen, target, word_batch: int, iterations: int,
+                            hit_capacity: int = 64):
+    from jax import lax
+
+    from dprf_tpu.ops.hmac import pack_raw_varlen
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, L = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+    mode = target.params["mode"]
+    salt = target.params["salt"]
+    pwv = int.from_bytes(target.params["verify"], "big")
+
+    @jax.jit
+    def step(w0, n_valid_words):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
+        key = pack_raw_varlen(cw, cl, big_endian=True)
+        istate, ostate = hmac_key_states(key)
+        t = pbkdf2_sha1_block(istate, ostate, salt, mode + 1, iterations)
+        found = ((t[:, 4 - mode] >> 16) == jnp.uint32(pwv)) & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
+                                    hit_capacity)
+
+    return step
+
+
+class _Zip2AcceptMixin:
+    """Per-target compiled steps + oracle confirmation of every device
+    maybe (2-byte prefilter -> full PBKDF2 + auth HMAC check on host)."""
+
+    def _prep_targets(self):
+        # per-target state is the compiled step, not (salt, digest
+        # words) -- the 10-byte auth digest has no word form
+        return None
+
+    def _accept(self, ti: int, gidx: int, plain: bytes) -> bool:
+        oracle = self.oracle or self.engine
+        t = self.targets[ti]
+        return oracle.hash_batch([plain], params=t.params)[0] == t.digest
+
+    def _invoke(self, ti: int, base, n):
+        return self._steps[ti](base, n)
+
+
+class Zip2MaskWorker(_Zip2AcceptMixin, SaltedMaskWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 16,
+                 hit_capacity: int = 64, oracle=None):
+        _SaltedWorkerBase.__init__(self, engine, gen, targets, batch,
+                                   hit_capacity, oracle)
+        self.stride = batch
+        self._steps = [
+            make_zip2_mask_step(gen, t, batch, engine.iterations,
+                                hit_capacity)
+            for t in self.targets]
+
+
+class Zip2WordlistWorker(_Zip2AcceptMixin, SaltedWordlistWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 16,
+                 hit_capacity: int = 64, oracle=None):
+        _SaltedWorkerBase.__init__(self, engine, gen, targets, batch,
+                                   hit_capacity, oracle)
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self._steps = [
+            make_zip2_wordlist_step(gen, t, self.word_batch,
+                                    engine.iterations, hit_capacity)
+            for t in self.targets]
+
+
+@register("zip2", device="jax")
+@register("winzip", device="jax")
+class JaxZip2Engine(Zip2Engine):
+    """Device WinZip AES.  Parsing and the auth-code oracle come from
+    the CPU engine; the device runs the PBKDF2 prefilter block."""
+
+    little_endian = False
+    digest_words = 5
+
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return Zip2MaskWorker(self, gen, targets, batch=batch,
+                              hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return Zip2WordlistWorker(self, gen, targets, batch=batch,
+                                  hit_capacity=hit_capacity, oracle=oracle)
+
+    make_sharded_mask_worker = None
+    make_sharded_wordlist_worker = None
+    make_combinator_worker = None
+    make_sharded_combinator_worker = None

@@ -1,0 +1,100 @@
+"""WinZip AES ($zip2$, hashcat 13600): parse, oracle, and device
+workers with the 2-byte prefilter + oracle auth confirmation."""
+
+import hashlib
+import hmac
+
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+from dprf_tpu.runtime.workunit import WorkUnit
+
+_KEYLEN = {1: 16, 2: 24, 3: 32}
+
+
+def _line(pw: bytes, mode: int = 3, iterations: int = 1000,
+          data: bytes = b"sekrit-payload" * 5) -> str:
+    kl = _KEYLEN[mode]
+    salt = bytes(range(4 + 4 * mode))
+    dk = hashlib.pbkdf2_hmac("sha1", pw, salt, iterations, 2 * kl + 2)
+    verify = dk[2 * kl:]
+    auth = hmac.new(dk[kl:2 * kl], data, hashlib.sha1).digest()[:10]
+    return "$zip2$*0*%d*0*%s*%s*%x*%s*%s*$/zip2$" % (
+        mode, salt.hex(), verify.hex(), len(data), data.hex(), auth.hex())
+
+
+@pytest.mark.parametrize("mode", [1, 2, 3])
+def test_parse_and_oracle(mode):
+    eng = get_engine("zip2")
+    t = eng.parse_target(_line(b"password", mode=mode))
+    assert t.params["mode"] == mode
+    assert len(t.params["salt"]) == 4 + 4 * mode
+    assert eng.hash_batch([b"password"], params=t.params)[0] == t.digest
+    assert not eng.verify(b"nope", t)
+
+
+def test_parse_rejects_malformed():
+    eng = get_engine("zip2")
+    for bad in ("$zip2$*0*9*0*aa*aaaa*1*aa*" + "00" * 10 + "*$/zip2$",
+                "$zip2$*0*3*0*aabb*aaaa*1*aa*" + "00" * 10 + "*$/zip2$",
+                "not a zip line"):
+        with pytest.raises(ValueError):
+            eng.parse_target(bad)
+
+
+@pytest.mark.parametrize("mode", [1, 2, 3])
+def test_device_mask_worker_cracks(mode):
+    cpu = get_engine("zip2")
+    dev = get_engine("zip2", device="jax")
+    cpu.iterations = dev.iterations = 20    # keep the CPU-mesh suite fast
+    try:
+        gen = MaskGenerator("?l?l?l")
+        t = cpu.parse_target(_line(b"fox", mode=mode, iterations=20))
+        w = dev.make_mask_worker(gen, [t], batch=4096, hit_capacity=8,
+                                 oracle=cpu)
+        hits = w.process(WorkUnit(0, 0, gen.keyspace))
+        assert [h.plaintext for h in hits] == [b"fox"]
+    finally:
+        cpu.iterations = dev.iterations = 1000
+
+
+def test_device_wordlist_worker_cracks():
+    from dprf_tpu.rules.parser import parse_rule
+
+    cpu = get_engine("zip2")
+    dev = get_engine("zip2", device="jax")
+    cpu.iterations = dev.iterations = 20
+    try:
+        gen = WordlistRulesGenerator(
+            words=[b"apple", b"Banana", b"zebra"],
+            rules=[parse_rule(":"), parse_rule("l")])
+        t = cpu.parse_target(_line(b"banana", iterations=20))
+        w = dev.make_wordlist_worker(gen, [t], batch=256, hit_capacity=8,
+                                     oracle=cpu)
+        hits = w.process(WorkUnit(0, 0, gen.keyspace))
+        assert b"banana" in {h.plaintext for h in hits}
+    finally:
+        cpu.iterations = dev.iterations = 1000
+
+
+def test_prefilter_false_maybe_rejected():
+    """A target whose verify value collides with some candidate but
+    whose auth code matches nothing must produce zero hits (the
+    _accept oracle confirmation drops the maybe)."""
+    cpu = get_engine("zip2")
+    dev = get_engine("zip2", device="jax")
+    cpu.iterations = dev.iterations = 20
+    try:
+        gen = MaskGenerator("?d?d")
+        line = _line(b"42", iterations=20)
+        # corrupt the auth code: prefilter still fires for '42'
+        head, auth_hex, tail = line.rsplit("*", 2)
+        line = head + "*" + ("00" * 10) + "*" + tail
+        t = cpu.parse_target(line)
+        w = dev.make_mask_worker(gen, [t], batch=128, hit_capacity=8,
+                                 oracle=cpu)
+        assert w.process(WorkUnit(0, 0, gen.keyspace)) == []
+    finally:
+        cpu.iterations = dev.iterations = 1000
