@@ -50,9 +50,12 @@ from dprf_tpu.ops import sha256 as sha256_ops
 
 #: sublane count per grid cell; TILE = SUB * 128 candidate lanes.
 #: DPRF_PALLAS_SUB overrides for tuning (tools/tpu_session.py sweeps
-#: it on real hardware); 32 showed no regressions in interpret mode
-#: and keeps the per-cell register/VMEM footprint modest.
-SUB = int(os.environ.get("DPRF_PALLAS_SUB", "32"))
+#: it on real hardware).  The round-3 sweep on TPU v5 lite
+#: (TPU_RESULTS_r03.json) measured the md5 kernel at 0.91/1.75/2.97/
+#: 3.97/4.14 GH/s for SUB 8/16/32/64/128: bigger tiles amortize the
+#: per-grid-cell scalar work, so the packed-output format's maximum
+#: (128) is the default.
+SUB = int(os.environ.get("DPRF_PALLAS_SUB", "128"))
 TILE = SUB * 128
 #: charsets needing more piecewise segments than this use the XLA path.
 MAX_SEGMENTS = 16

@@ -18,6 +18,10 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 # For any subprocess a test might spawn:
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The production default tile (SUB=128, tuned on real TPU -- see
+# BASELINE.md) makes interpret-mode kernel tests 4x slower without
+# changing semantics; keep the hermetic suite on the small tile.
+os.environ.setdefault("DPRF_PALLAS_SUB", "32")
 
 import jax  # noqa: E402
 
