@@ -216,6 +216,116 @@ def sweep_sub():
     return out
 
 
+def bench_slow_engines():
+    """The iterated/memory-hard acceptance paths (configs 4/5 + scrypt)
+    measured as raw fused steps with device-side loops.  Each step's
+    own iteration structure (fori_loop x 4096 for PBKDF2, 2^cost
+    EksBlowfish rounds, N BlockMix rounds) already amortizes dispatch
+    latency, but the looped wrapper still batches a few steps per
+    round trip."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from dprf_tpu import get_engine
+    from dprf_tpu.generators.mask import MaskGenerator
+
+    out = {}
+
+    def timed(name, fn, base, per_dispatch, seconds=15.0):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(base))
+        compile_s = time.perf_counter() - t0
+        n, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            jax.block_until_ready(fn(base))
+            n += 1
+        dt = time.perf_counter() - t0
+        out[name] = {"hs": n * per_dispatch / dt,
+                     "per_dispatch": per_dispatch, "dispatches": n,
+                     "compile_s": round(compile_s, 1),
+                     "elapsed_s": round(dt, 2)}
+
+    # -- PMKID (config 5): PBKDF2-HMAC-SHA1 x 4096 + PMKID compare
+    write_status("slow", case="pmkid")
+    try:
+        from dprf_tpu.engines.device.pmkid import make_pmkid_crack_step
+        eng = get_engine("wpa2-pmkid", device="jax")
+        tgt = eng.parse_target(
+            "%s*0a1b2c3d4e5f*a0b1c2d3e4f5*%s" % ("ff" * 16,
+                                                b"benchnet".hex()))
+        gen = MaskGenerator("?l?l?l?l?l?l?l?l")
+        B = 1 << 12
+        step = make_pmkid_crack_step(eng, gen, [tgt], B)
+
+        @jax.jit
+        def run(base):
+            def body(i, acc):
+                o = step(base.at[-1].add(i), jnp.int32(B))
+                return acc + o[0]
+            return lax.fori_loop(0, 4, body, jnp.int32(0))
+
+        timed("pmkid", run, jnp.asarray(gen.digits(0), jnp.int32), 4 * B)
+    except Exception as e:
+        out["pmkid"] = {"error": f"{type(e).__name__}: {e}",
+                        "traceback": traceback.format_exc()[-1200:]}
+    RESULTS["stages"]["slow"] = out
+    flush_results()
+
+    # -- bcrypt (config 4): cost 12, mask sweep
+    write_status("slow", case="bcrypt12")
+    try:
+        from dprf_tpu.engines.device.bcrypt import make_bcrypt_mask_step
+        gen = MaskGenerator("?l?l?l?l?l?l")
+        B = 1 << 9
+        step = make_bcrypt_mask_step(gen, B)
+        salt_words = jnp.asarray(
+            np.frombuffer(bytes(range(16)), ">u4").astype(np.uint32))
+        tgt = jnp.full((6,), 0xFFFFFFFF, jnp.uint32)
+
+        @jax.jit
+        def run(base):
+            o = step(base, jnp.int32(B), salt_words,
+                     jnp.int32(1 << 12), tgt)
+            return o[0]
+
+        timed("bcrypt12", run, jnp.asarray(gen.digits(0), jnp.int32), B,
+              seconds=30.0)
+    except Exception as e:
+        out["bcrypt12"] = {"error": f"{type(e).__name__}: {e}",
+                          "traceback": traceback.format_exc()[-1200:]}
+    RESULTS["stages"]["slow"] = out
+    flush_results()
+
+    # -- scrypt 16384:8:1 (the common interactive parameter set)
+    write_status("slow", case="scrypt")
+    try:
+        from dprf_tpu.ops.hmac import pack_raw_varlen
+        from dprf_tpu.ops.scrypt import scrypt_dk
+        gen = MaskGenerator("?l?l?l?l?l?l?l?l")
+        B = 1 << 8           # V = B * 16 MiB = 4 GiB HBM
+        flat = gen.flat_charsets
+
+        @jax.jit
+        def run(base):
+            cand = gen.decode_batch(base, flat, B)
+            kw = pack_raw_varlen(cand, jnp.full((B,), 8, jnp.int32),
+                                 True)
+            salt = jnp.zeros((51,), jnp.uint8)
+            dk = scrypt_dk(kw, salt, jnp.int32(8), 16384, 8, 1)
+            return dk.sum()
+
+        timed("scrypt", run, jnp.asarray(gen.digits(0), jnp.int32), B,
+              seconds=30.0)
+    except Exception as e:
+        out["scrypt"] = {"error": f"{type(e).__name__}: {e}",
+                         "traceback": traceback.format_exc()[-1200:]}
+    RESULTS["stages"]["slow"] = out
+    flush_results()
+    return out
+
+
 def main():
     write_status("starting", pid=os.getpid())
     import jax
@@ -230,6 +340,7 @@ def main():
     check_lowering()
     sweep_sub()
     bench_all()
+    bench_slow_engines()
     RESULTS["finished"] = time.time()
     flush_results()
     write_status("done", ok=True)
