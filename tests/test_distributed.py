@@ -571,3 +571,49 @@ def test_cli_worker_phpass_job(capsys):
         assert state.found == {0: secret}
     finally:
         server.shutdown()
+
+
+def test_cli_worker_markov_job(tmp_path, capsys):
+    """A distributed Markov-ordered mask job: the worker rebuilds the
+    reordered keyspace from the shipped stats path, and divergent
+    stats content fails the fingerprint check instead of leaving
+    coverage holes."""
+    from dprf_tpu.generators.markov import save_stats, stats_digest, \
+        train_stats
+    from dprf_tpu.generators.mask import MaskGenerator
+    from dprf_tpu.runtime.session import job_fingerprint
+
+    counts = train_stats([b"pat", b"pig", b"cat"])
+    stats = tmp_path / "s.dprfstat"
+    save_stats(str(stats), counts)
+    eng = get_engine("md5")
+    gen = MaskGenerator("?l?l?l", markov_counts=counts)
+    targets = [eng.parse_target(hashlib.md5(b"pig").hexdigest())]
+    desc = f"mask:?l?l?l:markov={stats_digest(counts)}"
+    fp = job_fingerprint("md5", desc, gen.keyspace,
+                         [t.digest for t in targets])
+    job = {"engine": "md5", "attack": "mask", "attack_arg": "?l?l?l",
+           "customs": {}, "rules": None, "markov": str(stats),
+           "max_len": None, "targets": [t.raw for t in targets],
+           "keyspace": gen.keyspace, "unit_size": 1 << 12,
+           "batch": 1 << 12, "hit_cap": 8, "fingerprint": fp}
+    state, server, _ = _serve(job, gen, targets)
+    try:
+        host, port = server.address
+        rc = cli_main(["worker", "--connect", f"{host}:{port}",
+                       "--device", "tpu", "--quiet"])
+        assert rc == 0
+        assert state.found == {0: b"pig"}
+    finally:
+        server.shutdown()
+
+    # divergent stats on the worker host: fingerprint mismatch, rc 2
+    save_stats(str(stats), train_stats([b"zzz"]))
+    state2, server2, _ = _serve(job, gen, targets)
+    try:
+        host, port = server2.address
+        rc = cli_main(["worker", "--connect", f"{host}:{port}",
+                       "--device", "tpu", "--quiet"])
+        assert rc == 2
+    finally:
+        server2.shutdown()
