@@ -1,0 +1,118 @@
+"""Run ONE risky TPU bench case in its own client process.
+
+A device fault (or a dispatch that trips the server-side deadline)
+poisons the whole client backend, so the slow/memory-hard cases are
+isolated: one case per process, clean exit either way, results
+appended to TPU_CASES_OUT as one JSON line per case.
+
+Usage: python tools/tpu_case.py <case>
+Cases: scrypt-<N>-<r>-<p>-<B> | bcrypt-<cost>-<B> | pmkid-<B>
+"""
+
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.environ.get("TPU_CASES_OUT", "/tmp/tpu_cases.jsonl")
+
+
+def emit(doc):
+    with open(OUT, "a") as f:
+        f.write(json.dumps(doc) + "\n")
+
+
+def run_case(name: str) -> dict:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from dprf_tpu.generators.mask import MaskGenerator
+
+    parts = name.split("-")
+    kind = parts[0]
+    gen = MaskGenerator("?l?l?l?l?l?l?l?l")
+    base = jnp.asarray(gen.digits(0), jnp.int32)
+
+    if kind == "scrypt":
+        n, r, p, B = (int(x) for x in parts[1:])
+        from dprf_tpu.ops.hmac import pack_raw_varlen
+        from dprf_tpu.ops.scrypt import scrypt_dk
+        flat = gen.flat_charsets
+
+        @jax.jit
+        def run(b):
+            cand = gen.decode_batch(b, flat, B)
+            kw = pack_raw_varlen(cand, jnp.full((B,), 8, jnp.int32),
+                                 True)
+            dk = scrypt_dk(kw, jnp.zeros((51,), jnp.uint8),
+                           jnp.int32(8), n, r, p)
+            return dk.sum()
+    elif kind == "bcrypt":
+        cost, B = (int(x) for x in parts[1:])
+        from dprf_tpu.engines.device.bcrypt import make_bcrypt_mask_step
+        g6 = MaskGenerator("?l?l?l?l?l?l")
+        base = jnp.asarray(g6.digits(0), jnp.int32)
+        step = make_bcrypt_mask_step(g6, B)
+        sw = jnp.asarray(np.frombuffer(bytes(range(16)), ">u4")
+                         .astype(np.uint32))
+        tgt = jnp.full((6,), 0xFFFFFFFF, jnp.uint32)
+
+        @jax.jit
+        def run(b):
+            return step(b, jnp.int32(B), sw, jnp.int32(1 << cost),
+                        tgt)[0]
+    elif kind == "pmkid":
+        B = int(parts[1])
+        from dprf_tpu import get_engine
+        from dprf_tpu.engines.device.pmkid import make_pmkid_crack_step
+        eng = get_engine("wpa2-pmkid", device="jax")
+        tgt = eng.parse_target("%s*0a1b2c3d4e5f*a0b1c2d3e4f5*%s"
+                               % ("ff" * 16, b"benchnet".hex()))
+        step = make_pmkid_crack_step(eng, gen, [tgt], B)
+
+        @jax.jit
+        def run(b):
+            return step(b, jnp.int32(B))[0]
+    else:
+        raise ValueError(f"unknown case {name!r}")
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(run(base))
+    compile_s = time.perf_counter() - t0
+    # time a few dispatches, at least one, up to ~30 s
+    per = (B,)
+    k, t0 = 0, time.perf_counter()
+    while True:
+        jax.block_until_ready(run(base))
+        k += 1
+        if time.perf_counter() - t0 > 30.0 or k >= 64:
+            break
+    dt = time.perf_counter() - t0
+    return {"case": name, "ok": True, "hs": k * per[0] / dt,
+            "batch": per[0], "dispatches": k,
+            "dispatch_s": round(dt / k, 2),
+            "compile_s": round(compile_s, 1)}
+
+
+def main():
+    name = sys.argv[1]
+    emit({"case": name, "stage": "start", "t": time.time(),
+          "pid": os.getpid()})
+    try:
+        doc = run_case(name)
+    except Exception as e:
+        doc = {"case": name, "ok": False,
+               "error": f"{type(e).__name__}: {e}",
+               "traceback": traceback.format_exc()[-1200:]}
+    doc["t"] = time.time()
+    emit(doc)
+    print(json.dumps(doc)[:300])
+    return 0 if doc.get("ok") else 1
+
+
+if __name__ == "__main__":
+    main()
