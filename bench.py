@@ -166,16 +166,17 @@ def _cached_session_result():
     bench time but served a session earlier in the round, the honest
     best number is that session's measurement (clearly labeled), not a
     CPU fallback."""
+    import glob
     best = None
-    for path in ("/tmp/tpu_session2_results.json",
-                 "/tmp/tpu_session_results.json",
-                 "/tmp/tpu_session_results_old.json"):
+    for path in sorted(glob.glob("/tmp/tpu_session*results*.json")):
         doc = _read_json(path)
         if not doc:
             continue
         for name, res in (doc.get("stages", {}).get("bench", {})).items():
             if (isinstance(res, dict) and res.get("device") == "tpu"
-                    and res.get("engine") == "md5" and "value" in res):
+                    and res.get("engine") == "md5"
+                    # same poisoned-measurement cap as the live path
+                    and 0 < res.get("value", 0) < 1e12):
                 if best is None or res["value"] > best["value"]:
                     best = dict(res)
                     best["note"] = (f"measured by tools/tpu_session.py "
@@ -209,8 +210,12 @@ def main() -> int:
     if _tpu_available(env, workdir):
         device_doc = _run_device(env, workdir)
         if device_doc:
+            # physical sanity cap: nothing in this class exceeds ~1e11
+            # H/s on one chip; a dead backend once "measured" 1.3e15
+            # (poisoned buffers complete instantly without raising)
             impls = {k: v for k, v in device_doc.items()
-                     if isinstance(v, dict) and "value" in v}
+                     if isinstance(v, dict) and "value" in v
+                     and 0 < v["value"] < 1e12}
             if impls:
                 best = max(impls, key=lambda k: impls[k]["value"])
                 res = impls[best]

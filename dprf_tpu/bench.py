@@ -125,6 +125,13 @@ def run_bench(engine: str = "md5", device: str = "jax",
                 n += 1
             jax.block_until_ready(last)
         elapsed = time.perf_counter() - t0
+        # Materialize a real VALUE from the last result: a backend that
+        # died mid-run can complete dispatches instantly with poisoned
+        # buffers and no exception until the bytes are actually read --
+        # which once inflated a dead-device "measurement" to 1.3e15 H/s.
+        import numpy as _np
+        for part in (last if isinstance(last, tuple) else (last,)):
+            _np.asarray(part)
     else:
         eng = get_engine(engine, device="cpu")
         n, elapsed = 0, 0.0

@@ -863,3 +863,101 @@ class Zip2Engine(HashEngine):
             out.append(hmac.new(dk[kl:2 * kl], params["data"],
                                 hashlib.sha1).digest()[:self.digest_size])
         return out
+
+
+def _utf16_lower_user(user: str) -> bytes:
+    return user.lower().encode("utf-16-le")
+
+
+#: DCC outer-block budget: 16 digest bytes + salt + 0x80 + 8-byte
+#: length must fit one 64-byte MD4 block -> salt <= 39 bytes; an even
+#: byte count (UTF-16LE) makes that 38 bytes = 19 characters (Windows
+#: caps sAMAccountName at 20, so 19 covers all but the edge).
+DCC_USER_MAX = 19
+
+
+def _parse_user_digest(text_digest_hex: str, user: str,
+                       digest_size: int):
+    """Shared mscache/mscache2 field validation -> (digest, salt)."""
+    digest = bytes.fromhex(text_digest_hex)
+    if len(digest) != digest_size:
+        raise ValueError(f"expected {digest_size}-byte digest, "
+                         f"got {len(digest)}")
+    if not user:
+        raise ValueError("empty username")
+    if len(user) > DCC_USER_MAX:
+        raise ValueError(f"username longer than {DCC_USER_MAX} chars")
+    return digest, _utf16_lower_user(user)
+
+
+def _dcc1(password: bytes, user_salt: bytes) -> bytes:
+    """MS Cache v1: MD4(MD4(UTF16LE(pw)) || UTF16LE(lower(user)))."""
+    inner = md4(password.decode("latin-1").encode("utf-16-le"))
+    return md4(inner + user_salt)
+
+
+@register("mscache")
+@register("dcc")
+class MsCacheEngine(HashEngine):
+    """MS Cache v1 / Domain Cached Credentials (hashcat 1100):
+    ``hexdigest:username`` lines; digest = MD4(MD4(UTF16LE(pw)) ||
+    UTF16LE(lower(user)))."""
+
+    name = "mscache"
+    digest_size = 16
+    salted = True
+    max_candidate_len = 27     # UTF-16LE widening: one MD4 block
+
+    def parse_target(self, text: str) -> Target:
+        digest_hex, sep, user = text.strip().partition(":")
+        if not sep or not user:
+            raise ValueError(f"expected 'digest:username', got {text!r}")
+        digest, salt = _parse_user_digest(digest_hex, user,
+                                          self.digest_size)
+        return Target(raw=text.strip(), digest=digest,
+                      params={"salt": salt, "user": user})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError("mscache needs target params (user)")
+        return [_dcc1(c, params["salt"]) for c in candidates]
+
+
+@register("mscache2")
+@register("dcc2")
+class MsCache2Engine(HashEngine):
+    """MS Cache v2 / DCC2 (hashcat 2100): ``$DCC2$<iter>#<user>#<hex>``
+    lines; digest = PBKDF2-HMAC-SHA1(DCC1, UTF16LE(lower(user)),
+    iterations, 16)."""
+
+    name = "mscache2"
+    digest_size = 16
+    salted = True
+    max_candidate_len = 27
+
+    def parse_target(self, text: str) -> Target:
+        body = text.strip()
+        if not body.startswith("$DCC2$"):
+            raise ValueError(f"expected $DCC2$iter#user#hash, got {text!r}")
+        parts = body[len("$DCC2$"):].split("#")
+        if len(parts) != 3:
+            raise ValueError(f"expected 3 '#' fields in {text!r}")
+        iterations = int(parts[0])
+        if not 1 <= iterations <= (1 << 24):
+            raise ValueError(f"unreasonable DCC2 iterations {iterations}")
+        user = parts[1]
+        digest, salt = _parse_user_digest(parts[2], user,
+                                          self.digest_size)
+        return Target(raw=body, digest=digest,
+                      params={"salt": salt, "user": user,
+                              "iterations": iterations})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError("mscache2 needs target params (user, iters)")
+        return [hashlib.pbkdf2_hmac("sha1", _dcc1(c, params["salt"]),
+                                    params["salt"],
+                                    params["iterations"], 16)
+                for c in candidates]

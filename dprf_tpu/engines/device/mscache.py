@@ -1,0 +1,270 @@
+"""Device MS Cache engines (DCC/DCC2, hashcat 1100/2100).
+
+DCC1 is two chained MD4 blocks: the NTLM digest of the password, then
+MD4 over (inner digest || UTF16LE(lower(user))) -- the username is a
+runtime salt, so ONE compiled step serves every target.  DCC2 feeds
+DCC1 through PBKDF2-HMAC-SHA1 with the same username salt and a
+per-target iteration count (runtime scalar through the shared
+pbkdf2_sha1_runtime_salt helper).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.cpu.engines import MsCache2Engine, MsCacheEngine
+from dprf_tpu.engines.device.pbkdf2_sha1 import pbkdf2_sha1_runtime_salt
+from dprf_tpu.engines.device.salted import (SaltedMaskWorker,
+                                            SaltedWordlistWorker,
+                                            ShardedSaltedMaskWorker,
+                                            _SaltedWorkerBase)
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops import pack as pack_ops
+from dprf_tpu.ops.md4 import INIT as MD4_INIT, md4_compress
+from dprf_tpu.ops.scrypt import bswap32
+
+
+def dcc1_words(cand: jnp.ndarray, lengths: jnp.ndarray,
+               salt: jnp.ndarray, salt_len) -> jnp.ndarray:
+    """Candidates uint8[B, L] (+ per-lane lengths) + runtime username
+    salt -> DCC1 uint32[B, 4] (little-endian MD4 words)."""
+    B = cand.shape[0]
+    wide = pack_ops.utf16le_widen(cand)
+    inner = md4_compress(
+        jnp.broadcast_to(jnp.asarray(MD4_INIT), (B, 4)),
+        pack_ops.pack_varlen(wide, lengths * 2, big_endian=False))
+    # outer block bytes: inner digest (LE word bytes are already the
+    # digest byte order) then the salt, marker, and bit length
+    pos = jnp.arange(64, dtype=jnp.int32)
+    salt64 = jnp.pad(salt, (0, 64 - salt.shape[0]))
+    sbytes = jnp.broadcast_to(salt64[None, :], (B, 64))
+    sidx = jnp.clip(pos - 16, 0, 63)
+    buf = jnp.where((pos >= 16) & (pos < 16 + salt_len),
+                    jnp.take_along_axis(sbytes, jnp.broadcast_to(
+                        sidx[None, :], (B, 64)), axis=1), 0)
+    buf = buf + jnp.where(pos == 16 + salt_len, jnp.uint8(0x80),
+                          jnp.uint8(0))
+    m = pack_ops._words_from_bytes(buf.astype(jnp.uint8),
+                                   big_endian=False)
+    m = m.at[:, 0:4].set(inner)
+    m = m.at[:, 14].set(((16 + salt_len) * 8).astype(jnp.uint32))
+    return md4_compress(
+        jnp.broadcast_to(jnp.asarray(MD4_INIT), (B, 4)), m)
+
+
+def _dcc2_words(cand, lengths, salt, salt_len, iterations):
+    d1 = dcc1_words(cand, lengths, salt, salt_len)
+    key = jnp.zeros((cand.shape[0], 16), jnp.uint32)
+    key = key.at[:, 0:4].set(bswap32(d1))   # BE key-block packing
+    return pbkdf2_sha1_runtime_salt(key, salt, salt_len, iterations, 4)
+
+
+def _digest_fn(v2: bool):
+    if v2:
+        return lambda cand, lens, salt, slen, iters: _dcc2_words(
+            cand, lens, salt, slen, iters)
+    return lambda cand, lens, salt, slen, iters: dcc1_words(
+        cand, lens, salt, slen)
+
+
+def make_mscache_mask_step(gen, batch: int, v2: bool,
+                           hit_capacity: int = 64):
+    """step(base_digits, n_valid, salt, salt_len, iterations, target)
+    -> (count, lanes, _)."""
+    flat = gen.flat_charsets
+    length = gen.length
+    digest = _digest_fn(v2)
+
+    @jax.jit
+    def step(base_digits, n_valid, salt, salt_len, iterations, target):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        lengths = jnp.full((batch,), length, jnp.int32)
+        d = digest(cand, lengths, salt, salt_len, iterations)
+        found = cmp_ops.compare_single(d, target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def make_mscache_wordlist_step(gen, word_batch: int, v2: bool,
+                               hit_capacity: int = 64):
+    from jax import lax
+
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, L = word_batch, gen.max_len
+    if L > 27:
+        raise ValueError(
+            f"mscache candidates are UTF-16LE widened: wordlist "
+            f"max_len {L} > 27 overflows the single MD4 block "
+            "(set --max-len 27 or shorter)")
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+    digest = _digest_fn(v2)
+
+    @jax.jit
+    def step(w0, n_valid_words, salt, salt_len, iterations, target):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
+        pos = jnp.arange(cw.shape[1], dtype=jnp.int32)
+        cw = jnp.where(pos[None, :] < cl[:, None], cw, 0)  # mask junk
+        d = digest(cw, cl, salt, salt_len, iterations)
+        found = cmp_ops.compare_single(d, target) & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
+                                    hit_capacity)
+
+    return step
+
+
+def make_sharded_mscache_mask_step(gen, mesh, batch_per_device: int,
+                                   v2: bool, hit_capacity: int = 64):
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from dprf_tpu.parallel.mesh import SHARD_AXIS
+
+    flat = gen.flat_charsets
+    length = gen.length
+    B = batch_per_device
+    digest = _digest_fn(v2)
+
+    def shard_fn(base_digits, n_valid, salt, salt_len, iterations,
+                 target):
+        dev = lax.axis_index(SHARD_AXIS)
+        offset = (dev * B).astype(jnp.int32)
+        cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
+        lengths = jnp.full((B,), length, jnp.int32)
+        d = digest(cand, lengths, salt, salt_len, iterations)
+        lane_global = offset + jnp.arange(B, dtype=jnp.int32)
+        found = cmp_ops.compare_single(d, target) & \
+            (lane_global < n_valid)
+        count, lanes, tpos = cmp_ops.compact_hits(
+            found, jnp.zeros((B,), jnp.int32), hit_capacity)
+        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
+        total = lax.psum(count, SHARD_AXIS)
+        return (total[None],
+                lax.all_gather(count, SHARD_AXIS),
+                lax.all_gather(lanes, SHARD_AXIS),
+                lax.all_gather(tpos, SHARD_AXIS))
+
+    sharded = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(),) * 6,
+        out_specs=(P(), P(), P(), P()), check_vma=False)
+
+    @jax.jit
+    def step(base_digits, n_valid, salt, salt_len, iterations, target):
+        total, counts, lanes, tpos = sharded(
+            base_digits, n_valid, salt, salt_len, iterations, target)
+        return total[0], counts, lanes, tpos
+
+    step.super_batch = mesh.devices.size * B
+    return step
+
+
+class _MsCacheInvokeMixin:
+    """_targs rows gain the per-target iteration count (1 for DCC1)."""
+
+    #: DCC2's u1_block consumes the 51-byte PBKDF2 salt buffer; DCC1
+    #: only reads salt_len bytes, so the wide buffer serves both.
+    SALT_WIDTH = 51
+
+    def _prep_targets(self):
+        base = super()._prep_targets()
+        return [(salt, slen, tgt,
+                 jnp.int32(t.params.get("iterations", 1)))
+                for (salt, slen, tgt), t in zip(base, self.targets)]
+
+    def _invoke(self, ti: int, base, n):
+        salt, slen, tgt, iters = self._targs[ti]
+        return self.step(base, n, salt, slen, iters, tgt)
+
+
+class MsCacheMaskWorker(_MsCacheInvokeMixin, SaltedMaskWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 18,
+                 hit_capacity: int = 64, oracle=None):
+        _SaltedWorkerBase.__init__(self, engine, gen, targets, batch,
+                                   hit_capacity, oracle)
+        self.stride = batch
+        self.step = make_mscache_mask_step(gen, batch, engine._v2,
+                                           hit_capacity)
+
+
+class MsCacheWordlistWorker(_MsCacheInvokeMixin, SaltedWordlistWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 18,
+                 hit_capacity: int = 64, oracle=None):
+        _SaltedWorkerBase.__init__(self, engine, gen, targets, batch,
+                                   hit_capacity, oracle)
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self.step = make_mscache_wordlist_step(gen, self.word_batch,
+                                               engine._v2, hit_capacity)
+
+
+class ShardedMsCacheMaskWorker(_MsCacheInvokeMixin,
+                               ShardedSaltedMaskWorker):
+    def __init__(self, engine, gen, targets, mesh,
+                 batch_per_device: int = 1 << 16, hit_capacity: int = 64,
+                 oracle=None):
+        _SaltedWorkerBase.__init__(self, engine, gen, targets,
+                                   mesh.devices.size * batch_per_device,
+                                   hit_capacity, oracle)
+        self.mesh = mesh
+        self.stride = self.batch
+        self.step = make_sharded_mscache_mask_step(
+            gen, mesh, batch_per_device, engine._v2, hit_capacity)
+
+
+class _MsCacheDeviceMixin:
+    little_endian = True       # MD4 digest words
+    digest_words = 4
+    _v2 = False
+
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return MsCacheMaskWorker(self, gen, targets, batch=batch,
+                                 hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return MsCacheWordlistWorker(self, gen, targets, batch=batch,
+                                     hit_capacity=hit_capacity,
+                                     oracle=oracle)
+
+    def make_sharded_mask_worker(self, gen, targets, mesh,
+                                 batch_per_device: int, hit_capacity: int,
+                                 oracle=None):
+        return ShardedMsCacheMaskWorker(
+            self, gen, targets, mesh,
+            batch_per_device=batch_per_device,
+            hit_capacity=hit_capacity, oracle=oracle)
+
+    make_sharded_wordlist_worker = None
+    make_combinator_worker = None
+    make_sharded_combinator_worker = None
+
+
+@register("mscache", device="jax")
+@register("dcc", device="jax")
+class JaxMsCacheEngine(_MsCacheDeviceMixin, MsCacheEngine):
+    """Device MS Cache v1: two chained MD4 blocks, username as a
+    runtime salt."""
+
+
+@register("mscache2", device="jax")
+@register("dcc2", device="jax")
+class JaxMsCache2Engine(_MsCacheDeviceMixin, MsCache2Engine):
+    """Device MS Cache v2: DCC1 -> PBKDF2-HMAC-SHA1(username,
+    per-target iterations)."""
+
+    _v2 = True
+    little_endian = False      # PBKDF2 dk bytes are BE SHA-1 words

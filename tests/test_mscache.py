@@ -1,0 +1,102 @@
+"""MS Cache v1/v2 (DCC/DCC2, hashcat 1100/2100): oracles vs the
+reference construction, device workers, and parsing."""
+
+import hashlib
+
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.engines.cpu.engines import _dcc1, _utf16_lower_user
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+def _v1_line(pw: bytes, user: str) -> str:
+    return _dcc1(pw, _utf16_lower_user(user)).hex() + ":" + user
+
+
+def _v2_line(pw: bytes, user: str, iters: int = 100) -> str:
+    salt = _utf16_lower_user(user)
+    dk = hashlib.pbkdf2_hmac("sha1", _dcc1(pw, salt), salt, iters, 16)
+    return f"$DCC2${iters}#{user}#{dk.hex()}"
+
+
+def test_v1_oracle_and_parse():
+    eng = get_engine("mscache")
+    t = eng.parse_target(_v1_line(b"hashcat", "tom"))
+    assert eng.hash_batch([b"hashcat"], params=t.params)[0] == t.digest
+    assert not eng.verify(b"nope", t)
+    with pytest.raises(ValueError):
+        eng.parse_target("deadbeef")            # no username
+    with pytest.raises(ValueError):
+        eng.parse_target("aa" * 16 + ":" + "u" * 20)   # user too long
+
+
+def test_v2_oracle_and_parse():
+    eng = get_engine("mscache2")
+    t = eng.parse_target(_v2_line(b"hashcat", "Tom", 10240))
+    assert t.params["iterations"] == 10240
+    assert t.params["salt"] == _utf16_lower_user("tom")
+    assert eng.hash_batch([b"hashcat"], params=t.params)[0] == t.digest
+    with pytest.raises(ValueError):
+        eng.parse_target("$DCC2$bad")
+
+
+@pytest.mark.parametrize("name,line", [
+    ("mscache", _v1_line(b"fox", "Alice")),
+    ("mscache2", _v2_line(b"fox", "Alice")),
+])
+def test_device_mask_worker_cracks(name, line):
+    cpu = get_engine(name)
+    dev = get_engine(name, device="jax")
+    gen = MaskGenerator("?l?l?l")
+    t = cpu.parse_target(line)
+    w = dev.make_mask_worker(gen, [t], batch=4096, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [b"fox"]
+
+
+def test_device_wordlist_worker_cracks():
+    from dprf_tpu.rules.parser import parse_rule
+
+    cpu = get_engine("mscache2")
+    dev = get_engine("mscache2", device="jax")
+    gen = WordlistRulesGenerator(
+        words=[b"apple", b"Banana", b"zebra"],
+        rules=[parse_rule(":"), parse_rule("l")], max_len=16)
+    t = cpu.parse_target(_v2_line(b"banana", "svc_backup"))
+    w = dev.make_wordlist_worker(gen, [t], batch=256, hit_capacity=8,
+                                 oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert b"banana" in {h.plaintext for h in hits}
+
+
+def test_sharded_mask_worker_cracks():
+    from dprf_tpu.parallel import make_mesh
+
+    cpu = get_engine("mscache")
+    dev = get_engine("mscache", device="jax")
+    gen = MaskGenerator("?l?l?l")
+    t = cpu.parse_target(_v1_line(b"dog", "bob"))
+    w = dev.make_sharded_mask_worker(gen, [t], make_mesh(8),
+                                     batch_per_device=512,
+                                     hit_capacity=8, oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [b"dog"]
+
+
+def test_two_targets_different_iterations():
+    """Per-target iteration counts are runtime args: one step serves
+    targets with different DCC2 iteration settings."""
+    cpu = get_engine("mscache2")
+    dev = get_engine("mscache2", device="jax")
+    gen = MaskGenerator("?d?d")
+    ta = cpu.parse_target(_v2_line(b"42", "ann", 50))
+    tb = cpu.parse_target(_v2_line(b"77", "ben", 200))
+    w = dev.make_mask_worker(gen, [ta, tb], batch=128, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert {(h.target_index, h.plaintext) for h in hits} == \
+        {(0, b"42"), (1, b"77")}
