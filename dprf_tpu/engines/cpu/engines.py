@@ -961,3 +961,37 @@ class MsCache2Engine(HashEngine):
                                     params["salt"],
                                     params["iterations"], 16)
                 for c in candidates]
+
+
+@register("lm")
+class LmEngine(HashEngine):
+    """LM hash, one half (hashcat 3000): DES_{str_to_key(upper(pw))}
+    ("KGS!@#$%") over a <= 7-char half.  A full 16-byte LM hash is two
+    independent halves -- split it into two lines.  Candidates are
+    uppercased here (LM is case-insensitive), so lowercase masks and
+    wordlists work unchanged."""
+
+    name = "lm"
+    digest_size = 8
+    max_candidate_len = 7
+
+    def parse_target(self, text: str) -> Target:
+        t = text.strip()
+        digest = bytes.fromhex(t)
+        if len(digest) == 16:
+            raise ValueError(
+                "full 16-byte LM hash: split it into its two 8-byte "
+                "halves (one line each); each half cracks independently")
+        if len(digest) != self.digest_size:
+            raise ValueError(f"lm wants 8 digest bytes, got {text!r}")
+        return Target(raw=t, digest=digest)
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        from dprf_tpu.ops.des import lm_half
+        # a candidate longer than 7 bytes can never BE an LM half:
+        # an empty digest compares unequal to every 8-byte target
+        # (rule expansions may legitimately overshoot; truncating
+        # instead would report plaintexts that don't hash to the
+        # target)
+        return [lm_half(c) if len(c) <= 7 else b"" for c in candidates]

@@ -1,0 +1,214 @@
+"""Device LM-hash engine: bitslice DES on the VPU (hashcat 3000).
+
+Candidates are uppercased and transposed into 56 bit-planes (one int32
+plane bit-column per candidate, 32 candidates per vector word), the
+bitslice DES circuit (ops/des.py) encrypts the LM magic under every
+key simultaneously, and target compare is 64 plane selects folded into
+one match mask -- no gathers anywhere, which is what makes DES viable
+on this hardware at all (compare bcrypt's measured gather
+serialization).  Multi-target lists fold into the same pass at 64
+ops per extra target.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.base import Target
+from dprf_tpu.engines.cpu.engines import LmEngine
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops.des import (LM_MAGIC, const_planes, des_encrypt_bitslice,
+                              key_planes_from_bytes7)
+from dprf_tpu.runtime.worker import (DeviceWordlistWorker,
+                                     MaskWorkerBase)
+
+
+def _upper(cand: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where((cand >= 97) & (cand <= 122), cand - 32, cand)
+
+
+def byte_planes(cand: jnp.ndarray) -> list:
+    """uint8[B, 7] (B a multiple of 32) -> 56 int32 planes, plane
+    8k+bit = byte k's bit (MSB first), lane j of word v = candidate
+    32v+j."""
+    B = cand.shape[0]
+    groups = cand.astype(jnp.int32).reshape(B // 32, 32, 7)
+    weights = jnp.left_shift(jnp.int32(1), jnp.arange(32, dtype=jnp.int32))
+    planes = []
+    for k in range(7):
+        for bit in range(8):
+            vals = (groups[:, :, k] >> (7 - bit)) & 1
+            # distinct bits: sum == bitwise or, and int32 wrap on the
+            # sign bit is exact
+            planes.append((vals * weights).sum(axis=1, dtype=jnp.int32))
+    return planes
+
+
+def target_bits(digest: bytes) -> list[int]:
+    return [(digest[i // 8] >> (7 - i % 8)) & 1 for i in range(64)]
+
+
+def match_mask(cipher, tbits: list[int]):
+    """Cipher planes + 64 target bits -> int32 word mask of matching
+    lanes.  des_encrypt_bitslice always returns 64 real planes (the
+    final FP reindexes a stacked array), so this is a plain 64-term
+    select-and-AND chain."""
+    m = cipher[0] if tbits[0] else ~cipher[0]
+    for p, t in zip(cipher[1:], tbits[1:]):
+        m = m & (p if t else ~p)
+    return m
+
+
+def make_lm_mask_step(gen, targets: Sequence[Target], batch: int,
+                      hit_capacity: int = 64):
+    """step(base_digits, n_valid) -> (count, lanes, tpos); tpos carries
+    ORIGINAL target indices (first match per lane)."""
+    if batch % 32:
+        raise ValueError("bitslice batch must be a multiple of 32")
+    if gen.length > 7:
+        raise ValueError(
+            f"an LM half is at most 7 characters; mask decodes to "
+            f"{gen.length}")
+    flat = gen.flat_charsets
+    length = gen.length
+    tbits = [target_bits(t.digest) for t in targets]
+
+    @jax.jit
+    def step(base_digits, n_valid):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        cand7 = jnp.zeros((batch, 7), jnp.uint8).at[:, :length].set(
+            _upper(cand))
+        cipher = des_encrypt_bitslice(
+            key_planes_from_bytes7(byte_planes(cand7)),
+            const_planes(LM_MAGIC))
+        lanebit = jnp.left_shift(
+            jnp.int32(1), jnp.arange(32, dtype=jnp.int32))
+        found_any = jnp.zeros((batch,), jnp.bool_)
+        tfirst = jnp.zeros((batch,), jnp.int32)
+        for ti, tb in enumerate(tbits):
+            m = match_mask(cipher, tb)
+            f = ((jnp.broadcast_to(m[:, None], (batch // 32, 32))
+                  & lanebit) != 0).reshape(batch)
+            tfirst = jnp.where(f & ~found_any, jnp.int32(ti), tfirst)
+            found_any = found_any | f
+        valid = jnp.arange(batch, dtype=jnp.int32) < n_valid
+        return cmp_ops.compact_hits(found_any & valid, tfirst,
+                                    hit_capacity)
+
+    return step
+
+
+def make_lm_wordlist_step(gen, targets: Sequence[Target],
+                          word_batch: int, hit_capacity: int = 64):
+    from jax import lax
+
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, L = word_batch, gen.max_len
+    if L > 7:
+        raise ValueError("lm candidates cap at 7 bytes; set --max-len 7")
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+    tbits = [target_bits(t.digest) for t in targets]
+
+    @jax.jit
+    def step(w0, n_valid_words):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
+        RB = cw.shape[0]
+        pad = (-RB) % 32
+        cw = jnp.pad(cw, ((0, pad), (0, 0)))
+        cv = jnp.pad(cv, (0, pad))
+        pos = jnp.arange(cw.shape[1], dtype=jnp.int32)
+        cw = jnp.where(pos[None, :] < jnp.pad(cl, (0, pad))[:, None],
+                       cw, 0)
+        cand7 = jnp.zeros((RB + pad, 7), jnp.uint8).at[:, :cw.shape[1]] \
+            .set(_upper(cw))
+        cipher = des_encrypt_bitslice(
+            key_planes_from_bytes7(byte_planes(cand7)),
+            const_planes(LM_MAGIC))
+        lanebit = jnp.left_shift(
+            jnp.int32(1), jnp.arange(32, dtype=jnp.int32))
+        found_any = jnp.zeros((RB + pad,), jnp.bool_)
+        tfirst = jnp.zeros((RB + pad,), jnp.int32)
+        for ti, tb in enumerate(tbits):
+            m = match_mask(cipher, tb)
+            f = ((jnp.broadcast_to(m[:, None], ((RB + pad) // 32, 32))
+                  & lanebit) != 0).reshape(RB + pad)
+            tfirst = jnp.where(f & ~found_any, jnp.int32(ti), tfirst)
+            found_any = found_any | f
+        found = found_any[:RB] & cv[:RB]
+        return cmp_ops.compact_hits(found, tfirst[:RB], hit_capacity)
+
+    return step
+
+
+class LmMaskWorker(MaskWorkerBase):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 18,
+                 hit_capacity: int = 64, oracle=None):
+        self.engine = engine
+        self.gen = gen
+        self.targets = list(targets)
+        self.hit_capacity = hit_capacity
+        self.oracle = oracle
+        self.multi = len(self.targets) > 1
+        self._order = np.arange(max(1, len(self.targets)), dtype=np.int64)
+        batch = max(32, (batch // 32) * 32)
+        self.batch = self.stride = batch
+        self.step = make_lm_mask_step(gen, self.targets, batch,
+                                      hit_capacity)
+
+
+class LmWordlistWorker(DeviceWordlistWorker):
+    """DeviceWordlistWorker's process/hit-decode/rescan machinery over
+    the bitslice step (its own __init__ skips _setup_targets -- LM's
+    tpos already carries original target indices)."""
+
+    def __init__(self, engine, gen, targets, batch: int = 1 << 18,
+                 hit_capacity: int = 64, oracle=None):
+        self.engine = engine
+        self.gen = gen
+        self.targets = list(targets)
+        self.hit_capacity = hit_capacity
+        self.oracle = oracle
+        self.multi = len(self.targets) > 1
+        self._order = np.arange(max(1, len(self.targets)), dtype=np.int64)
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self.batch = batch
+        self.step = make_lm_wordlist_step(gen, self.targets,
+                                          self.word_batch, hit_capacity)
+
+
+@register("lm", device="jax")
+class JaxLmEngine(LmEngine):
+    """Device LM: bitslice DES (see module docstring).  Parsing and
+    the oracle come from the CPU engine."""
+
+    little_endian = False
+    digest_words = 2
+
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return LmMaskWorker(self, gen, targets, batch=batch,
+                            hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return LmWordlistWorker(self, gen, targets, batch=batch,
+                                hit_capacity=hit_capacity, oracle=oracle)
+
+    make_sharded_mask_worker = None
+    make_sharded_wordlist_worker = None
+    make_combinator_worker = None
+    make_sharded_combinator_worker = None

@@ -1,0 +1,314 @@
+"""DES as a BITSLICE kernel: the TPU-native way to run a
+permutation-heavy 1977 cipher on a vector unit.
+
+Why bitslice: DES is all bit permutations (IP, E, P, PC1/PC2) and
+6->4-bit S-box lookups -- gather-per-candidate tables are the one
+shape this VPU hates (see bcrypt's measured serialization).  In
+bitslice form each of the 64 state BITS is one int32 plane holding 32
+candidates, so every permutation is a free wire-rename at trace time
+and each S-box becomes a fixed boolean circuit (a 6-level mux tree
+with constant folding, ~60 vector ops per output bit) -- pure int32
+and/xor/andnot streams at full lane width, no gathers at all.
+
+The table constants below are the DES specification itself (FIPS
+46-3, public standard); the scalar reference implementation next to
+them is the CPU oracle and the test anchor for the bitslice form.
+Used by the LM-hash engine (engines/device/lm.py) and NetNTLMv1.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# FIPS 46-3 tables (1-based bit indices, MSB-first, as published)
+
+_IP = [58, 50, 42, 34, 26, 18, 10, 2, 60, 52, 44, 36, 28, 20, 12, 4,
+       62, 54, 46, 38, 30, 22, 14, 6, 64, 56, 48, 40, 32, 24, 16, 8,
+       57, 49, 41, 33, 25, 17, 9, 1, 59, 51, 43, 35, 27, 19, 11, 3,
+       61, 53, 45, 37, 29, 21, 13, 5, 63, 55, 47, 39, 31, 23, 15, 7]
+
+_FP = [40, 8, 48, 16, 56, 24, 64, 32, 39, 7, 47, 15, 55, 23, 63, 31,
+       38, 6, 46, 14, 54, 22, 62, 30, 37, 5, 45, 13, 53, 21, 61, 29,
+       36, 4, 44, 12, 52, 20, 60, 28, 35, 3, 43, 11, 51, 19, 59, 27,
+       34, 2, 42, 10, 50, 18, 58, 26, 33, 1, 41, 9, 49, 17, 57, 25]
+
+_E = [32, 1, 2, 3, 4, 5, 4, 5, 6, 7, 8, 9, 8, 9, 10, 11, 12, 13,
+      12, 13, 14, 15, 16, 17, 16, 17, 18, 19, 20, 21, 20, 21, 22, 23,
+      24, 25, 24, 25, 26, 27, 28, 29, 28, 29, 30, 31, 32, 1]
+
+_P = [16, 7, 20, 21, 29, 12, 28, 17, 1, 15, 23, 26, 5, 18, 31, 10,
+     2, 8, 24, 14, 32, 27, 3, 9, 19, 13, 30, 6, 22, 11, 4, 25]
+
+_PC1 = [57, 49, 41, 33, 25, 17, 9, 1, 58, 50, 42, 34, 26, 18,
+        10, 2, 59, 51, 43, 35, 27, 19, 11, 3, 60, 52, 44, 36,
+        63, 55, 47, 39, 31, 23, 15, 7, 62, 54, 46, 38, 30, 22,
+        14, 6, 61, 53, 45, 37, 29, 21, 13, 5, 28, 20, 12, 4]
+
+_PC2 = [14, 17, 11, 24, 1, 5, 3, 28, 15, 6, 21, 10,
+        23, 19, 12, 4, 26, 8, 16, 7, 27, 20, 13, 2,
+        41, 52, 31, 37, 47, 55, 30, 40, 51, 45, 33, 48,
+        44, 49, 39, 56, 34, 53, 46, 42, 50, 36, 29, 32]
+
+_SHIFTS = [1, 1, 2, 2, 2, 2, 2, 2, 1, 2, 2, 2, 2, 2, 2, 1]
+
+_S = [
+    # S1
+    [14, 4, 13, 1, 2, 15, 11, 8, 3, 10, 6, 12, 5, 9, 0, 7,
+     0, 15, 7, 4, 14, 2, 13, 1, 10, 6, 12, 11, 9, 5, 3, 8,
+     4, 1, 14, 8, 13, 6, 2, 11, 15, 12, 9, 7, 3, 10, 5, 0,
+     15, 12, 8, 2, 4, 9, 1, 7, 5, 11, 3, 14, 10, 0, 6, 13],
+    # S2
+    [15, 1, 8, 14, 6, 11, 3, 4, 9, 7, 2, 13, 12, 0, 5, 10,
+     3, 13, 4, 7, 15, 2, 8, 14, 12, 0, 1, 10, 6, 9, 11, 5,
+     0, 14, 7, 11, 10, 4, 13, 1, 5, 8, 12, 6, 9, 3, 2, 15,
+     13, 8, 10, 1, 3, 15, 4, 2, 11, 6, 7, 12, 0, 5, 14, 9],
+    # S3
+    [10, 0, 9, 14, 6, 3, 15, 5, 1, 13, 12, 7, 11, 4, 2, 8,
+     13, 7, 0, 9, 3, 4, 6, 10, 2, 8, 5, 14, 12, 11, 15, 1,
+     13, 6, 4, 9, 8, 15, 3, 0, 11, 1, 2, 12, 5, 10, 14, 7,
+     1, 10, 13, 0, 6, 9, 8, 7, 4, 15, 14, 3, 11, 5, 2, 12],
+    # S4
+    [7, 13, 14, 3, 0, 6, 9, 10, 1, 2, 8, 5, 11, 12, 4, 15,
+     13, 8, 11, 5, 6, 15, 0, 3, 4, 7, 2, 12, 1, 10, 14, 9,
+     10, 6, 9, 0, 12, 11, 7, 13, 15, 1, 3, 14, 5, 2, 8, 4,
+     3, 15, 0, 6, 10, 1, 13, 8, 9, 4, 5, 11, 12, 7, 2, 14],
+    # S5
+    [2, 12, 4, 1, 7, 10, 11, 6, 8, 5, 3, 15, 13, 0, 14, 9,
+     14, 11, 2, 12, 4, 7, 13, 1, 5, 0, 15, 10, 3, 9, 8, 6,
+     4, 2, 1, 11, 10, 13, 7, 8, 15, 9, 12, 5, 6, 3, 0, 14,
+     11, 8, 12, 7, 1, 14, 2, 13, 6, 15, 0, 9, 10, 4, 5, 3],
+    # S6
+    [12, 1, 10, 15, 9, 2, 6, 8, 0, 13, 3, 4, 14, 7, 5, 11,
+     10, 15, 4, 2, 7, 12, 9, 5, 6, 1, 13, 14, 0, 11, 3, 8,
+     9, 14, 15, 5, 2, 8, 12, 3, 7, 0, 4, 10, 1, 13, 11, 6,
+     4, 3, 2, 12, 9, 5, 15, 10, 11, 14, 1, 7, 6, 0, 8, 13],
+    # S7
+    [4, 11, 2, 14, 15, 0, 8, 13, 3, 12, 9, 7, 5, 10, 6, 1,
+     13, 0, 11, 7, 4, 9, 1, 10, 14, 3, 5, 12, 2, 15, 8, 6,
+     1, 4, 11, 13, 12, 3, 7, 14, 10, 15, 6, 8, 0, 5, 9, 2,
+     6, 11, 13, 8, 1, 4, 10, 7, 9, 5, 0, 15, 14, 2, 3, 12],
+    # S8
+    [13, 2, 8, 4, 6, 15, 11, 1, 10, 9, 3, 14, 5, 0, 12, 7,
+     1, 15, 13, 8, 10, 3, 7, 4, 12, 5, 6, 11, 0, 14, 9, 2,
+     7, 11, 4, 1, 9, 12, 14, 2, 0, 6, 10, 13, 15, 3, 5, 8,
+     2, 1, 14, 7, 4, 10, 8, 13, 15, 12, 9, 0, 3, 5, 6, 11]]
+
+
+def _sbox_flat(box: int) -> list[int]:
+    """S-box as a flat 64-entry table indexed by the 6 input bits in
+    stream order b1..b6 (row = b1b6, column = b2b3b4b5)."""
+    out = []
+    for idx in range(64):
+        b = [(idx >> (5 - k)) & 1 for k in range(6)]
+        row = 2 * b[0] + b[5]
+        col = 8 * b[1] + 4 * b[2] + 2 * b[3] + b[4]
+        out.append(_S[box][16 * row + col])
+    return out
+
+
+_S_FLAT = [_sbox_flat(i) for i in range(8)]
+
+
+# ---------------------------------------------------------------------------
+# scalar reference (the CPU oracle path)
+
+def _permute(bits: list[int], table: list[int]) -> list[int]:
+    return [bits[t - 1] for t in table]
+
+
+def _key_schedule_bits(key_bits: list[int]) -> list[list[int]]:
+    kp = _permute(key_bits, _PC1)
+    c, d = kp[:28], kp[28:]
+    out = []
+    for sh in _SHIFTS:
+        c = c[sh:] + c[:sh]
+        d = d[sh:] + d[:sh]
+        out.append(_permute(c + d, _PC2))
+    return out
+
+
+def _to_bits(data: bytes) -> list[int]:
+    return [(data[i // 8] >> (7 - i % 8)) & 1 for i in range(8 * len(data))]
+
+
+def _from_bits(bits: list[int]) -> bytes:
+    out = bytearray(len(bits) // 8)
+    for i, b in enumerate(bits):
+        out[i // 8] |= b << (7 - i % 8)
+    return bytes(out)
+
+
+def des_encrypt(key8: bytes, block8: bytes) -> bytes:
+    """Scalar single-block DES encryption (oracle/test anchor)."""
+    rks = _key_schedule_bits(_to_bits(key8))
+    bits = _permute(_to_bits(block8), _IP)
+    l, r = bits[:32], bits[32:]
+    for rk in rks:
+        e = _permute(r, _E)
+        x = [a ^ b for a, b in zip(e, rk)]
+        s_out = []
+        for box in range(8):
+            six = x[6 * box:6 * box + 6]
+            idx = 0
+            for b in six:
+                idx = (idx << 1) | b
+            v = _S_FLAT[box][idx]
+            s_out += [(v >> 3) & 1, (v >> 2) & 1, (v >> 1) & 1, v & 1]
+        f = _permute(s_out, _P)
+        l, r = r, [a ^ b for a, b in zip(l, f)]
+    return _from_bits(_permute(r + l, _FP))
+
+
+def str_to_key(seven: bytes) -> bytes:
+    """7 key bytes -> 8 DES key bytes (parity bit positions unused by
+    the cipher itself): the LM/NTLM key expansion."""
+    assert len(seven) == 7
+    b = seven
+    k = [b[0] >> 1,
+         ((b[0] & 0x01) << 6) | (b[1] >> 2),
+         ((b[1] & 0x03) << 5) | (b[2] >> 3),
+         ((b[2] & 0x07) << 4) | (b[3] >> 4),
+         ((b[3] & 0x0F) << 3) | (b[4] >> 5),
+         ((b[4] & 0x1F) << 2) | (b[5] >> 6),
+         ((b[5] & 0x3F) << 1) | (b[6] >> 7),
+         b[6] & 0x7F]
+    return bytes(x << 1 for x in k)
+
+
+LM_MAGIC = b"KGS!@#$%"
+
+
+def lm_half(password_half: bytes) -> bytes:
+    """LM hash of one 7-byte half: DES_{str_to_key(upper(half))}(magic).
+    Strict: a half longer than 7 bytes is a caller bug (silent
+    truncation once produced false 'cracks' whose plaintexts did not
+    hash to the target)."""
+    if len(password_half) > 7:
+        raise ValueError("an LM half is at most 7 bytes")
+    pw = password_half.upper().ljust(7, b"\x00")
+    return des_encrypt(str_to_key(pw), LM_MAGIC)
+
+
+# ---------------------------------------------------------------------------
+# bitslice form: planes are int32 vectors, one bit-plane per DES wire;
+# lane j of vector word v holds candidate v*32+j.
+
+def _mux_tree(sels, leaves):
+    """Constant-folded 6-level mux over {0,1} leaves.  sels are bit
+    planes MSB-first; returns an int32 plane (python 0 / -1 for the
+    degenerate constant cases).  out = sels[0] ? high_half : low_half."""
+    import jax.numpy as jnp
+
+    if len(leaves) == 1:
+        return -leaves[0]          # 0 -> 0x0, 1 -> ~0 (all-ones mask)
+    half = len(leaves) // 2
+    lo = _mux_tree(sels[1:], leaves[:half])
+    hi = _mux_tree(sels[1:], leaves[half:])
+    s = sels[0]
+    if isinstance(lo, int) and isinstance(hi, int):
+        if lo == hi:
+            return lo
+        # (0, ~0) -> s; (~0, 0) -> ~s
+        return s if lo == 0 else ~s
+    if isinstance(lo, int):
+        return (s & hi) if lo == 0 else (hi | ~s)
+    if isinstance(hi, int):
+        return (lo & ~s) if hi == 0 else (lo | s)
+    return lo ^ (s & (lo ^ hi))
+
+
+def sbox_planes(box: int, six):
+    """One S-box as a boolean circuit: 6 input planes -> 4 output
+    planes (MSB first)."""
+    flat = _S_FLAT[box]
+    outs = []
+    for bit in (3, 2, 1, 0):
+        leaves = [(v >> bit) & 1 for v in flat]
+        outs.append(_mux_tree(list(six), leaves))
+    return outs
+
+
+def des_encrypt_bitslice(key_planes, data_planes):
+    """Bitslice DES: key_planes[64], data_planes[64] (int32 planes or
+    0/-1 python constants, FIPS bit order 1..64) -> cipher planes[64].
+
+    The 16 rounds run in a lax.fori_loop over PRE-WIRED round-key
+    planes (the whole key schedule is static reindexing, materialized
+    once as a [16, 48, Bv] array), so only ONE round body -- 48 xors +
+    8 S-box mux circuits + 32 xors, with the E and P permutations as
+    static row-takes -- is traced and compiled.  A fully unrolled form
+    (~31k ops) takes XLA:CPU minutes to compile, the same lesson as
+    the unrolled SHA-256 kernel.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    # find a concrete plane to learn Bv (keys always carry >= 56 real
+    # planes; all-constant keys are not a cracking workload)
+    proto = next(p for p in list(key_planes) + list(data_planes)
+                 if not isinstance(p, int))
+    Bv = proto.shape[0]
+
+    def as_row(p):
+        if isinstance(p, int):
+            return jnp.full((Bv,), jnp.int32(p))
+        return p
+
+    def perm_idx(table):
+        return np.asarray(table, np.int32) - 1
+
+    # key schedule: static wiring -> one stacked [16, 48, Bv] array
+    kp = [key_planes[t - 1] for t in _PC1]
+    c, d = kp[:28], kp[28:]
+    rks = []
+    for sh in _SHIFTS:
+        c = c[sh:] + c[:sh]
+        d = d[sh:] + d[:sh]
+        rks.append(jnp.stack([as_row((c + d)[t - 1]) for t in _PC2]))
+    rk_all = jnp.stack(rks)                      # [16, 48, Bv]
+
+    bits = [data_planes[t - 1] for t in _IP]
+    l = jnp.stack([as_row(p) for p in bits[:32]])   # [32, Bv]
+    r = jnp.stack([as_row(p) for p in bits[32:]])
+
+    e_idx = jnp.asarray(perm_idx(_E))
+    p_idx = jnp.asarray(perm_idx(_P))
+
+    def round_body(i, carry):
+        l, r = carry
+        x = r[e_idx] ^ rk_all[i]                 # [48, Bv]
+        s_out = []
+        for box in range(8):
+            s_out += sbox_planes(box, [x[6 * box + k]
+                                       for k in range(6)])
+        f = jnp.stack([as_row(p) for p in s_out])[p_idx]
+        return r, l ^ f
+
+    l, r = lax.fori_loop(0, 16, round_body, (l, r))
+    out = jnp.concatenate([r, l])                # pre-FP bit order
+    return [out[t - 1] for t in _FP]
+
+
+def const_planes(data: bytes) -> list[int]:
+    """Constant data (e.g. the LM magic or a challenge) as degenerate
+    0 / ~0 planes."""
+    return [-b for b in _to_bits(data)]
+
+
+def key_planes_from_bytes7(byte_planes: Sequence):
+    """56 byte-bit planes (7 bytes x 8 bits, MSB-first per byte) ->
+    64 DES-key planes via the str_to_key expansion (pure wiring: key
+    byte k bit positions 1..7 are password bits, bit 8 is parity =
+    constant 0 plane)."""
+    # password bit stream p0..p55 (MSB of byte 0 first); str_to_key
+    # places stream bits 7k..7k+6 into key byte k bits 1..7 (1-based
+    # MSB order), parity bit 8 unused by the cipher.
+    planes = []
+    for k in range(8):
+        for bit in range(7):
+            planes.append(byte_planes[7 * k + bit])
+        planes.append(0)      # parity position
+    return planes
