@@ -303,6 +303,31 @@ def bench_slow_engines():
     RESULTS["stages"]["slow"] = out
     flush_results()
 
+    # -- LM / bitslice DES (fast-hash class; here because it shares
+    # the custom-loop harness)
+    write_status("slow", case="lm")
+    try:
+        from dprf_tpu.engines.device.lm import make_lm_mask_step
+        from dprf_tpu.engines.base import Target
+        gen = MaskGenerator("?u?u?u?u?u?u?u")
+        B = 1 << 20
+        tgt = Target(raw="bench", digest=bytes(8))   # unmatchable-ish
+        step = make_lm_mask_step(gen, [tgt], B)
+
+        @jax.jit
+        def run(base):
+            def body(i, acc):
+                o = step(base.at[-1].add(i), jnp.int32(B))
+                return acc + o[0]
+            return lax.fori_loop(0, 64, body, jnp.int32(0))
+
+        timed("lm", run, jnp.asarray(gen.digits(0), jnp.int32), 64 * B)
+    except Exception as e:
+        out["lm"] = {"error": f"{type(e).__name__}: {e}",
+                     "traceback": traceback.format_exc()[-1200:]}
+    RESULTS["stages"]["slow"] = out
+    flush_results()
+
     # -- scrypt 16384:8:1 (the common interactive parameter set)
     write_status("slow", case="scrypt")
     try:
