@@ -995,3 +995,55 @@ class LmEngine(HashEngine):
         # instead would report plaintexts that don't hash to the
         # target)
         return [lm_half(c) if len(c) <= 7 else b"" for c in candidates]
+
+
+def netntlmv1_response(password: bytes, challenge: bytes) -> bytes:
+    """NetNTLMv1 NT response: the 16-byte NTLM hash zero-padded to 21
+    bytes makes three DES keys; each encrypts the 8-byte challenge."""
+    from dprf_tpu.ops.des import des_encrypt, str_to_key
+    key21 = _md4_utf16(password) + bytes(5)
+    return b"".join(des_encrypt(str_to_key(key21[7 * i:7 * i + 7]),
+                                challenge) for i in range(3))
+
+
+@register("netntlmv1")
+class NetNtlmV1Engine(HashEngine):
+    """NetNTLMv1 challenge-response (hashcat 5500):
+    ``user::domain:lmresp(48 hex):ntresp(48 hex):challenge(16 hex)``
+    lines; the NT response (24 bytes) is the digest."""
+
+    name = "netntlmv1"
+    digest_size = 24
+    salted = True
+    max_candidate_len = 27
+
+    def parse_target(self, text: str) -> Target:
+        body = text.strip()
+        parts = body.split(":")
+        if len(parts) != 6 or parts[1]:
+            raise ValueError(
+                f"expected user::domain:lm:nt:challenge, got {text[:40]!r}")
+        lmresp = bytes.fromhex(parts[3])
+        ntresp = bytes.fromhex(parts[4])
+        challenge = bytes.fromhex(parts[5])
+        if len(ntresp) != self.digest_size:
+            raise ValueError("NT response must be 24 bytes")
+        if len(challenge) != 8:
+            raise ValueError("server challenge must be 8 bytes")
+        if len(lmresp) == 24 and lmresp[8:] == bytes(16) \
+                and lmresp[:8] != bytes(8):
+            # NTLMv1-ESS / SSP: the LM field carries the CLIENT
+            # challenge and the DES input is MD5(server||client)[:8];
+            # checking against the raw server challenge would silently
+            # never match such captures
+            challenge = hashlib.md5(challenge + lmresp[:8]).digest()[:8]
+        return Target(raw=body, digest=ntresp,
+                      params={"challenge": challenge, "user": parts[0],
+                              "domain": parts[2]})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError("netntlmv1 needs target params (challenge)")
+        return [netntlmv1_response(c, params["challenge"])
+                for c in candidates]

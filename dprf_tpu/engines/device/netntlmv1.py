@@ -1,0 +1,271 @@
+"""Device NetNTLMv1 engine (hashcat 5500): NTLM digest -> bitslice
+triple-DES-split of the server challenge.
+
+The MD4 digest words transpose into 168 key bit-planes (21 bytes =
+three 7-byte DES keys, the last padded with constant-zero planes); the
+bitslice DES circuit (ops/des.py) then encrypts the per-target
+challenge under thirds of every candidate's NTLM hash simultaneously.
+The challenge is a trace-time constant, so steps compile per target
+(the JWT pattern) -- v1 captures come one challenge at a time.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.cpu.engines import NetNtlmV1Engine
+from dprf_tpu.engines.device.lm import match_mask, target_bits
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops import pack as pack_ops
+from dprf_tpu.ops.des import (const_planes, des_encrypt_bitslice,
+                              key_planes_from_bytes7)
+from dprf_tpu.ops.md4 import md4_digest_words
+from dprf_tpu.runtime.worker import DeviceWordlistWorker, MaskWorkerBase
+
+
+def _digest_byte_planes(nt_words: jnp.ndarray) -> list:
+    """MD4 digest uint32[B, 4] (LE words) -> 128 bit-planes in byte
+    stream order (byte k = word k//4 >> 8*(k%4)), 32 candidates per
+    int32 word."""
+    B = nt_words.shape[0]
+    groups = nt_words.astype(jnp.uint32).reshape(B // 32, 32, 4)
+    weights = jnp.left_shift(jnp.int32(1), jnp.arange(32, dtype=jnp.int32))
+    planes = []
+    for k in range(16):
+        byte = (groups[:, :, k // 4] >> jnp.uint32(8 * (k % 4))) \
+            & jnp.uint32(0xFF)
+        byte = byte.astype(jnp.int32)
+        for bit in range(8):
+            vals = (byte >> (7 - bit)) & 1
+            planes.append((vals * weights).sum(axis=1, dtype=jnp.int32))
+    return planes
+
+
+def _nt_responses(nt_words: jnp.ndarray, challenge: bytes):
+    """NTLM digests -> three cipher plane lists (the 24-byte NT
+    response in bitslice form)."""
+    dplanes = _digest_byte_planes(nt_words) + [0] * 40   # 5 zero bytes
+    chal = const_planes(challenge)
+    out = []
+    for i in range(3):
+        seven = dplanes[56 * i:56 * i + 56]
+        out.append(des_encrypt_bitslice(
+            key_planes_from_bytes7(seven), chal))
+    return out
+
+
+def _match(ciphers, digest: bytes, batch: int):
+    """Three cipher plane lists vs the 24-byte response -> bool[B]."""
+    lanebit = jnp.left_shift(jnp.int32(1), jnp.arange(32, dtype=jnp.int32))
+    m = None
+    for i in range(3):
+        part = match_mask(ciphers[i], target_bits(digest[8 * i:8 * i + 8]))
+        m = part if m is None else (m & part)
+    return ((jnp.broadcast_to(m[:, None], (batch // 32, 32))
+             & lanebit) != 0).reshape(batch)
+
+
+def make_netntlmv1_mask_step(gen, target, batch: int,
+                             hit_capacity: int = 64):
+    """Per-target step: step(base_digits, n_valid) -> (count, lanes, _)."""
+    if batch % 32:
+        raise ValueError("bitslice batch must be a multiple of 32")
+    if gen.length > 27:
+        raise ValueError(f"netntlmv1 passwords cap at 27 chars "
+                         f"(UTF-16LE widening); mask decodes to "
+                         f"{gen.length}")
+    flat = gen.flat_charsets
+    length = gen.length
+    challenge = target.params["challenge"]
+    digest = target.digest
+
+    @jax.jit
+    def step(base_digits, n_valid):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        wide = pack_ops.utf16le_widen(cand)
+        nt = md4_digest_words(
+            pack_ops.pack_fixed(wide, 2 * length, big_endian=False))
+        found = _match(_nt_responses(nt, challenge), digest, batch)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def make_netntlmv1_wordlist_step(gen, target, word_batch: int,
+                                 hit_capacity: int = 64,
+                                 word_tables=None):
+    """word_tables: optional pre-uploaded (words_dev, lens_dev) so the
+    per-target step factories share ONE device copy of the wordlist."""
+    from jax import lax
+
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, L = word_batch, gen.max_len
+    if L > 27:
+        raise ValueError("ntlm candidates cap at 27 chars; lower "
+                         "--max-len")
+    if word_tables is None:
+        words_np, lens_np = gen.packed_words(pad_to=B,
+                                             min_size=gen.n_words + B - 1)
+        word_tables = (jnp.asarray(words_np), jnp.asarray(lens_np))
+    words_dev, lens_dev = word_tables
+    rules = gen.rules
+    challenge = target.params["challenge"]
+    digest = target.digest
+
+    @jax.jit
+    def step(w0, n_valid_words):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
+        RB = cw.shape[0]
+        pad = (-RB) % 32
+        cw = jnp.pad(cw, ((0, pad), (0, 0)))
+        cl_p = jnp.pad(cl, (0, pad))
+        wide = pack_ops.utf16le_widen(cw)
+        nt = md4_digest_words(
+            pack_ops.pack_varlen(wide, cl_p * 2, big_endian=False))
+        found = _match(_nt_responses(nt, challenge), digest, RB + pad)
+        found = found[:RB] & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
+                                    hit_capacity)
+
+    return step
+
+
+class NetNtlmV1MaskWorker(MaskWorkerBase):
+    """Per-target compiled steps (trace-time challenge), single-target
+    hit decode per sweep."""
+
+    def __init__(self, engine, gen, targets, batch: int = 1 << 18,
+                 hit_capacity: int = 64, oracle=None):
+        self.engine = engine
+        self.gen = gen
+        self.targets = list(targets)
+        self.hit_capacity = hit_capacity
+        self.oracle = oracle
+        self.multi = len(self.targets) > 1
+        self._order = np.arange(max(1, len(self.targets)), dtype=np.int64)
+        batch = max(32, (batch // 32) * 32)
+        self.batch = self.stride = batch
+        self._steps = [make_netntlmv1_mask_step(gen, t, batch,
+                                                hit_capacity)
+                       for t in self.targets]
+
+    def process(self, unit):
+        from dprf_tpu.runtime.worker import Hit
+        hits: list = []
+        for ti, step in enumerate(self._steps):
+            queued = []
+            for bstart in range(unit.start, unit.end, self.stride):
+                n_valid = min(self.stride, unit.end - bstart)
+                base = jnp.asarray(self.gen.digits(bstart),
+                                   dtype=jnp.int32)
+                queued.append((bstart, step(base, jnp.int32(n_valid))))
+            for bstart, (count, lanes, _) in queued:
+                count = int(count)
+                if count == 0:
+                    continue
+                if count > self.hit_capacity:
+                    # CpuWorker over the single target reports index 0
+                    hits.extend(Hit(ti, h.cand_index, h.plaintext)
+                                for h in self._rescan_one(bstart, unit,
+                                                          ti))
+                    continue
+                for lane in np.asarray(lanes):
+                    if lane < 0:
+                        continue
+                    gidx = bstart + int(lane)
+                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+    def _rescan_one(self, bstart: int, unit, ti: int):
+        from dprf_tpu.runtime.worker import CpuWorker
+        from dprf_tpu.runtime.workunit import WorkUnit
+        if self.oracle is None:
+            raise RuntimeError("hit buffer overflow and no oracle")
+        end = min(bstart + self.stride, unit.end)
+        sub = WorkUnit(-1, bstart, end - bstart)
+        return CpuWorker(self.oracle, self.gen,
+                         [self.targets[ti]]).process(sub)
+
+
+class NetNtlmV1WordlistWorker(DeviceWordlistWorker):
+    """DeviceWordlistWorker machinery over per-target bitslice steps;
+    sweeps the word range once per target."""
+
+    def __init__(self, engine, gen, targets, batch: int = 1 << 18,
+                 hit_capacity: int = 64, oracle=None):
+        self.engine = engine
+        self.gen = gen
+        self.targets = list(targets)
+        self.hit_capacity = hit_capacity
+        self.oracle = oracle
+        self.multi = len(self.targets) > 1
+        self._order = np.arange(max(1, len(self.targets)), dtype=np.int64)
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self.batch = batch
+        words_np, lens_np = gen.packed_words(
+            pad_to=self.word_batch,
+            min_size=gen.n_words + self.word_batch - 1)
+        tables = (jnp.asarray(words_np), jnp.asarray(lens_np))
+        self._steps = [
+            make_netntlmv1_wordlist_step(gen, t, self.word_batch,
+                                         hit_capacity,
+                                         word_tables=tables)
+            for t in self.targets]
+
+    def process(self, unit):
+        from dprf_tpu.runtime.worker import Hit
+
+        hits = []
+        all_targets = self.targets
+        try:
+            for ti, step in enumerate(self._steps):
+                # single-target view so the inherited hit decode AND
+                # the overflow rescan both see exactly this target;
+                # their index-0 hits rebind to ti
+                self.step = step
+                self.targets = [all_targets[ti]]
+                self.multi = False
+                hits.extend(Hit(ti, h.cand_index, h.plaintext)
+                            for h in super().process(unit))
+        finally:
+            self.targets = all_targets
+            self.multi = len(all_targets) > 1
+        return hits
+
+
+@register("netntlmv1", device="jax")
+class JaxNetNtlmV1Engine(NetNtlmV1Engine):
+    """Device NetNTLMv1: NTLM on the word pipeline, response via three
+    bitslice DES encryptions of the challenge."""
+
+    little_endian = True
+    digest_words = 6
+
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return NetNtlmV1MaskWorker(self, gen, targets, batch=batch,
+                                   hit_capacity=hit_capacity,
+                                   oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return NetNtlmV1WordlistWorker(self, gen, targets, batch=batch,
+                                       hit_capacity=hit_capacity,
+                                       oracle=oracle)
+
+    make_sharded_mask_worker = None
+    make_sharded_wordlist_worker = None
+    make_combinator_worker = None
+    make_sharded_combinator_worker = None
