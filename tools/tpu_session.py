@@ -273,36 +273,6 @@ def bench_slow_engines():
     RESULTS["stages"]["slow"] = out
     flush_results()
 
-    # -- bcrypt (config 4's path) at cost 8: the S-box gathers
-    # serialize with batch AND rounds, so a cost-12 dispatch (~218 s)
-    # exceeds the tunnel's ~60 s execution deadline at any batch and
-    # faults the whole client backend (measured 2026-07-30); cost 8 at
-    # B=64 (~14 s dispatches) measures the same code path safely --
-    # scale the number by 1/16 for the cost-12 figure.
-    write_status("slow", case="bcrypt8")
-    try:
-        from dprf_tpu.engines.device.bcrypt import make_bcrypt_mask_step
-        gen = MaskGenerator("?l?l?l?l?l?l")
-        B = 64
-        step = make_bcrypt_mask_step(gen, B)
-        salt_words = jnp.asarray(
-            np.frombuffer(bytes(range(16)), ">u4").astype(np.uint32))
-        tgt = jnp.full((6,), 0xFFFFFFFF, jnp.uint32)
-
-        @jax.jit
-        def run(base):
-            o = step(base, jnp.int32(B), salt_words,
-                     jnp.int32(1 << 8), tgt)
-            return o[0]
-
-        timed("bcrypt8", run, jnp.asarray(gen.digits(0), jnp.int32), B,
-              seconds=30.0)
-    except Exception as e:
-        out["bcrypt8"] = {"error": f"{type(e).__name__}: {e}",
-                         "traceback": traceback.format_exc()[-1200:]}
-    RESULTS["stages"]["slow"] = out
-    flush_results()
-
     # -- LM / bitslice DES (fast-hash class; here because it shares
     # the custom-loop harness)
     write_status("slow", case="lm")
@@ -353,6 +323,36 @@ def bench_slow_engines():
                          "traceback": traceback.format_exc()[-1200:]}
     RESULTS["stages"]["slow"] = out
     flush_results()
+    # -- bcrypt (config 4's path) at cost 8: the S-box gathers
+    # serialize with batch AND rounds, so a cost-12 dispatch (~218 s)
+    # exceeds the tunnel's ~60 s execution deadline at any batch and
+    # faults the whole client backend (measured 2026-07-30); cost 8 at
+    # B=64 (~14 s dispatches) measures the same code path safely --
+    # scale the number by 1/16 for the cost-12 figure.
+    write_status("slow", case="bcrypt8")
+    try:
+        from dprf_tpu.engines.device.bcrypt import make_bcrypt_mask_step
+        gen = MaskGenerator("?l?l?l?l?l?l")
+        B = 64
+        step = make_bcrypt_mask_step(gen, B)
+        salt_words = jnp.asarray(
+            np.frombuffer(bytes(range(16)), ">u4").astype(np.uint32))
+        tgt = jnp.full((6,), 0xFFFFFFFF, jnp.uint32)
+
+        @jax.jit
+        def run(base):
+            o = step(base, jnp.int32(B), salt_words,
+                     jnp.int32(1 << 8), tgt)
+            return o[0]
+
+        timed("bcrypt8", run, jnp.asarray(gen.digits(0), jnp.int32), B,
+              seconds=30.0)
+    except Exception as e:
+        out["bcrypt8"] = {"error": f"{type(e).__name__}: {e}",
+                         "traceback": traceback.format_exc()[-1200:]}
+    RESULTS["stages"]["slow"] = out
+    flush_results()
+
     return out
 
 
