@@ -25,10 +25,12 @@ from dprf_tpu.engines.cpu.engines import (SALT_MAX, JwtHs256Engine,
                                           parse_salted_line)
 from dprf_tpu.engines.device.engines import (JaxMd5Engine, JaxSha1Engine,
                                              JaxSha256Engine)
-from dprf_tpu.engines.device.salted import (SaltedMaskWorker,
+from dprf_tpu.engines.device.salted import (PerTargetStepsMixin,
+                                            SaltedMaskWorker,
                                             SaltedWordlistWorker,
                                             ShardedSaltedMaskWorker,
-                                            _SaltedWorkerBase)
+                                            _SaltedWorkerBase,
+                                            per_target_setup)
 from dprf_tpu.ops import compare as cmp_ops
 from dprf_tpu.ops import pack as pack_ops
 from dprf_tpu.ops.hmac import (hmac_const_msg, hmac_one_block_msg,
@@ -311,55 +313,37 @@ def make_jwt_wordlist_step(gen, msg: bytes, target_words: np.ndarray,
     return step
 
 
-def _jwt_setup(worker, engine, gen, targets, batch, hit_capacity, oracle):
-    """Shared field setup for the JWT workers (their per-target state is
-    a compiled step, not a (salt, target) pair, so _SaltedWorkerBase's
-    __init__ does not apply)."""
-    worker.engine = engine
-    worker.gen = gen
-    worker.targets = list(targets)
-    worker.hit_capacity = hit_capacity
-    worker.oracle = oracle
-    worker.batch = batch
-
-
 def _jwt_twords(t) -> np.ndarray:
     return np.frombuffer(t.digest, dtype=">u4").astype(np.uint32)
 
 
-class JwtMaskWorker(SaltedMaskWorker):
+class JwtMaskWorker(PerTargetStepsMixin, SaltedMaskWorker):
     """Per-target sweep with per-target compiled steps (the signing
     input is a trace-time constant); hit extraction is inherited from
     the salted worker via the _invoke override point."""
 
     def __init__(self, engine, gen, targets, batch: int = 1 << 18,
                  hit_capacity: int = 64, oracle=None):
-        _jwt_setup(self, engine, gen, targets, batch, hit_capacity,
-                   oracle)
+        per_target_setup(self, engine, gen, targets, batch,
+                         hit_capacity, oracle)
         self.stride = batch
         self._steps = [
             make_jwt_mask_step(gen, t.params["msg"], _jwt_twords(t),
                                batch, hit_capacity)
             for t in self.targets]
 
-    def _invoke(self, ti: int, base, n):
-        return self._steps[ti](base, n)
 
-
-class JwtWordlistWorker(SaltedWordlistWorker):
+class JwtWordlistWorker(PerTargetStepsMixin, SaltedWordlistWorker):
     def __init__(self, engine, gen, targets, batch: int = 1 << 18,
                  hit_capacity: int = 64, oracle=None):
-        _jwt_setup(self, engine, gen, targets, batch, hit_capacity,
-                   oracle)
+        per_target_setup(self, engine, gen, targets, batch,
+                         hit_capacity, oracle)
         self.word_batch = max(1, batch // gen.n_rules)
         self.stride = self.word_batch * gen.n_rules
         self._steps = [
             make_jwt_wordlist_step(gen, t.params["msg"], _jwt_twords(t),
                                    self.word_batch, hit_capacity)
             for t in self.targets]
-
-    def _invoke(self, ti: int, base, n):
-        return self._steps[ti](base, n)
 
 
 @register("jwt-hs256", device="jax")

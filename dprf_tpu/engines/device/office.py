@@ -1,0 +1,225 @@
+"""Device MS Office 2007 engine (hashcat 9400).
+
+Per candidate: 50,002 chained SHA-1 compressions on the word pipeline
+(a lax.fori_loop -- the same iterated-KDF shape as PMKID), the
+MS-OFFCRYPTO X1 key derivation, then a gather-based AES-128 decrypt of
+the verifier blocks (ops/aes.py).  The AES gathers cost ~3% of the
+SHA-1 spin, so the measured per-lane gather serialization that rules
+out gather-heavy ciphers as hot loops is irrelevant here.  Salt and
+verifier blocks are per-target trace-time constants (the JWT
+per-target-step pattern).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.cpu.engines import Office2007Engine
+from dprf_tpu.engines.device.salted import (PerTargetStepsMixin,
+                                            SaltedMaskWorker,
+                                            SaltedWordlistWorker,
+                                            per_target_setup)
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops import pack as pack_ops
+from dprf_tpu.ops.aes import aes128_decrypt_blocks
+from dprf_tpu.ops.scrypt import bswap32
+from dprf_tpu.ops.sha1 import INIT as SHA1_INIT, sha1_compress
+
+
+def _sha1_of_24(state_words, first_word):
+    """SHA-1 of a 24-byte message (4-byte prefix + 20-byte digest):
+    one compression on a padded block."""
+    B = state_words.shape[0]
+    m = jnp.zeros((B, 16), jnp.uint32)
+    m = m.at[:, 0].set(first_word)
+    m = m.at[:, 1:6].set(state_words)
+    m = m.at[:, 6].set(jnp.uint32(0x80000000))
+    m = m.at[:, 15].set(jnp.uint32(24 * 8))
+    init = jnp.broadcast_to(jnp.asarray(SHA1_INIT), (B, 5))
+    return sha1_compress(init, m)
+
+
+def office2007_key_words(cand: jnp.ndarray, lengths: jnp.ndarray,
+                         salt: bytes, spin_count: int) -> jnp.ndarray:
+    """Candidates uint8[B, L] -> AES key bytes uint8[B, 16] via the
+    MS-OFFCRYPTO standard-encryption derivation."""
+    B = cand.shape[0]
+    wide = pack_ops.utf16le_widen(cand)
+    # H0 = SHA1(salt || UTF16LE(pw)): salt is a 16-byte constant
+    # prefix, so pack the widened password after it in one block
+    width = 16 + wide.shape[1]
+    buf = jnp.zeros((B, width), jnp.uint8)
+    buf = buf.at[:, :16].set(jnp.broadcast_to(
+        jnp.asarray(np.frombuffer(salt, np.uint8)), (B, 16)))
+    buf = buf.at[:, 16:].set(wide)
+    words = pack_ops.pack_varlen(buf, lengths * 2 + 16, big_endian=True)
+    init = jnp.broadcast_to(jnp.asarray(SHA1_INIT), (B, 5))
+    h = sha1_compress(init, words)
+
+    def body(i, h):
+        # LE32(i) occupies the first 4 message bytes; as a big-endian
+        # packed word that is bswap32(i)
+        return _sha1_of_24(h, bswap32(jnp.uint32(i)))
+
+    h = lax.fori_loop(0, spin_count, body, h)
+    # Hfinal = SHA1(H || LE32(0))
+    m = jnp.zeros((B, 16), jnp.uint32)
+    m = m.at[:, 0:5].set(h)
+    m = m.at[:, 6].set(jnp.uint32(0x80000000))   # marker at byte 24
+    m = m.at[:, 15].set(jnp.uint32(24 * 8))
+    hfinal = sha1_compress(init, m)
+    # X1 = SHA1(0x36*64 with Hfinal xored into the first 20 bytes):
+    # a full first block then a constant pad block
+    pad36 = jnp.uint32(0x36363636)
+    blk1 = jnp.full((B, 16), pad36, jnp.uint32)
+    blk1 = blk1.at[:, 0:5].set(hfinal ^ pad36)
+    state = sha1_compress(init, blk1)
+    blk2 = np.zeros(16, np.uint32)
+    blk2[0] = 0x80000000
+    blk2[15] = 64 * 8
+    x1 = sha1_compress(state, jnp.broadcast_to(jnp.asarray(blk2),
+                                               (B, 16)))
+    # first 16 key bytes from the big-endian X1 words
+    key = jnp.zeros((B, 16), jnp.uint8)
+    for j in range(16):
+        key = key.at[:, j].set(
+            (x1[:, j // 4] >> jnp.uint32(24 - 8 * (j % 4)))
+            .astype(jnp.uint8))
+    return key
+
+
+def _office_found(cand, lengths, target, spin_count):
+    salt = target.params["salt"]
+    ev = target.params["verifier"]
+    evh = target.params["verifier_hash"]
+    blocks = np.stack([
+        np.frombuffer(ev, np.uint8),
+        np.frombuffer(evh[:16], np.uint8),
+        np.frombuffer(evh[16:], np.uint8)])
+    key = office2007_key_words(cand, lengths, salt, spin_count)
+    plain = aes128_decrypt_blocks(key, blocks)
+    verifier = plain[:, 0]                        # [B, 16]
+    vhash = plain[:, 1:3].reshape(-1, 32)
+    # SHA1(verifier): 16-byte message
+    B = cand.shape[0]
+    words = pack_ops.pack_fixed(verifier, 16, big_endian=True)
+    init = jnp.broadcast_to(jnp.asarray(SHA1_INIT), (B, 5))
+    vh_words = sha1_compress(init, words)
+    # decrypted hash bytes -> 5 big-endian words
+    want = jnp.zeros((B, 5), jnp.uint32)
+    for w in range(5):
+        acc = jnp.zeros((B,), jnp.uint32)
+        for b in range(4):
+            acc = (acc << jnp.uint32(8)) | \
+                vhash[:, 4 * w + b].astype(jnp.uint32)
+        want = want.at[:, w].set(acc)
+    return jnp.all(vh_words == want, axis=-1)
+
+
+def make_office_mask_step(gen, target, batch: int, spin_count: int,
+                          hit_capacity: int = 64):
+    """Per-target step: step(base_digits, n_valid) -> (count, lanes, _)."""
+    if gen.length > 19:
+        raise ValueError(
+            f"office2007 passwords cap at 19 chars (salt + UTF-16LE in "
+            f"one SHA-1 block); mask decodes to {gen.length}")
+    flat = gen.flat_charsets
+    length = gen.length
+
+    @jax.jit
+    def step(base_digits, n_valid):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        lengths = jnp.full((batch,), length, jnp.int32)
+        found = _office_found(cand, lengths, target, spin_count)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def make_office_wordlist_step(gen, target, word_batch: int,
+                              spin_count: int, hit_capacity: int = 64):
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, L = word_batch, gen.max_len
+    if L > 19:
+        raise ValueError("office2007 passwords cap at 19 chars; lower "
+                         "--max-len")
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+
+    @jax.jit
+    def step(w0, n_valid_words):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
+        # pack_varlen masks bytes at positions >= length, so rule-edit
+        # garbage beyond cl never reaches the hash
+        found = _office_found(cw, cl, target, spin_count) & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
+                                    hit_capacity)
+
+    return step
+
+
+class OfficeMaskWorker(PerTargetStepsMixin, SaltedMaskWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 13,
+                 hit_capacity: int = 64, oracle=None):
+        per_target_setup(self, engine, gen, targets, batch,
+                         hit_capacity, oracle)
+        self.stride = batch
+        self._steps = [
+            make_office_mask_step(gen, t, batch, engine.spin_count,
+                                  hit_capacity)
+            for t in self.targets]
+
+
+class OfficeWordlistWorker(PerTargetStepsMixin, SaltedWordlistWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 13,
+                 hit_capacity: int = 64, oracle=None):
+        per_target_setup(self, engine, gen, targets, batch,
+                         hit_capacity, oracle)
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self._steps = [
+            make_office_wordlist_step(gen, t, self.word_batch,
+                                      engine.spin_count, hit_capacity)
+            for t in self.targets]
+
+
+@register("office2007", device="jax")
+@register("office", device="jax")
+class JaxOffice2007Engine(Office2007Engine):
+    """Device Office 2007: the SHA-1 spin on the word pipeline, AES
+    verifier check via gather tables."""
+
+    little_endian = False
+    digest_words = 1
+
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        # 50k compressions/candidate: cap the batch like PMKID does
+        return OfficeMaskWorker(self, gen, targets,
+                                batch=min(batch, 1 << 13),
+                                hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return OfficeWordlistWorker(self, gen, targets,
+                                    batch=min(batch, 1 << 13),
+                                    hit_capacity=hit_capacity,
+                                    oracle=oracle)
+
+    make_sharded_mask_worker = None
+    make_sharded_wordlist_worker = None
+    make_combinator_worker = None
+    make_sharded_combinator_worker = None

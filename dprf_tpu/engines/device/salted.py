@@ -232,6 +232,27 @@ class _SaltedWorkerBase:
         return True
 
 
+def per_target_setup(worker, engine, gen, targets, batch, hit_capacity,
+                     oracle):
+    """Shared field setup for worker families whose per-target state is
+    a COMPILED STEP (JWT's signing input, office's salt+verifier
+    blocks) rather than the (salt, digest words) rows
+    _SaltedWorkerBase.__init__ prepares."""
+    worker.engine = engine
+    worker.gen = gen
+    worker.targets = list(targets)
+    worker.hit_capacity = hit_capacity
+    worker.oracle = oracle
+    worker.batch = batch
+
+
+class PerTargetStepsMixin:
+    """_invoke for workers holding one compiled step per target."""
+
+    def _invoke(self, ti: int, base, n):
+        return self._steps[ti](base, n)
+
+
 class SaltedMaskWorker(_SaltedWorkerBase):
     def __init__(self, engine, gen, targets, batch: int = 1 << 18,
                  hit_capacity: int = 64, oracle=None):

@@ -1,0 +1,198 @@
+"""AES-128 for verifier checks (MS Office, and any future AES-gated
+format).
+
+Scope deliberately narrow: the password-cracking use of AES here is
+ONE to THREE block decryptions per candidate at the END of an
+iterated-hash chain (Office 2007 runs 50,002 SHA-1 compressions
+first), so a gather-based device implementation is fine -- the
+measured per-lane gather serialization that makes bcrypt slow costs
+~3% here because the hash chain dominates.  The S-box is FIPS-197
+specification data; the inverse box and round constants are derived
+from it at import.
+
+Scalar encrypt/decrypt double as the CPU oracle and the test-vector
+builders; `aes128_decrypt_blocks` is the jit-traceable batched form.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# FIPS-197 S-box (specification constant).
+SBOX = bytes.fromhex(
+    "637c777bf26b6fc53001672bfed7ab76ca82c97dfa5947f0add4a2af9ca472c0"
+    "b7fd9326363ff7cc34a5e5f171d8311504c723c31896059a071280e2eb27b275"
+    "09832c1a1b6e5aa0523bd6b329e32f8453d100ed20fcb15b6acbbe394a4c58cf"
+    "d0efaafb434d338545f9027f503c9fa851a3408f929d38f5bcb6da2110fff3d2"
+    "cd0c13ec5f974417c4a77e3d645d197360814fdc222a908846eeb814de5e0bdb"
+    "e0323a0a4906245cc2d3ac629195e479e7c8376d8dd54ea96c56f4ea657aae08"
+    "ba78252e1ca6b4c6e8dd741f4bbd8b8a703eb5664803f60e613557b986c11d9e"
+    "e1f8981169d98e949b1e87e9ce5528df8ca1890dbfe6426841992d0fb054bb16")
+
+_inv = bytearray(256)
+for _i, _v in enumerate(SBOX):
+    _inv[_v] = _i
+INV_SBOX = bytes(_inv)
+
+
+def _xtime(a: int) -> int:
+    a <<= 1
+    return (a ^ 0x1B) & 0xFF if a & 0x100 else a
+
+
+def _gmul(a: int, b: int) -> int:
+    out = 0
+    for _ in range(8):
+        if b & 1:
+            out ^= a
+        a = _xtime(a)
+        b >>= 1
+    return out
+
+
+_RCON = []
+_r = 1
+for _ in range(10):
+    _RCON.append(_r)
+    _r = _xtime(_r)
+
+
+def key_schedule(key16: bytes) -> list[bytes]:
+    """AES-128 expanded round keys: 11 x 16 bytes."""
+    w = [list(key16[4 * i:4 * i + 4]) for i in range(4)]
+    for i in range(4, 44):
+        t = list(w[i - 1])
+        if i % 4 == 0:
+            t = t[1:] + t[:1]
+            t = [SBOX[b] for b in t]
+            t[0] ^= _RCON[i // 4 - 1]
+        w.append([a ^ b for a, b in zip(w[i - 4], t)])
+    return [bytes(sum(w[4 * r:4 * r + 4], [])) for r in range(11)]
+
+
+def _sub(state, box):
+    return [box[b] for b in state]
+
+
+def _shift_rows(s, inv=False):
+    out = list(s)
+    for r in range(1, 4):
+        row = [s[r + 4 * c] for c in range(4)]
+        k = (-r) % 4 if inv else r
+        row = row[k:] + row[:k]
+        for c in range(4):
+            out[r + 4 * c] = row[c]
+    return out
+
+
+def _mix_columns(s, inv=False):
+    m = ([[14, 11, 13, 9], [9, 14, 11, 13], [13, 9, 14, 11],
+          [11, 13, 9, 14]] if inv else
+         [[2, 3, 1, 1], [1, 2, 3, 1], [1, 1, 2, 3], [3, 1, 1, 2]])
+    out = [0] * 16
+    for c in range(4):
+        col = s[4 * c:4 * c + 4]
+        for r in range(4):
+            out[4 * c + r] = (_gmul(m[r][0], col[0]) ^ _gmul(m[r][1], col[1])
+                              ^ _gmul(m[r][2], col[2])
+                              ^ _gmul(m[r][3], col[3]))
+    return out
+
+
+def aes128_encrypt_block(key16: bytes, block16: bytes) -> bytes:
+    rks = key_schedule(key16)
+    s = [b ^ k for b, k in zip(block16, rks[0])]
+    for rnd in range(1, 10):
+        s = _mix_columns(_shift_rows(_sub(s, SBOX)))
+        s = [b ^ k for b, k in zip(s, rks[rnd])]
+    s = _shift_rows(_sub(s, SBOX))
+    return bytes(b ^ k for b, k in zip(s, rks[10]))
+
+
+def aes128_decrypt_block(key16: bytes, block16: bytes) -> bytes:
+    rks = key_schedule(key16)
+    s = [b ^ k for b, k in zip(block16, rks[10])]
+    for rnd in range(9, 0, -1):
+        s = _sub(_shift_rows(s, inv=True), INV_SBOX)
+        s = [b ^ k for b, k in zip(s, rks[rnd])]
+        s = _mix_columns(s, inv=True)
+    s = _sub(_shift_rows(s, inv=True), INV_SBOX)
+    return bytes(b ^ k for b, k in zip(s, rks[0]))
+
+
+# ---------------------------------------------------------------------------
+# batched device form (gather S-boxes; keys differ per candidate)
+
+def _dev_tables():
+    import jax.numpy as jnp
+    return (jnp.asarray(np.frombuffer(SBOX, np.uint8)),
+            jnp.asarray(np.frombuffer(INV_SBOX, np.uint8)),
+            jnp.asarray(_mul_table(), np.uint8))
+
+
+def _mul_table() -> np.ndarray:
+    """GF(2^8) multiply tables for the InvMixColumns coefficients
+    {9, 11, 13, 14}: uint8[4, 256]."""
+    out = np.zeros((4, 256), np.uint8)
+    for i, coef in enumerate((9, 11, 13, 14)):
+        for x in range(256):
+            out[i, x] = _gmul(coef, x)
+    return out
+
+
+def _take(table, idx):
+    import jax.numpy as jnp
+    return jnp.take(table, idx.astype(jnp.int32), axis=0)
+
+
+def aes128_key_schedule_batch(key: "jnp.ndarray"):
+    """uint8[B, 16] keys -> uint8[B, 11, 16] round keys (vectorized
+    FIPS-197 expansion; 40 S-box gathers total, shared per batch)."""
+    import jax.numpy as jnp
+
+    sbox, _, _ = _dev_tables()
+    w = [key[:, 4 * i:4 * i + 4] for i in range(4)]
+    for i in range(4, 44):
+        t = w[i - 1]
+        if i % 4 == 0:
+            t = jnp.concatenate([t[:, 1:], t[:, :1]], axis=1)
+            t = _take(sbox, t)
+            t = t.at[:, 0].set(t[:, 0] ^ np.uint8(_RCON[i // 4 - 1]))
+        w.append(w[i - 4] ^ t)
+    return jnp.stack(w, axis=1).reshape(key.shape[0], 11, 16)
+
+
+_INV_SHIFT = np.array(
+    [0, 13, 10, 7, 4, 1, 14, 11, 8, 5, 2, 15, 12, 9, 6, 3], np.int32)
+
+
+def aes128_decrypt_blocks(keys: "jnp.ndarray",
+                          blocks: np.ndarray) -> "jnp.ndarray":
+    """Per-candidate keys uint8[B, 16] + CONSTANT ciphertext blocks
+    uint8[N, 16] -> plaintext uint8[B, N, 16]."""
+    import jax.numpy as jnp
+
+    _, inv_sbox, mul = _dev_tables()
+    B = keys.shape[0]
+    rks = aes128_key_schedule_batch(keys)
+    ct = jnp.broadcast_to(jnp.asarray(blocks, jnp.uint8)[None],
+                          (B,) + blocks.shape)
+    out = []
+    inv_shift = jnp.asarray(_INV_SHIFT)
+    for n in range(blocks.shape[0]):
+        s = ct[:, n] ^ rks[:, 10]
+        for rnd in range(9, 0, -1):
+            s = _take(inv_sbox, s[:, inv_shift])
+            s = s ^ rks[:, rnd]
+            # InvMixColumns over the 4 columns
+            cols = s.reshape(B, 4, 4)
+            g = [_take(mul[i], cols) for i in range(4)]   # 9,11,13,14
+            m9, m11, m13, m14 = g
+            r0 = m14[..., 0] ^ m11[..., 1] ^ m13[..., 2] ^ m9[..., 3]
+            r1 = m9[..., 0] ^ m14[..., 1] ^ m11[..., 2] ^ m13[..., 3]
+            r2 = m13[..., 0] ^ m9[..., 1] ^ m14[..., 2] ^ m11[..., 3]
+            r3 = m11[..., 0] ^ m13[..., 1] ^ m9[..., 2] ^ m14[..., 3]
+            s = jnp.stack([r0, r1, r2, r3], axis=-1).reshape(B, 16)
+        s = _take(inv_sbox, s[:, inv_shift])
+        out.append(s ^ rks[:, 0])
+    return jnp.stack(out, axis=1)

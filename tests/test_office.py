@@ -1,0 +1,90 @@
+"""MS Office 2007 (hashcat 9400): AES reference vectors, the
+MS-OFFCRYPTO derivation, and device workers (spin count lowered so the
+CPU-mesh suite stays fast)."""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+from dprf_tpu.ops.aes import (aes128_decrypt_block, aes128_encrypt_block,
+                              aes128_decrypt_blocks)
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+def test_aes_fips_vector():
+    key = bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c")
+    pt = bytes.fromhex("3243f6a8885a308d313198a2e0370734")
+    ct = aes128_encrypt_block(key, pt)
+    assert ct.hex() == "3925841d02dc09fbdc118597196a0b32"
+    assert aes128_decrypt_block(key, ct) == pt
+
+
+def test_batched_decrypt_matches_scalar():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(3)
+    keys = rng.randint(0, 256, (32, 16), dtype=np.uint8)
+    blocks = rng.randint(0, 256, (2, 16), dtype=np.uint8)
+    got = np.asarray(aes128_decrypt_blocks(jnp.asarray(keys), blocks))
+    for j in range(32):
+        for n in range(2):
+            assert bytes(got[j, n]) == \
+                aes128_decrypt_block(bytes(keys[j]), bytes(blocks[n]))
+
+
+def _line(pw: bytes, spin: int, salt: bytes = bytes(range(16))) -> str:
+    eng = get_engine("office2007")
+    eng.spin_count = spin
+    key = eng._derive_key(pw, salt)
+    verifier = os.urandom(16)
+    vh = hashlib.sha1(verifier).digest() + os.urandom(12)
+    ev = aes128_encrypt_block(key, verifier)
+    evh = (aes128_encrypt_block(key, vh[:16])
+           + aes128_encrypt_block(key, vh[16:]))
+    return "$office$*2007*20*128*16*%s*%s*%s" % (
+        salt.hex(), ev.hex(), evh.hex())
+
+
+def test_parse_and_oracle():
+    eng = get_engine("office2007")
+    eng.spin_count = 100
+    t = eng.parse_target(_line(b"secret", 100))
+    assert eng.hash_batch([b"secret"], params=t.params)[0] == b"\x01"
+    assert eng.hash_batch([b"wrong"], params=t.params)[0] == b"\x00"
+    with pytest.raises(ValueError):
+        eng.parse_target("$office$*2013*20*128*16*aa*bb*cc")
+    with pytest.raises(ValueError):
+        eng.parse_target("not an office line")
+
+
+def test_device_mask_worker_cracks():
+    cpu = get_engine("office2007")
+    dev = get_engine("office2007", device="jax")
+    cpu.spin_count = dev.spin_count = 100
+    gen = MaskGenerator("?l?l")
+    t = cpu.parse_target(_line(b"fx", 100))
+    w = dev.make_mask_worker(gen, [t], batch=512, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [b"fx"]
+
+
+def test_device_wordlist_worker_cracks():
+    from dprf_tpu.rules.parser import parse_rule
+
+    cpu = get_engine("office2007")
+    dev = get_engine("office2007", device="jax")
+    cpu.spin_count = dev.spin_count = 80
+    gen = WordlistRulesGenerator(
+        words=[b"apple", b"Banana", b"zebra"],
+        rules=[parse_rule(":"), parse_rule("l")], max_len=16)
+    t = cpu.parse_target(_line(b"banana", 80))
+    w = dev.make_wordlist_worker(gen, [t], batch=128, hit_capacity=8,
+                                 oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert b"banana" in {h.plaintext for h in hits}
