@@ -18,7 +18,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from dprf_tpu.engines import register
-from dprf_tpu.engines.cpu.engines import Office2007Engine
+from dprf_tpu.engines.cpu.engines import (Office2007Engine,
+                                          Office2010Engine,
+                                          Office2013Engine)
 from dprf_tpu.engines.device.salted import (PerTargetStepsMixin,
                                             SaltedMaskWorker,
                                             SaltedWordlistWorker,
@@ -43,20 +45,23 @@ def _sha1_of_24(state_words, first_word):
     return sha1_compress(init, m)
 
 
-def office2007_key_words(cand: jnp.ndarray, lengths: jnp.ndarray,
-                         salt: bytes, spin_count: int) -> jnp.ndarray:
-    """Candidates uint8[B, L] -> AES key bytes uint8[B, 16] via the
-    MS-OFFCRYPTO standard-encryption derivation."""
+def _salted_pw_buf(cand, lengths, salt: bytes):
+    """(salt || UTF16LE(pw)) byte buffer + lengths for H0."""
     B = cand.shape[0]
     wide = pack_ops.utf16le_widen(cand)
-    # H0 = SHA1(salt || UTF16LE(pw)): salt is a 16-byte constant
-    # prefix, so pack the widened password after it in one block
-    width = 16 + wide.shape[1]
-    buf = jnp.zeros((B, width), jnp.uint8)
+    buf = jnp.zeros((B, 16 + wide.shape[1]), jnp.uint8)
     buf = buf.at[:, :16].set(jnp.broadcast_to(
         jnp.asarray(np.frombuffer(salt, np.uint8)), (B, 16)))
     buf = buf.at[:, 16:].set(wide)
-    words = pack_ops.pack_varlen(buf, lengths * 2 + 16, big_endian=True)
+    return buf, lengths * 2 + 16
+
+
+def sha1_spin(cand, lengths, salt: bytes, spin_count: int):
+    """H0 = SHA1(salt||UTF16LE(pw)); H_i = SHA1(LE32(i)||H): the
+    iterated core shared by 2007 standard and 2010 agile encryption."""
+    B = cand.shape[0]
+    buf, blens = _salted_pw_buf(cand, lengths, salt)
+    words = pack_ops.pack_varlen(buf, blens, big_endian=True)
     init = jnp.broadcast_to(jnp.asarray(SHA1_INIT), (B, 5))
     h = sha1_compress(init, words)
 
@@ -65,7 +70,27 @@ def office2007_key_words(cand: jnp.ndarray, lengths: jnp.ndarray,
         # packed word that is bswap32(i)
         return _sha1_of_24(h, bswap32(jnp.uint32(i)))
 
-    h = lax.fori_loop(0, spin_count, body, h)
+    return lax.fori_loop(0, spin_count, body, h)
+
+
+def _key_bytes(words, n: int):
+    """Big-endian digest words -> first n key bytes uint8[B, n]."""
+    B = words.shape[0]
+    key = jnp.zeros((B, n), jnp.uint8)
+    for j in range(n):
+        key = key.at[:, j].set(
+            (words[:, j // 4] >> jnp.uint32(24 - 8 * (j % 4)))
+            .astype(jnp.uint8))
+    return key
+
+
+def office2007_key_words(cand: jnp.ndarray, lengths: jnp.ndarray,
+                         salt: bytes, spin_count: int) -> jnp.ndarray:
+    """Candidates uint8[B, L] -> AES key bytes uint8[B, 16] via the
+    MS-OFFCRYPTO standard-encryption derivation."""
+    B = cand.shape[0]
+    init = jnp.broadcast_to(jnp.asarray(SHA1_INIT), (B, 5))
+    h = sha1_spin(cand, lengths, salt, spin_count)
     # Hfinal = SHA1(H || LE32(0))
     m = jnp.zeros((B, 16), jnp.uint32)
     m = m.at[:, 0:5].set(h)
@@ -83,13 +108,7 @@ def office2007_key_words(cand: jnp.ndarray, lengths: jnp.ndarray,
     blk2[15] = 64 * 8
     x1 = sha1_compress(state, jnp.broadcast_to(jnp.asarray(blk2),
                                                (B, 16)))
-    # first 16 key bytes from the big-endian X1 words
-    key = jnp.zeros((B, 16), jnp.uint8)
-    for j in range(16):
-        key = key.at[:, j].set(
-            (x1[:, j // 4] >> jnp.uint32(24 - 8 * (j % 4)))
-            .astype(jnp.uint8))
-    return key
+    return _key_bytes(x1, 16)
 
 
 def _office_found(cand, lengths, target, spin_count):
@@ -120,13 +139,106 @@ def _office_found(cand, lengths, target, spin_count):
     return jnp.all(vh_words == want, axis=-1)
 
 
-def make_office_mask_step(gen, target, batch: int, spin_count: int,
-                          hit_capacity: int = 64):
-    """Per-target step: step(base_digits, n_valid) -> (count, lanes, _)."""
-    if gen.length > 19:
+# -- agile encryption (2010: SHA-1/AES-128; 2013: SHA-512/AES-256) ----------
+
+def _sha1_agile_final(h, block_key: bytes):
+    """SHA1(H || BK8): a 28-byte message, one compression."""
+    B = h.shape[0]
+    bk = np.frombuffer(block_key, ">u4").astype(np.uint32)
+    m = jnp.zeros((B, 16), jnp.uint32)
+    m = m.at[:, 0:5].set(h)
+    m = m.at[:, 5].set(jnp.uint32(int(bk[0])))
+    m = m.at[:, 6].set(jnp.uint32(int(bk[1])))
+    m = m.at[:, 7].set(jnp.uint32(0x80000000))
+    m = m.at[:, 15].set(jnp.uint32(28 * 8))
+    init = jnp.broadcast_to(jnp.asarray(SHA1_INIT), (B, 5))
+    return sha1_compress(init, m)
+
+
+def sha512_spin(cand, lengths, salt: bytes, spin_count: int):
+    """The SHA-512 agile spin (Office 2013): 68-byte chain messages,
+    one 128-byte block each; 64-bit words ride the uint32-pair core."""
+    from dprf_tpu.ops.sha512 import sha512_digest_words
+
+    B = cand.shape[0]
+    buf, blens = _salted_pw_buf(cand, lengths, salt)
+    h = sha512_digest_words(
+        pack_ops.pack_varlen_wide(buf, blens))       # uint32[B, 16]
+
+    def body(i, h):
+        m = jnp.zeros((B, 32), jnp.uint32)
+        m = m.at[:, 0].set(bswap32(jnp.uint32(i)))   # LE32(i) bytes 0-3
+        m = m.at[:, 1:17].set(h)                     # digest at byte 4
+        m = m.at[:, 17].set(jnp.uint32(0x80000000))
+        m = m.at[:, 31].set(jnp.uint32(68 * 8))
+        return sha512_digest_words(m)
+
+    return lax.fori_loop(0, spin_count, body, h)
+
+
+def _sha512_agile_final(h, block_key: bytes):
+    """SHA512(H64 || BK8): a 72-byte message, one block."""
+    from dprf_tpu.ops.sha512 import sha512_digest_words
+
+    B = h.shape[0]
+    bk = np.frombuffer(block_key, ">u4").astype(np.uint32)
+    m = jnp.zeros((B, 32), jnp.uint32)
+    m = m.at[:, 0:16].set(h)
+    m = m.at[:, 16].set(jnp.uint32(int(bk[0])))
+    m = m.at[:, 17].set(jnp.uint32(int(bk[1])))
+    m = m.at[:, 18].set(jnp.uint32(0x80000000))
+    m = m.at[:, 31].set(jnp.uint32(72 * 8))
+    return sha512_digest_words(m)
+
+
+def _agile_found(cand, lengths, target, spin_count: int, sha512: bool):
+    from dprf_tpu.engines.cpu.engines import (OFFICE_BK_INPUT,
+                                              OFFICE_BK_VALUE)
+    from dprf_tpu.ops.aes import aes_decrypt_blocks
+    from dprf_tpu.ops.sha512 import sha512_digest_words
+
+    salt = target.params["salt"]
+    ev = target.params["verifier"]
+    evh = target.params["verifier_hash"]
+    keylen = 32 if sha512 else 16
+    B = cand.shape[0]
+    if sha512:
+        h = sha512_spin(cand, lengths, salt, spin_count)
+        ki = _key_bytes(_sha512_agile_final(h, OFFICE_BK_INPUT), keylen)
+        kv = _key_bytes(_sha512_agile_final(h, OFFICE_BK_VALUE), keylen)
+    else:
+        h = sha1_spin(cand, lengths, salt, spin_count)
+        ki = _key_bytes(_sha1_agile_final(h, OFFICE_BK_INPUT), keylen)
+        kv = _key_bytes(_sha1_agile_final(h, OFFICE_BK_VALUE), keylen)
+    saltv = jnp.asarray(np.frombuffer(salt, np.uint8))
+    inp = aes_decrypt_blocks(ki, np.frombuffer(ev, np.uint8)
+                             .reshape(1, 16))[:, 0] ^ saltv
+    vblocks = np.stack([np.frombuffer(evh[:16], np.uint8),
+                        np.frombuffer(evh[16:], np.uint8)])
+    val = aes_decrypt_blocks(kv, vblocks)
+    v1 = val[:, 0] ^ saltv
+    v2 = val[:, 1] ^ jnp.asarray(np.frombuffer(evh[:16], np.uint8))
+    # H(decrypted input), compared over min(32, hash size) bytes
+    if sha512:
+        dwords = sha512_digest_words(pack_ops.pack_fixed_wide(inp, 16))
+        n = 32
+    else:
+        init = jnp.broadcast_to(jnp.asarray(SHA1_INIT), (B, 5))
+        dwords = sha1_compress(
+            init, pack_ops.pack_fixed(inp, 16, big_endian=True))
+        n = 20
+    dbytes = _key_bytes(dwords, n)
+    vbytes = jnp.concatenate([v1, v2], axis=1)[:, :n]
+    return jnp.all(dbytes == vbytes, axis=-1)
+
+
+def _make_mask_step(gen, batch: int, cap: int, found_fn,
+                    hit_capacity: int = 64):
+    """Shared office step shape: found_fn(cand, lengths) -> bool[B]."""
+    if gen.length > cap:
         raise ValueError(
-            f"office2007 passwords cap at 19 chars (salt + UTF-16LE in "
-            f"one SHA-1 block); mask decodes to {gen.length}")
+            f"office passwords cap at {cap} chars (salt + UTF-16LE in "
+            f"one hash block); mask decodes to {gen.length}")
     flat = gen.flat_charsets
     length = gen.length
 
@@ -134,7 +246,7 @@ def make_office_mask_step(gen, target, batch: int, spin_count: int,
     def step(base_digits, n_valid):
         cand = gen.decode_batch(base_digits, flat, batch)
         lengths = jnp.full((batch,), length, jnp.int32)
-        found = _office_found(cand, lengths, target, spin_count)
+        found = found_fn(cand, lengths)
         found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
         return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
                                     hit_capacity)
@@ -142,13 +254,13 @@ def make_office_mask_step(gen, target, batch: int, spin_count: int,
     return step
 
 
-def make_office_wordlist_step(gen, target, word_batch: int,
-                              spin_count: int, hit_capacity: int = 64):
+def _make_wordlist_step(gen, word_batch: int, cap: int, found_fn,
+                        hit_capacity: int = 64):
     from dprf_tpu.ops.rules_pipeline import expand_rules
 
     B, L = word_batch, gen.max_len
-    if L > 19:
-        raise ValueError("office2007 passwords cap at 19 chars; lower "
+    if L > cap:
+        raise ValueError(f"office passwords cap at {cap} chars; lower "
                          "--max-len")
     words_np, lens_np = gen.packed_words(pad_to=B,
                                          min_size=gen.n_words + B - 1)
@@ -164,62 +276,140 @@ def make_office_wordlist_step(gen, target, word_batch: int,
         cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
         # pack_varlen masks bytes at positions >= length, so rule-edit
         # garbage beyond cl never reaches the hash
-        found = _office_found(cw, cl, target, spin_count) & cv
+        found = found_fn(cw, cl) & cv
         return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
                                     hit_capacity)
 
     return step
 
 
+def make_office_mask_step(gen, target, batch: int, spin_count: int,
+                          hit_capacity: int = 64):
+    return _make_mask_step(
+        gen, batch, 19,
+        lambda c, l: _office_found(c, l, target, spin_count),
+        hit_capacity)
+
+
+def make_office_wordlist_step(gen, target, word_batch: int,
+                              spin_count: int, hit_capacity: int = 64):
+    return _make_wordlist_step(
+        gen, word_batch, 19,
+        lambda c, l: _office_found(c, l, target, spin_count),
+        hit_capacity)
+
+
+def make_agile_mask_step(gen, target, batch: int, sha512: bool,
+                         hit_capacity: int = 64):
+    return _make_mask_step(
+        gen, batch, 47 if sha512 else 19,
+        lambda c, l: _agile_found(c, l, target, target.params["spin"],
+                                  sha512),
+        hit_capacity)
+
+
+def make_agile_wordlist_step(gen, target, word_batch: int, sha512: bool,
+                             hit_capacity: int = 64):
+    return _make_wordlist_step(
+        gen, word_batch, 47 if sha512 else 19,
+        lambda c, l: _agile_found(c, l, target, target.params["spin"],
+                                  sha512),
+        hit_capacity)
+
+
 class OfficeMaskWorker(PerTargetStepsMixin, SaltedMaskWorker):
+    """Per-target compiled steps from a pluggable factory(gen, target,
+    batch, hit_capacity) -- shared by the standard and agile engines."""
+
     def __init__(self, engine, gen, targets, batch: int = 1 << 13,
-                 hit_capacity: int = 64, oracle=None):
+                 hit_capacity: int = 64, oracle=None,
+                 step_factory=None):
         per_target_setup(self, engine, gen, targets, batch,
                          hit_capacity, oracle)
         self.stride = batch
-        self._steps = [
-            make_office_mask_step(gen, t, batch, engine.spin_count,
-                                  hit_capacity)
-            for t in self.targets]
+        self._steps = [step_factory(gen, t, batch, hit_capacity)
+                       for t in self.targets]
 
 
 class OfficeWordlistWorker(PerTargetStepsMixin, SaltedWordlistWorker):
     def __init__(self, engine, gen, targets, batch: int = 1 << 13,
-                 hit_capacity: int = 64, oracle=None):
+                 hit_capacity: int = 64, oracle=None,
+                 step_factory=None):
         per_target_setup(self, engine, gen, targets, batch,
                          hit_capacity, oracle)
         self.word_batch = max(1, batch // gen.n_rules)
         self.stride = self.word_batch * gen.n_rules
-        self._steps = [
-            make_office_wordlist_step(gen, t, self.word_batch,
-                                      engine.spin_count, hit_capacity)
-            for t in self.targets]
+        self._steps = [step_factory(gen, t, self.word_batch,
+                                    hit_capacity)
+                       for t in self.targets]
 
 
-@register("office2007", device="jax")
-@register("office", device="jax")
-class JaxOffice2007Engine(Office2007Engine):
-    """Device Office 2007: the SHA-1 spin on the word pipeline, AES
-    verifier check via gather tables."""
+class _OfficeDeviceMixin:
+    """Worker factories over the shared per-target-step office workers;
+    subclasses provide the step factories (the 50k-compression spin
+    caps the batch like PMKID's)."""
 
     little_endian = False
     digest_words = 1
 
+    def _mask_factory(self, gen, t, batch, cap):
+        raise NotImplementedError
+
     def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
                          oracle=None):
-        # 50k compressions/candidate: cap the batch like PMKID does
         return OfficeMaskWorker(self, gen, targets,
                                 batch=min(batch, 1 << 13),
-                                hit_capacity=hit_capacity, oracle=oracle)
+                                hit_capacity=hit_capacity, oracle=oracle,
+                                step_factory=self._mask_factory)
 
     def make_wordlist_worker(self, gen, targets, batch: int,
                              hit_capacity: int, oracle=None):
         return OfficeWordlistWorker(self, gen, targets,
                                     batch=min(batch, 1 << 13),
                                     hit_capacity=hit_capacity,
-                                    oracle=oracle)
+                                    oracle=oracle,
+                                    step_factory=self._wordlist_factory)
 
     make_sharded_mask_worker = None
     make_sharded_wordlist_worker = None
     make_combinator_worker = None
     make_sharded_combinator_worker = None
+
+
+class _AgileDeviceMixin(_OfficeDeviceMixin):
+    _sha512: bool
+
+    def _mask_factory(self, gen, t, batch, cap):
+        return make_agile_mask_step(gen, t, batch, self._sha512, cap)
+
+    def _wordlist_factory(self, gen, t, wb, cap):
+        return make_agile_wordlist_step(gen, t, wb, self._sha512, cap)
+
+
+@register("office2010", device="jax")
+class JaxOffice2010Engine(_AgileDeviceMixin, Office2010Engine):
+    """Device Office 2010 agile: SHA-1 spin + AES-128 CBC verifier."""
+
+    _sha512 = False
+
+
+@register("office2013", device="jax")
+class JaxOffice2013Engine(_AgileDeviceMixin, Office2013Engine):
+    """Device Office 2013 agile: SHA-512 spin (uint32-pair core) +
+    AES-256 CBC verifier."""
+
+    _sha512 = True
+
+
+@register("office2007", device="jax")
+@register("office", device="jax")
+class JaxOffice2007Engine(_OfficeDeviceMixin, Office2007Engine):
+    """Device Office 2007: the SHA-1 spin on the word pipeline, AES
+    verifier check via gather tables."""
+
+    def _mask_factory(self, gen, t, batch, cap):
+        return make_office_mask_step(gen, t, batch, self.spin_count, cap)
+
+    def _wordlist_factory(self, gen, t, wb, cap):
+        return make_office_wordlist_step(gen, t, wb, self.spin_count,
+                                         cap)

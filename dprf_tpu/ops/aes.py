@@ -57,17 +57,25 @@ for _ in range(10):
     _r = _xtime(_r)
 
 
-def key_schedule(key16: bytes) -> list[bytes]:
-    """AES-128 expanded round keys: 11 x 16 bytes."""
-    w = [list(key16[4 * i:4 * i + 4]) for i in range(4)]
-    for i in range(4, 44):
+def key_schedule(key: bytes) -> list[bytes]:
+    """AES expanded round keys: 11 x 16 bytes for a 16-byte key,
+    15 x 16 for a 32-byte key (FIPS-197 expansion, Nk = 4 or 8)."""
+    nk = len(key) // 4
+    if nk not in (4, 8):
+        raise ValueError("AES-128 or AES-256 keys only")
+    rounds = {4: 10, 8: 14}[nk]
+    w = [list(key[4 * i:4 * i + 4]) for i in range(nk)]
+    for i in range(nk, 4 * (rounds + 1)):
         t = list(w[i - 1])
-        if i % 4 == 0:
+        if i % nk == 0:
             t = t[1:] + t[:1]
             t = [SBOX[b] for b in t]
-            t[0] ^= _RCON[i // 4 - 1]
-        w.append([a ^ b for a, b in zip(w[i - 4], t)])
-    return [bytes(sum(w[4 * r:4 * r + 4], [])) for r in range(11)]
+            t[0] ^= _RCON[i // nk - 1]
+        elif nk == 8 and i % nk == 4:
+            t = [SBOX[b] for b in t]
+        w.append([a ^ b for a, b in zip(w[i - nk], t)])
+    return [bytes(sum(w[4 * r:4 * r + 4], []))
+            for r in range(rounds + 1)]
 
 
 def _sub(state, box):
@@ -99,25 +107,32 @@ def _mix_columns(s, inv=False):
     return out
 
 
-def aes128_encrypt_block(key16: bytes, block16: bytes) -> bytes:
-    rks = key_schedule(key16)
+def aes_encrypt_block(key: bytes, block16: bytes) -> bytes:
+    rks = key_schedule(key)
+    last = len(rks) - 1
     s = [b ^ k for b, k in zip(block16, rks[0])]
-    for rnd in range(1, 10):
+    for rnd in range(1, last):
         s = _mix_columns(_shift_rows(_sub(s, SBOX)))
         s = [b ^ k for b, k in zip(s, rks[rnd])]
     s = _shift_rows(_sub(s, SBOX))
-    return bytes(b ^ k for b, k in zip(s, rks[10]))
+    return bytes(b ^ k for b, k in zip(s, rks[last]))
 
 
-def aes128_decrypt_block(key16: bytes, block16: bytes) -> bytes:
-    rks = key_schedule(key16)
-    s = [b ^ k for b, k in zip(block16, rks[10])]
-    for rnd in range(9, 0, -1):
+def aes_decrypt_block(key: bytes, block16: bytes) -> bytes:
+    rks = key_schedule(key)
+    last = len(rks) - 1
+    s = [b ^ k for b, k in zip(block16, rks[last])]
+    for rnd in range(last - 1, 0, -1):
         s = _sub(_shift_rows(s, inv=True), INV_SBOX)
         s = [b ^ k for b, k in zip(s, rks[rnd])]
         s = _mix_columns(s, inv=True)
     s = _sub(_shift_rows(s, inv=True), INV_SBOX)
     return bytes(b ^ k for b, k in zip(s, rks[0]))
+
+
+# back-compat names used by the office2007 oracle/tests
+aes128_encrypt_block = aes_encrypt_block
+aes128_decrypt_block = aes_decrypt_block
 
 
 # ---------------------------------------------------------------------------
@@ -145,43 +160,49 @@ def _take(table, idx):
     return jnp.take(table, idx.astype(jnp.int32), axis=0)
 
 
-def aes128_key_schedule_batch(key: "jnp.ndarray"):
-    """uint8[B, 16] keys -> uint8[B, 11, 16] round keys (vectorized
-    FIPS-197 expansion; 40 S-box gathers total, shared per batch)."""
+def aes_key_schedule_batch(key: "jnp.ndarray"):
+    """uint8[B, 16|32] keys -> uint8[B, rounds+1, 16] round keys
+    (vectorized FIPS-197 expansion; a few dozen shared S-box gathers)."""
     import jax.numpy as jnp
 
     sbox, _, _ = _dev_tables()
-    w = [key[:, 4 * i:4 * i + 4] for i in range(4)]
-    for i in range(4, 44):
+    nk = key.shape[1] // 4
+    rounds = {4: 10, 8: 14}[nk]
+    w = [key[:, 4 * i:4 * i + 4] for i in range(nk)]
+    for i in range(nk, 4 * (rounds + 1)):
         t = w[i - 1]
-        if i % 4 == 0:
+        if i % nk == 0:
             t = jnp.concatenate([t[:, 1:], t[:, :1]], axis=1)
             t = _take(sbox, t)
-            t = t.at[:, 0].set(t[:, 0] ^ np.uint8(_RCON[i // 4 - 1]))
-        w.append(w[i - 4] ^ t)
-    return jnp.stack(w, axis=1).reshape(key.shape[0], 11, 16)
+            t = t.at[:, 0].set(t[:, 0] ^ np.uint8(_RCON[i // nk - 1]))
+        elif nk == 8 and i % nk == 4:
+            t = _take(sbox, t)
+        w.append(w[i - nk] ^ t)
+    return jnp.stack(w, axis=1).reshape(key.shape[0], rounds + 1, 16)
 
 
 _INV_SHIFT = np.array(
     [0, 13, 10, 7, 4, 1, 14, 11, 8, 5, 2, 15, 12, 9, 6, 3], np.int32)
 
 
-def aes128_decrypt_blocks(keys: "jnp.ndarray",
-                          blocks: np.ndarray) -> "jnp.ndarray":
-    """Per-candidate keys uint8[B, 16] + CONSTANT ciphertext blocks
-    uint8[N, 16] -> plaintext uint8[B, N, 16]."""
+def aes_decrypt_blocks(keys: "jnp.ndarray",
+                       blocks: np.ndarray) -> "jnp.ndarray":
+    """Per-candidate keys uint8[B, 16|32] + CONSTANT ciphertext blocks
+    uint8[N, 16] -> plaintext uint8[B, N, 16] (ECB; CBC callers xor
+    the IV/previous ciphertext themselves -- both are constants)."""
     import jax.numpy as jnp
 
     _, inv_sbox, mul = _dev_tables()
     B = keys.shape[0]
-    rks = aes128_key_schedule_batch(keys)
+    rks = aes_key_schedule_batch(keys)
+    last = rks.shape[1] - 1
     ct = jnp.broadcast_to(jnp.asarray(blocks, jnp.uint8)[None],
                           (B,) + blocks.shape)
     out = []
     inv_shift = jnp.asarray(_INV_SHIFT)
     for n in range(blocks.shape[0]):
-        s = ct[:, n] ^ rks[:, 10]
-        for rnd in range(9, 0, -1):
+        s = ct[:, n] ^ rks[:, last]
+        for rnd in range(last - 1, 0, -1):
             s = _take(inv_sbox, s[:, inv_shift])
             s = s ^ rks[:, rnd]
             # InvMixColumns over the 4 columns
@@ -196,3 +217,7 @@ def aes128_decrypt_blocks(keys: "jnp.ndarray",
         s = _take(inv_sbox, s[:, inv_shift])
         out.append(s ^ rks[:, 0])
     return jnp.stack(out, axis=1)
+
+
+# back-compat name used by the office2007 device engine
+aes128_decrypt_blocks = aes_decrypt_blocks

@@ -88,3 +88,70 @@ def test_device_wordlist_worker_cracks():
                                  oracle=cpu)
     hits = w.process(WorkUnit(0, 0, gen.keyspace))
     assert b"banana" in {h.plaintext for h in hits}
+
+
+def _agile_line(version: str, pw: bytes, spin: int) -> str:
+    from dprf_tpu.engines.cpu.engines import (OFFICE_BK_INPUT,
+                                              OFFICE_BK_VALUE)
+
+    eng = get_engine(f"office{version}")
+    salt = bytes(range(16))
+    ki = eng._agile_key(pw, salt, spin, OFFICE_BK_INPUT)
+    kv = eng._agile_key(pw, salt, spin, OFFICE_BK_VALUE)
+    inp = os.urandom(16)
+    want = hashlib.new(eng._hash, inp).digest()[:32].ljust(32, b"\x00")
+    c_inp = aes128_encrypt_block(ki, bytes(a ^ b for a, b in
+                                           zip(inp, salt)))
+    cv1 = aes128_encrypt_block(kv, bytes(a ^ b for a, b in
+                                         zip(want[:16], salt)))
+    cv2 = aes128_encrypt_block(kv, bytes(a ^ b for a, b in
+                                         zip(want[16:], cv1)))
+    return "$office$*%s*%d*%d*16*%s*%s*%s" % (
+        version, spin, eng._keybits, salt.hex(), c_inp.hex(),
+        (cv1 + cv2).hex())
+
+
+def test_aes256_fips_vector():
+    key = bytes.fromhex("000102030405060708090a0b0c0d0e0f"
+                        "101112131415161718191a1b1c1d1e1f")
+    pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+    ct = aes128_encrypt_block(key, pt)     # generic dispatch by keylen
+    assert ct.hex() == "8ea2b7ca516745bfeafc49904b496089"
+    assert aes128_decrypt_block(key, ct) == pt
+
+
+@pytest.mark.parametrize("version", ["2010", "2013"])
+def test_agile_oracle(version):
+    eng = get_engine(f"office{version}")
+    t = eng.parse_target(_agile_line(version, b"secret", 60))
+    assert eng.hash_batch([b"secret"], params=t.params)[0] == b"\x01"
+    assert eng.hash_batch([b"wrong"], params=t.params)[0] == b"\x00"
+    with pytest.raises(ValueError):
+        eng.parse_target("$office$*2007*20*128*16*aa*bb*cc")
+
+
+@pytest.mark.parametrize("version", ["2010", "2013"])
+def test_agile_device_mask_cracks(version):
+    cpu = get_engine(f"office{version}")
+    dev = get_engine(f"office{version}", device="jax")
+    t = cpu.parse_target(_agile_line(version, b"fx", 60))
+    gen = MaskGenerator("?l?l")
+    w = dev.make_mask_worker(gen, [t], batch=512, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [b"fx"]
+
+
+def test_agile_device_wordlist_cracks():
+    from dprf_tpu.rules.parser import parse_rule
+
+    cpu = get_engine("office2013")
+    dev = get_engine("office2013", device="jax")
+    gen = WordlistRulesGenerator(
+        words=[b"apple", b"Banana", b"zebra"],
+        rules=[parse_rule(":"), parse_rule("l")], max_len=16)
+    t = cpu.parse_target(_agile_line("2013", b"banana", 50))
+    w = dev.make_wordlist_worker(gen, [t], batch=128, hit_capacity=8,
+                                 oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert b"banana" in {h.plaintext for h in hits}
