@@ -75,9 +75,13 @@ def pbkdf2_sha256_runtime_salt(key_words: jnp.ndarray,
     return t
 
 
-def make_pbkdf2_mask_step(gen, batch: int, hit_capacity: int = 64):
+def make_pbkdf2_mask_step(gen, batch: int, hit_capacity: int = 64,
+                          fold=None):
     """step(base_digits, n_valid, salt uint8[SALT_MAX], salt_len,
-    iterations, target uint32[8]) -> (count, lanes, _)."""
+    iterations, target uint32[8]) -> (count, lanes, _).
+
+    fold: optional dk-words transform before the compare (RAR5 xors
+    the derived key's quarters into its 8-byte password check)."""
     flat = gen.flat_charsets
     length = gen.length
 
@@ -86,6 +90,8 @@ def make_pbkdf2_mask_step(gen, batch: int, hit_capacity: int = 64):
         cand = gen.decode_batch(base_digits, flat, batch)
         key = pack_ops.pack_raw(cand, length, big_endian=True)
         dk = pbkdf2_sha256_runtime_salt(key, salt, salt_len, iterations)
+        if fold is not None:
+            dk = fold(dk)
         found = cmp_ops.compare_single(dk, target)
         found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
         return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
@@ -95,7 +101,7 @@ def make_pbkdf2_mask_step(gen, batch: int, hit_capacity: int = 64):
 
 
 def make_pbkdf2_wordlist_step(gen, word_batch: int,
-                              hit_capacity: int = 64):
+                              hit_capacity: int = 64, fold=None):
     from jax import lax
 
     from dprf_tpu.ops.rules_pipeline import expand_rules
@@ -124,6 +130,8 @@ def make_pbkdf2_wordlist_step(gen, word_batch: int,
         key = (raw.reshape(cw.shape[0], 16, 4).astype(jnp.uint32)
                * coef).sum(axis=-1, dtype=jnp.uint32)
         dk = pbkdf2_sha256_runtime_salt(key, salt, salt_len, iterations)
+        if fold is not None:
+            dk = fold(dk)
         found = cmp_ops.compare_single(dk, target) & cv
         return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
                                     hit_capacity)

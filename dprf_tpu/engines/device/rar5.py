@@ -1,0 +1,66 @@
+"""Device RAR5 engine (hashcat 13000): the pbkdf2-sha256 workers with
+a fold -- the 32-byte derived key's quarters XOR into RAR5's 8-byte
+password check value, so the compare target is 2 words.  Iteration
+counts (2^n + 32) and salts are runtime args; one compiled step serves
+every target."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.cpu.engines import Rar5Engine
+from dprf_tpu.engines.device.pbkdf2 import (Pbkdf2MaskWorker,
+                                            Pbkdf2WordlistWorker, _targs,
+                                            make_pbkdf2_mask_step,
+                                            make_pbkdf2_wordlist_step)
+
+
+def _fold_pswcheck(dk):
+    """uint32[B, 8] dk words -> uint32[B, 2] check words (byte-aligned
+    XOR commutes with the big-endian word view)."""
+    return jnp.stack([dk[:, 0] ^ dk[:, 2] ^ dk[:, 4] ^ dk[:, 6],
+                      dk[:, 1] ^ dk[:, 3] ^ dk[:, 5] ^ dk[:, 7]],
+                     axis=-1)
+
+
+class Rar5MaskWorker(Pbkdf2MaskWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 13,
+                 hit_capacity: int = 64, oracle=None):
+        super().__init__(engine, gen, targets, batch=batch,
+                         hit_capacity=hit_capacity, oracle=oracle)
+        self.step = make_pbkdf2_mask_step(gen, batch, hit_capacity,
+                                          fold=_fold_pswcheck)
+
+
+class Rar5WordlistWorker(Pbkdf2WordlistWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 13,
+                 hit_capacity: int = 64, oracle=None):
+        super().__init__(engine, gen, targets, batch=batch,
+                         hit_capacity=hit_capacity, oracle=oracle)
+        self.step = make_pbkdf2_wordlist_step(gen, self.word_batch,
+                                              hit_capacity,
+                                              fold=_fold_pswcheck)
+
+
+@register("rar5", device="jax")
+class JaxRar5Engine(Rar5Engine):
+    """Device RAR5: PBKDF2-HMAC-SHA256 workers + the pswcheck fold."""
+
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return Rar5MaskWorker(self, gen, targets,
+                              batch=min(batch, 1 << 13),
+                              hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return Rar5WordlistWorker(self, gen, targets,
+                                  batch=min(batch, 1 << 13),
+                                  hit_capacity=hit_capacity,
+                                  oracle=oracle)
+
+    make_sharded_mask_worker = None
+    make_sharded_wordlist_worker = None
+    make_combinator_worker = None
+    make_sharded_combinator_worker = None

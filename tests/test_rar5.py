@@ -1,0 +1,56 @@
+"""RAR5 (hashcat 13000): check-value construction, parse, device
+workers over the pbkdf2-sha256 fold."""
+
+import hashlib
+
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.engines.cpu.engines import rar5_pswcheck
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+def _line(pw: bytes, n: int = 6, salt: bytes = bytes(range(16))) -> str:
+    dk = hashlib.pbkdf2_hmac("sha256", pw, salt, (1 << n) + 32, 32)
+    return "$rar5$16$%s$%d$%s$8$%s" % (
+        salt.hex(), n, bytes(16).hex(), rar5_pswcheck(dk).hex())
+
+
+def test_parse_and_oracle():
+    eng = get_engine("rar5")
+    t = eng.parse_target(_line(b"password"))
+    assert t.params["iterations"] == (1 << 6) + 32
+    assert eng.hash_batch([b"password"], params=t.params)[0] == t.digest
+    assert not eng.verify(b"nope", t)
+    with pytest.raises(ValueError):
+        eng.parse_target("$rar5$16$aa$99$bb$8$cc")   # absurd exponent
+    with pytest.raises(ValueError):
+        eng.parse_target("not rar5")
+
+
+def test_device_mask_worker_cracks():
+    cpu = get_engine("rar5")
+    dev = get_engine("rar5", device="jax")
+    gen = MaskGenerator("?l?l?l")
+    t = cpu.parse_target(_line(b"fox"))
+    w = dev.make_mask_worker(gen, [t], batch=4096, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [b"fox"]
+
+
+def test_device_wordlist_worker_cracks():
+    from dprf_tpu.rules.parser import parse_rule
+
+    cpu = get_engine("rar5")
+    dev = get_engine("rar5", device="jax")
+    gen = WordlistRulesGenerator(
+        words=[b"apple", b"Banana", b"zebra"],
+        rules=[parse_rule(":"), parse_rule("l")])
+    t = cpu.parse_target(_line(b"banana"))
+    w = dev.make_wordlist_worker(gen, [t], batch=256, hit_capacity=8,
+                                 oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert b"banana" in {h.plaintext for h in hits}
