@@ -770,7 +770,7 @@ class ScryptEngine(HashEngine):
             # V alone would be 128*r*N bytes per candidate; an absurd N
             # in one hostile line must not OOM the process
             raise ValueError(f"scrypt N={n} over the 2^24 limit")
-        if not (1 <= r <= 32 and 1 <= p <= 16):
+        if not (1 <= r <= 32 and 1 <= p <= 16) or p * 4 * r > 255:
             raise ValueError(f"unsupported scrypt r={r} p={p}")
         salt = base64.b64decode(parts[4])
         digest = base64.b64decode(parts[5])
@@ -1268,4 +1268,101 @@ class Rar5Engine(HashEngine):
             raise ValueError("rar5 needs target params (salt, iters)")
         return [rar5_pswcheck(hashlib.pbkdf2_hmac(
                     "sha256", c, params["salt"], params["iterations"], 32))
+                for c in candidates]
+
+
+class _EthereumEngineBase(HashEngine):
+    """Ethereum keystore (v3) wallets: MAC = Keccak-256(dk[16:32] ||
+    ciphertext) compared against the stored mac."""
+
+    digest_size = 32
+    salted = True
+    max_candidate_len = 64
+
+    def _mac(self, dk: bytes, params: dict) -> bytes:
+        from dprf_tpu.ops.keccak import keccak256
+        return keccak256(dk[16:32] + params["ct"])
+
+    @staticmethod
+    def _check_fields(salt: bytes, ct: bytes, mac: bytes) -> None:
+        if len(mac) != 32:
+            raise ValueError("ethereum mac must be 32 bytes")
+        if len(salt) > PBKDF2_SALT_MAX:
+            raise ValueError(f"salt longer than {PBKDF2_SALT_MAX} bytes")
+        if len(ct) > 119:
+            raise ValueError("ciphertext too long for the single-block "
+                             "keccak MAC path (>119 bytes)")
+
+
+@register("ethereum-pbkdf2")
+class EthereumPbkdf2Engine(_EthereumEngineBase):
+    """Ethereum keystore, PBKDF2 KDF (hashcat 15600):
+    ``$ethereum$p*<iter>*<salt hex>*<ct hex>*<mac hex>``."""
+
+    name = "ethereum-pbkdf2"
+
+    def parse_target(self, text: str) -> Target:
+        body = text.strip()
+        parts = body.split("*")
+        if len(parts) != 5 or parts[0] != "$ethereum$p":
+            raise ValueError(
+                f"expected $ethereum$p*iter*salt*ct*mac, got {text[:40]!r}")
+        iterations = int(parts[1])
+        if not 1 <= iterations <= (1 << 24):
+            raise ValueError(f"unreasonable iteration count {iterations}")
+        salt = bytes.fromhex(parts[2])
+        ct = bytes.fromhex(parts[3])
+        mac = bytes.fromhex(parts[4])
+        self._check_fields(salt, ct, mac)
+        return Target(raw=body, digest=mac,
+                      params={"salt": salt, "iterations": iterations,
+                              "ct": ct})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError("ethereum-pbkdf2 needs target params")
+        return [self._mac(hashlib.pbkdf2_hmac(
+                    "sha256", c, params["salt"], params["iterations"], 32),
+                          params)
+                for c in candidates]
+
+
+@register("ethereum-scrypt")
+class EthereumScryptEngine(_EthereumEngineBase):
+    """Ethereum keystore, scrypt KDF (hashcat 15700):
+    ``$ethereum$s*<N>*<r>*<p>*<salt hex>*<ct hex>*<mac hex>``."""
+
+    name = "ethereum-scrypt"
+
+    def parse_target(self, text: str) -> Target:
+        body = text.strip()
+        parts = body.split("*")
+        if len(parts) != 7 or parts[0] != "$ethereum$s":
+            raise ValueError(
+                f"expected $ethereum$s*N*r*p*salt*ct*mac, "
+                f"got {text[:40]!r}")
+        n, r, p = (int(x) for x in parts[1:4])
+        if n < 2 or n & (n - 1) or n > (1 << 24):
+            raise ValueError(f"scrypt N must be a power of two <= 2^24, "
+                             f"got {n}")
+        if not (1 <= r <= 32 and 1 <= p <= 16) or p * 4 * r > 255:
+            raise ValueError(f"unsupported scrypt r={r} p={p}")
+        salt = bytes.fromhex(parts[4])
+        ct = bytes.fromhex(parts[5])
+        mac = bytes.fromhex(parts[6])
+        self._check_fields(salt, ct, mac)
+        return Target(raw=body, digest=mac,
+                      params={"salt": salt, "n": n, "r": r, "p": p,
+                              "ct": ct})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError("ethereum-scrypt needs target params")
+        n, r, p = params["n"], params["r"], params["p"]
+        mem = 128 * r * n * max(1, p) * 2 + (1 << 20)
+        return [self._mac(hashlib.scrypt(c, salt=params["salt"], n=n,
+                                         r=r, p=p, dklen=32, maxmem=mem),
+                          params)
                 for c in candidates]

@@ -1,0 +1,153 @@
+"""Device Ethereum keystore engines (hashcat 15600/15700).
+
+KDF rides the existing PBKDF2-SHA256 or scrypt pipelines; the wallet
+MAC is one single-block Keccak-256 (ops/keccak.py, uint32 lane pairs)
+over dk[16:32] || ciphertext.  Salt, parameters, and ciphertext are
+per-target trace-time constants, so steps compile per target through
+the shared office-style step_factory workers; scrypt batches clamp to
+the ROMix memory budget."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.cpu.engines import (EthereumPbkdf2Engine,
+                                          EthereumScryptEngine)
+from dprf_tpu.engines.device.office import (OfficeMaskWorker,
+                                            OfficeWordlistWorker)
+from dprf_tpu.engines.device.scrypt import _clamp_batch
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops.hmac import pack_raw_varlen
+from dprf_tpu.ops.keccak import keccak256_words
+
+
+def _mac_found(dk_words, target):
+    """dk uint32[B, 8] -> keccak MAC compare vs the target's stored
+    mac."""
+    ct = target.params["ct"]
+    B = dk_words.shape[0]
+    width = 16 + len(ct)
+    msg = jnp.zeros((B, width), jnp.uint8)
+    for j in range(16):
+        msg = msg.at[:, j].set(
+            (dk_words[:, 4 + j // 4] >> jnp.uint32(24 - 8 * (j % 4)))
+            .astype(jnp.uint8))
+    msg = msg.at[:, 16:].set(jnp.broadcast_to(
+        jnp.asarray(np.frombuffer(ct, np.uint8)), (B, len(ct))))
+    mac = keccak256_words(msg, jnp.full((B,), width, jnp.int32))
+    want = jnp.asarray(np.frombuffer(target.digest, ">u4")
+                       .astype(np.uint32))
+    return cmp_ops.compare_single(mac, want)
+
+
+def _dk_fn(target):
+    """Per-target derived-key function over packed candidates."""
+    from dprf_tpu.engines.cpu.engines import PBKDF2_SALT_MAX
+
+    salt = target.params["salt"]
+    sbuf = np.zeros(PBKDF2_SALT_MAX, np.uint8)
+    sbuf[:len(salt)] = np.frombuffer(salt, np.uint8)
+    sdev = jnp.asarray(sbuf)
+    slen = jnp.int32(len(salt))
+    if "iterations" in target.params:
+        from dprf_tpu.engines.device.pbkdf2 import \
+            pbkdf2_sha256_runtime_salt
+        iters = jnp.int32(target.params["iterations"])
+
+        def dk(cand, lengths):
+            key = pack_raw_varlen(cand, lengths, big_endian=True)
+            return pbkdf2_sha256_runtime_salt(key, sdev, slen, iters)
+    else:
+        from dprf_tpu.ops.scrypt import scrypt_dk
+        n, r, p = (target.params[k] for k in ("n", "r", "p"))
+
+        def dk(cand, lengths):
+            key = pack_raw_varlen(cand, lengths, big_endian=True)
+            return scrypt_dk(key, sdev, slen, n, r, p)
+    return dk
+
+
+def make_ethereum_mask_step(gen, target, batch: int,
+                            hit_capacity: int = 64):
+    flat = gen.flat_charsets
+    length = gen.length
+    dk = _dk_fn(target)
+
+    @jax.jit
+    def step(base_digits, n_valid):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        lengths = jnp.full((batch,), length, jnp.int32)
+        found = _mac_found(dk(cand, lengths), target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def make_ethereum_wordlist_step(gen, target, word_batch: int,
+                                hit_capacity: int = 64):
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, L = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+    dk = _dk_fn(target)
+
+    @jax.jit
+    def step(w0, n_valid_words):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
+        found = _mac_found(dk(cw, cl), target) & cv
+        return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
+                                    hit_capacity)
+
+    return step
+
+
+class _EthereumDeviceMixin:
+    little_endian = False
+    digest_words = 8
+
+    def _cap_batch(self, targets, batch: int) -> int:
+        if any("n" in t.params for t in targets):
+            return _clamp_batch(min(batch, 1 << 13), targets, "batch")
+        return min(batch, 1 << 13)
+
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return OfficeMaskWorker(
+            self, gen, targets, batch=self._cap_batch(targets, batch),
+            hit_capacity=hit_capacity, oracle=oracle,
+            step_factory=make_ethereum_mask_step)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return OfficeWordlistWorker(
+            self, gen, targets, batch=self._cap_batch(targets, batch),
+            hit_capacity=hit_capacity, oracle=oracle,
+            step_factory=make_ethereum_wordlist_step)
+
+    make_sharded_mask_worker = None
+    make_sharded_wordlist_worker = None
+    make_combinator_worker = None
+    make_sharded_combinator_worker = None
+
+
+@register("ethereum-pbkdf2", device="jax")
+class JaxEthereumPbkdf2Engine(_EthereumDeviceMixin, EthereumPbkdf2Engine):
+    """Device Ethereum keystore (PBKDF2 KDF) with the Keccak MAC."""
+
+
+@register("ethereum-scrypt", device="jax")
+class JaxEthereumScryptEngine(_EthereumDeviceMixin, EthereumScryptEngine):
+    """Device Ethereum keystore (scrypt KDF) with the Keccak MAC."""

@@ -1,0 +1,193 @@
+"""Keccak-f[1600] on uint32 lane pairs -- SHA3-256 and the original
+Keccak-256 (Ethereum's hash; pre-NIST 0x01 padding).
+
+The 25 64-bit lanes live as 50 uint32 planes (hi, lo per lane), so
+every rotation is two shifts and an or -- the same 64-bit-emulation
+recipe the SHA-512 core uses.  Round constants come from the
+specification's LFSR, generated here rather than pasted.  Message
+support is single-block (<= 135 bytes at rate 1088), which covers the
+MAC shapes password cracking needs (Ethereum: 48 bytes).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _rc_constants() -> list[int]:
+    """Round constants via the Keccak LFSR (x^8+x^6+x^5+x^4+1)."""
+    out = []
+    r = 1
+    for _ in range(24):
+        rc = 0
+        for j in range(7):
+            if r & 1:
+                rc |= 1 << ((1 << j) - 1)
+            r = ((r << 1) ^ (0x71 if r & 0x80 else 0)) & 0xFF
+        out.append(rc)
+    return out
+
+
+RC = _rc_constants()
+
+#: rho rotation offsets, by lane (x, y) -> offset (generated from the
+#: spec's t-iteration rather than written as a table)
+_RHO = np.zeros((5, 5), np.int32)
+_x, _y = 1, 0
+for _t in range(24):
+    _RHO[_x, _y] = ((_t + 1) * (_t + 2) // 2) % 64
+    _x, _y = _y, (2 * _x + 3 * _y) % 5
+
+
+def _rot64(hi, lo, n: int):
+    n %= 64
+    if n == 0:
+        return hi, lo
+    if n == 32:
+        return lo, hi
+    if n < 32:
+        return ((hi << n) | (lo >> (32 - n)),
+                (lo << n) | (hi >> (32 - n)))
+    n -= 32
+    return ((lo << n) | (hi >> (32 - n)),
+            (hi << n) | (lo >> (32 - n)))
+
+
+def keccak_f(state):
+    """state: dict (x, y) -> (hi, lo) uint32 arrays.
+
+    The 24 rounds run in a lax.fori_loop: every rotation offset and
+    permutation is round-INDEPENDENT (only iota's constant varies, so
+    it indexes a [24, 2] table) -- one ~200-op round body compiles,
+    not a 5k-op unroll (the unrolled-SHA256/DES compile lesson)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    rc_tab = jnp.asarray(
+        np.array([[c >> 32, c & 0xFFFFFFFF] for c in RC], np.uint32))
+
+    def round_body(rnd, state):
+        # theta
+        c = [(state[(x, 0)][0] ^ state[(x, 1)][0] ^ state[(x, 2)][0]
+              ^ state[(x, 3)][0] ^ state[(x, 4)][0],
+              state[(x, 0)][1] ^ state[(x, 1)][1] ^ state[(x, 2)][1]
+              ^ state[(x, 3)][1] ^ state[(x, 4)][1])
+             for x in range(5)]
+        d = []
+        for x in range(5):
+            rh, rl = _rot64(*c[(x + 1) % 5], 1)
+            d.append((c[(x - 1) % 5][0] ^ rh, c[(x - 1) % 5][1] ^ rl))
+        for x in range(5):
+            for y in range(5):
+                hi, lo = state[(x, y)]
+                state[(x, y)] = (hi ^ d[x][0], lo ^ d[x][1])
+        # rho + pi
+        b = {}
+        for x in range(5):
+            for y in range(5):
+                hi, lo = state[(x, y)]
+                b[(y, (2 * x + 3 * y) % 5)] = _rot64(hi, lo,
+                                                     int(_RHO[x, y]))
+        # chi
+        for x in range(5):
+            for y in range(5):
+                bh, bl = b[(x, y)]
+                nh, nl = b[((x + 1) % 5, y)]
+                fh, fl = b[((x + 2) % 5, y)]
+                state[(x, y)] = (bh ^ (~nh & fh), bl ^ (~nl & fl))
+        # iota
+        hi, lo = state[(0, 0)]
+        state[(0, 0)] = (hi ^ rc_tab[rnd, 0], lo ^ rc_tab[rnd, 1])
+        return state
+
+    return lax.fori_loop(0, 24, round_body, dict(state))
+
+
+def keccak256_words(msg: "jnp.ndarray", lengths, pad_byte: int = 0x01):
+    """Single-block Keccak-256: msg uint8[B, maxlen <= 135] + per-lane
+    lengths -> digest uint32[B, 8] (big-endian word view of the 32
+    digest bytes).  pad_byte 0x01 = original Keccak (Ethereum);
+    0x06 = SHA3-256."""
+    import jax.numpy as jnp
+
+    B, maxlen = msg.shape
+    if maxlen > 135:
+        raise ValueError("single-block keccak-256 needs <= 135 bytes")
+    rate = 136
+    pos = jnp.arange(rate, dtype=jnp.int32)
+    buf = jnp.zeros((B, rate), jnp.uint8).at[:, :maxlen].set(msg)
+    lens = lengths[:, None]
+    buf = jnp.where(pos < lens, buf, 0).astype(jnp.uint8)
+    buf = buf + jnp.where(pos == lens, jnp.uint8(pad_byte), jnp.uint8(0))
+    buf = buf.at[:, rate - 1].set(buf[:, rate - 1] | jnp.uint8(0x80))
+    # lanes are little-endian 64-bit: lane i = bytes 8i..8i+7
+    grouped = buf.reshape(B, rate // 8, 2, 4).astype(jnp.uint32)
+    coef = jnp.asarray(np.array([1, 1 << 8, 1 << 16, 1 << 24],
+                                np.uint32))
+    words = (grouped * coef).sum(axis=-1, dtype=jnp.uint32)  # [B,17,2] lo,hi
+    state = {(x, y): (jnp.zeros((B,), jnp.uint32),
+                      jnp.zeros((B,), jnp.uint32))
+             for x in range(5) for y in range(5)}
+    for i in range(rate // 8):
+        x, y = i % 5, i // 5
+        hi, lo = state[(x, y)]
+        state[(x, y)] = (hi ^ words[:, i, 1], lo ^ words[:, i, 0])
+    state = keccak_f(state)
+    # digest = first 32 bytes of the state (lanes (0,0),(1,0),(2,0),
+    # (3,0), little-endian), exposed as BIG-endian uint32 words so the
+    # framework's ">u4" target tables compare directly
+    out = []
+    for i in range(4):
+        hi, lo = state[(i % 5, i // 5)]
+        out.append(_bswap(lo))
+        out.append(_bswap(hi))
+    return jnp.stack(out, axis=-1)
+
+
+def _bswap(x):
+    return ((x << 24) | ((x & 0xFF00) << 8) | ((x >> 8) & 0xFF00)
+            | (x >> 24))
+
+
+def _keccak_f_scalar(lanes: list[int]) -> list[int]:
+    """Pure-python keccak-f[1600] on 25 ints (x + 5y indexing)."""
+    M = (1 << 64) - 1
+
+    def rot(v, n):
+        n %= 64
+        return ((v << n) | (v >> (64 - n))) & M if n else v
+
+    for rnd in range(24):
+        c = [lanes[x] ^ lanes[x + 5] ^ lanes[x + 10] ^ lanes[x + 15]
+             ^ lanes[x + 20] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ rot(c[(x + 1) % 5], 1) for x in range(5)]
+        lanes = [lanes[i] ^ d[i % 5] for i in range(25)]
+        b = [0] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = rot(
+                    lanes[x + 5 * y], int(_RHO[x, y]))
+        lanes = [b[i] ^ ((~b[(i + 1) % 5 + 5 * (i // 5)] & M)
+                         & b[(i + 2) % 5 + 5 * (i // 5)])
+                 for i in range(25)]
+        lanes[0] ^= RC[rnd]
+    return lanes
+
+
+def keccak256(data: bytes, pad_byte: int = 0x01) -> bytes:
+    """Host scalar Keccak-256 (CPU oracle / test anchor); pad 0x01 =
+    Ethereum's original Keccak, 0x06 = SHA3-256.  Multi-block capable
+    (the device path is single-block; oracles may see longer data)."""
+    rate = 136
+    buf = bytearray(data)
+    buf.append(pad_byte)
+    while len(buf) % rate:
+        buf.append(0)
+    buf[-1] |= 0x80
+    lanes = [0] * 25
+    for off in range(0, len(buf), rate):
+        for i in range(rate // 8):
+            lanes[i] ^= int.from_bytes(buf[off + 8 * i:off + 8 * i + 8],
+                                       "little")
+        lanes = _keccak_f_scalar(lanes)
+    return b"".join(lanes[i].to_bytes(8, "little") for i in range(4))

@@ -131,6 +131,9 @@ def scrypt_dk(key_words: jnp.ndarray, salt: jnp.ndarray, salt_len,
 
     if n & (n - 1) or n < 2:
         raise ValueError("scrypt N must be a power of two >= 2")
+    if p * 4 * r > 255:
+        # u1_block encodes the PBKDF2 block index in one byte
+        raise ValueError("scrypt r*p too large: p*4*r must be <= 255")
     istate, ostate = hmac256_key_states(key_words)
     B = key_words.shape[0]
 

@@ -1,0 +1,84 @@
+"""Keccak-256 (uint32-pair lanes) and the Ethereum keystore engines
+(hashcat 15600/15700)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.ops.keccak import keccak256, keccak256_words
+from dprf_tpu.runtime.workunit import WorkUnit
+
+SALT = bytes(range(16))
+CT = bytes(range(32))
+
+
+def test_keccak_scalar_vs_hashlib_sha3():
+    """Same permutation as SHA3-256; only the padding byte differs."""
+    for n in (0, 1, 57, 135, 136, 300):
+        data = bytes(i & 0xFF for i in range(n))
+        assert keccak256(data, pad_byte=0x06) == \
+            hashlib.sha3_256(data).digest(), n
+
+
+def test_keccak_ethereum_empty_vector():
+    assert keccak256(b"").hex() == (
+        "c5d2460186f7233c927e7db2dcc703c0"
+        "e500b653ca82273b7bfad8045d85a470")
+
+
+def test_device_keccak_matches_scalar():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    for n in (0, 48, 135):
+        batch = rng.randint(0, 256, (8, max(1, n)), dtype=np.uint8)
+        w = np.asarray(keccak256_words(
+            jnp.asarray(batch[:, :max(1, n)]),
+            jnp.full((8,), n, jnp.int32)))
+        for j in range(8):
+            want = np.frombuffer(keccak256(bytes(batch[j, :n])), ">u4")
+            assert (w[j] == want).all(), (n, j)
+
+
+def _pbkdf2_line(pw: bytes, iters: int = 64) -> str:
+    dk = hashlib.pbkdf2_hmac("sha256", pw, SALT, iters, 32)
+    return "$ethereum$p*%d*%s*%s*%s" % (
+        iters, SALT.hex(), CT.hex(), keccak256(dk[16:32] + CT).hex())
+
+
+def _scrypt_line(pw: bytes, n: int = 16, r: int = 1, p: int = 1) -> str:
+    dk = hashlib.scrypt(pw, salt=SALT, n=n, r=r, p=p, dklen=32,
+                        maxmem=1 << 26)
+    return "$ethereum$s*%d*%d*%d*%s*%s*%s" % (
+        n, r, p, SALT.hex(), CT.hex(), keccak256(dk[16:32] + CT).hex())
+
+
+@pytest.mark.parametrize("name,line", [
+    ("ethereum-pbkdf2", _pbkdf2_line(b"password")),
+    ("ethereum-scrypt", _scrypt_line(b"password")),
+])
+def test_parse_and_oracle(name, line):
+    eng = get_engine(name)
+    t = eng.parse_target(line)
+    assert eng.hash_batch([b"password"], params=t.params)[0] == t.digest
+    assert not eng.verify(b"nope", t)
+    with pytest.raises(ValueError):
+        eng.parse_target("$ethereum$x*garbage")
+
+
+@pytest.mark.parametrize("name,line,plant", [
+    ("ethereum-pbkdf2", _pbkdf2_line(b"fox"), b"fox"),
+    ("ethereum-scrypt", _scrypt_line(b"cab"), b"cab"),
+])
+def test_device_mask_worker_cracks(name, line, plant):
+    cpu = get_engine(name)
+    dev = get_engine(name, device="jax")
+    gen = MaskGenerator("?l?l?l")
+    t = cpu.parse_target(line)
+    w = dev.make_mask_worker(gen, [t], batch=512, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [plant]
