@@ -1366,3 +1366,34 @@ class EthereumScryptEngine(_EthereumEngineBase):
                                          r=r, p=p, dklen=32, maxmem=mem),
                           params)
                 for c in candidates]
+
+
+@register("sha3-256")
+@register("sha3")
+class Sha3_256Engine(HashEngine):
+    """SHA3-256 (hashcat 17400): bare 64-hex-digest lines."""
+
+    name = "sha3-256"
+    digest_size = 32
+    max_candidate_len = 55
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        return [hashlib.sha3_256(c).digest() for c in candidates]
+
+
+@register("keccak-256")
+@register("keccak256")
+class Keccak256Engine(HashEngine):
+    """Original Keccak-256 (hashcat 17800; Ethereum's hash): bare
+    64-hex-digest lines.  Differs from SHA3-256 only in the 0x01
+    padding byte."""
+
+    name = "keccak-256"
+    digest_size = 32
+    max_candidate_len = 55
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        from dprf_tpu.ops.keccak import keccak256
+        return [keccak256(c) for c in candidates]

@@ -1,0 +1,159 @@
+"""Device SHA3-256 / Keccak-256 engines (hashcat 17400/17800).
+
+Keccak's sponge padding is its own thing, so these engines do not ride
+the Merkle-Damgard packers: the fused step decodes candidates and
+feeds raw bytes plus per-lane lengths straight into
+ops/keccak.keccak256_words (which pads in-kernel).  Multi-target lists
+reuse the sorted-table compare the fast MD engines use."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.cpu.engines import Keccak256Engine, Sha3_256Engine
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops.keccak import keccak256_words
+from dprf_tpu.runtime.worker import (DeviceWordlistWorker,
+                                     MaskWorkerBase)
+
+
+def make_keccak_mask_step(gen, tgt, batch: int, pad_byte: int,
+                          hit_capacity: int = 64):
+    """tgt: single-target words uint32[8] or a multi-target sorted
+    table from cmp_ops.make_target_table."""
+    flat = gen.flat_charsets
+    length = gen.length
+    multi = isinstance(tgt, cmp_ops.TargetTable)
+
+    @jax.jit
+    def step(base_digits, n_valid):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        lengths = jnp.full((batch,), length, jnp.int32)
+        digest = keccak256_words(cand, lengths, pad_byte=pad_byte)
+        if multi:
+            found, tpos = cmp_ops.compare_multi(digest, tgt)
+        else:
+            found = cmp_ops.compare_single(digest, jnp.asarray(tgt))
+            tpos = jnp.zeros((batch,), jnp.int32)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, tpos, hit_capacity)
+
+    return step
+
+
+def make_keccak_wordlist_step(gen, tgt, word_batch: int, pad_byte: int,
+                              hit_capacity: int = 64):
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, L = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+    multi = isinstance(tgt, cmp_ops.TargetTable)
+
+    @jax.jit
+    def step(w0, n_valid_words):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
+        pos = jnp.arange(cw.shape[1], dtype=jnp.int32)
+        cw = jnp.where(pos[None, :] < cl[:, None], cw, 0)  # mask junk
+        digest = keccak256_words(cw, cl, pad_byte=pad_byte)
+        if multi:
+            found, tpos = cmp_ops.compare_multi(digest, tgt)
+        else:
+            found = cmp_ops.compare_single(digest, jnp.asarray(tgt))
+            tpos = jnp.zeros_like(cl)
+        return cmp_ops.compact_hits(found & cv, tpos, hit_capacity)
+
+    return step
+
+
+class _KeccakTargetsMixin:
+    """Single- or multi-target setup with the sorted-table compare."""
+
+    def _setup_keccak(self, engine, gen, targets, hit_capacity, oracle):
+        self.engine = engine
+        self.gen = gen
+        self.targets = list(targets)
+        self.hit_capacity = hit_capacity
+        self.oracle = oracle
+        digests = [t.digest for t in self.targets]
+        self.multi = len(digests) > 1
+        if self.multi:
+            table = cmp_ops.make_target_table(digests,
+                                              little_endian=False)
+            self._order = table.order
+            return table
+        self._order = np.zeros(1, dtype=np.int64)
+        return np.frombuffer(digests[0], ">u4").astype(np.uint32)
+
+
+class KeccakMaskWorker(_KeccakTargetsMixin, MaskWorkerBase):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 18,
+                 hit_capacity: int = 64, oracle=None):
+        tgt = self._setup_keccak(engine, gen, targets, hit_capacity,
+                                 oracle)
+        self.batch = self.stride = batch
+        self.step = make_keccak_mask_step(gen, tgt, batch,
+                                          engine._pad_byte, hit_capacity)
+
+
+class KeccakWordlistWorker(_KeccakTargetsMixin, DeviceWordlistWorker):
+    def __init__(self, engine, gen, targets, batch: int = 1 << 18,
+                 hit_capacity: int = 64, oracle=None):
+        tgt = self._setup_keccak(engine, gen, targets, hit_capacity,
+                                 oracle)
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self.batch = batch
+        self.step = make_keccak_wordlist_step(gen, tgt, self.word_batch,
+                                              engine._pad_byte,
+                                              hit_capacity)
+
+
+class _KeccakDeviceMixin:
+    little_endian = False
+    digest_words = 8
+    _pad_byte: int
+
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return KeccakMaskWorker(self, gen, targets, batch=batch,
+                                hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return KeccakWordlistWorker(self, gen, targets, batch=batch,
+                                    hit_capacity=hit_capacity,
+                                    oracle=oracle)
+
+    make_sharded_mask_worker = None
+    make_sharded_wordlist_worker = None
+    make_combinator_worker = None
+    make_sharded_combinator_worker = None
+
+
+@register("sha3-256", device="jax")
+@register("sha3", device="jax")
+class JaxSha3_256Engine(_KeccakDeviceMixin, Sha3_256Engine):
+    """Device SHA3-256 (NIST 0x06 padding)."""
+
+    _pad_byte = 0x06
+
+
+@register("keccak-256", device="jax")
+@register("keccak256", device="jax")
+class JaxKeccak256Engine(_KeccakDeviceMixin, Keccak256Engine):
+    """Device original Keccak-256 (0x01 padding; Ethereum)."""
+
+    _pad_byte = 0x01

@@ -82,3 +82,40 @@ def test_device_mask_worker_cracks(name, line, plant):
                              oracle=cpu)
     hits = w.process(WorkUnit(0, 0, gen.keyspace))
     assert [h.plaintext for h in hits] == [plant]
+
+
+def test_sha3_and_keccak_raw_engines():
+    cpu = get_engine("sha3-256")
+    dev = get_engine("sha3-256", device="jax")
+    gen = MaskGenerator("?l?l?l")
+    t1 = cpu.parse_target(hashlib.sha3_256(b"fox").hexdigest())
+    t2 = cpu.parse_target(hashlib.sha3_256(b"dog").hexdigest())
+    w = dev.make_mask_worker(gen, [t1, t2], batch=4096, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert {(h.target_index, h.plaintext) for h in hits} == \
+        {(0, b"fox"), (1, b"dog")}
+
+    k = get_engine("keccak-256")
+    kd = get_engine("keccak-256", device="jax")
+    tk = k.parse_target(keccak256(b"cab").hex())
+    w2 = kd.make_mask_worker(gen, [tk], batch=4096, hit_capacity=8,
+                             oracle=k)
+    hits2 = w2.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits2] == [b"cab"]
+
+
+def test_keccak_wordlist_rules_worker():
+    from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+    from dprf_tpu.rules.parser import parse_rule
+
+    cpu = get_engine("keccak-256")
+    dev = get_engine("keccak-256", device="jax")
+    gen = WordlistRulesGenerator(
+        words=[b"apple", b"Banana", b"zebra"],
+        rules=[parse_rule(":"), parse_rule("l")])
+    t = cpu.parse_target(keccak256(b"banana").hex())
+    w = dev.make_wordlist_worker(gen, [t], batch=256, hit_capacity=8,
+                                 oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert b"banana" in {h.plaintext for h in hits}
