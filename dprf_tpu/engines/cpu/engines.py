@@ -1397,3 +1397,39 @@ class Keccak256Engine(HashEngine):
                    params: Optional[dict] = None) -> list[bytes]:
         from dprf_tpu.ops.keccak import keccak256
         return [keccak256(c) for c in candidates]
+
+
+@register("postgres")
+@register("postgres-md5")
+class PostgresMd5Engine(HashEngine):
+    """PostgreSQL MD5 auth hashes (hashcat 12): stored as
+    ``md5<hex(md5(password || username))>``; target lines are
+    ``md5<hex>:username`` or ``<hex>:username``."""
+
+    name = "postgres"
+    digest_size = 16
+    salted = True
+    max_candidate_len = 23     # + username <= 32 in one MD5 block
+
+    def parse_target(self, text: str) -> Target:
+        body = text.strip()
+        digest_part, sep, user = body.partition(":")
+        if not sep or not user:
+            raise ValueError(f"expected 'md5hex:username', got {text!r}")
+        if digest_part.startswith("md5"):
+            digest_part = digest_part[3:]
+        digest = bytes.fromhex(digest_part)
+        if len(digest) != self.digest_size:
+            raise ValueError(f"expected 16-byte digest in {text!r}")
+        salt = user.encode("latin-1")
+        if len(salt) > SALT_MAX:
+            raise ValueError(f"username longer than {SALT_MAX} bytes")
+        return Target(raw=body, digest=digest,
+                      params={"salt": salt, "user": user})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError("postgres needs target params (username)")
+        return [hashlib.md5(c + params["salt"]).digest()
+                for c in candidates]

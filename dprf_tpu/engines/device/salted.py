@@ -450,3 +450,18 @@ _register_device(JaxMd5Engine, "md5")
 _register_device(JaxSha1Engine, "sha1")
 _register_device(JaxSha256Engine, "sha256")
 _register_device(JaxSha512Engine, "sha512")
+
+
+@register("postgres", device="jax")
+@register("postgres-md5", device="jax")
+class JaxPostgresEngine(_SaltedDeviceMixin, JaxMd5Engine):
+    """PostgreSQL MD5 auth (hashcat 12): md5($pass.$username) -- the
+    salted-md5 'ps' machinery with postgres's line format."""
+
+    name = "postgres"
+    order = "ps"
+    max_candidate_len = 55 - SALT_MAX
+
+    def parse_target(self, text: str):
+        from dprf_tpu.engines.cpu.engines import PostgresMd5Engine
+        return PostgresMd5Engine().parse_target(text)

@@ -137,3 +137,24 @@ def test_sha512_salted_crack():
                              oracle=cpu)
     hits = w.process(WorkUnit(0, 0, gen.keyspace))
     assert [(h.target_index, h.plaintext) for h in hits] == [(0, b"4x2")]
+
+
+def test_postgres_engine():
+    """PostgreSQL MD5 auth (hashcat 12): md5(pass||user), 'md5hex:user'
+    lines, riding the salted-md5 device machinery."""
+    import hashlib
+
+    from dprf_tpu.engines import get_engine
+    from dprf_tpu.generators.mask import MaskGenerator
+    from dprf_tpu.runtime.workunit import WorkUnit
+
+    cpu = get_engine("postgres")
+    dev = get_engine("postgres", device="jax")
+    line = "md5" + hashlib.md5(b"fox" + b"alice").hexdigest() + ":alice"
+    t = cpu.parse_target(line)
+    assert cpu.hash_batch([b"fox"], params=t.params)[0] == t.digest
+    gen = MaskGenerator("?l?l?l")
+    w = dev.make_mask_worker(gen, [t], batch=4096, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [b"fox"]
