@@ -1401,35 +1401,25 @@ class Keccak256Engine(HashEngine):
 
 @register("postgres")
 @register("postgres-md5")
-class PostgresMd5Engine(HashEngine):
+class PostgresMd5Engine(_SaltedCpuMixin):
     """PostgreSQL MD5 auth hashes (hashcat 12): stored as
     ``md5<hex(md5(password || username))>``; target lines are
-    ``md5<hex>:username`` or ``<hex>:username``."""
+    ``md5<hex>:username`` or ``<hex>:username`` (``$HEX[..]`` decodes
+    non-latin-1 usernames, the shared salted-line convention).  The
+    hash itself is the salted-md5 'ps' oracle with the username as
+    the salt."""
 
     name = "postgres"
     digest_size = 16
-    salted = True
-    max_candidate_len = 23     # + username <= 32 in one MD5 block
+    _algo = "md5"
+    _order = "ps"
+    max_candidate_len = 55 - SALT_MAX
 
     def parse_target(self, text: str) -> Target:
         body = text.strip()
-        digest_part, sep, user = body.partition(":")
-        if not sep or not user:
-            raise ValueError(f"expected 'md5hex:username', got {text!r}")
-        if digest_part.startswith("md5"):
-            digest_part = digest_part[3:]
-        digest = bytes.fromhex(digest_part)
-        if len(digest) != self.digest_size:
-            raise ValueError(f"expected 16-byte digest in {text!r}")
-        salt = user.encode("latin-1")
-        if len(salt) > SALT_MAX:
-            raise ValueError(f"username longer than {SALT_MAX} bytes")
-        return Target(raw=body, digest=digest,
-                      params={"salt": salt, "user": user})
-
-    def hash_batch(self, candidates: Sequence[bytes],
-                   params: Optional[dict] = None) -> list[bytes]:
-        if not params:
-            raise ValueError("postgres needs target params (username)")
-        return [hashlib.md5(c + params["salt"]).digest()
-                for c in candidates]
+        if body.startswith("md5"):
+            body = body[3:]
+        digest, salt = parse_salted_line(body, self.digest_size)
+        return Target(raw=text.strip(), digest=digest,
+                      params={"salt": salt,
+                              "user": salt.decode("latin-1")})

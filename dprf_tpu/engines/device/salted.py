@@ -460,7 +460,6 @@ class JaxPostgresEngine(_SaltedDeviceMixin, JaxMd5Engine):
 
     name = "postgres"
     order = "ps"
-    max_candidate_len = 55 - SALT_MAX
 
     def parse_target(self, text: str):
         from dprf_tpu.engines.cpu.engines import PostgresMd5Engine
