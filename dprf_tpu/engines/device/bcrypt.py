@@ -16,6 +16,8 @@ throughput.
 
 from __future__ import annotations
 
+import os
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -95,6 +97,138 @@ class JaxBcryptEngine(BcryptEngine):
 
 _jit_bcrypt_batch = jax.jit(bf_ops.bcrypt_batch)
 
+#: per-dispatch wall budget for the chunked cost loop.  The axon tunnel
+#: enforces a hard ~60 s execution deadline per dispatch (a cost-12
+#: batch in ONE dispatch tripped it and poisoned the backend,
+#: TPU_PROBE_LOG_r03); a 20 s budget keeps 3x headroom while the
+#: ~0.4 s/dispatch tunnel RTT stays <2% overhead.
+DEFAULT_DISPATCH_S = float(os.environ.get("DPRF_BCRYPT_DISPATCH_S", "20"))
+
+
+class ChunkedEks:
+    """Drives the EksBlowfish 2**cost main loop in deadline-bounded
+    dispatches, carrying the (P, S) state on device between them.
+
+    The first chunk is small (16 rounds) to calibrate seconds/round for
+    the current (batch, impl) without risking the deadline; later chunks
+    grow toward `dispatch_s`, capped at 8x per step so one optimistic
+    estimate cannot jump straight past the deadline.  State buffers are
+    donated to the advance dispatch, so the 4 KB/lane S-boxes are
+    updated in place rather than copied each chunk.
+    """
+
+    CALIBRATE_ROUNDS = 16
+    GROWTH_CAP = 8
+
+    def __init__(self, dispatch_s: float = None, advance=None):
+        """`advance(P, S, key_words, salt18, n) -> (P, S)` defaults to
+        the jitted single-chip eks_rounds; the sharded workers pass
+        their shard_map'd equivalent."""
+        self.dispatch_s = (DEFAULT_DISPATCH_S if dispatch_s is None
+                           else dispatch_s)
+        self._advance = (advance if advance is not None else
+                         jax.jit(bf_ops.eks_rounds, donate_argnums=(0, 1)))
+        self._per_round: Optional[float] = None   # EMA, seconds/round
+        # Carried across run() calls: once calibrated, later batches
+        # start at the budget-sized chunk instead of re-paying the
+        # 8x ramp (a few tunnel RTTs per batch, thousands of batches).
+        self._last_chunk = self.CALIBRATE_ROUNDS
+
+    def _next_chunk(self, remaining: int, last_chunk: int) -> int:
+        if self._per_round is None:
+            return min(remaining, self.CALIBRATE_ROUNDS)
+        want = max(1, int(self.dispatch_s / self._per_round))
+        return min(remaining, want, last_chunk * self.GROWTH_CAP)
+
+    def run(self, P, S, key_words, salt18, total_rounds: int,
+            on_chunk=None):
+        """Advance (P, S) by `total_rounds`; returns the final state.
+        `on_chunk(done, total)` is called after each dispatch (progress
+        / lease-renewal hook)."""
+        done = 0
+        while done < total_rounds:
+            chunk = self._next_chunk(total_rounds - done,
+                                     self._last_chunk)
+            t0 = time.perf_counter()
+            P, S = self._advance(P, S, key_words, salt18,
+                                 jnp.int32(chunk))
+            jax.block_until_ready(S)
+            dt = time.perf_counter() - t0
+            per = dt / chunk
+            self._per_round = (per if self._per_round is None
+                               else 0.5 * self._per_round + 0.5 * per)
+            done += chunk
+            # remaining-clamped tails must not shrink the carried ramp
+            self._last_chunk = max(self._last_chunk, chunk)
+            if on_chunk is not None:
+                on_chunk(done, total_rounds)
+        return P, S
+
+
+def make_bcrypt_mask_chunk_fns(gen, batch: int, hit_capacity: int = 64):
+    """Chunked-variant device functions for the mask sweep:
+
+    begin(base_digits, salt_words) -> (key_words, P, S)
+    finish(P, S, n_valid, target) -> (count, lanes, _)
+
+    The cost loop between them runs through ChunkedEks.run, so no
+    single dispatch carries the whole 2**cost chain."""
+    flat = gen.flat_charsets
+    length = gen.length
+
+    @jax.jit
+    def begin(base_digits, salt_words):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        lens = jnp.full((batch,), length, jnp.int32)
+        kw = bf_ops.key_words_from_candidates(cand, lens)
+        P, S = bf_ops.eks_setup_begin(kw, salt_words)
+        return kw, P, S
+
+    @jax.jit
+    def finish(P, S, n_valid, target):
+        dwords = bf_ops.bcrypt_digest_words(P, S)
+        found = bf_ops.compare_digest_words(dwords, target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return begin, finish
+
+
+def make_bcrypt_wordlist_chunk_fns(gen, word_batch: int,
+                                   hit_capacity: int = 64):
+    """Chunked-variant device functions for the wordlist(+rules) sweep:
+
+    begin(w0, n_valid_words, salt_words) -> (key_words, valid, P, S)
+    finish(P, S, valid, target) -> (count, lanes, _)
+    """
+    B, L = word_batch, gen.max_len
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+
+    @jax.jit
+    def begin(w0, n_valid_words, salt_words):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
+        kw = bf_ops.key_words_from_candidates(cw, cl)
+        P, S = bf_ops.eks_setup_begin(kw, salt_words)
+        return kw, cv, P, S
+
+    @jax.jit
+    def finish(P, S, valid, target):
+        dwords = bf_ops.bcrypt_digest_words(P, S)
+        found = bf_ops.compare_digest_words(dwords, target) & valid
+        n = valid.shape[0]
+        return cmp_ops.compact_hits(found, jnp.zeros((n,), jnp.int32),
+                                    hit_capacity)
+
+    return begin, finish
+
 
 def _n_rounds(cost: int) -> jnp.ndarray:
     """2**cost as the device loop trip count.  Cost 31 (valid in the
@@ -134,14 +268,33 @@ def make_bcrypt_mask_step(gen, batch: int, hit_capacity: int = 64):
     return step
 
 
-def make_sharded_bcrypt_mask_step(gen, mesh, batch_per_device: int,
-                                  hit_capacity: int = 64):
-    """Multi-chip bcrypt mask step (config 4 at pod scale): chip c owns
-    lane slice [c*B, (c+1)*B) of the super-batch and runs the full
-    EksBlowfish chain locally; only the scalar hit count psums over ICI.
+def _make_sharded_eks_advance(mesh):
+    """Shard_map'd ChunkedEks advance: each chip advances its own lane
+    slice of the (key_words, P, S) state; no collectives -- the chains
+    are per-lane serial.  State stays sharded on device between
+    dispatches."""
+    from jax.sharding import PartitionSpec as P
 
-    step(base_digits, n_valid, salt_words, n_rounds, target) ->
-        (total, counts[n_dev], lanes[n_dev, cap] super-batch-global, _).
+    from dprf_tpu.parallel.mesh import SHARD_AXIS
+
+    sharded = jax.shard_map(
+        bf_ops.eks_rounds, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0, 1))
+
+
+def make_sharded_bcrypt_mask_chunk_fns(gen, mesh, batch_per_device: int,
+                                       hit_capacity: int = 64):
+    """Multi-chip chunked bcrypt mask sweep (config 4 at pod scale):
+    chip c owns lane slice [c*B, (c+1)*B) of the super-batch; the cost
+    loop runs through ChunkedEks with the state sharded across chips,
+    so no dispatch -- single- or multi-chip -- carries the whole
+    2**cost chain (the shape that trips per-dispatch deadlines).
+
+    begin(base_digits, salt_words) -> (key_words, P, S)   [sharded]
+    finish(P, S, n_valid, target) ->
+        (total, counts[n_dev], lanes[n_dev, cap] super-batch-global, _)
     """
     from jax.sharding import PartitionSpec as P
 
@@ -151,12 +304,23 @@ def make_sharded_bcrypt_mask_step(gen, mesh, batch_per_device: int,
     length = gen.length
     B = batch_per_device
 
-    def shard_fn(base_digits, n_valid, salt_words, n_rounds, target):
+    def begin_fn(base_digits, salt_words):
         dev = lax.axis_index(SHARD_AXIS)
         offset = (dev * B).astype(jnp.int32)
         cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
         lens = jnp.full((B,), length, jnp.int32)
-        dwords = bf_ops.bcrypt_batch(cand, lens, salt_words, n_rounds)
+        kw = bf_ops.key_words_from_candidates(cand, lens)
+        Pst, Sst = bf_ops.eks_setup_begin(kw, salt_words)
+        return kw, Pst, Sst
+
+    begin = jax.jit(jax.shard_map(
+        begin_fn, mesh=mesh, in_specs=(P(), P()),
+        out_specs=(P(SHARD_AXIS),) * 3, check_vma=False))
+
+    def finish_fn(Pst, Sst, n_valid, target):
+        dev = lax.axis_index(SHARD_AXIS)
+        offset = (dev * B).astype(jnp.int32)
+        dwords = bf_ops.bcrypt_digest_words(Pst, Sst)
         lane_global = offset + jnp.arange(B, dtype=jnp.int32)
         found = (bf_ops.compare_digest_words(dwords, target)
                  & (lane_global < n_valid))
@@ -170,27 +334,30 @@ def make_sharded_bcrypt_mask_step(gen, mesh, batch_per_device: int,
                 lax.all_gather(lanes, SHARD_AXIS),
                 lax.all_gather(tpos, SHARD_AXIS))
 
-    sharded = jax.shard_map(
-        shard_fn, mesh=mesh, in_specs=(P(),) * 5,
-        out_specs=(P(), P(), P(), P()),
-        check_vma=False)
+    finish_sm = jax.shard_map(
+        finish_fn, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
+        out_specs=(P(), P(), P(), P()), check_vma=False)
 
     @jax.jit
-    def step(base_digits, n_valid, salt_words, n_rounds, target):
-        total, counts, lanes, tpos = sharded(base_digits, n_valid,
-                                             salt_words, n_rounds, target)
+    def finish(Pst, Sst, n_valid, target):
+        total, counts, lanes, tpos = finish_sm(Pst, Sst, n_valid, target)
         return total[0], counts, lanes, tpos
 
-    step.super_batch = mesh.devices.size * B
-    return step
+    begin.super_batch = mesh.devices.size * B
+    return begin, finish
 
 
-def make_sharded_bcrypt_wordlist_step(gen, mesh, word_batch: int,
-                                      hit_capacity: int = 64):
-    """Multi-chip bcrypt wordlist step: chip c expands+hashes words
-    [w0 + c*B, w0 + (c+1)*B).  Lanes come back as super-batch flat
-    indices r*(n_dev*B) + global word lane (the same convention as
+def make_sharded_bcrypt_wordlist_chunk_fns(gen, mesh, word_batch: int,
+                                           hit_capacity: int = 64):
+    """Multi-chip chunked bcrypt wordlist sweep: chip c expands+hashes
+    words [w0 + c*B, w0 + (c+1)*B), cost loop chunked via ChunkedEks
+    (state sharded).  Lanes come back as super-batch flat indices
+    r*(n_dev*B) + global word lane (the same convention as
     ops/rules_pipeline.make_sharded_wordlist_crack_step).
+
+    begin(w0, n_valid_words, salt_words) -> (key_words, valid, P, S)
+    finish(P, S, valid, target) -> (total, counts, lanes, _)
     """
     from jax.sharding import PartitionSpec as P
 
@@ -204,7 +371,7 @@ def make_sharded_bcrypt_wordlist_step(gen, mesh, word_batch: int,
     lens_dev = jnp.asarray(lens_np)
     rules = gen.rules
 
-    def shard_fn(w0, n_valid_words, salt_words, n_rounds, target):
+    def begin_fn(w0, n_valid_words, salt_words):
         dev = lax.axis_index(SHARD_AXIS)
         my_w0 = w0 + (dev * B).astype(jnp.int32)
         wslice = lax.dynamic_slice(words_dev, (my_w0, 0), (B, L))
@@ -213,10 +380,21 @@ def make_sharded_bcrypt_wordlist_step(gen, mesh, word_batch: int,
             B, dtype=jnp.int32)
         base_valid = word_lane < n_valid_words
         cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
-        dwords = bf_ops.bcrypt_batch(cw, cl, salt_words, n_rounds)
-        found = bf_ops.compare_digest_words(dwords, target) & cv
+        kw = bf_ops.key_words_from_candidates(cw, cl)
+        Pst, Sst = bf_ops.eks_setup_begin(kw, salt_words)
+        return kw, cv, Pst, Sst
+
+    begin = jax.jit(jax.shard_map(
+        begin_fn, mesh=mesh, in_specs=(P(), P(), P()),
+        out_specs=(P(SHARD_AXIS),) * 4, check_vma=False))
+
+    def finish_fn(Pst, Sst, valid, target):
+        dev = lax.axis_index(SHARD_AXIS)
+        dwords = bf_ops.bcrypt_digest_words(Pst, Sst)
+        found = bf_ops.compare_digest_words(dwords, target) & valid
+        n = valid.shape[0]
         count, lanes, tpos = cmp_ops.compact_hits(
-            found, jnp.zeros_like(cl), hit_capacity)
+            found, jnp.zeros((n,), jnp.int32), hit_capacity)
         r = lanes // B
         b = lanes % B
         glanes = r * (n_dev * B) + dev * B + b
@@ -228,47 +406,18 @@ def make_sharded_bcrypt_wordlist_step(gen, mesh, word_batch: int,
                 lax.all_gather(lanes, SHARD_AXIS),
                 lax.all_gather(tpos, SHARD_AXIS))
 
-    sharded = jax.shard_map(
-        shard_fn, mesh=mesh, in_specs=(P(),) * 5,
-        out_specs=(P(), P(), P(), P()),
-        check_vma=False)
+    finish_sm = jax.shard_map(
+        finish_fn, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
+        out_specs=(P(), P(), P(), P()), check_vma=False)
 
     @jax.jit
-    def step(w0, n_valid_words, salt_words, n_rounds, target):
-        total, counts, lanes, tpos = sharded(w0, n_valid_words,
-                                             salt_words, n_rounds, target)
+    def finish(Pst, Sst, valid, target):
+        total, counts, lanes, tpos = finish_sm(Pst, Sst, valid, target)
         return total[0], counts, lanes, tpos
 
-    step.super_words = n_dev * B
-    return step
-
-
-def make_bcrypt_wordlist_step(gen, word_batch: int, hit_capacity: int = 64):
-    """Wordlist(+rules) variant; words are sliced from the HBM-resident
-    packed table and expanded through the rule set on device, exactly
-    like ops/rules_pipeline.py, then fed to EksBlowfish.
-
-    step(w0, n_valid_words, salt_words, n_rounds, target) ->
-        (count, lanes, _); lanes are flat r*B + b candidate indices.
-    """
-    B, L = word_batch, gen.max_len
-    words_np, lens_np = gen.packed_words(pad_to=B,
-                                         min_size=gen.n_words + B - 1)
-    words_dev = jnp.asarray(words_np)
-    lens_dev = jnp.asarray(lens_np)
-    rules = gen.rules
-
-    @jax.jit
-    def step(w0, n_valid_words, salt_words, n_rounds, target):
-        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
-        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
-        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
-        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
-        dwords = bf_ops.bcrypt_batch(cw, cl, salt_words, n_rounds)
-        found = bf_ops.compare_digest_words(dwords, target) & cv
-        return cmp_ops.compact_hits(found, jnp.zeros_like(cl), hit_capacity)
-
-    return step
+    begin.super_words = n_dev * B
+    return begin, finish
 
 
 class _BcryptWorkerBase:
@@ -296,23 +445,33 @@ class _BcryptWorkerBase:
 
 
 class BcryptMaskWorker(_BcryptWorkerBase):
+    """Single-chip mask sweep, chunked: the cost loop of every batch is
+    split over deadline-bounded dispatches (ChunkedEks), so a cost-12
+    batch no longer rides in one hour-long dispatch -- session3 proved
+    that trips the tunnel's per-dispatch execution deadline and poisons
+    the backend (TPU_PROBE_LOG_r03)."""
+
     def __init__(self, engine, gen, targets, batch: int = DEFAULT_BATCH,
-                 hit_capacity: int = 64, oracle=None):
+                 hit_capacity: int = 64, oracle=None,
+                 dispatch_s: float = None):
         super().__init__(engine, gen, targets, batch, hit_capacity, oracle)
         self.stride = batch
-        self.step = make_bcrypt_mask_step(gen, batch, hit_capacity)
+        self.begin, self.finish = make_bcrypt_mask_chunk_fns(
+            gen, batch, hit_capacity)
+        self.chunker = ChunkedEks(dispatch_s)
 
     def process(self, unit: WorkUnit) -> list[Hit]:
         hits: list[Hit] = []
         for ti in range(len(self.targets)):
             salt_w, n_rounds, tgt = self._targs[ti]
-            queued = []
+            salt18 = bf_ops.salt18_words(salt_w)
+            total = int(n_rounds)
             for bstart in range(unit.start, unit.end, self.stride):
                 n_valid = min(self.stride, unit.end - bstart)
                 base = jnp.asarray(self.gen.digits(bstart), dtype=jnp.int32)
-                queued.append((bstart, self.step(
-                    base, jnp.int32(n_valid), salt_w, n_rounds, tgt)))
-            for bstart, (count, lanes, _) in queued:
+                kw, P, S = self.begin(base, salt_w)
+                P, S = self.chunker.run(P, S, kw, salt18, total)
+                count, lanes, _ = self.finish(P, S, jnp.int32(n_valid), tgt)
                 count = int(count)
                 if count == 0:
                     continue
@@ -329,30 +488,37 @@ class BcryptMaskWorker(_BcryptWorkerBase):
 
 
 class ShardedBcryptMaskWorker(_BcryptWorkerBase):
-    """Multi-chip bcrypt mask worker (keyspace DP over the mesh)."""
+    """Multi-chip bcrypt mask worker (keyspace DP over the mesh),
+    chunked: the cost loop runs in deadline-bounded dispatches with the
+    EksBlowfish state sharded across chips (see BcryptMaskWorker)."""
 
     def __init__(self, engine, gen, targets, mesh,
                  batch_per_device: int = DEFAULT_BATCH,
-                 hit_capacity: int = 64, oracle=None):
+                 hit_capacity: int = 64, oracle=None,
+                 dispatch_s: float = None):
         super().__init__(engine, gen, targets,
                          mesh.devices.size * batch_per_device,
                          hit_capacity, oracle)
         self.mesh = mesh
-        self.stride = self.batch          # one super-batch per step
-        self.step = make_sharded_bcrypt_mask_step(
+        self.stride = self.batch          # one super-batch per sweep
+        self.begin, self.finish = make_sharded_bcrypt_mask_chunk_fns(
             gen, mesh, batch_per_device, hit_capacity)
+        self.chunker = ChunkedEks(dispatch_s,
+                                  advance=_make_sharded_eks_advance(mesh))
 
     def process(self, unit: WorkUnit) -> list[Hit]:
         hits: list[Hit] = []
         for ti in range(len(self.targets)):
             salt_w, n_rounds, tgt = self._targs[ti]
-            queued = []
+            salt18 = bf_ops.salt18_words(salt_w)
+            total_rounds = int(n_rounds)
             for bstart in range(unit.start, unit.end, self.stride):
                 n_valid = min(self.stride, unit.end - bstart)
                 base = jnp.asarray(self.gen.digits(bstart), dtype=jnp.int32)
-                queued.append((bstart, self.step(
-                    base, jnp.int32(n_valid), salt_w, n_rounds, tgt)))
-            for bstart, (total, counts, lanes, _) in queued:
+                kw, P, S = self.begin(base, salt_w)
+                P, S = self.chunker.run(P, S, kw, salt18, total_rounds)
+                total, counts, lanes, _ = self.finish(
+                    P, S, jnp.int32(n_valid), tgt)
                 if int(total) == 0:
                     continue
                 if (np.asarray(counts) > self.hit_capacity).any():
@@ -373,14 +539,17 @@ class ShardedBcryptWordlistWorker(_BcryptWorkerBase):
 
     def __init__(self, engine, gen, targets, mesh,
                  word_batch_per_device: int = 1 << 9,
-                 hit_capacity: int = 64, oracle=None):
+                 hit_capacity: int = 64, oracle=None,
+                 dispatch_s: float = None):
         super().__init__(engine, gen, targets,
                          mesh.devices.size * word_batch_per_device
                          * gen.n_rules, hit_capacity, oracle)
         self.mesh = mesh
-        self.step = make_sharded_bcrypt_wordlist_step(
+        self.begin, self.finish = make_sharded_bcrypt_wordlist_chunk_fns(
             gen, mesh, word_batch_per_device, hit_capacity)
-        self.super_words = self.step.super_words
+        self.chunker = ChunkedEks(dispatch_s,
+                                  advance=_make_sharded_eks_advance(mesh))
+        self.super_words = self.begin.super_words
         self.word_batch = self.super_words
         self.stride = self.super_words * gen.n_rules
 
@@ -390,15 +559,17 @@ class ShardedBcryptWordlistWorker(_BcryptWorkerBase):
         hits: list[Hit] = []
         for ti in range(len(self.targets)):
             salt_w, n_rounds, tgt = self._targs[ti]
-            queued = []
+            salt18 = bf_ops.salt18_words(salt_w)
+            total_rounds = int(n_rounds)
             for ws in range(w_start, w_end, self.super_words):
                 nw = min(self.super_words, w_end - ws,
                          self.gen.n_words - ws)
                 if nw <= 0:
                     break
-                queued.append((ws, nw, self.step(
-                    jnp.int32(ws), jnp.int32(nw), salt_w, n_rounds, tgt)))
-            for ws, nw, (total, counts, lanes, _) in queued:
+                kw, cv, P, S = self.begin(jnp.int32(ws), jnp.int32(nw),
+                                          salt_w)
+                P, S = self.chunker.run(P, S, kw, salt18, total_rounds)
+                total, counts, lanes, _ = self.finish(P, S, cv, tgt)
                 if int(total) == 0:
                     continue
                 if (np.asarray(counts) > self.hit_capacity).any():
@@ -418,13 +589,18 @@ class ShardedBcryptWordlistWorker(_BcryptWorkerBase):
 
 
 class BcryptWordlistWorker(_BcryptWorkerBase):
+    """Single-chip wordlist(+rules) sweep, chunked like the mask
+    worker (see BcryptMaskWorker)."""
+
     def __init__(self, engine, gen, targets, batch: int = DEFAULT_BATCH,
-                 hit_capacity: int = 64, oracle=None):
+                 hit_capacity: int = 64, oracle=None,
+                 dispatch_s: float = None):
         super().__init__(engine, gen, targets, batch, hit_capacity, oracle)
         self.word_batch = max(1, batch // gen.n_rules)
         self.stride = self.word_batch * gen.n_rules
-        self.step = make_bcrypt_wordlist_step(gen, self.word_batch,
-                                              hit_capacity)
+        self.begin, self.finish = make_bcrypt_wordlist_chunk_fns(
+            gen, self.word_batch, hit_capacity)
+        self.chunker = ChunkedEks(dispatch_s)
 
     def process(self, unit: WorkUnit) -> list[Hit]:
         R = self.gen.n_rules
@@ -432,14 +608,16 @@ class BcryptWordlistWorker(_BcryptWorkerBase):
         hits: list[Hit] = []
         for ti in range(len(self.targets)):
             salt_w, n_rounds, tgt = self._targs[ti]
-            queued = []
+            salt18 = bf_ops.salt18_words(salt_w)
+            total = int(n_rounds)
             for ws in range(w_start, w_end, self.word_batch):
                 nw = min(self.word_batch, w_end - ws, self.gen.n_words - ws)
                 if nw <= 0:
                     break
-                queued.append((ws, nw, self.step(
-                    jnp.int32(ws), jnp.int32(nw), salt_w, n_rounds, tgt)))
-            for ws, nw, (count, lanes, _) in queued:
+                kw, cv, P, S = self.begin(jnp.int32(ws), jnp.int32(nw),
+                                          salt_w)
+                P, S = self.chunker.run(P, S, kw, salt18, total)
+                count, lanes, _ = self.finish(P, S, cv, tgt)
                 count = int(count)
                 if count == 0:
                     continue

@@ -129,20 +129,29 @@ def key_words_from_candidates(cand: jnp.ndarray,
     return (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
 
 
-def eks_setup(key_words: jnp.ndarray, salt_words: jnp.ndarray,
-              n_rounds: jnp.ndarray):
-    """Full EksBlowfish setup for a batch of candidates.
+def salt18_words(salt_words: jnp.ndarray) -> jnp.ndarray:
+    """ExpandKey(salt) key words: the 16-byte salt cyclically extended
+    over 72 bytes is word-periodic with period 4."""
+    return jnp.tile(salt_words, 5)[:18]
 
-    key_words uint32[B, 18], salt_words uint32[4], n_rounds int32 scalar
-    (= 2**cost, a runtime value).  Returns the final (P, S) state.
-    """
+
+def eks_setup_begin(key_words: jnp.ndarray, salt_words: jnp.ndarray):
+    """EksBlowfish setup prologue: fresh P/S boxes plus the one
+    salt-perturbed ExpandKey(key).  Returns (P, S) ready for the main
+    cost loop (`eks_rounds`)."""
     B = key_words.shape[0]
     P = jnp.broadcast_to(jnp.asarray(P0), (B, 18))
     S = jnp.broadcast_to(jnp.asarray(S0), (B, 1024))
-    P, S = expand_key(P, S, key_words, salt_words)
-    # ExpandKey(salt): the 16-byte salt cyclically extended over 72
-    # bytes is word-periodic with period 4.
-    salt18 = jnp.tile(salt_words, 5)[:18]
+    return expand_key(P, S, key_words, salt_words)
+
+
+def eks_rounds(P: jnp.ndarray, S: jnp.ndarray, key_words: jnp.ndarray,
+               salt18: jnp.ndarray, n_rounds: jnp.ndarray):
+    """Advance the EksBlowfish main loop by `n_rounds` iterations of
+    {ExpandKey(key); ExpandKey(salt)}.  The body is independent of the
+    absolute round index, so the 2**cost chain can be split across any
+    number of calls with (P, S) carried between them -- the device
+    engine uses this to keep each dispatch under a time budget."""
 
     def body(_, PS):
         P, S = PS
@@ -151,6 +160,17 @@ def eks_setup(key_words: jnp.ndarray, salt_words: jnp.ndarray,
         return P, S
 
     return lax.fori_loop(0, n_rounds, body, (P, S))
+
+
+def eks_setup(key_words: jnp.ndarray, salt_words: jnp.ndarray,
+              n_rounds: jnp.ndarray):
+    """Full EksBlowfish setup for a batch of candidates.
+
+    key_words uint32[B, 18], salt_words uint32[4], n_rounds int32 scalar
+    (= 2**cost, a runtime value).  Returns the final (P, S) state.
+    """
+    P, S = eks_setup_begin(key_words, salt_words)
+    return eks_rounds(P, S, key_words, salt18_words(salt_words), n_rounds)
 
 
 def bcrypt_digest_words(P: jnp.ndarray, S: jnp.ndarray) -> jnp.ndarray:

@@ -134,3 +134,54 @@ def test_bcrypt_mask_worker_finds_planted():
     assert len(hits) == 1
     assert hits[0].plaintext == b"42"
     assert hits[0].target_index == 0
+
+
+def test_chunked_eks_matches_fused():
+    """Splitting the cost loop across arbitrary dispatch boundaries must
+    reproduce the one-shot eks_setup state exactly (the chunked path is
+    how cost >= 10 runs in production: one dispatch per time budget, not
+    one per batch -- see ChunkedEks)."""
+    rng = np.random.default_rng(7)
+    kw = jnp.asarray(rng.integers(0, 2**32, (4, 18), dtype=np.uint32))
+    sw = jnp.asarray(rng.integers(0, 2**32, (4,), dtype=np.uint32))
+    n = 32                                    # cost 5
+    P1, S1 = bf_ops.eks_setup(kw, sw, jnp.int32(n))
+    want = np.asarray(bf_ops.bcrypt_digest_words(P1, S1))
+
+    salt18 = bf_ops.salt18_words(sw)
+    P, S = bf_ops.eks_setup_begin(kw, sw)
+    for chunk in (1, 16, 5, 10):              # uneven split of 32
+        P, S = bf_ops.eks_rounds(P, S, kw, salt18, jnp.int32(chunk))
+    got = np.asarray(bf_ops.bcrypt_digest_words(P, S))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_chunked_worker_many_dispatches_finds_planted():
+    """A dispatch budget far below one chunk's calibration time forces
+    the worker down to 1-round dispatches; the sweep must still find the
+    planted password (state carries across dispatch boundaries)."""
+    from dprf_tpu.generators.mask import MaskGenerator
+
+    gen = MaskGenerator("?d?d")
+    salt = b"0123456789abcdef"
+    eng = get_engine("bcrypt", device="jax")
+    targets = [eng.parse_target(bcrypt_hash(b"73", salt, 4))]
+    worker = eng.make_mask_worker(gen, targets, batch=128, hit_capacity=8,
+                                  oracle=None)
+    worker.chunker.dispatch_s = 1e-9          # force minimum chunks
+    hits = worker.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, b"73")]
+    # calibration chunk (16) + 1-round tail dispatches
+    assert worker.chunker._per_round is not None
+
+
+def test_chunked_growth_cap():
+    """One optimistic per-round estimate must not jump the chunk size
+    straight past the deadline: growth is capped at 8x per dispatch."""
+    from dprf_tpu.engines.device.bcrypt import ChunkedEks
+
+    c = ChunkedEks(dispatch_s=100.0)
+    assert c._next_chunk(1 << 20, 16) == 16   # calibration first
+    c._per_round = 1e-6                       # looks 1e8-rounds-cheap
+    assert c._next_chunk(1 << 30, 16) == 128  # 16 * 8, not 1e8
+    assert c._next_chunk(100, 1 << 20) == 100  # remaining clamps

@@ -7,6 +7,8 @@ appended to TPU_CASES_OUT as one JSON line per case.
 
 Usage: python tools/tpu_case.py <case>
 Cases: scrypt-<N>-<r>-<p>-<B> | bcrypt-<cost>-<B> | pmkid-<B>
+     | bcryptchunk-<cost>-<B>   (deadline-bounded chunked cost loop;
+                                 the only safe shape for cost >= 10)
 """
 
 import json
@@ -65,6 +67,38 @@ def run_case(name: str) -> dict:
         def run(b):
             return step(b, jnp.int32(B), sw, jnp.int32(1 << cost),
                         tgt)[0]
+    elif kind == "bcryptchunk":
+        # One full batch through the deadline-bounded chunked path
+        # (begin -> ChunkedEks.run -> finish): no single dispatch holds
+        # the whole 2**cost chain, so cost 12 cannot trip the tunnel's
+        # per-dispatch execution deadline the way session3's one-shot
+        # step did.
+        cost, B = (int(x) for x in parts[1:])
+        from dprf_tpu.engines.device.bcrypt import (
+            ChunkedEks, make_bcrypt_mask_chunk_fns)
+        g6 = MaskGenerator("?l?l?l?l?l?l")
+        base6 = jnp.asarray(g6.digits(0), jnp.int32)
+        begin, finish = make_bcrypt_mask_chunk_fns(g6, B)
+        sw = jnp.asarray(np.frombuffer(bytes(range(16)), ">u4")
+                         .astype(np.uint32))
+        from dprf_tpu.ops import blowfish as bf_ops
+        salt18 = bf_ops.salt18_words(sw)
+        tgt = jnp.full((6,), 0xFFFFFFFF, jnp.uint32)
+        chunker = ChunkedEks()
+        marks = [time.perf_counter()]
+        t0 = marks[0]
+        kw, P, S = begin(base6, sw)
+        P, S = chunker.run(P, S, kw, salt18, 1 << cost,
+                           on_chunk=lambda d, t: marks.append(
+                               time.perf_counter()))
+        count = int(finish(P, S, jnp.int32(B), tgt)[0])
+        dt = time.perf_counter() - t0
+        steps = [marks[i + 1] - marks[i] for i in range(len(marks) - 1)]
+        return {"case": name, "ok": True, "hs": B / dt, "batch": B,
+                "rounds": 1 << cost, "total_s": round(dt, 1),
+                "n_dispatches": len(steps) + 2,
+                "max_dispatch_s": round(max(steps), 1),
+                "false_hits": count}
     elif kind == "pmkid":
         B = int(parts[1])
         from dprf_tpu import get_engine
