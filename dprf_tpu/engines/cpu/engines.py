@@ -146,6 +146,211 @@ _register_salted_cpu("sha256", 32)
 _register_salted_cpu("sha512", 64, block_limit=111)
 
 
+def parse_ldap_line(text: str, scheme: str, digest_size: int):
+    """LDAP userPassword line '{SCHEME}base64(digest + salt)' ->
+    (digest, salt).  The salt is whatever follows the digest in the
+    decoded blob (typically 4-8 bytes; empty for the unsalted {SHA}/
+    {MD5} schemes)."""
+    import base64
+
+    t = text.strip()
+    tag = "{" + scheme + "}"
+    if not t[:len(tag)].upper() == tag:
+        raise ValueError(f"not an LDAP {tag} line: {text!r}")
+    try:
+        blob = base64.b64decode(t[len(tag):], validate=True)
+    except Exception as e:
+        raise ValueError(f"bad base64 in LDAP line {text!r}: {e}")
+    if len(blob) < digest_size:
+        raise ValueError(f"LDAP {tag} blob shorter than the "
+                         f"{digest_size}-byte digest: {text!r}")
+    digest, salt = blob[:digest_size], blob[digest_size:]
+    if len(salt) > SALT_MAX:
+        raise ValueError(f"salt longer than {SALT_MAX} bytes in {text!r}")
+    return digest, salt
+
+
+class _LdapSaltedEngine(_SaltedCpuMixin):
+    """LDAP {SSHA}-style schemes: digest(pass + salt), digest and salt
+    packed together in one base64 blob -- the salted 'ps' computation
+    with LDAP's line format."""
+
+    _order = "ps"
+    _scheme: str
+
+    def parse_target(self, text: str) -> Target:
+        digest, salt = parse_ldap_line(text, self._scheme,
+                                       self.digest_size)
+        return Target(raw=text.strip(), digest=digest,
+                      params={"salt": salt})
+
+
+@register("ldap-ssha")
+@register("ssha")
+class LdapSshaEngine(_LdapSaltedEngine):
+    """LDAP {SSHA} (hashcat 111): sha1($pass.$salt), base64 blob."""
+
+    name = "ldap-ssha"
+    digest_size = 20
+    _algo = "sha1"
+    _scheme = "SSHA"
+    max_candidate_len = 55 - SALT_MAX
+
+
+@register("ldap-ssha512")
+@register("ssha512")
+class LdapSsha512Engine(_LdapSaltedEngine):
+    """LDAP {SSHA512} (hashcat 1711): sha512($pass.$salt)."""
+
+    name = "ldap-ssha512"
+    digest_size = 64
+    _algo = "sha512"
+    _scheme = "SSHA512"
+    max_candidate_len = 111 - SALT_MAX
+
+
+@register("ldap-smd5")
+class LdapSmd5Engine(_LdapSaltedEngine):
+    """LDAP {SMD5}: md5($pass.$salt), base64 blob."""
+
+    name = "ldap-smd5"
+    digest_size = 16
+    _algo = "md5"
+    _scheme = "SMD5"
+    max_candidate_len = 55 - SALT_MAX
+
+
+class _LdapPlainMixin(HashEngine):
+    """Unsalted LDAP schemes ({SHA}, {MD5}): the plain fast hash with
+    the base64 line format, so the multi-target fast path applies."""
+
+    _scheme: str
+
+    def parse_target(self, text: str) -> Target:
+        digest, salt = parse_ldap_line(text, self._scheme,
+                                       self.digest_size)
+        if salt:
+            raise ValueError(f"unexpected salt bytes after the digest "
+                             f"in unsalted {{{self._scheme}}} line: "
+                             f"{text!r}")
+        return Target(raw=text.strip(), digest=digest)
+
+
+@register("ldap-sha")
+class LdapShaEngine(_LdapPlainMixin, Sha1Engine):
+    """LDAP {SHA} (hashcat 101): raw sha1, base64 line format."""
+
+    name = "ldap-sha"
+    _scheme = "SHA"
+
+
+@register("ldap-md5")
+class LdapMd5Engine(_LdapPlainMixin, Md5Engine):
+    """LDAP {MD5}: raw md5, base64 line format."""
+
+    name = "ldap-md5"
+    _scheme = "MD5"
+
+
+def parse_mssql_line(text: str, version_tag: str, digest_hex: int):
+    """MSSQL '0x<ver><8-hex salt><hex digest[s]>' -> (salt, digests).
+    2000 lines carry TWO 40-hex sha1 digests (case-sensitive then
+    upper-cased); 2005 carry one 40-hex; 2012/2014 one 128-hex."""
+    t = text.strip()
+    if not t.lower().startswith("0x" + version_tag):
+        raise ValueError(f"not an MSSQL 0x{version_tag} line: {text!r}")
+    body = t[2 + len(version_tag):]
+    if len(body) < 8 + digest_hex or (len(body) - 8) % digest_hex:
+        raise ValueError(f"malformed MSSQL line (want 8-hex salt + "
+                         f"k x {digest_hex}-hex digest): {text!r}")
+    try:
+        salt = bytes.fromhex(body[:8])
+        digests = [bytes.fromhex(body[8 + i * digest_hex:
+                                      8 + (i + 1) * digest_hex])
+                   for i in range((len(body) - 8) // digest_hex)]
+    except ValueError:
+        raise ValueError(f"bad hex in MSSQL line: {text!r}")
+    return salt, digests
+
+
+class _MssqlCpuBase(HashEngine):
+    """sha-family over utf16le($pass) . $salt (4-byte salt)."""
+
+    salted = True
+    _algo: str
+    _tag: str
+    _upper = False
+    #: digests per line: 2000 stores [case-sensitive, upper-cased],
+    #: 2005/2012 exactly one.  Enforced so a 2000-format line fed to
+    #: the 2005 engine (or vice versa) is rejected instead of silently
+    #: cracking against the wrong digest.
+    _ndigests = 1
+
+    def parse_target(self, text: str) -> Target:
+        salt, digests = parse_mssql_line(text, self._tag,
+                                         2 * self.digest_size)
+        if len(digests) != self._ndigests:
+            raise ValueError(
+                f"{self.name} wants {self._ndigests} digest(s) per "
+                f"line, got {len(digests)} -- wrong MSSQL version? "
+                f"{text!r}")
+        # 2000 lines: [case-sensitive, upper]; crack the LAST digest
+        # (the case-insensitive one).
+        return Target(raw=text.strip(), digest=digests[-1],
+                      params={"salt": salt})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError(f"{self.name} needs target params (salt)")
+        salt = params["salt"]
+        out = []
+        for c in candidates:
+            if self._upper:
+                c = c.upper()          # ASCII-only, like the device path
+            wide = bytes(b for ch in c for b in (ch, 0))
+            out.append(hashlib.new(self._algo, wide + salt).digest())
+        return out
+
+
+@register("mssql2000")
+class Mssql2000Engine(_MssqlCpuBase):
+    """MSSQL 2000 (hashcat 131): sha1(utf16le(upper($pass)) . $salt) --
+    the case-insensitive second digest of the 0x0100 line."""
+
+    name = "mssql2000"
+    digest_size = 20
+    _algo = "sha1"
+    _tag = "0100"
+    _upper = True
+    _ndigests = 2
+    max_candidate_len = (55 - 4) // 2
+
+
+@register("mssql2005")
+class Mssql2005Engine(_MssqlCpuBase):
+    """MSSQL 2005 (hashcat 132): sha1(utf16le($pass) . $salt)."""
+
+    name = "mssql2005"
+    digest_size = 20
+    _algo = "sha1"
+    _tag = "0100"
+    max_candidate_len = (55 - 4) // 2
+
+
+@register("mssql2012")
+@register("mssql2014")
+class Mssql2012Engine(_MssqlCpuBase):
+    """MSSQL 2012/2014 (hashcat 1731): sha512(utf16le($pass) . $salt),
+    0x0200 lines."""
+
+    name = "mssql2012"
+    digest_size = 64
+    _algo = "sha512"
+    _tag = "0200"
+    max_candidate_len = (111 - 4) // 2
+
+
 #: nested double-hash combinations (outer, inner) with their hashcat
 #: modes -- the ONE list device/nested.py and the oracles share (this
 #: module stays jax-free, so it is the importable-everywhere home)
@@ -337,7 +542,27 @@ class Md5cryptEngine(HashEngine):
         from dprf_tpu.engines.cpu.md5crypt import md5crypt_raw
         if not params:
             raise ValueError("md5crypt needs target params (salt)")
-        return [md5crypt_raw(c, params["salt"]) for c in candidates]
+        return [md5crypt_raw(c, params["salt"], self.magic)
+                for c in candidates]
+
+    #: scheme tag in the initial md5 context; subclasses override.
+    magic = b"$1$"
+
+
+@register("apr1")
+@register("apache-md5")
+class Apr1Engine(Md5cryptEngine):
+    """Apache $apr1$ (htpasswd MD5; hashcat 1600): md5crypt with a
+    6-byte magic -- same 1000-round scheme otherwise."""
+
+    name = "apr1"
+    magic = b"$apr1$"
+
+    def parse_target(self, text: str) -> Target:
+        from dprf_tpu.engines.cpu.md5crypt import parse_md5crypt
+        salt, digest = parse_md5crypt(text, prefix="$apr1$")
+        return Target(raw=text.strip(), digest=digest,
+                      params={"salt": salt})
 
 
 @register("sha512crypt")

@@ -25,10 +25,14 @@ MAX_SALT_LEN = 8
 _PERM = [12, 6, 0, 13, 7, 1, 14, 8, 2, 15, 9, 3, 5, 10, 4, 11]
 
 
-def md5crypt_raw(password: bytes, salt: bytes) -> bytes:
-    """The raw (unpermuted) 16-byte md5crypt digest."""
+def md5crypt_raw(password: bytes, salt: bytes,
+                 magic: bytes = b"$1$") -> bytes:
+    """The raw (unpermuted) 16-byte md5crypt digest.  `magic` is the
+    scheme tag mixed into the initial context -- b"$1$" for FreeBSD
+    md5crypt, b"$apr1$" for Apache's apr1 variant (identical scheme
+    otherwise)."""
     alt = hashlib.md5(password + salt + password).digest()
-    ctx = password + b"$1$" + salt
+    ctx = password + magic + salt
     # alt CYCLES for passwords longer than one digest (glibc appends it
     # per 16-byte block of the password length)
     ctx += (alt * (len(password) // 16 + 1))[:len(password)]
@@ -62,12 +66,12 @@ def decode_digest(text: str) -> bytes:
     return bytes(out)
 
 
-def parse_md5crypt(text: str):
-    """'$1$salt$hash' -> (salt bytes, raw digest bytes)."""
+def parse_md5crypt(text: str, prefix: str = "$1$"):
+    """'$1$salt$hash' (or '$apr1$salt$hash') -> (salt, raw digest)."""
     t = text.strip()
-    if not t.startswith("$1$"):
-        raise ValueError(f"not an md5crypt hash: {text!r}")
-    rest = t[3:]
+    if not t.startswith(prefix):
+        raise ValueError(f"not a {prefix} hash: {text!r}")
+    rest = t[len(prefix):]
     salt_text, sep, digest_text = rest.partition("$")
     if not sep or len(digest_text) != 22:
         raise ValueError(f"malformed md5crypt hash: {text!r}")

@@ -282,3 +282,27 @@ class JaxNtlmEngine(JaxEngineBase):
             raise ValueError("ntlm: candidate longer than 27 chars")
         widened = [bytes(b for ch in c for b in (ch, 0)) for c in candidates]
         return super().hash_batch(widened, params=params)
+
+
+@register("ldap-sha", device="jax")
+class JaxLdapShaEngine(JaxSha1Engine):
+    """LDAP {SHA} (hashcat 101): the unsalted sha1 fast path (incl.
+    multi-target compare) with the base64 line format."""
+
+    name = "ldap-sha"
+
+    def parse_target(self, text: str):
+        from dprf_tpu.engines.cpu.engines import LdapShaEngine
+        return LdapShaEngine().parse_target(text)
+
+
+@register("ldap-md5", device="jax")
+class JaxLdapMd5Engine(JaxMd5Engine):
+    """LDAP {MD5}: the unsalted md5 fast path with the base64 line
+    format."""
+
+    name = "ldap-md5"
+
+    def parse_target(self, text: str):
+        from dprf_tpu.engines.cpu.engines import LdapMd5Engine
+        return LdapMd5Engine().parse_target(text)

@@ -63,9 +63,12 @@ def _digest_bytes(words):
 
 
 def md5crypt_digest_batch(cand: jnp.ndarray, lens: jnp.ndarray,
-                          salt: jnp.ndarray, salt_len) -> jnp.ndarray:
+                          salt: jnp.ndarray, salt_len,
+                          magic_bytes: bytes = b"$1$") -> jnp.ndarray:
     """cand uint8[B, maxlen] (lens <= 15) + salt uint8[8]/salt_len ->
-    raw digest words uint32[B, 4]."""
+    raw digest words uint32[B, 4].  `magic_bytes` is a trace-time
+    constant ($1$ for md5crypt, $apr1$ for Apache's variant; it only
+    enters the initial context)."""
     B = cand.shape[0]
     pos = jnp.arange(64, dtype=jnp.int32)[None, :]
     pw = _pad64(cand)
@@ -84,18 +87,19 @@ def md5crypt_digest_batch(cand: jnp.ndarray, lens: jnp.ndarray,
     alt = md5_digest_words(_finish(msg, (2 * lens
                                          + S[:, 0]).astype(jnp.int32)))
 
-    # -- initial context: pw + "$1$" + salt + alt[:len(pw)] + bitwalk ----
+    # -- initial context: pw + magic + salt + alt[:len(pw)] + bitwalk ----
+    M = len(magic_bytes)
     magic = jnp.broadcast_to(
-        jnp.pad(jnp.asarray(np.frombuffer(b"$1$", np.uint8)),
-                (0, 61))[None, :], (B, 64)).astype(jnp.uint8)
+        jnp.pad(jnp.asarray(np.frombuffer(magic_bytes, np.uint8)),
+                (0, 64 - M))[None, :], (B, 64)).astype(jnp.uint8)
     altb = _pad64(_digest_bytes(alt))
     # bit-walk bytes: for j while (L >> j) > 0: (L>>j)&1 ? 0 : pw[0]
     walk = jnp.stack(
         [jnp.where((lens >> j) & 1 == 1, jnp.uint8(0), cand[:, 0])
          for j in range(4)], axis=1).astype(jnp.uint8)
     wlen = sum(((lens >> j) > 0).astype(jnp.int32) for j in range(4))
-    o1, o2 = L, L + 3
-    o3, o4 = L + 3 + S, 2 * L + 3 + S
+    o1, o2 = L, L + M
+    o3, o4 = L + M + S, 2 * L + M + S
     total = (o4 + wlen[:, None])[:, 0]
     msg = jnp.where(pos < o1, _gat(pw, pos), 0)
     msg = jnp.where((pos >= o1) & (pos < o2), _gat(magic, pos - o1), msg)
@@ -132,7 +136,8 @@ def md5crypt_digest_batch(cand: jnp.ndarray, lens: jnp.ndarray,
     return lax.fori_loop(0, 1000, body, inter)
 
 
-def make_md5crypt_mask_step(gen, batch: int, hit_capacity: int = 64):
+def make_md5crypt_mask_step(gen, batch: int, hit_capacity: int = 64,
+                            magic: bytes = b"$1$"):
     """step(base_digits, n_valid, salt uint8[8], salt_len int32,
     target uint32[4]) -> (count, lanes, _)."""
     flat = gen.flat_charsets
@@ -146,7 +151,8 @@ def make_md5crypt_mask_step(gen, batch: int, hit_capacity: int = 64):
     def step(base_digits, n_valid, salt, salt_len, target):
         cand = gen.decode_batch(base_digits, flat, batch)
         lens = jnp.full((batch,), length, jnp.int32)
-        digest = md5crypt_digest_batch(cand, lens, salt, salt_len)
+        digest = md5crypt_digest_batch(cand, lens, salt, salt_len,
+                                       magic)
         found = cmp_ops.compare_single(digest, target)
         found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
         return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
@@ -156,7 +162,8 @@ def make_md5crypt_mask_step(gen, batch: int, hit_capacity: int = 64):
 
 
 def make_md5crypt_wordlist_step(gen, word_batch: int,
-                                hit_capacity: int = 64):
+                                hit_capacity: int = 64,
+                                magic: bytes = b"$1$"):
     from dprf_tpu.ops.rules_pipeline import expand_rules
 
     B, Lw = word_batch, gen.max_len
@@ -176,7 +183,7 @@ def make_md5crypt_wordlist_step(gen, word_batch: int,
         lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
         base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
         cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, Lw)
-        digest = md5crypt_digest_batch(cw, cl, salt, salt_len)
+        digest = md5crypt_digest_batch(cw, cl, salt, salt_len, magic)
         found = cmp_ops.compare_single(digest, target) & cv
         return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
                                     hit_capacity)
@@ -185,7 +192,8 @@ def make_md5crypt_wordlist_step(gen, word_batch: int,
 
 
 def make_sharded_md5crypt_mask_step(gen, mesh, batch_per_device: int,
-                                    hit_capacity: int = 64):
+                                    hit_capacity: int = 64,
+                                    magic: bytes = b"$1$"):
     from jax.sharding import PartitionSpec as P
 
     from dprf_tpu.parallel.mesh import SHARD_AXIS
@@ -203,7 +211,8 @@ def make_sharded_md5crypt_mask_step(gen, mesh, batch_per_device: int,
         offset = (dev * B).astype(jnp.int32)
         cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
         lens = jnp.full((B,), length, jnp.int32)
-        digest = md5crypt_digest_batch(cand, lens, salt, salt_len)
+        digest = md5crypt_digest_batch(cand, lens, salt, salt_len,
+                                       magic)
         lane_global = offset + jnp.arange(B, dtype=jnp.int32)
         found = cmp_ops.compare_single(digest, target) & \
             (lane_global < n_valid)
@@ -254,7 +263,8 @@ class Md5cryptMaskWorker(PhpassMaskWorker):
         self.hit_capacity, self.oracle = hit_capacity, oracle
         self.batch = self.stride = batch
         self._targs = _md5crypt_targs(self.targets)
-        self.step = make_md5crypt_mask_step(gen, batch, hit_capacity)
+        self.step = make_md5crypt_mask_step(
+            gen, batch, hit_capacity, magic=engine.magic)
 
 
 class Md5cryptWordlistWorker(PhpassWordlistWorker):
@@ -267,8 +277,9 @@ class Md5cryptWordlistWorker(PhpassWordlistWorker):
         self.word_batch = max(1, batch // gen.n_rules)
         self.stride = self.word_batch * gen.n_rules
         self._targs = _md5crypt_targs(self.targets)
-        self.step = make_md5crypt_wordlist_step(gen, self.word_batch,
-                                                hit_capacity)
+        self.step = make_md5crypt_wordlist_step(
+            gen, self.word_batch, hit_capacity,
+            magic=engine.magic)
 
 
 class ShardedMd5cryptMaskWorker(ShardedPhpassMaskWorker):
@@ -282,7 +293,8 @@ class ShardedMd5cryptMaskWorker(ShardedPhpassMaskWorker):
         self.batch = self.stride = mesh.devices.size * batch_per_device
         self._targs = _md5crypt_targs(self.targets)
         self.step = make_sharded_md5crypt_mask_step(
-            gen, mesh, batch_per_device, hit_capacity)
+            gen, mesh, batch_per_device, hit_capacity,
+            magic=engine.magic)
 
 
 @register("md5crypt", device="jax")
@@ -307,3 +319,18 @@ class JaxMd5cryptEngine(Md5cryptEngine):
             self, gen, targets, mesh,
             batch_per_device=min(batch_per_device, 1 << 12),
             hit_capacity=hit_capacity, oracle=oracle)
+
+@register("apr1", device="jax")
+@register("apache-md5", device="jax")
+class JaxApr1Engine(JaxMd5cryptEngine):
+    """Apache $apr1$ (htpasswd; hashcat 1600) on the md5crypt device
+    pipeline: the magic is a trace-time constant of the step, so the
+    only difference from $1$ is six context bytes instead of three.
+    Parsing comes from the CPU Apr1Engine."""
+
+    name = "apr1"
+    magic = b"$apr1$"
+
+    def parse_target(self, text: str):
+        from dprf_tpu.engines.cpu.engines import Apr1Engine
+        return Apr1Engine().parse_target(text)

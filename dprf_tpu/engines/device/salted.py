@@ -36,15 +36,19 @@ from dprf_tpu.runtime.worker import (Hit, CpuWorker, word_cover_range,
 from dprf_tpu.runtime.workunit import WorkUnit
 
 def _salted_concat(cand, length: int, salt, salt_len, order: str,
-                   batch: int):
-    """cand uint8[B, L] + salt uint8[SALT_MAX] (salt_len valid) ->
-    (bytes uint8[B, L + SALT_MAX], lengths int32[B])."""
-    width = length + SALT_MAX
+                   batch: int, salt_width: int = SALT_MAX):
+    """cand uint8[B, L] + salt uint8[salt_width] (salt_len valid) ->
+    (bytes uint8[B, L + salt_width], lengths int32[B]).  `salt_width`
+    is the engine's static salt-buffer width -- SALT_MAX for the
+    generic hexdigest:salt modes, 4 for MSSQL's fixed salt, so widened
+    candidates don't pay a 32-byte buffer reservation against the
+    single-block limit."""
+    width = length + salt_width
     pos = jnp.arange(width, dtype=jnp.int32)[None, :]
     if order == "ps":
         out = jnp.zeros((batch, width), jnp.uint8).at[:, :length].set(cand)
-        sidx = jnp.clip(pos - length, 0, SALT_MAX - 1)
-        svals = jnp.broadcast_to(salt[None, :], (batch, SALT_MAX))
+        sidx = jnp.clip(pos - length, 0, salt_width - 1)
+        svals = jnp.broadcast_to(salt[None, :], (batch, salt_width))
         out = jnp.where(pos < length, out,
                         jnp.take_along_axis(svals, sidx, axis=1))
     else:
@@ -52,7 +56,7 @@ def _salted_concat(cand, length: int, salt, salt_len, order: str,
         cidx = jnp.clip(pos - salt_len, 0, width - 1)
         cshift = jnp.take_along_axis(cpad, cidx, axis=1)
         svals = jnp.broadcast_to(
-            jnp.pad(salt, (0, width - SALT_MAX))[None, :], (batch, width))
+            jnp.pad(salt, (0, width - salt_width))[None, :], (batch, width))
         out = jnp.where(pos < salt_len, svals, cshift)
     return out, jnp.full((batch,), length, jnp.int32) + salt_len
 
@@ -63,12 +67,17 @@ def make_salted_mask_step(engine, gen, batch: int, order: str,
     target uint32[W]) -> (count, lanes, _)."""
     flat = gen.flat_charsets
     length = gen.length
+    pre = engine.pre_salt
+    mult = engine.length_multiplier
+    sw = engine.salt_width
 
     @jax.jit
     def step(base_digits, n_valid, salt, salt_len, target):
         cand = gen.decode_batch(base_digits, flat, batch)
-        byts, lengths = _salted_concat(cand, length, salt, salt_len,
-                                       order, batch)
+        if pre is not None:
+            cand = pre(cand)
+        byts, lengths = _salted_concat(cand, length * mult, salt,
+                                       salt_len, order, batch, sw)
         words = engine.pack_varlen(byts, lengths)
         digest = engine.digest_packed(words)
         found = cmp_ops.compare_single(digest, target)
@@ -92,6 +101,9 @@ def make_salted_wordlist_step(engine, gen, word_batch: int, order: str,
     words_dev = jnp.asarray(words_np)
     lens_dev = jnp.asarray(lens_np)
     rules = gen.rules
+    pre = engine.pre_salt
+    mult = engine.length_multiplier
+    sw = engine.salt_width
 
     @jax.jit
     def step(w0, n_valid_words, salt, salt_len, target):
@@ -99,21 +111,25 @@ def make_salted_wordlist_step(engine, gen, word_batch: int, order: str,
         lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
         base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
         cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
+        if pre is not None:
+            cw = pre(cw)
+            cl = cl * mult
+        Le = L * mult
         RB = cw.shape[0]
-        width = L + SALT_MAX
+        width = Le + sw
         pos = jnp.arange(width, dtype=jnp.int32)[None, :]
         if order == "ps":
-            out = jnp.zeros((RB, width), jnp.uint8).at[:, :L].set(cw)
-            sidx = jnp.clip(pos - cl[:, None], 0, SALT_MAX - 1)
-            svals = jnp.broadcast_to(salt[None, :], (RB, SALT_MAX))
+            out = jnp.zeros((RB, width), jnp.uint8).at[:, :Le].set(cw)
+            sidx = jnp.clip(pos - cl[:, None], 0, sw - 1)
+            svals = jnp.broadcast_to(salt[None, :], (RB, sw))
             out = jnp.where(pos < cl[:, None], out,
                             jnp.take_along_axis(svals, sidx, axis=1))
         else:
-            cpad = jnp.zeros((RB, width), jnp.uint8).at[:, :L].set(cw)
+            cpad = jnp.zeros((RB, width), jnp.uint8).at[:, :Le].set(cw)
             cidx = jnp.clip(pos - salt_len, 0, width - 1)
             out = jnp.where(
                 pos < salt_len,
-                jnp.broadcast_to(jnp.pad(salt, (0, width - SALT_MAX))[None, :],
+                jnp.broadcast_to(jnp.pad(salt, (0, width - sw))[None, :],
                                  (RB, width)),
                 jnp.take_along_axis(cpad, cidx, axis=1))
         lengths = cl + salt_len
@@ -138,13 +154,18 @@ def make_sharded_salted_mask_step(engine, gen, mesh, batch_per_device: int,
     flat = gen.flat_charsets
     length = gen.length
     B = batch_per_device
+    pre = engine.pre_salt
+    mult = engine.length_multiplier
+    sw = engine.salt_width
 
     def shard_fn(base_digits, n_valid, salt, salt_len, target):
         dev = lax.axis_index(SHARD_AXIS)
         offset = (dev * B).astype(jnp.int32)
         cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
-        byts, lengths = _salted_concat(cand, length, salt, salt_len,
-                                       order, B)
+        if pre is not None:
+            cand = pre(cand)
+        byts, lengths = _salted_concat(cand, length * mult, salt,
+                                       salt_len, order, B, sw)
         digest = engine.digest_packed(engine.pack_varlen(byts, lengths))
         lane_global = offset + jnp.arange(B, dtype=jnp.int32)
         found = cmp_ops.compare_single(digest, target) & \
@@ -196,10 +217,15 @@ class _SaltedWorkerBase:
         else entirely (zip2's per-target compiled steps over a 10-byte
         auth digest) override this alongside _invoke."""
         dt = "<u4" if self.engine.little_endian else ">u4"
+        width = getattr(self.engine, "salt_width", self.SALT_WIDTH)
         targs = []
         for t in self.targets:
             salt = t.params["salt"]
-            buf = np.zeros((self.SALT_WIDTH,), np.uint8)
+            if len(salt) > width:
+                raise ValueError(
+                    f"{self.engine.name}: salt of {len(salt)} bytes "
+                    f"exceeds the engine's {width}-byte buffer")
+            buf = np.zeros((width,), np.uint8)
             buf[:len(salt)] = np.frombuffer(salt, np.uint8)
             targs.append((
                 jnp.asarray(buf), jnp.int32(len(salt)),
@@ -381,6 +407,16 @@ class _SaltedDeviceMixin:
 
     salted = True
     order: str
+    #: optional device transform of the candidate bytes BEFORE the salt
+    #: is appended (mssql's UTF-16LE widening); uint8[B, L] ->
+    #: uint8[B, length_multiplier * L] with every valid byte mapped to
+    #: `length_multiplier` output bytes.
+    pre_salt = None
+    length_multiplier = 1
+    #: static device salt-buffer width; engines with a fixed short salt
+    #: (MSSQL: 4 bytes) narrow it so the buffer reservation doesn't
+    #: count against the single-block limit.
+    salt_width = SALT_MAX
     #: leave headroom for any parseable salt in the single block;
     #: the worker factories additionally check ACTUAL salts.  Set per
     #: class in _register_device from the base engine's block limit.
@@ -423,7 +459,8 @@ class _SaltedDeviceMixin:
     make_sharded_combinator_worker = None
 
     def _check_lengths(self, cand_len: int, targets) -> None:
-        worst = cand_len + max(len(t.params["salt"]) for t in targets)
+        worst = (cand_len * self.length_multiplier
+                 + max(len(t.params["salt"]) for t in targets))
         if worst > self._block_limit:
             raise ValueError(
                 f"candidate+salt can reach {worst} bytes, over the "
@@ -464,3 +501,96 @@ class JaxPostgresEngine(_SaltedDeviceMixin, JaxMd5Engine):
     def parse_target(self, text: str):
         from dprf_tpu.engines.cpu.engines import PostgresMd5Engine
         return PostgresMd5Engine().parse_target(text)
+
+
+def _register_ldap_salted():
+    """LDAP {SSHA}/{SSHA512}/{SMD5} (hashcat 111/1711): the salted
+    'ps' device machinery with the LDAP base64 line format -- parsing
+    delegates to the CPU engines (same pattern as postgres)."""
+    from dprf_tpu.engines.cpu.engines import (LdapSmd5Engine,
+                                              LdapSsha512Engine,
+                                              LdapSshaEngine)
+
+    for names, base_cls, cpu_cls in (
+            (("ldap-ssha", "ssha"), JaxSha1Engine, LdapSshaEngine),
+            (("ldap-ssha512", "ssha512"), JaxSha512Engine,
+             LdapSsha512Engine),
+            (("ldap-smd5",), JaxMd5Engine, LdapSmd5Engine)):
+        def make_parse(cpu_cls):
+            def parse_target(self, text: str):
+                return cpu_cls().parse_target(text)
+            return parse_target
+
+        cls = type(f"Jax{cpu_cls.__name__}",
+                   (_SaltedDeviceMixin, base_cls),
+                   {"name": names[0], "order": "ps",
+                    "__doc__": cpu_cls.__doc__ + " (device)",
+                    "parse_target": make_parse(cpu_cls),
+                    "max_candidate_len":
+                        base_cls._block_limit - SALT_MAX})
+        for n in names:
+            register(n, device="jax")(cls)
+
+
+_register_ldap_salted()
+
+
+class _MssqlDeviceMixin(_SaltedDeviceMixin):
+    """MSSQL family: the salted 'ps' machinery with a pre-salt
+    UTF-16LE widening of the candidate (and an ASCII uppercase first
+    for 2000's case-insensitive digest).  The 4-byte salt is appended
+    to the WIDENED bytes, unwidened -- which is why this is a pre-salt
+    transform, not the engines' widen_utf16 packing flag (that would
+    widen the salt too)."""
+
+    order = "ps"
+    length_multiplier = 2
+    #: MSSQL salts are exactly 4 bytes; a narrow buffer keeps the
+    #: widened candidate + salt inside the single block (2*25+4 <= 55).
+    salt_width = 4
+    _upper = False
+
+    def pre_salt(self, cand):
+        from dprf_tpu.ops import pack as pack_ops
+        if self._upper:
+            cand = jnp.where((cand >= 97) & (cand <= 122),
+                             cand - 32, cand).astype(jnp.uint8)
+        return pack_ops.utf16le_widen(cand)
+
+
+@register("mssql2000", device="jax")
+class JaxMssql2000Engine(_MssqlDeviceMixin, JaxSha1Engine):
+    """MSSQL 2000 (hashcat 131; device)."""
+
+    name = "mssql2000"
+    _upper = True
+    max_candidate_len = (55 - 4) // 2
+
+    def parse_target(self, text: str):
+        from dprf_tpu.engines.cpu.engines import Mssql2000Engine
+        return Mssql2000Engine().parse_target(text)
+
+
+@register("mssql2005", device="jax")
+class JaxMssql2005Engine(_MssqlDeviceMixin, JaxSha1Engine):
+    """MSSQL 2005 (hashcat 132; device)."""
+
+    name = "mssql2005"
+    max_candidate_len = (55 - 4) // 2
+
+    def parse_target(self, text: str):
+        from dprf_tpu.engines.cpu.engines import Mssql2005Engine
+        return Mssql2005Engine().parse_target(text)
+
+
+@register("mssql2012", device="jax")
+@register("mssql2014", device="jax")
+class JaxMssql2012Engine(_MssqlDeviceMixin, JaxSha512Engine):
+    """MSSQL 2012/2014 (hashcat 1731; device)."""
+
+    name = "mssql2012"
+    max_candidate_len = (111 - 4) // 2
+
+    def parse_target(self, text: str):
+        from dprf_tpu.engines.cpu.engines import Mssql2012Engine
+        return Mssql2012Engine().parse_target(text)

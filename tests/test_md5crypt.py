@@ -157,3 +157,59 @@ def test_cpu_reference_handles_long_passwords():
         msg += inter if i & 1 else pw
         inter = hashlib.md5(msg).digest()
     assert md5crypt_raw(pw, salt) == inter
+
+
+# ---------------- apr1 (Apache $apr1$; hashcat 1600) ----------------
+
+APR1_VECTORS = [
+    # openssl passwd -apr1 -salt <salt> <pw>
+    ("$apr1$myQ9PyAF$L5YLQ39NLlrY7ONcZW.XQ/", b"hello"),
+    ("$apr1$saltsalt$GPKuzxa7vsYnZ2yysFVga.", b"secret12"),
+]
+
+
+@pytest.mark.parametrize("line,pw", APR1_VECTORS)
+def test_apr1_cpu_vectors(line, pw):
+    eng = get_engine("apr1")
+    t = eng.parse_target(line)
+    assert eng.hash_batch([pw], t.params)[0] == t.digest
+    # magic matters: the same inputs under $1$ give a different digest
+    assert md5crypt_raw(pw, t.params["salt"]) != t.digest
+
+
+def test_apr1_device_matches_cpu():
+    import random
+
+    from dprf_tpu.engines.device.md5crypt import md5crypt_digest_batch
+
+    rnd = random.Random(1600)
+    salt = b"apr1salt"
+    cands = [bytes(rnd.randrange(1, 256) for _ in range(rnd.randrange(1, 15)))
+             for _ in range(6)]
+    L = max(len(c) for c in cands)
+    buf = np.zeros((len(cands), L), np.uint8)
+    lens = np.zeros((len(cands),), np.int32)
+    for i, c in enumerate(cands):
+        buf[i, :len(c)] = np.frombuffer(c, np.uint8)
+        lens[i] = len(c)
+    sbuf = np.zeros((8,), np.uint8)
+    sbuf[:len(salt)] = np.frombuffer(salt, np.uint8)
+    words = np.asarray(md5crypt_digest_batch(
+        jnp.asarray(buf), jnp.asarray(lens), jnp.asarray(sbuf),
+        jnp.int32(len(salt)), b"$apr1$"))
+    for i, c in enumerate(cands):
+        want = md5crypt_raw(c, salt, b"$apr1$")
+        got = words[i].astype("<u4").tobytes()
+        assert got == want, c
+
+
+def test_apr1_mask_worker_finds_planted():
+    from dprf_tpu.engines.cpu.md5crypt import encode_digest
+
+    gen = MaskGenerator("?d?d?d")
+    raw = md5crypt_raw(b"407", b"saltsalt", b"$apr1$")
+    dev = get_engine("apr1", device="jax")
+    t = dev.parse_target("$apr1$saltsalt$" + encode_digest(raw))
+    w = dev.make_mask_worker(gen, [t], batch=256, hit_capacity=8)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, b"407")]

@@ -158,3 +158,159 @@ def test_postgres_engine():
                              oracle=cpu)
     hits = w.process(WorkUnit(0, 0, gen.keyspace))
     assert [h.plaintext for h in hits] == [b"fox"]
+
+
+# ---------------- LDAP schemes ({SSHA}/{SHA}/... ) ----------------
+
+def _ldap_line(scheme, algo, plain, salt=b""):
+    import base64
+    return ("{%s}" % scheme) + base64.b64encode(
+        hashlib.new(algo, plain + salt).digest() + salt).decode()
+
+
+@pytest.mark.parametrize("name,scheme,algo,salted", [
+    ("ldap-ssha", "SSHA", "sha1", True),       # hashcat 111
+    ("ldap-ssha512", "SSHA512", "sha512", True),  # hashcat 1711
+    ("ldap-smd5", "SMD5", "md5", True),
+    ("ldap-sha", "SHA", "sha1", False),        # hashcat 101
+    ("ldap-md5", "MD5", "md5", False),
+])
+def test_ldap_parse_and_oracle(name, scheme, algo, salted):
+    salt = b"NaCl" if salted else b""
+    line = _ldap_line(scheme, algo, b"hunter2", salt)
+    cpu = get_engine(name)
+    t = cpu.parse_target(line)
+    assert cpu.hash_batch([b"hunter2"], t.params)[0] == t.digest
+    if salted:
+        assert t.params["salt"] == salt
+    dev = get_engine(name, device="jax")
+    assert dev.parse_target(line).digest == t.digest
+
+
+def test_ldap_rejects_malformed():
+    cpu = get_engine("ldap-ssha")
+    with pytest.raises(ValueError):
+        cpu.parse_target("{SSHA}!!!notbase64!!!")
+    with pytest.raises(ValueError):
+        cpu.parse_target("{SSHA}" + "QUJD")       # shorter than digest
+    with pytest.raises(ValueError):
+        get_engine("ldap-sha").parse_target(
+            _ldap_line("SHA", "sha1", b"x", b"saltbytes"))  # salt on unsalted
+
+
+def test_ldap_ssha_mask_worker_end_to_end():
+    dev = get_engine("ldap-ssha", "jax")
+    cpu = get_engine("ldap-ssha", "cpu")
+    gen = MaskGenerator("?l?l?l")
+    t = dev.parse_target(_ldap_line("SSHA", "sha1", b"fox", b"abcd1234"))
+    w = dev.make_mask_worker(gen, [t], batch=1024, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, b"fox")]
+
+
+def test_ldap_sha_multi_target_fast_path():
+    """{SHA} rides the unsalted fast path: a 3-target list resolves in
+    one sweep with per-target indices."""
+    dev = get_engine("ldap-sha", "jax")
+    cpu = get_engine("ldap-sha", "cpu")
+    gen = MaskGenerator("?d?d?d")
+    secrets = [b"042", b"700", b"999"]
+    targets = [dev.parse_target(_ldap_line("SHA", "sha1", s))
+               for s in secrets]
+    w = dev.make_mask_worker(gen, targets, batch=1024, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert {(h.target_index, h.plaintext) for h in hits} == \
+        {(i, s) for i, s in enumerate(secrets)}
+
+
+# ---------------- MSSQL family (hashcat 131/132/1731) ----------------
+
+def _wide(b):
+    return bytes(x for ch in b for x in (ch, 0))
+
+
+MSSQL_SALT = bytes.fromhex("1a2b3c4d")
+
+
+def _mssql_line(version, pw):
+    if version == 2000:
+        cs = hashlib.sha1(_wide(pw) + MSSQL_SALT).hexdigest()
+        up = hashlib.sha1(_wide(pw.upper()) + MSSQL_SALT).hexdigest()
+        return "0x0100" + MSSQL_SALT.hex() + cs + up
+    if version == 2005:
+        return "0x0100" + MSSQL_SALT.hex() + \
+            hashlib.sha1(_wide(pw) + MSSQL_SALT).hexdigest()
+    return "0x0200" + MSSQL_SALT.hex() + \
+        hashlib.sha512(_wide(pw) + MSSQL_SALT).hexdigest()
+
+
+@pytest.mark.parametrize("name,version,planted,cracks_as", [
+    ("mssql2005", 2005, b"fox", b"fox"),
+    ("mssql2012", 2012, b"hen", b"hen"),
+    # 2000 is case-insensitive: the stored digest is over UPPER(pass),
+    # so a lowercase sweep finds the mixed-case original
+    ("mssql2000", 2000, b"Fox", b"fox"),
+])
+def test_mssql_mask_worker_end_to_end(name, version, planted, cracks_as):
+    cpu = get_engine(name)
+    dev = get_engine(name, "jax")
+    t = cpu.parse_target(_mssql_line(version, planted))
+    assert cpu.hash_batch([cracks_as], t.params)[0] == t.digest
+    gen = MaskGenerator("?l?l?l")
+    w = dev.make_mask_worker(gen, [t], batch=2048, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == \
+        [(0, cracks_as)]
+
+
+def test_mssql_wordlist_worker_with_rules():
+    from dprf_tpu.rules.parser import parse_rule
+
+    cpu = get_engine("mssql2005")
+    dev = get_engine("mssql2005", "jax")
+    words = [b"alpha", b"fox", b"delta"]
+    rules = [parse_rule(":"), parse_rule("$1")]
+    gen = WordlistRulesGenerator(words, rules, max_len=8)
+    t = cpu.parse_target(_mssql_line(2005, b"fox1"))
+    w = dev.make_wordlist_worker(gen, [t], batch=64, hit_capacity=8,
+                                 oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [b"fox1"]
+
+
+def test_mssql_parse_rejects_malformed():
+    cpu = get_engine("mssql2005")
+    with pytest.raises(ValueError):
+        cpu.parse_target("0x0200" + "00" * 24)          # wrong version tag
+    with pytest.raises(ValueError):
+        cpu.parse_target("0x0100" + "zz" * 24)          # bad hex
+    with pytest.raises(ValueError):
+        cpu.parse_target("0x0100" + "aabbccdd" + "ab")  # short digest
+
+
+def test_mssql_long_candidates_fit_single_block():
+    """12+-char candidates must trace: the widened bytes + 4-byte salt
+    (2L+4 <= 55) fit the block because MSSQL's salt buffer is 4 bytes,
+    not the generic 32-byte reservation."""
+    pw = b"abcdefghijkl"                       # 12 chars -> 28 bytes
+    line = _mssql_line(2005, pw)
+    cpu = get_engine("mssql2005")
+    dev = get_engine("mssql2005", "jax")
+    t = cpu.parse_target(line)
+    gen = MaskGenerator("?l" * 12)
+    w = dev.make_mask_worker(gen, [t], batch=64, hit_capacity=8,
+                             oracle=cpu)
+    w.process(WorkUnit(0, 0, 64))              # traces at length 12
+
+
+def test_mssql_cross_version_lines_rejected():
+    """A 2000-format line (two digests) fed to the 2005 engine must
+    error, not silently crack against the upper-cased digest (and vice
+    versa)."""
+    with pytest.raises(ValueError, match="wrong MSSQL version"):
+        get_engine("mssql2005").parse_target(_mssql_line(2000, b"x"))
+    with pytest.raises(ValueError, match="wrong MSSQL version"):
+        get_engine("mssql2000").parse_target(_mssql_line(2005, b"x"))
