@@ -76,6 +76,7 @@ def _ensure_imported(device: str) -> None:
             import dprf_tpu.engines.device.rar5     # noqa: F401
             import dprf_tpu.engines.device.ethereum  # noqa: F401
             import dprf_tpu.engines.device.sha3     # noqa: F401
+            import dprf_tpu.engines.device.descrypt  # noqa: F401
         except ModuleNotFoundError as e:
             # Translate only a missing engines.device package into a friendly
             # error; import failures *inside* it should surface as-is.

@@ -338,6 +338,75 @@ class Mssql2005Engine(_MssqlCpuBase):
     max_candidate_len = (55 - 4) // 2
 
 
+def descrypt_encode(digest8: bytes) -> str:
+    """8-byte descrypt ciphertext -> the 11 itoa64 chars of a crypt(3)
+    line: the 64 bits MSB-first in 6-bit groups (NOT phpass's
+    little-endian packing), 2 zero bits appended."""
+    from dprf_tpu.engines.cpu.phpass import ITOA64
+    bits = [(digest8[i // 8] >> (7 - i % 8)) & 1 for i in range(64)]
+    bits += [0, 0]
+    out = []
+    for g in range(11):
+        v = 0
+        for b in bits[6 * g:6 * g + 6]:
+            v = (v << 1) | b
+        out.append(ITOA64[v])
+    return "".join(out)
+
+
+def descrypt_decode(text11: str) -> bytes:
+    """11 itoa64 chars -> the 8-byte ciphertext (inverse of
+    descrypt_encode)."""
+    from dprf_tpu.engines.cpu.phpass import ITOA64
+    bits = []
+    for ch in text11:
+        v = ITOA64.index(ch)
+        bits += [(v >> k) & 1 for k in range(5, -1, -1)]
+    if bits[64] or bits[65]:
+        raise ValueError("descrypt digest has nonzero trailing bits")
+    return bytes(sum(bits[8 * k + j] << (7 - j) for j in range(8))
+                 for k in range(8))
+
+
+@register("descrypt")
+@register("des-crypt")
+@register("unix-crypt")
+class DescryptEngine(HashEngine):
+    """Traditional DES crypt(3) (hashcat 1500): 25 chained DES
+    encryptions of the zero block, E expansion perturbed by the 12-bit
+    salt, key = low 7 bits of the first 8 password bytes.  Validated
+    against the system crypt()."""
+
+    name = "descrypt"
+    digest_size = 8
+    salted = True
+    #: crypt(3) silently truncates at 8; the workers cap candidates so
+    #: every reported plaintext hashes to the target as-is
+    max_candidate_len = 8
+
+    def parse_target(self, text: str) -> Target:
+        from dprf_tpu.engines.cpu.phpass import ITOA64
+        t = text.strip()
+        if len(t) != 13:
+            raise ValueError(f"descrypt wants 13-char salt+digest "
+                             f"lines, got {len(t)}: {text!r}")
+        try:
+            salt = ITOA64.index(t[0]) | (ITOA64.index(t[1]) << 6)
+            digest = descrypt_decode(t[2:])
+        except ValueError as e:
+            raise ValueError(f"bad descrypt line {text!r}: {e}")
+        return Target(raw=t, digest=digest,
+                      params={"salt": salt, "salt_text": t[:2]})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        from dprf_tpu.ops.des import des_crypt25, descrypt_key8
+        if params is None or "salt" not in params:
+            raise ValueError("descrypt needs target params (salt)")
+        salt = params["salt"]
+        return [des_crypt25(descrypt_key8(c), salt) for c in candidates]
+
+
 @register("mssql2012")
 @register("mssql2014")
 class Mssql2012Engine(_MssqlCpuBase):

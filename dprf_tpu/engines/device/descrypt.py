@@ -1,0 +1,199 @@
+"""Device descrypt engine (traditional crypt(3); hashcat 1500):
+bitslice DES, like LM, but with crypt's two twists --
+
+- the salt perturbs the E expansion.  In bitslice form E is a static
+  row-take, so each DISTINCT salt is free re-wiring at trace time: the
+  step groups targets by salt and unrolls one 25x16-round circuit per
+  distinct salt, with every same-salt target folded into that circuit's
+  compare at 64 ops apiece (the LM multi-target shape).  One compiled
+  step, one keyspace sweep, serves the whole hashlist -- descrypt has
+  only 4096 salts, so shadow files collide constantly.
+- 25 chained encryptions of the zero block: the end-of-encryption
+  half-swap feeds the next iteration (FP o IP cancels between
+  iterations), so each circuit is one nested fori_loop over the single
+  traced round body.
+
+Key material is (password byte << 1) per crypt(3); candidates cap at
+8 bytes so every reported plaintext hashes to the target exactly
+(crypt's silent truncation never manufactures 'extra' cracks).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.base import Target
+from dprf_tpu.engines.cpu.engines import DescryptEngine
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops.des import descrypt_bitslice
+from dprf_tpu.engines.device.lm import (byte_planes, found_lanes,
+                                        match_mask, target_bits)
+from dprf_tpu.runtime.worker import (DeviceWordlistWorker,
+                                     MaskWorkerBase)
+
+
+def _key_bytes(cand: jnp.ndarray) -> jnp.ndarray:
+    """uint8[B, L<=8] candidate bytes -> uint8[B, 8] DES key bytes
+    ((c << 1) & 0xFF, zero-padded)."""
+    B, L = cand.shape
+    key = jnp.zeros((B, 8), jnp.uint8)
+    return key.at[:, :min(L, 8)].set(
+        jnp.left_shift(cand[:, :8], 1))
+
+
+def _salt_groups(targets: Sequence[Target]):
+    """[(salt, [(orig_ti, target_bits), ...]), ...] -- one bitslice
+    circuit per distinct salt, all its targets folded into the
+    compare."""
+    groups: dict[int, list] = {}
+    for ti, t in enumerate(targets):
+        groups.setdefault(t.params["salt"], []).append(
+            (ti, target_bits(t.digest)))
+    return sorted(groups.items())
+
+
+def _fold_groups(kplanes, groups, n_lanes: int):
+    """Run one circuit per salt group over the shared key planes and
+    fold every target's compare; returns (found_any, tfirst) with
+    tfirst carrying ORIGINAL target indices."""
+    found_any = jnp.zeros((n_lanes,), jnp.bool_)
+    tfirst = jnp.zeros((n_lanes,), jnp.int32)
+    for salt, members in groups:
+        cipher = descrypt_bitslice(kplanes, salt)
+        for ti, tb in members:
+            f = found_lanes(match_mask(cipher, tb), n_lanes)
+            tfirst = jnp.where(f & ~found_any, jnp.int32(ti), tfirst)
+            found_any = found_any | f
+    return found_any, tfirst
+
+
+def make_descrypt_mask_step(gen, targets: Sequence[Target], batch: int,
+                            hit_capacity: int = 64):
+    """step(base_digits, n_valid) -> (count, lanes, tpos); tpos carries
+    ORIGINAL target indices (the LM step contract)."""
+    if batch % 32:
+        raise ValueError("bitslice batch must be a multiple of 32")
+    if gen.length > 8:
+        raise ValueError(f"descrypt candidates cap at 8 bytes; mask "
+                         f"decodes to {gen.length}")
+    flat = gen.flat_charsets
+    groups = _salt_groups(targets)
+
+    @jax.jit
+    def step(base_digits, n_valid):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        kplanes = byte_planes(_key_bytes(cand))
+        found_any, tfirst = _fold_groups(kplanes, groups, batch)
+        valid = jnp.arange(batch, dtype=jnp.int32) < n_valid
+        return cmp_ops.compact_hits(found_any & valid, tfirst,
+                                    hit_capacity)
+
+    return step
+
+
+def make_descrypt_wordlist_step(gen, targets: Sequence[Target],
+                                word_batch: int, hit_capacity: int = 64):
+    from jax import lax
+
+    from dprf_tpu.ops.rules_pipeline import expand_rules
+
+    B, L = word_batch, gen.max_len
+    if L > 8:
+        raise ValueError("descrypt candidates cap at 8 bytes; set "
+                         "--max-len 8")
+    words_np, lens_np = gen.packed_words(pad_to=B,
+                                         min_size=gen.n_words + B - 1)
+    words_dev = jnp.asarray(words_np)
+    lens_dev = jnp.asarray(lens_np)
+    rules = gen.rules
+    groups = _salt_groups(targets)
+
+    @jax.jit
+    def step(w0, n_valid_words):
+        wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
+        lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
+        base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
+        cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
+        RB = cw.shape[0]
+        pad = (-RB) % 32
+        pos = jnp.arange(cw.shape[1], dtype=jnp.int32)
+        cw = jnp.where(pos[None, :] < cl[:, None], cw, 0)
+        cw = jnp.pad(cw, ((0, pad), (0, 0)))
+        kplanes = byte_planes(_key_bytes(cw))
+        found_any, tfirst = _fold_groups(kplanes, groups, RB + pad)
+        found = found_any[:RB] & cv
+        return cmp_ops.compact_hits(found, tfirst[:RB], hit_capacity)
+
+    return step
+
+
+class DescryptMaskWorker(MaskWorkerBase):
+    """The LM worker shape: one step, one sweep, tpos carries original
+    target indices."""
+
+    def __init__(self, engine, gen, targets, batch: int = 1 << 17,
+                 hit_capacity: int = 64, oracle=None):
+        self.engine = engine
+        self.gen = gen
+        self.targets = list(targets)
+        self.hit_capacity = hit_capacity
+        self.oracle = oracle
+        self.multi = len(self.targets) > 1
+        self._order = np.arange(max(1, len(self.targets)), dtype=np.int64)
+        batch = max(32, (batch // 32) * 32)
+        self.batch = self.stride = batch
+        self.step = make_descrypt_mask_step(gen, self.targets, batch,
+                                            hit_capacity)
+
+
+class DescryptWordlistWorker(DeviceWordlistWorker):
+    """DeviceWordlistWorker's machinery over the salt-grouped step
+    (skips _setup_targets -- tpos already carries original indices)."""
+
+    def __init__(self, engine, gen, targets, batch: int = 1 << 17,
+                 hit_capacity: int = 64, oracle=None):
+        self.engine = engine
+        self.gen = gen
+        self.targets = list(targets)
+        self.hit_capacity = hit_capacity
+        self.oracle = oracle
+        self.multi = len(self.targets) > 1
+        self._order = np.arange(max(1, len(self.targets)), dtype=np.int64)
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self.batch = batch
+        self.step = make_descrypt_wordlist_step(gen, self.targets,
+                                                self.word_batch,
+                                                hit_capacity)
+
+
+@register("descrypt", device="jax")
+@register("des-crypt", device="jax")
+@register("unix-crypt", device="jax")
+class JaxDescryptEngine(DescryptEngine):
+    """Device descrypt (see module docstring).  Parsing and the oracle
+    come from the CPU engine."""
+
+    little_endian = False
+    digest_words = 2
+
+    def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
+                         oracle=None):
+        return DescryptMaskWorker(self, gen, targets, batch=batch,
+                                  hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        return DescryptWordlistWorker(self, gen, targets, batch=batch,
+                                      hit_capacity=hit_capacity,
+                                      oracle=oracle)
+
+    make_sharded_mask_worker = None
+    make_sharded_wordlist_worker = None
+    make_combinator_worker = None
+    make_sharded_combinator_worker = None

@@ -33,14 +33,14 @@ def _upper(cand: jnp.ndarray) -> jnp.ndarray:
 
 
 def byte_planes(cand: jnp.ndarray) -> list:
-    """uint8[B, 7] (B a multiple of 32) -> 56 int32 planes, plane
+    """uint8[B, K] (B a multiple of 32) -> 8K int32 planes, plane
     8k+bit = byte k's bit (MSB first), lane j of word v = candidate
-    32v+j."""
-    B = cand.shape[0]
-    groups = cand.astype(jnp.int32).reshape(B // 32, 32, 7)
+    32v+j.  K is 7 for LM halves, 8 for descrypt keys."""
+    B, K = cand.shape
+    groups = cand.astype(jnp.int32).reshape(B // 32, 32, K)
     weights = jnp.left_shift(jnp.int32(1), jnp.arange(32, dtype=jnp.int32))
     planes = []
-    for k in range(7):
+    for k in range(K):
         for bit in range(8):
             vals = (groups[:, :, k] >> (7 - bit)) & 1
             # distinct bits: sum == bitwise or, and int32 wrap on the
@@ -51,6 +51,14 @@ def byte_planes(cand: jnp.ndarray) -> list:
 
 def target_bits(digest: bytes) -> list[int]:
     return [(digest[i // 8] >> (7 - i % 8)) & 1 for i in range(64)]
+
+
+def found_lanes(m, batch: int):
+    """int32 word match-mask -> bool[batch] per-lane mask (lane j of
+    word v = candidate 32v+j).  Shared by the LM and descrypt steps."""
+    lanebit = jnp.left_shift(jnp.int32(1), jnp.arange(32, dtype=jnp.int32))
+    return ((jnp.broadcast_to(m[:, None], (batch // 32, 32))
+             & lanebit) != 0).reshape(batch)
 
 
 def match_mask(cipher, tbits: list[int]):
@@ -86,14 +94,10 @@ def make_lm_mask_step(gen, targets: Sequence[Target], batch: int,
         cipher = des_encrypt_bitslice(
             key_planes_from_bytes7(byte_planes(cand7)),
             const_planes(LM_MAGIC))
-        lanebit = jnp.left_shift(
-            jnp.int32(1), jnp.arange(32, dtype=jnp.int32))
         found_any = jnp.zeros((batch,), jnp.bool_)
         tfirst = jnp.zeros((batch,), jnp.int32)
         for ti, tb in enumerate(tbits):
-            m = match_mask(cipher, tb)
-            f = ((jnp.broadcast_to(m[:, None], (batch // 32, 32))
-                  & lanebit) != 0).reshape(batch)
+            f = found_lanes(match_mask(cipher, tb), batch)
             tfirst = jnp.where(f & ~found_any, jnp.int32(ti), tfirst)
             found_any = found_any | f
         valid = jnp.arange(batch, dtype=jnp.int32) < n_valid
@@ -137,14 +141,10 @@ def make_lm_wordlist_step(gen, targets: Sequence[Target],
         cipher = des_encrypt_bitslice(
             key_planes_from_bytes7(byte_planes(cand7)),
             const_planes(LM_MAGIC))
-        lanebit = jnp.left_shift(
-            jnp.int32(1), jnp.arange(32, dtype=jnp.int32))
         found_any = jnp.zeros((RB + pad,), jnp.bool_)
         tfirst = jnp.zeros((RB + pad,), jnp.int32)
         for ti, tb in enumerate(tbits):
-            m = match_mask(cipher, tb)
-            f = ((jnp.broadcast_to(m[:, None], ((RB + pad) // 32, 32))
-                  & lanebit) != 0).reshape(RB + pad)
+            f = found_lanes(match_mask(cipher, tb), RB + pad)
             tfirst = jnp.where(f & ~found_any, jnp.int32(ti), tfirst)
             found_any = found_any | f
         found = found_any[:RB] & cv[:RB]

@@ -141,13 +141,11 @@ def _from_bits(bits: list[int]) -> bytes:
     return bytes(out)
 
 
-def des_encrypt(key8: bytes, block8: bytes) -> bytes:
-    """Scalar single-block DES encryption (oracle/test anchor)."""
-    rks = _key_schedule_bits(_to_bits(key8))
-    bits = _permute(_to_bits(block8), _IP)
-    l, r = bits[:32], bits[32:]
+def _rounds16(l, r, rks, e_table):
+    """The 16 Feistel rounds (shared by des_encrypt and des_crypt25;
+    descrypt passes a salt-perturbed E table)."""
     for rk in rks:
-        e = _permute(r, _E)
+        e = _permute(r, e_table)
         x = [a ^ b for a, b in zip(e, rk)]
         s_out = []
         for box in range(8):
@@ -159,6 +157,14 @@ def des_encrypt(key8: bytes, block8: bytes) -> bytes:
             s_out += [(v >> 3) & 1, (v >> 2) & 1, (v >> 1) & 1, v & 1]
         f = _permute(s_out, _P)
         l, r = r, [a ^ b for a, b in zip(l, f)]
+    return l, r
+
+
+def des_encrypt(key8: bytes, block8: bytes) -> bytes:
+    """Scalar single-block DES encryption (oracle/test anchor)."""
+    rks = _key_schedule_bits(_to_bits(key8))
+    bits = _permute(_to_bits(block8), _IP)
+    l, r = _rounds16(bits[:32], bits[32:], rks, _E)
     return _from_bits(_permute(r + l, _FP))
 
 
@@ -231,6 +237,42 @@ def sbox_planes(box: int, six):
     return outs
 
 
+def _bitslice_schedule(key_planes, as_row):
+    """Static key schedule -> one stacked [16, 48, Bv] round-key array
+    (pure re-wiring at trace time)."""
+    import jax.numpy as jnp
+
+    kp = [key_planes[t - 1] for t in _PC1]
+    c, d = kp[:28], kp[28:]
+    rks = []
+    for sh in _SHIFTS:
+        c = c[sh:] + c[:sh]
+        d = d[sh:] + d[:sh]
+        rks.append(jnp.stack([as_row((c + d)[t - 1]) for t in _PC2]))
+    return jnp.stack(rks)
+
+
+def _bitslice_round_body(rk_all, e_table, as_row):
+    """One traced Feistel round over [32, Bv] half planes; `e_table`
+    is the (possibly salt-perturbed) E expansion as static row-takes."""
+    import jax.numpy as jnp
+
+    e_idx = jnp.asarray(np.asarray(e_table, np.int32) - 1)
+    p_idx = jnp.asarray(np.asarray(_P, np.int32) - 1)
+
+    def round_body(i, carry):
+        l, r = carry
+        x = r[e_idx] ^ rk_all[i]                 # [48, Bv]
+        s_out = []
+        for box in range(8):
+            s_out += sbox_planes(box, [x[6 * box + k]
+                                       for k in range(6)])
+        f = jnp.stack([as_row(p) for p in s_out])[p_idx]
+        return r, l ^ f
+
+    return round_body
+
+
 def des_encrypt_bitslice(key_planes, data_planes):
     """Bitslice DES: key_planes[64], data_planes[64] (int32 planes or
     0/-1 python constants, FIPS bit order 1..64) -> cipher planes[64].
@@ -257,36 +299,13 @@ def des_encrypt_bitslice(key_planes, data_planes):
             return jnp.full((Bv,), jnp.int32(p))
         return p
 
-    def perm_idx(table):
-        return np.asarray(table, np.int32) - 1
-
-    # key schedule: static wiring -> one stacked [16, 48, Bv] array
-    kp = [key_planes[t - 1] for t in _PC1]
-    c, d = kp[:28], kp[28:]
-    rks = []
-    for sh in _SHIFTS:
-        c = c[sh:] + c[:sh]
-        d = d[sh:] + d[:sh]
-        rks.append(jnp.stack([as_row((c + d)[t - 1]) for t in _PC2]))
-    rk_all = jnp.stack(rks)                      # [16, 48, Bv]
+    rk_all = _bitslice_schedule(key_planes, as_row)
 
     bits = [data_planes[t - 1] for t in _IP]
     l = jnp.stack([as_row(p) for p in bits[:32]])   # [32, Bv]
     r = jnp.stack([as_row(p) for p in bits[32:]])
 
-    e_idx = jnp.asarray(perm_idx(_E))
-    p_idx = jnp.asarray(perm_idx(_P))
-
-    def round_body(i, carry):
-        l, r = carry
-        x = r[e_idx] ^ rk_all[i]                 # [48, Bv]
-        s_out = []
-        for box in range(8):
-            s_out += sbox_planes(box, [x[6 * box + k]
-                                       for k in range(6)])
-        f = jnp.stack([as_row(p) for p in s_out])[p_idx]
-        return r, l ^ f
-
+    round_body = _bitslice_round_body(rk_all, _E, as_row)
     l, r = lax.fori_loop(0, 16, round_body, (l, r))
     out = jnp.concatenate([r, l])                # pre-FP bit order
     return [out[t - 1] for t in _FP]
@@ -312,3 +331,71 @@ def key_planes_from_bytes7(byte_planes: Sequence):
             planes.append(byte_planes[7 * k + bit])
         planes.append(0)      # parity position
     return planes
+
+
+# ---------------------------------------------------------------------------
+# descrypt (traditional crypt(3), hashcat 1500): 25 chained DES
+# encryptions of the zero block under a salt-perturbed E expansion.
+
+def _salted_e_table(salt: int) -> list[int]:
+    """The crypt(3) salt perturbation: for each of the 12 salt bits
+    that is set, E-expansion outputs i and i+24 swap (1-based FIPS
+    table entries)."""
+    e = list(_E)
+    for i in range(12):
+        if (salt >> i) & 1:
+            e[i], e[i + 24] = e[i + 24], e[i]
+    return e
+
+
+def descrypt_key8(password: bytes) -> bytes:
+    """crypt(3) key: the low 7 bits of each of the first 8 password
+    bytes, left-shifted into DES key bit positions 1..7."""
+    pw = password[:8].ljust(8, b"\x00")
+    return bytes((c << 1) & 0xFF for c in pw)
+
+
+def des_crypt25(key8: bytes, salt: int) -> bytes:
+    """Scalar descrypt core (oracle/test anchor): 25 iterations of
+    salt-perturbed DES on the zero block; returns the 8-byte (64-bit)
+    ciphertext."""
+    rks = _key_schedule_bits(_to_bits(key8))
+    e_table = _salted_e_table(salt)
+    l, r = [0] * 32, [0] * 32                  # IP(zero block)
+    for _ in range(25):
+        l, r = _rounds16(l, r, rks, e_table)
+        # the end-of-encryption swap feeds the next iteration
+        # (FP then IP between iterations cancel)
+        l, r = r, l
+    return _from_bits(_permute(l + r, _FP))
+
+
+def descrypt_bitslice(key_planes, salt: int):
+    """Bitslice descrypt: key_planes[64] (FIPS order; from
+    (password << 1) byte planes) -> 64 cipher planes.  The salt is a
+    TRACE-TIME constant -- the E swaps are free re-wiring of the
+    static row-take index, so one compiled step serves one salt (the
+    worker compiles per target; targets sharing a salt could share)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    proto = next(p for p in key_planes if not isinstance(p, int))
+    Bv = proto.shape[0]
+
+    def as_row(p):
+        if isinstance(p, int):
+            return jnp.full((Bv,), jnp.int32(p))
+        return p
+
+    rk_all = _bitslice_schedule(key_planes, as_row)
+    round_body = _bitslice_round_body(rk_all, _salted_e_table(salt),
+                                      as_row)
+
+    def outer(j, carry):
+        l, r = lax.fori_loop(0, 16, round_body, carry)
+        return r, l                             # end-of-encrypt swap
+
+    zero = jnp.zeros((32, Bv), jnp.int32)
+    l, r = lax.fori_loop(0, 25, outer, (zero, zero))
+    out = jnp.concatenate([l, r])               # pre-FP order
+    return [out[t - 1] for t in _FP]

@@ -1,0 +1,175 @@
+"""descrypt (traditional DES crypt(3); hashcat 1500): scalar core vs
+the system crypt(), bitslice vs scalar, encode/decode round-trip,
+device workers end-to-end, CLI."""
+
+import random
+import subprocess
+import warnings
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.engines.cpu.engines import descrypt_decode, descrypt_encode
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.ops.des import des_crypt25, descrypt_key8
+from dprf_tpu.runtime.workunit import WorkUnit
+
+with warnings.catch_warnings():
+    warnings.simplefilter("ignore")             # removed in 3.13
+    try:
+        import crypt as _crypt
+    except ImportError:                          # pragma: no cover
+        _crypt = None
+
+ITOA64 = "./0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz"
+
+
+def _syscrypt(pw: str, salt2: str) -> str:
+    if _crypt is not None:
+        return _crypt.crypt(pw, salt2)
+    out = subprocess.run(
+        ["perl", "-e", "print crypt($ARGV[0], $ARGV[1])", pw, salt2],
+        capture_output=True, text=True).stdout
+    if len(out) != 13:
+        pytest.skip("no system crypt() available")
+    return out
+
+
+def test_scalar_matches_system_crypt():
+    rnd = random.Random(1500)
+    for _ in range(12):
+        pw = "".join(chr(rnd.randrange(33, 127))
+                     for _ in range(rnd.randrange(0, 12)))
+        salt2 = ITOA64[rnd.randrange(64)] + ITOA64[rnd.randrange(64)]
+        want = _syscrypt(pw, salt2)
+        salt = ITOA64.index(salt2[0]) | (ITOA64.index(salt2[1]) << 6)
+        got = salt2 + descrypt_encode(
+            des_crypt25(descrypt_key8(pw.encode()), salt))
+        assert got == want, (pw, salt2)
+
+
+def test_encode_decode_roundtrip():
+    rnd = random.Random(3)
+    for _ in range(16):
+        d = bytes(rnd.randrange(256) for _ in range(8))
+        assert descrypt_decode(descrypt_encode(d)) == d
+
+
+def test_bitslice_matches_scalar():
+    from dprf_tpu.engines.device.lm import byte_planes
+    from dprf_tpu.ops.des import descrypt_bitslice
+
+    rnd = random.Random(46)
+    B = 32
+    cands = [bytes(rnd.randrange(32, 127)
+                   for _ in range(rnd.randrange(0, 9)))
+             for _ in range(B)]
+    buf = np.zeros((B, 8), np.uint8)
+    for i, c in enumerate(cands):
+        buf[i] = np.frombuffer(descrypt_key8(c), np.uint8)
+    salt = 0b011010110101
+    planes = [np.asarray(p) for p in
+              descrypt_bitslice(byte_planes(jnp.asarray(buf)), salt)]
+    for i, c in enumerate(cands):
+        want = des_crypt25(descrypt_key8(c), salt)
+        bits = [(int(planes[b][i // 32]) >> (i % 32)) & 1
+                for b in range(64)]
+        got = bytes(sum(bits[8 * k + j] << (7 - j) for j in range(8))
+                    for k in range(8))
+        assert got == want, (i, c)
+
+
+def test_parse_rejects_malformed():
+    cpu = get_engine("descrypt")
+    with pytest.raises(ValueError):
+        cpu.parse_target("tooshort")
+    with pytest.raises(ValueError):
+        cpu.parse_target("ab" + "!" * 11)       # non-itoa64 chars
+    t = cpu.parse_target(_syscrypt("x", "ab"))
+    assert t.params["salt_text"] == "ab"
+
+
+def test_mask_worker_finds_planted():
+    cpu = get_engine("descrypt")
+    dev = get_engine("descrypt", device="jax")
+    t = cpu.parse_target(_syscrypt("dog", "K9"))
+    gen = MaskGenerator("?l?l?l")
+    w = dev.make_mask_worker(gen, [t], batch=2048, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, b"dog")]
+
+
+def test_mask_worker_two_targets_distinct_salts():
+    """Distinct salts become distinct circuits inside the one step;
+    both planted passwords surface with their own indices."""
+    cpu = get_engine("descrypt")
+    dev = get_engine("descrypt", device="jax")
+    ts = [cpu.parse_target(_syscrypt("07", "ab")),
+          cpu.parse_target(_syscrypt("42", "zQ"))]
+    gen = MaskGenerator("?d?d")
+    w = dev.make_mask_worker(gen, ts, batch=128, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert {(h.target_index, h.plaintext) for h in hits} == \
+        {(0, b"07"), (1, b"42")}
+
+
+def test_wordlist_worker_with_rules():
+    from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+    from dprf_tpu.rules.parser import parse_rule
+
+    cpu = get_engine("descrypt")
+    dev = get_engine("descrypt", device="jax")
+    words = [b"alpha", b"dog", b"cat"]
+    rules = [parse_rule(":"), parse_rule("u"), parse_rule("$1")]
+    gen = WordlistRulesGenerator(words, rules, max_len=8)
+    t = cpu.parse_target(_syscrypt("cat1", "zz"))
+    w = dev.make_wordlist_worker(gen, [t], batch=96, hit_capacity=8,
+                                 oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [b"cat1"]
+
+
+def test_long_masks_rejected():
+    dev = get_engine("descrypt", device="jax")
+    cpu = get_engine("descrypt")
+    t = cpu.parse_target(_syscrypt("x", "ab"))
+    gen = MaskGenerator("?l" * 9)
+    with pytest.raises(ValueError, match="cap at 8"):
+        dev.make_mask_worker(gen, [t], batch=32, hit_capacity=8)
+
+
+def test_cli_descrypt_crack(tmp_path, capsys):
+    from dprf_tpu.cli import main
+
+    hashes = tmp_path / "h.txt"
+    hashes.write_text(_syscrypt("fox", "Qr") + "\n")
+    pot = tmp_path / "pot.txt"
+    rc = main(["crack", "--engine=descrypt", "--device=jax",
+               "-a", "mask", "?l?l?l", str(hashes),
+               "--potfile", str(pot), "--batch", "2048"])
+    assert rc == 0
+    assert pot.read_text().strip().endswith(":fox")
+
+
+def test_mask_worker_same_salt_targets_fold():
+    """Targets sharing a salt fold into ONE bitslice circuit (the
+    salt-group step); all of them crack in one sweep with original
+    indices."""
+    from dprf_tpu.engines.device.descrypt import _salt_groups
+
+    cpu = get_engine("descrypt")
+    dev = get_engine("descrypt", device="jax")
+    ts = [cpu.parse_target(_syscrypt("11", "ab")),
+          cpu.parse_target(_syscrypt("99", "ab")),
+          cpu.parse_target(_syscrypt("55", "cd"))]
+    assert len(_salt_groups(ts)) == 2          # ab shared, cd alone
+    gen = MaskGenerator("?d?d")
+    w = dev.make_mask_worker(gen, ts, batch=128, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert {(h.target_index, h.plaintext) for h in hits} == \
+        {(0, b"11"), (1, b"99"), (2, b"55")}
