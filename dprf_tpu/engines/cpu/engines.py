@@ -252,6 +252,88 @@ class LdapMd5Engine(_LdapPlainMixin, Md5Engine):
     _scheme = "MD5"
 
 
+@register("oracle11")
+@register("oracle-11g")
+class Oracle11Engine(_SaltedCpuMixin):
+    """Oracle 11g (hashcat 112): sha1($pass.$salt) with a 10-byte
+    salt.  Accepts Oracle's native 'S:<40-hex digest><20-hex salt>'
+    and hashcat's 'hexdigest:salt' lines."""
+
+    name = "oracle11"
+    digest_size = 20
+    _algo = "sha1"
+    _order = "ps"
+    #: the 11g salt is fixed at 10 raw bytes, so candidates get the
+    #: rest of the single block (cf. the generic 55 - SALT_MAX cap)
+    max_candidate_len = 55 - 10
+
+    def parse_target(self, text: str) -> Target:
+        t = text.strip()
+        if t[:2].upper() == "S:" and len(t) == 62:
+            try:
+                digest = bytes.fromhex(t[2:42])
+                salt = bytes.fromhex(t[42:])
+            except ValueError:
+                raise ValueError(f"bad hex in oracle11 line: {text!r}")
+            return Target(raw=t, digest=digest, params={"salt": salt})
+        tgt = super().parse_target(text)
+        salt = tgt.params["salt"]
+        # hashcat -m 112 lines carry the salt HEX-ENCODED (ST_HEX):
+        # a 20-hex-char field is the 10-byte salt, not literal bytes
+        if len(salt) == 20:
+            try:
+                salt = bytes.fromhex(salt.decode("ascii"))
+            except (ValueError, UnicodeDecodeError):
+                pass
+        if len(salt) != 10:
+            raise ValueError(
+                f"oracle11 salts are exactly 10 bytes (20 hex chars); "
+                f"got {len(salt)} in {text!r}")
+        return Target(raw=tgt.raw, digest=tgt.digest,
+                      params={"salt": salt})
+
+
+def mysql323_words(password: bytes) -> tuple:
+    """MySQL pre-4.1 OLD_PASSWORD(): two 31-bit words from an
+    add/xor/shift scan over the password bytes (space and tab are
+    skipped, as the server does).  All arithmetic is u32."""
+    M = 0xFFFFFFFF
+    nr, nr2, add = 1345345333, 0x12345671, 7
+    for c in password:
+        if c in (0x20, 0x09):
+            continue
+        nr ^= ((((nr & 63) + add) * c) + ((nr << 8) & M)) & M
+        nr2 = (nr2 + (((nr2 << 8) & M) ^ nr)) & M
+        add = (add + c) & M
+    return nr & 0x7FFFFFFF, nr2 & 0x7FFFFFFF
+
+
+@register("mysql323")
+@register("mysql-old")
+class Mysql323Engine(HashEngine):
+    """MySQL pre-4.1 OLD_PASSWORD (hashcat 200): 16 hex chars = two
+    big-endian 31-bit words."""
+
+    name = "mysql323"
+    digest_size = 8
+    max_candidate_len = 55
+
+    def parse_target(self, text: str) -> Target:
+        t = text.strip()
+        digest = bytes.fromhex(t)
+        if len(digest) != 8:
+            raise ValueError(f"mysql323 wants 16 hex chars: {text!r}")
+        return Target(raw=t, digest=digest)
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        out = []
+        for c in candidates:
+            a, b = mysql323_words(c)
+            out.append(a.to_bytes(4, "big") + b.to_bytes(4, "big"))
+        return out
+
+
 def parse_mssql_line(text: str, version_tag: str, digest_hex: int):
     """MSSQL '0x<ver><8-hex salt><hex digest[s]>' -> (salt, digests).
     2000 lines carry TWO 40-hex sha1 digests (case-sensitive then
@@ -841,6 +923,32 @@ class Pbkdf2Sha1Engine(HashEngine):
                                     params["iterations"],
                                     params.get("dklen", 20))
                 for c in candidates]
+
+
+@register("atlassian")
+@register("pkcs5s2")
+class AtlassianEngine(Pbkdf2Sha1Engine):
+    """Atlassian/Crowd {PKCS5S2} (hashcat 12001): PBKDF2-HMAC-SHA1,
+    10000 iterations, base64(16-byte salt + 32-byte dk)."""
+
+    name = "atlassian"
+
+    def parse_target(self, text: str) -> Target:
+        import base64
+        t = text.strip()
+        tag = "{PKCS5S2}"
+        if not t.startswith(tag):
+            raise ValueError(f"not a {tag} line: {text!r}")
+        try:
+            blob = base64.b64decode(t[len(tag):], validate=True)
+        except Exception as e:
+            raise ValueError(f"bad base64 in {text!r}: {e}")
+        if len(blob) != 48:
+            raise ValueError(f"{tag} blob must be 48 bytes "
+                             f"(16 salt + 32 dk): {text!r}")
+        return Target(raw=t, digest=blob[16:],
+                      params={"salt": blob[:16], "iterations": 10000,
+                              "dklen": 32})
 
 
 @register("phpass")

@@ -306,3 +306,44 @@ class JaxLdapMd5Engine(JaxMd5Engine):
     def parse_target(self, text: str):
         from dprf_tpu.engines.cpu.engines import LdapMd5Engine
         return LdapMd5Engine().parse_target(text)
+
+
+@register("mysql323", device="jax")
+@register("mysql-old", device="jax")
+class JaxMysql323Engine(JaxEngineBase):
+    """MySQL pre-4.1 OLD_PASSWORD (hashcat 200): an add/xor/shift scan
+    over the password bytes.  digest_packed recovers bytes and length
+    from the standard big-endian single-block packing (bit count in
+    word 15), so every generic pipeline -- mask, wordlist+rules,
+    combinator, multi-target table, sharded -- applies unchanged."""
+
+    name = "mysql323"
+    digest_size = 8
+    digest_words = 2
+    little_endian = False
+
+    def digest_packed(self, blocks: jnp.ndarray,
+                      lengths=None) -> jnp.ndarray:
+        B = blocks.shape[0]
+        lens = (blocks[:, 15] // 8).astype(jnp.int32)
+        shifts = jnp.asarray([24, 16, 8, 0], jnp.uint32)
+        byts = ((blocks[:, :14, None] >> shifts) &
+                jnp.uint32(0xFF)).reshape(B, 56)
+        nr = jnp.full((B,), jnp.uint32(1345345333))
+        nr2 = jnp.full((B,), jnp.uint32(0x12345671))
+        add = jnp.full((B,), jnp.uint32(7))
+        for i in range(55):
+            c = byts[:, i]
+            active = ((i < lens) & (c != 0x20) & (c != 0x09))
+            nr_n = nr ^ ((((nr & 63) + add) * c) + (nr << 8))
+            nr2_n = nr2 + ((nr2 << 8) ^ nr_n)
+            add_n = add + c
+            nr = jnp.where(active, nr_n, nr)
+            nr2 = jnp.where(active, nr2_n, nr2)
+            add = jnp.where(active, add_n, add)
+        mask31 = jnp.uint32(0x7FFFFFFF)
+        return jnp.stack([nr & mask31, nr2 & mask31], axis=1)
+
+    def parse_target(self, text: str):
+        from dprf_tpu.engines.cpu.engines import Mysql323Engine
+        return Mysql323Engine().parse_target(text)

@@ -194,3 +194,17 @@ class ShardedPbkdf2Sha1MaskWorker(ShardedPhpassMaskWorker):
 
         self.step = make_sharded_pertarget_mask_step(
             gen, mesh, batch_per_device, digest_fn, 3, hit_capacity)
+
+
+@register("atlassian", device="jax")
+@register("pkcs5s2", device="jax")
+class JaxAtlassianEngine(JaxPbkdf2Sha1Engine):
+    """Atlassian/Crowd {PKCS5S2} (hashcat 12001): the generic
+    PBKDF2-HMAC-SHA1 device pipeline (2 output blocks for the 32-byte
+    dk) with the {PKCS5S2} base64 line format."""
+
+    name = "atlassian"
+
+    def parse_target(self, text: str):
+        from dprf_tpu.engines.cpu.engines import AtlassianEngine
+        return AtlassianEngine().parse_target(text)

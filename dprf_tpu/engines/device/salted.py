@@ -594,3 +594,20 @@ class JaxMssql2012Engine(_MssqlDeviceMixin, JaxSha512Engine):
     def parse_target(self, text: str):
         from dprf_tpu.engines.cpu.engines import Mssql2012Engine
         return Mssql2012Engine().parse_target(text)
+
+
+@register("oracle11", device="jax")
+@register("oracle-11g", device="jax")
+class JaxOracle11Engine(_SaltedDeviceMixin, JaxSha1Engine):
+    """Oracle 11g (hashcat 112): sha1($pass.$salt) -- the salted-sha1
+    'ps' machinery with Oracle's S: line format."""
+
+    name = "oracle11"
+    order = "ps"
+    #: fixed 10-byte salt -> narrow buffer, longer candidates (45)
+    salt_width = 10
+    max_candidate_len = 55 - 10
+
+    def parse_target(self, text: str):
+        from dprf_tpu.engines.cpu.engines import Oracle11Engine
+        return Oracle11Engine().parse_target(text)

@@ -1,0 +1,167 @@
+"""oracle11 (hashcat 112), mysql323 (200), atlassian {PKCS5S2}
+(12001): parse formats, oracle equivalence, device workers e2e."""
+
+import base64
+import hashlib
+import random
+
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+# ---------------- mysql323 ----------------
+
+MYSQL323_VECTORS = [
+    # OLD_PASSWORD() canonical vectors
+    ("test", "378b243e220ca493"),
+    ("password", "5d2e19393cc5ef67"),
+]
+
+
+@pytest.mark.parametrize("pw,want", MYSQL323_VECTORS)
+def test_mysql323_vectors(pw, want):
+    cpu = get_engine("mysql323")
+    assert cpu.hash_batch([pw.encode()])[0].hex() == want
+
+
+def test_mysql323_device_matches_oracle():
+    cpu = get_engine("mysql323")
+    dev = get_engine("mysql323", device="jax")
+    rnd = random.Random(200)
+    cands = [bytes(rnd.randrange(1, 127)
+                   for _ in range(rnd.randrange(0, 20)))
+             for _ in range(24)]
+    # the server skips space and tab mid-password
+    cands += [b"has space", b"tab\there", b"", b" \t "]
+    assert dev.hash_batch(cands) == cpu.hash_batch(cands)
+
+
+def test_mysql323_multi_target_mask():
+    cpu = get_engine("mysql323")
+    dev = get_engine("mysql323", device="jax")
+    gen = MaskGenerator("?l?l?l")
+    ts = [cpu.parse_target(cpu.hash_batch([b"fox"])[0].hex()),
+          cpu.parse_target(cpu.hash_batch([b"hen"])[0].hex())]
+    w = dev.make_mask_worker(gen, ts, batch=4096, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert {(h.target_index, h.plaintext) for h in hits} == \
+        {(0, b"fox"), (1, b"hen")}
+
+
+def test_mysql323_wordlist_with_rules():
+    from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+    from dprf_tpu.rules.parser import parse_rule
+
+    cpu = get_engine("mysql323")
+    dev = get_engine("mysql323", device="jax")
+    words = [b"alpha", b"fox", b"delta"]
+    rules = [parse_rule(":"), parse_rule("$1")]
+    gen = WordlistRulesGenerator(words, rules, max_len=8)
+    t = cpu.parse_target(cpu.hash_batch([b"fox1"])[0].hex())
+    w = dev.make_wordlist_worker(gen, [t], batch=64, hit_capacity=8,
+                                 oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [h.plaintext for h in hits] == [b"fox1"]
+
+
+def test_mysql323_parse_rejects_malformed():
+    cpu = get_engine("mysql323")
+    with pytest.raises(ValueError):
+        cpu.parse_target("xyz")
+    with pytest.raises(ValueError):
+        cpu.parse_target("ab" * 10)
+
+
+# ---------------- oracle11 ----------------
+
+def _oracle11_line(pw: bytes, salt: bytes) -> str:
+    return ("S:" + hashlib.sha1(pw + salt).hexdigest().upper()
+            + salt.hex().upper())
+
+
+def test_oracle11_parse_and_crack():
+    cpu = get_engine("oracle11")
+    dev = get_engine("oracle11", device="jax")
+    salt = bytes(range(10))
+    t = cpu.parse_target(_oracle11_line(b"dog", salt))
+    assert t.params["salt"] == salt
+    assert cpu.hash_batch([b"dog"], t.params)[0] == t.digest
+    gen = MaskGenerator("?l?l?l")
+    w = dev.make_mask_worker(gen, [t], batch=4096, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, b"dog")]
+
+
+def test_oracle11_hashcat_style_line():
+    """A literal (non-hex) 10-byte salt after the colon is accepted;
+    anything that isn't 10 raw bytes is rejected (the 11g salt is
+    fixed-width)."""
+    cpu = get_engine("oracle11")
+    salt = b"saltysalty"                        # 10 literal bytes
+    t = cpu.parse_target(hashlib.sha1(b"x" + salt).hexdigest()
+                         + ":" + salt.decode())
+    assert t.params["salt"] == salt
+    assert cpu.hash_batch([b"x"], t.params)[0] == t.digest
+
+
+# ---------------- atlassian {PKCS5S2} ----------------
+
+def _atlassian_line(pw: bytes, salt: bytes) -> str:
+    dk = hashlib.pbkdf2_hmac("sha1", pw, salt, 10000, 32)
+    return "{PKCS5S2}" + base64.b64encode(salt + dk).decode()
+
+
+def test_atlassian_parse_and_crack():
+    cpu = get_engine("atlassian")
+    dev = get_engine("atlassian", device="jax")
+    salt = bytes(range(16))
+    t = cpu.parse_target(_atlassian_line(b"ca", salt))
+    assert t.params == {"salt": salt, "iterations": 10000, "dklen": 32}
+    assert cpu.hash_batch([b"ca"], t.params)[0] == t.digest
+    gen = MaskGenerator("?l?l")
+    w = dev.make_mask_worker(gen, [t], batch=256, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, b"ca")]
+
+
+def test_atlassian_parse_rejects_malformed():
+    cpu = get_engine("atlassian")
+    with pytest.raises(ValueError):
+        cpu.parse_target("{PKCS5S2}!!!")
+    with pytest.raises(ValueError):
+        cpu.parse_target("{PKCS5S2}" + base64.b64encode(b"x" * 20).decode())
+    with pytest.raises(ValueError):
+        cpu.parse_target("sha1:100:AAAA:BBBB{PKCS5S2}")
+
+
+def test_oracle11_hashcat_hex_salt_line():
+    """hashcat -m 112 lines carry the 10-byte salt hex-encoded; the
+    parser must decode it, not hash the ASCII hex."""
+    cpu = get_engine("oracle11")
+    salt = bytes(range(10))
+    line = hashlib.sha1(b"pw" + salt).hexdigest() + ":" + salt.hex()
+    t = cpu.parse_target(line)
+    assert t.params["salt"] == salt
+    assert cpu.hash_batch([b"pw"], t.params)[0] == t.digest
+    with pytest.raises(ValueError, match="10 bytes"):
+        cpu.parse_target(hashlib.sha1(b"x").hexdigest() + ":abc")
+
+
+def test_oracle11_long_candidates_fit():
+    """The fixed 10-byte salt leaves 45 bytes for candidates; a
+    30-char job must trace (the generic 23-byte cap must not apply)."""
+    cpu = get_engine("oracle11")
+    dev = get_engine("oracle11", device="jax")
+    assert dev.max_candidate_len == 45
+    salt = bytes(range(10))
+    t = cpu.parse_target(_oracle11_line(b"x" * 30, salt))
+    gen = MaskGenerator("?l" * 30)
+    w = dev.make_mask_worker(gen, [t], batch=64, hit_capacity=8,
+                             oracle=cpu)
+    w.process(WorkUnit(0, 0, 64))              # traces at length 30

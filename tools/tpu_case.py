@@ -9,6 +9,7 @@ Usage: python tools/tpu_case.py <case>
 Cases: scrypt-<N>-<r>-<p>-<B> | bcrypt-<cost>-<B> | pmkid-<B>
      | bcryptchunk-<cost>-<B>   (deadline-bounded chunked cost loop;
                                  the only safe shape for cost >= 10)
+     | descrypt-<B>             (bitslice crypt(3): 25 chained DES)
 """
 
 import json
@@ -99,6 +100,24 @@ def run_case(name: str) -> dict:
                 "n_dispatches": len(steps) + 2,
                 "max_dispatch_s": round(max(steps), 1),
                 "false_hits": count}
+    elif kind == "descrypt":
+        B = int(parts[1])
+        from dprf_tpu.engines.device.descrypt import (
+            make_descrypt_mask_step)
+        from dprf_tpu.engines.base import Target
+        g6 = MaskGenerator("?l?l?l?l?l?l")
+        base = jnp.asarray(g6.digits(0), jnp.int32)
+        # plant the 5th candidate of the keyspace under salt "ab" (12)
+        from dprf_tpu.ops.des import des_crypt25, descrypt_key8
+        plain = g6.candidate(4)
+        tgt = Target(raw="x", digest=des_crypt25(descrypt_key8(plain),
+                                                 12),
+                     params={"salt": 12, "salt_text": "ab"})
+        step = make_descrypt_mask_step(g6, [tgt], B)
+
+        @jax.jit
+        def run(b):
+            return step(b, jnp.int32(B))[0]
     elif kind == "pmkid":
         B = int(parts[1])
         from dprf_tpu import get_engine
