@@ -1801,6 +1801,48 @@ class Keccak256Engine(HashEngine):
         return [keccak256(c) for c in candidates]
 
 
+#: (bits, sponge rate) for the SHA3/Keccak family; rate = 200 - bits/4
+KECCAK_SIZES = [(224, 144), (384, 104), (512, 72)]
+
+
+def _register_keccak_family():
+    """sha3-224/384/512 (hashcat 17300/17500/17600; hashlib oracles)
+    and keccak-224/384/512 (17700/17900/18000; scalar sponge oracle).
+    256 variants are the explicit classes above."""
+    from dprf_tpu.ops.keccak import keccak_digest
+
+    for bits, rate in KECCAK_SIZES:
+        def make_sha3_hash(bits):
+            def hash_batch(self, candidates, params=None):
+                return [hashlib.new(f"sha3_{bits}", c).digest()
+                        for c in candidates]
+            return hash_batch
+
+        def make_keccak_hash(bits, rate):
+            def hash_batch(self, candidates, params=None):
+                return [keccak_digest(c, 0x01, rate, bits // 8)
+                        for c in candidates]
+            return hash_batch
+
+        cls = type(f"Sha3_{bits}Engine", (HashEngine,),
+                   {"name": f"sha3-{bits}", "digest_size": bits // 8,
+                    "max_candidate_len": rate - 1,
+                    "__doc__": f"SHA3-{bits}: bare hex-digest lines.",
+                    "hash_batch": make_sha3_hash(bits)})
+        register(f"sha3-{bits}", device="cpu")(cls)
+        kcls = type(f"Keccak{bits}Engine", (HashEngine,),
+                    {"name": f"keccak-{bits}", "digest_size": bits // 8,
+                     "max_candidate_len": rate - 1,
+                     "__doc__": (f"Original Keccak-{bits} (0x01 "
+                                 "padding): bare hex-digest lines."),
+                     "hash_batch": make_keccak_hash(bits, rate)})
+        register(f"keccak-{bits}", device="cpu")(kcls)
+        register(f"keccak{bits}", device="cpu")(kcls)
+
+
+_register_keccak_family()
+
+
 @register("postgres")
 @register("postgres-md5")
 class PostgresMd5Engine(_SaltedCpuMixin):

@@ -1,9 +1,11 @@
-"""Device SHA3-256 / Keccak-256 engines (hashcat 17400/17800).
+"""Device SHA3 / Keccak family engines (hashcat 17300-18000):
+sha3-224/256/384/512 and raw keccak-224/256/384/512, one generalized
+single-block sponge with (rate, pad byte, digest width) per variant.
 
 Keccak's sponge padding is its own thing, so these engines do not ride
 the Merkle-Damgard packers: the fused step decodes candidates and
 feeds raw bytes plus per-lane lengths straight into
-ops/keccak.keccak256_words (which pads in-kernel).  Multi-target lists
+ops/keccak.keccak_words (which pads in-kernel).  Multi-target lists
 reuse the sorted-table compare the fast MD engines use."""
 
 from __future__ import annotations
@@ -18,15 +20,17 @@ from jax import lax
 from dprf_tpu.engines import register
 from dprf_tpu.engines.cpu.engines import Keccak256Engine, Sha3_256Engine
 from dprf_tpu.ops import compare as cmp_ops
-from dprf_tpu.ops.keccak import keccak256_words
+from dprf_tpu.ops.keccak import keccak_words
 from dprf_tpu.runtime.worker import (DeviceWordlistWorker,
                                      MaskWorkerBase)
 
 
 def make_keccak_mask_step(gen, tgt, batch: int, pad_byte: int,
-                          hit_capacity: int = 64):
-    """tgt: single-target words uint32[8] or a multi-target sorted
-    table from cmp_ops.make_target_table."""
+                          hit_capacity: int = 64, rate: int = 136,
+                          out_bytes: int = 32):
+    """tgt: single-target words uint32[out_bytes//4] (7 for the 224
+    variants, 16 for 512) or a multi-target sorted table from
+    cmp_ops.make_target_table."""
     flat = gen.flat_charsets
     length = gen.length
     multi = isinstance(tgt, cmp_ops.TargetTable)
@@ -35,7 +39,8 @@ def make_keccak_mask_step(gen, tgt, batch: int, pad_byte: int,
     def step(base_digits, n_valid):
         cand = gen.decode_batch(base_digits, flat, batch)
         lengths = jnp.full((batch,), length, jnp.int32)
-        digest = keccak256_words(cand, lengths, pad_byte=pad_byte)
+        digest = keccak_words(cand, lengths, pad_byte=pad_byte,
+                              rate=rate, out_bytes=out_bytes)
         if multi:
             found, tpos = cmp_ops.compare_multi(digest, tgt)
         else:
@@ -48,7 +53,8 @@ def make_keccak_mask_step(gen, tgt, batch: int, pad_byte: int,
 
 
 def make_keccak_wordlist_step(gen, tgt, word_batch: int, pad_byte: int,
-                              hit_capacity: int = 64):
+                              hit_capacity: int = 64, rate: int = 136,
+                              out_bytes: int = 32):
     from dprf_tpu.ops.rules_pipeline import expand_rules
 
     B, L = word_batch, gen.max_len
@@ -67,7 +73,8 @@ def make_keccak_wordlist_step(gen, tgt, word_batch: int, pad_byte: int,
         cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
         pos = jnp.arange(cw.shape[1], dtype=jnp.int32)
         cw = jnp.where(pos[None, :] < cl[:, None], cw, 0)  # mask junk
-        digest = keccak256_words(cw, cl, pad_byte=pad_byte)
+        digest = keccak_words(cw, cl, pad_byte=pad_byte, rate=rate,
+                              out_bytes=out_bytes)
         if multi:
             found, tpos = cmp_ops.compare_multi(digest, tgt)
         else:
@@ -104,8 +111,9 @@ class KeccakMaskWorker(_KeccakTargetsMixin, MaskWorkerBase):
         tgt = self._setup_keccak(engine, gen, targets, hit_capacity,
                                  oracle)
         self.batch = self.stride = batch
-        self.step = make_keccak_mask_step(gen, tgt, batch,
-                                          engine._pad_byte, hit_capacity)
+        self.step = make_keccak_mask_step(
+            gen, tgt, batch, engine._pad_byte, hit_capacity,
+            rate=engine._rate, out_bytes=engine.digest_size)
 
 
 class KeccakWordlistWorker(_KeccakTargetsMixin, DeviceWordlistWorker):
@@ -116,15 +124,16 @@ class KeccakWordlistWorker(_KeccakTargetsMixin, DeviceWordlistWorker):
         self.word_batch = max(1, batch // gen.n_rules)
         self.stride = self.word_batch * gen.n_rules
         self.batch = batch
-        self.step = make_keccak_wordlist_step(gen, tgt, self.word_batch,
-                                              engine._pad_byte,
-                                              hit_capacity)
+        self.step = make_keccak_wordlist_step(
+            gen, tgt, self.word_batch, engine._pad_byte, hit_capacity,
+            rate=engine._rate, out_bytes=engine.digest_size)
 
 
 class _KeccakDeviceMixin:
     little_endian = False
     digest_words = 8
     _pad_byte: int
+    _rate = 136
 
     def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
                          oracle=None):
@@ -157,3 +166,27 @@ class JaxKeccak256Engine(_KeccakDeviceMixin, Keccak256Engine):
     """Device original Keccak-256 (0x01 padding; Ethereum)."""
 
     _pad_byte = 0x01
+
+
+def _register_keccak_device_family():
+    """Device sha3-224/384/512 and keccak-224/384/512 on the
+    generalized sponge (hashcat 17300/17500/17600/17700/17900/18000);
+    the 256 variants are the explicit classes above."""
+    from dprf_tpu.engines.cpu.engines import KECCAK_SIZES
+    from dprf_tpu.engines import engine_class
+
+    for bits, rate in KECCAK_SIZES:
+        for kind, pad in (("sha3", 0x06), ("keccak", 0x01)):
+            name = f"{kind}-{bits}"
+            cpu_cls = engine_class(name, device="cpu")
+            cls = type(f"Jax{kind.title()}{bits}Engine",
+                       (_KeccakDeviceMixin, cpu_cls),
+                       {"__doc__": cpu_cls.__doc__ + " (device)",
+                        "_pad_byte": pad, "_rate": rate,
+                        "digest_words": bits // 32})
+            register(name, device="jax")(cls)
+            if kind == "keccak":
+                register(f"keccak{bits}", device="jax")(cls)
+
+
+_register_keccak_device_family()

@@ -103,17 +103,20 @@ def keccak_f(state):
     return lax.fori_loop(0, 24, round_body, dict(state))
 
 
-def keccak256_words(msg: "jnp.ndarray", lengths, pad_byte: int = 0x01):
-    """Single-block Keccak-256: msg uint8[B, maxlen <= 135] + per-lane
-    lengths -> digest uint32[B, 8] (big-endian word view of the 32
-    digest bytes).  pad_byte 0x01 = original Keccak (Ethereum);
-    0x06 = SHA3-256."""
+def keccak_words(msg: "jnp.ndarray", lengths, pad_byte: int = 0x01,
+                 rate: int = 136, out_bytes: int = 32):
+    """Single-block Keccak/SHA3 sponge: msg uint8[B, maxlen <= rate-1]
+    + per-lane lengths -> digest uint32[B, ceil(out_bytes/4)]
+    (big-endian word view).  pad_byte 0x01 = original Keccak;
+    0x06 = SHA3.  rate = 200 - 2*out for standard digests
+    (136/144/104/72 for 256/224/384/512)."""
     import jax.numpy as jnp
 
     B, maxlen = msg.shape
-    if maxlen > 135:
-        raise ValueError("single-block keccak-256 needs <= 135 bytes")
-    rate = 136
+    if maxlen > rate - 1:
+        raise ValueError(
+            f"single-block keccak at rate {rate} needs <= {rate - 1} "
+            "bytes")
     pos = jnp.arange(rate, dtype=jnp.int32)
     buf = jnp.zeros((B, rate), jnp.uint8).at[:, :maxlen].set(msg)
     lens = lengths[:, None]
@@ -133,15 +136,25 @@ def keccak256_words(msg: "jnp.ndarray", lengths, pad_byte: int = 0x01):
         hi, lo = state[(x, y)]
         state[(x, y)] = (hi ^ words[:, i, 1], lo ^ words[:, i, 0])
     state = keccak_f(state)
-    # digest = first 32 bytes of the state (lanes (0,0),(1,0),(2,0),
-    # (3,0), little-endian), exposed as BIG-endian uint32 words so the
-    # framework's ">u4" target tables compare directly
+    # digest = first out_bytes of the state (row-major lanes,
+    # little-endian within a lane), exposed as BIG-endian uint32 words
+    # so the framework's ">u4" target tables compare directly.  A
+    # half-lane tail (224: 28 bytes = 3.5 lanes) emits its low word.
     out = []
-    for i in range(4):
+    for i in range(out_bytes // 8):
         hi, lo = state[(i % 5, i // 5)]
         out.append(_bswap(lo))
         out.append(_bswap(hi))
+    if out_bytes % 8:
+        i = out_bytes // 8
+        hi, lo = state[(i % 5, i // 5)]
+        out.append(_bswap(lo))
     return jnp.stack(out, axis=-1)
+
+
+def keccak256_words(msg: "jnp.ndarray", lengths, pad_byte: int = 0x01):
+    """Single-block Keccak-256 (see keccak_words)."""
+    return keccak_words(msg, lengths, pad_byte, rate=136, out_bytes=32)
 
 
 def _bswap(x):
@@ -174,11 +187,11 @@ def _keccak_f_scalar(lanes: list[int]) -> list[int]:
     return lanes
 
 
-def keccak256(data: bytes, pad_byte: int = 0x01) -> bytes:
-    """Host scalar Keccak-256 (CPU oracle / test anchor); pad 0x01 =
-    Ethereum's original Keccak, 0x06 = SHA3-256.  Multi-block capable
-    (the device path is single-block; oracles may see longer data)."""
-    rate = 136
+def keccak_digest(data: bytes, pad_byte: int = 0x01, rate: int = 136,
+                  out_bytes: int = 32) -> bytes:
+    """Host scalar Keccak sponge (CPU oracle / test anchor); pad 0x01 =
+    original Keccak, 0x06 = SHA3.  Multi-block capable (the device
+    path is single-block; oracles may see longer data)."""
     buf = bytearray(data)
     buf.append(pad_byte)
     while len(buf) % rate:
@@ -190,4 +203,11 @@ def keccak256(data: bytes, pad_byte: int = 0x01) -> bytes:
             lanes[i] ^= int.from_bytes(buf[off + 8 * i:off + 8 * i + 8],
                                        "little")
         lanes = _keccak_f_scalar(lanes)
-    return b"".join(lanes[i].to_bytes(8, "little") for i in range(4))
+    full = b"".join(lanes[i].to_bytes(8, "little")
+                    for i in range((out_bytes + 7) // 8))
+    return full[:out_bytes]
+
+
+def keccak256(data: bytes, pad_byte: int = 0x01) -> bytes:
+    """Host scalar Keccak-256 (see keccak_digest)."""
+    return keccak_digest(data, pad_byte, rate=136, out_bytes=32)

@@ -112,3 +112,71 @@ def test_sha224_vector_and_crack():
             found.extend(start + int(l) for l in np.asarray(lanes)
                          if l >= 0)
     assert found == [gen.index_of(secret)]
+
+
+# ---------------- SHA3/Keccak family (hashcat 17300-18000) ----------------
+
+KECCAK_FAMILY = [(224, 144), (256, 136), (384, 104), (512, 72)]
+
+
+@pytest.mark.parametrize("bits,rate", KECCAK_FAMILY)
+def test_sha3_cpu_matches_hashlib(bits, rate):
+    import hashlib
+    import random
+
+    cpu = get_engine(f"sha3-{bits}")
+    rnd = random.Random(bits)
+    cands = [bytes(rnd.randrange(256) for _ in range(rnd.randrange(0, 40)))
+             for _ in range(8)]
+    assert cpu.hash_batch(cands) == [
+        hashlib.new(f"sha3_{bits}", c).digest() for c in cands]
+
+
+@pytest.mark.parametrize("bits,rate", [(224, 144), (384, 104), (512, 72)])
+@pytest.mark.parametrize("kind", ["sha3", "keccak"])
+def test_keccak_family_device_crack(kind, bits, rate):
+    """Each (variant, size) cracks a planted password on device; the
+    224 sizes exercise the half-lane digest tail."""
+    from dprf_tpu.runtime.workunit import WorkUnit
+
+    cpu = get_engine(f"{kind}-{bits}")
+    dev = get_engine(f"{kind}-{bits}", device="jax")
+    assert dev.digest_size == bits // 8
+    line = cpu.hash_batch([b"dog"])[0].hex()
+    t = cpu.parse_target(line)
+    gen = MaskGenerator("?l?l?l")
+    w = dev.make_mask_worker(gen, [t], batch=2048, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, b"dog")]
+
+
+def test_sha3_224_multi_target_table():
+    """28-byte digests (7 words, a half-lane tail) through the sorted
+    multi-target table."""
+    import hashlib
+
+    from dprf_tpu.runtime.workunit import WorkUnit
+
+    cpu = get_engine("sha3-224")
+    dev = get_engine("sha3-224", device="jax")
+    gen = MaskGenerator("?l?l?l")
+    ts = [cpu.parse_target(hashlib.sha3_224(s).hexdigest())
+          for s in (b"abc", b"zzz")]
+    w = dev.make_mask_worker(gen, ts, batch=2048, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert {(h.target_index, h.plaintext) for h in hits} == \
+        {(0, b"abc"), (1, b"zzz")}
+
+
+def test_keccak_block_limit_tracks_rate():
+    """The single-block limit is rate-1 bytes: 71 for sha3-512, 143
+    for sha3-224."""
+    from dprf_tpu.ops.keccak import keccak_words
+
+    import jax.numpy as jnp
+
+    with pytest.raises(ValueError, match="<= 71"):
+        keccak_words(jnp.zeros((8, 72), jnp.uint8),
+                     jnp.zeros((8,), jnp.int32), rate=72)
