@@ -30,6 +30,73 @@ def test_run_config_1_worker_path():
     assert res["value"] > 0 and res["targets"] == 1
 
 
+def test_cached_session_fallback_reads_committed_results(tmp_path):
+    """bench.py's fallback chain must consult checked-in
+    TPU_RESULTS_r*.json (VERDICT r3 #1): /tmp session files first, then
+    the latest committed round, ignoring poisoned (>=1e12) values."""
+    import importlib.util
+    import json
+    import os
+    import shutil
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_root", os.path.join(repo, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    # sandbox copy so the test controls exactly which files exist
+    sandbox = tmp_path / "repo"
+    sandbox.mkdir()
+    shutil.copy(os.path.join(repo, "bench.py"), sandbox / "bench.py")
+    (sandbox / "TPU_RESULTS_r01.json").write_text(json.dumps(
+        {"stages": {"bench": {"md5-pallas": {
+            "device": "tpu", "engine": "md5", "value": 1.0e9}}}}))
+    (sandbox / "TPU_RESULTS_r02.json").write_text(json.dumps(
+        {"sessionA": {"stages": {"bench": {
+            "md5-pallas": {"device": "tpu", "engine": "md5",
+                           "value": 2.0e9},
+            "md5-poisoned": {"device": "tpu", "engine": "md5",
+                             "value": 1.3e15},     # poisoned: ignored
+            "sha1": {"device": "tpu", "engine": "sha1",
+                     "value": 9.9e9}}}}}))         # wrong engine
+    spec2 = importlib.util.spec_from_file_location(
+        "bench_sandbox", str(sandbox / "bench.py"))
+    mod2 = importlib.util.module_from_spec(spec2)
+    spec2.loader.exec_module(mod2)
+    mod2.TMP_SESSION_GLOB = str(tmp_path / "nonexistent" / "*.json")
+    res = mod2._cached_session_result()
+    # newest round wins; nested session shape is scanned; caps applied
+    assert res is not None and res["value"] == 2.0e9
+    assert res["device"] == "tpu" and "cached session" in res["note"]
+
+    # the real repo's committed results must be found too (tmp tier
+    # neutralized so this exercises the committed-file path); only
+    # schema properties are asserted -- the value belongs to whatever
+    # round last measured, not to this test
+    mod.TMP_SESSION_GLOB = str(tmp_path / "nonexistent" / "*.json")
+    real = mod._cached_session_result()
+    assert real is not None and real["device"] == "tpu"
+    assert 0 < real["value"] < mod.CACHED_VALUE_CAP
+
+    # a stale /tmp leftover (older than the newest committed file)
+    # must NOT shadow the committed record -- it joins the same tier
+    stale_dir = tmp_path / "stale"
+    stale_dir.mkdir()
+    stale = stale_dir / "tpu_session_results.json"
+    stale.write_text(json.dumps({"stages": {"bench": {"md5-xla": {
+        "device": "tpu", "engine": "md5", "value": 5.0e7}}}}))
+    committed = sandbox / "TPU_RESULTS_r02.json"
+    os.utime(stale, (os.path.getmtime(committed) - 100,) * 2)
+    mod2.TMP_SESSION_GLOB = str(stale_dir / "*.json")
+    res = mod2._cached_session_result()
+    assert res["value"] == 2.0e9   # committed round wins the tier
+    # but a FRESH /tmp session (newer than the committed file) wins
+    os.utime(stale, (os.path.getmtime(committed) + 100,) * 2)
+    res = mod2._cached_session_result()
+    assert res["value"] == 5.0e7
+
+
 def test_run_scaling_plumbing():
     assert len(jax.devices()) >= 2, "conftest fakes 8 CPU devices"
     res = run_scaling(engine="md5", mask="?l?l?l?l?l?l", n_devices=2,
