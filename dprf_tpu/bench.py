@@ -106,16 +106,24 @@ def run_bench(engine: str = "md5", device: str = "jax",
                 gen.keyspace - batch, 1)), dtype=jnp.int32)
             return fn(base, jnp.int32(batch))
 
+        from dprf_tpu.utils.sync import hard_sync
+
         # Warmup / compile
         t0 = time.perf_counter()
-        jax.block_until_ready(run_batch(0))
+        hard_sync(run_batch(0))
         compile_s = time.perf_counter() - t0
         if log:
             log.info("bench compiled", seconds=f"{compile_s:.1f}")
-        # Timed with BOUNDED queue depth: sync every few dispatches so
-        # the wall-time window reflects sustained throughput rather
-        # than enqueue speed (an unbounded async queue over a slow link
-        # once enqueued 16k batches in 10 s and drained for 108 min).
+        # Timed with BOUNDED queue depth, synced by hard_sync (NOT
+        # block_until_ready, which over the axon tunnel returns at
+        # enqueue -- see utils/sync.py) so the wall-time window
+        # reflects sustained throughput rather than enqueue speed (an
+        # unbounded async queue over a slow link once enqueued 16k
+        # batches in 10 s and drained for 108 min; the enqueue-speed
+        # bug measured 1,671 "dispatches" in a 0.5 s window).
+        # hard_sync also materializes real bytes, so a backend that
+        # died mid-run cannot complete dispatches instantly with
+        # poisoned buffers (once inflated a measurement to 1.3e15 H/s).
         n, t0 = 0, time.perf_counter()
         depth = 1 if inner > 1 else 8
         while time.perf_counter() - t0 < seconds:
@@ -123,15 +131,8 @@ def run_bench(engine: str = "md5", device: str = "jax",
             for _ in range(depth):
                 last = run_batch(n)
                 n += 1
-            jax.block_until_ready(last)
+            hard_sync(last)
         elapsed = time.perf_counter() - t0
-        # Materialize a real VALUE from the last result: a backend that
-        # died mid-run can complete dispatches instantly with poisoned
-        # buffers and no exception until the bytes are actually read --
-        # which once inflated a dead-device "measurement" to 1.3e15 H/s.
-        import numpy as _np
-        for part in (last if isinstance(last, tuple) else (last,)):
-            _np.asarray(part)
     else:
         eng = get_engine(engine, device="cpu")
         n, elapsed = 0, 0.0
@@ -204,8 +205,10 @@ def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
                 dtype=jnp.int32)
             return fn(base, jnp.int32(sb))
 
+        from dprf_tpu.utils.sync import hard_sync
+
         t0 = time.perf_counter()
-        jax.block_until_ready(run_batch(0))
+        hard_sync(run_batch(0))
         compile_s = time.perf_counter() - t0
         if log:
             log.info("scaling bench compiled", devices=n,
@@ -217,7 +220,7 @@ def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
             for _ in range(depth):
                 last = run_batch(k)
                 k += 1
-            jax.block_until_ready(last)
+            hard_sync(last)
         elapsed = time.perf_counter() - t0
         return {"rate": k * sb * max(1, inner) / elapsed,
                 "compile_s": round(compile_s, 1),

@@ -102,19 +102,28 @@ _jit_bcrypt_batch = jax.jit(bf_ops.bcrypt_batch)
 #: batch in ONE dispatch tripped it and poisoned the backend,
 #: TPU_PROBE_LOG_r03); a 20 s budget keeps 3x headroom while the
 #: ~0.4 s/dispatch tunnel RTT stays <2% overhead.
-DEFAULT_DISPATCH_S = float(os.environ.get("DPRF_BCRYPT_DISPATCH_S", "20"))
+try:
+    DEFAULT_DISPATCH_S = float(
+        os.environ.get("DPRF_BCRYPT_DISPATCH_S", "20"))
+except ValueError:
+    import warnings
+    warnings.warn("DPRF_BCRYPT_DISPATCH_S is not a number; using 20")
+    DEFAULT_DISPATCH_S = 20.0
 
 
 class ChunkedEks:
     """Drives the EksBlowfish 2**cost main loop in deadline-bounded
     dispatches, carrying the (P, S) state on device between them.
 
-    The first chunk is small (16 rounds) to calibrate seconds/round for
-    the current (batch, impl) without risking the deadline; later chunks
-    grow toward `dispatch_s`, capped at 8x per step so one optimistic
-    estimate cannot jump straight past the deadline.  State buffers are
-    donated to the advance dispatch, so the 4 KB/lane S-boxes are
-    updated in place rather than copied each chunk.
+    The very first dispatch is a single untimed round that absorbs the
+    advance fn's JIT compile; the next chunk is small (16 rounds) to
+    calibrate seconds/round for the current (batch, impl) without
+    risking the deadline; later chunks grow toward `dispatch_s`, capped
+    at 8x per step so one optimistic estimate cannot jump straight past
+    the deadline.  Once calibrated, a total that fits one dispatch with
+    headroom is issued sync-free so consecutive batches pipeline.
+    State buffers are donated to the advance dispatch, so the 4 KB/lane
+    S-boxes are updated in place rather than copied each chunk.
     """
 
     CALIBRATE_ROUNDS = 16
@@ -145,14 +154,42 @@ class ChunkedEks:
         """Advance (P, S) by `total_rounds`; returns the final state.
         `on_chunk(done, total)` is called after each dispatch (progress
         / lease-renewal hook)."""
+        from dprf_tpu.utils.sync import hard_sync
+
         done = 0
+        if self._per_round is None and done < total_rounds:
+            # warm the advance fn's compile with a 1-round dispatch so
+            # the first EMA sample doesn't fold seconds of JIT time
+            # into seconds/round and starve the ramp (ADVICE r3)
+            P, S = self._advance(P, S, key_words, salt18, jnp.int32(1))
+            hard_sync(S)
+            done += 1
+            if on_chunk is not None:
+                on_chunk(done, total_rounds)
+        elif (self._per_round is not None
+              and (total_rounds - done) * self._per_round
+              <= 0.75 * self.dispatch_s):
+            # the whole remaining chain fits one calibrated dispatch
+            # with deadline headroom: issue it WITHOUT a host sync so
+            # batch N+1's begin/cost-loop can overlap batch N's finish
+            # (the worker's hit readback is the natural per-batch sync
+            # point).  No EMA update -- nothing was measured.
+            P, S = self._advance(P, S, key_words, salt18,
+                                 jnp.int32(total_rounds - done))
+            if on_chunk is not None:
+                on_chunk(total_rounds, total_rounds)
+            return P, S
         while done < total_rounds:
             chunk = self._next_chunk(total_rounds - done,
                                      self._last_chunk)
             t0 = time.perf_counter()
             P, S = self._advance(P, S, key_words, salt18,
                                  jnp.int32(chunk))
-            jax.block_until_ready(S)
+            # hard_sync, NOT block_until_ready: over the axon tunnel
+            # the latter returns at enqueue (utils/sync.py), which
+            # would calibrate the EMA on enqueue time and grow chunks
+            # straight past the ~60 s execution deadline
+            hard_sync(S)
             dt = time.perf_counter() - t0
             per = dt / chunk
             self._per_round = (per if self._per_round is None

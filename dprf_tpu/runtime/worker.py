@@ -116,10 +116,15 @@ class MaskWorkerBase:
         factory calls this so a Mosaic/XLA compile failure surfaces at
         worker construction -- where it can fall back to another path --
         instead of mid-job."""
-        import jax
         import jax.numpy as jnp
+
+        from dprf_tpu.utils.sync import hard_sync
         base = jnp.asarray(self.gen.digits(0), dtype=jnp.int32)
-        jax.block_until_ready(self.step(base, jnp.int32(0)))
+        # hard_sync (not block_until_ready) so a RUNTIME kernel fault
+        # also surfaces here, not just a compile failure -- over the
+        # axon tunnel block_until_ready returns at enqueue and the
+        # fault would land on the first real batch instead
+        hard_sync(self.step(base, jnp.int32(0)))
 
     def process(self, unit: WorkUnit) -> list[Hit]:
         import jax.numpy as jnp

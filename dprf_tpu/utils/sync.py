@@ -1,0 +1,53 @@
+"""Host-visible device synchronization that survives broken
+`block_until_ready` semantics.
+
+Measured 2026-07-30 on the axon-tunneled TPU v5 lite backend:
+`jax.block_until_ready` returns in ~0.1 ms for a dispatch whose compute
+takes tens of milliseconds -- over this platform it no longer waits for
+execution, only for enqueue.  Every timing loop that used it as its
+sync point silently started measuring enqueue speed (a 0.5 s "timed
+window" once enqueued 1,671 dispatches that then drained for 26 s),
+and deadline-bounded protocols (ChunkedEks) would calibrate on enqueue
+time and build oversized dispatches that trip the tunnel's ~60 s
+execution deadline, faulting the backend.
+
+`hard_sync` forces a real round trip by materializing one element of
+each array leaf on the host (`jax.device_get` cannot return before the
+producing computation and everything queued ahead of it on the device
+stream has executed).  Cost: one tunnel RTT (~60 ms) per call (one
+leaf is fetched; stream ordering covers the rest) -- always sync a
+whole depth-window of dispatches, never each one.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def hard_sync(tree) -> None:
+    """Block until every array in `tree` (any pytree) has actually been
+    computed, by fetching one element of ONE leaf to the host.
+
+    One fetch suffices: the device stream executes in order, so a
+    gather enqueued after the producing dispatches can only yield its
+    value once everything ahead of it has run -- including every other
+    leaf of the same pytree.  The remaining leaves get a plain
+    block_until_ready (free, and still correct on platforms where it
+    does block)."""
+    import jax
+
+    fetched = False
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array) and not fetched and leaf.size:
+            if leaf.ndim == 0:
+                np.asarray(jax.device_get(leaf))
+            else:
+                # one-element slice: the gather is a dispatch that
+                # depends on `leaf`, so fetching it fences everything
+                # queued before it without transferring the buffer
+                np.asarray(jax.device_get(leaf.ravel()[0]))
+            fetched = True
+        elif isinstance(leaf, jax.Array):
+            jax.block_until_ready(leaf)
+        else:
+            np.asarray(leaf)

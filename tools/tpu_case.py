@@ -133,14 +133,18 @@ def run_case(name: str) -> dict:
     else:
         raise ValueError(f"unknown case {name!r}")
 
+    from dprf_tpu.utils.sync import hard_sync
+
     t0 = time.perf_counter()
-    jax.block_until_ready(run(base))
+    hard_sync(run(base))
     compile_s = time.perf_counter() - t0
-    # time a few dispatches, at least one, up to ~30 s
+    # time a few dispatches, at least one, up to ~30 s; hard_sync, not
+    # block_until_ready, which returns at enqueue over the axon tunnel
+    # (utils/sync.py) and would measure enqueue speed
     per = (B,)
     k, t0 = 0, time.perf_counter()
     while True:
-        jax.block_until_ready(run(base))
+        hard_sync(run(base))
         k += 1
         if time.perf_counter() - t0 > 30.0 or k >= 64:
             break
