@@ -298,7 +298,9 @@ def _config_job(n: int, bcrypt_cost: int):
                  for i in range(1000)]
         return "ntlm", "mask", MaskGenerator("?a?a?a?a?a?a?a"), lines
     if n == 3:     # SHA-256 wordlist + best64, on-device rule expansion
-        gen = WordlistRulesGenerator(_synthetic_words(1 << 17),
+        # 1M words x 77 rules = an 80M keyspace, big enough that a
+        # multi-stride unit amortizes link latency (see unit_strides)
+        gen = WordlistRulesGenerator(_synthetic_words(1 << 20),
                                      load_rules("best64"))
         return "sha256", "wordlist", gen, None
     if n == 4:     # bcrypt wordlist, memory-hard path
@@ -312,9 +314,16 @@ def _config_job(n: int, bcrypt_cost: int):
 
 def run_config(config: int, device: str = "jax", seconds: float = 5.0,
                batch: int = 1 << 18, bcrypt_cost: int = 12,
-               log=None) -> dict:
+               unit_strides: int = 1, log=None) -> dict:
     """Measure one acceptance workload end to end.  Returns the same
-    JSON shape as run_bench, plus the config number."""
+    JSON shape as run_bench, plus the config number.
+
+    unit_strides: worker batches per WorkUnit.  Real jobs get units
+    from the Dispatcher that span MANY device batches, and the worker
+    pipelines their dispatches before reading hits back -- so over a
+    high-latency link a one-stride unit measures the round trip, not
+    the chip.  Pass enough strides for a few seconds of compute per
+    process() call to reproduce the production shape."""
     import time as _time
 
     from dprf_tpu.runtime.worker import CpuWorker
@@ -343,11 +352,12 @@ def run_config(config: int, device: str = "jax", seconds: float = 5.0,
         log.info("config compiled", config=config,
                  seconds=f"{compile_s:.1f}")
 
+    unit_len = stride * max(1, unit_strides)
     tested = 0
     start = 0
     t0 = _time.perf_counter()
     while _time.perf_counter() - t0 < seconds:
-        length = min(stride, gen.keyspace - start)
+        length = min(unit_len, gen.keyspace - start)
         if length <= 0:
             start = 0
             continue
@@ -368,6 +378,7 @@ def run_config(config: int, device: str = "jax", seconds: float = 5.0,
         "targets": len(targets),
         "device": platform,
         "batch": batch,
+        "unit_strides": max(1, unit_strides),
         "tested": tested,
         "elapsed_s": round(elapsed, 3),
         "compile_s": round(compile_s, 1),

@@ -150,6 +150,11 @@ def _build_parser() -> argparse.ArgumentParser:
                    "and N chips and report per-chip rate + efficiency")
     b.add_argument("--bcrypt-cost", type=int, default=12,
                    help="cost for --config 4 (lower it off-TPU)")
+    b.add_argument("--unit-strides", type=int, default=1, metavar="K",
+                   help="--config mode: device batches per WorkUnit; "
+                   "real Dispatcher units span many batches, and over "
+                   "a high-latency link a 1-stride unit measures the "
+                   "round trip, not the chip")
     b.add_argument("--profile", default=None, metavar="DIR")
     b.add_argument("--quiet", "-q", action="store_true")
 
@@ -815,7 +820,8 @@ def cmd_bench(args, log: Log) -> int:
             res = run_config(args.config,
                              device=_DEVICE_ALIASES[args.device],
                              seconds=args.seconds, batch=args.batch,
-                             bcrypt_cost=args.bcrypt_cost, log=log)
+                             bcrypt_cost=args.bcrypt_cost,
+                             unit_strides=args.unit_strides, log=log)
         else:
             res = run_bench(engine=args.engine,
                             device=_DEVICE_ALIASES[args.device],

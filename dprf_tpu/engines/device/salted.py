@@ -250,6 +250,12 @@ class _SaltedWorkerBase:
         salt, salt_len, tgt = self._targs[ti]
         return self.step(base, n, salt, salt_len, tgt)
 
+    def _batch_flag(self, result):
+        """Scalar that is nonzero iff this batch needs host attention
+        (hits or overflow); override with any extra buffers.  See
+        runtime/worker.py MaskWorkerBase._batch_flag."""
+        return result[0]
+
     def _accept(self, ti: int, gidx: int, plain: bytes) -> bool:
         """Final say on a device-reported lane.  Workers whose device
         compare is a narrow prefilter (e.g. zip2's 2-byte password
@@ -291,11 +297,19 @@ class SaltedMaskWorker(_SaltedWorkerBase):
         hits: list[Hit] = []
         for ti in range(len(self.targets)):
             queued = []
+            flag = None
             for bstart in range(unit.start, unit.end, self.stride):
                 n_valid = min(self.stride, unit.end - bstart)
                 base = jnp.asarray(self.gen.digits(bstart), dtype=jnp.int32)
-                queued.append((bstart, self._invoke(
-                    ti, base, jnp.int32(n_valid))))
+                result = self._invoke(ti, base, jnp.int32(n_valid))
+                # device-accumulated unit flag: one host readback per
+                # (target, unit) when nothing hit -- see
+                # runtime/worker.py MaskWorkerBase.process
+                f = self._batch_flag(result)
+                flag = f if flag is None else flag + f
+                queued.append((bstart, result))
+            if flag is None or int(flag) == 0:
+                continue
             for bstart, (count, lanes, _) in queued:
                 count = int(count)
                 if count == 0:
@@ -330,12 +344,18 @@ class SaltedWordlistWorker(_SaltedWorkerBase):
         hits: list[Hit] = []
         for ti in range(len(self.targets)):
             queued = []
+            flag = None
             for ws in range(w_start, w_end, self.word_batch):
                 nw = min(self.word_batch, w_end - ws, self.gen.n_words - ws)
                 if nw <= 0:
                     break
-                queued.append((ws, nw, self._invoke(
-                    ti, jnp.int32(ws), jnp.int32(nw))))
+                result = self._invoke(ti, jnp.int32(ws), jnp.int32(nw))
+                # device-accumulated unit flag (see mask worker above)
+                f = self._batch_flag(result)
+                flag = f if flag is None else flag + f
+                queued.append((ws, nw, result))
+            if flag is None or int(flag) == 0:
+                continue
             for ws, nw, (count, lanes, _) in queued:
                 count = int(count)
                 if count == 0:
@@ -378,12 +398,18 @@ class ShardedSaltedMaskWorker(SaltedMaskWorker):
         hits: list[Hit] = []
         for ti in range(len(self.targets)):
             queued = []
+            flag = None
             for bstart in range(unit.start, unit.end, self.stride):
                 n_valid = min(self.stride, unit.end - bstart)
                 base = jnp.asarray(self.gen.digits(bstart),
                                    dtype=jnp.int32)
-                queued.append((bstart, self._invoke(
-                    ti, base, jnp.int32(n_valid))))
+                result = self._invoke(ti, base, jnp.int32(n_valid))
+                # device-accumulated unit flag (total is psum'd)
+                f = self._batch_flag(result)
+                flag = f if flag is None else flag + f
+                queued.append((bstart, result))
+            if flag is None or int(flag) == 0:
+                continue
             for bstart, (total, counts, lanes, _) in queued:
                 if int(total) == 0:
                     continue

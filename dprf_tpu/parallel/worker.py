@@ -103,11 +103,18 @@ class ShardedWordlistWorker(WordlistWorkerBase):
         import jax.numpy as jnp
         w_start, w_end = word_cover_range(unit, self.gen.n_rules)
         queued = []
+        flag = None
         for ws in range(w_start, w_end, self.super_words):
             nw = min(self.super_words, w_end - ws, self.gen.n_words - ws)
             if nw <= 0:
                 break
-            queued.append((ws, nw, self.step(jnp.int32(ws), jnp.int32(nw))))
+            result = self.step(jnp.int32(ws), jnp.int32(nw))
+            # device-accumulated unit flag; see MaskWorkerBase.process
+            f = self._batch_flag(result)
+            flag = f if flag is None else flag + f
+            queued.append((ws, nw, result))
+        if flag is None or int(flag) == 0:
+            return []
         hits: list[Hit] = []
         for ws, nw, result in queued:
             total, counts, lanes, tpos = result

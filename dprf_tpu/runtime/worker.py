@@ -126,13 +126,32 @@ class MaskWorkerBase:
         # fault would land on the first real batch instead
         hard_sync(self.step(base, jnp.int32(0)))
 
+    def _batch_flag(self, result):
+        """Scalar that is nonzero iff this batch needs host attention
+        (hits or overflow).  Element 0 of every step result is its hit
+        count; subclasses with extra buffers override."""
+        return result[0]
+
     def process(self, unit: WorkUnit) -> list[Hit]:
         import jax.numpy as jnp
         queued = []
+        flag = None
         for bstart in range(unit.start, unit.end, self.stride):
             n_valid = min(self.stride, unit.end - bstart)
             base = jnp.asarray(self.gen.digits(bstart), dtype=jnp.int32)
-            queued.append((bstart, self.step(base, jnp.int32(n_valid))))
+            result = self.step(base, jnp.int32(n_valid))
+            # unit-level hit indicator, accumulated ON DEVICE: scalar
+            # adds ride the stream behind their batches, so the single
+            # int() below is the only host readback a hitless unit
+            # pays.  Per-batch count fetches would cost one link round
+            # trip per batch -- over a high-latency transport (the axon
+            # tunnel: ~60 ms RTT) that caps throughput at
+            # batch/RTT regardless of chip speed.
+            f = self._batch_flag(result)
+            flag = f if flag is None else flag + f
+            queued.append((bstart, result))
+        if flag is None or int(flag) == 0:
+            return []
         hits: list[Hit] = []
         for bstart, result in queued:
             hits.extend(self._batch_hits(bstart, result, unit))
@@ -230,11 +249,18 @@ class DeviceWordlistWorker(WordlistWorkerBase):
         import jax.numpy as jnp
         w_start, w_end = word_cover_range(unit, self.gen.n_rules)
         queued = []
+        flag = None
         for ws in range(w_start, w_end, self.word_batch):
             nw = min(self.word_batch, w_end - ws, self.gen.n_words - ws)
             if nw <= 0:
                 break
-            queued.append((ws, nw, self.step(jnp.int32(ws), jnp.int32(nw))))
+            result = self.step(jnp.int32(ws), jnp.int32(nw))
+            # device-accumulated unit flag; see MaskWorkerBase.process
+            f = self._batch_flag(result)
+            flag = f if flag is None else flag + f
+            queued.append((ws, nw, result))
+        if flag is None or int(flag) == 0:
+            return []
         hits: list[Hit] = []
         for ws, nw, result in queued:
             count, lanes, tpos = result
@@ -294,6 +320,11 @@ class PallasMaskWorker(MaskWorkerBase):
             self.step = make_pallas_mask_crack_step(
                 engine.name, gen, np.asarray(tgt), batch, hit_capacity,
                 interpret=interpret)
+
+    def _batch_flag(self, result):
+        if not self.multi:
+            return result[0]
+        return result[0] + result[2]   # single maybes + collided tiles
 
     def _batch_hits(self, bstart: int, result, unit: WorkUnit) -> list[Hit]:
         if not self.multi:

@@ -1,53 +1,118 @@
-"""One TPU-client session: Mosaic lowering proof + kernel benches.
+"""TPU measurement session: an orchestrator + per-stage client children.
 
 The axon tunnel serves ONE client at a time and wedges if a client is
-killed mid-handshake (see tools/tpu_probe.py).  So this script does all
-real-TPU work for a round in a single process, reports progress through
-a status file (atomic replace, poll it -- NEVER kill this process), and
-exits cleanly whatever happens.
+killed mid-handshake (tools/tpu_probe.py).  Round 3 ran all stages in
+one client process with manual case ordering, and a bcrypt kernel
+fault poisoned the in-process backend and corrupted the following
+cases (TPU_PROBE_LOG_r03 session W1).  This round EVERY stage runs in
+its own child process (VERDICT r3 #6):
 
-Stages:
-  1. lowering -- compile + run every Pallas kernel variant on the real
-     chip with a planted target; record compile time and correctness.
-  2. bench    -- sustained H/s for the MD5 kernel and the XLA pipeline
-     (the BENCH north-star paths), plus NTLM multi-target and SHA-256.
+  - the parent NEVER imports jax (it must not hold the single client
+    slot) -- it spawns `tpu_session.py --child STAGE`, polls the
+    stage's result file, and merges results;
+  - children exit cleanly whatever happens, releasing the slot;
+  - nothing is ever killed -- a hung child is abandoned after its
+    deadline (recorded as timeout) and the next child simply tries to
+    connect;
+  - each finished stage is scanned for the poisoned-backend signature
+    (physically impossible rates; "TPU device error" strings) and
+    flagged, so one faulting stage leaves a visible mark instead of
+    silently corrupting the session.
 
-Results land in TPU_SESSION_OUT (default /tmp/tpu_session_results.json).
+Stages (each one client process):
+  kernels    -- Mosaic lowering + planted-target proof for all Pallas
+                kernel variants
+  bench_fast -- sustained H/s for the md5/ntlm/sha1/sha256 kernels and
+                the XLA pipeline (the BENCH north-star paths)
+  config1..5 -- the five BASELINE.json acceptance workloads through
+                the REAL worker paths (dprf_tpu.bench.run_config);
+                config 4 uses the deadline-bounded chunked bcrypt
+                protocol at a small batch (cost 12 is ~0.3 H/s -- the
+                batch IS the time budget)
+  sweep      -- SUB tuning sweep (opt-in; SUB=128 is the r3 winner)
+
+Usage:
+  python tools/tpu_session.py                  # default round plan
+  python tools/tpu_session.py kernels config3  # just those stages
+  python tools/tpu_session.py --child STAGE --out PATH   # internal
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 import traceback
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 STATUS = os.environ.get("TPU_SESSION_STATUS", "/tmp/tpu_session_status.json")
 OUT = os.environ.get("TPU_SESSION_OUT", "/tmp/tpu_session_results.json")
+WORKDIR = os.environ.get("TPU_SESSION_WORKDIR", "/tmp/tpu_session_stages")
 
-RESULTS = {"stages": {}, "started": time.time()}
+#: per-stage wall deadlines (compile + measure + tunnel RTTs), seconds.
+#: Children are ABANDONED (never killed) past the deadline.
+DEADLINES = {
+    "kernels": 900,
+    "bench_fast": 1500,
+    "config1": 600,
+    "config2": 600,
+    "config3": 900,
+    "config4": 900,
+    "config5": 900,
+    "sweep": 1200,
+}
+
+DEFAULT_PLAN = ["kernels", "bench_fast", "config1", "config2", "config3",
+                "config5", "config4"]   # bcrypt last: slowest, riskiest
+
+#: a single-chip rate above this is physically impossible for any
+#: engine here (md5 roofline ~8e9 H/s; see BASELINE.md) -- it is the
+#: signature of a dead backend completing dispatches with poisoned
+#: buffers, or of enqueue-speed timing (utils/sync.py).
+POISON_RATE = 5e10
 
 
-def write_status(stage, **kw):
-    tmp = STATUS + ".tmp"
+# ---------------------------------------------------------------- children
+
+def _atomic_write(path, doc):
+    tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        json.dump({"stage": stage, "t": time.time(), **kw}, f)
+        json.dump(doc, f, indent=1)
         f.write("\n")
-    os.replace(tmp, STATUS)
+    os.replace(tmp, path)
 
 
-def flush_results():
-    tmp = OUT + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(RESULTS, f, indent=1)
-        f.write("\n")
-    os.replace(tmp, OUT)
+class StageIO:
+    """Progress + result reporting for one child stage."""
+
+    def __init__(self, name, out_path):
+        self.name = name
+        self.out_path = out_path
+        self.doc = {"stage": name, "started": time.time(),
+                    "results": {}, "done": False}
+
+    def status(self, case, **kw):
+        self.doc["now"] = {"case": case, "t": time.time(), **kw}
+        _atomic_write(self.out_path, self.doc)
+
+    def record(self, case, result):
+        self.doc["results"][case] = result
+        _atomic_write(self.out_path, self.doc)
+
+    def finish(self, ok=True, **kw):
+        self.doc["done"] = True
+        self.doc["ok"] = ok
+        self.doc["finished"] = time.time()
+        self.doc.update(kw)
+        _atomic_write(self.out_path, self.doc)
 
 
-def plant_target(engine_name, gen, index):
+def _plant_target(engine_name, gen, index):
     """CPU-oracle digest words for the candidate at `index`."""
     import numpy as np
+
     from dprf_tpu import get_engine
     oracle = get_engine(engine_name, device="cpu")
     cand = gen.candidate(index)
@@ -56,30 +121,32 @@ def plant_target(engine_name, gen, index):
     return np.frombuffer(digest, dtype=dt).astype(np.uint32), cand
 
 
-def check_lowering():
+def stage_kernels(io: StageIO):
+    """Compile + run every Pallas kernel variant with a planted target."""
     import numpy as np
-    import jax
+    import jax.numpy as jnp
+
     from dprf_tpu.generators.mask import MaskGenerator
     from dprf_tpu.ops import pallas_mask as pm
+    from dprf_tpu.utils.sync import hard_sync
 
     cases = [
         ("md5", "?l?l?l?l?l?l", 1),
         ("sha1", "?l?l?l?l?l?l", 1),
         ("ntlm", "?a?a?a?a?a?a?a", 1),
         ("sha256", "?l?l?l?l?l?l?l?l", 1),
-        ("md5", "?a?a?a?a?a?a?a", 1000),   # Bloom multi-target gather
+        ("md5", "?a?a?a?a?a?a?a", 1000),   # Bloom multi-target
         ("ntlm", "?a?a?a?a?a?a?a", 1000),
     ]
-    out = {}
     for engine, mask, n_targets in cases:
         name = f"{engine}/{n_targets}t"
-        write_status("lowering", case=name)
+        io.status(name)
         rec = {"engine": engine, "mask": mask, "targets": n_targets}
         try:
             gen = MaskGenerator(mask)
             batch = pm.TILE * 4
             plant_idx = pm.TILE + 7   # tile 1, lane 7
-            tw, cand = plant_target(engine, gen, plant_idx)
+            tw, _ = _plant_target(engine, gen, plant_idx)
             if n_targets > 1:
                 rng = np.random.RandomState(42)
                 tws = rng.randint(0, 2**32, (n_targets, tw.shape[0]),
@@ -88,44 +155,33 @@ def check_lowering():
                 tw = tws
             t0 = time.perf_counter()
             fn = pm.make_mask_pallas_fn(engine, gen, tw, batch)
-            import jax.numpy as jnp
             base = jnp.asarray(gen.digits(0), jnp.int32)
-            counts, lanes = jax.block_until_ready(
-                fn(base, jnp.asarray([batch], jnp.int32)))
+            out = fn(base, jnp.asarray([batch], jnp.int32))
+            hard_sync(out)
             rec["compile_s"] = round(time.perf_counter() - t0, 2)
-            counts = np.asarray(counts)[:, 0]
-            lanes = np.asarray(lanes)[:, 0]
+            counts = np.asarray(out[0])[:, 0]
+            lanes = np.asarray(out[1])[:, 0]
             hits = [(t * pm.TILE + lanes[t]) for t in np.nonzero(counts)[0]]
             if n_targets > 1:
-                # multi-target counts are Bloom MAYBE counts: the planted
-                # hit must be present; a stray false maybe (p ~ 1.5e-5 per
-                # lane) is tolerated, not a failure.
+                # multi-target counts are Bloom MAYBE counts: the
+                # planted hit must be present; a stray false maybe
+                # (p ~ 1.5e-5/lane) is tolerated, not a failure
                 rec["ok"] = (plant_idx in hits and int(counts.sum()) <= 3)
             else:
                 rec["ok"] = (int(counts.sum()) == 1 and hits == [plant_idx])
             rec["hits"] = [int(h) for h in hits]
-            if not rec["ok"]:
-                rec["counts_nonzero"] = int((counts > 0).sum())
-        except Exception as e:  # record, keep going
+        except Exception as e:   # record, keep going
             rec["ok"] = False
             rec["error"] = f"{type(e).__name__}: {e}"
             rec["traceback"] = traceback.format_exc()[-1500:]
-        out[name] = rec
-        RESULTS["stages"]["lowering"] = out
-        flush_results()
-    return out
+        io.record(name, rec)
 
 
-from dprf_tpu.bench import calibrated_inner as _calibrated_inner
+def stage_bench_fast(io: StageIO):
+    """Sustained kernel/pipeline H/s (run_bench does honest hard_sync
+    timing internally)."""
+    from dprf_tpu.bench import calibrated_inner, run_bench
 
-
-def bench_all():
-    """Each case: calibrate with a short inner loop (one dispatch, so
-    the ~0.4 s/round-trip tunnel latency can't dominate), then measure
-    ~3 dispatches at a ~5 s inner loop.  run_bench(inner=...) does the
-    device-side looping."""
-    from dprf_tpu.bench import run_bench
-    out = {}
     runs = [
         ("md5-pallas", dict(engine="md5", impl="pallas", batch=1 << 22)),
         ("md5-xla", dict(engine="md5", impl="xla", batch=1 << 22)),
@@ -137,41 +193,62 @@ def bench_all():
         ("sha256-xla", dict(engine="sha256", impl="xla", batch=1 << 21)),
     ]
     for name, kw in runs:
-        write_status("bench", case=name, phase="calibrate")
+        io.status(name, phase="calibrate")
         try:
             cal = run_bench(device="jax", seconds=0.1, inner=16, **kw)
-            inner = _calibrated_inner(cal["value"], kw["batch"])
-            write_status("bench", case=name, phase="measure",
-                         inner=inner, cal_hs=cal["value"])
-            out[name] = run_bench(device="jax", seconds=15.0,
-                                  inner=inner, **kw)
-            out[name]["calibrate_hs"] = cal["value"]
+            inner = calibrated_inner(cal["value"], kw["batch"])
+            io.status(name, phase="measure", inner=inner,
+                      cal_hs=cal["value"])
+            res = run_bench(device="jax", seconds=15.0, inner=inner, **kw)
+            res["calibrate_hs"] = cal["value"]
         except Exception as e:
-            out[name] = {"error": f"{type(e).__name__}: {e}",
-                         "traceback": traceback.format_exc()[-1500:]}
-        RESULTS["stages"]["bench"] = out
-        flush_results()
-    return out
+            res = {"error": f"{type(e).__name__}: {e}",
+                   "traceback": traceback.format_exc()[-1500:]}
+        io.record(name, res)
 
 
-def sweep_sub():
-    """Raw kernel throughput vs SUB (sublanes per grid cell): the main
-    tuning knob.  Times the bare pallas fn (no worker machinery) on an
-    unmatchable target, with a device-side fori_loop per dispatch so
-    tunnel latency can't mask the differences between SUB values."""
+#: per-config run_config kwargs: batch sized so one worker stride is
+#: seconds (fast engines) or one deadline-safe chunked batch (bcrypt).
+CONFIG_ARGS = {
+    # unit_strides sized for ~60-200 ms of compute per WorkUnit so the
+    # one-readback-per-unit worker path amortizes the ~60 ms tunnel RTT
+    1: dict(seconds=15.0, batch=1 << 22, unit_strides=64),
+    2: dict(seconds=15.0, batch=1 << 22, unit_strides=256),
+    3: dict(seconds=20.0, batch=1 << 20, unit_strides=64),
+    # cost 12 at ~0.3 H/s: one 64-lane chunked batch is ~3.5 min of
+    # deadline-bounded dispatches; seconds only gates NEW strides
+    4: dict(seconds=1.0, batch=64, bcrypt_cost=12),
+    5: dict(seconds=20.0, batch=1 << 14, unit_strides=8),
+}
+
+
+def _stage_config(n):
+    def run(io: StageIO):
+        from dprf_tpu.bench import run_config
+        io.status(f"config{n}")
+        res = run_config(n, device="jax", **CONFIG_ARGS[n])
+        io.record(f"config{n}", res)
+    run.__name__ = f"stage_config{n}"
+    return run
+
+
+def stage_sweep(io: StageIO):
+    """Raw kernel throughput vs SUB (sublanes per grid cell)."""
     import numpy as np
     import jax
     import jax.numpy as jnp
     from jax import lax
+
+    from dprf_tpu.bench import calibrated_inner
     from dprf_tpu.generators.mask import MaskGenerator
     from dprf_tpu.ops import pallas_mask as pm
+    from dprf_tpu.utils.sync import hard_sync
 
     gen = MaskGenerator("?a?a?a?a?a?a?a?a")
     tw = np.full((4,), 0xFFFFFFFF, np.uint32)   # unmatchable
-    out = {}
     for sub in (8, 16, 32, 64, 128):
         name = f"sub{sub}"
-        write_status("sweep", case=name)
+        io.status(name)
         try:
             tile = sub * 128
             batch = (max(1 << 22, tile) // tile) * tile
@@ -188,194 +265,160 @@ def sweep_sub():
                 return run
 
             base = jnp.asarray(gen.digits(0), jnp.int32)
-            # calibrate: compile first, then time ONE 16-iter dispatch
-            # (timing the compile here would collapse `inner` and
-            # re-measure tunnel latency -- the bug this sweep fixes)
             cal = looped(16)
-            jax.block_until_ready(cal(base))
+            hard_sync(cal(base))            # compile
             t0 = time.perf_counter()
-            jax.block_until_ready(cal(base))
+            hard_sync(cal(base))
             cal_s = time.perf_counter() - t0
             rate = 16 * batch / max(cal_s, 1e-3)
-            inner = _calibrated_inner(rate, batch)
+            inner = calibrated_inner(rate, batch)
             run = looped(inner)
-            jax.block_until_ready(run(base))       # compile
+            hard_sync(run(base))            # compile
             n, t0 = 0, time.perf_counter()
             while time.perf_counter() - t0 < 10.0:
-                jax.block_until_ready(run(base))
+                hard_sync(run(base))
                 n += 1
             dt = time.perf_counter() - t0
-            out[name] = {"sub": sub, "hs": n * inner * batch / dt,
-                         "batch": batch, "inner": inner,
-                         "dispatches": n, "cal_hs": rate}
+            io.record(name, {"sub": sub, "hs": n * inner * batch / dt,
+                             "batch": batch, "inner": inner,
+                             "dispatches": n, "cal_hs": rate})
         except Exception as e:
-            out[name] = {"sub": sub,
-                         "error": f"{type(e).__name__}: {e}"}
-        RESULTS["stages"]["sweep"] = out
-        flush_results()
-    return out
+            io.record(name, {"sub": sub,
+                             "error": f"{type(e).__name__}: {e}"})
 
 
-def bench_slow_engines():
-    """The iterated/memory-hard acceptance paths (configs 4/5 + scrypt)
-    measured as raw fused steps with device-side loops.  Each step's
-    own iteration structure (fori_loop x 4096 for PBKDF2, 2^cost
-    EksBlowfish rounds, N BlockMix rounds) already amortizes dispatch
-    latency, but the looped wrapper still batches a few steps per
-    round trip."""
-    import numpy as np
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
+STAGES = {
+    "kernels": stage_kernels,
+    "bench_fast": stage_bench_fast,
+    "sweep": stage_sweep,
+    **{f"config{n}": _stage_config(n) for n in range(1, 6)},
+}
 
-    from dprf_tpu import get_engine
-    from dprf_tpu.generators.mask import MaskGenerator
 
-    out = {}
-
-    def timed(name, fn, base, per_dispatch, seconds=15.0):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn(base))
-        compile_s = time.perf_counter() - t0
-        n, t0 = 0, time.perf_counter()
-        while time.perf_counter() - t0 < seconds:
-            jax.block_until_ready(fn(base))
-            n += 1
-        dt = time.perf_counter() - t0
-        out[name] = {"hs": n * per_dispatch / dt,
-                     "per_dispatch": per_dispatch, "dispatches": n,
-                     "compile_s": round(compile_s, 1),
-                     "elapsed_s": round(dt, 2)}
-
-    # -- PMKID (config 5): PBKDF2-HMAC-SHA1 x 4096 + PMKID compare
-    write_status("slow", case="pmkid")
+def child_main(stage: str, out_path: str) -> int:
+    io = StageIO(stage, out_path)
+    io.status("connect")
     try:
-        from dprf_tpu.engines.device.pmkid import make_pmkid_crack_step
-        eng = get_engine("wpa2-pmkid", device="jax")
-        tgt = eng.parse_target(
-            "%s*0a1b2c3d4e5f*a0b1c2d3e4f5*%s" % ("ff" * 16,
-                                                b"benchnet".hex()))
-        gen = MaskGenerator("?l?l?l?l?l?l?l?l")
-        B = 1 << 12
-        step = make_pmkid_crack_step(eng, gen, [tgt], B)
-
-        @jax.jit
-        def run(base):
-            def body(i, acc):
-                o = step(base.at[-1].add(i), jnp.int32(B))
-                return acc + o[0]
-            return lax.fori_loop(0, 4, body, jnp.int32(0))
-
-        timed("pmkid", run, jnp.asarray(gen.digits(0), jnp.int32), 4 * B)
+        import jax
+        devs = jax.devices()
+        io.doc["devices"] = [str(d) for d in devs]
+        io.doc["platform"] = devs[0].platform
+        if devs[0].platform != "tpu":
+            io.finish(ok=False, note="no TPU visible")
+            return 1
+        STAGES[stage](io)
+        io.finish(ok=True)
+        return 0
     except Exception as e:
-        out["pmkid"] = {"error": f"{type(e).__name__}: {e}",
-                        "traceback": traceback.format_exc()[-1200:]}
-    RESULTS["stages"]["slow"] = out
-    flush_results()
-
-    # -- LM / bitslice DES (fast-hash class; here because it shares
-    # the custom-loop harness)
-    write_status("slow", case="lm")
-    try:
-        from dprf_tpu.engines.device.lm import make_lm_mask_step
-        from dprf_tpu.engines.base import Target
-        gen = MaskGenerator("?u?u?u?u?u?u?u")
-        B = 1 << 20
-        tgt = Target(raw="bench", digest=bytes(8))   # unmatchable-ish
-        step = make_lm_mask_step(gen, [tgt], B)
-
-        @jax.jit
-        def run(base):
-            def body(i, acc):
-                o = step(base.at[-1].add(i), jnp.int32(B))
-                return acc + o[0]
-            return lax.fori_loop(0, 64, body, jnp.int32(0))
-
-        timed("lm", run, jnp.asarray(gen.digits(0), jnp.int32), 64 * B)
-    except Exception as e:
-        out["lm"] = {"error": f"{type(e).__name__}: {e}",
-                     "traceback": traceback.format_exc()[-1200:]}
-    RESULTS["stages"]["slow"] = out
-    flush_results()
-
-    # -- scrypt 16384:8:1 (the common interactive parameter set)
-    write_status("slow", case="scrypt")
-    try:
-        from dprf_tpu.ops.hmac import pack_raw_varlen
-        from dprf_tpu.ops.scrypt import scrypt_dk
-        gen = MaskGenerator("?l?l?l?l?l?l?l?l")
-        B = 1 << 8           # V = B * 16 MiB = 4 GiB HBM
-        flat = gen.flat_charsets
-
-        @jax.jit
-        def run(base):
-            cand = gen.decode_batch(base, flat, B)
-            kw = pack_raw_varlen(cand, jnp.full((B,), 8, jnp.int32),
-                                 True)
-            salt = jnp.zeros((51,), jnp.uint8)
-            dk = scrypt_dk(kw, salt, jnp.int32(8), 16384, 8, 1)
-            return dk.sum()
-
-        timed("scrypt", run, jnp.asarray(gen.digits(0), jnp.int32), B,
-              seconds=30.0)
-    except Exception as e:
-        out["scrypt"] = {"error": f"{type(e).__name__}: {e}",
-                         "traceback": traceback.format_exc()[-1200:]}
-    RESULTS["stages"]["slow"] = out
-    flush_results()
-    # -- bcrypt (config 4's path) at cost 8: the S-box gathers
-    # serialize with batch AND rounds, so a cost-12 dispatch (~218 s)
-    # exceeds the tunnel's ~60 s execution deadline at any batch and
-    # faults the whole client backend (measured 2026-07-30); cost 8 at
-    # B=64 (~14 s dispatches) measures the same code path safely --
-    # scale the number by 1/16 for the cost-12 figure.
-    write_status("slow", case="bcrypt8")
-    try:
-        from dprf_tpu.engines.device.bcrypt import make_bcrypt_mask_step
-        gen = MaskGenerator("?l?l?l?l?l?l")
-        B = 64
-        step = make_bcrypt_mask_step(gen, B)
-        salt_words = jnp.asarray(
-            np.frombuffer(bytes(range(16)), ">u4").astype(np.uint32))
-        tgt = jnp.full((6,), 0xFFFFFFFF, jnp.uint32)
-
-        @jax.jit
-        def run(base):
-            o = step(base, jnp.int32(B), salt_words,
-                     jnp.int32(1 << 8), tgt)
-            return o[0]
-
-        timed("bcrypt8", run, jnp.asarray(gen.digits(0), jnp.int32), B,
-              seconds=30.0)
-    except Exception as e:
-        out["bcrypt8"] = {"error": f"{type(e).__name__}: {e}",
-                         "traceback": traceback.format_exc()[-1200:]}
-    RESULTS["stages"]["slow"] = out
-    flush_results()
-
-    return out
-
-
-def main():
-    write_status("starting", pid=os.getpid())
-    import jax
-    devs = jax.devices()
-    RESULTS["devices"] = [str(d) for d in devs]
-    RESULTS["platform"] = devs[0].platform
-    write_status("devices", devices=RESULTS["devices"])
-    flush_results()
-    if devs[0].platform != "tpu":
-        write_status("done", ok=False, note="no TPU")
+        io.finish(ok=False, error=f"{type(e).__name__}: {e}",
+                  traceback=traceback.format_exc()[-2000:])
         return 1
-    check_lowering()
-    sweep_sub()
-    bench_all()
-    bench_slow_engines()
-    RESULTS["finished"] = time.time()
-    flush_results()
-    write_status("done", ok=True)
-    print("TPU session complete")
+
+
+# ------------------------------------------------------------ orchestrator
+
+def _scan_poison(node, flags, path=""):
+    """Flag physically impossible rates and backend-fault errors."""
+    if isinstance(node, dict):
+        v = node.get("value", node.get("hs", 0))
+        if isinstance(v, (int, float)) and v > POISON_RATE:
+            flags.append(f"{path}: rate {v:.3g} exceeds physical cap")
+        err = node.get("error", "")
+        if isinstance(err, str) and "TPU device error" in err:
+            flags.append(f"{path}: backend fault ({err[:80]})")
+        for k, val in node.items():
+            _scan_poison(val, flags, f"{path}/{k}")
+
+
+def write_status(stage, **kw):
+    _atomic_write(STATUS, {"stage": stage, "t": time.time(), **kw})
+
+
+def orchestrate(plan) -> int:
+    os.makedirs(WORKDIR, exist_ok=True)
+    results = {"round": 4, "plan": plan, "started": time.time(),
+               "stages": {}, "poison_flags": []}
+    for stage in plan:
+        out_path = os.path.join(WORKDIR, f"{stage}.json")
+        log_path = os.path.join(WORKDIR, f"{stage}.log")
+        try:
+            os.unlink(out_path)
+        except FileNotFoundError:
+            pass
+        write_status("spawn", child=stage)
+        with open(log_path, "ab") as log:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--child", stage, "--out", out_path],
+                stdout=log, stderr=log, start_new_session=True,
+                cwd=REPO)
+        deadline = DEADLINES.get(stage, 600)
+        t0 = time.monotonic()
+        doc = None
+        while time.monotonic() - t0 < deadline:
+            try:
+                with open(out_path) as f:
+                    doc = json.load(f)
+            except (FileNotFoundError, ValueError):
+                doc = None
+            if doc is not None and doc.get("done"):
+                break
+            if proc.poll() is not None:
+                # child EXITED (crash/OOM -- a clean child always
+                # writes done first); its file can no longer change,
+                # so don't burn the rest of the deadline.  One last
+                # read below picks up whatever it managed to record.
+                try:
+                    with open(out_path) as f:
+                        doc = json.load(f)
+                except (FileNotFoundError, ValueError):
+                    doc = None
+                if doc is None or not doc.get("done"):
+                    doc = dict(doc or {"stage": stage, "results": {}},
+                               died=True, exit_code=proc.returncode)
+                break
+            write_status("wait", child=stage,
+                         elapsed=round(time.monotonic() - t0),
+                         now=(doc or {}).get("now"))
+            time.sleep(3)
+        if doc is None:
+            doc = {"stage": stage, "timeout": True, "results": {}}
+        elif not doc.get("done"):
+            doc.setdefault("died", False)
+            doc["timeout"] = not doc["died"]   # partials are still real
+        results["stages"][stage] = doc
+        flags = []
+        # scan the WHOLE stage doc: a backend fault that escapes a
+        # stage's per-case handler lands in doc["error"] via
+        # io.finish(ok=False), not under results
+        _scan_poison(doc, flags, stage)
+        if flags:
+            results["poison_flags"].extend(flags)
+            write_status("poison_flagged", child=stage, flags=flags)
+        _atomic_write(OUT, results)
+        # let the child exit and release the single client slot; an
+        # abandoned (timed-out) child gets a grace period instead
+        time.sleep(15 if doc.get("timeout") else 5)
+    results["finished"] = time.time()
+    _atomic_write(OUT, results)
+    write_status("done", ok=True,
+                 poison_flags=results["poison_flags"])
+    print(f"TPU session complete: {len(plan)} stages, "
+          f"{len(results['poison_flags'])} poison flags -> {OUT}")
     return 0
+
+
+def main() -> int:
+    args = sys.argv[1:]
+    if args and args[0] == "--child":
+        return child_main(args[1], args[args.index("--out") + 1])
+    plan = args if args else DEFAULT_PLAN
+    unknown = [s for s in plan if s not in STAGES]
+    if unknown:
+        sys.stderr.write(f"unknown stages: {unknown}; "
+                         f"available: {sorted(STAGES)}\n")
+        return 2
+    return orchestrate(plan)
 
 
 if __name__ == "__main__":
