@@ -46,6 +46,20 @@ def _key_bytes(cand: jnp.ndarray) -> jnp.ndarray:
         jnp.left_shift(cand[:, :8], 1))
 
 
+#: distinct salts folded into ONE jitted step.  Each salt unrolls a
+#: full 25x16-round bitslice circuit into the program, so XLA program
+#: size and compile time grow linearly with salts-per-step; 8 keeps a
+#: step's compile in the tens of seconds.  Workers build one step per
+#: block of salts and sweep them in sequence (ADVICE r3).
+MAX_SALTS_PER_STEP = 8
+
+#: hard cap on distinct salts per job.  descrypt has 4096 possible
+#: salts; a hashlist using hundreds means hundreds of compiled
+#: circuits -- hours of compile for a sweep the CPU oracle finishes
+#: faster.  Fail with direction instead of hanging.
+MAX_DISTINCT_SALTS = 256
+
+
 def _salt_groups(targets: Sequence[Target]):
     """[(salt, [(orig_ti, target_bits), ...]), ...] -- one bitslice
     circuit per distinct salt, all its targets folded into the
@@ -54,7 +68,43 @@ def _salt_groups(targets: Sequence[Target]):
     for ti, t in enumerate(targets):
         groups.setdefault(t.params["salt"], []).append(
             (ti, target_bits(t.digest)))
+    if len(groups) > MAX_DISTINCT_SALTS:
+        raise ValueError(
+            f"descrypt hashlist has {len(groups)} distinct salts; the "
+            f"device engine caps at {MAX_DISTINCT_SALTS} (each salt "
+            "compiles a full bitslice circuit) -- split the hashlist "
+            "or use --device=cpu")
     return sorted(groups.items())
+
+
+def _salt_blocks(groups):
+    """Split salt groups into blocks of MAX_SALTS_PER_STEP for one
+    compiled step each."""
+    return [groups[i:i + MAX_SALTS_PER_STEP]
+            for i in range(0, len(groups), MAX_SALTS_PER_STEP)] or [[]]
+
+
+def _block_tis(block) -> list:
+    """Original target indices covered by one salt block."""
+    return sorted({ti for _, members in block for ti, _ in members})
+
+
+def _scoped_rescan(worker, tis, start: int, end: int) -> list:
+    """Exact host rescan over ONLY the given targets, with hit target
+    indices mapped back to the worker's original list."""
+    from dprf_tpu.runtime.worker import CpuWorker
+
+    if worker.oracle is None:
+        raise RuntimeError(
+            f"hit buffer overflow (> {worker.hit_capacity}) and no "
+            "oracle engine to rescan with; raise hit_capacity")
+    from dprf_tpu.runtime.workunit import WorkUnit as WU
+    sub = WU(-1, start, end - start)
+    hits = CpuWorker(worker.oracle, worker.gen,
+                     [worker.targets[i] for i in tis]).process(sub)
+    from dprf_tpu.runtime.worker import Hit as HitRec
+    return [HitRec(tis[h.target_index], h.cand_index, h.plaintext)
+            for h in hits]
 
 
 def _fold_groups(kplanes, groups, n_lanes: int):
@@ -72,17 +122,17 @@ def _fold_groups(kplanes, groups, n_lanes: int):
     return found_any, tfirst
 
 
-def make_descrypt_mask_step(gen, targets: Sequence[Target], batch: int,
+def _make_mask_step_grouped(gen, groups, batch: int,
                             hit_capacity: int = 64):
-    """step(base_digits, n_valid) -> (count, lanes, tpos); tpos carries
-    ORIGINAL target indices (the LM step contract)."""
+    """One compiled step over ONE block of salt groups (<=
+    MAX_SALTS_PER_STEP circuits); tpos carries ORIGINAL target
+    indices (the LM step contract)."""
     if batch % 32:
         raise ValueError("bitslice batch must be a multiple of 32")
     if gen.length > 8:
         raise ValueError(f"descrypt candidates cap at 8 bytes; mask "
                          f"decodes to {gen.length}")
     flat = gen.flat_charsets
-    groups = _salt_groups(targets)
 
     @jax.jit
     def step(base_digits, n_valid):
@@ -96,8 +146,22 @@ def make_descrypt_mask_step(gen, targets: Sequence[Target], batch: int,
     return step
 
 
-def make_descrypt_wordlist_step(gen, targets: Sequence[Target],
-                                word_batch: int, hit_capacity: int = 64):
+def make_descrypt_mask_step(gen, targets: Sequence[Target], batch: int,
+                            hit_capacity: int = 64):
+    """Single-step factory (all salts in one program): only valid up
+    to MAX_SALTS_PER_STEP distinct salts -- the workers block larger
+    hashlists across several steps."""
+    groups = _salt_groups(targets)
+    if len(groups) > MAX_SALTS_PER_STEP:
+        raise ValueError(
+            f"{len(groups)} distinct salts exceed one step's "
+            f"{MAX_SALTS_PER_STEP}-circuit budget; use the worker "
+            "(it sweeps blocked steps)")
+    return _make_mask_step_grouped(gen, groups, batch, hit_capacity)
+
+
+def _make_wordlist_step_grouped(gen, groups, word_batch: int,
+                                hit_capacity: int = 64):
     from jax import lax
 
     from dprf_tpu.ops.rules_pipeline import expand_rules
@@ -111,7 +175,6 @@ def make_descrypt_wordlist_step(gen, targets: Sequence[Target],
     words_dev = jnp.asarray(words_np)
     lens_dev = jnp.asarray(lens_np)
     rules = gen.rules
-    groups = _salt_groups(targets)
 
     @jax.jit
     def step(w0, n_valid_words):
@@ -132,9 +195,25 @@ def make_descrypt_wordlist_step(gen, targets: Sequence[Target],
     return step
 
 
+def make_descrypt_wordlist_step(gen, targets: Sequence[Target],
+                                word_batch: int, hit_capacity: int = 64):
+    """Single-step factory; see make_descrypt_mask_step."""
+    groups = _salt_groups(targets)
+    if len(groups) > MAX_SALTS_PER_STEP:
+        raise ValueError(
+            f"{len(groups)} distinct salts exceed one step's "
+            f"{MAX_SALTS_PER_STEP}-circuit budget; use the worker "
+            "(it sweeps blocked steps)")
+    return _make_wordlist_step_grouped(gen, groups, word_batch,
+                                       hit_capacity)
+
+
 class DescryptMaskWorker(MaskWorkerBase):
-    """The LM worker shape: one step, one sweep, tpos carries original
-    target indices."""
+    """The LM worker shape -- tpos carries original target indices --
+    except the hashlist's distinct salts are BLOCKED into steps of
+    MAX_SALTS_PER_STEP circuits each, swept in sequence per unit, so
+    a many-salt shadow file bounds each program's size/compile time
+    instead of unrolling everything into one (ADVICE r3)."""
 
     def __init__(self, engine, gen, targets, batch: int = 1 << 17,
                  hit_capacity: int = 64, oracle=None):
@@ -147,8 +226,33 @@ class DescryptMaskWorker(MaskWorkerBase):
         self._order = np.arange(max(1, len(self.targets)), dtype=np.int64)
         batch = max(32, (batch // 32) * 32)
         self.batch = self.stride = batch
-        self.step = make_descrypt_mask_step(gen, self.targets, batch,
-                                            hit_capacity)
+        blocks = _salt_blocks(_salt_groups(self.targets))
+        self._steps = [
+            _make_mask_step_grouped(gen, block, batch, hit_capacity)
+            for block in blocks]
+        self._step_tis = [_block_tis(block) for block in blocks]
+        self.step = self._steps[0]
+        self._current_tis = self._step_tis[0]
+
+    def warmup(self) -> None:
+        for step in self._steps:
+            self.step = step
+            super().warmup()
+
+    def process(self, unit):
+        hits = []
+        for step, tis in zip(self._steps, self._step_tis):
+            self.step = step
+            self._current_tis = tis
+            hits.extend(super().process(unit))
+        return hits
+
+    def _rescan(self, bstart, unit):
+        # scope the exact rescan to THIS block's targets: the base
+        # rescan covers self.targets wholesale, which would double-
+        # report other blocks' hits (their own sweeps find them too)
+        return _scoped_rescan(self, self._current_tis, bstart,
+                              min(bstart + self.stride, unit.end))
 
 
 class DescryptWordlistWorker(DeviceWordlistWorker):
@@ -167,9 +271,34 @@ class DescryptWordlistWorker(DeviceWordlistWorker):
         self.word_batch = max(1, batch // gen.n_rules)
         self.stride = self.word_batch * gen.n_rules
         self.batch = batch
-        self.step = make_descrypt_wordlist_step(gen, self.targets,
-                                                self.word_batch,
-                                                hit_capacity)
+        blocks = _salt_blocks(_salt_groups(self.targets))
+        self._steps = [
+            _make_wordlist_step_grouped(gen, block, self.word_batch,
+                                        hit_capacity)
+            for block in blocks]
+        self._step_tis = [_block_tis(block) for block in blocks]
+        self.step = self._steps[0]
+        self._current_tis = self._step_tis[0]
+
+    def warmup(self) -> None:
+        from dprf_tpu.utils.sync import hard_sync
+        for step in self._steps:
+            hard_sync(step(jnp.int32(0), jnp.int32(0)))
+
+    def process(self, unit):
+        hits = []
+        for step, tis in zip(self._steps, self._step_tis):
+            self.step = step
+            self._current_tis = tis
+            hits.extend(super().process(unit))
+        return hits
+
+    def _rescan_words(self, ws, nw, unit):
+        # block-scoped exact rescan; see DescryptMaskWorker._rescan
+        R = self.gen.n_rules
+        start = max(unit.start, ws * R)
+        end = min(unit.end, (ws + nw) * R)
+        return _scoped_rescan(self, self._current_tis, start, end)
 
 
 @register("descrypt", device="jax")

@@ -378,6 +378,119 @@ class SaltedWordlistWorker(_SaltedWorkerBase):
         return hits
 
 
+class PallasSaltedMaskWorker(SaltedMaskWorker):
+    """Salted mask sweep over the extended Pallas kernels
+    (ops/pallas_ext.py): the whole decode -> concat-salt -> compress
+    -> compare chain stays in VMEM, with the salt bytes and target
+    digest as RUNTIME scalars -- one compiled kernel per distinct salt
+    LENGTH serves the whole hashlist.  Per-target sweep loop, hit
+    contract, rescan, and the unit flag all come from
+    SaltedMaskWorker; only _invoke changes."""
+
+    def __init__(self, engine, gen, targets, algo: str,
+                 batch: int = 1 << 18, hit_capacity: int = 64,
+                 oracle=None, interpret: bool = False):
+        from dprf_tpu.ops import pallas_ext
+        from dprf_tpu.ops.pallas_mask import SUB
+
+        # NOT _SaltedWorkerBase.__init__: its _prep_targets builds
+        # per-target (salt buffer, len, digest) device arrays this
+        # worker never reads -- _kargs below is the kernel-format
+        # equivalent
+        self.engine = engine
+        self.gen = gen
+        self.targets = list(targets)
+        self.hit_capacity = hit_capacity
+        self.oracle = oracle
+        tile = SUB * 128
+        batch = max(tile, (batch // tile) * tile)
+        self.stride = self.batch = batch
+        lens = sorted({len(t.params["salt"]) for t in self.targets})
+        self._ksteps = {
+            n: pallas_ext.make_salted_crack_step(
+                algo, engine.order, gen, batch, n, hit_capacity,
+                interpret=interpret)
+            for n in lens}
+        # per-target runtime args: salt bytes as int32, target words
+        # bit-cast to int32 (SMEM scalars)
+        dt = "<u4" if engine.little_endian else ">u4"
+        self._kargs = []
+        for t in self.targets:
+            salt = t.params["salt"]
+            self._kargs.append((
+                len(salt),
+                jnp.asarray(np.frombuffer(salt, np.uint8)
+                            .astype(np.int32)),
+                jnp.asarray(np.frombuffer(t.digest, dtype=dt)
+                            .astype(np.uint32).view(np.int32))))
+
+    def warmup(self) -> None:
+        """One launch per COMPILED KERNEL (distinct salt length), not
+        per target -- warmup exists to surface compile failures, and a
+        10k-target hashlist shares at most a handful of kernels."""
+        from dprf_tpu.utils.sync import hard_sync
+        base = jnp.asarray(self.gen.digits(0), dtype=jnp.int32)
+        by_len = {n: (salt, tgt) for n, salt, tgt in self._kargs}
+        for n, (salt, tgt) in by_len.items():
+            hard_sync(self._ksteps[n](base, jnp.int32(0), salt, tgt))
+
+    def _invoke(self, ti: int, base, n):
+        slen, salt, tgt = self._kargs[ti]
+        return self._ksteps[slen](base, n, salt, tgt)
+
+
+#: device base class -> kernel core algo for the extended salted
+#: kernels (sha512 has no 32-bit core; engines with pre_salt
+#: transforms or length multipliers pack differently)
+_KERNEL_ALGOS = ((JaxMd5Engine, "md5"), (JaxSha1Engine, "sha1"),
+                 (JaxSha256Engine, "sha256"))
+
+
+def _kernel_algo(engine) -> str | None:
+    if engine.pre_salt is not None or engine.length_multiplier != 1:
+        return None
+    for base, algo in _KERNEL_ALGOS:
+        if isinstance(engine, base):
+            return algo
+    return None
+
+
+def maybe_pallas_salted_worker(engine, gen, targets, batch: int,
+                               hit_capacity: int, oracle):
+    """PallasSaltedMaskWorker when the job is kernel-eligible (warmed,
+    so compile failures surface here), else None -- the factory then
+    builds the XLA-step worker.  Mirrors JaxEngineBase's pallas
+    selection + fallback pattern."""
+    from dprf_tpu.ops import pallas_ext
+    from dprf_tpu.ops.pallas_mask import pallas_mode
+    from dprf_tpu.utils.logging import DEFAULT as log
+
+    mode = pallas_mode()
+    if mode is None:
+        return None
+    algo = _kernel_algo(engine)
+    lens = [len(t.params["salt"]) for t in targets]
+    if algo is None or not pallas_ext.salted_eligible(
+            algo, engine.order, gen, lens):
+        log.info("salted pallas kernel not eligible for this job; "
+                 "using the XLA pipeline", engine=engine.name,
+                 targets=len(targets))
+        return None
+    try:
+        worker = PallasSaltedMaskWorker(
+            engine, gen, targets, algo, batch=batch,
+            hit_capacity=hit_capacity, oracle=oracle,
+            interpret=mode.get("interpret", False))
+        worker.warmup()
+        return worker
+    except Exception as e:
+        log.warn("salted pallas kernel failed to build/compile; "
+                 "falling back to the XLA pipeline",
+                 engine=engine.name,
+                 error=f"{type(e).__name__}: {e}")
+        return None
+
+
 class ShardedSaltedMaskWorker(SaltedMaskWorker):
     """SaltedMaskWorker over a device mesh: super-batch strides, the
     per-shard overflow check, super-batch-global lanes."""
@@ -456,6 +569,10 @@ class _SaltedDeviceMixin:
     def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
                          oracle=None):
         self._check_lengths(gen.length, targets)
+        worker = maybe_pallas_salted_worker(self, gen, targets, batch,
+                                            hit_capacity, oracle)
+        if worker is not None:
+            return worker
         return SaltedMaskWorker(self, gen, targets, batch=batch,
                                 hit_capacity=hit_capacity, oracle=oracle)
 

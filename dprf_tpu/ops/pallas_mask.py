@@ -142,8 +142,13 @@ def mask_supported(charsets: Sequence[bytes]) -> bool:
 
 
 def kernel_eligible(engine_name: str, gen, n_targets: int) -> bool:
-    """One kernel-eligibility predicate for engine selection and bench."""
-    if engine_name not in CORES or not 1 <= n_targets <= MAX_TARGETS:
+    """One kernel-eligibility predicate for engine selection and bench.
+    Non-CORES names (nested double-hash, mysql41) dispatch to the
+    extended-kernel module."""
+    if engine_name not in CORES:
+        from dprf_tpu.ops import pallas_ext
+        return pallas_ext.nested_eligible(engine_name, gen, n_targets)
+    if not 1 <= n_targets <= MAX_TARGETS:
         return False
     if not hasattr(gen, "charsets"):
         return False
@@ -206,6 +211,44 @@ def _decode_byte(digit, segs):
     return byte
 
 
+def decode_candidate_bytes(radices, seg_tables, length: int, base, carry):
+    """Mixed-radix add (base digits + per-lane carry) fused with the
+    arithmetic charset lookup, least significant position first --
+    the shared decode of every mask kernel body (this module's and
+    pallas_ext's)."""
+    byts: list = [None] * length
+    for p in range(length - 1, -1, -1):
+        r = radices[p]
+        s = base[p] + carry
+        byts[p] = _decode_byte(s % r, seg_tables[p]).astype(jnp.uint32)
+        carry = s // r
+    return byts
+
+
+def bloom_found(digest, tables, valid, n_sets: int, shape):
+    """Bloom prefilter shared by the kernel bodies: a lane survives if
+    it passes ALL K_PROBES of ANY target set.  Real hits always
+    survive (their probe bits come from the matching target's own
+    digest); false maybes are rare enough that the caller verifies
+    single maybes with one host oracle hash and exactly rescans
+    collided tiles (see reduce_tile_maybes)."""
+    probes = []
+    for p in range(K_PROBES):
+        bits = _probe_bits(digest, p)
+        probes.append(((bits >> jnp.uint32(5)).astype(jnp.int32),
+                       (bits & jnp.uint32(31))))
+    found = jnp.zeros(shape, jnp.bool_)
+    for s in range(n_sets):
+        m_set = valid
+        for p, (idx7, bit5) in enumerate(probes):
+            row = jnp.broadcast_to(tables[s * K_PROBES + p][None, :],
+                                   shape)
+            word = jnp.take_along_axis(row, idx7, axis=1)
+            m_set = m_set & (((word >> bit5) & jnp.uint32(1)) == 1)
+        found = found | m_set
+    return found
+
+
 def _pack_message(byts, length: int, shape, big_endian: bool,
                   widen_utf16: bool):
     """Candidate bytes -> the 16 padded single-block message words."""
@@ -253,17 +296,11 @@ def _build_kernel_body(engine_name: str, radices, seg_tables, length: int,
         shape = (sub, 128)
         lane = (jax.lax.broadcasted_iota(jnp.int32, shape, 0) * 128
                 + jax.lax.broadcasted_iota(jnp.int32, shape, 1))
-        # mixed-radix add (base digits + global offset), least
-        # significant (rightmost mask position) first, fused with the
-        # charset lookup.  The base index of this *tile* is folded into
-        # the scalar side (pid * tile) before vector carry propagation.
+        # The base index of this *tile* is folded into the scalar side
+        # (pid * tile) before vector carry propagation.
         carry = lane + pid * tile
-        byts: list = [None] * length
-        for p in range(length - 1, -1, -1):
-            r = radices[p]
-            s = base[p] + carry
-            byts[p] = _decode_byte(s % r, seg_tables[p]).astype(jnp.uint32)
-            carry = s // r
+        byts = decode_candidate_bytes(radices, seg_tables, length,
+                                      base, carry)
         m = _pack_message(byts, length, shape, big_endian, widen)
         digest = core(m, shape)
         valid = (lane + pid * tile) < n_valid
@@ -272,26 +309,7 @@ def _build_kernel_body(engine_name: str, radices, seg_tables, length: int,
             for got, want in zip(digest, tw):
                 found = found & (got == jnp.uint32(want))
         else:
-            # Bloom prefilter: a lane survives if it passes ALL K_PROBES
-            # of ANY target set.  Real hits always survive (their probe
-            # bits come from the matching target's own digest); false
-            # maybes are rare enough that the caller verifies single
-            # maybes with one host oracle hash and exactly rescans
-            # collided tiles (see reduce_tile_maybes).
-            probes = []
-            for p in range(K_PROBES):
-                bits = _probe_bits(digest, p)
-                probes.append(((bits >> jnp.uint32(5)).astype(jnp.int32),
-                               (bits & jnp.uint32(31))))
-            found = jnp.zeros(shape, jnp.bool_)
-            for s in range(n_sets):
-                m_set = valid
-                for p, (idx7, bit5) in enumerate(probes):
-                    row = jnp.broadcast_to(
-                        tables[s * K_PROBES + p][None, :], shape)
-                    word = jnp.take_along_axis(row, idx7, axis=1)
-                    m_set = m_set & (((word >> bit5) & jnp.uint32(1)) == 1)
-                found = found | m_set
+            found = bloom_found(digest, tables, valid, n_sets, shape)
         count = jnp.sum(found.astype(jnp.int32))
         # single-hit extraction: max lane among hits (-1 if none); the
         # caller rescans any tile whose count exceeds 1.
@@ -427,6 +445,11 @@ def make_pallas_mask_crack_step(engine_name: str, gen,
     """Drop-in replacement for ops/pipeline.make_mask_crack_step on the
     single-target kernel path: step(base_digits, n_valid) ->
     (count, lanes, tpos)."""
+    if engine_name not in CORES:
+        from dprf_tpu.ops import pallas_ext
+        return pallas_ext.make_ext_mask_crack_step(
+            engine_name, gen, target_words, batch, hit_capacity,
+            interpret=interpret)
     tile = SUB * 128
     fn = make_mask_pallas_fn(engine_name, gen, target_words, batch,
                              interpret=interpret)
@@ -456,6 +479,11 @@ def make_pallas_multi_crack_step(engine_name: str, gen,
     hit_capacity or n_collided > rescan_capacity means the whole batch
     needs the exact rescan (astronomically rare at the Bloom FP rates
     documented at SET_SIZE)."""
+    if engine_name not in CORES:
+        from dprf_tpu.ops import pallas_ext
+        return pallas_ext.make_ext_multi_crack_step(
+            engine_name, gen, target_words, batch, hit_capacity,
+            rescan_capacity, interpret=interpret)
     tile = SUB * 128
     fn = make_mask_pallas_fn(engine_name, gen, target_words, batch,
                              interpret=interpret)

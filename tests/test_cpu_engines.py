@@ -115,3 +115,14 @@ def test_registry():
     names = engine_names("cpu")
     for n in ["md5", "sha1", "sha256", "ntlm", "bcrypt", "wpa2-pmkid"]:
         assert n in names
+
+
+def test_engine_alias_sets_device_symmetric():
+    """Every name resolvable on one device resolves on the other
+    (VERDICT r3 weak #6: a job written with a jax-side alias must not
+    fail under --device=cpu, and vice versa)."""
+    from dprf_tpu.engines import engine_names
+
+    cpu = set(engine_names("cpu"))
+    jax = set(engine_names("jax"))
+    assert cpu == jax, (sorted(cpu - jax), sorted(jax - cpu))

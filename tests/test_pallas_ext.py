@@ -1,0 +1,193 @@
+"""Extended Pallas kernels (ops/pallas_ext.py) vs the CPU oracles:
+salted $pass.$salt / $salt.$pass, nested double-hash, and mysql41.
+
+Interpret mode on the CPU backend covers the md5/sha1 chains; the
+sha256-stage variants use the eager body emulator (the statically
+unrolled sha256 rounds don't compile on XLA:CPU in reasonable time --
+same split as test_pallas_mask).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.ops import pallas_ext as pe
+from dprf_tpu.runtime.workunit import WorkUnit
+
+BATCH = pe.SUB * 128
+
+
+def _tw(engine_name: str, plain: bytes, salt=None) -> np.ndarray:
+    """Final digest words in the engine's layout via the CPU oracle."""
+    eng = get_engine(engine_name, device="cpu")
+    params = {"salt": salt} if salt is not None else None
+    d = eng.hash_batch([plain], params=params)[0]
+    dt = "<u4" if _little(engine_name) else ">u4"
+    return np.frombuffer(d, dtype=dt).astype(np.uint32)
+
+
+def _little(engine_name: str) -> bool:
+    if engine_name == "mysql41":
+        return False
+    if engine_name in pe.NESTED_COMBOS:
+        outer = pe.NESTED_COMBOS[engine_name][0]
+        return outer == "md5"
+    return engine_name.startswith("md5")
+
+
+def _run_fn(fn, gen, *extra, n_valid=None):
+    base = jnp.asarray(gen.digits(0), jnp.int32)
+    c, l = fn(base, jnp.asarray([n_valid], jnp.int32), *extra)
+    c, l = np.asarray(c)[:, 0], np.asarray(l)[:, 0]
+    return [int(t * pe.SUB * 128 + l[t]) for t in np.nonzero(c)[0]], \
+        int(c.sum())
+
+
+@pytest.mark.parametrize("name", ["md5(md5)", "sha1(sha1)", "md5(sha1)",
+                                  "sha1(md5)", "mysql41"])
+def test_nested_kernel_interpret_finds_plant(name):
+    gen = MaskGenerator("?l?l?l?l")
+    plant = 2 * pe.SUB * 128 + 77     # tile 2, lane 77
+    tw = _tw(name, gen.candidate(plant))
+    fn = pe.make_ext_pallas_fn(name, gen, tw, BATCH * 4, interpret=True)
+    hits, total = _run_fn(fn, gen, n_valid=BATCH * 4)
+    assert hits == [plant] and total == 1
+
+
+@pytest.mark.parametrize("name", ["sha256(md5)", "sha256(sha1)"])
+def test_sha256_nested_emulated(name):
+    gen = MaskGenerator("?l?l?l")
+    plant = 321
+    tw = _tw(name, gen.candidate(plant))
+    counts, lanes = pe.emulate_ext_kernel(name, gen, tw, BATCH,
+                                          gen.digits(0), BATCH)
+    c, l = counts[:, 0], lanes[:, 0]
+    hits = [int(t * pe.SUB * 128 + l[t]) for t in np.nonzero(c)[0]]
+    assert hits == [plant]
+
+
+def test_nested_multi_target_bloom():
+    gen = MaskGenerator("?l?l?l?l")
+    plants = [5, pe.SUB * 128 + 9, 3 * pe.SUB * 128 + 100]
+    tws = np.stack([_tw("md5(md5)", gen.candidate(i)) for i in plants])
+    rng = np.random.RandomState(7)
+    noise = rng.randint(0, 2**32, (47, 4), dtype=np.uint32)
+    all_t = np.concatenate([noise[:20], tws, noise[20:]])
+    fn = pe.make_ext_pallas_fn("md5(md5)", gen, all_t, BATCH * 4,
+                               interpret=True)
+    hits, total = _run_fn(fn, gen, n_valid=BATCH * 4)
+    # Bloom maybes: every plant must surface; false maybes tolerated
+    assert set(plants) <= set(hits)
+    assert total <= len(plants) + 2
+
+
+@pytest.mark.parametrize("algo,order", [("md5", "ps"), ("md5", "sp"),
+                                        ("sha1", "ps"), ("sha1", "sp")])
+@pytest.mark.parametrize("salt", [b"ab", b"s3cr3t!", b"0123456789abcdef"])
+def test_salted_kernel_interpret(algo, order, salt):
+    gen = MaskGenerator("?l?l?l?l")
+    plant = pe.SUB * 128 + 31
+    tw = _tw(f"{algo}-{order}", gen.candidate(plant), salt=salt)
+    fn = pe.make_salted_pallas_fn(algo, order, gen, BATCH * 2,
+                                  len(salt), interpret=True)
+    salt_dev = jnp.asarray(np.frombuffer(salt, np.uint8).astype(np.int32))
+    tgt_dev = jnp.asarray(tw.view(np.int32))
+    hits, total = _run_fn(fn, gen, salt_dev, tgt_dev,
+                          n_valid=BATCH * 2)
+    assert hits == [plant] and total == 1
+
+
+@pytest.mark.parametrize("order", ["ps", "sp"])
+def test_salted_sha256_emulated(order):
+    gen = MaskGenerator("?l?l?l")
+    salt = b"NaCl"
+    plant = 1234
+    tw = _tw(f"sha256-{order}", gen.candidate(plant), salt=salt)
+    counts, lanes = pe.emulate_ext_kernel(
+        "sha256", gen, tw, BATCH, gen.digits(0), BATCH,
+        order=order, salt=salt)
+    c, l = counts[:, 0], lanes[:, 0]
+    hits = [int(t * pe.SUB * 128 + l[t]) for t in np.nonzero(c)[0]]
+    assert hits == [plant]
+
+
+def test_salted_worker_selected_and_cracks(monkeypatch):
+    """DPRF_PALLAS=1 routes eligible salted mask jobs to the kernel
+    worker; mixed salt lengths compile one kernel per length and every
+    target cracks with its original index."""
+    from dprf_tpu.engines.device.salted import PallasSaltedMaskWorker
+
+    monkeypatch.setenv("DPRF_PALLAS", "1")
+    gen = MaskGenerator("?l?l?l?l")
+    cpu = get_engine("md5-ps", device="cpu")
+    dev = get_engine("md5-ps", device="jax")
+    plants = [(123, b"aa"), (45000, b"longersalt!")]
+    targets = []
+    for idx, salt in plants:
+        d = cpu.hash_batch([gen.candidate(idx)],
+                           params={"salt": salt})[0]
+        targets.append(cpu.parse_target(d.hex() + ":" + salt.decode()))
+    w = dev.make_mask_worker(gen, targets, batch=1 << 15,
+                             hit_capacity=8, oracle=cpu)
+    assert isinstance(w, PallasSaltedMaskWorker)
+    assert len(w._ksteps) == 2      # one compiled kernel per salt len
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert {(h.target_index, h.cand_index) for h in hits} == \
+        {(0, 123), (1, 45000)}
+
+
+def test_salted_worker_falls_back_when_ineligible(monkeypatch):
+    """sha512 has no 32-bit kernel core -> XLA salted worker."""
+    from dprf_tpu.engines.device.salted import (PallasSaltedMaskWorker,
+                                                SaltedMaskWorker)
+
+    monkeypatch.setenv("DPRF_PALLAS", "1")
+    gen = MaskGenerator("?l?l?l")
+    cpu = get_engine("sha512-ps", device="cpu")
+    dev = get_engine("sha512-ps", device="jax")
+    d = cpu.hash_batch([b"abc"], params={"salt": b"xy"})[0]
+    t = cpu.parse_target(d.hex() + ":xy")
+    w = dev.make_mask_worker(gen, [t], batch=4096, hit_capacity=8,
+                             oracle=cpu)
+    assert isinstance(w, SaltedMaskWorker)
+    assert not isinstance(w, PallasSaltedMaskWorker)
+
+
+def test_nested_engine_uses_kernel_worker(monkeypatch):
+    """Nested names flow through the standard PallasMaskWorker via the
+    pallas_mask dispatch (single target, exact compare)."""
+    from dprf_tpu.runtime.worker import PallasMaskWorker
+
+    monkeypatch.setenv("DPRF_PALLAS", "1")
+    gen = MaskGenerator("?l?l?l?l")
+    cpu = get_engine("md5(md5)", device="cpu")
+    dev = get_engine("md5(md5)", device="jax")
+    plant = 31337
+    d = cpu.hash_batch([gen.candidate(plant)])[0]
+    t = cpu.parse_target(d.hex())
+    w = dev.make_mask_worker(gen, [t], batch=1 << 15, hit_capacity=8,
+                             oracle=cpu)
+    assert isinstance(w, PallasMaskWorker)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.cand_index) for h in hits] == [(0, plant)]
+
+
+def test_eligibility_rules():
+    gen = MaskGenerator("?l?l?l?l")
+    # nested: known combos only; candidate must fit one block
+    assert pe.nested_eligible("md5(md5)", gen, 1)
+    assert pe.nested_eligible("mysql41", gen, 50)
+    assert not pe.nested_eligible("md5(sha256)", gen, 1)   # no such combo
+    assert not pe.nested_eligible("md5(md5)", gen, 0)
+    long = MaskGenerator("?l" * 56)
+    assert not pe.nested_eligible("md5(md5)", long, 1)
+    # salted: algo must have a core; salt must fit the block
+    assert pe.salted_eligible("md5", "ps", gen, [4, 12])
+    assert not pe.salted_eligible("sha512", "ps", gen, [4])
+    assert not pe.salted_eligible("md5", "xx", gen, [4])
+    assert not pe.salted_eligible("md5", "ps", gen, [52])  # 4+52 > 55
+    assert not pe.salted_eligible("md5", "ps", gen, [])
+    assert not pe.salted_eligible("md5", "ps", gen,
+                                  list(range(1, 10)))     # 9 lengths
