@@ -13,6 +13,7 @@ keyspace.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import Optional
 
@@ -965,6 +966,15 @@ _COMMANDS = {
 
 
 def main(argv: Optional[list] = None) -> int:
+    # Honor an explicit JAX_PLATFORMS before any backend initializes:
+    # some environments (the axon TPU tunnel) force-register their
+    # platform via sitecustomize + jax.config, which silently overrides
+    # the env var -- so `JAX_PLATFORMS=cpu dprf bench --devices 8`
+    # would grab the real TPU instead of the virtual CPU mesh.
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms is not None:   # "" = JAX auto-selection, honor it
+        import jax
+        jax.config.update("jax_platforms", env_platforms or None)
     args = _build_parser().parse_args(argv)
     log = Log(quiet=getattr(args, "quiet", False))
     # library code logs through the module-level DEFAULT; mirror -q

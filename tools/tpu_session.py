@@ -62,6 +62,7 @@ DEADLINES = {
     "config4": 900,
     "config5": 900,
     "sweep": 1200,
+    "ext_kernels": 1800,
 }
 
 DEFAULT_PLAN = ["kernels", "bench_fast", "config1", "config2", "config3",
@@ -180,8 +181,6 @@ def stage_kernels(io: StageIO):
 def stage_bench_fast(io: StageIO):
     """Sustained kernel/pipeline H/s (run_bench does honest hard_sync
     timing internally)."""
-    from dprf_tpu.bench import calibrated_inner, run_bench
-
     runs = [
         ("md5-pallas", dict(engine="md5", impl="pallas", batch=1 << 22)),
         ("md5-xla", dict(engine="md5", impl="xla", batch=1 << 22)),
@@ -193,14 +192,9 @@ def stage_bench_fast(io: StageIO):
         ("sha256-xla", dict(engine="sha256", impl="xla", batch=1 << 21)),
     ]
     for name, kw in runs:
-        io.status(name, phase="calibrate")
+        io.status(name, phase="calibrate+measure")
         try:
-            cal = run_bench(device="jax", seconds=0.1, inner=16, **kw)
-            inner = calibrated_inner(cal["value"], kw["batch"])
-            io.status(name, phase="measure", inner=inner,
-                      cal_hs=cal["value"])
-            res = run_bench(device="jax", seconds=15.0, inner=inner, **kw)
-            res["calibrate_hs"] = cal["value"]
+            res = _calibrated_bench(**kw)
         except Exception as e:
             res = {"error": f"{type(e).__name__}: {e}",
                    "traceback": traceback.format_exc()[-1500:]}
@@ -287,10 +281,128 @@ def stage_sweep(io: StageIO):
                              "error": f"{type(e).__name__}: {e}"})
 
 
+def _calibrated_bench(**kw):
+    """Shared calibrate-then-measure sequence (see stage_bench_fast):
+    a 0.1 s / inner=16 probe sizes the device loop, then a 15 s
+    measured run."""
+    from dprf_tpu.bench import calibrated_inner, run_bench
+    cal = run_bench(device="jax", seconds=0.1, inner=16, **kw)
+    inner = calibrated_inner(cal["value"], kw["batch"])
+    res = run_bench(device="jax", seconds=15.0, inner=inner, **kw)
+    res["calibrate_hs"] = cal["value"]
+    return res
+
+
+def _prove_planted(io: StageIO, name: str, plant: int, salt=None,
+                   expected_worker: str = "PallasMaskWorker"):
+    """Plant one target in a small mask keyspace, build the production
+    worker, and verify it is the expected kernel worker AND cracks
+    exactly the plant."""
+    from dprf_tpu import get_engine
+    from dprf_tpu.generators.mask import MaskGenerator
+    from dprf_tpu.runtime.workunit import WorkUnit
+
+    io.status(f"lower/{name}")
+    rec = {"variant": name}
+    if salt is not None:
+        rec["salt_len"] = len(salt)
+    try:
+        gen = MaskGenerator("?l?l?l?l?l")
+        cpu = get_engine(name, device="cpu")
+        dev = get_engine(name, device="jax")
+        params = {"salt": salt} if salt is not None else None
+        d = cpu.hash_batch([gen.candidate(plant)], params=params)[0]
+        if salt is not None:
+            tgt = cpu.parse_target(d.hex() + ":" + salt.decode())
+        else:
+            tgt = cpu.parse_target(d.hex() if name != "mysql41"
+                                   else "*" + d.hex().upper())
+        t0 = time.perf_counter()
+        w = dev.make_mask_worker(gen, [tgt], batch=1 << 20,
+                                 hit_capacity=8, oracle=cpu)
+        rec["worker"] = type(w).__name__
+        rec["compile_s"] = round(time.perf_counter() - t0, 2)
+        hits = w.process(WorkUnit(0, 0, gen.keyspace))
+        rec["ok"] = ([(h.target_index, h.cand_index) for h in hits]
+                     == [(0, plant)]
+                     and rec["worker"] == expected_worker)
+        rec["hits"] = [h.cand_index for h in hits]
+    except Exception as e:
+        rec["ok"] = False
+        rec["error"] = f"{type(e).__name__}: {e}"
+        rec["traceback"] = traceback.format_exc()[-1200:]
+    io.record(f"lower/{name}", rec)
+
+
+def stage_ext_kernels(io: StageIO):
+    """Round-4 extended kernels (ops/pallas_ext.py) on real hardware:
+    Mosaic lowering + planted-target proof for the salted and nested
+    variants, then sustained worker-path rates (the VERDICT r3 #3
+    'done' criterion: >= 10x the XLA mask rate)."""
+    from dprf_tpu import get_engine
+    from dprf_tpu.generators.mask import MaskGenerator
+    from dprf_tpu.runtime.workunit import WorkUnit
+
+    for name, salt in (("md5-ps", b"aXb!"), ("md5-sp", b"na"),
+                       ("sha1-ps", b"pepper7"), ("sha256-sp", b"Qx")):
+        _prove_planted(io, name, plant=100_003, salt=salt,
+                       expected_worker="PallasSaltedMaskWorker")
+    for name in ("md5(md5)", "sha1(sha1)", "sha256(sha1)", "mysql41"):
+        _prove_planted(io, name, plant=222_222)
+
+    # -- sustained worker-path rates with unmatchable targets (the
+    # run_config shape: multi-stride units, one readback per unit)
+    def timed_worker(name, w, gen, seconds=15.0):
+        unit_len = w.stride * 64
+        tested, start = 0, 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            length = min(unit_len, gen.keyspace - start)
+            if length <= 0:
+                start = 0
+                continue
+            w.process(WorkUnit(-1, start, length))
+            tested += length
+            start += length
+        dt = time.perf_counter() - t0
+        return {"metric": f"{name} candidates/sec/chip",
+                "value": tested / dt, "unit": "H/s", "engine": name,
+                "device": "tpu", "batch": w.stride,
+                "unit_strides": 64, "tested": tested,
+                "elapsed_s": round(dt, 2)}
+
+    io.status("bench/md5-ps")
+    try:
+        gen = MaskGenerator("?a?a?a?a?a?a?a?a")
+        cpu = get_engine("md5-ps", device="cpu")
+        dev = get_engine("md5-ps", device="jax")
+        tgt = cpu.parse_target("ff" * 16 + ":saltsalt")
+        w = dev.make_mask_worker(gen, [tgt], batch=1 << 22,
+                                 hit_capacity=8, oracle=cpu)
+        res = timed_worker("md5-ps", w, gen)
+        res["worker"] = type(w).__name__
+        io.record("bench/md5-ps", res)
+    except Exception as e:
+        io.record("bench/md5-ps",
+                  {"error": f"{type(e).__name__}: {e}",
+                   "traceback": traceback.format_exc()[-1200:]})
+
+    io.status("bench/md5(md5)")
+    try:
+        io.record("bench/md5(md5)",
+                  _calibrated_bench(engine="md5(md5)", impl="pallas",
+                                    batch=1 << 22))
+    except Exception as e:
+        io.record("bench/md5(md5)",
+                  {"error": f"{type(e).__name__}: {e}",
+                   "traceback": traceback.format_exc()[-1200:]})
+
+
 STAGES = {
     "kernels": stage_kernels,
     "bench_fast": stage_bench_fast,
     "sweep": stage_sweep,
+    "ext_kernels": stage_ext_kernels,
     **{f"config{n}": _stage_config(n) for n in range(1, 6)},
 }
 
