@@ -185,3 +185,74 @@ def test_chunked_growth_cap():
     c._per_round = 1e-6                       # looks 1e8-rounds-cheap
     assert c._next_chunk(1 << 30, 16) == 128  # 16 * 8, not 1e8
     assert c._next_chunk(100, 1 << 20) == 100  # remaining clamps
+
+
+def test_pallas_eks_advance_matches_xla():
+    """The Pallas EksBlowfish advance kernel (ops/pallas_bcrypt.py) is
+    bit-exact vs the XLA form over the ChunkedEks advance contract
+    (interpret mode; the same kernel was proven on TPU v5 lite --
+    TPU_RESULTS_r04 / tpu_cases pallaseks)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from dprf_tpu.ops import blowfish as bf
+    from dprf_tpu.ops.pallas_bcrypt import make_pallas_eks_advance
+
+    B = 8
+    rng = np.random.RandomState(0)
+    cand = rng.randint(97, 123, (B, 6), dtype=np.uint8)
+    kw = bf.key_words_from_candidates(jnp.asarray(cand),
+                                      jnp.full((B,), 6, jnp.int32))
+    sw = jnp.asarray(np.frombuffer(bytes(range(16)), ">u4")
+                     .astype(np.uint32))
+    P, S = bf.eks_setup_begin(kw, sw)
+    s18 = bf.salt18_words(sw)
+    n = jnp.int32(2)
+    P_ref, S_ref = bf.eks_rounds(P, S, kw, s18, n)
+    adv = make_pallas_eks_advance(B, interpret=True, subc=8)
+    P_k, S_k = adv(P, S, kw, s18, n)
+    assert np.array_equal(np.asarray(P_ref), np.asarray(P_k))
+    assert np.array_equal(np.asarray(S_ref), np.asarray(S_k))
+
+
+def test_bcrypt_route_forced_cpu_cracks(monkeypatch):
+    """DPRF_BCRYPT_ROUTE=cpu returns the routed CPU worker from the
+    device factory and it still cracks a planted target."""
+    from dprf_tpu.engines.device.bcrypt import RoutedCpuBcryptWorker
+    from dprf_tpu.generators.mask import MaskGenerator
+
+    monkeypatch.setenv("DPRF_BCRYPT_ROUTE", "cpu")
+    gen = MaskGenerator("?d?d")
+    cpu = get_engine("bcrypt", device="cpu")
+    dev = get_engine("bcrypt", device="jax")
+    salt = bytes(range(16))
+    t = cpu.parse_target(bcrypt_hash(b"42", salt, 4))
+    w = dev.make_mask_worker(gen, [t], batch=64, hit_capacity=8,
+                             oracle=cpu)
+    assert isinstance(w, RoutedCpuBcryptWorker)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, b"42")]
+
+
+def test_bcrypt_route_forced_device(monkeypatch):
+    from dprf_tpu.engines.device.bcrypt import BcryptMaskWorker
+    from dprf_tpu.generators.mask import MaskGenerator
+
+    monkeypatch.setenv("DPRF_BCRYPT_ROUTE", "device")
+    gen = MaskGenerator("?d?d")
+    cpu = get_engine("bcrypt", device="cpu")
+    dev = get_engine("bcrypt", device="jax")
+    t = cpu.parse_target(bcrypt_hash(b"xx", bytes(range(16)), 4))
+    w = dev.make_mask_worker(gen, [t], batch=64, hit_capacity=8,
+                             oracle=cpu)
+    assert isinstance(w, BcryptMaskWorker)
+
+
+def test_measure_eks_rates_runs():
+    """The routing micro-bench returns positive head-to-head rates."""
+    from dprf_tpu.engines.device.bcrypt import measure_eks_rates
+
+    cpu = get_engine("bcrypt", device="cpu")
+    rates = measure_eks_rates(cpu, batch=8, rounds=2)
+    assert rates["device_cand_rounds_s"] > 0
+    assert rates["cpu_cand_rounds_s"] > 0

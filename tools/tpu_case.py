@@ -10,6 +10,9 @@ Cases: scrypt-<N>-<r>-<p>-<B> | bcrypt-<cost>-<B> | pmkid-<B>
      | bcryptchunk-<cost>-<B>   (deadline-bounded chunked cost loop;
                                  the only safe shape for cost >= 10)
      | descrypt-<B>             (bitslice crypt(3): 25 chained DES)
+     | pallaseks-<cost>-<B>     (Pallas EksBlowfish advance kernel:
+                                 on-chip equivalence vs the XLA form,
+                                 then a chunked timed run)
 """
 
 import json
@@ -100,6 +103,47 @@ def run_case(name: str) -> dict:
                 "n_dispatches": len(steps) + 2,
                 "max_dispatch_s": round(max(steps), 1),
                 "false_hits": count}
+    elif kind == "pallaseks":
+        # Pallas EksBlowfish advance (ops/pallas_bcrypt.py): first an
+        # on-chip bit-equivalence check vs the XLA eks_rounds at 2
+        # rounds, then the full 2**cost chain through ChunkedEks with
+        # the kernel as the advance fn.
+        cost, B = (int(x) for x in parts[1:])
+        from dprf_tpu.engines.device.bcrypt import ChunkedEks
+        from dprf_tpu.ops import blowfish as bf_ops
+        from dprf_tpu.ops.pallas_bcrypt import make_pallas_eks_advance
+        from dprf_tpu.utils.sync import hard_sync
+
+        rng = np.random.RandomState(7)
+        cand = rng.randint(97, 123, (B, 6), dtype=np.uint8)
+        lens = np.full((B,), 6, np.int32)
+        kw = bf_ops.key_words_from_candidates(jnp.asarray(cand),
+                                              jnp.asarray(lens))
+        sw = jnp.asarray(np.frombuffer(bytes(range(16)), ">u4")
+                         .astype(np.uint32))
+        s18 = bf_ops.salt18_words(sw)
+        P0, S0 = bf_ops.eks_setup_begin(kw, sw)
+        hard_sync(S0)
+        adv = make_pallas_eks_advance(B)
+        t0 = time.perf_counter()
+        Pk, Sk = adv(P0, S0, kw, s18, jnp.int32(2))
+        hard_sync(Sk)
+        compile_s = time.perf_counter() - t0
+        Pr, Sr = bf_ops.eks_rounds(P0, S0, kw, s18, jnp.int32(2))
+        equal = (np.array_equal(np.asarray(Pk), np.asarray(Pr))
+                 and np.array_equal(np.asarray(Sk), np.asarray(Sr)))
+        # timed: full 2**cost chain, deadline-chunked via the kernel
+        chunker = ChunkedEks(advance=adv)
+        t0 = time.perf_counter()
+        P, S = bf_ops.eks_setup_begin(kw, sw)
+        P, S = chunker.run(P, S, kw, s18, 1 << cost)
+        dw = bf_ops.bcrypt_digest_words(P, S)
+        hard_sync(dw)
+        dt = time.perf_counter() - t0
+        return {"case": name, "ok": equal, "equal_2rounds": equal,
+                "hs": B / dt, "batch": B, "rounds": 1 << cost,
+                "total_s": round(dt, 1), "compile_s": round(compile_s, 1),
+                "per_round_s": chunker._per_round}
     elif kind == "descrypt":
         B = int(parts[1])
         from dprf_tpu.engines.device.descrypt import (
