@@ -62,6 +62,7 @@ def test_cost_is_runtime_arg():
     (b"U*U", "$2a$05$CCCCCCCCCCCCCCCCCCCCC.E5YPO9kmyuRGyh0XouQYb4YMJKvyOeW"),
     (b"U*U*U", "$2a$05$XXXXXXXXXXXXXXXXXXXXXOAcXxm9kjPGEMsLznoKqmqw7tc8WCx4a"),
 ])
+@pytest.mark.smoke
 def test_device_hash_batch_openbsd_vectors(password, line):
     eng = get_engine("bcrypt", device="jax")
     t = eng.parse_target(line)
@@ -136,6 +137,7 @@ def test_bcrypt_mask_worker_finds_planted():
     assert hits[0].target_index == 0
 
 
+@pytest.mark.smoke
 def test_chunked_eks_matches_fused():
     """Splitting the cost loop across arbitrary dispatch boundaries must
     reproduce the one-shot eks_setup state exactly (the chunked path is

@@ -5,6 +5,8 @@ validate plumbing and output schema, not performance."""
 import jax
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from dprf_tpu.bench import run_bench, run_config, run_scaling
 
 

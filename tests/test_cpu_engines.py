@@ -2,6 +2,8 @@
 
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from dprf_tpu import get_engine
 from dprf_tpu.engines.cpu.md4 import md4
 from dprf_tpu.engines.cpu import bcrypt as bc

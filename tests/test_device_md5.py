@@ -23,6 +23,7 @@ def dev():
     return get_engine("md5", "jax")
 
 
+@pytest.mark.smoke
 def test_md5_vectors(dev):
     got = dev.hash_batch([b"", b"abc", b"message digest"])
     assert got[0].hex() == "d41d8cd98f00b204e9800998ecf8427e"
@@ -37,6 +38,7 @@ def test_md5_random_batch_matches_oracle(dev, oracle):
     assert dev.hash_batch(cands) == oracle.hash_batch(cands)
 
 
+@pytest.mark.smoke
 def test_fused_step_finds_planted_password(dev, oracle):
     gen = MaskGenerator("?l?l?l")
     secret = b"wxy"

@@ -44,6 +44,7 @@ def _serve(job, gen, targets, lease_timeout=300.0, clock=None):
     return state, server, dispatcher
 
 
+@pytest.mark.smoke
 def test_two_workers_crack_everything():
     eng, gen, targets, job = _mask_job("?l?l?l", [b"cat", b"zzz"])
     state, server, _ = _serve(job, gen, targets)
@@ -216,6 +217,7 @@ def test_status_op():
         server.shutdown()
 
 
+@pytest.mark.smoke
 def test_auth_bad_token_rejected_good_token_accepted():
     """Challenge-response on hello: a client without the shared secret
     gets no job and no ops; the right token unlocks the connection."""

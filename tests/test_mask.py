@@ -4,6 +4,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.smoke
 from hypothesis import given, settings, strategies as st
 
 from dprf_tpu.generators.mask import MaskGenerator, parse_mask, BUILTIN_CHARSETS

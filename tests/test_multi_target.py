@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from dprf_tpu import get_engine
 from dprf_tpu.generators.mask import MaskGenerator
 from dprf_tpu.ops import compare as cmp_ops

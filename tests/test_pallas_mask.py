@@ -26,6 +26,7 @@ def _target(plain: bytes) -> np.ndarray:
                          dtype="<u4").astype(np.uint32)
 
 
+@pytest.mark.smoke
 def test_charset_segments_reconstruct():
     for name, cs in BUILTIN_CHARSETS.items():
         segs = charset_segments(cs)
@@ -77,6 +78,7 @@ def test_kernel_finds_planted(engine, mask, plant):
     assert int(count2) == 0
 
 
+@pytest.mark.smoke
 def test_tile_collision_forces_rescan_convention():
     """Two hits in one tile can only report one lane, so the reducer
     must return count > hit_capacity (the worker then rescans exactly).

@@ -13,6 +13,8 @@ import random
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 import jax.numpy as jnp
 
 from dprf_tpu.rules import (parse_rule, parse_rules, load_rules,

@@ -4,6 +4,8 @@ import json
 
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from dprf_tpu.runtime.dispatcher import Dispatcher, IntervalSet
 from dprf_tpu.runtime.potfile import Potfile, encode_plain, decode_plain
 from dprf_tpu.runtime.session import SessionJournal, job_fingerprint
