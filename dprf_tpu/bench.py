@@ -298,10 +298,15 @@ def _config_job(n: int, bcrypt_cost: int):
                  for i in range(1000)]
         return "ntlm", "mask", MaskGenerator("?a?a?a?a?a?a?a"), lines
     if n == 3:     # SHA-256 wordlist + best64, on-device rule expansion
-        # 1M words x 77 rules = an 80M keyspace, big enough that a
-        # multi-stride unit amortizes link latency (see unit_strides)
+        # 1M words x 64 rules = a 67M keyspace, big enough that a
+        # multi-stride unit amortizes link latency (see unit_strides).
+        # max_len 24 is the MINIMUM that keeps every best64 expansion
+        # of the 8-byte words identical to the 55-byte default
+        # (computed against rules/cpu.py: two rules grow to 24 bytes
+        # mid-rule before truncating) while keeping per-position rule
+        # cost proportional to real candidate lengths.
         gen = WordlistRulesGenerator(_synthetic_words(1 << 20),
-                                     load_rules("best64"))
+                                     load_rules("best64"), max_len=24)
         return "sha256", "wordlist", gen, None
     if n == 4:     # bcrypt wordlist, memory-hard path
         gen = WordlistRulesGenerator(_synthetic_words(1 << 12))

@@ -100,8 +100,33 @@ class JaxEngineBase(DeviceHashEngine, HashEngine):
 
     def make_wordlist_worker(self, gen, targets, batch: int,
                              hit_capacity: int, oracle=None):
-        """Fused wordlist+rules worker (config 3's on-device expansion)."""
+        """Fused wordlist+rules worker (config 3's on-device expansion).
+        Single-target jobs whose rule set the in-VMEM interpreter
+        kernel supports get the Pallas path (ops/pallas_rules.py),
+        with the XLA pipeline as build-failure fallback."""
+        from dprf_tpu.ops.pallas_mask import pallas_mode
+        from dprf_tpu.ops.pallas_rules import kernel_rules_eligible
         from dprf_tpu.runtime.worker import DeviceWordlistWorker
+        from dprf_tpu.utils.logging import DEFAULT as log
+        mode = pallas_mode()
+        if (mode is not None
+                and kernel_rules_eligible(self.name, gen, len(targets))):
+            from dprf_tpu.runtime.worker import PallasWordlistWorker
+            try:
+                worker = PallasWordlistWorker(
+                    self, gen, targets, batch=batch,
+                    hit_capacity=hit_capacity, oracle=oracle, **mode)
+                worker.warmup()
+                return worker
+            except Exception as e:
+                log.warn("rules kernel failed to build/compile; "
+                         "falling back to the XLA pipeline",
+                         engine=self.name,
+                         error=f"{type(e).__name__}: {e}")
+        elif mode is not None:
+            log.info("rules kernel not eligible for this job; "
+                     "using the XLA pipeline", engine=self.name,
+                     targets=len(targets))
         return DeviceWordlistWorker(self, gen, targets, batch=batch,
                                     hit_capacity=hit_capacity, oracle=oracle)
 

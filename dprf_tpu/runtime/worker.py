@@ -275,6 +275,40 @@ class DeviceWordlistWorker(WordlistWorkerBase):
         return hits
 
 
+class PallasWordlistWorker(DeviceWordlistWorker):
+    """Wordlist+rules worker over the in-VMEM rule-interpreter kernel
+    (ops/pallas_rules.py) -- config 3's fast path.  Single target,
+    exact in-kernel compare; the step keeps DeviceWordlistWorker's
+    (w0, n_valid_words) -> (count, lanes, tpos) contract with
+    rule-major flat lanes for ANY w0 (units need not be tile-aligned),
+    so process/hit decode/rescan are inherited unchanged."""
+
+    def __init__(self, engine, gen, targets: Sequence[Target],
+                 batch: int = 1 << 18, hit_capacity: int = 64,
+                 oracle: Optional[HashEngine] = None,
+                 interpret: bool = False):
+        from dprf_tpu.ops.pallas_rules import TILE_W, make_rules_crack_step
+
+        tgt = self._setup_targets(engine, gen, targets, hit_capacity,
+                                  oracle)
+        if self.multi:
+            raise ValueError("rules kernel is single-target")
+        word_batch = max(TILE_W,
+                         (batch // max(1, gen.n_rules) // TILE_W)
+                         * TILE_W)
+        self.step = make_rules_crack_step(
+            engine.name, gen, np.asarray(tgt), word_batch,
+            hit_capacity, interpret=interpret)
+        self.word_batch = self.step.word_batch
+        self.stride = self.word_batch * gen.n_rules
+
+    def warmup(self) -> None:
+        import jax.numpy as jnp
+
+        from dprf_tpu.utils.sync import hard_sync
+        hard_sync(self.step(jnp.int32(0), jnp.int32(0)))
+
+
 class PallasMaskWorker(MaskWorkerBase):
     """Mask worker over the hand-written Pallas kernels
     (ops/pallas_mask.py) -- the fast path where the whole

@@ -63,6 +63,7 @@ DEADLINES = {
     "config5": 900,
     "sweep": 1200,
     "ext_kernels": 1800,
+    "rules_kernel": 1200,
 }
 
 DEFAULT_PLAN = ["kernels", "bench_fast", "config1", "config2", "config3",
@@ -398,11 +399,69 @@ def stage_ext_kernels(io: StageIO):
                    "traceback": traceback.format_exc()[-1200:]})
 
 
+def stage_rules_kernel(io: StageIO):
+    """Round-4 rules-interpreter kernel (ops/pallas_rules.py) on real
+    hardware: planted-target proof through the production wordlist
+    worker, then the VERDICT criterion measurement -- config 3
+    re-measured (run_config auto-selects the kernel on TPU)."""
+    import hashlib
+
+    from dprf_tpu import get_engine
+    from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+    from dprf_tpu.rules.parser import load_rules
+    from dprf_tpu.runtime.workunit import WorkUnit
+
+    io.status("prove/md5+best64")
+    rec = {}
+    try:
+        words = [b"alpha", b"bravo", b"s3cret", b"delta", b"echo"] + [
+            b"w%05d" % i for i in range(3000)]
+        gen = WordlistRulesGenerator(words, load_rules("best64"),
+                                     max_len=16)
+        cpu = get_engine("md5", device="cpu")
+        dev = get_engine("md5", device="jax")
+        # plant rule 'd' (duplicate) on "s3cret" -> find via CPU sweep
+        from dprf_tpu.rules.cpu import apply_rule
+        ri = next(i for i, ops in enumerate(gen.rules)
+                  if apply_rule(b"s3cret", ops, 16) == b"s3crets3cret")
+        plain = b"s3crets3cret"
+        t = cpu.parse_target(hashlib.md5(plain).hexdigest())
+        t0 = time.perf_counter()
+        w = dev.make_wordlist_worker(gen, [t], batch=1 << 18,
+                                     hit_capacity=8, oracle=cpu)
+        rec["worker"] = type(w).__name__
+        rec["compile_s"] = round(time.perf_counter() - t0, 2)
+        hits = w.process(WorkUnit(0, 0, gen.keyspace))
+        want = (0, gen.index_of(2, ri))
+        rec["ok"] = (rec["worker"] == "PallasWordlistWorker"
+                     and want in {(h.target_index, h.cand_index)
+                                  for h in hits}
+                     and all(cpu.hash_batch([h.plaintext])[0] == t.digest
+                             for h in hits))
+        rec["hits"] = [h.cand_index for h in hits]
+    except Exception as e:
+        rec["ok"] = False
+        rec["error"] = f"{type(e).__name__}: {e}"
+        rec["traceback"] = traceback.format_exc()[-1500:]
+    io.record("prove/md5+best64", rec)
+
+    io.status("config3-kernel")
+    try:
+        from dprf_tpu.bench import run_config
+        res = run_config(3, device="jax", **CONFIG_ARGS[3])
+        io.record("config3-kernel", res)
+    except Exception as e:
+        io.record("config3-kernel",
+                  {"error": f"{type(e).__name__}: {e}",
+                   "traceback": traceback.format_exc()[-1500:]})
+
+
 STAGES = {
     "kernels": stage_kernels,
     "bench_fast": stage_bench_fast,
     "sweep": stage_sweep,
     "ext_kernels": stage_ext_kernels,
+    "rules_kernel": stage_rules_kernel,
     **{f"config{n}": _stage_config(n) for n in range(1, 6)},
 }
 
