@@ -53,6 +53,12 @@ class JaxPmkidEngine(Pmkid2Engine):
                          oracle=None):
         # PBKDF2 is ~16k compressions/candidate; a huge batch only adds
         # latency per step, so cap it well below fast-hash batch sizes.
+        worker = maybe_pallas_pmkid_worker(self, gen, targets,
+                                           batch=min(batch, 1 << 15),
+                                           hit_capacity=hit_capacity,
+                                           oracle=oracle)
+        if worker is not None:
+            return worker
         return PmkidDeviceWorker(self, gen, targets,
                                  batch=min(batch, 1 << 14),
                                  hit_capacity=hit_capacity, oracle=oracle)
@@ -185,6 +191,132 @@ def make_sharded_pmkid_crack_step(engine: JaxPmkidEngine,
 
     step.super_batch = mesh.devices.size * B
     return step
+
+
+class PallasPmkidWorker:
+    """Per-target PMKID sweep over the fused Pallas PBKDF2 kernel
+    (ops/pallas_pbkdf2.py) -- measured 156.5 kH/s at 4096 iterations
+    on TPU v5 lite vs 17.4 kH/s through the XLA step (9x; ~2.56 G
+    SHA-1 compressions/s, the sha1 kernel's rate).
+
+    The kernel recomputes the PMK per target, so jobs where many
+    targets share one ESSID (where the XLA step amortizes the KDF)
+    route here only while the per-essid target count stays under the
+    kernel's speedup factor -- see maybe_pallas_pmkid_worker."""
+
+    def __init__(self, engine, gen, targets: Sequence[Target],
+                 batch: int = 1 << 15, hit_capacity: int = 64,
+                 oracle=None):
+        from dprf_tpu.ops.pallas_pbkdf2 import (make_pmkid_kernel_step,
+                                                target_kernel_args)
+
+        self.engine = engine
+        self.gen = gen
+        self.targets = list(targets)
+        self.hit_capacity = hit_capacity
+        self.oracle = oracle
+        self._targs = [target_kernel_args(t) for t in self.targets]
+        lens = sorted({a[0] for a in self._targs})
+        self._steps = {n: make_pmkid_kernel_step(gen, batch, n,
+                                                 hit_capacity)
+                       for n in lens}
+        self.batch = self.stride = next(iter(self._steps.values())).batch
+
+    def warmup(self) -> None:
+        from dprf_tpu.utils.sync import hard_sync
+        base = jnp.asarray(self.gen.digits(0), dtype=jnp.int32)
+        by_len = {a[0]: a for a in self._targs}
+        for n, (el, essid, msg5, tgt) in by_len.items():
+            hard_sync(self._steps[n](base, jnp.int32(0),
+                                     jnp.int32(self.engine.iterations),
+                                     essid, msg5, tgt))
+
+    def process(self, unit) -> list:
+        from dprf_tpu.runtime.worker import CpuWorker, Hit
+        iters = jnp.int32(self.engine.iterations)
+        hits: list = []
+        for ti, (el, essid, msg5, tgt) in enumerate(self._targs):
+            step = self._steps[el]
+            queued = []
+            flag = None
+            for bstart in range(unit.start, unit.end, self.stride):
+                n_valid = min(self.stride, unit.end - bstart)
+                base = jnp.asarray(self.gen.digits(bstart),
+                                   dtype=jnp.int32)
+                result = step(base, jnp.int32(n_valid), iters, essid,
+                              msg5, tgt)
+                # device-accumulated unit flag; one readback per
+                # (target, unit) -- see MaskWorkerBase.process
+                flag = result[0] if flag is None else flag + result[0]
+                queued.append((bstart, result))
+            if flag is None or int(flag) == 0:
+                continue
+            for bstart, (count, lanes, _) in queued:
+                count = int(count)
+                if count == 0:
+                    continue
+                if count > self.hit_capacity:
+                    if self.oracle is None:
+                        raise RuntimeError(
+                            "hit buffer overflow and no oracle to "
+                            "rescan with; raise hit_capacity")
+                    end = min(bstart + self.stride, unit.end)
+                    sub = type(unit)(-1, bstart, end - bstart)
+                    hits.extend(Hit(ti, h.cand_index, h.plaintext)
+                                for h in CpuWorker(
+                                    self.oracle, self.gen,
+                                    [self.targets[ti]]).process(sub))
+                    continue
+                for lane in np.asarray(lanes):
+                    if lane < 0:
+                        continue
+                    gidx = bstart + int(lane)
+                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+        return hits
+
+
+def maybe_pallas_pmkid_worker(engine, gen, targets, batch: int,
+                              hit_capacity: int, oracle):
+    """PallasPmkidWorker when the kernel path wins, else None.
+
+    The kernel is ~9x the XLA step per keyspace sweep but sweeps once
+    per TARGET, while the XLA step shares each ESSID's PBKDF2 across
+    its targets -- so route to the kernel only while the largest
+    same-essid target group stays under the speedup factor."""
+    from dprf_tpu.ops.pallas_mask import pallas_mode
+    from dprf_tpu.ops.pallas_pbkdf2 import pmkid_kernel_eligible
+    from dprf_tpu.utils.logging import DEFAULT as log
+
+    if not targets:
+        return None
+    # evaluate the routing heuristic BEFORE the backend check so the
+    # hermetic suite can exercise it (the mode gate would otherwise
+    # shadow it off-TPU)
+    lens = [len(t.params["essid"]) for t in targets]
+    by_essid, _ = _group_targets(targets)
+    max_per_essid = max(len(v) for v in by_essid.values())
+    if max_per_essid > 8 or not pmkid_kernel_eligible(gen, lens):
+        log.info("pmkid pallas kernel not chosen for this job; "
+                 "using the XLA step", targets=len(targets),
+                 max_per_essid=max_per_essid)
+        return None
+    mode = pallas_mode()
+    if mode is None or mode.get("interpret", False):
+        # TPU-only: the 14 statically-unrolled SHA-1 compressions
+        # don't compile on XLA:CPU in reasonable time (the sha256
+        # kernel rule); hardware proof in TPU_RESULTS_r04
+        return None
+    try:
+        worker = PallasPmkidWorker(engine, gen, targets, batch=batch,
+                                   hit_capacity=hit_capacity,
+                                   oracle=oracle)
+        worker.warmup()
+        return worker
+    except Exception as e:
+        log.warn("pmkid pallas kernel failed to build/compile; "
+                 "falling back to the XLA step",
+                 error=f"{type(e).__name__}: {e}")
+        return None
 
 
 class PmkidDeviceWorker(DeviceMaskWorker):
