@@ -349,26 +349,45 @@ def run_config(config: int, device: str = "jax", seconds: float = 5.0,
         worker = CpuWorker(oracle, gen, targets)
         stride = min(1 << 12, gen.keyspace)
 
-    # warmup/compile on one stride
+    unit_len = stride * max(1, unit_strides)
+    # warmup/compile on a FULL unit so the super-step program (workers
+    # fuse many batches into one dispatch for multi-stride units) is
+    # compiled outside the timed window, not inside it.
     t0 = _time.perf_counter()
-    worker.process(WorkUnit(-1, 0, min(stride, gen.keyspace)))
+    worker.process(WorkUnit(-1, 0, min(unit_len, gen.keyspace)))
     compile_s = _time.perf_counter() - t0
     if log:
         log.info("config compiled", config=config,
                  seconds=f"{compile_s:.1f}")
 
-    unit_len = stride * max(1, unit_strides)
+    from dprf_tpu.runtime.worker import submit_or_process
+
     tested = 0
     start = 0
+    pending: list = []
     t0 = _time.perf_counter()
-    while _time.perf_counter() - t0 < seconds:
-        length = min(unit_len, gen.keyspace - start)
-        if length <= 0:
-            start = 0
-            continue
-        worker.process(WorkUnit(-1, start, length))
-        tested += length
-        start += length
+    # depth-2 submit/resolve pipeline -- the production Coordinator
+    # shape -- so a unit's flag readback overlaps the next unit's
+    # compute instead of serializing with it.
+    # Always submit FULL-size units (wrapping to 0 early rather than
+    # issuing a keyspace-tail remnant): an odd-sized tail unit would
+    # pick super-step inner sizes the warmup never compiled, putting a
+    # multi-second jit inside the timed window.
+    length = min(unit_len, gen.keyspace)
+    while True:
+        in_window = _time.perf_counter() - t0 < seconds
+        if in_window:
+            if gen.keyspace - start < length:
+                start = 0
+            pending.append((length, submit_or_process(
+                worker, WorkUnit(-1, start, length))))
+            start += length
+        if not pending:
+            break
+        if len(pending) >= 2 or not in_window:
+            ulen, p = pending.pop(0)
+            p.resolve()
+            tested += ulen
     elapsed = _time.perf_counter() - t0
 
     import jax as _jax

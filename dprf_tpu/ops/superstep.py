@@ -1,0 +1,92 @@
+"""Super-step: one device dispatch covering many worker batches.
+
+Why: over the axon tunnel a host<->device round trip costs ~0.1-0.4 s
+and every dispatch enqueue / argument transfer adds fixed overhead.
+The production workers process a WorkUnit as `unit_strides` separate
+step dispatches plus one flag readback; at fast-engine rates (~1 ms of
+device work per 4M-candidate batch) that fixed cost dominated -- the
+round-4 session1 measurements put the config-1 worker path at 960 MH/s
+against a 3.66 GH/s kernel bench whose `inner`-loop wrapper amortized
+exactly this overhead (TPU_RESULTS_r04.json).
+
+This module is the *production-grade* version of that bench wrapper
+(dprf_tpu/bench.py make_looped_step is measurement-only: it discards
+hit lanes).  A super-step wraps a worker crack step in a `lax.scan` of
+`inner` iterations inside ONE jit:
+
+  - xs carries each iteration's leading step argument, precomputed on
+    host: a [inner, L] matrix of mixed-radix digit vectors for mask
+    steps, or an [inner] vector of word-window starts for wordlist
+    steps.  Host-side digit math is microseconds; shipping it as one
+    array replaces `inner` separate small transfers.
+  - n_valid is the TOTAL valid candidates (or words) across the super
+    chunk; each iteration clips its own share, so partial tails are
+    exact.
+  - The per-iteration step outputs are returned STACKED (scan ys), so
+    hit decoding on the host sees exactly the same (count, lanes, ...)
+    tuples the per-batch path produces -- same overflow semantics,
+    same rescan granularity (one batch), no on-device merge logic.
+  - The unit-level "does the host need to look at this" flag is
+    accumulated in the scan carry and returned as one scalar: a
+    hitless unit still costs a single scalar readback, never a
+    stacked-buffer fetch.
+
+The scan body compiles once regardless of `inner`; carrying only an
+int32 scalar (probe-log finding: large tuple carries can upset the
+TPU backend compiler, and the bench's scalar-carry fori_loop over the
+same Pallas step is hardware-proven at inner=512).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: per-dispatch int32 lane budget: batch * inner must stay below 2^31
+#: (step-internal lane arithmetic and the n_valid clip are int32).
+INT32_BUDGET = (1 << 31) - 256
+
+
+def max_inner(batch: int, cap: int = 512) -> int:
+    """Largest power-of-two inner length whose super chunk fits int32
+    arithmetic (and an optional cap)."""
+    n = min(cap, INT32_BUDGET // max(1, batch))
+    return 1 << (n.bit_length() - 1) if n >= 1 else 0
+
+
+def make_super_step(step, inner: int, batch: int, flag_fn=None):
+    """Wrap `step(x, n_valid) -> tuple` in a device-side scan.
+
+    Returns super_step(xs, n_valid_total) -> (flag, stacked_outputs)
+    where xs[i] is iteration i's leading argument and stacked_outputs
+    mirrors the step's output tuple with a leading [inner] axis.
+
+    flag_fn(out) -> int32 scalar marks an iteration as needing host
+    attention (default: out[0], the hit count).  The returned flag is
+    the sum over iterations.
+    """
+    if inner < 1:
+        raise ValueError("inner must be >= 1")
+    if inner * batch > INT32_BUDGET:
+        raise ValueError(
+            f"inner*batch = {inner * batch} overflows int32 lane "
+            f"arithmetic (max {INT32_BUDGET}); lower inner")
+
+    @jax.jit
+    def super_step(xs, n_valid):
+        n_valid = jnp.asarray(n_valid, jnp.int32)
+
+        def body(acc, xi):
+            x, i = xi
+            nv = jnp.clip(n_valid - i * batch, 0, batch)
+            out = step(x, nv)
+            f = flag_fn(out) if flag_fn is not None else out[0]
+            return acc + f.astype(jnp.int32), out
+
+        acc, outs = lax.scan(
+            body, jnp.int32(0),
+            (xs, jnp.arange(inner, dtype=jnp.int32)))
+        return acc, outs
+
+    return super_step

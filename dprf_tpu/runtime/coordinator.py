@@ -132,13 +132,19 @@ class Coordinator:
                                     hit.plaintext)
         return True
 
-    def _process_unit(self, unit) -> None:
-        """Run one unit through the worker; any rejected hit means the
+    #: units dispatched ahead of the oldest unresolved one.  Depth 2 is
+    #: enough to overlap one unit's flag round trip with the next
+    #: unit's compute (the only latency in the loop); deeper queues
+    #: just hold more leases without hiding more.
+    PIPELINE_DEPTH = 2
+
+    def _finish_unit(self, unit, hits) -> None:
+        """Record a unit's resolved hits; any rejected hit means the
         device path is suspect for this range, so the whole unit is
         exactly rescanned with the CPU oracle (whose hits verify by
         construction) before the unit may count as covered."""
         rejected = False
-        for hit in self.worker.process(unit):
+        for hit in hits:
             rejected |= not self._record(hit)
         if rejected:
             from dprf_tpu.runtime.worker import CpuWorker
@@ -148,20 +154,34 @@ class Coordinator:
                 self._record(hit)   # oracle-produced: verifies trivially
 
     def run(self) -> JobResult:
+        from dprf_tpu.runtime.worker import submit_or_process
+
         t0 = time.perf_counter()
         tested0 = self.dispatcher.progress()[0]
         last_report = t0
         if self.session is not None:
             self.session.open(self.spec.as_dict())
+        # (unit, PendingUnit) FIFO: device work for every queued unit is
+        # already dispatched; resolving the head overlaps its readback
+        # latency with the tail's compute.
+        pending: list = []
         try:
-            while not self._all_found() and not self.dispatcher.done():
-                unit = self.dispatcher.lease()
-                if unit is None:
-                    if self.dispatcher.outstanding_count() == 0:
+            while not self._all_found():
+                while (len(pending) < self.PIPELINE_DEPTH
+                       and not self.dispatcher.done()):
+                    unit = self.dispatcher.lease()
+                    if unit is None:
+                        break
+                    pending.append((unit, submit_or_process(self.worker,
+                                                            unit)))
+                if not pending:
+                    if self.dispatcher.done() or \
+                            self.dispatcher.outstanding_count() == 0:
                         break        # exhausted
                     time.sleep(0.01)
                     continue
-                self._process_unit(unit)
+                unit, p = pending.pop(0)
+                self._finish_unit(unit, p.resolve())
                 self.dispatcher.complete(unit.unit_id)
                 if self.session is not None:
                     self.session.record_units(

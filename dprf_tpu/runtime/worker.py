@@ -29,6 +29,58 @@ class Hit:
     plaintext: bytes
 
 
+class PendingUnit:
+    """A WorkUnit whose device work is fully enqueued but not yet
+    resolved.  The unit-level flag (device-accumulated hit indicator)
+    is already on its way back to the host; ``resolve()`` blocks on it
+    and only fetches the queued hit buffers when it is nonzero.
+
+    Callers that hold a PendingUnit while submitting the NEXT unit
+    overlap the flag's link round trip with that unit's compute -- the
+    difference between paying ~RTT per unit and paying ~max(compute,
+    RTT) (see Coordinator.run / bench.run_config)."""
+
+    __slots__ = ("worker", "unit", "queued", "flag")
+
+    def __init__(self, worker, unit, queued, flag):
+        self.worker = worker
+        self.unit = unit
+        self.queued = queued
+        self.flag = flag
+
+    def resolve(self) -> list["Hit"]:
+        if self.flag is None or int(self.flag) == 0:
+            return []
+        hits: list[Hit] = []
+        for kind, start, result in self.queued:
+            hits.extend(self.worker._decode_queued(kind, start, result,
+                                                   self.unit))
+        return hits
+
+
+def submit_or_process(worker, unit) -> "PendingUnit":
+    """Uniform pipelining entry.  A worker is submitted asynchronously
+    ONLY when its ``process`` is one of the submit-based
+    implementations (marked ``_submit_based``): a subclass that
+    overrides ``process`` with its own sweep logic (per-salt-block
+    steps, per-target steps, sharded super-batches, chunked bcrypt,
+    CpuWorker...) must run through that override, not through an
+    inherited ``submit`` that would bypass it."""
+    if getattr(type(worker).process, "_submit_based", False):
+        return worker.submit(unit)
+    return _ResolvedUnit(worker.process(unit))
+
+
+class _ResolvedUnit:
+    __slots__ = ("hits",)
+
+    def __init__(self, hits):
+        self.hits = hits
+
+    def resolve(self):
+        return self.hits
+
+
 def word_cover_range(unit: WorkUnit, n_rules: int) -> tuple:
     """Covering word range [w_start, w_end) of a keyspace-index unit
     (index = word * n_rules + rule; ceil on the end)."""
@@ -132,30 +184,135 @@ class MaskWorkerBase:
         count; subclasses with extra buffers override."""
         return result[0]
 
-    def process(self, unit: WorkUnit) -> list[Hit]:
+    #: largest number of batches fused into one super-step dispatch
+    #: and the smallest chunk worth a dedicated compile.  Power-of-two
+    #: inner sizes bound the compile cache at log2(SUPER_CAP) entries.
+    SUPER_CAP = 256
+    SUPER_MIN = 8
+
+    def _super_batch(self) -> int:
+        """Keyspace indices consumed per super-step iteration."""
+        return self.stride
+
+    def _super_step(self, inner: int):
+        from dprf_tpu.ops.superstep import make_super_step
+        cache = getattr(self, "_super_cache", None)
+        if cache is None:
+            cache = self._super_cache = {}
+        # keyed by the step OBJECT, not just inner: some workers swap
+        # self.step between sweeps (descrypt's salt blocks).  The
+        # cached entry holds a strong ref to its step so the id key
+        # can never be reused by a successor object.
+        key = (id(self.step), inner)
+        entry = cache.get(key)
+        if entry is None:
+            entry = cache[key] = (self.step, make_super_step(
+                self.step, inner, self._super_batch(), self._batch_flag))
+        return entry[1]
+
+    def _super_inner(self, remaining_chunks: int) -> int:
+        """Power-of-two scan length for a super dispatch, or 0 for the
+        per-batch path.  DPRF_SUPERSTEP=0 disables super dispatch."""
+        import os
+
+        from dprf_tpu.ops.superstep import max_inner
+        if getattr(self, "_super_disabled", False) or \
+                os.environ.get("DPRF_SUPERSTEP", "1") == "0":
+            return 0
+        cap = max_inner(self._super_batch(), self.SUPER_CAP)
+        if remaining_chunks < self.SUPER_MIN or cap < self.SUPER_MIN:
+            return 0
+        return min(cap, 1 << (remaining_chunks.bit_length() - 1))
+
+    def _super_dispatch(self, inner: int, xs, n_valid):
+        """One super dispatch, or None if its program will not build.
+        Super programs compile lazily at the first big unit -- after
+        the engine factory's warmup-time Pallas->XLA fallback has
+        already run -- so a backend that rejects the scan-wrapped step
+        must degrade THIS worker to per-batch dispatch, not kill the
+        job mid-run."""
+        import jax.numpy as jnp
+        try:
+            ss = self._super_step(inner)
+            return ss(jnp.asarray(xs), jnp.int32(n_valid))
+        except Exception as e:        # noqa: BLE001 -- compiler errors
+            # are backend-specific exception types; anything raised
+            # here means "no super program", never a wrong result
+            from dprf_tpu.utils.logging import DEFAULT as log
+            self._super_disabled = True
+            log.warn("super-step program failed to build; falling back "
+                     "to per-batch dispatch", inner=inner, error=str(e))
+            return None
+
+    def submit(self, unit: WorkUnit) -> PendingUnit:
+        """Enqueue ALL device work for the unit and return a
+        PendingUnit.  Large units go out as super-step dispatches --
+        one scan program covering up to SUPER_CAP batches -- so the
+        per-dispatch link overhead (argument transfers + enqueue) is
+        paid once per ~10^9 candidates instead of once per batch; the
+        remainder uses the per-batch step.  The unit-level hit flag is
+        accumulated ON DEVICE across both kinds, so a hitless unit
+        costs exactly one scalar readback."""
         import jax.numpy as jnp
         queued = []
         flag = None
-        for bstart in range(unit.start, unit.end, self.stride):
+        pos = unit.start
+        while True:
+            inner = self._super_inner((unit.end - pos) // self.stride)
+            if inner < 2:
+                break
+            sstride = inner * self.stride
+            digits = np.stack([
+                np.asarray(self.gen.digits(pos + i * self.stride),
+                           dtype=np.int32) for i in range(inner)])
+            out = self._super_dispatch(inner, digits, sstride)
+            if out is None:
+                break                      # degraded to per-batch
+            f, outs = out
+            flag = f if flag is None else flag + f
+            queued.append(("super", pos, outs))
+            pos += sstride
+        for bstart in range(pos, unit.end, self.stride):
             n_valid = min(self.stride, unit.end - bstart)
             base = jnp.asarray(self.gen.digits(bstart), dtype=jnp.int32)
             result = self.step(base, jnp.int32(n_valid))
-            # unit-level hit indicator, accumulated ON DEVICE: scalar
-            # adds ride the stream behind their batches, so the single
-            # int() below is the only host readback a hitless unit
-            # pays.  Per-batch count fetches would cost one link round
-            # trip per batch -- over a high-latency transport (the axon
-            # tunnel: ~60 ms RTT) that caps throughput at
-            # batch/RTT regardless of chip speed.
+            # scalar adds ride the stream behind their batches; per-
+            # batch count fetches would cost one link round trip per
+            # batch -- over a high-latency transport that caps
+            # throughput at batch/RTT regardless of chip speed.
             f = self._batch_flag(result)
             flag = f if flag is None else flag + f
-            queued.append((bstart, result))
-        if flag is None or int(flag) == 0:
-            return []
+            queued.append(("batch", bstart, result))
+        if flag is not None and hasattr(flag, "copy_to_host_async"):
+            flag.copy_to_host_async()
+        return PendingUnit(self, unit, queued, flag)
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        return self.submit(unit).resolve()
+
+    process._submit_based = True   # safe to pipeline via submit()
+
+    @staticmethod
+    def _super_rows(result, start: int, window: int, decode_row):
+        """Stacked super-step outputs -> per-row decode at start + i *
+        window.  Each row is exactly one per-batch step output tuple,
+        so overflow/rescan semantics stay at one-batch granularity."""
+        arrs = [np.asarray(a) for a in result]
         hits: list[Hit] = []
-        for bstart, result in queued:
-            hits.extend(self._batch_hits(bstart, result, unit))
+        for i in range(arrs[0].shape[0]):
+            hits.extend(decode_row(start + i * window,
+                                   tuple(a[i] for a in arrs)))
         return hits
+
+    def _decode_queued(self, kind: str, start, result,
+                       unit: WorkUnit) -> list[Hit]:
+        """One queued dispatch -> Hit records; super rows decode
+        through the SAME _batch_hits path as plain batches."""
+        if kind == "batch":
+            return self._batch_hits(start, result, unit)
+        return self._super_rows(
+            result, start, self.stride,
+            lambda bstart, row: self._batch_hits(bstart, row, unit))
 
     def _decode_lanes(self, bstart: int, lanes_np, tpos_np) -> list[Hit]:
         """Hit-buffer arrays -> Hit records (lane -1 = unused slot)."""
@@ -245,34 +402,72 @@ class DeviceWordlistWorker(WordlistWorkerBase):
             engine, gen, tgt, self.word_batch, hit_capacity,
             widen_utf16=getattr(engine, "widen_utf16", False))
 
-    def process(self, unit: WorkUnit) -> list[Hit]:
+    def _super_batch(self) -> int:
+        return self.word_batch
+
+    def submit(self, unit: WorkUnit) -> PendingUnit:
+        """Word-window analogue of MaskWorkerBase.submit: the step
+        argument is a window start (scalar), n_valid counts WORDS, and
+        super dispatches cover runs of full word windows."""
         import jax.numpy as jnp
         w_start, w_end = word_cover_range(unit, self.gen.n_rules)
+        w_end = min(w_end, self.gen.n_words)
         queued = []
         flag = None
-        for ws in range(w_start, w_end, self.word_batch):
-            nw = min(self.word_batch, w_end - ws, self.gen.n_words - ws)
-            if nw <= 0:
+        ws = w_start
+        while True:
+            inner = self._super_inner((w_end - ws) // self.word_batch)
+            if inner < 2:
                 break
+            w0s = (np.arange(inner, dtype=np.int32) * self.word_batch
+                   + np.int32(ws))
+            out = self._super_dispatch(inner, w0s,
+                                       inner * self.word_batch)
+            if out is None:
+                break                      # degraded to per-batch
+            f, outs = out
+            flag = f if flag is None else flag + f
+            queued.append(("wsuper", ws, outs))
+            ws += inner * self.word_batch
+        while ws < w_end:
+            nw = min(self.word_batch, w_end - ws)
             result = self.step(jnp.int32(ws), jnp.int32(nw))
-            # device-accumulated unit flag; see MaskWorkerBase.process
+            # device-accumulated unit flag; see MaskWorkerBase.submit
             f = self._batch_flag(result)
             flag = f if flag is None else flag + f
-            queued.append((ws, nw, result))
-        if flag is None or int(flag) == 0:
+            queued.append(("wbatch", (ws, nw), result))
+            ws += nw
+        if flag is not None and hasattr(flag, "copy_to_host_async"):
+            flag.copy_to_host_async()
+        return PendingUnit(self, unit, queued, flag)
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        return self.submit(unit).resolve()
+
+    process._submit_based = True   # safe to pipeline via submit()
+
+    def _window_hits(self, ws: int, nw: int, result,
+                     unit: WorkUnit) -> list[Hit]:
+        count, lanes, tpos = result
+        count = int(count)
+        if count == 0:
             return []
-        hits: list[Hit] = []
-        for ws, nw, result in queued:
-            count, lanes, tpos = result
-            count = int(count)
-            if count == 0:
-                continue
-            if count > self.hit_capacity:
-                hits.extend(self._rescan_words(ws, nw, unit))
-                continue
-            hits.extend(self._collect_word_hits(
-                np.asarray(lanes), np.asarray(tpos), ws, unit))
-        return hits
+        if count > self.hit_capacity:
+            return self._rescan_words(ws, nw, unit)
+        return self._collect_word_hits(
+            np.asarray(lanes), np.asarray(tpos), ws, unit)
+
+    def _decode_queued(self, kind: str, start, result,
+                       unit: WorkUnit) -> list[Hit]:
+        if kind == "wbatch":
+            ws, nw = start
+            return self._window_hits(ws, nw, result, unit)
+        if kind == "wsuper":
+            return self._super_rows(
+                result, start, self.word_batch,
+                lambda ws, row: self._window_hits(
+                    ws, self.word_batch, row, unit))
+        return super()._decode_queued(kind, start, result, unit)
 
 
 class PallasWordlistWorker(DeviceWordlistWorker):
