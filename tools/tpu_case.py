@@ -174,6 +174,61 @@ def run_case(name: str) -> dict:
         @jax.jit
         def run(b):
             return step(b, jnp.int32(B))[0]
+    elif kind == "scanprobe":
+        # scanprobe-<variant>-<inner>: minimal lax.scan shapes on this
+        # backend, bisecting the round-4b config-stage hang (the
+        # super-step scan program never came back from compile).
+        #   scalar -- scalar carry, scalar ys
+        #   ys     -- scalar carry, stacked [8,128] vector ys
+        variant, inner = parts[1], int(parts[2])
+        from jax import lax
+        vec = jnp.arange(8 * 128, dtype=jnp.int32).reshape(8, 128)
+        xs = jnp.arange(inner, dtype=jnp.int32)
+        B = inner
+
+        @jax.jit
+        def run(b):
+            def body(c, i):
+                v = vec * (b[0] + i) + 1
+                s = v.sum()
+                y = s if variant == "scalar" else v
+                return c + s, y
+            acc, ys = lax.scan(body, jnp.int32(0), xs)
+            return acc
+    elif kind == "superstep":
+        # superstep-<engine>-<logbatch>-<inner>: the production
+        # worker super-dispatch path (ops/superstep.py scan wrapping
+        # the real crack step) at a controllable batch, via
+        # worker.process on one unit of exactly inner batches.
+        ename, logB, inner = parts[1], int(parts[2]), int(parts[3])
+        from dprf_tpu import get_engine
+        from dprf_tpu.runtime.workunit import WorkUnit
+        B = 1 << logB
+        eng = get_engine(ename, device="jax")
+        oracle = get_engine(ename, device="cpu")
+        g8 = MaskGenerator("?l?l?l?l?l?l?l?l")
+        from dprf_tpu.bench import _unmatchable
+        tgt = oracle.parse_target(_unmatchable(oracle))
+        worker = eng.make_mask_worker(g8, [tgt], batch=B,
+                                      hit_capacity=64, oracle=oracle)
+        worker.SUPER_CAP = inner
+        unit_len = worker.stride * inner
+        t0 = time.perf_counter()
+        hits = worker.process(WorkUnit(-1, 0, unit_len))
+        compile_s = time.perf_counter() - t0
+        degraded = getattr(worker, "_super_disabled", False)
+        k, t0 = 0, time.perf_counter()
+        while True:
+            worker.process(WorkUnit(-1, 0, unit_len))
+            k += 1
+            if time.perf_counter() - t0 > 20.0 or k >= 32:
+                break
+        dt = time.perf_counter() - t0
+        return {"case": name, "ok": not degraded, "degraded": degraded,
+                "hs": k * unit_len / dt, "batch": B, "inner": inner,
+                "units": k, "unit_s": round(dt / k, 2),
+                "compile_s": round(compile_s, 1),
+                "false_hits": len(hits)}
     else:
         raise ValueError(f"unknown case {name!r}")
 
