@@ -247,12 +247,13 @@ class DescryptMaskWorker(MaskWorkerBase):
             hits.extend(super().process(unit))
         return hits
 
-    def _rescan(self, bstart, unit):
+    def _rescan(self, bstart, unit, window: int = 0):
         # scope the exact rescan to THIS block's targets: the base
         # rescan covers self.targets wholesale, which would double-
         # report other blocks' hits (their own sweeps find them too)
         return _scoped_rescan(self, self._current_tis, bstart,
-                              min(bstart + self.stride, unit.end))
+                              min(bstart + (window or self.stride),
+                                  unit.end))
 
 
 class DescryptWordlistWorker(DeviceWordlistWorker):

@@ -372,13 +372,14 @@ class PmkidDeviceWorker(DeviceMaskWorker):
                         hits.append(Hit(ti, gidx, plain))
         return hits
 
-    def _batch_hits(self, bstart: int, result, unit) -> list:
+    def _batch_hits(self, bstart: int, result, unit,
+                    window: int = 0) -> list:
         count, lanes, tpos, n_multi = result
         count = int(count)
         if count == 0:
             return []
-        if count > self.hit_capacity:
-            return self._rescan(bstart, unit)
+        if count > lanes.shape[0]:     # the step's built buffer size
+            return self._rescan(bstart, unit, window)
         if int(n_multi):
             return self._resolve_all_targets(bstart, np.asarray(lanes))
         return self._decode_lanes(bstart, np.asarray(lanes),
@@ -398,12 +399,13 @@ class ShardedPmkidWorker(PmkidDeviceWorker):
         self.step = make_sharded_pmkid_crack_step(
             engine, gen, self.targets, mesh, batch_per_device, hit_capacity)
 
-    def _batch_hits(self, bstart: int, result, unit) -> list:
+    def _batch_hits(self, bstart: int, result, unit,
+                    window: int = 0) -> list:
         total, counts, lanes, tpos, n_multi = result
         if int(total) == 0:
             return []
-        if (np.asarray(counts) > self.hit_capacity).any():
-            return self._rescan(bstart, unit)
+        if (np.asarray(counts) > lanes.shape[-1]).any():
+            return self._rescan(bstart, unit, window)
         lanes_np = np.asarray(lanes).ravel()
         if int(n_multi):
             return self._resolve_all_targets(bstart, lanes_np)

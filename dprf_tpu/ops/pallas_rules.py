@@ -378,7 +378,13 @@ def make_rules_pallas_fn(engine_name: str, gen, target_words,
     if shared_words is not None:
         w4, l3 = shared_words
         n_tiles = w4.shape[0]
-    else:
+        # a window needs ceil(n_words/TILE_W) + Twin padding tiles;
+        # arrays shared from a narrower-window build would let the
+        # host-side dynamic_slice clamp and silently shift the whole
+        # window to earlier words -- rebuild instead of reusing
+        if n_tiles < -(-gen.n_words // TILE_W) + Twin:
+            shared_words = None
+    if shared_words is None:
         # words in HBM as (n_tiles, L, SUBW, 128) int32 SoA tiles,
         # padded so the host-side dynamic_slice can never clamp for
         # any in-range start tile (a clamped start would silently
@@ -479,7 +485,7 @@ def make_rules_pallas_fn(engine_name: str, gen, target_words,
 
 def make_rules_crack_step(engine_name: str, gen, target_words,
                           word_batch: int, hit_capacity: int = 64,
-                          interpret: bool = False):
+                          interpret: bool = False, shared_words=None):
     """DeviceWordlistWorker-contract step over the rules kernels:
     step(w0, n_valid_words) -> (count, lanes int32[cap], tpos) with
     flat rule-major lanes (lane = r * word_batch + b).
@@ -501,15 +507,18 @@ def make_rules_crack_step(engine_name: str, gen, target_words,
     B = T * TILE_W
     buckets = step_buckets(gen.rules)
     fns = []
-    shared = None
+    # caller-provided arrays (e.g. a worker sharing one copy across
+    # wide-step sizes) are reused when their padding suffices --
+    # make_rules_pallas_fn checks and rebuilds otherwise, so always
+    # re-read the arrays the first bucket ACTUALLY used
+    shared = shared_words
     for nsteps in sorted(buckets):
         idxs = buckets[nsteps]
         fnb = make_rules_pallas_fn(engine_name, gen, target_words, T,
                                    interpret=interpret,
                                    rule_indices=idxs,
                                    shared_words=shared)
-        if shared is None:
-            shared = (fnb.words4, fnb.lens3)
+        shared = (fnb.words4, fnb.lens3)
         fns.append((fnb, jnp.asarray(np.asarray(idxs, np.int32)),
                     len(idxs)))
 
@@ -549,4 +558,5 @@ def make_rules_crack_step(engine_name: str, gen, target_words,
         return _step(w4, l3, target, w0, n_valid_words)
 
     step.word_batch = B
+    step.words4, step.lens3 = w4, l3    # for cross-step sharing
     return step

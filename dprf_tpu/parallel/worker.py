@@ -35,7 +35,8 @@ class ShardedMaskWorker(MaskWorkerBase):
             engine, gen, tgt, mesh, batch_per_device, hit_capacity,
             widen_utf16=getattr(engine, "widen_utf16", False))
 
-    def _batch_hits(self, bstart: int, result, unit: WorkUnit) -> list[Hit]:
+    def _batch_hits(self, bstart: int, result, unit: WorkUnit,
+                    window: int = 0) -> list[Hit]:
         total, counts, lanes, tpos = result
         if int(total) == 0:
             return []
@@ -43,8 +44,9 @@ class ShardedMaskWorker(MaskWorkerBase):
         # Check every shard BEFORE decoding any: an overflow rescan
         # replaces the whole super-batch, so mixing it with per-shard
         # decoded hits would double-report the non-overflowed shards.
-        if (counts_np > self.hit_capacity).any():
-            return self._rescan(bstart, unit)
+        # Capacity is the step's built per-shard buffer width.
+        if (counts_np > lanes.shape[-1]).any():
+            return self._rescan(bstart, unit, window)
         lanes_np = np.asarray(lanes)
         tpos_np = np.asarray(tpos)
         hits: list[Hit] = []

@@ -190,6 +190,20 @@ class MaskWorkerBase:
     SUPER_CAP = 256
     SUPER_MIN = 8
 
+    #: fusion mechanism for multi-batch units.  "scan" wraps the step
+    #: in ops/superstep.make_super_step (lax.scan with stacked
+    #: outputs) -- right for the XLA-pipeline steps, whose bodies are
+    #: plain jnp ops.  "wide" rebuilds the worker's own step at
+    #: inner*stride lanes via _make_step: the SAME single-pallas_call
+    #: program shape as a plain batch, just a longer (sequential) grid
+    #: -- the only fused shape proven on the axon TPU backend, where a
+    #: scan-wrapped pallas_call wedged the remote compile helper
+    #: (TPU_PROBE_LOG_r04.md, round-4b finding).  Pallas workers set
+    #: "wide"; kernels pay no extra HBM for it (tile state is VMEM,
+    #: raw output is batch/4 bytes), unlike the XLA steps whose
+    #: materialized candidate blocks scale with batch.
+    SUPER_MODE = "scan"
+
     def _super_batch(self) -> int:
         """Keyspace indices consumed per super-step iteration."""
         return self.stride
@@ -224,6 +238,40 @@ class MaskWorkerBase:
             return 0
         return min(cap, 1 << (remaining_chunks.bit_length() - 1))
 
+    def _make_step(self, batch: int):
+        """Rebuild this worker's step at a different lane count.
+        Wide-capable subclasses (SUPER_MODE == "wide") override; the
+        contract is the per-batch step's exactly, with hit capacities
+        scaled up by batch // self.stride (shape-derived at decode)."""
+        raise NotImplementedError
+
+    def _wide_step(self, sbatch: int):
+        cache = getattr(self, "_wide_cache", None)
+        if cache is None:
+            cache = self._wide_cache = {}
+        step = cache.get(sbatch)
+        if step is None:
+            step = cache[sbatch] = self._make_step(sbatch)
+        return step
+
+    def _wide_dispatch(self, sbatch: int, base, n_valid):
+        """One wide dispatch, or None if its program will not build.
+        A backend that rejects the wide program has already run the
+        per-batch program (factory warmup), so the degradation target
+        is per-batch dispatch -- NOT the scan super-step, which is an
+        unproven third shape on the backend that just failed."""
+        import jax.numpy as jnp
+        try:
+            ws = self._wide_step(sbatch)
+            return ws(base, jnp.int32(n_valid))
+        except Exception as e:        # noqa: BLE001 -- compiler errors
+            from dprf_tpu.utils.logging import DEFAULT as log
+            self._wide_disabled = True
+            log.warn("wide-step program failed to build; falling back "
+                     "to per-batch dispatch", sbatch=sbatch,
+                     error=str(e))
+            return None
+
     def _super_dispatch(self, inner: int, xs, n_valid):
         """One super dispatch, or None if its program will not build.
         Super programs compile lazily at the first big unit -- after
@@ -257,11 +305,31 @@ class MaskWorkerBase:
         queued = []
         flag = None
         pos = unit.start
-        while True:
+        # a wide-mode worker whose wide program failed to build must
+        # fall back to PER-BATCH dispatch, never to the scan wrapper:
+        # on the backend that just rejected the wide shape, scan-of-
+        # pallas_call is the shape that silently wedges the compile
+        # helper (TPU_PROBE_LOG_r04.md round-4b)
+        wide = self.SUPER_MODE == "wide"
+        fuse = not (wide and getattr(self, "_wide_disabled", False))
+        while fuse:
+            # _super_inner's max_inner(stride) budget bounds the wide
+            # program's inner*stride lanes to int32 as well -- every
+            # worker using THIS submit has _super_batch() == stride
             inner = self._super_inner((unit.end - pos) // self.stride)
             if inner < 2:
                 break
             sstride = inner * self.stride
+            if wide:
+                base = jnp.asarray(self.gen.digits(pos), dtype=jnp.int32)
+                result = self._wide_dispatch(sstride, base, sstride)
+                if result is None:
+                    break                  # degraded to per-batch
+                f = self._batch_flag(result)
+                flag = f if flag is None else flag + f
+                queued.append(("wide", (pos, sstride), result))
+                pos += sstride
+                continue
             digits = np.stack([
                 np.asarray(self.gen.digits(pos + i * self.stride),
                            dtype=np.int32) for i in range(inner)])
@@ -306,10 +374,14 @@ class MaskWorkerBase:
 
     def _decode_queued(self, kind: str, start, result,
                        unit: WorkUnit) -> list[Hit]:
-        """One queued dispatch -> Hit records; super rows decode
-        through the SAME _batch_hits path as plain batches."""
+        """One queued dispatch -> Hit records; super rows and wide
+        windows decode through the SAME _batch_hits path as plain
+        batches (wide entries carry their window explicitly)."""
         if kind == "batch":
             return self._batch_hits(start, result, unit)
+        if kind == "wide":
+            pos, window = start
+            return self._batch_hits(pos, result, unit, window=window)
         return self._super_rows(
             result, start, self.stride,
             lambda bstart, row: self._batch_hits(bstart, row, unit))
@@ -325,25 +397,51 @@ class MaskWorkerBase:
             hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
         return hits
 
-    def _rescan(self, bstart: int, unit: WorkUnit) -> list[Hit]:
-        """Exact host rescan of one overflowed batch (pathological case:
-        more hits in a batch than the device hit buffer holds)."""
+    def _rescan(self, bstart: int, unit: WorkUnit,
+                window: int = 0) -> list[Hit]:
+        """Exact host rescan of one overflowed dispatch window
+        (pathological case: more hits than the device hit buffer
+        holds).  window defaults to one batch stride; wide dispatches
+        pass their full window."""
         if self.oracle is None:
             raise RuntimeError(
                 f"hit buffer overflow (> {self.hit_capacity}) and no "
                 "oracle engine to rescan with; raise hit_capacity")
-        end = min(bstart + self.stride, unit.end)
+        end = min(bstart + (window or self.stride), unit.end)
         sub = WorkUnit(-1, bstart, end - bstart)
         return CpuWorker(self.oracle, self.gen, self.targets).process(sub)
 
-    def _batch_hits(self, bstart: int, result, unit: WorkUnit) -> list[Hit]:
+    def _batch_hits(self, bstart: int, result, unit: WorkUnit,
+                    window: int = 0) -> list[Hit]:
         count, lanes, tpos = result
         count = int(count)
         if count == 0:
             return []
-        if count > self.hit_capacity:
-            return self._rescan(bstart, unit)
+        # capacity is the buffer the step was BUILT with (wide steps
+        # scale it), not the worker's nominal hit_capacity
+        if count > lanes.shape[0]:
+            if window > self.stride:
+                return self._redrive_wide(bstart, window, unit)
+            return self._rescan(bstart, unit, window)
         return self._decode_lanes(bstart, np.asarray(lanes), np.asarray(tpos))
+
+    def _redrive_wide(self, bstart: int, window: int,
+                      unit: WorkUnit) -> list[Hit]:
+        """An overflowed wide window re-runs through the per-batch
+        DEVICE step, so exact-rescan granularity stays one stride.
+        The in-kernel collision sentinel (count = capacity + 1 on any
+        two-hit tile) makes wide 'overflow' far more likely than real
+        buffer exhaustion; a whole-window host rescan of 10^8+
+        candidates here would stall the job for hours."""
+        import jax.numpy as jnp
+        hits: list[Hit] = []
+        end = min(bstart + window, unit.end)
+        for bs in range(bstart, end, self.stride):
+            nv = min(self.stride, end - bs)
+            base = jnp.asarray(self.gen.digits(bs), dtype=jnp.int32)
+            hits.extend(self._batch_hits(
+                bs, self.step(base, jnp.int32(nv)), unit))
+        return hits
 
 
 class WordlistWorkerBase(MaskWorkerBase):
@@ -353,7 +451,7 @@ class WordlistWorkerBase(MaskWorkerBase):
     divisor) before using these."""
 
     def _collect_word_hits(self, lanes_np, tpos_np, ws: int,
-                           unit: WorkUnit) -> list[Hit]:
+                           unit: WorkUnit, lane_wb: int = 0) -> list[Hit]:
         """Flat rule-major step lanes -> in-unit Hit records."""
         R = self.gen.n_rules
         hits: list[Hit] = []
@@ -361,7 +459,7 @@ class WordlistWorkerBase(MaskWorkerBase):
             if lane < 0:
                 continue
             gidx = wordlist_lane_to_gidx(int(lane), ws,
-                                         self.word_batch, R)
+                                         lane_wb or self.word_batch, R)
             if not unit.start <= gidx < unit.end:
                 continue
             ti = int(self._order[int(tp)]) if self.multi else 0
@@ -410,15 +508,35 @@ class DeviceWordlistWorker(WordlistWorkerBase):
         argument is a window start (scalar), n_valid counts WORDS, and
         super dispatches cover runs of full word windows."""
         import jax.numpy as jnp
+
+        from dprf_tpu.ops.superstep import max_inner
         w_start, w_end = word_cover_range(unit, self.gen.n_rules)
         w_end = min(w_end, self.gen.n_words)
         queued = []
         flag = None
         ws = w_start
-        while True:
+        # as in MaskWorkerBase.submit: a failed wide build degrades to
+        # per-batch dispatch only, never to the scan wrapper
+        wide = self.SUPER_MODE == "wide"
+        fuse = not (wide and getattr(self, "_wide_disabled", False))
+        while fuse:
             inner = self._super_inner((w_end - ws) // self.word_batch)
+            if wide:
+                # the wide program carries inner * stride rule-expanded
+                # LANES; _super_inner budgeted per-word windows only
+                inner = min(inner, max_inner(self.stride, self.SUPER_CAP))
             if inner < 2:
                 break
+            nw = inner * self.word_batch
+            if wide:
+                result = self._wide_dispatch(nw, jnp.int32(ws), nw)
+                if result is None:
+                    break                  # degraded to per-batch
+                f = self._batch_flag(result)
+                flag = f if flag is None else flag + f
+                queued.append(("wwide", (ws, nw), result))
+                ws += nw
+                continue
             w0s = (np.arange(inner, dtype=np.int32) * self.word_batch
                    + np.int32(ws))
             out = self._super_dispatch(inner, w0s,
@@ -446,22 +564,48 @@ class DeviceWordlistWorker(WordlistWorkerBase):
 
     process._submit_based = True   # safe to pipeline via submit()
 
-    def _window_hits(self, ws: int, nw: int, result,
-                     unit: WorkUnit) -> list[Hit]:
+    def _window_hits(self, ws: int, nw: int, result, unit: WorkUnit,
+                     lane_wb: int = 0) -> list[Hit]:
+        """lane_wb: word-batch stride the step's flat lanes were built
+        with (lane = r * lane_wb + b) -- self.word_batch for plain
+        windows, the full window for wide dispatches."""
         count, lanes, tpos = result
         count = int(count)
         if count == 0:
             return []
-        if count > self.hit_capacity:
+        if count > lanes.shape[0]:
+            if nw > self.word_batch:
+                return self._redrive_wide_words(ws, nw, unit)
             return self._rescan_words(ws, nw, unit)
         return self._collect_word_hits(
-            np.asarray(lanes), np.asarray(tpos), ws, unit)
+            np.asarray(lanes), np.asarray(tpos), ws, unit,
+            lane_wb or self.word_batch)
+
+    def _redrive_wide_words(self, ws: int, nw: int,
+                            unit: WorkUnit) -> list[Hit]:
+        """Overflowed wide word window -> per-batch device windows (see
+        MaskWorkerBase._redrive_wide: the rules kernel's collision
+        sentinel fires on any two-hit cell, so wide overflow must not
+        mean a whole-window host rescan)."""
+        import jax.numpy as jnp
+        hits: list[Hit] = []
+        end = ws + nw
+        w = ws
+        while w < end:
+            n = min(self.word_batch, end - w)
+            hits.extend(self._window_hits(
+                w, n, self.step(jnp.int32(w), jnp.int32(n)), unit))
+            w += n
+        return hits
 
     def _decode_queued(self, kind: str, start, result,
                        unit: WorkUnit) -> list[Hit]:
         if kind == "wbatch":
             ws, nw = start
             return self._window_hits(ws, nw, result, unit)
+        if kind == "wwide":
+            ws, nw = start
+            return self._window_hits(ws, nw, result, unit, lane_wb=nw)
         if kind == "wsuper":
             return self._super_rows(
                 result, start, self.word_batch,
@@ -478,6 +622,8 @@ class PallasWordlistWorker(DeviceWordlistWorker):
     rule-major flat lanes for ANY w0 (units need not be tile-aligned),
     so process/hit decode/rescan are inherited unchanged."""
 
+    SUPER_MODE = "wide"
+
     def __init__(self, engine, gen, targets: Sequence[Target],
                  batch: int = 1 << 18, hit_capacity: int = 64,
                  oracle: Optional[HashEngine] = None,
@@ -491,11 +637,41 @@ class PallasWordlistWorker(DeviceWordlistWorker):
         word_batch = max(TILE_W,
                          (batch // max(1, gen.n_rules) // TILE_W)
                          * TILE_W)
+        self._tgt_words = np.asarray(tgt)
+        self._interpret = interpret
         self.step = make_rules_crack_step(
-            engine.name, gen, np.asarray(tgt), word_batch,
+            engine.name, gen, self._tgt_words, word_batch,
             hit_capacity, interpret=interpret)
         self.word_batch = self.step.word_batch
         self.stride = self.word_batch * gen.n_rules
+
+    def _make_step(self, n_words: int):
+        """Rules-kernel step over an n_words window (wide dispatches:
+        n_words = inner * word_batch, already a TILE_W multiple), with
+        the hit buffer scaled to keep per-word capacity constant.
+
+        All wide sizes share ONE device copy of the packed wordlist:
+        a build whose window fits the current copy's padding reuses
+        it; a larger one rebuilds with more padding, replaces the
+        shared copy, AND evicts cached steps still closing over the
+        old one -- so HBM holds at most the per-batch step's copy
+        plus one wide copy, never one per cached size."""
+        from dprf_tpu.ops.pallas_rules import make_rules_crack_step
+        scale = max(1, n_words // self.word_batch)
+        cap = max(self.hit_capacity,
+                  min(self.hit_capacity * scale, 1024))
+        old = getattr(self, "_wide_shared", None)
+        step = make_rules_crack_step(
+            self.engine.name, self.gen, self._tgt_words, n_words,
+            cap, interpret=self._interpret, shared_words=old)
+        if old is not None and step.words4 is not old[0]:
+            # evict IN PLACE: _wide_step holds a reference to the dict
+            cache = getattr(self, "_wide_cache", {})
+            for k in [k for k, v in cache.items()
+                      if getattr(v, "words4", None) is not step.words4]:
+                del cache[k]
+        self._wide_shared = (step.words4, step.lens3)
+        return step
 
     def warmup(self) -> None:
         import jax.numpy as jnp
@@ -520,50 +696,73 @@ class PallasMaskWorker(MaskWorkerBase):
     """
 
     RESCAN_CAPACITY = 16
+    SUPER_MODE = "wide"
 
     def __init__(self, engine, gen, targets: Sequence[Target],
                  batch: int = 1 << 18, hit_capacity: int = 64,
                  oracle: Optional[HashEngine] = None,
                  interpret: bool = False):
-        from dprf_tpu.ops.pallas_mask import (TILE,
-                                              make_pallas_mask_crack_step,
-                                              make_pallas_multi_crack_step)
+        from dprf_tpu.ops.pallas_mask import TILE
 
         tgt = self._setup_targets(engine, gen, targets, hit_capacity, oracle)
         batch = max(TILE, (batch // TILE) * TILE)
         self.batch = self.stride = batch
         self._tile = TILE
+        self._interpret = interpret
         if self.multi:
             if oracle is None:
                 raise ValueError("multi-target pallas worker needs an "
                                  "oracle engine to verify Bloom maybes")
             dt = "<u4" if engine.little_endian else ">u4"
-            twords = np.stack([np.frombuffer(t.digest, dtype=dt)
-                               .astype(np.uint32) for t in self.targets])
+            self._twords = np.stack([np.frombuffer(t.digest, dtype=dt)
+                                     .astype(np.uint32)
+                                     for t in self.targets])
             self._digest_map = {t.digest: i
                                 for i, t in enumerate(self.targets)}
-            self.step = make_pallas_multi_crack_step(
-                engine.name, gen, twords, batch, hit_capacity,
-                self.RESCAN_CAPACITY, interpret=interpret)
         else:
-            self.step = make_pallas_mask_crack_step(
-                engine.name, gen, np.asarray(tgt), batch, hit_capacity,
-                interpret=interpret)
+            self._twords = np.asarray(tgt)
+        self.step = self._make_step(batch)
+
+    def _make_step(self, batch: int):
+        """Kernel step at `batch` lanes; wide steps (batch a multiple
+        of self.batch) scale the hit/rescan buffers so per-candidate
+        capacity matches the per-batch path, capped to keep the
+        reduce buffers small."""
+        from dprf_tpu.ops.pallas_mask import (make_pallas_mask_crack_step,
+                                              make_pallas_multi_crack_step)
+        scale = max(1, batch // self.batch)
+        # never below the user's nominal capacity (a raised --hit-cap
+        # must reach the per-batch step unclamped), never a wide
+        # buffer smaller than one batch's
+        cap = max(self.hit_capacity,
+                  min(self.hit_capacity * scale, 1024))
+        if self.multi:
+            rcap = max(self.RESCAN_CAPACITY,
+                       min(self.RESCAN_CAPACITY * scale, 256))
+            return make_pallas_multi_crack_step(
+                self.engine.name, self.gen, self._twords, batch, cap,
+                rcap, interpret=self._interpret)
+        return make_pallas_mask_crack_step(
+            self.engine.name, self.gen, self._twords, batch, cap,
+            interpret=self._interpret)
 
     def _batch_flag(self, result):
         if not self.multi:
             return result[0]
         return result[0] + result[2]   # single maybes + collided tiles
 
-    def _batch_hits(self, bstart: int, result, unit: WorkUnit) -> list[Hit]:
+    def _batch_hits(self, bstart: int, result, unit: WorkUnit,
+                    window: int = 0) -> list[Hit]:
         if not self.multi:
-            return super()._batch_hits(bstart, result, unit)
+            return super()._batch_hits(bstart, result, unit, window)
         n_single, lanes, n_collided, ctiles = result
         n_single, n_collided = int(n_single), int(n_collided)
         if n_single == 0 and n_collided == 0:
             return []
-        if n_single > self.hit_capacity or n_collided > self.RESCAN_CAPACITY:
-            return self._rescan(bstart, unit)      # pathological overflow
+        if n_single > lanes.shape[0] or n_collided > ctiles.shape[0]:
+            if window > self.stride:
+                return self._redrive_wide(bstart, window, unit)
+            return self._rescan(bstart, unit, window)  # pathological
         hits: list[Hit] = []
         for lane in np.asarray(lanes):
             if lane < 0:

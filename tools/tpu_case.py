@@ -212,11 +212,18 @@ def run_case(name: str) -> dict:
         worker = eng.make_mask_worker(g8, [tgt], batch=B,
                                       hit_capacity=64, oracle=oracle)
         worker.SUPER_CAP = inner
-        unit_len = worker.stride * inner
+        worker.SUPER_MIN = min(worker.SUPER_MIN, inner)  # allow small
+        unit_len = worker.stride * inner                 # bisect steps
         t0 = time.perf_counter()
         hits = worker.process(WorkUnit(-1, 0, unit_len))
         compile_s = time.perf_counter() - t0
-        degraded = getattr(worker, "_super_disabled", False)
+        degraded = (getattr(worker, "_super_disabled", False)
+                    or getattr(worker, "_wide_disabled", False))
+        # a fused program must actually have been built -- a silent
+        # fall-through to per-batch dispatch is a FAILED bisect case,
+        # not a pass
+        fused = bool(getattr(worker, "_super_cache", None)
+                     or getattr(worker, "_wide_cache", None))
         k, t0 = 0, time.perf_counter()
         while True:
             worker.process(WorkUnit(-1, 0, unit_len))
@@ -224,7 +231,10 @@ def run_case(name: str) -> dict:
             if time.perf_counter() - t0 > 20.0 or k >= 32:
                 break
         dt = time.perf_counter() - t0
-        return {"case": name, "ok": not degraded, "degraded": degraded,
+        return {"case": name, "ok": fused and not degraded,
+                "degraded": degraded, "fused": fused,
+                "mode": type(worker).SUPER_MODE,
+                "worker": type(worker).__name__,
                 "hs": k * unit_len / dt, "batch": B, "inner": inner,
                 "units": k, "unit_s": round(dt / k, 2),
                 "compile_s": round(compile_s, 1),
