@@ -25,6 +25,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 OUT = os.environ.get("TPU_CASES_OUT", "/tmp/tpu_cases.jsonl")
 
+#: case-name kinds run_case understands (first dash-field).  Kept as
+#: data so orchestrators (tools/tpu_session.py) can validate a plan
+#: WITHOUT importing jax / touching the tunnel.
+KINDS = ("scrypt", "bcrypt", "bcryptchunk", "pallaseks", "descrypt",
+         "pmkid", "scanprobe", "superstep")
+
 
 def emit(doc):
     with open(OUT, "a") as f:

@@ -66,6 +66,22 @@ DEADLINES = {
     "rules_kernel": 1200,
 }
 
+#: deadlines for "case:<kind>-..." stages, by case kind: the slow /
+#: memory-hard kinds need compile + multi-minute dispatch chains.
+CASE_DEADLINES = {
+    "bcryptchunk": 1800, "pallaseks": 1800, "scrypt": 1500,
+    "bcrypt": 1200, "descrypt": 900, "pmkid": 1200,
+    "scanprobe": 900, "superstep": 900,
+}
+
+
+def stage_deadline(stage: str) -> int:
+    if stage.startswith("case:"):
+        kind = stage[len("case:"):].split("-")[0]
+        return CASE_DEADLINES.get(kind, 900)
+    return DEADLINES.get(stage, 600)
+
+
 DEFAULT_PLAN = ["kernels", "bench_fast", "config1", "config2", "config3",
                 "config5", "config4"]   # bcrypt last: slowest, riskiest
 
@@ -466,6 +482,28 @@ STAGES = {
 }
 
 
+def _stage_case(case_name: str):
+    """Any tools/tpu_case.py case as an isolated session stage
+    ("case:<name>" in the plan) -- same one-client-per-stage
+    protection, results merged into the session document.  Lets a
+    session prove a risky shape (e.g. superstep-md5-18-8, the wide
+    dispatch) in a disposable child BEFORE the config stages bet
+    their deadlines on it."""
+    def run(io):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        from tpu_case import run_case
+        io.status(case_name)
+        io.record(case_name, run_case(case_name))
+    run.__name__ = f"stage_case_{case_name}"
+    return run
+
+
+def resolve_stage(stage: str):
+    if stage.startswith("case:"):
+        return _stage_case(stage[len("case:"):])
+    return STAGES[stage]
+
+
 def child_main(stage: str, out_path: str) -> int:
     io = StageIO(stage, out_path)
     io.status("connect")
@@ -477,7 +515,7 @@ def child_main(stage: str, out_path: str) -> int:
         if devs[0].platform != "tpu":
             io.finish(ok=False, note="no TPU visible")
             return 1
-        STAGES[stage](io)
+        resolve_stage(stage)(io)
         io.finish(ok=True)
         return 0
     except Exception as e:
@@ -523,7 +561,7 @@ def orchestrate(plan) -> int:
                  "--child", stage, "--out", out_path],
                 stdout=log, stderr=log, start_new_session=True,
                 cwd=REPO)
-        deadline = DEADLINES.get(stage, 600)
+        deadline = stage_deadline(stage)
         t0 = time.monotonic()
         doc = None
         while time.monotonic() - t0 < deadline:
@@ -584,10 +622,23 @@ def main() -> int:
     if args and args[0] == "--child":
         return child_main(args[1], args[args.index("--out") + 1])
     plan = args if args else DEFAULT_PLAN
-    unknown = [s for s in plan if s not in STAGES]
+
+    def known(s):
+        if s in STAGES:
+            return True
+        if s.startswith("case:"):
+            # validate WITHOUT importing jax (tpu_case's top level is
+            # tunnel-free by design): a typo'd case must fail fast
+            # here, not after a child has taken the tunnel slot
+            sys.path.insert(0, os.path.join(REPO, "tools"))
+            from tpu_case import KINDS
+            return s[len("case:"):].split("-")[0] in KINDS
+        return False
+
+    unknown = [s for s in plan if not known(s)]
     if unknown:
         sys.stderr.write(f"unknown stages: {unknown}; "
-                         f"available: {sorted(STAGES)}\n")
+                         f"available: {sorted(STAGES)} or case:<kind>-...\n")
         return 2
     return orchestrate(plan)
 
