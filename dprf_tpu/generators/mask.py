@@ -16,10 +16,15 @@ position as the least-significant digit (odometer order).
 
 TPU-first design: `decode_batch` materializes a whole batch of
 candidates on device from a unit's *digit vector* plus each lane's
-offset, using only int32 adds/mod/div and one gather per position --
-no 64-bit math, no host transfer of candidate bytes, static shapes
-throughout.  Radices and charset offsets are Python-level constants
-baked into the jitted program.
+offset, using only int32 adds/mod/div plus a handful of vector
+compare/selects per position (segment-mux decode; positions whose
+charset exceeds MAX_SEGMENTS contiguous runs fall back to one gather
+over the flat table) -- no 64-bit math, no host transfer of candidate
+bytes, static shapes throughout.  Radices, charset offsets, and
+segment tables are Python-level constants baked into the jitted
+program.  The same segment model drives the Pallas kernels'
+eligibility and in-kernel decode (ops/pallas_mask.py imports
+`charset_segments` from here).
 """
 
 from __future__ import annotations
@@ -80,6 +85,25 @@ def parse_mask(mask: str,
     return charsets
 
 
+#: segment-decode bound shared by the XLA mux and the Pallas kernels
+#: (kernel eligibility: ops/pallas_mask.mask_supported).
+MAX_SEGMENTS = 16
+
+
+def charset_segments(charset: bytes):
+    """Charset (digit order) -> [(start_digit, byte_delta)] pieces where
+    byte = digit + delta for digit >= start_digit (until next piece).
+    Single source of truth for the segment decode model: consumed by
+    MaskGenerator.decode_batch's mux AND the Pallas kernel builders
+    (ops/pallas_mask.py re-exports it)."""
+    segs = []
+    for d, byte in enumerate(charset):
+        delta = byte - d
+        if not segs or segs[-1][1] != delta:
+            segs.append((d, delta))
+    return segs
+
+
 class MaskGenerator(CandidateGenerator):
     """index -> fixed-length candidate via mixed-radix decode."""
 
@@ -107,6 +131,16 @@ class MaskGenerator(CandidateGenerator):
             flat.extend(cs)
         self._offsets = tuple(offsets)
         self._flat_np = np.frombuffer(bytes(flat), dtype=np.uint8)
+        # segment-mux decode tables: a charset whose byte values form
+        # few contiguous runs (every builtin: ?l/?u/?d/?b/?a are one
+        # run, ?s is four) decodes with a handful of vector
+        # compare/selects instead of a per-position batch-sized
+        # gather -- the gather is the measured XLA mask bottleneck on
+        # TPU (BASELINE.md).  None = too many runs (e.g.
+        # markov-scrambled order): keep the gather.
+        self._segments = tuple(
+            segs if len(segs) <= MAX_SEGMENTS else None
+            for segs in (charset_segments(cs) for cs in self.charsets))
 
     # ---------------- host (oracle) path ----------------
 
@@ -149,19 +183,32 @@ class MaskGenerator(CandidateGenerator):
 
         base_digits: int32[length] digit vector of the first candidate
         (from `digits()`, host-computed once per unit).  flat: the
-        uint8 flat charset table (device-resident).  lane_offset (int32
-        scalar, may be traced): decode candidates base+offset ..
+        uint8 flat charset table (device-resident) -- consulted ONLY
+        for positions whose charset exceeds MAX_SEGMENTS contiguous
+        runs (markov-scrambled orders); every builtin charset decodes
+        via the baked-in segment mux and ignores it.  lane_offset
+        (int32 scalar, may be traced): decode candidates base+offset ..
         base+offset+batch -- the sharded path passes each chip's lane
         range start here.  Returns uint8[batch, length].  jit-traceable;
-        radices/offsets are baked in as constants so the per-position
-        mod/div lower to cheap int32 vector ops.
+        radices/offsets/segments are baked in as constants so the
+        per-position mod/div/selects lower to cheap int32 vector ops.
         """
         carry = lane_offset + jnp.arange(batch, dtype=jnp.int32)
         cols: list = [None] * self.length
         for p in range(self.length - 1, -1, -1):
             radix = self.radices[p]
             s = base_digits[p] + carry
-            cols[p] = flat[self._offsets[p] + (s % radix)]
+            idx = s % radix
+            segs = self._segments[p]
+            if segs is not None:
+                # piece starts are ascending, so the last satisfied
+                # select wins: byte = digit + delta of its piece
+                col = idx + segs[0][1]        # segs[0] starts at 0
+                for d0, delta in segs[1:]:
+                    col = jnp.where(idx >= d0, idx + delta, col)
+                cols[p] = col.astype(jnp.uint8)
+            else:
+                cols[p] = flat[self._offsets[p] + idx]
             carry = s // radix
         # Lanes that carried past the most-significant digit wrapped around;
         # callers mask them out via the unit's valid-count.

@@ -57,8 +57,11 @@ from dprf_tpu.ops import sha256 as sha256_ops
 #: (128) is the default.
 SUB = int(os.environ.get("DPRF_PALLAS_SUB", "128"))
 TILE = SUB * 128
-#: charsets needing more piecewise segments than this use the XLA path.
-MAX_SEGMENTS = 16
+#: charsets needing more piecewise segments than MAX_SEGMENTS use the
+#: gather decode (and the XLA pipeline); the bound and the segment
+#: model are shared with the generator's mux decode.
+from dprf_tpu.generators.mask import (MAX_SEGMENTS,  # noqa: E402,F401
+                                      charset_segments)
 
 # -- multi-target Bloom prefilter parameters --------------------------------
 #: probes per target set; each probe consumes 12 digest bits (7 bits
@@ -123,15 +126,9 @@ def pallas_mode() -> Optional[dict]:
     return None
 
 
-def charset_segments(charset: bytes):
-    """Charset (digit order) -> [(start_digit, byte_delta)] pieces where
-    byte = digit + delta for digit >= start_digit (until next piece)."""
-    segs = []
-    for d, byte in enumerate(charset):
-        delta = byte - d
-        if not segs or segs[-1][1] != delta:
-            segs.append((d, delta))
-    return segs
+# charset_segments / MAX_SEGMENTS: canonical segment model lives with
+# the generator (generators/mask.py -- the XLA mux uses the same
+# tables); imported above and re-exported for the kernel builders.
 
 
 def mask_supported(charsets: Sequence[bytes]) -> bool:

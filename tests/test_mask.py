@@ -86,6 +86,27 @@ def test_device_decode_matches_host(mask, start):
         assert bytes(got[i].tobytes()) == h, f"lane {i}"
 
 
+def test_device_decode_segment_mux_and_gather_fallback():
+    """Builtin charsets decode via the segment mux (few contiguous
+    byte runs); a scrambled custom charset exceeds MUX_MAX_SEGMENTS
+    and falls back to the flat-table gather.  Both must match host."""
+    g = MaskGenerator("?s?l?d")
+    assert all(s is not None for s in g._segments)
+    out = np.asarray(g.decode_batch(
+        jnp.asarray(g.digits(1000), jnp.int32), g.flat_charsets, 64))
+    for i in range(64):
+        assert out[i].tobytes() == g.candidate(1000 + i)
+
+    scrambled = bytes((i * 37) % 251 for i in range(100))
+    g2 = MaskGenerator("?1?l", custom={1: scrambled})
+    assert g2._segments[0] is None      # gather path retained
+    assert g2._segments[1] is not None  # mux for ?l
+    out = np.asarray(g2.decode_batch(
+        jnp.asarray(g2.digits(5), jnp.int32), g2.flat_charsets, 64))
+    for i in range(64):
+        assert out[i].tobytes() == g2.candidate(5 + i)
+
+
 def test_device_decode_large_batch_contiguous():
     g = MaskGenerator("?l?l?l")
     base = jnp.asarray(g.digits(700), dtype=jnp.int32)

@@ -25,11 +25,25 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 OUT = os.environ.get("TPU_CASES_OUT", "/tmp/tpu_cases.jsonl")
 
-#: case-name kinds run_case understands (first dash-field).  Kept as
-#: data so orchestrators (tools/tpu_session.py) can validate a plan
-#: WITHOUT importing jax / touching the tunnel.
-KINDS = ("scrypt", "bcrypt", "bcryptchunk", "pallaseks", "descrypt",
-         "pmkid", "scanprobe", "superstep")
+#: case-name kinds run_case understands (first dash-field) -> number
+#: of dash-parameters after the kind.  Kept as data so orchestrators
+#: (tools/tpu_session.py) can validate a whole plan WITHOUT importing
+#: jax / touching the tunnel.
+KINDS = {"scrypt": 4, "bcrypt": 2, "bcryptchunk": 2, "pallaseks": 2,
+         "descrypt": 1, "pmkid": 1, "scanprobe": 2, "superstep": 3}
+
+
+def case_valid(name: str) -> bool:
+    """Cheap, tunnel-free well-formedness check for a case name:
+    known kind, right parameter count, numeric fields numeric."""
+    parts = name.split("-")
+    kind = parts[0]
+    if kind not in KINDS or len(parts) - 1 != KINDS[kind]:
+        return False
+    # every parameter is an int except scanprobe's variant and
+    # superstep's engine name (parts[1] for both)
+    num_from = 2 if kind in ("scanprobe", "superstep") else 1
+    return all(p.lstrip("-").isdigit() for p in parts[num_from:])
 
 
 def emit(doc):

@@ -628,11 +628,12 @@ def main() -> int:
             return True
         if s.startswith("case:"):
             # validate WITHOUT importing jax (tpu_case's top level is
-            # tunnel-free by design): a typo'd case must fail fast
-            # here, not after a child has taken the tunnel slot
+            # tunnel-free by design): a typo'd kind, wrong parameter
+            # count, or non-numeric field must fail fast here, not
+            # after a child has taken the tunnel slot
             sys.path.insert(0, os.path.join(REPO, "tools"))
-            from tpu_case import KINDS
-            return s[len("case:"):].split("-")[0] in KINDS
+            from tpu_case import case_valid
+            return case_valid(s[len("case:"):])
         return False
 
     unknown = [s for s in plan if not known(s)]
