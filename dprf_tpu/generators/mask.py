@@ -104,6 +104,17 @@ def charset_segments(charset: bytes):
     return segs
 
 
+def segment_mux(digit, segs):
+    """Vectorized piecewise charset lookup: digit array -> byte array.
+    Piece starts are ascending, so the last satisfied select wins.
+    Shared by decode_batch's XLA mux and the Pallas kernel decode
+    (ops/pallas_mask._decode_byte)."""
+    byte = digit + segs[0][1]
+    for start, delta in segs[1:]:
+        byte = jnp.where(digit >= start, digit + delta, byte)
+    return byte
+
+
 class MaskGenerator(CandidateGenerator):
     """index -> fixed-length candidate via mixed-radix decode."""
 
@@ -201,12 +212,7 @@ class MaskGenerator(CandidateGenerator):
             idx = s % radix
             segs = self._segments[p]
             if segs is not None:
-                # piece starts are ascending, so the last satisfied
-                # select wins: byte = digit + delta of its piece
-                col = idx + segs[0][1]        # segs[0] starts at 0
-                for d0, delta in segs[1:]:
-                    col = jnp.where(idx >= d0, idx + delta, col)
-                cols[p] = col.astype(jnp.uint8)
+                cols[p] = segment_mux(idx, segs).astype(jnp.uint8)
             else:
                 cols[p] = flat[self._offsets[p] + idx]
             carry = s // radix

@@ -13,10 +13,14 @@ cell -- (count << 16) | (hit_lane + 1), splatted over the minimum
 candidate (1 byte at sub=32) instead of ~(L+4W).
 
 The compression rounds themselves are imported from the same modules
-the XLA path uses (md5_rounds/sha1_rounds/md4_rounds), so there is one
-source of truth per algorithm.  SHA-256 stays on the XLA path: its
-rolling message schedule is written as a fori_loop+concatenate carry
-(see ops/sha256.py) that does not lower to Mosaic.
+the XLA path uses (md5_rounds/sha1_rounds/md4_rounds/sha256_rounds/
+sha512_rounds), so there is one source of truth per algorithm.  The
+SHA-256 and SHA-512-family kernels use the statically-unrolled
+rolling-schedule round forms (fori_loop+concatenate carries do not
+lower to Mosaic) and are TPU-only: XLA:CPU takes minutes to compile
+the flat unrolled graphs, so off-TPU those engines ride the XLA
+pipeline and the kernel bodies are validated eagerly via
+emulate_mask_kernel.
 
 Design choices forced by the VPU:
 - Charset lookup is arithmetic, not a gather: a charset in digit order
@@ -47,6 +51,7 @@ from dprf_tpu.ops import md4 as md4_ops
 from dprf_tpu.ops import md5 as md5_ops
 from dprf_tpu.ops import sha1 as sha1_ops
 from dprf_tpu.ops import sha256 as sha256_ops
+from dprf_tpu.ops import sha512 as sha512_ops
 
 #: sublane count per grid cell; TILE = SUB * 128 candidate lanes.
 #: DPRF_PALLAS_SUB overrides for tuning (tools/tpu_session.py sweeps
@@ -61,7 +66,7 @@ TILE = SUB * 128
 #: gather decode (and the XLA pipeline); the bound and the segment
 #: model are shared with the generator's mux decode.
 from dprf_tpu.generators.mask import (MAX_SEGMENTS,  # noqa: E402,F401
-                                      charset_segments)
+                                      charset_segments, segment_mux)
 
 # -- multi-target Bloom prefilter parameters --------------------------------
 #: probes per target set; each probe consumes 12 digest bits (7 bits
@@ -96,6 +101,29 @@ _md4_core = _make_core(md4_ops.md4_rounds, md4_ops.INIT)
 _sha1_core = _make_core(sha1_ops.sha1_rounds, sha1_ops.INIT)
 _sha256_core = _make_core(sha256_ops.sha256_rounds, sha256_ops.INIT)
 
+
+def _make_sha512_core(init_words, out_words: int):
+    """SHA-512-family digest core over (hi, lo) uint32 pairs: m is the
+    32 words of one 128-byte block; returns the first out_words uint32
+    digest words (16 for sha512, 12 for the sha384 truncation)."""
+    def core(m, shape):
+        pairs = [(m[2 * i], m[2 * i + 1]) for i in range(16)]
+        init = [(jnp.uint32(v >> 32), jnp.uint32(v & 0xFFFFFFFF))
+                for v in init_words]
+        vars8 = tuple((jnp.full(shape, h), jnp.full(shape, l))
+                      for h, l in init)
+        out = sha512_ops.sha512_rounds(vars8, pairs)
+        res = []
+        for v, iv in zip(out, init):
+            h, l = sha512_ops._add64(v, iv)
+            res.extend([h, l])
+        return tuple(res[:out_words])
+    return core
+
+
+_sha512_core = _make_sha512_core(sha512_ops.INIT512, 16)
+_sha384_core = _make_sha512_core(sha512_ops.INIT384, 12)
+
 #: engine name -> (rounds core, digest words, big-endian packing,
 #: UTF-16LE widening)
 CORES = {
@@ -105,7 +133,15 @@ CORES = {
     "sha256": (_sha256_core, 8, True, False),
     "sha-256": (_sha256_core, 8, True, False),
     "ntlm": (_md4_core, 4, False, True),
+    "sha512": (_sha512_core, 16, True, False),
+    "sha-512": (_sha512_core, 16, True, False),
+    "sha384": (_sha384_core, 12, True, False),
+    "sha-384": (_sha384_core, 12, True, False),
 }
+
+#: engines whose compression consumes a 128-byte block (32 message
+#: words, 128-bit length field) instead of the 64-byte default.
+WIDE_BLOCK = frozenset(("sha512", "sha-512", "sha384", "sha-384"))
 
 
 def pallas_mode() -> Optional[dict]:
@@ -149,17 +185,20 @@ def kernel_eligible(engine_name: str, gen, n_targets: int) -> bool:
         return False
     if not hasattr(gen, "charsets"):
         return False
-    if engine_name in ("sha256", "sha-256"):
-        # The statically-unrolled SHA-256 graph compiles fine through
-        # Mosaic's path but takes XLA:CPU many minutes, so the kernel
-        # is TPU-only; off-TPU (tests, --device cpu fallback) SHA-256
-        # uses the XLA pipeline.  The kernel body itself is validated
-        # eagerly via emulate_mask_kernel.
+    if engine_name in ("sha256", "sha-256") or engine_name in WIDE_BLOCK:
+        # The statically-unrolled SHA-256 graph (and the even larger
+        # 80-round SHA-512 pair graph) compiles fine through Mosaic's
+        # path but takes XLA:CPU many minutes, so these kernels are
+        # TPU-only; off-TPU (tests, --device cpu fallback) they use
+        # the XLA pipeline.  The kernel bodies themselves are
+        # validated eagerly via emulate_mask_kernel.
         import jax as _jax
         if _jax.default_backend() != "tpu":
             return False
     widen = CORES[engine_name][3]
-    max_len = 27 if widen else 55
+    max_len = (27 if widen
+               else 111 if engine_name in WIDE_BLOCK   # 128-byte block
+               else 55)
     return gen.length <= max_len and mask_supported(gen.charsets)
 
 
@@ -200,12 +239,8 @@ def _probe_bits(digest, p: int):
     return bits & jnp.uint32(0xFFF)
 
 
-def _decode_byte(digit, segs):
-    """Vectorized piecewise charset lookup: digit array -> byte array."""
-    byte = digit + segs[0][1]
-    for start, delta in segs[1:]:
-        byte = jnp.where(digit >= start, digit + delta, byte)
-    return byte
+# piecewise charset lookup shared with the generator's XLA mux
+_decode_byte = segment_mux
 
 
 def decode_candidate_bytes(radices, seg_tables, length: int, base, carry):
@@ -247,13 +282,15 @@ def bloom_found(digest, tables, valid, n_sets: int, shape):
 
 
 def _pack_message(byts, length: int, shape, big_endian: bool,
-                  widen_utf16: bool):
-    """Candidate bytes -> the 16 padded single-block message words."""
+                  widen_utf16: bool, block_words: int = 16):
+    """Candidate bytes -> the padded single-block message words
+    (16 words / 64-byte block by default; 32 words / 128-byte block
+    with a 128-bit length field for the SHA-512 family)."""
     def put(m, q, byte):
         shift = 8 * (3 - q % 4) if big_endian else 8 * (q % 4)
         m[q // 4] = m[q // 4] | (byte << jnp.uint32(shift))
 
-    m = [jnp.zeros(shape, jnp.uint32) for _ in range(16)]
+    m = [jnp.zeros(shape, jnp.uint32) for _ in range(block_words)]
     stride = 2 if widen_utf16 else 1        # UTF-16LE: byte p -> pos 2p
     for p, byte in enumerate(byts):
         put(m, stride * p, byte)
@@ -261,7 +298,7 @@ def _pack_message(byts, length: int, shape, big_endian: bool,
     put(m, msg_len, jnp.uint32(0x80))
     bitlen = jnp.full(shape, jnp.uint32(8 * msg_len))
     if big_endian:
-        m[15] = bitlen       # 64-bit BE length, low word
+        m[block_words - 1] = bitlen   # 64/128-bit BE length, low word
     else:
         m[14] = bitlen       # 64-bit LE length, low word
     return m
@@ -298,7 +335,8 @@ def _build_kernel_body(engine_name: str, radices, seg_tables, length: int,
         carry = lane + pid * tile
         byts = decode_candidate_bytes(radices, seg_tables, length,
                                       base, carry)
-        m = _pack_message(byts, length, shape, big_endian, widen)
+        m = _pack_message(byts, length, shape, big_endian, widen,
+                          32 if engine_name in WIDE_BLOCK else 16)
         digest = core(m, shape)
         valid = (lane + pid * tile) < n_valid
         if not multi:

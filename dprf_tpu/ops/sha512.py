@@ -131,6 +131,27 @@ _KH = np.array([k >> 32 for k in K], dtype=np.uint32)
 _KL = np.array([k & 0xFFFFFFFF for k in K], dtype=np.uint32)
 
 
+def sha512_rounds(vars8, m):
+    """The 80 SHA-512 rounds over (hi, lo) uint32 pairs (no
+    feed-forward), STATICALLY unrolled with a rolling 16-pair schedule
+    so every W[t] lives in registers -- the form the Pallas kernel
+    needs (fori_loop with array-carried schedules does not lower to
+    Mosaic; see ops/sha256.sha256_rounds for the same split).
+
+    vars8: 8 (hi, lo) pairs; m: 16 (hi, lo) message-word pairs.
+    The XLA path (sha512_compress_state below) keeps the fori_loop
+    form: the flat ~80x70-op pair graph hits XLA:CPU's compile-time
+    pathology, and under jit the loop form costs no throughput.
+    """
+    w = list(m)
+    for t in range(80):
+        if t >= 16:
+            w[t % 16] = _schedule_ext(w[(t - 15) % 16], w[(t - 2) % 16],
+                                      w[t % 16], w[(t - 7) % 16])
+        vars8 = _round(vars8, w[t % 16], _split(K[t]))
+    return vars8
+
+
 def sha512_compress_state(state: jnp.ndarray,
                           words: jnp.ndarray) -> jnp.ndarray:
     """One SHA-512 compression: state uint32[..., 16] (interleaved

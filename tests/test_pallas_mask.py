@@ -48,6 +48,10 @@ def _engine_target(engine_name: str, plain: bytes) -> np.ndarray:
         d, dt = hashlib.sha1(plain).digest(), ">u4"
     elif engine_name == "sha256":
         d, dt = hashlib.sha256(plain).digest(), ">u4"
+    elif engine_name == "sha512":
+        d, dt = hashlib.sha512(plain).digest(), ">u4"
+    elif engine_name == "sha384":
+        d, dt = hashlib.sha384(plain).digest(), ">u4"
     else:   # ntlm: MD4 over UTF-16LE
         from dprf_tpu.engines.cpu.md4 import md4
         d, dt = md4(plain.decode("latin-1").encode("utf-16-le")), "<u4"
@@ -147,7 +151,8 @@ def test_pallas_worker_matches_xla_worker(engine):
     assert phits[0].plaintext == plant
 
 
-@pytest.mark.parametrize("engine", ["md5", "sha1", "sha256", "ntlm"])
+@pytest.mark.parametrize("engine", ["md5", "sha1", "sha256", "ntlm",
+                                    "sha512", "sha384"])
 def test_kernel_body_emulated_finds_planted(engine):
     """Eager (no-jit) drive of the shared kernel body: the only CPU
     vehicle for the SHA-256 kernel math, whose statically-unrolled
@@ -330,3 +335,28 @@ def test_make_mask_worker_warmup_failure_falls_back(monkeypatch, capsys):
     w = eng.make_mask_worker(gen, [t1], batch=TILE, hit_capacity=8)
     assert isinstance(w, DeviceMaskWorker)
     assert "falling back" in capsys.readouterr().err
+
+
+@pytest.mark.smoke
+def test_sha512_rounds_unrolled_matches_loop_form():
+    """The statically-unrolled pair-arithmetic rounds (the Mosaic
+    form the kernel core uses) must be bit-identical to the fori_loop
+    XLA form on random full blocks."""
+    from dprf_tpu.ops import sha512 as s5
+
+    rng = np.random.default_rng(3)
+    words = jnp.asarray(rng.integers(0, 2 ** 32, (4, 32),
+                                     dtype=np.uint32))
+    ref = s5.sha512_compress(s5.INIT512, words)
+    pairs = [(words[:, 2 * i], words[:, 2 * i + 1]) for i in range(16)]
+    init = [(jnp.uint32(v >> 32), jnp.uint32(v & 0xFFFFFFFF))
+            for v in s5.INIT512]
+    vars8 = tuple((jnp.full((4,), h), jnp.full((4,), l))
+                  for h, l in init)
+    out = s5.sha512_rounds(vars8, pairs)
+    got = []
+    for v, iv in zip(out, init):
+        h, l = s5._add64(v, iv)
+        got.extend([h, l])
+    assert np.array_equal(np.stack([np.asarray(g) for g in got], -1),
+                          np.asarray(ref))

@@ -43,7 +43,7 @@ def case_valid(name: str) -> bool:
     # every parameter is an int except scanprobe's variant and
     # superstep's engine name (parts[1] for both)
     num_from = 2 if kind in ("scanprobe", "superstep") else 1
-    return all(p.lstrip("-").isdigit() for p in parts[num_from:])
+    return all(p.isdigit() for p in parts[num_from:])
 
 
 def emit(doc):
