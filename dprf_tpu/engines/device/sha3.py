@@ -116,6 +116,41 @@ class KeccakMaskWorker(_KeccakTargetsMixin, MaskWorkerBase):
             rate=engine._rate, out_bytes=engine.digest_size)
 
 
+class PallasKeccakMaskWorker(_KeccakTargetsMixin, MaskWorkerBase):
+    """Single-target mask worker over the fused Keccak kernel
+    (ops/pallas_keccak.py): the whole decode->sponge->compare chain
+    stays in VMEM.  Wide-step capable like the MD kernels."""
+
+    SUPER_MODE = "wide"
+
+    def __init__(self, engine, gen, targets, batch: int = 1 << 18,
+                 hit_capacity: int = 64, oracle=None,
+                 interpret: bool = False):
+        from dprf_tpu.ops.pallas_keccak import SUBK
+
+        tgt = self._setup_keccak(engine, gen, targets, hit_capacity,
+                                 oracle)
+        if self.multi:
+            raise ValueError("keccak kernel is single-target")
+        tile = SUBK * 128
+        batch = max(tile, (batch // tile) * tile)
+        self.batch = self.stride = batch
+        self._tgt_words = np.asarray(tgt)
+        self._interpret = interpret
+        self.step = self._make_step(batch)
+
+    def _make_step(self, batch: int):
+        from dprf_tpu.ops.pallas_keccak import (
+            make_pallas_keccak_crack_step)
+        scale = max(1, batch // self.batch)
+        cap = max(self.hit_capacity,
+                  min(self.hit_capacity * scale, 1024))
+        e = self.engine
+        return make_pallas_keccak_crack_step(
+            self.gen, self._tgt_words, batch, e._pad_byte,
+            e._rate, e.digest_size, cap, interpret=self._interpret)
+
+
 class KeccakWordlistWorker(_KeccakTargetsMixin, DeviceWordlistWorker):
     def __init__(self, engine, gen, targets, batch: int = 1 << 18,
                  hit_capacity: int = 64, oracle=None):
@@ -137,6 +172,31 @@ class _KeccakDeviceMixin:
 
     def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
                          oracle=None):
+        from dprf_tpu.ops.pallas_keccak import keccak_kernel_eligible
+        from dprf_tpu.ops.pallas_mask import pallas_mode
+        from dprf_tpu.utils.logging import DEFAULT as log
+        mode = pallas_mode()
+        if mode is not None and not keccak_kernel_eligible(
+                gen, len(targets), self._rate):
+            # weak-spot visibility, as in engines.py: --impl auto users
+            # should be able to tell which path ran without reading
+            # result JSON
+            log.info("keccak kernel not eligible for this job; "
+                     "using the XLA pipeline", engine=self.name,
+                     targets=len(targets))
+        elif mode is not None:
+            try:
+                w = PallasKeccakMaskWorker(self, gen, targets,
+                                           batch=batch,
+                                           hit_capacity=hit_capacity,
+                                           oracle=oracle, **mode)
+                w.warmup()
+                return w
+            except Exception as e:   # build/compile failure -> XLA
+                log.warn("keccak kernel failed to build/compile; "
+                         "falling back to the XLA pipeline",
+                         engine=self.name,
+                         error=f"{type(e).__name__}: {e}")
         return KeccakMaskWorker(self, gen, targets, batch=batch,
                                 hit_capacity=hit_capacity, oracle=oracle)
 

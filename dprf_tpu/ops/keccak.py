@@ -53,6 +53,45 @@ def _rot64(hi, lo, n: int):
             (hi << n) | (lo >> (32 - n)))
 
 
+def _keccak_round(state, rc_hi, rc_lo):
+    """One Keccak-f round over dict (x, y) -> (hi, lo) uint32 arrays;
+    rc_hi/rc_lo may be traced gathers (fori form) or static scalars
+    (unrolled form).  Shared by both so the round math has one source
+    of truth."""
+    # theta
+    c = [(state[(x, 0)][0] ^ state[(x, 1)][0] ^ state[(x, 2)][0]
+          ^ state[(x, 3)][0] ^ state[(x, 4)][0],
+          state[(x, 0)][1] ^ state[(x, 1)][1] ^ state[(x, 2)][1]
+          ^ state[(x, 3)][1] ^ state[(x, 4)][1])
+         for x in range(5)]
+    d = []
+    for x in range(5):
+        rh, rl = _rot64(*c[(x + 1) % 5], 1)
+        d.append((c[(x - 1) % 5][0] ^ rh, c[(x - 1) % 5][1] ^ rl))
+    for x in range(5):
+        for y in range(5):
+            hi, lo = state[(x, y)]
+            state[(x, y)] = (hi ^ d[x][0], lo ^ d[x][1])
+    # rho + pi
+    b = {}
+    for x in range(5):
+        for y in range(5):
+            hi, lo = state[(x, y)]
+            b[(y, (2 * x + 3 * y) % 5)] = _rot64(hi, lo,
+                                                 int(_RHO[x, y]))
+    # chi
+    for x in range(5):
+        for y in range(5):
+            bh, bl = b[(x, y)]
+            nh, nl = b[((x + 1) % 5, y)]
+            fh, fl = b[((x + 2) % 5, y)]
+            state[(x, y)] = (bh ^ (~nh & fh), bl ^ (~nl & fl))
+    # iota
+    hi, lo = state[(0, 0)]
+    state[(0, 0)] = (hi ^ rc_hi, lo ^ rc_lo)
+    return state
+
+
 def keccak_f(state):
     """state: dict (x, y) -> (hi, lo) uint32 arrays.
 
@@ -67,40 +106,24 @@ def keccak_f(state):
         np.array([[c >> 32, c & 0xFFFFFFFF] for c in RC], np.uint32))
 
     def round_body(rnd, state):
-        # theta
-        c = [(state[(x, 0)][0] ^ state[(x, 1)][0] ^ state[(x, 2)][0]
-              ^ state[(x, 3)][0] ^ state[(x, 4)][0],
-              state[(x, 0)][1] ^ state[(x, 1)][1] ^ state[(x, 2)][1]
-              ^ state[(x, 3)][1] ^ state[(x, 4)][1])
-             for x in range(5)]
-        d = []
-        for x in range(5):
-            rh, rl = _rot64(*c[(x + 1) % 5], 1)
-            d.append((c[(x - 1) % 5][0] ^ rh, c[(x - 1) % 5][1] ^ rl))
-        for x in range(5):
-            for y in range(5):
-                hi, lo = state[(x, y)]
-                state[(x, y)] = (hi ^ d[x][0], lo ^ d[x][1])
-        # rho + pi
-        b = {}
-        for x in range(5):
-            for y in range(5):
-                hi, lo = state[(x, y)]
-                b[(y, (2 * x + 3 * y) % 5)] = _rot64(hi, lo,
-                                                     int(_RHO[x, y]))
-        # chi
-        for x in range(5):
-            for y in range(5):
-                bh, bl = b[(x, y)]
-                nh, nl = b[((x + 1) % 5, y)]
-                fh, fl = b[((x + 2) % 5, y)]
-                state[(x, y)] = (bh ^ (~nh & fh), bl ^ (~nl & fl))
-        # iota
-        hi, lo = state[(0, 0)]
-        state[(0, 0)] = (hi ^ rc_tab[rnd, 0], lo ^ rc_tab[rnd, 1])
-        return state
+        return _keccak_round(state, rc_tab[rnd, 0], rc_tab[rnd, 1])
 
     return lax.fori_loop(0, 24, round_body, dict(state))
+
+
+def keccak_f_unrolled(state):
+    """24 STATICALLY-unrolled rounds with python-int round constants --
+    the Mosaic-lowerable form for the Pallas kernel (a fori_loop with
+    a 50-array dict carry does not lower; see ops/sha256.py for the
+    same split).  XLA:CPU compile time for the flat graph is minutes,
+    so this form is TPU/emulator-only."""
+    import jax.numpy as jnp
+
+    state = dict(state)
+    for rnd in range(24):
+        state = _keccak_round(state, jnp.uint32(RC[rnd] >> 32),
+                              jnp.uint32(RC[rnd] & 0xFFFFFFFF))
+    return state
 
 
 def keccak_words(msg: "jnp.ndarray", lengths, pad_byte: int = 0x01,
@@ -136,10 +159,21 @@ def keccak_words(msg: "jnp.ndarray", lengths, pad_byte: int = 0x01,
         hi, lo = state[(x, y)]
         state[(x, y)] = (hi ^ words[:, i, 1], lo ^ words[:, i, 0])
     state = keccak_f(state)
-    # digest = first out_bytes of the state (row-major lanes,
-    # little-endian within a lane), exposed as BIG-endian uint32 words
-    # so the framework's ">u4" target tables compare directly.  A
-    # half-lane tail (224: 28 bytes = 3.5 lanes) emits its low word.
+    return jnp.stack(squeeze_words(state, out_bytes), axis=-1)
+
+
+def keccak256_words(msg: "jnp.ndarray", lengths, pad_byte: int = 0x01):
+    """Single-block Keccak-256 (see keccak_words)."""
+    return keccak_words(msg, lengths, pad_byte, rate=136, out_bytes=32)
+
+
+def squeeze_words(state, out_bytes: int) -> list:
+    """Digest squeeze: the first out_bytes of the state (row-major
+    lanes, little-endian within a lane), exposed as BIG-endian uint32
+    words so the framework's ">u4" target tables compare directly.  A
+    half-lane tail (224: 28 bytes = 3.5 lanes) emits its low word.
+    Shared by the XLA sponge (keccak_words) and the Pallas kernel body
+    (ops/pallas_keccak.py)."""
     out = []
     for i in range(out_bytes // 8):
         hi, lo = state[(i % 5, i // 5)]
@@ -147,14 +181,8 @@ def keccak_words(msg: "jnp.ndarray", lengths, pad_byte: int = 0x01,
         out.append(_bswap(hi))
     if out_bytes % 8:
         i = out_bytes // 8
-        hi, lo = state[(i % 5, i // 5)]
-        out.append(_bswap(lo))
-    return jnp.stack(out, axis=-1)
-
-
-def keccak256_words(msg: "jnp.ndarray", lengths, pad_byte: int = 0x01):
-    """Single-block Keccak-256 (see keccak_words)."""
-    return keccak_words(msg, lengths, pad_byte, rate=136, out_bytes=32)
+        out.append(_bswap(state[(i % 5, i // 5)][1]))
+    return out
 
 
 def _bswap(x):

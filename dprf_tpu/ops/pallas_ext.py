@@ -44,6 +44,7 @@ from jax.experimental.pallas import tpu as pltpu
 from dprf_tpu.ops.pallas_mask import (CORES, MAX_TARGETS, SET_SIZE, SUB,
                                       _pack_message, bloom_found,
                                       bloom_tables, charset_segments,
+                                      check_batch,
                                       decode_candidate_bytes,
                                       mask_supported, reduce_tile_hits,
                                       reduce_tile_maybes)
@@ -240,19 +241,8 @@ def _build_ext_body(name: str, radices, seg_tables, length: int,
     return body
 
 
-def _check_batch(batch: int, sub: int) -> int:
-    if sub > 128:
-        # same guard as pallas_mask: count and hit_lane+1 must fit the
-        # packed 16-bit output fields (tile = sub*128 <= 16384)
-        raise ValueError("sub > 128 overflows the packed 16-bit "
-                         "count/lane output fields")
-    tile = sub * 128
-    if batch % tile:
-        raise ValueError(f"batch {batch} not a multiple of tile {tile}")
-    if batch > (1 << 31) - 256:
-        raise ValueError("batch must fit in int32 lane arithmetic "
-                         "(max 2**31 - 256)")
-    return batch // tile
+# shared packed-output factory guard (pallas_mask.check_batch)
+_check_batch = check_batch
 
 
 def make_ext_pallas_fn(name: str, gen, target_words, batch: int,

@@ -85,6 +85,26 @@ SET_SIZE = 1024
 MAX_TARGETS = 8192
 
 
+def check_batch(batch: int, sub: int) -> int:
+    """Shared guard for every packed-output mask kernel factory
+    (this module's, pallas_ext's, pallas_keccak's): sub bound for the
+    16-bit packed count/lane fields, tile alignment, and the int32
+    lane-arithmetic headroom (the first mixed-radix addition computes
+    base_digit + lane with base_digit <= 255, so the lane index needs
+    256 of headroom below 2^31 or the last lanes wrap and decode
+    wrong candidates).  Returns the grid size."""
+    if sub > 128:
+        raise ValueError("sub > 128 overflows the packed 16-bit "
+                         "count/lane output fields")
+    tile = sub * 128
+    if batch % tile:
+        raise ValueError(f"batch {batch} not a multiple of tile {tile}")
+    if batch > (1 << 31) - 256:
+        raise ValueError("batch must fit in int32 lane arithmetic "
+                         "(max 2**31 - 256)")
+    return batch // tile
+
+
 def _make_core(rounds_fn, init_words):
     """Wrap a shared rounds function into a kernel digest core:
     broadcast the initial state, run the rounds, add the Davies-Meyer
@@ -419,24 +439,13 @@ def make_mask_pallas_fn(engine_name: str, gen, target_words: np.ndarray,
     reduce_tile_maybes for the caller contract).
     """
     tile = sub * 128
-    if batch % tile:
-        raise ValueError(f"batch {batch} not a multiple of tile {tile}")
-    if batch > (1 << 31) - 256:
-        # the first mixed-radix addition computes base_digit + lane with
-        # base_digit <= 255, so the lane index needs 256 of headroom
-        # below 2^31 or the last lanes wrap and decode wrong candidates
-        raise ValueError("batch must fit in int32 lane arithmetic "
-                         "(max 2**31 - 256)")
+    grid = check_batch(batch, sub)
     target_words = np.asarray(target_words)
     multi = target_words.ndim == 2 and target_words.shape[0] > 1
     n_targets = target_words.shape[0] if multi else 1
     if not kernel_eligible(engine_name, gen, n_targets):
         raise ValueError(f"{engine_name} mask job not kernel-eligible; "
                          "use the XLA path")
-    if sub > 128:
-        raise ValueError("sub > 128 overflows the packed 16-bit "
-                         "count/lane output fields")
-    grid = batch // tile
     seg_tables = [charset_segments(cs) for cs in gen.charsets]
     kernel = _build_kernel(engine_name, gen.radices, seg_tables,
                            gen.length, target_words, sub, multi=multi)

@@ -1,0 +1,67 @@
+"""Keccak/SHA3 Pallas kernel body vs hashlib oracles (eager emulation
+on CPU -- the kernel itself is TPU-only; see
+ops/pallas_keccak.keccak_kernel_eligible)."""
+
+import hashlib
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.ops.keccak import keccak_f, keccak_f_unrolled
+from dprf_tpu.ops.pallas_keccak import SUBK, emulate_keccak_kernel
+
+pytestmark = pytest.mark.smoke
+
+TILE = SUBK * 128
+
+
+def test_keccak_f_unrolled_matches_fori():
+    rng = np.random.default_rng(9)
+    state = {(x, y): (jnp.asarray(rng.integers(0, 2 ** 32, (4,),
+                                               dtype=np.uint32)),
+                      jnp.asarray(rng.integers(0, 2 ** 32, (4,),
+                                               dtype=np.uint32)))
+             for x in range(5) for y in range(5)}
+    a = keccak_f(dict(state))
+    b = keccak_f_unrolled(dict(state))
+    for k in state:
+        assert np.array_equal(np.asarray(a[k][0]), np.asarray(b[k][0]))
+        assert np.array_equal(np.asarray(a[k][1]), np.asarray(b[k][1]))
+
+
+def _tw(plain: bytes, variant: str) -> np.ndarray:
+    from dprf_tpu.engines import get_engine
+    d = get_engine(variant, device="cpu").hash_batch([plain])[0]
+    if variant.startswith("sha3"):   # cross-check vs the stdlib oracle
+        assert d == getattr(hashlib,
+                            variant.replace("-", "_"))(plain).digest()
+    return np.frombuffer(d, ">u4").astype(np.uint32)
+
+
+@pytest.mark.parametrize("variant,pad,rate,out", [
+    ("sha3-256", 0x06, 136, 32),
+    ("sha3-512", 0x06, 72, 64),
+    ("sha3-224", 0x06, 144, 28),    # half-lane digest tail
+    ("keccak-256", 0x01, 136, 32),
+])
+def test_keccak_kernel_body_emulated_finds_planted(variant, pad, rate,
+                                                   out):
+    gen = MaskGenerator("?l?l?l?l")
+    plant = b"frog"
+    pidx = gen.index_of(plant)
+    tw = _tw(plant, variant)
+    base = TILE * (pidx // TILE)
+    bd = gen.digits(base)
+    counts, lanes = emulate_keccak_kernel(
+        gen, tw, batch=TILE, base_digits=bd,
+        n_valid=min(TILE, gen.keyspace - base),
+        pad_byte=pad, rate=rate, out_bytes=out)
+    assert counts.sum() == 1
+    assert base + int(lanes[0, 0]) == pidx
+    # n_valid masking excludes the plant
+    counts2, _ = emulate_keccak_kernel(
+        gen, tw, batch=TILE, base_digits=bd, n_valid=pidx - base,
+        pad_byte=pad, rate=rate, out_bytes=out)
+    assert counts2.sum() == 0
