@@ -153,8 +153,11 @@ def stage_kernels(io: StageIO):
         ("sha1", "?l?l?l?l?l?l", 1),
         ("ntlm", "?a?a?a?a?a?a?a", 1),
         ("sha256", "?l?l?l?l?l?l?l?l", 1),
+        ("sha512", "?l?l?l?l?l?l?l?l", 1),   # round-4b: 64-bit pairs
+        ("sha384", "?l?l?l?l?l?l?l?l", 1),
         ("md5", "?a?a?a?a?a?a?a", 1000),   # Bloom multi-target
         ("ntlm", "?a?a?a?a?a?a?a", 1000),
+        ("sha512", "?a?a?a?a?a?a?a", 1000),
     ]
     for engine, mask, n_targets in cases:
         name = f"{engine}/{n_targets}t"
@@ -189,6 +192,38 @@ def stage_kernels(io: StageIO):
                 rec["ok"] = (int(counts.sum()) == 1 and hits == [plant_idx])
             rec["hits"] = [int(h) for h in hits]
         except Exception as e:   # record, keep going
+            rec["ok"] = False
+            rec["error"] = f"{type(e).__name__}: {e}"
+            rec["traceback"] = traceback.format_exc()[-1500:]
+        io.record(name, rec)
+
+    # round-4b keccak kernels (own factory: sponge, not MD framing)
+    from dprf_tpu.ops import pallas_keccak as pk
+    for kname, pad, rate, outb in [("sha3-256", 0x06, 136, 32),
+                                   ("keccak-256", 0x01, 136, 32),
+                                   ("sha3-512", 0x06, 72, 64)]:
+        name = f"{kname}/1t"
+        io.status(name)
+        rec = {"engine": kname, "mask": "?l?l?l?l?l?l", "targets": 1}
+        try:
+            gen = MaskGenerator("?l?l?l?l?l?l")
+            tile = pk.SUBK * 128
+            batch = tile * 4
+            plant_idx = tile + 7
+            tw, _ = _plant_target(kname, gen, plant_idx)
+            t0 = time.perf_counter()
+            fn = pk.make_keccak_pallas_fn(gen, tw, batch, pad, rate,
+                                          outb)
+            base = jnp.asarray(gen.digits(0), jnp.int32)
+            out = fn(base, jnp.asarray([batch], jnp.int32))
+            hard_sync(out)
+            rec["compile_s"] = round(time.perf_counter() - t0, 2)
+            counts = np.asarray(out[0])[:, 0]
+            lanes = np.asarray(out[1])[:, 0]
+            hits = [(t * tile + lanes[t]) for t in np.nonzero(counts)[0]]
+            rec["ok"] = (int(counts.sum()) == 1 and hits == [plant_idx])
+            rec["hits"] = [int(h) for h in hits]
+        except Exception as e:
             rec["ok"] = False
             rec["error"] = f"{type(e).__name__}: {e}"
             rec["traceback"] = traceback.format_exc()[-1500:]
