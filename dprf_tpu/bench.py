@@ -73,7 +73,34 @@ def run_bench(engine: str = "md5", device: str = "jax",
         eng = get_engine(engine, device="jax")
         fake = bytes([0xFF]) * eng.digest_size
         use_pallas = False
-        if impl != "xla":
+        rate = getattr(eng, "_rate", None)
+        if rate is not None:
+            # keccak family: its own sponge steps (the generic MD
+            # pipeline's framing does not apply)
+            import numpy as np
+
+            from dprf_tpu.engines.device.sha3 import make_keccak_mask_step
+            from dprf_tpu.ops.pallas_keccak import (
+                SUBK, keccak_kernel_eligible, make_pallas_keccak_crack_step)
+            tw = np.frombuffer(fake, ">u4").astype(np.uint32)
+            if impl != "xla" and keccak_kernel_eligible(gen, 1, rate):
+                tile = SUBK * 128
+                batch = max(tile, (batch // tile) * tile)
+                step = make_pallas_keccak_crack_step(
+                    gen, tw, batch, eng._pad_byte, rate,
+                    eng.digest_size)
+                use_pallas = True
+            elif impl == "pallas":
+                raise ValueError(
+                    "--impl pallas: keccak kernel not eligible -- it "
+                    "requires a real TPU backend, a mask the "
+                    "arithmetic charset decode supports, and a "
+                    f"candidate <= {rate - 1} bytes (rate {rate})")
+            else:
+                step = make_keccak_mask_step(
+                    gen, tw, batch, eng._pad_byte, rate=rate,
+                    out_bytes=eng.digest_size)
+        elif impl != "xla":
             from dprf_tpu.ops import pallas_mask
             eligible = pallas_mask.kernel_eligible(engine, gen, 1)
             if impl == "pallas" and not eligible:
@@ -93,7 +120,7 @@ def run_bench(engine: str = "md5", device: str = "jax",
                     np.frombuffer(fake, dtype=dt).astype(np.uint32),
                     batch, **mode)
                 use_pallas = True
-        if not use_pallas:
+        if not use_pallas and rate is None:
             step = make_mask_crack_step(
                 eng, gen, target_words(fake, eng.little_endian), batch,
                 widen_utf16=getattr(eng, "widen_utf16", False))

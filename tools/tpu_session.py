@@ -140,33 +140,51 @@ def _plant_target(engine_name, gen, index):
 
 
 def stage_kernels(io: StageIO):
-    """Compile + run every Pallas kernel variant with a planted target."""
+    """Compile + run every Pallas kernel variant with a planted target.
+
+    One harness for both kernel families: a case supplies its factory
+    (fn(gen, tw, batch) -> pallas fn) and tile size; the MD factories
+    come from pallas_mask, the sponge factories from pallas_keccak."""
     import numpy as np
     import jax.numpy as jnp
 
     from dprf_tpu.generators.mask import MaskGenerator
+    from dprf_tpu.ops import pallas_keccak as pk
     from dprf_tpu.ops import pallas_mask as pm
     from dprf_tpu.utils.sync import hard_sync
 
+    def md(engine):
+        return (lambda gen, tw, batch:
+                pm.make_mask_pallas_fn(engine, gen, tw, batch)), pm.TILE
+
+    def keccak(pad, rate, outb):
+        return (lambda gen, tw, batch:
+                pk.make_keccak_pallas_fn(gen, tw, batch, pad, rate,
+                                         outb)), pk.SUBK * 128
+
     cases = [
-        ("md5", "?l?l?l?l?l?l", 1),
-        ("sha1", "?l?l?l?l?l?l", 1),
-        ("ntlm", "?a?a?a?a?a?a?a", 1),
-        ("sha256", "?l?l?l?l?l?l?l?l", 1),
-        ("sha512", "?l?l?l?l?l?l?l?l", 1),   # round-4b: 64-bit pairs
-        ("sha384", "?l?l?l?l?l?l?l?l", 1),
-        ("md5", "?a?a?a?a?a?a?a", 1000),   # Bloom multi-target
-        ("ntlm", "?a?a?a?a?a?a?a", 1000),
-        ("sha512", "?a?a?a?a?a?a?a", 1000),
+        ("md5", "?l?l?l?l?l?l", 1, *md("md5")),
+        ("sha1", "?l?l?l?l?l?l", 1, *md("sha1")),
+        ("ntlm", "?a?a?a?a?a?a?a", 1, *md("ntlm")),
+        ("sha256", "?l?l?l?l?l?l?l?l", 1, *md("sha256")),
+        ("sha512", "?l?l?l?l?l?l?l?l", 1, *md("sha512")),   # r4b
+        ("sha384", "?l?l?l?l?l?l?l?l", 1, *md("sha384")),
+        ("md5", "?a?a?a?a?a?a?a", 1000, *md("md5")),   # Bloom multi
+        ("ntlm", "?a?a?a?a?a?a?a", 1000, *md("ntlm")),
+        ("sha512", "?a?a?a?a?a?a?a", 1000, *md("sha512")),
+        # r4b sponge kernels (own factory: not MD framing)
+        ("sha3-256", "?l?l?l?l?l?l", 1, *keccak(0x06, 136, 32)),
+        ("keccak-256", "?l?l?l?l?l?l", 1, *keccak(0x01, 136, 32)),
+        ("sha3-512", "?l?l?l?l?l?l", 1, *keccak(0x06, 72, 64)),
     ]
-    for engine, mask, n_targets in cases:
+    for engine, mask, n_targets, factory, tile in cases:
         name = f"{engine}/{n_targets}t"
         io.status(name)
         rec = {"engine": engine, "mask": mask, "targets": n_targets}
         try:
             gen = MaskGenerator(mask)
-            batch = pm.TILE * 4
-            plant_idx = pm.TILE + 7   # tile 1, lane 7
+            batch = tile * 4
+            plant_idx = tile + 7   # tile 1, lane 7
             tw, _ = _plant_target(engine, gen, plant_idx)
             if n_targets > 1:
                 rng = np.random.RandomState(42)
@@ -175,14 +193,14 @@ def stage_kernels(io: StageIO):
                 tws[313] = tw   # bury the real target mid-list
                 tw = tws
             t0 = time.perf_counter()
-            fn = pm.make_mask_pallas_fn(engine, gen, tw, batch)
+            fn = factory(gen, tw, batch)
             base = jnp.asarray(gen.digits(0), jnp.int32)
             out = fn(base, jnp.asarray([batch], jnp.int32))
             hard_sync(out)
             rec["compile_s"] = round(time.perf_counter() - t0, 2)
             counts = np.asarray(out[0])[:, 0]
             lanes = np.asarray(out[1])[:, 0]
-            hits = [(t * pm.TILE + lanes[t]) for t in np.nonzero(counts)[0]]
+            hits = [(t * tile + lanes[t]) for t in np.nonzero(counts)[0]]
             if n_targets > 1:
                 # multi-target counts are Bloom MAYBE counts: the
                 # planted hit must be present; a stray false maybe
@@ -192,38 +210,6 @@ def stage_kernels(io: StageIO):
                 rec["ok"] = (int(counts.sum()) == 1 and hits == [plant_idx])
             rec["hits"] = [int(h) for h in hits]
         except Exception as e:   # record, keep going
-            rec["ok"] = False
-            rec["error"] = f"{type(e).__name__}: {e}"
-            rec["traceback"] = traceback.format_exc()[-1500:]
-        io.record(name, rec)
-
-    # round-4b keccak kernels (own factory: sponge, not MD framing)
-    from dprf_tpu.ops import pallas_keccak as pk
-    for kname, pad, rate, outb in [("sha3-256", 0x06, 136, 32),
-                                   ("keccak-256", 0x01, 136, 32),
-                                   ("sha3-512", 0x06, 72, 64)]:
-        name = f"{kname}/1t"
-        io.status(name)
-        rec = {"engine": kname, "mask": "?l?l?l?l?l?l", "targets": 1}
-        try:
-            gen = MaskGenerator("?l?l?l?l?l?l")
-            tile = pk.SUBK * 128
-            batch = tile * 4
-            plant_idx = tile + 7
-            tw, _ = _plant_target(kname, gen, plant_idx)
-            t0 = time.perf_counter()
-            fn = pk.make_keccak_pallas_fn(gen, tw, batch, pad, rate,
-                                          outb)
-            base = jnp.asarray(gen.digits(0), jnp.int32)
-            out = fn(base, jnp.asarray([batch], jnp.int32))
-            hard_sync(out)
-            rec["compile_s"] = round(time.perf_counter() - t0, 2)
-            counts = np.asarray(out[0])[:, 0]
-            lanes = np.asarray(out[1])[:, 0]
-            hits = [(t * tile + lanes[t]) for t in np.nonzero(counts)[0]]
-            rec["ok"] = (int(counts.sum()) == 1 and hits == [plant_idx])
-            rec["hits"] = [int(h) for h in hits]
-        except Exception as e:
             rec["ok"] = False
             rec["error"] = f"{type(e).__name__}: {e}"
             rec["traceback"] = traceback.format_exc()[-1500:]
