@@ -83,7 +83,12 @@ def run_bench(engine: str = "md5", device: str = "jax",
             from dprf_tpu.ops.pallas_keccak import (
                 SUBK, keccak_kernel_eligible, make_pallas_keccak_crack_step)
             tw = np.frombuffer(fake, ">u4").astype(np.uint32)
-            if impl != "xla" and keccak_kernel_eligible(gen, 1, rate):
+            from dprf_tpu.ops.pallas_mask import pallas_mode
+            # same selection a real job makes: auto honors the
+            # DPRF_PALLAS kill-switch via pallas_mode()
+            kernel_on = (impl == "pallas" or pallas_mode() is not None)
+            if (impl != "xla" and kernel_on
+                    and keccak_kernel_eligible(gen, 1, rate)):
                 tile = SUBK * 128
                 batch = max(tile, (batch // tile) * tile)
                 step = make_pallas_keccak_crack_step(

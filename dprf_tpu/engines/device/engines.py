@@ -28,7 +28,51 @@ from dprf_tpu.ops.sha256 import (sha224_digest_words,
 from dprf_tpu.ops.sha512 import sha384_digest_words, sha512_digest_words
 
 
-class JaxEngineBase(DeviceHashEngine, HashEngine):
+class GenericWorkerFactories:
+    """Combinator + multi-chip (keyspace DP over a 1-D mesh) worker
+    factories over the generic fused steps.  Any engine exposing the
+    digest_candidates hook can mix this in (JaxEngineBase and the
+    keccak family both do); salted engines (bcrypt, PMKID) override
+    with their own sharded pipelines, so every engine exposes the same
+    multi-chip surface and `--devices N` never silently degrades to
+    one chip."""
+
+    def make_combinator_worker(self, gen, targets, batch: int,
+                               hit_capacity: int, oracle=None):
+        """Fused combinator/hybrid worker (left x right word tables)."""
+        from dprf_tpu.runtime.worker import DeviceCombinatorWorker
+        return DeviceCombinatorWorker(self, gen, targets, batch=batch,
+                                      hit_capacity=hit_capacity,
+                                      oracle=oracle)
+
+    def make_sharded_mask_worker(self, gen, targets, mesh,
+                                 batch_per_device: int, hit_capacity: int,
+                                 oracle=None):
+        from dprf_tpu.parallel.worker import ShardedMaskWorker
+        return ShardedMaskWorker(self, gen, targets, mesh,
+                                 batch_per_device=batch_per_device,
+                                 hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_sharded_wordlist_worker(self, gen, targets, mesh,
+                                     word_batch_per_device: int,
+                                     hit_capacity: int, oracle=None):
+        from dprf_tpu.parallel.worker import ShardedWordlistWorker
+        return ShardedWordlistWorker(
+            self, gen, targets, mesh,
+            word_batch_per_device=word_batch_per_device,
+            hit_capacity=hit_capacity, oracle=oracle)
+
+    def make_sharded_combinator_worker(self, gen, targets, mesh,
+                                       batch_per_device: int,
+                                       hit_capacity: int, oracle=None):
+        from dprf_tpu.parallel.worker import ShardedCombinatorWorker
+        return ShardedCombinatorWorker(
+            self, gen, targets, mesh,
+            batch_per_device=batch_per_device,
+            hit_capacity=hit_capacity, oracle=oracle)
+
+
+class JaxEngineBase(GenericWorkerFactories, DeviceHashEngine, HashEngine):
     """Shared packing + host-convenience layer for single-block engines."""
 
     #: digest words are little-endian uint32 (MD4/MD5 family) or
@@ -49,6 +93,20 @@ class JaxEngineBase(DeviceHashEngine, HashEngine):
     def pack_varlen(self, cand: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
         return pack_ops.pack_varlen(cand, lengths,
                                     big_endian=not self.little_endian)
+
+    def digest_candidates(self, cand: jnp.ndarray,
+                          lengths) -> jnp.ndarray:
+        """uint8[B, L] candidates + int32[B] lengths (or a python int
+        for a fixed-length batch) -> digest words.  Default is the
+        MD-style pack + compress; engines with non-MD framing (the
+        keccak sponge family) override, so the generic sharded /
+        combinator / rules factories serve every family through ONE
+        hook instead of assuming the block packers."""
+        if isinstance(lengths, int):
+            words = self.pack(cand, lengths)
+        else:
+            words = self.pack_varlen(cand, lengths)
+        return self.digest_packed(words)
 
     def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
                          oracle=None):
@@ -129,45 +187,6 @@ class JaxEngineBase(DeviceHashEngine, HashEngine):
                      targets=len(targets))
         return DeviceWordlistWorker(self, gen, targets, batch=batch,
                                     hit_capacity=hit_capacity, oracle=oracle)
-
-    def make_combinator_worker(self, gen, targets, batch: int,
-                               hit_capacity: int, oracle=None):
-        """Fused combinator/hybrid worker (left x right word tables)."""
-        from dprf_tpu.runtime.worker import DeviceCombinatorWorker
-        return DeviceCombinatorWorker(self, gen, targets, batch=batch,
-                                      hit_capacity=hit_capacity,
-                                      oracle=oracle)
-
-    # -- multi-chip factories (keyspace DP over a 1-D mesh) --------------
-    # Salted engines (bcrypt, PMKID) override these with their own
-    # sharded pipelines, so every engine exposes the same multi-chip
-    # surface and `--devices N` never silently degrades to one chip.
-
-    def make_sharded_mask_worker(self, gen, targets, mesh,
-                                 batch_per_device: int, hit_capacity: int,
-                                 oracle=None):
-        from dprf_tpu.parallel.worker import ShardedMaskWorker
-        return ShardedMaskWorker(self, gen, targets, mesh,
-                                 batch_per_device=batch_per_device,
-                                 hit_capacity=hit_capacity, oracle=oracle)
-
-    def make_sharded_wordlist_worker(self, gen, targets, mesh,
-                                     word_batch_per_device: int,
-                                     hit_capacity: int, oracle=None):
-        from dprf_tpu.parallel.worker import ShardedWordlistWorker
-        return ShardedWordlistWorker(
-            self, gen, targets, mesh,
-            word_batch_per_device=word_batch_per_device,
-            hit_capacity=hit_capacity, oracle=oracle)
-
-    def make_sharded_combinator_worker(self, gen, targets, mesh,
-                                       batch_per_device: int,
-                                       hit_capacity: int, oracle=None):
-        from dprf_tpu.parallel.worker import ShardedCombinatorWorker
-        return ShardedCombinatorWorker(
-            self, gen, targets, mesh,
-            batch_per_device=batch_per_device,
-            hit_capacity=hit_capacity, oracle=oracle)
 
     # -- host-facing HashEngine API --------------------------------------
 

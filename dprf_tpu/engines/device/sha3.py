@@ -19,6 +19,7 @@ from jax import lax
 
 from dprf_tpu.engines import register
 from dprf_tpu.engines.cpu.engines import Keccak256Engine, Sha3_256Engine
+from dprf_tpu.engines.device.engines import GenericWorkerFactories
 from dprf_tpu.ops import compare as cmp_ops
 from dprf_tpu.ops.keccak import keccak_words
 from dprf_tpu.runtime.worker import (DeviceWordlistWorker,
@@ -164,11 +165,20 @@ class KeccakWordlistWorker(_KeccakTargetsMixin, DeviceWordlistWorker):
             rate=engine._rate, out_bytes=engine.digest_size)
 
 
-class _KeccakDeviceMixin:
+class _KeccakDeviceMixin(GenericWorkerFactories):
     little_endian = False
     digest_words = 8
     _pad_byte: int
     _rate = 136
+
+    def digest_candidates(self, cand, lengths):
+        """The generic-factory hook (JaxEngineBase.digest_candidates):
+        sponge framing instead of MD packing, so the sharded and
+        combinator factories serve this family unchanged."""
+        if isinstance(lengths, int):
+            lengths = jnp.full((cand.shape[0],), lengths, jnp.int32)
+        return keccak_words(cand, lengths, pad_byte=self._pad_byte,
+                            rate=self._rate, out_bytes=self.digest_size)
 
     def make_mask_worker(self, gen, targets, batch: int, hit_capacity: int,
                          oracle=None):
@@ -206,10 +216,10 @@ class _KeccakDeviceMixin:
                                     hit_capacity=hit_capacity,
                                     oracle=oracle)
 
-    make_sharded_mask_worker = None
-    make_sharded_wordlist_worker = None
-    make_combinator_worker = None
-    make_sharded_combinator_worker = None
+    # the generic multi-chip / combinator workers (inherited from
+    # GenericWorkerFactories) ride the digest_candidates hook
+    # (round 4b: previously None -- --devices N and -a combinator on
+    # this family errored out)
 
 
 @register("sha3-256", device="jax")

@@ -65,8 +65,7 @@ def make_combinator_crack_step(engine, gen,
         if widen_utf16:
             cand = pack_ops.utf16le_widen(cand)
             lengths = lengths * 2
-        words = engine.pack_varlen(cand, lengths)
-        digest = engine.digest_packed(words)
+        digest = engine.digest_candidates(cand, lengths)
         if multi:
             found, tpos = cmp_ops.compare_multi(digest, targets)
         else:
@@ -103,8 +102,7 @@ def make_sharded_combinator_crack_step(
         if widen_utf16:
             cand = pack_ops.utf16le_widen(cand)
             lengths = lengths * 2
-        words = engine.pack_varlen(cand, lengths)
-        digest = engine.digest_packed(words)
+        digest = engine.digest_candidates(cand, lengths)
         if multi:
             found, tpos = cmp_ops.compare_multi(digest, targets)
         else:

@@ -62,8 +62,7 @@ def _expand_and_digest(engine, rules, wslice, lslice, base_valid,
     if widen_utf16:
         cw = pack_ops.utf16le_widen(cw)
         cl = cl * 2
-    words = engine.pack_varlen(cw, cl)
-    return engine.digest_packed(words), cv
+    return engine.digest_candidates(cw, cl), cv
 
 
 def _compare(digest, targets, multi):

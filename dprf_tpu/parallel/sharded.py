@@ -104,13 +104,12 @@ def make_sharded_mask_crack_step(
         offset = (dev * batch).astype(jnp.int32)
         cand = gen.decode_batch(base_digits, flat, batch, lane_offset=offset)
         if widen_utf16:
-            cand_bytes = jnp.reshape(
+            cand = jnp.reshape(
                 jnp.stack([cand, jnp.zeros_like(cand)], axis=-1),
                 (batch, 2 * length))
-            words = engine.pack(cand_bytes, 2 * length)
+            digest = engine.digest_candidates(cand, 2 * length)
         else:
-            words = engine.pack(cand, length)
-        digest = engine.digest_packed(words)
+            digest = engine.digest_candidates(cand, length)
         if multi:
             found, tpos = cmp_ops.compare_multi(digest, targets)
         else:

@@ -128,3 +128,19 @@ def test_cli_combinator_and_hybrid(tmp_path, capsys):
                "--batch", "64", "-q"])
     out = capsys.readouterr().out
     assert rc == 0 and f"{digest3}:7beta" in out
+
+
+def test_combinator_keccak_worker():
+    """Round 4b: combinator attacks on the keccak family via the
+    digest_candidates hook (previously no path)."""
+    left = [f"w{i}".encode() for i in range(10)]
+    right = [f"{i:02d}".encode() for i in range(12)]
+    gen = CombinatorGenerator(left, right, max_len=8)
+    dev = get_engine("sha3-256", device="jax")
+    secret = b"w307"
+    t = dev.parse_target(hashlib.sha3_256(secret).hexdigest())
+    w = dev.make_combinator_worker(gen, [t], batch=64, hit_capacity=4,
+                                   oracle=get_engine("sha3-256"))
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
+    assert gen.candidate(hits[0].cand_index) == secret

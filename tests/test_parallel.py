@@ -285,3 +285,41 @@ for bstart in range(0, gen.keyspace, 512):
                           capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "MULTIHOST_OK" in proc.stdout
+
+
+def test_sharded_keccak_worker(mesh):
+    """Round 4b: the sha3/keccak family rides the generic sharded
+    worker via the digest_candidates hook (previously --devices N on
+    this family had no path)."""
+    gen = MaskGenerator("?l?l?l?l")
+    pw = b"toad"
+    idx = gen.index_of(pw)
+    dev = get_engine("sha3-256", device="jax")
+    t = dev.parse_target(hashlib.sha3_256(pw).hexdigest())
+    w = dev.make_sharded_mask_worker(gen, [t], mesh,
+                                     batch_per_device=1024,
+                                     hit_capacity=8,
+                                     oracle=get_engine("sha3-256"))
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.cand_index, h.plaintext)
+            for h in hits] == [(0, idx, pw)]
+
+
+def test_sharded_keccak_wordlist_worker(mesh):
+    from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+    from dprf_tpu.rules.parser import parse_rule
+
+    words = [b"alpha", b"bravo", b"charlie"] + \
+        [b"w%03d" % i for i in range(200)]
+    rules = [parse_rule(":"), parse_rule("u")]
+    gen = WordlistRulesGenerator(words, rules, max_len=12)
+    dev = get_engine("keccak-256", device="jax")
+    cpu = get_engine("keccak-256")
+    plant = b"BRAVO"                     # rule 'u' on word 1
+    t = dev.parse_target(cpu.hash_batch([plant])[0].hex())
+    w = dev.make_sharded_wordlist_worker(gen, [t], mesh,
+                                         word_batch_per_device=16,
+                                         hit_capacity=8, oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.cand_index, h.plaintext)
+            for h in hits] == [(0, 1 * gen.n_rules + 1, plant)]
