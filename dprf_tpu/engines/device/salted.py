@@ -250,6 +250,37 @@ class _SaltedWorkerBase:
         salt, salt_len, tgt = self._targs[ti]
         return self.step(base, n, salt, salt_len, tgt)
 
+    #: wide fusion bounds (see runtime/worker.py MaskWorkerBase): a
+    #: wide-capable subclass overrides _wide_invoke to rebuild its
+    #: per-target step at inner*stride lanes -- one device program per
+    #: ~100 batches instead of per batch, the same link-amortization
+    #: the Pallas mask workers use (scan-wrapping is not an option on
+    #: this backend; TPU_PROBE_LOG_r04.md finding 8).
+    SUPER_CAP = 256
+    SUPER_MIN = 8
+
+    def _wide_invoke(self, ti: int, base, sbatch: int, n_valid):
+        """Wide step call for target ti, or None when not wide-capable
+        (the default: per-batch dispatch only)."""
+        return None
+
+    def _wide_inner(self, remaining_strides: int) -> int:
+        # env flag + int32 cap are worker-lifetime invariants: resolve
+        # once (this runs on every iteration of the per-batch sweep)
+        cap = getattr(self, "_wide_cap", None)
+        if cap is None:
+            import os as _os
+
+            from dprf_tpu.ops.superstep import max_inner
+            cap = self._wide_cap = (
+                0 if _os.environ.get("DPRF_SUPERSTEP", "1") == "0"
+                else max_inner(self.stride, self.SUPER_CAP))
+        if getattr(self, "_wide_disabled", False) or \
+                cap < self.SUPER_MIN or \
+                remaining_strides < self.SUPER_MIN:
+            return 0
+        return min(cap, 1 << (remaining_strides.bit_length() - 1))
+
     def _batch_flag(self, result):
         """Scalar that is nonzero iff this batch needs host attention
         (hits or overflow); override with any extra buffers.  See
@@ -298,33 +329,63 @@ class SaltedMaskWorker(_SaltedWorkerBase):
         for ti in range(len(self.targets)):
             queued = []
             flag = None
-            for bstart in range(unit.start, unit.end, self.stride):
-                n_valid = min(self.stride, unit.end - bstart)
-                base = jnp.asarray(self.gen.digits(bstart), dtype=jnp.int32)
-                result = self._invoke(ti, base, jnp.int32(n_valid))
+            pos = unit.start
+            while pos < unit.end:
+                inner = self._wide_inner((unit.end - pos) // self.stride)
+                window = inner * self.stride if inner >= 2 else 0
+                base = jnp.asarray(self.gen.digits(pos), dtype=jnp.int32)
+                result = None
+                if window:
+                    result = self._wide_invoke(ti, base, window,
+                                               jnp.int32(window))
+                if result is None:         # per-batch dispatch
+                    window = min(self.stride, unit.end - pos)
+                    result = self._invoke(ti, base, jnp.int32(window))
                 # device-accumulated unit flag: one host readback per
                 # (target, unit) when nothing hit -- see
                 # runtime/worker.py MaskWorkerBase.process
                 f = self._batch_flag(result)
                 flag = f if flag is None else flag + f
-                queued.append((bstart, result))
+                queued.append((pos, window, result))
+                pos += window
             if flag is None or int(flag) == 0:
                 continue
-            for bstart, (count, lanes, _) in queued:
-                count = int(count)
-                if count == 0:
-                    continue
-                if count > self.hit_capacity:
-                    hits.extend(self._rescan(
-                        bstart, min(bstart + self.stride, unit.end), ti))
-                    continue
-                for lane in np.asarray(lanes):
-                    if lane < 0:
-                        continue
-                    gidx = bstart + int(lane)
-                    plain = self.gen.candidate(gidx)
-                    if self._accept(ti, gidx, plain):
-                        hits.append(Hit(ti, gidx, plain))
+            for bstart, window, result in queued:
+                hits.extend(self._entry_hits(ti, bstart, window, result,
+                                             unit))
+        return hits
+
+    def _entry_hits(self, ti: int, bstart: int, window: int, result,
+                    unit: WorkUnit) -> list[Hit]:
+        """Decode one dispatch's result; a wide window whose buffer
+        overflowed re-drives through the per-batch device step so the
+        exact host rescan stays one stride wide."""
+        count, lanes, _ = result
+        count = int(count)
+        if count == 0:
+            return []
+        if count > lanes.shape[0]:     # the step's BUILT buffer size
+            if window > self.stride:
+                out: list[Hit] = []
+                end = min(bstart + window, unit.end)
+                for bs in range(bstart, end, self.stride):
+                    nv = min(self.stride, end - bs)
+                    base = jnp.asarray(self.gen.digits(bs),
+                                       dtype=jnp.int32)
+                    out.extend(self._entry_hits(
+                        ti, bs, nv, self._invoke(ti, base, jnp.int32(nv)),
+                        unit))
+                return out
+            return self._rescan(
+                bstart, min(bstart + self.stride, unit.end), ti)
+        hits: list[Hit] = []
+        for lane in np.asarray(lanes):
+            if lane < 0:
+                continue
+            gidx = bstart + int(lane)
+            plain = self.gen.candidate(gidx)
+            if self._accept(ti, gidx, plain):
+                hits.append(Hit(ti, gidx, plain))
         return hits
 
 
@@ -405,12 +466,15 @@ class PallasSaltedMaskWorker(SaltedMaskWorker):
         tile = SUB * 128
         batch = max(tile, (batch // tile) * tile)
         self.stride = self.batch = batch
+        self._algo = algo
+        self._interpret = interpret
         lens = sorted({len(t.params["salt"]) for t in self.targets})
         self._ksteps = {
             n: pallas_ext.make_salted_crack_step(
                 algo, engine.order, gen, batch, n, hit_capacity,
                 interpret=interpret)
             for n in lens}
+        self._wide_ksteps: dict = {}
         # per-target runtime args: salt bytes as int32, target words
         # bit-cast to int32 (SMEM scalars)
         dt = "<u4" if engine.little_endian else ">u4"
@@ -437,6 +501,38 @@ class PallasSaltedMaskWorker(SaltedMaskWorker):
     def _invoke(self, ti: int, base, n):
         slen, salt, tgt = self._kargs[ti]
         return self._ksteps[slen](base, n, salt, tgt)
+
+    def _wide_invoke(self, ti: int, base, sbatch: int, n_valid):
+        """Wide kernel step at sbatch lanes, cached per (salt length,
+        sbatch) -- salt/target stay RUNTIME scalars, so one wide
+        program per salt length serves the whole hashlist, exactly
+        like the per-batch kernels.  A build failure degrades this
+        worker to per-batch dispatch (never a scan wrapper)."""
+        from dprf_tpu.ops import pallas_ext
+        slen, salt, tgt = self._kargs[ti]
+        key = (slen, sbatch)
+        try:
+            step = self._wide_ksteps.get(key)
+            if step is None:
+                scale = max(1, sbatch // self.batch)
+                cap = max(self.hit_capacity,
+                          min(self.hit_capacity * scale, 1024))
+                step = self._wide_ksteps[key] = \
+                    pallas_ext.make_salted_crack_step(
+                        self._algo, self.engine.order, self.gen,
+                        sbatch, slen, cap, interpret=self._interpret)
+            # the CALL stays inside the try: jit/Mosaic compile
+            # lazily, so a wide program that exceeds VMEM surfaces
+            # HERE, not in the factory -- it must degrade this worker
+            # to per-batch dispatch, not kill the WorkUnit
+            return step(base, n_valid, salt, tgt)
+        except Exception as e:  # noqa: BLE001 -- compiler errors
+            from dprf_tpu.utils.logging import DEFAULT as log
+            self._wide_disabled = True
+            log.warn("wide salted kernel failed to build/compile; "
+                     "falling back to per-batch dispatch",
+                     sbatch=sbatch, error=str(e))
+            return None
 
 
 #: device base class -> kernel core algo for the extended salted

@@ -234,3 +234,35 @@ def test_wordlist_wide_matches_per_batch(monkeypatch):
     s_small = w._make_step(4 * TILE_W)
     assert s_small.words4 is s_big.words4
     assert s_small.lens3 is s_big.lens3
+
+
+def test_salted_wide_matches_per_batch(monkeypatch):
+    """PallasSaltedMaskWorker fuses its per-target sweep into wide
+    kernel dispatches; hits and indices must match the per-batch path
+    and the wide kernels must actually be built."""
+    from dprf_tpu.engines.device.salted import PallasSaltedMaskWorker
+
+    monkeypatch.setenv("DPRF_PALLAS", "1")
+    gen = MaskGenerator("?l?l?l?l")
+    cpu = get_engine("md5-ps", device="cpu")
+    dev = get_engine("md5-ps", device="jax")
+    plants = [(8 * TILE - 1, b"na"), (9 * TILE + 5, b"clsalt")]
+    targets = []
+    for idx, salt in plants:
+        d = cpu.hash_batch([gen.candidate(idx)],
+                           params={"salt": salt})[0]
+        targets.append(cpu.parse_target(d.hex() + ":" + salt.decode()))
+    unit = WorkUnit(0, 0, 12 * TILE)
+    w = dev.make_mask_worker(gen, targets, batch=TILE,
+                             hit_capacity=8, oracle=cpu)
+    assert isinstance(w, PallasSaltedMaskWorker)
+    got = _hits(w.process(unit))
+    assert {(t, i) for t, i, _ in got} == {(0, 8 * TILE - 1),
+                                          (1, 9 * TILE + 5)}
+    assert any(sb > TILE for _, sb in w._wide_ksteps), \
+        "wide salted kernels never engaged"
+    monkeypatch.setenv("DPRF_SUPERSTEP", "0")
+    w2 = dev.make_mask_worker(gen, targets, batch=TILE,
+                              hit_capacity=8, oracle=cpu)
+    assert got == _hits(w2.process(unit))
+    assert not w2._wide_ksteps
