@@ -1,0 +1,159 @@
+"""Kerberos 5 etype-23 (RC4-HMAC) engines: TGS-REP and AS-REP tickets.
+
+Kerberoasting / AS-REP-roasting — the hashcat 13100 / 18200 modes a
+hashcat-class framework is expected to carry (SURVEY.md §A fixes only
+the five acceptance engines; these extend the same HashEngine plugin
+surface, reference file:line citations impossible — empty mount).
+
+RFC 4757 (the RC4-HMAC Kerberos encryption type):
+
+    K  = NTLM(password) = MD4(UTF-16LE(password))
+    K1 = HMAC-MD5(K, msg_type)      msg_type: 4-byte LE, 2=TGS, 8=AS-REP
+    K3 = HMAC-MD5(K1, checksum)
+    plaintext = RC4(K3, edata2)
+    valid  <=>  HMAC-MD5(K1, plaintext) == checksum
+
+The oracle computes the full RFC chain; `hash_batch` returns the
+recomputed checksum so `digest == target.digest` is the standard
+compare.  The device path (engines/device/krb5.py) instead checks the
+DER header of the decrypted ticket — deterministic given len(edata2) —
+and relies on coordinator oracle verification for the final say.
+"""
+
+from __future__ import annotations
+
+import hmac as _hmac
+from typing import Optional, Sequence
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.base import HashEngine, Target
+
+#: RFC 4757 message-type constants (4-byte little-endian HMAC input).
+TGS_MSG_TYPE = 2
+ASREP_MSG_TYPE = 8
+
+#: edata2 must at least hold a DER header + HMAC'able content.
+MIN_EDATA = 16
+
+
+def rc4(key: bytes, data: bytes) -> bytes:
+    """Plain RC4 (KSA + PRGA) — the oracle-side stream cipher."""
+    S = list(range(256))
+    j = 0
+    for i in range(256):
+        j = (j + S[i] + key[i % len(key)]) & 0xFF
+        S[i], S[j] = S[j], S[i]
+    out = bytearray(len(data))
+    i = j = 0
+    for t, c in enumerate(data):
+        i = (i + 1) & 0xFF
+        j = (j + S[i]) & 0xFF
+        S[i], S[j] = S[j], S[i]
+        out[t] = c ^ S[(S[i] + S[j]) & 0xFF]
+    return bytes(out)
+
+
+def krb5_rc4_checksum(password: bytes, msg_type: int, checksum: bytes,
+                      edata: bytes) -> bytes:
+    """Recompute the ticket checksum for one candidate (RFC 4757)."""
+    from dprf_tpu.engines.cpu.engines import _md4_utf16
+    nt = _md4_utf16(password)
+    k1 = _hmac.new(nt, msg_type.to_bytes(4, "little"), "md5").digest()
+    k3 = _hmac.new(k1, checksum, "md5").digest()
+    plain = rc4(k3, edata)
+    return _hmac.new(k1, plain, "md5").digest()
+
+
+def _checksum_edata(fields: list[str], what: str) -> tuple[bytes, bytes]:
+    """Decode the trailing checksum/edata2 hex fields of a krb5 line."""
+    chk_hex, edata_hex = fields
+    checksum = bytes.fromhex(chk_hex)
+    edata = bytes.fromhex(edata_hex)
+    if len(checksum) != 16:
+        raise ValueError(f"{what}: checksum must be 16 bytes, "
+                         f"got {len(checksum)}")
+    if len(edata) < MIN_EDATA:
+        raise ValueError(f"{what}: edata2 is {len(edata)} bytes "
+                         f"(< {MIN_EDATA}) — truncated line?")
+    return checksum, edata
+
+
+def parse_krb5tgs(text: str) -> tuple[bytes, bytes]:
+    """``$krb5tgs$23$*user$realm$spn*$checksum$edata2`` (the starred
+    account metadata is optional) -> (checksum, edata2)."""
+    t = text.strip()
+    if not t.startswith("$krb5tgs$23$"):
+        raise ValueError(f"not a $krb5tgs$23$ line: {text[:40]!r}")
+    rest = t[len("$krb5tgs$23$"):]
+    if rest.startswith("*"):
+        meta, sep, rest = rest[1:].partition("*$")
+        if not sep:
+            raise ValueError(f"unterminated account metadata: {text[:60]!r}")
+    fields = rest.split("$")
+    if len(fields) != 2:
+        raise ValueError(f"expected checksum$edata2, got "
+                         f"{len(fields)} fields: {text[:60]!r}")
+    return _checksum_edata(fields, "krb5tgs")
+
+
+def parse_krb5asrep(text: str) -> tuple[bytes, bytes]:
+    """``$krb5asrep$23$user@realm:checksum$edata2`` (the account part
+    before ':' is optional) -> (checksum, edata2)."""
+    t = text.strip()
+    if not t.startswith("$krb5asrep$"):
+        raise ValueError(f"not a $krb5asrep$ line: {text[:40]!r}")
+    rest = t[len("$krb5asrep$"):]
+    if rest.startswith("23$"):
+        rest = rest[len("23$"):]
+    head, _, edata_hex = rest.rpartition("$")
+    _, _, chk_hex = head.rpartition(":")
+    return _checksum_edata([chk_hex, edata_hex], "krb5asrep")
+
+
+class _Krb5Rc4Engine(HashEngine):
+    """Shared RFC 4757 oracle; subclasses fix msg_type + line format."""
+
+    digest_size = 16
+    salted = True
+    max_candidate_len = 27      # NTLM single-block UTF-16LE limit
+    _msg_type: int = 0
+
+    def _parse(self, text: str) -> tuple[bytes, bytes]:
+        raise NotImplementedError
+
+    def parse_target(self, text: str) -> Target:
+        checksum, edata = self._parse(text)
+        return Target(raw=text.strip(), digest=checksum,
+                      params={"checksum": checksum, "edata": edata,
+                              "msg_type": self._msg_type})
+
+    def hash_batch(self, candidates: Sequence[bytes],
+                   params: Optional[dict] = None) -> list[bytes]:
+        if not params:
+            raise ValueError(f"{self.name} needs target params "
+                             "(checksum + edata2)")
+        return [krb5_rc4_checksum(c, params["msg_type"],
+                                  params["checksum"], params["edata"])
+                for c in candidates]
+
+
+@register("krb5tgs")
+class Krb5TgsEngine(_Krb5Rc4Engine):
+    """Kerberos 5 TGS-REP etype 23, 'Kerberoasting' (hashcat 13100)."""
+
+    name = "krb5tgs"
+    _msg_type = TGS_MSG_TYPE
+
+    def _parse(self, text: str) -> tuple[bytes, bytes]:
+        return parse_krb5tgs(text)
+
+
+@register("krb5asrep")
+class Krb5AsRepEngine(_Krb5Rc4Engine):
+    """Kerberos 5 AS-REP etype 23, 'AS-REP roasting' (hashcat 18200)."""
+
+    name = "krb5asrep"
+    _msg_type = ASREP_MSG_TYPE
+
+    def _parse(self, text: str) -> tuple[bytes, bytes]:
+        return parse_krb5asrep(text)

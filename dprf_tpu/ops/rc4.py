@@ -1,0 +1,79 @@
+"""RC4 keystream prefix, vectorized over a candidate batch.
+
+Only the Kerberos etype-23 filter needs RC4 on device, and it needs
+just the FIRST FOUR keystream bytes (the DER header of the decrypted
+ticket is deterministic — see engines/device/krb5.py), so this op
+stops after the KSA plus a statically-unrolled 4-byte PRGA.
+
+TPU mapping: the 256-byte S state lives as an int32[B, 256] array —
+swaps at the loop counter are dynamic column slices (the counter is
+uniform across lanes), while the data-dependent j side is a per-lane
+`take_along_axis` gather + one-position scatter, the same
+batch-dimension pattern as the bcrypt S-boxes.  RC4's KSA is
+inherently sequential (256 chained swaps), so the loop body is a
+`lax.fori_loop`; throughput comes from the batch dimension.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _swap(S: jnp.ndarray, i, si: jnp.ndarray,
+          j: jnp.ndarray, sj: jnp.ndarray) -> jnp.ndarray:
+    """S[:, i], S[lane, j[lane]] = sj, si — correct when j == i for a
+    lane, because the per-lane scatter lands second."""
+    B = S.shape[0]
+    S = lax.dynamic_update_slice_in_dim(S, sj[:, None], i, axis=1)
+    return S.at[jnp.arange(B), j].set(si)
+
+
+def rc4_prefix4(key4: jnp.ndarray) -> jnp.ndarray:
+    """First 4 RC4 keystream bytes for 16-byte keys, packed LE.
+
+    key4: uint32[B, 4] (the key's little-endian words, e.g. an MD5
+    digest straight from `md5_compress`).  Returns uint32[B]:
+    k0 | k1<<8 | k2<<16 | k3<<24.
+    """
+    B = key4.shape[0]
+    shifts = jnp.asarray([0, 8, 16, 24], jnp.uint32)
+    key_bytes = ((key4[:, :, None] >> shifts[None, None, :]) &
+                 jnp.uint32(0xFF)).reshape(B, 16).astype(jnp.int32)
+
+    S0 = jnp.broadcast_to(jnp.arange(256, dtype=jnp.int32),
+                          (B, 256))
+    j0 = jnp.zeros((B,), jnp.int32)
+
+    def ksa(i, carry):
+        S, j = carry
+        si = lax.dynamic_slice_in_dim(S, i, 1, axis=1)[:, 0]
+        ki = lax.dynamic_slice_in_dim(key_bytes, i % 16, 1,
+                                      axis=1)[:, 0]
+        j = (j + si + ki) & 255
+        sj = jnp.take_along_axis(S, j[:, None], axis=1)[:, 0]
+        return _swap(S, i, si, j, sj), j
+
+    S, _ = lax.fori_loop(0, 256, ksa, (S0, j0))
+
+    j = jnp.zeros((B,), jnp.int32)
+    word = jnp.zeros((B,), jnp.uint32)
+    for t in range(4):              # PRGA, static i = t + 1
+        i = t + 1
+        si = S[:, i]
+        j = (j + si) & 255
+        sj = jnp.take_along_axis(S, j[:, None], axis=1)[:, 0]
+        S = _swap(S, i, si, j, sj)
+        k = jnp.take_along_axis(S, ((si + sj) & 255)[:, None],
+                                axis=1)[:, 0]
+        word = word | (k.astype(jnp.uint32) << (8 * t))
+    return word
+
+
+def rc4_prefix4_reference(key: bytes) -> int:
+    """Host-side oracle for tests: same packed LE word from pure
+    Python RC4 (engines/cpu/krb5.py)."""
+    from dprf_tpu.engines.cpu.krb5 import rc4
+    ks = rc4(key, bytes(4))
+    return int.from_bytes(ks, "little")

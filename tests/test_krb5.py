@@ -1,0 +1,208 @@
+"""Kerberos etype-23 (krb5tgs 13100 / krb5asrep 18200): RC4 vectors,
+oracle round-trip, DER-header filter math, device RC4 vs reference,
+workers (mask/wordlist/sharded), parsing."""
+
+import hmac as hmac_mod
+import random
+
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.engines.cpu.krb5 import (ASREP_MSG_TYPE, TGS_MSG_TYPE,
+                                       krb5_rc4_checksum, parse_krb5asrep,
+                                       parse_krb5tgs, rc4)
+from dprf_tpu.engines.cpu.md4 import md4
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+def test_rc4_published_vectors():
+    # Classic test vector (appears in the original cypherpunks posting)
+    assert rc4(b"Key", b"Plaintext").hex() == "bbf316e8d940af0ad3"
+    # RFC 6229, 128-bit key: first 16 keystream bytes
+    key = bytes.fromhex("0102030405060708090a0b0c0d0e0f10")
+    ks = rc4(key, bytes(16))
+    assert ks.hex() == "9ac7cc9a609d1ef7b2932899cde41b97"
+
+
+def _der_wrap(tag: int, content: bytes) -> bytes:
+    C = len(content)
+    if C < 0x80:
+        return bytes([tag, C]) + content
+    if C <= 0xFF:
+        return bytes([tag, 0x81, C]) + content
+    if C <= 0xFFFF:
+        return bytes([tag, 0x82, C >> 8, C & 0xFF]) + content
+    return bytes([tag, 0x83, C >> 16, (C >> 8) & 0xFF, C & 0xFF]) + content
+
+
+def _ticket(password: bytes, msg_type: int, body_len: int,
+            tag: int) -> tuple[bytes, bytes, bytes]:
+    """Build a VALID (checksum, edata2, plaintext) triple by running
+    RFC 4757 forward from a DER-framed plaintext."""
+    rng = random.Random(body_len * 1000 + msg_type)
+    body = bytes(rng.randrange(256) for _ in range(body_len))
+    plain = _der_wrap(tag, _der_wrap(0x30, body))
+    nt = md4(password.decode("latin-1").encode("utf-16-le"))
+    k1 = hmac_mod.new(nt, msg_type.to_bytes(4, "little"), "md5").digest()
+    checksum = hmac_mod.new(k1, plain, "md5").digest()
+    k3 = hmac_mod.new(k1, checksum, "md5").digest()
+    return checksum, rc4(k3, plain), plain
+
+
+def _tgs_line(password: bytes, body_len: int = 300) -> str:
+    chk, edata, _ = _ticket(password, TGS_MSG_TYPE, body_len, 0x63)
+    return f"$krb5tgs$23$*svc$EXAMPLE.COM$http/web*${chk.hex()}${edata.hex()}"
+
+
+def _asrep_line(password: bytes, body_len: int = 200) -> str:
+    chk, edata, _ = _ticket(password, ASREP_MSG_TYPE, body_len, 0x79)
+    return f"$krb5asrep$23$user@EXAMPLE.COM:{chk.hex()}${edata.hex()}"
+
+
+@pytest.mark.smoke
+def test_oracle_roundtrip_and_parse():
+    pw = b"Winter2024"
+    line = _tgs_line(pw)
+    chk, edata = parse_krb5tgs(line)
+    assert krb5_rc4_checksum(pw, TGS_MSG_TYPE, chk, edata) == chk
+    assert krb5_rc4_checksum(b"wrong", TGS_MSG_TYPE, chk, edata) != chk
+
+    cpu = get_engine("krb5tgs", "cpu")
+    t = cpu.parse_target(line)
+    assert cpu.verify(pw, t) and not cpu.verify(b"nope", t)
+
+    cpu_as = get_engine("krb5asrep", "cpu")
+    t2 = cpu_as.parse_target(_asrep_line(pw))
+    assert cpu_as.verify(pw, t2) and not cpu_as.verify(b"nope", t2)
+
+
+def test_parse_variants_and_errors():
+    pw = b"x"
+    chk, edata, _ = _ticket(pw, TGS_MSG_TYPE, 300, 0x63)
+    # no account-metadata block
+    bare = f"$krb5tgs$23${chk.hex()}${edata.hex()}"
+    assert parse_krb5tgs(bare) == (chk, edata)
+    with pytest.raises(ValueError):
+        parse_krb5tgs("$krb5tgs$18$aes-etype-not-supported$00")
+    with pytest.raises(ValueError):
+        parse_krb5tgs(f"$krb5tgs$23$*unterminated${chk.hex()}${edata.hex()}")
+    with pytest.raises(ValueError):
+        parse_krb5asrep("not-a-krb5-line")
+    # asrep without the account field
+    chk2, edata2, _ = _ticket(pw, ASREP_MSG_TYPE, 80, 0x79)
+    assert parse_krb5asrep(
+        f"$krb5asrep$23${chk2.hex()}${edata2.hex()}") == (chk2, edata2)
+
+
+@pytest.mark.parametrize("body_len,form", [(60, "short"), (180, "0x81"),
+                                           (400, "0x82"),
+                                           (70_000, "0x83")])
+def test_der_filter_matches_real_plaintext(body_len, form):
+    """The masked 4-byte expectation must MATCH the true plaintext for
+    every DER length form (a filter miss is a false negative)."""
+    from dprf_tpu.engines.device.krb5 import der_filter_words
+
+    for msg_type, tag in ((TGS_MSG_TYPE, 0x63), (ASREP_MSG_TYPE, 0x79),
+                          (ASREP_MSG_TYPE, 0x7A)):
+        _, edata, plain = _ticket(b"pw", msg_type, body_len, tag)
+        expected, mask = der_filter_words(len(edata), msg_type)
+        first4 = int.from_bytes(plain[:4], "little")
+        assert (first4 & mask) == expected, (form, hex(tag))
+
+
+def test_device_rc4_prefix_matches_reference():
+    import numpy as np
+    import jax.numpy as jnp
+
+    from dprf_tpu.ops.rc4 import rc4_prefix4, rc4_prefix4_reference
+
+    rng = random.Random(7)
+    keys = [bytes(rng.randrange(256) for _ in range(16))
+            for _ in range(32)]
+    key4 = np.frombuffer(b"".join(keys), "<u4").reshape(32, 4)
+    got = np.asarray(rc4_prefix4(jnp.asarray(key4)))
+    want = [rc4_prefix4_reference(k) for k in keys]
+    assert got.tolist() == want
+
+
+@pytest.mark.smoke
+@pytest.mark.parametrize("name,line_fn", [("krb5tgs", _tgs_line),
+                                          ("krb5asrep", _asrep_line)])
+def test_mask_worker_end_to_end(name, line_fn):
+    dev = get_engine(name, "jax")
+    cpu = get_engine(name, "cpu")
+    gen = MaskGenerator("?l?d?l")
+    secret = gen.candidate(3333)
+    t = dev.parse_target(line_fn(secret))
+    w = dev.make_mask_worker(gen, [t], batch=2048, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.cand_index, h.plaintext)
+            for h in hits] == [(0, 3333, secret)]
+
+
+@pytest.mark.parametrize("body_len", [60, 180, 400])
+def test_mask_worker_every_der_form(body_len):
+    dev = get_engine("krb5tgs", "jax")
+    cpu = get_engine("krb5tgs", "cpu")
+    gen = MaskGenerator("?d?d?d")
+    secret = gen.candidate(512)
+    t = dev.parse_target(_tgs_line(secret, body_len=body_len))
+    w = dev.make_mask_worker(gen, [t], batch=512, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.cand_index) for h in hits] == [(0, 512)]
+
+
+def test_wordlist_worker():
+    from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+    from dprf_tpu.rules.parser import parse_rule
+
+    dev = get_engine("krb5tgs", "jax")
+    cpu = get_engine("krb5tgs", "cpu")
+    words = [b"autumn", b"spring"]
+    rules = [parse_rule(":"), parse_rule("c $9")]
+    gen = WordlistRulesGenerator(words, rules, max_len=20)
+    secret = b"Spring9"
+    t = dev.parse_target(_tgs_line(secret))
+    w = dev.make_wordlist_worker(gen, [t], batch=16, hit_capacity=8,
+                                 oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
+
+
+def test_sharded_worker():
+    import jax
+
+    from dprf_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) >= 8
+    dev = get_engine("krb5asrep", "jax")
+    cpu = get_engine("krb5asrep", "cpu")
+    gen = MaskGenerator("?d?l")
+    secret = gen.candidate(117)
+    t = dev.parse_target(_asrep_line(secret))
+    w = dev.make_sharded_mask_worker(gen, [t], make_mesh(8),
+                                     batch_per_device=32, hit_capacity=8,
+                                     oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
+
+
+def test_multi_target_sweep_and_engine_listing():
+    cpu = get_engine("krb5tgs", "cpu")
+    dev = get_engine("krb5tgs", "jax")
+    gen = MaskGenerator("?d?d?d")
+    secrets = [gen.candidate(12), gen.candidate(900)]
+    targets = [dev.parse_target(_tgs_line(s, body_len=100 + 50 * i))
+               for i, s in enumerate(secrets)]
+    w = dev.make_mask_worker(gen, targets, batch=512, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert sorted((h.target_index, h.plaintext) for h in hits) == \
+        [(0, secrets[0]), (1, secrets[1])]
+
+    from dprf_tpu.engines import engine_names
+    for name in ("krb5tgs", "krb5asrep"):
+        assert name in engine_names("cpu") and name in engine_names("jax")

@@ -56,6 +56,7 @@ WORKDIR = os.environ.get("TPU_SESSION_WORKDIR", "/tmp/tpu_session_stages")
 DEADLINES = {
     "kernels": 900,
     "bench_fast": 1500,
+    "bench_r4b": 1500,
     "config1": 600,
     "config2": 600,
     "config3": 900,
@@ -229,6 +230,12 @@ def stage_bench_fast(io: StageIO):
                                batch=1 << 22)),
         ("sha256-xla", dict(engine="sha256", impl="xla", batch=1 << 21)),
     ]
+    _run_bench_list(io, runs)
+
+
+def _run_bench_list(io: StageIO, runs) -> None:
+    """Calibrate+measure each (name, run_bench kwargs) pair, recording
+    errors per case so one failure doesn't sink the stage."""
     for name, kw in runs:
         io.status(name, phase="calibrate+measure")
         try:
@@ -237,6 +244,29 @@ def stage_bench_fast(io: StageIO):
             res = {"error": f"{type(e).__name__}: {e}",
                    "traceback": traceback.format_exc()[-1500:]}
         io.record(name, res)
+
+
+def stage_bench_r4b(io: StageIO):
+    """Round-4b kernel families (SHA-512/384 pair-arithmetic cores,
+    Keccak/SHA3 sponge kernels) plus their XLA pipelines for the
+    speedup denominator -- the BASELINE.md 'round 4b additions'
+    predictions, measured."""
+    runs = [
+        ("sha512-pallas", dict(engine="sha512", impl="pallas",
+                               batch=1 << 22)),
+        ("sha384-pallas", dict(engine="sha384", impl="pallas",
+                               batch=1 << 22)),
+        ("sha512-xla", dict(engine="sha512", impl="xla", batch=1 << 20)),
+        ("sha3-256-pallas", dict(engine="sha3-256", impl="pallas",
+                                 batch=1 << 22)),
+        ("keccak-256-pallas", dict(engine="keccak-256", impl="pallas",
+                                   batch=1 << 22)),
+        ("sha3-512-pallas", dict(engine="sha3-512", impl="pallas",
+                                 batch=1 << 22)),
+        ("sha3-256-xla", dict(engine="sha3-256", impl="xla",
+                              batch=1 << 20)),
+    ]
+    _run_bench_list(io, runs)
 
 
 #: per-config run_config kwargs: batch sized so one worker stride is
@@ -496,6 +526,7 @@ def stage_rules_kernel(io: StageIO):
 STAGES = {
     "kernels": stage_kernels,
     "bench_fast": stage_bench_fast,
+    "bench_r4b": stage_bench_r4b,
     "sweep": stage_sweep,
     "ext_kernels": stage_ext_kernels,
     "rules_kernel": stage_rules_kernel,
