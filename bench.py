@@ -71,6 +71,20 @@ for impl, batch in (("pallas", 1 << 22), ("xla", 1 << 22)):
     except Exception as e:
         out[impl] = {{"error": f"{{type(e).__name__}}: {{e}}"}}
     save()
+
+# The production worker path (config 1 through run_config) is now the
+# FASTEST md5 path: the wide-step dispatch fuses a whole multi-batch
+# WorkUnit into one kernel program, beating the looped-step bench
+# above (r4 session: 4.9 vs 3.6 GH/s).  Measure it too and let the
+# headline pick the best.
+try:
+    from dprf_tpu.bench import run_config
+    rec = run_config(1, device="jax", seconds=15.0, batch=1 << 22,
+                     unit_strides=64)
+    rec["impl"] = "worker-wide"
+    out["worker"] = rec
+except Exception as e:
+    out["worker"] = {{"error": f"{{type(e).__name__}}: {{e}}"}}
 save(done=True)
 """
 

@@ -32,8 +32,9 @@ from dprf_tpu.engines.base import HashEngine, Target
 TGS_MSG_TYPE = 2
 ASREP_MSG_TYPE = 8
 
-#: edata2 must at least hold a DER header + HMAC'able content.
-MIN_EDATA = 16
+#: edata2 must at least hold the 8-byte confounder + a DER header +
+#: HMAC'able content.
+MIN_EDATA = 24
 
 
 def rc4(key: bytes, data: bytes) -> bytes:
@@ -103,8 +104,14 @@ def parse_krb5asrep(text: str) -> tuple[bytes, bytes]:
     if not t.startswith("$krb5asrep$"):
         raise ValueError(f"not a $krb5asrep$ line: {text[:40]!r}")
     rest = t[len("$krb5asrep$"):]
-    if rest.startswith("23$"):
-        rest = rest[len("23$"):]
+    etype, sep, after = rest.partition("$")
+    if sep and etype.isdigit():
+        # explicit etype field: only RC4-HMAC (23) is this engine
+        if etype != "23":
+            raise ValueError(f"$krb5asrep$ etype {etype} is not "
+                             "RC4-HMAC (23) — AES etypes need a "
+                             "different engine")
+        rest = after
     head, _, edata_hex = rest.rpartition("$")
     _, _, chk_hex = head.rpartition(":")
     return _checksum_edata([chk_hex, edata_hex], "krb5asrep")

@@ -2,13 +2,14 @@
 
 Full RFC 4757 verification needs RC4 over the WHOLE multi-KB ticket
 plus HMAC-MD5 over the plaintext — per candidate.  The device path
-avoids all of it: the decrypted ticket begins with a DER header
-([APPLICATION n] + length + SEQUENCE + length) whose four bytes are
-DETERMINISTIC given len(edata2), so the filter is
+avoids all of it: the plaintext is confounder(8 random bytes) || DER
+ticket, and the DER header at offset 8 ([APPLICATION n] + length +
+SEQUENCE + length) is DETERMINISTIC given len(edata2) - 8, so the
+filter is
 
     NTLM -> K1 -> K3 (two constant-message HMAC-MD5s, shared with
-    netntlmv2) -> RC4 KSA + 4 keystream bytes (ops/rc4.py) ->
-    (first4 ^ cipher4) & mask == expected
+    netntlmv2) -> RC4 KSA + 12 keystream bytes (ops/rc4.py) ->
+    (keystream[8:12] ^ edata2[8:12]) & mask == expected
 
 an exact masked 32-bit compare.  False-positive odds are ~2^-32 per
 candidate per target (~2^-30 for AS-REP's relaxed tag byte); the
@@ -39,17 +40,21 @@ from dprf_tpu.engines.device.phpass import (PhpassMaskWorker,
 from dprf_tpu.ops import compare as cmp_ops
 from dprf_tpu.ops import pack as pack_ops
 from dprf_tpu.ops.md4 import md4_digest_words
-from dprf_tpu.ops.rc4 import rc4_prefix4
+from dprf_tpu.ops.rc4 import rc4_keystream_words
+
+#: RFC 4757 prepends 8 random confounder bytes before the DER ticket;
+#: the predictable header lives at plaintext offset CONF.
+CONF = 8
 
 
 def der_filter_words(edata_len: int, msg_type: int) -> tuple[int, int]:
-    """(expected, mask) little-endian uint32 over the first four
-    plaintext bytes.
+    """(expected, mask) little-endian uint32 over plaintext bytes
+    [8, 12) — the DER header right after the confounder.
 
     DER framing of the decrypted ticket: [APPLICATION n] tag, outer
-    length of C = edata_len - header, then SEQUENCE (0x30) and its
-    length.  DER's definite minimal-length rule fixes the outer form
-    from C alone, and the inner SEQUENCE fills the rest of the window:
+    length of C = (edata_len - 8) - header, then SEQUENCE (0x30) and
+    its length.  DER's definite minimal-length rule fixes the outer
+    form from C alone, and the inner SEQUENCE fills the window:
 
       C < 0x80:        [tag,   C, 0x30, C-2]   (inner short form too)
       C <= 0xFF:       [tag, 0x81,   C, 0x30]
@@ -65,7 +70,7 @@ def der_filter_words(edata_len: int, msg_type: int) -> tuple[int, int]:
         tag_exp, tag_mask = 0x63, 0xFF
     else:
         tag_exp, tag_mask = 0x78, 0xFC
-    L = edata_len
+    L = edata_len - CONF            # DER blob length
     if L - 2 < 0x80:
         exp = [tag_exp, L - 2, 0x30, L - 4]
         msk = [tag_mask, 0xFF, 0xFF, 0xFF]
@@ -83,8 +88,9 @@ def der_filter_words(edata_len: int, msg_type: int) -> tuple[int, int]:
     else:
         # a >16 MB ticket is not a ticket; a silent filter miss would
         # be a false NEGATIVE, so refuse loudly (--device=cpu works)
-        raise ValueError(f"edata2 of {L} bytes exceeds the DER header "
-                         "forms the device filter predicts")
+        raise ValueError(f"DER blob of {L} bytes (edata2 minus "
+                         "confounder) exceeds the header forms the "
+                         "device filter predicts")
     pack = lambda bs: sum(b << (8 * t) for t, b in enumerate(bs))
     return pack(exp) & pack(msk), pack(msk)
 
@@ -92,17 +98,18 @@ def der_filter_words(edata_len: int, msg_type: int) -> tuple[int, int]:
 def krb5_filter_batch(cand: jnp.ndarray, lens: jnp.ndarray,
                       type_blocks, type_n, chk_blocks, chk_n,
                       cipher4, mask) -> jnp.ndarray:
-    """Candidates -> masked first-4-plaintext-bytes word uint32[B, 1].
+    """Candidates -> masked plaintext-bytes-[8,12) word uint32[B, 1].
 
-    cipher4: uint32[1] — first 4 edata2 bytes (LE); mask: uint32[1].
-    The step's target word is the DER expectation from
-    `der_filter_words`, already masked."""
+    cipher4: uint32[1] — edata2 bytes [8, 12) (LE), past the
+    confounder; mask: uint32[1].  The step's target word is the DER
+    expectation from `der_filter_words`, already masked."""
     wide = pack_ops.utf16le_widen(cand)
     nt = md4_digest_words(pack_ops.pack_varlen(wide, lens * 2,
                                                big_endian=False))
     k1 = _hmac_md5_const_msg(nt, type_blocks, type_n)
     k3 = _hmac_md5_const_msg(k1, chk_blocks, chk_n)
-    plain4 = rc4_prefix4(k3) ^ cipher4[0]
+    ks = rc4_keystream_words(k3, (CONF + 4) // 4)
+    plain4 = ks[:, CONF // 4] ^ cipher4[0]
     return (plain4 & mask[0])[:, None]
 
 
@@ -174,7 +181,7 @@ def _targs(targets: Sequence[Target]):
         cw, cn = hmac_msg_blocks(p["checksum"], 1, what="checksum")
         expected, mask = der_filter_words(len(p["edata"]),
                                           p["msg_type"])
-        cipher4 = int.from_bytes(p["edata"][:4], "little")
+        cipher4 = int.from_bytes(p["edata"][CONF:CONF + 4], "little")
         out.append((jnp.asarray(tw), jnp.int32(tn),
                     jnp.asarray(cw), jnp.int32(cn),
                     jnp.asarray([cipher4], jnp.uint32),

@@ -1,9 +1,10 @@
 """RC4 keystream prefix, vectorized over a candidate batch.
 
 Only the Kerberos etype-23 filter needs RC4 on device, and it needs
-just the FIRST FOUR keystream bytes (the DER header of the decrypted
-ticket is deterministic — see engines/device/krb5.py), so this op
-stops after the KSA plus a statically-unrolled 4-byte PRGA.
+just the first FEW keystream words (the DER header of the decrypted
+ticket sits at offset 8, after RFC 4757's random confounder, and is
+deterministic — see engines/device/krb5.py), so this op stops after
+the KSA plus a statically-unrolled short PRGA.
 
 TPU mapping: the 256-byte S state lives as an int32[B, 256] array —
 swaps at the loop counter are dynamic column slices (the counter is
@@ -30,12 +31,12 @@ def _swap(S: jnp.ndarray, i, si: jnp.ndarray,
     return S.at[jnp.arange(B), j].set(si)
 
 
-def rc4_prefix4(key4: jnp.ndarray) -> jnp.ndarray:
-    """First 4 RC4 keystream bytes for 16-byte keys, packed LE.
+def rc4_keystream_words(key4: jnp.ndarray, nwords: int) -> jnp.ndarray:
+    """First `nwords` 32-bit RC4 keystream words for 16-byte keys.
 
     key4: uint32[B, 4] (the key's little-endian words, e.g. an MD5
-    digest straight from `md5_compress`).  Returns uint32[B]:
-    k0 | k1<<8 | k2<<16 | k3<<24.
+    digest straight from `md5_compress`).  Returns uint32[B, nwords],
+    each word packing 4 keystream bytes LE (byte 4w+t at shift 8t).
     """
     B = key4.shape[0]
     shifts = jnp.asarray([0, 8, 16, 24], jnp.uint32)
@@ -58,8 +59,9 @@ def rc4_prefix4(key4: jnp.ndarray) -> jnp.ndarray:
     S, _ = lax.fori_loop(0, 256, ksa, (S0, j0))
 
     j = jnp.zeros((B,), jnp.int32)
+    words = []
     word = jnp.zeros((B,), jnp.uint32)
-    for t in range(4):              # PRGA, static i = t + 1
+    for t in range(4 * nwords):     # PRGA, static i = t + 1
         i = t + 1
         si = S[:, i]
         j = (j + si) & 255
@@ -67,13 +69,17 @@ def rc4_prefix4(key4: jnp.ndarray) -> jnp.ndarray:
         S = _swap(S, i, si, j, sj)
         k = jnp.take_along_axis(S, ((si + sj) & 255)[:, None],
                                 axis=1)[:, 0]
-        word = word | (k.astype(jnp.uint32) << (8 * t))
-    return word
+        word = word | (k.astype(jnp.uint32) << (8 * (t % 4)))
+        if t % 4 == 3:
+            words.append(word)
+            word = jnp.zeros((B,), jnp.uint32)
+    return jnp.stack(words, axis=1)
 
 
-def rc4_prefix4_reference(key: bytes) -> int:
-    """Host-side oracle for tests: same packed LE word from pure
+def rc4_keystream_words_reference(key: bytes, nwords: int) -> list[int]:
+    """Host-side oracle for tests: same packed LE words from pure
     Python RC4 (engines/cpu/krb5.py)."""
     from dprf_tpu.engines.cpu.krb5 import rc4
-    ks = rc4(key, bytes(4))
-    return int.from_bytes(ks, "little")
+    ks = rc4(key, bytes(4 * nwords))
+    return [int.from_bytes(ks[4 * w:4 * w + 4], "little")
+            for w in range(nwords)]

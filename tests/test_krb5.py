@@ -39,10 +39,12 @@ def _der_wrap(tag: int, content: bytes) -> bytes:
 def _ticket(password: bytes, msg_type: int, body_len: int,
             tag: int) -> tuple[bytes, bytes, bytes]:
     """Build a VALID (checksum, edata2, plaintext) triple by running
-    RFC 4757 forward from a DER-framed plaintext."""
+    RFC 4757 forward: plaintext = 8-byte random confounder || DER
+    ticket, exactly as a real KDC emits."""
     rng = random.Random(body_len * 1000 + msg_type)
     body = bytes(rng.randrange(256) for _ in range(body_len))
-    plain = _der_wrap(tag, _der_wrap(0x30, body))
+    confounder = bytes(rng.randrange(256) for _ in range(8))
+    plain = confounder + _der_wrap(tag, _der_wrap(0x30, body))
     nt = md4(password.decode("latin-1").encode("utf-16-le"))
     k1 = hmac_mod.new(nt, msg_type.to_bytes(4, "little"), "md5").digest()
     checksum = hmac_mod.new(k1, plain, "md5").digest()
@@ -93,6 +95,10 @@ def test_parse_variants_and_errors():
     chk2, edata2, _ = _ticket(pw, ASREP_MSG_TYPE, 80, 0x79)
     assert parse_krb5asrep(
         f"$krb5asrep$23${chk2.hex()}${edata2.hex()}") == (chk2, edata2)
+    # AES etypes must be rejected loudly, not cracked-to-exhaustion
+    with pytest.raises(ValueError):
+        parse_krb5asrep(
+            f"$krb5asrep$17$user@REALM:{chk2.hex()}${edata2.hex()}")
 
 
 @pytest.mark.parametrize("body_len,form", [(60, "short"), (180, "0x81"),
@@ -107,22 +113,24 @@ def test_der_filter_matches_real_plaintext(body_len, form):
                           (ASREP_MSG_TYPE, 0x7A)):
         _, edata, plain = _ticket(b"pw", msg_type, body_len, tag)
         expected, mask = der_filter_words(len(edata), msg_type)
-        first4 = int.from_bytes(plain[:4], "little")
-        assert (first4 & mask) == expected, (form, hex(tag))
+        # the DER header sits AFTER the 8-byte confounder
+        hdr4 = int.from_bytes(plain[8:12], "little")
+        assert (hdr4 & mask) == expected, (form, hex(tag))
 
 
 def test_device_rc4_prefix_matches_reference():
     import numpy as np
     import jax.numpy as jnp
 
-    from dprf_tpu.ops.rc4 import rc4_prefix4, rc4_prefix4_reference
+    from dprf_tpu.ops.rc4 import (rc4_keystream_words,
+                                  rc4_keystream_words_reference)
 
     rng = random.Random(7)
     keys = [bytes(rng.randrange(256) for _ in range(16))
             for _ in range(32)]
     key4 = np.frombuffer(b"".join(keys), "<u4").reshape(32, 4)
-    got = np.asarray(rc4_prefix4(jnp.asarray(key4)))
-    want = [rc4_prefix4_reference(k) for k in keys]
+    got = np.asarray(rc4_keystream_words(jnp.asarray(key4), 3))
+    want = [rc4_keystream_words_reference(k, 3) for k in keys]
     assert got.tolist() == want
 
 
