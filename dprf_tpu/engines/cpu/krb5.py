@@ -105,7 +105,9 @@ def parse_krb5asrep(text: str) -> tuple[bytes, bytes]:
         raise ValueError(f"not a $krb5asrep$ line: {text[:40]!r}")
     rest = t[len("$krb5asrep$"):]
     etype, sep, after = rest.partition("$")
-    if sep and etype.isdigit():
+    # an etype field is 1-2 digits; a 32-hex checksum that happens to
+    # be all-decimal must not be mistaken for one
+    if sep and etype.isdigit() and len(etype) <= 2:
         # explicit etype field: only RC4-HMAC (23) is this engine
         if etype != "23":
             raise ValueError(f"$krb5asrep$ etype {etype} is not "

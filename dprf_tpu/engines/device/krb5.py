@@ -199,6 +199,61 @@ class Krb5MaskWorker(PhpassMaskWorker):
         self.step = make_krb5_mask_step(gen, batch, hit_capacity)
 
 
+class PallasKrb5MaskWorker(PhpassMaskWorker):
+    """Mask sweep over the RC4 prefilter KERNEL (ops/pallas_krb5.py):
+    the XLA step's per-lane serial RC4 swaps measured 21 kH/s on chip
+    (TPU_RESULTS_r04 krb5-20); the kernel's sublane layout makes them
+    vector ops.  Target scalars are runtime, so one compiled kernel
+    serves the whole hashlist (both msg types).  Sweep loop, rescan,
+    and the hit contract come from PhpassMaskWorker."""
+
+    def __init__(self, engine, gen, targets, batch: int = 1 << 18,
+                 hit_capacity: int = 64, oracle=None,
+                 interpret: bool = False):
+        from dprf_tpu.ops import pallas_krb5
+
+        self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
+        tile = pallas_krb5.SUBC * pallas_krb5.CHUNKS
+        batch = max(tile, (batch // tile) * tile)
+        self.batch = self.stride = batch
+        self._targs = [pallas_krb5.target_scalars(t) for t in targets]
+        self.step = pallas_krb5.make_krb5_crack_step(
+            gen, batch, hit_capacity, interpret=interpret)
+
+    def warmup(self) -> None:
+        """One launch so Mosaic compile failures surface in the
+        factory (which then falls back to the XLA step), not mid-job."""
+        import jax.numpy as jnp
+
+        from dprf_tpu.utils.sync import hard_sync
+        base = jnp.asarray(self.gen.digits(0), dtype=jnp.int32)
+        hard_sync(self.step(base, jnp.int32(0), *self._targs[0]))
+
+
+def maybe_pallas_krb5_worker(engine, gen, targets, batch: int,
+                             hit_capacity: int, oracle):
+    """PallasKrb5MaskWorker when the job is kernel-eligible (warmed so
+    compile failures surface here), else None -> XLA-step worker."""
+    from dprf_tpu.ops import pallas_krb5
+    from dprf_tpu.ops.pallas_mask import pallas_mode
+    from dprf_tpu.utils.logging import DEFAULT as log
+
+    mode = pallas_mode()
+    if mode is None or not pallas_krb5.krb5_kernel_eligible(gen):
+        return None
+    try:
+        worker = PallasKrb5MaskWorker(
+            engine, gen, targets, batch=batch,
+            hit_capacity=hit_capacity, oracle=oracle,
+            interpret=mode.get("interpret", False))
+        worker.warmup()
+        return worker
+    except Exception as e:  # noqa: BLE001 -- compiler errors
+        log.warn("krb5 kernel failed to build/compile; using the "
+                 "XLA step", engine=engine.name, error=str(e))
+        return None
+
+
 class Krb5WordlistWorker(PhpassWordlistWorker):
     def __init__(self, engine, gen, targets, batch: int = 1 << 18,
                  hit_capacity: int = 64, oracle=None):
@@ -231,6 +286,10 @@ class ShardedKrb5MaskWorker(ShardedPhpassMaskWorker):
 class _JaxKrb5Mixin:
     def make_mask_worker(self, gen, targets, batch: int,
                          hit_capacity: int, oracle=None):
+        worker = maybe_pallas_krb5_worker(self, gen, targets, batch,
+                                          hit_capacity, oracle)
+        if worker is not None:
+            return worker
         return Krb5MaskWorker(self, gen, targets, batch=batch,
                               hit_capacity=hit_capacity, oracle=oracle)
 

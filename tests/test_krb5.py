@@ -99,6 +99,16 @@ def test_parse_variants_and_errors():
     with pytest.raises(ValueError):
         parse_krb5asrep(
             f"$krb5asrep$17$user@REALM:{chk2.hex()}${edata2.hex()}")
+    # ...but an all-decimal 32-char checksum is NOT an etype field
+    digit_chk = bytes.fromhex("12" * 16)
+    assert parse_krb5asrep(
+        f"$krb5asrep${digit_chk.hex()}${edata2.hex()}") == \
+        (digit_chk, edata2)
+    # packed-output tile limit is enforced, not silently corrupted
+    from dprf_tpu.ops import pallas_krb5
+    with pytest.raises(ValueError):
+        pallas_krb5.make_krb5_pallas_fn(MaskGenerator("?l?l?l"),
+                                        1 << 16, sub=32, chunks=2048)
 
 
 @pytest.mark.parametrize("body_len,form", [(60, "short"), (180, "0x81"),
@@ -196,6 +206,92 @@ def test_sharded_worker():
                                      oracle=cpu)
     hits = w.process(WorkUnit(0, 0, gen.keyspace))
     assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
+
+
+def test_rc4_unrolled_matches_loop_form():
+    """The two KSA forms of the kernel's RC4 op are bit-identical
+    (eager, no pallas_call: the unrolled graph is compiler-hostile --
+    it SIGABRTs Mosaic -- but its math must stay correct for future
+    toolchains)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from dprf_tpu.ops.pallas_krb5 import _rc4_word2
+    from dprf_tpu.ops.rc4 import rc4_keystream_words_reference
+
+    rng = random.Random(11)
+    keys = [bytes(rng.randrange(256) for _ in range(16))
+            for _ in range(8)]
+    key_np = np.frombuffer(b"".join(keys), "<u4").reshape(8, 4)
+    key4 = tuple(jnp.broadcast_to(
+        jnp.asarray(key_np[:, w])[:, None], (8, 128)).astype(jnp.uint32)
+        for w in range(4))
+    want = [rc4_keystream_words_reference(k, 3)[2] for k in keys]
+    for unroll in (False, True):
+        got = np.asarray(_rc4_word2(key4, (8, 128), unroll))[:, 0]
+        assert got.tolist() == want, f"unroll={unroll}"
+
+
+def test_pallas_kernel_matches_xla_filter():
+    """Interpret-mode kernel vs the XLA filter step over one batch:
+    identical found sets, planted hit at its exact index."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from dprf_tpu.engines.device.krb5 import _targs, krb5_filter_batch
+    from dprf_tpu.ops import pallas_krb5
+
+    gen = MaskGenerator("?l?l?l")
+    plant = 21
+    cpu = get_engine("krb5tgs", "cpu")
+    t = cpu.parse_target(_tgs_line(gen.candidate(plant)))
+    sub, chunks = 8, 2
+    tile = sub * chunks
+    batch = tile * 2                     # 2 grid cells, plant in cell 1
+    fn = pallas_krb5.make_krb5_pallas_fn(gen, batch, sub=sub,
+                                         chunks=chunks,
+                                         interpret=True)
+    base = jnp.asarray(gen.digits(0), jnp.int32)
+    counts, lanes = fn(base, jnp.asarray([batch], jnp.int32),
+                       *pallas_krb5.target_scalars(t))
+    counts = np.asarray(counts)[:, 0]
+    lanes = np.asarray(lanes)[:, 0]
+    hits = [ti * tile + lanes[ti] for ti in np.nonzero(counts)[0]]
+    assert hits == [plant] and counts.sum() == 1
+
+    # cross-check the whole batch against the XLA filter step
+    (tb, tn, cb, cn, c4, mk, ex) = _targs([t])[0]
+    cand = jnp.asarray(np.stack(
+        [np.frombuffer(gen.candidate(i).ljust(gen.length, b"\0"),
+                       np.uint8) for i in range(batch)]))
+    word = krb5_filter_batch(cand,
+                             jnp.full((batch,), gen.length, jnp.int32),
+                             tb, tn, cb, cn, c4, mk)
+    xla_found = np.asarray(word[:, 0] == ex[0])
+    assert xla_found.sum() == 1 and xla_found[plant]
+
+
+def test_pallas_worker_planted(monkeypatch):
+    """DPRF_PALLAS=1 routes make_mask_worker to the kernel worker
+    (interpret mode off-TPU); planted crack through the production
+    sweep, including the small-tile rescan contract."""
+    from dprf_tpu.engines.device import krb5 as dkrb5
+    from dprf_tpu.ops import pallas_krb5
+
+    monkeypatch.setenv("DPRF_PALLAS", "1")
+    monkeypatch.setattr(pallas_krb5, "SUBC", 8)
+    monkeypatch.setattr(pallas_krb5, "CHUNKS", 2)
+    dev = get_engine("krb5asrep", "jax")
+    cpu = get_engine("krb5asrep", "cpu")
+    gen = MaskGenerator("?d?d?l")
+    secret = gen.candidate(1517)
+    t = dev.parse_target(_asrep_line(secret))
+    w = dev.make_mask_worker(gen, [t], batch=64, hit_capacity=8,
+                             oracle=cpu)
+    assert type(w).__name__ == "PallasKrb5MaskWorker"
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.cand_index, h.plaintext)
+            for h in hits] == [(0, 1517, secret)]
 
 
 def test_multi_target_sweep_and_engine_listing():

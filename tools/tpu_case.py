@@ -30,7 +30,8 @@ OUT = os.environ.get("TPU_CASES_OUT", "/tmp/tpu_cases.jsonl")
 #: (tools/tpu_session.py) can validate a whole plan WITHOUT importing
 #: jax / touching the tunnel.
 KINDS = {"scrypt": 4, "bcrypt": 2, "bcryptchunk": 2, "pallaseks": 2,
-         "descrypt": 1, "pmkid": 1, "scanprobe": 2, "superstep": 3}
+         "descrypt": 1, "pmkid": 1, "scanprobe": 2, "superstep": 3,
+         "krb5": 1, "krb5cfg": 3}
 
 
 def case_valid(name: str) -> bool:
@@ -194,6 +195,92 @@ def run_case(name: str) -> dict:
         @jax.jit
         def run(b):
             return step(b, jnp.int32(B))[0]
+    elif kind == "krb5":
+        # krb5-<logB>: the Kerberos etype-23 DER-prefilter worker
+        # (NTLM -> HMAC-MD5 chain -> RC4 KSA, a fori_loop of per-lane
+        # gathers/scatters -- the shape whose TPU behavior is the open
+        # question).  Planted-crack proof on a small keyspace through
+        # the PRODUCTION worker, then a timed sweep; returns directly.
+        import hmac as hmac_mod
+
+        from dprf_tpu import get_engine
+        from dprf_tpu.engines.cpu.krb5 import TGS_MSG_TYPE, rc4
+        from dprf_tpu.engines.cpu.md4 import md4
+        from dprf_tpu.runtime.workunit import WorkUnit
+        B = 1 << int(parts[1])
+        eng = get_engine("krb5tgs", device="jax")
+        cpu = get_engine("krb5tgs", device="cpu")
+
+        def line(pw: bytes, fill: int) -> str:
+            body = bytes((fill + i) % 256 for i in range(512))
+            inner = bytes([0x30, 0x82, 0x02, 0x00]) + body
+            plain = bytes(8) + bytes([0x63, 0x82, 0x02, 0x04]) + inner
+            nt = md4(pw.decode("latin-1").encode("utf-16-le"))
+            k1 = hmac_mod.new(nt, TGS_MSG_TYPE.to_bytes(4, "little"),
+                              "md5").digest()
+            chk = hmac_mod.new(k1, plain, "md5").digest()
+            ed = rc4(hmac_mod.new(k1, chk, "md5").digest(), plain)
+            return f"$krb5tgs$23${chk.hex()}${ed.hex()}"
+
+        g5 = MaskGenerator("?l?l?l?l?l")
+        plant = 777_001
+        t0 = time.perf_counter()
+        w = eng.make_mask_worker(g5, [cpu.parse_target(
+            line(g5.candidate(plant), 1))], batch=B, hit_capacity=8,
+            oracle=cpu)
+        hits = w.process(WorkUnit(-1, plant - plant % B, B))
+        compile_s = time.perf_counter() - t0
+        ok = [(h.target_index, h.cand_index) for h in hits] == [(0, plant)]
+
+        # timed sweep: a target whose edata2 bytes [8,12) cannot
+        # decrypt to the expected DER header for (almost) any
+        # candidate; stray 2^-32 maybes only cost an oracle check
+        g8 = MaskGenerator("?a?a?a?a?a?a?a?a")
+        sweep = eng.make_mask_worker(g8, [cpu.parse_target(
+            line(b"absent!", 7))], batch=B, hit_capacity=64,
+            oracle=cpu)
+        tested, start = 0, 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 15.0:
+            sweep.process(WorkUnit(-1, start, B))
+            tested += B
+            start += B
+        dt = time.perf_counter() - t0
+        return {"case": name, "ok": ok, "batch": B,
+                "compile_s": round(compile_s, 1),
+                "hs": tested / dt, "tested": tested,
+                "elapsed_s": round(dt, 2),
+                "hits": [h.cand_index for h in hits]}
+    elif kind == "krb5cfg":
+        # krb5cfg-<logB>-<subc>-<unroll>: raw krb5 kernel throughput
+        # at a (SUBC, unroll) point -- the tuning sweep behind the
+        # production defaults.  Unmatchable target, hard_sync timing.
+        from dprf_tpu.ops import pallas_krb5
+        from dprf_tpu.utils.sync import hard_sync
+        logB, subc, unroll = (int(x) for x in parts[1:])
+        B = 1 << logB
+        chunks = max(1, 2048 // subc)    # keep tile ~2048
+        # unmatchable: impossible DER expectation via fake scalars
+        step = pallas_krb5.make_krb5_crack_step(
+            gen, B, sub=subc, chunks=chunks, unroll=bool(unroll))
+        targs = (jnp.asarray([2], jnp.int32),
+                 jnp.asarray([3, 5, 7, 9], jnp.int32),
+                 jnp.asarray([0], jnp.int32),
+                 jnp.asarray([-1], jnp.int32),
+                 jnp.asarray([1], jnp.int32))
+        t0 = time.perf_counter()
+        hard_sync(step(base, jnp.int32(B), *targs))
+        compile_s = time.perf_counter() - t0
+        n, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < 15.0:
+            hard_sync(step(base, jnp.int32(B), *targs))
+            n += 1
+        dt = time.perf_counter() - t0
+        return {"case": name, "ok": True, "batch": B, "subc": subc,
+                "chunks": chunks, "unroll": bool(unroll),
+                "hs": n * B / dt, "dispatches": n,
+                "compile_s": round(compile_s, 1),
+                "elapsed_s": round(dt, 2)}
     elif kind == "scanprobe":
         # scanprobe-<variant>-<inner>: minimal lax.scan shapes on this
         # backend, bisecting the round-4b config-stage hang (the
