@@ -31,18 +31,18 @@ def _swap(S: jnp.ndarray, i, si: jnp.ndarray,
     return S.at[jnp.arange(B), j].set(si)
 
 
-def rc4_keystream_words(key4: jnp.ndarray, nwords: int) -> jnp.ndarray:
-    """First `nwords` 32-bit RC4 keystream words for 16-byte keys.
-
-    key4: uint32[B, 4] (the key's little-endian words, e.g. an MD5
-    digest straight from `md5_compress`).  Returns uint32[B, nwords],
-    each word packing 4 keystream bytes LE (byte 4w+t at shift 8t).
-    """
-    B = key4.shape[0]
+def words_to_bytes(words: jnp.ndarray) -> jnp.ndarray:
+    """uint32[B, W] LE words -> int32[B, 4W] bytes."""
+    B, W = words.shape
     shifts = jnp.asarray([0, 8, 16, 24], jnp.uint32)
-    key_bytes = ((key4[:, :, None] >> shifts[None, None, :]) &
-                 jnp.uint32(0xFF)).reshape(B, 16).astype(jnp.int32)
+    return ((words[:, :, None] >> shifts[None, None, :]) &
+            jnp.uint32(0xFF)).reshape(B, 4 * W).astype(jnp.int32)
 
+
+def rc4_ksa(key_bytes: jnp.ndarray) -> jnp.ndarray:
+    """KSA for per-candidate keys: key_bytes int32[B, K] (K static)
+    -> S int32[B, 256]."""
+    B, K = key_bytes.shape
     S0 = jnp.broadcast_to(jnp.arange(256, dtype=jnp.int32),
                           (B, 256))
     j0 = jnp.zeros((B,), jnp.int32)
@@ -50,14 +50,25 @@ def rc4_keystream_words(key4: jnp.ndarray, nwords: int) -> jnp.ndarray:
     def ksa(i, carry):
         S, j = carry
         si = lax.dynamic_slice_in_dim(S, i, 1, axis=1)[:, 0]
-        ki = lax.dynamic_slice_in_dim(key_bytes, i % 16, 1,
+        ki = lax.dynamic_slice_in_dim(key_bytes, i % K, 1,
                                       axis=1)[:, 0]
         j = (j + si + ki) & 255
         sj = jnp.take_along_axis(S, j[:, None], axis=1)[:, 0]
         return _swap(S, i, si, j, sj), j
 
     S, _ = lax.fori_loop(0, 256, ksa, (S0, j0))
+    return S
 
+
+def rc4_keystream_bytes(key_bytes: jnp.ndarray,
+                        nwords: int) -> jnp.ndarray:
+    """First `nwords` 32-bit keystream words for per-candidate keys of
+    any (static) length: key_bytes int32[B, K] -> uint32[B, nwords],
+    each word packing 4 keystream bytes LE (byte 4w+t at shift 8t).
+    The single PRGA implementation — every RC4 consumer (krb5 XLA
+    filter, PDF R2/R3 checks) goes through here."""
+    B = key_bytes.shape[0]
+    S = rc4_ksa(key_bytes)
     j = jnp.zeros((B,), jnp.int32)
     words = []
     word = jnp.zeros((B,), jnp.uint32)
@@ -74,6 +85,21 @@ def rc4_keystream_words(key4: jnp.ndarray, nwords: int) -> jnp.ndarray:
             words.append(word)
             word = jnp.zeros((B,), jnp.uint32)
     return jnp.stack(words, axis=1)
+
+
+def rc4_keystream_words(key4: jnp.ndarray, nwords: int) -> jnp.ndarray:
+    """rc4_keystream_bytes for 16-byte keys given as uint32[B, 4] LE
+    words (e.g. an MD5 digest straight from `md5_compress`)."""
+    return rc4_keystream_bytes(words_to_bytes(key4), nwords)
+
+
+def rc4_apply16(key_bytes: jnp.ndarray,
+                data4: jnp.ndarray) -> jnp.ndarray:
+    """RC4-transform a 16-byte buffer per candidate (the PDF R3+
+    U-check runs 20 of these): key_bytes int32[B, K], data4
+    uint32[B, 4] LE words -> uint32[B, 4].  A stream cipher is just
+    keystream XOR."""
+    return data4 ^ rc4_keystream_bytes(key_bytes, 4)
 
 
 def rc4_keystream_words_reference(key: bytes, nwords: int) -> list[int]:

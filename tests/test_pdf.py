@@ -1,0 +1,137 @@
+"""PDF RC4 user-password engines (hashcat 10400/10500): forward
+construction, parsing, device-vs-oracle filters, workers."""
+
+import hashlib
+import random
+import struct
+
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.engines.cpu.krb5 import rc4
+from dprf_tpu.engines.cpu.pdf import (PAD, parse_pdf, pdf_key,
+                                      pdf_user_check)
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+def _line(password: bytes, rev: int, p: int = -1,
+          enc_metadata: bool = True, seed: int = 5,
+          bits: int = None) -> str:
+    """A self-consistent $pdf$ line: run the spec algorithm forward
+    from a random O/ID and the true password, store the resulting U."""
+    rng = random.Random(seed)
+    o = bytes(rng.randrange(256) for _ in range(32))
+    doc_id = bytes(rng.randrange(256) for _ in range(16))
+    bits = bits or (40 if rev == 2 else 128)
+    key_len = bits // 8
+    u = pdf_user_check(password, o, p, doc_id, rev, key_len,
+                       enc_metadata)
+    ver = 1 if rev == 2 else 2
+    if rev >= 3:
+        u = u + bytes(16)          # files store 32 bytes, last 16 noise
+    return (f"$pdf${ver}*{rev}*{bits}*{p}*{int(enc_metadata)}*16*"
+            f"{doc_id.hex()}*32*{u.hex()}*32*{o.hex()}")
+
+
+def test_forward_construction_is_spec_algorithm():
+    """pdf_key literally implements Algorithm 2 (hashlib cross-build)."""
+    pw, o = b"tiger", bytes(range(32))
+    doc_id, p = bytes(range(16)), -44
+    msg = (pw + PAD)[:32] + o + struct.pack("<i", p) + doc_id
+    assert pdf_key(pw, o, p, doc_id, 2, 5) == \
+        hashlib.md5(msg).digest()[:5]
+    d = hashlib.md5(msg).digest()
+    for _ in range(50):
+        d = hashlib.md5(d[:16]).digest()
+    assert pdf_key(pw, o, p, doc_id, 3, 16) == d[:16]
+    # R2 U is RC4 of the PAD with that key
+    assert pdf_user_check(pw, o, p, doc_id, 2, 5) == \
+        rc4(hashlib.md5(msg).digest()[:5], PAD)
+
+
+@pytest.mark.parametrize("rev", [2, 3])
+def test_oracle_roundtrip_and_parse(rev):
+    pw = b"Sec9"
+    cpu = get_engine("pdf", "cpu")
+    t = cpu.parse_target(_line(pw, rev))
+    assert t.params["rev"] == rev
+    assert cpu.verify(pw, t) and not cpu.verify(b"nope", t)
+
+
+def test_parse_errors():
+    with pytest.raises(ValueError):
+        parse_pdf("$pdf$2*5*256*-1*1*16*00*32*00*32*00")   # R5/R6
+    with pytest.raises(ValueError):
+        parse_pdf("not-a-pdf-line")
+    with pytest.raises(ValueError):
+        parse_pdf("$pdf$1*2*40*-1*1")                      # too few
+
+
+@pytest.mark.smoke
+@pytest.mark.parametrize("rev", [2, 3])
+def test_mask_worker_end_to_end(rev):
+    dev = get_engine("pdf", "jax")
+    cpu = get_engine("pdf", "cpu")
+    gen = MaskGenerator("?l?d?l")
+    secret = gen.candidate(4242)
+    t = dev.parse_target(_line(secret, rev))
+    w = dev.make_mask_worker(gen, [t], batch=2048, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.cand_index, h.plaintext)
+            for h in hits] == [(0, 4242, secret)]
+
+
+def test_mask_worker_mixed_revisions_and_rev4_metadata():
+    dev = get_engine("pdf", "jax")
+    cpu = get_engine("pdf", "cpu")
+    gen = MaskGenerator("?d?d?d")
+    s1, s2, s3 = (gen.candidate(i) for i in (12, 340, 876))
+    targets = [dev.parse_target(_line(s1, 2, seed=1)),
+               dev.parse_target(_line(s2, 3, seed=2)),
+               dev.parse_target(_line(s3, 4, enc_metadata=False,
+                                      seed=3))]
+    # plus an R3 40-bit document (legal per spec: R3 allows 40-128)
+    s4 = gen.candidate(555)
+    targets.append(dev.parse_target(_line(s4, 3, seed=4, bits=40)))
+    w = dev.make_mask_worker(gen, targets, batch=512, hit_capacity=8,
+                             oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert sorted((h.target_index, h.plaintext) for h in hits) == \
+        [(0, s1), (1, s2), (2, s3), (3, s4)]
+
+
+def test_wordlist_worker():
+    from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+    from dprf_tpu.rules.parser import parse_rule
+
+    dev = get_engine("pdf", "jax")
+    cpu = get_engine("pdf", "cpu")
+    words = [b"draft", b"final"]
+    rules = [parse_rule(":"), parse_rule("c $2")]
+    gen = WordlistRulesGenerator(words, rules, max_len=16)
+    secret = b"Final2"
+    t = dev.parse_target(_line(secret, 3))
+    w = dev.make_wordlist_worker(gen, [t], batch=16, hit_capacity=8,
+                                 oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
+
+
+def test_sharded_worker():
+    import jax
+
+    from dprf_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) >= 8
+    dev = get_engine("pdf", "jax")
+    cpu = get_engine("pdf", "cpu")
+    gen = MaskGenerator("?d?l")
+    secret = gen.candidate(133)
+    t = dev.parse_target(_line(secret, 2))
+    w = dev.make_sharded_mask_worker(gen, [t], make_mesh(8),
+                                     batch_per_device=32, hit_capacity=8,
+                                     oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
