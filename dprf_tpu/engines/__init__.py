@@ -53,6 +53,7 @@ def _ensure_imported(device: str) -> None:
         import dprf_tpu.engines.cpu.engines  # noqa: F401
         import dprf_tpu.engines.cpu.krb5     # noqa: F401
         import dprf_tpu.engines.cpu.pdf      # noqa: F401
+        import dprf_tpu.engines.cpu.sevenzip  # noqa: F401
     elif device == "jax":
         try:
             import dprf_tpu.engines.device.engines  # noqa: F401
@@ -81,6 +82,7 @@ def _ensure_imported(device: str) -> None:
             import dprf_tpu.engines.device.descrypt  # noqa: F401
             import dprf_tpu.engines.device.krb5     # noqa: F401
             import dprf_tpu.engines.device.pdf      # noqa: F401
+            import dprf_tpu.engines.device.sevenzip  # noqa: F401
         except ModuleNotFoundError as e:
             # Translate only a missing engines.device package into a friendly
             # error; import failures *inside* it should surface as-is.
