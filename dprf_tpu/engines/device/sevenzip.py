@@ -134,9 +134,10 @@ def sevenzip_key_words(cand, length: int, salt: bytes, cycles: int):
         jnp.asarray(pad), (B, 16)))
 
 
-def make_7z_filter(length: int, params: dict):
-    """fb(cand, lens) -> uint32[B, 1] recomputed CRC32 (exact)."""
-    salt, cycles = params["salt"], params["cycles"]
+def make_state_check(params: dict):
+    """uint32[B, 8] SHA-256 key states -> uint32[B, 1] recomputed
+    CRC32 (exact); shared by the XLA KDF path and the Pallas KDF
+    kernel (ops/pallas_7z.py)."""
     data, iv = params["data"], params["iv"]
     unpacked = params["unpacked_len"]
     blocks = np.frombuffer(data, np.uint8).reshape(-1, 16)
@@ -144,10 +145,9 @@ def make_7z_filter(length: int, params: dict):
         [np.frombuffer((iv + bytes(16))[:16], np.uint8)[None],
          blocks[:-1]], axis=0)           # CBC xor chain, all constant
 
-    def fb(cand, lens):
-        state = sevenzip_key_words(cand, length, salt, cycles)
+    def check(state):
         # key bytes: big-endian serialization of the 8 state words
-        B = cand.shape[0]
+        B = state.shape[0]
         shifts = jnp.asarray([24, 16, 8, 0], jnp.uint32)
         keys = ((state[:, :, None] >> shifts[None, None, :])
                 & jnp.uint32(0xFF)).reshape(B, 32).astype(jnp.uint8)
@@ -155,6 +155,17 @@ def make_7z_filter(length: int, params: dict):
             jnp.asarray(prev)[None]
         flat = plain.reshape(B, -1)
         return crc32_batch(flat, unpacked)[:, None]
+
+    return check
+
+
+def make_7z_filter(length: int, params: dict):
+    """fb(cand, lens) -> uint32[B, 1] recomputed CRC32 (exact)."""
+    salt, cycles = params["salt"], params["cycles"]
+    check = make_state_check(params)
+
+    def fb(cand, lens):
+        return check(sevenzip_key_words(cand, length, salt, cycles))
 
     return fb
 
@@ -169,6 +180,27 @@ def _make_step(gen, batch: int, params: dict, hit_capacity: int):
         cand = gen.decode_batch(base_digits, flat, batch)
         lens = jnp.full((batch,), length, jnp.int32)
         word = fb(cand, lens)
+        found = cmp_ops.compare_single(word, target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+def _make_kernel_step(gen, batch: int, params: dict,
+                      hit_capacity: int, interpret: bool):
+    """KDF on the Pallas kernel (ops/pallas_7z.py), AES+CRC verdict
+    in XLA -- the KDF is ~99.9% of the work at production cycles."""
+    from dprf_tpu.ops.pallas_7z import make_7z_kdf_pallas_fn
+
+    check = make_state_check(params)
+    kdf = make_7z_kdf_pallas_fn(gen, batch, params["salt"],
+                                params["cycles"], interpret=interpret)
+
+    @jax.jit
+    def step(base_digits, n_valid, target):
+        word = check(kdf(base_digits))
         found = cmp_ops.compare_single(word, target)
         found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
         return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
@@ -192,10 +224,30 @@ class SevenZipMaskWorker(PhpassMaskWorker):
 
     def __init__(self, engine, gen, targets, batch: int = 1 << 12,
                  hit_capacity: int = 64, oracle=None):
+        from dprf_tpu.ops.pallas_7z import sevenzip_kernel_eligible
+        from dprf_tpu.ops.pallas_mask import TILE, pallas_mode
+
         self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
+        mode = pallas_mode()
+        if mode is not None:
+            batch = max(TILE, (batch // TILE) * TILE)
         self.batch = self.stride = batch
-        self._steps = [_make_step(gen, batch, t.params, hit_capacity)
-                       for t in self.targets]
+        self._steps = []
+        for t in self.targets:
+            step = None
+            if mode is not None and sevenzip_kernel_eligible(
+                    gen, t.params["cycles"], len(t.params["salt"])):
+                try:
+                    step = _make_kernel_step(
+                        gen, batch, t.params, hit_capacity,
+                        interpret=mode.get("interpret", False))
+                except Exception as e:  # noqa: BLE001 -- compiler
+                    from dprf_tpu.utils.logging import DEFAULT as log
+                    log.warn("7z KDF kernel failed to build; using "
+                             "the XLA walker", error=str(e))
+            if step is None:
+                step = _make_step(gen, batch, t.params, hit_capacity)
+            self._steps.append(step)
         self._targs = [(ti, _crc_word(t))
                        for ti, t in enumerate(self.targets)]
 

@@ -152,6 +152,42 @@ def test_device_payload_cap_falls_back_to_cpu():
     assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
 
 
+def test_kdf_pallas_kernel_matches_oracle():
+    """Interpret-mode KDF kernel vs the streaming oracle, lane for
+    lane (the kernel emits raw key states; AES+CRC stay in XLA)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from dprf_tpu.ops.pallas_7z import make_7z_kdf_pallas_fn
+
+    gen = MaskGenerator("?l?d")
+    salt = b"Na"
+    fn = make_7z_kdf_pallas_fn(gen, batch=1024, salt=salt, cycles=CYCLES,
+                               sub=8, interpret=True)
+    keys = np.asarray(fn(jnp.asarray(gen.digits(0), jnp.int32)))
+    for idx in (0, 7, 259):
+        want = sevenzip_key(gen.candidate(idx), salt, CYCLES)
+        got = b"".join(int(w).to_bytes(4, "big") for w in keys[idx])
+        assert got == want, idx
+
+
+def test_kernel_worker_planted(monkeypatch):
+    """DPRF_PALLAS=1 routes the per-target step onto the KDF kernel
+    (interpret off-TPU); planted crack through the production sweep."""
+    monkeypatch.setenv("DPRF_PALLAS", "1")
+    dev = get_engine("7z", "jax")
+    cpu = get_engine("7z", "cpu")
+    gen = MaskGenerator("?l?d")
+    secret = gen.candidate(201)
+    t = dev.parse_target(_line(secret, b"kernel path payload!"))
+    w = dev.make_mask_worker(gen, [t], batch=64, hit_capacity=8,
+                             oracle=cpu)
+    assert w.batch >= 64        # rounded up to the kernel tile
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.cand_index, h.plaintext)
+            for h in hits] == [(0, 201, secret)]
+
+
 def test_sharded_worker():
     import jax
 

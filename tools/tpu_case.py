@@ -31,7 +31,7 @@ OUT = os.environ.get("TPU_CASES_OUT", "/tmp/tpu_cases.jsonl")
 #: jax / touching the tunnel.
 KINDS = {"scrypt": 4, "bcrypt": 2, "bcryptchunk": 2, "pallaseks": 2,
          "descrypt": 1, "pmkid": 1, "scanprobe": 2, "superstep": 3,
-         "krb5": 1, "krb5cfg": 3}
+         "krb5": 1, "krb5cfg": 3, "pdf": 2, "sevenzip": 2}
 
 
 def case_valid(name: str) -> bool:
@@ -247,6 +247,59 @@ def run_case(name: str) -> dict:
             start += B
         dt = time.perf_counter() - t0
         return {"case": name, "ok": ok, "batch": B,
+                "compile_s": round(compile_s, 1),
+                "hs": tested / dt, "tested": tested,
+                "elapsed_s": round(dt, 2),
+                "hits": [h.cand_index for h in hits]}
+    elif kind in ("pdf", "sevenzip"):
+        # pdf-<rev>-<logB> / sevenzip-<cycles>-<logB>: planted crack
+        # on a small keyspace through the PRODUCTION worker, then a
+        # timed sweep with an absent password.  Both engines build
+        # self-consistent targets by running the spec forward (the
+        # same constructors the hermetic tests use).
+        import sys as _sys
+        _sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tests"))
+        from dprf_tpu import get_engine
+        from dprf_tpu.runtime.workunit import WorkUnit
+        a, logB = int(parts[1]), int(parts[2])
+        B = 1 << logB
+        if kind == "pdf":
+            from test_pdf import _line as mk
+            ename = "pdf"
+            line = lambda pw: mk(pw, a)
+        else:
+            from test_sevenzip import _line as mk
+            ename = "7z"
+            line = lambda pw: mk(pw, b"stored payload for the sweep",
+                                 salt=b"Qx", cycles=a)
+        eng = get_engine(ename, device="jax")
+        cpu = get_engine(ename, device="cpu")
+        g3 = MaskGenerator("?l?l?l")
+        plant = 7_077
+        t0 = time.perf_counter()
+        w = eng.make_mask_worker(g3, [cpu.parse_target(
+            line(g3.candidate(plant)))], batch=min(B, 4096),
+            hit_capacity=8, oracle=cpu)
+        hits = w.process(WorkUnit(-1, plant - plant % w.stride,
+                                  w.stride))
+        compile_s = time.perf_counter() - t0
+        ok = [(h.target_index, h.cand_index) for h in hits] == \
+            [(0, plant)]
+
+        g8 = MaskGenerator("?a?a?a?a?a?a?a?a")
+        sweep = eng.make_mask_worker(g8, [cpu.parse_target(
+            line(b"absent!9"))], batch=B, hit_capacity=64, oracle=cpu)
+        tested, start = 0, 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 20.0:
+            sweep.process(WorkUnit(-1, start, B))
+            tested += B
+            start += B
+        dt = time.perf_counter() - t0
+        return {"case": name, "ok": ok, "param": a, "batch": B,
+                "worker": type(sweep).__name__,
                 "compile_s": round(compile_s, 1),
                 "hs": tested / dt, "tested": tested,
                 "elapsed_s": round(dt, 2),

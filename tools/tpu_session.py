@@ -72,7 +72,8 @@ DEADLINES = {
 CASE_DEADLINES = {
     "bcryptchunk": 1800, "pallaseks": 1800, "scrypt": 1500,
     "bcrypt": 1200, "descrypt": 900, "pmkid": 1200,
-    "scanprobe": 900, "superstep": 900,
+    "scanprobe": 900, "superstep": 900, "krb5": 1200,
+    "krb5cfg": 900, "pdf": 1200, "sevenzip": 1500,
 }
 
 
