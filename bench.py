@@ -32,6 +32,12 @@ import sys
 import time
 
 BASELINE_TARGET = 1.0e11   # MD5 H/s/chip north-star target
+# BASELINE.md "MD5 kernel roofline": the chip's int32 VPU ceiling for
+# MD5 is 4-8 GH/s (3-6e12 int32 ops/s over ~800 ops/candidate).  The
+# north-star target sits ~15-25x ABOVE that ceiling, so vs_baseline
+# alone misreads a near-roofline kernel as 5% of target; roofline_frac
+# carries the physically meaningful fraction alongside it.
+ROOFLINE_BAND_HS = (4.0e9, 8.0e9)
 PROBE_DEADLINE_S = 240     # tunnel handshake + one tiny computation
 DEVICE_DEADLINE_S = 900    # two compiles + calibrated timed runs
 CPU_TIMEOUT_S = 300
@@ -319,6 +325,13 @@ def main() -> int:
 
     out = {"metric": "md5 candidates/sec/chip", "value": res["value"],
            "unit": "H/s", "vs_baseline": res["value"] / BASELINE_TARGET}
+    if res.get("device") == "tpu":
+        # conservative fraction (vs the 8 GH/s upper ceiling) plus the
+        # optimistic one (vs 4 GH/s); the truth is in the band
+        lo, hi = ROOFLINE_BAND_HS
+        out["roofline_frac"] = round(res["value"] / hi, 4)
+        out["roofline_frac_hi"] = round(res["value"] / lo, 4)
+        out["roofline_band_hs"] = [lo, hi]
     for k in ("impl", "device", "batch", "batches", "inner",
               "calibrate_hs", "elapsed_s", "compile_s", "note"):
         if k in res:

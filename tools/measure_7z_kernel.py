@@ -1,0 +1,59 @@
+"""Direct hardware measurement of the 7z KDF Pallas kernel.
+
+Times ops/pallas_7z.make_7z_kdf_pallas_fn standalone (no worker, no
+oracle) at the production cycles=19 stream, one (SUB, batch) point per
+invocation so a deadline trip can't take other points down with it.
+
+Usage: python tools/measure_7z_kernel.py <sub> <logB> [cycles]
+Appends one JSON line to TPU_CASES_OUT (default /tmp/tpu_cases.jsonl).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.environ.get("TPU_CASES_OUT", "/tmp/tpu_cases.jsonl")
+
+
+def main():
+    sub, logB = int(sys.argv[1]), int(sys.argv[2])
+    cycles = int(sys.argv[3]) if len(sys.argv) > 3 else 19
+    doc = {"case": f"7zkdf-{sub}-{logB}-{cycles}", "t": time.time()}
+    try:
+        import jax.numpy as jnp
+        from dprf_tpu.generators.mask import MaskGenerator
+        from dprf_tpu.ops.pallas_7z import make_7z_kdf_pallas_fn
+        from dprf_tpu.utils.sync import hard_sync
+
+        B = 1 << logB
+        gen = MaskGenerator("?a?a?a?a?a?a?a?a")
+        kdf = make_7z_kdf_pallas_fn(gen, B, b"Qx", cycles, sub=sub)
+        base = jnp.asarray(gen.digits(0), jnp.int32)
+        t0 = time.perf_counter()
+        hard_sync(kdf(base))
+        doc["compile_s"] = round(time.perf_counter() - t0, 1)
+        k, t0 = 0, time.perf_counter()
+        while True:
+            hard_sync(kdf(base))
+            k += 1
+            if time.perf_counter() - t0 > 30.0 or k >= 16:
+                break
+        dt = time.perf_counter() - t0
+        doc.update(ok=True, hs=k * B / dt, batch=B, sub=sub,
+                   cycles=cycles, dispatches=k,
+                   dispatch_s=round(dt / k, 2))
+    except Exception as e:  # noqa: BLE001 -- report, don't crash
+        import traceback
+        doc.update(ok=False, error=f"{type(e).__name__}: {e}",
+                   traceback=traceback.format_exc()[-800:])
+    with open(OUT, "a") as f:
+        f.write(json.dumps(doc) + "\n")
+    print(json.dumps(doc)[:300])
+    return 0 if doc.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,26 @@ def emit(doc):
         f.write(json.dumps(doc) + "\n")
 
 
+def timed_sweep(worker, WorkUnit, seconds: float):
+    """Timed production-worker sweep crediting whole strides.
+
+    The worker may round its batch up to the Pallas tile (stride >
+    requested batch), so credit `worker.stride` per unit or the rate
+    under-reports by stride/batch (r4 session11 misread 4x low); burn
+    one unit first so the sweep-step compile stays outside the timed
+    window.  Returns (hs, tested, elapsed, stride)."""
+    stride = worker.stride
+    worker.process(WorkUnit(-1, 0, stride))
+    tested, start = 0, stride
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        worker.process(WorkUnit(-1, start, stride))
+        tested += stride
+        start += stride
+    dt = time.perf_counter() - t0
+    return tested / dt, tested, dt, stride
+
+
 def run_case(name: str) -> dict:
     import numpy as np
     import jax
@@ -239,16 +259,10 @@ def run_case(name: str) -> dict:
         sweep = eng.make_mask_worker(g8, [cpu.parse_target(
             line(b"absent!", 7))], batch=B, hit_capacity=64,
             oracle=cpu)
-        tested, start = 0, 0
-        t0 = time.perf_counter()
-        while time.perf_counter() - t0 < 15.0:
-            sweep.process(WorkUnit(-1, start, B))
-            tested += B
-            start += B
-        dt = time.perf_counter() - t0
-        return {"case": name, "ok": ok, "batch": B,
+        hs, tested, dt, stride = timed_sweep(sweep, WorkUnit, 15.0)
+        return {"case": name, "ok": ok, "batch": stride,
                 "compile_s": round(compile_s, 1),
-                "hs": tested / dt, "tested": tested,
+                "hs": hs, "tested": tested,
                 "elapsed_s": round(dt, 2),
                 "hits": [h.cand_index for h in hits]}
     elif kind in ("pdf", "sevenzip"):
@@ -291,17 +305,11 @@ def run_case(name: str) -> dict:
         g8 = MaskGenerator("?a?a?a?a?a?a?a?a")
         sweep = eng.make_mask_worker(g8, [cpu.parse_target(
             line(b"absent!9"))], batch=B, hit_capacity=64, oracle=cpu)
-        tested, start = 0, 0
-        t0 = time.perf_counter()
-        while time.perf_counter() - t0 < 20.0:
-            sweep.process(WorkUnit(-1, start, B))
-            tested += B
-            start += B
-        dt = time.perf_counter() - t0
-        return {"case": name, "ok": ok, "param": a, "batch": B,
+        hs, tested, dt, stride = timed_sweep(sweep, WorkUnit, 20.0)
+        return {"case": name, "ok": ok, "param": a, "batch": stride,
                 "worker": type(sweep).__name__,
                 "compile_s": round(compile_s, 1),
-                "hs": tested / dt, "tested": tested,
+                "hs": hs, "tested": tested,
                 "elapsed_s": round(dt, 2),
                 "hits": [h.cand_index for h in hits]}
     elif kind == "krb5cfg":
