@@ -221,22 +221,72 @@ class PdfMaskWorker(PhpassMaskWorker):
     """Per-target sweep with PER-REVISION compiled steps (a hashlist
     may mix R2 and R3 documents); the base sweep calls
     step(base, n, *targ), so _targs carries the target index and the
-    dispatcher picks that target's step."""
+    dispatcher picks that target's step.
+
+    On TPU, eligible kinds ride the fused Pallas kernel
+    (ops/pallas_pdf.py — decode -> Algorithm-2 MD5 -> 50-fold stretch
+    -> RC4 cascade in one program, the krb5 RC4 layout); others keep
+    the XLA step."""
 
     def __init__(self, engine, gen, targets, batch: int = 1 << 16,
                  hit_capacity: int = 64, oracle=None):
+        from dprf_tpu.ops import pallas_krb5, pallas_pdf
+        from dprf_tpu.ops.pallas_mask import pallas_mode
+
         self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
+        mode = pallas_mode()
+        tile = pallas_krb5.SUBC * pallas_pdf.CHUNKS
+        if mode is not None:
+            batch = max(tile, (batch // tile) * tile)
         self.batch = self.stride = batch
         by_kind = {}
         self._kargs = []
+        self.kernel_kinds = set()      # (rev, key_len) on the kernel
         for t in self.targets:
             kind = (2 if t.params["rev"] == 2 else 3,
                     t.params["key_len"])
             if kind not in by_kind:
-                by_kind[kind] = _make_step(gen, batch, *kind,
-                                           hit_capacity)
-            params, tw = _target_args(t)
-            self._kargs.append((by_kind[kind], params, tw))
+                step = None
+                interp = (mode or {}).get("interpret", False)
+                if mode is not None and pallas_pdf.pdf_kernel_eligible(
+                        gen, *kind, on_hardware=not interp):
+                    try:
+                        step = pallas_pdf.make_pdf_crack_step(
+                            gen, batch, *kind,
+                            hit_capacity=hit_capacity,
+                            interpret=interp)
+                        # warmup INSIDE the try: the step is lazily
+                        # jitted, so the Mosaic compile (the failure
+                        # mode that must fall back to XLA) only fires
+                        # on first call -- force it now, per-kind,
+                        # with this kind's first target's scalars
+                        from dprf_tpu.ops.pallas_pdf import \
+                            target_scalars
+                        from dprf_tpu.utils.sync import hard_sync
+                        o, b2, x0, u = target_scalars(t)
+                        hard_sync(step(
+                            jnp.zeros((gen.length,), jnp.int32),
+                            jnp.int32(0), o, b2, x0, u))
+                    except Exception as e:  # noqa: BLE001 -- compiler
+                        from dprf_tpu.utils.logging import DEFAULT as log
+                        log.warn("pdf kernel failed to build; using "
+                                 "the XLA step", error=str(e))
+                        step = None
+                if step is None:
+                    step = _make_step(gen, batch, *kind, hit_capacity)
+                    kernel = False
+                else:
+                    kernel = True
+                    self.kernel_kinds.add(kind)
+                by_kind[kind] = (step, kernel)
+            step, kernel = by_kind[kind]
+            if kernel:
+                from dprf_tpu.ops.pallas_pdf import target_scalars
+                o, b2, x0, u = target_scalars(t)
+                self._kargs.append((step, (o, b2, x0), u))
+            else:
+                params, tw = _target_args(t)
+                self._kargs.append((step, params, tw))
         self._targs = [(ti,) for ti in range(len(self.targets))]
 
     def step(self, base, n_valid, ti: int):

@@ -10,9 +10,11 @@ real passwords orders of magnitude sooner.  The keyspace and the
 index<->candidate bijection machinery are untouched -- ordering is just
 a permutation of each position's charset BEFORE the mixed-radix decode,
 so every device path (XLA gather decode, sharded steps) works
-unchanged.  The Pallas kernel's arithmetic charset decode needs few
-piecewise segments, which an arbitrary permutation breaks, so Markov
-mask jobs route to the XLA pipeline via the existing eligibility check.
+unchanged.  Since r5 the Pallas kernels cover permuted charsets too:
+positions that exceed the arithmetic segment budget decode through a
+256-entry lane-axis LUT (ops/pallas_mask.charset_lut -- one
+per-sublane gather, the krb5 S-box layout), so Markov-ordered mask
+jobs run at kernel rates instead of the old XLA gather floor.
 
 Stats format (.dprfstat): magic | uint16 max_len | uint64le counts
 [max_len][256].  Positions past the trained length reuse the last

@@ -34,14 +34,14 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from dprf_tpu.ops import sha256 as sha256_ops
-from dprf_tpu.ops.pallas_mask import (SUB, charset_segments,
-                                      decode_candidate_bytes,
-                                      mask_supported)
+from dprf_tpu.ops.pallas_mask import (SUB, decode_candidate_bytes,
+                                      mask_supported, segment_tables)
 
 
 def sevenzip_kernel_eligible(gen, cycles: int, salt_len: int) -> bool:
-    """Arithmetic mask decode; the counter stream must tile into
-    whole groups (always true for cycles >= 6, the realistic range)."""
+    """Any mask charset order (segment mux, unbounded since r5); the
+    counter stream must tile into whole groups (always true for
+    cycles >= 6, the realistic range)."""
     if not hasattr(gen, "charsets") or not mask_supported(gen.charsets):
         return False
     unit = salt_len + 2 * gen.length + 8
@@ -116,7 +116,7 @@ def make_7z_kdf_pallas_fn(gen, batch: int, salt: bytes, cycles: int,
     if not sevenzip_kernel_eligible(gen, cycles, len(salt)):
         raise ValueError("7z KDF kernel: job not eligible")
     grid = batch // tile
-    seg_tables = [charset_segments(cs) for cs in gen.charsets]
+    seg_tables = segment_tables(gen.charsets)
     radices, length = gen.radices, gen.length
 
     def kernel(base_ref, out_ref):

@@ -8,6 +8,14 @@ The families this module covers all consume one or two 64-byte blocks
 of the exact same compression cores, so they reuse pallas_mask's
 decode machinery with a different message build / digest chain:
 
+Markov/scrambled charsets decode here through the UNBOUNDED segment
+mux (segment_tables), not pallas_mask's LUT input: a worst-case
+Markov ?a position costs ~190 extra VPU ops, comparable to one extra
+compression for the 2-block families -- a known ~2x worst-case decode
+overhead on Markov+nested jobs, accepted to keep these factories'
+input plumbing simple.  Wire position_tables through if that
+combination ever becomes a measured bottleneck.
+
 - **salted** ``$pass.$salt`` / ``$salt.$pass`` md5/sha1/sha256
   (hashcat 10/20, 110/120, 1410/1420, plus postgres and LDAP {SSHA}
   which ride the same classes): the salt BYTES and the target digest
@@ -43,9 +51,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from dprf_tpu.ops.pallas_mask import (CORES, MAX_TARGETS, SET_SIZE, SUB,
                                       _pack_message, bloom_found,
-                                      bloom_tables, charset_segments,
+                                      bloom_tables,
                                       check_batch,
                                       decode_candidate_bytes,
+                                      segment_tables,
                                       mask_supported, reduce_tile_hits,
                                       reduce_tile_maybes)
 
@@ -256,7 +265,7 @@ def make_ext_pallas_fn(name: str, gen, target_words, batch: int,
     if not nested_eligible(name, gen,
                            target_words.shape[0] if multi else 1):
         raise ValueError(f"{name} mask job not ext-kernel-eligible")
-    seg_tables = [charset_segments(cs) for cs in gen.charsets]
+    seg_tables = segment_tables(gen.charsets)
     body = _build_ext_body(name, gen.radices, seg_tables, gen.length,
                            target_words, sub)
 
@@ -314,7 +323,7 @@ def make_salted_pallas_fn(algo: str, order: str, gen, batch: int,
     if not salted_eligible(algo, order, gen, [salt_len]):
         raise ValueError(f"{algo}-{order} mask job not kernel-eligible")
     n_words, _ = variant_words(algo)
-    seg_tables = [charset_segments(cs) for cs in gen.charsets]
+    seg_tables = segment_tables(gen.charsets)
     body = _build_ext_body(algo, gen.radices, seg_tables, gen.length,
                            None, sub, order=order, salt_len=salt_len)
     SW = max(salt_len, 1)
@@ -418,7 +427,7 @@ def emulate_ext_kernel(name: str, gen, target_words, batch: int,
     tile = sub * 128
     if batch % tile:
         raise ValueError(f"batch {batch} not a multiple of tile {tile}")
-    seg_tables = [charset_segments(cs) for cs in gen.charsets]
+    seg_tables = segment_tables(gen.charsets)
     salted = order is not None
     tables = None
     if salted:

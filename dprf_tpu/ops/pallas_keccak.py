@@ -29,11 +29,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from dprf_tpu.generators.mask import charset_segments
 from dprf_tpu.ops.keccak import keccak_f_unrolled, squeeze_words
 from dprf_tpu.ops.pallas_mask import (check_batch,
                                       decode_candidate_bytes,
-                                      mask_supported, reduce_tile_hits)
+                                      mask_supported, reduce_tile_hits,
+                                      segment_tables)
 
 #: sublane count per grid cell (tile = SUBK * 128 lanes).  Keccak-f
 #: holds ~120 pair registers live, ~4x the MD cores, so the default
@@ -130,7 +130,7 @@ def emulate_keccak_kernel(gen, tw, batch: int, base_digits, n_valid,
     vehicle; XLA:CPU cannot compile the unrolled graph)."""
     tile = sub * 128
     check_batch(batch, sub)
-    seg_tables = [charset_segments(cs) for cs in gen.charsets]
+    seg_tables = segment_tables(gen.charsets)
     body = _build_keccak_body(gen.radices, seg_tables, gen.length, tw,
                               pad_byte, rate, out_bytes, sub)
     base = jnp.asarray(base_digits, jnp.int32)
@@ -152,7 +152,7 @@ def make_keccak_pallas_fn(gen, tw, batch: int, pad_byte: int,
     grid = check_batch(batch, sub)
     if not keccak_kernel_eligible(gen, 1, rate):
         raise ValueError("mask job not keccak-kernel eligible")
-    seg_tables = [charset_segments(cs) for cs in gen.charsets]
+    seg_tables = segment_tables(gen.charsets)
     body = _build_keccak_body(gen.radices, seg_tables, gen.length, tw,
                               pad_byte, rate, out_bytes, sub)
     L = gen.length

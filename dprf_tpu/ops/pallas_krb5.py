@@ -43,11 +43,11 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from dprf_tpu.generators.mask import charset_segments
 from dprf_tpu.ops import md4 as md4_ops
 from dprf_tpu.ops import md5 as md5_ops
 from dprf_tpu.ops.pallas_mask import (decode_candidate_bytes,
-                                      mask_supported, _pack_message)
+                                      mask_supported, segment_tables,
+                                      _pack_message)
 
 #: candidates per sublane chunk / chunks per grid cell.  VMEM per
 #: chunk is ~SUBC * 1 KB of S state plus the lane-replicated words.
@@ -67,7 +67,8 @@ _OPAD = 0x5C5C5C5C
 
 
 def krb5_kernel_eligible(gen, max_len: int = 27) -> bool:
-    """Mask-attack jobs the kernel covers: arithmetic charset decode,
+    """Mask-attack jobs the kernel covers: any charset order
+    (unbounded segment mux since r5),
     NTLM's single-block UTF-16LE candidate limit."""
     return (hasattr(gen, "charsets") and gen.length <= max_len
             and mask_supported(gen.charsets))
@@ -102,21 +103,11 @@ def _hmac_md5(key4, msg_words, msg_len: int, shape):
     return _compress(ostate, outer_m)
 
 
-def _gather256(S_lo, S_hi, idx):
-    """Per-sublane S lookup: idx uint32[SUBC, 128] lane-replicated
-    entry index 0..255 -> value uint32[SUBC, 128]."""
-    idx7 = (idx & jnp.uint32(127)).astype(jnp.int32)
-    glo = jnp.take_along_axis(S_lo, idx7, axis=1)
-    ghi = jnp.take_along_axis(S_hi, idx7, axis=1)
-    return jnp.where(idx < jnp.uint32(128), glo, ghi)
-
-
-def _swap256(S_lo, S_hi, pos, val, lane):
-    """S[pos] = val via lane-iota compare + select (no scatter)."""
-    at = lane == (pos & jnp.uint32(127)).astype(jnp.int32)
-    S_lo = jnp.where((pos < jnp.uint32(128)) & at, val, S_lo)
-    S_hi = jnp.where((pos >= jnp.uint32(128)) & at, val, S_hi)
-    return S_lo, S_hi
+# the 256-entry lane-axis lookup/swap pair now lives in pallas_mask
+# (shared with the PDF RC4 kernel and the LUT charset decode); kept
+# under the historical names for this module's KSA/PRGA bodies.
+from dprf_tpu.ops.pallas_mask import gather256 as _gather256  # noqa: E402
+from dprf_tpu.ops.pallas_mask import swap256 as _swap256  # noqa: E402
 
 
 def _rc4_word2(key4, shape, unroll: bool):
@@ -248,7 +239,7 @@ def make_krb5_pallas_fn(gen, batch: int, sub: int = 0,
     if not krb5_kernel_eligible(gen):
         raise ValueError("krb5 kernel: mask not eligible")
     grid = batch // tile
-    seg_tables = [charset_segments(cs) for cs in gen.charsets]
+    seg_tables = segment_tables(gen.charsets)
     body = _build_body(gen.radices, seg_tables, gen.length, sub,
                        chunks, unroll)
 

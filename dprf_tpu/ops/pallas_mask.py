@@ -23,10 +23,14 @@ pipeline and the kernel bodies are validated eagerly via
 emulate_mask_kernel.
 
 Design choices forced by the VPU:
-- Charset lookup is arithmetic, not a gather: a charset in digit order
-  is piecewise byte = digit + delta, so the lookup is a few vectorized
-  `where` adds (7 segments for ?a, 1 for ?l/?u/?d).  Charsets needing
-  more than MAX_SEGMENTS segments fall back to the XLA path.
+- Charset lookup is arithmetic where possible: a charset in digit
+  order is piecewise byte = digit + delta, so the lookup is a few
+  vectorized `where` adds (7 segments for ?a, 1 for ?l/?u/?d).
+  Positions needing more than MAX_SEGMENTS segments (Markov-permuted
+  orders, scrambled custom charsets) use a 256-entry LUT with the
+  digit index along the LANE axis instead — one per-sublane
+  `take_along_axis` gather, the krb5/bcrypt S-box layout — so every
+  mask now rides the kernel path (r5; previously the XLA fallback).
 - Hit extraction per tile is count + single-lane arithmetic max.  Two
   hits in one TILE-candidate tile (vanishingly rare for random
   targets; always visible in the count) force the caller's exact host
@@ -63,8 +67,9 @@ from dprf_tpu.ops import sha512 as sha512_ops
 SUB = int(os.environ.get("DPRF_PALLAS_SUB", "128"))
 TILE = SUB * 128
 #: charsets needing more piecewise segments than MAX_SEGMENTS use the
-#: gather decode (and the XLA pipeline); the bound and the segment
-#: model are shared with the generator's mux decode.
+#: lane-axis LUT decode in kernels (charset_lut below) and the gather
+#: decode in the XLA pipeline; the bound and the segment model are
+#: shared with the generator's mux decode.
 from dprf_tpu.generators.mask import (MAX_SEGMENTS,  # noqa: E402,F401
                                       charset_segments, segment_mux)
 
@@ -188,10 +193,89 @@ def pallas_mode() -> Optional[dict]:
 
 
 def mask_supported(charsets: Sequence[bytes]) -> bool:
-    """True if every position's charset decodes in <= MAX_SEGMENTS
-    arithmetic pieces (all builtin charsets do)."""
-    return all(len(charset_segments(cs)) <= MAX_SEGMENTS
-               for cs in charsets)
+    """True if every position decodes on the kernel path.  Since r5
+    that is EVERY well-formed mask: positions within MAX_SEGMENTS
+    arithmetic pieces use the segment mux; arbitrary orders (Markov
+    permutations, scrambled custom charsets) use a 256-entry LUT on
+    the lane axis (charset_lut below) -- the per-sublane gather layout
+    proven by the bcrypt/krb5 kernels.  The predicate keeps only the
+    structural requirement: nonempty byte charsets."""
+    return all(1 <= len(cs) <= 256 for cs in charsets)
+
+
+def charset_lut(cs: bytes) -> np.ndarray:
+    """Arbitrary charset -> (2, 128) uint32 LUT with the DIGIT INDEX
+    along lanes (row 0 digits 0..127, row 1 digits 128..255) -- the
+    krb5 S-box layout, so the lookup is one per-sublane
+    `take_along_axis` gather + a row select, independent of how many
+    contiguous runs the byte values form."""
+    tbl = np.zeros((2, 128), np.uint32)
+    arr = np.frombuffer(cs, np.uint8)
+    tbl.reshape(-1)[:len(arr)] = arr
+    return tbl
+
+
+def position_tables(charsets: Sequence[bytes]):
+    """Per-position decode tables for THIS module's fast mask kernels:
+    (proc_tables, luts) where proc entries are segment lists
+    (arithmetic mux) or ("lut", k) markers, and luts is the stacked
+    uint32[2 * n_lut, 128] LUT array (None when every position is
+    arithmetic).  pallas_call forbids captured vector constants, so
+    the LUT rides as a kernel INPUT; the heavy kernel families
+    (krb5/pdf/7z/pbkdf2/keccak/ext) instead run the segment mux
+    UNBOUNDED -- up to ~2 ops per contiguous run per position, noise
+    next to their per-candidate work -- via segment_tables below."""
+    proc, luts = [], []
+    for cs in charsets:
+        segs = charset_segments(cs)
+        if len(segs) <= MAX_SEGMENTS:
+            proc.append(segs)
+        else:
+            proc.append(("lut", len(luts)))
+            luts.append(charset_lut(cs))
+    luts_np = (np.concatenate(luts, axis=0).astype(np.uint32)
+               if luts else None)
+    return proc, luts_np
+
+
+def segment_tables(charsets: Sequence[bytes]) -> list:
+    """Unbounded per-position segment lists: correct for ANY charset
+    (segment_mux reconstructs arbitrary orders with one compare+select
+    per contiguous run).  The heavy kernel families use this so Markov
+    and scrambled custom charsets stay kernel-eligible without LUT
+    input plumbing."""
+    return [charset_segments(cs) for cs in charsets]
+
+
+def gather256(lo, hi, idx):
+    """Per-sublane 256-entry lookup: table halves lo/hi uint32[sub, 128]
+    with the ENTRY INDEX along lanes, idx uint32[sub, 128] in 0..255 ->
+    values uint32[sub, 128].  The hardware's native per-sublane
+    `take_along_axis` gather + a half select -- the S-box layout proven
+    by the bcrypt/krb5 kernels; shared by the RC4 kernels (krb5, pdf)
+    and the LUT charset decode."""
+    idx7 = (idx & jnp.uint32(127)).astype(jnp.int32)
+    glo = jnp.take_along_axis(lo, idx7, axis=1)
+    ghi = jnp.take_along_axis(hi, idx7, axis=1)
+    return jnp.where(idx < jnp.uint32(128), glo, ghi)
+
+
+def swap256(lo, hi, pos, val, lane):
+    """table[pos] = val via lane-iota compare + select (no scatter);
+    lane is the int32 lane-index iota of the tile."""
+    at = lane == (pos & jnp.uint32(127)).astype(jnp.int32)
+    lo = jnp.where((pos < jnp.uint32(128)) & at, val, lo)
+    hi = jnp.where((pos >= jnp.uint32(128)) & at, val, hi)
+    return lo, hi
+
+
+def _lut_byte(digit, lo_row, hi_row):
+    """Lane-axis LUT lookup for int32 digit tiles of shape (sub, 128):
+    rows are (128,) uint32 halves of the 256-entry table."""
+    shape = digit.shape
+    return gather256(jnp.broadcast_to(lo_row[None, :], shape),
+                     jnp.broadcast_to(hi_row[None, :], shape),
+                     digit.astype(jnp.uint32))
 
 
 def kernel_eligible(engine_name: str, gen, n_targets: int) -> bool:
@@ -263,16 +347,26 @@ def _probe_bits(digest, p: int):
 _decode_byte = segment_mux
 
 
-def decode_candidate_bytes(radices, seg_tables, length: int, base, carry):
+def decode_candidate_bytes(radices, seg_tables, length: int, base, carry,
+                           luts=None):
     """Mixed-radix add (base digits + per-lane carry) fused with the
-    arithmetic charset lookup, least significant position first --
-    the shared decode of every mask kernel body (this module's and
-    pallas_ext's)."""
+    per-position charset lookup, least significant position first --
+    the shared decode of every mask kernel body.  seg_tables entries
+    are segment lists (arithmetic mux, any length) or ("lut", k)
+    markers resolving into `luts` rows [2k, 2k+2) (position_tables;
+    carry must then be a (sub, 128) tile -- every kernel body's is)."""
+    lut_arr = luts[...] if luts is not None else None
     byts: list = [None] * length
     for p in range(length - 1, -1, -1):
         r = radices[p]
         s = base[p] + carry
-        byts[p] = _decode_byte(s % r, seg_tables[p]).astype(jnp.uint32)
+        d = s % r
+        t = seg_tables[p]
+        if isinstance(t, tuple) and t[0] == "lut":
+            byts[p] = _lut_byte(d, lut_arr[2 * t[1]],
+                                lut_arr[2 * t[1] + 1]).astype(jnp.uint32)
+        else:
+            byts[p] = _decode_byte(d, t).astype(jnp.uint32)
         carry = s // r
     return byts
 
@@ -346,7 +440,7 @@ def _build_kernel_body(engine_name: str, radices, seg_tables, length: int,
             raise ValueError(f"{engine_name}: expected {n_words} "
                              "target words")
 
-    def kernel_body(pid, base, n_valid, tables=None):
+    def kernel_body(pid, base, n_valid, tables=None, luts=None):
         shape = (sub, 128)
         lane = (jax.lax.broadcasted_iota(jnp.int32, shape, 0) * 128
                 + jax.lax.broadcasted_iota(jnp.int32, shape, 1))
@@ -354,7 +448,7 @@ def _build_kernel_body(engine_name: str, radices, seg_tables, length: int,
         # (pid * tile) before vector carry propagation.
         carry = lane + pid * tile
         byts = decode_candidate_bytes(radices, seg_tables, length,
-                                      base, carry)
+                                      base, carry, luts)
         m = _pack_message(byts, length, shape, big_endian, widen,
                           32 if engine_name in WIDE_BLOCK else 16)
         digest = core(m, shape)
@@ -375,8 +469,13 @@ def _build_kernel_body(engine_name: str, radices, seg_tables, length: int,
 
 
 def _build_kernel(engine_name: str, radices, seg_tables, length: int,
-                  target, sub: int, multi: bool = False):
-    """pallas_call kernel wrapper around the pure body."""
+                  target, sub: int, multi: bool = False,
+                  has_lut: bool = False):
+    """pallas_call kernel wrapper around the pure body.  Optional
+    positional inputs follow (base, n_valid) in a fixed order: the
+    Bloom tables (multi-target), then the charset LUT rows (masks with
+    positions past the segment budget -- pallas_call forbids captured
+    vector constants, so the LUT is a real input)."""
     body = _build_kernel_body(engine_name, radices, seg_tables, length,
                               target, sub)
 
@@ -386,18 +485,15 @@ def _build_kernel(engine_name: str, radices, seg_tables, length: int,
     # block per grid cell (~1 byte/candidate of HBM traffic at sub=32;
     # noise next to the compression rounds).  count and hit_lane+1 both
     # fit 15/16 bits because tile = sub*128 <= 16384 (sub <= 128).
-    if multi:
-        def kernel(base_ref, nvalid_ref, tables_ref, out_ref):
-            count, hit_lane = body(pl.program_id(0), base_ref,
-                                   nvalid_ref[0], tables_ref)
-            packed = (count << 16) | (hit_lane + 1)
-            out_ref[...] = jnp.full((8, 128), packed, jnp.int32)
-    else:
-        def kernel(base_ref, nvalid_ref, out_ref):
-            count, hit_lane = body(pl.program_id(0), base_ref,
-                                   nvalid_ref[0])
-            packed = (count << 16) | (hit_lane + 1)
-            out_ref[...] = jnp.full((8, 128), packed, jnp.int32)
+    def kernel(base_ref, nvalid_ref, *rest):
+        out_ref = rest[-1]
+        extras = list(rest[:-1])
+        tables_ref = extras.pop(0) if multi else None
+        luts_ref = extras.pop(0) if has_lut else None
+        count, hit_lane = body(pl.program_id(0), base_ref,
+                               nvalid_ref[0], tables_ref, luts_ref)
+        packed = (count << 16) | (hit_lane + 1)
+        out_ref[...] = jnp.full((8, 128), packed, jnp.int32)
 
     return kernel
 
@@ -414,13 +510,15 @@ def emulate_mask_kernel(engine_name: str, gen, target_words: np.ndarray,
     target_words = np.asarray(target_words)
     multi = target_words.ndim == 2 and target_words.shape[0] > 1
     tables = jnp.asarray(bloom_tables(target_words)) if multi else None
-    seg_tables = [charset_segments(cs) for cs in gen.charsets]
+    seg_tables, luts_np = position_tables(gen.charsets)
+    luts = jnp.asarray(luts_np) if luts_np is not None else None
     body = _build_kernel_body(engine_name, gen.radices, seg_tables,
                               gen.length, target_words, sub)
     base = jnp.asarray(base_digits, jnp.int32)
     counts, lanes = [], []
     for pid in range(batch // tile):
-        c, l = body(jnp.int32(pid), base, jnp.int32(n_valid), tables)
+        c, l = body(jnp.int32(pid), base, jnp.int32(n_valid), tables,
+                    luts)
         counts.append(int(c))
         lanes.append(int(l))
     return (np.asarray(counts, np.int32)[:, None],
@@ -446,9 +544,11 @@ def make_mask_pallas_fn(engine_name: str, gen, target_words: np.ndarray,
     if not kernel_eligible(engine_name, gen, n_targets):
         raise ValueError(f"{engine_name} mask job not kernel-eligible; "
                          "use the XLA path")
-    seg_tables = [charset_segments(cs) for cs in gen.charsets]
+    seg_tables, luts_np = position_tables(gen.charsets)
+    has_lut = luts_np is not None
     kernel = _build_kernel(engine_name, gen.radices, seg_tables,
-                           gen.length, target_words, sub, multi=multi)
+                           gen.length, target_words, sub, multi=multi,
+                           has_lut=has_lut)
     L = gen.length
     in_specs = [
         pl.BlockSpec((L,), lambda i: (0,), memory_space=pltpu.SMEM),
@@ -458,6 +558,8 @@ def make_mask_pallas_fn(engine_name: str, gen, target_words: np.ndarray,
         tables = bloom_tables(target_words)
         R = tables.shape[0]
         in_specs.append(pl.BlockSpec((R, 128), lambda i: (0, 0)))
+    if has_lut:
+        in_specs.append(pl.BlockSpec(luts_np.shape, lambda i: (0, 0)))
     raw = pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -471,10 +573,14 @@ def make_mask_pallas_fn(engine_name: str, gen, target_words: np.ndarray,
         interpret=interpret,
     )
     tables_dev = jnp.asarray(tables) if multi else None
+    luts_dev = jnp.asarray(luts_np) if has_lut else None
 
     def fn(base_digits, n_valid):
-        args = (base_digits, n_valid, tables_dev) if multi else \
-            (base_digits, n_valid)
+        args = [base_digits, n_valid]
+        if multi:
+            args.append(tables_dev)
+        if has_lut:
+            args.append(luts_dev)
         (packed,) = raw(*args)
         p = packed[::8, 0:1]          # row 0 of each tile's block
         return p >> 16, (p & 0xFFFF) - 1

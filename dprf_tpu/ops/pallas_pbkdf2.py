@@ -34,16 +34,17 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from dprf_tpu.ops import sha1 as sha1_ops
-from dprf_tpu.ops.pallas_mask import (SUB, charset_segments,
-                                      decode_candidate_bytes,
-                                      mask_supported, reduce_tile_hits)
+from dprf_tpu.ops.pallas_mask import (SUB, decode_candidate_bytes,
+                                      mask_supported, reduce_tile_hits,
+                                      segment_tables)
 
 _IPAD = 0x36363636
 _OPAD = 0x5C5C5C5C
 
 
 def pmkid_kernel_eligible(gen, essid_lens) -> bool:
-    """Mask decode must be arithmetic; passphrase and ESSID must fit
+    """Any mask charset order (unbounded segment mux since r5);
+    passphrase and ESSID must fit
     their single blocks (ESSID <= 32 by 802.11; belt and braces)."""
     if not hasattr(gen, "charsets") or not mask_supported(gen.charsets):
         return False
@@ -154,7 +155,7 @@ def make_pmkid_pallas_fn(gen, batch: int, essid_len: int,
         raise ValueError(f"batch {batch} not a multiple of tile {tile}")
     if not pmkid_kernel_eligible(gen, [essid_len]):
         raise ValueError("pmkid mask job not kernel-eligible")
-    seg_tables = [charset_segments(cs) for cs in gen.charsets]
+    seg_tables = segment_tables(gen.charsets)
     radices = gen.radices
     length = gen.length
     grid = batch // tile

@@ -360,3 +360,99 @@ def test_sha512_rounds_unrolled_matches_loop_form():
         got.extend([h, l])
     assert np.array_equal(np.stack([np.asarray(g) for g in got], -1),
                           np.asarray(ref))
+
+
+@pytest.mark.smoke
+def test_position_tables_mixes_segments_and_luts():
+    """Builtin charsets stay on the arithmetic mux; scrambled orders
+    (Markov permutations) become lane-axis LUT inputs."""
+    from dprf_tpu.ops.pallas_mask import position_tables
+
+    scrambled = bytes(dict.fromkeys(
+        b"qazwsxedcrfvtgbyhnujmikolp"))            # 26 letters, shuffled
+    proc, luts = position_tables([BUILTIN_CHARSETS["l"], scrambled])
+    assert isinstance(proc[0], list)               # arithmetic segments
+    assert proc[1] == ("lut", 0)                   # LUT marker
+    assert luts.shape == (2, 128)
+    # LUT rows reconstruct the charset exactly
+    assert bytes(int(luts.reshape(-1)[d]) for d in
+                 range(len(scrambled))) == scrambled
+    # all-arithmetic masks carry no LUT input
+    proc2, luts2 = position_tables([BUILTIN_CHARSETS["l"]])
+    assert luts2 is None and isinstance(proc2[0], list)
+
+
+def test_kernel_finds_planted_markov_mask():
+    """A Markov-permuted mask (arbitrary charset order at every
+    position) rides the kernel via the LUT decode: planted password
+    found at its exact index in interpret mode."""
+    from dprf_tpu.ops.pallas_mask import position_tables
+
+    counts = np.zeros((4, 256), np.uint64)
+    rng = np.random.RandomState(11)
+    counts[:, :] = rng.randint(1, 10**6, (4, 256))
+    gen = MaskGenerator("?l?l?d?d", markov_counts=counts)
+    proc, luts = position_tables(gen.charsets)
+    assert luts is not None, \
+        "the permutation should exceed the segment budget"
+    plant = gen.candidate(12345)
+    pidx = 12345
+    step = make_pallas_mask_crack_step("md5", gen,
+                                       _engine_target("md5", plant),
+                                       batch=TILE, interpret=True)
+    base = TILE * (pidx // TILE)
+    bd = jnp.asarray(gen.digits(base), dtype=jnp.int32)
+    count, lanes, _ = step(bd, jnp.int32(min(TILE, gen.keyspace - base)))
+    assert int(count) == 1
+    assert int(np.asarray(lanes)[0]) == pidx - base
+
+
+def test_markov_worker_routes_to_kernel(monkeypatch):
+    """DPRF_PALLAS=1: a Markov-ordered mask job gets the Pallas worker
+    (pre-r5 it fell back to the XLA pipeline) and cracks end-to-end."""
+    monkeypatch.setenv("DPRF_PALLAS", "1")
+    counts = np.zeros((3, 256), np.uint64)
+    rng = np.random.RandomState(7)
+    counts[:, :] = rng.randint(1, 10**6, (3, 256))
+    gen = MaskGenerator("?l?d?l", markov_counts=counts)
+    secret = gen.candidate(404)
+    eng = get_engine("md5", device="jax")
+    t = eng.parse_target(hashlib.md5(secret).hexdigest())
+    w = eng.make_mask_worker(gen, [t], batch=TILE, hit_capacity=8,
+                             oracle=get_engine("md5", device="cpu"))
+    assert isinstance(w, PallasMaskWorker)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.cand_index, h.plaintext)
+            for h in hits] == [(0, 404, secret)]
+
+
+@pytest.mark.smoke
+def test_unbounded_segment_decode_matches_oracle():
+    """The heavy kernel families (krb5/pdf/7z/pbkdf2) decode Markov/
+    scrambled charsets through the UNBOUNDED segment mux
+    (segment_tables): eager decode_candidate_bytes must reproduce the
+    generator's candidates byte-for-byte, and the families' eligibility
+    predicates must now admit such masks."""
+    from dprf_tpu.ops.pallas_7z import sevenzip_kernel_eligible
+    from dprf_tpu.ops.pallas_krb5 import krb5_kernel_eligible
+    from dprf_tpu.ops.pallas_mask import (decode_candidate_bytes,
+                                          segment_tables)
+    from dprf_tpu.ops.pallas_pdf import pdf_kernel_eligible
+
+    counts = np.zeros((3, 256), np.uint64)
+    rng = np.random.RandomState(3)
+    counts[:, :] = rng.randint(1, 10**6, (3, 256))
+    gen = MaskGenerator("?l?l?d", markov_counts=counts)
+    tabs = segment_tables(gen.charsets)
+    assert any(len(t) > 16 for t in tabs)     # really past the budget
+    base = jnp.asarray(gen.digits(100), jnp.int32)
+    carry = jnp.arange(16, dtype=jnp.int32).reshape(2, 8)
+    byts = decode_candidate_bytes(gen.radices, tabs, gen.length,
+                                  base, carry)
+    got = np.stack([np.asarray(b) for b in byts], axis=-1).reshape(16, 3)
+    want = np.stack([np.frombuffer(gen.candidate(100 + i), np.uint8)
+                     for i in range(16)])
+    assert (got == want).all()
+    assert krb5_kernel_eligible(gen)
+    assert pdf_kernel_eligible(gen, 3, 16)
+    assert sevenzip_kernel_eligible(gen, 19, 2)

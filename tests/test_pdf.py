@@ -135,3 +135,55 @@ def test_sharded_worker():
                                      oracle=cpu)
     hits = w.process(WorkUnit(0, 0, gen.keyspace))
     assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
+
+
+@pytest.mark.parametrize("rev,bits", [(2, 40), (3, 128), (3, 40)])
+def test_pallas_kernel_matches_oracle(rev, bits):
+    """Interpret-mode pallas_pdf kernel over one small batch: planted
+    hit at its exact tile-local index, every other candidate rejected
+    (the CPU oracle is the ground truth the plant was built from)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dprf_tpu.ops import pallas_pdf
+
+    gen = MaskGenerator("?l?d")
+    plant = 97
+    cpu = get_engine("pdf", "cpu")
+    t = cpu.parse_target(_line(gen.candidate(plant), rev, bits=bits))
+    sub, chunks = 8, 2
+    tile = sub * chunks
+    batch = tile * 8                 # plant 97 sits in grid cell 6
+    fn = pallas_pdf.make_pdf_pallas_fn(
+        gen, batch, 2 if rev == 2 else 3, bits // 8, sub=sub,
+        chunks=chunks, interpret=True)
+    base = jnp.asarray(gen.digits(0), jnp.int32)
+    counts, lanes = fn(base, jnp.asarray([batch], jnp.int32),
+                       *pallas_pdf.target_scalars(t))
+    counts = np.asarray(counts)[:, 0]
+    lanes = np.asarray(lanes)[:, 0]
+    hits = [ti * tile + lanes[ti] for ti in np.nonzero(counts)[0]]
+    assert hits == [plant] and counts.sum() == 1
+
+
+def test_pallas_worker_planted_mixed_revisions(monkeypatch):
+    """DPRF_PALLAS=1 routes PdfMaskWorker's eligible kinds onto the
+    kernel steps (interpret mode off-TPU); planted cracks for an R2
+    and an R3 document through the production sweep."""
+    from dprf_tpu.ops import pallas_krb5, pallas_pdf
+
+    monkeypatch.setenv("DPRF_PALLAS", "1")
+    monkeypatch.setattr(pallas_krb5, "SUBC", 8)
+    monkeypatch.setattr(pallas_pdf, "CHUNKS", 2)
+    dev = get_engine("pdf", "jax")
+    cpu = get_engine("pdf", "cpu")
+    gen = MaskGenerator("?d?d?l")
+    s2, s3 = gen.candidate(303), gen.candidate(1799)
+    targets = [dev.parse_target(_line(s2, 2, seed=11)),
+               dev.parse_target(_line(s3, 3, seed=12))]
+    w = dev.make_mask_worker(gen, targets, batch=64, hit_capacity=8,
+                             oracle=cpu)
+    assert w.kernel_kinds == {(2, 5), (3, 16)}
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert sorted((h.target_index, h.cand_index, h.plaintext)
+                  for h in hits) == [(0, 303, s2), (1, 1799, s3)]
