@@ -52,6 +52,7 @@ def _ensure_imported(device: str) -> None:
     if device == "cpu":
         import dprf_tpu.engines.cpu.engines  # noqa: F401
         import dprf_tpu.engines.cpu.krb5     # noqa: F401
+        import dprf_tpu.engines.cpu.krb5aes  # noqa: F401
         import dprf_tpu.engines.cpu.pdf      # noqa: F401
         import dprf_tpu.engines.cpu.sevenzip  # noqa: F401
     elif device == "jax":
@@ -81,6 +82,7 @@ def _ensure_imported(device: str) -> None:
             import dprf_tpu.engines.device.sha3     # noqa: F401
             import dprf_tpu.engines.device.descrypt  # noqa: F401
             import dprf_tpu.engines.device.krb5     # noqa: F401
+            import dprf_tpu.engines.device.krb5aes  # noqa: F401
             import dprf_tpu.engines.device.pdf      # noqa: F401
             import dprf_tpu.engines.device.sevenzip  # noqa: F401
         except ModuleNotFoundError as e:

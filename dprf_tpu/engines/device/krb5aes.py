@@ -1,0 +1,273 @@
+"""Device Kerberos AES etype-17/18 engines (hashcat 19600/19700,
+19800/19900, 32100): fused PBKDF2 -> DK -> CBC-prefilter check.
+
+TPU mapping of the RFC 3962 check (cpu/krb5aes.py for the spec and
+the full oracle):
+
+- **PBKDF2-HMAC-SHA1** (4096 iterations, 1 block for AES-128 / 2 for
+  AES-256) dominates the cost — the same fused XLA chain config 5's
+  PMKID engine rides (`ops/hmac_sha1.pbkdf2_sha1_block`).
+- **DK derivations** (string-to-key's "kerberos" fold, then the
+  usage||0xAA encryption subkey) are 1-2 batched AES encryptions each
+  with per-candidate keys (`ops/aes.aes_encrypt_block_batch`); the
+  n-fold constants are host bytes.
+- **Prefilter**: decrypt ONE ciphertext block with Ke and check the
+  DER header right after the 16-byte confounder — plaintext bytes
+  [16, 20) are deterministic given len(edata2) exactly like the
+  etype-23 filter (engines/device/krb5.der_filter_words, CONF=8
+  there / 16 here).  Block 2 is plain CBC as long as it is not in
+  the CTS stolen pair, so the device path requires edata2 >= 64
+  bytes (always true for real TGS/AS-REP tickets; short Pre-Auth
+  timestamps fall back to the CPU oracle).
+- Device hits are *maybes* (2^-32 false rate per the masked 32-bit
+  DER window); the coordinator oracle-verifies each with the full
+  CTS + HMAC-SHA1-96 chain, mirroring the etype-23 design.
+
+Wordlist attacks run on the CPU oracle (variable-length HMAC keys);
+mask + sharded mask are the device paths.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dprf_tpu.engines import register
+from dprf_tpu.engines.cpu.krb5aes import (Krb5AsRepAesEngine,
+                                          Krb5PaAesEngine,
+                                          Krb5TgsAesEngine,
+                                          USAGE_AS_REP,
+                                          USAGE_PA_TIMESTAMP,
+                                          USAGE_TGS_REP_TICKET, nfold)
+from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.ops import pack as pack_ops
+from dprf_tpu.ops.aes import aes_decrypt_blocks, aes_encrypt_block_batch
+from dprf_tpu.ops.hmac_sha1 import hmac_key_states, pbkdf2_sha1_block
+
+#: confounder prefix of the decrypted plaintext (one AES block).
+CONF = 16
+
+#: smallest edata2 the device prefilter covers: the DER window block
+#: (index 1) must sit outside the CTS stolen pair in every layout.
+MIN_DEVICE_EDATA = 64
+
+
+def der_filter_words_aes(edata_len: int, usage: int) -> tuple[int, int]:
+    """(expected, mask) little-endian uint32 over plaintext bytes
+    [16, 20) — the DER header right after the confounder.  Same
+    definite-minimal-length reasoning as the etype-23 filter
+    (engines/device/krb5.der_filter_words), with the AES confounder
+    width and per-usage application tags:
+
+    TGS-REP ticket enc-part is EncTicketPart [APPLICATION 3] = 0x63
+    (exact); AS-REP is EncASRepPart 0x79 with 0x7A KDC variance
+    (match 0x78-0x7B, mask 0xFC); the Pre-Auth timestamp is a bare
+    SEQUENCE 0x30."""
+    if usage == USAGE_TGS_REP_TICKET:
+        tag_exp, tag_mask = 0x63, 0xFF
+    elif usage == USAGE_AS_REP:
+        tag_exp, tag_mask = 0x78, 0xFC
+    else:
+        tag_exp, tag_mask = 0x30, 0xFF
+    L = edata_len - CONF            # DER blob length (CTS: no padding)
+    if L - 2 < 0x80:
+        # short-form length; the third byte is the first content byte
+        # (inner SEQUENCE 0x30, or the [0] context tag 0xA0 of a
+        # PA-ENC-TS-ENC); byte 4 varies, so the window is 24 bits here
+        inner = 0xA0 if usage == USAGE_PA_TIMESTAMP else 0x30
+        exp = [tag_exp, L - 2, inner, 0x00]
+        msk = [tag_mask, 0xFF, 0xFF, 0x00]
+    elif L - 3 <= 0xFF:
+        exp = [tag_exp, 0x81, L - 3, 0x30]
+        msk = [tag_mask, 0xFF, 0xFF, 0xFF]
+    elif L - 4 <= 0xFFFF:
+        C = L - 4
+        exp = [tag_exp, 0x82, (C >> 8) & 0xFF, C & 0xFF]
+        msk = [tag_mask, 0xFF, 0xFF, 0xFF]
+    elif L - 5 <= 0xFFFFFF:
+        C = L - 5
+        exp = [tag_exp, 0x83, (C >> 16) & 0xFF, (C >> 8) & 0xFF]
+        msk = [tag_mask, 0xFF, 0xFF, 0xFF]
+    else:
+        raise ValueError("edata2 above 16 MB is not a ticket; use "
+                         "--device=cpu")
+    exp_w = sum(e << (8 * i) for i, e in enumerate(exp))
+    msk_w = sum(m << (8 * i) for i, m in enumerate(msk))
+    return exp_w & msk_w, msk_w
+
+
+def _words_to_bytes_be(words: jnp.ndarray) -> jnp.ndarray:
+    """uint32[B, W] big-endian words -> uint8[B, 4W] (SHA-1/PBKDF2
+    output serialization)."""
+    B, W = words.shape
+    shifts = jnp.asarray([24, 16, 8, 0], jnp.uint32)
+    return ((words[:, :, None] >> shifts[None, None, :])
+            & jnp.uint32(0xFF)).reshape(B, 4 * W).astype(jnp.uint8)
+
+
+def _dk_batch(base: jnp.ndarray, constant: bytes) -> jnp.ndarray:
+    """RFC 3961 DK with per-candidate base keys uint8[B, 16|32]:
+    chain ECB encryptions of the n-folded constant until key-length
+    bytes exist (1 block for AES-128, 2 for AES-256)."""
+    B, kl = base.shape
+    nf = nfold(constant, 16) if len(constant) != 16 else constant
+    block = jnp.broadcast_to(
+        jnp.asarray(np.frombuffer(nf, np.uint8)), (B, 16))
+    out = aes_encrypt_block_batch(base, block)
+    if kl == 16:
+        return out
+    out2 = aes_encrypt_block_batch(base, out)
+    return jnp.concatenate([out, out2], axis=1)
+
+
+def make_krb5aes_filter(length: int, params: dict):
+    """fb(cand, lens) -> uint32[B, 1] MASKED DER window (compare
+    against the masked expectation from der_filter_words_aes)."""
+    salt, key_len = params["salt"], params["key_len"]
+    usage, edata = params["usage"], params["edata"]
+    _, mask_w = der_filter_words_aes(len(edata), usage)
+    c1 = np.frombuffer(edata[:16], np.uint8)
+    c2 = np.frombuffer(edata[16:32], np.uint8).reshape(1, 16)
+    usage_const = usage.to_bytes(4, "big") + b"\xaa"
+
+    def fb(cand, lens):
+        key_words = pack_ops.pack_raw(cand, cand.shape[1],
+                                      big_endian=True)
+        istate, ostate = hmac_key_states(key_words)
+        t1 = pbkdf2_sha1_block(istate, ostate, salt, 1, 4096)
+        if key_len == 16:
+            base = _words_to_bytes_be(t1)[:, :16]
+        else:
+            t2 = pbkdf2_sha1_block(istate, ostate, salt, 2, 4096)
+            base = _words_to_bytes_be(
+                jnp.concatenate([t1, t2[:, :3]], axis=1))
+        kkey = _dk_batch(base, b"kerberos")
+        ke = _dk_batch(kkey, usage_const)
+        p2 = aes_decrypt_blocks(ke, c2)[:, 0] ^ jnp.asarray(c1)
+        word = (p2[:, 0].astype(jnp.uint32)
+                | (p2[:, 1].astype(jnp.uint32) << 8)
+                | (p2[:, 2].astype(jnp.uint32) << 16)
+                | (p2[:, 3].astype(jnp.uint32) << 24))
+        return (word & jnp.uint32(mask_w))[:, None]
+
+    return fb
+
+
+def _expected_word(t) -> jnp.ndarray:
+    exp_w, _ = der_filter_words_aes(len(t.params["edata"]),
+                                    t.params["usage"])
+    return jnp.asarray(np.array([exp_w], np.uint32))
+
+
+from dprf_tpu.engines.device.phpass import (PhpassMaskWorker,  # noqa: E402
+                                            ShardedPhpassMaskWorker)
+
+
+class Krb5AesMaskWorker(PhpassMaskWorker):
+    """Per-target sweep (salt/etype/edata are per-target constants,
+    so each target owns a compiled step)."""
+
+    def __init__(self, engine, gen, targets, batch: int = 1 << 13,
+                 hit_capacity: int = 64, oracle=None):
+        self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
+        self.batch = self.stride = batch
+        self._steps = []
+        for t in self.targets:
+            fb = make_krb5aes_filter(gen.length, t.params)
+            self._steps.append(_make_step(gen, batch, fb, hit_capacity))
+        self._targs = [(ti, _expected_word(t))
+                       for ti, t in enumerate(self.targets)]
+
+    def step(self, base, n_valid, ti: int, target):
+        return self._steps[ti](base, n_valid, target)
+
+
+def _make_step(gen, batch: int, fb, hit_capacity: int):
+    flat = gen.flat_charsets
+    length = gen.length
+
+    @jax.jit
+    def step(base_digits, n_valid, target):
+        cand = gen.decode_batch(base_digits, flat, batch)
+        lens = jnp.full((batch,), length, jnp.int32)
+        word = fb(cand, lens)
+        found = cmp_ops.compare_single(word, target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step
+
+
+class ShardedKrb5AesMaskWorker(ShardedPhpassMaskWorker):
+    def __init__(self, engine, gen, targets, mesh,
+                 batch_per_device: int = 1 << 11, hit_capacity: int = 64,
+                 oracle=None):
+        from dprf_tpu.parallel.sharded import \
+            make_sharded_pertarget_mask_step
+        self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
+        self.mesh = mesh
+        self.batch = self.stride = mesh.devices.size * batch_per_device
+        self._steps = [make_sharded_pertarget_mask_step(
+            gen, mesh, batch_per_device,
+            make_krb5aes_filter(gen.length, t.params), 0, hit_capacity)
+            for t in self.targets]
+        self._targs = [(ti, _expected_word(t))
+                       for ti, t in enumerate(self.targets)]
+
+    def step(self, base, n_valid, ti: int, target):
+        return self._steps[ti](base, n_valid, target)
+
+
+def _device_ok(targets) -> bool:
+    small = min(len(t.params["edata"]) for t in targets)
+    if small >= MIN_DEVICE_EDATA:
+        return True
+    from dprf_tpu.utils.logging import DEFAULT as log
+    log.warn("krb5 AES edata2 shorter than the CTS-safe device floor; "
+             "running on the CPU oracle", edata_bytes=small,
+             floor=MIN_DEVICE_EDATA)
+    return False
+
+
+class _JaxKrb5AesMixin:
+    def make_mask_worker(self, gen, targets, batch: int,
+                         hit_capacity: int, oracle=None):
+        if not _device_ok(targets):
+            from dprf_tpu.runtime.worker import CpuWorker
+            return CpuWorker(oracle or self, gen, targets)
+        return Krb5AesMaskWorker(self, gen, targets, batch=batch,
+                                 hit_capacity=hit_capacity,
+                                 oracle=oracle)
+
+    def make_sharded_mask_worker(self, gen, targets, mesh,
+                                 batch_per_device: int, hit_capacity: int,
+                                 oracle=None):
+        if not _device_ok(targets):
+            from dprf_tpu.runtime.worker import CpuWorker
+            return CpuWorker(oracle or self, gen, targets)
+        return ShardedKrb5AesMaskWorker(
+            self, gen, targets, mesh, batch_per_device=batch_per_device,
+            hit_capacity=hit_capacity, oracle=oracle)
+
+
+@register("krb5tgs17", device="jax")
+@register("krb5tgs18", device="jax")
+@register("krb5tgs-aes", device="jax")
+class JaxKrb5TgsAesEngine(_JaxKrb5AesMixin, Krb5TgsAesEngine):
+    pass
+
+
+@register("krb5pa17", device="jax")
+@register("krb5pa18", device="jax")
+@register("krb5pa", device="jax")
+class JaxKrb5PaAesEngine(_JaxKrb5AesMixin, Krb5PaAesEngine):
+    pass
+
+
+@register("krb5asrep17", device="jax")
+@register("krb5asrep18", device="jax")
+@register("krb5asrep-aes", device="jax")
+class JaxKrb5AsRepAesEngine(_JaxKrb5AesMixin, Krb5AsRepAesEngine):
+    pass

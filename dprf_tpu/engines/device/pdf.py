@@ -232,6 +232,7 @@ class PdfMaskWorker(PhpassMaskWorker):
                  hit_capacity: int = 64, oracle=None):
         from dprf_tpu.ops import pallas_krb5, pallas_pdf
         from dprf_tpu.ops.pallas_mask import pallas_mode
+        from dprf_tpu.ops.pallas_pdf import target_scalars
 
         self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
         mode = pallas_mode()
@@ -250,28 +251,19 @@ class PdfMaskWorker(PhpassMaskWorker):
                 interp = (mode or {}).get("interpret", False)
                 if mode is not None and pallas_pdf.pdf_kernel_eligible(
                         gen, *kind, on_hardware=not interp):
-                    try:
-                        step = pallas_pdf.make_pdf_crack_step(
+                    from dprf_tpu.engines.device._kernel_util import \
+                        kind_kernel_step
+                    from dprf_tpu.utils.sync import hard_sync
+                    scalars = target_scalars(t)
+                    step = kind_kernel_step(
+                        "pdf",
+                        lambda: pallas_pdf.make_pdf_crack_step(
                             gen, batch, *kind,
                             hit_capacity=hit_capacity,
-                            interpret=interp)
-                        # warmup INSIDE the try: the step is lazily
-                        # jitted, so the Mosaic compile (the failure
-                        # mode that must fall back to XLA) only fires
-                        # on first call -- force it now, per-kind,
-                        # with this kind's first target's scalars
-                        from dprf_tpu.ops.pallas_pdf import \
-                            target_scalars
-                        from dprf_tpu.utils.sync import hard_sync
-                        o, b2, x0, u = target_scalars(t)
-                        hard_sync(step(
+                            interpret=interp),
+                        lambda s: hard_sync(s(
                             jnp.zeros((gen.length,), jnp.int32),
-                            jnp.int32(0), o, b2, x0, u))
-                    except Exception as e:  # noqa: BLE001 -- compiler
-                        from dprf_tpu.utils.logging import DEFAULT as log
-                        log.warn("pdf kernel failed to build; using "
-                                 "the XLA step", error=str(e))
-                        step = None
+                            jnp.int32(0), *scalars)))
                 if step is None:
                     step = _make_step(gen, batch, *kind, hit_capacity)
                     kernel = False
@@ -281,7 +273,6 @@ class PdfMaskWorker(PhpassMaskWorker):
                 by_kind[kind] = (step, kernel)
             step, kernel = by_kind[kind]
             if kernel:
-                from dprf_tpu.ops.pallas_pdf import target_scalars
                 o, b2, x0, u = target_scalars(t)
                 self._kargs.append((step, (o, b2, x0), u))
             else:

@@ -237,14 +237,18 @@ class SevenZipMaskWorker(PhpassMaskWorker):
             step = None
             if mode is not None and sevenzip_kernel_eligible(
                     gen, t.params["cycles"], len(t.params["salt"])):
-                try:
-                    step = _make_kernel_step(
+                from dprf_tpu.engines.device._kernel_util import \
+                    kind_kernel_step
+                from dprf_tpu.utils.sync import hard_sync
+                tw = _crc_word(t)
+                step = kind_kernel_step(
+                    "7z KDF",
+                    lambda t=t: _make_kernel_step(
                         gen, batch, t.params, hit_capacity,
-                        interpret=mode.get("interpret", False))
-                except Exception as e:  # noqa: BLE001 -- compiler
-                    from dprf_tpu.utils.logging import DEFAULT as log
-                    log.warn("7z KDF kernel failed to build; using "
-                             "the XLA walker", error=str(e))
+                        interpret=mode.get("interpret", False)),
+                    lambda s, tw=tw: hard_sync(s(
+                        jnp.zeros((gen.length,), jnp.int32),
+                        jnp.int32(0), tw)))
             if step is None:
                 step = _make_step(gen, batch, t.params, hit_capacity)
             self._steps.append(step)

@@ -221,3 +221,49 @@ def aes_decrypt_blocks(keys: "jnp.ndarray",
 
 # back-compat name used by the office2007 device engine
 aes128_decrypt_blocks = aes_decrypt_blocks
+
+
+_SHIFT = np.array(
+    [0, 5, 10, 15, 4, 9, 14, 3, 8, 13, 2, 7, 12, 1, 6, 11], np.int32)
+
+
+def _mul23_table() -> np.ndarray:
+    """GF(2^8) multiply tables for the MixColumns coefficients
+    {2, 3}: uint8[2, 256] (the forward-cipher counterpart of
+    _mul_table's {9, 11, 13, 14})."""
+    out = np.zeros((2, 256), np.uint8)
+    for i, coef in enumerate((2, 3)):
+        for x in range(256):
+            out[i, x] = _gmul(coef, x)
+    return out
+
+
+def aes_encrypt_block_batch(keys: "jnp.ndarray",
+                            block: "jnp.ndarray") -> "jnp.ndarray":
+    """Per-candidate keys uint8[B, 16|32] + per-candidate plaintext
+    block uint8[B, 16] -> ciphertext uint8[B, 16].  The forward cipher
+    the RFC 3961 DK derivation chains (1-2 calls per derived key);
+    per-candidate plaintext because the second chain block IS the
+    prior per-candidate output."""
+    import jax.numpy as jnp
+
+    sbox, _, _ = _dev_tables()
+    mul23 = jnp.asarray(_mul23_table())
+    B = keys.shape[0]
+    rks = aes_key_schedule_batch(keys)
+    last = rks.shape[1] - 1
+    shift = jnp.asarray(_SHIFT)
+    s = block ^ rks[:, 0]
+    for rnd in range(1, last):
+        s = _take(sbox, s)[:, shift]
+        cols = s.reshape(B, 4, 4)
+        m2 = _take(mul23[0], cols)
+        m3 = _take(mul23[1], cols)
+        r0 = m2[..., 0] ^ m3[..., 1] ^ cols[..., 2] ^ cols[..., 3]
+        r1 = cols[..., 0] ^ m2[..., 1] ^ m3[..., 2] ^ cols[..., 3]
+        r2 = cols[..., 0] ^ cols[..., 1] ^ m2[..., 2] ^ m3[..., 3]
+        r3 = m3[..., 0] ^ cols[..., 1] ^ cols[..., 2] ^ m2[..., 3]
+        s = jnp.stack([r0, r1, r2, r3], axis=-1).reshape(B, 16)
+        s = s ^ rks[:, rnd]
+    s = _take(sbox, s)[:, shift]
+    return s ^ rks[:, last]

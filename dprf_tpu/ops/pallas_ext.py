@@ -8,13 +8,10 @@ The families this module covers all consume one or two 64-byte blocks
 of the exact same compression cores, so they reuse pallas_mask's
 decode machinery with a different message build / digest chain:
 
-Markov/scrambled charsets decode here through the UNBOUNDED segment
-mux (segment_tables), not pallas_mask's LUT input: a worst-case
-Markov ?a position costs ~190 extra VPU ops, comparable to one extra
-compression for the 2-block families -- a known ~2x worst-case decode
-overhead on Markov+nested jobs, accepted to keep these factories'
-input plumbing simple.  Wire position_tables through if that
-combination ever becomes a measured bottleneck.
+Markov/scrambled charsets decode here through the same lane-axis LUT
+input as pallas_mask (position_tables): these families are in the
+plain-mask speed class, where the unbounded segment mux's ~190 extra
+VPU ops per worst-case Markov ?a position would have cost up to 2x.
 
 - **salted** ``$pass.$salt`` / ``$salt.$pass`` md5/sha1/sha256
   (hashcat 10/20, 110/120, 1410/1420, plus postgres and LDAP {SSHA}
@@ -54,7 +51,7 @@ from dprf_tpu.ops.pallas_mask import (CORES, MAX_TARGETS, SET_SIZE, SUB,
                                       bloom_tables,
                                       check_batch,
                                       decode_candidate_bytes,
-                                      segment_tables,
+                                      position_tables,
                                       mask_supported, reduce_tile_hits,
                                       reduce_tile_maybes)
 
@@ -182,7 +179,7 @@ def _inner_big_endian(name: str) -> bool:
 
 def _build_ext_body(name: str, radices, seg_tables, length: int,
                     target, sub: int, order: Optional[str] = None,
-                    salt_len: int = 0):
+                    salt_len: int = 0, has_lut: bool = False):
     """Kernel math as a pure function.  Two shapes:
 
     - nested/mysql41 (order None): (pid, base, n_valid[, tables])
@@ -214,12 +211,16 @@ def _build_ext_body(name: str, radices, seg_tables, length: int,
                                  "target words")
 
     def body(pid, base, n_valid, *rest):
+        # rest order: [tables (multi) | salt, tgt (salted)] then, when
+        # the mask has LUT positions, the charset LUT rows LAST
+        rest = list(rest)
+        luts = rest.pop() if has_lut else None
         shape = (sub, 128)
         lane = (jax.lax.broadcasted_iota(jnp.int32, shape, 0) * 128
                 + jax.lax.broadcasted_iota(jnp.int32, shape, 1))
         carry = lane + pid * tile
         cand = decode_candidate_bytes(radices, seg_tables, length,
-                                      base, carry)
+                                      base, carry, luts)
         if salted:
             salt_ref, tgt_ref = rest
             salt_b = [salt_ref[j].astype(jnp.uint32)
@@ -265,22 +266,17 @@ def make_ext_pallas_fn(name: str, gen, target_words, batch: int,
     if not nested_eligible(name, gen,
                            target_words.shape[0] if multi else 1):
         raise ValueError(f"{name} mask job not ext-kernel-eligible")
-    seg_tables = segment_tables(gen.charsets)
+    seg_tables, luts_np = position_tables(gen.charsets)
+    has_lut = luts_np is not None
     body = _build_ext_body(name, gen.radices, seg_tables, gen.length,
-                           target_words, sub)
+                           target_words, sub, has_lut=has_lut)
 
-    if multi:
-        def kernel(base_ref, nvalid_ref, tables_ref, out_ref):
-            count, hit_lane = body(pl.program_id(0), base_ref,
-                                   nvalid_ref[0], tables_ref)
-            out_ref[...] = jnp.full((8, 128), (count << 16) | (hit_lane + 1),
-                                    jnp.int32)
-    else:
-        def kernel(base_ref, nvalid_ref, out_ref):
-            count, hit_lane = body(pl.program_id(0), base_ref,
-                                   nvalid_ref[0])
-            out_ref[...] = jnp.full((8, 128), (count << 16) | (hit_lane + 1),
-                                    jnp.int32)
+    def kernel(base_ref, nvalid_ref, *rest):
+        out_ref = rest[-1]
+        count, hit_lane = body(pl.program_id(0), base_ref,
+                               nvalid_ref[0], *rest[:-1])
+        out_ref[...] = jnp.full((8, 128), (count << 16) | (hit_lane + 1),
+                                jnp.int32)
 
     L = gen.length
     in_specs = [
@@ -291,6 +287,8 @@ def make_ext_pallas_fn(name: str, gen, target_words, batch: int,
         tables = bloom_tables(target_words)
         in_specs.append(pl.BlockSpec((tables.shape[0], 128),
                                      lambda i: (0, 0)))
+    if has_lut:
+        in_specs.append(pl.BlockSpec(luts_np.shape, lambda i: (0, 0)))
     raw = pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -300,10 +298,14 @@ def make_ext_pallas_fn(name: str, gen, target_words, batch: int,
         interpret=interpret,
     )
     tables_dev = jnp.asarray(tables) if multi else None
+    luts_dev = jnp.asarray(luts_np) if has_lut else None
 
     def fn(base_digits, n_valid):
-        args = (base_digits, n_valid, tables_dev) if multi else \
-            (base_digits, n_valid)
+        args = [base_digits, n_valid]
+        if multi:
+            args.append(tables_dev)
+        if has_lut:
+            args.append(luts_dev)
         (packed,) = raw(*args)
         p = packed[::8, 0:1]
         return p >> 16, (p & 0xFFFF) - 1
@@ -323,35 +325,45 @@ def make_salted_pallas_fn(algo: str, order: str, gen, batch: int,
     if not salted_eligible(algo, order, gen, [salt_len]):
         raise ValueError(f"{algo}-{order} mask job not kernel-eligible")
     n_words, _ = variant_words(algo)
-    seg_tables = segment_tables(gen.charsets)
+    seg_tables, luts_np = position_tables(gen.charsets)
+    has_lut = luts_np is not None
     body = _build_ext_body(algo, gen.radices, seg_tables, gen.length,
-                           None, sub, order=order, salt_len=salt_len)
+                           None, sub, order=order, salt_len=salt_len,
+                           has_lut=has_lut)
     SW = max(salt_len, 1)
 
-    def kernel(base_ref, nvalid_ref, salt_ref, tgt_ref, out_ref):
+    def kernel(base_ref, nvalid_ref, *rest):
+        out_ref = rest[-1]
         count, hit_lane = body(pl.program_id(0), base_ref,
-                               nvalid_ref[0], salt_ref, tgt_ref)
+                               nvalid_ref[0], *rest[:-1])
         out_ref[...] = jnp.full((8, 128), (count << 16) | (hit_lane + 1),
                                 jnp.int32)
 
     L = gen.length
+    in_specs = [
+        pl.BlockSpec((L,), lambda i: (0,), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1,), lambda i: (0,), memory_space=pltpu.SMEM),
+        pl.BlockSpec((SW,), lambda i: (0,), memory_space=pltpu.SMEM),
+        pl.BlockSpec((n_words,), lambda i: (0,),
+                     memory_space=pltpu.SMEM),
+    ]
+    if has_lut:
+        in_specs.append(pl.BlockSpec(luts_np.shape, lambda i: (0, 0)))
     raw = pl.pallas_call(
         kernel,
         grid=(grid,),
-        in_specs=[
-            pl.BlockSpec((L,), lambda i: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1,), lambda i: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((SW,), lambda i: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((n_words,), lambda i: (0,),
-                         memory_space=pltpu.SMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
         out_shape=[jax.ShapeDtypeStruct((grid * 8, 128), jnp.int32)],
         interpret=interpret,
     )
+    luts_dev = jnp.asarray(luts_np) if has_lut else None
 
     def fn(base_digits, n_valid, salt, target):
-        (packed,) = raw(base_digits, n_valid, salt[:SW], target)
+        args = [base_digits, n_valid, salt[:SW], target]
+        if has_lut:
+            args.append(luts_dev)
+        (packed,) = raw(*args)
         p = packed[::8, 0:1]
         return p >> 16, (p & 0xFFFF) - 1
 
@@ -427,12 +439,14 @@ def emulate_ext_kernel(name: str, gen, target_words, batch: int,
     tile = sub * 128
     if batch % tile:
         raise ValueError(f"batch {batch} not a multiple of tile {tile}")
-    seg_tables = segment_tables(gen.charsets)
+    seg_tables, luts_np = position_tables(gen.charsets)
+    has_lut = luts_np is not None
     salted = order is not None
     tables = None
     if salted:
         body = _build_ext_body(name, gen.radices, seg_tables, gen.length,
-                               None, sub, order=order, salt_len=len(salt))
+                               None, sub, order=order, salt_len=len(salt),
+                               has_lut=has_lut)
         target_words = np.asarray(target_words)
         extra = (jnp.asarray(np.frombuffer(salt, np.uint8)
                              .astype(np.int32)),
@@ -442,10 +456,12 @@ def emulate_ext_kernel(name: str, gen, target_words, batch: int,
         target_words = np.asarray(target_words)
         multi = target_words.ndim == 2 and target_words.shape[0] > 1
         body = _build_ext_body(name, gen.radices, seg_tables, gen.length,
-                               target_words, sub)
+                               target_words, sub, has_lut=has_lut)
         if multi:
             tables = jnp.asarray(bloom_tables(target_words))
         extra = (tables,) if multi else ()
+    if has_lut:
+        extra = extra + (jnp.asarray(luts_np),)
     base = jnp.asarray(base_digits, jnp.int32)
     counts, lanes = [], []
     for pid in range(batch // tile):

@@ -74,10 +74,9 @@ def krb5_kernel_eligible(gen, max_len: int = 27) -> bool:
             and mask_supported(gen.charsets))
 
 
-def _compress(state, m):
-    """md5_compress on lane-replicated word tuples (state 4, m 16)."""
-    out = md5_ops.md5_rounds(*state, m)
-    return tuple(x + s for x, s in zip(out, state))
+# lane-replicated MD5 compress now shared via pallas_mask (also used
+# by the PDF kernel); historical local name kept for the bodies below.
+from dprf_tpu.ops.pallas_mask import md5_compress_lanes as _compress  # noqa: E402
 
 
 def _hmac_md5(key4, msg_words, msg_len: int, shape):

@@ -247,6 +247,21 @@ def segment_tables(charsets: Sequence[bytes]) -> list:
     return [charset_segments(cs) for cs in charsets]
 
 
+def md5_init_lanes(shape):
+    """MD5 initial state as lane-replicated word tuples -- shared by
+    the kernel bodies that chain raw compressions (krb5 HMAC tower,
+    PDF Algorithm 2) rather than the one-shot digest cores above."""
+    return tuple(jnp.full(shape, jnp.uint32(int(w)))
+                 for w in md5_ops.INIT)
+
+
+def md5_compress_lanes(state, m):
+    """One MD5 compression on lane-replicated word tuples (state 4,
+    m 16) with the Davies-Meyer feed-forward."""
+    out = md5_ops.md5_rounds(*state, m)
+    return tuple(x + s for x, s in zip(out, state))
+
+
 def gather256(lo, hi, idx):
     """Per-sublane 256-entry lookup: table halves lo/hi uint32[sub, 128]
     with the ENTRY INDEX along lanes, idx uint32[sub, 128] in 0..255 ->

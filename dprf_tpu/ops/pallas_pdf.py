@@ -44,7 +44,6 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from dprf_tpu.engines.cpu.pdf import PAD
-from dprf_tpu.ops import md5 as md5_ops
 from dprf_tpu.ops import pallas_krb5 as _krb5
 from dprf_tpu.ops.pallas_mask import (decode_candidate_bytes,
                                       gather256, mask_supported,
@@ -78,14 +77,8 @@ def pdf_kernel_eligible(gen, rev: int, key_len: int,
             and rev >= 2 and key_len in (5, 16))
 
 
-def _compress(state, m):
-    out = md5_ops.md5_rounds(*state, m)
-    return tuple(x + s for x, s in zip(out, state))
-
-
-def _md5_init(shape):
-    return tuple(jnp.full(shape, jnp.uint32(int(w)))
-                 for w in md5_ops.INIT)
+from dprf_tpu.ops.pallas_mask import (  # noqa: E402 -- shared
+    md5_compress_lanes as _compress, md5_init_lanes as _md5_init)
 
 
 def _block1_words(byts, length: int, o_ref, shape):
@@ -327,11 +320,15 @@ def make_pdf_crack_step(gen, batch: int, rev: int, key_len: int,
 def target_scalars(target) -> tuple:
     """Target.params -> the kernel's four runtime SMEM arrays
     (o[8], b2[16], x0[4], u[4]); R2's u[0] carries the keystream
-    expectation U[0:4] ^ PAD[0:4] (stored U = RC4(key, PAD))."""
-    import hashlib
-    import struct
+    expectation U[0:4] ^ PAD[0:4] (stored U = RC4(key, PAD)).
 
-    from dprf_tpu.engines.device.pdf import _block2_words
+    PAIRED with engines/device/pdf._target_args: both marshal the same
+    $pdf$ params (there into the XLA step's argument layout, here into
+    flat SMEM scalars) via the shared _block2_words/_PAD_W0 — a format
+    change must touch both or the kernel and XLA paths diverge."""
+    import hashlib
+
+    from dprf_tpu.engines.device.pdf import _PAD_W0, _block2_words
 
     p = target.params
 
@@ -342,8 +339,7 @@ def target_scalars(target) -> tuple:
     b2 = jnp.asarray(_block2_words(p).view(np.int32))
     if p["rev"] == 2:
         x0 = jnp.zeros((4,), jnp.int32)
-        w0 = int.from_bytes(p["u"][:4], "little") ^ \
-            int.from_bytes(PAD[:4], "little")
+        w0 = int.from_bytes(p["u"][:4], "little") ^ _PAD_W0
         u = jnp.asarray(np.array([w0, 0, 0, 0], np.uint32)
                         .view(np.int32))
     else:

@@ -1,0 +1,202 @@
+"""Kerberos AES etype-17/18 engines (hashcat 19600/19700/19800/19900/
+32100): RFC vectors, forward construction, device-vs-oracle workers.
+"""
+
+import hashlib
+import hmac as hmac_mod
+import random
+
+import pytest
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.engines.cpu.krb5aes import (USAGE_AS_REP,
+                                          USAGE_PA_TIMESTAMP,
+                                          USAGE_TGS_REP_TICKET,
+                                          cts_decrypt, cts_encrypt,
+                                          nfold, string_to_key,
+                                          usage_keys)
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+@pytest.mark.smoke
+def test_nfold_rfc3961_vectors():
+    assert nfold(b"012345", 8).hex() == "be072631276b1955"
+    assert nfold(b"password", 7).hex() == "78a07b6caf85fa"
+    assert nfold(b"kerberos", 16).hex() == \
+        "6b65726265726f737b9b5b2b93132b93"
+    assert nfold(b"Rough Consensus, and Running Code", 8).hex() == \
+        "bb6ed30870b7f0e0"
+    assert nfold(b"password", 21).hex() == \
+        "59e4a8ca7c0385c3c37b3f6d2000247cb6e6bd5b3e"
+
+
+@pytest.mark.smoke
+def test_string_to_key_rfc3962_vectors():
+    """RFC 3962 appendix B (iteration counts that run fast)."""
+    s = b"ATHENA.MIT.EDUraeburn"
+    assert string_to_key(b"password", s, 16, iterations=1).hex() == \
+        "42263c6e89f4fc28b8df68ee09799f15"
+    assert string_to_key(b"password", s, 32, iterations=1).hex() == \
+        "fe697b52bc0d3ce14432ba036a92e65bbb52280990a2fa27883998d72af30161"
+    assert string_to_key(b"password", s, 16, iterations=2).hex() == \
+        "c651bf29e2300ac27fa469d693bdda13"
+    assert string_to_key(b"password", s, 32, iterations=1200).hex() == \
+        "55a6ac740ad17b4846941051e1e8b0a7548d93b0ab30a8bc3ff16280382b8c2a"
+
+
+@pytest.mark.smoke
+def test_cts_rfc3962_vectors():
+    """RFC 3962 appendix B AES-128-CBC-CS3 vectors (zero IV)."""
+    key = bytes.fromhex("636869636b656e207465726979616b69")
+    cases = [
+        ("I would like the ",
+         "c6353568f2bf8cb4d8a580362da7ff7f97"),
+        ("I would like the General Gau's ",
+         "fc00783e0efdb2c1d445d4c8eff7ed22"
+         "97687268d6ecccc0c07b25e25ecfe5"),
+        ("I would like the General Gau's C",
+         "39312523a78662d5be7fcbcc98ebf5a8"
+         "97687268d6ecccc0c07b25e25ecfe584"),
+        ("I would like the General Gau's Chicken, please,",
+         "97687268d6ecccc0c07b25e25ecfe584"
+         "b3fffd940c16a18c1b5549d2f838029e"
+         "39312523a78662d5be7fcbcc98ebf5"),
+        ("I would like the General Gau's Chicken, please, ",
+         "97687268d6ecccc0c07b25e25ecfe584"
+         "9dad8bbb96c4cdc03bc103e1a194bbd8"
+         "39312523a78662d5be7fcbcc98ebf5a8"),
+    ]
+    for pt, want in cases:
+        assert cts_encrypt(key, pt.encode()).hex() == want, len(pt)
+        assert cts_decrypt(key, bytes.fromhex(want)) == pt.encode()
+
+
+def _der_blob(body_len: int, tag: int, fill: int) -> bytes:
+    """A DER blob [tag] len <body> whose total length the filter can
+    predict; body starts with a SEQUENCE so the window matches."""
+    body = bytes([0x30, 0x82]) + (body_len - 2).to_bytes(2, "big") + \
+        bytes((fill + i) % 256 for i in range(body_len - 4))
+    total = len(body)
+    assert total <= 0xFFFF
+    return bytes([tag, 0x82]) + total.to_bytes(2, "big") + body
+
+
+def _line(pw: bytes, tag_name: str, etype: int, usage: int,
+          seed: int = 3, body_len: int = 400,
+          user: str = "svc", realm: str = "EXAMPLE.COM") -> str:
+    """Self-consistent hash line: run RFC 3962 forward with the true
+    password and a deterministic DER plaintext, store checksum+edata."""
+    rng = random.Random(seed)
+    conf = bytes(rng.randrange(256) for _ in range(16))
+    app_tag = {USAGE_TGS_REP_TICKET: 0x63, USAGE_AS_REP: 0x79,
+               USAGE_PA_TIMESTAMP: 0x30}[usage]
+    if usage == USAGE_PA_TIMESTAMP:
+        inner = (b"\xa0\x11\x18\x0f20260731120000Z"
+                 b"\xa1\x05\x02\x03\x01\xe2\x40")
+        plain = conf + bytes([0x30, len(inner)]) + inner
+    else:
+        plain = conf + _der_blob(body_len, app_tag, seed)
+    salt = (realm + user).encode()
+    key = string_to_key(pw, salt, 16 if etype == 17 else 32)
+    ke, ki = usage_keys(key, usage)
+    edata = cts_encrypt(ke, plain)
+    chk = hmac_mod.new(ki, plain, hashlib.sha1).digest()[:12]
+    return (f"${tag_name}${etype}${user}${realm}${chk.hex()}$"
+            f"{edata.hex()}")
+
+
+@pytest.mark.parametrize("etype", [17, 18])
+def test_oracle_roundtrip_and_parse(etype):
+    pw = b"Spr1ng"
+    cpu = get_engine("krb5tgs-aes", device="cpu")
+    t = cpu.parse_target(_line(pw, "krb5tgs", etype,
+                               USAGE_TGS_REP_TICKET))
+    assert t.params["etype"] == etype
+    assert t.params["key_len"] == (16 if etype == 17 else 32)
+    assert cpu.verify(pw, t) and not cpu.verify(b"nope", t)
+
+
+def test_parse_errors():
+    cpu = get_engine("krb5tgs-aes", device="cpu")
+    with pytest.raises(ValueError):
+        cpu.parse_target("$krb5tgs$23$a$B$" + "00" * 12 + "$" + "00" * 40)
+    with pytest.raises(ValueError):
+        cpu.parse_target("$krb5tgs$17$a$B$00$" + "00" * 40)   # short chk
+    with pytest.raises(ValueError):
+        cpu.parse_target("not-a-line")
+
+
+@pytest.mark.smoke
+@pytest.mark.parametrize("etype", [17, 18])
+def test_mask_worker_end_to_end_tgs(etype):
+    dev = get_engine("krb5tgs-aes", device="jax")
+    cpu = get_engine("krb5tgs-aes", device="cpu")
+    gen = MaskGenerator("?l?d?l")
+    secret = gen.candidate(1744)
+    t = dev.parse_target(_line(secret, "krb5tgs", etype,
+                               USAGE_TGS_REP_TICKET))
+    w = dev.make_mask_worker(gen, [t], batch=512, hit_capacity=8,
+                             oracle=cpu)
+    assert type(w).__name__ == "Krb5AesMaskWorker"
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.cand_index, h.plaintext)
+            for h in hits] == [(0, 1744, secret)]
+
+
+def test_mask_worker_asrep_and_pa_fallback():
+    # AS-REP big ticket: device path with the 0x79/0x7A tag mask
+    dev = get_engine("krb5asrep-aes", device="jax")
+    cpu = get_engine("krb5asrep-aes", device="cpu")
+    gen = MaskGenerator("?d?d?d")
+    s1 = gen.candidate(271)
+    t1 = dev.parse_target(_line(s1, "krb5asrep", 18, USAGE_AS_REP,
+                                seed=9))
+    w = dev.make_mask_worker(gen, [t1], batch=256, hit_capacity=8,
+                             oracle=cpu)
+    assert type(w).__name__ == "Krb5AesMaskWorker"
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, s1)]
+
+    # Pre-Auth timestamp: edata below the CTS-safe floor -> CPU worker
+    # (tiny keyspace: the pure-python oracle runs the full PBKDF2+DK
+    # chain per candidate)
+    pa = get_engine("krb5pa", device="jax")
+    pa_cpu = get_engine("krb5pa", device="cpu")
+    gen2 = MaskGenerator("?d?d")
+    secret = gen2.candidate(88)
+    t2 = pa.parse_target(_line(secret, "krb5pa", 18,
+                               USAGE_PA_TIMESTAMP, seed=4))
+    w2 = pa.make_mask_worker(gen2, [t2], batch=256, hit_capacity=8,
+                             oracle=pa_cpu)
+    assert type(w2).__name__ == "CpuWorker"
+    hits2 = w2.process(WorkUnit(0, 0, gen2.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits2] == \
+        [(0, secret)]
+
+
+def test_sharded_worker():
+    import jax
+
+    from dprf_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) >= 8
+    dev = get_engine("krb5tgs-aes", device="jax")
+    cpu = get_engine("krb5tgs-aes", device="cpu")
+    gen = MaskGenerator("?d?l")
+    secret = gen.candidate(133)
+    t = dev.parse_target(_line(secret, "krb5tgs", 18,
+                               USAGE_TGS_REP_TICKET, seed=6))
+    w = dev.make_sharded_mask_worker(gen, [t], make_mesh(8),
+                                     batch_per_device=32,
+                                     hit_capacity=8, oracle=cpu)
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
+
+
+def test_engine_listing_symmetry():
+    from dprf_tpu.engines import engine_names
+    for name in ("krb5tgs-aes", "krb5tgs17", "krb5tgs18", "krb5pa",
+                 "krb5asrep-aes"):
+        assert name in engine_names("cpu")
+        assert name in engine_names("jax")

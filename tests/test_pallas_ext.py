@@ -191,3 +191,36 @@ def test_eligibility_rules():
     assert not pe.salted_eligible("md5", "ps", gen, [])
     assert not pe.salted_eligible("md5", "ps", gen,
                                   list(range(1, 10)))     # 9 lengths
+
+
+def test_nested_and_salted_kernels_markov_mask():
+    """Markov-permuted charsets ride the ext kernels through the same
+    lane-axis LUT input as pallas_mask (r5): planted hits at exact
+    indices for a nested and a salted variant, interpret mode."""
+    counts = np.zeros((4, 256), np.uint64)
+    rng = np.random.RandomState(5)
+    counts[:, :] = rng.randint(1, 10**6, (4, 256))
+    gen = MaskGenerator("?l?l?d", markov_counts=counts)
+    from dprf_tpu.ops.pallas_mask import position_tables
+    assert position_tables(gen.charsets)[1] is not None   # LUT in play
+
+    plant = pe.SUB * 128 + 9          # tile 1, lane 9
+    tw = _tw("md5(md5)", gen.candidate(plant))
+    fn = pe.make_ext_pallas_fn("md5(md5)", gen, tw, BATCH * 2,
+                               interpret=True)
+    hits, total = _run_fn(fn, gen, n_valid=BATCH * 2)
+    assert hits == [plant] and total == 1
+
+    import hashlib as _hl
+    salt = b"na"
+    plain = gen.candidate(plant)
+    tw2 = np.frombuffer(_hl.md5(plain + salt).digest(),
+                        "<u4").astype(np.uint32)
+    fn2 = pe.make_salted_pallas_fn("md5", "ps", gen, BATCH * 2,
+                                   len(salt), interpret=True)
+    salt_dev = jnp.asarray(np.frombuffer(salt, np.uint8)
+                           .astype(np.int32))
+    tgt_dev = jnp.asarray(tw2.view(np.int32))
+    hits2, total2 = _run_fn(fn2, gen, salt_dev, tgt_dev,
+                            n_valid=BATCH * 2)
+    assert hits2 == [plant] and total2 == 1

@@ -1,0 +1,153 @@
+"""Minimal repro for the Mosaic compile-helper SIGABRT on long
+statically-unrolled gather/select chains (TPU_PROBE_LOG_r04 finding 9).
+
+The krb5 kernel's unrolled 256-step RC4 KSA — each step one
+per-sublane `take_along_axis` gather plus lane-iota selects on an
+(SUB, 128) tile — crashes the remote `tpu_compile_helper` with SIGABRT
+at every SUB tried, while the `lax.fori_loop` form of the SAME math
+compiles in ~10 s.  This tool strips the repro to its skeleton: an
+N-step unrolled chain of
+
+    j   = (j + S[j]) & 255        # data-dependent per-sublane gather
+    S   = select(lane == i%128, j, S)   # lane-iota "swap" write
+
+with NOTHING else (no hashes, no key schedule, no second table half),
+so the platform bug can be reported upstream and retried on newer
+toolchains with one command.
+
+Usage:
+  python tools/mosaic_unroll_repro.py <steps> [sub]   # one point
+  python tools/mosaic_unroll_repro.py --bisect [sub]  # smallest failing N
+
+Each point runs in its OWN subprocess (the crash is a clean HTTP 500 /
+SIGABRT per finding 9 — no tunnel wedge — but the client backend is
+poisoned afterwards, so isolation is still mandatory).  Results append
+to TPU_CASES_OUT (default /tmp/tpu_cases.jsonl) as
+{"case": "unrollrepro-<steps>-<sub>", "ok": bool, ...}.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.environ.get("TPU_CASES_OUT", "/tmp/tpu_cases.jsonl")
+
+
+def emit(doc):
+    with open(OUT, "a") as f:
+        f.write(json.dumps(doc) + "\n")
+
+
+def run_point(steps: int, sub: int) -> dict:
+    """Build + compile + run the N-step unrolled chain (in-process:
+    callers isolate via subprocess)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    from dprf_tpu.utils.sync import hard_sync
+
+    shape = (sub, 128)
+
+    def kernel(out_ref):
+        lane = lax.broadcasted_iota(jnp.int32, shape, 1)
+        S = lane.astype(jnp.uint32)
+        j = jnp.zeros(shape, jnp.uint32)
+        for i in range(steps):          # the statically-unrolled chain
+            idx7 = (j & jnp.uint32(127)).astype(jnp.int32)
+            sj = jnp.take_along_axis(S, idx7, axis=1)
+            j = (j + sj + jnp.uint32(i)) & jnp.uint32(255)
+            S = jnp.where(lane == i % 128, j, S)
+        out_ref[...] = S[:8] if sub >= 8 else jnp.broadcast_to(
+            S[:1], (8, 128))
+
+    fn = pl.pallas_call(
+        kernel,
+        out_specs=[pl.BlockSpec((8, 128), lambda: (0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((8, 128), jnp.uint32)],
+    )
+    t0 = time.perf_counter()
+    (out,) = fn()
+    hard_sync(out)
+    return {"compile_run_s": round(time.perf_counter() - t0, 1)}
+
+
+def run_isolated(steps: int, sub: int, timeout_s: int = 420) -> dict:
+    """One (steps, sub) point in a child process; never killed early
+    unless it exceeds timeout_s (compile hangs are finding-8 territory
+    and the caller should stop bisecting immediately)."""
+    case = f"unrollrepro-{steps}-{sub}"
+    code = (f"import sys; sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r});"
+            f"from tools.mosaic_unroll_repro import run_point;"
+            f"import json; print('REPRO_JSON:' + json.dumps(run_point({steps}, {sub})))")
+    t0 = time.time()
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        doc = {"case": case, "ok": False, "outcome": "TIMEOUT",
+               "timeout_s": timeout_s,
+               "warning": "possible compile HANG (finding-8 class): "
+                          "stop probing, check tunnel health"}
+        emit(doc)
+        return doc
+    outcome, extra = "CRASH", {}
+    for line in proc.stdout.splitlines():
+        if line.startswith("REPRO_JSON:"):
+            outcome = "OK"
+            extra = json.loads(line[len("REPRO_JSON:"):])
+    doc = {"case": case, "ok": outcome == "OK", "outcome": outcome,
+           "rc": proc.returncode, "elapsed_s": round(time.time() - t0, 1),
+           **extra}
+    if outcome == "CRASH":
+        doc["stderr_tail"] = proc.stderr[-500:]
+    emit(doc)
+    return doc
+
+
+def bisect(sub: int) -> None:
+    """Smallest failing step count in [2, 256] (lo always compiles,
+    hi is the known-SIGABRT production shape)."""
+    lo, hi = 2, 256            # invariant: lo OK, hi CRASH (verified)
+    d = run_isolated(hi, sub)
+    if d["ok"]:
+        print(json.dumps({"result": "256-step chain now COMPILES -- "
+                          "toolchain fixed? re-enable DPRF_KRB5_UNROLL "
+                          "and re-measure", "sub": sub}))
+        return
+    if d["outcome"] == "TIMEOUT":
+        return
+    d = run_isolated(lo, sub)
+    if not d["ok"]:
+        print(json.dumps({"result": "even 2 steps fail", "sub": sub}))
+        return
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        d = run_isolated(mid, sub)
+        if d["outcome"] == "TIMEOUT":
+            return
+        lo, hi = (mid, hi) if d["ok"] else (lo, mid)
+        print(f"bisect: OK<= {lo}, CRASH>= {hi}", file=sys.stderr)
+    print(json.dumps({"result": "minimal failing unroll length",
+                      "sub": sub, "last_ok": lo, "first_crash": hi}))
+    emit({"case": f"unrollrepro-bisect-{sub}", "ok": True,
+          "last_ok": lo, "first_crash": hi})
+
+
+def main():
+    if sys.argv[1] == "--bisect":
+        bisect(int(sys.argv[2]) if len(sys.argv) > 2 else 32)
+    else:
+        steps = int(sys.argv[1])
+        sub = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+        print(json.dumps(run_isolated(steps, sub)))
+
+
+if __name__ == "__main__":
+    main()
