@@ -84,6 +84,10 @@ def parse_krb5tgs(text: str) -> tuple[bytes, bytes]:
     account metadata is optional) -> (checksum, edata2)."""
     t = text.strip()
     if not t.startswith("$krb5tgs$23$"):
+        if t.startswith(("$krb5tgs$17$", "$krb5tgs$18$")):
+            raise ValueError("etype-17/18 ticket: use --engine "
+                             "krb5tgs-aes (AES modes), not the "
+                             "etype-23 RC4 engine")
         raise ValueError(f"not a $krb5tgs$23$ line: {text[:40]!r}")
     rest = t[len("$krb5tgs$23$"):]
     if rest.startswith("*"):

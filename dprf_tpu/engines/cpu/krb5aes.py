@@ -179,19 +179,28 @@ def krb5_aes_checksum(password: bytes, salt: bytes, key_len: int,
 # krb5pa / krb5asrep variants (hashcat 19600/19700/19800/19900/32100)
 
 def parse_krb5aes(text: str, tag: str) -> tuple[int, bytes, bytes, bytes]:
-    """-> (etype, salt, checksum12, edata2)."""
+    """-> (etype, salt, checksum12, edata2).
+
+    checksum/edata2 are parsed from the RIGHT and user/realm split at
+    the last middle '$', so principals containing '$' (AD machine
+    accounts like WS01$) parse; realm names cannot contain '$'."""
     text = text.strip()
-    parts = text.split("$")
-    # ['', 'krb5tgs', '17', user, realm, checksum, edata2]
-    if len(parts) != 7 or parts[0] or parts[1] != tag:
+    for et in ("17", "18"):
+        prefix = f"${tag}${et}$"
+        if text.startswith(prefix):
+            etype = int(et)
+            rest = text[len(prefix):]
+            break
+    else:
         raise ValueError(f"not a ${tag}$17/18 line")
-    if parts[2] not in ("17", "18"):
-        raise ValueError(f"${tag}$: etype must be 17 or 18, "
-                         f"got {parts[2]!r}")
-    etype = int(parts[2])
-    user, realm = parts[3], parts[4]
-    checksum = bytes.fromhex(parts[5])
-    edata = bytes.fromhex(parts[6])
+    try:
+        middle, chk_hex, edata_hex = rest.rsplit("$", 2)
+        user, realm = middle.rsplit("$", 1)
+    except ValueError:
+        raise ValueError(f"${tag}$: expected user$realm$checksum$"
+                         "edata2 fields") from None
+    checksum = bytes.fromhex(chk_hex)
+    edata = bytes.fromhex(edata_hex)
     if len(checksum) != 12:
         raise ValueError(f"${tag}$: checksum must be 12 bytes")
     if len(edata) < MIN_EDATA:

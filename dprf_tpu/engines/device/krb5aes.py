@@ -23,8 +23,11 @@ the full oracle):
   DER window); the coordinator oracle-verifies each with the full
   CTS + HMAC-SHA1-96 chain, mirroring the etype-23 design.
 
-Wordlist attacks run on the CPU oracle (variable-length HMAC keys);
-mask + sharded mask are the device paths.
+Mask, wordlist+rules, and sharded mask all run on device (variable
+candidate lengths flow through pack_raw_varlen into the HMAC key
+block); jobs fall back to the CPU oracle only when a target's edata2
+sits below the CTS-safe floor or a wordlist exceeds the one-block
+HMAC key budget (55 bytes).
 """
 
 from __future__ import annotations
@@ -41,7 +44,6 @@ from dprf_tpu.engines.cpu.krb5aes import (Krb5AsRepAesEngine,
                                           USAGE_PA_TIMESTAMP,
                                           USAGE_TGS_REP_TICKET, nfold)
 from dprf_tpu.ops import compare as cmp_ops
-from dprf_tpu.ops import pack as pack_ops
 from dprf_tpu.ops.aes import aes_decrypt_blocks, aes_encrypt_block_batch
 from dprf_tpu.ops.hmac_sha1 import hmac_key_states, pbkdf2_sha1_block
 
@@ -121,9 +123,12 @@ def _dk_batch(base: jnp.ndarray, constant: bytes) -> jnp.ndarray:
     return jnp.concatenate([out, out2], axis=1)
 
 
-def make_krb5aes_filter(length: int, params: dict):
+def make_krb5aes_filter(params: dict):
     """fb(cand, lens) -> uint32[B, 1] MASKED DER window (compare
-    against the masked expectation from der_filter_words_aes)."""
+    against the masked expectation from der_filter_words_aes);
+    candidate lengths arrive at trace time via `lens` (varlen HMAC
+    keys), so the filter serves mask, wordlist, and sharded steps
+    alike."""
     salt, key_len = params["salt"], params["key_len"]
     usage, edata = params["usage"], params["edata"]
     _, mask_w = der_filter_words_aes(len(edata), usage)
@@ -132,8 +137,8 @@ def make_krb5aes_filter(length: int, params: dict):
     usage_const = usage.to_bytes(4, "big") + b"\xaa"
 
     def fb(cand, lens):
-        key_words = pack_ops.pack_raw(cand, cand.shape[1],
-                                      big_endian=True)
+        from dprf_tpu.ops.hmac import pack_raw_varlen
+        key_words = pack_raw_varlen(cand, lens, big_endian=True)
         istate, ostate = hmac_key_states(key_words)
         t1 = pbkdf2_sha1_block(istate, ostate, salt, 1, 4096)
         if key_len == 16:
@@ -161,23 +166,51 @@ def _expected_word(t) -> jnp.ndarray:
 
 
 from dprf_tpu.engines.device.phpass import (PhpassMaskWorker,  # noqa: E402
+                                            PhpassWordlistWorker,
                                             ShardedPhpassMaskWorker)
 
 
 class Krb5AesMaskWorker(PhpassMaskWorker):
     """Per-target sweep (salt/etype/edata are per-target constants,
-    so each target owns a compiled step)."""
+    so each target owns a compiled step).  A target whose edata2 sits
+    below the CTS-safe floor gets a HOST pseudo-step (full oracle over
+    the unit) instead of demoting the whole job: mixed hashlists keep
+    every CTS-safe target on the device path."""
 
     def __init__(self, engine, gen, targets, batch: int = 1 << 13,
                  hit_capacity: int = 64, oracle=None):
         self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
         self.batch = self.stride = batch
         self._steps = []
-        for t in self.targets:
-            fb = make_krb5aes_filter(gen.length, t.params)
+        for ti, t in enumerate(self.targets):
+            if len(t.params["edata"]) < MIN_DEVICE_EDATA:
+                self._steps.append(self._host_step(ti))
+                continue
+            fb = make_krb5aes_filter(t.params)
             self._steps.append(_make_step(gen, batch, fb, hit_capacity))
         self._targs = [(ti, _expected_word(t))
                        for ti, t in enumerate(self.targets)]
+
+    def _host_step(self, ti: int):
+        """Oracle scan with the jitted-step output contract; the base
+        sweep's int()/np.asarray() reads work on plain numpy."""
+        t = self.targets[ti]
+        oracle = self.oracle or self.engine
+
+        def step(base_digits, n_valid, target):
+            digits = [int(d) for d in np.asarray(base_digits)]
+            start = 0
+            for d, r in zip(digits, self.gen.radices):
+                start = start * r + d
+            n = int(n_valid)
+            lanes = [i for i in range(n)
+                     if oracle.verify(self.gen.candidate(start + i), t)]
+            buf = np.full((self.hit_capacity,), -1, np.int32)
+            buf[:len(lanes)] = lanes[:self.hit_capacity]
+            return (np.int32(len(lanes)), buf,
+                    np.zeros_like(buf))
+
+        return step
 
     def step(self, base, n_valid, ti: int, target):
         return self._steps[ti](base, n_valid, target)
@@ -200,6 +233,32 @@ def _make_step(gen, batch: int, fb, hit_capacity: int):
     return step
 
 
+class Krb5AesWordlistWorker(PhpassWordlistWorker):
+    """Wordlist+rules on device — the realistic Kerberoasting attack
+    shape; per-target compiled steps (the shared scaffold of
+    phpass.make_pertarget_wordlist_step with this engine's filter;
+    variable candidate lengths flow into pack_raw_varlen)."""
+
+    def __init__(self, engine, gen, targets, batch: int = 1 << 13,
+                 hit_capacity: int = 64, oracle=None):
+        from dprf_tpu.engines.device.phpass import \
+            make_pertarget_wordlist_step
+        self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
+        self.batch = batch
+        self.word_batch = max(1, batch // gen.n_rules)
+        self.stride = self.word_batch * gen.n_rules
+        self._steps = [
+            make_pertarget_wordlist_step(
+                gen, self.word_batch, make_krb5aes_filter(t.params),
+                hit_capacity)
+            for t in self.targets]
+        self._targs = [(ti, _expected_word(t))
+                       for ti, t in enumerate(self.targets)]
+
+    def step(self, w0, n_valid, ti: int, target):
+        return self._steps[ti](w0, n_valid, target)
+
+
 class ShardedKrb5AesMaskWorker(ShardedPhpassMaskWorker):
     def __init__(self, engine, gen, targets, mesh,
                  batch_per_device: int = 1 << 11, hit_capacity: int = 64,
@@ -211,7 +270,7 @@ class ShardedKrb5AesMaskWorker(ShardedPhpassMaskWorker):
         self.batch = self.stride = mesh.devices.size * batch_per_device
         self._steps = [make_sharded_pertarget_mask_step(
             gen, mesh, batch_per_device,
-            make_krb5aes_filter(gen.length, t.params), 0, hit_capacity)
+            make_krb5aes_filter(t.params), 0, hit_capacity)
             for t in self.targets]
         self._targs = [(ti, _expected_word(t))
                        for ti, t in enumerate(self.targets)]
@@ -220,26 +279,40 @@ class ShardedKrb5AesMaskWorker(ShardedPhpassMaskWorker):
         return self._steps[ti](base, n_valid, target)
 
 
-def _device_ok(targets) -> bool:
-    small = min(len(t.params["edata"]) for t in targets)
-    if small >= MIN_DEVICE_EDATA:
-        return True
-    from dprf_tpu.utils.logging import DEFAULT as log
-    log.warn("krb5 AES edata2 shorter than the CTS-safe device floor; "
-             "running on the CPU oracle", edata_bytes=small,
-             floor=MIN_DEVICE_EDATA)
-    return False
+def _device_ok(targets, any_ok: bool = False) -> bool:
+    """False when the job must demote to the CPU oracle.  With
+    any_ok (the mask sweep, which routes below-floor targets to host
+    pseudo-steps per target), one CTS-safe target keeps the device
+    worker; the wordlist/sharded scaffolds demote on any short
+    target."""
+    sizes = [len(t.params["edata"]) for t in targets]
+    ok = (max(sizes) if any_ok else min(sizes)) >= MIN_DEVICE_EDATA
+    if not ok:
+        from dprf_tpu.utils.logging import DEFAULT as log
+        log.warn("krb5 AES edata2 shorter than the CTS-safe device "
+                 "floor; running on the CPU oracle",
+                 edata_bytes=min(sizes), floor=MIN_DEVICE_EDATA)
+    return ok
 
 
 class _JaxKrb5AesMixin:
     def make_mask_worker(self, gen, targets, batch: int,
                          hit_capacity: int, oracle=None):
-        if not _device_ok(targets):
+        if not _device_ok(targets, any_ok=True):
             from dprf_tpu.runtime.worker import CpuWorker
             return CpuWorker(oracle or self, gen, targets)
         return Krb5AesMaskWorker(self, gen, targets, batch=batch,
                                  hit_capacity=hit_capacity,
                                  oracle=oracle)
+
+    def make_wordlist_worker(self, gen, targets, batch: int,
+                             hit_capacity: int, oracle=None):
+        if not _device_ok(targets) or gen.max_len > 55:
+            from dprf_tpu.runtime.worker import CpuWorker
+            return CpuWorker(oracle or self, gen, targets)
+        return Krb5AesWordlistWorker(self, gen, targets, batch=batch,
+                                     hit_capacity=hit_capacity,
+                                     oracle=oracle)
 
     def make_sharded_mask_worker(self, gen, targets, mesh,
                                  batch_per_device: int, hit_capacity: int,

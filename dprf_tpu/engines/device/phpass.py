@@ -100,14 +100,18 @@ def make_phpass_mask_step(gen, batch: int, hit_capacity: int = 64):
     return step
 
 
-def make_phpass_wordlist_step(gen, word_batch: int, hit_capacity: int = 64):
+def make_pertarget_wordlist_step(gen, word_batch: int, digest_fn,
+                                 hit_capacity: int = 64):
+    """Generic wordlist+rules step for per-target-sweep engines: the
+    on-device scaffold (packed-wordlist slice -> rule expansion ->
+    digest -> compare -> compact) with the engine's math injected as
+    `digest_fn(cand, lens, *params)` — the same contract as
+    parallel/sharded.make_sharded_pertarget_mask_step, so an engine
+    writes its filter once for both.  The LAST step argument is the
+    target word vector: step(w0, n_valid_words, *params, target)."""
     from dprf_tpu.ops.rules_pipeline import expand_rules
 
     B, L = word_batch, gen.max_len
-    if gen.max_len > MAX_PASS_LEN:
-        raise ValueError(
-            f"wordlist max_len {gen.max_len} exceeds this engine's "
-            f"{MAX_PASS_LEN}-byte single-block budget")
     words_np, lens_np = gen.packed_words(pad_to=B,
                                          min_size=gen.n_words + B - 1)
     words_dev = jnp.asarray(words_np)
@@ -115,17 +119,28 @@ def make_phpass_wordlist_step(gen, word_batch: int, hit_capacity: int = 64):
     rules = gen.rules
 
     @jax.jit
-    def step(w0, n_valid_words, salt, count, target):
+    def step(w0, n_valid_words, *args):
+        *params, target = args
         wslice = lax.dynamic_slice(words_dev, (w0, 0), (B, L))
         lslice = lax.dynamic_slice(lens_dev, (w0,), (B,))
         base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
         cw, cl, cv = expand_rules(rules, wslice, lslice, base_valid, L)
-        digest = phpass_digest_batch(cw, cl, salt, count)
+        digest = digest_fn(cw, cl, *params)
         found = cmp_ops.compare_single(digest, target) & cv
         return cmp_ops.compact_hits(found, jnp.zeros_like(cl),
                                     hit_capacity)
 
     return step
+
+
+def make_phpass_wordlist_step(gen, word_batch: int, hit_capacity: int = 64):
+    if gen.max_len > MAX_PASS_LEN:
+        raise ValueError(
+            f"wordlist max_len {gen.max_len} exceeds this engine's "
+            f"{MAX_PASS_LEN}-byte single-block budget")
+    return make_pertarget_wordlist_step(gen, word_batch,
+                                        phpass_digest_batch,
+                                        hit_capacity)
 
 
 def make_sharded_phpass_mask_step(gen, mesh, batch_per_device: int,

@@ -221,10 +221,11 @@ def position_tables(charsets: Sequence[bytes]):
     (arithmetic mux) or ("lut", k) markers, and luts is the stacked
     uint32[2 * n_lut, 128] LUT array (None when every position is
     arithmetic).  pallas_call forbids captured vector constants, so
-    the LUT rides as a kernel INPUT; the heavy kernel families
-    (krb5/pdf/7z/pbkdf2/keccak/ext) instead run the segment mux
-    UNBOUNDED -- up to ~2 ops per contiguous run per position, noise
-    next to their per-candidate work -- via segment_tables below."""
+    the LUT rides as a kernel INPUT (this module's fast cores and the
+    pallas_ext salted/nested kernels); the heavy kernel families
+    (krb5/pdf/7z/pbkdf2/keccak) instead run the segment mux UNBOUNDED
+    -- up to ~2 ops per contiguous run per position, noise next to
+    their per-candidate work -- via segment_tables below."""
     proc, luts = [], []
     for cs in charsets:
         segs = charset_segments(cs)

@@ -124,6 +124,8 @@ def bisect(sub: int) -> None:
     if d["outcome"] == "TIMEOUT":
         return
     d = run_isolated(lo, sub)
+    if d["outcome"] == "TIMEOUT":
+        return                # finding-8-class hang: stop probing
     if not d["ok"]:
         print(json.dumps({"result": "even 2 steps fail", "sub": sub}))
         return
