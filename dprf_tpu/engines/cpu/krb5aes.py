@@ -166,9 +166,10 @@ def cts_encrypt(key: bytes, plain: bytes) -> bytes:
 
 
 def krb5_aes_checksum(password: bytes, salt: bytes, key_len: int,
-                      usage: int, edata: bytes) -> bytes:
+                      usage: int, edata: bytes,
+                      iterations: int = PBKDF2_ITERATIONS) -> bytes:
     """Recompute the 12-byte HMAC-SHA1-96 tag for one candidate."""
-    key = string_to_key(password, salt, key_len)
+    key = string_to_key(password, salt, key_len, iterations)
     ke, ki = usage_keys(key, usage)
     plain = cts_decrypt(ke, edata)
     return _hmac.new(ki, plain, hashlib.sha1).digest()[:12]
@@ -199,6 +200,11 @@ def parse_krb5aes(text: str, tag: str) -> tuple[int, bytes, bytes, bytes]:
     except ValueError:
         raise ValueError(f"${tag}$: expected user$realm$checksum$"
                          "edata2 fields") from None
+    if not user or not realm or "*" in realm or "*" in user:
+        # extra starred metadata fields (etype-23 style) would land in
+        # the middle and silently corrupt the salt; reject loudly
+        raise ValueError(f"${tag}$: malformed user/realm fields "
+                         f"{middle!r}")
     checksum = bytes.fromhex(chk_hex)
     edata = bytes.fromhex(edata_hex)
     if len(checksum) != 12:
@@ -215,6 +221,10 @@ class _Krb5AesEngine(HashEngine):
     digest_size = 12
     salted = True
     max_candidate_len = 55      # one PBKDF2 HMAC key block
+    #: PBKDF2 iteration count; no deployed realm ships s2kparams, so
+    #: this stays the MIT/AD default -- overridable (tests, dry runs)
+    #: exactly like the PMKID engine's attribute
+    iterations = PBKDF2_ITERATIONS
     _usage: int = 0
     _tag: str = ""
 
@@ -231,7 +241,8 @@ class _Krb5AesEngine(HashEngine):
         if not params:
             raise ValueError(f"{self.name} needs target params")
         return [krb5_aes_checksum(c, params["salt"], params["key_len"],
-                                  params["usage"], params["edata"])
+                                  params["usage"], params["edata"],
+                                  self.iterations)
                 for c in candidates]
 
 

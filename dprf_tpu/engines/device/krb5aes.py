@@ -123,30 +123,18 @@ def _dk_batch(base: jnp.ndarray, constant: bytes) -> jnp.ndarray:
     return jnp.concatenate([out, out2], axis=1)
 
 
-def make_krb5aes_filter(params: dict):
-    """fb(cand, lens) -> uint32[B, 1] MASKED DER window (compare
-    against the masked expectation from der_filter_words_aes);
-    candidate lengths arrive at trace time via `lens` (varlen HMAC
-    keys), so the filter serves mask, wordlist, and sharded steps
-    alike."""
-    salt, key_len = params["salt"], params["key_len"]
+def make_krb5aes_check(params: dict):
+    """check(base uint8[B, key_len] PBKDF2 output) -> uint32[B, 1]
+    MASKED DER window: the cheap tail (DK derivations + one-block CBC
+    decrypt) shared by the XLA filter and the Pallas KDF-kernel step
+    (the 7z pattern: heavy KDF on the kernel, verdict in XLA)."""
     usage, edata = params["usage"], params["edata"]
     _, mask_w = der_filter_words_aes(len(edata), usage)
     c1 = np.frombuffer(edata[:16], np.uint8)
     c2 = np.frombuffer(edata[16:32], np.uint8).reshape(1, 16)
     usage_const = usage.to_bytes(4, "big") + b"\xaa"
 
-    def fb(cand, lens):
-        from dprf_tpu.ops.hmac import pack_raw_varlen
-        key_words = pack_raw_varlen(cand, lens, big_endian=True)
-        istate, ostate = hmac_key_states(key_words)
-        t1 = pbkdf2_sha1_block(istate, ostate, salt, 1, 4096)
-        if key_len == 16:
-            base = _words_to_bytes_be(t1)[:, :16]
-        else:
-            t2 = pbkdf2_sha1_block(istate, ostate, salt, 2, 4096)
-            base = _words_to_bytes_be(
-                jnp.concatenate([t1, t2[:, :3]], axis=1))
+    def check(base):
         kkey = _dk_batch(base, b"kerberos")
         ke = _dk_batch(kkey, usage_const)
         p2 = aes_decrypt_blocks(ke, c2)[:, 0] ^ jnp.asarray(c1)
@@ -155,6 +143,31 @@ def make_krb5aes_filter(params: dict):
                 | (p2[:, 2].astype(jnp.uint32) << 16)
                 | (p2[:, 3].astype(jnp.uint32) << 24))
         return (word & jnp.uint32(mask_w))[:, None]
+
+    return check
+
+
+def make_krb5aes_filter(params: dict, iterations: int = 4096):
+    """fb(cand, lens) -> uint32[B, 1] MASKED DER window (compare
+    against the masked expectation from der_filter_words_aes);
+    candidate lengths arrive at trace time via `lens` (varlen HMAC
+    keys), so the filter serves mask, wordlist, and sharded steps
+    alike."""
+    salt, key_len = params["salt"], params["key_len"]
+    check = make_krb5aes_check(params)
+
+    def fb(cand, lens):
+        from dprf_tpu.ops.hmac import pack_raw_varlen
+        key_words = pack_raw_varlen(cand, lens, big_endian=True)
+        istate, ostate = hmac_key_states(key_words)
+        t1 = pbkdf2_sha1_block(istate, ostate, salt, 1, iterations)
+        if key_len == 16:
+            base = _words_to_bytes_be(t1)[:, :16]
+        else:
+            t2 = pbkdf2_sha1_block(istate, ostate, salt, 2, iterations)
+            base = _words_to_bytes_be(
+                jnp.concatenate([t1, t2[:, :3]], axis=1))
+        return check(base)
 
     return fb
 
@@ -170,26 +183,121 @@ from dprf_tpu.engines.device.phpass import (PhpassMaskWorker,  # noqa: E402
                                             ShardedPhpassMaskWorker)
 
 
+def kdf_kernel_enabled(interpret: bool) -> bool:
+    """The PBKDF2 kernel route is DEFAULT-OFF on real hardware until a
+    recorded planted-crack run exists (DPRF_KRB5AES_KERNEL=1 enables
+    it for the measuring session): the shape matches the
+    hardware-proven PMKID kernel, but this repo records first compiles
+    of new kernel variants before trusting them (TPU_PROBE_LOG_r05
+    finding 12's lesson).  Interpret mode (tests) is ungated."""
+    import os
+    return interpret or os.environ.get("DPRF_KRB5AES_KERNEL",
+                                       "0") == "1"
+
+
+def _make_kdf_kernel_step(gen, batch: int, params: dict,
+                          hit_capacity: int, interpret: bool,
+                          iterations: int = 4096, kdf=None):
+    """Mask step with PBKDF2 on the Pallas kernel
+    (ops/pallas_pbkdf2.make_pbkdf2_kdf_pallas_fn) and the DK + CBC
+    verdict in XLA — the KDF is ~99% of the work at 4096 iterations.
+    The salt bytes and iteration count are runtime SMEM scalars, so
+    callers share one compiled `kdf` per (mask, salt_len, key_len)
+    across targets (the worker passes its cache entry)."""
+    from dprf_tpu.ops.pallas_pbkdf2 import make_pbkdf2_kdf_pallas_fn
+
+    salt, key_len = params["salt"], params["key_len"]
+    check = make_krb5aes_check(params)
+    if kdf is None:
+        kdf = make_pbkdf2_kdf_pallas_fn(gen, batch, len(salt),
+                                        key_len // 4,
+                                        interpret=interpret)
+    salt_dev = jnp.asarray(np.frombuffer(salt, np.uint8)
+                           .astype(np.int32))
+
+    @jax.jit
+    def step(base_digits, n_valid, target):
+        words = kdf(base_digits, jnp.int32(iterations), salt_dev)
+        word = check(_words_to_bytes_be(words))
+        found = cmp_ops.compare_single(word, target)
+        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        return cmp_ops.compact_hits(found, jnp.zeros((batch,), jnp.int32),
+                                    hit_capacity)
+
+    return step, kdf
+
+
 class Krb5AesMaskWorker(PhpassMaskWorker):
     """Per-target sweep (salt/etype/edata are per-target constants,
     so each target owns a compiled step).  A target whose edata2 sits
     below the CTS-safe floor gets a HOST pseudo-step (full oracle over
     the unit) instead of demoting the whole job: mixed hashlists keep
-    every CTS-safe target on the device path."""
+    every CTS-safe target on the device path.  On TPU the PBKDF2 runs
+    on the fused Pallas kernel (warmup-gated, XLA fallback)."""
 
     def __init__(self, engine, gen, targets, batch: int = 1 << 13,
                  hit_capacity: int = 64, oracle=None):
+        from dprf_tpu.engines.device._kernel_util import kind_kernel_step
+        from dprf_tpu.ops.pallas_mask import TILE, pallas_mode
+        from dprf_tpu.utils.sync import hard_sync
+
         self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
+        mode = pallas_mode()
+        if mode is not None:
+            batch = max(TILE, (batch // TILE) * TILE)
         self.batch = self.stride = batch
         self._steps = []
+        self.kernel_targets = set()    # target indices on the kernel
+        kdf_cache = {}    # one compiled KDF per (salt_len, key_len)
         for ti, t in enumerate(self.targets):
             if len(t.params["edata"]) < MIN_DEVICE_EDATA:
                 self._steps.append(self._host_step(ti))
                 continue
-            fb = make_krb5aes_filter(t.params)
-            self._steps.append(_make_step(gen, batch, fb, hit_capacity))
+            step = None
+            interp = (mode or {}).get("interpret", False)
+            if mode is not None and kdf_kernel_enabled(interp):
+                tw = _expected_word(t)
+                kind = (len(t.params["salt"]), t.params["key_len"])
+                built = {}
+
+                def build(t=t, kind=kind):
+                    s, kdf = _make_kdf_kernel_step(
+                        gen, batch, t.params, hit_capacity,
+                        interpret=interp,
+                        iterations=getattr(engine, "iterations", 4096),
+                        kdf=kdf_cache.get(kind))
+                    built["kdf"] = kdf
+                    return s
+
+                step = kind_kernel_step(
+                    "krb5aes pbkdf2", build,
+                    lambda s, tw=tw: hard_sync(s(
+                        jnp.zeros((gen.length,), jnp.int32),
+                        jnp.int32(0), tw)))
+                if step is not None and "kdf" in built:
+                    kdf_cache[kind] = built["kdf"]
+            if step is None:
+                fb = make_krb5aes_filter(
+                    t.params, getattr(engine, "iterations", 4096))
+                step = _make_step(gen, batch, fb, hit_capacity)
+            else:
+                self.kernel_targets.add(ti)
+            self._steps.append(step)
         self._targs = [(ti, _expected_word(t))
                        for ti, t in enumerate(self.targets)]
+
+    def _rescan(self, start, end, ti):
+        # the device engine IS a full CPU-capable oracle (subclass of
+        # the cpu engine), so an overflow without an explicit oracle
+        # still rescans exactly instead of raising
+        if self.oracle is None:
+            from dprf_tpu.runtime.worker import CpuWorker, Hit
+            from dprf_tpu.runtime.workunit import WorkUnit
+            sub = WorkUnit(-1, start, end - start)
+            hits = CpuWorker(self.engine, self.gen,
+                             [self.targets[ti]]).process(sub)
+            return [Hit(ti, h.cand_index, h.plaintext) for h in hits]
+        return super()._rescan(start, end, ti)
 
     def _host_step(self, ti: int):
         """Oracle scan with the jitted-step output contract; the base
@@ -249,7 +357,9 @@ class Krb5AesWordlistWorker(PhpassWordlistWorker):
         self.stride = self.word_batch * gen.n_rules
         self._steps = [
             make_pertarget_wordlist_step(
-                gen, self.word_batch, make_krb5aes_filter(t.params),
+                gen, self.word_batch,
+                make_krb5aes_filter(t.params,
+                                    getattr(engine, "iterations", 4096)),
                 hit_capacity)
             for t in self.targets]
         self._targs = [(ti, _expected_word(t))
@@ -270,7 +380,9 @@ class ShardedKrb5AesMaskWorker(ShardedPhpassMaskWorker):
         self.batch = self.stride = mesh.devices.size * batch_per_device
         self._steps = [make_sharded_pertarget_mask_step(
             gen, mesh, batch_per_device,
-            make_krb5aes_filter(t.params), 0, hit_capacity)
+            make_krb5aes_filter(t.params,
+                                getattr(engine, "iterations", 4096)),
+            0, hit_capacity)
             for t in self.targets]
         self._targs = [(ti, _expected_word(t))
                        for ti, t in enumerate(self.targets)]

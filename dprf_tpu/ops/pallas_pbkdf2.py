@@ -81,15 +81,13 @@ def _hmac20(istate, ostate, msg5, shape):
     return _compress(ostate, _block20(inner, shape), shape)
 
 
-def pmkid_lanes(byts, essid_vals, essid_len: int, msg_vals, iters,
-                shape):
-    """The kernel math as a PURE function: candidate byte arrays ->
-    4 PMKID words, shared verbatim by the pallas kernel (SMEM scalar
-    reads) and the eager oracle tests (python ints / tiny arrays) --
-    one source of truth for the key padding, PBKDF2 chaining, PMK
-    assembly, and PMKID truncation."""
-    # one-block big-endian key words, RAW zero padding (the HMAC key
-    # block is a full block -- no 0x80 marker)
+def pbkdf2_lanes(byts, salt_vals, salt_len: int, iters, n_words: int,
+                 shape):
+    """Generic PBKDF2-HMAC-SHA1 on kernel layouts: candidate byte
+    arrays -> the first n_words uint32 words of T1 || T2 (n_words <= 10
+    covers every deployed key width: 4 for AES-128 string-to-key, 8
+    for AES-256/PMK).  Same chaining as pmkid_lanes (shared _compress/
+    _block20/_hmac20); the salt plays the ESSID's role."""
     K = [jnp.zeros(shape, jnp.uint32) for _ in range(16)]
     for p, b in enumerate(byts):
         K[p // 4] = K[p // 4] | (b << jnp.uint32(8 * (3 - p % 4)))
@@ -101,15 +99,13 @@ def pmkid_lanes(byts, essid_vals, essid_len: int, msg_vals, iters,
         return x.astype(jnp.uint32) if hasattr(x, "astype") \
             else jnp.uint32(x)
 
-    def pbkdf2_block(block_index: int):
-        # first message: essid || INT32BE(i), padded as the second
-        # block of the inner hash (64-byte key prefix)
-        msg_len = essid_len + 4
+    def block(block_index: int):
+        msg_len = salt_len + 4
         first = [jnp.zeros(shape, jnp.uint32) for _ in range(16)]
-        for p in range(essid_len):
+        for p in range(salt_len):
             first[p // 4] = first[p // 4] | (
-                as_u32(essid_vals[p]) << jnp.uint32(8 * (3 - p % 4)))
-        for p, b in zip(range(essid_len, essid_len + 4),
+                as_u32(salt_vals[p]) << jnp.uint32(8 * (3 - p % 4)))
+        for p, b in zip(range(salt_len, salt_len + 4),
                         int(block_index).to_bytes(4, "big")):
             first[p // 4] = first[p // 4] | (
                 jnp.uint32(b) << jnp.uint32(8 * (3 - p % 4)))
@@ -127,14 +123,94 @@ def pmkid_lanes(byts, essid_vals, essid_len: int, msg_vals, iters,
         _, t = lax.fori_loop(1, iters, body, (u, u))
         return t
 
-    t1 = pbkdf2_block(1)
-    t2 = pbkdf2_block(2)
-    pmk = t1 + t2[:3]                           # 8 words = 32 bytes
+    out = list(block(1))
+    if n_words > 5:
+        out.extend(block(2))
+    return tuple(out[:n_words])
+
+
+def make_pbkdf2_kdf_pallas_fn(gen, batch: int, salt_len: int,
+                              n_words: int, sub: int = SUB,
+                              interpret: bool = False):
+    """Generic fused mask-decode -> PBKDF2-HMAC-SHA1 kernel producing
+    raw derived-key words (the 7z-kernel pattern: KDF on the kernel,
+    cheap verdict in XLA downstream).  fn(base_digits int32[L],
+    iters int32[1], salt int32[salt_len]) -> uint32[batch, n_words].
+    One compile per (mask, salt_len) serves every target and
+    iteration count."""
+    if sub > 128:
+        raise ValueError("sub > 128 overflows the tile layout")
+    tile = sub * 128
+    if batch % tile or batch <= 0:
+        raise ValueError(f"batch {batch} must be a multiple of "
+                         f"tile {tile}")
+    if not (hasattr(gen, "charsets") and mask_supported(gen.charsets)
+            and gen.length <= 63 and 0 < salt_len <= 51):
+        raise ValueError("pbkdf2 kdf kernel: job not eligible")
+    if not 1 <= n_words <= 10:
+        raise ValueError("n_words must be in 1..10 (T1 || T2)")
+    seg_tables = segment_tables(gen.charsets)
+    radices, length = gen.radices, gen.length
+    grid = batch // tile
+
+    def kernel(iters_ref, salt_ref, base_ref, out_ref):
+        shape = (sub, 128)
+        pid = pl.program_id(0)
+        lane = (lax.broadcasted_iota(jnp.int32, shape, 0) * 128
+                + lax.broadcasted_iota(jnp.int32, shape, 1))
+        carry = lane + pid * tile
+        byts = decode_candidate_bytes(radices, seg_tables, length,
+                                      base_ref, carry)
+        t = pbkdf2_lanes(byts, [salt_ref[p] for p in range(salt_len)],
+                         salt_len, iters_ref[0], n_words, shape)
+        out_ref[...] = jnp.concatenate(list(t), axis=0)
+
+    L = gen.length
+    raw = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((1,), lambda i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((salt_len,), lambda i: (0,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((L,), lambda i: (0,), memory_space=pltpu.SMEM),
+        ],
+        out_specs=[pl.BlockSpec((n_words * sub, 128),
+                                lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((grid * n_words * sub, 128),
+                                        jnp.uint32)],
+        interpret=interpret,
+    )
+
+    @jax.jit
+    def fn(base_digits, iters, salt):
+        (packed,) = raw(jnp.reshape(iters, (1,)).astype(jnp.int32),
+                        salt, base_digits.astype(jnp.int32))
+        words = packed.reshape(grid, n_words, sub, 128)
+        return words.transpose(0, 2, 3, 1).reshape(batch, n_words)
+
+    return fn
+
+
+def pmkid_lanes(byts, essid_vals, essid_len: int, msg_vals, iters,
+                shape):
+    """The kernel math as a PURE function: candidate byte arrays ->
+    4 PMKID words, shared verbatim by the pallas kernel (SMEM scalar
+    reads) and the eager oracle tests (python ints / tiny arrays) --
+    one source of truth for the key padding, PBKDF2 chaining, PMK
+    assembly, and PMKID truncation."""
+    # one-block big-endian key words, RAW zero padding (the HMAC key
+    # block is a full block -- no 0x80 marker)
+    # PMK = first 8 words of T1 || T2 (the shared generic PBKDF2 body)
+    pmk = pbkdf2_lanes(byts, essid_vals, essid_len, iters, 8, shape)
+    init = _init_state(shape)
     K2 = [jnp.zeros(shape, jnp.uint32) for _ in range(16)]
     for i in range(8):
         K2[i] = pmk[i]
     istate2 = _compress(init, [k ^ jnp.uint32(_IPAD) for k in K2], shape)
     ostate2 = _compress(init, [k ^ jnp.uint32(_OPAD) for k in K2], shape)
+    as_u32 = (lambda x: x.astype(jnp.uint32)
+              if hasattr(x, "astype") else jnp.uint32(x))
     msg5 = tuple(jnp.full(shape, jnp.uint32(0)) | as_u32(msg_vals[i])
                  for i in range(5))
     return _hmac20(istate2, ostate2, msg5, shape)[:4]
