@@ -31,7 +31,8 @@ OUT = os.environ.get("TPU_CASES_OUT", "/tmp/tpu_cases.jsonl")
 #: jax / touching the tunnel.
 KINDS = {"scrypt": 4, "bcrypt": 2, "bcryptchunk": 2, "pallaseks": 2,
          "descrypt": 1, "pmkid": 1, "scanprobe": 2, "superstep": 3,
-         "krb5": 1, "krb5cfg": 3, "pdf": 2, "sevenzip": 2}
+         "krb5": 1, "krb5cfg": 3, "pdf": 2, "sevenzip": 2,
+         "krb5aes": 2}
 
 
 def case_valid(name: str) -> bool:
@@ -311,6 +312,66 @@ def run_case(name: str) -> dict:
                 "compile_s": round(compile_s, 1),
                 "hs": hs, "tested": tested,
                 "elapsed_s": round(dt, 2),
+                "hits": [h.cand_index for h in hits]}
+    elif kind == "krb5aes":
+        # krb5aes-<etype>-<logB>: the AES etype-17/18 TGS engine
+        # through the PRODUCTION worker -- planted crack, then a timed
+        # sweep.  Run once with DPRF_KRB5AES_KERNEL=0 (XLA PBKDF2) and
+        # once =1 (fused Pallas KDF kernel, FIRST HARDWARE COMPILE --
+        # schedule LAST in a session per TPU_PROBE_LOG_r05 finding 14).
+        import hashlib as _hl
+        import hmac as _hm
+        import random as _rnd
+        import sys as _sys
+        _sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tests"))
+        from dprf_tpu import get_engine
+        from dprf_tpu.engines.cpu.krb5aes import (USAGE_TGS_REP_TICKET,
+                                                  cts_encrypt,
+                                                  string_to_key,
+                                                  usage_keys)
+        from dprf_tpu.runtime.workunit import WorkUnit
+        etype, logB = int(parts[1]), int(parts[2])
+        B = 1 << logB
+        kl = 16 if etype == 17 else 32
+
+        def line(pw: bytes) -> str:
+            rng = _rnd.Random(5)
+            conf = bytes(rng.randrange(256) for _ in range(16))
+            body = bytes([0x30, 0x82, 0x01, 0x80]) + \
+                bytes(i % 256 for i in range(380))
+            plain = conf + bytes([0x63, 0x82, 0x01, 0x84]) + body
+            key = string_to_key(pw, b"REALM.TESTsvc", kl)
+            ke, ki = usage_keys(key, USAGE_TGS_REP_TICKET)
+            ed = cts_encrypt(ke, plain)
+            chk = _hm.new(ki, plain, _hl.sha1).digest()[:12]
+            return (f"$krb5tgs${etype}$svc$REALM.TEST${chk.hex()}$"
+                    f"{ed.hex()}")
+
+        eng = get_engine("krb5tgs-aes", device="jax")
+        cpu = get_engine("krb5tgs-aes", device="cpu")
+        g3 = MaskGenerator("?l?l?l")
+        plant = 7_077
+        t0 = time.perf_counter()
+        w = eng.make_mask_worker(g3, [cpu.parse_target(
+            line(g3.candidate(plant)))], batch=min(B, 4096),
+            hit_capacity=8, oracle=cpu)
+        hits = w.process(WorkUnit(-1, plant - plant % w.stride,
+                                  w.stride))
+        compile_s = time.perf_counter() - t0
+        ok = [(h.target_index, h.cand_index) for h in hits] == \
+            [(0, plant)]
+        g8 = MaskGenerator("?a?a?a?a?a?a?a?a")
+        sweep = eng.make_mask_worker(g8, [cpu.parse_target(
+            line(b"absent!9"))], batch=B, hit_capacity=64, oracle=cpu)
+        hs, tested, dt, stride = timed_sweep(sweep, WorkUnit, 20.0)
+        return {"case": name, "ok": ok, "etype": etype,
+                "batch": stride,
+                "kernel_route": sorted(getattr(sweep, "kernel_targets",
+                                               set())),
+                "compile_s": round(compile_s, 1),
+                "hs": hs, "tested": tested, "elapsed_s": round(dt, 2),
                 "hits": [h.cand_index for h in hits]}
     elif kind == "krb5cfg":
         # krb5cfg-<logB>-<subc>-<unroll>: raw krb5 kernel throughput
