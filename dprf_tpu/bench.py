@@ -17,6 +17,30 @@ from dprf_tpu.generators.mask import MaskGenerator
 from dprf_tpu.ops.pipeline import make_mask_crack_step, target_words
 
 
+def _publish(result: dict, mode: str) -> dict:
+    """Every bench run reports through the SAME registry the runtime
+    publishes into (ISSUE 1): a scrape or telemetry snapshot taken
+    during/after a bench shows what was measured, at what rate, with
+    how much compile time -- machine-checkable, not stdout-only."""
+    from dprf_tpu.telemetry import DEFAULT as metrics
+    labels = dict(engine=result.get("engine", "?"),
+                  impl=result.get("impl", mode),
+                  device=result.get("device", "?"), mode=mode)
+    metrics.gauge("dprf_bench_rate_hs",
+                  "last measured bench rate (or efficiency fraction "
+                  "for mode=scaling)",
+                  labelnames=("engine", "impl", "device", "mode")
+                  ).set(result["value"], **labels)
+    metrics.counter("dprf_bench_runs_total", "bench invocations",
+                    labelnames=("mode",)).inc(mode=mode)
+    if "compile_s" in result:
+        metrics.histogram(
+            "dprf_compile_seconds", "step warmup/compile wall time",
+            labelnames=("engine",)).observe(
+                result["compile_s"], engine=labels["engine"])
+    return result
+
+
 def calibrated_inner(probe_rate: float, batch: int,
                      target_s: float = 5.0, cap: int = 1 << 20) -> int:
     """Inner-loop length so one dispatch computes ~target_s of work.
@@ -183,7 +207,7 @@ def run_bench(engine: str = "md5", device: str = "jax",
 
     rate = n * batch * max(1, inner if device == "jax" else 1) / elapsed
     platform = jax.devices()[0].platform if device == "jax" else "cpu"
-    return {
+    return _publish({
         "metric": f"{engine} candidates/sec/chip",
         "value": rate,
         "unit": "H/s",
@@ -196,7 +220,7 @@ def run_bench(engine: str = "md5", device: str = "jax",
         "inner": inner,
         "elapsed_s": round(elapsed, 3),
         "compile_s": round(compile_s, 1),
-    }
+    }, mode="bench")
 
 
 def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
@@ -279,7 +303,7 @@ def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
         out["note"] = ("virtual CPU mesh: plumbing validation only -- "
                        "devices share one core, efficiency is not "
                        "meaningful off-TPU")
-    return out
+    return _publish(out, mode="scaling")
 
 
 # ---------------------------------------------------------------------------
@@ -424,7 +448,7 @@ def run_config(config: int, device: str = "jax", seconds: float = 5.0,
 
     import jax as _jax
     platform = (_jax.devices()[0].platform if device == "jax" else "cpu")
-    return {
+    return _publish({
         "metric": f"config{config} {engine_name} candidates/sec/chip",
         "value": tested / elapsed,
         "unit": "H/s",
@@ -438,4 +462,4 @@ def run_config(config: int, device: str = "jax", seconds: float = 5.0,
         "tested": tested,
         "elapsed_s": round(elapsed, 3),
         "compile_s": round(compile_s, 1),
-    }
+    }, mode="config")

@@ -169,6 +169,22 @@ def _build_parser() -> argparse.ArgumentParser:
         v.add_argument("--potfile", default="dprf.potfile")
         v.add_argument("--quiet", "-q", action="store_true")
 
+    mt = sub.add_parser("metrics", help="scrape a running coordinator's "
+                        "/metrics endpoint (Prometheus text format)")
+    mt.add_argument("--connect", required=True, metavar="HOST:PORT",
+                    help="the coordinator's RPC address (`dprf serve "
+                    "--bind`); /metrics is served on the same port")
+    mt.add_argument("--json", action="store_true",
+                    help="print the registry as a JSON snapshot "
+                    "instead of Prometheus text (uses the RPC "
+                    "protocol, so --token applies)")
+    mt.add_argument("--token", default=None,
+                    help="shared secret for a token-authenticated "
+                    "coordinator's --json path (default: $DPRF_TOKEN; "
+                    "the plain-text scrape never needs one)")
+    mt.add_argument("--timeout", type=float, default=10.0)
+    mt.add_argument("--quiet", "-q", action="store_true")
+
     e = sub.add_parser("engines", help="list available engines")
     e.add_argument("--device", default=None)
     e.add_argument("--verbose", "-v", action="store_true",
@@ -636,15 +652,28 @@ def _crack_single(args, device: str, log: Log):
     if coord.found:
         log.info("pre-cracked targets", count=len(coord.found))
 
-    if args.profile:
-        # jax.profiler.trace captures device + host timelines for every
-        # step the coordinator drives (SURVEY.md section 5: tracing).
-        import jax
-        with jax.profiler.trace(args.profile):
+    snap = None
+    if session is not None:
+        from dprf_tpu.telemetry import (DEFAULT as _registry,
+                                        TelemetrySnapshotter,
+                                        snapshot_interval)
+        snap = TelemetrySnapshotter(session.telemetry_path, _registry,
+                                    interval=snapshot_interval()).start()
+    try:
+        if args.profile:
+            # jax.profiler.trace captures device + host timelines for
+            # every step the coordinator drives (SURVEY.md section 5).
+            import jax
+            with jax.profiler.trace(args.profile):
+                result = coord.run()
+            log.info("profile written", dir=args.profile)
+        else:
             result = coord.run()
-        log.info("profile written", dir=args.profile)
-    else:
-        result = coord.run()
+    finally:
+        if snap is not None:
+            snap.stop()
+            log.info("telemetry snapshots written",
+                     path=session.telemetry_path)
 
     _print_results(result.found, hl.targets)
     log.info("job finished",
@@ -734,6 +763,7 @@ def cmd_serve(args, log: Log) -> int:
                                               restore_hits_into)
     restore_hits_into(state.found, restored_hits)
     preload_potfile(state.found, hl.targets, potfile)
+    state.refresh_found_gauge()
     if state.found:
         log.info("pre-cracked targets", count=len(state.found))
 
@@ -741,9 +771,22 @@ def cmd_serve(args, log: Log) -> int:
     server = CoordinatorServer(state, host, port)
     log.info("serving job", bind=f"{server.address[0]}:{server.address[1]}",
              fingerprint=spec.fingerprint, keyspace=gen.keyspace)
+    log.info("metrics endpoint",
+             url=f"http://{server.address[0]}:{server.address[1]}/metrics")
+    snap = None
+    if session is not None:
+        from dprf_tpu.telemetry import (TelemetrySnapshotter,
+                                        snapshot_interval)
+        snap = TelemetrySnapshotter(session.telemetry_path,
+                                    state.registry,
+                                    interval=snapshot_interval()).start()
     try:
         server.serve_until_done()
     finally:
+        if snap is not None:
+            snap.stop()
+            log.info("telemetry snapshots written",
+                     path=session.telemetry_path)
         if session is not None:
             session.snapshot(dispatcher.completed_intervals())
             session.close()
@@ -829,6 +872,32 @@ def cmd_bench(args, log: Log) -> int:
                             mask=args.mask, batch=args.batch,
                             seconds=args.seconds, impl=args.impl, log=log)
     print(json.dumps(res))
+    return 0
+
+
+def cmd_metrics(args, log: Log) -> int:
+    """Scrape a running coordinator: plain HTTP GET on the RPC port
+    (no client library; works for curl/Prometheus too).  --json asks
+    the authenticated RPC op for the structured snapshot instead."""
+    host, port = _parse_hostport(args.connect)
+    if args.json:
+        import json as _json
+
+        from dprf_tpu.runtime.rpc import CoordinatorClient
+        token = args.token or os.environ.get("DPRF_TOKEN") or None
+        client = CoordinatorClient(host, port, timeout=args.timeout,
+                                   token=token)
+        try:
+            if token:
+                client.hello()       # answer the auth challenge first
+            resp = client.call("metrics", format="json")
+        finally:
+            client.close()
+        print(_json.dumps(resp.get("metrics", {}), indent=2,
+                          sort_keys=True))
+        return 0
+    from dprf_tpu.telemetry import scrape_metrics
+    sys.stdout.write(scrape_metrics(host, port, timeout=args.timeout))
     return 0
 
 
@@ -956,6 +1025,7 @@ _COMMANDS = {
     "serve": cmd_serve,
     "worker": cmd_worker,
     "bench": cmd_bench,
+    "metrics": cmd_metrics,
     "show": cmd_show,
     "left": cmd_left,
     "engines": cmd_engines,

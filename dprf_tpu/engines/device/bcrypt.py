@@ -397,9 +397,9 @@ def _make_sharded_eks_advance(mesh):
     dispatches."""
     from jax.sharding import PartitionSpec as P
 
-    from dprf_tpu.parallel.mesh import SHARD_AXIS
+    from dprf_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         bf_ops.eks_rounds, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)), check_vma=False)
@@ -420,7 +420,7 @@ def make_sharded_bcrypt_mask_chunk_fns(gen, mesh, batch_per_device: int,
     """
     from jax.sharding import PartitionSpec as P
 
-    from dprf_tpu.parallel.mesh import SHARD_AXIS
+    from dprf_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
     flat = gen.flat_charsets
     length = gen.length
@@ -435,7 +435,7 @@ def make_sharded_bcrypt_mask_chunk_fns(gen, mesh, batch_per_device: int,
         Pst, Sst = bf_ops.eks_setup_begin(kw, salt_words)
         return kw, Pst, Sst
 
-    begin = jax.jit(jax.shard_map(
+    begin = jax.jit(shard_map(
         begin_fn, mesh=mesh, in_specs=(P(), P()),
         out_specs=(P(SHARD_AXIS),) * 3, check_vma=False))
 
@@ -456,7 +456,7 @@ def make_sharded_bcrypt_mask_chunk_fns(gen, mesh, batch_per_device: int,
                 lax.all_gather(lanes, SHARD_AXIS),
                 lax.all_gather(tpos, SHARD_AXIS))
 
-    finish_sm = jax.shard_map(
+    finish_sm = shard_map(
         finish_fn, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
         out_specs=(P(), P(), P(), P()), check_vma=False)
@@ -483,7 +483,7 @@ def make_sharded_bcrypt_wordlist_chunk_fns(gen, mesh, word_batch: int,
     """
     from jax.sharding import PartitionSpec as P
 
-    from dprf_tpu.parallel.mesh import SHARD_AXIS
+    from dprf_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
     n_dev = mesh.devices.size
     B, L = word_batch, gen.max_len
@@ -506,7 +506,7 @@ def make_sharded_bcrypt_wordlist_chunk_fns(gen, mesh, word_batch: int,
         Pst, Sst = bf_ops.eks_setup_begin(kw, salt_words)
         return kw, cv, Pst, Sst
 
-    begin = jax.jit(jax.shard_map(
+    begin = jax.jit(shard_map(
         begin_fn, mesh=mesh, in_specs=(P(), P(), P()),
         out_specs=(P(SHARD_AXIS),) * 4, check_vma=False))
 
@@ -528,7 +528,7 @@ def make_sharded_bcrypt_wordlist_chunk_fns(gen, mesh, word_batch: int,
                 lax.all_gather(lanes, SHARD_AXIS),
                 lax.all_gather(tpos, SHARD_AXIS))
 
-    finish_sm = jax.shard_map(
+    finish_sm = shard_map(
         finish_fn, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P()),
         out_specs=(P(), P(), P(), P()), check_vma=False)

@@ -19,15 +19,19 @@ the full oracle):
   the CTS stolen pair, so the device path requires edata2 >= 64
   bytes (always true for real TGS/AS-REP tickets; short Pre-Auth
   timestamps fall back to the CPU oracle).
-- Device hits are *maybes* (2^-32 false rate per the masked 32-bit
-  DER window); the coordinator oracle-verifies each with the full
-  CTS + HMAC-SHA1-96 chain, mirroring the etype-23 design.
+- Device hits are *maybes*: the masked DER window is 32 bits for
+  long-form tickets but only 24 bits for short-form ones (the
+  short-form branch masks byte 4 out, so expect a 2^-24 false-maybe
+  rate there, 2^-32 otherwise); the coordinator oracle-verifies each
+  with the full CTS + HMAC-SHA1-96 chain, mirroring the etype-23
+  design.
 
 Mask, wordlist+rules, and sharded mask all run on device (variable
 candidate lengths flow through pack_raw_varlen into the HMAC key
 block); jobs fall back to the CPU oracle only when a target's edata2
-sits below the CTS-safe floor or a wordlist exceeds the one-block
-HMAC key budget (55 bytes).
+sits below the CTS-safe floor, its salt (realm+user) exceeds the
+one-block PBKDF2 salt budget (51 bytes), or a wordlist exceeds the
+one-block HMAC key budget (55 bytes).
 """
 
 from __future__ import annotations
@@ -54,6 +58,14 @@ CONF = 16
 #: (index 1) must sit outside the CTS stolen pair in every layout.
 MIN_DEVICE_EDATA = 64
 
+#: largest salt (realm+user) the fused PBKDF2 path packs: salt + the
+#: 4-byte block index + 0x80 marker + 8-byte length must fit one
+#: 64-byte SHA-1 block (ops/hmac_sha1.salt_block).  Long AD realms or
+#: service-account principals above this run on the CPU oracle --
+#: demoted at routing time, NOT discovered as a ValueError at the
+#: first step() (ADVICE.md round-5 medium).
+MAX_DEVICE_SALT = 51
+
 
 def der_filter_words_aes(edata_len: int, usage: int) -> tuple[int, int]:
     """(expected, mask) little-endian uint32 over plaintext bytes
@@ -73,15 +85,19 @@ def der_filter_words_aes(edata_len: int, usage: int) -> tuple[int, int]:
     else:
         tag_exp, tag_mask = 0x30, 0xFF
     L = edata_len - CONF            # DER blob length (CTS: no padding)
+    # first content byte after the length: inner SEQUENCE 0x30, or the
+    # [0] context tag 0xA0 of a PA-ENC-TS-ENC (same for BOTH length
+    # forms -- the long-form branches below must not assume 0x30, or a
+    # large Pre-Auth blob's true password would be prefilter-rejected:
+    # a silent missed-crack, ADVICE.md round-5 low)
+    inner = 0xA0 if usage == USAGE_PA_TIMESTAMP else 0x30
     if L - 2 < 0x80:
-        # short-form length; the third byte is the first content byte
-        # (inner SEQUENCE 0x30, or the [0] context tag 0xA0 of a
-        # PA-ENC-TS-ENC); byte 4 varies, so the window is 24 bits here
-        inner = 0xA0 if usage == USAGE_PA_TIMESTAMP else 0x30
+        # short-form length; the third window byte is the first
+        # content byte; byte 4 varies, so the window is 24 bits here
         exp = [tag_exp, L - 2, inner, 0x00]
         msk = [tag_mask, 0xFF, 0xFF, 0x00]
     elif L - 3 <= 0xFF:
-        exp = [tag_exp, 0x81, L - 3, 0x30]
+        exp = [tag_exp, 0x81, L - 3, inner]
         msk = [tag_mask, 0xFF, 0xFF, 0xFF]
     elif L - 4 <= 0xFFFF:
         C = L - 4
@@ -229,10 +245,11 @@ def _make_kdf_kernel_step(gen, batch: int, params: dict,
 
 class Krb5AesMaskWorker(PhpassMaskWorker):
     """Per-target sweep (salt/etype/edata are per-target constants,
-    so each target owns a compiled step).  A target whose edata2 sits
-    below the CTS-safe floor gets a HOST pseudo-step (full oracle over
+    so each target owns a compiled step).  A target outside the device
+    envelope (edata2 below the CTS-safe floor, or salt above the
+    one-block PBKDF2 budget) gets a HOST pseudo-step (full oracle over
     the unit) instead of demoting the whole job: mixed hashlists keep
-    every CTS-safe target on the device path.  On TPU the PBKDF2 runs
+    every eligible target on the device path.  On TPU the PBKDF2 runs
     on the fused Pallas kernel (warmup-gated, XLA fallback)."""
 
     def __init__(self, engine, gen, targets, batch: int = 1 << 13,
@@ -250,7 +267,10 @@ class Krb5AesMaskWorker(PhpassMaskWorker):
         self.kernel_targets = set()    # target indices on the kernel
         kdf_cache = {}    # one compiled KDF per (salt_len, key_len)
         for ti, t in enumerate(self.targets):
-            if len(t.params["edata"]) < MIN_DEVICE_EDATA:
+            # below-floor edata2 OR over-budget salt: host pseudo-step
+            # for THIS target only (the rest of the hashlist keeps its
+            # compiled device steps)
+            if not _target_device_ok(t):
                 self._steps.append(self._host_step(ti))
                 continue
             step = None
@@ -391,19 +411,33 @@ class ShardedKrb5AesMaskWorker(ShardedPhpassMaskWorker):
         return self._steps[ti](base, n_valid, target)
 
 
+def _target_device_ok(t) -> bool:
+    """One target's eligibility for the fused device path: edata2 at
+    or above the CTS-safe floor AND a salt that fits the one-block
+    PBKDF2 layout.  The salt check matters: without it a long AD
+    realm/principal crashes the job at the first step() with
+    'salt too long for one block' instead of demoting to the oracle."""
+    return (len(t.params["edata"]) >= MIN_DEVICE_EDATA
+            and len(t.params["salt"]) <= MAX_DEVICE_SALT)
+
+
 def _device_ok(targets, any_ok: bool = False) -> bool:
     """False when the job must demote to the CPU oracle.  With
-    any_ok (the mask sweep, which routes below-floor targets to host
-    pseudo-steps per target), one CTS-safe target keeps the device
-    worker; the wordlist/sharded scaffolds demote on any short
-    target."""
-    sizes = [len(t.params["edata"]) for t in targets]
-    ok = (max(sizes) if any_ok else min(sizes)) >= MIN_DEVICE_EDATA
+    any_ok (the mask sweep, which routes ineligible targets to host
+    pseudo-steps per target), one device-eligible target keeps the
+    device worker; the wordlist/sharded scaffolds demote on any
+    ineligible target (below-floor edata2 or over-budget salt)."""
+    eligible = [_target_device_ok(t) for t in targets]
+    ok = any(eligible) if any_ok else all(eligible)
     if not ok:
         from dprf_tpu.utils.logging import DEFAULT as log
-        log.warn("krb5 AES edata2 shorter than the CTS-safe device "
-                 "floor; running on the CPU oracle",
-                 edata_bytes=min(sizes), floor=MIN_DEVICE_EDATA)
+        log.warn("krb5 AES target outside the device envelope (edata2 "
+                 "below the CTS-safe floor, or salt above the "
+                 "one-block budget); running on the CPU oracle",
+                 edata_bytes=min(len(t.params["edata"]) for t in targets),
+                 floor=MIN_DEVICE_EDATA,
+                 salt_bytes=max(len(t.params["salt"]) for t in targets),
+                 salt_cap=MAX_DEVICE_SALT)
     return ok
 
 

@@ -150,7 +150,7 @@ def make_sharded_pmkid_crack_step(engine: JaxPmkidEngine,
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
-    from dprf_tpu.parallel.mesh import SHARD_AXIS
+    from dprf_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
     flat = gen.flat_charsets
     length = gen.length
@@ -179,7 +179,7 @@ def make_sharded_pmkid_crack_step(engine: JaxPmkidEngine,
                 lax.all_gather(tpos, SHARD_AXIS),
                 n_multi[None])
 
-    sharded = _jax.shard_map(
+    sharded = shard_map(
         shard_fn, mesh=mesh, in_specs=(P(), P()),
         out_specs=(P(), P(), P(), P(), P()),
         check_vma=False)

@@ -149,7 +149,7 @@ def make_sharded_salted_mask_step(engine, gen, mesh, batch_per_device: int,
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
-    from dprf_tpu.parallel.mesh import SHARD_AXIS
+    from dprf_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
     flat = gen.flat_charsets
     length = gen.length
@@ -180,7 +180,7 @@ def make_sharded_salted_mask_step(engine, gen, mesh, batch_per_device: int,
                 lax.all_gather(lanes, SHARD_AXIS),
                 lax.all_gather(tpos, SHARD_AXIS))
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_fn, mesh=mesh, in_specs=(P(),) * 5,
         out_specs=(P(), P(), P(), P()), check_vma=False)
 

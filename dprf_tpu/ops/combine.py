@@ -87,7 +87,7 @@ def make_sharded_combinator_crack_step(
     from jax.sharding import PartitionSpec as P
 
     from dprf_tpu.ops import pack as pack_ops
-    from dprf_tpu.parallel.mesh import SHARD_AXIS
+    from dprf_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
     lbuf, llens, rbuf, rlens = map(jnp.asarray, gen.tables())
     multi = isinstance(targets, cmp_ops.TargetTable)
@@ -120,7 +120,7 @@ def make_sharded_combinator_crack_step(
                 lax.all_gather(lanes, SHARD_AXIS),
                 lax.all_gather(tpos, SHARD_AXIS))
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_fn, mesh=mesh, in_specs=(P(), P()),
         out_specs=(P(), P(), P(), P()), check_vma=False)
 

@@ -114,7 +114,7 @@ def make_sharded_wordlist_crack_step(
          tpos int32[n_dev, cap]); lanes are flat indices into the
     *super-batch* candidate block, i.e. r*(n_dev*B) + (global word lane).
     """
-    from dprf_tpu.parallel.mesh import SHARD_AXIS
+    from dprf_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
     n_dev = mesh.devices.size
     B, L = word_batch, gen.max_len
@@ -152,7 +152,7 @@ def make_sharded_wordlist_crack_step(
                 lax.all_gather(lanes, SHARD_AXIS),
                 lax.all_gather(tpos, SHARD_AXIS))
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(), P()),
         out_specs=(P(), P(), P(), P()),

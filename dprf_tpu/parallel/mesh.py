@@ -16,6 +16,20 @@ from jax.sharding import Mesh
 SHARD_AXIS = "shard"
 
 
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable shard_map: ``jax.shard_map`` where it exists
+    (jax >= 0.6), else ``jax.experimental.shard_map.shard_map`` with
+    ``check_vma`` translated to its older ``check_rep`` spelling.  All
+    sharded steps route through here so an installed-jax skew breaks
+    ONE function, not fifteen call sites."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
 def make_mesh(n_devices: Optional[int] = None,
               devices: Optional[Sequence] = None) -> Mesh:
     """Build the 1-D keyspace mesh over `n_devices` (default: all)."""

@@ -25,7 +25,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dprf_tpu.generators.mask import MaskGenerator
 from dprf_tpu.ops import compare as cmp_ops
-from dprf_tpu.parallel.mesh import SHARD_AXIS
+from dprf_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
 
 def make_sharded_pertarget_mask_step(gen, mesh, batch_per_device: int,
@@ -63,7 +63,7 @@ def make_sharded_pertarget_mask_step(gen, mesh, batch_per_device: int,
                 lax.all_gather(lanes, SHARD_AXIS),
                 lax.all_gather(tpos, SHARD_AXIS))
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_fn, mesh=mesh, in_specs=(P(),) * (3 + n_params),
         out_specs=(P(), P(), P(), P()), check_vma=False)
 
@@ -131,7 +131,7 @@ def make_sharded_mask_crack_step(
                 lax.all_gather(lanes, SHARD_AXIS),
                 lax.all_gather(tpos, SHARD_AXIS))
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(), P()),
         out_specs=(P(), P(), P(), P()),

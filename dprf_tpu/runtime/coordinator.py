@@ -19,6 +19,7 @@ from dprf_tpu.runtime.dispatcher import Dispatcher
 from dprf_tpu.runtime.potfile import Potfile
 from dprf_tpu.runtime.session import SessionJournal
 from dprf_tpu.runtime.worker import Hit
+from dprf_tpu.telemetry import get_registry
 
 
 @dataclasses.dataclass
@@ -76,7 +77,7 @@ class Coordinator:
                  potfile: Optional[Potfile] = None,
                  progress_cb: Optional[Callable] = None,
                  progress_interval: float = 5.0,
-                 oracle=None):
+                 oracle=None, registry=None):
         self.spec = spec
         self.targets = list(targets)
         self.dispatcher = dispatcher
@@ -94,6 +95,16 @@ class Coordinator:
         self.oracle = oracle
         self.rejected = 0
         self.found: dict[int, bytes] = {}
+        from dprf_tpu.telemetry import declare_job_metrics
+        jm = declare_job_metrics(get_registry(registry))
+        self._m_hits = jm["hits"]
+        self._m_rejects = jm["rejects"]
+        self._m_cands = jm["cands"]
+        self._h_unit = jm["unit_seconds"]
+        self._g_targets = jm["targets"]
+        self._g_found = jm["found"]
+        self._g_targets.set(len(self.targets))
+        self._g_found.set(len(self.found))
 
     # -- pre-run bookkeeping ---------------------------------------------
 
@@ -101,9 +112,11 @@ class Coordinator:
         """Mark targets already cracked (potfile) or recorded in a resumed
         session so work stops early / never starts."""
         preload_potfile(self.found, self.targets, self.potfile)
+        self._g_found.set(len(self.found))
 
     def restore_hits(self, hits: list) -> None:
         restore_hits_into(self.found, hits)
+        self._g_found.set(len(self.found))
 
     # -- the run loop ----------------------------------------------------
 
@@ -120,11 +133,14 @@ class Coordinator:
                                                               target):
             from dprf_tpu.utils.logging import DEFAULT as log
             self.rejected += 1
+            self._m_rejects.inc()
             log.warn("rejected unverifiable device hit; rescanning unit "
                      "with the CPU oracle", target=target.raw[:32],
                      cand_index=hit.cand_index)
             return False
         self.found[hit.target_index] = hit.plaintext
+        self._m_hits.inc()
+        self._g_found.set(len(self.found))
         if self.potfile is not None:
             self.potfile.add(target.raw, hit.plaintext)
         if self.session is not None:
@@ -173,15 +189,19 @@ class Coordinator:
                     if unit is None:
                         break
                     pending.append((unit, submit_or_process(self.worker,
-                                                            unit)))
+                                                            unit),
+                                    time.monotonic()))
                 if not pending:
                     if self.dispatcher.done() or \
                             self.dispatcher.outstanding_count() == 0:
                         break        # exhausted
                     time.sleep(0.01)
                     continue
-                unit, p = pending.pop(0)
+                unit, p, t_submit = pending.pop(0)
                 self._finish_unit(unit, p.resolve())
+                self._h_unit.observe(time.monotonic() - t_submit)
+                self._m_cands.inc(unit.length, engine=self.spec.engine,
+                                  device=self.spec.device)
                 self.dispatcher.complete(unit.unit_id)
                 if self.session is not None:
                     self.session.record_units(

@@ -20,6 +20,7 @@ from collections import deque
 from typing import Callable, Optional
 
 from dprf_tpu.runtime.workunit import WorkUnit
+from dprf_tpu.telemetry import get_registry
 
 
 class IntervalSet:
@@ -87,7 +88,8 @@ class Dispatcher:
 
     def __init__(self, keyspace: int, unit_size: int,
                  lease_timeout: float = 300.0,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 registry=None):
         if unit_size <= 0:
             raise ValueError("unit_size must be positive")
         self.keyspace = keyspace
@@ -99,6 +101,22 @@ class Dispatcher:
         self._pending: deque[WorkUnit] = deque()
         self._outstanding: dict[int, tuple] = {}   # id -> (unit, worker, deadline)
         self._done = IntervalSet()
+        m = get_registry(registry)
+        self._m_leased = m.counter(
+            "dprf_units_leased_total", "WorkUnit leases handed out")
+        self._m_completed = m.counter(
+            "dprf_units_completed_total", "WorkUnits marked done")
+        self._m_reissued = m.counter(
+            "dprf_units_reissued_total",
+            "WorkUnits returned to the queue", labelnames=("reason",))
+        self._g_outstanding = m.gauge(
+            "dprf_units_outstanding", "leases currently held")
+        self._g_keyspace = m.gauge(
+            "dprf_keyspace_total", "keyspace indices in the job")
+        self._g_covered = m.gauge(
+            "dprf_keyspace_covered", "keyspace indices completed")
+        self._g_keyspace.set(keyspace)
+        self._g_covered.set(0)
 
     # -- construction from a resume journal ------------------------------
 
@@ -108,6 +126,7 @@ class Dispatcher:
         d = cls(keyspace, unit_size, **kw)
         for s, e in completed:
             d._done.add(s, e)
+        d._g_covered.set(d._done.covered())
         frontier = max((e for _, e in completed), default=0)
         for s, e in d._done.gaps(frontier):
             # re-split big gaps into unit-sized pieces
@@ -137,6 +156,8 @@ class Dispatcher:
             return None
         self._outstanding[unit.unit_id] = (
             unit, worker_id, self._clock() + self.lease_timeout)
+        self._m_leased.inc()
+        self._g_outstanding.set(len(self._outstanding))
         return unit
 
     def complete(self, unit_id: int) -> None:
@@ -145,11 +166,16 @@ class Dispatcher:
             return   # late completion of an already-reissued unit: idempotent
         unit = entry[0]
         self._done.add(unit.start, unit.end)
+        self._m_completed.inc()
+        self._g_covered.set(self._done.covered())
+        self._g_outstanding.set(len(self._outstanding))
 
     def fail(self, unit_id: int) -> None:
         entry = self._outstanding.pop(unit_id, None)
         if entry is not None:
             self._pending.append(entry[0])
+            self._m_reissued.inc(reason="failed")
+            self._g_outstanding.set(len(self._outstanding))
 
     def reap_expired(self) -> int:
         now = self._clock()
@@ -157,6 +183,9 @@ class Dispatcher:
                    if dl < now]
         for uid in expired:
             self._pending.append(self._outstanding.pop(uid)[0])
+        if expired:
+            self._m_reissued.inc(len(expired), reason="lease_expired")
+            self._g_outstanding.set(len(self._outstanding))
         return len(expired)
 
     # -- status ----------------------------------------------------------
@@ -178,3 +207,10 @@ class Dispatcher:
 
     def outstanding_count(self) -> int:
         return len(self._outstanding)
+
+    def outstanding_unit(self, unit_id: int) -> Optional[WorkUnit]:
+        """The still-leased unit with this id (None once completed,
+        failed, or reaped) -- lets the RPC layer attribute a completion
+        report's candidate count without re-deriving unit geometry."""
+        entry = self._outstanding.get(unit_id)
+        return entry[0] if entry is not None else None

@@ -46,6 +46,7 @@ from typing import Callable, Optional
 from dprf_tpu.runtime.dispatcher import Dispatcher
 from dprf_tpu.runtime.worker import Hit
 from dprf_tpu.runtime.workunit import WorkUnit
+from dprf_tpu.telemetry import get_registry
 
 MAX_LINE = 64 << 20   # hashlists can be large; candidates never cross
 
@@ -85,7 +86,7 @@ class CoordinatorState:
                  on_hit: Optional[Callable] = None,
                  on_progress: Optional[Callable] = None,
                  verifier: Optional[Callable] = None,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None, registry=None):
         self.job = job                    # serializable job description
         self.dispatcher = dispatcher
         self.n_targets = n_targets
@@ -108,6 +109,54 @@ class CoordinatorState:
         self.token = token                # None = unauthenticated protocol
         self.lock = threading.Lock()
         self.t0 = time.perf_counter()
+        #: the registry the RPC port's /metrics endpoint serves; the
+        #: Dispatcher publishes unit/keyspace metrics into the same one
+        self.registry = get_registry(registry)
+        m = self.registry
+        from dprf_tpu.telemetry import declare_job_metrics
+        jm = declare_job_metrics(m)
+        self._m_hits = jm["hits"]
+        self._m_rejects = jm["rejects"]
+        self._m_cands = jm["cands"]
+        self._g_targets = jm["targets"]
+        self._g_found = jm["found"]
+        self._m_rpc = m.counter(
+            "dprf_rpc_requests_total", "RPC ops served",
+            labelnames=("op",))
+        self._g_quar = m.gauge(
+            "dprf_workers_quarantined", "workers benched for repeated "
+            "unverifiable hits")
+        self._g_seen = m.gauge(
+            "dprf_worker_last_seen_timestamp",
+            "unix time of each worker's last lease/complete",
+            labelnames=("worker",))
+        self._g_targets.set(n_targets)
+        self._g_found.set(0)
+        self._g_quar.set(0)
+
+    #: distinct worker ids the liveness gauge will track; label
+    #: children live for the registry's lifetime, so id CHURN (every
+    #: restart is a new hostname:pid) must not grow coordinator memory
+    #: without bound on a long-lived job
+    MAX_WORKER_LABELS = 1024
+
+    def _touch_worker(self, wid: str) -> None:
+        """Liveness: scrape-visible last-contact time per worker.
+        Past the label cap, overflow ids share one child -- the fleet
+        stays observable even when individual ids stop being.  (The
+        check-then-set pair is not atomic; concurrent handlers can
+        overshoot the cap by a few children, which is fine -- the cap
+        bounds growth, it is not an exact quota.)"""
+        if (not self._g_seen.has_labels(worker=wid)
+                and self._g_seen.child_count() >= self.MAX_WORKER_LABELS):
+            wid = "_overflow"
+        self._g_seen.set(time.time(), worker=wid)
+
+    def refresh_found_gauge(self) -> None:
+        """Re-sync dprf_targets_found after out-of-band mutations of
+        .found (potfile preload / session restore in cli.cmd_serve)."""
+        with self.lock:
+            self._g_found.set(len(self.found))
 
     #: rejected completions before a worker is quarantined.  Lower than
     #: the unit threshold so a single bad worker is benched while its
@@ -136,6 +185,12 @@ class CoordinatorState:
                 # nothing leasable right now; workers retry unless done
                 return {"unit": None,
                         "stop": self.dispatcher.outstanding_count() == 0}
+            # liveness gauge only for ids that actually HOLD a lease:
+            # worker_id is client-controlled, and a label child lives
+            # forever, so polls with throwaway ids must not grow the
+            # registry (holding a lease bounds the id set by the unit
+            # ledger)
+            self._touch_worker(wid)
             return {"unit": {"id": unit.unit_id, "start": unit.start,
                              "length": unit.length}}
 
@@ -164,8 +219,19 @@ class CoordinatorState:
                 if ti in self.found:
                     continue
                 self.found[ti] = plain
+                self._m_hits.inc()
                 if self.on_hit:
                     self.on_hit(ti, cand, plain)
+            self._g_found.set(len(self.found))
+            # attribute the unit's candidates BEFORE complete() drops
+            # it from the lease ledger: remote workers hash in their
+            # own processes, so the coordinator's scrapeable registry
+            # must carry the fleet's sweep count itself
+            unit = self.dispatcher.outstanding_unit(unit_id)
+            if unit is not None:
+                # liveness only for completions of real leases (see
+                # op_lease on label cardinality)
+                self._touch_worker(str(msg.get("worker_id", "?")))
             if rejected:
                 # The reporting worker's device path is suspect: requeue
                 # the range instead of marking it done, or a wrong
@@ -173,12 +239,14 @@ class CoordinatorState:
                 # where the true crack may live.
                 from dprf_tpu.utils.logging import DEFAULT as log
                 self.rejected += rejected
+                self._m_rejects.inc(rejected)
                 wid = str(msg.get("worker_id", "?"))
                 self.worker_rejects[wid] = \
                     self.worker_rejects.get(wid, 0) + 1
                 if (self.worker_rejects[wid] >= self.MAX_WORKER_REJECTS
                         and wid not in self.quarantined):
                     self.quarantined.add(wid)
+                    self._g_quar.set(len(self.quarantined))
                     log.warn("quarantined worker after repeated "
                              "unverifiable hits", worker=wid,
                              rejects=self.worker_rejects[wid])
@@ -198,6 +266,12 @@ class CoordinatorState:
                     self.dispatcher.fail(unit_id)
             else:
                 self.dispatcher.complete(unit_id)
+                if unit is not None:
+                    # rejected units requeue and are NOT counted: the
+                    # range will be re-swept by another worker
+                    self._m_cands.inc(unit.length,
+                                      engine=self.job.get("engine", "?"),
+                                      device="remote")
             if self.on_progress:
                 done, total = self.dispatcher.progress()
                 self.on_progress(done, total, len(self.found))
@@ -207,6 +281,14 @@ class CoordinatorState:
         with self.lock:
             self.dispatcher.fail(int(msg["unit_id"]))
         return {"ok": True}
+
+    def op_metrics(self, msg: dict) -> dict:
+        """Registry read over the RPC protocol (authenticated when the
+        coordinator has a token); the HTTP GET path below serves the
+        same registry for Prometheus scrapers."""
+        if msg.get("format") == "json":
+            return {"ok": True, "metrics": self.registry.snapshot()}
+        return {"ok": True, "text": self.registry.render()}
 
     def op_status(self, msg: dict) -> dict:
         with self.lock:
@@ -234,6 +316,40 @@ class _Handler(socketserver.StreamRequestHandler):
     #: failed auth attempts before the connection is dropped
     MAX_AUTH_FAILURES = 3
 
+    def _serve_http(self, request_line: bytes) -> None:
+        """One-shot HTTP responder on the RPC port: ``GET /metrics``
+        returns the coordinator registry in Prometheus text format.
+        Read-only observability is served even when the RPC protocol
+        is token-authenticated -- it exposes rates and counts, never
+        the job description or hits -- so a scraper needs no secret."""
+        state: CoordinatorState = self.server.state   # type: ignore
+        try:
+            while True:            # drain request headers politely
+                line = self.rfile.readline(MAX_LINE)
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+            parts = request_line.split()
+            head_only = parts and parts[0] == b"HEAD"
+            path = parts[1].decode("latin-1") if len(parts) > 1 else ""
+            if path.split("?")[0] == "/metrics":
+                body = state.registry.render().encode()
+                head = (b"HTTP/1.0 200 OK\r\n"
+                        b"Content-Type: text/plain; version=0.0.4; "
+                        b"charset=utf-8\r\n"
+                        b"Content-Length: %d\r\n"
+                        b"Connection: close\r\n\r\n" % len(body))
+            else:
+                body = b"try /metrics\n"
+                head = (b"HTTP/1.0 404 Not Found\r\n"
+                        b"Content-Type: text/plain\r\n"
+                        b"Content-Length: %d\r\n"
+                        b"Connection: close\r\n\r\n" % len(body))
+            # HEAD: headers only (Content-Length still describes what
+            # GET would return)
+            self.connection.sendall(head if head_only else head + body)
+        except OSError:
+            pass
+
     def handle(self):
         state: CoordinatorState = self.server.state   # type: ignore
         nonce = secrets.token_hex(16)      # challenge, rotated per failure
@@ -241,10 +357,23 @@ class _Handler(socketserver.StreamRequestHandler):
         authed = state.token is None
         while True:
             try:
-                msg = recv_msg(self.rfile)
-            except (ValueError, OSError):
+                line = self.rfile.readline(MAX_LINE)
+            except OSError:
                 return
-            if msg is None:
+            if not line:
+                return
+            if line.startswith((b"GET ", b"HEAD ")):
+                # Prometheus/curl scrape on the RPC port: answer HTTP
+                # and close (HTTP clients don't speak the JSON framing)
+                self._serve_http(line)
+                return
+            if not line.endswith(b"\n"):
+                return     # over the frame limit: drop, as recv_msg does
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                return
+            if not isinstance(msg, dict):
                 return
             if not authed:
                 if msg.get("op") == "hello":
@@ -273,6 +402,13 @@ class _Handler(socketserver.StreamRequestHandler):
                         return
                     continue
             op = getattr(state, f"op_{msg.get('op', '')}", None)
+            # unknown ops share ONE label child: op strings are
+            # client-controlled, and each distinct label value lives in
+            # the registry forever -- an open-protocol client must not
+            # be able to grow coordinator memory one junk op at a time
+            state._m_rpc.inc(
+                op=str(msg.get("op", "?")) if op is not None
+                else "unknown")
             if op is None:
                 resp = {"error": f"unknown op {msg.get('op')!r}"}
             else:
@@ -413,12 +549,22 @@ class CoordinatorClient:
 
 
 def worker_loop(client: CoordinatorClient, worker, worker_id: str,
-                idle_sleep: float = 0.5, log=None) -> int:
+                idle_sleep: float = 0.5, log=None, registry=None) -> int:
     """Lease -> process -> complete until the coordinator says stop.
 
     worker: any object with .process(WorkUnit) -> list[Hit] (the same
     duck type the local Coordinator drives).  Returns units completed.
     """
+    m = get_registry(registry)
+    # worker-side publication: candidates are counted where the hashing
+    # happens (the local Coordinator does the same for in-process jobs)
+    eng_name = getattr(getattr(worker, "engine", None), "name", "unknown")
+    device = "cpu" if type(worker).__name__ == "CpuWorker" else "jax"
+    m_cands = m.counter("dprf_candidates_hashed_total",
+                        "keyspace indices swept",
+                        labelnames=("engine", "device"))
+    h_unit = m.histogram("dprf_unit_seconds",
+                         "submit-to-resolve latency of one WorkUnit")
     done_units = 0
     while True:
         try:
@@ -446,6 +592,7 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
             time.sleep(idle_sleep)
             continue
         unit = WorkUnit(unit_d["id"], unit_d["start"], unit_d["length"])
+        t_unit = time.monotonic()
         try:
             hits = worker.process(unit)
         except Exception:
@@ -455,6 +602,8 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
             except Exception:
                 pass
             raise
+        h_unit.observe(time.monotonic() - t_unit)
+        m_cands.inc(unit.length, engine=eng_name, device=device)
         payload = [{"target": h.target_index, "cand": h.cand_index,
                     "plaintext": h.plaintext.hex()} for h in hits]
         resp = client.call("complete", unit_id=unit.unit_id, hits=payload,

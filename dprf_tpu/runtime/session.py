@@ -36,6 +36,14 @@ class SessionJournal:
         self._since_snapshot = 0
         self._fh = None
 
+    @property
+    def telemetry_path(self) -> str:
+        """Where this session's periodic telemetry snapshots live
+        (telemetry.TelemetrySnapshotter) -- next to the journal, so a
+        wedged run's post-mortem has both coverage AND fleet state."""
+        from dprf_tpu.telemetry import telemetry_path
+        return telemetry_path(self.path)
+
     # -- writing ---------------------------------------------------------
 
     def open(self, spec: dict) -> None:

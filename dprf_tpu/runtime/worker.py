@@ -176,7 +176,18 @@ class MaskWorkerBase:
         # also surfaces here, not just a compile failure -- over the
         # axon tunnel block_until_ready returns at enqueue and the
         # fault would land on the first real batch instead
-        hard_sync(self.step(base, jnp.int32(0)))
+        with self._compile_timer():
+            hard_sync(self.step(base, jnp.int32(0)))
+
+    def _compile_timer(self):
+        """Telemetry timer for warmup compiles (the dominant fixed cost
+        of a job; a scrape that shows minutes here explains a 'stalled'
+        fleet that is really compiling)."""
+        from dprf_tpu.telemetry import DEFAULT as metrics
+        return metrics.histogram(
+            "dprf_compile_seconds", "step warmup/compile wall time",
+            labelnames=("engine",)).time(
+                engine=getattr(self.engine, "name", "unknown"))
 
     def _batch_flag(self, result):
         """Scalar that is nonzero iff this batch needs host attention
@@ -677,7 +688,8 @@ class PallasWordlistWorker(DeviceWordlistWorker):
         import jax.numpy as jnp
 
         from dprf_tpu.utils.sync import hard_sync
-        hard_sync(self.step(jnp.int32(0), jnp.int32(0)))
+        with self._compile_timer():
+            hard_sync(self.step(jnp.int32(0), jnp.int32(0)))
 
 
 class PallasMaskWorker(MaskWorkerBase):

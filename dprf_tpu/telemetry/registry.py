@@ -1,0 +1,313 @@
+"""Dependency-free metrics registry: counters, gauges, histograms.
+
+The substrate every runtime layer publishes into (coordinator,
+dispatcher, worker, rpc, bench): a process-wide DEFAULT registry plus
+explicit registries for tests and embedded use.  Three render targets:
+
+  - render()    Prometheus text exposition format (served by the
+                coordinator's ``/metrics`` endpoint, rpc._Handler);
+  - snapshot()  JSON-serializable dict (the periodic JSONL telemetry
+                snapshot written next to the session journal);
+  - direct reads in tests (``Counter.value()``).
+
+Design constraints: stdlib only (the worker path must not grow a
+client-library dependency), thread-safe under the RPC server's
+handler threads and the worker's async submit, and cheap enough that
+per-unit increments are noise next to one device dispatch.  Timers use
+the monotonic clock -- wall-clock steps must never produce negative
+latencies in the journal.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+#: default histogram buckets: spans sub-ms registry ops through
+#: multi-minute compiles (the observed range of step latency and
+#: compile-time observations); +Inf is implicit.
+DEFAULT_BUCKETS = (0.005, 0.025, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0,
+                   30.0, 60.0, 120.0, 300.0)
+
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _fmt(v: float) -> str:
+    """Prometheus number formatting: integers stay integral."""
+    if v == math.inf:
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v)
+
+
+def _label_str(names: Sequence[str], values: Tuple) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{_escape_label(v)}"'
+                     for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """Shared label-child bookkeeping; subclasses define the per-child
+    state and rendering."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str],
+                 lock: threading.RLock):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = lock
+        self._children: dict = {}
+
+    def _key(self, labels: dict) -> Tuple:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != declared "
+                f"{sorted(self.labelnames)}")
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def _child(self, labels: dict):
+        key = self._key(labels)
+        with self._lock:
+            c = self._children.get(key)
+            if c is None:
+                c = self._children[key] = self._new_child()
+            return c
+
+    def header(self) -> list:
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} {self.kind}"]
+
+    def child_count(self) -> int:
+        with self._lock:
+            return len(self._children)
+
+    def has_labels(self, **labels) -> bool:
+        """Whether this exact label set already has a child (without
+        creating one) -- lets callers bound label cardinality against
+        client-controlled values."""
+        with self._lock:
+            return self._key(labels) in self._children
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _new_child(self):
+        return [0.0]
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError(f"{self.name}: counters only go up")
+        c = self._child(labels)
+        with self._lock:
+            c[0] += amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._child(labels)[0]
+
+    def render(self) -> list:
+        out = self.header()
+        with self._lock:
+            for key, c in sorted(self._children.items()):
+                out.append(f"{self.name}"
+                           f"{_label_str(self.labelnames, key)} "
+                           f"{_fmt(c[0])}")
+        return out
+
+    def snapshot_values(self) -> list:
+        with self._lock:
+            return [{"labels": dict(zip(self.labelnames, k)),
+                     "value": c[0]}
+                    for k, c in sorted(self._children.items())]
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        c = self._child(labels)
+        with self._lock:
+            c[0] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        c = self._child(labels)
+        with self._lock:
+            c[0] += amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+
+class _Timer:
+    """Context manager feeding a histogram from the monotonic clock."""
+
+    __slots__ = ("_hist", "_labels", "_t0")
+
+    def __init__(self, hist: "Histogram", labels: dict):
+        self._hist = hist
+        self._labels = labels
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.monotonic() - self._t0, **self._labels)
+        return False
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help, labelnames, lock,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames, lock)
+        b = sorted(float(x) for x in buckets)
+        if not b:
+            raise ValueError("histogram needs at least one bucket")
+        self.buckets = tuple(b)
+
+    def _new_child(self):
+        # [bucket counts..., +Inf count, sum]
+        return [0] * (len(self.buckets) + 1) + [0.0]
+
+    def observe(self, value: float, **labels) -> None:
+        c = self._child(labels)
+        with self._lock:
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    c[i] += 1
+                    break
+            else:
+                c[len(self.buckets)] += 1
+            c[-1] += value
+
+    def time(self, **labels) -> _Timer:
+        return _Timer(self, labels)
+
+    def count(self, **labels) -> int:
+        c = self._child(labels)
+        with self._lock:
+            return sum(c[:-1])
+
+    def sum(self, **labels) -> float:
+        c = self._child(labels)
+        with self._lock:
+            return c[-1]
+
+    def render(self) -> list:
+        out = self.header()
+        with self._lock:
+            for key, c in sorted(self._children.items()):
+                cum = 0
+                for i, ub in enumerate(self.buckets):
+                    cum += c[i]
+                    ls = _label_str(self.labelnames + ("le",),
+                                    key + (_fmt(ub),))
+                    out.append(f"{self.name}_bucket{ls} {cum}")
+                cum += c[len(self.buckets)]
+                ls = _label_str(self.labelnames + ("le",), key + ("+Inf",))
+                out.append(f"{self.name}_bucket{ls} {cum}")
+                base = _label_str(self.labelnames, key)
+                out.append(f"{self.name}_sum{base} {_fmt(c[-1])}")
+                out.append(f"{self.name}_count{base} {cum}")
+        return out
+
+    def snapshot_values(self) -> list:
+        with self._lock:
+            return [{"labels": dict(zip(self.labelnames, k)),
+                     "buckets": dict(zip(
+                         [_fmt(b) for b in self.buckets] + ["+Inf"],
+                         c[:-1])),
+                     "sum": c[-1], "count": sum(c[:-1])}
+                    for k, c in sorted(self._children.items())]
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    """Get-or-create metric registry.  Re-declaring an existing name
+    with the same kind and labelnames returns the SAME metric (every
+    layer declares what it uses, none owns the registry); a conflicting
+    re-declaration is a programming error and raises."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get(self, cls, name: str, help: str,
+             labelnames: Sequence[str], **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if type(m) is not cls or m.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{m.kind} with labels {m.labelnames}")
+                want = kw.get("buckets")
+                if (want is not None and
+                        m.buckets != tuple(sorted(float(b)
+                                                  for b in want))):
+                    # silently keeping the first declaration's buckets
+                    # would bin the second caller's observations into
+                    # bounds it never asked for
+                    raise ValueError(
+                        f"histogram {name!r} already registered with "
+                        f"buckets {m.buckets}")
+                return m
+            m = cls(name, help, labelnames, self._lock, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help, labelnames,
+                         buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render(self) -> str:
+        """Prometheus text exposition format (version 0.0.4)."""
+        lines: list = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> dict:
+        """JSON-serializable view: {name: {kind, help, values}}."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        out = {}
+        for m in metrics:
+            out[m.name] = {"kind": m.kind, "help": m.help,
+                           "values": m.snapshot_values()}
+        return out
+
+    def snapshot_json(self) -> str:
+        return json.dumps(self.snapshot(), separators=(",", ":"))

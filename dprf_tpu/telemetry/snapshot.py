@@ -1,0 +1,113 @@
+"""Periodic JSONL telemetry snapshots, written next to the session
+journal.
+
+Post-mortems of wedged runs (the round-5 tunnel wedge cost a full
+round of measurements) need data, not guesswork: a background thread
+appends one ``{"ts": ..., "elapsed_s": ..., "metrics": {...}}`` line
+per interval, so the last line of the file is the fleet's state at the
+moment the run died.  Append-only JSONL with the same torn-tail
+tolerance as the session journal; snapshots are diagnostics, never
+resume state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from dprf_tpu.telemetry.registry import MetricsRegistry
+
+#: suffix appended to a session journal path for its telemetry stream
+TELEMETRY_SUFFIX = ".telemetry.jsonl"
+
+#: default seconds between snapshot lines (override per-run with
+#: DPRF_TELEMETRY_INTERVAL)
+DEFAULT_INTERVAL_S = 30.0
+
+
+def telemetry_path(session_path: str) -> str:
+    """Snapshot file location for a session journal path."""
+    return session_path + TELEMETRY_SUFFIX
+
+
+def snapshot_interval(default: float = DEFAULT_INTERVAL_S) -> float:
+    try:
+        return float(os.environ.get("DPRF_TELEMETRY_INTERVAL", default))
+    except ValueError:
+        return default
+
+
+class TelemetrySnapshotter:
+    """Background writer: one registry snapshot line per interval plus
+    a final line on stop() -- so a clean shutdown always journals the
+    end-state even for runs shorter than one interval."""
+
+    def __init__(self, path: str, registry: MetricsRegistry,
+                 interval: float = DEFAULT_INTERVAL_S,
+                 clock=time.time):
+        self.path = path
+        self.registry = registry
+        self.interval = max(0.25, float(interval))
+        self._clock = clock
+        self._t0 = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def write_once(self) -> dict:
+        line = {"ts": self._clock(),
+                "elapsed_s": round(time.monotonic() - self._t0, 3),
+                "metrics": self.registry.snapshot()}
+        data = json.dumps(line, separators=(",", ":")) + "\n"
+        with self._lock:
+            with open(self.path, "a", encoding="utf-8") as fh:
+                fh.write(data)
+                fh.flush()
+                os.fsync(fh.fileno())
+        return line
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.write_once()
+            except OSError:
+                # a full/unwritable disk must not kill the job; the
+                # next interval retries
+                continue
+
+    def start(self) -> "TelemetrySnapshotter":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        try:
+            self.write_once()
+        except OSError:
+            pass
+
+
+def load_snapshots(path: str) -> list:
+    """Read a snapshot JSONL file back (torn tail lines skipped, like
+    SessionJournal.load)."""
+    out = []
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out

@@ -138,6 +138,7 @@ def test_bcrypt_mask_worker_finds_planted():
 
 
 @pytest.mark.smoke
+@pytest.mark.compileheavy    # two full EKS program compiles (~1 min)
 def test_chunked_eks_matches_fused():
     """Splitting the cost loop across arbitrary dispatch boundaries must
     reproduce the one-shot eks_setup state exactly (the chunked path is

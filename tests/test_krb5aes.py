@@ -84,9 +84,12 @@ def _der_blob(body_len: int, tag: int, fill: int) -> bytes:
 
 def _line(pw: bytes, tag_name: str, etype: int, usage: int,
           seed: int = 3, body_len: int = 400,
-          user: str = "svc", realm: str = "EXAMPLE.COM") -> str:
+          user: str = "svc", realm: str = "EXAMPLE.COM",
+          iterations: int = 4096) -> str:
     """Self-consistent hash line: run RFC 3962 forward with the true
-    password and a deterministic DER plaintext, store checksum+edata."""
+    password and a deterministic DER plaintext, store checksum+edata.
+    iterations: tests that lower it must ALSO lower the engines'
+    `iterations` attribute (the line format does not carry it)."""
     rng = random.Random(seed)
     conf = bytes(rng.randrange(256) for _ in range(16))
     app_tag = {USAGE_TGS_REP_TICKET: 0x63, USAGE_AS_REP: 0x79,
@@ -98,7 +101,8 @@ def _line(pw: bytes, tag_name: str, etype: int, usage: int,
     else:
         plain = conf + _der_blob(body_len, app_tag, seed)
     salt = (realm + user).encode()
-    key = string_to_key(pw, salt, 16 if etype == 17 else 32)
+    key = string_to_key(pw, salt, 16 if etype == 17 else 32,
+                        iterations=iterations)
     ke, ki = usage_keys(key, usage)
     edata = cts_encrypt(ke, plain)
     chk = hmac_mod.new(ki, plain, hashlib.sha1).digest()[:12]
@@ -130,18 +134,24 @@ def test_parse_errors():
 @pytest.mark.smoke
 @pytest.mark.parametrize("etype", [17, 18])
 def test_mask_worker_end_to_end_tgs(etype):
+    """End-to-end device mask sweep, shrunk for the smoke tier: a
+    low KDF iteration count (the iteration loop is runtime-bound, not
+    compile-bound -- the fori_loop body compiles once) and a tiny
+    keyspace/batch.  The RFC-vector tests above pin the full-count
+    math; this case proves the fused pipeline plumbing."""
     dev = get_engine("krb5tgs-aes", device="jax")
     cpu = get_engine("krb5tgs-aes", device="cpu")
-    gen = MaskGenerator("?l?d?l")
-    secret = gen.candidate(1744)
+    dev.iterations = cpu.iterations = 128
+    gen = MaskGenerator("?d?l")
+    secret = gen.candidate(174)
     t = dev.parse_target(_line(secret, "krb5tgs", etype,
-                               USAGE_TGS_REP_TICKET))
-    w = dev.make_mask_worker(gen, [t], batch=512, hit_capacity=8,
+                               USAGE_TGS_REP_TICKET, iterations=128))
+    w = dev.make_mask_worker(gen, [t], batch=128, hit_capacity=8,
                              oracle=cpu)
     assert type(w).__name__ == "Krb5AesMaskWorker"
     hits = w.process(WorkUnit(0, 0, gen.keyspace))
     assert [(h.target_index, h.cand_index, h.plaintext)
-            for h in hits] == [(0, 1744, secret)]
+            for h in hits] == [(0, 174, secret)]
 
 
 def test_mask_worker_asrep_and_pa_fallback():
@@ -264,6 +274,103 @@ def test_mixed_floor_targets_stay_on_device():
     hits = w.process(WorkUnit(0, 0, gen.keyspace))
     assert sorted((h.target_index, h.plaintext) for h in hits) == \
         [(0, s_short), (1, s_long)]
+
+
+@pytest.mark.smoke
+def test_pa_long_form_der_window():
+    """Long-form DER length branches must expect the PA-ENC-TS-ENC [0]
+    inner tag 0xA0 (not the SEQUENCE 0x30 of ticket payloads) -- the
+    0x81 branch's byte 4 is the first content byte (ADVICE.md round-5
+    low: a wrong expectation here is a silent missed-crack)."""
+    from dprf_tpu.engines.device.krb5aes import (CONF,
+                                                 der_filter_words_aes)
+
+    # 0x81 long form: L - 2 >= 0x80, L - 3 <= 0xFF -> window byte 4 is
+    # the inner tag
+    L = 200
+    exp, msk = der_filter_words_aes(CONF + L, USAGE_PA_TIMESTAMP)
+    b = [(exp >> (8 * i)) & 0xFF for i in range(4)]
+    assert b == [0x30, 0x81, L - 3, 0xA0]
+    assert msk == 0xFFFFFFFF
+    # ticket usages keep the inner SEQUENCE expectation
+    exp_t, _ = der_filter_words_aes(CONF + L, USAGE_TGS_REP_TICKET)
+    assert [(exp_t >> (8 * i)) & 0xFF for i in range(4)] == \
+        [0x63, 0x81, L - 3, 0x30]
+    # short form: 24-bit window (byte 4 masked out), PA inner tag 0xA0
+    exp_s, msk_s = der_filter_words_aes(CONF + 40, USAGE_PA_TIMESTAMP)
+    assert [(exp_s >> (8 * i)) & 0xFF for i in range(4)] == \
+        [0x30, 38, 0xA0, 0x00]
+    assert msk_s == 0x00FFFFFF
+    # 0x82 windows carry tag + 3 length bytes only -- no content byte
+    exp_w, msk_w = der_filter_words_aes(CONF + 0x1000, USAGE_PA_TIMESTAMP)
+    C = 0x1000 - 4
+    assert [(exp_w >> (8 * i)) & 0xFF for i in range(4)] == \
+        [0x30, 0x82, (C >> 8) & 0xFF, C & 0xFF]
+
+
+_LONG_REALM = "VERY-LONG-SUBDOMAIN.CORP.EXAMPLE-ENTERPRISES.COM"
+
+
+def test_long_salt_targets_demote_to_oracle():
+    """A salt (realm+user) above the one-block PBKDF2 budget must
+    route to the CPU oracle instead of crashing the job with 'salt too
+    long for one block' at the first step() (ADVICE.md round-5
+    medium)."""
+    from dprf_tpu.engines.device.krb5aes import (MAX_DEVICE_SALT,
+                                                 _target_device_ok)
+
+    dev = get_engine("krb5tgs-aes", device="jax")
+    cpu = get_engine("krb5tgs-aes", device="cpu")
+    gen = MaskGenerator("?d?d")
+    secret = gen.candidate(42)
+    line = _line(secret, "krb5tgs", 18, USAGE_TGS_REP_TICKET, seed=5,
+                 user="svc-backup", realm=_LONG_REALM)
+    t = dev.parse_target(line)
+    assert len(t.params["salt"]) > MAX_DEVICE_SALT
+    assert not _target_device_ok(t)
+
+    # single long-salt target: the whole job demotes (mask worker)
+    w = dev.make_mask_worker(gen, [t], batch=128, hit_capacity=8,
+                             oracle=cpu)
+    assert type(w).__name__ == "CpuWorker"
+    hits = w.process(WorkUnit(0, 0, gen.keyspace))
+    assert [(h.target_index, h.plaintext) for h in hits] == [(0, secret)]
+
+    # wordlist scaffold demotes too (it has no per-target host steps)
+    from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+    wgen = WordlistRulesGenerator([secret, b"nope"], max_len=16)
+    ww = dev.make_wordlist_worker(wgen, [t], batch=16, hit_capacity=8,
+                                  oracle=cpu)
+    assert type(ww).__name__ == "CpuWorker"
+
+
+def test_mixed_long_salt_target_gets_host_step():
+    """Mixed hashlist: the long-salt target rides a host pseudo-step
+    while eligible targets keep compiled device steps (same per-target
+    routing as the below-floor edata case).  The device step is only
+    CONSTRUCTED here (jit is lazy) -- the host step is driven directly
+    so the test stays off the multi-minute XLA PBKDF2 compile."""
+    dev = get_engine("krb5tgs-aes", device="jax")
+    cpu = get_engine("krb5tgs-aes", device="cpu")
+    gen = MaskGenerator("?d?d")
+    s_long = gen.candidate(31)
+    t_long = dev.parse_target(_line(s_long, "krb5tgs", 18,
+                                    USAGE_TGS_REP_TICKET, seed=5,
+                                    user="svc-backup",
+                                    realm=_LONG_REALM))
+    t_ok = dev.parse_target(_line(gen.candidate(77), "krb5tgs", 18,
+                                  USAGE_TGS_REP_TICKET, seed=8))
+    w = dev.make_mask_worker(gen, [t_long, t_ok], batch=128,
+                             hit_capacity=8, oracle=cpu)
+    assert type(w).__name__ == "Krb5AesMaskWorker"
+    # index 0 (long salt) is a plain-python host pseudo-step; index 1
+    # is a jitted device step
+    assert not hasattr(w._steps[0], "lower")
+    assert hasattr(w._steps[1], "lower")
+    import numpy as np
+    count, lanes, _ = w._steps[0](
+        np.zeros(gen.length, np.int32), np.int32(gen.keyspace), None)
+    assert int(count) == 1 and int(lanes[0]) == 31
 
 
 def test_machine_account_principal_parses():

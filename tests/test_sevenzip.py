@@ -81,6 +81,7 @@ def test_decrypt_roundtrip():
 
 
 @pytest.mark.smoke
+@pytest.mark.compileheavy    # iterated SHA-256 KDF step compile
 def test_mask_worker_end_to_end():
     dev = get_engine("7z", "jax")
     cpu = get_engine("7z", "cpu")

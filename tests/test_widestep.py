@@ -198,6 +198,7 @@ def test_wide_build_failure_degrades_to_per_batch(md5_jax):
     assert not getattr(w, "_super_cache", None)
 
 
+@pytest.mark.compileheavy    # interpret-mode rules-kernel wide build
 def test_wordlist_wide_matches_per_batch(monkeypatch):
     """PallasWordlistWorker wide dispatch: flat rule-major lanes are
     decoded with the WIDE word stride (lane = r * n_words + b), so a
