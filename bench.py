@@ -64,7 +64,12 @@ def save(done=False):
 
 from dprf_tpu.bench import calibrated_inner
 
-for impl, batch in (("pallas", 1 << 22), ("xla", 1 << 22)):
+# warm-start from the tuning cache when `dprf tune` has swept this
+# chip (ISSUE 2); a miss keeps the proven 1<<22 default
+from dprf_tpu.tune import lookup_tuned_batch
+_tb = lookup_tuned_batch("md5", attack="mask", device="jax")
+
+for impl, batch in (("pallas", _tb or 1 << 22), ("xla", _tb or 1 << 22)):
     try:
         cal = run_bench(engine="md5", device="jax",
                         mask="?a?a?a?a?a?a?a?a", batch=batch,
@@ -74,6 +79,7 @@ for impl, batch in (("pallas", 1 << 22), ("xla", 1 << 22)):
                               mask="?a?a?a?a?a?a?a?a", batch=batch,
                               seconds=15.0, inner=inner, impl=impl)
         out[impl]["calibrate_hs"] = cal["value"]
+        out[impl]["tuned"] = _tb is not None
     except Exception as e:
         out[impl] = {{"error": f"{{type(e).__name__}}: {{e}}"}}
     save()
@@ -85,9 +91,10 @@ for impl, batch in (("pallas", 1 << 22), ("xla", 1 << 22)):
 # headline pick the best.
 try:
     from dprf_tpu.bench import run_config
-    rec = run_config(1, device="jax", seconds=15.0, batch=1 << 22,
-                     unit_strides=64)
+    rec = run_config(1, device="jax", seconds=15.0,
+                     batch=_tb or 1 << 22, unit_strides=64)
     rec["impl"] = "worker-wide"
+    rec["tuned"] = _tb is not None
     out["worker"] = rec
 except Exception as e:
     out["worker"] = {{"error": f"{{type(e).__name__}}: {{e}}"}}
@@ -368,15 +375,19 @@ def main() -> int:
         _record_freshness(workdir, False, 0)
         print(json.dumps({"metric": "md5 candidates/sec/chip", "value": 0,
                           "unit": "H/s", "vs_baseline": 0.0,
-                          "fresh": False, "note": "bench failed"}))
+                          "fresh": False, "tuned": False,
+                          "note": "bench failed"}))
         return 1
 
     # fresh: this invocation ran the measurement (live chip or live
     # CPU); false ONLY for the cached-session tier.  Machine-checkable
     # liveness per the VERDICT r5 mandate.
+    # tuned: the measurement ran at a batch loaded from the tuning
+    # cache (`dprf tune`); false = default/pinned batch.  Same
+    # machine-checkable contract as `fresh` (ISSUE 2).
     out = {"metric": "md5 candidates/sec/chip", "value": res["value"],
            "unit": "H/s", "vs_baseline": res["value"] / BASELINE_TARGET,
-           "fresh": fresh}
+           "fresh": fresh, "tuned": bool(res.get("tuned", False))}
     if res.get("device") == "tpu":
         # conservative fraction (vs the 8 GH/s upper ceiling) plus the
         # optimistic one (vs 4 GH/s); the truth is in the band

@@ -41,6 +41,23 @@ def _publish(result: dict, mode: str) -> dict:
     return result
 
 
+def _tuned_or(batch, engine: str, device: str, fallback: int,
+              attack: str = "mask") -> tuple:
+    """Bench-side ``--batch auto``: (resolved batch, tuned flag).  An
+    explicit integer is pinned; "auto"/None warm-starts from the tuning
+    cache written by ``dprf tune`` (environment-validated -- a stale
+    entry reads as a miss) and otherwise uses `fallback`.  Every bench
+    result carries the flag, so a reported rate is attributable to a
+    tuned or a default batch -- machine-checkable, like `fresh`."""
+    if batch not in (None, "auto"):
+        return int(batch), False
+    from dprf_tpu.tune import lookup_tuned_batch
+    b = lookup_tuned_batch(engine, attack=attack, device=device)
+    if b:
+        return b, True
+    return fallback, False
+
+
 def calibrated_inner(probe_rate: float, batch: int,
                      target_s: float = 5.0, cap: int = 1 << 20) -> int:
     """Inner-loop length so one dispatch computes ~target_s of work.
@@ -79,16 +96,21 @@ def make_looped_step(step, inner: int):
 
 
 def run_bench(engine: str = "md5", device: str = "jax",
-              mask: str = "?a?a?a?a?a?a?a?a", batch: int = 1 << 20,
+              mask: str = "?a?a?a?a?a?a?a?a", batch="auto",
               seconds: float = 5.0, impl: str = "auto",
               inner: int = 1, log=None) -> dict:
     """impl: "xla" forces the generic fused pipeline, "pallas" forces
     the hand-written kernel (MD5 only), "auto" = pallas on TPU when
     eligible -- the same selection a real job makes.
 
+    batch: an int pins the batch; "auto" (default) consumes the tuning
+    cache (`dprf tune`) and falls back to 1<<20.  The result reports
+    `tuned` accordingly.
+
     inner > 1 loops the step on device (see make_looped_step) and is
     the honest way to measure chip throughput over a high-latency
     link; inner = 1 measures the per-dispatch production path."""
+    batch, tuned = _tuned_or(batch, engine, device, 1 << 20)
     gen = MaskGenerator(mask)
     # An all-0xFF digest can't be produced by these hash functions'
     # outputs for in-keyspace candidates (and a false hit would only add
@@ -216,6 +238,7 @@ def run_bench(engine: str = "md5", device: str = "jax",
         "device": platform,
         "mask": mask,
         "batch": batch,
+        "tuned": tuned,
         "batches": n,
         "inner": inner,
         "elapsed_s": round(elapsed, 3),
@@ -224,7 +247,7 @@ def run_bench(engine: str = "md5", device: str = "jax",
 
 
 def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
-                n_devices: int = 8, batch_per_device: int = 1 << 20,
+                n_devices: int = 8, batch_per_device="auto",
                 seconds: float = 5.0, inner: int = 1, log=None) -> dict:
     """Scaling-efficiency mode (the second north-star number:
     >= 95% efficiency at pod scale).  Measures the sharded fused step
@@ -242,6 +265,8 @@ def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
     from dprf_tpu.parallel.mesh import make_mesh
     from dprf_tpu.parallel.sharded import make_sharded_mask_crack_step
 
+    batch_per_device, tuned = _tuned_or(batch_per_device, engine, "jax",
+                                        1 << 20)
     gen = MaskGenerator(mask)
     eng = get_engine(engine, device="jax")
     fake = bytes([0xFF]) * eng.digest_size   # unmatchable (see run_bench)
@@ -293,6 +318,7 @@ def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
         "mask": mask,
         "n_devices": n_devices,
         "batch_per_device": batch_per_device,
+        "tuned": tuned,
         "rate_1chip": one["rate"],
         "rate_ndev": many["rate"],
         "per_chip": many["rate"] / n_devices,
@@ -374,7 +400,7 @@ def _config_job(n: int, bcrypt_cost: int):
 
 
 def run_config(config: int, device: str = "jax", seconds: float = 5.0,
-               batch: int = 1 << 18, bcrypt_cost: int = 12,
+               batch="auto", bcrypt_cost: int = 12,
                unit_strides: int = 1, log=None) -> dict:
     """Measure one acceptance workload end to end.  Returns the same
     JSON shape as run_bench, plus the config number.
@@ -391,6 +417,8 @@ def run_config(config: int, device: str = "jax", seconds: float = 5.0,
     from dprf_tpu.runtime.workunit import WorkUnit
 
     engine_name, attack, gen, lines = _config_job(config, bcrypt_cost)
+    batch, tuned = _tuned_or(batch, engine_name, device, 1 << 18,
+                             attack=attack)
     oracle = get_engine(engine_name, device="cpu")
     targets = [oracle.parse_target(s)
                for s in (lines or [_unmatchable(oracle)])]
@@ -458,6 +486,7 @@ def run_config(config: int, device: str = "jax", seconds: float = 5.0,
         "targets": len(targets),
         "device": platform,
         "batch": batch,
+        "tuned": tuned,
         "unit_strides": max(1, unit_strides),
         "tested": tested,
         "elapsed_s": round(elapsed, 3),

@@ -30,6 +30,18 @@ from dprf_tpu.utils.logging import Log
 
 _DEVICE_ALIASES = {"tpu": "jax", "jax": "jax", "cpu": "cpu"}
 
+#: the pre-tuning hard-coded device batch; "auto" falls back here when
+#: neither the session journal nor the tune cache has an entry
+DEFAULT_BATCH = 1 << 18
+
+
+def _batch_size(s: str):
+    """--batch value: an integer, or "auto" (resolve from the tuning
+    subsystem: session journal > persistent cache > DEFAULT_BATCH)."""
+    if s == "auto":
+        return s
+    return int(s)
+
 
 def _add_job_args(c, with_hashfile: bool = True) -> None:
     """Attack/job flags shared by crack and serve."""
@@ -62,7 +74,15 @@ def _add_job_args(c, with_hashfile: bool = True) -> None:
     c.add_argument("--potfile", default="dprf.potfile")
     c.add_argument("--no-potfile", action="store_true")
     c.add_argument("--unit-size", type=int, default=1 << 22)
-    c.add_argument("--batch", type=int, default=1 << 18)
+    c.add_argument("--unit-seconds", type=float, default=20.0,
+                   metavar="S",
+                   help="adaptive unit sizing: grow/shrink each "
+                   "worker's WorkUnits toward S seconds apiece from "
+                   "its measured throughput (0 pins --unit-size)")
+    c.add_argument("--batch", type=_batch_size, default="auto",
+                   help="device batch size, or 'auto' (default): use "
+                   "the tuning cache written by `dprf tune`, falling "
+                   f"back to {DEFAULT_BATCH}")
     c.add_argument("--hit-cap", type=int, default=64)
     c.add_argument("--skip", type=int, default=0, metavar="N",
                    help="skip the first N keyspace indices")
@@ -137,7 +157,9 @@ def _build_parser() -> argparse.ArgumentParser:
     b.add_argument("--engine", "-m", default="md5")
     b.add_argument("--device", default="tpu", choices=sorted(_DEVICE_ALIASES))
     b.add_argument("--mask", default="?a?a?a?a?a?a?a?a")
-    b.add_argument("--batch", type=int, default=1 << 20)
+    b.add_argument("--batch", type=_batch_size, default="auto",
+                   help="batch size, or 'auto' (default): tuned batch "
+                   "from the cache when one matches, else 1<<20")
     b.add_argument("--seconds", type=float, default=5.0)
     b.add_argument("--impl", default="auto", choices=["auto", "xla", "pallas"],
                    help="force the generic XLA pipeline or the Pallas "
@@ -158,6 +180,34 @@ def _build_parser() -> argparse.ArgumentParser:
                    "round trip, not the chip")
     b.add_argument("--profile", default=None, metavar="DIR")
     b.add_argument("--quiet", "-q", action="store_true")
+
+    tn = sub.add_parser("tune", help="autotune the device batch size "
+                        "for an engine and record it in the tuning "
+                        "cache (consumed by `--batch auto` and bench)")
+    tn.add_argument("--engine", "-m", required=True)
+    tn.add_argument("--device", default="tpu",
+                    choices=sorted(_DEVICE_ALIASES))
+    tn.add_argument("--mask", default="?a?a?a?a?a?a?a?a",
+                    help="mask shaping the candidates swept during "
+                    "the probe")
+    tn.add_argument("--hashfile", default=None,
+                    help="tune against real targets (required for "
+                    "salted engines; default: one synthetic "
+                    "unmatchable digest)")
+    tn.add_argument("--seconds", type=float, default=2.0,
+                    help="steady-state probe window per ladder rung")
+    tn.add_argument("--min-batch", type=int, default=1 << 14)
+    tn.add_argument("--max-batch", type=int, default=1 << 22)
+    tn.add_argument("--ladder-factor", type=int, default=4,
+                    help="geometric step between ladder rungs")
+    tn.add_argument("--compile-budget", type=float, default=120.0,
+                    metavar="S", help="skip rungs whose warmup/compile "
+                    "exceeds S seconds (and stop climbing)")
+    tn.add_argument("--hit-cap", type=int, default=64)
+    tn.add_argument("--tune-dir", default=None,
+                    help="cache directory (default: $DPRF_TUNE_DIR or "
+                    "~/.cache/dprf)")
+    tn.add_argument("--quiet", "-q", action="store_true")
 
     for name, helptext in (("show", "print potfile-cracked targets of a "
                             "hashlist as hash:plain"),
@@ -427,10 +477,12 @@ def _load_targets(engine, hashfile: str, log: Log):
 
 
 def _setup_session(args, spec, log: Log):
-    """Returns (session, completed, restored_hits) or None on conflict."""
+    """Returns (session, completed, restored_hits, tuning) or None on
+    conflict."""
     session = None
     completed: list = []
     restored_hits: list = []
+    tuning: dict = {}
     if args.session:
         session = SessionJournal(args.session)
         prior = SessionJournal.load(args.session)
@@ -445,6 +497,7 @@ def _setup_session(args, spec, log: Log):
             else:
                 completed = prior.completed
                 restored_hits = prior.hits
+                tuning = prior.tuning
                 done = sum(e - s for s, e in completed)
                 log.info("resuming session", covered=done,
                          hits=len(restored_hits))
@@ -452,7 +505,7 @@ def _setup_session(args, spec, log: Log):
             log.error("session file exists; pass --restore to resume "
                       "or remove it", path=args.session)
             return None
-    return session, completed, restored_hits
+    return session, completed, restored_hits, tuning
 
 
 def _print_results(found: dict, targets) -> None:
@@ -469,7 +522,8 @@ class _JobSetup:
     generator, spec/fingerprint, session state, dispatcher."""
 
     def __init__(self, engine, hl, gen, max_len, unit_size, spec,
-                 session, completed, restored_hits, dispatcher):
+                 session, completed, restored_hits, dispatcher,
+                 tuning=None):
         self.engine = engine
         self.hl = hl
         self.gen = gen
@@ -480,6 +534,8 @@ class _JobSetup:
         self.completed = completed
         self.restored_hits = restored_hits
         self.dispatcher = dispatcher
+        #: tuning records restored from the session journal (resume)
+        self.tuning = tuning or {}
 
 
 def _setup_job(args, device: str, log: Log,
@@ -508,9 +564,20 @@ def _setup_job(args, device: str, log: Log,
     sess = _setup_session(args, spec, log)
     if sess is None:
         return None
-    session, completed, restored_hits = sess
+    session, completed, restored_hits, tuning = sess
 
     kw = {} if lease_timeout is None else {"lease_timeout": lease_timeout}
+    unit_seconds = getattr(args, "unit_seconds", 0) or 0
+    if unit_seconds > 0:
+        from dprf_tpu.tune import AdaptiveUnitSizer
+        # wordlist units stay word-aligned even when adaptively sized,
+        # so no candidate is rehashed at unit boundaries
+        align = gen.n_rules if args.attack == "wordlist" else 1
+        kw["sizer"] = AdaptiveUnitSizer(
+            unit_size, target_seconds=unit_seconds, align=align,
+            # an explicit tiny --unit-size is a floor the sizer must
+            # respect, not round up away from
+            min_unit=max(align, min(unit_size, 1 << 10)))
     # --skip/--limit restrict THIS run's sweep by pre-marking the
     # excluded ranges done (run-scoped: not part of the job identity,
     # exactly like resuming a partially-covered session)
@@ -533,7 +600,48 @@ def _setup_job(args, device: str, log: Log,
     else:
         dispatcher = Dispatcher(gen.keyspace, unit_size, **kw)
     return _JobSetup(engine, hl, gen, max_len, unit_size, spec,
-                     session, completed, restored_hits, dispatcher)
+                     session, completed, restored_hits, dispatcher,
+                     tuning=tuning)
+
+
+def _resolve_batch(batch_arg, engine_name: str, device: str, attack: str,
+                   log: Log, session=None, session_tuning=None):
+    """--batch resolution: an explicit integer is pinned; "auto"
+    consults the tuning subsystem -- the resumed session's journaled
+    decision first (the resumed ledger's unit geometry was built around
+    it, and the journal survives machines whose tune cache doesn't),
+    then the persistent cache.  Returns (batch, tuned); a tuned choice
+    is re-journaled so the NEXT resume sees it too."""
+    from dprf_tpu import tune as tune_mod
+
+    if batch_arg != "auto":
+        return int(batch_arg), False
+    key = tune_mod.make_key(engine_name, attack=attack, device=device)
+    rec = (session_tuning or {}).get(key)
+    batch = None
+    if isinstance(rec, dict):
+        try:
+            batch = int(rec["batch"])
+        except (KeyError, TypeError, ValueError):
+            batch = None
+        if batch:
+            log.info("tuned batch restored from session", batch=batch)
+            tune_mod.publish_tuned_batch(engine_name, device, attack,
+                                         batch)
+    if not batch:
+        batch = tune_mod.lookup_tuned_batch(engine_name, attack=attack,
+                                            device=device)
+        if batch:
+            log.info("tuned batch loaded from cache", batch=batch,
+                     cache=tune_mod.cache_path())
+    if not batch:
+        log.info("no tuning entry for this job; using the default "
+                 "batch (run `dprf tune` to sweep one)",
+                 batch=DEFAULT_BATCH, engine=engine_name)
+        return DEFAULT_BATCH, False
+    if session is not None:
+        session.record_tuning(key, {"batch": batch})
+    return batch, True
 
 
 def cmd_crack(args, log: Log) -> int:
@@ -626,8 +734,11 @@ def _crack_single(args, device: str, log: Log):
     session, restored_hits = job.session, job.restored_hits
     dispatcher, spec = job.dispatcher, job.spec
 
+    batch, _ = _resolve_batch(args.batch, args.engine, device,
+                              args.attack, log, session=session,
+                              session_tuning=job.tuning)
     worker = _select_worker(args.engine, device, args.attack, gen,
-                            hl.targets, args.batch, args.hit_cap,
+                            hl.targets, batch, args.hit_cap,
                             engine, args.devices, log)
 
     potfile = None if args.no_potfile else Potfile(args.potfile)
@@ -676,6 +787,10 @@ def _crack_single(args, device: str, log: Log):
                      path=session.telemetry_path)
 
     _print_results(result.found, hl.targets)
+    if result.parked:
+        log.warn("job finished with POISONED units parked; their "
+                 "ranges were NOT swept (see "
+                 "dprf_units_poisoned_total)", parked=result.parked)
     log.info("job finished",
              found=f"{len(result.found)}/{len(hl.targets)}",
              tested=result.tested, elapsed=f"{result.elapsed:.2f}s",
@@ -707,8 +822,14 @@ def cmd_serve(args, log: Log) -> int:
 
     potfile = None if args.no_potfile else Potfile(args.potfile)
 
+    batch, _ = _resolve_batch(args.batch, engine.name, device,
+                              args.attack, log, session=session,
+                              session_tuning=job_setup.tuning)
+
     # Everything a worker needs to rebuild the identical job.  max_len
     # is shipped so worker-side keyspace/packing can't drift from ours.
+    # batch ships RESOLVED (an int): the coordinator's tuning decision
+    # applies fleet-wide unless a worker overrides with --batch.
     job = {
         "engine": engine.name,
         "attack": args.attack,
@@ -720,7 +841,7 @@ def cmd_serve(args, log: Log) -> int:
         "targets": [t.raw for t in hl.targets],
         "keyspace": gen.keyspace,
         "unit_size": unit_size,
-        "batch": args.batch,
+        "batch": batch,
         "hit_cap": args.hit_cap,
         "fingerprint": spec.fingerprint,
     }
@@ -791,6 +912,11 @@ def cmd_serve(args, log: Log) -> int:
             session.snapshot(dispatcher.completed_intervals())
             session.close()
     _print_results(state.found, hl.targets)
+    if dispatcher.parked_count():
+        log.warn("job finished with POISONED units parked; their "
+                 "ranges were NOT swept",
+                 parked=dispatcher.parked_count(),
+                 indices=dispatcher.parked_indices())
     log.info("job finished",
              found=f"{len(state.found)}/{len(hl.targets)}")
     return 0 if state.found else 1
@@ -872,6 +998,67 @@ def cmd_bench(args, log: Log) -> int:
                             mask=args.mask, batch=args.batch,
                             seconds=args.seconds, impl=args.impl, log=log)
     print(json.dumps(res))
+    return 0
+
+
+def cmd_tune(args, log: Log) -> int:
+    """Sweep the batch ladder for one engine through the REAL worker
+    path and record the winner in the persistent tuning cache, where
+    `--batch auto` jobs and bench warm-start from it."""
+    import json as _json
+
+    from dprf_tpu import tune as tune_mod
+    from dprf_tpu.tune import geometric_ladder, record_tuned_batch, sweep
+
+    device = _DEVICE_ALIASES[args.device]
+    if args.tune_dir:
+        os.environ["DPRF_TUNE_DIR"] = args.tune_dir
+    oracle = get_engine(args.engine, device="cpu")
+    gen = MaskGenerator(args.mask)
+    if args.hashfile:
+        hl = _load_targets(oracle, args.hashfile, log)
+        if hl is None:
+            return 2
+        targets = hl.targets
+    else:
+        try:
+            # unmatchable digest (bench's trick): tuning needs load,
+            # not cracks
+            targets = [oracle.parse_target("ff" * oracle.digest_size)]
+        except Exception:
+            log.error("this engine's targets need salts/params; pass "
+                      "--hashfile with real target lines to tune "
+                      "against", engine=args.engine)
+            return 2
+
+    def make_worker(batch: int):
+        if device == "cpu":
+            return CpuWorker(oracle, gen, targets, chunk=batch)
+        return _select_worker(args.engine, device, "mask", gen, targets,
+                              batch, args.hit_cap, oracle, 1, log)
+
+    ladder = geometric_ladder(args.min_batch, args.max_batch,
+                              args.ladder_factor)
+    log.info("tuning", engine=args.engine, device=device,
+             ladder=",".join(str(b) for b in ladder))
+    result = sweep(make_worker, gen.keyspace, ladder,
+                   probe_seconds=args.seconds,
+                   compile_budget_s=args.compile_budget, log=log)
+    path = record_tuned_batch(args.engine, "mask", device, result)
+    log.info("tuned", batch=result.batch,
+             rate=f"{result.rate_hs:,.0f}/s", cache=path)
+    print(_json.dumps({
+        "engine": args.engine,
+        "device": device,
+        "env": tune_mod.env_fingerprint(args.engine, device),
+        "key": tune_mod.make_key(args.engine, attack="mask",
+                                 device=device),
+        "batch": result.batch,
+        "rate_hs": result.rate_hs,
+        "compile_s": round(result.compile_s, 3),
+        "swept": [p.as_dict() for p in result.swept],
+        "cache": path,
+    }))
     return 0
 
 
@@ -1025,6 +1212,7 @@ _COMMANDS = {
     "serve": cmd_serve,
     "worker": cmd_worker,
     "bench": cmd_bench,
+    "tune": cmd_tune,
     "metrics": cmd_metrics,
     "show": cmd_show,
     "left": cmd_left,

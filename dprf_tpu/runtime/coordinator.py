@@ -41,6 +41,9 @@ class JobResult:
     tested: int
     elapsed: float
     exhausted: bool
+    #: units parked by the dispatcher's retry cap (poisoned ranges the
+    #: run could not cover; 0 on a healthy job)
+    parked: int = 0
 
     @property
     def rate(self) -> float:
@@ -199,10 +202,16 @@ class Coordinator:
                     continue
                 unit, p, t_submit = pending.pop(0)
                 self._finish_unit(unit, p.resolve())
-                self._h_unit.observe(time.monotonic() - t_submit)
+                unit_s = time.monotonic() - t_submit
+                self._h_unit.observe(unit_s)
                 self._m_cands.inc(unit.length, engine=self.spec.engine,
                                   device=self.spec.device)
-                self.dispatcher.complete(unit.unit_id)
+                # submit-to-resolve time feeds the adaptive unit sizer;
+                # it includes up to PIPELINE_DEPTH-1 units of queue
+                # wait, so the EWMA under-estimates throughput a little
+                # -- which only biases units SMALLER than the target,
+                # the safe direction
+                self.dispatcher.complete(unit.unit_id, elapsed=unit_s)
                 if self.session is not None:
                     self.session.record_units(
                         self.dispatcher.completed_intervals())
@@ -221,4 +230,6 @@ class Coordinator:
         elapsed = time.perf_counter() - t0
         done, total = self.dispatcher.progress()
         return JobResult(found=dict(self.found), tested=done - tested0,
-                         elapsed=elapsed, exhausted=done >= total)
+                         elapsed=elapsed,
+                         exhausted=self.dispatcher.exhausted(),
+                         parked=self.dispatcher.parked_count())

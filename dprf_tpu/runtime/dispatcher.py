@@ -11,6 +11,19 @@ never materialized).  The ledger tracks three populations:
 Failure detection / elastic recovery (SURVEY.md section 5): a worker
 that stops heartbeating simply lets its lease expire; `reap_expired`
 moves the unit to the reissue queue and another worker picks it up.
+
+Two tuning hooks (ISSUE 2):
+
+  - an optional AdaptiveUnitSizer resizes LAZILY-GENERATED units per
+    leasing worker (already-split units -- resume gaps, reissues --
+    keep their geometry; resizing them would tear the ledger);
+  - a per-unit retry cap (default 5 failed attempts) PARKS a unit that
+    keeps dying instead of reissuing it forever: a unit that crashes
+    every worker that touches it (a generator edge case, a poisoned
+    shape) must not livelock the whole job.  Parked ranges count as
+    unreachable -- `done()` fires once everything else is covered --
+    and surface in job status + dprf_units_poisoned_total, never as
+    silent coverage.
 """
 
 from __future__ import annotations
@@ -89,17 +102,27 @@ class Dispatcher:
     def __init__(self, keyspace: int, unit_size: int,
                  lease_timeout: float = 300.0,
                  clock: Optional[Callable[[], float]] = None,
-                 registry=None):
+                 registry=None, sizer=None,
+                 max_unit_retries: Optional[int] = 5):
         if unit_size <= 0:
             raise ValueError("unit_size must be positive")
         self.keyspace = keyspace
         self.unit_size = unit_size
         self.lease_timeout = lease_timeout
+        #: tune.AdaptiveUnitSizer (or None): sizes fresh units per
+        #: leasing worker toward a target seconds-per-unit
+        self.sizer = sizer
+        #: failed attempts (fail() or lease expiry) before a unit is
+        #: parked; None = reissue forever (the pre-guard behavior)
+        self.max_unit_retries = max_unit_retries
         self._clock = clock or time.monotonic
         self._next_start = 0
         self._next_id = 0
         self._pending: deque[WorkUnit] = deque()
         self._outstanding: dict[int, tuple] = {}   # id -> (unit, worker, deadline)
+        self._retries: dict[int, int] = {}         # id -> failed attempts
+        self._parked: list[WorkUnit] = []
+        self._parked_len = 0
         self._done = IntervalSet()
         m = get_registry(registry)
         self._m_leased = m.counter(
@@ -115,6 +138,9 @@ class Dispatcher:
             "dprf_keyspace_total", "keyspace indices in the job")
         self._g_covered = m.gauge(
             "dprf_keyspace_covered", "keyspace indices completed")
+        self._m_poisoned = m.counter(
+            "dprf_units_poisoned_total",
+            "units parked after exhausting their retry budget")
         self._g_keyspace.set(keyspace)
         self._g_covered.set(0)
 
@@ -149,7 +175,9 @@ class Dispatcher:
         if self._pending:
             unit = self._pending.popleft()
         elif self._next_start < self.keyspace:
-            length = min(self.unit_size, self.keyspace - self._next_start)
+            size = (self.sizer.next_size(worker_id)
+                    if self.sizer is not None else self.unit_size)
+            length = min(size, self.keyspace - self._next_start)
             unit = self._make_unit(self._next_start, length)
             self._next_start += length
         else:
@@ -160,21 +188,47 @@ class Dispatcher:
         self._g_outstanding.set(len(self._outstanding))
         return unit
 
-    def complete(self, unit_id: int) -> None:
+    def complete(self, unit_id: int,
+                 elapsed: Optional[float] = None) -> None:
         entry = self._outstanding.pop(unit_id, None)
         if entry is None:
             return   # late completion of an already-reissued unit: idempotent
-        unit = entry[0]
+        unit, worker_id, _ = entry
         self._done.add(unit.start, unit.end)
+        self._retries.pop(unit_id, None)
+        if self.sizer is not None and elapsed is not None:
+            # throughput report feeds the ADAPTIVE sizer: the next unit
+            # this worker leases is sized toward the target seconds
+            self.sizer.observe(worker_id, unit.length, elapsed)
         self._m_completed.inc()
         self._g_covered.set(self._done.covered())
         self._g_outstanding.set(len(self._outstanding))
 
+    def _requeue(self, unit: WorkUnit, reason: str) -> None:
+        """Reissue a failed/expired unit -- unless it has burned its
+        retry budget, in which case it is PARKED: its range becomes
+        unreachable for this run (visible in status and the poisoned
+        counter, and still a resume-journal gap) instead of bouncing
+        between workers forever."""
+        n = self._retries.get(unit.unit_id, 0) + 1
+        self._retries[unit.unit_id] = n
+        if (self.max_unit_retries is not None
+                and n >= self.max_unit_retries):
+            self._parked.append(unit)
+            self._parked_len += unit.length
+            self._m_poisoned.inc()
+            from dprf_tpu.utils.logging import DEFAULT as log
+            log.warn("parking poisoned unit after repeated failures",
+                     unit=unit.unit_id, start=unit.start,
+                     length=unit.length, attempts=n, reason=reason)
+        else:
+            self._pending.append(unit)
+            self._m_reissued.inc(reason=reason)
+
     def fail(self, unit_id: int) -> None:
         entry = self._outstanding.pop(unit_id, None)
         if entry is not None:
-            self._pending.append(entry[0])
-            self._m_reissued.inc(reason="failed")
+            self._requeue(entry[0], "failed")
             self._g_outstanding.set(len(self._outstanding))
 
     def reap_expired(self) -> int:
@@ -182,16 +236,24 @@ class Dispatcher:
         expired = [uid for uid, (_, _, dl) in self._outstanding.items()
                    if dl < now]
         for uid in expired:
-            self._pending.append(self._outstanding.pop(uid)[0])
+            self._requeue(self._outstanding.pop(uid)[0], "lease_expired")
         if expired:
-            self._m_reissued.inc(len(expired), reason="lease_expired")
             self._g_outstanding.set(len(self._outstanding))
         return len(expired)
 
     # -- status ----------------------------------------------------------
 
     def done(self) -> bool:
-        return (self._done.covered() >= self.keyspace)
+        # parked ranges are unreachable this run: waiting on them would
+        # livelock the job, so "done" means everything REACHABLE is
+        # covered (exhausted() still reports the honest full-coverage
+        # answer)
+        return (self._done.covered() >= self.keyspace - self._parked_len)
+
+    def exhausted(self) -> bool:
+        """True only when the WHOLE keyspace is covered (no parked
+        holes) -- the answer `JobResult.exhausted` reports."""
+        return self._done.covered() >= self.keyspace
 
     def idle(self) -> bool:
         """Nothing leasable and nothing outstanding (but not done:
@@ -207,6 +269,16 @@ class Dispatcher:
 
     def outstanding_count(self) -> int:
         return len(self._outstanding)
+
+    def parked_count(self) -> int:
+        return len(self._parked)
+
+    def parked_indices(self) -> int:
+        """Keyspace indices inside parked (poisoned) units."""
+        return self._parked_len
+
+    def parked_units(self) -> list:
+        return list(self._parked)
 
     def outstanding_unit(self, unit_id: int) -> Optional[WorkUnit]:
         """The still-leased unit with this id (None once completed,

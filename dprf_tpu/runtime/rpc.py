@@ -197,6 +197,13 @@ class CoordinatorState:
     def op_complete(self, msg: dict) -> dict:
         unit_id = int(msg["unit_id"])
         hits = msg.get("hits", [])
+        # per-unit wall time reported by the worker: feeds the adaptive
+        # unit sizer's per-worker throughput EWMA (tune.unit_sizer).
+        # Client-controlled, so sanitize: a junk value must read as "no
+        # report", never as a poisoned estimate.
+        elapsed = msg.get("elapsed")
+        if not (isinstance(elapsed, (int, float)) and elapsed > 0):
+            elapsed = None
         # Parse + verify OUTSIDE the lock: the oracle re-hash takes
         # seconds for bcrypt/PBKDF2, and holding the lock there would
         # stall every other worker's lease/complete (and hand any buggy
@@ -265,7 +272,7 @@ class CoordinatorState:
                 else:
                     self.dispatcher.fail(unit_id)
             else:
-                self.dispatcher.complete(unit_id)
+                self.dispatcher.complete(unit_id, elapsed=elapsed)
                 if unit is not None:
                     # rejected units requeue and are NOT counted: the
                     # range will be re-swept by another worker
@@ -295,6 +302,10 @@ class CoordinatorState:
             done, total = self.dispatcher.progress()
             return {"done": done, "total": total,
                     "found": len(self.found), "stop": self._stopped(),
+                    # poisoned ranges (retry-cap parked): a job that
+                    # "finished" with parked units did NOT sweep them
+                    "parked": self.dispatcher.parked_count(),
+                    "parked_indices": self.dispatcher.parked_indices(),
                     "elapsed": time.perf_counter() - self.t0}
 
     def _stopped(self) -> bool:
@@ -602,12 +613,15 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
             except Exception:
                 pass
             raise
-        h_unit.observe(time.monotonic() - t_unit)
+        unit_s = time.monotonic() - t_unit
+        h_unit.observe(unit_s)
         m_cands.inc(unit.length, engine=eng_name, device=device)
         payload = [{"target": h.target_index, "cand": h.cand_index,
                     "plaintext": h.plaintext.hex()} for h in hits]
+        # elapsed rides the complete report: the coordinator's adaptive
+        # unit sizer turns it into this worker's next unit length
         resp = client.call("complete", unit_id=unit.unit_id, hits=payload,
-                           worker_id=worker_id)
+                           worker_id=worker_id, elapsed=unit_s)
         done_units += 1
         if log and hits:
             log.info("hits reported", count=len(hits))

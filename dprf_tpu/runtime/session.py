@@ -8,6 +8,9 @@ pure functions of their index range, so the journal is just:
   {"type": "header", "spec": {...}}          job identity (guards resume)
   {"type": "units", "intervals": [[s,e],..]} completed-coverage snapshot
   {"type": "hit", "target": t, "index": i, "plaintext": hex}
+  {"type": "tune", "key": k, "record": {...}} tuning decision (batch
+      autotune result) -- a resumed job reuses the recorded batch even
+      when the machine's persistent tune cache is gone
 
 Coverage is re-snapshotted (merged intervals) every `snapshot_every`
 completions, so the file stays small and resume cost is O(intervals),
@@ -27,6 +30,7 @@ class SessionState:
     spec: dict
     completed: list          # [(start, end), ...]
     hits: list               # [{"target": int, "index": int, "plaintext": str}]
+    tuning: dict = dataclasses.field(default_factory=dict)  # key -> record
 
 
 class SessionJournal:
@@ -35,6 +39,7 @@ class SessionJournal:
         self.snapshot_every = snapshot_every
         self._since_snapshot = 0
         self._fh = None
+        self._pending: list = []   # records queued before open()
 
     @property
     def telemetry_path(self) -> str:
@@ -51,6 +56,9 @@ class SessionJournal:
         self._fh = open(self.path, "a", encoding="utf-8")
         if fresh:
             self._emit({"type": "header", "spec": spec})
+        for obj in self._pending:
+            self._emit(obj)
+        self._pending = []
 
     def _emit(self, obj: dict) -> None:
         self._fh.write(json.dumps(obj, separators=(",", ":")) + "\n")
@@ -73,6 +81,17 @@ class SessionJournal:
         self._emit({"type": "hit", "target": target_index,
                     "index": cand_index, "plaintext": plaintext.hex()})
 
+    def record_tuning(self, key: str, record: dict) -> None:
+        """Journal a tuning decision (tune.make_key -> result record).
+        The CLI resolves the batch BEFORE the journal is opened, so a
+        pre-open record is buffered and flushed by open() -- right
+        after the header, where resume reads it back."""
+        obj = {"type": "tune", "key": key, "record": record}
+        if self._fh is None:
+            self._pending.append(obj)
+        else:
+            self._emit(obj)
+
     def close(self) -> None:
         if self._fh:
             self._fh.close()
@@ -84,7 +103,7 @@ class SessionJournal:
     def load(path: str) -> Optional[SessionState]:
         if not os.path.exists(path):
             return None
-        spec, completed, hits = {}, [], []
+        spec, completed, hits, tuning = {}, [], [], {}
         with open(path, encoding="utf-8") as fh:
             for line in fh:
                 line = line.strip()
@@ -101,7 +120,13 @@ class SessionJournal:
                     completed = [(s, e) for s, e in obj["intervals"]]
                 elif t == "hit":
                     hits.append(obj)
-        return SessionState(spec=spec, completed=completed, hits=hits)
+                elif t == "tune":
+                    try:
+                        tuning[str(obj["key"])] = dict(obj["record"])
+                    except (KeyError, TypeError, ValueError):
+                        continue    # malformed tune line: ignore
+        return SessionState(spec=spec, completed=completed, hits=hits,
+                            tuning=tuning)
 
 
 def job_fingerprint(engine: str, attack: str, keyspace: int,

@@ -168,6 +168,8 @@ class MaskWorkerBase:
         factory calls this so a Mosaic/XLA compile failure surfaces at
         worker construction -- where it can fall back to another path --
         instead of mid-job."""
+        import time
+
         import jax.numpy as jnp
 
         from dprf_tpu.utils.sync import hard_sync
@@ -176,8 +178,13 @@ class MaskWorkerBase:
         # also surfaces here, not just a compile failure -- over the
         # axon tunnel block_until_ready returns at enqueue and the
         # fault would land on the first real batch instead
+        t0 = time.perf_counter()
         with self._compile_timer():
             hard_sync(self.step(base, jnp.int32(0)))
+        #: warmup/compile wall time; tune/autotuner.sweep folds it into
+        #: a rung's fixed cost (covers workers warmed before the
+        #: sweep's own clock started)
+        self.compile_seconds = time.perf_counter() - t0
 
     def _compile_timer(self):
         """Telemetry timer for warmup compiles (the dominant fixed cost
@@ -685,11 +692,15 @@ class PallasWordlistWorker(DeviceWordlistWorker):
         return step
 
     def warmup(self) -> None:
+        import time
+
         import jax.numpy as jnp
 
         from dprf_tpu.utils.sync import hard_sync
+        t0 = time.perf_counter()
         with self._compile_timer():
             hard_sync(self.step(jnp.int32(0), jnp.int32(0)))
+        self.compile_seconds = time.perf_counter() - t0
 
 
 class PallasMaskWorker(MaskWorkerBase):

@@ -18,6 +18,9 @@ Metric names (all prefixed ``dprf_``; see README "Observability"):
   dprf_targets_total / dprf_targets_found
   dprf_workers_quarantined / dprf_worker_last_seen_timestamp{worker}
   dprf_bench_rate_hs{engine,impl,device,mode}   bench results
+  dprf_tuned_batch{engine,device,attack}        tuning-subsystem batch
+  dprf_unit_target_seconds / dprf_unit_size     adaptive unit sizing
+  dprf_units_poisoned_total                     retry-cap parked units
 """
 
 from __future__ import annotations

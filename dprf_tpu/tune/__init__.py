@@ -1,0 +1,91 @@
+"""Adaptive tuning subsystem (ISSUE 2).
+
+Replaces the hard-coded batch/unit-size constants strewn across
+workers, bench, and the CLI with one subsystem every execution path
+consults:
+
+  - autotuner.sweep        geometric batch ladder over the real worker
+                           path, best batch under a compile budget;
+  - cache.TuningCache      persistent JSON cache ($DPRF_TUNE_DIR /
+                           session dir) with environment-fingerprint
+                           invalidation (jax version, device kind,
+                           engine source rev);
+  - unit_sizer.AdaptiveUnitSizer
+                           per-worker EWMA throughput -> WorkUnit
+                           length targeting seconds-per-unit, fed by
+                           the RPC complete path.
+
+Metric surface: ``dprf_tuned_batch{engine,device,attack}``,
+``dprf_unit_target_seconds``, ``dprf_unit_size``,
+``dprf_units_poisoned_total`` (dispatcher retry-cap guard).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dprf_tpu.tune.autotuner import (Probe, TuneResult, geometric_ladder,
+                                     sweep)
+from dprf_tpu.tune.cache import (TuningCache, cache_path, default_cache,
+                                 engine_rev, env_fingerprint, make_key,
+                                 tune_dir)
+from dprf_tpu.tune.unit_sizer import AdaptiveUnitSizer
+
+
+def publish_tuned_batch(engine: str, device: str, attack: str,
+                        batch: int, registry=None) -> None:
+    """ONE declaration site for the dprf_tuned_batch gauge (CLI, bench,
+    and the tune command all publish through here, so the labels can
+    never drift)."""
+    from dprf_tpu.telemetry import get_registry
+    get_registry(registry).gauge(
+        "dprf_tuned_batch",
+        "device batch size selected by the tuning subsystem",
+        labelnames=("engine", "device", "attack")
+    ).set(batch, engine=engine, device=device, attack=attack)
+
+
+def lookup_tuned_batch(engine: str, attack: str = "mask",
+                       device: str = "jax",
+                       session_path: Optional[str] = None,
+                       registry=None) -> Optional[int]:
+    """Environment-validated cache lookup; the warm-start path bench
+    and ``--batch auto`` jobs take.  Returns the tuned batch (and
+    publishes the gauge) or None -- never raises: a broken cache reads
+    as a miss and the caller's default stands."""
+    try:
+        cache = default_cache(session_path)
+        env = env_fingerprint(engine, device)
+        entry = cache.get(make_key(engine, attack=attack, device=device),
+                          env)
+        if not entry:
+            return None
+        batch = int(entry["batch"])
+        if batch <= 0:
+            return None
+        publish_tuned_batch(engine, device, attack, batch,
+                            registry=registry)
+        return batch
+    except Exception:
+        return None
+
+
+def record_tuned_batch(engine: str, attack: str, device: str,
+                       result: TuneResult,
+                       session_path: Optional[str] = None,
+                       registry=None) -> str:
+    """Persist a sweep result and publish the gauge; returns the cache
+    file path written."""
+    cache = default_cache(session_path)
+    cache.put(make_key(engine, attack=attack, device=device),
+              result.as_record(), env_fingerprint(engine, device))
+    publish_tuned_batch(engine, device, attack, result.batch,
+                        registry=registry)
+    return cache.path
+
+
+__all__ = ["AdaptiveUnitSizer", "Probe", "TuneResult", "TuningCache",
+           "cache_path", "default_cache", "engine_rev",
+           "env_fingerprint", "geometric_ladder", "lookup_tuned_batch",
+           "make_key", "publish_tuned_batch", "record_tuned_batch",
+           "sweep", "tune_dir"]

@@ -1,0 +1,167 @@
+"""Batch autotuner: sweep a geometric batch ladder, pick the fastest
+batch whose fixed cost fits the compile budget.
+
+The sweep drives the REAL worker path (``make_worker(batch)`` builds
+the same worker a job would run, ``process`` covers real WorkUnits),
+so the measured H/s includes candidate generation, compare, and hit
+readback -- the number a job sustains, not a stripped kernel.  Compile
+time is the worker's warmup + first-unit cost; workers publish it into
+the existing ``dprf_compile_seconds`` telemetry histogram as a side
+effect, so a scrape during a sweep shows exactly where the time went.
+
+Ladder policy: batches climb geometrically (default x4) because
+throughput-vs-batch curves for these pipelines are smooth and
+saturating -- fine-grained probing buys nothing.  The climb stops
+early when (a) a rung's compile time exceeds the budget (bigger
+batches compile strictly longer), (b) a rung fails to build/allocate
+(the HBM ceiling), or (c) `patience` consecutive rungs improve the
+best rate by less than `improve_eps` (saturation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, List, Optional
+
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+@dataclasses.dataclass
+class Probe:
+    """One ladder rung's measurement."""
+    batch: int
+    rate_hs: float
+    compile_s: float
+    error: Optional[str] = None
+
+    def as_dict(self) -> dict:
+        d = {"batch": self.batch, "rate_hs": self.rate_hs,
+             "compile_s": round(self.compile_s, 3)}
+        if self.error:
+            d["error"] = self.error
+        return d
+
+
+@dataclasses.dataclass
+class TuneResult:
+    batch: int
+    rate_hs: float
+    compile_s: float
+    swept: List[Probe]
+    source: str = "swept"        # "swept" | "cache" | "session" | "default"
+
+    @property
+    def tuned(self) -> bool:
+        return self.source in ("swept", "cache", "session")
+
+    def as_record(self) -> dict:
+        """The cache/session payload (environment fingerprint is added
+        by the cache layer)."""
+        return {"batch": self.batch, "rate_hs": self.rate_hs,
+                "compile_s": round(self.compile_s, 3),
+                "swept": [p.as_dict() for p in self.swept]}
+
+
+def geometric_ladder(lo: int = 1 << 14, hi: int = 1 << 22,
+                     factor: int = 4) -> List[int]:
+    if lo <= 0 or hi < lo or factor < 2:
+        raise ValueError(f"bad ladder bounds {lo}..{hi} x{factor}")
+    out, b = [], lo
+    while b < hi:
+        out.append(b)
+        b *= factor
+    out.append(hi)
+    return out
+
+
+def _probe_rate(worker, keyspace: int, seconds: float,
+                clock: Callable[[], float]) -> float:
+    """Steady-state H/s: process whole units (one worker stride each,
+    the production dispatch granularity) until the window closes.
+    Always at least one unit, so an injected/fake clock cannot starve
+    the measurement."""
+    stride = (getattr(worker, "stride", None)
+              or getattr(worker, "chunk", None) or 2048)
+    unit_len = max(1, min(int(stride), keyspace))
+    n, start = 0, 0
+    t0 = clock()
+    while True:
+        if start + unit_len > keyspace:
+            start = 0
+        worker.process(WorkUnit(-1, start, unit_len))
+        n += unit_len
+        start += unit_len
+        if clock() - t0 >= seconds:
+            break
+    elapsed = max(clock() - t0, 1e-9)
+    return n / elapsed
+
+
+def sweep(make_worker: Callable[[int], object], keyspace: int,
+          ladder: Optional[List[int]] = None, *,
+          probe_seconds: float = 1.0, compile_budget_s: float = 120.0,
+          improve_eps: float = 0.05, patience: int = 2,
+          clock: Callable[[], float] = time.perf_counter,
+          log=None) -> TuneResult:
+    """Measure each ladder rung through `make_worker(batch)`; return
+    the best batch under the compile budget.  Raises ValueError when no
+    rung produces a worker at all (the caller's default batch stands).
+    """
+    ladder = ladder or geometric_ladder()
+    swept: List[Probe] = []
+    best: Optional[Probe] = None
+    stall = 0
+    for batch in ladder:
+        try:
+            t0 = clock()
+            worker = make_worker(batch)
+            # prime: the first unit pays warmup/compile (workers built
+            # by the engine factories have already warmed their step;
+            # this also covers super/wide program builds)
+            stride = (getattr(worker, "stride", None)
+                      or getattr(worker, "chunk", None) or 2048)
+            worker.process(WorkUnit(-1, 0, max(1, min(int(stride),
+                                                      keyspace))))
+            # fixed cost = construction + warmup + first unit; a worker
+            # whose step was warmed before make_worker returned (a
+            # caller-level cache) still reports its own warmup time via
+            # compile_seconds (runtime/worker.py), so take the max
+            compile_s = max(clock() - t0,
+                            getattr(worker, "compile_seconds", 0.0))
+        except Exception as e:   # noqa: BLE001 -- compiler/alloc errors
+            swept.append(Probe(batch, 0.0, 0.0,
+                               error=f"{type(e).__name__}: {e}"))
+            if log:
+                log.warn("tune rung failed to build; stopping ladder",
+                         batch=batch, error=str(e))
+            break                # bigger batches will only fail harder
+        if compile_s > compile_budget_s:
+            swept.append(Probe(batch, 0.0, compile_s,
+                               error="over compile budget"))
+            if log:
+                log.warn("tune rung over compile budget; stopping "
+                         "ladder", batch=batch,
+                         compile_s=f"{compile_s:.1f}",
+                         budget_s=compile_budget_s)
+            break                # compile time grows with batch
+        rate = _probe_rate(worker, keyspace, probe_seconds, clock)
+        p = Probe(batch, rate, compile_s)
+        swept.append(p)
+        if log:
+            log.info("tune rung", batch=batch, rate=f"{rate:,.0f}/s",
+                     compile_s=f"{compile_s:.2f}")
+        improved = best is None or rate > best.rate_hs * (1.0 + improve_eps)
+        if best is None or rate > best.rate_hs:
+            best = p
+        if improved:
+            stall = 0
+        else:
+            stall += 1
+            if stall >= patience:
+                break            # saturated: bigger batches buy nothing
+    if best is None:
+        errs = "; ".join(p.error or "?" for p in swept) or "empty ladder"
+        raise ValueError(f"batch autotune failed on every rung ({errs})")
+    return TuneResult(best.batch, best.rate_hs, best.compile_s, swept,
+                      source="swept")

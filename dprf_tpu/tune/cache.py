@@ -1,0 +1,166 @@
+"""Persistent tuning cache: (engine, device, attack) -> tuned batch.
+
+One JSON document under ``$DPRF_TUNE_DIR`` (or, when a job has a
+session journal, the journal's directory; else ``~/.cache/dprf``).
+Entries carry an *environment fingerprint* -- jax version, device
+kind, and a content hash of the engine's source module -- so a cache
+recorded under a different toolchain or engine revision is IGNORED,
+never reused: a batch tuned for one compiler/chip generation says
+nothing about another, and silently trusting it would pin every later
+job to a stale optimum.
+
+The cache is advisory: any read/write failure degrades to "no entry"
+(the caller falls back to its default batch), never to a crashed job.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+CACHE_BASENAME = "tune_cache.json"
+CACHE_VERSION = 1
+
+
+def tune_dir(session_path: Optional[str] = None) -> str:
+    """Resolution order: $DPRF_TUNE_DIR > the session journal's
+    directory > ~/.cache/dprf.  The session-dir tier keeps a resumable
+    job's tuning next to its coverage ledger, so copying the session
+    directory to another host carries the whole resume state."""
+    d = os.environ.get("DPRF_TUNE_DIR")
+    if d:
+        return d
+    if session_path:
+        return os.path.dirname(os.path.abspath(session_path)) or "."
+    return os.path.join(os.path.expanduser("~"), ".cache", "dprf")
+
+
+def cache_path(session_path: Optional[str] = None) -> str:
+    return os.path.join(tune_dir(session_path), CACHE_BASENAME)
+
+
+def make_key(engine: str, attack: str = "mask", device: str = "jax",
+             **extra) -> str:
+    """Stable cache key.  The engine name is normalized exactly as the
+    engine registry normalizes it (lower-cased), so `dprf tune -m MD5`
+    and a serve job keyed on the canonical engine.name can never fork
+    the key space; extras (e.g. rules=n_rules) are sorted so call-site
+    argument order cannot either."""
+    parts = [f"engine={engine.lower()}", f"device={device}",
+             f"attack={attack}"]
+    parts += [f"{k}={extra[k]}" for k in sorted(extra)
+              if extra[k] is not None]
+    return "|".join(parts)
+
+
+def engine_rev(engine_name: str, device: str = "jax") -> str:
+    """Content hash of the engine's source module: a kernel edit means
+    re-tuning, and the rev makes that automatic instead of a tribal
+    "clear your cache" ritual."""
+    import hashlib
+    import inspect
+    try:
+        from dprf_tpu.engines import engine_class
+        try:
+            cls = engine_class(engine_name,
+                               "jax" if device == "jax" else "cpu")
+        except KeyError:
+            cls = engine_class(engine_name, "cpu")
+        src = inspect.getsourcefile(cls)
+        with open(src, "rb") as fh:
+            return hashlib.sha256(fh.read()).hexdigest()[:12]
+    except Exception:
+        return "unknown"
+
+
+def env_fingerprint(engine_name: str, device: str = "jax") -> dict:
+    """What a tuned batch is conditional on: jax/XLA version, the chip
+    generation, and the engine source rev.  Any mismatch invalidates."""
+    env = {"jax": "none", "device_kind": "cpu"}
+    if device == "jax":
+        try:
+            import jax
+            env["jax"] = jax.__version__
+            dev = jax.devices()[0]
+            env["device_kind"] = getattr(dev, "device_kind", dev.platform)
+        except Exception:
+            env["device_kind"] = "unknown"
+    else:
+        try:
+            import jax
+            env["jax"] = jax.__version__
+        except Exception:
+            pass
+    env["engine_rev"] = engine_rev(engine_name, device)
+    return env
+
+
+class TuningCache:
+    """Load/validate/update one tuning-cache JSON file.  Writes are
+    atomic (tmp + replace) so a killed run can never leave a torn
+    document; a torn or alien file reads as empty."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._doc: Optional[dict] = None
+
+    def _load(self) -> dict:
+        if self._doc is None:
+            try:
+                with open(self.path, encoding="utf-8") as fh:
+                    doc = json.load(fh)
+                if (not isinstance(doc, dict)
+                        or doc.get("version") != CACHE_VERSION
+                        or not isinstance(doc.get("entries"), dict)):
+                    doc = {"version": CACHE_VERSION, "entries": {}}
+            except (OSError, ValueError):
+                doc = {"version": CACHE_VERSION, "entries": {}}
+            self._doc = doc
+        return self._doc
+
+    def get(self, key: str, env: dict) -> Optional[dict]:
+        """The entry for `key`, or None if absent OR recorded under a
+        different environment fingerprint (jax version / device kind /
+        engine rev) -- stale entries must be ignored, not reused."""
+        with self._lock:
+            entry = self._load()["entries"].get(key)
+        if not isinstance(entry, dict):
+            return None
+        recorded = entry.get("env")
+        if not isinstance(recorded, dict):
+            return None
+        for k, v in env.items():
+            if recorded.get(k) != v:
+                return None
+        return dict(entry)
+
+    def put(self, key: str, record: dict, env: dict) -> None:
+        with self._lock:
+            doc = self._load()
+            doc["entries"][key] = {**record, "env": dict(env),
+                                   "ts": time.time()}
+            self._save(doc)
+
+    def _save(self, doc: dict) -> None:
+        try:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=1, sort_keys=True)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass   # advisory cache: a read-only FS must not kill the job
+
+    def entries(self) -> dict:
+        with self._lock:
+            return dict(self._load()["entries"])
+
+
+def default_cache(session_path: Optional[str] = None) -> TuningCache:
+    return TuningCache(cache_path(session_path))

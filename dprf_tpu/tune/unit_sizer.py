@@ -1,0 +1,94 @@
+"""Throughput-adaptive work-unit sizing.
+
+The Dispatcher splits the keyspace with one static ``unit_size``; in a
+heterogeneous fleet (a TPU pod slice next to a CPU box, or chips
+behind links of very different latency) that single constant is wrong
+for everyone at once: too small and the fast workers pay per-unit RPC
+overhead, too large and a slow worker's lease spans hours (and its
+death re-runs hours of work).  The sizer keeps a per-worker EWMA of
+completion throughput -- reported over the existing RPC complete path
+-- and sizes each worker's NEXT unit toward a target seconds-per-unit,
+so every worker settles at units roughly `target_seconds` long no
+matter how fast it drains them (HashKitty's per-node work-sizing
+lesson, PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from dprf_tpu.telemetry import get_registry
+
+
+class AdaptiveUnitSizer:
+    """EWMA per-worker throughput -> next unit length.
+
+    Lazily-generated units only: already-split units (resume gaps,
+    reissues) keep their geometry -- resizing them would tear the
+    coverage ledger.  Thread-safe: the RPC server observes completions
+    from handler threads while the dispatcher leases under its own
+    lock.
+    """
+
+    def __init__(self, initial: int, target_seconds: float = 20.0,
+                 min_unit: int = 1 << 10, max_unit: int = 1 << 28,
+                 align: int = 1, alpha: float = 0.4, registry=None):
+        if initial <= 0:
+            raise ValueError("initial unit size must be positive")
+        if target_seconds <= 0:
+            raise ValueError("target_seconds must be positive")
+        self.initial = initial
+        self.target_seconds = target_seconds
+        self.align = max(1, int(align))
+        # floors/ceilings keep a cold or glitching EWMA from issuing
+        # degenerate units (1-index units, or one unit = whole keyspace)
+        self.min_unit = max(self.align, int(min_unit))
+        self.max_unit = max(self.min_unit, int(max_unit))
+        self.alpha = alpha
+        self._rates: dict[str, float] = {}
+        self._lock = threading.Lock()
+        m = get_registry(registry)
+        m.gauge("dprf_unit_target_seconds",
+                "adaptive unit sizing: target seconds per WorkUnit"
+                ).set(target_seconds)
+        self._g_size = m.gauge(
+            "dprf_unit_size",
+            "last adaptively-sized WorkUnit length issued")
+        self._g_size.set(self._clamp(initial))
+
+    def _clamp(self, size: int) -> int:
+        size = max(self.min_unit, min(self.max_unit, int(size)))
+        if self.align > 1:
+            size = max(self.align, (size // self.align) * self.align)
+        return size
+
+    def observe(self, worker_id: str, length: int, elapsed: float) -> None:
+        """Fold one completed unit into the worker's throughput EWMA.
+        Non-positive reports (clock skew, zero-length tails) are
+        dropped rather than poisoning the estimate."""
+        if length <= 0 or not elapsed or elapsed <= 0:
+            return
+        rate = length / float(elapsed)
+        with self._lock:
+            prev = self._rates.get(worker_id)
+            self._rates[worker_id] = (
+                rate if prev is None
+                else self.alpha * rate + (1.0 - self.alpha) * prev)
+
+    def rate(self, worker_id: str) -> Optional[float]:
+        with self._lock:
+            return self._rates.get(worker_id)
+
+    def next_size(self, worker_id: str) -> int:
+        """Unit length for this worker's next lease: EWMA rate x the
+        target seconds, clamped and alignment-rounded.  A worker with
+        no history gets the configured initial size (the first unit is
+        the measurement)."""
+        with self._lock:
+            rate = self._rates.get(worker_id)
+        size = (self.initial if rate is None
+                else int(rate * self.target_seconds))
+        size = self._clamp(size)
+        self._g_size.set(size)
+        return size

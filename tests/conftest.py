@@ -23,6 +23,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # changing semantics; keep the hermetic suite on the small tile.
 os.environ.setdefault("DPRF_PALLAS_SUB", "32")
 
+# Hermetic tuning cache: `--batch auto` is the CLI default now, so any
+# e2e test would otherwise read/write the USER's ~/.cache/dprf tuning
+# cache -- cross-contaminating real tuning state with test runs.
+if "DPRF_TUNE_DIR" not in os.environ:
+    import tempfile as _tempfile
+    os.environ["DPRF_TUNE_DIR"] = _tempfile.mkdtemp(prefix="dprf-tune-test-")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -59,6 +66,28 @@ def _smoke_budget(config):
 
 def pytest_configure(config):
     config._dprf_tier_t0 = _time.monotonic()
+    _check_tier_markers()
+
+
+def _check_tier_markers():
+    """Run tools/check_markers.py at the top of every tier run: a test
+    that compiles device pipelines without declaring a tier would
+    silently ride into the smoke tier's 5-minute promise.  Static AST
+    scan, so the cost is milliseconds."""
+    import subprocess
+    import sys
+
+    import pytest
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(repo, "tools", "check_markers.py")
+    if not os.path.exists(tool):
+        return
+    proc = subprocess.run([sys.executable, tool],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise pytest.UsageError(
+            "tier-marker check failed:\n" + proc.stdout + proc.stderr)
 
 
 def _has_compileheavy(session) -> bool:

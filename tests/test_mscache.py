@@ -5,6 +5,10 @@ import hashlib
 
 import pytest
 
+# device-pipeline compiles: full suite / tier-1, excluded from the <5-min
+# smoke tier (tools/check_markers.py enforces an explicit tier decision)
+pytestmark = pytest.mark.compileheavy
+
 from dprf_tpu.engines import get_engine
 from dprf_tpu.engines.cpu.engines import _dcc1, _utf16_lower_user
 from dprf_tpu.generators.mask import MaskGenerator

@@ -17,6 +17,10 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
+# device-pipeline compiles: full suite / tier-1, excluded from the <5-min
+# smoke tier (tools/check_markers.py enforces an explicit tier decision)
+pytestmark = pytest.mark.compileheavy
+
 from dprf_tpu.engines import get_engine
 from dprf_tpu.generators.wordlist import WordlistRulesGenerator
 from dprf_tpu.ops import pallas_rules as pr

@@ -138,8 +138,11 @@ def test_dispatcher_chaos_full_coverage():
     import random
     rng = random.Random(7)
     clk = FakeClock()
+    # retry cap disabled: this chaos model fails units at random (not
+    # because the unit itself is poisoned), so parking would be wrong
+    # -- full convergence is the invariant under test
     d = Dispatcher(keyspace=10_000, unit_size=37, lease_timeout=50.0,
-                   clock=clk)
+                   clock=clk, max_unit_retries=None)
     held = []                      # units currently "running"
     completed_ids = []
     for _ in range(200_000):
@@ -170,6 +173,102 @@ def test_dispatcher_chaos_full_coverage():
                 d.complete(rng.choice(completed_ids))
     assert d.done()
     assert d.completed_intervals() == [(0, 10_000)]
+
+
+def test_dispatcher_poison_guard_parks_after_retry_cap():
+    """A unit that fails every worker that touches it must be PARKED
+    after the retry cap, not reissued forever: before the guard,
+    Dispatcher.fail()/reap_expired() livelocked the whole job on one
+    poisoned unit."""
+    from dprf_tpu.telemetry import MetricsRegistry
+
+    m = MetricsRegistry()
+    d = Dispatcher(keyspace=256, unit_size=128, registry=m,
+                   max_unit_retries=5)
+    poisoned = d.lease("w0")
+    for i in range(5):
+        assert d.parked_count() == 0
+        d.fail(poisoned.unit_id)
+        if i < 4:                       # reissued, not yet parked
+            again = d.lease("w0")
+            assert (again.start, again.end) == (poisoned.start,
+                                                poisoned.end)
+    # 5th failure parks it: the range becomes unreachable this run
+    assert d.parked_count() == 1
+    assert d.parked_indices() == poisoned.length
+    assert m.counter("dprf_units_poisoned_total").value() == 1
+    # the rest of the keyspace still sweeps, and the job terminates
+    u = d.lease("w1")
+    assert (u.start, u.end) == (128, 256)
+    d.complete(u.unit_id)
+    assert d.lease("w1") is None
+    assert d.done()                     # reachable keyspace covered
+    assert not d.exhausted()            # ...but honestly NOT exhausted
+    assert d.progress() == (128, 256)
+
+
+def test_dispatcher_poison_guard_counts_lease_expiry():
+    """Lease expiry (dead worker) burns the same retry budget as an
+    explicit fail -- a unit that kills every worker that leases it
+    never reports fail() at all."""
+    clk = FakeClock()
+    d = Dispatcher(keyspace=128, unit_size=128, lease_timeout=10.0,
+                   clock=clk, max_unit_retries=3)
+    for _ in range(3):
+        u = d.lease("w0")
+        assert u is not None
+        clk.t += 11.0                   # worker dies holding the lease
+        d.reap_expired()
+    assert d.parked_count() == 1
+    assert d.done() and not d.exhausted()
+
+
+def test_dispatcher_retry_count_resets_nothing_on_success():
+    """Retries are per-unit: one unit's failures must not park a
+    DIFFERENT unit, and a unit that eventually completes clears its
+    tally."""
+    d = Dispatcher(keyspace=512, unit_size=128, max_unit_retries=5)
+    u1 = d.lease("w0")
+    for _ in range(4):
+        d.fail(u1.unit_id)
+        u1 = d.lease("w0")
+        assert u1 is not None
+    d.complete(u1.unit_id)              # 4 failures then success
+    assert d.parked_count() == 0
+    while True:
+        u = d.lease("w0")
+        if u is None:
+            break
+        d.complete(u.unit_id)
+    assert d.exhausted()
+
+
+def test_resume_resplit_with_different_unit_size_exact_coverage():
+    """Satellite regression (ISSUE 2): a session journaled under one
+    unit size resumes under ANOTHER (adaptive sizing makes that the
+    normal case) -- gap re-splitting with the new size must yield
+    exact coverage: every uncovered index issued exactly once, no
+    overlap with the journaled intervals."""
+    keyspace = 10_000
+    # intervals a previous run with odd adaptive sizes might journal
+    completed = [(0, 37), (1000, 1771), (4096, 9001)]
+    for new_size in (64, 300, 8192):
+        d = Dispatcher.from_completed(keyspace, new_size, completed)
+        issued = []
+        while True:
+            u = d.lease("w")
+            if u is None:
+                break
+            issued.append((u.start, u.end))
+            d.complete(u.unit_id)
+        # disjoint among themselves and with the journaled coverage
+        spans = sorted(issued + list(completed))
+        for (s1, e1), (s2, e2) in zip(spans, spans[1:]):
+            assert e1 <= s2, f"overlap: {(s1, e1)} vs {(s2, e2)}"
+        assert sum(e - s for s, e in issued) == keyspace - sum(
+            e - s for s, e in completed)
+        assert d.exhausted()
+        assert d.completed_intervals() == [(0, keyspace)]
 
 
 def test_coordinator_rejects_unverifiable_hit_and_rescans(tmp_path):

@@ -1,0 +1,418 @@
+"""Adaptive tuning subsystem (ISSUE 2): batch autotuner sweep logic,
+persistent cache + environment invalidation, throughput-adaptive unit
+sizing (the simulated-clock convergence acceptance case), RPC wiring,
+session persistence, and the CLI/bench warm-start paths."""
+
+import hashlib
+import json
+import os
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+from dprf_tpu import tune
+from dprf_tpu.runtime.dispatcher import Dispatcher
+from dprf_tpu.runtime.session import SessionJournal
+from dprf_tpu.runtime.workunit import WorkUnit
+from dprf_tpu.telemetry import MetricsRegistry
+from dprf_tpu.tune import (AdaptiveUnitSizer, TuningCache,
+                           geometric_ladder, sweep)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class FakeWorker:
+    """Deterministic worker: fixed compile cost on the first unit,
+    then a constant simulated throughput."""
+
+    def __init__(self, clk, rate, compile_s, stride):
+        self.stride = stride
+        self._clk = clk
+        self._rate = rate
+        self._compile = compile_s
+        self._first = True
+
+    def process(self, unit):
+        if self._first:
+            self._clk.t += self._compile
+            self._first = False
+        self._clk.t += unit.length / self._rate
+        return []
+
+
+# ---------------------------------------------------------------------------
+# autotuner sweep
+
+def _rates(table):
+    return lambda batch: table[batch]
+
+
+def test_sweep_picks_fastest_batch_and_stops_on_saturation():
+    clk = FakeClock()
+    rate = _rates({256: 1e3, 1024: 4e3, 4096: 8e3, 16384: 7e3,
+                   65536: 6e3})
+
+    def make_worker(batch):
+        return FakeWorker(clk, rate(batch), compile_s=0.1, stride=batch)
+
+    res = sweep(make_worker, keyspace=1 << 40,
+                ladder=[256, 1024, 4096, 16384, 65536],
+                probe_seconds=1.0, clock=clk)
+    assert res.batch == 4096
+    assert res.source == "swept" and res.tuned
+    # patience=2: both post-peak rungs measured, then the ladder stops
+    assert [p.batch for p in res.swept] == [256, 1024, 4096, 16384,
+                                            65536]
+    assert res.rate_hs == pytest.approx(8e3, rel=0.01)
+
+
+def test_sweep_compile_budget_stops_the_ladder():
+    clk = FakeClock()
+    rate = _rates({256: 1e3, 1024: 2e3, 4096: 4e3, 16384: 8e3})
+
+    def make_worker(batch):
+        return FakeWorker(clk, rate(batch), compile_s=0.001 * batch,
+                          stride=batch)
+
+    res = sweep(make_worker, keyspace=1 << 40,
+                ladder=[256, 1024, 4096, 16384],
+                probe_seconds=1.0, compile_budget_s=10.0, clock=clk)
+    # 16384 compiles for 16s > budget: recorded, never considered
+    assert res.batch == 4096
+    assert res.swept[-1].batch == 16384
+    assert res.swept[-1].error == "over compile budget"
+
+
+def test_sweep_build_failure_stops_the_ladder():
+    clk = FakeClock()
+
+    def make_worker(batch):
+        if batch >= 4096:
+            raise MemoryError("RESOURCE_EXHAUSTED: HBM")
+        return FakeWorker(clk, 1e3 * batch, compile_s=0.1, stride=batch)
+
+    res = sweep(make_worker, keyspace=1 << 40,
+                ladder=[256, 1024, 4096, 16384],
+                probe_seconds=0.5, clock=clk)
+    assert res.batch == 1024
+    assert "MemoryError" in res.swept[-1].error
+    assert res.swept[-1].batch == 4096      # 16384 never attempted
+
+
+def test_sweep_all_rungs_failing_raises():
+    def make_worker(batch):
+        raise RuntimeError("no backend")
+
+    with pytest.raises(ValueError, match="every rung"):
+        sweep(make_worker, keyspace=1 << 20, ladder=[256],
+              clock=FakeClock())
+
+
+def test_geometric_ladder_bounds():
+    assert geometric_ladder(1 << 14, 1 << 22, 4) == [
+        1 << 14, 1 << 16, 1 << 18, 1 << 20, 1 << 22]
+    assert geometric_ladder(100, 100) == [100]
+    with pytest.raises(ValueError):
+        geometric_ladder(0, 100)
+
+
+# ---------------------------------------------------------------------------
+# persistent cache + invalidation
+
+def test_cache_roundtrip_and_env_invalidation(tmp_path):
+    """Satellite: an entry recorded under a different jax version /
+    device kind / engine rev must be IGNORED, not reused."""
+    path = str(tmp_path / "tc.json")
+    env = {"jax": "0.4.37", "device_kind": "cpu", "engine_rev": "abc"}
+    TuningCache(path).put("k", {"batch": 1024, "rate_hs": 5e6}, env)
+
+    c = TuningCache(path)                  # fresh load from disk
+    hit = c.get("k", env)
+    assert hit["batch"] == 1024 and hit["env"] == env
+    for field, stale in (("jax", "9.9.9"),
+                         ("device_kind", "TPU v6 lite"),
+                         ("engine_rev", "defdefdefdef")):
+        assert c.get("k", dict(env, **{field: stale})) is None, field
+    assert c.get("other-key", env) is None
+
+
+def test_cache_survives_torn_or_alien_files(tmp_path):
+    path = str(tmp_path / "tc.json")
+    with open(path, "w") as fh:
+        fh.write('{"version": 99, "entr')      # torn foreign write
+    c = TuningCache(path)
+    assert c.get("k", {}) is None
+    c.put("k", {"batch": 64}, {"jax": "x"})
+    assert TuningCache(path).get("k", {"jax": "x"})["batch"] == 64
+
+
+def test_make_key_stable_and_extra_sorted():
+    a = tune.make_key("md5", attack="mask", device="jax", b=2, a=1)
+    b = tune.make_key("md5", device="jax", attack="mask", a=1, b=2)
+    assert a == b
+    # engine-registry normalization: `dprf tune -m MD5` and a job keyed
+    # on the canonical engine.name must share one entry
+    assert tune.make_key("MD5", device="jax") == tune.make_key(
+        "md5", device="jax")
+    assert tune.make_key("md5") != tune.make_key("sha1")
+    assert (tune.make_key("md5", device="jax")
+            != tune.make_key("md5", device="cpu"))
+
+
+def test_lookup_tuned_batch_env_validated(tmp_path, monkeypatch):
+    monkeypatch.setenv("DPRF_TUNE_DIR", str(tmp_path))
+    env = tune.env_fingerprint("md5", "cpu")
+    key = tune.make_key("md5", attack="mask", device="cpu")
+    tune.default_cache().put(key, {"batch": 2048}, env)
+    assert tune.lookup_tuned_batch("md5", attack="mask",
+                                   device="cpu") == 2048
+    # same key re-recorded under a stale jax version: read as a miss
+    tune.default_cache().put(key, {"batch": 4096},
+                             dict(env, jax="0.0.0"))
+    assert tune.lookup_tuned_batch("md5", attack="mask",
+                                   device="cpu") is None
+
+
+def test_engine_rev_tracks_source_identity():
+    assert tune.engine_rev("md5", "cpu") == tune.engine_rev("md5", "cpu")
+    assert tune.engine_rev("md5", "cpu") != "unknown"
+    assert tune.engine_rev("no-such-engine", "cpu") == "unknown"
+
+
+# ---------------------------------------------------------------------------
+# adaptive unit sizing
+
+def test_unit_sizes_converge_to_target_under_10x_worker_spread():
+    """Acceptance: simulated-clock dispatcher run with a 10x speed
+    spread -- each worker's units converge to the target
+    seconds-per-unit (so the fast worker gets 10x longer units)."""
+    m = MetricsRegistry()
+    clk = FakeClock()
+    target = 5.0
+    sizer = AdaptiveUnitSizer(initial=10_000, target_seconds=target,
+                              min_unit=1, max_unit=1 << 30, registry=m)
+    d = Dispatcher(keyspace=10**9, unit_size=10_000, lease_timeout=1e12,
+                   clock=clk, sizer=sizer, registry=m)
+    rates = {"fast": 1e6, "slow": 1e5}
+    last = {}
+    for _ in range(20):
+        for wid, rate in rates.items():
+            u = d.lease(wid)
+            elapsed = u.length / rate            # simulated wall time
+            clk.t += elapsed
+            d.complete(u.unit_id, elapsed=elapsed)
+            last[wid] = u.length
+    for wid, rate in rates.items():
+        seconds_per_unit = last[wid] / rate
+        assert seconds_per_unit == pytest.approx(target, rel=0.15), wid
+    ratio = last["fast"] / last["slow"]
+    assert 8.0 < ratio < 12.0
+    assert m.gauge("dprf_unit_target_seconds").value() == target
+    assert m.gauge("dprf_unit_size").value() > 0
+
+
+def test_unit_sizer_clamps_aligns_and_ignores_junk():
+    sizer = AdaptiveUnitSizer(initial=1000, target_seconds=10.0,
+                              min_unit=64, max_unit=4096, align=64,
+                              registry=MetricsRegistry())
+    assert sizer.next_size("w") == 1000 - (1000 % 64)   # no history
+    sizer.observe("w", 0, 1.0)                          # junk: dropped
+    sizer.observe("w", 100, 0.0)
+    sizer.observe("w", 100, -3.0)
+    assert sizer.rate("w") is None
+    sizer.observe("w", 1_000_000, 1.0)                  # very fast
+    assert sizer.next_size("w") == 4096                 # max clamp
+    sizer2 = AdaptiveUnitSizer(initial=1000, target_seconds=10.0,
+                               min_unit=512, max_unit=4096,
+                               registry=MetricsRegistry())
+    sizer2.observe("w", 10, 100.0)                      # very slow
+    assert sizer2.next_size("w") == 512                 # min clamp
+
+
+def test_dispatcher_reissued_units_keep_their_geometry():
+    """Adaptive sizing applies to lazily-generated units only: a
+    reissued unit must come back with its original range."""
+    m = MetricsRegistry()
+    sizer = AdaptiveUnitSizer(initial=100, target_seconds=10.0,
+                              min_unit=1, registry=m)
+    d = Dispatcher(keyspace=100_000, unit_size=100, sizer=sizer,
+                   registry=m)
+    u = d.lease("w0")
+    assert u.length == 100
+    d.complete(u.unit_id, elapsed=1.0)     # 100/s -> next target 1000
+    u2 = d.lease("w0")
+    assert u2.length == 1000
+    d.fail(u2.unit_id)
+    u3 = d.lease("w0")                     # reissue: same geometry
+    assert (u3.start, u3.end) == (u2.start, u2.end)
+
+
+def test_rpc_complete_elapsed_feeds_the_sizer():
+    """The existing RPC complete path carries the throughput report;
+    junk elapsed values must be ignored."""
+    from dprf_tpu.runtime.rpc import CoordinatorState
+
+    m = MetricsRegistry()
+    sizer = AdaptiveUnitSizer(initial=100, target_seconds=10.0,
+                              min_unit=1, registry=m)
+    d = Dispatcher(keyspace=1_000_000, unit_size=100, sizer=sizer,
+                   registry=m)
+    state = CoordinatorState({"engine": "md5"}, d, n_targets=1,
+                             registry=m)
+    resp = state.op_lease({"worker_id": "w0"})
+    assert resp["unit"]["length"] == 100
+    state.op_complete({"unit_id": resp["unit"]["id"], "hits": [],
+                       "worker_id": "w0", "elapsed": 2.0})  # 50/s
+    resp = state.op_lease({"worker_id": "w0"})
+    assert resp["unit"]["length"] == 500
+    # junk elapsed: no crash, no observation folded in
+    state.op_complete({"unit_id": resp["unit"]["id"], "hits": [],
+                       "worker_id": "w0", "elapsed": "soon"})
+    assert sizer.rate("w0") == pytest.approx(50.0)
+    st = state.op_status({})
+    assert st["parked"] == 0 and st["parked_indices"] == 0
+
+
+# ---------------------------------------------------------------------------
+# session persistence
+
+def test_session_journal_tune_records_roundtrip(tmp_path):
+    p = str(tmp_path / "job.session")
+    j = SessionJournal(p)
+    key = tune.make_key("md5", attack="mask", device="jax")
+    j.record_tuning(key, {"batch": 4096})   # pre-open: buffered
+    j.open({"engine": "md5", "fingerprint": "f"})
+    j.record_tuning("k2", {"batch": 512})
+    j.close()
+    st = SessionJournal.load(p)
+    assert st.tuning[key]["batch"] == 4096
+    assert st.tuning["k2"]["batch"] == 512
+    assert st.spec["fingerprint"] == "f"    # header still first
+
+
+# ---------------------------------------------------------------------------
+# CLI + bench end to end (CPU oracle path: fast, no compiles)
+
+def test_cli_tune_writes_cache_then_bench_and_crack_warm_start(
+        tmp_path, monkeypatch, capsys):
+    """Acceptance: `dprf tune` writes the cache; a later bench and a
+    `--batch auto` job both LOAD it -- no re-sweep -- observable via
+    `tuned: true` in the bench JSON and the dprf_tuned_batch gauge."""
+    from dprf_tpu.bench import run_bench
+    from dprf_tpu.cli import main as cli_main
+
+    monkeypatch.setenv("DPRF_TUNE_DIR", str(tmp_path))
+    rc = cli_main(["tune", "--engine", "md5", "--device", "cpu",
+                   "--mask", "?l?l?l", "--seconds", "0.05",
+                   "--min-batch", "256", "--max-batch", "1024",
+                   "--ladder-factor", "2", "-q"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["batch"] in (256, 512, 1024)
+    assert [p["batch"] for p in doc["swept"]]       # the sweep ran
+    cache_file = tmp_path / "tune_cache.json"
+    assert cache_file.exists()
+
+    # bench consumes the cache: tuned flag flips true, batch matches
+    res = run_bench(engine="md5", device="cpu", mask="?l?l?l?l",
+                    batch="auto", seconds=0.05)
+    assert res["tuned"] is True
+
+    # a --batch auto job loads the same entry (no sweep in the job
+    # path at all; the gauge records what it ran with)
+    hashfile = tmp_path / "hashes.txt"
+    hashfile.write_text(hashlib.md5(b"abc").hexdigest() + "\n")
+    rc = cli_main(["crack", "?l?l?l", str(hashfile), "--engine", "md5",
+                   "--device", "cpu", "--no-potfile",
+                   "--unit-seconds", "0", "-q"])
+    assert rc == 0
+    from dprf_tpu.telemetry import DEFAULT
+    g = DEFAULT.get("dprf_tuned_batch")
+    assert g is not None
+    assert g.value(engine="md5", device="cpu",
+                   attack="mask") == doc["batch"]
+
+
+def test_bench_auto_without_cache_reports_untuned(tmp_path, monkeypatch):
+    from dprf_tpu.bench import run_bench
+
+    monkeypatch.setenv("DPRF_TUNE_DIR", str(tmp_path / "empty"))
+    res = run_bench(engine="md5", device="cpu", mask="?l?l?l?l",
+                    batch="auto", seconds=0.05)
+    assert res["tuned"] is False
+    assert res["value"] > 0
+
+
+def test_cli_batch_auto_resumes_from_session_journal(tmp_path,
+                                                     monkeypatch):
+    """A resumed session reuses its journaled tuning decision even
+    when the persistent cache is gone (different machine)."""
+    from dprf_tpu.cli import main as cli_main
+
+    monkeypatch.setenv("DPRF_TUNE_DIR", str(tmp_path / "cachedir"))
+    env = tune.env_fingerprint("md5", "cpu")
+    key = tune.make_key("md5", attack="mask", device="cpu")
+    tune.default_cache().put(key, {"batch": 512}, env)
+
+    hashfile = tmp_path / "hashes.txt"
+    hashfile.write_text(hashlib.md5(b"zz").hexdigest() + "\n")
+    session = str(tmp_path / "job.session")
+    rc = cli_main(["crack", "?l?l", str(hashfile), "--engine", "md5",
+                   "--device", "cpu", "--no-potfile",
+                   "--session", session, "--unit-seconds", "0", "-q"])
+    assert rc == 0
+    st = SessionJournal.load(session)
+    assert st.tuning[key]["batch"] == 512   # decision journaled
+
+    # cache vanishes (new machine); the journal alone drives resume
+    monkeypatch.setenv("DPRF_TUNE_DIR", str(tmp_path / "elsewhere"))
+    rc = cli_main(["crack", "?l?l", str(hashfile), "--engine", "md5",
+                   "--device", "cpu", "--no-potfile",
+                   "--session", session, "--restore",
+                   "--unit-seconds", "0", "-q"])
+    assert rc == 0
+    from dprf_tpu.telemetry import DEFAULT
+    assert DEFAULT.get("dprf_tuned_batch").value(
+        engine="md5", device="cpu", attack="mask") == 512
+
+
+# ---------------------------------------------------------------------------
+# marker-hygiene tool (satellite: runs at the top of tier-1)
+
+def test_check_markers_tool_passes_on_this_suite_and_fails_on_unmarked(
+        tmp_path):
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(repo, "tools", "check_markers.py")
+    proc = subprocess.run([sys.executable, tool], capture_output=True,
+                          text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    bad = tmp_path / "test_unmarked_device.py"
+    bad.write_text(
+        "def test_x():\n"
+        "    from dprf_tpu.ops.pallas_mask import TILE\n"
+        "    assert TILE\n")
+    proc = subprocess.run([sys.executable, tool, str(tmp_path)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "test_unmarked_device.py" in proc.stdout
+    # a tier marker satisfies the rule
+    bad.write_text(
+        "import pytest\n"
+        "pytestmark = pytest.mark.compileheavy\n"
+        "def test_x():\n"
+        "    from dprf_tpu.ops.pallas_mask import TILE\n"
+        "    assert TILE\n")
+    proc = subprocess.run([sys.executable, tool, str(tmp_path)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
