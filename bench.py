@@ -64,10 +64,17 @@ def save(done=False):
 
 from dprf_tpu.bench import calibrated_inner
 
+# persistent XLA compile cache (ISSUE 3): the second bench of a shape
+# on this machine loads cached executables instead of re-running XLA
+# (run_bench enables it too; enabling here covers the probe ordering)
+from dprf_tpu import compilecache
+compilecache.enable()
+
 # warm-start from the tuning cache when `dprf tune` has swept this
 # chip (ISSUE 2); a miss keeps the proven 1<<22 default
 from dprf_tpu.tune import lookup_tuned_batch
-_tb = lookup_tuned_batch("md5", attack="mask", device="jax")
+_tb = lookup_tuned_batch("md5", attack="mask", device="jax",
+                         extras={{"hit_cap": 64}})
 
 for impl, batch in (("pallas", _tb or 1 << 22), ("xla", _tb or 1 << 22)):
     try:
@@ -396,9 +403,15 @@ def main() -> int:
         out["roofline_frac_hi"] = round(res["value"] / lo, 4)
         out["roofline_band_hs"] = [lo, hi]
     for k in ("impl", "device", "batch", "batches", "inner",
-              "calibrate_hs", "elapsed_s", "compile_s", "note"):
+              "calibrate_hs", "elapsed_s", "compile_s", "note",
+              "compile_cold_s", "compile_warm_s"):
         if k in res:
             out[k] = res[k]
+    # compile-cache classification (ISSUE 3): machine-checkable like
+    # `fresh`/`tuned` -- "hit" means this measurement paid ~zero
+    # compile cost, "miss" means it also populated the cache, "off"
+    # means no persistent cache was in play (e.g. cached-session tier)
+    out["compile_cache"] = res.get("compile_cache", "off")
     out.update(extras)
     _record_freshness(workdir, fresh, res["value"])
     print(json.dumps(out))

@@ -21,7 +21,11 @@ def _publish(result: dict, mode: str) -> dict:
     """Every bench run reports through the SAME registry the runtime
     publishes into (ISSUE 1): a scrape or telemetry snapshot taken
     during/after a bench shows what was measured, at what rate, with
-    how much compile time -- machine-checkable, not stdout-only."""
+    how much compile time -- machine-checkable, not stdout-only.
+    Compile metrics are NOT re-observed here: the compile site itself
+    publishes (compile_observer in run_bench / worker warmup), and a
+    second observation would double every dprf_compile_seconds count
+    and hit/miss counter a report like tools/compile_report.py sums."""
     from dprf_tpu.telemetry import DEFAULT as metrics
     labels = dict(engine=result.get("engine", "?"),
                   impl=result.get("impl", mode),
@@ -33,26 +37,41 @@ def _publish(result: dict, mode: str) -> dict:
                   ).set(result["value"], **labels)
     metrics.counter("dprf_bench_runs_total", "bench invocations",
                     labelnames=("mode",)).inc(mode=mode)
-    if "compile_s" in result:
-        metrics.histogram(
-            "dprf_compile_seconds", "step warmup/compile wall time",
-            labelnames=("engine",)).observe(
-                result["compile_s"], engine=labels["engine"])
     return result
 
 
+def _compile_fields(cache: str, seconds: float, warm_s=None) -> dict:
+    """The machine-checkable compile-cost fields every bench result
+    carries (ISSUE 3): the classification, the cold-compile cost when
+    THIS run paid it, and the warm (cache-served) cost when measured.
+    A hit run cannot know its cold cost, so compile_cold_s is None
+    there rather than a made-up number.  ONE derivation site: both
+    bench modes' JSON must keep the same field contract."""
+    out = {"compile_cache": cache,
+           "compile_cold_s": (round(seconds, 3)
+                              if cache in ("miss", "off") else None),
+           "compile_warm_s": (round(seconds, 3)
+                              if cache == "hit" else None)}
+    if warm_s is not None:
+        out["compile_warm_s"] = round(warm_s, 3)
+    return out
+
+
 def _tuned_or(batch, engine: str, device: str, fallback: int,
-              attack: str = "mask") -> tuple:
+              attack: str = "mask", extras=None) -> tuple:
     """Bench-side ``--batch auto``: (resolved batch, tuned flag).  An
     explicit integer is pinned; "auto"/None warm-starts from the tuning
     cache written by ``dprf tune`` (environment-validated -- a stale
     entry reads as a miss) and otherwise uses `fallback`.  Every bench
     result carries the flag, so a reported rate is attributable to a
-    tuned or a default batch -- machine-checkable, like `fresh`."""
+    tuned or a default batch -- machine-checkable, like `fresh`.
+    extras: key dimensions beyond (engine, device, attack) -- see
+    tune.lookup_tuned_batch."""
     if batch not in (None, "auto"):
         return int(batch), False
     from dprf_tpu.tune import lookup_tuned_batch
-    b = lookup_tuned_batch(engine, attack=attack, device=device)
+    b = lookup_tuned_batch(engine, attack=attack, device=device,
+                           extras=extras)
     if b:
         return b, True
     return fallback, False
@@ -95,6 +114,85 @@ def make_looped_step(step, inner: int):
     return run
 
 
+def _build_mask_step(engine: str, eng, gen, impl: str, batch: int,
+                     fake: bytes) -> tuple:
+    """Step selection for run_bench (the same selection a real job
+    makes); returns (step, use_pallas, tile-aligned batch).  Factored
+    out so a second same-shape build can measure the warm
+    (cache-served) compile cost."""
+    use_pallas = False
+    step = None
+    rate = getattr(eng, "_rate", None)
+    if rate is not None:
+        # keccak family: its own sponge steps (the generic MD
+        # pipeline's framing does not apply)
+        import numpy as np
+
+        from dprf_tpu.engines.device.sha3 import make_keccak_mask_step
+        from dprf_tpu.ops.pallas_keccak import (
+            SUBK, keccak_kernel_eligible, make_pallas_keccak_crack_step)
+        tw = np.frombuffer(fake, ">u4").astype(np.uint32)
+        from dprf_tpu.ops.pallas_mask import pallas_mode
+        # auto honors the DPRF_PALLAS kill-switch via pallas_mode()
+        kernel_on = (impl == "pallas" or pallas_mode() is not None)
+        if (impl != "xla" and kernel_on
+                and keccak_kernel_eligible(gen, 1, rate)):
+            tile = SUBK * 128
+            batch = max(tile, (batch // tile) * tile)
+            step = make_pallas_keccak_crack_step(
+                gen, tw, batch, eng._pad_byte, rate,
+                eng.digest_size)
+            use_pallas = True
+        elif impl == "pallas":
+            raise ValueError(
+                "--impl pallas: keccak kernel not eligible -- it "
+                "requires a real TPU backend, a mask the "
+                "arithmetic charset decode supports, and a "
+                f"candidate <= {rate - 1} bytes (rate {rate})")
+        else:
+            step = make_keccak_mask_step(
+                gen, tw, batch, eng._pad_byte, rate=rate,
+                out_bytes=eng.digest_size)
+    elif impl != "xla":
+        from dprf_tpu.ops import pallas_mask
+        eligible = pallas_mask.kernel_eligible(engine, gen, 1)
+        if impl == "pallas" and not eligible:
+            raise ValueError(
+                "--impl pallas requires a kernel-capable engine "
+                f"({', '.join(sorted(pallas_mask.CORES))}) and a mask "
+                "the arithmetic charset decode supports")
+        mode = ({"interpret": jax.default_backend() != "tpu"}
+                if impl == "pallas" else pallas_mask.pallas_mode())
+        if eligible and mode is not None:
+            batch = max(pallas_mask.TILE,
+                        (batch // pallas_mask.TILE) * pallas_mask.TILE)
+            import numpy as np
+            dt = "<u4" if eng.little_endian else ">u4"
+            step = pallas_mask.make_pallas_mask_crack_step(
+                engine, gen,
+                np.frombuffer(fake, dtype=dt).astype(np.uint32),
+                batch, **mode)
+            use_pallas = True
+    if step is None:
+        step = make_mask_crack_step(
+            eng, gen, target_words(fake, eng.little_endian), batch,
+            widen_utf16=getattr(eng, "widen_utf16", False))
+    return step, use_pallas, batch
+
+
+def _timed_aot_compile(fn, *args):
+    """Seconds to lower+compile `fn` at these args WITHOUT dispatching
+    (None when the step cannot AOT-lower).  With the persistent cache
+    populated by the run that just measured, this is the warm compile
+    cost a same-shape job pays."""
+    lower = getattr(fn, "lower", None)
+    if lower is None:
+        return None
+    t0 = time.perf_counter()
+    lower(*args).compile()
+    return time.perf_counter() - t0
+
+
 def run_bench(engine: str = "md5", device: str = "jax",
               mask: str = "?a?a?a?a?a?a?a?a", batch="auto",
               seconds: float = 5.0, impl: str = "auto",
@@ -110,71 +208,23 @@ def run_bench(engine: str = "md5", device: str = "jax",
     inner > 1 loops the step on device (see make_looped_step) and is
     the honest way to measure chip throughput over a high-latency
     link; inner = 1 measures the per-dispatch production path."""
-    batch, tuned = _tuned_or(batch, engine, device, 1 << 20)
+    batch, tuned = _tuned_or(batch, engine, device, 1 << 20,
+                             extras={"hit_cap": 64})
     gen = MaskGenerator(mask)
+    # CPU-oracle path has no jit at all; the jax path overwrites
+    compile_fields: dict = {"compile_cache": "off",
+                            "compile_cold_s": None,
+                            "compile_warm_s": None}
     # An all-0xFF digest can't be produced by these hash functions'
     # outputs for in-keyspace candidates (and a false hit would only add
     # one buffer readback anyway).
     if device == "jax":
+        from dprf_tpu import compilecache
+        compilecache.enable(log=log)
         eng = get_engine(engine, device="jax")
         fake = bytes([0xFF]) * eng.digest_size
-        use_pallas = False
-        rate = getattr(eng, "_rate", None)
-        if rate is not None:
-            # keccak family: its own sponge steps (the generic MD
-            # pipeline's framing does not apply)
-            import numpy as np
-
-            from dprf_tpu.engines.device.sha3 import make_keccak_mask_step
-            from dprf_tpu.ops.pallas_keccak import (
-                SUBK, keccak_kernel_eligible, make_pallas_keccak_crack_step)
-            tw = np.frombuffer(fake, ">u4").astype(np.uint32)
-            from dprf_tpu.ops.pallas_mask import pallas_mode
-            # same selection a real job makes: auto honors the
-            # DPRF_PALLAS kill-switch via pallas_mode()
-            kernel_on = (impl == "pallas" or pallas_mode() is not None)
-            if (impl != "xla" and kernel_on
-                    and keccak_kernel_eligible(gen, 1, rate)):
-                tile = SUBK * 128
-                batch = max(tile, (batch // tile) * tile)
-                step = make_pallas_keccak_crack_step(
-                    gen, tw, batch, eng._pad_byte, rate,
-                    eng.digest_size)
-                use_pallas = True
-            elif impl == "pallas":
-                raise ValueError(
-                    "--impl pallas: keccak kernel not eligible -- it "
-                    "requires a real TPU backend, a mask the "
-                    "arithmetic charset decode supports, and a "
-                    f"candidate <= {rate - 1} bytes (rate {rate})")
-            else:
-                step = make_keccak_mask_step(
-                    gen, tw, batch, eng._pad_byte, rate=rate,
-                    out_bytes=eng.digest_size)
-        elif impl != "xla":
-            from dprf_tpu.ops import pallas_mask
-            eligible = pallas_mask.kernel_eligible(engine, gen, 1)
-            if impl == "pallas" and not eligible:
-                raise ValueError(
-                    "--impl pallas requires a kernel-capable engine "
-                    f"({', '.join(sorted(pallas_mask.CORES))}) and a mask "
-                    "the arithmetic charset decode supports")
-            mode = ({"interpret": jax.default_backend() != "tpu"}
-                    if impl == "pallas" else pallas_mask.pallas_mode())
-            if eligible and mode is not None:
-                batch = max(pallas_mask.TILE,
-                            (batch // pallas_mask.TILE) * pallas_mask.TILE)
-                import numpy as np
-                dt = "<u4" if eng.little_endian else ">u4"
-                step = pallas_mask.make_pallas_mask_crack_step(
-                    engine, gen,
-                    np.frombuffer(fake, dtype=dt).astype(np.uint32),
-                    batch, **mode)
-                use_pallas = True
-        if not use_pallas and rate is None:
-            step = make_mask_crack_step(
-                eng, gen, target_words(fake, eng.little_endian), batch,
-                widen_utf16=getattr(eng, "widen_utf16", False))
+        step, use_pallas, batch = _build_mask_step(engine, eng, gen,
+                                                   impl, batch, fake)
         import jax.numpy as jnp
 
         fn = make_looped_step(step, inner) if inner > 1 else step
@@ -184,14 +234,30 @@ def run_bench(engine: str = "md5", device: str = "jax",
                 gen.keyspace - batch, 1)), dtype=jnp.int32)
             return fn(base, jnp.int32(batch))
 
+        from dprf_tpu.compilecache import compile_observer
         from dprf_tpu.utils.sync import hard_sync
 
-        # Warmup / compile
+        # Warmup / compile -- observed, classified hit/miss/off against
+        # the persistent compilation cache.  Argument materialization
+        # happens before the observer opens (it can write tiny cache
+        # entries of its own).
+        base0 = jnp.asarray(gen.digits(0), dtype=jnp.int32)
         t0 = time.perf_counter()
-        hard_sync(run_batch(0))
+        with compile_observer(engine) as obs:
+            hard_sync(fn(base0, jnp.int32(batch)))
         compile_s = time.perf_counter() - t0
+        # Warm cost: a second same-shape build now loads the cached
+        # executable; AOT (no dispatch), so the field is pure compile.
+        warm_s = None
+        if compilecache.enabled():
+            step2, _, _ = _build_mask_step(engine, eng, gen, impl,
+                                           batch, fake)
+            fn2 = make_looped_step(step2, inner) if inner > 1 else step2
+            warm_s = _timed_aot_compile(fn2, base0, jnp.int32(batch))
+        compile_fields = _compile_fields(obs.cache, obs.seconds, warm_s)
         if log:
-            log.info("bench compiled", seconds=f"{compile_s:.1f}")
+            log.info("bench compiled", seconds=f"{compile_s:.1f}",
+                     cache=obs.cache)
         # Timed with BOUNDED queue depth, synced by hard_sync (NOT
         # block_until_ready, which over the axon tunnel returns at
         # enqueue -- see utils/sync.py) so the wall-time window
@@ -243,6 +309,7 @@ def run_bench(engine: str = "md5", device: str = "jax",
         "inner": inner,
         "elapsed_s": round(elapsed, 3),
         "compile_s": round(compile_s, 1),
+        **compile_fields,
     }, mode="bench")
 
 
@@ -266,7 +333,10 @@ def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
     from dprf_tpu.parallel.sharded import make_sharded_mask_crack_step
 
     batch_per_device, tuned = _tuned_or(batch_per_device, engine, "jax",
-                                        1 << 20)
+                                        1 << 20,
+                                        extras={"hit_cap": 64})
+    from dprf_tpu import compilecache
+    compilecache.enable(log=log)
     gen = MaskGenerator(mask)
     eng = get_engine(engine, device="jax")
     fake = bytes([0xFF]) * eng.digest_size   # unmatchable (see run_bench)
@@ -418,11 +488,16 @@ def run_config(config: int, device: str = "jax", seconds: float = 5.0,
 
     engine_name, attack, gen, lines = _config_job(config, bcrypt_cost)
     batch, tuned = _tuned_or(batch, engine_name, device, 1 << 18,
-                             attack=attack)
+                             attack=attack,
+                             extras={"hit_cap": 64,
+                                     **({"rules_n": gen.n_rules}
+                                        if attack == "wordlist" else {})})
     oracle = get_engine(engine_name, device="cpu")
     targets = [oracle.parse_target(s)
                for s in (lines or [_unmatchable(oracle)])]
+    from dprf_tpu import compilecache
     if device == "jax":
+        compilecache.enable(log=log)
         eng = get_engine(engine_name, device="jax")
         maker = ("make_mask_worker" if attack == "mask"
                  else "make_wordlist_worker")
@@ -436,13 +511,32 @@ def run_config(config: int, device: str = "jax", seconds: float = 5.0,
     unit_len = stride * max(1, unit_strides)
     # warmup/compile on a FULL unit so the super-step program (workers
     # fuse many batches into one dispatch for multi-stride units) is
-    # compiled outside the timed window, not inside it.
+    # compiled outside the timed window, not inside it.  Device workers
+    # warm their per-batch step FIRST (a zero-work dispatch through the
+    # observer gives a clean hit/miss classification); the full-unit
+    # prime is then classified by cache-entry delta alone -- its wall
+    # time is mostly real hashing, which must not read as a cold
+    # compile.  The CPU-oracle path has no jit at all: always "off".
     t0 = _time.perf_counter()
-    worker.process(WorkUnit(-1, 0, min(unit_len, gen.keyspace)))
+    if device == "jax":
+        if not getattr(worker, "_warmed", False):
+            worker.warmup()
+        before = compilecache.entry_count()
+        worker.process(WorkUnit(-1, 0, min(unit_len, gen.keyspace)))
+        prime = compilecache.classify_delta(before,
+                                            compilecache.entry_count())
+        # any cold compile anywhere in the fixed cost -- step warmup or
+        # super/wide program build during the prime -- means this run
+        # paid one
+        wc = getattr(worker, "compile_cache", "off")
+        compile_cache = "miss" if "miss" in (wc, prime) else wc
+    else:
+        worker.process(WorkUnit(-1, 0, min(unit_len, gen.keyspace)))
+        compile_cache = "off"
     compile_s = _time.perf_counter() - t0
     if log:
         log.info("config compiled", config=config,
-                 seconds=f"{compile_s:.1f}")
+                 seconds=f"{compile_s:.1f}", cache=compile_cache)
 
     from dprf_tpu.runtime.worker import submit_or_process
 
@@ -491,4 +585,5 @@ def run_config(config: int, device: str = "jax", seconds: float = 5.0,
         "tested": tested,
         "elapsed_s": round(elapsed, 3),
         "compile_s": round(compile_s, 1),
+        **_compile_fields(compile_cache, compile_s),
     }, mode="config")

@@ -6,8 +6,9 @@ ran against the reference's CPU engines select the TPU backend with
 --device and otherwise run unchanged.
 
 Subcommands: crack (local job), serve + worker (distributed job:
-coordinator RPC + remote workers, runtime/rpc.py), bench, engines,
-keyspace.
+coordinator RPC + remote workers, runtime/rpc.py), bench, prewarm
+(ahead-of-time compile-cache population), retry-parked (admin op on a
+running coordinator), engines, keyspace.
 """
 
 from __future__ import annotations
@@ -208,6 +209,51 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="cache directory (default: $DPRF_TUNE_DIR or "
                     "~/.cache/dprf)")
     tn.add_argument("--quiet", "-q", action="store_true")
+
+    pw = sub.add_parser("prewarm", help="populate the persistent XLA "
+                        "compile cache ahead of time (fleet images: a "
+                        "worker then starts hashing in seconds, not "
+                        "minutes)")
+    pw.add_argument("--engines", default=None, metavar="E1,E2|all",
+                    help="engines to prewarm ('all' = every registered "
+                    "device engine; default: the shapes recorded in "
+                    "the tuning cache)")
+    pw.add_argument("--attacks", default="mask", metavar="A1,A2",
+                    help="attack shapes per engine (mask,wordlist)")
+    pw.add_argument("--mask", default="?a?a?a?a?a?a?a?a",
+                    help="mask shaping the prewarmed mask step")
+    pw.add_argument("--rules", default=None,
+                    help="rule set for wordlist-shape prewarm")
+    pw.add_argument("--wordlist", default=None, metavar="FILE",
+                    help="wordlist-shape prewarm: the job's REAL "
+                    "wordlist (the compiled program embeds the packed "
+                    "word table; a stand-in would cache a program no "
+                    "job runs)")
+    pw.add_argument("--batch", type=_batch_size, default="auto",
+                    help="step batch, or 'auto' (default): each "
+                    "engine's tuned batch from the tuning cache, "
+                    f"falling back to {DEFAULT_BATCH}")
+    pw.add_argument("--hit-cap", type=int, default=64)
+    pw.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="compile specs in N parallel child processes")
+    pw.add_argument("--cache-dir", default=None,
+                    help="compile cache directory (default: "
+                    "$DPRF_COMPILE_CACHE_DIR or ~/.cache/dprf/xla)")
+    pw.add_argument("--spec-json", default=None, help=argparse.SUPPRESS)
+    pw.add_argument("--quiet", "-q", action="store_true")
+
+    rp = sub.add_parser("retry-parked", help="admin op on a RUNNING "
+                        "coordinator: requeue poisoned/parked units "
+                        "with a fresh retry budget, without restarting "
+                        "the job")
+    rp.add_argument("--connect", required=True, metavar="HOST:PORT",
+                    help="the coordinator's RPC address (`dprf serve "
+                    "--bind`)")
+    rp.add_argument("--token", default=None,
+                    help="shared secret for an authenticated "
+                    "coordinator (default: $DPRF_TOKEN)")
+    rp.add_argument("--timeout", type=float, default=30.0)
+    rp.add_argument("--quiet", "-q", action="store_true")
 
     for name, helptext in (("show", "print potfile-cracked targets of a "
                             "hashlist as hash:plain"),
@@ -604,8 +650,23 @@ def _setup_job(args, device: str, log: Log,
                      tuning=tuning)
 
 
+def _tune_extras(attack: str, hit_cap=None, n_rules=None) -> dict:
+    """Tuning-cache key extras beyond (engine, device, attack):
+    hit_capacity scales every hit buffer (moving the HBM ceiling), and
+    the rules-set cardinality changes a wordlist step's word_batch for
+    the same --batch -- either can fork the optimum, so they live in
+    the key and can never alias a stale one."""
+    extras: dict = {}
+    if hit_cap is not None:
+        extras["hit_cap"] = int(hit_cap)
+    if attack == "wordlist" and n_rules:
+        extras["rules_n"] = int(n_rules)
+    return extras
+
+
 def _resolve_batch(batch_arg, engine_name: str, device: str, attack: str,
-                   log: Log, session=None, session_tuning=None):
+                   log: Log, session=None, session_tuning=None,
+                   hit_cap=None, n_rules=None):
     """--batch resolution: an explicit integer is pinned; "auto"
     consults the tuning subsystem -- the resumed session's journaled
     decision first (the resumed ledger's unit geometry was built around
@@ -616,7 +677,9 @@ def _resolve_batch(batch_arg, engine_name: str, device: str, attack: str,
 
     if batch_arg != "auto":
         return int(batch_arg), False
-    key = tune_mod.make_key(engine_name, attack=attack, device=device)
+    extras = _tune_extras(attack, hit_cap=hit_cap, n_rules=n_rules)
+    key = tune_mod.make_key(engine_name, attack=attack, device=device,
+                            **extras)
     rec = (session_tuning or {}).get(key)
     batch = None
     if isinstance(rec, dict):
@@ -630,7 +693,8 @@ def _resolve_batch(batch_arg, engine_name: str, device: str, attack: str,
                                          batch)
     if not batch:
         batch = tune_mod.lookup_tuned_batch(engine_name, attack=attack,
-                                            device=device)
+                                            device=device,
+                                            extras=extras)
         if batch:
             log.info("tuned batch loaded from cache", batch=batch,
                      cache=tune_mod.cache_path())
@@ -727,6 +791,8 @@ def _crack_increment(args, device: str, log: Log) -> int:
 
 def _crack_single(args, device: str, log: Log):
     """One crack job; returns (rc, JobResult | None, n_targets)."""
+    from dprf_tpu import compilecache
+    compilecache.enable(log=log)
     job = _setup_job(args, device, log)
     if job is None:
         return 2, None, 0
@@ -736,10 +802,21 @@ def _crack_single(args, device: str, log: Log):
 
     batch, _ = _resolve_batch(args.batch, args.engine, device,
                               args.attack, log, session=session,
-                              session_tuning=job.tuning)
+                              session_tuning=job.tuning,
+                              hit_cap=args.hit_cap,
+                              n_rules=getattr(gen, "n_rules", None))
     worker = _select_worker(args.engine, device, args.attack, gen,
                             hl.targets, batch, args.hit_cap,
                             engine, args.devices, log)
+    # Overlapped warmup: start the step compile now on a background
+    # thread so it runs while the potfile preloads, the session
+    # restores, and the coordinator takes its first leases; the
+    # coordinator joins it at the first dispatch (cold start ~=
+    # max(compile, setup), not their sum).  No-op for factory-warmed
+    # (Pallas) workers and for the CPU oracle path.
+    warmup_async = getattr(worker, "warmup_async", None)
+    if warmup_async is not None:
+        warmup_async()
 
     potfile = None if args.no_potfile else Potfile(args.potfile)
 
@@ -808,8 +885,10 @@ def _parse_hostport(s: str) -> tuple:
 
 
 def cmd_serve(args, log: Log) -> int:
+    from dprf_tpu import compilecache
     from dprf_tpu.runtime.rpc import CoordinatorServer, CoordinatorState
 
+    compilecache.enable(log=log)
     device = _DEVICE_ALIASES[args.device]
     job_setup = _setup_job(args, device, log,
                            lease_timeout=args.lease_timeout)
@@ -824,7 +903,9 @@ def cmd_serve(args, log: Log) -> int:
 
     batch, _ = _resolve_batch(args.batch, engine.name, device,
                               args.attack, log, session=session,
-                              session_tuning=job_setup.tuning)
+                              session_tuning=job_setup.tuning,
+                              hit_cap=args.hit_cap,
+                              n_rules=getattr(gen, "n_rules", None))
 
     # Everything a worker needs to rebuild the identical job.  max_len
     # is shipped so worker-side keyspace/packing can't drift from ours.
@@ -926,8 +1007,10 @@ def cmd_worker(args, log: Log) -> int:
     import os
     import socket as _socket
 
+    from dprf_tpu import compilecache
     from dprf_tpu.runtime.rpc import CoordinatorClient, worker_loop
 
+    compilecache.enable(log=log)
     device = _DEVICE_ALIASES[args.device]
     host, port = _parse_hostport(args.connect)
     token = args.token or os.environ.get("DPRF_TOKEN") or None
@@ -959,6 +1042,12 @@ def cmd_worker(args, log: Log) -> int:
     worker = _select_worker(job["engine"], device, job["attack"], gen,
                             targets, args.batch or job["batch"],
                             job["hit_cap"], engine, args.devices, log)
+    # overlapped warmup: the step compile runs while the first lease
+    # round-trips to the coordinator; worker_loop joins it before the
+    # first dispatch
+    warmup_async = getattr(worker, "warmup_async", None)
+    if warmup_async is not None:
+        warmup_async()
     worker_id = args.id or f"{_socket.gethostname()}:{os.getpid()}"
     # worker_loop exits cleanly only on an explicit stop signal; any
     # bare connection drop (coordinator crash) or quarantine raises and
@@ -974,7 +1063,10 @@ def cmd_worker(args, log: Log) -> int:
 def cmd_bench(args, log: Log) -> int:
     import contextlib
     import json
+
+    from dprf_tpu import compilecache
     from dprf_tpu.bench import run_bench, run_config
+    compilecache.enable(log=log)
     ctx = contextlib.nullcontext()
     if args.profile:
         import jax
@@ -1010,9 +1102,12 @@ def cmd_tune(args, log: Log) -> int:
     from dprf_tpu import tune as tune_mod
     from dprf_tpu.tune import geometric_ladder, record_tuned_batch, sweep
 
+    from dprf_tpu import compilecache
+
     device = _DEVICE_ALIASES[args.device]
     if args.tune_dir:
         os.environ["DPRF_TUNE_DIR"] = args.tune_dir
+    compilecache.enable(log=log)
     oracle = get_engine(args.engine, device="cpu")
     gen = MaskGenerator(args.mask)
     if args.hashfile:
@@ -1044,7 +1139,9 @@ def cmd_tune(args, log: Log) -> int:
     result = sweep(make_worker, gen.keyspace, ladder,
                    probe_seconds=args.seconds,
                    compile_budget_s=args.compile_budget, log=log)
-    path = record_tuned_batch(args.engine, "mask", device, result)
+    extras = _tune_extras("mask", hit_cap=args.hit_cap)
+    path = record_tuned_batch(args.engine, "mask", device, result,
+                              extras=extras)
     log.info("tuned", batch=result.batch,
              rate=f"{result.rate_hs:,.0f}/s", cache=path)
     print(_json.dumps({
@@ -1052,13 +1149,107 @@ def cmd_tune(args, log: Log) -> int:
         "device": device,
         "env": tune_mod.env_fingerprint(args.engine, device),
         "key": tune_mod.make_key(args.engine, attack="mask",
-                                 device=device),
+                                 device=device, **extras),
         "batch": result.batch,
         "rate_hs": result.rate_hs,
         "compile_s": round(result.compile_s, 3),
         "swept": [p.as_dict() for p in result.swept],
         "cache": path,
     }))
+    return 0
+
+
+def cmd_prewarm(args, log: Log) -> int:
+    """Populate the persistent compile cache ahead of time: iterate
+    (engine, attack, batch) specs -- tune-cache-seeded and/or an
+    explicit --engines/--attacks list -- build each worker's step
+    through the real factory path, and lower+compile it WITHOUT
+    dispatching.  Bake the cache dir into a fleet image and every
+    worker's warmup becomes a cache load."""
+    import json as _json
+
+    from dprf_tpu import compilecache, engine_names
+    from dprf_tpu.compilecache.prewarm import (RESULT_MARKER,
+                                               PrewarmSpec,
+                                               explicit_specs,
+                                               render_table,
+                                               run_prewarm,
+                                               tune_seeded_specs)
+
+    d = compilecache.enable(dir=args.cache_dir, log=log)
+    if d is None:
+        log.error("persistent compile cache unavailable (disabled or "
+                  "unwritable dir); nothing to prewarm into")
+        return 2
+    if args.spec_json:
+        # child-process mode (prewarm --jobs fan-out): compile exactly
+        # these specs, report one marker line each
+        from dprf_tpu.compilecache.prewarm import prewarm_one
+        specs = [PrewarmSpec.from_dict(s)
+                 for s in _json.loads(args.spec_json)]
+        for spec in specs:
+            res = prewarm_one(spec, log=log)
+            print(RESULT_MARKER + _json.dumps(res.as_dict()), flush=True)
+        return 0
+    attacks = [a.strip() for a in args.attacks.split(",") if a.strip()]
+    for a in attacks:
+        if a not in ("mask", "wordlist"):
+            log.error(f"unknown attack shape {a!r} (mask, wordlist)")
+            return 2
+    if args.engines:
+        engines = (sorted(engine_names("jax"))
+                   if args.engines == "all"
+                   else [e.strip() for e in args.engines.split(",")
+                         if e.strip()])
+        specs = explicit_specs(engines, attacks, hit_cap=args.hit_cap,
+                               mask=args.mask, rules=args.rules,
+                               wordlist=args.wordlist,
+                               batch=args.batch)
+    else:
+        specs = tune_seeded_specs("jax", hit_cap=args.hit_cap,
+                                  mask=args.mask, rules=args.rules,
+                                  wordlist=args.wordlist, log=log)
+        if not specs:
+            log.error("tuning cache has no device entries to seed "
+                      "from; pass --engines (e.g. --engines md5,ntlm "
+                      "or --engines all)")
+            return 2
+    log.info("prewarming", specs=len(specs), jobs=args.jobs, cache=d)
+    results = run_prewarm(specs, jobs=args.jobs, log=log)
+    if not args.quiet:
+        print(render_table(results), file=sys.stderr)
+    ok = [r for r in results if not r.error]
+    print(_json.dumps({
+        "cache_dir": d,
+        "specs": len(results),
+        "compiled": len(ok),
+        "hits": sum(1 for r in ok if r.cache == "hit"),
+        "misses": sum(1 for r in ok if r.cache == "miss"),
+        "errors": len(results) - len(ok),
+        "results": [r.as_dict() for r in results],
+    }))
+    return 0 if ok or not results else 1
+
+
+def cmd_retry_parked(args, log: Log) -> int:
+    """Admin client for rpc.op_retry_parked: requeue a live job's
+    poisoned/parked units with a fresh retry budget."""
+    import json as _json
+
+    from dprf_tpu.runtime.rpc import CoordinatorClient
+
+    host, port = _parse_hostport(args.connect)
+    token = args.token or os.environ.get("DPRF_TOKEN") or None
+    client = CoordinatorClient(host, port, timeout=args.timeout,
+                               token=token)
+    try:
+        client.hello()             # answers the auth challenge if any
+        resp = client.call("retry_parked")
+    finally:
+        client.close()
+    retried = int(resp.get("retried", 0))
+    log.info("parked units requeued", retried=retried)
+    print(_json.dumps({"retried": retried}))
     return 0
 
 
@@ -1213,6 +1404,8 @@ _COMMANDS = {
     "worker": cmd_worker,
     "bench": cmd_bench,
     "tune": cmd_tune,
+    "prewarm": cmd_prewarm,
+    "retry-parked": cmd_retry_parked,
     "metrics": cmd_metrics,
     "show": cmd_show,
     "left": cmd_left,

@@ -1,0 +1,300 @@
+"""Compile-cost elimination layer (ISSUE 3).
+
+The dominant *fixed* cost of every job is the jit warmup compile
+(runtime/worker.py calls it out; the krb5aes smoke tier once spent ~9
+minutes almost entirely in XLA compiles).  Every step shape we compile
+is deterministic and repeated across workers, sessions, and bench runs
+-- so this package wires JAX's persistent XLA compilation cache into
+every execution path and makes its behavior observable:
+
+  - ``enable()``          one idempotent entrypoint that points
+                          ``jax_compilation_cache_dir`` at
+                          ``$DPRF_COMPILE_CACHE_DIR`` (default
+                          ``~/.cache/dprf/xla``, beside the tune cache)
+                          with the persistence thresholds lowered so
+                          our step compiles always persist.  Called
+                          from the CLI (crack/serve/worker/bench/tune/
+                          prewarm), dprf_tpu/bench.py, and the batch
+                          autotuner.  Advisory: an unwritable dir or a
+                          ``DPRF_COMPILE_CACHE=0`` kill switch degrades
+                          to "no cache", never to a crashed job.
+  - ``compile_observer``  times one step compile, classifies it as a
+                          cache hit/miss, and publishes
+                          ``dprf_compile_seconds{engine,cache}`` plus
+                          ``dprf_compile_cache_hits_total`` /
+                          ``_misses_total`` -- so "a stalled fleet that
+                          is really compiling" is diagnosable from a
+                          scrape or a telemetry snapshot
+                          (tools/compile_report.py).
+  - ``prewarm``           ahead-of-time cache population for a fleet
+                          image (the ``dprf prewarm`` subcommand; see
+                          compilecache/prewarm.py).
+
+Classification: a compile that wrote new entries into the cache dir is
+a miss (exact -- JAX persists every compile at these thresholds); one
+that wrote nothing and finished under the cold-compile floor
+(``$DPRF_COMPILE_COLD_FLOOR_S``, default 5 s) is a hit.  A no-write
+compile OVER the floor is still reported as a miss: that is what a
+backend whose compiles cannot persist looks like, and calling it a hit
+would hide exactly the cost this layer exists to eliminate.  Windows
+that mix compile with real compute (an autotuner rung, a bench warmup
+unit) classify by the entry delta alone -- ``classify_delta`` -- since
+their wall time says nothing about the compile.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+CACHE_DIR_ENV = "DPRF_COMPILE_CACHE_DIR"
+#: kill switch: DPRF_COMPILE_CACHE=0 disables the persistent cache
+DISABLE_ENV = "DPRF_COMPILE_CACHE"
+COLD_FLOOR_ENV = "DPRF_COMPILE_COLD_FLOOR_S"
+#: wall-time floor separating a deserialize-and-load cache hit from a
+#: real XLA compile when the entry-count delta is zero.  The floor
+#: only arbitrates that delta==0 case: a cold compile with the cache
+#: enabled writes entries and is classified miss by the delta alone,
+#: so the floor's job is telling a served hit (trace + executable
+#: load, 0.2-2 s observed on a loaded CPU box) from a backend whose
+#: compiles cannot persist at all (cold every time, typically tens of
+#: seconds to minutes).  5 s splits those populations with headroom.
+DEFAULT_COLD_FLOOR_S = 5.0
+
+_lock = threading.Lock()
+_state: dict = {"dir": None}
+
+
+def default_cache_dir() -> str:
+    """$DPRF_COMPILE_CACHE_DIR, or ~/.cache/dprf/xla (deliberately
+    beside the tuning cache: one directory tree to bake into a fleet
+    image carries both the tuned batches and their compiled steps)."""
+    d = os.environ.get(CACHE_DIR_ENV)
+    if d:
+        return d
+    return os.path.join(os.path.expanduser("~"), ".cache", "dprf", "xla")
+
+
+def cache_dir() -> Optional[str]:
+    """The directory the cache is currently enabled on, or None."""
+    return _state["dir"]
+
+
+def enabled() -> bool:
+    return _state["dir"] is not None
+
+
+def enable(dir: Optional[str] = None, log=None) -> Optional[str]:
+    """Turn on the persistent XLA compilation cache; returns the cache
+    directory, or None when disabled/unusable.  Idempotent: re-calls
+    with the same (or default) dir are no-ops; an explicit different
+    dir re-points the cache (tests, ``prewarm --cache-dir``).
+
+    The persistence thresholds are lowered to "persist everything":
+    the default min-compile-time gate (1 s) would silently drop the
+    very step compiles (some take ~1 s on CPU, minutes on TPU) this
+    cache exists for, and a dropped entry reads as an eternal miss.
+    """
+    if os.environ.get(DISABLE_ENV, "1") == "0":
+        return None
+    d = os.path.abspath(dir or default_cache_dir())
+    with _lock:
+        if _state["dir"] == d:
+            return d
+        try:
+            os.makedirs(d, exist_ok=True)
+            probe = os.path.join(d, ".dprf-write-probe")
+            with open(probe, "w") as fh:
+                fh.write("ok")
+            os.unlink(probe)
+        except OSError as e:
+            _warn(log, "compile cache dir unwritable; persistent "
+                  "compilation cache DISABLED", dir=d, error=str(e))
+            return None
+        try:
+            import jax
+            jax.config.update("jax_compilation_cache_dir", d)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1)
+            # jax materializes its cache object AT MOST ONCE, at the
+            # first compile -- a dir set (or changed) after that is
+            # silently ignored unless the cache is reset.  Without
+            # this, an enable() after any prior jit dispatch in the
+            # process is a no-op that still *reports* enabled.
+            _reset_backend_cache()
+        except Exception as e:   # noqa: BLE001 -- an old jax without
+            # these options must degrade, not kill the job
+            _warn(log, "jax compilation-cache config rejected; "
+                  "persistent compilation cache DISABLED", error=str(e))
+            return None
+        _state["dir"] = d
+        if log is not None:
+            log.info("persistent compile cache enabled", dir=d)
+        return d
+
+
+def _reset_backend_cache() -> None:
+    """Drop jax's in-memory cache OBJECT so the next compile
+    re-initializes it against the current config dir (on-disk entries
+    are untouched)."""
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:   # noqa: BLE001 -- internal API; a jax that
+        # moved it initializes lazily anyway on first-ever compile
+        pass
+
+
+def disable() -> None:
+    """Undo enable() (tests).  Leaves on-disk entries alone."""
+    with _lock:
+        if _state["dir"] is None:
+            return
+        try:
+            import jax
+            jax.config.update("jax_compilation_cache_dir", None)
+            _reset_backend_cache()
+        except Exception:   # noqa: BLE001
+            pass
+        _state["dir"] = None
+
+
+def _warn(log, msg: str, **kw) -> None:
+    if log is not None:
+        log.warn(msg, **kw)
+    else:
+        from dprf_tpu.utils.logging import DEFAULT
+        DEFAULT.warn(msg, **kw)
+
+
+def entry_count() -> Optional[int]:
+    """Number of entries in the cache dir (None when disabled or
+    unreadable).  JAX writes one flat file per cached executable, so a
+    before/after count delta is an exact "did this compile persist
+    anything new" signal for a single-process compile."""
+    d = _state["dir"]
+    if d is None:
+        return None
+    try:
+        return len(os.listdir(d))
+    except OSError:
+        return None
+
+
+def cold_floor_s() -> float:
+    try:
+        return float(os.environ.get(COLD_FLOOR_ENV,
+                                    DEFAULT_COLD_FLOOR_S))
+    except ValueError:
+        return DEFAULT_COLD_FLOOR_S
+
+
+def classify_compile(seconds: float, entries_before: Optional[int] = None,
+                     entries_after: Optional[int] = None) -> str:
+    """"hit" | "miss" | "off" for one timed compile (see module
+    docstring for the decision rule)."""
+    if not enabled():
+        return "off"
+    if (entries_before is not None and entries_after is not None
+            and entries_after > entries_before):
+        return "miss"
+    return "hit" if seconds < cold_floor_s() else "miss"
+
+
+def classify_delta(entries_before: Optional[int],
+                   entries_after: Optional[int]) -> str:
+    """Entry-delta-only classification, for windows whose wall time
+    mixes compile with real compute (autotuner rungs, bench warmup
+    units): new entries -> miss, none -> hit.  The wall-time floor is
+    deliberately NOT consulted -- a big rung's hashing would flip a
+    genuine hit to 'miss' by sheer compute time."""
+    if not enabled():
+        return "off"
+    if (entries_before is not None and entries_after is not None
+            and entries_after > entries_before):
+        return "miss"
+    return "hit"
+
+
+def compile_histogram(registry=None):
+    """ONE declaration site for dprf_compile_seconds (worker warmup,
+    bench, and prewarm all publish through here, so the label set can
+    never drift).  The ``cache`` label is the hit/miss/off
+    classification -- a scrape separates "fleet is cold-compiling"
+    from "fleet is loading cached executables"."""
+    from dprf_tpu.telemetry import get_registry
+    return get_registry(registry).histogram(
+        "dprf_compile_seconds", "step warmup/compile wall time",
+        labelnames=("engine", "cache"))
+
+
+def _cache_counters(registry=None) -> tuple:
+    from dprf_tpu.telemetry import get_registry
+    m = get_registry(registry)
+    return (m.counter("dprf_compile_cache_hits_total",
+                      "step compiles served from the persistent "
+                      "compilation cache", labelnames=("engine",)),
+            m.counter("dprf_compile_cache_misses_total",
+                      "step compiles that ran XLA cold",
+                      labelnames=("engine",)))
+
+
+def observe_compile(engine: str, seconds: float, cache: str,
+                    registry=None) -> None:
+    """Publish one classified compile into the metric surface."""
+    compile_histogram(registry).observe(seconds, engine=engine,
+                                        cache=cache)
+    hits, misses = _cache_counters(registry)
+    if cache == "hit":
+        hits.inc(engine=engine)
+    elif cache == "miss":
+        misses.inc(engine=engine)
+
+
+class compile_observer:
+    """Context manager around one step compile: times it, classifies
+    hit/miss/off from the cache-dir entry delta + wall time, and
+    publishes the metrics.  Build the compile's *arguments* before
+    entering -- argument materialization can itself write tiny cache
+    entries, which would misread a hit as a miss.
+
+    Attributes after exit: ``seconds``, ``cache``.  Nothing is
+    published when the body raises (a failed compile is not a compile
+    cost, it is an error the caller handles)."""
+
+    __slots__ = ("engine", "registry", "publish", "seconds", "cache",
+                 "_t0", "_before")
+
+    def __init__(self, engine: str, registry=None, publish: bool = True):
+        self.engine = engine
+        self.registry = registry
+        self.publish = publish
+        self.seconds = 0.0
+        self.cache = "off"
+
+    def __enter__(self) -> "compile_observer":
+        self._before = entry_count()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.seconds = time.perf_counter() - self._t0
+        if exc_type is not None:
+            return False
+        self.cache = classify_compile(self.seconds, self._before,
+                                      entry_count())
+        if self.publish:
+            observe_compile(self.engine, self.seconds, self.cache,
+                            registry=self.registry)
+        return False
+
+
+__all__ = ["CACHE_DIR_ENV", "DISABLE_ENV", "COLD_FLOOR_ENV",
+           "DEFAULT_COLD_FLOOR_S", "cache_dir", "classify_compile",
+           "classify_delta", "cold_floor_s", "compile_histogram",
+           "compile_observer", "default_cache_dir", "disable",
+           "enable", "enabled", "entry_count", "observe_compile"]

@@ -178,6 +178,15 @@ class Coordinator:
         t0 = time.perf_counter()
         tested0 = self.dispatcher.progress()[0]
         last_report = t0
+        # Overlapped warmup: kick the step compile onto a background
+        # thread (a no-op for workers already warmed -- Pallas
+        # factories -- or already started by the CLI) and join it only
+        # at the first dispatch, so the compile overlaps session open
+        # and the first leases instead of serializing with them.
+        warmup_async = getattr(self.worker, "warmup_async", None)
+        if warmup_async is not None:
+            warmup_async()
+        ensure_warm = getattr(self.worker, "ensure_warm", None)
         if self.session is not None:
             self.session.open(self.spec.as_dict())
         # (unit, PendingUnit) FIFO: device work for every queued unit is
@@ -191,6 +200,11 @@ class Coordinator:
                     unit = self.dispatcher.lease()
                     if unit is None:
                         break
+                    if ensure_warm is not None:
+                        # join the background compile before the first
+                        # step dispatch (submitting mid-compile would
+                        # race the jit tracer against itself)
+                        ensure_warm()
                     pending.append((unit, submit_or_process(self.worker,
                                                             unit),
                                     time.monotonic()))

@@ -141,8 +141,13 @@ class Dispatcher:
         self._m_poisoned = m.counter(
             "dprf_units_poisoned_total",
             "units parked after exhausting their retry budget")
+        self._g_parked = m.gauge(
+            "dprf_units_parked",
+            "units currently parked (poisoned); drops to 0 on a "
+            "retry-parked admin op")
         self._g_keyspace.set(keyspace)
         self._g_covered.set(0)
+        self._g_parked.set(0)
 
     # -- construction from a resume journal ------------------------------
 
@@ -217,6 +222,7 @@ class Dispatcher:
             self._parked.append(unit)
             self._parked_len += unit.length
             self._m_poisoned.inc()
+            self._g_parked.set(len(self._parked))
             from dprf_tpu.utils.logging import DEFAULT as log
             log.warn("parking poisoned unit after repeated failures",
                      unit=unit.unit_id, start=unit.start,
@@ -279,6 +285,30 @@ class Dispatcher:
 
     def parked_units(self) -> list:
         return list(self._parked)
+
+    def retry_parked(self) -> int:
+        """Admin op (`dprf retry-parked` -> rpc.op_retry_parked):
+        requeue every parked unit with a FRESH retry budget, without
+        restarting the job.  The operator's tool for "the poison was
+        environmental" (a bad worker build since replaced, a host that
+        ran out of memory): the ranges become reachable again and
+        `done()` stops treating them as holes.  Returns the number of
+        units requeued.  dprf_units_poisoned_total keeps its count --
+        it records parking EVENTS; the dprf_units_parked gauge drops
+        to 0."""
+        n = len(self._parked)
+        for unit in self._parked:
+            self._retries.pop(unit.unit_id, None)
+            self._pending.append(unit)
+            self._m_reissued.inc(reason="retry_parked")
+        self._parked = []
+        self._parked_len = 0
+        self._g_parked.set(0)
+        if n:
+            from dprf_tpu.utils.logging import DEFAULT as log
+            log.info("requeued parked units with a fresh retry budget",
+                     count=n)
+        return n
 
     def outstanding_unit(self, unit_id: int) -> Optional[WorkUnit]:
         """The still-leased unit with this id (None once completed,

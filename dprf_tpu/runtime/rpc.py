@@ -289,6 +289,16 @@ class CoordinatorState:
             self.dispatcher.fail(int(msg["unit_id"]))
         return {"ok": True}
 
+    def op_retry_parked(self, msg: dict) -> dict:
+        """Admin op (`dprf retry-parked --connect`): requeue poisoned/
+        parked units with a fresh retry budget on the LIVE job --
+        without restarting it.  Token-authenticated like every other
+        RPC op when the coordinator has a token (it mutates the unit
+        ledger, unlike the read-only /metrics scrape)."""
+        with self.lock:
+            n = self.dispatcher.retry_parked()
+        return {"ok": True, "retried": n}
+
     def op_metrics(self, msg: dict) -> dict:
         """Registry read over the RPC protocol (authenticated when the
         coordinator has a token); the HTTP GET path below serves the
@@ -605,6 +615,13 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
         unit = WorkUnit(unit_d["id"], unit_d["start"], unit_d["length"])
         t_unit = time.monotonic()
         try:
+            # join an overlapped warmup (cli.cmd_worker starts one
+            # before the loop, so the step compile overlapped the
+            # lease round trip); inside the try so a compile failure
+            # releases the lease like any processing failure
+            ensure_warm = getattr(worker, "ensure_warm", None)
+            if ensure_warm is not None:
+                ensure_warm()
             hits = worker.process(unit)
         except Exception:
             # release the lease for another worker, then surface the bug

@@ -163,38 +163,122 @@ class MaskWorkerBase:
         self._order = np.zeros(1, dtype=np.int64)
         return target_words(digests[0], engine.little_endian)
 
+    def warmup_args(self) -> tuple:
+        """The step arguments a zero-work warmup dispatch uses -- same
+        shapes/dtypes as production dispatches, so the compiled (and
+        persistently cached) program is the one real units run."""
+        import jax.numpy as jnp
+        return (jnp.asarray(self.gen.digits(0), dtype=jnp.int32),
+                jnp.int32(0))
+
     def warmup(self) -> None:
         """Force the step's compile now (jit is lazy).  The engine
-        factory calls this so a Mosaic/XLA compile failure surfaces at
-        worker construction -- where it can fall back to another path --
-        instead of mid-job."""
+        factory calls this for Pallas workers so a Mosaic/XLA compile
+        failure surfaces at worker construction -- where it can fall
+        back to another path -- instead of mid-job."""
+        args = self.warmup_args()   # built OUTSIDE the observer: arg
+        # materialization can write tiny cache entries of its own
+        self._timed_warmup(args)
+
+    def _timed_warmup(self, args: tuple) -> None:
+        """One observed warmup dispatch: times the compile, classifies
+        it against the persistent compilation cache (hit/miss/off),
+        and publishes dprf_compile_seconds{engine,cache} (the dominant
+        fixed cost of a job; a scrape that shows minutes here explains
+        a 'stalled' fleet that is really compiling)."""
         import time
 
-        import jax.numpy as jnp
-
+        from dprf_tpu.compilecache import compile_observer
         from dprf_tpu.utils.sync import hard_sync
-        base = jnp.asarray(self.gen.digits(0), dtype=jnp.int32)
+        t0 = time.perf_counter()
         # hard_sync (not block_until_ready) so a RUNTIME kernel fault
         # also surfaces here, not just a compile failure -- over the
         # axon tunnel block_until_ready returns at enqueue and the
         # fault would land on the first real batch instead
-        t0 = time.perf_counter()
-        with self._compile_timer():
-            hard_sync(self.step(base, jnp.int32(0)))
+        with compile_observer(getattr(self.engine, "name",
+                                      "unknown")) as obs:
+            hard_sync(self.step(*args))
         #: warmup/compile wall time; tune/autotuner.sweep folds it into
         #: a rung's fixed cost (covers workers warmed before the
         #: sweep's own clock started)
         self.compile_seconds = time.perf_counter() - t0
+        #: "hit" | "miss" | "off": whether the persistent compilation
+        #: cache served this step (bench and prewarm report it)
+        self.compile_cache = obs.cache
+        self._warmed = True
 
-    def _compile_timer(self):
-        """Telemetry timer for warmup compiles (the dominant fixed cost
-        of a job; a scrape that shows minutes here explains a 'stalled'
-        fleet that is really compiling)."""
-        from dprf_tpu.telemetry import DEFAULT as metrics
-        return metrics.histogram(
-            "dprf_compile_seconds", "step warmup/compile wall time",
-            labelnames=("engine",)).time(
-                engine=getattr(self.engine, "name", "unknown"))
+    def aot_compile(self) -> None:
+        """Compile the step WITHOUT dispatching (``dprf prewarm``):
+        lower + compile populates the persistent compilation cache
+        with exactly the executable a same-shape warmup dispatch
+        loads.  Steps that cannot AOT-lower fall back to a plain
+        warmup dispatch (still zero keyspace work: n_valid = 0).
+
+        Tracing/lowering happens OUTSIDE the observer: it is pure
+        Python the cache can never serve, and folding it in would
+        understate the cache's effect on the XLA compile itself
+        (``xla_compile_seconds``, the >=5x acceptance quantity)."""
+        import time
+        args = self.warmup_args()
+        lower = getattr(self.step, "lower", None)
+        if lower is None:
+            return self.warmup()
+        from dprf_tpu.compilecache import compile_observer
+        t0 = time.perf_counter()
+        lowered = lower(*args)
+        trace_s = time.perf_counter() - t0
+        with compile_observer(getattr(self.engine, "name",
+                                      "unknown")) as obs:
+            lowered.compile()
+        #: the XLA compile alone -- what the persistent cache
+        #: eliminates (trace/lower cost is irreducible host Python)
+        self.xla_compile_seconds = obs.seconds
+        self.compile_seconds = trace_s + obs.seconds
+        self.compile_cache = obs.cache
+
+    def warmup_async(self):
+        """Overlapped warmup: start warmup() on a background thread so
+        the step compile runs while the caller finishes job setup
+        (potfile preload, session restore, first leases).  Join with
+        ``ensure_warm()`` before the first step dispatch -- cold-start
+        wall time becomes max(compile, setup) instead of their sum.
+        DPRF_ASYNC_WARMUP=0 degrades to a synchronous warmup."""
+        import os
+        import threading
+        if getattr(self, "_warmed", False) or \
+                getattr(self, "_warm_thread", None) is not None:
+            return self
+        if os.environ.get("DPRF_ASYNC_WARMUP", "1") == "0":
+            self.warmup()
+            return self
+        self._warm_error = None
+
+        def _run():
+            try:
+                self.warmup()
+            except BaseException as e:   # noqa: BLE001 -- re-raised
+                # by ensure_warm on the caller's thread
+                self._warm_error = e
+
+        t = threading.Thread(target=_run, name="dprf-warmup",
+                             daemon=True)
+        self._warm_thread = t
+        t.start()
+        return self
+
+    def ensure_warm(self) -> None:
+        """Join an in-flight warmup_async(); re-raises its failure on
+        the calling thread (the same place a synchronous warmup would
+        have raised).  No-op when warmup never ran or already ran."""
+        t = getattr(self, "_warm_thread", None)
+        if t is None:
+            return
+        t.join()
+        self._warm_thread = None
+        err = getattr(self, "_warm_error", None)
+        if err is not None:
+            self._warm_error = None
+            raise err
 
     def _batch_flag(self, result):
         """Scalar that is nonzero iff this batch needs host attention
@@ -468,6 +552,12 @@ class WordlistWorkerBase(MaskWorkerBase):
     ``self.word_batch`` (words per step, = the step's flat-lane stride
     divisor) before using these."""
 
+    def warmup_args(self) -> tuple:
+        """Wordlist steps take (word-window start, n_valid words) --
+        both scalars -- not a digit vector."""
+        import jax.numpy as jnp
+        return (jnp.int32(0), jnp.int32(0))
+
     def _collect_word_hits(self, lanes_np, tpos_np, ws: int,
                            unit: WorkUnit, lane_wb: int = 0) -> list[Hit]:
         """Flat rule-major step lanes -> in-unit Hit records."""
@@ -690,17 +780,6 @@ class PallasWordlistWorker(DeviceWordlistWorker):
                 del cache[k]
         self._wide_shared = (step.words4, step.lens3)
         return step
-
-    def warmup(self) -> None:
-        import time
-
-        import jax.numpy as jnp
-
-        from dprf_tpu.utils.sync import hard_sync
-        t0 = time.perf_counter()
-        with self._compile_timer():
-            hard_sync(self.step(jnp.int32(0), jnp.int32(0)))
-        self.compile_seconds = time.perf_counter() - t0
 
 
 class PallasMaskWorker(MaskWorkerBase):

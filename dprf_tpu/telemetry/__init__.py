@@ -13,14 +13,19 @@ Metric names (all prefixed ``dprf_``; see README "Observability"):
   dprf_units_leased_total / _completed_total / _reissued_total{reason}
   dprf_hits_total / dprf_hits_rejected_total    oracle-verified cracks
   dprf_unit_seconds                             unit latency histogram
-  dprf_compile_seconds{engine}                  step warmup compiles
+  dprf_compile_seconds{engine,cache}            step warmup compiles
+                                                (cache: hit|miss|off)
+  dprf_compile_cache_hits_total{engine}         persistent-compile-
+  dprf_compile_cache_misses_total{engine}         cache behavior
   dprf_keyspace_total / dprf_keyspace_covered   sweep progress gauges
   dprf_targets_total / dprf_targets_found
   dprf_workers_quarantined / dprf_worker_last_seen_timestamp{worker}
   dprf_bench_rate_hs{engine,impl,device,mode}   bench results
   dprf_tuned_batch{engine,device,attack}        tuning-subsystem batch
   dprf_unit_target_seconds / dprf_unit_size     adaptive unit sizing
-  dprf_units_poisoned_total                     retry-cap parked units
+  dprf_units_poisoned_total                     retry-cap parking events
+  dprf_units_parked                             currently-parked gauge
+                                                (0 after retry-parked)
 """
 
 from __future__ import annotations

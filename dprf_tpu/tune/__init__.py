@@ -48,15 +48,23 @@ def publish_tuned_batch(engine: str, device: str, attack: str,
 def lookup_tuned_batch(engine: str, attack: str = "mask",
                        device: str = "jax",
                        session_path: Optional[str] = None,
-                       registry=None) -> Optional[int]:
+                       registry=None,
+                       extras: Optional[dict] = None) -> Optional[int]:
     """Environment-validated cache lookup; the warm-start path bench
     and ``--batch auto`` jobs take.  Returns the tuned batch (and
     publishes the gauge) or None -- never raises: a broken cache reads
-    as a miss and the caller's default stands."""
+    as a miss and the caller's default stands.
+
+    extras: additional key dimensions that fork the optimum --
+    hit_capacity (a raised --hit-cap scales every hit buffer, moving
+    the HBM ceiling) and rules-set cardinality (word_batch = batch //
+    n_rules, so the same batch means different step shapes) -- folded
+    into the cache key so a stale optimum can never alias."""
     try:
         cache = default_cache(session_path)
         env = env_fingerprint(engine, device)
-        entry = cache.get(make_key(engine, attack=attack, device=device),
+        entry = cache.get(make_key(engine, attack=attack, device=device,
+                                   **(extras or {})),
                           env)
         if not entry:
             return None
@@ -73,11 +81,14 @@ def lookup_tuned_batch(engine: str, attack: str = "mask",
 def record_tuned_batch(engine: str, attack: str, device: str,
                        result: TuneResult,
                        session_path: Optional[str] = None,
-                       registry=None) -> str:
+                       registry=None,
+                       extras: Optional[dict] = None) -> str:
     """Persist a sweep result and publish the gauge; returns the cache
-    file path written."""
+    file path written.  `extras` must match what the consuming job's
+    lookup passes (see lookup_tuned_batch)."""
     cache = default_cache(session_path)
-    cache.put(make_key(engine, attack=attack, device=device),
+    cache.put(make_key(engine, attack=attack, device=device,
+                       **(extras or {})),
               result.as_record(), env_fingerprint(engine, device))
     publish_tuned_batch(engine, device, attack, result.batch,
                         registry=registry)

@@ -34,12 +34,19 @@ class Probe:
     rate_hs: float
     compile_s: float
     error: Optional[str] = None
+    #: persistent-compile-cache classification of this rung's fixed
+    #: cost ("hit" | "miss" | "off"): a hit rung's compile is ~free,
+    #: which is how a cached sweep reaches bigger batches within the
+    #: same compile budget
+    cache: Optional[str] = None
 
     def as_dict(self) -> dict:
         d = {"batch": self.batch, "rate_hs": self.rate_hs,
              "compile_s": round(self.compile_s, 3)}
         if self.error:
             d["error"] = self.error
+        if self.cache is not None:
+            d["cache"] = self.cache
         return d
 
 
@@ -108,12 +115,20 @@ def sweep(make_worker: Callable[[int], object], keyspace: int,
     the best batch under the compile budget.  Raises ValueError when no
     rung produces a worker at all (the caller's default batch stands).
     """
+    from dprf_tpu import compilecache
+
+    # Persistent compile cache ON for the sweep: a previously-swept
+    # (or prewarmed) rung's fixed cost collapses to a cache load, so
+    # the ladder reaches bigger batches inside the same compile budget
+    # instead of burning it on recompiles of known shapes.
+    compilecache.enable(log=log)
     ladder = ladder or geometric_ladder()
     swept: List[Probe] = []
     best: Optional[Probe] = None
     stall = 0
     for batch in ladder:
         try:
+            entries0 = compilecache.entry_count()
             t0 = clock()
             worker = make_worker(batch)
             # prime: the first unit pays warmup/compile (workers built
@@ -129,6 +144,10 @@ def sweep(make_worker: Callable[[int], object], keyspace: int,
             # compile_seconds (runtime/worker.py), so take the max
             compile_s = max(clock() - t0,
                             getattr(worker, "compile_seconds", 0.0))
+            # delta-only: the rung window includes a whole prime unit
+            # of hashing, so wall time says nothing about the compile
+            rung_cache = compilecache.classify_delta(
+                entries0, compilecache.entry_count())
         except Exception as e:   # noqa: BLE001 -- compiler/alloc errors
             swept.append(Probe(batch, 0.0, 0.0,
                                error=f"{type(e).__name__}: {e}"))
@@ -138,7 +157,8 @@ def sweep(make_worker: Callable[[int], object], keyspace: int,
             break                # bigger batches will only fail harder
         if compile_s > compile_budget_s:
             swept.append(Probe(batch, 0.0, compile_s,
-                               error="over compile budget"))
+                               error="over compile budget",
+                               cache=rung_cache))
             if log:
                 log.warn("tune rung over compile budget; stopping "
                          "ladder", batch=batch,
@@ -146,11 +166,11 @@ def sweep(make_worker: Callable[[int], object], keyspace: int,
                          budget_s=compile_budget_s)
             break                # compile time grows with batch
         rate = _probe_rate(worker, keyspace, probe_seconds, clock)
-        p = Probe(batch, rate, compile_s)
+        p = Probe(batch, rate, compile_s, cache=rung_cache)
         swept.append(p)
         if log:
             log.info("tune rung", batch=batch, rate=f"{rate:,.0f}/s",
-                     compile_s=f"{compile_s:.2f}")
+                     compile_s=f"{compile_s:.2f}", cache=rung_cache)
         improved = best is None or rate > best.rate_hs * (1.0 + improve_eps)
         if best is None or rate > best.rate_hs:
             best = p

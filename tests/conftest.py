@@ -30,6 +30,16 @@ if "DPRF_TUNE_DIR" not in os.environ:
     import tempfile as _tempfile
     os.environ["DPRF_TUNE_DIR"] = _tempfile.mkdtemp(prefix="dprf-tune-test-")
 
+# Hermetic persistent compile cache (ISSUE 3): CLI/bench paths call
+# compilecache.enable(), which would otherwise point jax's
+# compilation cache at the USER's ~/.cache/dprf/xla -- test-compiled
+# executables must never leak into (or warm-start from) real fleet
+# state.
+if "DPRF_COMPILE_CACHE_DIR" not in os.environ:
+    import tempfile as _tempfile
+    os.environ["DPRF_COMPILE_CACHE_DIR"] = _tempfile.mkdtemp(
+        prefix="dprf-xla-cache-test-")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

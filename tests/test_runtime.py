@@ -223,6 +223,64 @@ def test_dispatcher_poison_guard_counts_lease_expiry():
     assert d.done() and not d.exhausted()
 
 
+def test_dispatcher_retry_parked_requeues_with_fresh_budget():
+    """Satellite (ISSUE 3): the retry-parked admin op un-parks
+    poisoned units WITHOUT restarting the job -- attempt counts reset
+    (a requeued unit gets the full retry budget again), the parked
+    gauge drops to 0, and `done()` stops treating the ranges as
+    unreachable."""
+    from dprf_tpu.telemetry import MetricsRegistry
+
+    m = MetricsRegistry()
+    d = Dispatcher(keyspace=256, unit_size=128, registry=m,
+                   max_unit_retries=2)
+    poisoned = d.lease("w0")
+    d.fail(poisoned.unit_id)
+    d.fail(d.lease("w0").unit_id)       # 2nd failure parks it
+    u = d.lease("w1")                   # rest of the keyspace done
+    d.complete(u.unit_id)
+    assert d.parked_count() == 1 and d.done() and not d.exhausted()
+    assert m.gauge("dprf_units_parked").value() == 1
+
+    assert d.retry_parked() == 1
+    assert d.parked_count() == 0 and d.parked_indices() == 0
+    assert m.gauge("dprf_units_parked").value() == 0
+    assert not d.done()                 # the range is reachable again
+    # fresh budget: the requeued unit survives max_unit_retries - 1
+    # NEW failures before parking again (attempt count was reset)
+    again = d.lease("w2")
+    assert (again.start, again.end) == (poisoned.start, poisoned.end)
+    d.fail(again.unit_id)
+    assert d.parked_count() == 0        # 1 of 2: reissued, not parked
+    d.complete(d.lease("w2").unit_id)
+    assert d.exhausted()                # full honest coverage now
+    assert d.retry_parked() == 0        # idempotent when nothing parked
+    # the parking EVENT counter keeps history; reissue reason is logged
+    assert m.counter("dprf_units_poisoned_total").value() == 1
+    assert m.counter("dprf_units_reissued_total",
+                     labelnames=("reason",)).value(
+        reason="retry_parked") == 1
+
+
+def test_rpc_retry_parked_admin_op():
+    """The op reaches the dispatcher through CoordinatorState (what
+    `dprf retry-parked --connect` invokes server-side)."""
+    from dprf_tpu.runtime.rpc import CoordinatorState
+    from dprf_tpu.telemetry import MetricsRegistry
+
+    m = MetricsRegistry()
+    d = Dispatcher(keyspace=128, unit_size=128, registry=m,
+                   max_unit_retries=1)
+    state = CoordinatorState({"engine": "md5"}, d, n_targets=1,
+                             registry=m)
+    resp = state.op_lease({"worker_id": "w0"})
+    state.op_fail({"unit_id": resp["unit"]["id"]})   # parks (cap 1)
+    assert state.op_status({})["parked"] == 1
+    assert state.op_retry_parked({}) == {"ok": True, "retried": 1}
+    assert state.op_status({})["parked"] == 0
+    assert state.op_lease({"worker_id": "w1"})["unit"] is not None
+
+
 def test_dispatcher_retry_count_resets_nothing_on_success():
     """Retries are per-unit: one unit's failures must not park a
     DIFFERENT unit, and a unit that eventually completes clears its

@@ -180,6 +180,43 @@ def test_lookup_tuned_batch_env_validated(tmp_path, monkeypatch):
                                    device="cpu") is None
 
 
+def test_key_extras_fork_the_optimum(tmp_path, monkeypatch):
+    """Satellite (ISSUE 3): hit_capacity and rules-set cardinality are
+    key dimensions -- an entry tuned under one must never alias a
+    lookup under another."""
+    monkeypatch.setenv("DPRF_TUNE_DIR", str(tmp_path))
+    env = tune.env_fingerprint("md5", "cpu")
+    tune.default_cache().put(
+        tune.make_key("md5", attack="mask", device="cpu", hit_cap=64),
+        {"batch": 2048}, env)
+    assert tune.lookup_tuned_batch(
+        "md5", attack="mask", device="cpu",
+        extras={"hit_cap": 64}) == 2048
+    # a raised --hit-cap is a DIFFERENT optimum: must read as a miss
+    assert tune.lookup_tuned_batch(
+        "md5", attack="mask", device="cpu",
+        extras={"hit_cap": 1024}) is None
+    # wordlist entries fork on the rules-set cardinality
+    tune.default_cache().put(
+        tune.make_key("sha256", attack="wordlist", device="cpu",
+                      hit_cap=64, rules_n=64),
+        {"batch": 8192}, env)
+    assert tune.lookup_tuned_batch(
+        "sha256", attack="wordlist", device="cpu",
+        extras={"hit_cap": 64, "rules_n": 64}) == 8192
+    assert tune.lookup_tuned_batch(
+        "sha256", attack="wordlist", device="cpu",
+        extras={"hit_cap": 64, "rules_n": 77}) is None
+    # record_tuned_batch round-trips the same extras
+    from dprf_tpu.tune import TuneResult, record_tuned_batch
+    res = TuneResult(4096, 1e6, 0.5, [])
+    record_tuned_batch("md5", "mask", "cpu", res,
+                       extras={"hit_cap": 128})
+    assert tune.lookup_tuned_batch(
+        "md5", attack="mask", device="cpu",
+        extras={"hit_cap": 128}) == 4096
+
+
 def test_engine_rev_tracks_source_identity():
     assert tune.engine_rev("md5", "cpu") == tune.engine_rev("md5", "cpu")
     assert tune.engine_rev("md5", "cpu") != "unknown"
@@ -358,7 +395,9 @@ def test_cli_batch_auto_resumes_from_session_journal(tmp_path,
 
     monkeypatch.setenv("DPRF_TUNE_DIR", str(tmp_path / "cachedir"))
     env = tune.env_fingerprint("md5", "cpu")
-    key = tune.make_key("md5", attack="mask", device="cpu")
+    # the job-side key carries the hit_cap extra (ISSUE 3 satellite);
+    # the CLI's default --hit-cap is 64
+    key = tune.make_key("md5", attack="mask", device="cpu", hit_cap=64)
     tune.default_cache().put(key, {"batch": 512}, env)
 
     hashfile = tmp_path / "hashes.txt"

@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Summarize step-compile cost from telemetry JSONL snapshots.
+
+A fleet that looks stalled is often just compiling (the krb5aes smoke
+tier once spent ~9 minutes in XLA compiles); this tool makes that
+diagnosable from ARTIFACTS -- the ``*.telemetry.jsonl`` snapshots the
+runtime writes next to the session journal -- instead of someone
+eyeballing stdout.  It reads the LAST snapshot line (metrics are
+cumulative) and reports, per (engine, cache-hit/miss) label pair of
+``dprf_compile_seconds``:
+
+    count, p50, p95 (bucket-interpolated), mean, total seconds
+
+plus the persistent-compile-cache hit/miss counters, so "the fleet is
+cold-compiling shapes the image should have prewarmed" is one glance.
+
+Usage:
+    python tools/compile_report.py SESSION.telemetry.jsonl [...] [--json]
+
+Exit status: 0 with a report, 1 when no snapshot has compile metrics
+(still machine-distinguishable from a crash).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+
+def _load_last_snapshot(path: str):
+    """Last parseable snapshot line of a JSONL file (None when the
+    file is missing/empty/torn -- same tolerance as the runtime's
+    loader, without importing the package)."""
+    last = None
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(doc, dict) and "metrics" in doc:
+                    last = doc
+    except OSError:
+        return None
+    return last
+
+
+def _percentile(buckets: dict, total: int, q: float) -> float:
+    """Bucket-interpolated percentile.  `buckets` maps upper-bound
+    strings (plus "+Inf") to per-bucket counts; observations inside a
+    bucket are assumed uniform.  The +Inf bucket reports the largest
+    finite bound (a floor -- honest, since the true value is off the
+    histogram's scale)."""
+    bounds = []
+    for k, c in buckets.items():
+        ub = math.inf if k == "+Inf" else float(k)
+        bounds.append((ub, int(c)))
+    bounds.sort(key=lambda t: t[0])
+    want = q * total
+    cum = 0.0
+    lo = 0.0
+    largest_finite = max((b for b, _ in bounds if b != math.inf),
+                        default=0.0)
+    for ub, count in bounds:
+        if count <= 0:
+            lo = ub if ub != math.inf else lo
+            continue
+        if cum + count >= want:
+            if ub == math.inf:
+                return largest_finite
+            frac = (want - cum) / count
+            return lo + frac * (ub - lo)
+        cum += count
+        lo = ub
+    return largest_finite
+
+
+def _metric_values(snapshot: dict, name: str) -> list:
+    m = snapshot.get("metrics", {}).get(name)
+    if not isinstance(m, dict):
+        return []
+    vals = m.get("values")
+    return vals if isinstance(vals, list) else []
+
+
+def summarize(snapshot: dict) -> dict:
+    """The report document for one snapshot line."""
+    rows = []
+    for v in _metric_values(snapshot, "dprf_compile_seconds"):
+        count = int(v.get("count", 0))
+        if count <= 0:
+            continue
+        labels = v.get("labels", {})
+        buckets = v.get("buckets", {})
+        total_s = float(v.get("sum", 0.0))
+        rows.append({
+            "engine": labels.get("engine", "?"),
+            # pre-ISSUE-3 snapshots have no cache label; report "n/a"
+            # rather than guessing
+            "cache": labels.get("cache", "n/a"),
+            "count": count,
+            "p50_s": round(_percentile(buckets, count, 0.50), 3),
+            "p95_s": round(_percentile(buckets, count, 0.95), 3),
+            "mean_s": round(total_s / count, 3),
+            "total_s": round(total_s, 3),
+        })
+    rows.sort(key=lambda r: (-r["total_s"], r["engine"], r["cache"]))
+    counters = {"hits": 0, "misses": 0}
+    for name, key in (("dprf_compile_cache_hits_total", "hits"),
+                      ("dprf_compile_cache_misses_total", "misses")):
+        for v in _metric_values(snapshot, name):
+            counters[key] += int(v.get("value", 0))
+    return {"ts": snapshot.get("ts"),
+            "elapsed_s": snapshot.get("elapsed_s"),
+            "compiles": rows,
+            "cache_hits": counters["hits"],
+            "cache_misses": counters["misses"]}
+
+
+def render(report: dict, source: str) -> str:
+    rows = [("engine", "cache", "count", "p50_s", "p95_s", "mean_s",
+             "total_s")]
+    for r in report["compiles"]:
+        rows.append((r["engine"], r["cache"], str(r["count"]),
+                     f"{r['p50_s']:.2f}", f"{r['p95_s']:.2f}",
+                     f"{r['mean_s']:.2f}", f"{r['total_s']:.2f}"))
+    widths = [max(len(row[i]) for row in rows)
+              for i in range(len(rows[0]))]
+    lines = [f"compile report: {source} "
+             f"(snapshot at elapsed {report.get('elapsed_s')}s)"]
+    lines += ["  ".join(c.ljust(w) for c, w in zip(row, widths))
+              for row in rows]
+    h, m = report["cache_hits"], report["cache_misses"]
+    ratio = f"{100.0 * h / (h + m):.0f}%" if h + m else "n/a"
+    lines.append(f"persistent compile cache: {h} hits / {m} misses "
+                 f"(hit ratio {ratio})")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="summarize dprf_compile_seconds from telemetry "
+        "JSONL snapshots")
+    p.add_argument("snapshots", nargs="+",
+                   help="*.telemetry.jsonl files (session journal "
+                   "siblings)")
+    p.add_argument("--json", action="store_true",
+                   help="machine output: one JSON document per file")
+    args = p.parse_args(argv)
+
+    any_data = False
+    out_docs = []
+    for path in args.snapshots:
+        snap = _load_last_snapshot(path)
+        if snap is None:
+            print(f"compile report: {path}: no parseable snapshots",
+                  file=sys.stderr)
+            out_docs.append({"source": path, "error": "no snapshots"})
+            continue
+        report = summarize(snap)
+        if report["compiles"] or report["cache_hits"] \
+                or report["cache_misses"]:
+            any_data = True
+        out_docs.append({"source": os.path.basename(path), **report})
+        if not args.json:
+            print(render(report, path))
+    if args.json:
+        print(json.dumps(out_docs if len(out_docs) > 1 else out_docs[0]))
+    return 0 if any_data else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
