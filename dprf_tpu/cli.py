@@ -8,7 +8,8 @@ ran against the reference's CPU engines select the TPU backend with
 Subcommands: crack (local job), serve + worker (distributed job:
 coordinator RPC + remote workers, runtime/rpc.py), bench, prewarm
 (ahead-of-time compile-cache population), retry-parked (admin op on a
-running coordinator), engines, keyspace.
+running coordinator), top (live fleet view from the flight recorder),
+trace export (session span stream -> Perfetto), engines, keyspace.
 """
 
 from __future__ import annotations
@@ -264,6 +265,43 @@ def _build_parser() -> argparse.ArgumentParser:
         v.add_argument("--engine", "-m", required=True)
         v.add_argument("--potfile", default="dprf.potfile")
         v.add_argument("--quiet", "-q", action="store_true")
+
+    tp = sub.add_parser("top", help="live terminal view of a running "
+                        "coordinator: per-worker state, current unit, "
+                        "span in progress, lease countdown (reads the "
+                        "flight recorder over the op_trace_tail RPC)")
+    tp.add_argument("--connect", required=True, metavar="HOST:PORT",
+                    help="the coordinator's RPC address (`dprf serve "
+                    "--bind`)")
+    tp.add_argument("--interval", type=float, default=2.0, metavar="S",
+                    help="seconds between refreshes")
+    tp.add_argument("--iterations", type=int, default=0, metavar="N",
+                    help="stop after N frames (0 = until the job "
+                    "finishes / Ctrl-C)")
+    tp.add_argument("--spans", type=int, default=400, metavar="N",
+                    help="flight-recorder spans to fetch per frame")
+    tp.add_argument("--no-clear", action="store_true",
+                    help="append frames instead of redrawing the "
+                    "screen")
+    tp.add_argument("--token", default=None,
+                    help="shared secret for an authenticated "
+                    "coordinator (default: $DPRF_TOKEN)")
+    tp.add_argument("--timeout", type=float, default=30.0)
+    tp.add_argument("--quiet", "-q", action="store_true")
+
+    tr = sub.add_parser("trace", help="work with session trace streams "
+                        "(the per-unit lifecycle spans recorded next "
+                        "to the session journal)")
+    trsub = tr.add_subparsers(dest="trace_cmd", required=True)
+    te = trsub.add_parser("export", help="convert a session's span "
+                          "stream to Chrome-trace JSON (open in "
+                          "Perfetto / chrome://tracing)")
+    te.add_argument("session", help="session journal path (or the "
+                    ".trace.jsonl stream itself)")
+    te.add_argument("-o", "--out", default=None,
+                    help="output file (default: <session>"
+                    ".perfetto.json)")
+    te.add_argument("--quiet", "-q", action="store_true")
 
     mt = sub.add_parser("metrics", help="scrape a running coordinator's "
                         "/metrics endpoint (Prometheus text format)")
@@ -792,6 +830,7 @@ def _crack_increment(args, device: str, log: Log) -> int:
 def _crack_single(args, device: str, log: Log):
     """One crack job; returns (rc, JobResult | None, n_targets)."""
     from dprf_tpu import compilecache
+    from dprf_tpu.telemetry.trace import get_tracer
     compilecache.enable(log=log)
     job = _setup_job(args, device, log)
     if job is None:
@@ -799,6 +838,11 @@ def _crack_single(args, device: str, log: Log):
     engine, hl, gen = job.engine, job.hl, job.gen
     session, restored_hits = job.session, job.restored_hits
     dispatcher, spec = job.dispatcher, job.spec
+    tracer = get_tracer()
+    if session is not None:
+        # flight-recorder stream next to the journal (attached BEFORE
+        # the worker builds, so warmup-era spans land in the file too)
+        tracer.attach_file(session.trace_path)
 
     batch, _ = _resolve_batch(args.batch, args.engine, device,
                               args.attack, log, session=session,
@@ -862,6 +906,10 @@ def _crack_single(args, device: str, log: Log):
             snap.stop()
             log.info("telemetry snapshots written",
                      path=session.telemetry_path)
+        if session is not None:
+            tracer.detach_file()
+            log.info("trace spans written (export with `dprf trace "
+                     "export`)", path=session.trace_path)
 
     _print_results(result.found, hl.targets)
     if result.parked:
@@ -937,13 +985,19 @@ def cmd_serve(args, log: Log) -> int:
         return False
 
     import os as _os
+
+    from dprf_tpu.telemetry.trace import get_tracer
     token = args.token or _os.environ.get("DPRF_TOKEN") or None
     state = CoordinatorState(job, dispatcher, len(hl.targets),
                              verifier=verify_hit, token=token)
+    tracer = get_tracer()
     if token:
         log.info("worker authentication enabled")
     if session is not None:
         session.open(spec.as_dict())
+        # stream the fleet's lifecycle spans (incl. the ones remote
+        # workers ship back) next to the journal for dprf trace export
+        tracer.attach_file(session.trace_path)
 
     def on_hit(ti, cand, plain):
         log.info("cracked", target=hl.targets[ti].raw[:32], lane=cand)
@@ -990,6 +1044,9 @@ def cmd_serve(args, log: Log) -> int:
             log.info("telemetry snapshots written",
                      path=session.telemetry_path)
         if session is not None:
+            tracer.detach_file()
+            log.info("trace spans written (export with `dprf trace "
+                     "export`)", path=session.trace_path)
             session.snapshot(dispatcher.completed_intervals())
             session.close()
     _print_results(state.found, hl.targets)
@@ -1253,6 +1310,90 @@ def cmd_retry_parked(args, log: Log) -> int:
     return 0
 
 
+def cmd_top(args, log: Log) -> int:
+    """Live fleet view (`dprf top --connect host:port`): renders the
+    coordinator's flight recorder + lease table every --interval
+    seconds -- per-worker state, current unit, lease deadline
+    countdown, and recent lifecycle spans."""
+    import time as _time
+
+    from dprf_tpu.runtime.rpc import CoordinatorClient
+    from dprf_tpu.telemetry.trace import render_top
+
+    host, port = _parse_hostport(args.connect)
+    token = args.token or os.environ.get("DPRF_TOKEN") or None
+    client = CoordinatorClient(host, port, timeout=args.timeout,
+                               token=token)
+    try:
+        if token:
+            client.hello()     # answer the auth challenge first
+        prev = None
+        frames = 0
+        while True:
+            resp = client.call("trace_tail", n=args.spans)
+            text = render_top(resp, prev)
+            if not args.no_clear and sys.stdout.isatty():
+                sys.stdout.write("\x1b[H\x1b[2J")
+            print(text)
+            sys.stdout.flush()
+            prev = (_time.monotonic(), resp.get("status") or {})
+            frames += 1
+            if args.iterations and frames >= args.iterations:
+                break
+            if (resp.get("status") or {}).get("stop"):
+                log.info("job finished")
+                break
+            _time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        client.close()
+    return 0
+
+
+def cmd_trace(args, log: Log) -> int:
+    """`dprf trace export SESSION`: session span stream -> Chrome-trace
+    JSON (Perfetto-loadable), plus a lifecycle summary -- how many unit
+    traces, reissues, orphan spans (there should be none), and
+    incomplete lifecycles."""
+    import json as _json
+
+    from dprf_tpu.telemetry import trace as trace_mod
+
+    path = trace_mod.trace_path(args.session)
+    spans = trace_mod.load_trace(path)
+    if not spans:
+        log.error("no spans found (did the job run with --session?)",
+                  path=path)
+        return 2
+    doc = trace_mod.export_chrome_trace(spans)
+    base = (args.session[:-len(trace_mod.TRACE_SUFFIX)]
+            if args.session.endswith(trace_mod.TRACE_SUFFIX)
+            else args.session)
+    out = args.out or base + ".perfetto.json"
+    with open(out, "w", encoding="utf-8") as fh:
+        _json.dump(doc, fh)
+    report = trace_mod.lifecycle_report(spans)
+    reissued = sum(1 for d in report["details"].values()
+                   if d["reissues"])
+    log.info("trace exported", out=out, spans=report["spans"],
+             traces=report["traces"], reissued_units=reissued,
+             orphans=report["orphans"],
+             incomplete=len(report["incomplete"]))
+    if report["orphans"]:
+        log.warn("orphan spans present: a parent link crossed the RPC "
+                 "boundary without its context (bug?)")
+    print(_json.dumps({
+        "out": out,
+        "spans": report["spans"],
+        "traces": report["traces"],
+        "reissued_units": reissued,
+        "orphans": report["orphans"],
+        "incomplete": len(report["incomplete"]),
+    }))
+    return 0
+
+
 def cmd_metrics(args, log: Log) -> int:
     """Scrape a running coordinator: plain HTTP GET on the RPC port
     (no client library; works for curl/Prometheus too).  --json asks
@@ -1406,6 +1547,8 @@ _COMMANDS = {
     "tune": cmd_tune,
     "prewarm": cmd_prewarm,
     "retry-parked": cmd_retry_parked,
+    "top": cmd_top,
+    "trace": cmd_trace,
     "metrics": cmd_metrics,
     "show": cmd_show,
     "left": cmd_left,

@@ -20,6 +20,7 @@ from dprf_tpu.runtime.potfile import Potfile
 from dprf_tpu.runtime.session import SessionJournal
 from dprf_tpu.runtime.worker import Hit
 from dprf_tpu.telemetry import get_registry
+from dprf_tpu.telemetry.trace import get_tracer, jax_profile_ctx
 
 
 @dataclasses.dataclass
@@ -80,7 +81,7 @@ class Coordinator:
                  potfile: Optional[Potfile] = None,
                  progress_cb: Optional[Callable] = None,
                  progress_interval: float = 5.0,
-                 oracle=None, registry=None):
+                 oracle=None, registry=None, recorder=None):
         self.spec = spec
         self.targets = list(targets)
         self.dispatcher = dispatcher
@@ -98,6 +99,10 @@ class Coordinator:
         self.oracle = oracle
         self.rejected = 0
         self.found: dict[int, bytes] = {}
+        #: flight recorder for the local job's sweep/hit_verify spans
+        #: (the dispatcher records the lease ledger's into the same
+        #: one by default)
+        self.tracer = get_tracer(recorder)
         from dprf_tpu.telemetry import declare_job_metrics
         jm = declare_job_metrics(get_registry(registry))
         self._m_hits = jm["hits"]
@@ -193,6 +198,12 @@ class Coordinator:
         # already dispatched; resolving the head overlaps its readback
         # latency with the tail's compute.
         pending: list = []
+        warm_pending = ensure_warm is not None
+        # DPRF_JAX_PROFILE=<dir>: kernel-level drill-down beside the
+        # span timeline (no-op when unset; degrades safely if a
+        # profiler trace is already active via --profile)
+        profile = jax_profile_ctx()
+        profile.__enter__()
         try:
             while not self._all_found():
                 while (len(pending) < self.PIPELINE_DEPTH
@@ -205,6 +216,23 @@ class Coordinator:
                         # step dispatch (submitting mid-compile would
                         # race the jit tracer against itself)
                         ensure_warm()
+                    if warm_pending:
+                        # trace the overlapped compile at its REAL cost
+                        # (compile_seconds), parented onto the first
+                        # lease so the cold start is legible per unit
+                        warm_pending = False
+                        warm_s = getattr(self.worker, "compile_seconds",
+                                         None)
+                        ctx = self.dispatcher.trace_context(unit.unit_id)
+                        if warm_s is not None:
+                            self.tracer.record(
+                                "warmup", dur=float(warm_s),
+                                trace=ctx[0] if ctx else None,
+                                parent=ctx[1] if ctx else None,
+                                proc="local", engine=self.spec.engine,
+                                cache=getattr(self.worker,
+                                              "compile_cache", None),
+                                overlapped=True)
                     pending.append((unit, submit_or_process(self.worker,
                                                             unit),
                                     time.monotonic()))
@@ -215,8 +243,27 @@ class Coordinator:
                     time.sleep(0.01)
                     continue
                 unit, p, t_submit = pending.pop(0)
-                self._finish_unit(unit, p.resolve())
+                ctx = self.dispatcher.trace_context(unit.unit_id)
+                hits = p.resolve()
                 unit_s = time.monotonic() - t_submit
+                self.tracer.record(
+                    "sweep", dur=unit_s,
+                    trace=ctx[0] if ctx else None,
+                    parent=ctx[1] if ctx else None, proc="local",
+                    unit=unit.unit_id, length=unit.length,
+                    hits=len(hits))
+                if hits:
+                    t_verify = time.monotonic()
+                    rejected0 = self.rejected
+                    self._finish_unit(unit, hits)
+                    self.tracer.record(
+                        "hit_verify",
+                        dur=time.monotonic() - t_verify,
+                        trace=ctx[0] if ctx else None,
+                        parent=ctx[1] if ctx else None,
+                        proc="coordinator", unit=unit.unit_id,
+                        hits=len(hits),
+                        rejected=self.rejected - rejected0)
                 self._h_unit.observe(unit_s)
                 self._m_cands.inc(unit.length, engine=self.spec.engine,
                                   device=self.spec.device)
@@ -236,6 +283,7 @@ class Coordinator:
                     self.progress_cb(done, total, len(self.found),
                                      (done - tested0) / max(now - t0, 1e-9))
         finally:
+            profile.__exit__(None, None, None)
             # Snapshot in finally: a Ctrl-C mid-job must not lose up to
             # snapshot_every-1 units of journaled coverage.
             if self.session is not None:

@@ -16,7 +16,10 @@ Two tuning hooks (ISSUE 2):
 
   - an optional AdaptiveUnitSizer resizes LAZILY-GENERATED units per
     leasing worker (already-split units -- resume gaps, reissues --
-    keep their geometry; resizing them would tear the ledger);
+    keep their geometry; resizing them would tear the ledger); the
+    dispatcher also reports every failed attempt / lease expiry to it,
+    so a worker with a CRASH HISTORY gets smaller units, not just a
+    slow one (ISSUE 4 satellite of a ROADMAP item);
   - a per-unit retry cap (default 5 failed attempts) PARKS a unit that
     keeps dying instead of reissuing it forever: a unit that crashes
     every worker that touches it (a generator edge case, a poisoned
@@ -24,6 +27,12 @@ Two tuning hooks (ISSUE 2):
     unreachable -- `done()` fires once everything else is covered --
     and surface in job status + dprf_units_poisoned_total, never as
     silent coverage.
+
+Tracing (ISSUE 4): every unit gets a TRACE ID at split time; lease /
+complete / fail / reissue / park events are recorded as spans into the
+flight recorder (telemetry/trace.py), and `trace_context()` hands the
+RPC layer the (trace id, lease span id) pair it propagates to remote
+workers so their spans stitch onto the same timeline.
 """
 
 from __future__ import annotations
@@ -34,6 +43,7 @@ from typing import Callable, Optional
 
 from dprf_tpu.runtime.workunit import WorkUnit
 from dprf_tpu.telemetry import get_registry
+from dprf_tpu.telemetry.trace import get_tracer, new_trace_id, span_id
 
 
 class IntervalSet:
@@ -103,7 +113,8 @@ class Dispatcher:
                  lease_timeout: float = 300.0,
                  clock: Optional[Callable[[], float]] = None,
                  registry=None, sizer=None,
-                 max_unit_retries: Optional[int] = 5):
+                 max_unit_retries: Optional[int] = 5,
+                 recorder=None):
         if unit_size <= 0:
             raise ValueError("unit_size must be positive")
         self.keyspace = keyspace
@@ -119,11 +130,16 @@ class Dispatcher:
         self._next_start = 0
         self._next_id = 0
         self._pending: deque[WorkUnit] = deque()
-        self._outstanding: dict[int, tuple] = {}   # id -> (unit, worker, deadline)
+        #: id -> (unit, worker, deadline, lease span id)
+        self._outstanding: dict[int, tuple] = {}
         self._retries: dict[int, int] = {}         # id -> failed attempts
         self._parked: list[WorkUnit] = []
         self._parked_len = 0
         self._done = IntervalSet()
+        self.tracer = get_tracer(recorder)
+        #: unit id -> trace id, assigned at split time; entries are
+        #: dropped on complete (bounded by live + parked units)
+        self._trace_ids: dict[int, str] = {}
         m = get_registry(registry)
         self._m_leased = m.counter(
             "dprf_units_leased_total", "WorkUnit leases handed out")
@@ -169,7 +185,19 @@ class Dispatcher:
     def _make_unit(self, start: int, length: int) -> WorkUnit:
         u = WorkUnit(self._next_id, start, length)
         self._next_id += 1
+        # the unit's whole lifecycle -- every lease, failure, reissue,
+        # wherever it lands -- shares this one trace id
+        self._trace_ids[u.unit_id] = new_trace_id()
         return u
+
+    def trace_context(self, unit_id: int) -> Optional[tuple]:
+        """(trace id, lease span id) of the unit's CURRENT lease --
+        what the RPC layer ships to the worker so its spans stitch
+        onto this attempt; None once the unit is no longer leased."""
+        entry = self._outstanding.get(unit_id)
+        if entry is None:
+            return None
+        return self._trace_ids.get(unit_id), entry[3]
 
     # -- the worker-facing API -------------------------------------------
 
@@ -187,8 +215,15 @@ class Dispatcher:
             self._next_start += length
         else:
             return None
+        lease_span = self.tracer.record(
+            "lease", trace=self._trace_ids.get(unit.unit_id),
+            proc="coordinator", worker=worker_id, unit=unit.unit_id,
+            start=unit.start, length=unit.length,
+            lease_timeout_s=self.lease_timeout,
+            attempt=self._retries.get(unit.unit_id, 0) + 1)
         self._outstanding[unit.unit_id] = (
-            unit, worker_id, self._clock() + self.lease_timeout)
+            unit, worker_id, self._clock() + self.lease_timeout,
+            span_id(lease_span))
         self._m_leased.inc()
         self._g_outstanding.set(len(self._outstanding))
         return unit
@@ -198,18 +233,35 @@ class Dispatcher:
         entry = self._outstanding.pop(unit_id, None)
         if entry is None:
             return   # late completion of an already-reissued unit: idempotent
-        unit, worker_id, _ = entry
+        unit, worker_id, _, lease_sid = entry
         self._done.add(unit.start, unit.end)
         self._retries.pop(unit_id, None)
         if self.sizer is not None and elapsed is not None:
             # throughput report feeds the ADAPTIVE sizer: the next unit
             # this worker leases is sized toward the target seconds
             self.sizer.observe(worker_id, unit.length, elapsed)
+        self.tracer.record(
+            "complete", trace=self._trace_ids.pop(unit_id, None),
+            parent=lease_sid, proc="coordinator", worker=worker_id,
+            unit=unit_id, elapsed_s=elapsed)
         self._m_completed.inc()
         self._g_covered.set(self._done.covered())
         self._g_outstanding.set(len(self._outstanding))
 
-    def _requeue(self, unit: WorkUnit, reason: str) -> None:
+    def _observe_failure(self, worker_id: Optional[str]) -> None:
+        """Crash history -> unit sizing: every failed attempt / lease
+        expiry shrinks the worker's NEXT units (tune.AdaptiveUnitSizer
+        halves per recent failure), so a flaky host re-runs minutes of
+        work when it dies, not hours -- low throughput alone would
+        never catch a worker that is fast but keeps crashing."""
+        if self.sizer is not None and worker_id is not None:
+            observe = getattr(self.sizer, "observe_failure", None)
+            if observe is not None:
+                observe(worker_id)
+
+    def _requeue(self, unit: WorkUnit, reason: str,
+                 worker_id: Optional[str] = None,
+                 lease_sid: Optional[str] = None) -> None:
         """Reissue a failed/expired unit -- unless it has burned its
         retry budget, in which case it is PARKED: its range becomes
         unreachable for this run (visible in status and the poisoned
@@ -217,32 +269,50 @@ class Dispatcher:
         between workers forever."""
         n = self._retries.get(unit.unit_id, 0) + 1
         self._retries[unit.unit_id] = n
+        self._observe_failure(worker_id)
+        tid = self._trace_ids.get(unit.unit_id)
         if (self.max_unit_retries is not None
                 and n >= self.max_unit_retries):
             self._parked.append(unit)
             self._parked_len += unit.length
             self._m_poisoned.inc()
             self._g_parked.set(len(self._parked))
+            self.tracer.record("park", trace=tid, parent=lease_sid,
+                               proc="coordinator", unit=unit.unit_id,
+                               worker=worker_id, attempts=n,
+                               reason=reason)
             from dprf_tpu.utils.logging import DEFAULT as log
             log.warn("parking poisoned unit after repeated failures",
                      unit=unit.unit_id, start=unit.start,
                      length=unit.length, attempts=n, reason=reason)
         else:
             self._pending.append(unit)
+            self.tracer.record("reissue", trace=tid, parent=lease_sid,
+                               proc="coordinator", unit=unit.unit_id,
+                               worker=worker_id, attempts=n,
+                               reason=reason)
             self._m_reissued.inc(reason=reason)
 
     def fail(self, unit_id: int) -> None:
         entry = self._outstanding.pop(unit_id, None)
         if entry is not None:
-            self._requeue(entry[0], "failed")
+            unit, worker_id, _, lease_sid = entry
+            self.tracer.record("fail",
+                               trace=self._trace_ids.get(unit_id),
+                               parent=lease_sid, proc="coordinator",
+                               worker=worker_id, unit=unit_id)
+            self._requeue(unit, "failed", worker_id=worker_id,
+                          lease_sid=lease_sid)
             self._g_outstanding.set(len(self._outstanding))
 
     def reap_expired(self) -> int:
         now = self._clock()
-        expired = [uid for uid, (_, _, dl) in self._outstanding.items()
+        expired = [uid for uid, (_, _, dl, _) in self._outstanding.items()
                    if dl < now]
         for uid in expired:
-            self._requeue(self._outstanding.pop(uid)[0], "lease_expired")
+            unit, worker_id, _, lease_sid = self._outstanding.pop(uid)
+            self._requeue(unit, "lease_expired", worker_id=worker_id,
+                          lease_sid=lease_sid)
         if expired:
             self._g_outstanding.set(len(self._outstanding))
         return len(expired)
@@ -300,6 +370,10 @@ class Dispatcher:
         for unit in self._parked:
             self._retries.pop(unit.unit_id, None)
             self._pending.append(unit)
+            self.tracer.record("reissue",
+                               trace=self._trace_ids.get(unit.unit_id),
+                               proc="coordinator", unit=unit.unit_id,
+                               reason="retry_parked")
             self._m_reissued.inc(reason="retry_parked")
         self._parked = []
         self._parked_len = 0
@@ -316,3 +390,14 @@ class Dispatcher:
         report's candidate count without re-deriving unit geometry."""
         entry = self._outstanding.get(unit_id)
         return entry[0] if entry is not None else None
+
+    def outstanding_leases(self) -> list:
+        """Live-lease table for the ``dprf top`` view: every held
+        lease with its worker, range, seconds until expiry, and trace
+        id."""
+        now = self._clock()
+        return [{"unit": uid, "worker": wid, "start": u.start,
+                 "length": u.length,
+                 "deadline_s": round(dl - now, 3),
+                 "trace": self._trace_ids.get(uid)}
+                for uid, (u, wid, dl, _) in self._outstanding.items()]

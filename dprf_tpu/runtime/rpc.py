@@ -46,7 +46,8 @@ from typing import Callable, Optional
 from dprf_tpu.runtime.dispatcher import Dispatcher
 from dprf_tpu.runtime.worker import Hit
 from dprf_tpu.runtime.workunit import WorkUnit
-from dprf_tpu.telemetry import get_registry
+from dprf_tpu.telemetry import declare_job_metrics, get_registry
+from dprf_tpu.telemetry.trace import get_tracer, jax_profile_ctx
 
 MAX_LINE = 64 << 20   # hashlists can be large; candidates never cross
 
@@ -86,7 +87,8 @@ class CoordinatorState:
                  on_hit: Optional[Callable] = None,
                  on_progress: Optional[Callable] = None,
                  verifier: Optional[Callable] = None,
-                 token: Optional[str] = None, registry=None):
+                 token: Optional[str] = None, registry=None,
+                 recorder=None):
         self.job = job                    # serializable job description
         self.dispatcher = dispatcher
         self.n_targets = n_targets
@@ -112,8 +114,11 @@ class CoordinatorState:
         #: the registry the RPC port's /metrics endpoint serves; the
         #: Dispatcher publishes unit/keyspace metrics into the same one
         self.registry = get_registry(registry)
+        #: the flight recorder op_trace_tail serves; should be the
+        #: SAME one the Dispatcher records into so the timeline is
+        #: whole (both default to the process-wide recorder)
+        self.tracer = get_tracer(recorder)
         m = self.registry
-        from dprf_tpu.telemetry import declare_job_metrics
         jm = declare_job_metrics(m)
         self._m_hits = jm["hits"]
         self._m_rejects = jm["rejects"]
@@ -191,8 +196,15 @@ class CoordinatorState:
             # registry (holding a lease bounds the id set by the unit
             # ledger)
             self._touch_worker(wid)
-            return {"unit": {"id": unit.unit_id, "start": unit.start,
+            resp = {"unit": {"id": unit.unit_id, "start": unit.start,
                              "length": unit.length}}
+            # trace context OUT: the worker parents its rpc/warmup/
+            # sweep spans onto this lease, so the spans it ships back
+            # with complete/fail stitch onto the coordinator timeline
+            ctx = self.dispatcher.trace_context(unit.unit_id)
+            if ctx is not None:
+                resp["trace"] = {"trace": ctx[0], "span": ctx[1]}
+            return resp
 
     def op_complete(self, msg: dict) -> dict:
         unit_id = int(msg["unit_id"])
@@ -210,6 +222,14 @@ class CoordinatorState:
         # worker a coordinator-wide DoS).
         with self.lock:
             already = set(self.found)
+            # trace context of the attempt, read BEFORE complete/fail
+            # pops the lease; remote spans + the hit_verify span below
+            # parent onto it
+            ctx = self.dispatcher.trace_context(unit_id)
+        self.tracer.ingest(msg.get("spans"),
+                           proc=str(msg.get("worker_id", "?")),
+                           sent_at=msg.get("clock"))
+        t_verify = time.monotonic()
         verified = []
         rejected = 0
         for h in hits:
@@ -221,6 +241,12 @@ class CoordinatorState:
                 rejected += 1
                 continue
             verified.append((ti, int(h["cand"]), plain))
+        if hits:
+            self.tracer.record(
+                "hit_verify", dur=time.monotonic() - t_verify,
+                trace=ctx[0] if ctx else None,
+                parent=ctx[1] if ctx else None, proc="coordinator",
+                unit=unit_id, hits=len(hits), rejected=rejected)
         with self.lock:
             for ti, cand, plain in verified:
                 if ti in self.found:
@@ -285,9 +311,45 @@ class CoordinatorState:
             return {"ok": rejected == 0, "stop": self._stopped()}
 
     def op_fail(self, msg: dict) -> dict:
+        # the failing worker's spans (rpc, the aborted sweep) still
+        # join the timeline -- exactly the attempts an operator wants
+        # to see when a unit bounced between workers
+        self.tracer.ingest(msg.get("spans"),
+                           proc=str(msg.get("worker_id", "?")),
+                           sent_at=msg.get("clock"))
         with self.lock:
             self.dispatcher.fail(int(msg["unit_id"]))
         return {"ok": True}
+
+    def op_trace_tail(self, msg: dict) -> dict:
+        """Flight-recorder read for ``dprf top``: the most recent
+        spans plus the live lease table and job status -- everything a
+        terminal view needs to show per-worker state, current unit,
+        span in progress, and lease countdown."""
+        try:
+            n = int(msg.get("n", 200))
+        except (TypeError, ValueError):
+            n = 200
+        trace = msg.get("trace")
+        spans = self.tracer.tail(max(1, min(n, 2000)),
+                                 trace=trace if isinstance(trace, str)
+                                 else None)
+        with self.lock:
+            done, total = self.dispatcher.progress()
+            leases = self.dispatcher.outstanding_leases()
+            status = {"done": done, "total": total,
+                      "found": len(self.found),
+                      "targets": self.n_targets,
+                      "parked": self.dispatcher.parked_count(),
+                      "stop": self._stopped(),
+                      "elapsed": time.perf_counter() - self.t0,
+                      # the clock span timestamps live in: span ages
+                      # must be computed against THIS, not the
+                      # viewer's possibly-skewed wall clock
+                      "now": time.time(),
+                      "quarantined": sorted(self.quarantined)}
+        return {"ok": True, "spans": spans, "leases": leases,
+                "status": status}
 
     def op_retry_parked(self, msg: dict) -> dict:
         """Admin op (`dprf retry-parked --connect`): requeue poisoned/
@@ -570,77 +632,140 @@ class CoordinatorClient:
 
 
 def worker_loop(client: CoordinatorClient, worker, worker_id: str,
-                idle_sleep: float = 0.5, log=None, registry=None) -> int:
+                idle_sleep: float = 0.5, log=None, registry=None,
+                recorder=None) -> int:
     """Lease -> process -> complete until the coordinator says stop.
 
     worker: any object with .process(WorkUnit) -> list[Hit] (the same
     duck type the local Coordinator drives).  Returns units completed.
+
+    Tracing: the lease response's trace context parents this worker's
+    ``rpc`` / ``warmup`` / ``sweep`` spans, which ship back inside the
+    complete (or fail) message -- the coordinator's flight recorder
+    then holds the unit's WHOLE lifecycle across every host that
+    touched it.  ``DPRF_JAX_PROFILE=<dir>`` additionally wraps the
+    loop in a jax.profiler trace.
     """
     m = get_registry(registry)
+    tracer = get_tracer(recorder)
     # worker-side publication: candidates are counted where the hashing
-    # happens (the local Coordinator does the same for in-process jobs)
+    # happens (the local Coordinator does the same for in-process
+    # jobs); declared through declare_job_metrics -- the ONE
+    # declaration site (tools/check_metrics.py) -- so names and labels
+    # can never drift from the coordinator's
+    jm = declare_job_metrics(m)
     eng_name = getattr(getattr(worker, "engine", None), "name", "unknown")
     device = "cpu" if type(worker).__name__ == "CpuWorker" else "jax"
-    m_cands = m.counter("dprf_candidates_hashed_total",
-                        "keyspace indices swept",
-                        labelnames=("engine", "device"))
-    h_unit = m.histogram("dprf_unit_seconds",
-                         "submit-to-resolve latency of one WorkUnit")
+    m_cands = jm["cands"]
+    h_unit = jm["unit_seconds"]
     done_units = 0
-    while True:
-        try:
-            resp = client.call("lease", worker_id=worker_id)
-        except ConnectionError:
-            # The coordinator serves through its drain window and
-            # answers every lease poll with an explicit stop flag once
-            # the job is over, so a worker always learns completion
-            # in-band and returns below.  A bare connection drop here
-            # therefore means the coordinator crashed mid-job: surface
-            # it so scripted workers don't report success on unfinished
-            # work (a clean rc used to hide exactly that).
-            raise ConnectionError(
-                "coordinator connection dropped before any stop signal "
-                "(coordinator crash mid-job?)")
-        if resp.get("quarantined"):
-            raise RpcError(
-                "coordinator quarantined this worker: its reported hits "
-                "repeatedly failed oracle verification (divergent device "
-                "path?)")
-        unit_d = resp.get("unit")
-        if unit_d is None:
+    warm_pending = getattr(worker, "ensure_warm", None) is not None
+    with jax_profile_ctx(log=log):
+        while True:
+            t_lease = time.monotonic()
+            try:
+                resp = client.call("lease", worker_id=worker_id)
+            except ConnectionError:
+                # The coordinator serves through its drain window and
+                # answers every lease poll with an explicit stop flag
+                # once the job is over, so a worker always learns
+                # completion in-band and returns below.  A bare
+                # connection drop here therefore means the coordinator
+                # crashed mid-job: surface it so scripted workers don't
+                # report success on unfinished work (a clean rc used to
+                # hide exactly that).
+                raise ConnectionError(
+                    "coordinator connection dropped before any stop "
+                    "signal (coordinator crash mid-job?)")
+            if resp.get("quarantined"):
+                raise RpcError(
+                    "coordinator quarantined this worker: its reported "
+                    "hits repeatedly failed oracle verification "
+                    "(divergent device path?)")
+            unit_d = resp.get("unit")
+            if unit_d is None:
+                if resp.get("stop"):
+                    return done_units
+                time.sleep(idle_sleep)
+                continue
+            unit = WorkUnit(unit_d["id"], unit_d["start"],
+                            unit_d["length"])
+            ctx = resp.get("trace") or {}
+            tid, lease_sid = ctx.get("trace"), ctx.get("span")
+            ship = []
+            ev = tracer.record("rpc", dur=time.monotonic() - t_lease,
+                               trace=tid, parent=lease_sid,
+                               proc=worker_id, op="lease",
+                               unit=unit.unit_id)
+            if ev:
+                ship.append(ev)
+            t_unit = time.monotonic()
+            try:
+                # join an overlapped warmup (cli.cmd_worker starts one
+                # before the loop, so the step compile overlapped the
+                # lease round trip); inside the try so a compile failure
+                # releases the lease like any processing failure
+                ensure_warm = getattr(worker, "ensure_warm", None)
+                if ensure_warm is not None:
+                    ensure_warm()
+                if warm_pending:
+                    # the compile ran overlapped on a background thread;
+                    # report its REAL cost (compile_seconds), not the
+                    # near-zero join time, so a fleet stalled on cold
+                    # compiles is legible in the trace
+                    warm_pending = False
+                    warm_s = getattr(worker, "compile_seconds", None)
+                    if warm_s is not None:
+                        ev = tracer.record(
+                            "warmup", dur=float(warm_s), trace=tid,
+                            parent=lease_sid, proc=worker_id,
+                            engine=eng_name,
+                            cache=getattr(worker, "compile_cache",
+                                          None), overlapped=True)
+                        if ev:
+                            ship.append(ev)
+                hits = worker.process(unit)
+            except Exception as e:
+                # the aborted attempt still joins the timeline: ship
+                # what we have with the fail report, then release the
+                # lease for another worker and surface the bug
+                ev = tracer.record("sweep",
+                                   dur=time.monotonic() - t_unit,
+                                   trace=tid, parent=lease_sid,
+                                   proc=worker_id, unit=unit.unit_id,
+                                   error=type(e).__name__)
+                if ev:
+                    ship.append(ev)
+                try:
+                    # clock rides along so the coordinator can rebase
+                    # our wall-clock span timestamps onto its own
+                    client.call("fail", unit_id=unit.unit_id,
+                                worker_id=worker_id, spans=ship,
+                                clock=time.time())
+                except Exception:
+                    pass
+                raise
+            unit_s = time.monotonic() - t_unit
+            h_unit.observe(unit_s)
+            m_cands.inc(unit.length, engine=eng_name, device=device)
+            ev = tracer.record("sweep", dur=unit_s, trace=tid,
+                               parent=lease_sid, proc=worker_id,
+                               unit=unit.unit_id, length=unit.length,
+                               hits=len(hits))
+            if ev:
+                ship.append(ev)
+            payload = [{"target": h.target_index, "cand": h.cand_index,
+                        "plaintext": h.plaintext.hex()} for h in hits]
+            # elapsed rides the complete report: the coordinator's
+            # adaptive unit sizer turns it into this worker's next unit
+            # length; spans stitch the attempt onto the coordinator's
+            # flight recorder
+            resp = client.call("complete", unit_id=unit.unit_id,
+                               hits=payload, worker_id=worker_id,
+                               elapsed=unit_s, spans=ship,
+                               clock=time.time())
+            done_units += 1
+            if log and hits:
+                log.info("hits reported", count=len(hits))
             if resp.get("stop"):
                 return done_units
-            time.sleep(idle_sleep)
-            continue
-        unit = WorkUnit(unit_d["id"], unit_d["start"], unit_d["length"])
-        t_unit = time.monotonic()
-        try:
-            # join an overlapped warmup (cli.cmd_worker starts one
-            # before the loop, so the step compile overlapped the
-            # lease round trip); inside the try so a compile failure
-            # releases the lease like any processing failure
-            ensure_warm = getattr(worker, "ensure_warm", None)
-            if ensure_warm is not None:
-                ensure_warm()
-            hits = worker.process(unit)
-        except Exception:
-            # release the lease for another worker, then surface the bug
-            try:
-                client.call("fail", unit_id=unit.unit_id)
-            except Exception:
-                pass
-            raise
-        unit_s = time.monotonic() - t_unit
-        h_unit.observe(unit_s)
-        m_cands.inc(unit.length, engine=eng_name, device=device)
-        payload = [{"target": h.target_index, "cand": h.cand_index,
-                    "plaintext": h.plaintext.hex()} for h in hits]
-        # elapsed rides the complete report: the coordinator's adaptive
-        # unit sizer turns it into this worker's next unit length
-        resp = client.call("complete", unit_id=unit.unit_id, hits=payload,
-                           worker_id=worker_id, elapsed=unit_s)
-        done_units += 1
-        if log and hits:
-            log.info("hits reported", count=len(hits))
-        if resp.get("stop"):
-            return done_units

@@ -49,6 +49,16 @@ class SessionJournal:
         from dprf_tpu.telemetry import telemetry_path
         return telemetry_path(self.path)
 
+    @property
+    def trace_path(self) -> str:
+        """Where this session's lifecycle-span stream lives
+        (telemetry/trace.py; exported with ``dprf trace export``) --
+        third member of the journal family: coverage (.session),
+        fleet state (.telemetry.jsonl), per-unit timeline
+        (.trace.jsonl)."""
+        from dprf_tpu.telemetry.trace import trace_path
+        return trace_path(self.path)
+
     # -- writing ---------------------------------------------------------
 
     def open(self, spec: dict) -> None:

@@ -26,6 +26,13 @@ Metric names (all prefixed ``dprf_``; see README "Observability"):
   dprf_units_poisoned_total                     retry-cap parking events
   dprf_units_parked                             currently-parked gauge
                                                 (0 after retry-parked)
+  dprf_trace_spans_total                        flight-recorder spans
+                                                (telemetry/trace.py)
+
+Alongside metrics, telemetry/trace.py records per-unit lifecycle SPANS
+(the flight recorder): trace ids assigned at split time, context
+propagated over the RPC messages, ``dprf top`` live view, and ``dprf
+trace export`` to Perfetto -- see its module docstring.
 """
 
 from __future__ import annotations
@@ -39,6 +46,10 @@ from dprf_tpu.telemetry.snapshot import (TelemetrySnapshotter,
                                          load_snapshots,
                                          snapshot_interval,
                                          telemetry_path)
+
+# NOTE: dprf_tpu.telemetry.trace is imported lazily by its users (it
+# imports get_registry from this package at recorder construction);
+# `from dprf_tpu.telemetry.trace import get_tracer` is the entrypoint.
 
 #: process-wide registry: library code with no registry threaded
 #: through publishes here (the utils/logging.DEFAULT pattern); the

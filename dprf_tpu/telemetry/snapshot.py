@@ -8,6 +8,12 @@ per interval, so the last line of the file is the fleet's state at the
 moment the run died.  Append-only JSONL with the same torn-tail
 tolerance as the session journal; snapshots are diagnostics, never
 resume state.
+
+The file is SIZE-CAPPED (``DPRF_TELEMETRY_MAX_BYTES``, default 16
+MiB): when a write would exceed the cap the file rotates to a ``.1``
+suffix (replacing any previous rotation) -- a serve session that runs
+for weeks holds at most ~2x the cap on disk instead of growing without
+limit.  The trace stream (telemetry/trace.py) rotates the same way.
 """
 
 from __future__ import annotations
@@ -26,6 +32,54 @@ TELEMETRY_SUFFIX = ".telemetry.jsonl"
 #: default seconds between snapshot lines (override per-run with
 #: DPRF_TELEMETRY_INTERVAL)
 DEFAULT_INTERVAL_S = 30.0
+
+#: size cap for the snapshot file before it rotates to `.1`
+#: (DPRF_TELEMETRY_MAX_BYTES overrides; 0 disables the cap)
+MAX_BYTES_ENV = "DPRF_TELEMETRY_MAX_BYTES"
+DEFAULT_MAX_BYTES = 16 << 20
+
+
+def max_bytes_from_env(env: str, default: int) -> Optional[int]:
+    """Shared byte-cap env parsing (telemetry snapshots AND the trace
+    stream): int value, fallback to the default on junk, 0 disables
+    (returns None)."""
+    try:
+        v = int(os.environ.get(env, default))
+    except ValueError:
+        return default
+    return v if v > 0 else None
+
+
+def snapshot_max_bytes(default: int = DEFAULT_MAX_BYTES) -> Optional[int]:
+    return max_bytes_from_env(MAX_BYTES_ENV, default)
+
+
+def rotate_if_over(path: str, incoming: int,
+                   max_bytes: Optional[int]) -> bool:
+    """Move ``path`` aside to ``path + '.1'`` (replacing any previous
+    rotation) when appending ``incoming`` bytes would push it over
+    ``max_bytes``.  When the rotation target is unusable (unwritable
+    dir, ``.1`` exists as a directory) the file is truncated in place
+    instead -- a bounded file with lost history beats the unbounded
+    growth the cap exists to prevent.  Returns True when the file was
+    rotated or truncated."""
+    if not max_bytes:
+        return False
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return False
+    if size and size + incoming > max_bytes:
+        try:
+            os.replace(path, path + ".1")
+            return True
+        except OSError:
+            try:
+                open(path, "w").close()
+                return True
+            except OSError:
+                return False
+    return False
 
 
 def telemetry_path(session_path: str) -> str:
@@ -47,10 +101,12 @@ class TelemetrySnapshotter:
 
     def __init__(self, path: str, registry: MetricsRegistry,
                  interval: float = DEFAULT_INTERVAL_S,
-                 clock=time.time):
+                 clock=time.time, max_bytes: Optional[int] = None):
         self.path = path
         self.registry = registry
         self.interval = max(0.25, float(interval))
+        #: rotation cap; None = env default at write time
+        self.max_bytes = max_bytes
         self._clock = clock
         self._t0 = time.monotonic()
         self._stop = threading.Event()
@@ -63,6 +119,9 @@ class TelemetrySnapshotter:
                 "metrics": self.registry.snapshot()}
         data = json.dumps(line, separators=(",", ":")) + "\n"
         with self._lock:
+            cap = (snapshot_max_bytes() if self.max_bytes is None
+                   else self.max_bytes)
+            rotate_if_over(self.path, len(data), cap)
             with open(self.path, "a", encoding="utf-8") as fh:
                 fh.write(data)
                 fh.flush()

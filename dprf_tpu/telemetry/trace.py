@@ -1,0 +1,559 @@
+"""Distributed tracing & flight recorder (ISSUE 4).
+
+The metrics layer answers "how much / how fast"; this module answers
+"WHICH unit, on WHICH worker, spent its time WHERE".  Every WorkUnit
+gets a trace id when the Dispatcher splits it; each lifecycle step is
+a SPAN -- ``lease``, ``rpc``, ``warmup``, ``sweep``, ``hit_verify``,
+``complete`` / ``fail`` / ``reissue`` / ``park`` -- recorded by the
+coordinator, dispatcher, and workers.  Trace context (trace id + lease
+span id) rides the existing RPC messages: the lease response carries
+it out, and the worker ships its spans back inside ``complete`` /
+``fail``, so a remote worker's spans stitch onto the coordinator's
+timeline with correct parent links even when the unit bounced between
+hosts.
+
+Spans land in two places:
+
+  - a bounded in-memory ring (the "flight recorder"): the last N spans
+    are always available for post-mortems and the ``op_trace_tail``
+    RPC that feeds ``dprf top``;
+  - a JSONL stream next to the session journal (``<session>
+    .trace.jsonl``), size-capped with ``.1`` rotation like the
+    telemetry snapshots, which ``dprf trace export`` converts to
+    Chrome-trace / Perfetto JSON.
+
+Span schema (one JSON object per line / ring entry)::
+
+    {"name": "sweep", "ts": <epoch s>, "dur": <s>,
+     "trace": "<unit trace id>", "span": "<id>", "parent": "<id|null>",
+     "proc": "<coordinator|worker id|local>", "attrs": {...}}
+
+``SPAN_NAMES`` below is the SINGLE declaration site for span names;
+``tools/check_metrics.py`` (run from conftest) statically asserts that
+every ``record("...")`` call site uses a declared name and that every
+metric name is declared at exactly one site.
+
+Overhead: spans are per-UNIT events (a handful per ~20-second unit),
+``record`` is a dict build + deque append + one buffered file write --
+asserted <= 2% of the local sweep hot path in tests/test_trace.py.
+``DPRF_TRACE=0`` disables recording entirely.  Opt-in
+``DPRF_JAX_PROFILE=<dir>`` additionally wraps sweep loops in a
+``jax.profiler`` trace for kernel-level drill-down.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import secrets
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+#: the one declaration site for span names (tools/check_metrics.py
+#: enforces that every record() literal is a member)
+SPAN_NAMES = ("lease", "rpc", "warmup", "sweep", "hit_verify",
+              "complete", "fail", "reissue", "park")
+
+#: suffix appended to a session journal path for its span stream
+TRACE_SUFFIX = ".trace.jsonl"
+
+#: kill switch: DPRF_TRACE=0 disables span recording process-wide
+ENABLE_ENV = "DPRF_TRACE"
+#: size cap for the trace JSONL stream (rotated to `.1` when exceeded)
+MAX_BYTES_ENV = "DPRF_TRACE_MAX_BYTES"
+DEFAULT_MAX_BYTES = 16 << 20
+#: opt-in: wrap sweep loops in a jax.profiler trace written here
+PROFILE_ENV = "DPRF_JAX_PROFILE"
+
+#: span-id namespace: a per-process random prefix + a cheap counter --
+#: unique across the fleet without paying a uuid4 per span
+_ID_PREFIX = secrets.token_hex(4)
+_ID_COUNTER = itertools.count(1)
+
+#: ingest sanitization bounds (remote spans are client-controlled)
+MAX_INGEST_SPANS = 64
+MAX_ATTRS = 16
+MAX_ATTR_STR = 256
+MAX_ID_LEN = 64
+
+
+def new_trace_id() -> str:
+    """Trace id for one work-unit lifecycle (assigned at split time)."""
+    return secrets.token_hex(8)
+
+
+def new_span_id() -> str:
+    return f"{_ID_PREFIX}-{next(_ID_COUNTER):x}"
+
+
+def trace_path(session_path: str) -> str:
+    """Span-stream location for a session journal path (idempotent:
+    a path that already IS a trace stream is returned unchanged, so
+    ``dprf trace export`` accepts either)."""
+    if session_path.endswith(TRACE_SUFFIX):
+        return session_path
+    return session_path + TRACE_SUFFIX
+
+
+def trace_enabled() -> bool:
+    return os.environ.get(ENABLE_ENV, "1") != "0"
+
+
+def trace_max_bytes() -> Optional[int]:
+    """Byte cap for the trace JSONL stream; 0 disables the cap (env
+    parsing shared with the telemetry snapshot cap)."""
+    from dprf_tpu.telemetry.snapshot import max_bytes_from_env
+    return max_bytes_from_env(MAX_BYTES_ENV, DEFAULT_MAX_BYTES)
+
+
+def _clean_id(v) -> Optional[str]:
+    if isinstance(v, str) and 0 < len(v) <= MAX_ID_LEN:
+        return v
+    return None
+
+
+def _clean_attrs(attrs) -> dict:
+    if not isinstance(attrs, dict):
+        return {}
+    out = {}
+    for k, v in itertools.islice(attrs.items(), MAX_ATTRS):
+        k = str(k)[:32]
+        if isinstance(v, bool) or isinstance(v, (int, float)):
+            out[k] = v
+        elif isinstance(v, str):
+            out[k] = v[:MAX_ATTR_STR]
+        else:
+            out[k] = str(v)[:MAX_ATTR_STR]
+    return out
+
+
+class TraceRecorder:
+    """Bounded flight-recorder ring + optional JSONL stream.
+
+    Thread-safe; ``record`` is the only hot-path entry and returns the
+    span dict (so a worker can ship it over RPC) or None when tracing
+    is disabled.  One recorder per process is the normal shape (the
+    module-level DEFAULT); tests construct their own.
+    """
+
+    def __init__(self, capacity: int = 4096, clock=time.time,
+                 enabled: Optional[bool] = None, proc: str = "local",
+                 registry=None):
+        self._ring: deque = deque(maxlen=max(16, int(capacity)))
+        self._clock = clock
+        self.enabled = trace_enabled() if enabled is None else enabled
+        self.proc = proc
+        self._lock = threading.Lock()
+        self._fh = None
+        self._path: Optional[str] = None
+        self._max_bytes: Optional[int] = None
+        self._file_bytes = 0
+        from dprf_tpu.telemetry import get_registry
+        self._m_spans = get_registry(registry).counter(
+            "dprf_trace_spans_total",
+            "lifecycle spans recorded into the flight recorder")
+
+    # -- recording -------------------------------------------------------
+
+    def record(self, name: str, dur: float = 0.0, ts: Optional[float] = None,
+               trace: Optional[str] = None, parent: Optional[str] = None,
+               proc: Optional[str] = None, **attrs) -> Optional[dict]:
+        """Record one span; ``ts`` defaults to now - dur (i.e. the
+        caller measured ``dur`` ending now).  Returns the span dict
+        (shippable over RPC) or None when disabled."""
+        if not self.enabled:
+            return None
+        if ts is None:
+            ts = self._clock() - dur
+        span = {"name": name, "ts": round(float(ts), 6),
+                "dur": round(float(dur), 6), "trace": trace,
+                "parent": parent, "span": new_span_id(),
+                "proc": proc if proc is not None else self.proc,
+                "attrs": attrs}
+        self._append(span)
+        return span
+
+    def ingest(self, spans, proc: Optional[str] = None,
+               sent_at=None) -> int:
+        """Fold REMOTE spans (shipped inside an RPC complete/fail
+        message) into this recorder.  Client-controlled data, so
+        sanitize hard: bounded count, declared span names only, scalar
+        attrs, and ``proc`` forced to the server-known worker id when
+        given -- a worker cannot impersonate another's timeline.
+
+        ``sent_at`` is the sender's wall clock at send time: span
+        timestamps are REBASED by (our now - sent_at), so a fleet
+        whose hosts disagree by NTP drift still renders one coherent
+        timeline (residual error = one-way network latency, seconds of
+        drift otherwise)."""
+        if not self.enabled or not isinstance(spans, list):
+            return 0
+        offset = 0.0
+        if isinstance(sent_at, (int, float)):
+            offset = self._clock() - float(sent_at)
+        n = 0
+        for s in spans[:MAX_INGEST_SPANS]:
+            if not isinstance(s, dict):
+                continue
+            name = s.get("name")
+            if not isinstance(name, str) or name not in SPAN_NAMES:
+                continue
+            try:
+                ts = float(s.get("ts", 0.0))
+                dur = float(s.get("dur", 0.0))
+            except (TypeError, ValueError):
+                continue
+            clean = {"name": name, "ts": round(ts + offset, 6),
+                     "dur": round(dur, 6),
+                     "trace": _clean_id(s.get("trace")),
+                     "parent": _clean_id(s.get("parent")),
+                     "span": _clean_id(s.get("span")) or new_span_id(),
+                     "proc": str(proc if proc is not None
+                                 else s.get("proc", "?"))[:MAX_ID_LEN],
+                     "attrs": _clean_attrs(s.get("attrs"))}
+            self._append(clean)
+            n += 1
+        return n
+
+    def _append(self, span: dict) -> None:
+        self._m_spans.inc()
+        with self._lock:
+            self._ring.append(span)
+            if self._fh is not None:
+                try:
+                    data = json.dumps(span, separators=(",", ":"),
+                                      default=str) + "\n"
+                    if (self._max_bytes is not None
+                            and self._file_bytes
+                            and self._file_bytes + len(data)
+                            > self._max_bytes):
+                        self._rotate_locked()
+                    if self._fh is not None:
+                        self._fh.write(data)
+                        self._fh.flush()
+                        self._file_bytes += len(data)
+                except OSError:
+                    pass   # a full disk must not kill the job
+
+    def _rotate_locked(self) -> None:
+        """Size-cap rotation: the stream moves to ``<path>.1``
+        (replacing any previous rotation) and restarts -- a long serve
+        session holds at most ~2x the cap on disk.  An unusable
+        rotation target truncates in place instead (the cap must hold
+        either way); an unreopenable path degrades to ring-only."""
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+        mode = "a"
+        try:
+            os.replace(self._path, self._path + ".1")
+        except OSError:
+            mode = "w"
+        try:
+            self._fh = open(self._path, mode, encoding="utf-8")
+            self._file_bytes = 0
+        except OSError:
+            self._fh = None
+
+    # -- file stream -----------------------------------------------------
+
+    def attach_file(self, path: str,
+                    max_bytes: Optional[int] = None) -> "TraceRecorder":
+        """Stream subsequent spans to a JSONL file (the session's
+        flight-recorder journal).  Ring contents recorded BEFORE the
+        attach are not replayed -- the file is this run's record, the
+        ring is the process's."""
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+            self._path = path
+            self._max_bytes = (trace_max_bytes() if max_bytes is None
+                               else (max_bytes or None))
+            self._fh = open(path, "a", encoding="utf-8")
+            try:
+                self._file_bytes = os.path.getsize(path)
+            except OSError:
+                self._file_bytes = 0
+        return self
+
+    def detach_file(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+            self._fh = None
+            self._path = None
+
+    # -- reads -----------------------------------------------------------
+
+    def tail(self, n: int = 200, trace: Optional[str] = None) -> list:
+        """The most recent n spans (optionally one trace's), oldest
+        first -- the op_trace_tail payload."""
+        with self._lock:
+            items = list(self._ring)
+        if trace is not None:
+            items = [s for s in items if s.get("trace") == trace]
+        return [dict(s) for s in items[-max(1, int(n)):]]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+#: process-wide recorder, like telemetry.DEFAULT: library code with no
+#: recorder threaded through records here
+DEFAULT_TRACER = TraceRecorder()
+
+
+def get_tracer(recorder: Optional[TraceRecorder] = None) -> TraceRecorder:
+    return recorder if recorder is not None else DEFAULT_TRACER
+
+
+def span_id(span: Optional[dict]) -> Optional[str]:
+    """The id of a recorded span, tolerating a disabled recorder's
+    None."""
+    return span["span"] if span else None
+
+
+# ---------------------------------------------------------------------------
+# trace-file loading + analysis (dprf trace export, tests)
+
+def load_trace(path: str) -> list:
+    """Read a span stream back (rotated ``.1`` part first, torn tail
+    lines skipped), sorted by start time."""
+    spans = []
+    for p in (path + ".1", path):
+        if not os.path.exists(p):
+            continue
+        with open(p, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    s = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(s, dict) and isinstance(s.get("name"), str) \
+                        and "ts" in s:
+                    spans.append(s)
+    spans.sort(key=lambda s: (s.get("ts", 0.0), s.get("span") or ""))
+    return spans
+
+
+def lifecycle_report(spans: list) -> dict:
+    """Reconstruct per-unit lifecycles: for every trace id, the ordered
+    span names, the procs that touched it, lease/terminal accounting,
+    and ORPHANS (spans whose parent id never appears in their trace --
+    a broken context-propagation link)."""
+    traces: dict = {}
+    for s in spans:
+        tid = s.get("trace")
+        if not tid:
+            continue
+        t = traces.setdefault(tid, {"spans": [], "ids": set()})
+        t["spans"].append(s)
+        sid = s.get("span")
+        if sid:
+            t["ids"].add(sid)
+    details = {}
+    orphans = 0
+    incomplete = []
+    for tid, t in traces.items():
+        names = [s["name"] for s in t["spans"]]
+        t_orphans = [s.get("span") for s in t["spans"]
+                     if s.get("parent") and s["parent"] not in t["ids"]]
+        orphans += len(t_orphans)
+        terminal = any(n in ("complete", "park") for n in names)
+        if not terminal:
+            incomplete.append(tid)
+        details[tid] = {
+            "names": names,
+            "procs": sorted({str(s.get("proc")) for s in t["spans"]}),
+            "leases": names.count("lease"),
+            "reissues": names.count("reissue"),
+            "terminal": terminal,
+            "orphans": t_orphans,
+        }
+    return {"traces": len(traces), "spans": len(spans),
+            "orphans": orphans, "incomplete": sorted(incomplete),
+            "details": details}
+
+
+def export_chrome_trace(spans: list) -> dict:
+    """Spans -> Chrome-trace JSON (the "JSON Array Format" with
+    metadata events), loadable in Perfetto / chrome://tracing.
+
+    Mapping: pid = actor (coordinator / worker id / local), tid = one
+    work-unit trace within that actor -- so a reissued unit renders as
+    aligned lanes across the workers that touched it.  Timestamps are
+    microseconds relative to the earliest span (absolute epoch kept in
+    ``otherData``)."""
+    pids: dict = {}
+    tids: dict = {}
+    events = []
+
+    def pid_of(proc: str) -> int:
+        if proc not in pids:
+            pids[proc] = len(pids) + 1
+            events.append({"name": "process_name", "ph": "M", "cat": "__metadata",
+                           "pid": pids[proc], "tid": 0,
+                           "args": {"name": proc}})
+        return pids[proc]
+
+    def tid_of(pid: int, tid_key) -> int:
+        key = (pid, tid_key)
+        if key not in tids:
+            tids[key] = len(tids) + 1
+            label = (f"unit trace {str(tid_key)[:10]}"
+                     if tid_key != "-" else "untraced")
+            events.append({"name": "thread_name", "ph": "M",
+                           "cat": "__metadata", "pid": pid,
+                           "tid": tids[key], "args": {"name": label}})
+        return tids[key]
+
+    t0 = min((float(s.get("ts", 0.0)) for s in spans), default=0.0)
+    for s in spans:
+        proc = str(s.get("proc") or "?")
+        pid = pid_of(proc)
+        tid = tid_of(pid, s.get("trace") or "-")
+        dur_us = max(float(s.get("dur", 0.0)) * 1e6, 1.0)
+        args = dict(s.get("attrs") or {})
+        args.update({"trace": s.get("trace"), "span": s.get("span"),
+                     "parent": s.get("parent")})
+        events.append({"name": s["name"], "cat": "dprf", "ph": "X",
+                       "ts": round((float(s["ts"]) - t0) * 1e6, 3),
+                       "dur": round(dur_us, 3),
+                       "pid": pid, "tid": tid, "args": args})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"tool": "dprf trace export",
+                          "t0_epoch_s": t0, "spans": len(spans)}}
+
+
+# ---------------------------------------------------------------------------
+# dprf top rendering
+
+def _fmt_age(s: float) -> str:
+    if s < 0:
+        return "expired"
+    if s < 120:
+        return f"{s:.0f}s"
+    return f"{s / 60:.1f}m"
+
+
+def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
+    """One frame of the ``dprf top`` live view from an op_trace_tail
+    response.  ``prev`` is (monotonic_time, status) of the previous
+    frame, used for the interval throughput estimate."""
+    status = resp.get("status") or {}
+    spans = resp.get("spans") or []
+    leases = resp.get("leases") or []
+    done = status.get("done", 0)
+    total = max(status.get("total", 0), 1)
+    lines = []
+    rate = ""
+    if prev:
+        t_prev, s_prev = prev
+        dt = time.monotonic() - t_prev
+        if dt > 0:
+            rate = f" | {max(done - s_prev.get('done', 0), 0) / dt:,.0f}/s"
+    state = "FINISHED" if status.get("stop") else "running"
+    lines.append(
+        f"dprf top — {state} | found {status.get('found', 0)}"
+        f"/{status.get('targets', '?')} | "
+        f"{100.0 * done / total:.2f}% covered | parked "
+        f"{status.get('parked', 0)} | elapsed "
+        f"{status.get('elapsed', 0.0):.0f}s{rate}")
+    quarantined = status.get("quarantined") or []
+    if quarantined:
+        lines.append(f"quarantined workers: {', '.join(quarantined)}")
+    # per-worker table: current lease + the worker's most recent span
+    last_span: dict = {}
+    for s in spans:
+        last_span[str(s.get("proc"))] = s
+    by_worker = {str(l.get("worker")): l for l in leases}
+    workers = sorted(set(by_worker)
+                     | {p for p in last_span
+                        if p not in ("coordinator",)})
+    lines.append("")
+    lines.append(f"{'WORKER':20s} {'STATE':10s} {'UNIT':>8s} "
+                 f"{'RANGE':>24s} {'LEASE':>8s} {'LAST SPAN':>10s}")
+    # ages against the COORDINATOR's clock (shipped in status): the
+    # spans carry its wall time, and the viewer's clock may be skewed
+    now = status.get("now") or time.time()
+    for w in workers:
+        lease = by_worker.get(w)
+        s = last_span.get(w)
+        state = s["name"] if s else ("sweep" if lease else "idle")
+        unit = f"#{lease['unit']}" if lease else "-"
+        rng = (f"[{lease['start']},{lease['start'] + lease['length']})"
+               if lease else "-")
+        dl = _fmt_age(lease["deadline_s"]) if lease else "-"
+        age = (_fmt_age(max(0.0, now - (s.get("ts", now)
+                                        + s.get("dur", 0.0))))
+               if s else "-")
+        lines.append(f"{w[:20]:20s} {state:10s} {unit:>8s} {rng:>24s} "
+                     f"{dl:>8s} {age:>10s}")
+    lines.append("")
+    lines.append("recent spans:")
+    for s in spans[-8:]:
+        tid = (s.get("trace") or "-")[:8]
+        lines.append(f"  {s['name']:11s} trace={tid:8s} "
+                     f"proc={str(s.get('proc'))[:16]:16s} "
+                     f"dur={s.get('dur', 0.0):.3f}s")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# opt-in jax.profiler wrapping of sweep loops
+
+class _SafeProfile:
+    """Context manager around jax.profiler.trace that degrades to a
+    no-op (with a logged warning) instead of killing the job when the
+    profiler cannot start -- e.g. a trace is already active because the
+    run was also launched with ``--profile``."""
+
+    def __init__(self, directory: str, log=None):
+        self._dir = directory
+        self._log = log
+        self._cm = None
+
+    def __enter__(self):
+        try:
+            import jax
+            self._cm = jax.profiler.trace(self._dir)
+            self._cm.__enter__()
+        except Exception as e:   # noqa: BLE001 -- diagnostics only
+            self._cm = None
+            if self._log is not None:
+                self._log.warn("DPRF_JAX_PROFILE trace failed to start",
+                               dir=self._dir, error=str(e))
+        return self
+
+    def __exit__(self, *exc):
+        if self._cm is not None:
+            try:
+                self._cm.__exit__(*exc)
+            except Exception:    # noqa: BLE001
+                pass
+        return False
+
+
+def jax_profile_ctx(log=None):
+    """``DPRF_JAX_PROFILE=<dir>``: a jax.profiler trace context for a
+    sweep loop (kernel-level drill-down next to the span timeline);
+    a null context when unset."""
+    import contextlib
+    d = os.environ.get(PROFILE_ENV)
+    if not d:
+        return contextlib.nullcontext()
+    return _SafeProfile(d, log=log)

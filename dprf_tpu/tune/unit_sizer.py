@@ -11,6 +11,18 @@ completion throughput -- reported over the existing RPC complete path
 so every worker settles at units roughly `target_seconds` long no
 matter how fast it drains them (HashKitty's per-node work-sizing
 lesson, PAPERS.md).
+
+Crash history (ISSUE 4 satellite of a ROADMAP item): throughput alone
+never shrinks a worker that is FAST but keeps dying -- its lease
+expiries re-run target_seconds of work every time, and a worker OOMing
+on big units retries the same fatal size forever.  The Dispatcher
+reports every failed attempt / lease expiry via ``observe_failure``;
+each recent failure HALVES the worker's next units (capped at 1/2**4),
+and each successful completion decays one failure off -- so a host
+whose crash was environmental earns its size back, while a flaky one
+keeps re-running minutes, not hours.  The failure/reissue spans in the
+trace timeline (telemetry/trace.py) carry the same per-worker history
+an operator sees.
 """
 
 from __future__ import annotations
@@ -47,6 +59,9 @@ class AdaptiveUnitSizer:
         self.max_unit = max(self.min_unit, int(max_unit))
         self.alpha = alpha
         self._rates: dict[str, float] = {}
+        #: per-worker recent-failure score (fail() or lease expiry);
+        #: decays by one per successful completion
+        self._failures: dict[str, int] = {}
         self._lock = threading.Lock()
         m = get_registry(registry)
         m.gauge("dprf_unit_target_seconds",
@@ -63,10 +78,19 @@ class AdaptiveUnitSizer:
             size = max(self.align, (size // self.align) * self.align)
         return size
 
+    #: penalty halvings stop at 1/2**MAX_PENALTY_BITS of the computed
+    #: size: units must stay big enough to measure recovery with
+    MAX_PENALTY_BITS = 4
+    #: failure score ceiling: bounds how many clean completions a
+    #: recovered worker owes before its units are full-size again
+    MAX_FAILURES = 8
+
     def observe(self, worker_id: str, length: int, elapsed: float) -> None:
         """Fold one completed unit into the worker's throughput EWMA.
         Non-positive reports (clock skew, zero-length tails) are
-        dropped rather than poisoning the estimate."""
+        dropped rather than poisoning the estimate.  A clean
+        completion also decays one recent failure: size comes back
+        gradually, each probe unit a little bigger."""
         if length <= 0 or not elapsed or elapsed <= 0:
             return
         rate = length / float(elapsed)
@@ -75,6 +99,24 @@ class AdaptiveUnitSizer:
             self._rates[worker_id] = (
                 rate if prev is None
                 else self.alpha * rate + (1.0 - self.alpha) * prev)
+            f = self._failures.get(worker_id, 0)
+            if f > 1:
+                self._failures[worker_id] = f - 1
+            elif f:
+                del self._failures[worker_id]
+
+    def observe_failure(self, worker_id: str) -> None:
+        """One failed attempt / lease expiry (reported by the
+        Dispatcher's requeue path): the worker's next units halve per
+        recent failure, so a crash re-runs less and an OOM-sized unit
+        is not retried at the fatal size."""
+        with self._lock:
+            self._failures[worker_id] = min(
+                self._failures.get(worker_id, 0) + 1, self.MAX_FAILURES)
+
+    def failures(self, worker_id: str) -> int:
+        with self._lock:
+            return self._failures.get(worker_id, 0)
 
     def rate(self, worker_id: str) -> Optional[float]:
         with self._lock:
@@ -82,13 +124,15 @@ class AdaptiveUnitSizer:
 
     def next_size(self, worker_id: str) -> int:
         """Unit length for this worker's next lease: EWMA rate x the
-        target seconds, clamped and alignment-rounded.  A worker with
-        no history gets the configured initial size (the first unit is
-        the measurement)."""
+        target seconds, halved per recent failure, clamped and
+        alignment-rounded.  A worker with no history gets the
+        configured initial size (the first unit is the measurement)."""
         with self._lock:
             rate = self._rates.get(worker_id)
+            fails = self._failures.get(worker_id, 0)
         size = (self.initial if rate is None
                 else int(rate * self.target_seconds))
+        size >>= min(fails, self.MAX_PENALTY_BITS)
         size = self._clamp(size)
         self._g_size.set(size)
         return size

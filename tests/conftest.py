@@ -76,28 +76,35 @@ def _smoke_budget(config):
 
 def pytest_configure(config):
     config._dprf_tier_t0 = _time.monotonic()
-    _check_tier_markers()
+    _run_static_checks()
 
 
-def _check_tier_markers():
-    """Run tools/check_markers.py at the top of every tier run: a test
-    that compiles device pipelines without declaring a tier would
-    silently ride into the smoke tier's 5-minute promise.  Static AST
-    scan, so the cost is milliseconds."""
+def _run_static_checks():
+    """Run the static AST lints at the top of every tier run (cost:
+    milliseconds each):
+
+      - tools/check_markers.py: a test that compiles device pipelines
+        without declaring a tier would silently ride into the smoke
+        tier's 5-minute promise;
+      - tools/check_metrics.py: every metric/span name declared at
+        exactly one site (the PR 3 duplicate-declaration bug, made
+        impossible)."""
     import subprocess
     import sys
 
     import pytest
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    tool = os.path.join(repo, "tools", "check_markers.py")
-    if not os.path.exists(tool):
-        return
-    proc = subprocess.run([sys.executable, tool],
-                          capture_output=True, text=True)
-    if proc.returncode != 0:
-        raise pytest.UsageError(
-            "tier-marker check failed:\n" + proc.stdout + proc.stderr)
+    for name, what in (("check_markers.py", "tier-marker"),
+                       ("check_metrics.py", "metric/span declaration")):
+        tool = os.path.join(repo, "tools", name)
+        if not os.path.exists(tool):
+            continue
+        proc = subprocess.run([sys.executable, tool],
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise pytest.UsageError(
+                f"{what} check failed:\n" + proc.stdout + proc.stderr)
 
 
 def _has_compileheavy(session) -> bool:
