@@ -150,6 +150,12 @@ def _build_parser() -> argparse.ArgumentParser:
                    "ledger (default: host:pid)")
     w.add_argument("--batch", type=int, default=None,
                    help="override the job's device batch size")
+    w.add_argument("--pipeline-depth", type=int, default=None,
+                   metavar="N",
+                   help="units leased ahead and submitted before the "
+                   "oldest one resolves (default: $DPRF_PIPELINE_DEPTH "
+                   "or 2; 1 = the serial lease->process->complete "
+                   "loop)")
     w.add_argument("--token", default=None,
                    help="shared secret for an authenticated coordinator "
                    "(default: $DPRF_TOKEN)")
@@ -220,16 +226,25 @@ def _build_parser() -> argparse.ArgumentParser:
                     "device engine; default: the shapes recorded in "
                     "the tuning cache)")
     pw.add_argument("--attacks", default="mask", metavar="A1,A2",
-                    help="attack shapes per engine (mask,wordlist)")
+                    help="attack shapes per engine (mask, wordlist, "
+                    "combinator, hybrid-wm, hybrid-mw)")
     pw.add_argument("--mask", default="?a?a?a?a?a?a?a?a",
-                    help="mask shaping the prewarmed mask step")
+                    help="mask shaping the prewarmed mask step (and "
+                    "the mask side of hybrid shapes)")
     pw.add_argument("--rules", default=None,
                     help="rule set for wordlist-shape prewarm")
     pw.add_argument("--wordlist", default=None, metavar="FILE",
-                    help="wordlist-shape prewarm: the job's REAL "
-                    "wordlist (the compiled program embeds the packed "
-                    "word table; a stand-in would cache a program no "
-                    "job runs)")
+                    help="wordlist/hybrid-shape prewarm: the job's "
+                    "REAL wordlist (the compiled program embeds the "
+                    "packed word table; a stand-in would cache a "
+                    "program no job runs)")
+    pw.add_argument("--combinator", default=None, metavar="LEFT,RIGHT",
+                    help="combinator-shape prewarm: the job's REAL "
+                    "left,right word files (both tables are embedded)")
+    pw.add_argument("--devices", type=int, default=1, metavar="N",
+                    help="prewarm the SHARDED (multi-chip mesh) step "
+                    "shape at N devices instead of the single-device "
+                    "one; skipped gracefully on hosts with fewer")
     pw.add_argument("--batch", type=_batch_size, default="auto",
                     help="step batch, or 'auto' (default): each "
                     "engine's tuned batch from the tuning cache, "
@@ -280,6 +295,10 @@ def _build_parser() -> argparse.ArgumentParser:
                     "finishes / Ctrl-C)")
     tp.add_argument("--spans", type=int, default=400, metavar="N",
                     help="flight-recorder spans to fetch per frame")
+    tp.add_argument("--follow", action="store_true",
+                    help="incremental span streaming: each frame "
+                    "fetches only spans newer than the last frame's "
+                    "cursor (cuts refresh cost on big fleets)")
     tp.add_argument("--no-clear", action="store_true",
                     help="append frames instead of redrawing the "
                     "screen")
@@ -1109,7 +1128,8 @@ def cmd_worker(args, log: Log) -> int:
     # worker_loop exits cleanly only on an explicit stop signal; any
     # bare connection drop (coordinator crash) or quarantine raises and
     # surfaces through main()'s error handler as a nonzero exit.
-    done = worker_loop(client, worker, worker_id, log=log)
+    done = worker_loop(client, worker, worker_id, log=log,
+                       depth=args.pipeline_depth)
     log.info("worker done", units=done)
     client.close()
     return 0
@@ -1250,8 +1270,10 @@ def cmd_prewarm(args, log: Log) -> int:
         return 0
     attacks = [a.strip() for a in args.attacks.split(",") if a.strip()]
     for a in attacks:
-        if a not in ("mask", "wordlist"):
-            log.error(f"unknown attack shape {a!r} (mask, wordlist)")
+        if a not in ("mask", "wordlist", "combinator", "hybrid-wm",
+                     "hybrid-mw"):
+            log.error(f"unknown attack shape {a!r} (mask, wordlist, "
+                      "combinator, hybrid-wm, hybrid-mw)")
             return 2
     if args.engines:
         engines = (sorted(engine_names("jax"))
@@ -1261,11 +1283,14 @@ def cmd_prewarm(args, log: Log) -> int:
         specs = explicit_specs(engines, attacks, hit_cap=args.hit_cap,
                                mask=args.mask, rules=args.rules,
                                wordlist=args.wordlist,
-                               batch=args.batch)
+                               combinator=args.combinator,
+                               batch=args.batch,
+                               devices=args.devices)
     else:
         specs = tune_seeded_specs("jax", hit_cap=args.hit_cap,
                                   mask=args.mask, rules=args.rules,
-                                  wordlist=args.wordlist, log=log)
+                                  wordlist=args.wordlist,
+                                  devices=args.devices, log=log)
         if not specs:
             log.error("tuning cache has no device entries to seed "
                       "from; pass --engines (e.g. --engines md5,ntlm "
@@ -1275,17 +1300,19 @@ def cmd_prewarm(args, log: Log) -> int:
     results = run_prewarm(specs, jobs=args.jobs, log=log)
     if not args.quiet:
         print(render_table(results), file=sys.stderr)
-    ok = [r for r in results if not r.error]
+    skipped = [r for r in results if r.skipped]
+    ok = [r for r in results if not r.error and not r.skipped]
     print(_json.dumps({
         "cache_dir": d,
         "specs": len(results),
         "compiled": len(ok),
         "hits": sum(1 for r in ok if r.cache == "hit"),
         "misses": sum(1 for r in ok if r.cache == "miss"),
-        "errors": len(results) - len(ok),
+        "skipped": len(skipped),
+        "errors": len(results) - len(ok) - len(skipped),
         "results": [r.as_dict() for r in results],
     }))
-    return 0 if ok or not results else 1
+    return 0 if ok or skipped or not results else 1
 
 
 def cmd_retry_parked(args, log: Log) -> int:
@@ -1329,8 +1356,26 @@ def cmd_top(args, log: Log) -> int:
             client.hello()     # answer the auth challenge first
         prev = None
         frames = 0
+        cursor = None
+        # --follow keeps a client-side span buffer and asks only for
+        # spans past the cursor; a resync (cursor fell off the
+        # coordinator's ring) replaces the buffer with the full tail
+        from collections import deque
+        buf: deque = deque(maxlen=max(args.spans, 64))
         while True:
-            resp = client.call("trace_tail", n=args.spans)
+            if args.follow:
+                resp = client.call("trace_tail", n=args.spans,
+                                   since=cursor)
+                if resp.get("resync") or "cursor" not in resp:
+                    # resync, or a pre-cursor coordinator that ignored
+                    # `since` and sent the full tail: REPLACE the
+                    # buffer (appending would duplicate every span)
+                    buf.clear()
+                buf.extend(resp.get("spans") or [])
+                cursor = resp.get("cursor") or cursor
+                resp = dict(resp, spans=list(buf))
+            else:
+                resp = client.call("trace_tail", n=args.spans)
             text = render_top(resp, prev)
             if not args.no_clear and sys.stdout.isatty():
                 sys.stdout.write("\x1b[H\x1b[2J")

@@ -40,17 +40,24 @@ DEFAULT_BATCH = 1 << 18
 @dataclasses.dataclass
 class PrewarmSpec:
     engine: str
-    attack: str = "mask"            # "mask" | "wordlist"
+    #: "mask" | "wordlist" | "combinator" | "hybrid-wm" | "hybrid-mw"
+    attack: str = "mask"
     batch: int = DEFAULT_BATCH
     hit_cap: int = 64
     mask: str = "?a?a?a?a?a?a?a?a"
     rules: Optional[str] = None
-    #: wordlist attacks only: the REAL wordlist file.  The compiled
+    #: wordlist/hybrid attacks: the REAL wordlist file.  The compiled
     #: program embeds the packed word table as constants (verified:
     #: identical content hits, different content misses), so a
     #: synthetic stand-in would cache a program no job ever runs --
     #: "covered" in the report, cold on the fleet.
     wordlist: Optional[str] = None
+    #: combinator attacks: the job's REAL "LEFT,RIGHT" word files
+    #: (both tables are embedded, same contract as wordlist)
+    combinator: Optional[str] = None
+    #: >1 = the sharded (multi-chip mesh) step shape at this many
+    #: devices; skipped gracefully when the host has fewer
+    devices: int = 1
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -61,21 +68,30 @@ class PrewarmSpec:
                       if k in {f.name for f in dataclasses.fields(cls)}})
 
 
+class SkipSpec(Exception):
+    """A spec this HOST cannot prewarm (e.g. a sharded shape on a
+    single-device box) -- reported as skipped, never as an error."""
+
+
 @dataclasses.dataclass
 class PrewarmResult:
     engine: str
     attack: str
     batch: int
     compile_s: float = 0.0
-    cache: str = "off"              # hit | miss | off
+    cache: str = "off"              # hit | miss | off | skip
     error: Optional[str] = None
+    devices: int = 1
+    skipped: Optional[str] = None   # why the host skipped the spec
 
     def as_dict(self) -> dict:
         d = {"engine": self.engine, "attack": self.attack,
              "batch": self.batch, "compile_s": round(self.compile_s, 3),
-             "cache": self.cache}
+             "cache": self.cache, "devices": self.devices}
         if self.error:
             d["error"] = self.error
+        if self.skipped:
+            d["skipped"] = self.skipped
         return d
 
 
@@ -83,6 +99,7 @@ def tune_seeded_specs(device: str = "jax", hit_cap: int = 64,
                       mask: str = "?a?a?a?a?a?a?a?a",
                       rules: Optional[str] = None,
                       wordlist: Optional[str] = None,
+                      devices: int = 1,
                       log=None) -> List[PrewarmSpec]:
     """Specs for every tuning-cache entry recorded for this device:
     `dprf tune` has already decided the batch each engine runs at, so
@@ -137,7 +154,8 @@ def tune_seeded_specs(device: str = "jax", hit_cap: int = 64,
             engine=engine, attack=attack, batch=batch, hit_cap=cap,
             mask=mask,
             rules=rules if attack == "wordlist" else None,
-            wordlist=wordlist if attack == "wordlist" else None))
+            wordlist=wordlist if attack == "wordlist" else None,
+            devices=max(1, int(devices))))
     return specs
 
 
@@ -145,12 +163,15 @@ def explicit_specs(engines: Sequence[str], attacks: Sequence[str],
                    hit_cap: int = 64, mask: str = "?a?a?a?a?a?a?a?a",
                    rules: Optional[str] = None,
                    wordlist: Optional[str] = None,
-                   batch=None) -> List[PrewarmSpec]:
+                   combinator: Optional[str] = None,
+                   batch=None, devices: int = 1) -> List[PrewarmSpec]:
     """engines x attacks, batch resolved per engine from the tuning
     cache (``batch=None``/"auto") or pinned by an explicit int.  The
     tuned-batch lookup carries the same key extras a job's resolution
     uses (hit_cap, and rules_n for wordlist attacks with a rule set),
-    so prewarm compiles the batch the job will actually run."""
+    so prewarm compiles the batch the job will actually run.
+    ``devices > 1`` builds every spec's SHARDED (multi-chip mesh)
+    shape instead of the single-device one."""
     from dprf_tpu.tune import lookup_tuned_batch
     rules_n = None
     if rules:
@@ -167,12 +188,46 @@ def explicit_specs(engines: Sequence[str], attacks: Sequence[str],
                                        extras=extras) or DEFAULT_BATCH
             else:
                 b = int(batch)
+            hybrid = attack in ("hybrid-wm", "hybrid-mw")
             specs.append(PrewarmSpec(
                 engine=eng, attack=attack, batch=b, hit_cap=hit_cap,
                 mask=mask,
                 rules=rules if attack == "wordlist" else None,
-                wordlist=wordlist if attack == "wordlist" else None))
+                wordlist=(wordlist if attack == "wordlist" or hybrid
+                          else None),
+                combinator=(combinator if attack == "combinator"
+                            else None),
+                devices=max(1, int(devices))))
     return specs
+
+
+def _combinator_gen(spec: PrewarmSpec, oracle):
+    """Combinator/hybrid generator from the spec's REAL word files
+    (both side tables are embedded in the compiled program, so
+    stand-ins are refused exactly like wordlist shapes; the hybrid
+    mask side is synthesized from spec.mask, as in a real job)."""
+    from dprf_tpu.cli import _build_combinator_gen
+    from dprf_tpu.utils.logging import DEFAULT as log
+    if spec.attack == "combinator":
+        if not spec.combinator:
+            raise ValueError(
+                "combinator prewarm needs the job's real left,right "
+                "word files (--combinator LEFT,RIGHT): the compiled "
+                "program embeds both word tables")
+        arg = spec.combinator
+    else:
+        if not spec.wordlist:
+            raise ValueError(
+                f"{spec.attack} prewarm needs the job's real wordlist "
+                "(--wordlist FILE): the compiled program embeds the "
+                "word table, so a synthetic list would cache a "
+                "program no job runs")
+        arg = (f"{spec.wordlist},{spec.mask}"
+               if spec.attack == "hybrid-wm"
+               else f"{spec.mask},{spec.wordlist}")
+    gen, _, _ = _build_combinator_gen(spec.attack, arg, {}, None,
+                                      oracle, "jax", log)
+    return gen
 
 
 def _build_worker(spec: PrewarmSpec):
@@ -199,11 +254,38 @@ def _build_worker(spec: PrewarmSpec):
         gen = WordlistRulesGenerator.from_files(
             spec.wordlist, spec.rules,
             max_len=_wordlist_max_len(spec.engine, oracle, "jax"))
-        maker = getattr(dev, "make_wordlist_worker", None)
+        maker_name = "make_wordlist_worker"
+    elif spec.attack in ("combinator", "hybrid-wm", "hybrid-mw"):
+        gen = _combinator_gen(spec, oracle)
+        maker_name = "make_combinator_worker"
     else:
         from dprf_tpu.generators.mask import MaskGenerator
         gen = MaskGenerator(spec.mask)
-        maker = getattr(dev, "make_mask_worker", None)
+        maker_name = "make_mask_worker"
+    if spec.devices > 1:
+        # sharded (multi-chip mesh) step shape, through the same
+        # factory a `--devices N` job selects
+        import jax
+        have = len(jax.devices())
+        if have < spec.devices:
+            raise SkipSpec(f"host has {have} device(s); the sharded "
+                           f"shape needs {spec.devices}")
+        from dprf_tpu.parallel.mesh import make_mesh
+        smaker = getattr(
+            dev, "make_sharded_" + maker_name[len("make_"):], None)
+        if not callable(smaker):
+            # a `--devices N` job for this engine warns and falls back
+            # to one chip (cli._select_worker); mirror that as a skip,
+            # not an error, so a fleet-wide sharded bake over mixed
+            # engines doesn't read as failed
+            raise SkipSpec(f"engine {spec.engine} has no sharded "
+                           f"{spec.attack} worker (a job falls back "
+                           "to one chip)")
+        per_dev = (max(1, spec.batch // gen.n_rules)
+                   if spec.attack == "wordlist" else spec.batch)
+        return smaker(gen, [target], make_mesh(spec.devices), per_dev,
+                      hit_capacity=spec.hit_cap, oracle=oracle)
+    maker = getattr(dev, maker_name, None)
     if not callable(maker):
         raise ValueError(f"engine {spec.engine} has no {spec.attack} "
                          "device worker")
@@ -224,13 +306,26 @@ def prewarm_one(spec: PrewarmSpec, log=None) -> PrewarmResult:
         return PrewarmResult(
             spec.engine, spec.attack, spec.batch,
             compile_s=getattr(worker, "compile_seconds", 0.0),
-            cache=getattr(worker, "compile_cache", "off"))
+            cache=getattr(worker, "compile_cache", "off"),
+            devices=spec.devices)
+    except SkipSpec as e:
+        # not an error: this host simply cannot compile the shape
+        # (e.g. a sharded spec on a single-device box); the fleet
+        # image builder runs prewarm on a host that can
+        if log is not None:
+            log.info("prewarm spec skipped", engine=spec.engine,
+                     attack=spec.attack, devices=spec.devices,
+                     reason=str(e))
+        return PrewarmResult(spec.engine, spec.attack, spec.batch,
+                             cache="skip", devices=spec.devices,
+                             skipped=str(e))
     except Exception as e:   # noqa: BLE001 -- parse/build/compile errors
         if log is not None:
             log.warn("prewarm spec failed", engine=spec.engine,
                      attack=spec.attack,
                      error=f"{type(e).__name__}: {e}")
         return PrewarmResult(spec.engine, spec.attack, spec.batch,
+                             devices=spec.devices,
                              error=f"{type(e).__name__}: {e}")
 
 
@@ -274,14 +369,17 @@ def _run_children(specs: List[PrewarmSpec], jobs: int,
                         d["engine"], d["attack"], d["batch"],
                         compile_s=d.get("compile_s", 0.0),
                         cache=d.get("cache", "off"),
-                        error=d.get("error")))
+                        error=d.get("error"),
+                        devices=d.get("devices", 1),
+                        skipped=d.get("skipped")))
                 except (ValueError, KeyError):
                     continue
-        reported = {(r.engine, r.attack, r.batch) for r in got}
+        reported = {(r.engine, r.attack, r.batch, r.devices)
+                    for r in got}
         for s in shard:                    # child died mid-shard
-            if (s.engine, s.attack, s.batch) not in reported:
+            if (s.engine, s.attack, s.batch, s.devices) not in reported:
                 got.append(PrewarmResult(
-                    s.engine, s.attack, s.batch,
+                    s.engine, s.attack, s.batch, devices=s.devices,
                     error=f"prewarm child rc={proc.returncode}"))
         if proc.returncode != 0 and log is not None:
             log.warn("prewarm child failed", rc=proc.returncode,
@@ -293,13 +391,15 @@ def _run_children(specs: List[PrewarmSpec], jobs: int,
 def render_table(results: Sequence[PrewarmResult]) -> str:
     """The human summary `dprf prewarm` prints to stderr via the log
     (the stdout JSON line stays machine-parseable)."""
-    rows = [("engine", "attack", "batch", "compile_s", "cached?")]
+    rows = [("engine", "attack", "devs", "batch", "compile_s",
+             "cached?")]
     for r in results:
-        rows.append((r.engine, r.attack, str(r.batch),
-                     f"{r.compile_s:.2f}",
-                     r.error if r.error else
-                     {"hit": "yes", "miss": "no (now cached)"}.get(
-                         r.cache, r.cache)))
+        status = (r.error if r.error
+                  else f"skipped ({r.skipped})" if r.skipped
+                  else {"hit": "yes", "miss": "no (now cached)"}.get(
+                      r.cache, r.cache))
+        rows.append((r.engine, r.attack, str(r.devices), str(r.batch),
+                     f"{r.compile_s:.2f}", status))
     widths = [max(len(row[i]) for row in rows)
               for i in range(len(rows[0]))]
     return "\n".join("  ".join(c.ljust(w) for c, w in zip(row, widths))

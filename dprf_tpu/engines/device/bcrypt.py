@@ -610,6 +610,9 @@ class BcryptMaskWorker(_BcryptWorkerBase):
                     gidx = bstart + int(lane)
                     hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
         return hits
+    # this sweep overlaps internally (queue-then-decode); an
+    # inherited submit() would bypass the override
+    process._serial_only = True
 
 
 class ShardedBcryptMaskWorker(_BcryptWorkerBase):
@@ -656,6 +659,9 @@ class ShardedBcryptMaskWorker(_BcryptWorkerBase):
                     gidx = bstart + int(lane)
                     hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
         return hits
+    # this sweep overlaps internally (queue-then-decode); an
+    # inherited submit() would bypass the override
+    process._serial_only = True
 
 
 class ShardedBcryptWordlistWorker(_BcryptWorkerBase):
@@ -711,6 +717,9 @@ class ShardedBcryptWordlistWorker(_BcryptWorkerBase):
                         continue
                     hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
         return hits
+    # this sweep overlaps internally (queue-then-decode); an
+    # inherited submit() would bypass the override
+    process._serial_only = True
 
 
 class BcryptWordlistWorker(_BcryptWorkerBase):
@@ -765,3 +774,6 @@ class BcryptWordlistWorker(_BcryptWorkerBase):
                         continue
                     hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
         return hits
+    # this sweep overlaps internally (queue-then-decode); an
+    # inherited submit() would bypass the override
+    process._serial_only = True

@@ -246,6 +246,9 @@ class DescryptMaskWorker(MaskWorkerBase):
             self._current_tis = tis
             hits.extend(super().process(unit))
         return hits
+    # this sweep overlaps internally (queue-then-decode); an
+    # inherited submit() would bypass the override
+    process._serial_only = True
 
     def _rescan(self, bstart, unit, window: int = 0):
         # scope the exact rescan to THIS block's targets: the base
@@ -293,6 +296,9 @@ class DescryptWordlistWorker(DeviceWordlistWorker):
             self._current_tis = tis
             hits.extend(super().process(unit))
         return hits
+    # this sweep overlaps internally (queue-then-decode); an
+    # inherited submit() would bypass the override
+    process._serial_only = True
 
     def _rescan_words(self, ws, nw, unit):
         # block-scoped exact rescan; see DescryptMaskWorker._rescan

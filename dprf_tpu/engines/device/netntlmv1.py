@@ -186,6 +186,9 @@ class NetNtlmV1MaskWorker(MaskWorkerBase):
                     gidx = bstart + int(lane)
                     hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
         return hits
+    # this sweep overlaps internally (queue-then-decode); an
+    # inherited submit() would bypass the override
+    process._serial_only = True
 
     def _rescan_one(self, bstart: int, unit, ti: int):
         from dprf_tpu.runtime.worker import CpuWorker
@@ -243,6 +246,9 @@ class NetNtlmV1WordlistWorker(DeviceWordlistWorker):
             self.targets = all_targets
             self.multi = len(all_targets) > 1
         return hits
+    # this sweep overlaps internally (queue-then-decode); an
+    # inherited submit() would bypass the override
+    process._serial_only = True
 
 
 @register("netntlmv1", device="jax")

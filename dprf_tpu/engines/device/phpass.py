@@ -226,6 +226,9 @@ class PhpassMaskWorker(_PhpassWorkerBase):
                     gidx = bstart + int(lane)
                     hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
         return hits
+    # this sweep overlaps internally (queue-then-decode); an
+    # inherited submit() would bypass the override
+    process._serial_only = True
 
 
 class PhpassWordlistWorker(_PhpassWorkerBase):
@@ -269,6 +272,9 @@ class PhpassWordlistWorker(_PhpassWorkerBase):
                         continue
                     hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
         return hits
+    # this sweep overlaps internally (queue-then-decode); an
+    # inherited submit() would bypass the override
+    process._serial_only = True
 
 
 class ShardedPhpassMaskWorker(PhpassMaskWorker):
@@ -307,6 +313,9 @@ class ShardedPhpassMaskWorker(PhpassMaskWorker):
                     gidx = bstart + int(lane)
                     hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
         return hits
+    # this sweep overlaps internally (queue-then-decode); an
+    # inherited submit() would bypass the override
+    process._serial_only = True
 
 
 @register("phpass", device="jax")

@@ -273,6 +273,9 @@ class PallasPmkidWorker:
                     gidx = bstart + int(lane)
                     hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
         return hits
+    # this sweep overlaps internally (queue-then-decode); an
+    # inherited submit() would bypass the override
+    process._serial_only = True
 
 
 def maybe_pallas_pmkid_worker(engine, gen, targets, batch: int,

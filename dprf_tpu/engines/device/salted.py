@@ -354,6 +354,9 @@ class SaltedMaskWorker(_SaltedWorkerBase):
                 hits.extend(self._entry_hits(ti, bstart, window, result,
                                              unit))
         return hits
+    # this sweep overlaps internally (queue-then-decode); an
+    # inherited submit() would bypass the override
+    process._serial_only = True
 
     def _entry_hits(self, ti: int, bstart: int, window: int, result,
                     unit: WorkUnit) -> list[Hit]:
@@ -437,6 +440,9 @@ class SaltedWordlistWorker(_SaltedWorkerBase):
                     if self._accept(ti, gidx, plain):
                         hits.append(Hit(ti, gidx, plain))
         return hits
+    # this sweep overlaps internally (queue-then-decode); an
+    # inherited submit() would bypass the override
+    process._serial_only = True
 
 
 class PallasSaltedMaskWorker(SaltedMaskWorker):
@@ -634,6 +640,9 @@ class ShardedSaltedMaskWorker(SaltedMaskWorker):
                     if self._accept(ti, gidx, plain):
                         hits.append(Hit(ti, gidx, plain))
         return hits
+    # this sweep overlaps internally (queue-then-decode); an
+    # inherited submit() would bypass the override
+    process._serial_only = True
 
 
 class _SaltedDeviceMixin:

@@ -206,6 +206,9 @@ class Wpa2MaskWorker(PhpassMaskWorker):
             self.step = self._steps[self._keyvers[ti]]
             hits.extend(self._sweep_one(unit, ti))
         return hits
+    # this sweep overlaps internally (queue-then-decode); an
+    # inherited submit() would bypass the override
+    process._serial_only = True
 
     def _sweep_one(self, unit, ti):
         from dprf_tpu.runtime.worker import Hit

@@ -66,7 +66,14 @@ def init_multihost(coordinator: Optional[str] = None,
     """
     import jax as _jax
 
-    if _jax.distributed.is_initialized():
+    is_init = getattr(_jax.distributed, "is_initialized", None)
+    if is_init is None:
+        # jax < 0.5 has no is_initialized(); the client handle on the
+        # internal global state is the same answer
+        def is_init():
+            from jax._src import distributed as _dist
+            return getattr(_dist.global_state, "client", None) is not None
+    if is_init():
         return False      # already initialized: idempotent no-op
     kwargs = {}
     if coordinator is not None:

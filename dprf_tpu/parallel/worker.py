@@ -15,7 +15,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from dprf_tpu.engines.base import HashEngine, Target
-from dprf_tpu.runtime.worker import (Hit, MaskWorkerBase,
+from dprf_tpu.runtime.worker import (Hit, MaskWorkerBase, PendingUnit,
                                      WordlistWorkerBase, word_cover_range)
 from dprf_tpu.runtime.workunit import WorkUnit
 
@@ -101,7 +101,12 @@ class ShardedWordlistWorker(WordlistWorkerBase):
         self.word_batch = self.super_words = self.step.super_words
         self.stride = self.super_words * gen.n_rules
 
-    def process(self, unit: WorkUnit) -> list[Hit]:
+    def submit(self, unit: WorkUnit) -> PendingUnit:
+        """Enqueue ALL sharded device work for the unit and return a
+        PendingUnit (the MaskWorkerBase.submit contract): the unit-
+        level hit flag is accumulated on device, so a hitless unit
+        costs one scalar readback and the worker pipelines through
+        submit_or_process like the single-device paths."""
         import jax.numpy as jnp
         w_start, w_end = word_cover_range(unit, self.gen.n_rules)
         queued = []
@@ -111,21 +116,29 @@ class ShardedWordlistWorker(WordlistWorkerBase):
             if nw <= 0:
                 break
             result = self.step(jnp.int32(ws), jnp.int32(nw))
-            # device-accumulated unit flag; see MaskWorkerBase.process
+            # device-accumulated unit flag; see MaskWorkerBase.submit
             f = self._batch_flag(result)
             flag = f if flag is None else flag + f
-            queued.append((ws, nw, result))
-        if flag is None or int(flag) == 0:
+            queued.append(("wshard", (ws, nw), result))
+        if flag is not None and hasattr(flag, "copy_to_host_async"):
+            flag.copy_to_host_async()
+        return PendingUnit(self, unit, queued, flag)
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        return self.submit(unit).resolve()
+
+    process._submit_based = True   # safe to pipeline via submit()
+
+    def _decode_queued(self, kind: str, start, result,
+                       unit: WorkUnit) -> list[Hit]:
+        if kind != "wshard":
+            return super()._decode_queued(kind, start, result, unit)
+        ws, nw = start
+        total, counts, lanes, tpos = result
+        if int(total) == 0:
             return []
-        hits: list[Hit] = []
-        for ws, nw, result in queued:
-            total, counts, lanes, tpos = result
-            if int(total) == 0:
-                continue
-            if (np.asarray(counts) > self.hit_capacity).any():
-                hits.extend(self._rescan_words(ws, nw, unit))
-                continue
-            hits.extend(self._collect_word_hits(
-                np.asarray(lanes).ravel(), np.asarray(tpos).ravel(),
-                ws, unit))
-        return hits
+        if (np.asarray(counts) > self.hit_capacity).any():
+            return self._rescan_words(ws, nw, unit)
+        return self._collect_word_hits(
+            np.asarray(lanes).ravel(), np.asarray(tpos).ravel(),
+            ws, unit)

@@ -156,10 +156,12 @@ class Coordinator:
                                     hit.plaintext)
         return True
 
-    #: units dispatched ahead of the oldest unresolved one.  Depth 2 is
-    #: enough to overlap one unit's flag round trip with the next
-    #: unit's compute (the only latency in the loop); deeper queues
-    #: just hold more leases without hiding more.
+    #: default units dispatched ahead of the oldest unresolved one
+    #: (``DPRF_PIPELINE_DEPTH`` overrides -- worker.pipeline_depth is
+    #: the one resolution site, shared with the remote worker_loop).
+    #: Depth 2 is enough to overlap one unit's flag round trip with
+    #: the next unit's compute (the only latency in the local loop);
+    #: deeper queues just hold more leases without hiding more.
     PIPELINE_DEPTH = 2
 
     def _finish_unit(self, unit, hits) -> None:
@@ -178,7 +180,7 @@ class Coordinator:
                 self._record(hit)   # oracle-produced: verifies trivially
 
     def run(self) -> JobResult:
-        from dprf_tpu.runtime.worker import submit_or_process
+        from dprf_tpu.runtime.worker import UnitPipeline, pipeline_depth
 
         t0 = time.perf_counter()
         tested0 = self.dispatcher.progress()[0]
@@ -194,10 +196,12 @@ class Coordinator:
         ensure_warm = getattr(self.worker, "ensure_warm", None)
         if self.session is not None:
             self.session.open(self.spec.as_dict())
-        # (unit, PendingUnit) FIFO: device work for every queued unit is
-        # already dispatched; resolving the head overlaps its readback
-        # latency with the tail's compute.
-        pending: list = []
+        # Submit-ahead FIFO (shared with the remote worker_loop):
+        # device work for every queued unit is already dispatched;
+        # resolving the head overlaps its readback latency with the
+        # tail's compute.
+        pipeline = UnitPipeline(self.worker,
+                                pipeline_depth(self.PIPELINE_DEPTH))
         warm_pending = ensure_warm is not None
         # DPRF_JAX_PROFILE=<dir>: kernel-level drill-down beside the
         # span timeline (no-op when unset; degrades safely if a
@@ -206,8 +210,7 @@ class Coordinator:
         profile.__enter__()
         try:
             while not self._all_found():
-                while (len(pending) < self.PIPELINE_DEPTH
-                       and not self.dispatcher.done()):
+                while not pipeline.full and not self.dispatcher.done():
                     unit = self.dispatcher.lease()
                     if unit is None:
                         break
@@ -233,16 +236,14 @@ class Coordinator:
                                 cache=getattr(self.worker,
                                               "compile_cache", None),
                                 overlapped=True)
-                    pending.append((unit, submit_or_process(self.worker,
-                                                            unit),
-                                    time.monotonic()))
-                if not pending:
+                    pipeline.submit(unit)
+                if not len(pipeline):
                     if self.dispatcher.done() or \
                             self.dispatcher.outstanding_count() == 0:
                         break        # exhausted
                     time.sleep(0.01)
                     continue
-                unit, p, t_submit = pending.pop(0)
+                unit, p, t_submit, _ = pipeline.pop()
                 ctx = self.dispatcher.trace_context(unit.unit_id)
                 hits = p.resolve()
                 unit_s = time.monotonic() - t_submit

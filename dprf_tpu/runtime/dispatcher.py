@@ -228,11 +228,49 @@ class Dispatcher:
         self._g_outstanding.set(len(self._outstanding))
         return unit
 
-    def complete(self, unit_id: int,
-                 elapsed: Optional[float] = None) -> None:
-        entry = self._outstanding.pop(unit_id, None)
+    def lease_many(self, worker_id: str, n: int) -> list:
+        """Up to n units for ONE worker in one call -- the RPC
+        lease-ahead form: a pipelined remote worker holds several
+        leases so the next super-step is on its device stream while
+        the previous unit's hits decode and the report round trip
+        flies.  Accounting stays strictly per-unit: each lease gets
+        its own span, deadline, and reissue path, so an aheaded unit
+        whose lease expires while queued is released exactly like a
+        running one."""
+        out = []
+        for _ in range(max(0, int(n))):
+            unit = self.lease(worker_id)
+            if unit is None:
+                break
+            out.append(unit)
+        return out
+
+    def outstanding_for(self, worker_id: str) -> int:
+        """Leases this worker currently holds (multi-outstanding
+        accounting: the RPC layer caps lease-ahead against it)."""
+        return sum(1 for (_, wid, _, _) in self._outstanding.values()
+                   if wid == worker_id)
+
+    def lease_holder(self, unit_id: int) -> Optional[str]:
+        """Worker currently holding the unit's lease (None once it is
+        completed, failed, or reaped)."""
+        entry = self._outstanding.get(unit_id)
+        return entry[1] if entry is not None else None
+
+    def complete(self, unit_id: int, elapsed: Optional[float] = None,
+                 worker_id: Optional[str] = None) -> bool:
+        """Mark a leased unit done; returns True iff this call covered
+        it.  A late completion of an already-reissued unit is
+        idempotent: when ``worker_id`` is given and the lease moved to
+        ANOTHER worker, the stale report is dropped (the live holder
+        owns the completion -- no double-complete, no double count),
+        and a unit with no live lease at all is simply ignored."""
+        entry = self._outstanding.get(unit_id)
         if entry is None:
-            return   # late completion of an already-reissued unit: idempotent
+            return False
+        if worker_id is not None and entry[1] != worker_id:
+            return False   # reissued to another worker: stale report
+        del self._outstanding[unit_id]
         unit, worker_id, _, lease_sid = entry
         self._done.add(unit.start, unit.end)
         self._retries.pop(unit_id, None)
@@ -247,6 +285,7 @@ class Dispatcher:
         self._m_completed.inc()
         self._g_covered.set(self._done.covered())
         self._g_outstanding.set(len(self._outstanding))
+        return True
 
     def _observe_failure(self, worker_id: Optional[str]) -> None:
         """Crash history -> unit sizing: every failed attempt / lease
@@ -293,17 +332,27 @@ class Dispatcher:
                                reason=reason)
             self._m_reissued.inc(reason=reason)
 
-    def fail(self, unit_id: int) -> None:
-        entry = self._outstanding.pop(unit_id, None)
-        if entry is not None:
-            unit, worker_id, _, lease_sid = entry
-            self.tracer.record("fail",
-                               trace=self._trace_ids.get(unit_id),
-                               parent=lease_sid, proc="coordinator",
-                               worker=worker_id, unit=unit_id)
-            self._requeue(unit, "failed", worker_id=worker_id,
-                          lease_sid=lease_sid)
-            self._g_outstanding.set(len(self._outstanding))
+    def fail(self, unit_id: int,
+             worker_id: Optional[str] = None) -> bool:
+        """Release a leased unit back to the queue; returns True iff
+        this call released it.  Stale-guarded like complete(): a fail
+        report from a worker that no longer holds the lease must not
+        tear the live holder's attempt off the ledger."""
+        entry = self._outstanding.get(unit_id)
+        if entry is None:
+            return False
+        if worker_id is not None and entry[1] != worker_id:
+            return False   # reissued to another worker: stale report
+        del self._outstanding[unit_id]
+        unit, holder, _, lease_sid = entry
+        self.tracer.record("fail",
+                           trace=self._trace_ids.get(unit_id),
+                           parent=lease_sid, proc="coordinator",
+                           worker=holder, unit=unit_id)
+        self._requeue(unit, "failed", worker_id=holder,
+                      lease_sid=lease_sid)
+        self._g_outstanding.set(len(self._outstanding))
+        return True
 
     def reap_expired(self) -> int:
         now = self._clock()

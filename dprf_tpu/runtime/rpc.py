@@ -51,6 +51,11 @@ from dprf_tpu.telemetry.trace import get_tracer, jax_profile_ctx
 
 MAX_LINE = 64 << 20   # hashlists can be large; candidates never cross
 
+#: leases one worker may hold at once (and the clamp on a lease
+#: request's ``ahead``): bounds how much of the queue a buggy or
+#: greedy client can vacuum into one host's ledger
+MAX_LEASE_AHEAD = 16
+
 
 class RpcError(RuntimeError):
     """Protocol-level failure talking to the coordinator (error
@@ -179,14 +184,32 @@ class CoordinatorState:
         return {"ok": True, "job": self.job}
 
     def op_lease(self, msg: dict) -> dict:
+        """Hand out the next unit(s).  The lease-ahead form
+        (``ahead=N``) returns up to N units in ``"units"`` so a
+        pipelined worker fills its submit-ahead queue in ONE round
+        trip; ``"unit"`` stays the first entry for pre-ahead clients.
+        Per-worker holdings are capped at MAX_LEASE_AHEAD."""
         with self.lock:
             if self._stopped():
                 return {"unit": None, "stop": True}
             wid = str(msg.get("worker_id", "?"))
             if wid in self.quarantined:
                 return {"unit": None, "stop": False, "quarantined": True}
-            unit = self.dispatcher.lease(wid)
-            if unit is None:
+            try:
+                ahead = int(msg.get("ahead", 1))
+            except (TypeError, ValueError):
+                ahead = 1
+            ahead = max(1, min(ahead, MAX_LEASE_AHEAD))
+            # reap BEFORE clamping against this worker's holdings: a
+            # restarted worker (same --id) still "holding" its crashed
+            # predecessor's expired leases would otherwise clamp to 0
+            # forever -- lease() below is the only reap site during an
+            # active job, and a clamp of 0 never reaches it
+            self.dispatcher.reap_expired()
+            ahead = min(ahead, max(
+                0, MAX_LEASE_AHEAD - self.dispatcher.outstanding_for(wid)))
+            units = self.dispatcher.lease_many(wid, ahead)
+            if not units:
                 # nothing leasable right now; workers retry unless done
                 return {"unit": None,
                         "stop": self.dispatcher.outstanding_count() == 0}
@@ -196,14 +219,22 @@ class CoordinatorState:
             # registry (holding a lease bounds the id set by the unit
             # ledger)
             self._touch_worker(wid)
-            resp = {"unit": {"id": unit.unit_id, "start": unit.start,
-                             "length": unit.length}}
-            # trace context OUT: the worker parents its rpc/warmup/
-            # sweep spans onto this lease, so the spans it ships back
-            # with complete/fail stitch onto the coordinator timeline
-            ctx = self.dispatcher.trace_context(unit.unit_id)
-            if ctx is not None:
-                resp["trace"] = {"trace": ctx[0], "span": ctx[1]}
+            entries = []
+            for unit in units:
+                e = {"id": unit.unit_id, "start": unit.start,
+                     "length": unit.length}
+                # trace context OUT, per unit: the worker parents its
+                # rpc/warmup/sweep spans onto this lease, so the spans
+                # it ships back with complete/fail stitch onto the
+                # coordinator timeline
+                ctx = self.dispatcher.trace_context(unit.unit_id)
+                if ctx is not None:
+                    e["trace"] = {"trace": ctx[0], "span": ctx[1]}
+                entries.append(e)
+            resp = {"unit": entries[0], "units": entries}
+            if "trace" in entries[0]:
+                # legacy single-unit clients read a top-level context
+                resp["trace"] = entries[0]["trace"]
             return resp
 
     def op_complete(self, msg: dict) -> dict:
@@ -260,11 +291,14 @@ class CoordinatorState:
             # it from the lease ledger: remote workers hash in their
             # own processes, so the coordinator's scrapeable registry
             # must carry the fleet's sweep count itself
+            raw_wid = msg.get("worker_id")
+            wid = str(raw_wid) if raw_wid is not None else "?"
+            # stale-guard context: with lease-ahead a crashed worker's
+            # LATE complete can arrive after its unit was reissued to
+            # another worker -- the live holder owns the completion
+            # (verified hits above were still recorded; hits dedupe)
+            guard = wid if raw_wid is not None else None
             unit = self.dispatcher.outstanding_unit(unit_id)
-            if unit is not None:
-                # liveness only for completions of real leases (see
-                # op_lease on label cardinality)
-                self._touch_worker(str(msg.get("worker_id", "?")))
             if rejected:
                 # The reporting worker's device path is suspect: requeue
                 # the range instead of marking it done, or a wrong
@@ -273,7 +307,6 @@ class CoordinatorState:
                 from dprf_tpu.utils.logging import DEFAULT as log
                 self.rejected += rejected
                 self._m_rejects.inc(rejected)
-                wid = str(msg.get("worker_id", "?"))
                 self.worker_rejects[wid] = \
                     self.worker_rejects.get(wid, 0) + 1
                 if (self.worker_rejects[wid] >= self.MAX_WORKER_REJECTS
@@ -294,14 +327,18 @@ class CoordinatorState:
                              "from several workers; range may hold an "
                              "unrecovered crack", unit=unit_id,
                              workers=len(rejecters))
-                    self.dispatcher.complete(unit_id)
+                    self.dispatcher.complete(unit_id, worker_id=guard)
                 else:
-                    self.dispatcher.fail(unit_id)
+                    self.dispatcher.fail(unit_id, worker_id=guard)
             else:
-                self.dispatcher.complete(unit_id, elapsed=elapsed)
-                if unit is not None:
-                    # rejected units requeue and are NOT counted: the
-                    # range will be re-swept by another worker
+                completed = self.dispatcher.complete(
+                    unit_id, elapsed=elapsed, worker_id=guard)
+                if completed and unit is not None:
+                    # liveness only for completions of real leases (see
+                    # op_lease on label cardinality); stale or rejected
+                    # units are NOT counted -- the range is (re)swept by
+                    # the live holder, whose complete counts it once
+                    self._touch_worker(wid)
                     self._m_cands.inc(unit.length,
                                       engine=self.job.get("engine", "?"),
                                       device="remote")
@@ -317,8 +354,11 @@ class CoordinatorState:
         self.tracer.ingest(msg.get("spans"),
                            proc=str(msg.get("worker_id", "?")),
                            sent_at=msg.get("clock"))
+        raw_wid = msg.get("worker_id")
         with self.lock:
-            self.dispatcher.fail(int(msg["unit_id"]))
+            self.dispatcher.fail(
+                int(msg["unit_id"]),
+                worker_id=str(raw_wid) if raw_wid is not None else None)
         return {"ok": True}
 
     def op_trace_tail(self, msg: dict) -> dict:
@@ -330,10 +370,21 @@ class CoordinatorState:
             n = int(msg.get("n", 200))
         except (TypeError, ValueError):
             n = 200
+        n = max(1, min(n, 2000))
         trace = msg.get("trace")
-        spans = self.tracer.tail(max(1, min(n, 2000)),
-                                 trace=trace if isinstance(trace, str)
-                                 else None)
+        trace = trace if isinstance(trace, str) else None
+        since = msg.get("since")
+        resync = False
+        if isinstance(since, str) and since:
+            # incremental read (`dprf top --follow`): only spans newer
+            # than the caller's cursor; resync=True means the cursor
+            # fell off the ring and the payload is a full tail the
+            # caller must REPLACE its buffer with
+            spans, resync = self.tracer.tail_after(since, n, trace=trace)
+        else:
+            spans = self.tracer.tail(n, trace=trace)
+        cursor = spans[-1].get("span") if spans else (
+            since if isinstance(since, str) else None)
         with self.lock:
             done, total = self.dispatcher.progress()
             leases = self.dispatcher.outstanding_leases()
@@ -349,7 +400,7 @@ class CoordinatorState:
                       "now": time.time(),
                       "quarantined": sorted(self.quarantined)}
         return {"ok": True, "spans": spans, "leases": leases,
-                "status": status}
+                "status": status, "cursor": cursor, "resync": resync}
 
     def op_retry_parked(self, msg: dict) -> dict:
         """Admin op (`dprf retry-parked --connect`): requeue poisoned/
@@ -585,9 +636,27 @@ class CoordinatorClient:
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
                  token: Optional[str] = None):
+        self._addr = (host, port)
+        self._timeout = timeout
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._fh = self._sock.makefile("rb")
         self._token = token
+
+    def clone(self) -> "CoordinatorClient":
+        """A second authenticated connection to the same coordinator
+        -- the async completion sender's channel, so report round
+        trips ride beside the lease/sweep loop instead of inside it.
+        Authentication is per-connection, so a token-auth'd clone
+        answers its own hello challenge here."""
+        peer = type(self)(self._addr[0], self._addr[1],
+                          timeout=self._timeout, token=self._token)
+        if self._token:
+            try:
+                peer.hello()
+            except BaseException:
+                peer.close()
+                raise
+        return peer
 
     def hello(self) -> dict:
         """Fetch the job, answering the coordinator's auth challenge if
@@ -631,13 +700,82 @@ class CoordinatorClient:
             pass
 
 
+class _CompletionSender:
+    """Ships ``complete``/``fail`` reports from a background thread on
+    a dedicated connection, so the report round trip overlaps the next
+    sweep instead of serializing with it.  Ordering is preserved (one
+    FIFO queue, one thread); the first send failure is latched and
+    re-raised by ``drain()`` -- the crash-surfacing contract of the
+    serial loop.  Reports queued after a failure are dropped: their
+    leases expire and reissue, and the latched error aborts the loop
+    anyway."""
+
+    def __init__(self, client: CoordinatorClient):
+        import queue
+        self._client = client
+        self._q: "queue.Queue" = queue.Queue()
+        self.error: Optional[BaseException] = None
+        self.stop_seen = False
+        self._t = threading.Thread(target=self._run, daemon=True,
+                                   name="dprf-sender")
+        self._t.start()
+
+    def send(self, op: str, **kw) -> None:
+        self._q.put((op, kw))
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            op, kw = item
+            try:
+                if self.error is None:
+                    # clock stamped at SEND time: the coordinator
+                    # rebases the shipped span timestamps against it
+                    resp = self._client.call(op, clock=time.time(),
+                                             **kw)
+                    if resp.get("stop"):
+                        self.stop_seen = True
+            except Exception as e:   # noqa: BLE001 -- latched, then
+                self.error = e       # re-raised by drain()
+            finally:
+                self._q.task_done()
+
+    def drain(self) -> None:
+        """Block until every queued report was sent (or dropped past a
+        failure), then re-raise the first send failure."""
+        self._q.join()
+        if self.error is not None:
+            raise self.error
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._t.join(timeout=30)
+        self._client.close()
+
+
 def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                 idle_sleep: float = 0.5, log=None, registry=None,
-                recorder=None) -> int:
-    """Lease -> process -> complete until the coordinator says stop.
+                recorder=None, depth: Optional[int] = None) -> int:
+    """Pipelined lease -> submit-ahead -> resolve -> async-complete
+    loop, until the coordinator says stop.  Returns units completed.
 
     worker: any object with .process(WorkUnit) -> list[Hit] (the same
-    duck type the local Coordinator drives).  Returns units completed.
+    duck type the local Coordinator drives).  Submit-based workers
+    (``process._submit_based``) enqueue unit N+1's device work BEFORE
+    unit N resolves, so the next super-step is on the device stream
+    while the host decodes hits and the RPC round trips fly; serial
+    workers still gain the lease-ahead batch and the overlapped
+    completion report.  ``depth`` defaults to the shared
+    ``DPRF_PIPELINE_DEPTH`` knob; depth 1 is the serial fallback (one
+    connection, synchronous completes -- the pre-pipelining loop).
+
+    Crash surfacing matches the serial loop: a processing failure
+    fails the aborted unit AND every queued lease, then re-raises;
+    queued completion reports are drained before any return, and the
+    first async send failure is re-raised.
 
     Tracing: the lease response's trace context parents this worker's
     ``rpc`` / ``warmup`` / ``sweep`` spans, which ship back inside the
@@ -646,6 +784,8 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
     touched it.  ``DPRF_JAX_PROFILE=<dir>`` additionally wraps the
     loop in a jax.profiler trace.
     """
+    from dprf_tpu.runtime.worker import UnitPipeline, pipeline_depth
+
     m = get_registry(registry)
     tracer = get_tracer(recorder)
     # worker-side publication: candidates are counted where the hashing
@@ -658,114 +798,258 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
     device = "cpu" if type(worker).__name__ == "CpuWorker" else "jax"
     m_cands = jm["cands"]
     h_unit = jm["unit_seconds"]
+    g_depth = m.gauge(
+        "dprf_worker_pipeline_depth",
+        "units this worker submits ahead of the oldest unresolved one "
+        "(1 = serial loop)")
+    c_idle = m.counter(
+        "dprf_worker_idle_seconds",
+        "seconds this worker held no submitted unit between sweeps "
+        "(pipeline drained: the device idles while RPCs fly)")
+    if depth is None:
+        depth = pipeline_depth()
+    sender = None
+    if depth > 1:
+        try:
+            sender = _CompletionSender(client.clone())
+        except (OSError, RpcError) as e:
+            if log:
+                log.warn("completion-sender connection failed; "
+                         "running the serial loop", error=str(e))
+            depth = 1
+    g_depth.set(depth)
+    pipe = UnitPipeline(worker, depth)
     done_units = 0
+    stop_seen = False
+    idle_mark: Optional[float] = None
+    t_last_resolve: Optional[float] = None
     warm_pending = getattr(worker, "ensure_warm", None) is not None
-    with jax_profile_ctx(log=log):
-        while True:
-            t_lease = time.monotonic()
-            try:
-                resp = client.call("lease", worker_id=worker_id)
-            except ConnectionError:
-                # The coordinator serves through its drain window and
-                # answers every lease poll with an explicit stop flag
-                # once the job is over, so a worker always learns
-                # completion in-band and returns below.  A bare
-                # connection drop here therefore means the coordinator
-                # crashed mid-job: surface it so scripted workers don't
-                # report success on unfinished work (a clean rc used to
-                # hide exactly that).
-                raise ConnectionError(
-                    "coordinator connection dropped before any stop "
-                    "signal (coordinator crash mid-job?)")
-            if resp.get("quarantined"):
-                raise RpcError(
-                    "coordinator quarantined this worker: its reported "
-                    "hits repeatedly failed oracle verification "
-                    "(divergent device path?)")
-            unit_d = resp.get("unit")
-            if unit_d is None:
-                if resp.get("stop"):
-                    return done_units
-                time.sleep(idle_sleep)
-                continue
-            unit = WorkUnit(unit_d["id"], unit_d["start"],
-                            unit_d["length"])
-            ctx = resp.get("trace") or {}
-            tid, lease_sid = ctx.get("trace"), ctx.get("span")
-            ship = []
-            ev = tracer.record("rpc", dur=time.monotonic() - t_lease,
-                               trace=tid, parent=lease_sid,
-                               proc=worker_id, op="lease",
-                               unit=unit.unit_id)
-            if ev:
-                ship.append(ev)
-            t_unit = time.monotonic()
-            try:
-                # join an overlapped warmup (cli.cmd_worker starts one
-                # before the loop, so the step compile overlapped the
-                # lease round trip); inside the try so a compile failure
-                # releases the lease like any processing failure
-                ensure_warm = getattr(worker, "ensure_warm", None)
-                if ensure_warm is not None:
-                    ensure_warm()
-                if warm_pending:
-                    # the compile ran overlapped on a background thread;
-                    # report its REAL cost (compile_seconds), not the
-                    # near-zero join time, so a fleet stalled on cold
-                    # compiles is legible in the trace
-                    warm_pending = False
-                    warm_s = getattr(worker, "compile_seconds", None)
-                    if warm_s is not None:
-                        ev = tracer.record(
-                            "warmup", dur=float(warm_s), trace=tid,
-                            parent=lease_sid, proc=worker_id,
-                            engine=eng_name,
-                            cache=getattr(worker, "compile_cache",
-                                          None), overlapped=True)
-                        if ev:
-                            ship.append(ev)
-                hits = worker.process(unit)
-            except Exception as e:
-                # the aborted attempt still joins the timeline: ship
-                # what we have with the fail report, then release the
-                # lease for another worker and surface the bug
-                ev = tracer.record("sweep",
-                                   dur=time.monotonic() - t_unit,
-                                   trace=tid, parent=lease_sid,
-                                   proc=worker_id, unit=unit.unit_id,
-                                   error=type(e).__name__)
+    cur = None        # entry being submitted/resolved, for the fail path
+    lease_q: list = []    # leased-but-not-yet-submitted batch remainder
+
+    def send_report(op: str, **kw) -> Optional[dict]:
+        if sender is not None:
+            sender.send(op, **kw)
+            return None
+        return client.call(op, clock=time.time(), **kw)
+
+    def send_fail(unit_id: int, ship: list) -> None:
+        try:
+            send_report("fail", unit_id=unit_id, worker_id=worker_id,
+                        spans=ship)
+        except Exception:   # noqa: BLE001 -- best-effort, as serial
+            pass            # (the lease expires and reissues anyway)
+
+    try:
+        with jax_profile_ctx(log=log):
+            while True:
+                if sender is not None and sender.error is not None:
+                    # the coordinator stopped answering completion
+                    # reports: surface it like a serial complete would
+                    raise sender.error
+                want = pipe.depth - len(pipe)
+                entries = []
+                if want > 0 and not stop_seen:
+                    t_lease = time.monotonic()
+                    try:
+                        resp = client.call("lease", worker_id=worker_id,
+                                           ahead=want)
+                    except ConnectionError:
+                        # The coordinator serves through its drain
+                        # window and answers every lease poll with an
+                        # explicit stop flag once the job is over, so a
+                        # worker always learns completion in-band and
+                        # returns below.  A bare connection drop here
+                        # therefore means the coordinator crashed
+                        # mid-job: surface it so scripted workers don't
+                        # report success on unfinished work.
+                        raise ConnectionError(
+                            "coordinator connection dropped before any "
+                            "stop signal (coordinator crash mid-job?)")
+                    if resp.get("quarantined"):
+                        raise RpcError(
+                            "coordinator quarantined this worker: its "
+                            "reported hits repeatedly failed oracle "
+                            "verification (divergent device path?)")
+                    lease_rtt = time.monotonic() - t_lease
+                    entries = resp.get("units")
+                    if entries is None:
+                        # pre-lease-ahead coordinator: single unit with
+                        # a top-level trace context
+                        entries = []
+                        if resp.get("unit"):
+                            unit_d = dict(resp["unit"])
+                            if resp.get("trace"):
+                                unit_d.setdefault("trace", resp["trace"])
+                            entries = [unit_d]
+                    if not entries:
+                        if resp.get("stop"):
+                            stop_seen = True
+                        elif sender is not None:
+                            # all our reports are in flight: land them,
+                            # then trust the freshest stop answer (the
+                            # final complete's response carries it)
+                            # instead of sleeping into another poll
+                            sender.drain()
+                            if sender.stop_seen:
+                                stop_seen = True
+                        if len(pipe) == 0:
+                            if stop_seen:
+                                break
+                            time.sleep(idle_sleep)
+                            continue
+                    first = True
+                    lease_q = list(entries)
+                    while lease_q:
+                        unit_d = lease_q.pop(0)
+                        unit = WorkUnit(unit_d["id"], unit_d["start"],
+                                        unit_d["length"])
+                        ctx = unit_d.get("trace") or {}
+                        tid, lease_sid = ctx.get("trace"), ctx.get("span")
+                        ship: list = []
+                        if first:
+                            # one rpc span per lease round trip,
+                            # parented on the batch's first lease
+                            first = False
+                            ev = tracer.record(
+                                "rpc", dur=lease_rtt, trace=tid,
+                                parent=lease_sid, proc=worker_id,
+                                op="lease", unit=unit.unit_id,
+                                units=len(entries))
+                            if ev:
+                                ship.append(ev)
+                        cur = (unit, None, time.monotonic(),
+                               (tid, lease_sid, ship))
+                        # join an overlapped warmup (cli.cmd_worker
+                        # starts one before the loop, so the compile
+                        # overlapped the lease round trip); under the
+                        # fail path so a compile failure releases the
+                        # lease like any processing failure
+                        ensure_warm = getattr(worker, "ensure_warm",
+                                              None)
+                        if ensure_warm is not None:
+                            ensure_warm()
+                        if warm_pending:
+                            # the compile ran overlapped on a background
+                            # thread; report its REAL cost
+                            # (compile_seconds), not the near-zero join
+                            # time, so a fleet stalled on cold compiles
+                            # is legible in the trace
+                            warm_pending = False
+                            warm_s = getattr(worker, "compile_seconds",
+                                             None)
+                            if warm_s is not None:
+                                ev = tracer.record(
+                                    "warmup", dur=float(warm_s),
+                                    trace=tid, parent=lease_sid,
+                                    proc=worker_id, engine=eng_name,
+                                    cache=getattr(worker,
+                                                  "compile_cache",
+                                                  None),
+                                    overlapped=True)
+                                if ev:
+                                    ship.append(ev)
+                        if idle_mark is not None:
+                            # the pipeline had drained: that gap was
+                            # device-idle time (RPCs with no submitted
+                            # work to hide them behind)
+                            c_idle.inc(time.monotonic() - idle_mark)
+                            idle_mark = None
+                        pipe.submit(unit, meta=(tid, lease_sid, ship))
+                        cur = None
+                if len(pipe) == 0:
+                    if stop_seen:
+                        break
+                    continue
+                cur = pipe.pop()
+                unit, pending, t_submit, (tid, lease_sid, ship) = cur
+                hits = pending.resolve()
+                cur = None
+                now = time.monotonic()
+                unit_s = now - t_submit
+                # steady-state per-unit cost for the ADAPTIVE SIZER:
+                # the interval between consecutive resolves.  unit_s
+                # (submit->resolve) includes up to depth-1 units of
+                # queue wait behind the device stream, which would read
+                # as ~1/depth of the true throughput and shrink every
+                # subsequent unit; the completion interval measures the
+                # worker's real drain rate once the pipeline is primed.
+                # After a drain (no leasable work) the interval would
+                # instead carry starvation time, so it resets below and
+                # the next unit falls back to its own unit_s.
+                elapsed_report = (now - t_last_resolve
+                                  if t_last_resolve is not None
+                                  else unit_s)
+                t_last_resolve = now
+                if len(pipe) == 0:
+                    idle_mark = now
+                    t_last_resolve = None
+                # the histogram gets the same per-unit cost: observing
+                # unit_s here would inflate dprf_unit_seconds ~depth x
+                # under pipelining with no throughput change
+                h_unit.observe(elapsed_report)
+                m_cands.inc(unit.length, engine=eng_name, device=device)
+                # ts backdates to t_submit, so consecutive sweep spans
+                # OVERLAP when the loop pipelines (the invariant
+                # tools/trace_overlap.py checks)
+                ev = tracer.record("sweep", dur=unit_s, trace=tid,
+                                   parent=lease_sid, proc=worker_id,
+                                   unit=unit.unit_id, length=unit.length,
+                                   hits=len(hits))
                 if ev:
                     ship.append(ev)
-                try:
-                    # clock rides along so the coordinator can rebase
-                    # our wall-clock span timestamps onto its own
-                    client.call("fail", unit_id=unit.unit_id,
-                                worker_id=worker_id, spans=ship,
-                                clock=time.time())
-                except Exception:
-                    pass
-                raise
-            unit_s = time.monotonic() - t_unit
-            h_unit.observe(unit_s)
-            m_cands.inc(unit.length, engine=eng_name, device=device)
-            ev = tracer.record("sweep", dur=unit_s, trace=tid,
-                               parent=lease_sid, proc=worker_id,
-                               unit=unit.unit_id, length=unit.length,
-                               hits=len(hits))
+                payload = [{"target": h.target_index,
+                            "cand": h.cand_index,
+                            "plaintext": h.plaintext.hex()}
+                           for h in hits]
+                # elapsed rides the complete report: the coordinator's
+                # adaptive unit sizer turns it into this worker's next
+                # unit length; spans stitch the attempt onto the
+                # coordinator's flight recorder
+                resp = send_report("complete", unit_id=unit.unit_id,
+                                   hits=payload, worker_id=worker_id,
+                                   elapsed=elapsed_report, spans=ship)
+                done_units += 1
+                if log and hits:
+                    log.info("hits reported", count=len(hits))
+                if resp is not None and resp.get("stop"):
+                    stop_seen = True
+                if sender is not None and sender.stop_seen:
+                    stop_seen = True
+                if stop_seen and len(pipe) == 0:
+                    break
+        # clean exit: every queued report must land before we return
+        # (the serial loop's in-band completion contract); the first
+        # async send failure re-raises here
+        if sender is not None:
+            sender.drain()
+        return done_units
+    except BaseException as e:
+        if cur is not None:
+            # the aborted attempt still joins the timeline: ship what
+            # we have with the fail report, then release the lease (and
+            # every still-queued one) for another worker
+            unit, _, t_unit, (tid, lease_sid, ship) = cur
+            ev = tracer.record("sweep",
+                               dur=time.monotonic() - t_unit,
+                               trace=tid, parent=lease_sid,
+                               proc=worker_id, unit=unit.unit_id,
+                               error=type(e).__name__)
             if ev:
                 ship.append(ev)
-            payload = [{"target": h.target_index, "cand": h.cand_index,
-                        "plaintext": h.plaintext.hex()} for h in hits]
-            # elapsed rides the complete report: the coordinator's
-            # adaptive unit sizer turns it into this worker's next unit
-            # length; spans stitch the attempt onto the coordinator's
-            # flight recorder
-            resp = client.call("complete", unit_id=unit.unit_id,
-                               hits=payload, worker_id=worker_id,
-                               elapsed=unit_s, spans=ship,
-                               clock=time.time())
-            done_units += 1
-            if log and hits:
-                log.info("hits reported", count=len(hits))
-            if resp.get("stop"):
-                return done_units
+            send_fail(unit.unit_id, ship)
+        for q_unit, _, _, meta in pipe.drain():
+            send_fail(q_unit.unit_id, meta[2])
+        for unit_d in lease_q:
+            # leased but never submitted (the batch aborted first):
+            # release these too, or they pin the ledger until expiry
+            send_fail(unit_d["id"], [])
+        if sender is not None:
+            try:
+                sender._q.join()   # land the fails; the original
+            except Exception:      # error outranks any send failure
+                pass
+        raise
+    finally:
+        if sender is not None:
+            sender.close()

@@ -81,6 +81,71 @@ class _ResolvedUnit:
         return self.hits
 
 
+#: env override for the submit-ahead depth both pipelined loops run at
+PIPELINE_DEPTH_ENV = "DPRF_PIPELINE_DEPTH"
+
+
+def pipeline_depth(default: int = 2) -> int:
+    """Units submitted ahead of the oldest unresolved one -- the ONE
+    resolution site for the depth knob shared by Coordinator.run and
+    rpc.worker_loop.  ``DPRF_PIPELINE_DEPTH`` overrides (1 = serial
+    fallback: no overlap, no async completion); clamped to [1, 64] --
+    depth 2 already overlaps one unit's readback latency with the next
+    unit's compute, deeper queues just hold more leases without hiding
+    more."""
+    import os
+    raw = os.environ.get(PIPELINE_DEPTH_ENV)
+    if raw is not None:
+        try:
+            default = int(raw)
+        except ValueError:
+            pass
+    return max(1, min(int(default), 64))
+
+
+class UnitPipeline:
+    """Bounded submit-ahead FIFO of (unit, PendingUnit): device work
+    for every queued unit is already dispatched when it enters, so
+    resolving the head overlaps its readback latency with the tail's
+    compute.  The ONE pipelining implementation shared by the local
+    Coordinator.run and the remote rpc.worker_loop -- over the RPC
+    boundary the same overlap additionally hides the lease/complete
+    round trips behind the device stream."""
+
+    __slots__ = ("worker", "depth", "_q")
+
+    def __init__(self, worker, depth: int):
+        self.worker = worker
+        self.depth = max(1, int(depth))
+        self._q: list = []
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    @property
+    def full(self) -> bool:
+        return len(self._q) >= self.depth
+
+    def submit(self, unit, meta=None) -> None:
+        """Dispatch the unit's device work now (enqueue-only for
+        submit-based workers; a serial worker's process runs here) and
+        queue it for a later resolve."""
+        import time
+        self._q.append((unit, submit_or_process(self.worker, unit),
+                        time.monotonic(), meta))
+
+    def pop(self):
+        """Oldest (unit, pending, t_submit, meta); caller resolves."""
+        return self._q.pop(0)
+
+    def drain(self) -> list:
+        """Abandon every queued entry (failure path): entries oldest
+        first; in-flight device work is never resolved."""
+        entries = self._q[:]
+        self._q.clear()
+        return entries
+
+
 def word_cover_range(unit: WorkUnit, n_rules: int) -> tuple:
     """Covering word range [w_start, w_end) of a keyspace-index unit
     (index = word * n_rules + rule; ceil on the end)."""
@@ -132,6 +197,10 @@ class CpuWorker:
                     if ti is not None:
                         hits.append(Hit(ti, gidx, cand))
         return hits
+
+    #: host loop, no device stream to overlap -- pipelining a CpuWorker
+    #: just runs process() at submit time (tools/check_worker_contract)
+    process._serial_only = True
 
 
 class MaskWorkerBase:

@@ -28,6 +28,11 @@ Metric names (all prefixed ``dprf_``; see README "Observability"):
                                                 (0 after retry-parked)
   dprf_trace_spans_total                        flight-recorder spans
                                                 (telemetry/trace.py)
+  dprf_worker_pipeline_depth                    remote worker submit-
+                                                ahead depth (1=serial)
+  dprf_worker_idle_seconds                      seconds a worker held
+                                                no submitted unit
+                                                (device idle)
 
 Alongside metrics, telemetry/trace.py records per-unit lifecycle SPANS
 (the flight recorder): trace ids assigned at split time, context
@@ -79,7 +84,9 @@ def declare_job_metrics(m: MetricsRegistry) -> dict:
                          "targets cracked so far"),
         "unit_seconds": m.histogram(
             "dprf_unit_seconds",
-            "submit-to-resolve latency of one WorkUnit"),
+            "per-unit wall cost: submit-to-resolve, or the "
+            "inter-completion interval once a worker pipeline is "
+            "primed (queue wait behind the stream excluded)"),
     }
 
 

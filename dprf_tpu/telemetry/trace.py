@@ -304,6 +304,36 @@ class TraceRecorder:
             items = [s for s in items if s.get("trace") == trace]
         return [dict(s) for s in items[-max(1, int(n)):]]
 
+    def tail_after(self, since: Optional[str], n: int = 200,
+                   trace: Optional[str] = None) -> tuple:
+        """Incremental flight-recorder read (``dprf top --follow``):
+        (spans recorded AFTER the span id ``since``, resync flag),
+        oldest first.  When ``since`` is unknown -- first call, or the
+        ring wrapped past it -- the plain tail comes back with
+        resync=True and the caller must REPLACE its buffer, not
+        append."""
+        with self._lock:
+            items = list(self._ring)
+        idx = None
+        if since:
+            # scan from the new end: the cursor is almost always near it
+            for i in range(len(items) - 1, -1, -1):
+                if items[i].get("span") == since:
+                    idx = i
+                    break
+        resync = idx is None
+        out = items if resync else items[idx + 1:]
+        if trace is not None:
+            out = [s for s in out if s.get("trace") == trace]
+        n = max(1, int(n))
+        if len(out) > n:
+            # the increment itself overflows the window: the caller
+            # cannot stitch it onto its buffer without a silent hole,
+            # so this is a resync too (replace, newest n)
+            out = out[-n:]
+            resync = True
+        return [dict(s) for s in out], resync
+
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
@@ -387,6 +417,73 @@ def lifecycle_report(spans: list) -> dict:
     return {"traces": len(traces), "spans": len(spans),
             "orphans": orphans, "incomplete": sorted(incomplete),
             "details": details}
+
+
+def overlap_report(spans: list) -> dict:
+    """Per-worker device-idle analysis of a span stream -- the
+    ``tools/trace_overlap.py`` report, and the ROADMAP "span-level
+    assertions back perf PRs" item.
+
+    For every proc with ``sweep`` spans, the gaps are the HOLES in the
+    union of its sweep intervals: walking spans by start time with a
+    running coverage frontier ``end = max(end, span.ts + span.dur)``,
+    a span starting past the frontier opens a device-idle hole of
+    ``span.ts - end`` seconds.  (Pipelined sweeps overlap -- several
+    units ride the stream at once and an ahead-batch's sweeps share a
+    start time -- so pairwise prev/next differences would misread tied
+    orderings; union holes are order-stable.)  On a pipelined worker
+    the max hole must stay below the RPC round trip; the serial loop
+    idles ~2 RTT per unit.  ``overlapped`` counts sweeps that started
+    before the coverage frontier (pipeline overlap events), and
+    ``complete_overlaps`` counts sweeps that started before the
+    coordinator recorded the PREVIOUS unit's ``complete`` span --
+    proof the report round trip overlapped device work.  (Both clocks
+    are coordinator-rebased at ingest, so every comparison is within
+    one timeline.)"""
+    completes: dict = {}
+    for s in spans:
+        if s.get("name") == "complete":
+            u = (s.get("attrs") or {}).get("unit")
+            if u is not None:
+                completes[u] = float(s.get("ts", 0.0))
+    by_proc: dict = {}
+    for s in spans:
+        if s.get("name") == "sweep":
+            by_proc.setdefault(str(s.get("proc")), []).append(s)
+    workers = {}
+    for proc, sw in by_proc.items():
+        sw.sort(key=lambda s: float(s.get("ts", 0.0)))
+        gaps, overlapped, c_overlaps = [], 0, 0
+        end = None
+        for i, s in enumerate(sw):
+            ts = float(s.get("ts", 0.0))
+            if end is not None:
+                if ts > end:
+                    gaps.append(ts - end)
+                else:
+                    overlapped += 1
+            if i > 0:
+                ct = completes.get(
+                    (sw[i - 1].get("attrs") or {}).get("unit"))
+                if ct is not None and ts < ct:
+                    c_overlaps += 1
+            send = ts + float(s.get("dur", 0.0))
+            end = send if end is None else max(end, send)
+        workers[proc] = {
+            "sweeps": len(sw),
+            "sweep_s": round(sum(float(s.get("dur", 0.0))
+                                 for s in sw), 6),
+            "gaps": len(sw) - 1,
+            "holes": len(gaps),
+            "idle_s": round(sum(gaps), 6),
+            "max_gap_s": round(max(gaps), 6) if gaps else 0.0,
+            "overlapped": overlapped,
+            "complete_overlaps": c_overlaps,
+        }
+    return {"workers": workers,
+            "max_gap_s": round(max(
+                (w["max_gap_s"] for w in workers.values()),
+                default=0.0), 6)}
 
 
 def export_chrome_trace(spans: list) -> dict:

@@ -88,7 +88,12 @@ def _run_static_checks():
         tier's 5-minute promise;
       - tools/check_metrics.py: every metric/span name declared at
         exactly one site (the PR 3 duplicate-declaration bug, made
-        impossible)."""
+        impossible);
+
+      - tools/check_worker_contract.py: every worker class overriding
+        process() declares its pipelining stance (_submit_based with
+        its own submit(), or _serial_only) -- an unmarked override
+        silently degrades submit_or_process to the serial path."""
     import subprocess
     import sys
 
@@ -96,7 +101,9 @@ def _run_static_checks():
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     for name, what in (("check_markers.py", "tier-marker"),
-                       ("check_metrics.py", "metric/span declaration")):
+                       ("check_metrics.py", "metric/span declaration"),
+                       ("check_worker_contract.py",
+                        "worker pipelining-contract")):
         tool = os.path.join(repo, "tools", name)
         if not os.path.exists(tool):
             continue

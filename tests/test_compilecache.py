@@ -490,3 +490,112 @@ def test_compile_report_tool_summarizes_snapshots(tmp_path):
         [sys.executable, tool, str(tmp_path / "nope.jsonl")],
         capture_output=True, text=True)
     assert proc.returncode == 1
+
+
+# ---------------------------------------------------------------------------
+# prewarm spec kinds: combinator/hybrid + sharded (ISSUE 5 satellite)
+
+def test_prewarm_combinator_needs_real_files_and_skip_is_cheap(
+        fresh_cache):
+    """Combinator prewarm refuses stand-ins (both word tables are
+    embedded in the program), and a sharded spec on a host with too
+    few devices is SKIPPED -- reported, never an error, and never
+    compiled."""
+    from dprf_tpu.compilecache.prewarm import (PrewarmSpec,
+                                               explicit_specs,
+                                               run_prewarm)
+
+    (res,) = run_prewarm([PrewarmSpec(engine="md5",
+                                      attack="combinator",
+                                      batch=512)])
+    assert res.error is not None and "--combinator" in res.error
+    (res,) = run_prewarm([PrewarmSpec(engine="md5",
+                                      attack="hybrid-wm", batch=512)])
+    assert res.error is not None and "--wordlist" in res.error
+    # sharded shape on a host with fewer devices: graceful skip
+    (res,) = run_prewarm([PrewarmSpec(engine="md5", attack="mask",
+                                      batch=512, devices=999)])
+    assert res.error is None and res.skipped
+    assert res.cache == "skip" and res.devices == 999
+    # explicit_specs threads the new fields through
+    (spec,) = explicit_specs(["md5"], ["combinator"], batch=512,
+                             combinator="l.txt,r.txt", devices=2)
+    assert spec.combinator == "l.txt,r.txt" and spec.devices == 2
+    (spec,) = explicit_specs(["md5"], ["hybrid-mw"], batch=512,
+                             wordlist="w.txt")
+    assert spec.wordlist == "w.txt" and spec.combinator is None
+
+
+@pytest.mark.compileheavy
+def test_prewarm_combinator_and_hybrid_shapes_warm_the_job(
+        fresh_cache, tmp_path):
+    """A combinator prewarm over the job's REAL files populates the
+    cache the job-side DeviceCombinatorWorker warms from; the hybrid
+    shape synthesizes its mask side exactly like a job."""
+    from dprf_tpu import get_engine
+    from dprf_tpu.compilecache.prewarm import (PrewarmSpec,
+                                               run_prewarm)
+    from dprf_tpu.generators.combinator import CombinatorGenerator
+    from dprf_tpu.generators.wordlist import load_words
+
+    lp, rp = tmp_path / "l.txt", tmp_path / "r.txt"
+    lp.write_text("".join(f"left{i}\n" for i in range(64)))
+    rp.write_text("".join(f"right{i}\n" for i in range(64)))
+    (res,) = run_prewarm([PrewarmSpec(
+        engine="md5", attack="combinator", batch=512,
+        combinator=f"{lp},{rp}")])
+    assert res.error is None and res.cache == "miss", res.as_dict()
+    # the job path (same files, same batch) hits
+    oracle = get_engine("md5", device="cpu")
+    gen = CombinatorGenerator(load_words(str(lp), 55)[0],
+                              load_words(str(rp), 55)[0], max_len=55)
+    w = get_engine("md5", device="jax").make_combinator_worker(
+        gen, [oracle.parse_target("ff" * 16)], batch=512,
+        hit_capacity=64, oracle=oracle)
+    w.warmup()
+    assert w.compile_cache == "hit"
+    # hybrid word+mask compiles too (its own program: different table)
+    (res,) = run_prewarm([PrewarmSpec(
+        engine="md5", attack="hybrid-wm", batch=512,
+        wordlist=str(lp), mask="?d?d")])
+    assert res.error is None and res.cache in ("miss", "hit")
+
+
+@pytest.mark.compileheavy
+def test_prewarm_sharded_shape_warms_the_sharded_job(fresh_cache,
+                                                     capsys):
+    """devices=N prewarms the SHARDED step through the same factory a
+    `--devices N` job selects (the hermetic suite fakes 8 CPU chips);
+    a later sharded worker of the same shape warms from the cache, and
+    the CLI JSON reports skip counts separately from errors."""
+    from dprf_tpu import get_engine
+    from dprf_tpu.cli import main as cli_main
+    from dprf_tpu.compilecache.prewarm import (PrewarmSpec,
+                                               run_prewarm)
+    from dprf_tpu.generators.mask import MaskGenerator
+    from dprf_tpu.parallel.mesh import make_mesh
+
+    (res,) = run_prewarm([PrewarmSpec(engine="md5", attack="mask",
+                                      batch=512, mask="?l?d?d",
+                                      devices=2)])
+    assert res.error is None and res.cache == "miss", res.as_dict()
+    assert res.devices == 2
+    oracle = get_engine("md5", device="cpu")
+    w = get_engine("md5", device="jax").make_sharded_mask_worker(
+        MaskGenerator("?l?d?d"), [oracle.parse_target("ff" * 16)],
+        make_mesh(2), 512, hit_capacity=64, oracle=oracle)
+    w.warmup()
+    assert w.compile_cache == "hit"
+    # CLI: one compiled sharded spec + one skipped (too many devices)
+    rc = cli_main(["prewarm", "--engines", "md5", "--attacks", "mask",
+                   "--mask", "?l?d?d", "--batch", "512",
+                   "--devices", "2", "-q"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["compiled"] == 1 and doc["skipped"] == 0
+    rc = cli_main(["prewarm", "--engines", "md5", "--attacks", "mask",
+                   "--batch", "512", "--devices", "64", "-q"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["skipped"] == 1 and doc["errors"] == 0
+    assert doc["results"][0]["cache"] == "skip"

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Per-worker device-idle report from an exported span stream.
+
+The span-level assertion behind the pipelined worker loop (ISSUE 5):
+for every worker, consecutive ``sweep`` spans should butt against (or
+overlap) each other -- a positive inter-sweep gap is device idle, and
+on a pipelined worker it must stay below the RPC round trip, because
+sweep N+1 is already on the device stream while unit N's hits decode
+and its complete report flies.  ``complete overlap`` counts sweeps
+that started before the coordinator recorded the previous unit's
+``complete`` span: proof the report RTT overlapped device work.
+
+Usage::
+
+    python tools/trace_overlap.py SESSION[.trace.jsonl]
+        [--max-gap SECONDS]     # exit 1 if any worker idles longer
+        [--json]                # machine-readable report on stdout
+
+The analysis itself lives in dprf_tpu.telemetry.trace.overlap_report
+so tests (tests/test_pipeline_rpc.py) assert on it directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def render(report: dict) -> str:
+    rows = [("worker", "sweeps", "sweep_s", "idle_s", "max_gap_s",
+             "overlapped", "complete_overlap")]
+    for proc in sorted(report["workers"]):
+        w = report["workers"][proc]
+        rows.append((proc, str(w["sweeps"]), f"{w['sweep_s']:.3f}",
+                     f"{w['idle_s']:.3f}", f"{w['max_gap_s']:.3f}",
+                     f"{w['overlapped']}/{w['gaps']}",
+                     f"{w['complete_overlaps']}/{w['gaps']}"))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    return "\n".join("  ".join(c.ljust(w) for c, w in zip(r, widths))
+                     for r in rows)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-worker device-idle gaps between consecutive "
+        "sweep spans of an exported trace")
+    ap.add_argument("session", help="session journal path (or the "
+                    ".trace.jsonl stream itself)")
+    ap.add_argument("--max-gap", type=float, default=None, metavar="S",
+                    help="fail (exit 1) if any worker's max inter-"
+                    "sweep gap exceeds S seconds (e.g. the injected/"
+                    "measured RPC round trip)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report as JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    from dprf_tpu.telemetry.trace import (load_trace, overlap_report,
+                                          trace_path)
+    spans = load_trace(trace_path(args.session))
+    if not spans:
+        print(f"trace_overlap: no spans found at "
+              f"{trace_path(args.session)}", file=sys.stderr)
+        return 2
+    report = overlap_report(spans)
+    if not report["workers"]:
+        print("trace_overlap: no sweep spans in the stream",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(render(report))
+    if args.max_gap is not None and report["max_gap_s"] > args.max_gap:
+        print(f"trace_overlap: FAIL max inter-sweep gap "
+              f"{report['max_gap_s']:.3f}s > {args.max_gap:.3f}s "
+              "budget (device idle between units)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
