@@ -336,7 +336,9 @@ def _run_cpu(env):
 
 def main() -> int:
     repo = os.path.dirname(os.path.abspath(__file__))
-    workdir = os.environ.get("DPRF_BENCH_DIR", "/tmp")
+    sys.path.insert(0, repo)
+    from dprf_tpu.utils import env as envreg
+    workdir = envreg.get_path("DPRF_BENCH_DIR")
     env = dict(os.environ)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
 

@@ -27,6 +27,7 @@ from dprf_tpu.runtime.potfile import Potfile
 from dprf_tpu.runtime.rpc import RpcError
 from dprf_tpu.runtime.session import SessionJournal, job_fingerprint
 from dprf_tpu.runtime.worker import CpuWorker
+from dprf_tpu.utils import env as envreg
 from dprf_tpu.utils.hashlist import load_hashlist
 from dprf_tpu.utils.logging import Log
 
@@ -1003,10 +1004,8 @@ def cmd_serve(args, log: Log) -> int:
         log.warn("rejected unverifiable hit", target=hl.targets[ti].raw[:32])
         return False
 
-    import os as _os
-
     from dprf_tpu.telemetry.trace import get_tracer
-    token = args.token or _os.environ.get("DPRF_TOKEN") or None
+    token = args.token or envreg.get_str("DPRF_TOKEN") or None
     state = CoordinatorState(job, dispatcher, len(hl.targets),
                              verifier=verify_hit, token=token)
     tracer = get_tracer()
@@ -1089,7 +1088,7 @@ def cmd_worker(args, log: Log) -> int:
     compilecache.enable(log=log)
     device = _DEVICE_ALIASES[args.device]
     host, port = _parse_hostport(args.connect)
-    token = args.token or os.environ.get("DPRF_TOKEN") or None
+    token = args.token or envreg.get_str("DPRF_TOKEN") or None
     client = CoordinatorClient(host, port, token=token)
     job = client.hello()["job"]
     log.info("job received", engine=job["engine"], attack=job["attack"],
@@ -1323,7 +1322,7 @@ def cmd_retry_parked(args, log: Log) -> int:
     from dprf_tpu.runtime.rpc import CoordinatorClient
 
     host, port = _parse_hostport(args.connect)
-    token = args.token or os.environ.get("DPRF_TOKEN") or None
+    token = args.token or envreg.get_str("DPRF_TOKEN") or None
     client = CoordinatorClient(host, port, timeout=args.timeout,
                                token=token)
     try:
@@ -1348,7 +1347,7 @@ def cmd_top(args, log: Log) -> int:
     from dprf_tpu.telemetry.trace import render_top
 
     host, port = _parse_hostport(args.connect)
-    token = args.token or os.environ.get("DPRF_TOKEN") or None
+    token = args.token or envreg.get_str("DPRF_TOKEN") or None
     client = CoordinatorClient(host, port, timeout=args.timeout,
                                token=token)
     try:
@@ -1448,7 +1447,7 @@ def cmd_metrics(args, log: Log) -> int:
         import json as _json
 
         from dprf_tpu.runtime.rpc import CoordinatorClient
-        token = args.token or os.environ.get("DPRF_TOKEN") or None
+        token = args.token or envreg.get_str("DPRF_TOKEN") or None
         client = CoordinatorClient(host, port, timeout=args.timeout,
                                    token=token)
         try:

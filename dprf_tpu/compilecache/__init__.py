@@ -49,6 +49,8 @@ import threading
 import time
 from typing import Optional
 
+from dprf_tpu.utils import env as envreg
+
 CACHE_DIR_ENV = "DPRF_COMPILE_CACHE_DIR"
 #: kill switch: DPRF_COMPILE_CACHE=0 disables the persistent cache
 DISABLE_ENV = "DPRF_COMPILE_CACHE"
@@ -71,7 +73,7 @@ def default_cache_dir() -> str:
     """$DPRF_COMPILE_CACHE_DIR, or ~/.cache/dprf/xla (deliberately
     beside the tuning cache: one directory tree to bake into a fleet
     image carries both the tuned batches and their compiled steps)."""
-    d = os.environ.get(CACHE_DIR_ENV)
+    d = envreg.get_path(CACHE_DIR_ENV)
     if d:
         return d
     return os.path.join(os.path.expanduser("~"), ".cache", "dprf", "xla")
@@ -97,7 +99,7 @@ def enable(dir: Optional[str] = None, log=None) -> Optional[str]:
     very step compiles (some take ~1 s on CPU, minutes on TPU) this
     cache exists for, and a dropped entry reads as an eternal miss.
     """
-    if os.environ.get(DISABLE_ENV, "1") == "0":
+    if not envreg.get_bool(DISABLE_ENV):
         return None
     d = os.path.abspath(dir or default_cache_dir())
     with _lock:
@@ -186,11 +188,7 @@ def entry_count() -> Optional[int]:
 
 
 def cold_floor_s() -> float:
-    try:
-        return float(os.environ.get(COLD_FLOOR_ENV,
-                                    DEFAULT_COLD_FLOOR_S))
-    except ValueError:
-        return DEFAULT_COLD_FLOOR_S
+    return envreg.get_float(COLD_FLOOR_ENV, DEFAULT_COLD_FLOOR_S)
 
 
 def classify_compile(seconds: float, entries_before: Optional[int] = None,

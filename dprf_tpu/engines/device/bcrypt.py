@@ -16,7 +16,6 @@ throughput.
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Optional, Sequence
 
@@ -29,6 +28,7 @@ from dprf_tpu.engines import register
 from dprf_tpu.engines.base import Target
 from dprf_tpu.engines.cpu.engines import BcryptEngine
 from dprf_tpu.ops import blowfish as bf_ops
+from dprf_tpu.utils import env as envreg
 from dprf_tpu.ops import compare as cmp_ops
 from dprf_tpu.ops.rules_pipeline import expand_rules
 from dprf_tpu.runtime.worker import (Hit, CpuWorker, word_cover_range,
@@ -95,7 +95,7 @@ def _route_bcrypt(oracle, batch: int):
     oracle anyway)."""
     from dprf_tpu.utils.logging import DEFAULT as log
 
-    mode = os.environ.get("DPRF_BCRYPT_ROUTE", "auto")
+    mode = envreg.get_str("DPRF_BCRYPT_ROUTE")
     if mode == "cpu" and oracle is None:
         log.warn("DPRF_BCRYPT_ROUTE=cpu but the job has no oracle "
                  "engine; staying on the device")
@@ -187,13 +187,7 @@ _jit_bcrypt_batch = jax.jit(bf_ops.bcrypt_batch)
 #: batch in ONE dispatch tripped it and poisoned the backend,
 #: TPU_PROBE_LOG_r03); a 20 s budget keeps 3x headroom while the
 #: ~0.4 s/dispatch tunnel RTT stays <2% overhead.
-try:
-    DEFAULT_DISPATCH_S = float(
-        os.environ.get("DPRF_BCRYPT_DISPATCH_S", "20"))
-except ValueError:
-    import warnings
-    warnings.warn("DPRF_BCRYPT_DISPATCH_S is not a number; using 20")
-    DEFAULT_DISPATCH_S = 20.0
+DEFAULT_DISPATCH_S = envreg.get_float("DPRF_BCRYPT_DISPATCH_S")
 
 
 class ChunkedEks:

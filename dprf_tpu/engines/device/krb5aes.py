@@ -206,9 +206,8 @@ def kdf_kernel_enabled(interpret: bool) -> bool:
     hardware-proven PMKID kernel, but this repo records first compiles
     of new kernel variants before trusting them (TPU_PROBE_LOG_r05
     finding 12's lesson).  Interpret mode (tests) is ungated."""
-    import os
-    return interpret or os.environ.get("DPRF_KRB5AES_KERNEL",
-                                       "0") == "1"
+    from dprf_tpu.utils import env as envreg
+    return interpret or envreg.get_bool("DPRF_KRB5AES_KERNEL")
 
 
 def _make_kdf_kernel_step(gen, batch: int, params: dict,

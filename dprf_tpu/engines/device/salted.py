@@ -269,11 +269,10 @@ class _SaltedWorkerBase:
         # once (this runs on every iteration of the per-batch sweep)
         cap = getattr(self, "_wide_cap", None)
         if cap is None:
-            import os as _os
-
             from dprf_tpu.ops.superstep import max_inner
+            from dprf_tpu.utils import env as envreg
             cap = self._wide_cap = (
-                0 if _os.environ.get("DPRF_SUPERSTEP", "1") == "0"
+                0 if not envreg.get_bool("DPRF_SUPERSTEP")
                 else max_inner(self.stride, self.SUPER_CAP))
         if getattr(self, "_wide_disabled", False) or \
                 cap < self.SUPER_MIN or \

@@ -11,7 +11,6 @@ runtime argument.
 
 from __future__ import annotations
 
-import os
 from typing import Sequence
 
 import numpy as np
@@ -28,11 +27,12 @@ from dprf_tpu.engines.device.salted import (SaltedMaskWorker,
 from dprf_tpu.ops import compare as cmp_ops
 from dprf_tpu.ops.hmac import pack_raw_varlen
 from dprf_tpu.ops.scrypt import scrypt_dk
+from dprf_tpu.utils import env as envreg
 from dprf_tpu.utils.logging import DEFAULT as log
 
 
 def _mem_cap() -> int:
-    return int(os.environ.get("DPRF_SCRYPT_MEM", 4 << 30))
+    return envreg.get_int("DPRF_SCRYPT_MEM")
 
 
 def _clamp_batch(batch: int, targets: Sequence, what: str) -> int:

@@ -25,7 +25,6 @@ are the device paths.
 from __future__ import annotations
 
 import math
-import os
 import struct
 
 import numpy as np
@@ -37,6 +36,7 @@ from dprf_tpu.engines import register
 from dprf_tpu.engines.base import Target
 from dprf_tpu.engines.cpu.sevenzip import SevenZipEngine
 from dprf_tpu.ops import compare as cmp_ops
+from dprf_tpu.utils import env as envreg
 from dprf_tpu.ops.aes import aes_decrypt_blocks
 from dprf_tpu.ops.sha256 import INIT as SHA256_INIT, sha256_compress
 
@@ -45,7 +45,7 @@ from dprf_tpu.ops.sha256 import INIT as SHA256_INIT, sha256_compress
 #: would explode the trace (aes_decrypt_blocks unrolls 14 rounds per
 #: block).  Targets above the cap run on the CPU oracle instead --
 #: correct either way, and the KDF (not the payload) dominates cost.
-DEVICE_DATA_CAP = int(os.environ.get("DPRF_7Z_DEVICE_DATA_CAP", "1024"))
+DEVICE_DATA_CAP = envreg.get_int("DPRF_7Z_DEVICE_DATA_CAP")
 
 #: CRC-32 (IEEE 802.3, the zlib polynomial) byte-step table.
 _CRC_TABLE = np.zeros(256, np.uint32)

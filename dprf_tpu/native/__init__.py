@@ -64,7 +64,8 @@ def load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if os.environ.get("DPRF_NATIVE", "1") == "0":
+    from dprf_tpu.utils import env as envreg
+    if not envreg.get_bool("DPRF_NATIVE"):
         return None
     path = _compile()
     if path is None:

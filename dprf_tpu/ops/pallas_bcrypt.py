@@ -44,9 +44,9 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-import os
 
 from dprf_tpu.ops import blowfish as bf_ops
+from dprf_tpu.utils import env as envreg
 
 #: candidates (sublanes) per grid cell.  VMEM per cell is
 #: SUBC * (4 KB S + padded P/key) ~= SUBC * 5 KB.  The r4 hardware
@@ -54,7 +54,7 @@ from dprf_tpu.ops import blowfish as bf_ops
 #: 10.1 / 7.7 ms per cost round at SUBC 8/16/32/64 -- per-candidate
 #: op count is SUBC-independent, so the gain is loop/control overhead
 #: amortization; 64 is the measured winner (~320 KB VMEM).
-SUBC = int(os.environ.get("DPRF_BCRYPT_SUBC", "64"))
+SUBC = envreg.get_int("DPRF_BCRYPT_SUBC")
 
 
 def pad_p18(x: jnp.ndarray) -> jnp.ndarray:

@@ -21,9 +21,10 @@ emulate_keccak_kernel.
 
 from __future__ import annotations
 
-import os
 
 import numpy as np
+
+from dprf_tpu.utils import env as envreg  # noqa: E402 -- stdlib-only
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -38,7 +39,7 @@ from dprf_tpu.ops.pallas_mask import (check_batch,
 #: sublane count per grid cell (tile = SUBK * 128 lanes).  Keccak-f
 #: holds ~120 pair registers live, ~4x the MD cores, so the default
 #: tile is smaller; DPRF_PALLAS_SUBK overrides for hardware sweeps.
-SUBK = int(os.environ.get("DPRF_PALLAS_SUBK", "32"))
+SUBK = envreg.get_int("DPRF_PALLAS_SUBK")
 
 
 def keccak_kernel_eligible(gen, n_targets: int, rate: int) -> bool:

@@ -34,9 +34,10 @@ target of both krb5tgs and krb5asrep (the msg_type is a scalar too).
 
 from __future__ import annotations
 
-import os
 
 import numpy as np
+
+from dprf_tpu.utils import env as envreg  # noqa: E402 -- stdlib-only
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -51,8 +52,8 @@ from dprf_tpu.ops.pallas_mask import (decode_candidate_bytes,
 
 #: candidates per sublane chunk / chunks per grid cell.  VMEM per
 #: chunk is ~SUBC * 1 KB of S state plus the lane-replicated words.
-SUBC = int(os.environ.get("DPRF_KRB5_SUBC", "32"))
-CHUNKS = int(os.environ.get("DPRF_KRB5_CHUNKS", "64"))
+SUBC = envreg.get_int("DPRF_KRB5_SUBC")
+CHUNKS = envreg.get_int("DPRF_KRB5_CHUNKS")
 #: statically unroll the 256-step KSA: the loop counter's S read
 #: becomes a static lane slice and the key byte a trace-time shift
 #: (no gather), leaving ONE dynamic gather per step instead of three.
@@ -60,7 +61,7 @@ CHUNKS = int(os.environ.get("DPRF_KRB5_CHUNKS", "64"))
 #: compile helper at every SUBC tried (r4 sweep, krb5cfg-20-*-1 --
 #: clean HTTP 500, no tunnel wedge); the fori_loop form compiles in
 #: ~10 s and measured 474-497 kH/s.  Re-try on newer toolchains.
-UNROLL = os.environ.get("DPRF_KRB5_UNROLL", "0") != "0"
+UNROLL = envreg.get_bool("DPRF_KRB5_UNROLL")
 
 _IPAD = 0x36363636
 _OPAD = 0x5C5C5C5C

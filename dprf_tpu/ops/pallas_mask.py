@@ -42,8 +42,9 @@ Design choices forced by the VPU:
 
 from __future__ import annotations
 
-import os
 from typing import Optional, Sequence
+
+from dprf_tpu.utils import env as envreg
 
 import numpy as np
 import jax
@@ -64,7 +65,7 @@ from dprf_tpu.ops import sha512 as sha512_ops
 #: 3.97/4.14 GH/s for SUB 8/16/32/64/128: bigger tiles amortize the
 #: per-grid-cell scalar work, so the packed-output format's maximum
 #: (128) is the default.
-SUB = int(os.environ.get("DPRF_PALLAS_SUB", "128"))
+SUB = envreg.get_int("DPRF_PALLAS_SUB")
 TILE = SUB * 128
 #: charsets needing more piecewise segments than MAX_SEGMENTS use the
 #: lane-axis LUT decode in kernels (charset_lut below) and the gather
@@ -176,13 +177,13 @@ def pallas_mode() -> Optional[dict]:
     for tests); default "auto" uses it on real TPU only.  Returns
     kwargs for the step factory, or None for the XLA path.
     """
-    env = os.environ.get("DPRF_PALLAS", "auto")
-    if env == "0":
+    mode = envreg.get_str("DPRF_PALLAS")
+    if mode == "0":
         return None
     import jax
     if jax.default_backend() == "tpu":
         return {"interpret": False}
-    if env == "1":
+    if mode == "1":
         return {"interpret": True}
     return None
 

@@ -34,9 +34,10 @@ engines/device/pdf.py.
 
 from __future__ import annotations
 
-import os
 
 import numpy as np
+
+from dprf_tpu.utils import env as envreg  # noqa: E402 -- stdlib-only
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -52,7 +53,7 @@ from dprf_tpu.ops.pallas_mask import (decode_candidate_bytes,
 #: chunks per grid cell (tile = SUBC * CHUNKS candidates).  The PDF
 #: body is ~21x heavier than krb5's, so the default tile is smaller
 #: to keep single-dispatch time near the tunnel deadline's safe zone.
-CHUNKS = int(os.environ.get("DPRF_PDF_CHUNKS", "8"))
+CHUNKS = envreg.get_int("DPRF_PDF_CHUNKS")
 
 _PAD_BYTES = np.frombuffer(PAD, np.uint8)
 
@@ -70,7 +71,7 @@ def pdf_kernel_eligible(gen, rev: int, key_len: int,
     but unproven on chip).  DPRF_PDF_K5_KERNEL=1 re-enables it for the
     measuring session; interpret mode (tests) is always allowed."""
     if key_len == 5 and on_hardware and \
-            os.environ.get("DPRF_PDF_K5_KERNEL", "0") != "1":
+            not envreg.get_bool("DPRF_PDF_K5_KERNEL"):
         return False
     return (hasattr(gen, "charsets") and gen.length <= 32
             and mask_supported(gen.charsets)

@@ -55,14 +55,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 from dprf_tpu.ops.pallas_mask import CORES, pallas_mode  # noqa: F401
 from dprf_tpu.rules.parser import Op, Opcode
+from dprf_tpu.utils import env as envreg
 
-import os
 
 #: word-tile geometry: SUBW sublanes x 128 lanes of words per grid
 #: cell.  Bigger tiles amortize per-cell control overhead exactly like
 #: the mask kernel's SUB (r3 sweep); DPRF_RULES_SUBW overrides for
 #: hardware tuning.
-SUBW = int(os.environ.get("DPRF_RULES_SUBW", "8"))
+SUBW = envreg.get_int("DPRF_RULES_SUBW")
 TILE_W = SUBW * 128
 # the packed (count << 16) | (hit_lane + 1) output needs both fields
 # in 16 bits (same constraint as pallas_mask's sub <= 128)

@@ -93,14 +93,9 @@ def pipeline_depth(default: int = 2) -> int:
     depth 2 already overlaps one unit's readback latency with the next
     unit's compute, deeper queues just hold more leases without hiding
     more."""
-    import os
-    raw = os.environ.get(PIPELINE_DEPTH_ENV)
-    if raw is not None:
-        try:
-            default = int(raw)
-        except ValueError:
-            pass
-    return max(1, min(int(default), 64))
+    from dprf_tpu.utils import env as envreg
+    return max(1, min(envreg.get_int(PIPELINE_DEPTH_ENV, int(default)),
+                      64))
 
 
 class UnitPipeline:
@@ -312,12 +307,13 @@ class MaskWorkerBase:
         ``ensure_warm()`` before the first step dispatch -- cold-start
         wall time becomes max(compile, setup) instead of their sum.
         DPRF_ASYNC_WARMUP=0 degrades to a synchronous warmup."""
-        import os
         import threading
+
+        from dprf_tpu.utils import env as envreg
         if getattr(self, "_warmed", False) or \
                 getattr(self, "_warm_thread", None) is not None:
             return self
-        if os.environ.get("DPRF_ASYNC_WARMUP", "1") == "0":
+        if not envreg.get_bool("DPRF_ASYNC_WARMUP"):
             self.warmup()
             return self
         self._warm_error = None
@@ -398,11 +394,10 @@ class MaskWorkerBase:
     def _super_inner(self, remaining_chunks: int) -> int:
         """Power-of-two scan length for a super dispatch, or 0 for the
         per-batch path.  DPRF_SUPERSTEP=0 disables super dispatch."""
-        import os
-
         from dprf_tpu.ops.superstep import max_inner
+        from dprf_tpu.utils import env as envreg
         if getattr(self, "_super_disabled", False) or \
-                os.environ.get("DPRF_SUPERSTEP", "1") == "0":
+                not envreg.get_bool("DPRF_SUPERSTEP"):
             return 0
         cap = max_inner(self._super_batch(), self.SUPER_CAP)
         if remaining_chunks < self.SUPER_MIN or cap < self.SUPER_MIN:

@@ -25,6 +25,7 @@ import time
 from typing import Optional
 
 from dprf_tpu.telemetry.registry import MetricsRegistry
+from dprf_tpu.utils import env as envreg
 
 #: suffix appended to a session journal path for its telemetry stream
 TELEMETRY_SUFFIX = ".telemetry.jsonl"
@@ -39,19 +40,14 @@ MAX_BYTES_ENV = "DPRF_TELEMETRY_MAX_BYTES"
 DEFAULT_MAX_BYTES = 16 << 20
 
 
-def max_bytes_from_env(env: str, default: int) -> Optional[int]:
-    """Shared byte-cap env parsing (telemetry snapshots AND the trace
-    stream): int value, fallback to the default on junk, 0 disables
-    (returns None)."""
-    try:
-        v = int(os.environ.get(env, default))
-    except ValueError:
-        return default
-    return v if v > 0 else None
+def cap_bytes(v: Optional[int]) -> Optional[int]:
+    """Shared byte-cap semantics (telemetry snapshots AND the trace
+    stream): 0 (or None) disables the cap."""
+    return v if v and v > 0 else None
 
 
 def snapshot_max_bytes(default: int = DEFAULT_MAX_BYTES) -> Optional[int]:
-    return max_bytes_from_env(MAX_BYTES_ENV, default)
+    return cap_bytes(envreg.get_int(MAX_BYTES_ENV, default))
 
 
 def rotate_if_over(path: str, incoming: int,
@@ -88,10 +84,7 @@ def telemetry_path(session_path: str) -> str:
 
 
 def snapshot_interval(default: float = DEFAULT_INTERVAL_S) -> float:
-    try:
-        return float(os.environ.get("DPRF_TELEMETRY_INTERVAL", default))
-    except ValueError:
-        return default
+    return envreg.get_float("DPRF_TELEMETRY_INTERVAL", default)
 
 
 class TelemetrySnapshotter:

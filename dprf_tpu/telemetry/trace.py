@@ -52,6 +52,8 @@ import time
 from collections import deque
 from typing import Optional
 
+from dprf_tpu.utils import env as envreg
+
 #: the one declaration site for span names (tools/check_metrics.py
 #: enforces that every record() literal is a member)
 SPAN_NAMES = ("lease", "rpc", "warmup", "sweep", "hit_verify",
@@ -79,7 +81,6 @@ MAX_ATTRS = 16
 MAX_ATTR_STR = 256
 MAX_ID_LEN = 64
 
-
 def new_trace_id() -> str:
     """Trace id for one work-unit lifecycle (assigned at split time)."""
     return secrets.token_hex(8)
@@ -99,14 +100,14 @@ def trace_path(session_path: str) -> str:
 
 
 def trace_enabled() -> bool:
-    return os.environ.get(ENABLE_ENV, "1") != "0"
+    return envreg.get_bool(ENABLE_ENV)
 
 
 def trace_max_bytes() -> Optional[int]:
-    """Byte cap for the trace JSONL stream; 0 disables the cap (env
-    parsing shared with the telemetry snapshot cap)."""
-    from dprf_tpu.telemetry.snapshot import max_bytes_from_env
-    return max_bytes_from_env(MAX_BYTES_ENV, DEFAULT_MAX_BYTES)
+    """Byte cap for the trace JSONL stream; 0 disables the cap (cap
+    semantics shared with the telemetry snapshot cap)."""
+    from dprf_tpu.telemetry.snapshot import cap_bytes
+    return cap_bytes(envreg.get_int(MAX_BYTES_ENV, DEFAULT_MAX_BYTES))
 
 
 def _clean_id(v) -> Optional[str]:
@@ -650,7 +651,7 @@ def jax_profile_ctx(log=None):
     sweep loop (kernel-level drill-down next to the span timeline);
     a null context when unset."""
     import contextlib
-    d = os.environ.get(PROFILE_ENV)
+    d = envreg.get_path(PROFILE_ENV)
     if not d:
         return contextlib.nullcontext()
     return _SafeProfile(d, log=log)

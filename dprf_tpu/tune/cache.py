@@ -30,7 +30,8 @@ def tune_dir(session_path: Optional[str] = None) -> str:
     directory > ~/.cache/dprf.  The session-dir tier keeps a resumable
     job's tuning next to its coverage ledger, so copying the session
     directory to another host carries the whole resume state."""
-    d = os.environ.get("DPRF_TUNE_DIR")
+    from dprf_tpu.utils import env as envreg
+    d = envreg.get_raw("DPRF_TUNE_DIR")
     if d:
         return d
     if session_path:

@@ -66,11 +66,9 @@ def _smoke_budget(config):
     if (not _re.search(r"\bsmoke\b", expr)
             or _re.search(r"\bnot\s+smoke\b", expr)):
         return None
-    try:
-        budget = float(os.environ.get("DPRF_TIER_BUDGET_S",
-                                      _TIER_BUDGET_DEFAULT_S))
-    except ValueError:
-        budget = _TIER_BUDGET_DEFAULT_S
+    from dprf_tpu.utils import env as envreg
+    budget = envreg.get_float("DPRF_TIER_BUDGET_S",
+                              _TIER_BUDGET_DEFAULT_S)
     return budget if budget > 0 else None
 
 
