@@ -296,6 +296,10 @@ def _build_parser() -> argparse.ArgumentParser:
                     "finishes / Ctrl-C)")
     tp.add_argument("--spans", type=int, default=400, metavar="N",
                     help="flight-recorder spans to fetch per frame")
+    tp.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="only spans of this work-unit trace id (from "
+                    "a lease table row or `dprf trace export`): watch "
+                    "one unit's lifecycle bounce across the fleet")
     tp.add_argument("--follow", action="store_true",
                     help="incremental span streaming: each frame "
                     "fetches only spans newer than the last frame's "
@@ -1364,7 +1368,7 @@ def cmd_top(args, log: Log) -> int:
         while True:
             if args.follow:
                 resp = client.call("trace_tail", n=args.spans,
-                                   since=cursor)
+                                   since=cursor, trace=args.trace)
                 if resp.get("resync") or "cursor" not in resp:
                     # resync, or a pre-cursor coordinator that ignored
                     # `since` and sent the full tail: REPLACE the
@@ -1374,7 +1378,8 @@ def cmd_top(args, log: Log) -> int:
                 cursor = resp.get("cursor") or cursor
                 resp = dict(resp, spans=list(buf))
             else:
-                resp = client.call("trace_tail", n=args.spans)
+                resp = client.call("trace_tail", n=args.spans,
+                                   trace=args.trace)
             text = render_top(resp, prev)
             if not args.no_clear and sys.stdout.isatty():
                 sys.stdout.write("\x1b[H\x1b[2J")
