@@ -1039,11 +1039,15 @@ def cmd_serve(args, log: Log) -> int:
     state.on_progress = on_progress
     from dprf_tpu.runtime.coordinator import (preload_potfile,
                                               restore_hits_into)
-    restore_hits_into(state.found, restored_hits)
-    preload_potfile(state.found, hl.targets, potfile)
+    # the server is not up yet, but taking the lock costs nothing and
+    # keeps the guarded-by invariant unconditional (dprf check locks)
+    with state.lock:
+        restore_hits_into(state.found, restored_hits)
+        preload_potfile(state.found, hl.targets, potfile)
+        preloaded = len(state.found)
     state.refresh_found_gauge()
-    if state.found:
-        log.info("pre-cracked targets", count=len(state.found))
+    if preloaded:
+        log.info("pre-cracked targets", count=preloaded)
 
     host, port = _parse_hostport(args.bind)
     server = CoordinatorServer(state, host, port)
@@ -1071,15 +1075,19 @@ def cmd_serve(args, log: Log) -> int:
                      "export`)", path=session.trace_path)
             session.snapshot(dispatcher.completed_intervals())
             session.close()
-    _print_results(state.found, hl.targets)
+    # one snapshot under the lock: the server just shut down, but a
+    # worker connection thread may still be unwinding its last op
+    with state.lock:
+        found = dict(state.found)
+    _print_results(found, hl.targets)
     if dispatcher.parked_count():
         log.warn("job finished with POISONED units parked; their "
                  "ranges were NOT swept",
                  parked=dispatcher.parked_count(),
                  indices=dispatcher.parked_indices())
     log.info("job finished",
-             found=f"{len(state.found)}/{len(hl.targets)}")
-    return 0 if state.found else 1
+             found=f"{len(found)}/{len(hl.targets)}")
+    return 0 if found else 1
 
 
 def cmd_worker(args, log: Log) -> int:
