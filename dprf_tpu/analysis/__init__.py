@@ -1,0 +1,461 @@
+"""``dprf check``: the unified static-analysis suite (ISSUE 6).
+
+One runner, six analyzers, zero runtime dependencies -- the layer
+that turns this repo's recurring concurrent/protocol/config bug
+classes into lint failures instead of loopback-test flakes:
+
+  markers           test modules using Pallas/device engines declare a
+                    tier marker (absorbed from tools/check_markers.py)
+  metrics           every dprf_* metric name declared at exactly one
+                    site; every span literal is in SPAN_NAMES
+                    (absorbed from tools/check_metrics.py)
+  worker-contract   every process() override declares its pipelining
+                    stance (absorbed from tools/check_worker_contract)
+  locks             lock-discipline / guarded-by race detector over
+                    the declared GUARDED_BY tables (analysis/locks.py)
+  protocol          RPC request/response contract: the dict keys each
+                    op's clients build vs. the handler reads, both
+                    directions (analysis/protocol.py)
+  env-knobs         every DPRF_* env read goes through the
+                    utils/env.py registry; README table in sync
+                    (analysis/envknobs.py)
+
+Entry points: ``dprf check`` (cli.py), ``python -m dprf_tpu.analysis``,
+``run_for_conftest()`` (one in-process pass at the top of every test
+tier), and the legacy ``tools/check_*.py`` shims.
+
+Suppressions are explicit and must carry a reason::
+
+    self.found = x   # dprf: disable=locks -- server not started yet
+
+The comment suppresses the named check(s) on its own line, or on the
+next line when it stands alone.  A suppression with no reason, and a
+suppression that matches no finding of a check that ran, are both
+findings themselves -- stale or lazy suppressions rot into the silent
+drift this suite exists to prevent.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from typing import Optional
+
+#: suppression comment: ``disable=<checks> -- <reason>`` after a
+#: ``dprf:`` marker.  Matched against COMMENT tokens only (tokenize),
+#: so documentation showing the syntax inside a string/docstring never
+#: trips the scanner.
+SUPPRESS_RE = re.compile(
+    r"#\s*dprf:\s*disable=([A-Za-z0-9_,-]+)\s*(?:--\s*(\S.*))?")
+
+
+@dataclasses.dataclass
+class Finding:
+    check: str
+    path: str            # repo-relative
+    line: int
+    message: str
+    suppressed: bool = False
+    reason: Optional[str] = None
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def render(self) -> str:
+        tag = f" [suppressed: {self.reason}]" if self.suppressed else ""
+        return f"{self.location()}: [{self.check}] {self.message}{tag}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FileIndex:
+    """One walk's worth of per-file AST buckets.  Six analyzers over
+    ~190 files re-walking every tree is what blew the first prototype
+    past its budget; each file is now walked exactly once and the
+    plugins iterate the typed buckets instead."""
+
+    __slots__ = ("calls", "classes", "functions", "subscripts",
+                 "assigns", "imports", "compares")
+
+    def __init__(self, tree: ast.AST):
+        self.calls: list = []
+        self.classes: list = []
+        self.functions: list = []
+        self.subscripts: list = []
+        self.assigns: list = []
+        self.imports: list = []
+        self.compares: list = []
+        # exact-type dispatch: ast nodes are never subclassed, and a
+        # dict probe beats a 7-way isinstance chain on ~10^6 nodes
+        buckets = {ast.Call: self.calls, ast.ClassDef: self.classes,
+                   ast.FunctionDef: self.functions,
+                   ast.AsyncFunctionDef: self.functions,
+                   ast.Subscript: self.subscripts,
+                   ast.Assign: self.assigns,
+                   ast.Import: self.imports,
+                   ast.ImportFrom: self.imports,
+                   ast.Compare: self.compares}
+        # hand-rolled walk over node.__dict__ (~30% over ast.walk,
+        # whose iter_fields pays a try/except getattr per field)
+        AST = ast.AST
+        stack = [tree]
+        pop = stack.pop
+        append = stack.append
+        while stack:
+            node = pop()
+            b = buckets.get(type(node))
+            if b is not None:
+                b.append(node)
+            for v in node.__dict__.values():
+                if type(v) is list:
+                    for x in v:
+                        if isinstance(x, AST):
+                            append(x)
+                elif isinstance(v, AST):
+                    append(v)
+
+
+class AnalysisContext:
+    """Shared parse state for one run: every analyzer reads sources,
+    ASTs, and node indexes through the same cache, so a six-analyzer
+    pass parses and walks each file once (the <2 s conftest budget,
+    the <5 s CLI budget)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.package_dir = os.path.join(self.root, "dprf_tpu")
+        self.tests_dir = os.path.join(self.root, "tests")
+        self.tools_dir = os.path.join(self.root, "tools")
+        self.readme = os.path.join(self.root, "README.md")
+        self._sources: dict = {}
+        self._trees: dict = {}
+        self._indexes: dict = {}
+        self.parse_failures: list = []   # [(path, message)]
+
+    # -- file discovery --------------------------------------------------
+
+    def rel(self, path: str) -> str:
+        return os.path.relpath(path, self.root)
+
+    def _walk(self, top: str) -> list:
+        out = []
+        for root, dirs, files in os.walk(top):
+            dirs[:] = [d for d in dirs
+                       if d != "__pycache__" and not d.startswith(".")]
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    out.append(os.path.join(root, name))
+        return out
+
+    def package_files(self) -> list:
+        return self._walk(self.package_dir)
+
+    def test_files(self) -> list:
+        if not os.path.isdir(self.tests_dir):
+            return []
+        return self._walk(self.tests_dir)
+
+    def tools_files(self) -> list:
+        if not os.path.isdir(self.tools_dir):
+            return []
+        return self._walk(self.tools_dir)
+
+    def root_files(self) -> list:
+        """Top-level driver scripts (bench.py & co) -- shallow."""
+        out = []
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return out
+        for name in names:
+            if name.endswith(".py"):
+                out.append(os.path.join(self.root, name))
+        return out
+
+    # -- cached parse ----------------------------------------------------
+
+    def source(self, path: str) -> str:
+        src = self._sources.get(path)
+        if src is None:
+            with open(path, encoding="utf-8") as fh:
+                src = self._sources[path] = fh.read()
+        return src
+
+    def tree(self, path: str) -> Optional[ast.AST]:
+        """Parsed AST, or None on a syntax error (recorded once in
+        parse_failures; the runner turns those into findings)."""
+        if path in self._trees:
+            return self._trees[path]
+        try:
+            t = ast.parse(self.source(path), filename=path)
+        except (SyntaxError, OSError) as e:
+            t = None
+            self.parse_failures.append((self.rel(path), str(e)))
+        self._trees[path] = t
+        return t
+
+    def index(self, path: str) -> Optional[FileIndex]:
+        """The file's typed node buckets (None on a parse failure)."""
+        if path not in self._indexes:
+            tree = self.tree(path)
+            self._indexes[path] = (FileIndex(tree)
+                                   if tree is not None else None)
+        return self._indexes[path]
+
+
+# ---------------------------------------------------------------------------
+# plugin registry
+
+def _plugins() -> dict:
+    """name -> module (imported lazily so a syntax error in one
+    analyzer doesn't take the whole runner down at import time)."""
+    from dprf_tpu.analysis import (envknobs, locks, markers, metrics,
+                                   protocol, worker_contract)
+    mods = (markers, metrics, worker_contract, locks, protocol,
+            envknobs)
+    return {m.NAME: m for m in mods}
+
+
+def plugin_names() -> list:
+    return list(_plugins())
+
+
+def describe_plugins() -> list:
+    return [(m.NAME, m.DESCRIPTION) for m in _plugins().values()]
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+
+def _suppressions_for(ctx: AnalysisContext, path: str) -> list:
+    """[(lines, {checks}, reason|None, comment_line)] -- the lines
+    each suppression comment covers (its own line, plus the next line
+    when the comment stands alone).  Only real COMMENT tokens count:
+    the syntax shown inside a docstring or string literal is
+    documentation, not a suppression."""
+    out = []
+    try:
+        src = ctx.source(path)
+    except OSError:
+        return out
+    if "dprf:" not in src:       # cheap prescan: most files have none
+        return out
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(src).readline))
+    except (tokenize.TokenError, SyntaxError, ValueError):
+        return out               # unparsable files surface elsewhere
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        row, col = tok.start
+        checks = {c.strip() for c in m.group(1).split(",") if c.strip()}
+        reason = m.group(2)
+        reason = reason.strip() if reason else None
+        lines = [row]
+        if tok.line[:col].strip() == "":
+            lines.append(row + 1)   # standalone comment: covers next line
+        out.append((lines, checks, reason, row))
+    return out
+
+
+def _apply_suppressions(ctx: AnalysisContext, findings: list,
+                        ran: set) -> list:
+    """Mark suppressed findings, and append framework findings for
+    reasonless or unused suppressions.  Returns the full list."""
+    by_path: dict = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    extra = []
+    paths = set(by_path)
+    # every file any ran check COULD have flagged may hold stale
+    # suppressions; restrict the unused-scan to files we parsed (the
+    # ones analyzers actually visited) to stay cheap and precise
+    paths.update(ctx.rel(p) for p in ctx._sources)
+    for rel in sorted(paths):
+        abspath = os.path.join(ctx.root, rel)
+        if not os.path.exists(abspath):
+            continue
+        for lines, checks, reason, cline in _suppressions_for(
+                ctx, abspath):
+            if reason is None:
+                extra.append(Finding(
+                    "suppression", rel, cline,
+                    "suppression without a reason -- write "
+                    "`# dprf: disable=<check> -- <why this is safe>`"))
+                continue
+            used = False
+            for f in by_path.get(rel, ()):
+                if (f.line in lines and f.check in checks
+                        and not f.suppressed):
+                    f.suppressed = True
+                    f.reason = reason
+                    used = True
+            if not used and checks & ran:
+                extra.append(Finding(
+                    "suppression", rel, cline,
+                    f"unused suppression for {sorted(checks & ran)} "
+                    "-- the finding it silenced is gone; delete it"))
+    return findings + extra
+
+
+# ---------------------------------------------------------------------------
+# runner
+
+def run(root: str, only=None, skip=None,
+        ctx: Optional[AnalysisContext] = None):
+    """Run the selected analyzers; returns (findings, ran) where
+    findings is every Finding (suppressed ones marked) and ran is the
+    set of check names that executed."""
+    plugins = _plugins()
+    names = list(plugins)
+    if only:
+        unknown = set(only) - set(names)
+        if unknown:
+            raise ValueError(f"unknown checks: {sorted(unknown)} "
+                             f"(have: {names})")
+        names = [n for n in names if n in set(only)]
+    if skip:
+        unknown = set(skip) - set(plugins)
+        if unknown:
+            raise ValueError(f"unknown checks: {sorted(unknown)} "
+                             f"(have: {list(plugins)})")
+        names = [n for n in names if n not in set(skip)]
+    if ctx is None:
+        ctx = AnalysisContext(root)
+    findings: list = []
+    for name in names:
+        findings.extend(plugins[name].run(ctx))
+    for rel, msg in ctx.parse_failures:
+        findings.append(Finding("parse", rel, 1,
+                                f"does not parse: {msg}"))
+    findings = _apply_suppressions(ctx, findings, set(names))
+    findings.sort(key=lambda f: (f.path, f.line, f.check))
+    return findings, set(names)
+
+
+def unsuppressed(findings: list) -> list:
+    return [f for f in findings if not f.suppressed]
+
+
+def run_for_conftest(root: str) -> Optional[str]:
+    """One in-process pass over every analyzer (the conftest
+    pytest_configure hook); returns a rendered failure message, or
+    None when clean."""
+    findings, _ = run(root)
+    bad = unsuppressed(findings)
+    if not bad:
+        return None
+    return ("dprf check found {n} violation(s):\n  ".format(n=len(bad))
+            + "\n  ".join(f.render() for f in bad))
+
+
+# ---------------------------------------------------------------------------
+# CLI (dprf check / python -m dprf_tpu.analysis / tools shims)
+
+def _default_root() -> str:
+    # dprf_tpu/analysis/__init__.py -> the repo root two levels up
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def shim_main(check: str, legacy_dir_attr: str) -> int:
+    """Entry point for the legacy ``tools/check_*.py`` shims.  The old
+    tools took one optional positional directory (the package dir for
+    metrics/worker-contract, the tests dir for markers); honor that by
+    pointing the context's matching dir at it.  Flag-style argv passes
+    straight through to the normal CLI."""
+    argv = sys.argv[1:]
+    if argv and not argv[0].startswith("-"):
+        ctx = AnalysisContext(argv[0])
+        setattr(ctx, legacy_dir_attr, ctx.root)
+        findings, _ = run(ctx.root, only=[check], ctx=ctx)
+        bad = unsuppressed(findings)
+        for f in bad:
+            print(f.render())
+        return 1 if bad else 0
+    return main(["--only", check] + argv)
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="dprf check",
+        description="static analysis over the dprf_tpu repo")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: the tree this package "
+                   "is installed in)")
+    p.add_argument("--only", action="append", default=None,
+                   metavar="CHECK", help="run only these checks "
+                   "(repeatable, or comma-separated)")
+    p.add_argument("--skip", action="append", default=None,
+                   metavar="CHECK", help="skip these checks")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--list", action="store_true",
+                   help="list available checks and exit")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print findings silenced by inline "
+                   "suppressions")
+    p.add_argument("--write-env-docs", action="store_true",
+                   help="regenerate the README env-knob table from "
+                   "the utils/env.py registry, then run the checks")
+    args = p.parse_args(argv)
+
+    if args.list:
+        for name, desc in describe_plugins():
+            print(f"{name:16s} {desc}")
+        return 0
+
+    root = os.path.abspath(args.root or _default_root())
+
+    if args.write_env_docs:
+        from dprf_tpu.utils import env
+        readme = os.path.join(root, "README.md")
+        changed = env.write_readme_table(readme)
+        state = "rewritten" if changed else "already in sync"
+        print(f"env-knob table {state}: {readme}", file=sys.stderr)
+
+    def _split(vals):
+        if not vals:
+            return None
+        out = []
+        for v in vals:
+            out.extend(s.strip() for s in v.split(",") if s.strip())
+        return out
+
+    try:
+        findings, ran = run(root, only=_split(args.only),
+                            skip=_split(args.skip))
+    except ValueError as e:
+        print(f"dprf check: {e}", file=sys.stderr)
+        return 2
+
+    bad = unsuppressed(findings)
+    shown = findings if args.show_suppressed else bad
+    if args.json:
+        print(json.dumps({
+            "root": root,
+            "checks": sorted(ran),
+            "findings": [f.as_dict() for f in shown],
+            "total": len(bad),
+            "suppressed": len(findings) - len(bad),
+        }, indent=2))
+    else:
+        for f in shown:
+            print(f.render())
+        n_sup = len(findings) - len(bad)
+        print(f"dprf check: {len(bad)} finding(s), {n_sup} "
+              f"suppressed, checks: {', '.join(sorted(ran))}",
+              file=sys.stderr)
+    return 1 if bad else 0

@@ -1,0 +1,5 @@
+import sys
+
+from dprf_tpu.analysis import main
+
+sys.exit(main())

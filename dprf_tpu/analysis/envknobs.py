@@ -1,0 +1,249 @@
+"""Env-knob registry lint.
+
+``dprf_tpu/utils/env.py`` is the ONE declaration site for every
+``DPRF_*`` environment knob (name, default, type, docstring) and the
+one sanctioned read path (typed getters).  This lint closes the loop:
+
+  1. no raw ``os.environ`` / ``os.getenv`` read of a ``DPRF_*`` name
+     (literal, or through a module-level string constant) anywhere
+     outside the registry module -- package, tools/, tests/, and the
+     repo-root driver scripts are all scanned;
+  2. inside the package, env reads whose variable name the checker
+     cannot resolve at all are flagged too ("unauditable read"):
+     a knob smuggled through a computed name is still a knob;
+  3. every getter call naming an UNDECLARED knob is flagged (the
+     registry raises at runtime; this catches it before any test);
+  4. every declared knob has at least one read site somewhere in the
+     repo -- a knob nobody reads is stale documentation;
+  5. the README's generated knob table is in sync with the registry
+     (``dprf check --write-env-docs`` regenerates it).
+
+Writes (``os.environ["DPRF_X"] = ...``) stay legal everywhere: tests
+and conftest pin knobs; the lint governs READS.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+from dprf_tpu.analysis import Finding
+
+NAME = "env-knobs"
+DESCRIPTION = ("DPRF_* env reads go through utils/env.py; registry "
+               "and README knob table stay in sync")
+
+GETTERS = {"get_raw", "get_str", "get_path", "get_int", "get_float",
+           "get_bool", "knob"}
+REGISTRY_REL = os.path.join("utils", "env.py")
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _os_bindings(import_nodes):
+    """(os-module names, environ names, getenv names) bound in this
+    file -- ``import os as _os`` / ``from os import environ as e``
+    must not make a read invisible to the lint."""
+    os_names = {"os"}
+    environ_names = {"environ"}
+    getenv_names = {"getenv"}
+    for node in import_nodes:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "os" and a.asname:
+                    os_names.add(a.asname)
+        elif isinstance(node, ast.ImportFrom) and node.module == "os":
+            for a in node.names:
+                if a.name == "environ":
+                    environ_names.add(a.asname or a.name)
+                elif a.name == "getenv":
+                    getenv_names.add(a.asname or a.name)
+    return os_names, environ_names, getenv_names
+
+
+def _is_environ(node, os_names, environ_names) -> bool:
+    """``<os-alias>.environ`` or a bare imported ``environ`` name."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ" \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id in os_names:
+        return True
+    return isinstance(node, ast.Name) and node.id in environ_names
+
+
+def _module_consts(tree) -> dict:
+    out = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            s = _const_str(node.value)
+            if s is not None:
+                out[node.targets[0].id] = s
+    return out
+
+
+def _declared_knobs(ctx) -> dict:
+    """name -> declaration line, parsed from the registry module's
+    ``_declare("DPRF_X", ...)`` calls (AST, not import: fixture trees
+    must be checkable without being importable)."""
+    path = os.path.join(ctx.package_dir, REGISTRY_REL)
+    if not os.path.exists(path):
+        return {}
+    tree = ctx.tree(path)
+    if tree is None:
+        return {}
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id == "_declare" and node.args:
+            name = _const_str(node.args[0])
+            if name is not None:
+                out[name] = node.lineno
+    return out
+
+
+def _load_registry(ctx):
+    """The registry module executed from ctx's own tree (so a fixture
+    repo checks against its own registry), or None."""
+    path = os.path.join(ctx.package_dir, REGISTRY_REL)
+    if not os.path.exists(path):
+        return None
+    import importlib.util
+    import sys
+    name = "_dprf_check_env_registry"
+    try:
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        # dataclass field-type resolution looks the module up in
+        # sys.modules (PEP 563 string annotations); exec'ing it
+        # unregistered makes @dataclass itself crash
+        sys.modules[name] = mod
+        try:
+            spec.loader.exec_module(mod)
+        finally:
+            sys.modules.pop(name, None)
+        return mod
+    except Exception:   # noqa: BLE001 -- a broken registry surfaces
+        return None     # through check 3/4 findings instead
+
+
+def run(ctx) -> list:
+    findings: list = []
+    declared = _declared_knobs(ctx)
+    registry_path = os.path.join(ctx.package_dir, REGISTRY_REL)
+    registry_rel = ctx.rel(registry_path)
+    read_knobs: set = set()
+
+    scan = (ctx.package_files() + ctx.tools_files() + ctx.test_files()
+            + ctx.root_files())
+    for path in scan:
+        rel = ctx.rel(path)
+        if rel == registry_rel:
+            continue
+        try:
+            src = ctx.source(path)
+        except OSError:
+            continue
+        # parse prefilter: every env read this lint can flag (or
+        # getter read it must count) names one of these in source
+        if ("environ" not in src and "getenv" not in src
+                and "DPRF_" not in src):
+            continue
+        tree = ctx.tree(path)
+        idx = ctx.index(path)
+        if idx is None:
+            continue
+        consts = _module_consts(tree)
+        os_names, environ_names, getenv_names = _os_bindings(
+            idx.imports)
+        in_package = path.startswith(ctx.package_dir + os.sep)
+
+        def _name_of(arg) -> tuple:
+            """(resolved name | None, resolvable?)"""
+            s = _const_str(arg)
+            if s is not None:
+                return s, True
+            if isinstance(arg, ast.Name) and arg.id in consts:
+                return consts[arg.id], True
+            return None, False
+
+        def _flag_read(arg, lineno):
+            resolved, ok = _name_of(arg)
+            if ok and resolved is not None \
+                    and resolved.startswith("DPRF_"):
+                findings.append(Finding(
+                    NAME, rel, lineno,
+                    f"raw environment read of {resolved!r} -- go "
+                    "through dprf_tpu.utils.env (the registry is the "
+                    "single declaration site)"))
+            elif not ok and in_package:
+                findings.append(Finding(
+                    NAME, rel, lineno,
+                    "environment read with a name the checker cannot "
+                    "resolve -- read knobs through "
+                    "dprf_tpu.utils.env so they stay auditable"))
+
+        for node in idx.calls:
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "get" \
+                    and _is_environ(f.value, os_names, environ_names) \
+                    and node.args:
+                _flag_read(node.args[0], node.lineno)
+            elif isinstance(f, ast.Attribute) \
+                    and f.attr == "getenv" \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in os_names and node.args:
+                _flag_read(node.args[0], node.lineno)
+            elif isinstance(f, ast.Name) and f.id in getenv_names \
+                    and node.args:
+                _flag_read(node.args[0], node.lineno)
+            elif ((isinstance(f, ast.Attribute)
+                   and f.attr in GETTERS)
+                  or (isinstance(f, ast.Name)
+                      and f.id in GETTERS)) and node.args:
+                # literal knob name, or a module-level string constant
+                # (the `ENABLE_ENV = "DPRF_TRACE"` idiom)
+                knob, _ = _name_of(node.args[0])
+                if knob is not None and knob.startswith("DPRF_"):
+                    read_knobs.add(knob)
+                    if declared and knob not in declared:
+                        findings.append(Finding(
+                            NAME, rel, node.lineno,
+                            f"getter reads undeclared knob "
+                            f"{knob!r} -- declare it in "
+                            "utils/env.py"))
+        for node in idx.subscripts:
+            if _is_environ(node.value, os_names, environ_names) \
+                    and isinstance(node.ctx, ast.Load):
+                _flag_read(node.slice, node.lineno)
+
+    if not declared:
+        if os.path.exists(registry_path):
+            findings.append(Finding(
+                NAME, registry_rel, 1,
+                "no _declare(...) knob declarations found in the "
+                "registry module"))
+        return findings
+
+    for knob, lineno in sorted(declared.items()):
+        if knob not in read_knobs:
+            findings.append(Finding(
+                NAME, registry_rel, lineno,
+                f"knob {knob!r} is declared but never read through "
+                "the registry anywhere in the repo -- delete it or "
+                "wire it up"))
+
+    # README sync (only when this tree has a README at all)
+    if os.path.exists(ctx.readme):
+        mod = _load_registry(ctx)
+        if mod is not None and hasattr(mod, "readme_sync_error"):
+            err = mod.readme_sync_error(ctx.readme)
+            if err:
+                findings.append(Finding(NAME, ctx.rel(ctx.readme), 1,
+                                        err))
+    return findings

@@ -1,0 +1,71 @@
+"""Tier-marker hygiene (absorbed from tools/check_markers.py).
+
+The smoke tier promises <5 minutes (pytest.ini); its wall time is
+runtime-guarded by tests/conftest.py.  What the runtime guard cannot
+catch is a NEW test that compiles device pipelines and rides into a
+tier nobody budgeted, because its author never declared a tier at all.
+
+Rule: any test module that uses Pallas kernels or JAX device engines
+-- statically imports ``dprf_tpu.ops.pallas_*`` /
+``dprf_tpu.engines.device*`` anywhere (module or function level), or
+requests ``device="jax"`` in source -- must carry at least one
+``pytest.mark.smoke`` / ``pytest.mark.compileheavy`` /
+``pytest.mark.slow`` marker.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from dprf_tpu.analysis import Finding
+
+NAME = "markers"
+DESCRIPTION = ("test modules using Pallas/device engines declare an "
+               "explicit tier marker")
+
+HEAVY_PREFIXES = ("dprf_tpu.ops.pallas_", "dprf_tpu.engines.device")
+TIER_MARK_RE = re.compile(r"pytest\.mark\.(smoke|compileheavy|slow)\b")
+DEVICE_USE_RE = re.compile(r"""device\s*=\s*["']jax["']""")
+
+
+def _imported_modules(import_nodes):
+    """Every dotted module name the file imports, at any nesting depth
+    (tests routinely import device engines inside test functions)."""
+    for node in import_nodes:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                yield alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            yield node.module
+            for alias in node.names:
+                # `from dprf_tpu.ops import pallas_mask` names the
+                # heavy module in the alias, not in node.module
+                yield f"{node.module}.{alias.name}"
+
+
+def run(ctx) -> list:
+    out = []
+    for path in ctx.test_files():
+        if not os.path.basename(path).startswith("test_"):
+            continue
+        try:
+            src = ctx.source(path)
+        except OSError:
+            continue
+        if TIER_MARK_RE.search(src):
+            continue     # marked: never a finding, and needs no parse
+        idx = ctx.index(path)
+        if idx is None:
+            continue          # parse failure surfaces via the runner
+        heavy = (any(m.startswith(HEAVY_PREFIXES)
+                     for m in _imported_modules(idx.imports))
+                 or DEVICE_USE_RE.search(src) is not None)
+        if heavy:
+            out.append(Finding(
+                NAME, ctx.rel(path), 1,
+                "uses Pallas/device engines but declares no tier "
+                "marker -- add pytest.mark.smoke (fast, "
+                "budget-checked), compileheavy, or slow"))
+    return out

@@ -1,0 +1,126 @@
+"""Metric/span declaration hygiene (absorbed from
+tools/check_metrics.py).
+
+The PR 3 bug this makes impossible: ``dprf_compile_seconds`` was
+declared with ``("engine",)`` labels in two call sites and with
+``("engine", "cache")`` in a third -- the registry's get-or-create
+semantics turn a second declaration site into either silent drift or
+a runtime ValueError, depending on which import runs first.  Rules:
+
+  1. every ``dprf_*`` metric name passed as a literal to
+     ``.counter(`` / ``.gauge(`` / ``.histogram(`` appears at EXACTLY
+     ONE call site across the package;
+  2. every span-name literal passed to a ``.record("...")`` call is a
+     member of ``telemetry/trace.py``'s ``SPAN_NAMES`` tuple, which
+     holds no duplicates.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from dprf_tpu.analysis import Finding
+
+NAME = "metrics"
+DESCRIPTION = ("every dprf_* metric declared at one site; every span "
+               "literal is in SPAN_NAMES")
+
+METRIC_METHODS = {"counter", "gauge", "histogram"}
+TRACE_REL = os.path.join("telemetry", "trace.py")
+
+#: parse prefilter: a file with no metric/record call text cannot
+#: contribute a declaration or span use
+_RELEVANT_RE = re.compile(
+    r"\.(?:counter|gauge|histogram|record)\s*\(")
+
+
+def _literal(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _scan_file(idx):
+    decls, span_uses = [], []
+    for node in idx.calls:
+        if not isinstance(node.func, ast.Attribute):
+            continue
+        first = _literal(node.args[0]) if node.args else None
+        if (node.func.attr in METRIC_METHODS and first
+                and first.startswith("dprf_")):
+            decls.append((first, node.lineno))
+        elif node.func.attr == "record" and first is not None:
+            span_uses.append((first, node.lineno))
+    return decls, span_uses
+
+
+def _declared_span_names(idx):
+    """The SPAN_NAMES tuple, or None when the assignment is missing."""
+    if idx is None:
+        return None
+    for node in idx.assigns:
+        if not any(isinstance(t, ast.Name) and t.id == "SPAN_NAMES"
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            names = [_literal(e) for e in node.value.elts]
+            if all(n is not None for n in names):
+                return names
+    return None
+
+
+def run(ctx) -> list:
+    pkg_dir = ctx.package_dir
+    out = []
+    decl_sites: dict = {}    # metric name -> [(rel, line), ...]
+    span_sites = []          # (name, rel, line)
+    for path in ctx.package_files():
+        try:
+            if not _RELEVANT_RE.search(ctx.source(path)):
+                continue
+        except OSError:
+            continue
+        idx = ctx.index(path)
+        if idx is None:
+            continue
+        decls, span_uses = _scan_file(idx)
+        rel = ctx.rel(path)
+        for metric, lineno in decls:
+            decl_sites.setdefault(metric, []).append((rel, lineno))
+        for span, lineno in span_uses:
+            span_sites.append((span, rel, lineno))
+
+    for metric, sites in sorted(decl_sites.items()):
+        if len(sites) > 1:
+            where = ", ".join(f"{r}:{ln}" for r, ln in sites)
+            out.append(Finding(
+                NAME, sites[0][0], sites[0][1],
+                f"metric {metric!r} declared at {len(sites)} sites "
+                f"({where}) -- declare once and share the helper "
+                "(telemetry.declare_job_metrics pattern)"))
+
+    trace_py = os.path.join(pkg_dir, TRACE_REL)
+    span_names = (_declared_span_names(ctx.index(trace_py))
+                  if os.path.exists(trace_py) else None)
+    if span_names is None:
+        if span_sites:
+            out.append(Finding(
+                NAME, ctx.rel(trace_py), 1,
+                f"SPAN_NAMES tuple not found but {len(span_sites)} "
+                ".record(...) call sites exist"))
+    else:
+        dupes = {n for n in span_names if span_names.count(n) > 1}
+        if dupes:
+            out.append(Finding(
+                NAME, ctx.rel(trace_py), 1,
+                f"duplicate SPAN_NAMES entries: {sorted(dupes)}"))
+        allowed = set(span_names)
+        for span, rel, lineno in span_sites:
+            if span not in allowed:
+                out.append(Finding(
+                    NAME, rel, lineno,
+                    f"span {span!r} not declared in "
+                    "telemetry/trace.py SPAN_NAMES"))
+    return out

@@ -1,0 +1,314 @@
+"""RPC protocol contract checker.
+
+The wire protocol (runtime/rpc.py) is newline-delimited JSON dicts:
+clients build request dicts (``client.call("lease", worker_id=...,
+ahead=...)``) and handlers read them (``op_lease`` reading
+``msg.get("ahead")``); handlers build response dicts and clients read
+those.  Nothing but convention kept the two sides' keys aligned --
+protocol drift surfaced as loopback-test flakes, if at all.  This
+checker extracts both sides from the AST and fails on:
+
+  - a client calling an op with no ``op_<name>`` handler;
+  - a handler reading a request key NO client ever sends;
+  - a client sending a request key the handler never reads;
+  - a client reading a response key the handler never returns.
+
+Extraction rules (static, same-function dataflow only):
+
+  - server side: every method named ``op_*(self, msg)`` on any class
+    in the package.  Request keys = ``msg["k"]`` / ``msg.get("k")``;
+    response keys = every string key of every dict literal in the
+    method plus ``name["k"] = ...`` constant subscript stores (an
+    over-approximation -- nested payload dicts widen the response set,
+    which can only silence, never fabricate, a finding);
+  - client side: calls whose callee is ``.call(`` / ``.send(`` /
+    ``send_report(`` with a literal first argument, scanned across
+    the package AND tools/; request keys are the literal keyword
+    names.  ``X = client.call("op", ...)`` followed by ``X["k"]`` /
+    ``X.get("k")`` / ``"k" in X`` records response reads; so does a
+    direct subscript on the call.  ``client.hello()`` maps to the
+    ``hello`` op.
+
+Transport-layer keys (framing/auth, owned by the handler loop and the
+senders, not the ops): ``op``, ``clock``, ``hmac``, ``cnonce``
+requests; ``ok``, ``error``, ``challenge``, ``coordinator_hmac``
+responses.  Dynamic call sites (op name in a variable, ``**kw``
+payloads) are skipped -- the loopback tests remain the net under
+those.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from dprf_tpu.analysis import Finding
+
+NAME = "protocol"
+DESCRIPTION = ("RPC request/response dict keys match between client "
+               "call sites and op_* handlers")
+
+REQUEST_TRANSPORT = {"op", "clock", "hmac", "cnonce"}
+RESPONSE_TRANSPORT = {"ok", "error", "challenge", "coordinator_hmac"}
+#: call-attribute names treated as "send an op by literal name"
+CLIENT_CALL_ATTRS = {"call", "send"}
+CLIENT_CALL_NAMES = {"send_report", "send"}
+#: zero-argument client methods that ARE an op under the hood
+CLIENT_METHOD_OPS = {"hello": "hello"}
+
+#: parse prefilters: a file with no handler/client call text cannot
+#: contribute to the contract (the \b matches right after a dot)
+_HANDLER_RE = re.compile(r"\bop_[A-Za-z0-9_]+\s*\(")
+_CLIENT_RE = re.compile(r"\b(?:call|send|send_report|hello)\s*\(")
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _Handler:
+    def __init__(self, op: str, rel: str, line: int):
+        self.op = op
+        self.rel = rel
+        self.line = line
+        self.reads: dict = {}      # key -> line
+        self.returns: dict = {}    # key -> line
+
+
+def _scan_handler(fn, rel: str) -> _Handler:
+    h = _Handler(fn.name[3:], rel, fn.lineno)
+    args = fn.args.posonlyargs + fn.args.args
+    msg_name = args[1].arg if len(args) > 1 else None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.value, ast.Name):
+            key = _const_str(node.slice)
+            if key is None:
+                continue
+            if node.value.id == msg_name \
+                    and isinstance(node.ctx, ast.Load):
+                h.reads.setdefault(key, node.lineno)
+            elif isinstance(node.ctx, ast.Store):
+                # resp["trace"] = ... -- incremental response build
+                h.returns.setdefault(key, node.lineno)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "get" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == msg_name and node.args:
+            key = _const_str(node.args[0])
+            if key is not None:
+                h.reads.setdefault(key, node.lineno)
+        elif isinstance(node, ast.Dict):
+            for k in node.keys:
+                key = _const_str(k)
+                if key is not None:
+                    h.returns.setdefault(key, node.lineno)
+    return h
+
+
+class _ClientSite:
+    def __init__(self, op: str, rel: str, line: int):
+        self.op = op
+        self.rel = rel
+        self.line = line
+        self.sends: dict = {}      # key -> line
+        self.reads: dict = {}      # response key -> line
+
+
+def _client_op_of_call(node: ast.Call) -> Optional[str]:
+    """The literal op name of a client-ish call, or None."""
+    f = node.func
+    name = None
+    if isinstance(f, ast.Attribute):
+        if f.attr in CLIENT_METHOD_OPS and not node.args:
+            return CLIENT_METHOD_OPS[f.attr]
+        if f.attr in CLIENT_CALL_ATTRS:
+            name = f.attr
+    elif isinstance(f, ast.Name) and f.id in CLIENT_CALL_NAMES:
+        name = f.id
+    if name is None or not node.args:
+        return None
+    return _const_str(node.args[0])
+
+
+def _scope_nodes(node) -> list:
+    """The nodes of ONE lexical scope: everything under ``node``
+    without descending into nested function/lambda bodies (each of
+    those is its own scope -- idx.functions lists them all, so every
+    body is scanned exactly once).  Class bodies are transparent,
+    methods are not."""
+    out = []
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _scan_clients(nodes: list, rel: str) -> list:
+    """Client call sites in one scope's node list, with same-SCOPE
+    response reads resolved through simple ``X = <call>``
+    assignments.  Scope isolation is load-bearing: one flat pass over
+    a whole module would alias every function's ``resp`` variable to
+    whichever call site assigned it last, cross-attributing reads to
+    the wrong op."""
+    sites: list = []
+    by_var: dict = {}      # var name -> _ClientSite (latest assign)
+    calls: dict = {}       # id(call node) -> _ClientSite
+    for node in nodes:
+        if isinstance(node, ast.Call):
+            op = _client_op_of_call(node)
+            if op is None:
+                continue
+            site = _ClientSite(op, rel, node.lineno)
+            for kw in node.keywords:
+                if kw.arg is not None and kw.arg != "op":
+                    site.sends.setdefault(kw.arg, node.lineno)
+            sites.append(site)
+            calls[id(node)] = site
+    if not calls:
+        return sites
+    # response reads: X = <call>; then X["k"] / X.get("k") / "k" in X
+    for node in nodes:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and id(node.value) in calls:
+            by_var[node.targets[0].id] = calls[id(node.value)]
+
+    def _site_of(expr) -> Optional[_ClientSite]:
+        if isinstance(expr, ast.Name):
+            return by_var.get(expr.id)
+        if isinstance(expr, ast.Call):
+            return calls.get(id(expr))
+        return None
+
+    for node in nodes:
+        if isinstance(node, ast.Subscript):
+            site = _site_of(node.value)
+            key = _const_str(node.slice)
+            if site is not None and key is not None:
+                site.reads.setdefault(key, node.lineno)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "get" and node.args:
+            site = _site_of(node.func.value)
+            key = _const_str(node.args[0])
+            if site is not None and key is not None:
+                site.reads.setdefault(key, node.lineno)
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)):
+            site = _site_of(node.comparators[0]
+                            if node.comparators else None)
+            key = _const_str(node.left)
+            if site is not None and key is not None:
+                site.reads.setdefault(key, node.lineno)
+    return sites
+
+
+def run(ctx) -> list:
+    findings: list = []
+    handlers: dict = {}    # op -> _Handler
+    for path in ctx.package_files():
+        try:
+            if not _HANDLER_RE.search(ctx.source(path)):
+                continue
+        except OSError:
+            continue
+        idx = ctx.index(path)
+        if idx is None:
+            continue
+        rel = ctx.rel(path)
+        for node in idx.classes:
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and item.name.startswith("op_"):
+                    h = _scan_handler(item, rel)
+                    if h.op in handlers:
+                        findings.append(Finding(
+                            NAME, rel, item.lineno,
+                            f"op {h.op!r} handled twice (also "
+                            f"{handlers[h.op].rel}:"
+                            f"{handlers[h.op].line})"))
+                    handlers[h.op] = h
+
+    sites: list = []
+    for path in ctx.package_files() + ctx.tools_files():
+        try:
+            if not _CLIENT_RE.search(ctx.source(path)):
+                continue
+        except OSError:
+            continue
+        idx = ctx.index(path)
+        if idx is None:
+            continue
+        rel = ctx.rel(path)
+        # one scope per function (plus the module top level), nested
+        # bodies excluded from their parents: the X-=-call dataflow
+        # must not leak across scopes in either direction (a nested
+        # def reusing the parent's response-variable name would
+        # cross-attribute reads between ops)
+        scopes = [_scope_nodes(ctx.tree(path))]
+        scopes.extend(_scope_nodes(fn) for fn in idx.functions)
+        for scope_nodes in scopes:
+            sites.extend(_scan_clients(scope_nodes, rel))
+
+    if not handlers:
+        return findings
+
+    by_op: dict = {}
+    for site in sites:
+        by_op.setdefault(site.op, []).append(site)
+
+    # 1. undeclared ops
+    for op, op_sites in sorted(by_op.items()):
+        if op not in handlers:
+            s = op_sites[0]
+            findings.append(Finding(
+                NAME, s.rel, s.line,
+                f"client calls op {op!r} but no op_{op} handler "
+                "exists"))
+
+    for op, h in sorted(handlers.items()):
+        op_sites = by_op.get(op, [])
+        if not op_sites:
+            continue       # ops endpoint (status & co): tests/scripts
+        sent: set = set()
+        for s in op_sites:
+            sent.update(s.sends)
+        # 2. handler reads a key no client sends
+        for key, line in sorted(h.reads.items()):
+            if key not in sent and key not in REQUEST_TRANSPORT:
+                findings.append(Finding(
+                    NAME, h.rel, line,
+                    f"op_{op} reads request key {key!r} that no "
+                    "client call site sends -- dead or drifted "
+                    "protocol surface"))
+        # 3. client sends a key the handler ignores
+        for s in op_sites:
+            for key, line in sorted(s.sends.items()):
+                if key not in h.reads and key not in REQUEST_TRANSPORT:
+                    findings.append(Finding(
+                        NAME, s.rel, line,
+                        f"op {op!r} call sends key {key!r} the "
+                        f"handler (op_{op}, {h.rel}:{h.line}) never "
+                        "reads"))
+        # 4. client reads a response key the handler never returns
+        for s in op_sites:
+            for key, line in sorted(s.reads.items()):
+                if key not in h.returns \
+                        and key not in RESPONSE_TRANSPORT:
+                    findings.append(Finding(
+                        NAME, s.rel, line,
+                        f"op {op!r} response read of key {key!r} "
+                        f"that op_{op} ({h.rel}:{h.line}) never "
+                        "returns"))
+    return findings

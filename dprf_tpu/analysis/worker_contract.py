@@ -1,0 +1,83 @@
+"""Worker pipelining-contract hygiene (absorbed from
+tools/check_worker_contract.py).
+
+``runtime/worker.py``'s ``submit_or_process`` pipelines a worker only
+when its ``process`` carries ``_submit_based = True``; everything else
+runs serially.  Every class in the package defining a ``process``
+method must declare its stance in its own body, exactly one of:
+
+  1. ``process._submit_based = True`` -- and then the class must also
+     define ``submit`` itself (an inherited submit under an
+     overridden process bypasses the override's sweep logic);
+  2. ``process._serial_only = True`` -- an explicit "do not pipeline
+     this worker".
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dprf_tpu.analysis import Finding
+
+NAME = "worker-contract"
+DESCRIPTION = ("every process() override declares _submit_based "
+               "(with its own submit) or _serial_only")
+
+
+def _marker_assignments(cls: ast.ClassDef):
+    """The ``process.<attr> = True`` statements in a class body."""
+    for node in cls.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        t = node.targets[0]
+        if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                and t.value.id == "process"
+                and isinstance(node.value, ast.Constant)
+                and node.value.value is True):
+            yield t.attr
+
+
+def run(ctx) -> list:
+    out = []
+    for path in ctx.package_files():
+        try:
+            if "def process" not in ctx.source(path):
+                continue     # parse prefilter: no override, no finding
+        except OSError:
+            continue
+        idx = ctx.index(path)
+        if idx is None:
+            continue
+        rel = ctx.rel(path)
+        for node in idx.classes:
+            defs = {n.name for n in node.body
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+            if "process" not in defs:
+                continue
+            markers = set(_marker_assignments(node))
+            where = f"class {node.name}"
+            if "_submit_based" in markers and "_serial_only" in markers:
+                out.append(Finding(
+                    NAME, rel, node.lineno,
+                    f"{where} marks process BOTH _submit_based and "
+                    "_serial_only -- pick one"))
+            elif "_submit_based" in markers:
+                if "submit" not in defs:
+                    out.append(Finding(
+                        NAME, rel, node.lineno,
+                        f"{where} marks process._submit_based but "
+                        "defines no submit() of its own -- an "
+                        "inherited submit bypasses the overridden "
+                        "process; define submit or mark "
+                        "process._serial_only"))
+            elif "_serial_only" not in markers:
+                out.append(Finding(
+                    NAME, rel, node.lineno,
+                    f"{where} overrides process() without declaring "
+                    "its pipelining stance -- set `process."
+                    "_submit_based = True` (and define submit) or "
+                    "`process._serial_only = True` after the def; an "
+                    "unmarked override silently degrades "
+                    "submit_or_process to the serial path"))
+    return out

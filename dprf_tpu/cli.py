@@ -343,6 +343,30 @@ def _build_parser() -> argparse.ArgumentParser:
     mt.add_argument("--timeout", type=float, default=10.0)
     mt.add_argument("--quiet", "-q", action="store_true")
 
+    ck = sub.add_parser("check", help="run the static-analysis suite "
+                        "(lock discipline, RPC protocol contract, "
+                        "env-knob registry, markers, metrics, worker "
+                        "contract)")
+    ck.add_argument("--root", default=None, metavar="DIR",
+                    help="repo root to analyze (default: the tree "
+                    "this package is installed in)")
+    ck.add_argument("--only", action="append", default=None,
+                    metavar="CHECK", help="run only these checks "
+                    "(repeatable, or comma-separated)")
+    ck.add_argument("--skip", action="append", default=None,
+                    metavar="CHECK", help="skip these checks")
+    ck.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ck.add_argument("--list", action="store_true",
+                    help="list available checks and exit")
+    ck.add_argument("--show-suppressed", action="store_true",
+                    help="also print findings silenced by inline "
+                    "suppressions")
+    ck.add_argument("--write-env-docs", action="store_true",
+                    help="regenerate the README env-knob table from "
+                    "the utils/env.py registry, then run the checks")
+    ck.add_argument("--quiet", "-q", action="store_true")
+
     e = sub.add_parser("engines", help="list available engines")
     e.add_argument("--device", default=None)
     e.add_argument("--verbose", "-v", action="store_true",
@@ -1513,6 +1537,21 @@ def cmd_left(args, log: Log) -> int:
     return 0
 
 
+def cmd_check(args, log: Log) -> int:
+    from dprf_tpu import analysis
+    argv = []
+    if args.root:
+        argv += ["--root", args.root]
+    for v in args.only or ():
+        argv += ["--only", v]
+    for v in args.skip or ():
+        argv += ["--skip", v]
+    for flag in ("json", "list", "show_suppressed", "write_env_docs"):
+        if getattr(args, flag):
+            argv.append("--" + flag.replace("_", "-"))
+    return analysis.main(argv)
+
+
 def cmd_engines(args, log: Log) -> int:
     devices = [args.device] if args.device else ["cpu", "jax"]
     for dev in devices:
@@ -1607,6 +1646,7 @@ _COMMANDS = {
     "top": cmd_top,
     "trace": cmd_trace,
     "metrics": cmd_metrics,
+    "check": cmd_check,
     "show": cmd_show,
     "left": cmd_left,
     "engines": cmd_engines,

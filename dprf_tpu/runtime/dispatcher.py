@@ -45,6 +45,16 @@ from dprf_tpu.runtime.workunit import WorkUnit
 from dprf_tpu.telemetry import get_registry
 from dprf_tpu.telemetry.trace import get_tracer, new_trace_id, span_id
 
+#: lock-discipline declaration (`dprf check` locks analyzer): the
+#: Dispatcher has NO lock of its own -- every concurrent caller (the
+#: RPC handlers, the server drain loop) serializes through
+#: CoordinatorState.lock, which declares its ``dispatcher`` reference
+#: guarded.  ``<extern>`` additionally forbids this class from ever
+#: acquiring a declared lock itself: a hidden acquisition here would
+#: be invisible to the callers' lock-order reasoning.  (The local
+#: Coordinator drives its Dispatcher from one thread; no lock needed.)
+GUARDED_BY = {"Dispatcher": {"<extern>": ()}}
+
 
 class IntervalSet:
     """Sorted, merged set of [start, end) integer intervals."""

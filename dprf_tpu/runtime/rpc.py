@@ -56,6 +56,23 @@ MAX_LINE = 64 << 20   # hashlists can be large; candidates never cross
 #: greedy client can vacuum into one host's ledger
 MAX_LEASE_AHEAD = 16
 
+#: lock-discipline declarations (`dprf check` locks analyzer).  Every
+#: worker connection is its own handler thread in a
+#: ThreadingTCPServer, all mutating this state: the listed
+#: CoordinatorState attributes must only be touched inside ``with
+#: <state>.lock`` (or a method annotated ``_holds_lock``).  The
+#: _CompletionSender flags are single-writer latched (assigned only by
+#: its own thread's ``_run``, read cross-thread) -- GIL-atomic by
+#: design, which ``<atomic>`` makes the checker enforce rather than
+#: assume.
+GUARDED_BY = {
+    "CoordinatorState": {
+        "lock": ("found", "dispatcher", "rejected", "worker_rejects",
+                 "unit_reject_workers", "quarantined"),
+    },
+    "_CompletionSender": {"<atomic>": ("error", "stop_seen")},
+}
+
 
 class RpcError(RuntimeError):
     """Protocol-level failure talking to the coordinator (error
@@ -434,6 +451,7 @@ class CoordinatorState:
     def _stopped(self) -> bool:
         return (len(self.found) >= self.n_targets
                 or self.dispatcher.done())
+    _stopped._holds_lock = "lock"   # callers hold self.lock
 
     def finished(self) -> bool:
         with self.lock:

@@ -81,6 +81,21 @@ MAX_ATTRS = 16
 MAX_ATTR_STR = 256
 MAX_ID_LEN = 64
 
+#: lock-discipline declaration (`dprf check` locks analyzer): the
+#: recorder is hit from RPC handler threads, the dispatcher (under
+#: CoordinatorState.lock), and worker loops at once; ring and file
+#: stream state must only move under ``_lock``.  The acquisition
+#: order this induces -- CoordinatorState.lock, THEN _lock -- is
+#: checked package-wide; code holding ``_lock`` must never call back
+#: into the coordinator.
+GUARDED_BY = {
+    "TraceRecorder": {
+        "_lock": ("_ring", "_fh", "_path", "_max_bytes",
+                  "_file_bytes"),
+    },
+}
+
+
 def new_trace_id() -> str:
     """Trace id for one work-unit lifecycle (assigned at split time)."""
     return secrets.token_hex(8)
@@ -259,6 +274,7 @@ class TraceRecorder:
             self._file_bytes = 0
         except OSError:
             self._fh = None
+    _rotate_locked._holds_lock = "_lock"   # only _append calls it
 
     # -- file stream -----------------------------------------------------
 

@@ -78,38 +78,20 @@ def pytest_configure(config):
 
 
 def _run_static_checks():
-    """Run the static AST lints at the top of every tier run (cost:
-    milliseconds each):
-
-      - tools/check_markers.py: a test that compiles device pipelines
-        without declaring a tier would silently ride into the smoke
-        tier's 5-minute promise;
-      - tools/check_metrics.py: every metric/span name declared at
-        exactly one site (the PR 3 duplicate-declaration bug, made
-        impossible);
-
-      - tools/check_worker_contract.py: every worker class overriding
-        process() declares its pipelining stance (_submit_based with
-        its own submit(), or _serial_only) -- an unmarked override
-        silently degrades submit_or_process to the serial path."""
-    import subprocess
-    import sys
-
+    """One in-process `dprf check` pass (all six analyzers: markers,
+    metrics, worker-contract, locks, protocol, env-knobs -- see
+    dprf_tpu/analysis/) at the top of every tier run, so a
+    lock-discipline race, a one-sided RPC key, or a rogue env read
+    fails the run before the first test executes.  Budget: <2 s
+    (the analyzers share one parse and prefilter on source text)."""
     import pytest
 
+    from dprf_tpu import analysis
+
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    for name, what in (("check_markers.py", "tier-marker"),
-                       ("check_metrics.py", "metric/span declaration"),
-                       ("check_worker_contract.py",
-                        "worker pipelining-contract")):
-        tool = os.path.join(repo, "tools", name)
-        if not os.path.exists(tool):
-            continue
-        proc = subprocess.run([sys.executable, tool],
-                              capture_output=True, text=True)
-        if proc.returncode != 0:
-            raise pytest.UsageError(
-                f"{what} check failed:\n" + proc.stdout + proc.stderr)
+    failure = analysis.run_for_conftest(repo)
+    if failure is not None:
+        raise pytest.UsageError(failure)
 
 
 def _has_compileheavy(session) -> bool:

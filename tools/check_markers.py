@@ -1,92 +1,18 @@
 #!/usr/bin/env python
-"""Tier-marker hygiene for the test suite (run at the top of tier-1).
-
-The smoke tier promises <5 minutes (pytest.ini); its wall time is
-runtime-guarded by tests/conftest.py.  What the runtime guard cannot
-catch is a NEW test that compiles device pipelines and rides into a
-tier nobody budgeted, because its author never declared a tier at all.
-
-Rule enforced here: any test module that uses Pallas kernels or JAX
-device engines -- statically imports ``dprf_tpu.ops.pallas_*`` /
-``dprf_tpu.engines.device*`` anywhere (module or function level), or
-requests ``device="jax"`` / ``device='jax'`` in source -- must declare
-an explicit tier decision: at least one ``pytest.mark.smoke`` (fast;
-the conftest wall-time guard holds it to the budget),
-``pytest.mark.compileheavy`` (full suite only, out of the smoke tier),
-or ``pytest.mark.slow`` (out of the tier-1 gate) marker.
+"""Thin shim over `dprf check --only markers` (the tier-marker lint
+moved into the plugin framework at dprf_tpu/analysis/markers.py; this
+entry point stays so existing workflows keep working).
 
 Exit status 1 lists the violating files; 0 means clean.
 """
 
-from __future__ import annotations
-
-import ast
 import os
-import re
 import sys
 
-HEAVY_PREFIXES = ("dprf_tpu.ops.pallas_", "dprf_tpu.engines.device")
-TIER_MARK_RE = re.compile(r"pytest\.mark\.(smoke|compileheavy|slow)\b")
-DEVICE_USE_RE = re.compile(r"""device\s*=\s*["']jax["']""")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-
-def _imported_modules(tree: ast.AST):
-    """Every dotted module name the file imports, at any nesting depth
-    (tests routinely import device engines inside test functions)."""
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                yield alias.name
-        elif isinstance(node, ast.ImportFrom) and node.module:
-            yield node.module
-            for alias in node.names:
-                # `from dprf_tpu.ops import pallas_mask` names the
-                # heavy module in the alias, not in node.module
-                yield f"{node.module}.{alias.name}"
-
-
-def check_file(path: str):
-    """None if clean, else a one-line violation message."""
-    with open(path, encoding="utf-8") as fh:
-        src = fh.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return f"{path}: does not parse ({e})"
-    heavy = (any(m.startswith(HEAVY_PREFIXES)
-                 for m in _imported_modules(tree))
-             or DEVICE_USE_RE.search(src) is not None)
-    if not heavy:
-        return None
-    if TIER_MARK_RE.search(src):
-        return None
-    return (f"{path}: uses Pallas/device engines but declares no tier "
-            "marker -- add pytest.mark.smoke (fast, budget-checked), "
-            "compileheavy, or slow")
-
-
-def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    if argv:
-        test_dir = argv[0]
-    else:
-        test_dir = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "tests")
-    violations = []
-    for name in sorted(os.listdir(test_dir)):
-        if not (name.startswith("test_") and name.endswith(".py")):
-            continue
-        msg = check_file(os.path.join(test_dir, name))
-        if msg:
-            violations.append(msg)
-    if violations:
-        print("check_markers: tier-marker violations:\n  "
-              + "\n  ".join(violations))
-        return 1
-    print(f"check_markers: OK ({test_dir})")
-    return 0
-
+from dprf_tpu import analysis  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(analysis.shim_main("markers", "tests_dir"))

@@ -1,152 +1,19 @@
 #!/usr/bin/env python
-"""Metric/span declaration hygiene for dprf_tpu (run at the top of
-every tier, like check_markers).
+"""Thin shim over `dprf check --only metrics` (the metric/span
+declaration lint moved into the plugin framework at
+dprf_tpu/analysis/metrics.py; this entry point stays so existing
+workflows keep working).
 
-The PR 3 bug this makes impossible: ``dprf_compile_seconds`` was
-declared with ``("engine",)`` labels in two call sites and with
-``("engine", "cache")`` in a third -- the registry's get-or-create
-semantics turn a second declaration site into either silent drift or a
-runtime ValueError, depending on which import runs first.  Single
-declaration sites (telemetry.declare_job_metrics,
-compilecache.compile_histogram) are the fix; this lint enforces the
-policy statically:
-
-  1. every ``dprf_*`` metric name passed as a literal to
-     ``.counter(`` / ``.gauge(`` / ``.histogram(`` appears at EXACTLY
-     ONE call site across the package (call the one site's helper
-     instead of re-declaring);
-  2. every span-name literal passed to a ``.record("...")`` call is a
-     member of ``telemetry/trace.py``'s ``SPAN_NAMES`` tuple -- the
-     single span-name declaration site -- and that tuple holds no
-     duplicates.
-
-Exit status 1 lists violations; 0 means clean.
+Exit status 1 lists the violations; 0 means clean.
 """
 
-from __future__ import annotations
-
-import ast
 import os
 import sys
 
-METRIC_METHODS = {"counter", "gauge", "histogram"}
-TRACE_REL = os.path.join("telemetry", "trace.py")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-
-def _literal(node) -> str | None:
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    return None
-
-
-def scan_file(path: str):
-    """(metric declarations, span-name uses) as [(name, lineno), ...];
-    a parse failure returns an error string instead."""
-    with open(path, encoding="utf-8") as fh:
-        src = fh.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return f"{path}: does not parse ({e})"
-    decls, span_uses = [], []
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)):
-            continue
-        first = _literal(node.args[0]) if node.args else None
-        if (node.func.attr in METRIC_METHODS and first
-                and first.startswith("dprf_")):
-            decls.append((first, node.lineno))
-        elif node.func.attr == "record" and first is not None:
-            span_uses.append((first, node.lineno))
-    return decls, span_uses
-
-
-def declared_span_names(trace_py: str):
-    """The SPAN_NAMES tuple from telemetry/trace.py, or None when the
-    file/assignment is missing."""
-    if not os.path.exists(trace_py):
-        return None
-    with open(trace_py, encoding="utf-8") as fh:
-        try:
-            tree = ast.parse(fh.read(), filename=trace_py)
-        except SyntaxError:
-            return None
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign):
-            continue
-        if not any(isinstance(t, ast.Name) and t.id == "SPAN_NAMES"
-                   for t in node.targets):
-            continue
-        if isinstance(node.value, (ast.Tuple, ast.List)):
-            names = [_literal(e) for e in node.value.elts]
-            if all(n is not None for n in names):
-                return names
-    return None
-
-
-def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    if argv:
-        pkg_dir = argv[0]
-    else:
-        pkg_dir = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "dprf_tpu")
-    violations = []
-    decl_sites: dict = {}    # metric name -> [site, ...]
-    span_sites = []          # (name, site)
-    for root, dirs, files in os.walk(pkg_dir):
-        dirs[:] = [d for d in dirs if d != "__pycache__"]
-        for name in sorted(files):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(root, name)
-            res = scan_file(path)
-            if isinstance(res, str):
-                violations.append(res)
-                continue
-            decls, span_uses = res
-            rel = os.path.relpath(path, pkg_dir)
-            for metric, lineno in decls:
-                decl_sites.setdefault(metric, []).append(f"{rel}:{lineno}")
-            for span, lineno in span_uses:
-                span_sites.append((span, f"{rel}:{lineno}"))
-
-    for metric, sites in sorted(decl_sites.items()):
-        if len(sites) > 1:
-            violations.append(
-                f"metric {metric!r} declared at {len(sites)} sites "
-                f"({', '.join(sites)}) -- declare once and share the "
-                "helper (telemetry.declare_job_metrics pattern)")
-
-    span_names = declared_span_names(os.path.join(pkg_dir, TRACE_REL))
-    if span_names is None:
-        if span_sites:
-            violations.append(
-                f"{TRACE_REL}: SPAN_NAMES tuple not found but "
-                f"{len(span_sites)} .record(...) call sites exist")
-    else:
-        dupes = {n for n in span_names if span_names.count(n) > 1}
-        if dupes:
-            violations.append(
-                f"{TRACE_REL}: duplicate SPAN_NAMES entries: "
-                f"{sorted(dupes)}")
-        allowed = set(span_names)
-        for span, site in span_sites:
-            if span not in allowed:
-                violations.append(
-                    f"{site}: span {span!r} not declared in "
-                    f"{TRACE_REL} SPAN_NAMES")
-
-    if violations:
-        print("check_metrics: declaration violations:\n  "
-              + "\n  ".join(violations))
-        return 1
-    print(f"check_metrics: OK ({len(decl_sites)} metrics, "
-          f"{len(span_sites)} span sites, {pkg_dir})")
-    return 0
-
+from dprf_tpu import analysis  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(analysis.shim_main("metrics", "package_dir"))

@@ -1,111 +1,19 @@
 #!/usr/bin/env python
-"""Worker pipelining-contract hygiene (run at the top of every tier,
-like check_markers / check_metrics).
+"""Thin shim over `dprf check --only worker-contract` (the worker
+pipelining-contract lint moved into the plugin framework at
+dprf_tpu/analysis/worker_contract.py; this entry point stays so
+existing workflows keep working).
 
-``runtime/worker.py``'s ``submit_or_process`` pipelines a worker only
-when its ``process`` carries ``_submit_based = True``; everything else
-runs serially.  Before this lint the contract was convention-only: a
-worker class overriding ``process()`` without re-marking silently
-degraded pipelining (the pre-ISSUE-5 ShardedWordlistWorker did exactly
-that), and a class that grew a ``submit()`` but forgot the marker
-never pipelined at all.
-
-Rule enforced here: every class in the package that defines a
-``process(self, unit)`` method must declare its pipelining stance in
-its own body, exactly one of:
-
-  1. ``process._submit_based = True`` -- and then the class must also
-     define ``submit`` itself (inheriting one under an overridden
-     ``process`` is the bug the marker exists to prevent: the
-     inherited submit would bypass the override's sweep logic);
-  2. ``process._serial_only = True`` -- an explicit "this worker's
-     process does its own internal overlap / has no device stream;
-     do not pipeline it".
-
-Exit status 1 lists violations; 0 means clean.
+Exit status 1 lists the violations; 0 means clean.
 """
 
-from __future__ import annotations
-
-import ast
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-def _marker_assignments(cls: ast.ClassDef):
-    """The ``process.<attr> = True`` statements in a class body."""
-    for node in cls.body:
-        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
-            continue
-        t = node.targets[0]
-        if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
-                and t.value.id == "process"
-                and isinstance(node.value, ast.Constant)
-                and node.value.value is True):
-            yield t.attr
-
-
-def check_file(path: str) -> list:
-    with open(path, encoding="utf-8") as fh:
-        src = fh.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [f"{path}: does not parse ({e})"]
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ClassDef):
-            continue
-        defs = {n.name for n in node.body
-                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
-        if "process" not in defs:
-            continue
-        markers = set(_marker_assignments(node))
-        where = f"{path}:{node.lineno}: class {node.name}"
-        if "_submit_based" in markers and "_serial_only" in markers:
-            out.append(f"{where} marks process BOTH _submit_based and "
-                       "_serial_only -- pick one")
-        elif "_submit_based" in markers:
-            if "submit" not in defs:
-                out.append(
-                    f"{where} marks process._submit_based but defines "
-                    "no submit() of its own -- an inherited submit "
-                    "bypasses the overridden process; define submit "
-                    "or mark process._serial_only")
-        elif "_serial_only" not in markers:
-            out.append(
-                f"{where} overrides process() without declaring its "
-                "pipelining stance -- set `process._submit_based = "
-                "True` (and define submit) or `process._serial_only "
-                "= True` after the def; an unmarked override silently "
-                "degrades submit_or_process to the serial path")
-    return out
-
-
-def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    if argv:
-        pkg_dir = argv[0]
-    else:
-        pkg_dir = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "dprf_tpu")
-    violations = []
-    n_files = 0
-    for root, dirs, files in os.walk(pkg_dir):
-        dirs[:] = [d for d in dirs if d != "__pycache__"]
-        for name in sorted(files):
-            if not name.endswith(".py"):
-                continue
-            n_files += 1
-            violations.extend(check_file(os.path.join(root, name)))
-    if violations:
-        print("check_worker_contract: pipelining-contract violations:"
-              "\n  " + "\n  ".join(violations))
-        return 1
-    print(f"check_worker_contract: OK ({n_files} files, {pkg_dir})")
-    return 0
-
+from dprf_tpu import analysis  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(analysis.shim_main("worker-contract", "package_dir"))
