@@ -1,0 +1,648 @@
+"""Interprocedural dataflow core: the whole-package call graph.
+
+PR 6's analyzers stopped at same-scope dataflow (protocol) and
+one-level method calls (locks).  The serve-plane and elastic-fleet
+tentpoles will multiply threads, locks, sockets, and RPC helpers --
+exactly the surfaces where one helper function launders a guarded
+access, a blocking call, or a request key out of an analyzer's sight.
+This module is the shared machinery that closes that gap:
+
+  - a MODULE REGISTRY with demand loading: files parse when an
+    import, annotation, or call actually reaches them, so the graph
+    covers the whole package without paying a whole-package parse on
+    every run (the <2 s in-process budget);
+  - TYPE RESOLUTION, lifted from the locks analyzer: ``self`` inside
+    a class; parameters/locals/attributes with class annotations;
+    direct constructions; factory calls whose return annotation names
+    a known class -- now shared by every interprocedural check;
+  - per-function SUMMARIES, memoized on the shared graph: locks
+    acquired (``with`` contexts over typed expressions), blocking
+    calls, resolvable callees, dict keys read/written through each
+    parameter, dict keys built, and return expressions;
+  - cycle-safe TRANSITIVE CLOSURE over summaries (acquires + blocking
+    reached), the machinery behind "blocking call reached via
+    Dispatcher._requeue while holding CoordinatorState.lock".
+
+The graph is generic: it records every ``with <typed>.<attr>``
+acquisition and every dict-key read, and the analyzers (locks,
+protocol, threads, retrace) filter against their own declaration
+tables.  An expression the graph cannot type is not resolved -- the
+declared tables cover the concurrent surfaces, and the fixtures in
+tests/test_analysis_interproc.py pin the surfaces it must see.
+
+Get the per-context singleton with ``callgraph.get(ctx)``; seed it
+with the files an analyzer's own prefilters selected via
+``graph.load_file`` -- imports pull in the rest on demand.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+#: method-attribute calls that block (or compile) -- the locks
+#: analyzer forbids these while a declared lock is held, directly or
+#: reached through the call graph
+BLOCKING_ATTRS = {"sendall", "recv", "recv_into", "readline", "accept",
+                  "connect", "makefile", "call", "aot_compile",
+                  "ensure_warm", "warmup", "drain"}
+#: bare-name calls that block
+BLOCKING_NAMES = {"send_msg", "recv_msg", "sleep"}
+#: module-qualified calls that block
+BLOCKING_QUALIFIED = {("time", "sleep"), ("socket", "create_connection"),
+                      ("subprocess", "run"), ("subprocess", "check_call"),
+                      ("subprocess", "check_output"), ("jax", "jit"),
+                      ("jax", "pmap")}
+
+#: summary recursion budget: helper chains deeper than this are real
+#: architecture smells, and an unbounded walk over a pathological
+#: fixture must not hang the suite
+MAX_CLOSURE_DEPTH = 64
+
+
+def const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def expr_key(node) -> Optional[str]:
+    """Normalize a Name/Attribute chain ('self', 'self.state', ...);
+    None for anything a guard matcher should not try to compare."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = expr_key(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def ann_name(node) -> Optional[str]:
+    """A class name out of an annotation: ``X``, ``"X"``, or
+    ``Optional[X]``-style subscripts are reduced to X."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Name):
+        return node.id
+    s = const_str(node)
+    if s:
+        return s.strip().strip('"').strip("'")
+    if isinstance(node, ast.Subscript):
+        return ann_name(node.slice)
+    return None
+
+
+def walk_scope(node):
+    """ast.walk that does NOT descend into nested function/class
+    scopes (they are analyzed separately, with their own env)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def blocking_reason(node: ast.Call) -> Optional[str]:
+    """Why this call blocks, or None."""
+    f = node.func
+    if isinstance(f, ast.Name) and f.id in BLOCKING_NAMES:
+        return f.id
+    if isinstance(f, ast.Attribute):
+        if isinstance(f.value, ast.Name) \
+                and (f.value.id, f.attr) in BLOCKING_QUALIFIED:
+            return f"{f.value.id}.{f.attr}"
+        if f.attr in BLOCKING_ATTRS:
+            return f".{f.attr}()"
+    return None
+
+
+def fn_params(fn) -> list:
+    """Positional parameter names, in call order (posonly + args)."""
+    a = fn.args
+    return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+
+
+class FuncInfo:
+    __slots__ = ("key", "name", "node", "rel", "module", "cls")
+
+    def __init__(self, key, name, node, rel, module, cls):
+        self.key = key          # ("C", clsname, name) | ("F", rel, name)
+        self.name = name
+        self.node = node
+        self.rel = rel
+        self.module = module    # ModuleInfo
+        self.cls = cls          # ClassInfo | None
+
+    @property
+    def qualname(self) -> str:
+        if self.cls is not None:
+            return f"{self.cls.name}.{self.name}"
+        return self.name
+
+
+class ClassInfo:
+    __slots__ = ("name", "rel", "line", "node", "module", "methods",
+                 "bases", "method_marks", "_attr_types",
+                 "init_assigned")
+
+    def __init__(self, name, rel, line, node, module):
+        self.name = name
+        self.rel = rel
+        self.line = line
+        self.node = node
+        self.module = module
+        self.methods: dict = {}       # name -> FuncInfo
+        self.bases: list = []         # base-class name strings
+        #: method -> {attr: constant} for ``method._attr = const``
+        #: class-body annotations (_holds_lock, _submit_based, ...)
+        self.method_marks: dict = {}
+        self._attr_types = None       # lazy: needs demand loading
+        self.init_assigned: set = set()
+
+
+class ModuleInfo:
+    __slots__ = ("rel", "path", "tree", "classes", "functions",
+                 "imports", "from_imports", "consts")
+
+    def __init__(self, rel, path, tree):
+        self.rel = rel
+        self.path = path
+        self.tree = tree
+        self.classes: dict = {}       # name -> ClassInfo
+        self.functions: dict = {}     # name -> FuncInfo (module level)
+        self.imports: dict = {}       # alias -> dotted module
+        self.from_imports: dict = {}  # name -> (dotted module, orig)
+        self.consts: dict = {}        # module-level str constants
+
+
+class Summary:
+    """One function's facts, generic (no declaration-table filtering
+    here -- each analyzer applies its own)."""
+
+    __slots__ = ("acquires", "global_acquires", "blocking", "callees",
+                 "param_reads", "param_writes", "dict_keys",
+                 "return_exprs", "returned_names")
+
+    def __init__(self):
+        #: ``with <typed expr>.<attr>:`` contexts -> {(class, attr)}
+        self.acquires: set = set()
+        #: ``with <bare name>:`` contexts -> {(module rel, name)}
+        self.global_acquires: set = set()
+        self.blocking: list = []      # [(reason, line)]
+        self.callees: dict = {}       # key -> (FuncInfo, first line)
+        #: param -> {key: line} for param["k"] / param.get("k") /
+        #: "k" in param reads (the dict-dataflow the protocol checker
+        #: follows through helpers)
+        self.param_reads: dict = {}
+        #: param -> {key: line} for param["k"] = ... stores (helpers
+        #: that BUILD a response dict passed in by the handler)
+        self.param_writes: dict = {}
+        #: every dict-literal key + constant subscript store in the
+        #: body (the protocol checker's response over-approximation)
+        self.dict_keys: dict = {}
+        self.return_exprs: list = []  # ast nodes returned
+        self.returned_names: set = set()
+
+
+class Closure:
+    """Transitive facts reachable from one function."""
+
+    __slots__ = ("acquires", "global_acquires", "blocking")
+
+    def __init__(self):
+        self.acquires: set = set()
+        self.global_acquires: set = set()
+        #: [(reason, via-qualname or None, line at the entry function)]
+        self.blocking: list = []
+
+
+def get(ctx) -> "CallGraph":
+    """The per-AnalysisContext graph (built lazily, shared by every
+    analyzer in the run so files parse and summarize once)."""
+    g = getattr(ctx, "_callgraph", None)
+    if g is None:
+        g = ctx._callgraph = CallGraph(ctx)
+    return g
+
+
+class CallGraph:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.modules: dict = {}       # rel -> ModuleInfo | None
+        self.classes: dict = {}       # name -> ClassInfo (first wins)
+        self.returns: dict = {}       # func name -> class name
+        self._funcs: dict = {}        # key -> FuncInfo
+        self._summaries: dict = {}
+        self._closures: dict = {}
+        #: dotted prefix of the package ("dprf_tpu")
+        self.pkg = os.path.basename(ctx.package_dir)
+
+    # -- registry --------------------------------------------------------
+
+    def load_file(self, path: str) -> Optional[ModuleInfo]:
+        rel = self.ctx.rel(path)
+        if rel in self.modules:
+            return self.modules[rel]
+        tree = self.ctx.tree(path)
+        if tree is None:
+            self.modules[rel] = None
+            return None
+        mod = ModuleInfo(rel, path, tree)
+        self.modules[rel] = mod
+        self._register(mod)
+        return mod
+
+    def load_dotted(self, dotted: str) -> Optional[ModuleInfo]:
+        """``dprf_tpu.runtime.worker`` -> its ModuleInfo (parsed on
+        demand); None for anything outside the package."""
+        if not dotted.startswith(self.pkg):
+            return None
+        parts = dotted.split(".")
+        base = os.path.join(os.path.dirname(self.ctx.package_dir),
+                            *parts)
+        for cand in (base + ".py", os.path.join(base, "__init__.py")):
+            if os.path.isfile(cand):
+                return self.load_file(cand)
+        return None
+
+    def _register(self, mod: ModuleInfo) -> None:
+        idx = self.ctx.index(mod.path)
+        # imports are collected FILE-wide (idx.imports), not just
+        # module-level: the repo imports factories inside __init__
+        # bodies, and those are exactly the edges the retrace check
+        # resolves jit factories through
+        for node in idx.imports:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    mod.from_imports[a.asname or a.name] = (node.module,
+                                                            a.name)
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                s = const_str(node.value)
+                if s is not None:
+                    mod.consts[node.targets[0].id] = s
+            elif isinstance(node, ast.ClassDef):
+                self._register_class(mod, node)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                fi = FuncInfo(("F", mod.rel, node.name), node.name,
+                              node, mod.rel, mod, None)
+                mod.functions[node.name] = fi
+                self._funcs[fi.key] = fi
+                r = ann_name(node.returns)
+                if r:
+                    self.returns.setdefault(node.name, r)
+
+    def _register_class(self, mod: ModuleInfo, node: ast.ClassDef):
+        ci = ClassInfo(node.name, mod.rel, node.lineno, node, mod)
+        ci.bases = [b.id if isinstance(b, ast.Name) else b.attr
+                    for b in node.bases
+                    if isinstance(b, (ast.Name, ast.Attribute))]
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(("C", node.name, item.name), item.name,
+                              item, mod.rel, mod, ci)
+                ci.methods[item.name] = fi
+                self._funcs.setdefault(fi.key, fi)
+                r = ann_name(item.returns)
+                if r and item.name != "__init__":
+                    self.returns.setdefault(item.name, r)
+            elif isinstance(item, ast.Assign) and len(item.targets) == 1:
+                t = item.targets[0]
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name):
+                    marks = ci.method_marks.setdefault(t.value.id, {})
+                    if isinstance(item.value, ast.Constant):
+                        marks[t.attr] = item.value.value
+        mod.classes[node.name] = ci
+        self.classes.setdefault(node.name, ci)
+        # methods register under the class-name key space; a second
+        # class of the same name elsewhere keeps its own ModuleInfo
+        # entry but does not displace the first in the global table
+
+    def func(self, key) -> Optional[FuncInfo]:
+        return self._funcs.get(key)
+
+    # -- type resolution --------------------------------------------------
+
+    def class_named(self, name: Optional[str],
+                    mod: Optional[ModuleInfo] = None) \
+            -> Optional[ClassInfo]:
+        """The ClassInfo for a name, demand-loading the module an
+        import binds it to."""
+        if not name:
+            return None
+        ci = self.classes.get(name)
+        if ci is not None:
+            return ci
+        if mod is not None:
+            tgt = mod.from_imports.get(name)
+            if tgt is not None:
+                m = self.load_dotted(tgt[0])
+                if m is not None:
+                    return self.classes.get(tgt[1]) or \
+                        self.classes.get(name)
+        return None
+
+    def factory_class(self, fname: str,
+                      mod: Optional[ModuleInfo] = None) -> Optional[str]:
+        """Class name a factory call returns, by return annotation
+        (demand-loading the factory's module when imported)."""
+        c = self.returns.get(fname)
+        if c is not None:
+            return c
+        if mod is not None:
+            tgt = mod.from_imports.get(fname)
+            if tgt is not None and self.load_dotted(tgt[0]) is not None:
+                return self.returns.get(tgt[1]) or self.returns.get(fname)
+        return None
+
+    def attr_types(self, ci: ClassInfo) -> dict:
+        """self-attr -> class name, from __init__ (annotated-parameter
+        assignment, direct construction, annotated factory call,
+        AnnAssign) -- lazy because annotation resolution may demand-
+        load other modules."""
+        if ci._attr_types is not None:
+            return ci._attr_types
+        out: dict = {}
+        ci._attr_types = out          # set first: cycles terminate
+        init = ci.methods.get("__init__")
+        if init is None:
+            return out
+        fn = init.node
+        ann = {}
+        a = fn.args
+        for p in (list(a.posonlyargs) + list(a.args)
+                  + list(a.kwonlyargs)):
+            n = ann_name(p.annotation)
+            if self.class_named(n, ci.module) is not None:
+                ann[p.arg] = n
+        for st in walk_scope(fn):
+            if isinstance(st, ast.Assign) and len(st.targets) == 1:
+                t = st.targets[0]
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    ci.init_assigned.add(t.attr)
+                    ty = None
+                    if isinstance(st.value, ast.Name):
+                        ty = ann.get(st.value.id)
+                    elif isinstance(st.value, ast.Call):
+                        ty = self.infer_call_type(st.value, ci.module)
+                    if ty:
+                        out[t.attr] = ty
+            elif isinstance(st, ast.AnnAssign):
+                t = st.target
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    ci.init_assigned.add(t.attr)
+                    ty = ann_name(st.annotation)
+                    if self.class_named(ty, ci.module) is not None:
+                        out[t.attr] = ty
+        return out
+
+    def infer_call_type(self, call: ast.Call,
+                        mod: Optional[ModuleInfo]) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if self.class_named(f.id, mod) is not None:
+                return f.id                     # direct construction
+            return self.factory_class(f.id, mod)
+        if isinstance(f, ast.Attribute):
+            return self.factory_class(f.attr, mod)
+        return None
+
+    def method(self, cls_name: str, name: str) -> Optional[FuncInfo]:
+        """Method lookup through the (name-resolved) base-class chain."""
+        seen = set()
+        stack = [cls_name]
+        while stack:
+            cn = stack.pop(0)
+            if cn in seen:
+                continue
+            seen.add(cn)
+            ci = self.classes.get(cn)
+            if ci is None:
+                continue
+            fi = ci.methods.get(name)
+            if fi is not None:
+                return fi
+            for b in ci.bases:
+                self.class_named(b, ci.module)   # demand-load
+                stack.append(b)
+        return None
+
+    def scope(self, fi: FuncInfo) -> "TypeScope":
+        return TypeScope(self, fi.node, fi.module,
+                         fi.cls.name if fi.cls is not None else None)
+
+    # -- summaries ---------------------------------------------------------
+
+    def summary(self, fi: FuncInfo) -> Summary:
+        s = self._summaries.get(fi.key)
+        if s is None:
+            s = self._summaries[fi.key] = self._summarize(fi)
+        return s
+
+    def _summarize(self, fi: FuncInfo) -> Summary:
+        s = Summary()
+        sc = self.scope(fi)
+        params = set(fn_params(fi.node))
+        params.update(p.arg for p in fi.node.args.kwonlyargs)
+        for node in walk_scope(fi.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    e = item.context_expr
+                    if isinstance(e, ast.Attribute):
+                        ty = sc.type_of(e.value)
+                        if ty is not None:
+                            s.acquires.add((ty, e.attr))
+                    elif isinstance(e, ast.Name):
+                        s.global_acquires.add((fi.rel, e.id))
+            elif isinstance(node, ast.Call):
+                why = blocking_reason(node)
+                if why is not None:
+                    s.blocking.append((why, node.lineno))
+                callee = self.resolve_call(node, sc)
+                if callee is not None and callee.key != fi.key:
+                    s.callees.setdefault(callee.key,
+                                         (callee, node.lineno))
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "get" \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in params and node.args:
+                    k = const_str(node.args[0])
+                    if k is not None:
+                        s.param_reads.setdefault(
+                            f.value.id, {}).setdefault(k, node.lineno)
+            elif isinstance(node, ast.Subscript):
+                k = const_str(node.slice)
+                if k is None:
+                    continue
+                if isinstance(node.value, ast.Name) \
+                        and node.value.id in params:
+                    d = (s.param_writes
+                         if isinstance(node.ctx, (ast.Store, ast.Del))
+                         else s.param_reads)
+                    d.setdefault(node.value.id, {}).setdefault(
+                        k, node.lineno)
+                if isinstance(node.ctx, ast.Store):
+                    s.dict_keys.setdefault(k, node.lineno)
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                    and node.comparators \
+                    and isinstance(node.comparators[0], ast.Name) \
+                    and node.comparators[0].id in params:
+                k = const_str(node.left)
+                if k is not None:
+                    s.param_reads.setdefault(
+                        node.comparators[0].id, {}).setdefault(
+                            k, node.lineno)
+            elif isinstance(node, ast.Dict):
+                for kn in node.keys:
+                    k = const_str(kn)
+                    if k is not None:
+                        s.dict_keys.setdefault(k, node.lineno)
+            elif isinstance(node, ast.Return) and node.value is not None:
+                s.return_exprs.append(node.value)
+                if isinstance(node.value, ast.Name):
+                    s.returned_names.add(node.value.id)
+        return s
+
+    def resolve_call(self, node: ast.Call,
+                     sc: "TypeScope") -> Optional[FuncInfo]:
+        """The FuncInfo a call statically reaches: a type-resolved
+        method, a same-module function, an imported function, or a
+        ``module.func()`` through an import alias."""
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            ty = sc.type_of(f.value)
+            if ty is not None:
+                return self.method(ty, f.attr)
+            if isinstance(f.value, ast.Name):
+                dotted = sc.module.imports.get(f.value.id)
+                if dotted is not None:
+                    m = self.load_dotted(dotted)
+                    if m is not None:
+                        return m.functions.get(f.attr)
+            return None
+        if isinstance(f, ast.Name):
+            fi = sc.module.functions.get(f.id)
+            if fi is not None:
+                return fi
+            tgt = sc.module.from_imports.get(f.id)
+            if tgt is not None:
+                m = self.load_dotted(tgt[0])
+                if m is not None:
+                    return m.functions.get(tgt[1])
+        return None
+
+    # -- transitive closure ------------------------------------------------
+
+    def closure(self, fi: FuncInfo) -> Closure:
+        out, _ = self._walk_closure(fi, set(), 0)
+        return out
+
+    def _walk_closure(self, fi: FuncInfo, visiting: set, depth: int):
+        """(Closure, tainted?) -- tainted means a cycle back-edge (or
+        the depth cap) truncated the recursion below, so the result
+        may be incomplete for THIS node and must not be cached
+        (caching a mid-cycle placeholder would permanently hide a
+        cycle member's facts from later call sites).  The root's
+        union is complete: every reachable node's direct facts fold
+        in exactly once."""
+        cached = self._closures.get(fi.key)
+        if cached is not None:
+            return cached, False
+        if fi.key in visiting or depth > MAX_CLOSURE_DEPTH:
+            return Closure(), True
+        visiting.add(fi.key)
+        s = self.summary(fi)
+        out = Closure()
+        out.acquires |= s.acquires
+        out.global_acquires |= s.global_acquires
+        out.blocking.extend((r, None, ln) for r, ln in s.blocking)
+        tainted = False
+        for key, (callee, line) in s.callees.items():
+            sub, t = self._walk_closure(callee, visiting, depth + 1)
+            tainted = tainted or t
+            out.acquires |= sub.acquires
+            out.global_acquires |= sub.global_acquires
+            for reason, via, _ in sub.blocking:
+                out.blocking.append(
+                    (reason, via or callee.qualname, line))
+        visiting.discard(fi.key)
+        if not tainted or not visiting:
+            self._closures[fi.key] = out
+        return out, tainted
+
+
+class TypeScope:
+    """Static typing for one function body (the locks analyzer's
+    resolution rules, lifted here so every interprocedural check
+    shares them): annotations, direct constructions, annotated
+    factories, and class attribute types."""
+
+    __slots__ = ("g", "fn", "module", "env")
+
+    def __init__(self, g: CallGraph, fn, module: ModuleInfo,
+                 cls_name: Optional[str]):
+        self.g = g
+        self.fn = fn
+        self.module = module
+        self.env: dict = {}
+        if cls_name is not None:
+            self.env["self"] = cls_name
+        self._build()
+
+    def _learn(self, name: str, ty: Optional[str]) -> None:
+        if ty is None:
+            return
+        cur = self.env.get(name)
+        if cur is not None and cur != ty:
+            self.env[name] = None    # conflicting: stop trusting it
+        elif cur is None and name in self.env:
+            pass                     # already poisoned
+        else:
+            self.env[name] = ty
+
+    def _build(self) -> None:
+        args = self.fn.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            n = ann_name(a.annotation)
+            if self.g.class_named(n, self.module) is not None:
+                self._learn(a.arg, n)
+        for node in walk_scope(self.fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                self._learn(node.targets[0].id,
+                            self.type_of(node.value))
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name):
+                n = ann_name(node.annotation)
+                if self.g.class_named(n, self.module) is not None:
+                    self._learn(node.target.id, n)
+
+    def type_of(self, node) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.type_of(node.value)
+            if base is not None:
+                ci = self.g.classes.get(base)
+                if ci is not None:
+                    return self.g.attr_types(ci).get(node.attr)
+            return None
+        if isinstance(node, ast.Call):
+            return self.g.infer_call_type(node, self.module)
+        return None

@@ -10,13 +10,16 @@ module-level ``GUARDED_BY`` table::
     GUARDED_BY = {
         "CoordinatorState": {"lock": ("found", "dispatcher", ...)},
         "_CompletionSender": {"<atomic>": ("error", "stop_seen")},
+        "<module>": {"_lock": ("_state",)},
     }
     # and in the class body, for methods called with the lock held:
     def _stopped(self): ...
     _stopped._holds_lock = "lock"
 
-Lock names are instance attributes holding a ``threading.Lock``.  Two
-special pseudo-locks:
+Lock names are instance attributes holding a ``threading.Lock`` or
+``threading.RLock`` (reentrant: re-acquiring an RLock already held is
+NOT a self-deadlock, and never a lock-order edge against itself).
+Three special keys:
 
   ``<atomic>``   single-writer latched flags (GIL-atomic reference
                  assignments read cross-thread by design).  Reads are
@@ -29,6 +32,11 @@ special pseudo-locks:
                  acquisition would be invisible to callers' lock-order
                  reasoning -- and owners declare the reference to it
                  as a guarded attribute.
+  ``<module>``   module-GLOBAL state guarded by a module-global lock
+                 (the compilecache ``_state`` under ``_lock`` shape):
+                 every function in the declaring module touching the
+                 global must hold ``with <lock>:`` (or carry
+                 ``func._holds_lock = "<lock>"``).
 
 Checks:
 
@@ -38,20 +46,21 @@ Checks:
      (construction happens-before publication);
   2. no blocking call (socket send/recv, RPC ``.call``, ``time.sleep``,
      jax compile entry points, subprocess) while any declared lock is
-     held;
+     held -- including blocking calls REACHED through the call graph
+     (analysis/callgraph.py): a helper that sleeps is as much a stall
+     under the lock as an inline sleep;
   3. lock-acquisition-order: acquiring (directly, or transitively via
-     a method call the checker can type-resolve) lock B while holding
-     lock A records the edge A->B; any cycle in that graph is an
-     inversion waiting for its third thread, and fails the check.
+     any call the graph can resolve -- methods AND module functions)
+     lock B while holding lock A records the edge A->B; any cycle in
+     that graph is an inversion waiting for its third thread, and
+     fails the check.
 
-Type resolution is deliberately simple and STATIC: ``self`` inside a
-class; parameters, locals, and instance attributes with class
-annotations; direct constructions ``x = ClassName(...)``; and calls to
-functions whose return annotation names a known class (e.g.
-``get_tracer() -> "TraceRecorder"``).  An expression the checker
-cannot type is not checked -- the declared tables cover the
-concurrent surfaces, and fixtures in tests/test_analysis.py pin the
-surfaces it must see.
+Type resolution is the call graph's (callgraph.TypeScope): ``self``
+inside a class; parameters, locals, and instance attributes with
+class annotations; direct constructions; annotated factory calls.  An
+expression the checker cannot type is not checked -- the declared
+tables cover the concurrent surfaces, and fixtures in
+tests/test_analysis.py pin the surfaces it must see.
 """
 
 from __future__ import annotations
@@ -60,60 +69,25 @@ import ast
 from typing import Optional
 
 from dprf_tpu.analysis import Finding
+from dprf_tpu.analysis import callgraph as cg
+from dprf_tpu.analysis.callgraph import (ann_name, blocking_reason,
+                                         const_str, expr_key,
+                                         walk_scope)
 
 NAME = "locks"
-DESCRIPTION = ("guarded-by discipline, blocking-calls-under-lock, and "
-               "lock-order cycles over declared GUARDED_BY tables")
+DESCRIPTION = ("guarded-by discipline, blocking-calls-under-lock "
+               "(direct and through the call graph), and lock-order "
+               "cycles over declared GUARDED_BY tables")
 
 ATOMIC = "<atomic>"
 EXTERN = "<extern>"
+MODULE = "<module>"
 
-#: method-attribute calls that block (or compile) -- forbidden while a
-#: declared lock is held
-BLOCKING_ATTRS = {"sendall", "recv", "recv_into", "readline", "accept",
-                  "connect", "makefile", "call", "aot_compile",
-                  "ensure_warm", "warmup", "drain"}
-#: bare-name calls that block
-BLOCKING_NAMES = {"send_msg", "recv_msg", "sleep"}
-#: module-qualified calls that block
-BLOCKING_QUALIFIED = {("time", "sleep"), ("socket", "create_connection"),
-                      ("subprocess", "run"), ("subprocess", "check_call"),
-                      ("subprocess", "check_output"), ("jax", "jit"),
-                      ("jax", "pmap")}
-
-
-def _const_str(node) -> Optional[str]:
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    return None
-
-
-def _expr_key(node) -> Optional[str]:
-    """Normalize a Name/Attribute chain ('self', 'self.state', ...);
-    None for anything the guard matcher should not try to compare."""
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Attribute):
-        base = _expr_key(node.value)
-        return f"{base}.{node.attr}" if base else None
-    return None
-
-
-def _ann_name(node) -> Optional[str]:
-    """A class name out of an annotation: ``X``, ``"X"``, or
-    ``Optional[X]``-style subscripts are reduced to X."""
-    if node is None:
-        return None
-    if isinstance(node, ast.Name):
-        return node.id
-    s = _const_str(node)
-    if s:
-        return s.strip().strip('"').strip("'")
-    if isinstance(node, ast.Subscript):
-        # Optional[X] / "Optional[X]": dig for the inner name
-        inner = node.slice
-        return _ann_name(inner)
-    return None
+#: re-exported for compatibility (the shared tables live in the
+#: call-graph core now)
+BLOCKING_ATTRS = cg.BLOCKING_ATTRS
+BLOCKING_NAMES = cg.BLOCKING_NAMES
+BLOCKING_QUALIFIED = cg.BLOCKING_QUALIFIED
 
 
 # ---------------------------------------------------------------------------
@@ -124,39 +98,41 @@ class _ClassSpec:
         self.name = name
         self.rel = rel
         self.line = line
-        self.declared = False        # has a GUARDED_BY entry
         self.guards: dict = {}       # attr -> lock name
         self.atomic: set = set()
         self.extern = False
         self.locks: set = set()      # declared lock attr names
+        self.rlocks: set = set()     # declared locks that are RLocks
         self.holds: dict = {}        # method -> lock name
-        self.attr_types: dict = {}   # self-attr -> class name
-        self.methods: dict = {}      # name -> ast.FunctionDef
-        self.init_assigned: set = set()   # attrs assigned in __init__
 
 
 def _parse_guarded_by(node: ast.Assign, rel: str, out: dict,
-                      findings: list) -> None:
+                      module_out: dict, findings: list) -> None:
     v = node.value
     if not isinstance(v, ast.Dict):
         findings.append(Finding(NAME, rel, node.lineno,
                                 "GUARDED_BY must be a dict literal"))
         return
     for ck, cv in zip(v.keys, v.values):
-        cname = _const_str(ck)
+        cname = const_str(ck)
         if cname is None or not isinstance(cv, ast.Dict):
             findings.append(Finding(
                 NAME, rel, node.lineno,
                 "GUARDED_BY entries must map a class-name string to "
                 "a {lock: (attrs...)} dict literal"))
             continue
-        spec = out.setdefault(cname, {"rel": rel, "line": node.lineno,
-                                      "locks": {}})
+        if cname == MODULE:
+            spec = module_out.setdefault(rel, {"line": node.lineno,
+                                               "locks": {}})
+        else:
+            spec = out.setdefault(cname, {"rel": rel,
+                                          "line": node.lineno,
+                                          "locks": {}})
         for lk, lv in zip(cv.keys, cv.values):
-            lname = _const_str(lk)
+            lname = const_str(lk)
             attrs = []
             if isinstance(lv, (ast.Tuple, ast.List)):
-                attrs = [_const_str(e) for e in lv.elts]
+                attrs = [const_str(e) for e in lv.elts]
             if lname is None or any(a is None for a in attrs):
                 findings.append(Finding(
                     NAME, rel, node.lineno,
@@ -166,32 +142,40 @@ def _parse_guarded_by(node: ast.Assign, rel: str, out: dict,
             spec["locks"][lname] = attrs
 
 
-def _walk_scope(node):
-    """ast.walk that does NOT descend into nested function/class
-    scopes (they are analyzed separately, with their own env)."""
-    stack = list(ast.iter_child_nodes(node))
-    while stack:
-        n = stack.pop()
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                          ast.Lambda, ast.ClassDef)):
-            continue
-        yield n
-        stack.extend(ast.iter_child_nodes(n))
+def _is_rlock_call(node) -> bool:
+    """``threading.RLock()`` / ``RLock()``."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id == "RLock"
+    return isinstance(f, ast.Attribute) and f.attr == "RLock"
 
 
-def _collect(ctx):
-    """(specs: name -> _ClassSpec for EVERY class (guards filled only
-    for GUARDED_BY-declared ones), class_nodes, returns, findings)."""
+class _ModuleGuard:
+    """One file's <module> declaration: global names guarded by
+    module-global locks."""
+
+    def __init__(self, rel: str, line: int):
+        self.rel = rel
+        self.line = line
+        self.guards: dict = {}       # global name -> lock name
+        self.locks: set = set()
+        self.rlocks: set = set()
+        self.holds: dict = {}        # function name -> lock name
+
+
+def _collect(ctx, graph: "cg.CallGraph"):
+    """(specs: declared-class name -> _ClassSpec, module_guards:
+    rel -> _ModuleGuard, findings).  Files register into the shared
+    call graph; staged needle parsing keeps untouched files unparsed
+    (a file whose SOURCE never names a declared class, GUARDED_BY, or
+    a factory returning one cannot define, type, or touch anything
+    this checker reasons about)."""
     findings: list = []
     declared: dict = {}      # class name -> raw decl
-    class_nodes: dict = {}   # class name -> (node, rel)
-    returns: dict = {}       # function name -> class name
+    module_decl: dict = {}   # rel -> raw decl
 
-    # staged parsing: a file whose SOURCE never names a declared class
-    # (or GUARDED_BY, or a factory returning one) cannot define, type,
-    # or touch anything this checker reasons about -- typing always
-    # needs the name in source (construction, annotation, factory
-    # call), so skipping its parse drops no finding.
     files = ctx.package_files()
     srcs = {}
     for path in files:
@@ -204,143 +188,124 @@ def _collect(ctx):
     for path in files:
         if "GUARDED_BY" not in srcs[path]:
             continue
-        tree = ctx.tree(path)
-        if tree is None:
+        mod = graph.load_file(path)
+        if mod is None:
             continue
         rel = ctx.rel(path)
-        for node in tree.body:
+        for node in mod.tree.body:
             if (isinstance(node, ast.Assign) and len(node.targets) == 1
                     and isinstance(node.targets[0], ast.Name)
                     and node.targets[0].id == "GUARDED_BY"):
-                _parse_guarded_by(node, rel, declared, findings)
-
-    def _scan(path):
-        idx = ctx.index(path)
-        if idx is None:
-            return
-        rel = ctx.rel(path)
-        for node in idx.classes:
-            class_nodes[node.name] = (node, rel)
-        for node in idx.functions:
-            r = _ann_name(node.returns)
-            if r:
-                returns[node.name] = r
+                _parse_guarded_by(node, rel, declared, module_decl,
+                                  findings)
 
     needles = set(declared) | {"GUARDED_BY"}
-    scanned = set()
+    loaded = set()
     for path in files:
         if any(n in srcs[path] for n in needles):
-            scanned.add(path)
-            _scan(path)
+            loaded.add(path)
+            graph.load_file(path)
     # one widening round: factories returning a declared class pull in
     # the files that only ever touch it through the factory
-    factories = {f for f, c in returns.items() if c in declared}
+    factories = {f for f, c in graph.returns.items() if c in declared}
     if factories:
         for path in files:
-            if path not in scanned \
+            if path not in loaded \
                     and any(f in srcs[path] for f in factories):
-                _scan(path)
-
-    # keep only return annotations that name a class we know about
-    returns = {k: v for k, v in returns.items() if v in class_nodes}
+                loaded.add(path)
+                graph.load_file(path)
 
     specs: dict = {}
-    for cname, (node, rel) in class_nodes.items():
-        spec = _ClassSpec(cname, rel, node.lineno)
-        decl = declared.pop(cname, None)
-        if decl is not None:
-            spec.declared = True
-            for lname, attrs in decl["locks"].items():
-                if lname == ATOMIC:
-                    spec.atomic.update(attrs)
-                elif lname == EXTERN:
-                    spec.extern = True
-                else:
-                    spec.locks.add(lname)
-                    for a in attrs:
-                        if a in spec.guards:
-                            findings.append(Finding(
-                                NAME, rel, node.lineno,
-                                f"{cname}.{a} declared guarded by "
-                                "two locks"))
-                        spec.guards[a] = lname
-        _scan_class_body(spec, node, returns, class_nodes, findings)
-        specs[cname] = spec
     for cname, decl in declared.items():
-        findings.append(Finding(
-            NAME, decl["rel"], decl["line"],
-            f"GUARDED_BY declares unknown class {cname!r}"))
-    return specs, class_nodes, returns, findings
-
-
-def _infer_call_type(call: ast.Call, returns: dict,
-                     class_nodes: dict) -> Optional[str]:
-    f = call.func
-    if isinstance(f, ast.Name):
-        if f.id in class_nodes:
-            return f.id                 # direct construction
-        return returns.get(f.id)        # annotated factory
-    if isinstance(f, ast.Attribute):
-        return returns.get(f.attr)      # module.factory()
-    return None
-
-
-def _scan_class_body(spec: _ClassSpec, node: ast.ClassDef,
-                     returns: dict, class_nodes: dict,
-                     findings: list) -> None:
-    for item in node.body:
-        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            spec.methods[item.name] = item
-        elif isinstance(item, ast.Assign) and len(item.targets) == 1:
-            # method._holds_lock = "lock" annotations
-            t = item.targets[0]
-            if (isinstance(t, ast.Attribute)
-                    and isinstance(t.value, ast.Name)
-                    and t.attr == "_holds_lock"):
-                lock = _const_str(item.value)
-                if lock:
-                    spec.holds[t.value.id] = lock
-    init = spec.methods.get("__init__")
-    if init is not None:
-        # parameter annotations: self.X = <annotated param>
-        ann = {}
-        args = init.args
-        for a in (list(args.posonlyargs) + list(args.args)
-                  + list(args.kwonlyargs)):
-            n = _ann_name(a.annotation)
-            if n in class_nodes:
-                ann[a.arg] = n
-        for st in _walk_scope(init):
-            if isinstance(st, ast.Assign) and len(st.targets) == 1:
-                t = st.targets[0]
-                if (isinstance(t, ast.Attribute)
-                        and isinstance(t.value, ast.Name)
-                        and t.value.id == "self"):
-                    spec.init_assigned.add(t.attr)
-                    ty = None
-                    if isinstance(st.value, ast.Name):
-                        ty = ann.get(st.value.id)
-                    elif isinstance(st.value, ast.Call):
-                        ty = _infer_call_type(st.value, returns,
-                                              class_nodes)
-                    if ty:
-                        spec.attr_types[t.attr] = ty
-            elif isinstance(st, ast.AnnAssign):
-                t = st.target
-                if (isinstance(t, ast.Attribute)
-                        and isinstance(t.value, ast.Name)
-                        and t.value.id == "self"):
-                    spec.init_assigned.add(t.attr)
-                    ty = _ann_name(st.annotation)
-                    if ty in class_nodes:
-                        spec.attr_types[t.attr] = ty
-    for lock in spec.locks:
-        if init is None or lock not in spec.init_assigned:
+        ci = graph.classes.get(cname)
+        if ci is None:
             findings.append(Finding(
-                NAME, spec.rel, spec.line,
-                f"{spec.name}: declared lock {lock!r} is never "
-                "assigned in __init__ -- the guard would silently "
-                "never exist"))
+                NAME, decl["rel"], decl["line"],
+                f"GUARDED_BY declares unknown class {cname!r}"))
+            continue
+        spec = _ClassSpec(cname, ci.rel, ci.line)
+        for lname, attrs in decl["locks"].items():
+            if lname == ATOMIC:
+                spec.atomic.update(attrs)
+            elif lname == EXTERN:
+                spec.extern = True
+            else:
+                spec.locks.add(lname)
+                for a in attrs:
+                    if a in spec.guards:
+                        findings.append(Finding(
+                            NAME, ci.rel, ci.line,
+                            f"{cname}.{a} declared guarded by two "
+                            "locks"))
+                    spec.guards[a] = lname
+        for mname, marks in ci.method_marks.items():
+            lock = marks.get("_holds_lock")
+            if isinstance(lock, str):
+                spec.holds[mname] = lock
+        # lock existence + RLock detection from __init__ (attr_types
+        # fills init_assigned as a side effect)
+        graph.attr_types(ci)
+        init = ci.methods.get("__init__")
+        rlock_attrs = set()
+        if init is not None:
+            for st in walk_scope(init.node):
+                if isinstance(st, ast.Assign) and len(st.targets) == 1:
+                    t = st.targets[0]
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and _is_rlock_call(st.value)):
+                        rlock_attrs.add(t.attr)
+        spec.rlocks = spec.locks & rlock_attrs
+        for lock in spec.locks:
+            if lock not in ci.init_assigned:
+                findings.append(Finding(
+                    NAME, ci.rel, ci.line,
+                    f"{cname}: declared lock {lock!r} is never "
+                    "assigned in __init__ -- the guard would silently "
+                    "never exist"))
+        specs[cname] = spec
+
+    module_guards: dict = {}
+    for rel, decl in module_decl.items():
+        mg = _ModuleGuard(rel, decl["line"])
+        for lname, attrs in decl["locks"].items():
+            mg.locks.add(lname)
+            for a in attrs:
+                mg.guards[a] = lname
+        mod = graph.modules.get(rel)
+        if mod is not None:
+            lock_assigned = set()
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    name = node.targets[0].id
+                    lock_assigned.add(name)
+                    if _is_rlock_call(node.value):
+                        mg.rlocks.add(name)
+                elif isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1:
+                    pass
+                # func._holds_lock = "<lock>" at module level
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Attribute) \
+                        and isinstance(node.targets[0].value,
+                                       ast.Name) \
+                        and node.targets[0].attr == "_holds_lock":
+                    lock = const_str(node.value)
+                    if lock:
+                        mg.holds[node.targets[0].value.id] = lock
+            for lname in mg.locks:
+                if lname not in lock_assigned:
+                    findings.append(Finding(
+                        NAME, rel, decl["line"],
+                        f"<module> lock {lname!r} is never assigned "
+                        "at module level -- the guard would silently "
+                        "never exist"))
+        module_guards[rel] = mg
+    return specs, module_guards, findings
 
 
 # ---------------------------------------------------------------------------
@@ -348,63 +313,21 @@ def _scan_class_body(spec: _ClassSpec, node: ast.ClassDef,
 
 class _FnAnalysis:
     """One function/method walk: guarded-access, blocking-call, and
-    lock-edge collection under a lexical held-locks stack."""
+    lock-edge collection under a lexical held-locks stack.  Held
+    entries are (class name | ("<module>", rel), lock name, owner
+    expr key)."""
 
     def __init__(self, checker: "_Checker", fn, rel: str,
-                 cls: Optional[_ClassSpec], fname: str):
+                 cls: Optional[_ClassSpec], fname: str,
+                 scope: "cg.TypeScope"):
         self.c = checker
         self.fn = fn
         self.rel = rel
         self.cls = cls
         self.fname = fname
-        self.env: dict = {}          # name -> class name
-        if cls is not None:
-            self.env["self"] = cls.name
-        self._build_env()
-
-    def _learn(self, name: str, ty: Optional[str]) -> None:
-        if ty is None:
-            return
-        cur = self.env.get(name)
-        if cur is not None and cur != ty:
-            self.env[name] = None    # conflicting: stop trusting it
-        elif cur is None and name in self.env:
-            pass                     # already poisoned
-        else:
-            self.env[name] = ty
-
-    def _build_env(self) -> None:
-        args = self.fn.args
-        for a in (list(args.posonlyargs) + list(args.args)
-                  + list(args.kwonlyargs)):
-            n = _ann_name(a.annotation)
-            if n in self.c.class_nodes:
-                self._learn(a.arg, n)
-        for node in _walk_scope(self.fn):
-            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                    and isinstance(node.targets[0], ast.Name):
-                self._learn(node.targets[0].id,
-                            self._type_of(node.value))
-            elif isinstance(node, ast.AnnAssign) \
-                    and isinstance(node.target, ast.Name):
-                n = _ann_name(node.annotation)
-                if n in self.c.class_nodes:
-                    self._learn(node.target.id, n)
-
-    def _type_of(self, node) -> Optional[str]:
-        if isinstance(node, ast.Name):
-            return self.env.get(node.id)
-        if isinstance(node, ast.Attribute):
-            base = self._type_of(node.value)
-            if base is not None:
-                spec = self.c.classes.get(base)
-                if spec is not None:
-                    return spec.attr_types.get(node.attr)
-            return None
-        if isinstance(node, ast.Call):
-            return _infer_call_type(node, self.c.returns,
-                                    self.c.class_nodes)
-        return None
+        self.scope = scope
+        self.mg: Optional[_ModuleGuard] = \
+            checker.module_guards.get(rel)
 
     # -- the walk --------------------------------------------------------
 
@@ -414,18 +337,33 @@ class _FnAnalysis:
             lock = self.cls.holds.get(self.fname)
             if lock:
                 held = [(self.cls.name, lock, "self")]
+        if self.mg is not None:
+            lock = self.mg.holds.get(self.fname)
+            if lock:
+                held = held + [((MODULE, self.rel), lock, lock)]
         self._visit_body(self.fn.body, held)
 
     def _lock_of_with(self, expr):
-        """(class, lock, owner_key) when the with-context is
-        ``<typed expr>.<declared lock>``."""
-        if not isinstance(expr, ast.Attribute):
+        """(class-or-module key, lock, owner_key) when the
+        with-context is ``<typed expr>.<declared lock>`` or a bare
+        module-lock name."""
+        if isinstance(expr, ast.Attribute):
+            ty = self.scope.type_of(expr.value)
+            spec = self.c.specs.get(ty) if ty else None
+            if spec is not None and expr.attr in spec.locks:
+                return (ty, expr.attr, expr_key(expr.value))
             return None
-        ty = self._type_of(expr.value)
-        spec = self.c.classes.get(ty) if ty else None
-        if spec is not None and expr.attr in spec.locks:
-            return (ty, expr.attr, _expr_key(expr.value))
+        if isinstance(expr, ast.Name) and self.mg is not None \
+                and expr.id in self.mg.locks:
+            return ((MODULE, self.rel), expr.id, expr.id)
         return None
+
+    def _is_rlock(self, acq) -> bool:
+        key, lock = acq[0], acq[1]
+        if isinstance(key, tuple):
+            return lock in (self.mg.rlocks if self.mg else ())
+        spec = self.c.specs.get(key)
+        return spec is not None and lock in spec.rlocks
 
     def _visit_body(self, stmts, held) -> None:
         for st in stmts:
@@ -441,18 +379,24 @@ class _FnAnalysis:
                 self._scan_expr(item.context_expr, held)
                 acq = self._lock_of_with(item.context_expr)
                 if acq is not None:
+                    reacquired = False
                     for h in new:
                         if (h[0], h[1]) != (acq[0], acq[1]):
                             self.c.add_edge((h[0], h[1]),
                                             (acq[0], acq[1]),
                                             self.rel, st.lineno)
+                        elif self._is_rlock(acq):
+                            # reentrant by construction: not a
+                            # deadlock, and no self-edge
+                            reacquired = True
                         else:
                             self.c.findings.append(Finding(
                                 NAME, self.rel, st.lineno,
-                                f"re-acquiring {acq[0]}.{acq[1]} "
+                                f"re-acquiring {self._lname(acq)} "
                                 "while already held (deadlock with a "
                                 "non-reentrant Lock)"))
-                    new.append(acq)
+                    if not reacquired:
+                        new.append(acq)
             self._visit_body(st.body, new)
             return
         for child in ast.iter_child_nodes(st):
@@ -465,6 +409,13 @@ class _FnAnalysis:
             else:
                 self._scan_expr(child, held)
 
+    @staticmethod
+    def _lname(entry) -> str:
+        key, lock = entry[0], entry[1]
+        if isinstance(key, tuple):
+            return f"{key[1]}:{lock}"
+        return f"{key}.{lock}"
+
     # -- expression-level checks -----------------------------------------
 
     def _scan_expr(self, node, held) -> None:
@@ -475,16 +426,33 @@ class _FnAnalysis:
             self._check_attr(node, held)
         elif isinstance(node, ast.Call):
             self._check_call(node, held)
+        elif isinstance(node, ast.Name):
+            self._check_global(node, held)
         for child in ast.iter_child_nodes(node):
             self._scan_expr(child, held)
 
+    def _check_global(self, node: ast.Name, held) -> None:
+        if self.mg is None:
+            return
+        lock = self.mg.guards.get(node.id)
+        if lock is None:
+            return
+        if ((MODULE, self.rel), lock, lock) in held:
+            return
+        self.c.findings.append(Finding(
+            NAME, self.rel, node.lineno,
+            f"module global {node.id!r} is guarded by module lock "
+            f"{lock!r} but accessed without it (wrap in `with "
+            f"{lock}:` or annotate the function "
+            f"`_holds_lock = {lock!r}`)"))
+
     def _check_attr(self, node: ast.Attribute, held) -> None:
-        ty = self._type_of(node.value)
-        spec = self.c.classes.get(ty) if ty else None
+        ty = self.scope.type_of(node.value)
+        spec = self.c.specs.get(ty) if ty else None
         if spec is None:
             return
         attr = node.attr
-        owner = _expr_key(node.value)
+        owner = expr_key(node.value)
         in_own_init = (self.cls is not None and self.cls.name == ty
                        and self.fname == "__init__" and owner == "self")
         if attr in spec.atomic:
@@ -515,45 +483,41 @@ class _FnAnalysis:
             f"{owner or '<owner>'}.{lock}:` or annotate the method "
             f"`_holds_lock = {lock!r}`)"))
 
-    def _blocking_reason(self, node: ast.Call) -> Optional[str]:
-        f = node.func
-        if isinstance(f, ast.Name) and f.id in BLOCKING_NAMES:
-            return f.id
-        if isinstance(f, ast.Attribute):
-            if isinstance(f.value, ast.Name) \
-                    and (f.value.id, f.attr) in BLOCKING_QUALIFIED:
-                return f"{f.value.id}.{f.attr}"
-            if f.attr in BLOCKING_ATTRS:
-                return f".{f.attr}()"
-        return None
-
     def _check_call(self, node: ast.Call, held) -> None:
-        if held:
-            why = self._blocking_reason(node)
-            if why is not None:
-                locks = ", ".join(f"{c}.{l}" for c, l, _ in held)
+        if not held:
+            return
+        why = blocking_reason(node)
+        if why is not None:
+            locks = ", ".join(self._lname(h) for h in held)
+            self.c.findings.append(Finding(
+                NAME, self.rel, node.lineno,
+                f"blocking call {why} while holding {locks} -- "
+                "move the slow work outside the lock"))
+        # interprocedural: lock-order edges AND blocking calls through
+        # everything the call graph can resolve (methods + module
+        # functions + imported helpers)
+        callee = self.c.graph.resolve_call(node, self.scope)
+        if callee is None:
+            return
+        closure = self.c.graph.closure(callee)
+        for acq in self.c.declared_acquires(closure):
+            for h in held:
+                if (h[0], h[1]) != acq:
+                    self.c.add_edge((h[0], h[1]), acq,
+                                    self.rel, node.lineno)
+        if why is None:       # don't double-report a direct block
+            locks = ", ".join(self._lname(h) for h in held)
+            seen = set()
+            for reason, via, _ in closure.blocking:
+                via = via or callee.qualname
+                if (reason, via) in seen:
+                    continue
+                seen.add((reason, via))
                 self.c.findings.append(Finding(
                     NAME, self.rel, node.lineno,
-                    f"blocking call {why} while holding {locks} -- "
-                    "move the slow work outside the lock"))
-        # lock-order edges through resolvable method calls
-        if held:
-            callee = self._resolve_method(node)
-            if callee is not None:
-                for acq in self.c.transitive_acquires(callee):
-                    for h in held:
-                        if (h[0], h[1]) != acq:
-                            self.c.add_edge((h[0], h[1]), acq,
-                                            self.rel, node.lineno)
-
-    def _resolve_method(self, node: ast.Call):
-        f = node.func
-        if isinstance(f, ast.Attribute):
-            ty = self._type_of(f.value)
-            if ty in self.c.classes and f.attr in \
-                    self.c.classes[ty].methods:
-                return (ty, f.attr)
-        return None
+                    f"blocking call {reason} reached via {via}() "
+                    f"while holding {locks} -- move the slow work "
+                    "outside the lock"))
 
 
 # ---------------------------------------------------------------------------
@@ -562,72 +526,27 @@ class _FnAnalysis:
 class _Checker:
     def __init__(self, ctx):
         self.ctx = ctx
+        self.graph = cg.get(ctx)
         self.findings: list = []
-        (self.classes, self.class_nodes, self.returns,
-         decl_findings) = _collect(ctx)
+        (self.specs, self.module_guards,
+         decl_findings) = _collect(ctx, self.graph)
         self.findings.extend(decl_findings)
         self.atomic_writes: dict = {}
         self.edges: dict = {}        # (A)->(B) : first site
-        self._acq_cache: dict = {}
-        self._direct_cache: dict = {}
 
-    # -- transitive lock acquisition per declared method -----------------
-
-    def _direct_info(self, key):
-        """(direct acquires, callees) for (class, method), memoized
-        (cycle members get re-walked across top-level queries)."""
-        cached = self._direct_cache.get(key)
-        if cached is not None:
-            return cached
-        cname, mname = key
-        spec = self.classes[cname]
-        fn = spec.methods[mname]
-        ana = _FnAnalysis(self, fn, spec.rel, spec, mname)
-        acquires: set = set()
-        callees: set = set()
-        for node in ast.walk(fn):
-            if isinstance(node, (ast.With, ast.AsyncWith)):
-                for item in node.items:
-                    acq = ana._lock_of_with(item.context_expr)
-                    if acq is not None:
-                        acquires.add((acq[0], acq[1]))
-            elif isinstance(node, ast.Call):
-                callee = ana._resolve_method(node)
-                if callee is not None:
-                    callees.add(callee)
-        self._direct_cache[key] = (acquires, callees)
-        return acquires, callees
-
-    def transitive_acquires(self, key) -> set:
-        out, _ = self._walk_acquires(key, set())
+    def declared_acquires(self, closure: "cg.Closure") -> set:
+        """The subset of a closure's acquisitions this checker
+        reasons about: declared class locks + declared module locks."""
+        out = set()
+        for ty, attr in closure.acquires:
+            spec = self.specs.get(ty)
+            if spec is not None and attr in spec.locks:
+                out.add((ty, attr))
+        for rel, name in closure.global_acquires:
+            mg = self.module_guards.get(rel)
+            if mg is not None and name in mg.locks:
+                out.add(((MODULE, rel), name))
         return out
-
-    def _walk_acquires(self, key, visiting):
-        """(acquire set, tainted?) -- tainted means a cycle back-edge
-        truncated the recursion somewhere below, so the set may be
-        incomplete for THIS node and must not be cached (caching a
-        mid-cycle placeholder would permanently hide a cycle member's
-        locks from later call sites -- a missed inversion).  The
-        root's union is always complete: every reachable node's direct
-        acquires are folded in exactly once."""
-        cached = self._acq_cache.get(key)
-        if cached is not None:
-            return cached, False
-        if key in visiting:
-            return set(), True
-        visiting.add(key)
-        acq, callees = self._direct_info(key)
-        out = set(acq)
-        tainted = False
-        for c in callees:
-            if c != key:
-                sub, t = self._walk_acquires(c, visiting)
-                out |= sub
-                tainted = tainted or t
-        visiting.discard(key)
-        if not tainted or not visiting:
-            self._acq_cache[key] = out   # complete at the root too
-        return out, tainted
 
     def add_edge(self, a, b, rel, line) -> None:
         self.edges.setdefault((a, b), (rel, line))
@@ -635,7 +554,7 @@ class _Checker:
     # -- the run ---------------------------------------------------------
 
     def run(self) -> list:
-        if not any(s.declared for s in self.classes.values()):
+        if not self.specs and not self.module_guards:
             return self.findings     # nothing declared, nothing to do
         # a file that never NAMES a declared class (or a factory whose
         # return annotation is one, or a GUARDED_BY table) cannot type
@@ -643,47 +562,53 @@ class _Checker:
         # attribute nor hold a declared lock -- skip its (expensive)
         # per-function analysis entirely.  Typing always needs the
         # name in source: construction, annotation, or factory call.
-        declared_names = {s.name for s in self.classes.values()
-                          if s.declared}
-        needles = set(declared_names) | {"GUARDED_BY"}
-        needles.update(f for f, c in self.returns.items()
-                       if c in declared_names)
+        needles = set(self.specs) | {"GUARDED_BY"}
+        needles.update(f for f, c in self.graph.returns.items()
+                       if c in self.specs)
         for path in self.ctx.package_files():
             try:
                 src = self.ctx.source(path)
             except OSError:
                 continue
             if not any(n in src for n in needles):
-                continue        # (before tree(): skips the parse too)
-            tree = self.ctx.tree(path)
-            if tree is None:
+                continue        # (before parse: skips the parse too)
+            mod = self.graph.load_file(path)
+            if mod is None:
                 continue
             rel = self.ctx.rel(path)
-            self._analyze_scopes(tree, rel, None)
+            self._analyze_scopes(mod.tree, rel, mod, None)
         self._check_extern()
         self._check_atomic_writers()
         self._check_cycles()
         return self.findings
 
-    def _analyze_scopes(self, node, rel, cls) -> None:
+    def _analyze_scopes(self, node, rel, mod, cls) -> None:
         for child in ast.iter_child_nodes(node):
             if isinstance(child, ast.ClassDef):
-                spec = self.classes.get(child.name)
-                self._analyze_scopes(child, rel, spec)
+                spec = self.specs.get(child.name)
+                self._analyze_scopes(child, rel, mod, spec)
             elif isinstance(child, (ast.FunctionDef,
                                     ast.AsyncFunctionDef)):
-                _FnAnalysis(self, child, rel, cls,
-                            child.name).analyze()
+                scope = cg.TypeScope(
+                    self.graph, child, mod,
+                    cls.name if cls is not None else None)
+                _FnAnalysis(self, child, rel, cls, child.name,
+                            scope).analyze()
                 # nested defs (closures) are separate, lock-free scopes
-                self._analyze_scopes(child, rel, None)
+                self._analyze_scopes(child, rel, mod, None)
 
     def _check_extern(self) -> None:
-        for spec in self.classes.values():
+        for spec in self.specs.values():
             if not spec.extern:
                 continue
-            for mname, fn in spec.methods.items():
-                ana = _FnAnalysis(self, fn, spec.rel, spec, mname)
-                for node in ast.walk(fn):
+            ci = self.graph.classes.get(spec.name)
+            if ci is None:
+                continue
+            for mname, fi in ci.methods.items():
+                scope = self.graph.scope(fi)
+                ana = _FnAnalysis(self, fi.node, spec.rel, spec,
+                                  mname, scope)
+                for node in ast.walk(fi.node):
                     if isinstance(node, (ast.With, ast.AsyncWith)):
                         for item in node.items:
                             if ana._lock_of_with(item.context_expr):
@@ -724,13 +649,19 @@ class _Checker:
         state: dict = {}       # node -> 1 (on stack) / 2 (done)
         stack: list = []
 
+        def _name(n):
+            c, l = n
+            if isinstance(c, tuple):
+                return f"{c[1]}:{l}"
+            return f"{c}.{l}"
+
         def dfs(n):
             state[n] = 1
             stack.append(n)
-            for m in sorted(graph.get(n, ())):
+            for m in sorted(graph.get(n, ()), key=_name):
                 if state.get(m) == 1:
                     cyc = stack[stack.index(m):] + [m]
-                    names = " -> ".join(f"{c}.{l}" for c, l in cyc)
+                    names = " -> ".join(_name(c) for c in cyc)
                     rel, line = self.edges[(n, m)]
                     self.findings.append(Finding(
                         NAME, rel, line,
@@ -742,7 +673,7 @@ class _Checker:
             stack.pop()
             state[n] = 2
 
-        for n in sorted(graph):
+        for n in sorted(graph, key=_name):
             if state.get(n) is None:
                 dfs(n)
 
