@@ -126,6 +126,15 @@ def fn_params(fn) -> list:
     return [p.arg for p in list(a.posonlyargs) + list(a.args)]
 
 
+def param_at(fi: "FuncInfo", pos: int) -> Optional[str]:
+    """The callee parameter a positional argument lands on (``self``
+    skipped for methods), or None past the parameter list."""
+    params = fn_params(fi.node)
+    if fi.cls is not None and params and params[0] == "self":
+        params = params[1:]
+    return params[pos] if 0 <= pos < len(params) else None
+
+
 class FuncInfo:
     __slots__ = ("key", "name", "node", "rel", "module", "cls")
 
@@ -184,8 +193,8 @@ class Summary:
     here -- each analyzer applies its own)."""
 
     __slots__ = ("acquires", "global_acquires", "blocking", "callees",
-                 "param_reads", "param_writes", "dict_keys",
-                 "return_exprs", "returned_names")
+                 "calls", "name_calls", "param_reads", "param_writes",
+                 "dict_keys", "return_exprs", "returned_names")
 
     def __init__(self):
         #: ``with <typed expr>.<attr>:`` contexts -> {(class, attr)}
@@ -194,6 +203,14 @@ class Summary:
         self.global_acquires: set = set()
         self.blocking: list = []      # [(reason, line)]
         self.callees: dict = {}       # key -> (FuncInfo, first line)
+        #: every resolvable call WITH its positional-argument names:
+        #: [(FuncInfo, (argname|None, ...), line)] -- the dataflow the
+        #: protocol checker follows a dict through helper parameters on
+        self.calls: list = []
+        #: local name -> FuncInfo for ``x = helper(...)`` assignments
+        #: (last one wins) -- the ``x = make_resp(...); return x``
+        #: response-builder dataflow
+        self.name_calls: dict = {}
         #: param -> {key: line} for param["k"] / param.get("k") /
         #: "k" in param reads (the dict-dataflow the protocol checker
         #: follows through helpers)
@@ -476,6 +493,9 @@ class CallGraph:
                 if callee is not None and callee.key != fi.key:
                     s.callees.setdefault(callee.key,
                                          (callee, node.lineno))
+                    s.calls.append((callee, tuple(
+                        a.id if isinstance(a, ast.Name) else None
+                        for a in node.args), node.lineno))
                 f = node.func
                 if isinstance(f, ast.Attribute) and f.attr == "get" \
                         and isinstance(f.value, ast.Name) \
@@ -512,6 +532,12 @@ class CallGraph:
                     k = const_str(kn)
                     if k is not None:
                         s.dict_keys.setdefault(k, node.lineno)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                callee = self.resolve_call(node.value, sc)
+                if callee is not None and callee.key != fi.key:
+                    s.name_calls[node.targets[0].id] = callee
             elif isinstance(node, ast.Return) and node.value is not None:
                 s.return_exprs.append(node.value)
                 if isinstance(node.value, ast.Name):

@@ -13,21 +13,28 @@ checker extracts both sides from the AST and fails on:
   - a client sending a request key the handler never reads;
   - a client reading a response key the handler never returns.
 
-Extraction rules (static, same-function dataflow only):
+Extraction is INTERPROCEDURAL over the shared call graph
+(analysis/callgraph.py) -- PR 6 stopped at same-scope dataflow, which
+is exactly where a helper function launders a key out of sight:
 
   - server side: every method named ``op_*(self, msg)`` on any class
-    in the package.  Request keys = ``msg["k"]`` / ``msg.get("k")``;
-    response keys = every string key of every dict literal in the
-    method plus ``name["k"] = ...`` constant subscript stores (an
+    in the package.  Request keys = ``msg["k"]`` / ``msg.get("k")`` /
+    ``"k" in msg`` in the handler, plus the same reads in any helper
+    the graph can resolve that ``msg`` is passed to (transitively).
+    Response keys = every string key of every dict literal plus
+    ``name["k"] = ...`` constant subscript stores in the method (an
     over-approximation -- nested payload dicts widen the response set,
-    which can only silence, never fabricate, a finding);
+    which can only silence, never fabricate, a finding), plus the same
+    keys in helpers whose RESULT the handler returns and helpers a
+    returned dict is passed into (``fill(resp)``-style builders);
   - client side: calls whose callee is ``.call(`` / ``.send(`` /
     ``send_report(`` with a literal first argument, scanned across
     the package AND tools/; request keys are the literal keyword
     names.  ``X = client.call("op", ...)`` followed by ``X["k"]`` /
     ``X.get("k")`` / ``"k" in X`` records response reads; so does a
-    direct subscript on the call.  ``client.hello()`` maps to the
-    ``hello`` op.
+    direct subscript on the call, and -- through the call graph --
+    ``helper(X)`` where the helper reads keys from that parameter.
+    ``client.hello()`` maps to the ``hello`` op.
 
 Transport-layer keys (framing/auth, owned by the handler loop and the
 senders, not the ops): ``op``, ``clock``, ``hmac``, ``cnonce``
@@ -44,10 +51,12 @@ import re
 from typing import Optional
 
 from dprf_tpu.analysis import Finding
+from dprf_tpu.analysis import callgraph as cg
 
 NAME = "protocol"
 DESCRIPTION = ("RPC request/response dict keys match between client "
-               "call sites and op_* handlers")
+               "call sites and op_* handlers, followed through helper "
+               "functions via the call graph")
 
 REQUEST_TRANSPORT = {"op", "clock", "hmac", "cnonce"}
 RESPONSE_TRANSPORT = {"ok", "error", "challenge", "coordinator_hmac"}
@@ -62,6 +71,11 @@ CLIENT_METHOD_OPS = {"hello": "hello"}
 _HANDLER_RE = re.compile(r"\bop_[A-Za-z0-9_]+\s*\(")
 _CLIENT_RE = re.compile(r"\b(?:call|send|send_report|hello)\s*\(")
 
+#: recursion guard for the helper-following walks (shared shape with
+#: callgraph.MAX_CLOSURE_DEPTH: a deeper helper chain is an
+#: architecture smell, and a pathological fixture must not hang)
+_MAX_FOLLOW = 64
+
 
 def _const_str(node) -> Optional[str]:
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
@@ -69,44 +83,99 @@ def _const_str(node) -> Optional[str]:
     return None
 
 
+# ---------------------------------------------------------------------------
+# interprocedural key-following (over the shared call graph)
+
+def _follow_param_reads(graph, fi, param: str, out: dict,
+                        visiting: set) -> None:
+    """Keys read from dict parameter ``param`` of ``fi``, transitively
+    through every resolvable helper the dict is passed to.
+    ``out``: key -> (rel, line) of the read that pins it."""
+    tag = (fi.key, param)
+    if tag in visiting or len(visiting) > _MAX_FOLLOW:
+        return
+    visiting.add(tag)
+    s = graph.summary(fi)
+    for k, ln in s.param_reads.get(param, {}).items():
+        out.setdefault(k, (fi.rel, ln))
+    for callee, argnames, _line in s.calls:
+        for pos, an in enumerate(argnames):
+            if an == param:
+                p2 = cg.param_at(callee, pos)
+                if p2:
+                    _follow_param_reads(graph, callee, p2, out,
+                                        visiting)
+
+
+def _follow_param_writes(graph, fi, param: str, out: dict,
+                         visiting: set) -> None:
+    """Keys a helper stores INTO dict parameter ``param``
+    (``resp["k"] = ...`` response builders), transitively."""
+    tag = (fi.key, param)
+    if tag in visiting or len(visiting) > _MAX_FOLLOW:
+        return
+    visiting.add(tag)
+    s = graph.summary(fi)
+    for k, ln in s.param_writes.get(param, {}).items():
+        out.setdefault(k, (fi.rel, ln))
+    for callee, argnames, _line in s.calls:
+        for pos, an in enumerate(argnames):
+            if an == param:
+                p2 = cg.param_at(callee, pos)
+                if p2:
+                    _follow_param_writes(graph, callee, p2, out,
+                                         visiting)
+
+
+def _follow_returned_keys(graph, fi, out: dict, visiting: set) -> None:
+    """Response keys ``fi`` can contribute: every dict-literal key and
+    constant-subscript store in its body, plus -- transitively --
+    helpers whose result it returns (``return make_resp(...)``, also
+    via ``x = make_resp(...); return x``) and helpers a returned dict
+    is passed into (``fill(resp); return resp``)."""
+    if fi.key in visiting or len(visiting) > _MAX_FOLLOW:
+        return
+    visiting.add(fi.key)
+    s = graph.summary(fi)
+    for k, ln in s.dict_keys.items():
+        out.setdefault(k, (fi.rel, ln))
+    sc = None
+    for node in s.return_exprs:
+        if isinstance(node, ast.Call):
+            if sc is None:
+                sc = graph.scope(fi)
+            callee = graph.resolve_call(node, sc)
+            if callee is not None and callee.key != fi.key:
+                _follow_returned_keys(graph, callee, out, visiting)
+    for callee, argnames, _line in s.calls:
+        for pos, an in enumerate(argnames):
+            if an is not None and an in s.returned_names:
+                p2 = cg.param_at(callee, pos)
+                if p2:
+                    _follow_param_writes(graph, callee, p2, out,
+                                         set())
+    for name in s.returned_names:
+        callee = s.name_calls.get(name)
+        if callee is not None and callee.key != fi.key:
+            _follow_returned_keys(graph, callee, out, visiting)
+
+
 class _Handler:
     def __init__(self, op: str, rel: str, line: int):
         self.op = op
         self.rel = rel
         self.line = line
-        self.reads: dict = {}      # key -> line
-        self.returns: dict = {}    # key -> line
+        self.reads: dict = {}      # key -> (rel, line)
+        self.returns: dict = {}    # key -> (rel, line)
 
 
-def _scan_handler(fn, rel: str) -> _Handler:
-    h = _Handler(fn.name[3:], rel, fn.lineno)
-    args = fn.args.posonlyargs + fn.args.args
-    msg_name = args[1].arg if len(args) > 1 else None
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Subscript) \
-                and isinstance(node.value, ast.Name):
-            key = _const_str(node.slice)
-            if key is None:
-                continue
-            if node.value.id == msg_name \
-                    and isinstance(node.ctx, ast.Load):
-                h.reads.setdefault(key, node.lineno)
-            elif isinstance(node.ctx, ast.Store):
-                # resp["trace"] = ... -- incremental response build
-                h.returns.setdefault(key, node.lineno)
-        elif isinstance(node, ast.Call) \
-                and isinstance(node.func, ast.Attribute) \
-                and node.func.attr == "get" \
-                and isinstance(node.func.value, ast.Name) \
-                and node.func.value.id == msg_name and node.args:
-            key = _const_str(node.args[0])
-            if key is not None:
-                h.reads.setdefault(key, node.lineno)
-        elif isinstance(node, ast.Dict):
-            for k in node.keys:
-                key = _const_str(k)
-                if key is not None:
-                    h.returns.setdefault(key, node.lineno)
+def _scan_handler(graph, fi) -> _Handler:
+    h = _Handler(fi.name[3:], fi.rel, fi.node.lineno)
+    params = cg.fn_params(fi.node)
+    msg_param = params[1] if len(params) > 1 else None
+    if msg_param is not None:
+        _follow_param_reads(graph, fi, msg_param, h.reads, set())
+    _follow_returned_keys(graph, fi, h.returns, set())
     return h
 
 
@@ -116,7 +185,7 @@ class _ClientSite:
         self.rel = rel
         self.line = line
         self.sends: dict = {}      # key -> line
-        self.reads: dict = {}      # response key -> line
+        self.reads: dict = {}      # response key -> (rel, line)
 
 
 def _client_op_of_call(node: ast.Call) -> Optional[str]:
@@ -153,13 +222,29 @@ def _scope_nodes(node) -> list:
     return out
 
 
-def _scan_clients(nodes: list, rel: str) -> list:
-    """Client call sites in one scope's node list, with same-SCOPE
-    response reads resolved through simple ``X = <call>``
-    assignments.  Scope isolation is load-bearing: one flat pass over
-    a whole module would alias every function's ``resp`` variable to
-    whichever call site assigned it last, cross-attributing reads to
-    the wrong op."""
+class _ModScope:
+    """Degenerate TypeScope for module-top-level client code: name
+    resolution through the module's imports still works, attribute
+    calls on typed expressions do not (no annotations to type from)."""
+
+    __slots__ = ("module",)
+
+    def __init__(self, module):
+        self.module = module
+
+    def type_of(self, node):
+        return None
+
+
+def _scan_clients(nodes: list, rel: str, graph, mod,
+                  make_scope) -> list:
+    """Client call sites in one scope's node list, with response reads
+    resolved through simple ``X = <call>`` assignments -- same-scope
+    subscript/get/in reads AND, through the call graph, helpers the
+    response is passed to.  Scope isolation is load-bearing: one flat
+    pass over a whole module would alias every function's ``resp``
+    variable to whichever call site assigned it last,
+    cross-attributing reads to the wrong op."""
     sites: list = []
     by_var: dict = {}      # var name -> _ClientSite (latest assign)
     calls: dict = {}       # id(call node) -> _ClientSite
@@ -190,31 +275,49 @@ def _scan_clients(nodes: list, rel: str) -> list:
             return calls.get(id(expr))
         return None
 
+    scope = None
     for node in nodes:
         if isinstance(node, ast.Subscript):
             site = _site_of(node.value)
             key = _const_str(node.slice)
             if site is not None and key is not None:
-                site.reads.setdefault(key, node.lineno)
-        elif isinstance(node, ast.Call) \
-                and isinstance(node.func, ast.Attribute) \
-                and node.func.attr == "get" and node.args:
-            site = _site_of(node.func.value)
-            key = _const_str(node.args[0])
-            if site is not None and key is not None:
-                site.reads.setdefault(key, node.lineno)
+                site.reads.setdefault(key, (rel, node.lineno))
+        elif isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "get" and node.args:
+                site = _site_of(node.func.value)
+                key = _const_str(node.args[0])
+                if site is not None and key is not None:
+                    site.reads.setdefault(key, (rel, node.lineno))
+                    continue
+            # helper(X): response keys read inside a resolvable helper
+            # count as this site's reads (the helper-laundering gap)
+            for pos, arg in enumerate(node.args):
+                site = _site_of(arg)
+                if site is None:
+                    continue
+                if scope is None:
+                    scope = make_scope()
+                callee = graph.resolve_call(node, scope)
+                if callee is None:
+                    continue
+                p = cg.param_at(callee, pos)
+                if p:
+                    _follow_param_reads(graph, callee, p, site.reads,
+                                        set())
         elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
                 and isinstance(node.ops[0], (ast.In, ast.NotIn)):
             site = _site_of(node.comparators[0]
                             if node.comparators else None)
             key = _const_str(node.left)
             if site is not None and key is not None:
-                site.reads.setdefault(key, node.lineno)
+                site.reads.setdefault(key, (rel, node.lineno))
     return sites
 
 
 def run(ctx) -> list:
     findings: list = []
+    graph = cg.get(ctx)
     handlers: dict = {}    # op -> _Handler
     for path in ctx.package_files():
         try:
@@ -222,23 +325,22 @@ def run(ctx) -> list:
                 continue
         except OSError:
             continue
-        idx = ctx.index(path)
-        if idx is None:
+        mod = graph.load_file(path)
+        if mod is None:
             continue
         rel = ctx.rel(path)
-        for node in idx.classes:
-            for item in node.body:
-                if isinstance(item, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)) \
-                        and item.name.startswith("op_"):
-                    h = _scan_handler(item, rel)
-                    if h.op in handlers:
-                        findings.append(Finding(
-                            NAME, rel, item.lineno,
-                            f"op {h.op!r} handled twice (also "
-                            f"{handlers[h.op].rel}:"
-                            f"{handlers[h.op].line})"))
-                    handlers[h.op] = h
+        for ci in mod.classes.values():
+            for mname, fi in ci.methods.items():
+                if not mname.startswith("op_"):
+                    continue
+                h = _scan_handler(graph, fi)
+                if h.op in handlers:
+                    findings.append(Finding(
+                        NAME, rel, fi.node.lineno,
+                        f"op {h.op!r} handled twice (also "
+                        f"{handlers[h.op].rel}:"
+                        f"{handlers[h.op].line})"))
+                handlers[h.op] = h
 
     sites: list = []
     for path in ctx.package_files() + ctx.tools_files():
@@ -250,16 +352,34 @@ def run(ctx) -> list:
         idx = ctx.index(path)
         if idx is None:
             continue
+        mod = graph.load_file(path)
+        if mod is None:
+            continue
         rel = ctx.rel(path)
         # one scope per function (plus the module top level), nested
         # bodies excluded from their parents: the X-=-call dataflow
         # must not leak across scopes in either direction (a nested
         # def reusing the parent's response-variable name would
         # cross-attribute reads between ops)
-        scopes = [_scope_nodes(ctx.tree(path))]
-        scopes.extend(_scope_nodes(fn) for fn in idx.functions)
-        for scope_nodes in scopes:
-            sites.extend(_scan_clients(scope_nodes, rel))
+        cls_of: dict = {}
+        for cnode in idx.classes:
+            for item in cnode.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    cls_of[id(item)] = cnode.name
+
+        def _mk_scope(fn=None, m=mod):
+            if fn is None:
+                return _ModScope(m)
+            return cg.TypeScope(graph, fn, m, cls_of.get(id(fn)))
+
+        sites.extend(_scan_clients(
+            _scope_nodes(ctx.tree(path)), rel, graph, mod,
+            lambda m=mod: _ModScope(m)))
+        for fn in idx.functions:
+            sites.extend(_scan_clients(
+                _scope_nodes(fn), rel, graph, mod,
+                lambda fn=fn: _mk_scope(fn)))
 
     if not handlers:
         return findings
@@ -285,10 +405,10 @@ def run(ctx) -> list:
         for s in op_sites:
             sent.update(s.sends)
         # 2. handler reads a key no client sends
-        for key, line in sorted(h.reads.items()):
+        for key, (rrel, line) in sorted(h.reads.items()):
             if key not in sent and key not in REQUEST_TRANSPORT:
                 findings.append(Finding(
-                    NAME, h.rel, line,
+                    NAME, rrel, line,
                     f"op_{op} reads request key {key!r} that no "
                     "client call site sends -- dead or drifted "
                     "protocol surface"))
@@ -303,11 +423,11 @@ def run(ctx) -> list:
                         "reads"))
         # 4. client reads a response key the handler never returns
         for s in op_sites:
-            for key, line in sorted(s.reads.items()):
+            for key, (rrel, line) in sorted(s.reads.items()):
                 if key not in h.returns \
                         and key not in RESPONSE_TRANSPORT:
                     findings.append(Finding(
-                        NAME, s.rel, line,
+                        NAME, rrel, line,
                         f"op {op!r} response read of key {key!r} "
                         f"that op_{op} ({h.rel}:{h.line}) never "
                         "returns"))
