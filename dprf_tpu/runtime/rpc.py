@@ -73,7 +73,6 @@ GUARDED_BY = {
     "_CompletionSender": {"<atomic>": ("error", "stop_seen")},
 }
 
-
 class RpcError(RuntimeError):
     """Protocol-level failure talking to the coordinator (error
     response, auth failure).  Distinct from RuntimeError so the CLI can
@@ -712,6 +711,13 @@ class CoordinatorClient:
         return resp
 
     def close(self) -> None:
+        # the makefile() stream holds its own reference to the socket
+        # (and a buffer): closing only the socket leaks the stream
+        # object and keeps the fd alive until GC
+        try:
+            self._fh.close()
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
