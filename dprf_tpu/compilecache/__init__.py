@@ -81,11 +81,13 @@ def default_cache_dir() -> str:
 
 def cache_dir() -> Optional[str]:
     """The directory the cache is currently enabled on, or None."""
-    return _state["dir"]
+    with _lock:
+        return _state["dir"]
 
 
 def enabled() -> bool:
-    return _state["dir"] is not None
+    with _lock:
+        return _state["dir"] is not None
 
 
 def enable(dir: Optional[str] = None, log=None) -> Optional[str]:
@@ -178,7 +180,8 @@ def entry_count() -> Optional[int]:
     unreadable).  JAX writes one flat file per cached executable, so a
     before/after count delta is an exact "did this compile persist
     anything new" signal for a single-process compile."""
-    d = _state["dir"]
+    with _lock:
+        d = _state["dir"]
     if d is None:
         return None
     try:
