@@ -106,6 +106,22 @@ def walk_scope(node):
         stack.extend(ast.iter_child_nodes(n))
 
 
+def walk_expr(node):
+    """Like walk_scope but yields ``node`` itself too -- for walking
+    one expression.  A plain ``continue`` inside ``ast.walk`` does
+    NOT do this: walk has already queued the nested scope's children,
+    so a lambda's body would be scanned as the enclosing function's
+    code."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
 def blocking_reason(node: ast.Call) -> Optional[str]:
     """Why this call blocks, or None."""
     f = node.func
@@ -255,6 +271,7 @@ class CallGraph:
         self._funcs: dict = {}        # key -> FuncInfo
         self._summaries: dict = {}
         self._closures: dict = {}
+        self._scopes: dict = {}       # key -> TypeScope (read-only)
         #: dotted prefix of the package ("dprf_tpu")
         self.pkg = os.path.basename(ctx.package_dir)
 
@@ -287,12 +304,19 @@ class CallGraph:
         return None
 
     def _register(self, mod: ModuleInfo) -> None:
-        idx = self.ctx.index(mod.path)
-        # imports are collected FILE-wide (idx.imports), not just
-        # module-level: the repo imports factories inside __init__
-        # bodies, and those are exactly the edges the retrace check
-        # resolves jit factories through
-        for node in idx.imports:
+        # imports are collected FILE-wide, not just module-level: the
+        # repo imports factories inside __init__ bodies, and those are
+        # exactly the edges the retrace check resolves jit factories
+        # through.  Reuse the typed index when another analyzer
+        # already built one; don't force the full 7-bucket build for
+        # files only the graph touches (demand-loaded imports).
+        idx = self.ctx._indexes.get(mod.path)
+        if idx is not None:
+            import_nodes = idx.imports
+        else:
+            import_nodes = [n for n in ast.walk(mod.tree)
+                            if type(n) in (ast.Import, ast.ImportFrom)]
+        for node in import_nodes:
             if isinstance(node, ast.Import):
                 for a in node.names:
                     mod.imports[a.asname or a.name.split(".")[0]] = a.name
@@ -459,8 +483,15 @@ class CallGraph:
         return None
 
     def scope(self, fi: FuncInfo) -> "TypeScope":
-        return TypeScope(self, fi.node, fi.module,
-                         fi.cls.name if fi.cls is not None else None)
+        """Memoized: a TypeScope is read-only after _build, and the
+        per-function env walk is the hottest path in a multi-analyzer
+        run (each analyzer resolves calls in the same functions)."""
+        sc = self._scopes.get(fi.key)
+        if sc is None:
+            sc = self._scopes[fi.key] = TypeScope(
+                self, fi.node, fi.module,
+                fi.cls.name if fi.cls is not None else None)
+        return sc
 
     # -- summaries ---------------------------------------------------------
 
