@@ -1,0 +1,469 @@
+"""Thread & resource lifecycle analyzer.
+
+The serve plane and elastic fleet multiply threads, sockets, and file
+streams; every one of those is a leak or a hang waiting for a missed
+release.  The discipline, machine-checked:
+
+**Threads** -- every ``threading.Thread(...)`` constructed must be
+
+  - ``daemon=True`` at construction (or ``t.daemon = True`` before
+    start), so process exit can never hang on it; or
+  - joined: a local thread needs a ``t.join()`` in the same function
+    (or be returned / stored on ``self`` -- ownership transfers); a
+    ``self.x = Thread(...)`` needs a ``self.x.join()`` in SOME method
+    of the class (the shutdown path).
+  - an unbound non-daemon ``Thread(...).start()`` can never be
+    joined: a leak by construction.
+
+**Resources** -- ``open(...)``, ``socket.socket(...)``,
+``socket.create_connection(...)``, and ``<sock>.makefile(...)``
+acquired OUTSIDE a ``with`` must be released:
+
+  - a local must be ``.close()``d in a ``finally`` or unconditionally
+    (a close only SOME branches reach is flagged: the other path
+    leaks), or returned / stored on ``self`` (ownership transfer);
+  - a ``self.attr = <acquire>`` must be declared in the module-level
+    ``RELEASES`` table and the declared releaser must actually close
+    it::
+
+        RELEASES = {"CoordinatorClient": {"_sock": "close",
+                                          "_fh": "close"}}
+
+    maps attr -> the method that releases it.  The analyzer verifies
+    the declared method exists and contains a
+    ``self.<attr>.close()``-style call (close/server_close/shutdown/
+    terminate/release/detach).  Stale declarations (unknown class,
+    unknown method, releaser that never releases) are findings too;
+  - an acquire that is immediately chained (``open(p).close()``), a
+    ``with`` context, or a ``return`` value is fine by construction;
+    one passed straight into another call (``json.load(open(p))``)
+    leaks on that call's exceptions and is flagged.
+
+**Condition variables** -- for every ``threading.Condition(...)``
+(class attr or local):
+
+  - ``.wait()`` must be called with the condition held (lexically
+    inside ``with <cond>:``, or in a method annotated
+    ``_holds_lock = "<cond attr>"``) AND inside a ``while`` re-check
+    loop -- an ``if``-guarded wait misses spurious wakeups;
+    ``.wait_for()`` carries its own predicate and is exempt from the
+    ``while`` rule;
+  - ``.notify()`` / ``.notify_all()`` must be called with the
+    condition held.
+
+Only DIRECT constructions are tracked (``x = Thread(...)``, ``self.cv
+= threading.Condition()``); a thread built by a helper is the
+helper's to discipline.  ``Event.wait`` is not ``Condition.wait``:
+only objects the analyzer saw constructed as Conditions are checked.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from dprf_tpu.analysis import Finding
+from dprf_tpu.analysis import callgraph as cg
+from dprf_tpu.analysis.callgraph import (const_str, expr_key,
+                                         walk_expr, walk_scope)
+
+NAME = "threads"
+DESCRIPTION = ("thread join/daemon discipline, socket/file release "
+               "(RELEASES tables), and Condition wait/notify rules")
+#: declaration tables --explain renders for this check
+DECL_TABLES = ("RELEASES",)
+
+#: method names that count as releasing a resource
+RELEASE_CALLS = {"close", "server_close", "shutdown", "terminate",
+                 "release", "detach"}
+
+#: word-boundary only -- a lookbehind here (to reject ``.open(``)
+#: costs ~0.25 s over the package; a false prefilter hit only costs
+#: one cached parse, the walker itself ignores attribute ``open`` calls
+_PREFILTER_RE = re.compile(
+    r"\b(?:Thread|Condition|open|makefile|create_connection)\s*\(|"
+    r"\bsocket\s*\.\s*socket\s*\(|\bRELEASES\b")
+
+
+def _is_call_to(node, names: set, qualified: set) -> bool:
+    """Call whose func is a bare Name in ``names`` or a
+    ``mod.attr`` / ``.attr`` pair in ``qualified`` (module part None
+    matches any base -- the ``.makefile()`` shape)."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in names
+    if isinstance(f, ast.Attribute):
+        if (None, f.attr) in qualified:
+            return True
+        if isinstance(f.value, ast.Name):
+            return (f.value.id, f.attr) in qualified
+    return False
+
+
+def _is_thread_ctor(node) -> bool:
+    return _is_call_to(node, {"Thread"}, {("threading", "Thread")})
+
+
+def _is_condition_ctor(node) -> bool:
+    return _is_call_to(node, {"Condition"},
+                       {("threading", "Condition")})
+
+
+def _is_acquire(node) -> bool:
+    return _is_call_to(
+        node, {"open"},
+        {("socket", "socket"), ("socket", "create_connection"),
+         (None, "makefile")})
+
+
+def _kw_true(call: ast.Call, name: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is True:
+            return True
+    return False
+
+
+def _parse_releases(mod) -> tuple:
+    """(releases: {class: {attr: (method, decl line)}}, findings)."""
+    out: dict = {}
+    findings: list = []
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "RELEASES"):
+            continue
+        v = node.value
+        if not isinstance(v, ast.Dict):
+            findings.append(Finding(
+                NAME, mod.rel, node.lineno,
+                "RELEASES must be a dict literal "
+                '{"Class": {"attr": "method"}}'))
+            continue
+        for ck, cv in zip(v.keys, v.values):
+            cname = const_str(ck)
+            if cname is None or not isinstance(cv, ast.Dict):
+                findings.append(Finding(
+                    NAME, mod.rel, node.lineno,
+                    "RELEASES entries must map a class-name string "
+                    "to an {attr: method} dict literal"))
+                continue
+            spec = out.setdefault(cname, {})
+            for ak, av in zip(cv.keys, cv.values):
+                attr, meth = const_str(ak), const_str(av)
+                if attr is None or meth is None:
+                    findings.append(Finding(
+                        NAME, mod.rel, node.lineno,
+                        f"RELEASES[{cname!r}] must map attr-name "
+                        "strings to releaser-method-name strings"))
+                    continue
+                spec[attr] = (meth, node.lineno)
+    return out, findings
+
+
+class _Walker:
+    """One function body's lifecycle walk.  Tracks each site's
+    control context: conditional depth (If/For/While/except nesting),
+    ``finally`` membership, the ``with`` contexts held, and whether a
+    ``while`` loop encloses it."""
+
+    def __init__(self):
+        self.threads: dict = {}      # local name -> (line, depth)
+        self.resources: dict = {}    # local name -> (line, depth)
+        self.attr_threads: list = []   # (attr key, line, daemon?)
+        self.attr_resources: list = []  # (attr key, line)
+        self.local_conds: set = set()
+        self.joins: set = set()      # expr keys .join()ed
+        self.daemon_sets: set = set()  # names with x.daemon = True
+        self.closes: dict = {}       # expr key -> [(depth, in_fin)]
+        self.returned: set = set()
+        self.stored: set = set()     # locals moved onto attributes
+        self.loose: list = []        # (kind, line): unbound ctors
+        self.cond_uses: list = []  # (key, kind, line, withs, in_while)
+        self._exempt: set = set()    # node ids consumed structurally
+
+    def walk(self, fn) -> None:
+        self._body(fn.body, 0, False, (), False)
+
+    # -- statement walk ---------------------------------------------------
+
+    def _body(self, stmts, depth, in_fin, withs, in_while) -> None:
+        for st in stmts:
+            self._stmt(st, depth, in_fin, withs, in_while)
+
+    def _stmt(self, st, depth, in_fin, withs, in_while) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return                      # separate scopes
+        if isinstance(st, ast.Assign) and len(st.targets) == 1:
+            self._assign(st, depth, in_fin, withs, in_while)
+            return
+        if isinstance(st, ast.Return):
+            if isinstance(st.value, ast.Name):
+                self.returned.add(st.value.id)
+            elif st.value is not None:
+                # `return open(...)`: ownership moves to the caller
+                self._exempt.add(id(st.value))
+            if st.value is not None:
+                self._exprs(st.value, depth, in_fin, withs, in_while)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            new_withs = list(withs)
+            for item in st.items:
+                k = expr_key(item.context_expr)
+                if k is not None:
+                    new_withs.append(k)
+                # `with <acquire>(...) as x:` releases by construction
+                self._exempt.add(id(item.context_expr))
+                self._exprs(item.context_expr, depth, in_fin, withs,
+                            in_while)
+            self._body(st.body, depth, in_fin, tuple(new_withs),
+                       in_while)
+            return
+        if isinstance(st, ast.Try):
+            self._body(st.body, depth, in_fin, withs, in_while)
+            for h in st.handlers:
+                self._body(h.body, depth + 1, in_fin, withs, in_while)
+            self._body(st.orelse, depth + 1, in_fin, withs, in_while)
+            self._body(st.finalbody, depth, True, withs, in_while)
+            return
+        if isinstance(st, ast.While):
+            self._exprs(st.test, depth, in_fin, withs, in_while)
+            self._body(st.body, depth + 1, in_fin, withs, True)
+            self._body(st.orelse, depth + 1, in_fin, withs, in_while)
+            return
+        if isinstance(st, (ast.If, ast.For, ast.AsyncFor)):
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.stmt):
+                    self._stmt(child, depth + 1, in_fin, withs,
+                               in_while)
+                else:
+                    self._exprs(child, depth, in_fin, withs, in_while)
+            return
+        self._exprs(st, depth, in_fin, withs, in_while)
+
+    def _assign(self, st: ast.Assign, depth, in_fin, withs,
+                in_while) -> None:
+        t = st.targets[0]
+        v = st.value
+        # x.daemon = True  (post-construction daemonization)
+        if isinstance(t, ast.Attribute) and t.attr == "daemon" \
+                and isinstance(t.value, ast.Name) \
+                and isinstance(v, ast.Constant) and v.value is True:
+            self.daemon_sets.add(t.value.id)
+            return
+        if isinstance(t, ast.Name):
+            if _is_thread_ctor(v):
+                self._exempt.add(id(v))
+                if not _kw_true(v, "daemon"):
+                    self.threads[t.id] = (v.lineno, depth)
+            elif _is_acquire(v):
+                self._exempt.add(id(v))
+                self.resources[t.id] = (v.lineno, depth)
+            elif _is_condition_ctor(v):
+                self.local_conds.add(t.id)
+        elif isinstance(t, ast.Attribute):
+            key = expr_key(t)
+            if _is_thread_ctor(v):
+                self._exempt.add(id(v))
+                if key is not None:
+                    self.attr_threads.append(
+                        (key, v.lineno, _kw_true(v, "daemon")))
+            elif _is_acquire(v):
+                self._exempt.add(id(v))
+                if key is not None:
+                    self.attr_resources.append((key, v.lineno))
+            elif isinstance(v, ast.Name):
+                self.stored.add(v.id)       # self.x = local: transfer
+        self._exprs(st.value, depth, in_fin, withs, in_while)
+
+    # -- expression walk --------------------------------------------------
+
+    def _exprs(self, node, depth, in_fin, withs, in_while) -> None:
+        # walk_expr prunes nested def/lambda SUBTREES (their bodies
+        # are not this function's control flow)
+        for n in walk_expr(node):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            if isinstance(f, ast.Attribute):
+                base_key = expr_key(f.value)
+                if f.attr == "join" and base_key is not None:
+                    self.joins.add(base_key)
+                elif f.attr in RELEASE_CALLS:
+                    if _is_acquire(f.value):
+                        # open(...).close() chain: fine by construction
+                        self._exempt.add(id(f.value))
+                    elif base_key is not None:
+                        self.closes.setdefault(base_key, []).append(
+                            (depth, in_fin))
+                elif f.attr in ("wait", "wait_for", "notify",
+                                "notify_all") and base_key is not None:
+                    self.cond_uses.append(
+                        (base_key, f.attr, n.lineno, withs, in_while))
+                elif f.attr == "start" and _is_thread_ctor(f.value):
+                    # Thread(...).start(): bindless; daemon or leak
+                    self._exempt.add(id(f.value))
+                    if not _kw_true(f.value, "daemon"):
+                        self.loose.append(("thread", f.value.lineno))
+            if id(n) in self._exempt:
+                continue
+            if _is_thread_ctor(n):
+                if not _kw_true(n, "daemon"):
+                    self.loose.append(("thread", n.lineno))
+            elif _is_acquire(n):
+                self.loose.append(("resource", n.lineno))
+
+    # -- verdicts ---------------------------------------------------------
+
+    def finish(self, rel: str, find) -> None:
+        for kind, line in self.loose:
+            if kind == "thread":
+                find(rel, line,
+                     "unbound non-daemon Thread can never be joined "
+                     "-- bind it (to join on shutdown) or pass "
+                     "daemon=True")
+            else:
+                find(rel, line,
+                     "resource acquired and passed straight on -- "
+                     "nothing can release it if the consumer raises; "
+                     "bind it and use `with` or close it in a "
+                     "finally")
+        for name, (line, _depth) in self.threads.items():
+            if name in self.daemon_sets or name in self.returned \
+                    or name in self.stored or name in self.joins:
+                continue
+            find(rel, line,
+                 f"non-daemon Thread {name!r} is never joined in this "
+                 "function (and never returned) -- pass daemon=True "
+                 "or join it on every shutdown path")
+        for name, (line, depth) in self.resources.items():
+            if name in self.returned or name in self.stored:
+                continue
+            closes = self.closes.get(name, [])
+            if not closes:
+                find(rel, line,
+                     f"resource {name!r} acquired outside `with` is "
+                     "never released here -- close it in a finally, "
+                     "use `with`, or transfer ownership (return / "
+                     "store on self with a RELEASES entry)")
+            elif not any(fin or d <= depth for d, fin in closes):
+                find(rel, line,
+                     f"resource {name!r} is closed on only some "
+                     "paths -- move the close() into a finally (or "
+                     "an unconditional statement)")
+
+
+def _check_cond_uses(w: _Walker, conds: set, holds, rel,
+                     find) -> None:
+    for key, kind, line, withs, in_while in w.cond_uses:
+        if key not in conds:
+            continue
+        short = key.split(".", 1)[1] if key.startswith("self.") \
+            else key
+        held = key in withs or (isinstance(holds, str)
+                                and holds in (key, short))
+        if not held:
+            find(rel, line,
+                 f"Condition.{kind}() on {key!r} without holding it "
+                 f"-- wrap in `with {key}:`")
+            continue
+        if kind == "wait" and not in_while:
+            find(rel, line,
+                 f"Condition.wait() on {key!r} outside a `while` "
+                 "re-check loop -- spurious wakeups make an "
+                 "if-guarded wait a race; re-check the predicate in "
+                 "a while (or use wait_for)")
+
+
+def _scan_class(ci, releases: dict, rel, find) -> None:
+    """Class-level lifecycle: attr threads joined somewhere in the
+    class, attr resources declared in RELEASES with a real releaser,
+    Condition attrs checked across every method."""
+    walkers: dict = {}
+    attr_joins: set = set()
+    cond_attrs: set = set()
+    for mname, fi in ci.methods.items():
+        w = _Walker()
+        w.walk(fi.node)
+        walkers[mname] = w
+        attr_joins.update(w.joins)
+        for st in walk_scope(fi.node):
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Attribute) \
+                    and _is_condition_ctor(st.value):
+                k = expr_key(st.targets[0])
+                if k is not None:
+                    cond_attrs.add(k)
+    for mname, w in walkers.items():
+        w.finish(rel, find)          # local lifecycle per method
+        for attr, line, daemon in w.attr_threads:
+            if daemon or attr in attr_joins:
+                continue
+            find(rel, line,
+                 f"{ci.name}: non-daemon Thread stored on {attr!r} "
+                 "is never joined by any method -- pass daemon=True "
+                 "or join it on the shutdown path")
+        for attr, line in w.attr_resources:
+            short = attr.split(".", 1)[1] if "." in attr else attr
+            decl = releases.get(ci.name, {}).get(short)
+            if decl is None:
+                find(rel, line,
+                     f"{ci.name}.{short} holds an acquired resource "
+                     "but is not declared in a module-level RELEASES "
+                     "table -- declare RELEASES = "
+                     f'{{"{ci.name}": {{"{short}": '
+                     '"<releaser method>"}}')
+                continue
+            meth, dline = decl
+            rw = walkers.get(meth)
+            if rw is None:
+                find(rel, dline,
+                     f"RELEASES declares {ci.name}.{short} released "
+                     f"by {meth!r}, but {ci.name} has no such method")
+            elif attr not in rw.closes:
+                mfi = ci.methods.get(meth)
+                find(rel, mfi.node.lineno if mfi else ci.line,
+                     f"RELEASES declares {ci.name}.{short} released "
+                     f"by {meth}(), but {meth}() never closes it")
+    for mname, w in walkers.items():
+        holds = ci.method_marks.get(mname, {}).get("_holds_lock")
+        _check_cond_uses(w, cond_attrs | w.local_conds, holds, rel,
+                         find)
+
+
+def run(ctx) -> list:
+    findings: list = []
+
+    def find(rel, line, msg):
+        findings.append(Finding(NAME, rel, line, msg))
+
+    graph = cg.get(ctx)
+    for path in ctx.package_files():
+        try:
+            src = ctx.source(path)
+        except OSError:
+            continue
+        if not _PREFILTER_RE.search(src):
+            continue
+        mod = graph.load_file(path)
+        if mod is None:
+            continue
+        rel = ctx.rel(path)
+        releases, shape_findings = _parse_releases(mod)
+        findings.extend(shape_findings)
+        for cname, spec in releases.items():
+            if cname not in mod.classes and spec:
+                _meth, dline = next(iter(spec.values()))
+                find(rel, dline,
+                     f"RELEASES declares unknown class {cname!r}")
+        for ci in mod.classes.values():
+            _scan_class(ci, releases, rel, find)
+        for fi in mod.functions.values():
+            w = _Walker()
+            w.walk(fi.node)
+            w.finish(rel, find)
+            _check_cond_uses(w, set(w.local_conds), None, rel, find)
+    return findings

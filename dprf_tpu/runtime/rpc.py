@@ -73,6 +73,15 @@ GUARDED_BY = {
     "_CompletionSender": {"<atomic>": ("error", "stop_seen")},
 }
 
+#: resource-ownership declarations (`dprf check` threads analyzer):
+#: every socket/stream attribute acquired outside a ``with`` names
+#: the method that releases it, and the analyzer verifies that
+#: method really closes it on the shutdown path.
+RELEASES = {
+    "CoordinatorClient": {"_sock": "close", "_fh": "close"},
+}
+
+
 class RpcError(RuntimeError):
     """Protocol-level failure talking to the coordinator (error
     response, auth failure).  Distinct from RuntimeError so the CLI can

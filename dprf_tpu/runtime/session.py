@@ -33,6 +33,14 @@ class SessionState:
     tuning: dict = dataclasses.field(default_factory=dict)  # key -> record
 
 
+#: `dprf check` threads analyzer: the journal stream is owned by the
+#: object and released by close() (called by the CLI's finally and the
+#: coordinator shutdown path).
+RELEASES = {
+    "SessionJournal": {"_fh": "close"},
+}
+
+
 class SessionJournal:
     def __init__(self, path: str, snapshot_every: int = 64):
         self.path = path

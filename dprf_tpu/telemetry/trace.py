@@ -95,6 +95,13 @@ GUARDED_BY = {
     },
 }
 
+#: `dprf check` threads analyzer: the flight-recorder stream is owned
+#: by the recorder across attach/rotate cycles and released by
+#: detach_file() (also called on re-attach).
+RELEASES = {
+    "TraceRecorder": {"_fh": "detach_file"},
+}
+
 
 def new_trace_id() -> str:
     """Trace id for one work-unit lifecycle (assigned at split time)."""
