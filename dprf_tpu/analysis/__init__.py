@@ -217,9 +217,10 @@ def _plugins() -> dict:
     """name -> module (imported lazily so a syntax error in one
     analyzer doesn't take the whole runner down at import time)."""
     from dprf_tpu.analysis import (envknobs, locks, markers, metrics,
-                                   protocol, threads, worker_contract)
+                                   protocol, retrace, threads,
+                                   worker_contract)
     mods = (markers, metrics, worker_contract, locks, protocol,
-            envknobs, threads)
+            envknobs, threads, retrace)
     return {m.NAME: m for m in mods}
 
 

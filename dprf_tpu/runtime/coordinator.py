@@ -74,6 +74,12 @@ def restore_hits_into(found: dict, hits: list) -> None:
             continue
 
 
+#: `dprf check` retrace analyzer: loops in these functions drive the
+#: device per work unit -- host syncs and shape-varying jit calls
+#: inside them are silent perf bugs the compile cache can't see.
+HOT_PATHS = ("Coordinator.run",)
+
+
 class Coordinator:
     def __init__(self, spec: JobSpec, targets: Sequence[Target],
                  dispatcher: Dispatcher, worker,

@@ -81,6 +81,10 @@ RELEASES = {
     "CoordinatorClient": {"_sock": "close", "_fh": "close"},
 }
 
+#: `dprf check` retrace analyzer: the remote pipelined sweep loop --
+#: a host sync here serializes the device stream against RPC latency.
+HOT_PATHS = ("worker_loop",)
+
 
 class RpcError(RuntimeError):
     """Protocol-level failure talking to the coordinator (error

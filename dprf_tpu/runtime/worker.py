@@ -81,6 +81,11 @@ class _ResolvedUnit:
         return self.hits
 
 
+#: `dprf check` retrace analyzer: the per-batch device dispatch loop.
+#: Everything submit() enqueues rides the device stream; a host sync
+#: or a retrace inside it stalls every unit of every job.
+HOT_PATHS = ("MaskWorkerBase.submit",)
+
 #: env override for the submit-ahead depth both pipelined loops run at
 PIPELINE_DEPTH_ENV = "DPRF_PIPELINE_DEPTH"
 
