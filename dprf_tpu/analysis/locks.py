@@ -78,6 +78,8 @@ NAME = "locks"
 DESCRIPTION = ("guarded-by discipline, blocking-calls-under-lock "
                "(direct and through the call graph), and lock-order "
                "cycles over declared GUARDED_BY tables")
+#: declaration tables --explain renders for this check
+DECL_TABLES = ("GUARDED_BY",)
 
 ATOMIC = "<atomic>"
 EXTERN = "<extern>"
@@ -361,7 +363,10 @@ class _FnAnalysis:
     def _is_rlock(self, acq) -> bool:
         key, lock = acq[0], acq[1]
         if isinstance(key, tuple):
-            return lock in (self.mg.rlocks if self.mg else ())
+            # (MODULE, rel): resolve through the run-wide table so a
+            # transitive acquire in ANOTHER module answers correctly
+            mg = self.c.module_guards.get(key[1])
+            return mg is not None and lock in mg.rlocks
         spec = self.c.specs.get(key)
         return spec is not None and lock in spec.rlocks
 
@@ -505,6 +510,15 @@ class _FnAnalysis:
                 if (h[0], h[1]) != acq:
                     self.c.add_edge((h[0], h[1]), acq,
                                     self.rel, node.lineno)
+                elif not self._is_rlock(acq):
+                    # same lock re-acquired somewhere inside the
+                    # callee: the interprocedural twin of the lexical
+                    # re-acquire check above
+                    self.c.findings.append(Finding(
+                        NAME, self.rel, node.lineno,
+                        f"re-acquiring {self._lname(h)} via "
+                        f"{callee.qualname}() while already held "
+                        "(deadlock with a non-reentrant Lock)"))
         if why is None:       # don't double-report a direct block
             locks = ", ".join(self._lname(h) for h in held)
             seen = set()

@@ -68,6 +68,13 @@ DEFAULT_COLD_FLOOR_S = 5.0
 _lock = threading.Lock()
 _state: dict = {"dir": None}
 
+#: `dprf check` locks analyzer: module-global cache state, written by
+#: enable()/disable() and read from every compile site -- the serve
+#: plane calls those from multiple threads.
+GUARDED_BY = {
+    "<module>": {"_lock": ("_state",)},
+}
+
 
 def default_cache_dir() -> str:
     """$DPRF_COMPILE_CACHE_DIR, or ~/.cache/dprf/xla (deliberately

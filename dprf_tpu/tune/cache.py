@@ -99,6 +99,14 @@ def env_fingerprint(engine_name: str, device: str = "jax") -> dict:
     return env
 
 
+#: `dprf check` locks analyzer: the lazily-loaded document is shared
+#: by every thread that consults the cache (autotuner, serve-plane
+#: job setup, prewarm); all access goes through _lock.
+GUARDED_BY = {
+    "TuningCache": {"_lock": ("_doc",)},
+}
+
+
 class TuningCache:
     """Load/validate/update one tuning-cache JSON file.  Writes are
     atomic (tmp + replace) so a killed run can never leave a torn
@@ -122,6 +130,8 @@ class TuningCache:
                 doc = {"version": CACHE_VERSION, "entries": {}}
             self._doc = doc
         return self._doc
+
+    _load._holds_lock = "_lock"   # every caller holds self._lock
 
     def get(self, key: str, env: dict) -> Optional[dict]:
         """The entry for `key`, or None if absent OR recorded under a
