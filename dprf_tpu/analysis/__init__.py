@@ -232,6 +232,55 @@ def describe_plugins() -> list:
     return [(m.NAME, m.DESCRIPTION) for m in _plugins().values()]
 
 
+def explain(root: str, check: str) -> str:
+    """Human-readable rules + live declaration tables for one check
+    (``dprf check --explain <check>``) -- the reference to read BEFORE
+    writing a suppression or a new declaration.  The rules are the
+    analyzer's module docstring; the tables are every module-level
+    assignment in the package whose name the analyzer lists in its
+    ``DECL_TABLES``, quoted from source with file:line locations."""
+    plugins = _plugins()
+    if check not in plugins:
+        raise ValueError(f"unknown check {check!r} "
+                         f"(have: {list(plugins)})")
+    mod = plugins[check]
+    out = [f"{mod.NAME}: {mod.DESCRIPTION}", ""]
+    doc = (mod.__doc__ or "").strip()
+    if doc:
+        out += [doc, ""]
+    tables = getattr(mod, "DECL_TABLES", ())
+    if tables:
+        ctx = AnalysisContext(root)
+        out.append("Declarations in this repo:")
+        found = False
+        for path in ctx.package_files():
+            try:
+                src = ctx.source(path)
+            except OSError:
+                continue
+            if not any(t in src for t in tables):
+                continue
+            tree = ctx.tree(path)
+            if tree is None:
+                continue
+            lines = src.splitlines()
+            for node in tree.body:
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id in tables):
+                    continue
+                found = True
+                out.append(f"\n  {ctx.rel(path)}:{node.lineno}")
+                end = getattr(node, "end_lineno", node.lineno)
+                for ln in lines[node.lineno - 1:end]:
+                    out.append(f"    {ln}")
+        if not found:
+            out.append(f"  (none yet -- declare "
+                       f"{' / '.join(tables)} in a runtime module)")
+    return "\n".join(out)
+
+
 # ---------------------------------------------------------------------------
 # suppressions
 
@@ -405,6 +454,9 @@ def main(argv: Optional[list] = None) -> int:
                    help="machine-readable findings on stdout")
     p.add_argument("--list", action="store_true",
                    help="list available checks and exit")
+    p.add_argument("--explain", metavar="CHECK", default=None,
+                   help="print one check's rules and its declaration "
+                   "tables as found in the repo, then exit")
     p.add_argument("--show-suppressed", action="store_true",
                    help="also print findings silenced by inline "
                    "suppressions")
@@ -419,6 +471,14 @@ def main(argv: Optional[list] = None) -> int:
         return 0
 
     root = os.path.abspath(args.root or _default_root())
+
+    if args.explain:
+        try:
+            print(explain(root, args.explain))
+        except ValueError as e:
+            print(f"dprf check: {e}", file=sys.stderr)
+            return 2
+        return 0
 
     if args.write_env_docs:
         from dprf_tpu.utils import env

@@ -359,6 +359,9 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="machine-readable findings on stdout")
     ck.add_argument("--list", action="store_true",
                     help="list available checks and exit")
+    ck.add_argument("--explain", metavar="CHECK", default=None,
+                    help="print one check's rules and its declaration "
+                    "tables as found in the repo, then exit")
     ck.add_argument("--show-suppressed", action="store_true",
                     help="also print findings silenced by inline "
                     "suppressions")
@@ -1546,6 +1549,8 @@ def cmd_check(args, log: Log) -> int:
         argv += ["--only", v]
     for v in args.skip or ():
         argv += ["--skip", v]
+    if args.explain:
+        argv += ["--explain", args.explain]
     for flag in ("json", "list", "show_suppressed", "write_env_docs"):
         if getattr(args, flag):
             argv.append("--" + flag.replace("_", "-"))
