@@ -719,14 +719,14 @@ def test_run_for_conftest_formats_failures(tmp_path):
 
 
 def test_real_repo_is_clean_and_fast():
-    """The acceptance criterion: all six analyzers over the whole
+    """The acceptance criterion: all eight analyzers over the whole
     package, zero unsuppressed findings, comfortably inside the 5 s
     CLI budget on the 2-core box."""
     t0 = time.monotonic()
     findings, ran = analysis.run(REPO)
     elapsed = time.monotonic() - t0
     assert ran == {"markers", "metrics", "worker-contract", "locks",
-                   "protocol", "env-knobs"}
+                   "protocol", "env-knobs", "threads", "retrace"}
     assert bad(findings) == [], "\n".join(
         f.render() for f in bad(findings))
     # every suppression carries a reason (reasonless ones would be
