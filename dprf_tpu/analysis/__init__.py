@@ -1,6 +1,7 @@
-"""``dprf check``: the unified static-analysis suite (ISSUE 6).
+"""``dprf check``: the unified static-analysis suite (ISSUE 6, made
+interprocedural in ISSUE 7).
 
-One runner, six analyzers, zero runtime dependencies -- the layer
+One runner, eight analyzers, zero runtime dependencies -- the layer
 that turns this repo's recurring concurrent/protocol/config bug
 classes into lint failures instead of loopback-test flakes:
 
@@ -12,17 +13,33 @@ classes into lint failures instead of loopback-test flakes:
   worker-contract   every process() override declares its pipelining
                     stance (absorbed from tools/check_worker_contract)
   locks             lock-discipline / guarded-by race detector over
-                    the declared GUARDED_BY tables (analysis/locks.py)
+                    the declared GUARDED_BY tables; blocking calls and
+                    lock-order edges propagate through the call graph
+                    (analysis/locks.py)
   protocol          RPC request/response contract: the dict keys each
                     op's clients build vs. the handler reads, both
-                    directions (analysis/protocol.py)
+                    directions, followed through helper functions
+                    (analysis/protocol.py)
   env-knobs         every DPRF_* env read goes through the
                     utils/env.py registry; README table in sync
                     (analysis/envknobs.py)
+  threads           thread join/daemon discipline, socket/file release
+                    against module-level RELEASES tables, Condition
+                    wait/notify rules (analysis/threads.py)
+  retrace           JAX silent-recompile + host-sync lint over the
+                    loops declared in HOT_PATHS tables, jit entries
+                    resolved through the call graph
+                    (analysis/retrace.py)
+
+The shared interprocedural machinery -- whole-package call graph,
+type resolution, per-function summaries, transitive closure -- lives
+in analysis/callgraph.py, one instance per AnalysisContext.
 
 Entry points: ``dprf check`` (cli.py), ``python -m dprf_tpu.analysis``,
 ``run_for_conftest()`` (one in-process pass at the top of every test
-tier), and the legacy ``tools/check_*.py`` shims.
+tier), and the legacy ``tools/check_*.py`` shims.  ``--explain
+<check>`` prints a check's rules and its declaration tables as found
+in the repo.
 
 Suppressions are explicit and must carry a reason::
 
