@@ -155,6 +155,7 @@ class AnalysisContext:
         self._trees: dict = {}
         self._indexes: dict = {}
         self.parse_failures: list = []   # [(path, message)]
+        self.timings: dict = {}          # check -> seconds (last run)
 
     # -- file discovery --------------------------------------------------
 
@@ -401,8 +402,15 @@ def run(root: str, only=None, skip=None,
     if ctx is None:
         ctx = AnalysisContext(root)
     findings: list = []
+    # per-analyzer wall time, exposed on the context (and in the CLI's
+    # --json output) so the CI artifact makes budget regressions
+    # visible per check, not just as one opaque suite total
+    import time as _time
+    ctx.timings = {}
     for name in names:
+        t0 = _time.perf_counter()
         findings.extend(plugins[name].run(ctx))
+        ctx.timings[name] = round(_time.perf_counter() - t0, 4)
     for rel, msg in ctx.parse_failures:
         findings.append(Finding("parse", rel, 1,
                                 f"does not parse: {msg}"))
@@ -512,9 +520,10 @@ def main(argv: Optional[list] = None) -> int:
             out.extend(s.strip() for s in v.split(",") if s.strip())
         return out
 
+    ctx = AnalysisContext(root)
     try:
         findings, ran = run(root, only=_split(args.only),
-                            skip=_split(args.skip))
+                            skip=_split(args.skip), ctx=ctx)
     except ValueError as e:
         print(f"dprf check: {e}", file=sys.stderr)
         return 2
@@ -528,6 +537,7 @@ def main(argv: Optional[list] = None) -> int:
             "findings": [f.as_dict() for f in shown],
             "total": len(bad),
             "suppressed": len(findings) - len(bad),
+            "timings_s": ctx.timings,
         }, indent=2))
     else:
         for f in shown:

@@ -145,10 +145,104 @@ def fn_params(fn) -> list:
 def param_at(fi: "FuncInfo", pos: int) -> Optional[str]:
     """The callee parameter a positional argument lands on (``self``
     skipped for methods), or None past the parameter list."""
+    s = slot_at(fi, pos)
+    return s if isinstance(s, str) else None
+
+
+# -- argument slots ---------------------------------------------------------
+#
+# A SLOT names how a tracked value is bound inside a function:
+#
+#   "msg"               a plain parameter
+#   ("*", "args", 2)    element 2 of the function's *args tuple
+#   ("**", "kw", "msg") the "msg" entry of the function's **kw dict
+#
+# ``arg_slot`` describes one call-site argument; ``forwarded_slots``
+# maps a caller-held slot through one call to the callee slots it
+# lands on.  Together they close the PR 7 gap where a wrapper like
+# ``def locked(self, *args, **kwargs): return self._do(*args,
+# **kwargs)`` laundered a dict (and the facts read from it) out of
+# the positional-names-only dataflow.
+
+def arg_slot(node):
+    """Call-site argument descriptor: a Name's id, ``("*", name)``
+    for ``*name`` spreads, None for anything else."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Starred) and isinstance(node.value,
+                                                    ast.Name):
+        return ("*", node.value.id)
+    return None
+
+
+def slot_at(fi: "FuncInfo", pos: int):
+    """The slot a positional argument lands on (``self`` skipped for
+    methods): a parameter name, ``("*", vararg, offset)`` past the
+    positional list when the callee takes ``*vararg``, else None."""
     params = fn_params(fi.node)
     if fi.cls is not None and params and params[0] == "self":
         params = params[1:]
-    return params[pos] if 0 <= pos < len(params) else None
+    if 0 <= pos < len(params):
+        return params[pos]
+    va = fi.node.args.vararg
+    if va is not None and pos >= len(params):
+        return ("*", va.arg, pos - len(params))
+    return None
+
+
+def slot_for_keyword(fi: "FuncInfo", key: str):
+    """The slot a ``key=value`` argument lands on: the parameter of
+    that name, ``("**", kwarg, key)`` when it falls into a ``**kwarg``
+    catch-all, else None (the call would TypeError at runtime)."""
+    a = fi.node.args
+    names = {p.arg for p in (list(a.posonlyargs) + list(a.args)
+                             + list(a.kwonlyargs))}
+    if key in names:
+        return key
+    if a.kwarg is not None:
+        return ("**", a.kwarg.arg, key)
+    return None
+
+
+def forwarded_slots(callee: "FuncInfo", argspec: tuple, kwspec: tuple,
+                    slot) -> list:
+    """Callee slots a caller-held ``slot`` reaches through one call
+    (``argspec``/``kwspec`` as recorded in ``Summary.calls``).
+    Positional pass-through, ``key=name`` keywords, ``*args`` and
+    ``**kwargs`` re-forwarding all resolve; a spread that cannot be
+    positioned soundly resolves to nothing rather than to a guess."""
+    out = []
+    if isinstance(slot, str):
+        for pos, an in enumerate(argspec):
+            if an == slot:
+                s2 = slot_at(callee, pos)
+                if s2 is not None:
+                    out.append(s2)
+        for k, vn in kwspec:
+            if vn == slot and k is not None:
+                s2 = slot_for_keyword(callee, k)
+                if s2 is not None:
+                    out.append(s2)
+    elif slot and slot[0] == "*":
+        _, va, idx = slot
+        for pos, an in enumerate(argspec):
+            if an == ("*", va):
+                # elements of *va land at call positions pos, pos+1,
+                # ...; sound because everything before pos is a fixed
+                # single argument.  Only the first spread of va is
+                # position-sound (a second one would sit at an
+                # unknowable offset past the first's length).
+                s2 = slot_at(callee, pos + idx)
+                if s2 is not None:
+                    out.append(s2)
+                break
+    elif slot and slot[0] == "**":
+        _, kw, key = slot
+        if any(k is None and vn == kw for k, vn in kwspec):
+            s2 = slot_for_keyword(callee, key)
+            if s2 is not None:
+                out.append(s2)
+    return out
 
 
 class FuncInfo:
@@ -219,9 +313,13 @@ class Summary:
         self.global_acquires: set = set()
         self.blocking: list = []      # [(reason, line)]
         self.callees: dict = {}       # key -> (FuncInfo, first line)
-        #: every resolvable call WITH its positional-argument names:
-        #: [(FuncInfo, (argname|None, ...), line)] -- the dataflow the
-        #: protocol checker follows a dict through helper parameters on
+        #: every resolvable call WITH its argument bindings:
+        #: [(FuncInfo, argspec, kwspec, line)] where argspec is a
+        #: tuple of ``arg_slot`` descriptors (names and ``*name``
+        #: spreads) and kwspec is ((kwname|None, valuename), ...)
+        #: (kwname None = a ``**name`` spread) -- the dataflow the
+        #: protocol checker follows a dict through helper parameters
+        #: and *args/**kwargs forwarding wrappers on
         self.calls: list = []
         #: local name -> FuncInfo for ``x = helper(...)`` assignments
         #: (last one wins) -- the ``x = make_resp(...); return x``
@@ -524,9 +622,13 @@ class CallGraph:
                 if callee is not None and callee.key != fi.key:
                     s.callees.setdefault(callee.key,
                                          (callee, node.lineno))
-                    s.calls.append((callee, tuple(
-                        a.id if isinstance(a, ast.Name) else None
-                        for a in node.args), node.lineno))
+                    s.calls.append((
+                        callee,
+                        tuple(arg_slot(a) for a in node.args),
+                        tuple((kw.arg, kw.value.id)
+                              for kw in node.keywords
+                              if isinstance(kw.value, ast.Name)),
+                        node.lineno))
                 f = node.func
                 if isinstance(f, ast.Attribute) and f.attr == "get" \
                         and isinstance(f.value, ast.Name) \

@@ -86,45 +86,41 @@ def _const_str(node) -> Optional[str]:
 # ---------------------------------------------------------------------------
 # interprocedural key-following (over the shared call graph)
 
-def _follow_param_reads(graph, fi, param: str, out: dict,
+def _follow_param_reads(graph, fi, slot, out: dict,
                         visiting: set) -> None:
-    """Keys read from dict parameter ``param`` of ``fi``, transitively
-    through every resolvable helper the dict is passed to.
+    """Keys read from the dict bound to ``slot`` in ``fi`` (a
+    parameter name, or a ``*args``/``**kwargs`` element descriptor --
+    callgraph slots), transitively through every resolvable helper
+    the dict is passed to, including forwarding wrappers.
     ``out``: key -> (rel, line) of the read that pins it."""
-    tag = (fi.key, param)
+    tag = (fi.key, slot)
     if tag in visiting or len(visiting) > _MAX_FOLLOW:
         return
     visiting.add(tag)
     s = graph.summary(fi)
-    for k, ln in s.param_reads.get(param, {}).items():
-        out.setdefault(k, (fi.rel, ln))
-    for callee, argnames, _line in s.calls:
-        for pos, an in enumerate(argnames):
-            if an == param:
-                p2 = cg.param_at(callee, pos)
-                if p2:
-                    _follow_param_reads(graph, callee, p2, out,
-                                        visiting)
+    if isinstance(slot, str):
+        for k, ln in s.param_reads.get(slot, {}).items():
+            out.setdefault(k, (fi.rel, ln))
+    for callee, argspec, kwspec, _line in s.calls:
+        for s2 in cg.forwarded_slots(callee, argspec, kwspec, slot):
+            _follow_param_reads(graph, callee, s2, out, visiting)
 
 
-def _follow_param_writes(graph, fi, param: str, out: dict,
+def _follow_param_writes(graph, fi, slot, out: dict,
                          visiting: set) -> None:
-    """Keys a helper stores INTO dict parameter ``param``
+    """Keys a helper stores INTO the dict bound to ``slot``
     (``resp["k"] = ...`` response builders), transitively."""
-    tag = (fi.key, param)
+    tag = (fi.key, slot)
     if tag in visiting or len(visiting) > _MAX_FOLLOW:
         return
     visiting.add(tag)
     s = graph.summary(fi)
-    for k, ln in s.param_writes.get(param, {}).items():
-        out.setdefault(k, (fi.rel, ln))
-    for callee, argnames, _line in s.calls:
-        for pos, an in enumerate(argnames):
-            if an == param:
-                p2 = cg.param_at(callee, pos)
-                if p2:
-                    _follow_param_writes(graph, callee, p2, out,
-                                         visiting)
+    if isinstance(slot, str):
+        for k, ln in s.param_writes.get(slot, {}).items():
+            out.setdefault(k, (fi.rel, ln))
+    for callee, argspec, kwspec, _line in s.calls:
+        for s2 in cg.forwarded_slots(callee, argspec, kwspec, slot):
+            _follow_param_writes(graph, callee, s2, out, visiting)
 
 
 def _follow_returned_keys(graph, fi, out: dict, visiting: set) -> None:
@@ -147,13 +143,11 @@ def _follow_returned_keys(graph, fi, out: dict, visiting: set) -> None:
             callee = graph.resolve_call(node, sc)
             if callee is not None and callee.key != fi.key:
                 _follow_returned_keys(graph, callee, out, visiting)
-    for callee, argnames, _line in s.calls:
-        for pos, an in enumerate(argnames):
-            if an is not None and an in s.returned_names:
-                p2 = cg.param_at(callee, pos)
-                if p2:
-                    _follow_param_writes(graph, callee, p2, out,
-                                         set())
+    for callee, argspec, kwspec, _line in s.calls:
+        for name in s.returned_names:
+            for s2 in cg.forwarded_slots(callee, argspec, kwspec,
+                                         name):
+                _follow_param_writes(graph, callee, s2, out, set())
     for name in s.returned_names:
         callee = s.name_calls.get(name)
         if callee is not None and callee.key != fi.key:
@@ -301,8 +295,8 @@ def _scan_clients(nodes: list, rel: str, graph, mod,
                 callee = graph.resolve_call(node, scope)
                 if callee is None:
                     continue
-                p = cg.param_at(callee, pos)
-                if p:
+                p = cg.slot_at(callee, pos)
+                if p is not None:
                     _follow_param_reads(graph, callee, p, site.reads,
                                         set())
         elif isinstance(node, ast.Compare) and len(node.ops) == 1 \

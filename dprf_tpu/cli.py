@@ -259,6 +259,82 @@ def _build_parser() -> argparse.ArgumentParser:
     pw.add_argument("--spec-json", default=None, help=argparse.SUPPRESS)
     pw.add_argument("--quiet", "-q", action="store_true")
 
+    jb = sub.add_parser("jobs", help="multi-tenant job admin against a "
+                        "RUNNING coordinator: submit new jobs into the "
+                        "fair-share scheduler, list/inspect/cancel/"
+                        "pause them, pull per-job hits")
+    jsub = jb.add_subparsers(dest="jobs_cmd", required=True)
+
+    def _jobs_client_args(c) -> None:
+        c.add_argument("--connect", required=True, metavar="HOST:PORT",
+                       help="the coordinator's RPC address "
+                       "(`dprf serve --bind`)")
+        c.add_argument("--token", default=None,
+                       help="shared secret for an authenticated "
+                       "coordinator (default: $DPRF_TOKEN)")
+        c.add_argument("--timeout", type=float, default=30.0)
+        c.add_argument("--quiet", "-q", action="store_true")
+
+    jsb = jsub.add_parser("submit", help="submit a new job to the "
+                          "scheduler; target lines are shipped, "
+                          "wordlist/rules paths must exist on the "
+                          "COORDINATOR host (it rebuilds and "
+                          "fingerprints the job before admitting it)")
+    jsb.add_argument("attack_arg", help="mask string or wordlist path")
+    jsb.add_argument("hashfile", help="file of target hashes")
+    jsb.add_argument("--engine", "-m", required=True)
+    jsb.add_argument("-a", "--attack", default="mask",
+                     choices=["mask", "wordlist", "combinator",
+                              "hybrid-wm", "hybrid-mw"])
+    jsb.add_argument("--rules", default=None)
+    jsb.add_argument("--markov", default=None, metavar="STATS")
+    for i in range(1, 5):
+        jsb.add_argument(f"--custom{i}", default=None)
+    jsb.add_argument("--unit-size", type=int, default=1 << 22)
+    jsb.add_argument("--unit-seconds", type=float, default=20.0)
+    jsb.add_argument("--batch", type=int, default=None,
+                     help="device batch size shipped to workers "
+                     f"(default: {DEFAULT_BATCH})")
+    jsb.add_argument("--hit-cap", type=int, default=64)
+    jsb.add_argument("--owner", default=None,
+                     help="tenant name recorded on the job (default: "
+                     "$USER)")
+    jsb.add_argument("--priority", type=int, default=1,
+                     help="fair-share weight: a priority-3 job "
+                     "receives ~3x the leases of a priority-1 job")
+    jsb.add_argument("--quota", type=int, default=None, metavar="N",
+                     help="cap on keyspace indices this job may sweep")
+    jsb.add_argument("--rate", type=float, default=None, metavar="U/S",
+                     help="lease-rate cap in units/second (token "
+                     "bucket)")
+    _jobs_client_args(jsb)
+
+    jls = jsub.add_parser("list", help="list every job with state, "
+                          "coverage, and fair-share accounting")
+    _jobs_client_args(jls)
+    for name, helptext in (
+            ("status", "one job's summary (adds its keyspace and "
+             "fingerprint)"),
+            ("cancel", "cancel a job: no more leases, in-flight "
+             "completes dropped"),
+            ("pause", "pause a job (outstanding units still land; "
+             "resume with `dprf jobs resume`)"),
+            ("resume", "resume a paused job")):
+        c = jsub.add_parser(name, help=helptext)
+        c.add_argument("job", help="job id (from submit/list)")
+        _jobs_client_args(c)
+    jh = jsub.add_parser("hits", help="pull a job's hits (cursor-"
+                         "based): each tenant streams its OWN cracks, "
+                         "not the global found set")
+    jh.add_argument("job", help="job id")
+    jh.add_argument("--cursor", type=int, default=0,
+                    help="resume from this hit sequence number")
+    jh.add_argument("--follow", action="store_true",
+                    help="keep polling until the job reaches a "
+                    "terminal state")
+    jh.add_argument("--interval", type=float, default=2.0)
+    _jobs_client_args(jh)
+
     rp = sub.add_parser("retry-parked", help="admin op on a RUNNING "
                         "coordinator: requeue poisoned/parked units "
                         "with a fresh retry budget, without restarting "
@@ -326,6 +402,30 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="output file (default: <session>"
                     ".perfetto.json)")
     te.add_argument("--quiet", "-q", action="store_true")
+    tpl = trsub.add_parser("pull", help="incident response: arm a "
+                           "fleet-wide flight-recorder pull (live "
+                           "workers ship their LOCAL rings on their "
+                           "next lease), then dump the coordinator's "
+                           "merged ring to a .trace.jsonl file that "
+                           "`dprf trace export` understands")
+    tpl.add_argument("--connect", required=True, metavar="HOST:PORT",
+                     help="the coordinator's RPC address")
+    tpl.add_argument("-o", "--out", default="pulled.trace.jsonl",
+                     help="output span stream (feed to `dprf trace "
+                     "export`)")
+    tpl.add_argument("--wait", type=float, default=2.0, metavar="S",
+                     help="seconds to wait after arming so polling "
+                     "workers can push their rings (0 with --no-arm)")
+    tpl.add_argument("--no-arm", action="store_true",
+                     help="dump only what the coordinator already "
+                     "holds; do not ask workers for their rings")
+    tpl.add_argument("--spans", type=int, default=1000, metavar="N",
+                     help="page size per op_trace_pull request")
+    tpl.add_argument("--token", default=None,
+                     help="shared secret for an authenticated "
+                     "coordinator (default: $DPRF_TOKEN)")
+    tpl.add_argument("--timeout", type=float, default=30.0)
+    tpl.add_argument("--quiet", "-q", action="store_true")
 
     mt = sub.add_parser("metrics", help="scrape a running coordinator's "
                         "/metrics endpoint (Prometheus text format)")
@@ -612,12 +712,14 @@ def _load_targets(engine, hashfile: str, log: Log):
 
 
 def _setup_session(args, spec, log: Log):
-    """Returns (session, completed, restored_hits, tuning) or None on
-    conflict."""
+    """Returns (session, completed, restored_hits, tuning, jobs) or
+    None on conflict; ``jobs`` is the journal's scheduler-submitted
+    job records (multi-tenant serve resume, jobs/build.restore_jobs)."""
     session = None
     completed: list = []
     restored_hits: list = []
     tuning: dict = {}
+    jobs: dict = {}
     if args.session:
         session = SessionJournal(args.session)
         prior = SessionJournal.load(args.session)
@@ -633,14 +735,15 @@ def _setup_session(args, spec, log: Log):
                 completed = prior.completed
                 restored_hits = prior.hits
                 tuning = prior.tuning
+                jobs = prior.jobs
                 done = sum(e - s for s, e in completed)
                 log.info("resuming session", covered=done,
-                         hits=len(restored_hits))
+                         hits=len(restored_hits), jobs=len(jobs))
         elif prior is not None:
             log.error("session file exists; pass --restore to resume "
                       "or remove it", path=args.session)
             return None
-    return session, completed, restored_hits, tuning
+    return session, completed, restored_hits, tuning, jobs
 
 
 def _print_results(found: dict, targets) -> None:
@@ -658,7 +761,7 @@ class _JobSetup:
 
     def __init__(self, engine, hl, gen, max_len, unit_size, spec,
                  session, completed, restored_hits, dispatcher,
-                 tuning=None):
+                 tuning=None, restored_jobs=None):
         self.engine = engine
         self.hl = hl
         self.gen = gen
@@ -671,6 +774,8 @@ class _JobSetup:
         self.dispatcher = dispatcher
         #: tuning records restored from the session journal (resume)
         self.tuning = tuning or {}
+        #: scheduler-submitted job records from the journal (resume)
+        self.restored_jobs = restored_jobs or {}
 
 
 def _setup_job(args, device: str, log: Log,
@@ -699,7 +804,7 @@ def _setup_job(args, device: str, log: Log,
     sess = _setup_session(args, spec, log)
     if sess is None:
         return None
-    session, completed, restored_hits, tuning = sess
+    session, completed, restored_hits, tuning, restored_jobs = sess
 
     kw = {} if lease_timeout is None else {"lease_timeout": lease_timeout}
     unit_seconds = getattr(args, "unit_seconds", 0) or 0
@@ -736,7 +841,7 @@ def _setup_job(args, device: str, log: Log,
         dispatcher = Dispatcher(gen.keyspace, unit_size, **kw)
     return _JobSetup(engine, hl, gen, max_len, unit_size, spec,
                      session, completed, restored_hits, dispatcher,
-                     tuning=tuning)
+                     tuning=tuning, restored_jobs=restored_jobs)
 
 
 def _tune_extras(attack: str, hit_cap=None, n_rules=None) -> dict:
@@ -1056,25 +1161,67 @@ def cmd_serve(args, log: Log) -> int:
             session.record_hit(ti, cand, plain)
 
     def on_progress(done, total, nfound):
-        if session is not None:
-            session.record_units(dispatcher.completed_intervals())
+        # done/total/nfound aggregate over EVERY non-cancelled job
         if not args.quiet:
             log.info("progress", pct=f"{100.0 * done / total:.2f}%",
-                     found=f"{nfound}/{len(hl.targets)}")
+                     found=nfound)
+
+    # -- multi-tenant hooks (jobs/scheduler.py; all fire under
+    # state.lock, so the journal writes below serialize) -------------
+
+    def on_job_hit(job, ti, cand, plain):
+        # the DEFAULT job's hits flow through on_hit above -- untagged
+        # journal lines, exactly the single-job format
+        if job.job_id == state.default_job_id:
+            return
+        raws = job.spec.get("targets") or []
+        raw = raws[ti] if 0 <= ti < len(raws) else str(ti)
+        log.info("cracked", job=job.job_id, target=str(raw)[:32],
+                 lane=cand)
+        if potfile is not None:
+            potfile.add(raw, plain)
+        if session is not None:
+            session.record_hit(ti, cand, plain, job=job.job_id)
+
+    def on_job_progress(jid, intervals):
+        if session is not None:
+            session.record_units(
+                intervals,
+                job=None if jid == state.default_job_id else jid)
+
+    def on_job_event(kind, job):
+        if session is None:
+            return
+        if kind == "submit":
+            session.record_job(job.job_id, job.spec, owner=job.owner,
+                               priority=job.priority, quota=job.quota,
+                               rate=job.rate)
+        else:
+            session.record_job_state(job.job_id, job.state)
 
     state.on_hit = on_hit
     state.on_progress = on_progress
-    from dprf_tpu.runtime.coordinator import (preload_potfile,
-                                              restore_hits_into)
+    state.on_job_hit = on_job_hit
+    state.on_job_progress = on_job_progress
+    state.on_job_event = on_job_event
+    from dprf_tpu.runtime.coordinator import preload_potfile
+    # restored hits go through the default job's hit BUFFER (not just
+    # the found dict) so op_hits_pull clients see them too
+    state.seed_found(restored_hits)
     # the server is not up yet, but taking the lock costs nothing and
     # keeps the guarded-by invariant unconditional (dprf check locks)
     with state.lock:
-        restore_hits_into(state.found, restored_hits)
         preload_potfile(state.found, hl.targets, potfile)
         preloaded = len(state.found)
     state.refresh_found_gauge()
     if preloaded:
         log.info("pre-cracked targets", count=preloaded)
+    if job_setup.restored_jobs:
+        # scheduler-submitted tenants from the journal: rebuild each
+        # job's ledger/hits/state so the restart loses no coverage
+        from dprf_tpu.jobs.build import restore_jobs
+        restore_jobs(state, job_setup.restored_jobs, log=log,
+                     lease_timeout=args.lease_timeout)
 
     host, port = _parse_hostport(args.bind)
     server = CoordinatorServer(state, host, port)
@@ -1100,18 +1247,36 @@ def cmd_serve(args, log: Log) -> int:
             tracer.detach_file()
             log.info("trace spans written (export with `dprf trace "
                      "export`)", path=session.trace_path)
-            session.snapshot(dispatcher.completed_intervals())
-            session.close()
     # one snapshot under the lock: the server just shut down, but a
     # worker connection thread may still be unwinding its last op
     with state.lock:
         found = dict(state.found)
+        summaries = state.scheduler.summaries()
+        per_job = [(j.job_id, j.dispatcher.completed_intervals(),
+                    j.dispatcher.parked_count(),
+                    j.dispatcher.parked_indices())
+                   for j in state.scheduler.jobs()]
+    if session is not None:
+        for jid, intervals, _, _ in per_job:
+            session.snapshot(
+                intervals,
+                job=None if jid == state.default_job_id else jid)
+        session.close()
     _print_results(found, hl.targets)
-    if dispatcher.parked_count():
-        log.warn("job finished with POISONED units parked; their "
-                 "ranges were NOT swept",
-                 parked=dispatcher.parked_count(),
-                 indices=dispatcher.parked_indices())
+    for jid, _, parked, parked_idx in per_job:
+        if parked:
+            log.warn("job finished with POISONED units parked; their "
+                     "ranges were NOT swept", job=jid, parked=parked,
+                     indices=parked_idx)
+    if len(summaries) > 1:
+        # tenants beyond the CLI-invoked default job: their hits
+        # streamed via op_hits_pull, but leave a closing audit line
+        for s in summaries:
+            if s["id"] != state.default_job_id:
+                log.info("tenant job finished", job=s["id"],
+                         owner=s["owner"], state=s["state"],
+                         found=f"{s['found']}/{s['targets']}",
+                         covered=f"{s['done']}/{s['total']}")
     log.info("job finished",
              found=f"{len(found)}/{len(hl.targets)}")
     return 0 if found else 1
@@ -1129,45 +1294,84 @@ def cmd_worker(args, log: Log) -> int:
     host, port = _parse_hostport(args.connect)
     token = args.token or envreg.get_str("DPRF_TOKEN") or None
     client = CoordinatorClient(host, port, token=token)
-    job = client.hello()["job"]
+    hello = client.hello()
+    job = hello["job"]
+    default_jid = hello.get("job_id")
     log.info("job received", engine=job["engine"], attack=job["attack"],
-             keyspace=job["keyspace"], targets=len(job["targets"]))
+             keyspace=job["keyspace"], targets=len(job["targets"]),
+             job=default_jid)
 
-    engine = get_engine(job["engine"], device="cpu")
-    targets = [engine.parse_target(raw) for raw in job["targets"]]
-    customs = {int(i): bytes.fromhex(v)
-               for i, v in job.get("customs", {}).items()}
-    gen, attack_desc, _ = _build_gen(job["attack"], job["attack_arg"],
-                                     customs, job.get("rules"),
-                                     job.get("max_len"), engine, device, log,
-                                     markov=job.get("markov"))
-    # Recompute the full job fingerprint locally: a wordlist or rules
-    # file that differs in CONTENT (not just size) on this host would
-    # silently leave coverage holes -- the unit ledger marks ranges done
-    # that this worker decoded to different candidates.
-    ours = job_fingerprint(engine.name, attack_desc, gen.keyspace,
-                           [t.digest for t in targets])
-    if ours != job["fingerprint"]:
-        log.error("local job disagrees with coordinator (different "
-                  "wordlist/rules file content on this host?)",
-                  ours=ours, theirs=job["fingerprint"])
+    def build_worker(spec: dict, jid):
+        """Rebuild one job's worker from its wire spec, fingerprint-
+        checked: a wordlist or rules file that differs in CONTENT (not
+        just size) on this host would silently leave coverage holes --
+        the unit ledger marks ranges done that this worker decoded to
+        different candidates."""
+        engine = get_engine(spec["engine"], device="cpu")
+        targets = [engine.parse_target(raw) for raw in spec["targets"]]
+        customs = {int(i): bytes.fromhex(v)
+                   for i, v in spec.get("customs", {}).items()}
+        gen, attack_desc, _ = _build_gen(
+            spec["attack"], spec["attack_arg"], customs,
+            spec.get("rules"), spec.get("max_len"), engine, device,
+            log, markov=spec.get("markov"))
+        ours = job_fingerprint(engine.name, attack_desc, gen.keyspace,
+                               [t.digest for t in targets])
+        if ours != spec["fingerprint"]:
+            raise RpcError(
+                f"local job {jid} disagrees with coordinator "
+                "(different wordlist/rules file content on this "
+                f"host?): ours={ours} theirs={spec['fingerprint']}")
+        w = _select_worker(spec["engine"], device, spec["attack"], gen,
+                           targets, args.batch or spec["batch"],
+                           spec["hit_cap"], engine, args.devices, log)
+        # overlapped warmup: the step compile runs while leases
+        # round-trip to the coordinator; worker_loop joins it before
+        # the first dispatch
+        warmup_async = getattr(w, "warmup_async", None)
+        if warmup_async is not None:
+            warmup_async()
+        return w
+
+    try:
+        worker = build_worker(job, default_jid)
+    except RpcError as e:
+        log.error(str(e))
         return 2
 
-    worker = _select_worker(job["engine"], device, job["attack"], gen,
-                            targets, args.batch or job["batch"],
-                            job["hit_cap"], engine, args.devices, log)
-    # overlapped warmup: the step compile runs while the first lease
-    # round-trips to the coordinator; worker_loop joins it before the
-    # first dispatch
-    warmup_async = getattr(worker, "warmup_async", None)
-    if warmup_async is not None:
-        warmup_async()
+    # multi-tenant fleets (jobs/scheduler.py): lease entries name
+    # their job; an unfamiliar id fetches the spec over op_job_status,
+    # rebuilds + fingerprint-checks it, and caches the worker.  A job
+    # this host CANNOT build (wordlist missing here, divergent file
+    # content) caches as None: worker_loop releases its leases and
+    # keeps serving every other tenant -- one bad submission must not
+    # kill the fleet.
+    workers = {default_jid: worker} if default_jid is not None else {}
+
+    def worker_for(jid):
+        if jid in workers:
+            return workers[jid]
+        try:
+            resp = client.call("job_status", job=jid)
+            spec = resp["spec"]
+            log.info("job received", engine=spec["engine"],
+                     attack=spec["attack"], keyspace=spec["keyspace"],
+                     job=jid)
+            w = build_worker(spec, jid)
+        except (RpcError, OSError, ValueError, KeyError) as e:
+            log.error("job cannot run on this host; refusing its "
+                      "leases", job=jid, error=str(e))
+            w = None
+        workers[jid] = w
+        return w
+
     worker_id = args.id or f"{_socket.gethostname()}:{os.getpid()}"
     # worker_loop exits cleanly only on an explicit stop signal; any
     # bare connection drop (coordinator crash) or quarantine raises and
     # surfaces through main()'s error handler as a nonzero exit.
     done = worker_loop(client, worker, worker_id, log=log,
-                       depth=args.pipeline_depth)
+                       depth=args.pipeline_depth,
+                       worker_for=worker_for)
     log.info("worker done", units=done)
     client.close()
     return 0
@@ -1435,14 +1639,162 @@ def cmd_top(args, log: Log) -> int:
     return 0
 
 
+def _jobs_client(args, log: Log):
+    """Authenticated client for the jobs/trace admin commands."""
+    from dprf_tpu.runtime.rpc import CoordinatorClient
+
+    host, port = _parse_hostport(args.connect)
+    token = args.token or envreg.get_str("DPRF_TOKEN") or None
+    client = CoordinatorClient(host, port, timeout=args.timeout,
+                               token=token)
+    if token:
+        client.hello()             # answer the auth challenge first
+    return client
+
+
+def cmd_jobs(args, log: Log) -> int:
+    """`dprf jobs submit/list/status/cancel/pause/resume/hits`: the
+    multi-tenant admin surface over a running coordinator's job
+    scheduler (rpc.op_job_* / op_hits_pull).  One helper per
+    subcommand: each RPC op's response lives in its own scope, so the
+    protocol checker's per-op key dataflow stays exact."""
+    client = _jobs_client(args, log)
+    try:
+        if args.jobs_cmd == "submit":
+            return _jobs_submit(client, args, log)
+        if args.jobs_cmd == "list":
+            return _jobs_list(client, args)
+        if args.jobs_cmd == "hits":
+            return _jobs_hits(client, args, log)
+        return _jobs_admin(client, args, log)
+    finally:
+        client.close()
+
+
+def _jobs_submit(client, args, log: Log) -> int:
+    import json as _json
+
+    with open(args.hashfile, encoding="utf-8",
+              errors="replace") as fh:
+        lines = [ln.strip() for ln in fh if ln.strip()]
+    spec = {
+        "engine": args.engine,
+        "attack": args.attack,
+        "attack_arg": args.attack_arg,
+        "customs": {str(i): v.hex()
+                    for i, v in _customs(args).items()},
+        "rules": args.rules,
+        "markov": args.markov,
+        "targets": lines,
+        "unit_size": args.unit_size,
+        "unit_seconds": args.unit_seconds,
+        "batch": args.batch or DEFAULT_BATCH,
+        "hit_cap": args.hit_cap,
+    }
+    owner = args.owner or os.environ.get("USER") or "?"
+    resp = client.call("job_submit", spec=spec, owner=owner,
+                       priority=args.priority,
+                       quota=args.quota, rate=args.rate)
+    log.info("job submitted", job=resp.get("job_id"),
+             keyspace=resp.get("keyspace"),
+             fingerprint=resp.get("fingerprint"))
+    print(_json.dumps({"job": resp.get("job_id"),
+                       "keyspace": resp.get("keyspace"),
+                       "fingerprint": resp.get("fingerprint")}))
+    return 0
+
+
+def _jobs_list(client, args) -> int:
+    import json as _json
+
+    resp = client.call("job_list")
+    jobs = resp.get("jobs") or []
+    if not args.quiet:
+        print(f"{'JOB':6s} {'OWNER':12s} {'PRIO':>4s} "
+              f"{'STATE':10s} {'COVERED':>18s} {'FOUND':>9s} "
+              f"{'LEASES':>7s}", file=sys.stderr)
+        for j in jobs:
+            cov = f"{j['done']}/{j['total']}"
+            print(f"{j['id']:6s} {j['owner'][:12]:12s} "
+                  f"{j['priority']:>4d} {j['state']:10s} "
+                  f"{cov:>18s} "
+                  f"{j['found']}/{j['targets']:>4d} "
+                  f"{j['leases']:>7d}", file=sys.stderr)
+    print(_json.dumps(jobs))
+    return 0
+
+
+def _jobs_admin(client, args, log: Log) -> int:
+    """status / cancel / pause / resume: one job in, its summary out."""
+    import json as _json
+
+    cmd = args.jobs_cmd
+    if cmd == "status":
+        resp = client.call("job_status", job=args.job)
+    elif cmd == "cancel":
+        resp = client.call("job_cancel", job=args.job)
+    else:
+        resp = client.call("job_pause", job=args.job,
+                           resume=cmd == "resume")
+    summary = resp.get("job") or {}
+    log.info(f"job {cmd}", job=summary.get("id"),
+             state=summary.get("state"))
+    print(_json.dumps(summary))
+    return 0
+
+
+def _jobs_hits(client, args, log: Log) -> int:
+    """Cursor-based per-job hit pull; --follow keeps polling until the
+    job reaches a terminal state."""
+    import time as _time
+
+    spec = _jobs_client_spec(client, args.job)
+    raws = (spec or {}).get("targets") or []
+    cursor = max(0, args.cursor)
+    while True:
+        resp = client.call("hits_pull", job=args.job, cursor=cursor)
+        for h in resp.get("hits") or ():
+            ti = h.get("target")
+            raw = (raws[ti] if isinstance(ti, int)
+                   and 0 <= ti < len(raws) else str(ti))
+            from dprf_tpu.runtime.potfile import encode_plain
+            print(f"{raw}:"
+                  f"{encode_plain(bytes.fromhex(h['plaintext']))}",
+                  flush=True)
+        cursor = resp.get("cursor") or cursor
+        state = resp.get("state")
+        if not args.follow or state in ("done", "cancelled"):
+            log.info("hits pulled", job=args.job, cursor=cursor,
+                     found=resp.get("found"),
+                     targets=resp.get("targets"), state=state)
+            return 0
+        _time.sleep(max(0.1, args.interval))
+
+
+def _jobs_client_spec(client, job_id: str):
+    """The job's wire spec via op_job_status (target raws for
+    rendering pulled hits); None when the job is unknown."""
+    from dprf_tpu.runtime.rpc import RpcError
+    try:
+        resp = client.call("job_status", job=job_id)
+    except RpcError:
+        return None
+    return resp.get("spec")
+
+
 def cmd_trace(args, log: Log) -> int:
     """`dprf trace export SESSION`: session span stream -> Chrome-trace
     JSON (Perfetto-loadable), plus a lifecycle summary -- how many unit
     traces, reissues, orphan spans (there should be none), and
-    incomplete lifecycles."""
+    incomplete lifecycles.  `dprf trace pull --connect` is the
+    incident-response path: collect the fleet's flight-recorder rings
+    from a live coordinator into a file export understands."""
     import json as _json
 
     from dprf_tpu.telemetry import trace as trace_mod
+
+    if args.trace_cmd == "pull":
+        return _trace_pull(args, log)
 
     path = trace_mod.trace_path(args.session)
     spans = trace_mod.load_trace(path)
@@ -1476,6 +1828,50 @@ def cmd_trace(args, log: Log) -> int:
         "incomplete": len(report["incomplete"]),
     }))
     return 0
+
+
+def _trace_pull(args, log: Log) -> int:
+    """`dprf trace pull --connect`: arm a fleet-wide ring pull (each
+    live worker ships its local flight recorder with its next lease
+    round trip), wait, then page the coordinator's merged ring out
+    through op_trace_pull and write a .trace.jsonl stream."""
+    import json as _json
+    import time as _time
+
+    client = _jobs_client(args, log)
+    try:
+        first = client.call("trace_pull", arm=not args.no_arm,
+                            since=None, n=args.spans)
+        if not args.no_arm:
+            log.info("pull armed; waiting for worker rings",
+                     epoch=first.get("epoch"), wait_s=args.wait)
+            _time.sleep(max(0.0, args.wait))
+        # page the ring: span-id cursor, stop when a page comes back
+        # short (tail reached)
+        spans: list = []
+        cursor = None
+        while True:
+            resp = client.call("trace_pull", arm=False, since=cursor,
+                               n=args.spans)
+            page = resp.get("spans") or []
+            if resp.get("resync"):
+                spans = []        # cursor fell off the ring: restart
+            spans.extend(page)
+            cursor = resp.get("cursor") or cursor
+            if len(page) < args.spans:
+                break
+        with open(args.out, "w", encoding="utf-8") as fh:
+            for s in spans:
+                fh.write(_json.dumps(s, separators=(",", ":"),
+                                     default=str) + "\n")
+        procs = sorted({str(s.get("proc")) for s in spans})
+        log.info("trace pulled", out=args.out, spans=len(spans),
+                 procs=len(procs))
+        print(_json.dumps({"out": args.out, "spans": len(spans),
+                           "procs": procs}))
+        return 0
+    finally:
+        client.close()
 
 
 def cmd_metrics(args, log: Log) -> int:
@@ -1647,6 +2043,7 @@ _COMMANDS = {
     "bench": cmd_bench,
     "tune": cmd_tune,
     "prewarm": cmd_prewarm,
+    "jobs": cmd_jobs,
     "retry-parked": cmd_retry_parked,
     "top": cmd_top,
     "trace": cmd_trace,

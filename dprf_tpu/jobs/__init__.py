@@ -1,0 +1,29 @@
+"""Multi-tenant serve plane (ISSUE 8): many jobs, many users, one fleet.
+
+The coordinator ran one job per session; this package turns the serve
+plane into a scheduler of MANY jobs sharing one worker fleet --
+HashKitty's platform shape (PAPERS.md): users submit tasks to a
+service that schedules them across nodes.
+
+  scheduler.py   Job records + JobScheduler: weighted fair-share
+                 (stride) selection across runnable jobs at lease
+                 time, per-job keyspace accounting, quota and lease-
+                 rate limits, per-job hit buffers for cursor-based
+                 delivery, and job states
+                 (queued/running/paused/done/cancelled).
+  build.py       Server-side job construction from a wire spec
+                 (op_job_submit): targets/generator/fingerprint/
+                 dispatcher/verifier -- the same composition the
+                 `dprf serve` front-end performs -- plus per-job
+                 session-journal resume.
+
+The RPC surface (op_job_submit/list/status/cancel/pause, op_hits_pull)
+lives on rpc.CoordinatorState, which owns one JobScheduler; the
+`dprf jobs` CLI is the admin client.
+"""
+
+from dprf_tpu.jobs.scheduler import (CANCELLED, DONE, PAUSED, QUEUED,
+                                     RUNNING, Job, JobScheduler)
+
+__all__ = ["Job", "JobScheduler", "QUEUED", "RUNNING", "PAUSED",
+           "DONE", "CANCELLED"]

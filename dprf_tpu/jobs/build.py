@@ -1,0 +1,198 @@
+"""Server-side job construction (op_job_submit) and per-job resume.
+
+A submitted spec is CLIENT data: the coordinator rebuilds the whole
+job from it -- parse the target lines with the named engine, build the
+generator (wordlist/rule paths are read on the COORDINATOR host, same
+placement contract as `dprf serve`), derive max_len, compute the
+fingerprint -- and only then admits it to the scheduler.  The
+resulting wire job is byte-for-byte the shape `dprf serve` ships at
+hello, so `cli.cmd_worker`'s rebuild-and-fingerprint-check path works
+unchanged for scheduler-assigned jobs.
+
+``restore_jobs`` is the resume half: `dprf serve --restore` replays
+the session journal's job records (spec + completed intervals + hits
++ last state) back into a fresh scheduler, so a coordinator restart
+loses no tenant's coverage.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dprf_tpu.jobs.scheduler import CANCELLED, DONE, PAUSED
+
+#: spec keys a submission must carry; everything else has defaults
+REQUIRED_SPEC_KEYS = ("engine", "attack", "attack_arg", "targets")
+
+DEFAULT_UNIT_SIZE = 1 << 22
+DEFAULT_HIT_CAP = 64
+
+
+def build_job_runtime(spec: dict, job_id: str, log=None,
+                      lease_timeout: float = 300.0, registry=None,
+                      recorder=None, completed=None):
+    """Wire spec -> (wire_job, dispatcher, targets, verifier).
+
+    Raises ValueError on a malformed spec (missing keys, unparsable
+    targets, generator construction failure, or a client-supplied
+    fingerprint that disagrees with the server-side rebuild).
+    ``completed`` (resume): prior coverage intervals the dispatcher is
+    rebuilt around.
+    """
+    from dprf_tpu import cli as _cli
+    from dprf_tpu import get_engine
+    from dprf_tpu.runtime.dispatcher import Dispatcher
+    from dprf_tpu.runtime.session import job_fingerprint
+    from dprf_tpu.utils.hashlist import parse_lines
+    from dprf_tpu.utils.logging import DEFAULT as _default_log
+
+    log = log or _default_log
+    if not isinstance(spec, dict):
+        raise ValueError("job spec must be a dict")
+    for k in REQUIRED_SPEC_KEYS:
+        if k not in spec:
+            raise ValueError(f"job spec missing {k!r}")
+    engine = get_engine(str(spec["engine"]), device="cpu")
+    lines = spec["targets"]
+    if not isinstance(lines, list) or not lines:
+        raise ValueError("job spec needs a non-empty 'targets' list")
+    hl = parse_lines(engine, [str(ln) for ln in lines])
+    for no, _text, err in hl.skipped:
+        log.warn("job submit: skipping target line", line=no, error=err)
+    if not hl.targets:
+        raise ValueError("no valid targets in the submitted hashlist")
+    customs = {int(i): bytes.fromhex(v)
+               for i, v in (spec.get("customs") or {}).items()}
+    attack = str(spec["attack"])
+    # device only shapes wordlist packing width (max_len); the job
+    # itself is device-agnostic -- workers pick their own backend
+    device = str(spec.get("device") or "jax")
+    gen, attack_desc, max_len = _cli._build_gen(
+        attack, str(spec["attack_arg"]), customs, spec.get("rules"),
+        None, engine, device, log, markov=spec.get("markov"))
+    fingerprint = job_fingerprint(engine.name, attack_desc,
+                                  gen.keyspace,
+                                  [t.digest for t in hl.targets])
+    theirs = spec.get("fingerprint")
+    if theirs is not None and theirs != fingerprint:
+        raise ValueError(
+            f"submitted fingerprint {theirs!r} disagrees with the "
+            f"coordinator's rebuild {fingerprint!r} (divergent "
+            "wordlist/rules/stats content on this host?)")
+    unit_size = _cli._align_unit_size(
+        int(spec.get("unit_size") or DEFAULT_UNIT_SIZE), attack, gen)
+    try:
+        batch = int(spec.get("batch") or _cli.DEFAULT_BATCH)
+    except (TypeError, ValueError):
+        batch = _cli.DEFAULT_BATCH
+    hit_cap = int(spec.get("hit_cap") or DEFAULT_HIT_CAP)
+
+    kw = {"lease_timeout": lease_timeout, "registry": registry,
+          "recorder": recorder, "job_id": job_id}
+    try:
+        unit_seconds = float(spec.get("unit_seconds", 20.0))
+    except (TypeError, ValueError):
+        unit_seconds = 20.0
+    if unit_seconds > 0:
+        from dprf_tpu.tune import AdaptiveUnitSizer
+        align = gen.n_rules if attack == "wordlist" else 1
+        kw["sizer"] = AdaptiveUnitSizer(
+            unit_size, target_seconds=unit_seconds, align=align,
+            min_unit=max(align, min(unit_size, 1 << 10)),
+            registry=registry)
+    if completed:
+        dispatcher = Dispatcher.from_completed(
+            gen.keyspace, unit_size, list(completed), **kw)
+    else:
+        dispatcher = Dispatcher(gen.keyspace, unit_size, **kw)
+
+    targets = hl.targets
+
+    def verifier(ti: int, plain: bytes) -> bool:
+        if engine.verify(plain, targets[ti]):
+            return True
+        log.warn("rejected unverifiable hit", job=job_id,
+                 target=targets[ti].raw[:32])
+        return False
+
+    # the exact wire shape cmd_serve ships at hello -- a worker's
+    # rebuild-and-fingerprint path is identical for every job source
+    wire_job = {
+        "engine": engine.name,
+        "attack": attack,
+        "attack_arg": str(spec["attack_arg"]),
+        "customs": {str(i): v.hex() for i, v in customs.items()},
+        "rules": spec.get("rules"),
+        "markov": spec.get("markov"),
+        "max_len": max_len,
+        "targets": [t.raw for t in targets],
+        "keyspace": gen.keyspace,
+        "unit_size": unit_size,
+        # persisted so a journal-restored rebuild sizes units exactly
+        # like the original admission did
+        "unit_seconds": unit_seconds,
+        "batch": batch,
+        "hit_cap": hit_cap,
+        "fingerprint": fingerprint,
+    }
+    return wire_job, dispatcher, targets, verifier
+
+
+def restore_jobs(state, jobs: dict, log=None,
+                 lease_timeout: float = 300.0) -> int:
+    """Replay a session journal's scheduler-submitted job records
+    (``SessionState.jobs``; the DEFAULT job is restored by the serve
+    front-end's existing single-job path) into ``state``'s scheduler.
+    Returns the number of jobs restored."""
+    from dprf_tpu.utils.logging import DEFAULT as _default_log
+
+    log = log or _default_log
+    n = 0
+    for jid in sorted(jobs, key=_job_sort_key):
+        rec = jobs[jid]
+        spec = rec.get("spec")
+        if not spec:
+            log.warn("journaled job has no spec; skipping", job=jid)
+            continue
+        try:
+            wire, dispatcher, targets, verifier = build_job_runtime(
+                spec, jid, log=log, lease_timeout=lease_timeout,
+                registry=state.registry, recorder=state.tracer,
+                completed=rec.get("completed") or ())
+        except (ValueError, OSError, KeyError) as e:
+            log.warn("journaled job failed to rebuild; skipping",
+                     job=jid, error=str(e))
+            continue
+        with state.lock:
+            job = state.scheduler.add(
+                wire, dispatcher, len(targets), verifier=verifier,
+                owner=str(rec.get("owner") or "?"),
+                priority=int(rec.get("priority") or 1),
+                quota=rec.get("quota"), rate=rec.get("rate"),
+                job_id=jid)
+            for h in rec.get("hits") or ():
+                try:
+                    job.record_hit(int(h["target"]), int(h["index"]),
+                                   bytes.fromhex(h["plaintext"]))
+                except (KeyError, ValueError, TypeError):
+                    continue
+            last = rec.get("state")
+            if last == CANCELLED:
+                state.scheduler.cancel(jid)
+            elif last == PAUSED:
+                state.scheduler.pause(jid)
+            elif last == DONE:
+                state.scheduler.refresh_job_state(job)
+        n += 1
+        done, total = dispatcher.progress()
+        log.info("restored job", job=jid, covered=done, total=total,
+                 hits=len(job.hits), state=job.state)
+    state.refresh_found_gauge()
+    return n
+
+
+def _job_sort_key(jid: str):
+    try:
+        return (0, int(jid.lstrip("j")))
+    except ValueError:
+        return (1, jid)

@@ -1,0 +1,458 @@
+"""Job records + the weighted fair-share scheduler.
+
+One Job wraps everything the single-job CoordinatorState used to own
+directly: a spec (the wire job description workers rebuild from), a
+Dispatcher (its OWN unit ledger -- per-job keyspace accounting, stale
+guards, and poison parking come for free), the per-job found set and
+an ordered hit buffer for cursor-based delivery (``op_hits_pull``),
+the CPU-oracle verifier, and the tenant knobs: owner, priority, quota,
+lease rate.
+
+Selection is STRIDE SCHEDULING (deterministic weighted fair share):
+every job carries a ``pass`` value; each lease picks the runnable job
+with the smallest pass and advances it by 1/weight, so over any window
+the lease counts of two runnable jobs approach their weight ratio
+exactly -- testable to tight bounds, no RNG.  A job with nothing
+leasable right now (all of its remaining work outstanding) is skipped
+WITHOUT advancing its pass, so it is not penalized for a full ledger.
+
+Limits:
+
+  - ``quota``: a cap on keyspace indices the job may SWEEP.  A job
+    whose covered + outstanding indices reach the quota stops leasing;
+    once covered alone reaches it, the job is DONE (reason "quota").
+    The cap is accounting, not geometry: the dispatcher keeps the full
+    keyspace, so raising the quota later needs no re-split.
+  - ``rate``: a token-bucket lease rate (units/second, burst = one
+    second's worth, minimum 1).  The cheap fleet-protection knob: a
+    low-priority bulk job can be pinned to a trickle no matter how
+    idle the fleet is.
+
+Thread model: the scheduler is driven entirely under the caller's lock
+(rpc.CoordinatorState.lock) -- same contract as the Dispatcher it
+multiplexes, declared ``<extern>`` below for the `dprf check` locks
+analyzer.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from dprf_tpu.runtime.dispatcher import Dispatcher
+from dprf_tpu.telemetry import get_registry
+
+#: job lifecycle states
+QUEUED = "queued"
+RUNNING = "running"
+PAUSED = "paused"
+DONE = "done"
+CANCELLED = "cancelled"
+
+STATES = (QUEUED, RUNNING, PAUSED, DONE, CANCELLED)
+
+#: lock-discipline declaration (`dprf check` locks analyzer): every
+#: concurrent caller (the RPC handler threads) serializes through
+#: CoordinatorState.lock, which declares its ``scheduler`` reference
+#: guarded -- exactly the Dispatcher contract.  ``<extern>`` also
+#: forbids this class from acquiring a declared lock itself.
+GUARDED_BY = {"JobScheduler": {"<extern>": ()}}
+
+
+class Job:
+    """One tenant job: spec + ledger + results + limits.  Pure data
+    plus derived accessors; all mutation happens through the
+    scheduler (under the caller's lock)."""
+
+    __slots__ = ("job_id", "spec", "dispatcher", "n_targets",
+                 "verifier", "owner", "priority", "quota", "rate",
+                 "state", "done_reason", "created", "found", "hits",
+                 "rejected", "leases", "pass_value", "_tokens",
+                 "_token_t")
+
+    def __init__(self, job_id: str, spec: dict, dispatcher: Dispatcher,
+                 n_targets: int, verifier: Optional[Callable] = None,
+                 owner: str = "local", priority: int = 1,
+                 quota: Optional[int] = None,
+                 rate: Optional[float] = None,
+                 created: float = 0.0):
+        self.job_id = job_id
+        self.spec = spec
+        self.dispatcher = dispatcher
+        self.n_targets = n_targets
+        #: (target_index, plaintext) -> bool; None = trust reports
+        self.verifier = verifier
+        self.owner = owner
+        self.priority = max(1, int(priority))
+        self.quota = None if quota is None else max(0, int(quota))
+        self.rate = None if rate is None else max(0.001, float(rate))
+        self.state = QUEUED
+        self.done_reason: Optional[str] = None
+        self.created = created
+        self.found: dict = {}            # target_index -> plaintext
+        #: ordered hit buffer for op_hits_pull: the cursor is the list
+        #: index, so a pull client never re-reads or skips a hit
+        self.hits: list = []
+        self.rejected = 0
+        self.leases = 0                  # fair-share accounting
+        self.pass_value = 0.0            # stride scheduler state
+        self._tokens = 1.0               # lease-rate token bucket
+        self._token_t: Optional[float] = None
+
+    @property
+    def weight(self) -> float:
+        return float(self.priority)
+
+    def terminal(self) -> bool:
+        return self.state in (DONE, CANCELLED)
+
+    def runnable(self) -> bool:
+        return self.state in (QUEUED, RUNNING)
+
+    def covered(self) -> int:
+        return self.dispatcher.progress()[0]
+
+    def swept_or_leased(self) -> int:
+        """Indices covered plus indices currently out on leases --
+        what the quota is enforced against (an aheaded lease counts;
+        otherwise a deep pipeline would overshoot the quota by a
+        fleet's worth of units)."""
+        return self.covered() + self.dispatcher.outstanding_indices()
+
+    def record_hit(self, target_index: int, cand_index: int,
+                   plaintext: bytes) -> bool:
+        """Append a VERIFIED hit; returns False for duplicates."""
+        if target_index in self.found:
+            return False
+        self.found[target_index] = plaintext
+        self.hits.append({"seq": len(self.hits),
+                          "target": target_index,
+                          "cand": cand_index,
+                          "plaintext": plaintext.hex()})
+        return True
+
+    def summary(self) -> dict:
+        """The op_job_list / op_job_status record (no spec: that ships
+        only from op_job_status, where one job was asked for)."""
+        done, total = self.dispatcher.progress()
+        return {"id": self.job_id, "owner": self.owner,
+                "priority": self.priority, "state": self.state,
+                "reason": self.done_reason, "done": done,
+                "total": total, "quota": self.quota, "rate": self.rate,
+                "found": len(self.found), "targets": self.n_targets,
+                "rejected": self.rejected, "leases": self.leases,
+                "outstanding": self.dispatcher.outstanding_count(),
+                "parked": self.dispatcher.parked_count()}
+
+
+class JobScheduler:
+    """Queue of Jobs + stride fair-share lease selection.  Driven
+    under the owner's lock (see GUARDED_BY above)."""
+
+    #: jobs a coordinator will hold at once (ids are server-assigned
+    #: -- "j0", "j1", ... -- so the per-job metric label cardinality
+    #: is bounded by this, not by client behavior)
+    MAX_JOBS = 64
+
+    def __init__(self, registry=None, clock=None):
+        self._jobs: dict = {}            # job_id -> Job, insert-ordered
+        self._next_id = 0
+        self._clock = clock or time.monotonic
+        m = get_registry(registry)
+        self._g_jobs = m.gauge(
+            "dprf_jobs", "jobs known to the scheduler, by state",
+            labelnames=("state",))
+        self._m_job_hits = m.counter(
+            "dprf_job_hits_total", "verified cracks per job",
+            labelnames=("job",))
+        self._refresh_states()
+
+    # -- registry --------------------------------------------------------
+
+    def _refresh_states(self) -> None:
+        counts = {s: 0 for s in STATES}
+        for j in self._jobs.values():
+            counts[j.state] += 1
+        for s, n in counts.items():
+            self._g_jobs.set(n, state=s)
+
+    def full(self) -> bool:
+        """Admission check BEFORE the expensive server-side build
+        (op_job_submit): a rejected submission must not have parsed
+        targets, built a generator, or registered per-job metric
+        series first."""
+        return len(self._jobs) >= self.MAX_JOBS
+
+    def reserve_id(self) -> str:
+        """Claim the next job id (call under the lock; the expensive
+        spec build then happens OUTSIDE it against a stable id)."""
+        jid = f"j{self._next_id}"
+        self._next_id += 1
+        return jid
+
+    def add(self, spec: dict, dispatcher: Dispatcher, n_targets: int,
+            verifier: Optional[Callable] = None, owner: str = "local",
+            priority: int = 1, quota: Optional[int] = None,
+            rate: Optional[float] = None,
+            job_id: Optional[str] = None, state: str = RUNNING) -> Job:
+        if len(self._jobs) >= self.MAX_JOBS:
+            raise ValueError(f"job table full ({self.MAX_JOBS} jobs)")
+        if job_id is None:
+            job_id = self.reserve_id()
+        elif job_id in self._jobs:
+            raise ValueError(f"job id {job_id!r} already exists")
+        else:
+            # restored ids ("j3") must not collide with future ones
+            try:
+                n = int(job_id.lstrip("j"))
+                self._next_id = max(self._next_id, n + 1)
+            except ValueError:
+                pass
+        job = Job(job_id, spec, dispatcher, n_targets,
+                  verifier=verifier, owner=owner, priority=priority,
+                  quota=quota, rate=rate, created=self._clock())
+        job.state = state
+        # a late-submitted job starts at the current pass frontier:
+        # fair share is forward-looking, not a retroactive catch-up
+        # burst that would starve every older job
+        passes = [j.pass_value for j in self._jobs.values()
+                  if j.runnable()]
+        job.pass_value = min(passes) if passes else 0.0
+        self._jobs[job_id] = job
+        self._refresh_states()
+        return job
+
+    def get(self, job_id: Optional[str]) -> Optional[Job]:
+        if job_id is None:
+            return self.default()
+        return self._jobs.get(job_id)
+
+    def default(self) -> Optional[Job]:
+        """The first job -- what a pre-multi-tenant client that never
+        names a job id is talking about."""
+        for j in self._jobs.values():
+            return j
+        return None
+
+    def jobs(self) -> list:
+        return list(self._jobs.values())
+
+    # -- lease-time selection --------------------------------------------
+
+    def _leasable(self, job: Job, now: float) -> bool:
+        if not job.runnable():
+            return False
+        if job.quota is not None and job.swept_or_leased() >= job.quota:
+            return False
+        if not job.dispatcher.leasable():
+            return False
+        if job.rate is not None:
+            if job._token_t is not None:
+                job._tokens = min(max(1.0, job.rate),
+                                  job._tokens
+                                  + (now - job._token_t) * job.rate)
+            job._token_t = now
+            if job._tokens < 1.0:
+                return False
+        return True
+
+    def lease_many(self, worker_id: str, n: int) -> list:
+        """Up to n (job, unit) pairs for one worker, stride-selected
+        across every leasable job."""
+        out: list = []
+        now = self._clock()
+        skip: set = set()
+        for _ in range(max(0, int(n))):
+            best = None
+            for j in self._jobs.values():
+                if j.job_id in skip or not self._leasable(j, now):
+                    continue
+                if best is None or (j.pass_value, j.created) \
+                        < (best.pass_value, best.created):
+                    best = j
+            if best is None:
+                break
+            unit = best.dispatcher.lease(worker_id)
+            if unit is None:
+                # everything left is outstanding: skip without a pass
+                # advance (no penalty for a full ledger)
+                skip.add(best.job_id)
+                continue
+            if best.state == QUEUED:
+                best.state = RUNNING
+                self._refresh_states()
+            best.pass_value += 1.0 / best.weight
+            best.leases += 1
+            if best.rate is not None:
+                best._tokens -= 1.0
+            out.append((best, unit))
+        return out
+
+    def reap_expired(self) -> int:
+        n = 0
+        for j in self._jobs.values():
+            if not j.terminal():
+                n += j.dispatcher.reap_expired()
+        return n
+
+    def outstanding_for(self, worker_id: str) -> int:
+        return sum(j.dispatcher.outstanding_for(worker_id)
+                   for j in self._jobs.values() if not j.terminal())
+
+    def total_outstanding(self) -> int:
+        return sum(j.dispatcher.outstanding_count()
+                   for j in self._jobs.values() if not j.terminal())
+
+    # -- completion / termination ----------------------------------------
+
+    def complete(self, job: Job, unit_id: int,
+                 elapsed: Optional[float] = None,
+                 worker_id: Optional[str] = None) -> bool:
+        """Route a completion to the job's ledger.  A CANCELLED job
+        drops the report outright -- a mid-flight cancel must not keep
+        counting coverage (or hits) from units leased before it."""
+        if job.state == CANCELLED:
+            return False
+        landed = job.dispatcher.complete(unit_id, elapsed=elapsed,
+                                         worker_id=worker_id)
+        if landed:
+            self.refresh_job_state(job)
+        return landed
+
+    def fail(self, job: Job, unit_id: int,
+             worker_id: Optional[str] = None) -> bool:
+        if job.state == CANCELLED:
+            return False
+        return job.dispatcher.fail(unit_id, worker_id=worker_id)
+
+    def record_hit(self, job: Job, target_index: int, cand_index: int,
+                   plaintext: bytes) -> bool:
+        new = job.record_hit(target_index, cand_index, plaintext)
+        if new:
+            self._m_job_hits.inc(job=job.job_id)
+            self.refresh_job_state(job)
+        return new
+
+    def refresh_job_state(self, job: Job) -> None:
+        """Promote a job to DONE when it has nothing left to do:
+        every target cracked, keyspace (minus parked) covered, or the
+        sweep quota reached."""
+        if job.terminal() or job.state == PAUSED:
+            return
+        if job.n_targets and len(job.found) >= job.n_targets:
+            job.state, job.done_reason = DONE, "all targets found"
+        elif job.dispatcher.done():
+            job.state, job.done_reason = DONE, "keyspace exhausted"
+        elif job.quota is not None and job.covered() >= job.quota:
+            job.state, job.done_reason = DONE, "quota reached"
+        else:
+            return
+        self._refresh_states()
+
+    # -- admin -----------------------------------------------------------
+
+    def retry_parked(self) -> int:
+        """Requeue every job's parked units with a fresh retry budget
+        (the op_retry_parked admin op).  A job the park-as-unreachable
+        rule already marked DONE ("keyspace exhausted") comes back to
+        RUNNING when its ranges become reachable again -- otherwise
+        the requeued units could never lease."""
+        n = 0
+        for j in self._jobs.values():
+            if j.state == CANCELLED:
+                continue
+            requeued = j.dispatcher.retry_parked()
+            n += requeued
+            if requeued and j.state == DONE \
+                    and not j.dispatcher.done():
+                j.state, j.done_reason = RUNNING, None
+        if n:
+            self._refresh_states()
+        return n
+
+    def cancel(self, job_id: str) -> Optional[Job]:
+        """Cancel a job: no more leases, in-flight completes dropped,
+        outstanding leases abandoned (their workers' reports bounce
+        off the CANCELLED guard).  Terminal states stay terminal."""
+        job = self._jobs.get(job_id)
+        if job is None:
+            return None
+        if not job.terminal():
+            job.state, job.done_reason = CANCELLED, "cancelled"
+            job.dispatcher.abandon()
+            self._refresh_states()
+        return job
+
+    def pause(self, job_id: str, resume: bool = False) -> Optional[Job]:
+        """Pause (or resume) a job: a paused job leases nothing, but
+        outstanding units may still complete -- they were honestly
+        leased -- and workers keep polling (pause is not stop)."""
+        job = self._jobs.get(job_id)
+        if job is None or job.terminal():
+            return job
+        if resume:
+            if job.state == PAUSED:
+                job.state = RUNNING
+                self.refresh_job_state(job)
+        else:
+            job.state = PAUSED
+        self._refresh_states()
+        return job
+
+    # -- aggregate status -------------------------------------------------
+
+    def all_finished(self) -> bool:
+        """Every job terminal (the multi-job _stopped condition) --
+        False while the table is empty only because an empty
+        coordinator shouldn't exist (the default job is added at
+        construction)."""
+        jobs = self._jobs.values()
+        if not jobs:
+            return False
+        for j in jobs:
+            self.refresh_job_state(j)
+        return all(j.terminal() for j in jobs)
+
+    def idle_stop(self) -> bool:
+        """Should an empty lease response tell the worker to stop?
+        Yes only when no non-terminal job could EVER hand out work
+        again without operator action: nothing outstanding and nothing
+        pending anywhere, and no job is merely paused (paused jobs
+        keep the fleet polling for the resume)."""
+        for j in self._jobs.values():
+            if j.terminal():
+                continue
+            if j.state == PAUSED:
+                return False
+            if j.dispatcher.outstanding_count() \
+                    or j.dispatcher.leasable():
+                return False
+        return True
+
+    def progress(self) -> tuple:
+        """(covered, total) summed over non-cancelled jobs."""
+        done = total = 0
+        for j in self._jobs.values():
+            if j.state == CANCELLED:
+                continue
+            d, t = j.dispatcher.progress()
+            done += d
+            total += t
+        return done, total
+
+    def found_total(self) -> int:
+        return sum(len(j.found) for j in self._jobs.values())
+
+    def targets_total(self) -> int:
+        return sum(j.n_targets for j in self._jobs.values())
+
+    def parked_total(self) -> int:
+        return sum(j.dispatcher.parked_count()
+                   for j in self._jobs.values())
+
+    def parked_indices_total(self) -> int:
+        return sum(j.dispatcher.parked_indices()
+                   for j in self._jobs.values())
+
+    def summaries(self) -> list:
+        return [j.summary() for j in self._jobs.values()]

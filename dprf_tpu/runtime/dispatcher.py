@@ -124,12 +124,17 @@ class Dispatcher:
                  clock: Optional[Callable[[], float]] = None,
                  registry=None, sizer=None,
                  max_unit_retries: Optional[int] = 5,
-                 recorder=None):
+                 recorder=None, job_id: str = "j0"):
         if unit_size <= 0:
             raise ValueError("unit_size must be positive")
         self.keyspace = keyspace
         self.unit_size = unit_size
         self.lease_timeout = lease_timeout
+        #: the job this ledger belongs to (multi-tenant serve plane,
+        #: jobs/scheduler.py): every unit-lifecycle metric and span
+        #: this dispatcher records carries it, so per-job observability
+        #: costs one label -- "j0" is the single-job/local default
+        self.job_id = job_id
         #: tune.AdaptiveUnitSizer (or None): sizes fresh units per
         #: leasing worker toward a target seconds-per-unit
         self.sizer = sizer
@@ -150,30 +155,40 @@ class Dispatcher:
         #: unit id -> trace id, assigned at split time; entries are
         #: dropped on complete (bounded by live + parked units)
         self._trace_ids: dict[int, str] = {}
+        # unit-lifecycle metrics carry the job id (ISSUE 8): one
+        # declaration site, one label -- a multi-tenant coordinator's
+        # /metrics splits cleanly per tenant job
         m = get_registry(registry)
         self._m_leased = m.counter(
-            "dprf_units_leased_total", "WorkUnit leases handed out")
+            "dprf_units_leased_total", "WorkUnit leases handed out",
+            labelnames=("job",))
         self._m_completed = m.counter(
-            "dprf_units_completed_total", "WorkUnits marked done")
+            "dprf_units_completed_total", "WorkUnits marked done",
+            labelnames=("job",))
         self._m_reissued = m.counter(
             "dprf_units_reissued_total",
-            "WorkUnits returned to the queue", labelnames=("reason",))
+            "WorkUnits returned to the queue",
+            labelnames=("reason", "job"))
         self._g_outstanding = m.gauge(
-            "dprf_units_outstanding", "leases currently held")
+            "dprf_units_outstanding", "leases currently held",
+            labelnames=("job",))
         self._g_keyspace = m.gauge(
-            "dprf_keyspace_total", "keyspace indices in the job")
+            "dprf_keyspace_total", "keyspace indices in the job",
+            labelnames=("job",))
         self._g_covered = m.gauge(
-            "dprf_keyspace_covered", "keyspace indices completed")
+            "dprf_keyspace_covered", "keyspace indices completed",
+            labelnames=("job",))
         self._m_poisoned = m.counter(
             "dprf_units_poisoned_total",
-            "units parked after exhausting their retry budget")
+            "units parked after exhausting their retry budget",
+            labelnames=("job",))
         self._g_parked = m.gauge(
             "dprf_units_parked",
             "units currently parked (poisoned); drops to 0 on a "
-            "retry-parked admin op")
-        self._g_keyspace.set(keyspace)
-        self._g_covered.set(0)
-        self._g_parked.set(0)
+            "retry-parked admin op", labelnames=("job",))
+        self._g_keyspace.set(keyspace, job=job_id)
+        self._g_covered.set(0, job=job_id)
+        self._g_parked.set(0, job=job_id)
 
     # -- construction from a resume journal ------------------------------
 
@@ -183,7 +198,7 @@ class Dispatcher:
         d = cls(keyspace, unit_size, **kw)
         for s, e in completed:
             d._done.add(s, e)
-        d._g_covered.set(d._done.covered())
+        d._g_covered.set(d._done.covered(), job=d.job_id)
         frontier = max((e for _, e in completed), default=0)
         for s, e in d._done.gaps(frontier):
             # re-split big gaps into unit-sized pieces
@@ -193,7 +208,8 @@ class Dispatcher:
         return d
 
     def _make_unit(self, start: int, length: int) -> WorkUnit:
-        u = WorkUnit(self._next_id, start, length)
+        u = WorkUnit(self._next_id, start, length,
+                     job_id=self.job_id)
         self._next_id += 1
         # the unit's whole lifecycle -- every lease, failure, reissue,
         # wherever it lands -- shares this one trace id
@@ -228,14 +244,15 @@ class Dispatcher:
         lease_span = self.tracer.record(
             "lease", trace=self._trace_ids.get(unit.unit_id),
             proc="coordinator", worker=worker_id, unit=unit.unit_id,
-            start=unit.start, length=unit.length,
+            job=self.job_id, start=unit.start, length=unit.length,
             lease_timeout_s=self.lease_timeout,
             attempt=self._retries.get(unit.unit_id, 0) + 1)
         self._outstanding[unit.unit_id] = (
             unit, worker_id, self._clock() + self.lease_timeout,
             span_id(lease_span))
-        self._m_leased.inc()
-        self._g_outstanding.set(len(self._outstanding))
+        self._m_leased.inc(job=self.job_id)
+        self._g_outstanding.set(len(self._outstanding),
+                                job=self.job_id)
         return unit
 
     def lease_many(self, worker_id: str, n: int) -> list:
@@ -291,10 +308,11 @@ class Dispatcher:
         self.tracer.record(
             "complete", trace=self._trace_ids.pop(unit_id, None),
             parent=lease_sid, proc="coordinator", worker=worker_id,
-            unit=unit_id, elapsed_s=elapsed)
-        self._m_completed.inc()
-        self._g_covered.set(self._done.covered())
-        self._g_outstanding.set(len(self._outstanding))
+            unit=unit_id, job=self.job_id, elapsed_s=elapsed)
+        self._m_completed.inc(job=self.job_id)
+        self._g_covered.set(self._done.covered(), job=self.job_id)
+        self._g_outstanding.set(len(self._outstanding),
+                                job=self.job_id)
         return True
 
     def _observe_failure(self, worker_id: Optional[str]) -> None:
@@ -324,12 +342,12 @@ class Dispatcher:
                 and n >= self.max_unit_retries):
             self._parked.append(unit)
             self._parked_len += unit.length
-            self._m_poisoned.inc()
-            self._g_parked.set(len(self._parked))
+            self._m_poisoned.inc(job=self.job_id)
+            self._g_parked.set(len(self._parked), job=self.job_id)
             self.tracer.record("park", trace=tid, parent=lease_sid,
                                proc="coordinator", unit=unit.unit_id,
-                               worker=worker_id, attempts=n,
-                               reason=reason)
+                               job=self.job_id, worker=worker_id,
+                               attempts=n, reason=reason)
             from dprf_tpu.utils.logging import DEFAULT as log
             log.warn("parking poisoned unit after repeated failures",
                      unit=unit.unit_id, start=unit.start,
@@ -338,9 +356,9 @@ class Dispatcher:
             self._pending.append(unit)
             self.tracer.record("reissue", trace=tid, parent=lease_sid,
                                proc="coordinator", unit=unit.unit_id,
-                               worker=worker_id, attempts=n,
-                               reason=reason)
-            self._m_reissued.inc(reason=reason)
+                               job=self.job_id, worker=worker_id,
+                               attempts=n, reason=reason)
+            self._m_reissued.inc(reason=reason, job=self.job_id)
 
     def fail(self, unit_id: int,
              worker_id: Optional[str] = None) -> bool:
@@ -358,10 +376,12 @@ class Dispatcher:
         self.tracer.record("fail",
                            trace=self._trace_ids.get(unit_id),
                            parent=lease_sid, proc="coordinator",
-                           worker=holder, unit=unit_id)
+                           worker=holder, unit=unit_id,
+                           job=self.job_id)
         self._requeue(unit, "failed", worker_id=holder,
                       lease_sid=lease_sid)
-        self._g_outstanding.set(len(self._outstanding))
+        self._g_outstanding.set(len(self._outstanding),
+                                job=self.job_id)
         return True
 
     def reap_expired(self) -> int:
@@ -373,7 +393,8 @@ class Dispatcher:
             self._requeue(unit, "lease_expired", worker_id=worker_id,
                           lease_sid=lease_sid)
         if expired:
-            self._g_outstanding.set(len(self._outstanding))
+            self._g_outstanding.set(len(self._outstanding),
+                                    job=self.job_id)
         return len(expired)
 
     # -- status ----------------------------------------------------------
@@ -405,6 +426,27 @@ class Dispatcher:
     def outstanding_count(self) -> int:
         return len(self._outstanding)
 
+    def outstanding_indices(self) -> int:
+        """Keyspace indices currently out on leases -- what a job
+        quota (jobs/scheduler.py) is enforced against alongside the
+        covered count."""
+        return sum(u.length for u, _, _, _ in self._outstanding.values())
+
+    def leasable(self) -> bool:
+        """Whether a lease() call could hand out a unit right now
+        (pending reissues, or unsplit keyspace left)."""
+        return bool(self._pending) or self._next_start < self.keyspace
+
+    def abandon(self) -> None:
+        """Job-cancel teardown (jobs/scheduler.py): drop every pending
+        and outstanding unit without completing or reissuing them.
+        The ledger stops dead -- late reports from workers still
+        holding these leases bounce off the scheduler's CANCELLED
+        guard, so nothing lands after this."""
+        self._pending.clear()
+        self._outstanding.clear()
+        self._g_outstanding.set(0, job=self.job_id)
+
     def parked_count(self) -> int:
         return len(self._parked)
 
@@ -432,11 +474,12 @@ class Dispatcher:
             self.tracer.record("reissue",
                                trace=self._trace_ids.get(unit.unit_id),
                                proc="coordinator", unit=unit.unit_id,
-                               reason="retry_parked")
-            self._m_reissued.inc(reason="retry_parked")
+                               job=self.job_id, reason="retry_parked")
+            self._m_reissued.inc(reason="retry_parked",
+                                 job=self.job_id)
         self._parked = []
         self._parked_len = 0
-        self._g_parked.set(0)
+        self._g_parked.set(0, job=self.job_id)
         if n:
             from dprf_tpu.utils.logging import DEFAULT as log
             log.info("requeued parked units with a fresh retry budget",
@@ -456,7 +499,7 @@ class Dispatcher:
         id."""
         now = self._clock()
         return [{"unit": uid, "worker": wid, "start": u.start,
-                 "length": u.length,
+                 "length": u.length, "job": self.job_id,
                  "deadline_s": round(dl - now, 3),
                  "trace": self._trace_ids.get(uid)}
                 for uid, (u, wid, dl, _) in self._outstanding.items()]

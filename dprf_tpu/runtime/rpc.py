@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from dprf_tpu.jobs.scheduler import CANCELLED as JOB_CANCELLED
 from dprf_tpu.runtime.dispatcher import Dispatcher
 from dprf_tpu.runtime.worker import Hit
 from dprf_tpu.runtime.workunit import WorkUnit
@@ -56,6 +57,10 @@ MAX_LINE = 64 << 20   # hashlists can be large; candidates never cross
 #: greedy client can vacuum into one host's ledger
 MAX_LEASE_AHEAD = 16
 
+#: spans one op_trace_push message may carry (a worker's whole local
+#: ring, vs the per-unit MAX_INGEST_SPANS bound on complete/fail)
+TRACE_PUSH_MAX = 2048
+
 #: lock-discipline declarations (`dprf check` locks analyzer).  Every
 #: worker connection is its own handler thread in a
 #: ThreadingTCPServer, all mutating this state: the listed
@@ -67,8 +72,9 @@ MAX_LEASE_AHEAD = 16
 #: assume.
 GUARDED_BY = {
     "CoordinatorState": {
-        "lock": ("found", "dispatcher", "rejected", "worker_rejects",
-                 "unit_reject_workers", "quarantined"),
+        "lock": ("found", "dispatcher", "scheduler", "rejected",
+                 "worker_rejects", "unit_reject_workers",
+                 "quarantined", "_pull_epoch"),
     },
     "_CompletionSender": {"<atomic>": ("error", "stop_seen")},
 }
@@ -115,20 +121,48 @@ def recv_msg(fh) -> Optional[dict]:
 # coordinator side
 
 class CoordinatorState:
-    """Shared, locked job state behind the RPC handlers."""
+    """Shared, locked serve-plane state behind the RPC handlers.
+
+    Multi-tenant (ISSUE 8): the state owns a jobs.JobScheduler -- a
+    queue of Job records, each with its OWN Dispatcher, found set, hit
+    buffer, verifier, and limits -- and the ctor's (job, dispatcher,
+    n_targets, verifier) become the DEFAULT job (id = the dispatcher's
+    ``job_id``, "j0").  ``self.job`` / ``self.dispatcher`` /
+    ``self.found`` / ``self.verifier`` stay aliases of that default
+    job, so every pre-multi-tenant caller and client reads exactly
+    what it always did; further jobs arrive over ``op_job_submit``.
+    """
 
     def __init__(self, job: dict, dispatcher: Dispatcher, n_targets: int,
                  on_hit: Optional[Callable] = None,
                  on_progress: Optional[Callable] = None,
                  verifier: Optional[Callable] = None,
                  token: Optional[str] = None, registry=None,
-                 recorder=None):
+                 recorder=None, scheduler=None, job_builder=None,
+                 on_job_hit: Optional[Callable] = None,
+                 on_job_event: Optional[Callable] = None,
+                 on_job_progress: Optional[Callable] = None,
+                 owner: str = "local", priority: int = 1,
+                 quota: Optional[int] = None):
+        from dprf_tpu.jobs.scheduler import JobScheduler
         self.job = job                    # serializable job description
         self.dispatcher = dispatcher
         self.n_targets = n_targets
-        self.found: dict[int, bytes] = {}
         self.on_hit = on_hit              # (target_index, cand_index, plain)
         self.on_progress = on_progress
+        #: per-job (Job, target_index, cand_index, plain): the
+        #: multi-tenant hit hook (session journaling, potfile) -- fires
+        #: for EVERY job, where on_hit stays default-job-only
+        self.on_job_hit = on_job_hit
+        #: (kind, Job) for job lifecycle events ("submit", "cancel",
+        #: "pause", "resume") -- how the serve front-end journals them
+        self.on_job_event = on_job_event
+        #: (job_id, completed_intervals) after every landed complete:
+        #: the per-job session-journal hook (tagged ``units`` records)
+        self.on_job_progress = on_job_progress
+        #: spec -> (wire_job, dispatcher, targets, verifier) for
+        #: op_job_submit; defaults to jobs.build.build_job_runtime
+        self.job_builder = job_builder
         #: (target_index, plaintext) -> bool.  A worker with a buggy or
         #: malicious device path could report a wrong plaintext; accepting
         #: it would permanently mark the target found and poison the
@@ -140,11 +174,25 @@ class CoordinatorState:
         #: lease -> reject -> requeue livelock (same unit bouncing to
         #: the same worker forever).
         self.worker_rejects: dict[str, int] = {}
-        self.unit_reject_workers: dict[int, set] = {}
+        self.unit_reject_workers: dict[tuple, set] = {}
         self.quarantined: set[str] = set()
         self.token = token                # None = unauthenticated protocol
         self.lock = threading.Lock()
         self.t0 = time.perf_counter()
+        #: flight-recorder pull epoch (op_trace_pull arm=True bumps
+        #: it): lease responses carry it, and a worker seeing a new
+        #: epoch ships its LOCAL ring back via op_trace_push
+        self._pull_epoch = 0
+        self.scheduler = scheduler if scheduler is not None \
+            else JobScheduler(registry=registry)
+        default = self.scheduler.add(
+            job, dispatcher, n_targets, verifier=verifier,
+            owner=owner, priority=priority, quota=quota,
+            job_id=dispatcher.job_id)
+        #: the default job's found set IS self.found (same dict): the
+        #: single-job callers that read/seed state.found keep working
+        self.found = default.found
+        self.default_job_id = default.job_id
         #: the registry the RPC port's /metrics endpoint serves; the
         #: Dispatcher publishes unit/keyspace metrics into the same one
         self.registry = get_registry(registry)
@@ -192,10 +240,25 @@ class CoordinatorState:
         self._g_seen.set(time.time(), worker=wid)
 
     def refresh_found_gauge(self) -> None:
-        """Re-sync dprf_targets_found after out-of-band mutations of
-        .found (potfile preload / session restore in cli.cmd_serve)."""
+        """Re-sync dprf_targets_found/_total after out-of-band
+        mutations (potfile preload / session restore in
+        cli.cmd_serve, job submit/restore)."""
         with self.lock:
-            self._g_found.set(len(self.found))
+            self._g_found.set(self.scheduler.found_total())
+            self._g_targets.set(self.scheduler.targets_total())
+
+    def seed_found(self, hits: list) -> None:
+        """Seed the DEFAULT job from journaled hit records (resume):
+        goes through the job's hit buffer so `op_hits_pull` clients
+        see restored hits too, tolerant of malformed entries."""
+        with self.lock:
+            job = self.scheduler.get(self.default_job_id)
+            for h in hits:
+                try:
+                    job.record_hit(int(h["target"]), int(h["index"]),
+                                   bytes.fromhex(h["plaintext"]))
+                except (KeyError, ValueError, TypeError):
+                    continue
 
     #: rejected completions before a worker is quarantined.  Lower than
     #: the unit threshold so a single bad worker is benched while its
@@ -210,20 +273,29 @@ class CoordinatorState:
     # -- RPC ops ---------------------------------------------------------
 
     def op_hello(self, msg: dict) -> dict:
-        return {"ok": True, "job": self.job}
+        # the default job + its scheduler id: a multi-job worker seeds
+        # its per-job worker cache with this one and fetches further
+        # specs through op_job_status as their units arrive
+        return {"ok": True, "job": self.job,
+                "job_id": self.default_job_id}
 
     def op_lease(self, msg: dict) -> dict:
-        """Hand out the next unit(s).  The lease-ahead form
-        (``ahead=N``) returns up to N units in ``"units"`` so a
-        pipelined worker fills its submit-ahead queue in ONE round
-        trip; ``"unit"`` stays the first entry for pre-ahead clients.
-        Per-worker holdings are capped at MAX_LEASE_AHEAD."""
+        """Hand out the next unit(s), fair-share-selected ACROSS jobs
+        (jobs/scheduler.py).  The lease-ahead form (``ahead=N``)
+        returns up to N units in ``"units"`` so a pipelined worker
+        fills its submit-ahead queue in ONE round trip; ``"unit"``
+        stays the first entry for pre-ahead clients.  Every entry
+        names its job; per-worker holdings are capped at
+        MAX_LEASE_AHEAD across all jobs.  ``pull`` carries the
+        flight-recorder pull epoch (op_trace_pull)."""
         with self.lock:
+            pull = self._pull_epoch
             if self._stopped():
-                return {"unit": None, "stop": True}
+                return {"unit": None, "stop": True, "pull": pull}
             wid = str(msg.get("worker_id", "?"))
             if wid in self.quarantined:
-                return {"unit": None, "stop": False, "quarantined": True}
+                return {"unit": None, "stop": False,
+                        "quarantined": True, "pull": pull}
             try:
                 ahead = int(msg.get("ahead", 1))
             except (TypeError, ValueError):
@@ -234,14 +306,17 @@ class CoordinatorState:
             # predecessor's expired leases would otherwise clamp to 0
             # forever -- lease() below is the only reap site during an
             # active job, and a clamp of 0 never reaches it
-            self.dispatcher.reap_expired()
+            self.scheduler.reap_expired()
             ahead = min(ahead, max(
-                0, MAX_LEASE_AHEAD - self.dispatcher.outstanding_for(wid)))
-            units = self.dispatcher.lease_many(wid, ahead)
-            if not units:
-                # nothing leasable right now; workers retry unless done
+                0, MAX_LEASE_AHEAD - self.scheduler.outstanding_for(wid)))
+            pairs = self.scheduler.lease_many(wid, ahead)
+            if not pairs:
+                # nothing leasable right now; workers retry unless NO
+                # non-terminal job could ever lease again (a paused
+                # job keeps the fleet polling for its resume)
                 return {"unit": None,
-                        "stop": self.dispatcher.outstanding_count() == 0}
+                        "stop": self.scheduler.idle_stop(),
+                        "pull": pull}
             # liveness gauge only for ids that actually HOLD a lease:
             # worker_id is client-controlled, and a label child lives
             # forever, so polls with throwaway ids must not grow the
@@ -249,18 +324,18 @@ class CoordinatorState:
             # ledger)
             self._touch_worker(wid)
             entries = []
-            for unit in units:
+            for job, unit in pairs:
                 e = {"id": unit.unit_id, "start": unit.start,
-                     "length": unit.length}
+                     "length": unit.length, "job": job.job_id}
                 # trace context OUT, per unit: the worker parents its
                 # rpc/warmup/sweep spans onto this lease, so the spans
                 # it ships back with complete/fail stitch onto the
                 # coordinator timeline
-                ctx = self.dispatcher.trace_context(unit.unit_id)
+                ctx = job.dispatcher.trace_context(unit.unit_id)
                 if ctx is not None:
                     e["trace"] = {"trace": ctx[0], "span": ctx[1]}
                 entries.append(e)
-            resp = {"unit": entries[0], "units": entries}
+            resp = {"unit": entries[0], "units": entries, "pull": pull}
             if "trace" in entries[0]:
                 # legacy single-unit clients read a top-level context
                 resp["trace"] = entries[0]["trace"]
@@ -280,24 +355,45 @@ class CoordinatorState:
         # seconds for bcrypt/PBKDF2, and holding the lock there would
         # stall every other worker's lease/complete (and hand any buggy
         # worker a coordinator-wide DoS).
+        raw_job = msg.get("job")
         with self.lock:
-            already = set(self.found)
+            job = self.scheduler.get(
+                str(raw_job) if raw_job is not None else None)
+            if job is None:
+                # unknown job id: nothing to route to -- treat like a
+                # stale report (the id was valid when leased only if
+                # the coordinator restarted without it)
+                return {"ok": True, "stop": self._stopped(),
+                        "dropped": True}
+            cancelled = job.state == JOB_CANCELLED
+            already = set(job.found)
+            # the job's verifier/targets are immutable after admission:
+            # safe to use outside the lock below
+            verifier = job.verifier
+            n_targets = job.n_targets
             # trace context of the attempt, read BEFORE complete/fail
             # pops the lease; remote spans + the hit_verify span below
             # parent onto it
-            ctx = self.dispatcher.trace_context(unit_id)
+            ctx = job.dispatcher.trace_context(unit_id)
         self.tracer.ingest(msg.get("spans"),
                            proc=str(msg.get("worker_id", "?")),
                            sent_at=msg.get("clock"))
+        if cancelled:
+            # cancel-mid-flight: the unit was leased before the
+            # cancel; neither its coverage nor its hits may land.
+            # _stopped mutates scheduler state, so back under the lock
+            with self.lock:
+                stopped = self._stopped()
+            return {"ok": True, "stop": stopped, "dropped": True}
         t_verify = time.monotonic()
         verified = []
         rejected = 0
         for h in hits:
             ti = int(h["target"])
-            if ti in already or not 0 <= ti < self.n_targets:
+            if ti in already or not 0 <= ti < n_targets:
                 continue
             plain = bytes.fromhex(h["plaintext"])
-            if self.verifier is not None and not self.verifier(ti, plain):
+            if verifier is not None and not verifier(ti, plain):
                 rejected += 1
                 continue
             verified.append((ti, int(h["cand"]), plain))
@@ -306,16 +402,21 @@ class CoordinatorState:
                 "hit_verify", dur=time.monotonic() - t_verify,
                 trace=ctx[0] if ctx else None,
                 parent=ctx[1] if ctx else None, proc="coordinator",
-                unit=unit_id, hits=len(hits), rejected=rejected)
+                unit=unit_id, job=job.job_id, hits=len(hits),
+                rejected=rejected)
         with self.lock:
+            if job.state == JOB_CANCELLED:  # cancelled during verify
+                return {"ok": True, "stop": self._stopped(),
+                        "dropped": True}
             for ti, cand, plain in verified:
-                if ti in self.found:
+                if not self.scheduler.record_hit(job, ti, cand, plain):
                     continue
-                self.found[ti] = plain
                 self._m_hits.inc()
-                if self.on_hit:
+                if self.on_hit and job.job_id == self.default_job_id:
                     self.on_hit(ti, cand, plain)
-            self._g_found.set(len(self.found))
+                if self.on_job_hit:
+                    self.on_job_hit(job, ti, cand, plain)
+            self._g_found.set(self.scheduler.found_total())
             # attribute the unit's candidates BEFORE complete() drops
             # it from the lease ledger: remote workers hash in their
             # own processes, so the coordinator's scrapeable registry
@@ -327,7 +428,7 @@ class CoordinatorState:
             # another worker -- the live holder owns the completion
             # (verified hits above were still recorded; hits dedupe)
             guard = wid if raw_wid is not None else None
-            unit = self.dispatcher.outstanding_unit(unit_id)
+            unit = job.dispatcher.outstanding_unit(unit_id)
             if rejected:
                 # The reporting worker's device path is suspect: requeue
                 # the range instead of marking it done, or a wrong
@@ -335,6 +436,7 @@ class CoordinatorState:
                 # where the true crack may live.
                 from dprf_tpu.utils.logging import DEFAULT as log
                 self.rejected += rejected
+                job.rejected += rejected
                 self._m_rejects.inc(rejected)
                 self.worker_rejects[wid] = \
                     self.worker_rejects.get(wid, 0) + 1
@@ -346,7 +448,7 @@ class CoordinatorState:
                              "unverifiable hits", worker=wid,
                              rejects=self.worker_rejects[wid])
                 rejecters = self.unit_reject_workers.setdefault(
-                    unit_id, set())
+                    (job.job_id, unit_id), set())
                 rejecters.add(wid)
                 if len(rejecters) >= self.MAX_UNIT_REJECT_WORKERS:
                     # several DIFFERENT workers all produced unverifiable
@@ -355,13 +457,18 @@ class CoordinatorState:
                     log.warn("completing unit after rejected reports "
                              "from several workers; range may hold an "
                              "unrecovered crack", unit=unit_id,
-                             workers=len(rejecters))
-                    self.dispatcher.complete(unit_id, worker_id=guard)
+                             job=job.job_id, workers=len(rejecters))
+                    self.scheduler.complete(job, unit_id,
+                                            worker_id=guard)
                 else:
-                    self.dispatcher.fail(unit_id, worker_id=guard)
+                    self.scheduler.fail(job, unit_id, worker_id=guard)
             else:
-                completed = self.dispatcher.complete(
-                    unit_id, elapsed=elapsed, worker_id=guard)
+                completed = self.scheduler.complete(
+                    job, unit_id, elapsed=elapsed, worker_id=guard)
+                if completed and self.on_job_progress:
+                    self.on_job_progress(
+                        job.job_id,
+                        job.dispatcher.completed_intervals())
                 if completed and unit is not None:
                     # liveness only for completions of real leases (see
                     # op_lease on label cardinality); stale or rejected
@@ -369,11 +476,12 @@ class CoordinatorState:
                     # the live holder, whose complete counts it once
                     self._touch_worker(wid)
                     self._m_cands.inc(unit.length,
-                                      engine=self.job.get("engine", "?"),
+                                      engine=job.spec.get("engine", "?"),
                                       device="remote")
             if self.on_progress:
-                done, total = self.dispatcher.progress()
-                self.on_progress(done, total, len(self.found))
+                done, total = self.scheduler.progress()
+                self.on_progress(done, total,
+                                 self.scheduler.found_total())
             return {"ok": rejected == 0, "stop": self._stopped()}
 
     def op_fail(self, msg: dict) -> dict:
@@ -384,10 +492,15 @@ class CoordinatorState:
                            proc=str(msg.get("worker_id", "?")),
                            sent_at=msg.get("clock"))
         raw_wid = msg.get("worker_id")
+        raw_job = msg.get("job")
         with self.lock:
-            self.dispatcher.fail(
-                int(msg["unit_id"]),
-                worker_id=str(raw_wid) if raw_wid is not None else None)
+            job = self.scheduler.get(
+                str(raw_job) if raw_job is not None else None)
+            if job is not None:
+                self.scheduler.fail(
+                    job, int(msg["unit_id"]),
+                    worker_id=str(raw_wid) if raw_wid is not None
+                    else None)
         return {"ok": True}
 
     def op_trace_tail(self, msg: dict) -> dict:
@@ -415,30 +528,36 @@ class CoordinatorState:
         cursor = spans[-1].get("span") if spans else (
             since if isinstance(since, str) else None)
         with self.lock:
-            done, total = self.dispatcher.progress()
-            leases = self.dispatcher.outstanding_leases()
+            done, total = self.scheduler.progress()
+            leases = []
+            for j in self.scheduler.jobs():
+                if not j.terminal():
+                    leases.extend(j.dispatcher.outstanding_leases())
             status = {"done": done, "total": total,
-                      "found": len(self.found),
-                      "targets": self.n_targets,
-                      "parked": self.dispatcher.parked_count(),
+                      "found": self.scheduler.found_total(),
+                      "targets": self.scheduler.targets_total(),
+                      "parked": self.scheduler.parked_total(),
                       "stop": self._stopped(),
                       "elapsed": time.perf_counter() - self.t0,
                       # the clock span timestamps live in: span ages
                       # must be computed against THIS, not the
                       # viewer's possibly-skewed wall clock
                       "now": time.time(),
+                      # per-job rows for the dprf top admin view
+                      "jobs": self.scheduler.summaries(),
                       "quarantined": sorted(self.quarantined)}
         return {"ok": True, "spans": spans, "leases": leases,
                 "status": status, "cursor": cursor, "resync": resync}
 
     def op_retry_parked(self, msg: dict) -> dict:
         """Admin op (`dprf retry-parked --connect`): requeue poisoned/
-        parked units with a fresh retry budget on the LIVE job --
-        without restarting it.  Token-authenticated like every other
-        RPC op when the coordinator has a token (it mutates the unit
-        ledger, unlike the read-only /metrics scrape)."""
+        parked units with a fresh retry budget on the LIVE jobs --
+        without restarting them (a DONE-because-parked job returns to
+        RUNNING).  Token-authenticated like every other RPC op when
+        the coordinator has a token (it mutates the unit ledger,
+        unlike the read-only /metrics scrape)."""
         with self.lock:
-            n = self.dispatcher.retry_parked()
+            n = self.scheduler.retry_parked()
         return {"ok": True, "retried": n}
 
     def op_metrics(self, msg: dict) -> dict:
@@ -451,18 +570,186 @@ class CoordinatorState:
 
     def op_status(self, msg: dict) -> dict:
         with self.lock:
-            done, total = self.dispatcher.progress()
+            done, total = self.scheduler.progress()
             return {"done": done, "total": total,
-                    "found": len(self.found), "stop": self._stopped(),
-                    # poisoned ranges (retry-cap parked): a job that
-                    # "finished" with parked units did NOT sweep them
-                    "parked": self.dispatcher.parked_count(),
-                    "parked_indices": self.dispatcher.parked_indices(),
+                    "found": self.scheduler.found_total(),
+                    "stop": self._stopped(),
+                    # poisoned ranges (retry-cap parked), summed over
+                    # EVERY job like done/total/found above: a tenant
+                    # that "finished" with parked units did NOT sweep
+                    # them, and the default-job-only count would hide
+                    # that (per-job detail is in "jobs")
+                    "parked": self.scheduler.parked_total(),
+                    "parked_indices":
+                        self.scheduler.parked_indices_total(),
+                    "jobs": self.scheduler.summaries(),
                     "elapsed": time.perf_counter() - self.t0}
 
+    # -- multi-tenant job admin (jobs/scheduler.py) -----------------------
+
+    def op_job_submit(self, msg: dict) -> dict:
+        """Admit a new job to the scheduler.  The spec is rebuilt
+        server-side (jobs/build.py): targets parsed, generator built,
+        fingerprint recomputed -- a submission is DATA, never trusted
+        structure.  The expensive build runs OUTSIDE the lock against
+        a pre-reserved job id."""
+        spec = msg.get("spec")
+        builder = self.job_builder
+        if builder is None:
+            from dprf_tpu.jobs.build import build_job_runtime
+            builder = build_job_runtime
+        with self.lock:
+            # capacity gate BEFORE the expensive build: a full table
+            # must not cost target parsing, generator construction,
+            # or per-job metric registration per rejected attempt
+            if self.scheduler.full():
+                return {"error": "job rejected: job table full "
+                        f"({self.scheduler.MAX_JOBS} jobs)"}
+            jid = self.scheduler.reserve_id()
+            lease_timeout = self.dispatcher.lease_timeout
+        try:
+            wire, dispatcher, targets, verifier = builder(
+                spec, jid, registry=self.registry,
+                recorder=self.tracer, lease_timeout=lease_timeout)
+        except (ValueError, OSError, KeyError, TypeError) as e:
+            return {"error": f"job rejected: {e}"}
+        owner = str(msg.get("owner") or "?")
+        try:
+            priority = max(1, int(msg.get("priority") or 1))
+        except (TypeError, ValueError):
+            priority = 1
+        quota = msg.get("quota")
+        quota = int(quota) if isinstance(quota, (int, float)) else None
+        rate = msg.get("rate")
+        rate = float(rate) if isinstance(rate, (int, float)) else None
+        with self.lock:
+            try:
+                job = self.scheduler.add(
+                    wire, dispatcher, len(targets), verifier=verifier,
+                    owner=owner, priority=priority, quota=quota,
+                    rate=rate, job_id=jid)
+            except ValueError as e:
+                return {"error": str(e)}
+            self._g_targets.set(self.scheduler.targets_total())
+            summary = job.summary()
+            # under the lock: the event hook journals (session file
+            # writes must serialize with the on_hit/on_job_progress
+            # writers, which also run under it)
+            if self.on_job_event:
+                self.on_job_event("submit", job)
+        from dprf_tpu.utils.logging import DEFAULT as log
+        log.info("job submitted", job=jid, owner=owner,
+                 priority=priority, keyspace=wire["keyspace"],
+                 fingerprint=wire["fingerprint"])
+        return {"ok": True, "job": summary, "job_id": jid,
+                "fingerprint": wire["fingerprint"],
+                "keyspace": wire["keyspace"]}
+
+    def op_job_list(self, msg: dict) -> dict:
+        with self.lock:
+            return {"ok": True, "jobs": self.scheduler.summaries()}
+
+    def op_job_status(self, msg: dict) -> dict:
+        """One job's summary plus its full wire spec -- the op a
+        multi-job worker rebuilds an unfamiliar job from (the spec is
+        the same shape op_hello ships for the default job)."""
+        with self.lock:
+            job = self.scheduler.get(self._job_arg(msg))
+            if job is None:
+                return {"error": f"unknown job {msg.get('job')!r}"}
+            return {"ok": True, "job": job.summary(),
+                    "spec": job.spec}
+
+    def op_job_cancel(self, msg: dict) -> dict:
+        with self.lock:
+            job = self.scheduler.cancel(self._job_arg(msg) or "")
+            if job is None:
+                return {"error": f"unknown job {msg.get('job')!r}"}
+            summary = job.summary()
+            if self.on_job_event:
+                self.on_job_event("cancel", job)
+        return {"ok": True, "job": summary}
+
+    def op_job_pause(self, msg: dict) -> dict:
+        resume = bool(msg.get("resume"))
+        with self.lock:
+            job = self.scheduler.pause(self._job_arg(msg) or "",
+                                       resume=resume)
+            if job is None:
+                return {"error": f"unknown job {msg.get('job')!r}"}
+            summary = job.summary()
+            if self.on_job_event:
+                self.on_job_event("resume" if resume else "pause",
+                                  job)
+        return {"ok": True, "job": summary}
+
+    def op_hits_pull(self, msg: dict) -> dict:
+        """Cursor-based per-job hit delivery: the submitting client
+        polls with its last cursor and receives only NEW hits -- the
+        multi-tenant replacement for scraping the single global found
+        set.  The cursor is the hit sequence number; hits never
+        reorder, so a client can resume from any cursor."""
+        try:
+            cursor = max(0, int(msg.get("cursor") or 0))
+        except (TypeError, ValueError):
+            cursor = 0
+        with self.lock:
+            job = self.scheduler.get(self._job_arg(msg))
+            if job is None:
+                return {"error": f"unknown job {msg.get('job')!r}"}
+            hits = [dict(h) for h in job.hits[cursor:]]
+            return {"ok": True, "hits": hits,
+                    "cursor": cursor + len(hits),
+                    "state": job.state, "found": len(job.found),
+                    "targets": job.n_targets}
+
+    def _job_arg(self, msg: dict) -> Optional[str]:
+        j = msg.get("job")
+        return str(j) if j is not None else None
+    _job_arg._holds_lock = "lock"   # callers hold self.lock
+
+    # -- incident-response trace collection -------------------------------
+
+    def op_trace_pull(self, msg: dict) -> dict:
+        """Flight-recorder dump for incident response (`dprf trace
+        pull`): page through the coordinator's ring with a span-id
+        cursor.  ``arm=True`` additionally bumps the PULL EPOCH, which
+        rides every lease response -- each live worker seeing a new
+        epoch ships its LOCAL ring back via op_trace_push, so the next
+        pull holds the fleet-wide record, including spans that never
+        rode a complete/fail message."""
+        if msg.get("arm"):
+            with self.lock:
+                self._pull_epoch += 1
+        try:
+            n = int(msg.get("n", 1000))
+        except (TypeError, ValueError):
+            n = 1000
+        n = max(1, min(n, 4096))
+        since = msg.get("since")
+        since = since if isinstance(since, str) else None
+        # forward pager from the ring's OLDEST span: a pull is a full
+        # dump, not a live tail -- the client walks until a short page
+        spans, resync = self.tracer.head_after(since, n)
+        cursor = spans[-1].get("span") if spans else since
+        with self.lock:
+            epoch = self._pull_epoch
+        return {"ok": True, "spans": spans, "cursor": cursor,
+                "resync": resync, "epoch": epoch}
+
+    def op_trace_push(self, msg: dict) -> dict:
+        """A worker shipping its local flight-recorder ring (the
+        op_trace_pull arm handshake).  Sanitized exactly like the
+        spans on complete/fail -- bounded count, declared names only,
+        proc forced to the reporting worker id -- just with a ring-
+        sized bound instead of the per-unit one."""
+        ingested = self.tracer.ingest(
+            msg.get("spans"), proc=str(msg.get("worker_id", "?")),
+            sent_at=msg.get("clock"), limit=TRACE_PUSH_MAX)
+        return {"ok": True, "ingested": ingested}
+
     def _stopped(self) -> bool:
-        return (len(self.found) >= self.n_targets
-                or self.dispatcher.done())
+        return self.scheduler.all_finished()
     _stopped._holds_lock = "lock"   # callers hold self.lock
 
     def finished(self) -> bool:
@@ -637,8 +924,9 @@ class CoordinatorServer:
                     # expired leases (dead workers) won't be reaped by
                     # lease() anymore -- nobody is leasing -- so reap
                     # here or a dead worker would pin the drain loop
-                    self.state.dispatcher.reap_expired()
-                    outstanding = self.state.dispatcher.outstanding_count()
+                    self.state.scheduler.reap_expired()
+                    outstanding = \
+                        self.state.scheduler.total_outstanding()
                 if outstanding == 0:
                     break
                 time.sleep(poll)
@@ -795,7 +1083,8 @@ class _CompletionSender:
 
 def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                 idle_sleep: float = 0.5, log=None, registry=None,
-                recorder=None, depth: Optional[int] = None) -> int:
+                recorder=None, depth: Optional[int] = None,
+                worker_for: Optional[Callable] = None) -> int:
     """Pipelined lease -> submit-ahead -> resolve -> async-complete
     loop, until the coordinator says stop.  Returns units completed.
 
@@ -805,9 +1094,26 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
     unit N resolves, so the next super-step is on the device stream
     while the host decodes hits and the RPC round trips fly; serial
     workers still gain the lease-ahead batch and the overlapped
-    completion report.  ``depth`` defaults to the shared
-    ``DPRF_PIPELINE_DEPTH`` knob; depth 1 is the serial fallback (one
-    connection, synchronous completes -- the pre-pipelining loop).
+    completion report.
+
+    Multi-tenant (ISSUE 8): lease entries name their JOB; the optional
+    ``worker_for(job_id)`` factory maps an unfamiliar job to its
+    worker (cli.cmd_worker builds one that fetches the spec over
+    op_job_status, fingerprint-checks it, and caches the rebuilt
+    worker).  A factory returning None means the job cannot run on
+    this host (missing wordlist file, divergent content fingerprint):
+    its leases are failed back in-band and the loop keeps serving
+    other jobs.  Without a factory every unit runs on the default
+    ``worker`` -- the single-job fleet unchanged.  Complete/fail
+    reports echo the job id so the coordinator routes them to the
+    right ledger.
+
+    ``depth=None`` (the default) runs the ADAPTIVE depth: EWMAs of
+    the lease round trip and the inter-completion interval derive the
+    live submit-ahead depth (~1 + rtt/unit_seconds) each iteration,
+    capped by the ``DPRF_PIPELINE_DEPTH`` knob / ``--pipeline-depth``
+    flag (worker.AdaptiveDepth).  An explicit integer pins the depth;
+    1 is the serial fallback (one connection, synchronous completes).
 
     Crash surfacing matches the serial loop: a processing failure
     fails the aborted unit AND every queued lease, then re-raises;
@@ -818,10 +1124,14 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
     ``rpc`` / ``warmup`` / ``sweep`` spans, which ship back inside the
     complete (or fail) message -- the coordinator's flight recorder
     then holds the unit's WHOLE lifecycle across every host that
-    touched it.  ``DPRF_JAX_PROFILE=<dir>`` additionally wraps the
-    loop in a jax.profiler trace.
+    touched it.  When an operator ARMS a trace pull (op_trace_pull),
+    the lease response's ``pull`` epoch bumps and this loop ships its
+    whole LOCAL ring back once via op_trace_push.
+    ``DPRF_JAX_PROFILE=<dir>`` additionally wraps the loop in a
+    jax.profiler trace.
     """
-    from dprf_tpu.runtime.worker import UnitPipeline, pipeline_depth
+    from dprf_tpu.runtime.worker import (AdaptiveDepth, UnitPipeline,
+                                         pipeline_depth)
 
     m = get_registry(registry)
     tracer = get_tracer(recorder)
@@ -831,22 +1141,28 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
     # declaration site (tools/check_metrics.py) -- so names and labels
     # can never drift from the coordinator's
     jm = declare_job_metrics(m)
-    eng_name = getattr(getattr(worker, "engine", None), "name", "unknown")
-    device = "cpu" if type(worker).__name__ == "CpuWorker" else "jax"
+
+    def _labels_of(w) -> tuple:
+        return (getattr(getattr(w, "engine", None), "name", "unknown"),
+                "cpu" if type(w).__name__ == "CpuWorker" else "jax")
+
     m_cands = jm["cands"]
     h_unit = jm["unit_seconds"]
     g_depth = m.gauge(
         "dprf_worker_pipeline_depth",
         "units this worker submits ahead of the oldest unresolved one "
-        "(1 = serial loop)")
+        "(1 = serial loop; adapted to rtt/unit-seconds under the "
+        "DPRF_PIPELINE_DEPTH cap unless pinned)")
     c_idle = m.counter(
         "dprf_worker_idle_seconds",
         "seconds this worker held no submitted unit between sweeps "
         "(pipeline drained: the device idles while RPCs fly)")
+    adaptive = None
     if depth is None:
-        depth = pipeline_depth()
+        adaptive = AdaptiveDepth(pipeline_depth())
+        depth = adaptive.depth
     sender = None
-    if depth > 1:
+    if depth > 1 or (adaptive is not None and adaptive.cap > 1):
         try:
             sender = _CompletionSender(client.clone())
         except (OSError, RpcError) as e:
@@ -854,6 +1170,7 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                 log.warn("completion-sender connection failed; "
                          "running the serial loop", error=str(e))
             depth = 1
+            adaptive = None
     g_depth.set(depth)
     pipe = UnitPipeline(worker, depth)
     done_units = 0
@@ -863,6 +1180,12 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
     warm_pending = getattr(worker, "ensure_warm", None) is not None
     cur = None        # entry being submitted/resolved, for the fail path
     lease_q: list = []    # leased-but-not-yet-submitted batch remainder
+    pull_seen = 0     # last trace-pull epoch this worker answered
+
+    def _worker_of(job_id):
+        if worker_for is None or job_id is None:
+            return worker
+        return worker_for(job_id)
 
     def send_report(op: str, **kw) -> Optional[dict]:
         if sender is not None:
@@ -870,12 +1193,23 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
             return None
         return client.call(op, clock=time.time(), **kw)
 
-    def send_fail(unit_id: int, ship: list) -> None:
+    def send_fail(unit_id: int, ship: list, job=None) -> None:
         try:
             send_report("fail", unit_id=unit_id, worker_id=worker_id,
-                        spans=ship)
+                        spans=ship, job=job)
         except Exception:   # noqa: BLE001 -- best-effort, as serial
             pass            # (the lease expires and reissues anyway)
+
+    def push_ring() -> None:
+        # an operator armed a fleet-wide trace pull: ship this
+        # worker's local flight recorder (spans that never rode a
+        # complete/fail) on the MAIN connection, best-effort
+        try:
+            client.call("trace_push", clock=time.time(),
+                        worker_id=worker_id,
+                        spans=tracer.tail(TRACE_PUSH_MAX))
+        except Exception:   # noqa: BLE001 -- diagnostics only
+            pass
 
     try:
         with jax_profile_ctx(log=log):
@@ -884,6 +1218,13 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                     # the coordinator stopped answering completion
                     # reports: surface it like a serial complete would
                     raise sender.error
+                if adaptive is not None:
+                    # adaptive lease-ahead: re-derive the live depth
+                    # from the rtt/unit EWMAs under the env-knob cap
+                    new_depth = adaptive.update()
+                    if new_depth != pipe.depth:
+                        pipe.depth = new_depth
+                        g_depth.set(new_depth)
                 want = pipe.depth - len(pipe)
                 entries = []
                 if want > 0 and not stop_seen:
@@ -909,6 +1250,12 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                             "reported hits repeatedly failed oracle "
                             "verification (divergent device path?)")
                     lease_rtt = time.monotonic() - t_lease
+                    if adaptive is not None:
+                        adaptive.observe_rtt(lease_rtt)
+                    pull = resp.get("pull")
+                    if isinstance(pull, int) and pull > pull_seen:
+                        pull_seen = pull
+                        push_ring()
                     entries = resp.get("units")
                     if entries is None:
                         # pre-lease-ahead coordinator: single unit with
@@ -939,8 +1286,11 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                     lease_q = list(entries)
                     while lease_q:
                         unit_d = lease_q.pop(0)
+                        job = unit_d.get("job")
                         unit = WorkUnit(unit_d["id"], unit_d["start"],
-                                        unit_d["length"])
+                                        unit_d["length"],
+                                        job_id=str(job) if job
+                                        is not None else "j0")
                         ctx = unit_d.get("trace") or {}
                         tid, lease_sid = ctx.get("trace"), ctx.get("span")
                         ship: list = []
@@ -952,21 +1302,37 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                                 "rpc", dur=lease_rtt, trace=tid,
                                 parent=lease_sid, proc=worker_id,
                                 op="lease", unit=unit.unit_id,
-                                units=len(entries))
+                                job=job, units=len(entries))
                             if ev:
                                 ship.append(ev)
+                        # resolve the unit's JOB to its worker (the
+                        # factory path may rebuild a job from
+                        # op_job_status).  None = this job cannot run
+                        # on THIS host (missing wordlist, divergent
+                        # fingerprint): release the lease in-band and
+                        # keep serving every other job -- one bad
+                        # submission must not take down the fleet
+                        # (its units park after the retry budget).
+                        # cur is set BEFORE the call so an unexpected
+                        # factory crash still releases the lease.
                         cur = (unit, None, time.monotonic(),
-                               (tid, lease_sid, ship))
+                               (tid, lease_sid, ship, job, worker))
+                        w = _worker_of(job)
+                        if w is None:
+                            send_fail(unit.unit_id, ship, job=job)
+                            cur = None
+                            continue
+                        cur = (unit, None, cur[2],
+                               (tid, lease_sid, ship, job, w))
                         # join an overlapped warmup (cli.cmd_worker
                         # starts one before the loop, so the compile
                         # overlapped the lease round trip); under the
                         # fail path so a compile failure releases the
                         # lease like any processing failure
-                        ensure_warm = getattr(worker, "ensure_warm",
-                                              None)
+                        ensure_warm = getattr(w, "ensure_warm", None)
                         if ensure_warm is not None:
                             ensure_warm()
-                        if warm_pending:
+                        if warm_pending and w is worker:
                             # the compile ran overlapped on a background
                             # thread; report its REAL cost
                             # (compile_seconds), not the near-zero join
@@ -979,7 +1345,8 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                                 ev = tracer.record(
                                     "warmup", dur=float(warm_s),
                                     trace=tid, parent=lease_sid,
-                                    proc=worker_id, engine=eng_name,
+                                    proc=worker_id,
+                                    engine=_labels_of(worker)[0],
                                     cache=getattr(worker,
                                                   "compile_cache",
                                                   None),
@@ -992,14 +1359,17 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                             # work to hide them behind)
                             c_idle.inc(time.monotonic() - idle_mark)
                             idle_mark = None
-                        pipe.submit(unit, meta=(tid, lease_sid, ship))
+                        pipe.submit(unit,
+                                    meta=(tid, lease_sid, ship, job, w),
+                                    worker=w)
                         cur = None
                 if len(pipe) == 0:
                     if stop_seen:
                         break
                     continue
                 cur = pipe.pop()
-                unit, pending, t_submit, (tid, lease_sid, ship) = cur
+                unit, pending, t_submit, \
+                    (tid, lease_sid, ship, job, w) = cur
                 hits = pending.resolve()
                 cur = None
                 now = time.monotonic()
@@ -1021,17 +1391,21 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                 if len(pipe) == 0:
                     idle_mark = now
                     t_last_resolve = None
+                if adaptive is not None:
+                    adaptive.observe_unit(elapsed_report)
                 # the histogram gets the same per-unit cost: observing
                 # unit_s here would inflate dprf_unit_seconds ~depth x
                 # under pipelining with no throughput change
                 h_unit.observe(elapsed_report)
+                eng_name, device = _labels_of(w)
                 m_cands.inc(unit.length, engine=eng_name, device=device)
                 # ts backdates to t_submit, so consecutive sweep spans
                 # OVERLAP when the loop pipelines (the invariant
                 # tools/trace_overlap.py checks)
                 ev = tracer.record("sweep", dur=unit_s, trace=tid,
                                    parent=lease_sid, proc=worker_id,
-                                   unit=unit.unit_id, length=unit.length,
+                                   unit=unit.unit_id, job=job,
+                                   length=unit.length,
                                    hits=len(hits))
                 if ev:
                     ship.append(ev)
@@ -1041,11 +1415,13 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                            for h in hits]
                 # elapsed rides the complete report: the coordinator's
                 # adaptive unit sizer turns it into this worker's next
-                # unit length; spans stitch the attempt onto the
+                # unit length; the job id routes it to the right
+                # ledger; spans stitch the attempt onto the
                 # coordinator's flight recorder
                 resp = send_report("complete", unit_id=unit.unit_id,
                                    hits=payload, worker_id=worker_id,
-                                   elapsed=elapsed_report, spans=ship)
+                                   elapsed=elapsed_report, spans=ship,
+                                   job=job)
                 done_units += 1
                 if log and hits:
                     log.info("hits reported", count=len(hits))
@@ -1066,21 +1442,21 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
             # the aborted attempt still joins the timeline: ship what
             # we have with the fail report, then release the lease (and
             # every still-queued one) for another worker
-            unit, _, t_unit, (tid, lease_sid, ship) = cur
+            unit, _, t_unit, (tid, lease_sid, ship, job, _w) = cur
             ev = tracer.record("sweep",
                                dur=time.monotonic() - t_unit,
                                trace=tid, parent=lease_sid,
                                proc=worker_id, unit=unit.unit_id,
-                               error=type(e).__name__)
+                               job=job, error=type(e).__name__)
             if ev:
                 ship.append(ev)
-            send_fail(unit.unit_id, ship)
+            send_fail(unit.unit_id, ship, job=job)
         for q_unit, _, _, meta in pipe.drain():
-            send_fail(q_unit.unit_id, meta[2])
+            send_fail(q_unit.unit_id, meta[2], job=meta[3])
         for unit_d in lease_q:
             # leased but never submitted (the batch aborted first):
             # release these too, or they pin the ledger until expiry
-            send_fail(unit_d["id"], [])
+            send_fail(unit_d["id"], [], job=unit_d.get("job"))
         if sender is not None:
             try:
                 sender._q.join()   # land the fails; the original

@@ -12,6 +12,17 @@ pure functions of their index range, so the journal is just:
       autotune result) -- a resumed job reuses the recorded batch even
       when the machine's persistent tune cache is gone
 
+Multi-tenant serve plane (ISSUE 8): a coordinator carries MANY jobs,
+so the journal grew per-job records.  ``units`` and ``hit`` lines may
+carry a ``"job": "<id>"`` tag; untagged lines belong to the DEFAULT
+job (the one in the header) -- full backward compatibility with
+single-job journals.  Scheduler-submitted jobs add:
+
+  {"type": "job", "id": j, "spec": {...}, "owner": o, "priority": p,
+   "quota": q, "rate": r}                    a submitted job's identity
+  {"type": "job_state", "id": j, "state": s} pause/cancel survives
+                                             a coordinator restart
+
 Coverage is re-snapshotted (merged intervals) every `snapshot_every`
 completions, so the file stays small and resume cost is O(intervals),
 not O(units run).
@@ -31,6 +42,11 @@ class SessionState:
     completed: list          # [(start, end), ...]
     hits: list               # [{"target": int, "index": int, "plaintext": str}]
     tuning: dict = dataclasses.field(default_factory=dict)  # key -> record
+    #: scheduler-submitted jobs (multi-tenant serve plane), by id:
+    #: {"spec", "owner", "priority", "quota", "rate", "state",
+    #:  "completed", "hits"} -- the DEFAULT job stays in the flat
+    #: fields above, exactly as single-job journals always read
+    jobs: dict = dataclasses.field(default_factory=dict)
 
 
 #: `dprf check` threads analyzer: the journal stream is owned by the
@@ -45,7 +61,7 @@ class SessionJournal:
     def __init__(self, path: str, snapshot_every: int = 64):
         self.path = path
         self.snapshot_every = snapshot_every
-        self._since_snapshot = 0
+        self._since_snapshot: dict = {}   # job id (None=default) -> n
         self._fh = None
         self._pending: list = []   # records queued before open()
 
@@ -83,21 +99,51 @@ class SessionJournal:
         self._fh.flush()
         os.fsync(self._fh.fileno())
 
-    def record_units(self, intervals: list) -> None:
-        self._since_snapshot += 1
-        if self._since_snapshot >= self.snapshot_every:
-            self._since_snapshot = 0
-            self._emit({"type": "units",
-                        "intervals": [[s, e] for s, e in intervals]})
+    @staticmethod
+    def _tag(obj: dict, job: Optional[str]) -> dict:
+        if job is not None:
+            obj["job"] = job
+        return obj
 
-    def snapshot(self, intervals: list) -> None:
-        self._emit({"type": "units",
-                    "intervals": [[s, e] for s, e in intervals]})
+    def record_units(self, intervals: list,
+                     job: Optional[str] = None) -> None:
+        # the snapshot counter is PER JOB: with one shared counter, a
+        # job whose completions never land on the threshold crossing
+        # would go unjournaled until shutdown -- a crash would lose
+        # its whole coverage
+        n = self._since_snapshot.get(job, 0) + 1
+        if n >= self.snapshot_every:
+            self._since_snapshot[job] = 0
+            self.snapshot(intervals, job=job)
+        else:
+            self._since_snapshot[job] = n
+
+    def snapshot(self, intervals: list,
+                 job: Optional[str] = None) -> None:
+        self._emit(self._tag(
+            {"type": "units",
+             "intervals": [[s, e] for s, e in intervals]}, job))
 
     def record_hit(self, target_index: int, cand_index: int,
-                   plaintext: bytes) -> None:
-        self._emit({"type": "hit", "target": target_index,
-                    "index": cand_index, "plaintext": plaintext.hex()})
+                   plaintext: bytes, job: Optional[str] = None) -> None:
+        self._emit(self._tag(
+            {"type": "hit", "target": target_index,
+             "index": cand_index, "plaintext": plaintext.hex()}, job))
+
+    def record_job(self, job_id: str, spec: dict, owner: str = "?",
+                   priority: int = 1, quota=None, rate=None) -> None:
+        """Journal a scheduler-submitted job's identity so a
+        coordinator restart can rebuild its ledger (jobs/build.py
+        restore_jobs)."""
+        self._emit({"type": "job", "id": job_id, "spec": spec,
+                    "owner": owner, "priority": priority,
+                    "quota": quota, "rate": rate})
+
+    def record_job_state(self, job_id: str, state: str) -> None:
+        """Journal a job-state transition (pause/cancel) -- an
+        operator's cancel must survive the restart, or the job would
+        silently resume sweeping."""
+        self._emit({"type": "job_state", "id": job_id, "state": state})
 
     def record_tuning(self, key: str, record: dict) -> None:
         """Journal a tuning decision (tune.make_key -> result record).
@@ -122,6 +168,14 @@ class SessionJournal:
         if not os.path.exists(path):
             return None
         spec, completed, hits, tuning = {}, [], [], {}
+        jobs: dict = {}
+
+        def job_rec(jid: str) -> dict:
+            return jobs.setdefault(jid, {
+                "spec": None, "owner": "?", "priority": 1,
+                "quota": None, "rate": None, "state": None,
+                "completed": [], "hits": []})
+
         with open(path, encoding="utf-8") as fh:
             for line in fh:
                 line = line.strip()
@@ -132,19 +186,43 @@ class SessionJournal:
                 except json.JSONDecodeError:
                     continue   # torn tail write from a killed run
                 t = obj.get("type")
+                jid = obj.get("job")
                 if t == "header":
                     spec = obj["spec"]
                 elif t == "units":
-                    completed = [(s, e) for s, e in obj["intervals"]]
+                    iv = [(s, e) for s, e in obj["intervals"]]
+                    if jid is None:
+                        completed = iv
+                    else:
+                        job_rec(str(jid))["completed"] = iv
                 elif t == "hit":
-                    hits.append(obj)
+                    if jid is None:
+                        hits.append(obj)
+                    else:
+                        job_rec(str(jid))["hits"].append(obj)
+                elif t == "job":
+                    try:
+                        r = job_rec(str(obj["id"]))
+                        r["spec"] = dict(obj["spec"])
+                        r["owner"] = str(obj.get("owner", "?"))
+                        r["priority"] = int(obj.get("priority") or 1)
+                        r["quota"] = obj.get("quota")
+                        r["rate"] = obj.get("rate")
+                    except (KeyError, TypeError, ValueError):
+                        continue    # malformed job line: ignore
+                elif t == "job_state":
+                    try:
+                        job_rec(str(obj["id"]))["state"] = \
+                            str(obj["state"])
+                    except (KeyError, TypeError):
+                        continue
                 elif t == "tune":
                     try:
                         tuning[str(obj["key"])] = dict(obj["record"])
                     except (KeyError, TypeError, ValueError):
                         continue    # malformed tune line: ignore
         return SessionState(spec=spec, completed=completed, hits=hits,
-                            tuning=tuning)
+                            tuning=tuning, jobs=jobs)
 
 
 def job_fingerprint(engine: str, attack: str, keyspace: int,

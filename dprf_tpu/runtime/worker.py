@@ -91,16 +91,69 @@ PIPELINE_DEPTH_ENV = "DPRF_PIPELINE_DEPTH"
 
 
 def pipeline_depth(default: int = 2) -> int:
-    """Units submitted ahead of the oldest unresolved one -- the ONE
-    resolution site for the depth knob shared by Coordinator.run and
-    rpc.worker_loop.  ``DPRF_PIPELINE_DEPTH`` overrides (1 = serial
-    fallback: no overlap, no async completion); clamped to [1, 64] --
-    depth 2 already overlaps one unit's readback latency with the next
-    unit's compute, deeper queues just hold more leases without hiding
-    more."""
+    """The depth CAP shared by Coordinator.run and rpc.worker_loop --
+    the ONE resolution site for the knob.  ``DPRF_PIPELINE_DEPTH``
+    overrides (1 = serial fallback: no overlap, no async completion);
+    clamped to [1, 64].  The local loop runs AT this depth; the remote
+    loop ADAPTS its live depth to the measured RTT / unit-seconds
+    ratio below it (AdaptiveDepth) -- the knob bounds how many leases
+    one worker may queue, it no longer pins the working depth."""
     from dprf_tpu.utils import env as envreg
     return max(1, min(envreg.get_int(PIPELINE_DEPTH_ENV, int(default)),
                       64))
+
+
+class AdaptiveDepth:
+    """RTT-adaptive submit-ahead depth for the remote worker loop.
+
+    The right depth is a physics answer, not a config answer: to keep
+    the device stream full, a worker must hold enough units that the
+    lease/complete round trips hide behind compute -- about
+    ``1 + rtt/unit_seconds`` units.  A static depth (the old
+    ``DPRF_PIPELINE_DEPTH`` semantics) over-leases on fat links
+    (units sit idle in one worker's queue while another starves) and
+    under-leases on thin ones.  This tracker keeps EWMAs of both
+    quantities (same smoothing idea as tune.AdaptiveUnitSizer) and
+    derives the live depth each loop iteration; the env knob / CLI
+    flag remains as the CAP.
+
+    Until both signals exist the depth stays at ``start`` (2: enough
+    to overlap one round trip -- the pre-adaptive default)."""
+
+    __slots__ = ("cap", "depth", "alpha", "_rtt", "_unit")
+
+    def __init__(self, cap: int, start: int = 2, alpha: float = 0.3):
+        self.cap = max(1, int(cap))
+        self.depth = max(1, min(int(start), self.cap))
+        self.alpha = alpha
+        self._rtt: Optional[float] = None
+        self._unit: Optional[float] = None
+
+    def _ewma(self, cur: Optional[float], sample: float) -> float:
+        if cur is None:
+            return sample
+        return cur + self.alpha * (sample - cur)
+
+    def observe_rtt(self, seconds: float) -> None:
+        if seconds > 0:
+            self._rtt = self._ewma(self._rtt, seconds)
+
+    def observe_unit(self, seconds: float) -> None:
+        if seconds > 0:
+            self._unit = self._ewma(self._unit, seconds)
+
+    def update(self) -> int:
+        """Recompute and return the live depth (monotonic per call,
+        moves at most one step at a time: a single glitched sample
+        must not swing a fleet's lease holdings)."""
+        if self._rtt is not None and self._unit is not None:
+            want = 1 + int(-(-self._rtt // max(self._unit, 1e-9)))
+            want = max(1, min(want, self.cap))
+            if want > self.depth:
+                self.depth += 1
+            elif want < self.depth:
+                self.depth -= 1
+        return self.depth
 
 
 class UnitPipeline:
@@ -126,12 +179,16 @@ class UnitPipeline:
     def full(self) -> bool:
         return len(self._q) >= self.depth
 
-    def submit(self, unit, meta=None) -> None:
+    def submit(self, unit, meta=None, worker=None) -> None:
         """Dispatch the unit's device work now (enqueue-only for
         submit-based workers; a serial worker's process runs here) and
-        queue it for a later resolve."""
+        queue it for a later resolve.  ``worker`` overrides the
+        pipeline's default for THIS unit -- a multi-job worker loop
+        routes each unit to its job's worker while sharing one
+        submit-ahead queue."""
         import time
-        self._q.append((unit, submit_or_process(self.worker, unit),
+        self._q.append((unit,
+                        submit_or_process(worker or self.worker, unit),
                         time.monotonic(), meta))
 
     def pop(self):

@@ -21,10 +21,16 @@ class WorkUnit:
     unit_id: int
     start: int
     length: int
+    #: owning job (multi-tenant serve plane, jobs/scheduler.py): unit
+    #: ids are only unique WITHIN a job's ledger, so every lease,
+    #: complete, and journal record routes by (job_id, unit_id).  The
+    #: default matches the single-job Dispatcher's default ledger id.
+    job_id: str = "j0"
 
     @property
     def end(self) -> int:
         return self.start + self.length
 
     def __repr__(self) -> str:  # pragma: no cover
-        return f"WorkUnit({self.unit_id}: [{self.start}, {self.end}))"
+        return (f"WorkUnit({self.job_id}/{self.unit_id}: "
+                f"[{self.start}, {self.end}))")

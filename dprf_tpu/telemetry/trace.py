@@ -200,12 +200,15 @@ class TraceRecorder:
         return span
 
     def ingest(self, spans, proc: Optional[str] = None,
-               sent_at=None) -> int:
+               sent_at=None, limit: Optional[int] = None) -> int:
         """Fold REMOTE spans (shipped inside an RPC complete/fail
         message) into this recorder.  Client-controlled data, so
         sanitize hard: bounded count, declared span names only, scalar
         attrs, and ``proc`` forced to the server-known worker id when
         given -- a worker cannot impersonate another's timeline.
+        ``limit`` overrides the per-message span bound (the
+        ring-sized op_trace_push path); the per-unit default stays
+        MAX_INGEST_SPANS.
 
         ``sent_at`` is the sender's wall clock at send time: span
         timestamps are REBASED by (our now - sent_at), so a fleet
@@ -218,7 +221,8 @@ class TraceRecorder:
         if isinstance(sent_at, (int, float)):
             offset = self._clock() - float(sent_at)
         n = 0
-        for s in spans[:MAX_INGEST_SPANS]:
+        for s in spans[:limit if limit is not None
+                       else MAX_INGEST_SPANS]:
             if not isinstance(s, dict):
                 continue
             name = s.get("name")
@@ -357,6 +361,28 @@ class TraceRecorder:
             out = out[-n:]
             resync = True
         return [dict(s) for s in out], resync
+
+    def head_after(self, since: Optional[str], n: int = 200) -> tuple:
+        """Forward pager for a FULL ring dump (op_trace_pull): (up to
+        n spans recorded after span id ``since``, resync flag), oldest
+        first, starting at the ring's OLDEST span when ``since`` is
+        None.  Unlike ``tail_after`` -- which serves live follow and
+        clamps to the newest window -- an oversized remainder pages
+        from the front; the caller walks forward until a short page.
+        An unknown cursor (the ring wrapped past it) restarts from the
+        oldest with resync=True: the caller replaces its buffer."""
+        with self._lock:
+            items = list(self._ring)
+        idx = None
+        if since:
+            # scan from the new end: the cursor is usually near it
+            for i in range(len(items) - 1, -1, -1):
+                if items[i].get("span") == since:
+                    idx = i
+                    break
+        resync = since is not None and idx is None
+        out = items if idx is None else items[idx + 1:]
+        return [dict(s) for s in out[:max(1, int(n))]], resync
 
     def clear(self) -> None:
         with self._lock:
@@ -597,6 +623,24 @@ def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
     quarantined = status.get("quarantined") or []
     if quarantined:
         lines.append(f"quarantined workers: {', '.join(quarantined)}")
+    # per-job table (multi-tenant serve plane): one row per scheduler
+    # job once the coordinator holds more than the default job
+    jobs = status.get("jobs") or []
+    if len(jobs) > 1:
+        lines.append("")
+        lines.append(f"{'JOB':6s} {'OWNER':12s} {'PRIO':>4s} "
+                     f"{'STATE':10s} {'COVERED':>20s} {'FOUND':>7s} "
+                     f"{'OUT':>4s} {'LEASES':>7s}")
+        for j in jobs:
+            cov = f"{j.get('done', 0)}/{j.get('total', 0)}"
+            fnd = f"{j.get('found', 0)}/{j.get('targets', 0)}"
+            lines.append(
+                f"{str(j.get('id'))[:6]:6s} "
+                f"{str(j.get('owner'))[:12]:12s} "
+                f"{j.get('priority', 1):>4d} "
+                f"{str(j.get('state'))[:10]:10s} {cov:>20s} "
+                f"{fnd:>7s} {j.get('outstanding', 0):>4d} "
+                f"{j.get('leases', 0):>7d}")
     # per-worker table: current lease + the worker's most recent span
     last_span: dict = {}
     for s in spans:
@@ -615,7 +659,10 @@ def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
         lease = by_worker.get(w)
         s = last_span.get(w)
         state = s["name"] if s else ("sweep" if lease else "idle")
-        unit = f"#{lease['unit']}" if lease else "-"
+        # the unit column names the owning job too (unit ids are only
+        # unique within a job's ledger)
+        unit = (f"{lease.get('job', '?')}#{lease['unit']}"
+                if lease else "-")
         rng = (f"[{lease['start']},{lease['start'] + lease['length']})"
                if lease else "-")
         dl = _fmt_age(lease["deadline_s"]) if lease else "-"

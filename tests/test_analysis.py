@@ -693,6 +693,11 @@ def test_cli_json_and_exit_codes(tmp_path):
     assert sorted(payload["checks"]) == ["metrics", "worker-contract"]
     assert payload["findings"][0]["check"] == "worker-contract"
     assert payload["findings"][0]["line"] == 1
+    # per-analyzer wall time for the CI artifact (ISSUE 8 satellite)
+    assert sorted(payload["timings_s"]) == ["metrics",
+                                            "worker-contract"]
+    assert all(isinstance(v, float) and v >= 0
+               for v in payload["timings_s"].values())
 
     proc = subprocess.run(
         [sys.executable, "-m", "dprf_tpu.analysis", "--root", root,

@@ -362,6 +362,137 @@ def test_protocol_client_helper_response_read_caught(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# *args/**kwargs forwarding (ISSUE 8 satellite: the PR 7 gap --
+# positional names only -- closed by callgraph slots)
+
+def test_callgraph_forwarded_slots_map_star_and_keyword(tmp_path):
+    root = make_repo(tmp_path, {"dprf_tpu/f.py": """\
+        def wrapper(*args, **kwargs):
+            return inner(*args, **kwargs)
+
+        def inner(msg, extra=None):
+            return msg
+"""})
+    g, ctx = graph_for(root)
+    mod = g.load_file(os.path.join(root, "dprf_tpu", "f.py"))
+    wrapper = mod.functions["wrapper"]
+    inner = mod.functions["inner"]
+    # a positional arg past wrapper's (empty) param list lands in *args
+    assert cg.slot_at(wrapper, 0) == ("*", "args", 0)
+    # a keyword with no matching param lands in **kwargs
+    assert cg.slot_for_keyword(wrapper, "msg") == ("**", "kwargs",
+                                                   "msg")
+    s = g.summary(wrapper)
+    (callee, argspec, kwspec, _line), = s.calls
+    assert callee is inner
+    # *args element 0 forwarded through wrapper reaches inner's "msg"
+    assert cg.forwarded_slots(callee, argspec, kwspec,
+                              ("*", "args", 0)) == ["msg"]
+    # **kwargs entry "extra" reaches inner's keyword param
+    assert cg.forwarded_slots(callee, argspec, kwspec,
+                              ("**", "kwargs", "extra")) == ["extra"]
+    # an unknown kwargs entry resolves to nothing, not a guess
+    assert cg.forwarded_slots(callee, argspec, kwspec,
+                              ("**", "kwargs", "nope")) == []
+
+
+def test_protocol_star_forwarding_wrapper_key_caught(tmp_path):
+    # the handler launders msg through a *args/**kwargs wrapper; the
+    # eventual reader's undeclared key must still surface
+    root = make_repo(tmp_path, {"dprf_tpu/rpc.py": """\
+        class Server:
+            def op_lease(self, msg):
+                return fwd(msg)
+
+        def fwd(*args, **kwargs):
+            return handle(*args, **kwargs)
+
+        def handle(msg):
+            return {"unit": msg["worker_id"], "n": msg.get("ahead")}
+
+        class Client:
+            def call(self, op, **kw):
+                return {}
+
+            def go(self):
+                resp = self.call("lease", worker_id=3)
+                return resp["unit"]
+"""})
+    msgs = [x.message for x in bad(check(root, "protocol"))]
+    assert len(msgs) == 1, msgs
+    assert "reads request key 'ahead'" in msgs[0]
+
+
+def test_protocol_keyword_passed_dict_followed(tmp_path):
+    # msg handed on BY KEYWORD (helper(req=msg)) -- dropped entirely
+    # by the positional-names-only dataflow
+    root = make_repo(tmp_path, {"dprf_tpu/rpc.py": """\
+        class Server:
+            def op_lease(self, msg):
+                return handle(req=msg)
+
+        def handle(req=None):
+            return {"unit": req["worker_id"], "n": req["ahead"]}
+
+        class Client:
+            def call(self, op, **kw):
+                return {}
+
+            def go(self):
+                resp = self.call("lease", worker_id=3)
+                return resp["unit"]
+"""})
+    msgs = [x.message for x in bad(check(root, "protocol"))]
+    assert len(msgs) == 1, msgs
+    assert "reads request key 'ahead'" in msgs[0]
+
+
+def test_protocol_star_forwarding_clean_when_keys_sent(tmp_path):
+    # clean twin: every key the forwarded reader touches is sent
+    root = make_repo(tmp_path, {"dprf_tpu/rpc.py": """\
+        class Server:
+            def op_lease(self, msg):
+                return fwd(msg)
+
+        def fwd(*args, **kwargs):
+            return handle(*args, **kwargs)
+
+        def handle(msg):
+            return {"unit": msg["worker_id"], "n": msg.get("ahead")}
+
+        class Client:
+            def call(self, op, **kw):
+                return {}
+
+            def go(self):
+                resp = self.call("lease", worker_id=3, ahead=2)
+                return resp["unit"]
+"""})
+    assert bad(check(root, "protocol")) == []
+
+
+def test_locks_blocking_through_star_forwarding_wrapper_caught(
+        tmp_path):
+    # blocking facts survive a *args/**kwargs forwarding wrapper
+    root = make_repo(tmp_path, {"dprf_tpu/state.py": LOCKED_STATE + """\
+
+        def bump(self):
+            with self.lock:
+                self.count += 1
+                self._fwd(1, 2)
+
+        def _fwd(self, *args, **kwargs):
+            return self._slow(*args, **kwargs)
+
+        def _slow(self, a, b):
+            time.sleep(a + b)
+"""})
+    f = bad(check(root, "locks"))
+    assert len(f) == 1, [x.message for x in f]
+    assert "blocking" in f[0].message
+
+
+# ---------------------------------------------------------------------------
 # threads: lifecycle discipline
 
 def test_threads_unjoined_local_thread_caught(tmp_path):

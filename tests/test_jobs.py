@@ -1,0 +1,951 @@
+"""Multi-tenant serve plane (ISSUE 8): the JobScheduler's stride
+fair share, quota/rate limits and lifecycle; the per-job RPC surface
+(op_job_submit/list/status/cancel/pause, op_hits_pull); the two-job
+chaos test over a loopback fleet (fair-share interleave, zero
+cross-job hit leakage, exact per-job coverage, per-job trace labels);
+per-job session-journal resume after a coordinator restart; and the
+adaptive lease-ahead depth that replaced the static pipeline knob.
+"""
+
+import hashlib
+import json
+import threading
+import time
+
+import pytest
+
+from dprf_tpu.cli import main as cli_main
+from dprf_tpu.engines import get_engine
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.jobs import (CANCELLED, DONE, PAUSED, RUNNING,
+                           JobScheduler)
+from dprf_tpu.runtime.dispatcher import Dispatcher
+from dprf_tpu.runtime.rpc import (CoordinatorClient, CoordinatorServer,
+                                  CoordinatorState, worker_loop)
+from dprf_tpu.runtime.session import SessionJournal, job_fingerprint
+from dprf_tpu.runtime.worker import AdaptiveDepth, CpuWorker
+from dprf_tpu.telemetry.registry import MetricsRegistry
+from dprf_tpu.telemetry.trace import TraceRecorder
+
+#: the `jobs` marker selects the multi-tenant serve-plane tier
+#: (`pytest -m jobs`); everything here is loopback CPU work, so the
+#: whole file also rides the smoke tier under its wall-time budget
+pytestmark = [pytest.mark.smoke, pytest.mark.jobs]
+
+UNIT = 100
+KEYSPACE = 1000   # 10 units per job
+
+
+def _sched(reg=None, clock=None):
+    return JobScheduler(registry=reg or MetricsRegistry(),
+                        clock=clock)
+
+
+def _disp(reg, job_id="j0", keyspace=KEYSPACE, unit=UNIT, rec=None,
+          **kw):
+    return Dispatcher(keyspace, unit, registry=reg, job_id=job_id,
+                      recorder=rec, **kw)
+
+
+def _add(sched, reg, priority=1, keyspace=KEYSPACE, n_targets=1,
+         rec=None, **kw):
+    jid = sched.reserve_id()
+    d = _disp(reg, job_id=jid, keyspace=keyspace, rec=rec)
+    return sched.add({"engine": "md5"}, d, n_targets,
+                     priority=priority, job_id=jid, **kw)
+
+
+# ---------------------------------------------------------------------------
+# stride fair share
+
+def test_stride_fair_share_matches_weights_exactly():
+    reg = MetricsRegistry()
+    s = _sched(reg)
+    a = _add(s, reg, priority=3)
+    b = _add(s, reg, priority=1)
+    order = []
+    for _ in range(8):
+        for job, unit in s.lease_many("w0", 1):
+            order.append(job.job_id)
+            job.dispatcher.complete(unit.unit_id)
+    # deterministic stride: over any window the lease counts approach
+    # the 3:1 weight ratio exactly -- 6/2 in the first 8
+    assert order.count(a.job_id) == 6
+    assert order.count(b.job_id) == 2
+    assert a.leases == 6 and b.leases == 2
+
+
+def test_fair_share_holds_within_lease_ahead_batches():
+    reg = MetricsRegistry()
+    s = _sched(reg)
+    a = _add(s, reg, priority=2)
+    b = _add(s, reg, priority=1)
+    pairs = s.lease_many("w0", 6)
+    jids = [j.job_id for j, _ in pairs]
+    assert jids.count(a.job_id) == 4
+    assert jids.count(b.job_id) == 2
+
+
+def test_job_with_full_ledger_skipped_without_pass_penalty():
+    reg = MetricsRegistry()
+    s = _sched(reg)
+    a = _add(s, reg, priority=1, keyspace=UNIT)      # one unit only
+    b = _add(s, reg, priority=1)
+    # a's single unit goes out; its ledger is now fully outstanding
+    pairs = s.lease_many("w0", 5)
+    assert [j.job_id for j, _ in pairs].count(a.job_id) == 1
+    pass_before = a.pass_value
+    more = s.lease_many("w0", 3)
+    assert all(j.job_id == b.job_id for j, _ in more)
+    # no penalty accrued: a's pass did not advance while unleasable
+    assert a.pass_value == pass_before
+
+
+def test_late_submitted_job_starts_at_pass_frontier():
+    reg = MetricsRegistry()
+    s = _sched(reg)
+    a = _add(s, reg, priority=1)
+    for _ in range(6):
+        (job, unit), = s.lease_many("w0", 1)
+        job.dispatcher.complete(unit.unit_id)
+    b = _add(s, reg, priority=1)
+    assert b.pass_value == a.pass_value
+    # equal weights from here: the newcomer does NOT get a retroactive
+    # catch-up burst, it alternates
+    jids = [j.job_id for j, _ in s.lease_many("w0", 4)]
+    assert jids.count(b.job_id) == 2
+
+
+# ---------------------------------------------------------------------------
+# quota and lease-rate limits
+
+def test_quota_counts_outstanding_and_stops_leasing():
+    reg = MetricsRegistry()
+    s = _sched(reg)
+    a = _add(s, reg, quota=250)
+    pairs = s.lease_many("w0", 10)
+    # 3 units x 100 indices: a 4th would overshoot the 250 quota
+    # because outstanding indices count against it too
+    assert len(pairs) == 3
+    for job, unit in pairs:
+        s.complete(job, unit.unit_id)
+    assert a.state == DONE and a.done_reason == "quota reached"
+    assert s.lease_many("w0", 1) == []
+
+
+def test_rate_token_bucket_throttles_leases():
+    clock = [0.0]
+    reg = MetricsRegistry()
+    s = _sched(reg, clock=lambda: clock[0])
+    a = _add(s, reg, rate=2.0)
+    # one token in the bucket at t0
+    assert len(s.lease_many("w0", 5)) == 1
+    assert s.lease_many("w0", 5) == []
+    clock[0] = 1.0          # 1s -> 2 tokens refilled (rate 2/s)
+    pairs = s.lease_many("w0", 5)
+    assert len(pairs) == 2
+    assert a.leases == 3
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: pause / cancel / done
+
+def test_pause_blocks_leasing_but_outstanding_completes_land():
+    reg = MetricsRegistry()
+    s = _sched(reg)
+    a = _add(s, reg)
+    (job, unit), = s.lease_many("w0", 1)
+    s.pause(a.job_id)
+    assert a.state == PAUSED
+    assert s.lease_many("w0", 1) == []
+    # pause is not stop: the fleet keeps polling for a resume
+    assert not s.idle_stop()
+    assert s.complete(job, unit.unit_id)      # honestly leased: lands
+    assert a.covered() == UNIT
+    s.pause(a.job_id, resume=True)
+    assert a.state == RUNNING
+    assert len(s.lease_many("w0", 1)) == 1
+
+
+def test_cancel_mid_flight_drops_stale_completes_and_hits():
+    reg = MetricsRegistry()
+    s = _sched(reg)
+    a = _add(s, reg)
+    (job, unit), = s.lease_many("w0", 1)
+    s.cancel(a.job_id)
+    assert a.state == CANCELLED and a.done_reason == "cancelled"
+    # the in-flight unit was leased before the cancel: its report must
+    # not land coverage (or anything else)
+    assert s.complete(job, unit.unit_id) is False
+    assert a.covered() == 0
+    assert s.lease_many("w0", 1) == []
+    # cancelled jobs are excluded from aggregate progress
+    assert s.progress() == (0, 0)
+    assert s.all_finished()
+
+
+def test_done_reasons_targets_and_exhaustion():
+    reg = MetricsRegistry()
+    s = _sched(reg)
+    a = _add(s, reg, keyspace=2 * UNIT, n_targets=1)
+    b = _add(s, reg, keyspace=2 * UNIT, n_targets=1)
+    # a: crack the target before the keyspace ends
+    (job, unit), = s.lease_many("w0", 1)
+    assert job is a
+    s.record_hit(a, 0, 5, b"pw")
+    assert a.state == DONE and a.done_reason == "all targets found"
+    # b: sweep everything without a crack
+    while True:
+        pairs = s.lease_many("w0", 1)
+        if not pairs:
+            break
+        for j, u in pairs:
+            s.complete(j, u.unit_id)
+    assert b.state == DONE and b.done_reason == "keyspace exhausted"
+    assert s.all_finished() and s.idle_stop()
+
+
+def test_hit_buffer_cursor_and_dedupe():
+    reg = MetricsRegistry()
+    s = _sched(reg)
+    a = _add(s, reg, n_targets=2)
+    assert s.record_hit(a, 0, 11, b"x")
+    assert not s.record_hit(a, 0, 99, b"y")     # duplicate target
+    assert s.record_hit(a, 1, 22, b"z")
+    assert [h["seq"] for h in a.hits] == [0, 1]
+    assert a.hits[1]["plaintext"] == b"z".hex()
+    assert a.found == {0: b"x", 1: b"z"}
+
+
+def test_retry_parked_revives_done_job():
+    reg = MetricsRegistry()
+    s = _sched(reg)
+    jid = s.reserve_id()
+    d = Dispatcher(2 * UNIT, UNIT, registry=reg, job_id=jid,
+                   max_unit_retries=1)
+    a = s.add({"engine": "md5"}, d, 1, job_id=jid)
+    (j1, u1), = s.lease_many("w0", 1)
+    s.fail(j1, u1.unit_id)                      # parks (retry cap 1)
+    (j2, u2), = s.lease_many("w0", 1)
+    s.complete(j2, u2.unit_id)
+    assert a.state == DONE and d.parked_count() == 1
+    assert s.retry_parked() == 1
+    assert a.state == RUNNING                   # reachable again
+    (j3, u3), = s.lease_many("w0", 1)
+    s.complete(j3, u3.unit_id)
+    assert a.state == DONE and a.covered() == 2 * UNIT
+
+
+def test_job_table_cap_and_duplicate_ids_rejected():
+    reg = MetricsRegistry()
+    s = _sched(reg)
+    a = _add(s, reg)
+    with pytest.raises(ValueError):
+        s.add({"engine": "md5"}, _disp(reg, job_id=a.job_id), 1,
+              job_id=a.job_id)
+    s.MAX_JOBS = 1
+    with pytest.raises(ValueError):
+        _add(s, reg)
+
+
+# ---------------------------------------------------------------------------
+# adaptive lease-ahead depth (replaces the static DPRF_PIPELINE_DEPTH)
+
+def test_adaptive_depth_tracks_rtt_to_unit_ratio():
+    d = AdaptiveDepth(cap=8)
+    assert d.depth == 2                 # pre-signal default
+    d.observe_rtt(0.4)
+    d.observe_unit(0.1)                 # want 1 + ceil(4) = 5
+    steps = [d.update() for _ in range(5)]
+    assert steps == [3, 4, 5, 5, 5]     # one step per update, converges
+    # the link got fast / units got long: back off toward serial
+    for _ in range(30):
+        d.observe_rtt(0.001)
+        d.observe_unit(1.0)
+        d.update()
+    assert d.depth == 2                 # 1 + ceil(0.001) = 2
+
+
+def test_adaptive_depth_env_knob_is_the_cap():
+    d = AdaptiveDepth(cap=3)
+    d.observe_rtt(10.0)
+    d.observe_unit(0.01)                # wants ~1001, capped
+    for _ in range(10):
+        d.update()
+    assert d.depth == 3
+
+
+def test_adaptive_depth_without_signals_stays_put():
+    d = AdaptiveDepth(cap=8)
+    assert [d.update() for _ in range(3)] == [2, 2, 2]
+
+
+# ---------------------------------------------------------------------------
+# session journal: per-job records
+
+def test_journal_snapshot_cadence_is_per_job(tmp_path):
+    # a shared counter would let one job's completions starve another
+    # job's snapshots indefinitely (crash -> its coverage lost)
+    path = str(tmp_path / "cadence.session")
+    j = SessionJournal(path, snapshot_every=2)
+    j.open({"engine": "md5"})
+    j.record_units([(0, 100)])                   # default: 1 of 2
+    j.record_units([(0, 50)], job="j1")          # j1: 1 of 2
+    j.record_units([(0, 200)])                   # default: snapshots
+    j.record_units([(0, 150)], job="j1")         # j1: snapshots
+    j.close()
+    st = SessionJournal.load(path)
+    assert st.completed == [(0, 200)]
+    assert st.jobs["j1"]["completed"] == [(0, 150)]
+
+
+def test_journal_job_records_round_trip(tmp_path):
+    path = str(tmp_path / "s.session")
+    j = SessionJournal(path, snapshot_every=1)
+    j.open({"engine": "md5"})
+    j.record_units([(0, 300)])                       # default job
+    j.record_hit(0, 7, b"aa")
+    j.record_job("j1", {"engine": "md5", "attack": "mask"},
+                 owner="alice", priority=3, quota=500, rate=1.5)
+    j.record_units([(100, 500)], job="j1")
+    j.record_hit(1, 42, b"bb", job="j1")
+    j.record_job_state("j1", "paused")
+    j.close()
+    st = SessionJournal.load(path)
+    assert st.completed == [(0, 300)]                # untagged: default
+    assert [h["target"] for h in st.hits] == [0]
+    rec = st.jobs["j1"]
+    assert rec["owner"] == "alice" and rec["priority"] == 3
+    assert rec["quota"] == 500 and rec["rate"] == 1.5
+    assert rec["completed"] == [(100, 500)]
+    assert rec["hits"][0]["plaintext"] == b"bb".hex()
+    assert rec["state"] == "paused"
+
+
+# ---------------------------------------------------------------------------
+# the loopback fleet
+
+def _mask_job(mask, plants, unit_size=UNIT):
+    eng = get_engine("md5")
+    gen = MaskGenerator(mask)
+    targets = [eng.parse_target(hashlib.md5(p).hexdigest())
+               for p in plants]
+    fp = job_fingerprint("md5", f"mask:{mask}", gen.keyspace,
+                         [t.digest for t in targets])
+    job = {"engine": "md5", "attack": "mask", "attack_arg": mask,
+           "customs": {}, "rules": None, "max_len": None,
+           "targets": [t.raw for t in targets], "keyspace": gen.keyspace,
+           "unit_size": unit_size, "batch": 4096, "hit_cap": 8,
+           "fingerprint": fp}
+    return eng, gen, targets, job
+
+
+def _serve(job, gen, targets, priority=1, rec=None, reg=None, **kw):
+    reg = reg or MetricsRegistry()
+    rec = rec or TraceRecorder(registry=reg)
+    eng = get_engine(job["engine"])
+    disp = Dispatcher(gen.keyspace, job["unit_size"], registry=reg,
+                      recorder=rec, job_id="j0")
+    state = CoordinatorState(
+        job, disp, len(targets), registry=reg, recorder=rec,
+        priority=priority,
+        verifier=lambda ti, p: eng.verify(p, targets[ti]), **kw)
+    server = CoordinatorServer(state, "127.0.0.1", 0)
+    server.start_background()
+    return state, server, disp, rec, reg
+
+
+def _submit_spec(mask, plants, priority=1, **extra):
+    spec = {"engine": "md5", "attack": "mask", "attack_arg": mask,
+            "targets": [hashlib.md5(p).hexdigest() for p in plants],
+            "unit_size": UNIT, "unit_seconds": 0}
+    spec.update(extra)
+    return spec
+
+
+def _spec_worker(spec):
+    """cmd_worker's rebuild: engine + generator + CpuWorker from a
+    wire job spec."""
+    eng = get_engine(spec["engine"])
+    gen = MaskGenerator(spec["attack_arg"])
+    targets = [eng.parse_target(raw) for raw in spec["targets"]]
+    return CpuWorker(eng, gen, targets)
+
+
+def test_two_jobs_chaos_fair_share_coverage_and_no_leakage():
+    """The ISSUE 8 acceptance test: two tenants on one fleet --
+    fair-share lease interleave matching the 3:1 weights, exact
+    per-job coverage, per-job hit streams with zero cross-job
+    leakage, and per-job trace labels end to end."""
+    eng, gen, targets, job = _mask_job("?d?d?d", [b"999"])
+    state, server, disp, rec, reg = _serve(job, gen, targets,
+                                           priority=3)
+    try:
+        admin = CoordinatorClient(*server.address)
+        resp = admin.call("job_submit",
+                          spec=_submit_spec("?d?d?d", [b"998"]),
+                          owner="bob", priority=1)
+        jid_b = resp["job_id"]
+        assert resp["keyspace"] == KEYSPACE
+
+        hello = admin.call("hello", worker_id="setup")
+        workers = {hello["job_id"]: CpuWorker(eng, gen, targets)}
+
+        def worker_for(jid):
+            w = workers.get(jid)
+            if w is None:
+                spec = admin.call("job_status", job=jid)["spec"]
+                workers[jid] = w = _spec_worker(spec)
+            return w
+
+        client = CoordinatorClient(*server.address)
+        wrec = TraceRecorder(registry=MetricsRegistry())
+        done = worker_loop(client, workers[hello["job_id"]], "w0",
+                           idle_sleep=0.01, registry=MetricsRegistry(),
+                           recorder=wrec, worker_for=worker_for)
+        client.close()
+
+        # every unit of both jobs completed exactly once
+        assert done == 20
+        with state.lock:
+            sched = state.scheduler
+            a = sched.get("j0")
+            b = sched.get(jid_b)
+            assert a.dispatcher.completed_intervals() == [(0, KEYSPACE)]
+            assert b.dispatcher.completed_intervals() == [(0, KEYSPACE)]
+            assert a.state == DONE and b.state == DONE
+            # zero cross-job hit leakage: each job found ITS plant
+            assert a.found == {0: b"999"}
+            assert b.found == {0: b"998"}
+
+        # per-job hit streams: each tenant pulls only its own crack
+        ha = admin.call("hits_pull", job="j0")
+        hb = admin.call("hits_pull", job=jid_b)
+        assert [h["plaintext"] for h in ha["hits"]] == [b"999".hex()]
+        assert [h["plaintext"] for h in hb["hits"]] == [b"998".hex()]
+        assert ha["cursor"] == 1 and hb["state"] == DONE
+        # the cursor never re-reads
+        again = admin.call("hits_pull", job=jid_b, cursor=hb["cursor"])
+        assert again["hits"] == []
+
+        # fair-share interleave: lease order is the stride order
+        # (selection happens under the coordinator lock), so the
+        # first-window ratio matches the 3:1 weights within 20%
+        leases = [s for s in rec.tail(4096) if s["name"] == "lease"]
+        window = [s["attrs"]["job"] for s in leases[:8]]
+        n_a = window.count("j0")
+        n_b = window.count(jid_b)
+        assert n_b > 0 and 2.4 <= n_a / n_b <= 3.6, window
+
+        # per-job observability: every unit-lifecycle span (incl. the
+        # rpc/sweep spans the worker shipped back) names its job
+        spans = rec.tail(4096)
+        for s in spans:
+            if s["name"] in ("lease", "complete", "sweep", "rpc"):
+                assert s["attrs"].get("job") in ("j0", jid_b), s
+        assert {s["attrs"]["job"] for s in leases} == {"j0", jid_b}
+        admin.close()
+    finally:
+        server.shutdown()
+
+
+def test_job_cancel_mid_flight_over_rpc_drops_report():
+    eng, gen, targets, job = _mask_job("?d?d?d", [b"999"])
+    state, server, disp, rec, reg = _serve(job, gen, targets)
+    try:
+        admin = CoordinatorClient(*server.address)
+        jid = admin.call("job_submit",
+                         spec=_submit_spec("?d?d?d", [b"123"]),
+                         owner="eve")["job_id"]
+        w = CoordinatorClient(*server.address)
+        # drain default-job units until a unit of the new job arrives
+        unit = None
+        for _ in range(40):
+            resp = w.call("lease", worker_id="w1")
+            u = resp.get("unit")
+            if u is None:
+                break
+            if u["job"] == jid:
+                unit = u
+                break
+            w.call("complete", unit_id=u["id"], hits=[],
+                   worker_id="w1", job=u["job"])
+        assert unit is not None
+        admin.call("job_cancel", job=jid)
+        # the stale complete -- WITH the real crack -- must bounce
+        resp = w.call("complete", unit_id=unit["id"],
+                      hits=[{"target": 0, "cand": 123,
+                             "plaintext": b"123".hex()}],
+                      worker_id="w1", job=jid)
+        assert resp.get("dropped") is True
+        with state.lock:
+            b = state.scheduler.get(jid)
+            assert b.found == {} and b.covered() == 0
+            assert b.state == CANCELLED
+        # no further leases from the cancelled job
+        resp = w.call("lease", worker_id="w1", ahead=8)
+        assert all(e["job"] != jid for e in resp.get("units") or ())
+        w.close()
+        admin.close()
+    finally:
+        server.shutdown()
+
+
+def test_jobs_cli_round_trip_against_live_coordinator(tmp_path,
+                                                      capsys):
+    """`dprf jobs submit/list/status/pause/resume/cancel/hits` against
+    a real serving coordinator."""
+    eng, gen, targets, job = _mask_job("?d?d?d", [b"999"])
+    state, server, disp, rec, reg = _serve(job, gen, targets)
+    addr = "%s:%d" % server.address
+    try:
+        hashfile = tmp_path / "h.txt"
+        hashfile.write_text(hashlib.md5(b"424").hexdigest() + "\n")
+        rc = cli_main(["jobs", "submit", "?d?d?d", str(hashfile),
+                       "--engine", "md5", "--owner", "alice",
+                       "--priority", "2", "--quota", "800",
+                       "--unit-size", str(UNIT), "--unit-seconds", "0",
+                       "--connect", addr, "-q"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        jid = json.loads(out.strip().splitlines()[-1])["job"]
+
+        rc = cli_main(["jobs", "list", "--connect", addr, "-q"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        jobs = json.loads(out.strip().splitlines()[-1])
+        by_id = {j["id"]: j for j in jobs}
+        assert by_id[jid]["owner"] == "alice"
+        assert by_id[jid]["priority"] == 2
+        assert by_id[jid]["quota"] == 800
+        assert "j0" in by_id
+
+        rc = cli_main(["jobs", "pause", jid, "--connect", addr, "-q"])
+        out = capsys.readouterr().out
+        assert json.loads(out.strip().splitlines()[-1])["state"] \
+            == PAUSED
+        rc = cli_main(["jobs", "resume", jid, "--connect", addr, "-q"])
+        out = capsys.readouterr().out
+        assert json.loads(out.strip().splitlines()[-1])["state"] \
+            == RUNNING
+
+        # crack the submitted job's target, then pull its hits
+        w = CoordinatorClient(*server.address)
+        for _ in range(40):
+            resp = w.call("lease", worker_id="w1")
+            u = resp.get("unit")
+            if u is None:
+                break
+            hits = []
+            if u["job"] == jid and u["start"] <= 424 < u["start"] \
+                    + u["length"]:
+                hits = [{"target": 0, "cand": 424,
+                         "plaintext": b"424".hex()}]
+            w.call("complete", unit_id=u["id"], hits=hits,
+                   worker_id="w1", job=u["job"])
+        w.close()
+        rc = cli_main(["jobs", "hits", jid, "--connect", addr, "-q"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert f"{hashlib.md5(b'424').hexdigest()}:424" in out
+
+        rc = cli_main(["jobs", "status", jid, "--connect", addr,
+                       "-q"])
+        out = capsys.readouterr().out
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert summary["found"] == 1
+
+        # a terminal job stays terminal: cancelling the DONE job is a
+        # no-op, so round-trip cancel against a still-running one
+        rc = cli_main(["jobs", "cancel", jid, "--connect", addr,
+                       "-q"])
+        out = capsys.readouterr().out
+        assert json.loads(out.strip().splitlines()[-1])["state"] \
+            == DONE
+        hashfile2 = tmp_path / "h2.txt"
+        hashfile2.write_text(hashlib.md5(b"000").hexdigest() + "\n")
+        rc = cli_main(["jobs", "submit", "?d?d?d", str(hashfile2),
+                       "--engine", "md5", "--unit-size", str(UNIT),
+                       "--unit-seconds", "0", "--connect", addr,
+                       "-q"])
+        out = capsys.readouterr().out
+        jid2 = json.loads(out.strip().splitlines()[-1])["job"]
+        rc = cli_main(["jobs", "cancel", jid2, "--connect", addr,
+                       "-q"])
+        out = capsys.readouterr().out
+        assert json.loads(out.strip().splitlines()[-1])["state"] \
+            == CANCELLED
+        with state.lock:
+            assert state.scheduler.get(jid2).state == CANCELLED
+    finally:
+        server.shutdown()
+
+
+def test_unbuildable_job_fails_leases_without_killing_worker():
+    """A tenant submission this host cannot rebuild (worker_for ->
+    None: missing wordlist, divergent fingerprint) must not take the
+    worker down: its leases fail back in-band, the retry budget parks
+    its units, and every other job still completes."""
+    eng, gen, targets, job = _mask_job("?d?d?d", [b"999"])
+    state, server, disp, rec, reg = _serve(job, gen, targets)
+    try:
+        admin = CoordinatorClient(*server.address)
+        jid_b = admin.call("job_submit",
+                           spec=_submit_spec("?d?d?d", [b"123"]),
+                           owner="bob")["job_id"]
+        client = CoordinatorClient(*server.address)
+        done = worker_loop(client, CpuWorker(eng, gen, targets), "w0",
+                           idle_sleep=0.01,
+                           registry=MetricsRegistry(),
+                           recorder=TraceRecorder(
+                               registry=MetricsRegistry()),
+                           worker_for=lambda jid:
+                               CpuWorker(eng, gen, targets)
+                               if jid == "j0" else None)
+        client.close()
+        with state.lock:
+            a = state.scheduler.get("j0")
+            b = state.scheduler.get(jid_b)
+            # the buildable job swept to completion on this worker
+            assert a.dispatcher.completed_intervals() == [(0, KEYSPACE)]
+            assert a.found == {0: b"999"}
+            # the unbuildable one parked every unit, swept nothing
+            assert b.covered() == 0
+            assert b.dispatcher.parked_count() == KEYSPACE // UNIT
+            assert b.state == DONE
+        assert done == KEYSPACE // UNIT     # only j0's units resolved
+        admin.close()
+    finally:
+        server.shutdown()
+
+
+def test_job_table_full_rejected_before_build():
+    eng, gen, targets, job = _mask_job("?d?d", [b"42"])
+    state, server, disp, rec, reg = _serve(job, gen, targets)
+    try:
+        with state.lock:
+            state.scheduler.MAX_JOBS = 1     # the default job fills it
+        c = CoordinatorClient(*server.address)
+        from dprf_tpu.runtime.rpc import RpcError
+        with pytest.raises(RpcError, match="job table full"):
+            c.call("job_submit", spec=_submit_spec("?d?d", [b"11"]))
+        # the rejected id registered no per-job metric series
+        assert reg.get("dprf_keyspace_total").value(job="j1") == 0
+        c.close()
+    finally:
+        server.shutdown()
+
+
+def test_bad_job_submissions_rejected():
+    eng, gen, targets, job = _mask_job("?d?d", [b"42"])
+    state, server, disp, rec, reg = _serve(job, gen, targets)
+    try:
+        c = CoordinatorClient(*server.address)
+        from dprf_tpu.runtime.rpc import RpcError
+        for spec in (None, {}, {"engine": "md5", "attack": "mask",
+                               "attack_arg": "?d", "targets": []},
+                     {"engine": "nosuch-engine", "attack": "mask",
+                      "attack_arg": "?d", "targets": ["00" * 16]}):
+            with pytest.raises(RpcError):
+                c.call("job_submit", spec=spec)
+        # fingerprint disagreement (client claims a different build)
+        spec = _submit_spec("?d?d", [b"11"], fingerprint="bogus")
+        with pytest.raises(RpcError, match="fingerprint"):
+            c.call("job_submit", spec=spec)
+        with pytest.raises(RpcError, match="unknown job"):
+            c.call("job_status", job="j99")
+        with pytest.raises(RpcError, match="unknown job"):
+            c.call("hits_pull", job="j99")
+        c.close()
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# per-job resume after a coordinator restart
+
+def test_per_job_resume_exact_coverage_after_restart(tmp_path):
+    """Kill a multi-tenant coordinator mid-job; a restarted one
+    rebuilds every tenant's ledger from the journal and the fleet
+    finishes with exact per-job coverage and no re-sweep overlap."""
+    from dprf_tpu.jobs.build import restore_jobs
+
+    path = str(tmp_path / "mt.session")
+    session = SessionJournal(path, snapshot_every=1)
+    session.open({"engine": "md5"})
+    eng, gen, targets, job = _mask_job("?d?d?d", [b"999"])
+    state, server, disp, rec, reg = _serve(job, gen, targets)
+    # cmd_serve's journaling hooks, wired the same way
+    state.on_job_progress = lambda jid, iv: session.record_units(
+        iv, job=None if jid == state.default_job_id else jid)
+    state.on_job_hit = (
+        lambda j, ti, cand, plain: session.record_hit(
+            ti, cand, plain, job=j.job_id)
+        if j.job_id != state.default_job_id else None)
+    state.on_job_event = (
+        lambda kind, j: session.record_job(
+            j.job_id, j.spec, owner=j.owner, priority=j.priority,
+            quota=j.quota, rate=j.rate)
+        if kind == "submit"
+        else session.record_job_state(j.job_id, j.state))
+    try:
+        admin = CoordinatorClient(*server.address)
+        # b has TWO targets: one cracks pre-crash (journaled hit),
+        # the other only at the end of the keyspace -- so b is still
+        # RUNNING after the restore, not DONE-by-targets
+        jid_b = admin.call("job_submit",
+                           spec=_submit_spec("?d?d?d",
+                                             [b"111", b"999"]),
+                           owner="bob")["job_id"]
+        # c's plant is outside its mask space: it stays mid-flight
+        # (never DONE) so the cancel below hits a RUNNING job
+        jid_c = admin.call("job_submit",
+                           spec=_submit_spec("?d?d?d", [b"zzz"]),
+                           owner="carol")["job_id"]
+        # partial progress: a few units of each, B's crack lands
+        w = CoordinatorClient(*server.address)
+        swept = {"j0": 0, jid_b: 0, jid_c: 0}
+        for _ in range(9):
+            resp = w.call("lease", worker_id="w1")
+            u = resp["unit"]
+            hits = []
+            if u["job"] == jid_b \
+                    and u["start"] <= 111 < u["start"] + u["length"]:
+                hits = [{"target": 0, "cand": 111,
+                         "plaintext": b"111".hex()}]
+            w.call("complete", unit_id=u["id"], hits=hits,
+                   worker_id="w1", job=u["job"])
+            swept[u["job"]] += u["length"]
+        admin.call("job_cancel", job=jid_c)
+        with state.lock:
+            covered_b = state.scheduler.get(jid_b).covered()
+            assert state.scheduler.get(jid_b).found == {0: b"111"}
+        assert covered_b == swept[jid_b] > 0
+        w.close()
+        admin.close()
+    finally:
+        server.shutdown()        # the "crash"
+    session.close()
+
+    # -- restart: rebuild default job + tenants from the journal -----
+    prior = SessionJournal.load(path)
+    assert set(prior.jobs) == {jid_b, jid_c}
+    reg2 = MetricsRegistry()
+    rec2 = TraceRecorder(registry=reg2)
+    disp2 = Dispatcher.from_completed(gen.keyspace, UNIT,
+                                      prior.completed, registry=reg2,
+                                      recorder=rec2, job_id="j0")
+    state2 = CoordinatorState(
+        job, disp2, len(targets), registry=reg2, recorder=rec2,
+        verifier=lambda ti, p: eng.verify(p, targets[ti]))
+    state2.seed_found(prior.hits)
+    assert restore_jobs(state2, prior.jobs, log=None) == 2
+    server2 = CoordinatorServer(state2, "127.0.0.1", 0)
+    server2.start_background()
+    try:
+        with state2.lock:
+            b = state2.scheduler.get(jid_b)
+            c = state2.scheduler.get(jid_c)
+            # exact pre-crash coverage, restored hit, restored states
+            assert b.covered() == swept[jid_b]
+            assert b.found == {0: b"111"}
+            assert b.owner == "bob" and b.state == RUNNING
+            assert c.state == CANCELLED      # cancel survived restart
+        hb = CoordinatorClient(*server2.address).call(
+            "hits_pull", job=jid_b)
+        assert [h["plaintext"] for h in hb["hits"]] == [b"111".hex()]
+
+        # the fleet finishes the remainder; coverage is exact -- every
+        # index swept once, nothing re-swept, nothing lost
+        client = CoordinatorClient(*server2.address)
+        workers = {"j0": CpuWorker(eng, gen, targets)}
+
+        def worker_for(jid):
+            w2 = workers.get(jid)
+            if w2 is None:
+                with state2.lock:
+                    spec = state2.scheduler.get(jid).spec
+                workers[jid] = w2 = _spec_worker(spec)
+            return w2
+
+        done = worker_loop(client, workers["j0"], "w2",
+                           idle_sleep=0.01,
+                           registry=MetricsRegistry(),
+                           recorder=TraceRecorder(
+                               registry=MetricsRegistry()),
+                           worker_for=worker_for)
+        client.close()
+        with state2.lock:
+            a2 = state2.scheduler.get("j0")
+            b2 = state2.scheduler.get(jid_b)
+            assert a2.dispatcher.completed_intervals() \
+                == [(0, KEYSPACE)]
+            assert b2.dispatcher.completed_intervals() \
+                == [(0, KEYSPACE)]
+            assert a2.found == {0: b"999"}
+            assert b2.found == {0: b"111", 1: b"999"}
+            # resumed units only: restart + finish never re-sweeps
+            assert done * UNIT == 2 * KEYSPACE - swept["j0"] \
+                - swept[jid_b]
+    finally:
+        server2.shutdown()
+
+
+def test_top_view_groups_by_job():
+    """op_trace_tail ships per-job summaries and render_top shows the
+    admin view grouped by job."""
+    from dprf_tpu.telemetry.trace import render_top
+
+    eng, gen, targets, job = _mask_job("?d?d?d", [b"999"])
+    state, server, disp, rec, reg = _serve(job, gen, targets)
+    try:
+        c = CoordinatorClient(*server.address)
+        c.call("job_submit", spec=_submit_spec("?d?d?d", [b"777"]),
+               owner="alice", priority=2)
+        c.call("lease", worker_id="w0")
+        resp = c.call("trace_tail")
+        jobs = resp["status"]["jobs"]
+        assert {j["id"] for j in jobs} == {"j0", "j1"}
+        text = render_top(resp)
+        assert "JOB" in text and "alice" in text
+        # the worker table names the lease's owning job
+        assert "j0#" in text or "j1#" in text
+        c.close()
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder pull (op_trace_pull / op_trace_push)
+
+def test_trace_pull_pages_ring_and_arm_bumps_epoch():
+    eng, gen, targets, job = _mask_job("?d?d?d", [b"999"])
+    state, server, disp, rec, reg = _serve(job, gen, targets)
+    try:
+        c = CoordinatorClient(*server.address)
+        # some coordinator-side spans
+        for _ in range(5):
+            resp = c.call("lease", worker_id="w1")
+            c.call("complete", unit_id=resp["unit"]["id"], hits=[],
+                   worker_id="w1", job=resp["unit"]["job"])
+        r0 = c.call("trace_pull", n=4)
+        assert r0["epoch"] == 0
+        r1 = c.call("trace_pull", arm=True, n=4)
+        assert r1["epoch"] == 1
+        # lease responses now carry the bumped epoch
+        assert c.call("lease", worker_id="w1")["pull"] == 1
+        # cursor pagination covers the whole ring without overlap
+        spans, cursor = [], None
+        while True:
+            page = c.call("trace_pull", since=cursor, n=4)
+            got = page["spans"]
+            spans.extend(got)
+            cursor = page["cursor"]
+            if len(got) < 4:
+                break
+        ids = [s["span"] for s in spans]
+        assert len(ids) == len(set(ids))
+        assert len(ids) == len(rec.tail(4096))
+        c.close()
+    finally:
+        server.shutdown()
+
+
+def test_trace_push_ingests_worker_ring_sanitized():
+    eng, gen, targets, job = _mask_job("?d?d", [b"42"])
+    state, server, disp, rec, reg = _serve(job, gen, targets)
+    try:
+        c = CoordinatorClient(*server.address)
+        spans = [{"name": "sweep", "span": "s1", "trace": "t1",
+                  "ts": 1.0, "dur": 0.5, "proc": "liar", "unit": 1},
+                 {"name": "not-a-span", "span": "s2"}]
+        resp = c.call("trace_push", worker_id="w7", spans=spans,
+                      clock=time.time())
+        assert resp["ingested"] == 1      # undeclared name dropped
+        got = [s for s in rec.tail(100) if s.get("span") == "s1"]
+        # proc forced to the server-known worker id: no impersonation
+        assert got and got[0]["proc"] == "w7"
+        c.close()
+    finally:
+        server.shutdown()
+
+
+def test_worker_loop_ships_ring_when_pull_armed():
+    """The fleet-wide incident pull: arming bumps the lease epoch and
+    a polling worker ships its LOCAL ring via op_trace_push."""
+    eng, gen, targets, job = _mask_job("?d?d", [b"99"])
+    state, server, disp, rec, reg = _serve(job, gen, targets)
+    try:
+        admin = CoordinatorClient(*server.address)
+        # a second, PAUSED job keeps the worker polling after the
+        # default job drains (pause is not stop)
+        jid = admin.call("job_submit",
+                         spec=_submit_spec("?d?d", [b"11"]),
+                         owner="bob")["job_id"]
+        admin.call("job_pause", job=jid)
+
+        wrec = TraceRecorder(registry=MetricsRegistry())
+        client = CoordinatorClient(*server.address)
+        t = threading.Thread(
+            target=worker_loop,
+            args=(client, CpuWorker(eng, gen, targets), "w0"),
+            kwargs={"idle_sleep": 0.01,
+                    "registry": MetricsRegistry(), "recorder": wrec})
+        t.start()
+        # wait until the default job drained and the worker idles
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with state.lock:
+                if state.scheduler.get("j0").state == DONE:
+                    break
+            time.sleep(0.01)
+        # plant a marker span in the worker's LOCAL ring: it rode no
+        # complete message, only a push can deliver it
+        marker = wrec.record("warmup", dur=0.0, proc="w0",
+                             engine="md5-marker")
+        admin.call("trace_pull", arm=True)
+        mid = marker["span"]
+        found = None
+        while time.time() < deadline and found is None:
+            found = next((s for s in rec.tail(4096)
+                          if s.get("span") == mid), None)
+            time.sleep(0.02)
+        admin.call("job_cancel", job=jid)    # lets the worker stop
+        t.join(timeout=30)
+        assert not t.is_alive()
+        client.close()
+        assert found is not None, "armed pull never delivered the ring"
+        assert found["proc"] == "w0"
+        admin.close()
+    finally:
+        server.shutdown()
+
+
+def test_trace_pull_cli_writes_export_compatible_file(tmp_path,
+                                                      capsys):
+    """`dprf trace pull --connect` -> file -> `dprf trace export`."""
+    eng, gen, targets, job = _mask_job("?d?d?d", [b"999"])
+    state, server, disp, rec, reg = _serve(job, gen, targets)
+    addr = "%s:%d" % server.address
+    try:
+        c = CoordinatorClient(*server.address)
+        for _ in range(3):
+            resp = c.call("lease", worker_id="w1")
+            c.call("complete", unit_id=resp["unit"]["id"], hits=[],
+                   worker_id="w1", job=resp["unit"]["job"])
+        c.close()
+        out = str(tmp_path / "pulled.trace.jsonl")
+        rc = cli_main(["trace", "pull", "--connect", addr, "-o", out,
+                       "--no-arm", "-q"])
+        got = capsys.readouterr().out
+        assert rc == 0
+        info = json.loads(got.strip().splitlines()[-1])
+        assert info["spans"] == len(rec.tail(4096)) > 0
+        # the pulled stream feeds straight into trace export
+        perfetto = str(tmp_path / "out.json")
+        rc = cli_main(["trace", "export", out, "-o", perfetto, "-q"])
+        assert rc == 0
+        events = json.loads(open(perfetto).read())["traceEvents"]
+        assert any(e.get("ph") == "X" for e in events)
+    finally:
+        server.shutdown()

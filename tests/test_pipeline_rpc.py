@@ -333,7 +333,7 @@ def test_crashed_worker_with_two_leases_reissues_both_no_double_complete():
         assert late["ok"]
         assert disp.outstanding_unit(units[0]["id"]) is not None
         assert disp.progress()[0] == 0
-        assert reg.get("dprf_units_completed_total").value() == 0
+        assert reg.get("dprf_units_completed_total").value(job="j0") == 0
         crashed.close()
         # wB completes it for real, then sweeps the rest via the loop
         survivor.call("complete", unit_id=units[0]["id"], hits=[],
@@ -350,7 +350,7 @@ def test_crashed_worker_with_two_leases_reissues_both_no_double_complete():
         survivor.close()
         # exact coverage, each unit completed exactly once
         assert disp.completed_intervals() == [(0, keyspace)]
-        assert reg.get("dprf_units_completed_total").value() == 2
+        assert reg.get("dprf_units_completed_total").value(job="j0") == 2
         rep = lifecycle_report(rec.tail(1000))
         assert rep["traces"] == 2
         assert rep["orphans"] == 0

@@ -196,7 +196,8 @@ def test_dispatcher_poison_guard_parks_after_retry_cap():
     # 5th failure parks it: the range becomes unreachable this run
     assert d.parked_count() == 1
     assert d.parked_indices() == poisoned.length
-    assert m.counter("dprf_units_poisoned_total").value() == 1
+    assert m.counter("dprf_units_poisoned_total",
+                     labelnames=("job",)).value(job="j0") == 1
     # the rest of the keyspace still sweeps, and the job terminates
     u = d.lease("w1")
     assert (u.start, u.end) == (128, 256)
@@ -240,11 +241,13 @@ def test_dispatcher_retry_parked_requeues_with_fresh_budget():
     u = d.lease("w1")                   # rest of the keyspace done
     d.complete(u.unit_id)
     assert d.parked_count() == 1 and d.done() and not d.exhausted()
-    assert m.gauge("dprf_units_parked").value() == 1
+    assert m.gauge("dprf_units_parked",
+                   labelnames=("job",)).value(job="j0") == 1
 
     assert d.retry_parked() == 1
     assert d.parked_count() == 0 and d.parked_indices() == 0
-    assert m.gauge("dprf_units_parked").value() == 0
+    assert m.gauge("dprf_units_parked",
+                   labelnames=("job",)).value(job="j0") == 0
     assert not d.done()                 # the range is reachable again
     # fresh budget: the requeued unit survives max_unit_retries - 1
     # NEW failures before parking again (attempt count was reset)
@@ -256,10 +259,11 @@ def test_dispatcher_retry_parked_requeues_with_fresh_budget():
     assert d.exhausted()                # full honest coverage now
     assert d.retry_parked() == 0        # idempotent when nothing parked
     # the parking EVENT counter keeps history; reissue reason is logged
-    assert m.counter("dprf_units_poisoned_total").value() == 1
+    assert m.counter("dprf_units_poisoned_total",
+                     labelnames=("job",)).value(job="j0") == 1
     assert m.counter("dprf_units_reissued_total",
-                     labelnames=("reason",)).value(
-        reason="retry_parked") == 1
+                     labelnames=("reason", "job")).value(
+        reason="retry_parked", job="j0") == 1
 
 
 def test_rpc_retry_parked_admin_op():

@@ -227,9 +227,9 @@ def test_metrics_endpoint_and_counters_match_state():
 
         n_units = -(-gen.keyspace // 100)
         assert reg.get("dprf_hits_total").value() == len(state.found)
-        assert reg.get("dprf_units_completed_total").value() == n_units
-        assert reg.get("dprf_units_leased_total").value() == n_units
-        assert reg.get("dprf_keyspace_covered").value() == gen.keyspace
+        assert reg.get("dprf_units_completed_total").value(job="j0") == n_units
+        assert reg.get("dprf_units_leased_total").value(job="j0") == n_units
+        assert reg.get("dprf_keyspace_covered").value(job="j0") == gen.keyspace
         cands = reg.get("dprf_candidates_hashed_total")
         assert cands.value(engine="md5", device="cpu") == gen.keyspace
         # the coordinator ALSO attributes completed units (its registry
@@ -240,7 +240,8 @@ def test_metrics_endpoint_and_counters_match_state():
         # scrape over the SAME port the RPC protocol uses
         text = scrape_metrics(*server.address)
         assert "dprf_hits_total 2" in text
-        assert f"dprf_units_completed_total {n_units}" in text
+        assert ('dprf_units_completed_total{job="j0"} '
+                f"{n_units}") in text
         assert ('dprf_candidates_hashed_total{engine="md5",'
                 f'device="cpu"}} {gen.keyspace}') in text
         assert 'dprf_worker_last_seen_timestamp{worker="w0"}' in text
@@ -294,7 +295,7 @@ def test_metrics_endpoint_served_with_token_auth():
     server.start_background()
     try:
         text = scrape_metrics(*server.address)
-        assert "dprf_keyspace_total 10" in text
+        assert 'dprf_keyspace_total{job="j0"} 10' in text
         client = CoordinatorClient(*server.address)   # no token
         with pytest.raises(RpcError):
             client.hello()
@@ -330,7 +331,7 @@ def test_local_coordinator_publishes(tmp_path):
     assert reg.get("dprf_candidates_hashed_total").value(
         engine="md5", device="cpu") == result.tested
     assert reg.get("dprf_unit_seconds").count() == \
-        reg.get("dprf_units_completed_total").value()
+        reg.get("dprf_units_completed_total").value(job="j0")
     assert reg.get("dprf_targets_found").value() == 1
 
 
