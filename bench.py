@@ -406,7 +406,7 @@ def main() -> int:
         out["roofline_band_hs"] = [lo, hi]
     for k in ("impl", "device", "batch", "batches", "inner",
               "calibrate_hs", "elapsed_s", "compile_s", "note",
-              "compile_cold_s", "compile_warm_s"):
+              "compile_cold_s", "compile_warm_s", "phases"):
         if k in res:
             out[k] = res[k]
     # compile-cache classification (ISSUE 3): machine-checkable like

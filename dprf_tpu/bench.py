@@ -27,6 +27,7 @@ def _publish(result: dict, mode: str) -> dict:
     second observation would double every dprf_compile_seconds count
     and hit/miss counter a report like tools/compile_report.py sums."""
     from dprf_tpu.telemetry import DEFAULT as metrics
+    from dprf_tpu.telemetry import perf as perf_mod
     labels = dict(engine=result.get("engine", "?"),
                   impl=result.get("impl", mode),
                   device=result.get("device", "?"), mode=mode)
@@ -37,6 +38,24 @@ def _publish(result: dict, mode: str) -> dict:
                   ).set(result["value"], **labels)
     metrics.counter("dprf_bench_runs_total", "bench invocations",
                     labelnames=("mode",)).inc(mode=mode)
+    if mode == "scaling":
+        # multichip accounting: per-chip H/s + scaling efficiency
+        # next to the roofline gauge (ISSUE 9)
+        perf_mod.publish_scaling(result.get("engine", "?"),
+                                 float(result.get("per_chip") or 0.0),
+                                 float(result["value"]),
+                                 int(result.get("n_devices") or 1),
+                                 registry=metrics)
+    elif result.get("device") == "tpu":
+        # roofline distance is only meaningful on the real chip; the
+        # JSON carries the raw fraction, the gauge the smoothed one
+        frac = perf_mod.roofline_fraction(result.get("engine", "?"),
+                                          result["value"])
+        if frac is not None:
+            result.setdefault("roofline_frac", round(frac, 4))
+            perf_mod.publish_roofline(result["engine"],
+                                      result["value"],
+                                      registry=metrics)
     return result
 
 
@@ -180,6 +199,42 @@ def _build_mask_step(engine: str, eng, gen, impl: str, batch: int,
     return step, use_pallas, batch
 
 
+def _round_phases(phases: dict) -> dict:
+    return {k: round(v, 6) for k, v in phases.items()}
+
+
+def _step_phases(gen, step, batch: int) -> dict:
+    """Per-phase breakdown of ONE per-batch step dispatch with forced
+    sync boundaries (the bench-side analogue of the runtime's sampled
+    probe, telemetry/perf.py): generate / h2d / device / d2h.  One
+    dispatch outside the timed window -- the syncs that make the
+    attribution honest must never touch the measured loop."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    t = {}
+    t0 = time.perf_counter()
+    digits = np.asarray(gen.digits(0), dtype=np.int32)
+    t1 = time.perf_counter()
+    t["generate"] = t1 - t0
+    base = jax.device_put(digits)
+    nv = jnp.int32(batch)
+    jax.block_until_ready((base, nv))
+    t2 = time.perf_counter()
+    t["h2d"] = t2 - t1
+    out = step(base, nv)
+    jax.block_until_ready(out)
+    t3 = time.perf_counter()
+    t["device"] = t3 - t2
+    if isinstance(out, (tuple, list)):
+        for x in out:
+            np.asarray(x)
+    else:
+        np.asarray(out)
+    t["d2h"] = time.perf_counter() - t3
+    return _round_phases(t)
+
+
 def _timed_aot_compile(fn, *args):
     """Seconds to lower+compile `fn` at these args WITHOUT dispatching
     (None when the step cannot AOT-lower).  With the persistent cache
@@ -255,6 +310,9 @@ def run_bench(engine: str = "md5", device: str = "jax",
             fn2 = make_looped_step(step2, inner) if inner > 1 else step2
             warm_s = _timed_aot_compile(fn2, base0, jnp.int32(batch))
         compile_fields = _compile_fields(obs.cache, obs.seconds, warm_s)
+        # per-phase attribution of one production dispatch (outside
+        # the timed window; the step is already compiled)
+        phases = _step_phases(gen, step, batch)
         if log:
             log.info("bench compiled", seconds=f"{compile_s:.1f}",
                      cache=obs.cache)
@@ -281,6 +339,14 @@ def run_bench(engine: str = "md5", device: str = "jax",
         eng = get_engine(engine, device="cpu")
         n, elapsed = 0, 0.0
         chunk = min(batch, 1 << 14)
+        # coarse phase split for the oracle path: generation vs
+        # hashing of one chunk (no device, so no h2d/d2h)
+        tp = time.perf_counter()
+        cands = [c for c in gen.candidates(0, chunk) if c is not None]
+        tg = time.perf_counter()
+        eng.hash_batch(cands)
+        phases = _round_phases({"generate": tg - tp,
+                                "device": time.perf_counter() - tg})
         # fresh candidates per iteration: a real job pays generation too,
         # and re-hashing one hot-cached chunk would inflate the number
         t0 = time.perf_counter()
@@ -309,6 +375,7 @@ def run_bench(engine: str = "md5", device: str = "jax",
         "inner": inner,
         "elapsed_s": round(elapsed, 3),
         "compile_s": round(compile_s, 1),
+        "phases": phases,
         **compile_fields,
     }, mode="bench")
 
@@ -538,6 +605,13 @@ def run_config(config: int, device: str = "jax", seconds: float = 5.0,
         log.info("config compiled", config=config,
                  seconds=f"{compile_s:.1f}", cache=compile_cache)
 
+    # per-phase attribution of one stride through the REAL worker
+    # (telemetry/perf.py probe; outside the timed window, compiled
+    # already) -- bench JSON carries the breakdown
+    from dprf_tpu.telemetry.perf import probe_phases
+    phases = _round_phases(probe_phases(
+        worker, WorkUnit(-1, 0, min(stride, gen.keyspace))))
+
     from dprf_tpu.runtime.worker import submit_or_process
 
     tested = 0
@@ -585,5 +659,6 @@ def run_config(config: int, device: str = "jax", seconds: float = 5.0,
         "tested": tested,
         "elapsed_s": round(elapsed, 3),
         "compile_s": round(compile_s, 1),
+        "phases": phases,
         **_compile_fields(compile_cache, compile_s),
     }, mode="config")

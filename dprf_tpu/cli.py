@@ -188,6 +188,22 @@ def _build_parser() -> argparse.ArgumentParser:
                    "a high-latency link a 1-stride unit measures the "
                    "round trip, not the chip")
     b.add_argument("--profile", default=None, metavar="DIR")
+    b.add_argument("--gate", action="store_true",
+                   help="regression sentinel: gate this measurement "
+                   "against the committed BENCH_r*.json baseline "
+                   "window (median of the last K same-device "
+                   "records +/- their observed spread); the result "
+                   "JSON gains a 'gate' verdict and a regression "
+                   "exits non-zero")
+    b.add_argument("--gate-dry", action="store_true",
+                   help="no measurement: gate the NEWEST committed "
+                   "BENCH record against the window before it (the "
+                   "CI mode -- the trajectory audits itself)")
+    b.add_argument("--baseline-dir", default=None, metavar="DIR",
+                   help="directory holding BENCH_r*.json (default: "
+                   "this repo's root)")
+    b.add_argument("--gate-window", type=int, default=5, metavar="K",
+                   help="baseline records considered by --gate")
     b.add_argument("--quiet", "-q", action="store_true")
 
     tn = sub.add_parser("tune", help="autotune the device batch size "
@@ -426,6 +442,19 @@ def _build_parser() -> argparse.ArgumentParser:
                      "coordinator (default: $DPRF_TOKEN)")
     tpl.add_argument("--timeout", type=float, default=30.0)
     tpl.add_argument("--quiet", "-q", action="store_true")
+
+    rpt = sub.add_parser("report", help="one-shot performance report "
+                         "from session artifacts alone (trace JSONL "
+                         "+ telemetry snapshots + journal): "
+                         "throughput, phase breakdown p50/p95, busy "
+                         "fraction, compile-cache hit rate, pipeline "
+                         "depth, per-job fair share -- no live "
+                         "coordinator needed")
+    rpt.add_argument("session", help="session journal path")
+    rpt.add_argument("--json", action="store_true",
+                     help="machine-readable report on stdout instead "
+                     "of the text rendering")
+    rpt.add_argument("--quiet", "-q", action="store_true")
 
     mt = sub.add_parser("metrics", help="scrape a running coordinator's "
                         "/metrics endpoint (Prometheus text format)")
@@ -1196,6 +1225,10 @@ def cmd_serve(args, log: Log) -> int:
             session.record_job(job.job_id, job.spec, owner=job.owner,
                                priority=job.priority, quota=job.quota,
                                rate=job.rate)
+        elif kind == "gc":
+            # age-based reap (DPRF_JOB_TTL_S): restore must not
+            # resurrect the job
+            session.record_job_gc(job.job_id)
         else:
             session.record_job_state(job.job_id, job.state)
 
@@ -1385,6 +1418,22 @@ def cmd_bench(args, log: Log) -> int:
 
     from dprf_tpu import compilecache
     from dprf_tpu.bench import run_bench, run_config
+    from dprf_tpu.perfreport import compare as compare_mod
+
+    baseline_dir = args.baseline_dir or compare_mod.repo_root()
+    if args.gate_dry:
+        # CI mode: audit the committed trajectory, measure nothing
+        verdict = compare_mod.gate_dry(baseline_dir,
+                                       window=args.gate_window)
+        print(json.dumps({"gate": verdict}))
+        if verdict["verdict"] == "regression":
+            log.error("bench gate: REGRESSION in the committed "
+                      "trajectory", ratio=verdict["ratio"],
+                      tolerance=verdict["tolerance"])
+            return 1
+        log.info("bench gate", verdict=verdict["verdict"],
+                 window=verdict["window"])
+        return 0
     compilecache.enable(log=log)
     ctx = contextlib.nullcontext()
     if args.profile:
@@ -1408,7 +1457,17 @@ def cmd_bench(args, log: Log) -> int:
                             device=_DEVICE_ALIASES[args.device],
                             mask=args.mask, batch=args.batch,
                             seconds=args.seconds, impl=args.impl, log=log)
+    if args.gate:
+        # regression sentinel: the verdict rides the result JSON (CI
+        # parses it) and a regression exits non-zero
+        res["gate"] = compare_mod.gate_repo(res, baseline_dir,
+                                            window=args.gate_window)
     print(json.dumps(res))
+    if args.gate and res["gate"]["verdict"] == "regression":
+        log.error("bench gate: REGRESSION vs the baseline window",
+                  ratio=res["gate"]["ratio"],
+                  tolerance=res["gate"]["tolerance"])
+        return 1
     return 0
 
 
@@ -1874,6 +1933,26 @@ def _trace_pull(args, log: Log) -> int:
         client.close()
 
 
+def cmd_report(args, log: Log) -> int:
+    """`dprf report SESSION`: render the performance-attribution
+    report from the session's artifacts (perfreport/report.py) --
+    a post-mortem needs no live coordinator."""
+    import json as _json
+
+    from dprf_tpu.perfreport import build_report, render_report
+
+    doc = build_report(args.session)
+    if doc is None:
+        log.error("no session artifacts found (journal, .trace.jsonl "
+                  "or .telemetry.jsonl)", session=args.session)
+        return 2
+    if args.json:
+        print(_json.dumps(doc, sort_keys=True))
+    else:
+        print(render_report(doc))
+    return 0
+
+
 def cmd_metrics(args, log: Log) -> int:
     """Scrape a running coordinator: plain HTTP GET on the RPC port
     (no client library; works for curl/Prometheus too).  --json asks
@@ -2047,6 +2126,7 @@ _COMMANDS = {
     "retry-parked": cmd_retry_parked,
     "top": cmd_top,
     "trace": cmd_trace,
+    "report": cmd_report,
     "metrics": cmd_metrics,
     "check": cmd_check,
     "show": cmd_show,

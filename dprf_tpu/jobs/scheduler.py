@@ -41,6 +41,12 @@ from typing import Callable, Optional
 
 from dprf_tpu.runtime.dispatcher import Dispatcher
 from dprf_tpu.telemetry import get_registry
+from dprf_tpu.utils import env as envreg
+
+#: seconds between age-based GC sweeps (the TTL itself is the
+#: DPRF_JOB_TTL_S knob; this only rate-limits the table scan on the
+#: lease path)
+GC_CHECK_INTERVAL_S = 30.0
 
 #: job lifecycle states
 QUEUED = "queued"
@@ -68,7 +74,7 @@ class Job:
                  "verifier", "owner", "priority", "quota", "rate",
                  "state", "done_reason", "created", "found", "hits",
                  "rejected", "leases", "pass_value", "_tokens",
-                 "_token_t")
+                 "_token_t", "finished_at")
 
     def __init__(self, job_id: str, spec: dict, dispatcher: Dispatcher,
                  n_targets: int, verifier: Optional[Callable] = None,
@@ -98,6 +104,9 @@ class Job:
         self.pass_value = 0.0            # stride scheduler state
         self._tokens = 1.0               # lease-rate token bucket
         self._token_t: Optional[float] = None
+        #: when the job entered a terminal state (scheduler clock) --
+        #: the age-based GC's reference point
+        self.finished_at: Optional[float] = None
 
     @property
     def weight(self) -> float:
@@ -158,6 +167,7 @@ class JobScheduler:
         self._jobs: dict = {}            # job_id -> Job, insert-ordered
         self._next_id = 0
         self._clock = clock or time.monotonic
+        self._gc_next = 0.0
         m = get_registry(registry)
         self._g_jobs = m.gauge(
             "dprf_jobs", "jobs known to the scheduler, by state",
@@ -165,6 +175,10 @@ class JobScheduler:
         self._m_job_hits = m.counter(
             "dprf_job_hits_total", "verified cracks per job",
             labelnames=("job",))
+        self._m_gc = m.counter(
+            "dprf_jobs_gc_total",
+            "terminal jobs reaped by the age-based GC "
+            "(DPRF_JOB_TTL_S)")
         self._refresh_states()
 
     # -- registry --------------------------------------------------------
@@ -347,6 +361,7 @@ class JobScheduler:
             job.state, job.done_reason = DONE, "quota reached"
         else:
             return
+        job.finished_at = self._clock()
         self._refresh_states()
 
     # -- admin -----------------------------------------------------------
@@ -366,9 +381,39 @@ class JobScheduler:
             if requeued and j.state == DONE \
                     and not j.dispatcher.done():
                 j.state, j.done_reason = RUNNING, None
+                j.finished_at = None
         if n:
             self._refresh_states()
         return n
+
+    def maybe_gc(self, keep=(), force: bool = False) -> list:
+        """Age-based job GC (``DPRF_JOB_TTL_S``): reap DONE/CANCELLED
+        jobs whose terminal age exceeds the TTL, so a long-lived
+        fleet's table never wedges at MAX_JOBS.  Rate-limited to one
+        scan per GC_CHECK_INTERVAL_S unless ``force`` (op_job_submit
+        forces when the table is full).  ``keep`` protects job ids
+        that must never leave the table (the default job: the serve
+        front-end aliases its found dict).  Returns the reaped Jobs
+        so the caller can journal ``job_gc`` records."""
+        ttl = envreg.get_float("DPRF_JOB_TTL_S")
+        if not ttl or ttl <= 0:
+            return []
+        now = self._clock()
+        if not force and now < self._gc_next:
+            return []
+        self._gc_next = now + GC_CHECK_INTERVAL_S
+        reaped = []
+        for jid, j in list(self._jobs.items()):
+            if jid in keep or not j.terminal():
+                continue
+            if j.finished_at is None or now - j.finished_at < ttl:
+                continue
+            del self._jobs[jid]
+            reaped.append(j)
+            self._m_gc.inc()
+        if reaped:
+            self._refresh_states()
+        return reaped
 
     def cancel(self, job_id: str) -> Optional[Job]:
         """Cancel a job: no more leases, in-flight completes dropped,
@@ -379,6 +424,7 @@ class JobScheduler:
             return None
         if not job.terminal():
             job.state, job.done_reason = CANCELLED, "cancelled"
+            job.finished_at = self._clock()
             job.dispatcher.abandon()
             self._refresh_states()
         return job

@@ -1,0 +1,24 @@
+"""Offline performance attribution (ISSUE 9): the bench regression
+sentinel and the one-shot session report.
+
+Two consumers of the artifacts the runtime already writes:
+
+  - ``perfreport.compare`` -- ``dprf bench --gate`` /
+    ``tools/bench_compare.py``: gate a fresh bench measurement against
+    the committed BENCH_r*.json trajectory (median of the last K
+    same-device records, noise tolerance from their observed
+    run-to-run spread), exit non-zero on regression;
+  - ``perfreport.report`` -- ``dprf report SESSION``: render a
+    text performance report (throughput, per-phase p50/p95, device
+    busy fraction, compile-cache hit rate, pipeline depth, per-job
+    fair-share actual-vs-weight) ENTIRELY from session artifacts (the
+    trace JSONL, telemetry snapshots, and the journal), so a
+    post-mortem needs no live coordinator.
+"""
+
+from dprf_tpu.perfreport.compare import (gate, latest_record,
+                                         load_bench_records)
+from dprf_tpu.perfreport.report import build_report, render_report
+
+__all__ = ["gate", "latest_record", "load_bench_records",
+           "build_report", "render_report"]

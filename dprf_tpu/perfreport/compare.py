@@ -1,0 +1,165 @@
+"""Bench regression sentinel: compare a measurement against the
+committed BENCH_r*.json trajectory.
+
+The driver bench records (``BENCH_r<NN>.json`` at the repo root) wrap
+one JSON result line in a ``tail`` field; this module parses them
+back into result dicts and gates a current measurement against the
+baseline WINDOW: the last K records measured on the SAME device
+backend (a CPU-fallback run must never "regress" against a TPU
+round), compared as
+
+    regression  <=>  current < median * (1 - tolerance)
+
+where the tolerance is the larger of a noise floor and the window's
+own observed run-to-run relative spread -- a trajectory that jitters
+10% between rounds must not alarm on an 8% dip, and a rock-steady
+one should.  Fewer than MIN_BASELINE comparable records is verdict
+``no-baseline`` (pass): the sentinel refuses to alarm on data it
+does not have.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Optional
+
+#: default baseline window (same-device records considered)
+DEFAULT_WINDOW = 5
+#: minimum tolerated regression even on a noise-free trajectory
+NOISE_FLOOR = 0.10
+#: same-device records needed before the gate may fail anything
+MIN_BASELINE = 2
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _result_from_tail(tail: str) -> Optional[dict]:
+    """The LAST JSON object line in a driver record's tail -- the
+    bench's single stdout JSON line (stderr noise precedes it)."""
+    best = None
+    for line in tail.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(doc, dict) and isinstance(
+                doc.get("value"), (int, float)):
+            best = doc
+    return best
+
+
+def load_bench_records(repo_dir: str,
+                       pattern: str = "BENCH_r*.json") -> list:
+    """Parsed bench results from the committed driver records, sorted
+    by round number; each result dict gains ``round``."""
+    out = []
+    for path in glob.glob(os.path.join(repo_dir, pattern)):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        res = None
+        if isinstance(doc, dict):
+            if isinstance(doc.get("tail"), str):
+                res = _result_from_tail(doc["tail"])
+            elif isinstance(doc.get("value"), (int, float)):
+                res = doc            # bare result file
+        if res is None:
+            continue
+        res = dict(res)
+        res["round"] = int(m.group(1))
+        out.append(res)
+    out.sort(key=lambda r: r["round"])
+    return out
+
+
+def latest_record(repo_dir: str) -> Optional[dict]:
+    recs = load_bench_records(repo_dir)
+    return recs[-1] if recs else None
+
+
+def _comparable(current: dict, rec: dict) -> bool:
+    """Baseline records must be measured on the same backend; the
+    engine too when both records carry one."""
+    if rec.get("device") != current.get("device"):
+        return False
+    ce, re_ = current.get("engine"), rec.get("engine")
+    if ce is not None and re_ is not None and ce != re_:
+        return False
+    return True
+
+
+def gate(current: dict, baseline: list, window: int = DEFAULT_WINDOW,
+         noise_floor: float = NOISE_FLOOR) -> dict:
+    """Gate verdict for ``current`` (a bench result dict with
+    ``value`` and ``device``) against the ``baseline`` record list.
+
+    Returns {"verdict": "pass"|"regression"|"no-baseline",
+    "median_hs", "tolerance", "ratio", "window", "baseline_rounds"}.
+    """
+    value = float(current.get("value") or 0.0)
+    comp = [r for r in baseline if _comparable(current, r)
+            and float(r.get("value") or 0) > 0]
+    comp = comp[-max(1, int(window)):]
+    if len(comp) < MIN_BASELINE or value <= 0:
+        return {"verdict": "no-baseline",
+                "median_hs": None, "tolerance": None, "ratio": None,
+                "window": len(comp),
+                "baseline_rounds": [r["round"] for r in comp
+                                    if "round" in r]}
+    vals = sorted(float(r["value"]) for r in comp)
+    n = len(vals)
+    median = (vals[n // 2] if n % 2
+              else 0.5 * (vals[n // 2 - 1] + vals[n // 2]))
+    # observed run-to-run spread of the window itself: a trajectory
+    # that jitters must widen its own alarm band
+    spread = (vals[-1] - vals[0]) / median if median > 0 else 0.0
+    tolerance = max(float(noise_floor), spread)
+    ratio = value / median if median > 0 else 0.0
+    verdict = "regression" if ratio < 1.0 - tolerance else "pass"
+    return {"verdict": verdict,
+            "median_hs": median,
+            "tolerance": round(tolerance, 4),
+            "ratio": round(ratio, 4),
+            "window": len(comp),
+            "baseline_rounds": [r["round"] for r in comp
+                                if "round" in r]}
+
+
+def gate_repo(current: dict, repo_dir: str,
+              window: int = DEFAULT_WINDOW) -> dict:
+    return gate(current, load_bench_records(repo_dir), window=window)
+
+
+def gate_dry(repo_dir: str, window: int = DEFAULT_WINDOW) -> dict:
+    """CI mode: gate the NEWEST committed record against the window
+    before it -- no fresh measurement needed (the committed
+    trajectory audits itself).  Adds ``current_round``/``current_hs``
+    so the verdict is self-describing."""
+    recs = load_bench_records(repo_dir)
+    if not recs:
+        return {"verdict": "no-baseline", "median_hs": None,
+                "tolerance": None, "ratio": None, "window": 0,
+                "baseline_rounds": []}
+    current, prior = recs[-1], recs[:-1]
+    out = gate(current, prior, window=window)
+    out["current_round"] = current.get("round")
+    out["current_hs"] = current.get("value")
+    return out
+
+
+def repo_root() -> str:
+    """The tree this package is installed in (where BENCH_r*.json
+    live) -- overridable by callers with an explicit dir."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,292 @@
+"""``dprf report SESSION``: one-shot performance report from session
+artifacts alone.
+
+Reads the journal family a run leaves behind -- ``<session>`` (job
+identity + per-job records), ``<session>.trace.jsonl`` (lifecycle +
+phase spans), ``<session>.telemetry.jsonl`` (periodic registry
+snapshots) -- and renders what a perf post-mortem needs without a
+live coordinator: throughput, per-phase p50/p95 breakdown, device
+busy fraction per worker, compile-cache behavior, pipeline depth,
+and per-job fair-share actual-vs-weight.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from dprf_tpu.telemetry.perf import PHASES, roofline_fraction
+from dprf_tpu.telemetry.snapshot import load_snapshots, telemetry_path
+from dprf_tpu.telemetry.trace import load_trace, trace_path
+
+
+def _pct(vals: list, q: float) -> float:
+    """Nearest-rank percentile of a sorted list."""
+    if not vals:
+        return 0.0
+    i = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
+    return vals[i]
+
+
+def _metric_values(snapshot: Optional[dict], name: str) -> list:
+    if not snapshot:
+        return []
+    m = (snapshot.get("metrics") or {}).get(name)
+    if not isinstance(m, dict):
+        return []
+    return m.get("values") or []
+
+
+def _counter_total(snapshot, name: str, **labels) -> float:
+    total = 0.0
+    for v in _metric_values(snapshot, name):
+        lv = v.get("labels") or {}
+        if all(lv.get(k) == val for k, val in labels.items()):
+            total += float(v.get("value") or 0.0)
+    return total
+
+
+def _phase_stats(spans: list, sample_scale: float = 1.0) -> dict:
+    """phase -> {count, p50_s, p95_s, total_s, share}.  The
+    generate/h2d/device/d2h durations come from SAMPLED probes (every
+    Nth unit) while ``verify`` comes from every hit batch's
+    hit_verify span, so the share denominator scales the sampled
+    totals by the observed cadence (units / probed units) -- without
+    it, verify's share would inflate by the sampling factor.
+    ``total_s``/p50/p95/count stay the observed values."""
+    by_phase: dict = {}
+    for s in spans:
+        if s.get("name") != "phase":
+            continue
+        ph = (s.get("attrs") or {}).get("phase")
+        if ph:
+            by_phase.setdefault(str(ph), []).append(
+                float(s.get("dur", 0.0)))
+    # hit_verify spans carry the verify cost for EVERY hit batch
+    for s in spans:
+        if s.get("name") == "hit_verify":
+            by_phase.setdefault("verify", []).append(
+                float(s.get("dur", 0.0)))
+    scale = max(1.0, float(sample_scale))
+
+    def scaled(ph: str) -> float:
+        t = sum(by_phase.get(ph, ()))
+        return t if ph == "verify" else t * scale
+
+    total_all = sum(scaled(ph) for ph in by_phase) or 1.0
+    out = {}
+    for ph in PHASES:
+        durs = sorted(by_phase.get(ph, ()))
+        if not durs:
+            continue
+        out[ph] = {"count": len(durs),
+                   "p50_s": round(_pct(durs, 0.50), 6),
+                   "p95_s": round(_pct(durs, 0.95), 6),
+                   "total_s": round(sum(durs), 6),
+                   "share": round(scaled(ph) / total_all, 4)}
+    return out
+
+
+def _busy_by_worker(spans: list) -> dict:
+    """worker -> busy fraction over its own active span: union
+    coverage / (first sweep start .. last sweep end) -- the offline
+    form of the live dprf_device_busy_fraction gauge, same union-hole
+    math as tools/trace_overlap.py."""
+    from dprf_tpu.telemetry.trace import overlap_report
+    rep = overlap_report(spans)
+    sweeps_by_proc: dict = {}
+    for s in spans:
+        if s.get("name") == "sweep":
+            sweeps_by_proc.setdefault(str(s.get("proc")), []).append(s)
+    out = {}
+    for proc, w in rep["workers"].items():
+        sw = sweeps_by_proc.get(proc, [])
+        if not sw:
+            continue
+        t0 = min(float(s.get("ts", 0.0)) for s in sw)
+        t1 = max(float(s.get("ts", 0.0)) + float(s.get("dur", 0.0))
+                 for s in sw)
+        span = t1 - t0
+        if span <= 0:
+            out[proc] = 1.0
+            continue
+        out[proc] = round(max(0.0, span - w["idle_s"]) / span, 4)
+    return out
+
+
+def _throughput(spans: list, snapshot: Optional[dict]) -> dict:
+    """H/s two ways: swept keyspace over the sweep-span wall window
+    (trace-derived), and the candidates counter over the snapshot's
+    elapsed time (telemetry-derived)."""
+    sw = [s for s in spans if s.get("name") == "sweep"]
+    out: dict = {"trace_hs": None, "telemetry_hs": None,
+                 "candidates": 0}
+    lengths = [int((s.get("attrs") or {}).get("length") or 0)
+               for s in sw]
+    if sw and sum(lengths) > 0:
+        t0 = min(float(s.get("ts", 0.0)) for s in sw)
+        t1 = max(float(s.get("ts", 0.0)) + float(s.get("dur", 0.0))
+                 for s in sw)
+        if t1 > t0:
+            out["trace_hs"] = sum(lengths) / (t1 - t0)
+        out["candidates"] = sum(lengths)
+    if snapshot:
+        cands = _counter_total(snapshot,
+                               "dprf_candidates_hashed_total")
+        elapsed = float(snapshot.get("elapsed_s") or 0.0)
+        if cands and elapsed > 0:
+            out["telemetry_hs"] = cands / elapsed
+            out["candidates"] = max(out["candidates"], int(cands))
+    return out
+
+
+def _fair_share(spans: list, journal) -> list:
+    """Per-job lease share vs fair-share weight, from the lease spans
+    and the journal's job records (the default job's priority is 1
+    unless journaled otherwise)."""
+    leases: dict = {}
+    for s in spans:
+        if s.get("name") != "lease":
+            continue
+        jid = (s.get("attrs") or {}).get("job")
+        if jid is not None:
+            leases[str(jid)] = leases.get(str(jid), 0) + 1
+    if not leases:
+        return []
+    prio = {}
+    if journal is not None:
+        for jid, rec in (journal.jobs or {}).items():
+            try:
+                prio[str(jid)] = max(1, int(rec.get("priority") or 1))
+            except (TypeError, ValueError):
+                prio[str(jid)] = 1
+    total = sum(leases.values())
+    weight_total = sum(prio.get(j, 1) for j in leases)
+    out = []
+    for jid in sorted(leases):
+        w = prio.get(jid, 1)
+        out.append({"job": jid, "leases": leases[jid],
+                    "actual_share": round(leases[jid] / total, 4),
+                    "weight_share": round(w / weight_total, 4),
+                    "priority": w})
+    return out
+
+
+def build_report(session_path: str) -> Optional[dict]:
+    """The machine-readable report, or None when the session left no
+    artifacts at all."""
+    from dprf_tpu.runtime.session import SessionJournal
+    spans = load_trace(trace_path(session_path))
+    snaps = load_snapshots(telemetry_path(session_path))
+    journal = (SessionJournal.load(session_path)
+               if os.path.exists(session_path) else None)
+    if not spans and not snaps and journal is None:
+        return None
+    last = snaps[-1] if snaps else None
+    engine = (journal.spec.get("engine") if journal
+              and journal.spec else None)
+    thr = _throughput(spans, last)
+    rate = thr.get("trace_hs") or thr.get("telemetry_hs")
+    hits = _counter_total(last, "dprf_compile_cache_hits_total")
+    misses = _counter_total(last, "dprf_compile_cache_misses_total")
+    depth_vals = _metric_values(last, "dprf_worker_pipeline_depth")
+    sweeps = [s for s in spans if s.get("name") == "sweep"]
+    probed = sum(1 for s in sweeps
+                 if (s.get("attrs") or {}).get("probed"))
+    sample_scale = (len(sweeps) / probed) if probed else 1.0
+    return {
+        "session": session_path,
+        "engine": engine,
+        "spans": len(spans),
+        "units": len(sweeps),
+        "probed_units": probed,
+        "throughput": {
+            "hs": rate,
+            "trace_hs": thr["trace_hs"],
+            "telemetry_hs": thr["telemetry_hs"],
+            "candidates": thr["candidates"],
+            "roofline_frac": (roofline_fraction(engine, rate)
+                              if engine and rate else None),
+        },
+        "phases": _phase_stats(spans, sample_scale=sample_scale),
+        "busy": _busy_by_worker(spans),
+        "compile_cache": {
+            "hits": int(hits), "misses": int(misses),
+            "hit_rate": (round(hits / (hits + misses), 4)
+                         if hits + misses else None),
+        },
+        "pipeline_depth": (float(depth_vals[-1]["value"])
+                           if depth_vals else None),
+        "fair_share": _fair_share(spans, journal),
+    }
+
+
+def _fmt_hs(v: Optional[float]) -> str:
+    if v is None:
+        return "n/a"
+    for unit, div in (("GH/s", 1e9), ("MH/s", 1e6), ("kH/s", 1e3)):
+        if v >= div:
+            return f"{v / div:,.2f} {unit}"
+    return f"{v:,.0f} H/s"
+
+
+def render_report(doc: dict) -> str:
+    """The human half: a sectioned text report (stdout of ``dprf
+    report``; CI uploads it as an artifact)."""
+    lines = [f"dprf report — {doc['session']}",
+             f"engine {doc.get('engine') or '?'} | "
+             f"{doc['units']} units ({doc['probed_units']} probed) | "
+             f"{doc['spans']} spans"]
+    thr = doc["throughput"]
+    roof = thr.get("roofline_frac")
+    lines.append("")
+    lines.append("throughput")
+    lines.append(f"  swept      {thr['candidates']:,} candidates")
+    lines.append(f"  rate       {_fmt_hs(thr.get('hs'))}"
+                 + (f"  (roofline {roof:.2f})" if roof else ""))
+    if thr.get("telemetry_hs") and thr.get("trace_hs"):
+        lines.append(f"  telemetry  {_fmt_hs(thr['telemetry_hs'])}")
+    phases = doc.get("phases") or {}
+    if phases:
+        lines.append("")
+        lines.append("phase breakdown (sampled probes)")
+        lines.append(f"  {'PHASE':9s} {'COUNT':>6s} {'P50':>10s} "
+                     f"{'P95':>10s} {'TOTAL':>10s} {'SHARE':>6s}")
+        for ph in PHASES:
+            st = phases.get(ph)
+            if not st:
+                continue
+            lines.append(
+                f"  {ph:9s} {st['count']:>6d} "
+                f"{st['p50_s'] * 1e3:>8.2f}ms "
+                f"{st['p95_s'] * 1e3:>8.2f}ms "
+                f"{st['total_s']:>9.3f}s "
+                f"{100 * st['share']:>5.1f}%")
+    busy = doc.get("busy") or {}
+    if busy:
+        lines.append("")
+        lines.append("device busy fraction (sweep-span union)")
+        for w in sorted(busy):
+            lines.append(f"  {w:24s} {100 * busy[w]:>5.1f}%")
+    cc = doc.get("compile_cache") or {}
+    lines.append("")
+    lines.append(
+        "compile cache  hits "
+        f"{cc.get('hits', 0)} / misses {cc.get('misses', 0)}"
+        + (f"  (hit rate {100 * cc['hit_rate']:.0f}%)"
+           if cc.get("hit_rate") is not None else ""))
+    if doc.get("pipeline_depth") is not None:
+        lines.append(f"pipeline depth {doc['pipeline_depth']:.0f}")
+    fs = doc.get("fair_share") or []
+    if len(fs) > 1:
+        lines.append("")
+        lines.append("fair share (lease counts vs weights)")
+        lines.append(f"  {'JOB':6s} {'PRIO':>4s} {'LEASES':>7s} "
+                     f"{'ACTUAL':>7s} {'WEIGHT':>7s}")
+        for row in fs:
+            lines.append(
+                f"  {row['job'][:6]:6s} {row['priority']:>4d} "
+                f"{row['leases']:>7d} "
+                f"{100 * row['actual_share']:>6.1f}% "
+                f"{100 * row['weight_share']:>6.1f}%")
+    return "\n".join(lines)

@@ -20,6 +20,7 @@ from dprf_tpu.runtime.potfile import Potfile
 from dprf_tpu.runtime.session import SessionJournal
 from dprf_tpu.runtime.worker import Hit
 from dprf_tpu.telemetry import get_registry
+from dprf_tpu.telemetry import perf as perf_mod
 from dprf_tpu.telemetry.trace import get_tracer, jax_profile_ctx
 
 
@@ -109,8 +110,13 @@ class Coordinator:
         #: (the dispatcher records the lease ledger's into the same
         #: one by default)
         self.tracer = get_tracer(recorder)
+        self._registry = get_registry(registry)
+        #: per-phase sweep attribution (ISSUE 9): every Nth unit runs
+        #: the sampled synced probe; verify timing is unsampled
+        self._perf = perf_mod.PerfSampler(registry=self._registry,
+                                          recorder=self.tracer)
         from dprf_tpu.telemetry import declare_job_metrics
-        jm = declare_job_metrics(get_registry(registry))
+        jm = declare_job_metrics(self._registry)
         self._m_hits = jm["hits"]
         self._m_rejects = jm["rejects"]
         self._m_cands = jm["cands"]
@@ -209,6 +215,7 @@ class Coordinator:
         pipeline = UnitPipeline(self.worker,
                                 pipeline_depth(self.PIPELINE_DEPTH))
         warm_pending = ensure_warm is not None
+        t_last_resolve = None
         # DPRF_JAX_PROFILE=<dir>: kernel-level drill-down beside the
         # span timeline (no-op when unset; degrades safely if a
         # profiler trace is already active via --profile)
@@ -242,7 +249,15 @@ class Coordinator:
                                 cache=getattr(self.worker,
                                               "compile_cache", None),
                                 overlapped=True)
-                    pipeline.submit(unit)
+                    probe = None
+                    if self._perf.take():
+                        # sampled unit: serial synced sweep with
+                        # per-phase attribution (declared PERF_PROBE)
+                        pctx = self.dispatcher.trace_context(
+                            unit.unit_id)
+                        probe = (self._perf,
+                                 pctx[0] if pctx else None)
+                    pipeline.submit(unit, probe=probe)
                 if not len(pipeline):
                     if self.dispatcher.done() or \
                             self.dispatcher.outstanding_count() == 0:
@@ -252,20 +267,38 @@ class Coordinator:
                 unit, p, t_submit, _ = pipeline.pop()
                 ctx = self.dispatcher.trace_context(unit.unit_id)
                 hits = p.resolve()
-                unit_s = time.monotonic() - t_submit
+                now_resolve = time.monotonic()
+                unit_s = now_resolve - t_submit
+                # inter-completion interval: the loop's true drain
+                # rate once the pipeline is primed (unit_s includes
+                # up to depth-1 units of queue wait) -- feeds the
+                # roofline gauge; resets when the pipeline empties so
+                # starvation never reads as slow hashing
+                interval = (now_resolve - t_last_resolve
+                            if t_last_resolve is not None else unit_s)
+                t_last_resolve = (now_resolve if len(pipeline)
+                                  else None)
                 self.tracer.record(
                     "sweep", dur=unit_s,
                     trace=ctx[0] if ctx else None,
                     parent=ctx[1] if ctx else None, proc="local",
+                    # a probed unit's sweep span carries the id its
+                    # phase children were parented on
+                    span=getattr(p, "sweep_span", None),
                     unit=unit.unit_id, length=unit.length,
-                    hits=len(hits))
+                    hits=len(hits),
+                    probed=getattr(p, "sweep_span", None) is not None)
                 if hits:
                     t_verify = time.monotonic()
                     rejected0 = self.rejected
                     self._finish_unit(unit, hits)
+                    verify_s = time.monotonic() - t_verify
+                    self._perf.observe_verify(verify_s,
+                                              engine=self.spec.engine,
+                                              job=self.dispatcher.job_id)
                     self.tracer.record(
                         "hit_verify",
-                        dur=time.monotonic() - t_verify,
+                        dur=verify_s,
                         trace=ctx[0] if ctx else None,
                         parent=ctx[1] if ctx else None,
                         proc="coordinator", unit=unit.unit_id,
@@ -274,6 +307,11 @@ class Coordinator:
                 self._h_unit.observe(unit_s)
                 self._m_cands.inc(unit.length, engine=self.spec.engine,
                                   device=self.spec.device)
+                if interval > 0:
+                    # live roofline distance from the drain rate
+                    perf_mod.publish_roofline(
+                        self.spec.engine, unit.length / interval,
+                        registry=self._registry)
                 # submit-to-resolve time feeds the adaptive unit sizer;
                 # it includes up to PIPELINE_DEPTH-1 units of queue
                 # wait, so the EWMA under-estimates throughput a little

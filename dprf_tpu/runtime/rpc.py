@@ -48,6 +48,7 @@ from dprf_tpu.runtime.dispatcher import Dispatcher
 from dprf_tpu.runtime.worker import Hit
 from dprf_tpu.runtime.workunit import WorkUnit
 from dprf_tpu.telemetry import declare_job_metrics, get_registry
+from dprf_tpu.telemetry import perf as perf_mod
 from dprf_tpu.telemetry.trace import get_tracer, jax_profile_ctx
 
 MAX_LINE = 64 << 20   # hashlists can be large; candidates never cross
@@ -201,6 +202,9 @@ class CoordinatorState:
         #: whole (both default to the process-wide recorder)
         self.tracer = get_tracer(recorder)
         m = self.registry
+        #: verify-phase attribution (telemetry/perf.py): the oracle
+        #: re-hash cost of every hit batch, labeled per job
+        self._h_phase = perf_mod.phase_histogram(m)
         jm = declare_job_metrics(m)
         self._m_hits = jm["hits"]
         self._m_rejects = jm["rejects"]
@@ -307,6 +311,14 @@ class CoordinatorState:
             # forever -- lease() below is the only reap site during an
             # active job, and a clamp of 0 never reaches it
             self.scheduler.reap_expired()
+            # age-based job GC (DPRF_JOB_TTL_S): terminal jobs past
+            # their TTL leave the table here, journaled so a restart
+            # does not resurrect them; the default job is never reaped
+            # (state.found aliases its dict)
+            for gone in self.scheduler.maybe_gc(
+                    keep=(self.default_job_id,)):
+                if self.on_job_event:
+                    self.on_job_event("gc", gone)
             ahead = min(ahead, max(
                 0, MAX_LEASE_AHEAD - self.scheduler.outstanding_for(wid)))
             pairs = self.scheduler.lease_many(wid, ahead)
@@ -398,8 +410,12 @@ class CoordinatorState:
                 continue
             verified.append((ti, int(h["cand"]), plain))
         if hits:
+            verify_s = time.monotonic() - t_verify
+            self._h_phase.observe(verify_s, phase="verify",
+                                  engine=job.spec.get("engine", "?"),
+                                  job=job.job_id)
             self.tracer.record(
-                "hit_verify", dur=time.monotonic() - t_verify,
+                "hit_verify", dur=verify_s,
                 trace=ctx[0] if ctx else None,
                 parent=ctx[1] if ctx else None, proc="coordinator",
                 unit=unit_id, job=job.job_id, hits=len(hits),
@@ -478,6 +494,13 @@ class CoordinatorState:
                     self._m_cands.inc(unit.length,
                                       engine=job.spec.get("engine", "?"),
                                       device="remote")
+                    if elapsed:
+                        # live roofline distance from the fleet's
+                        # per-unit throughput (telemetry/perf.py)
+                        perf_mod.publish_roofline(
+                            job.spec.get("engine", "?"),
+                            unit.length / elapsed,
+                            registry=self.registry)
             if self.on_progress:
                 done, total = self.scheduler.progress()
                 self.on_progress(done, total,
@@ -527,6 +550,10 @@ class CoordinatorState:
             spans = self.tracer.tail(n, trace=trace)
         cursor = spans[-1].get("span") if spans else (
             since if isinstance(since, str) else None)
+        # live utilization & roofline distance (ISSUE 9), computed
+        # outside the state lock (the recorder has its own)
+        busy = self.tracer.busy_fractions()
+        roofline = perf_mod.roofline_snapshot(self.registry)
         with self.lock:
             done, total = self.scheduler.progress()
             leases = []
@@ -545,6 +572,11 @@ class CoordinatorState:
                       "now": time.time(),
                       # per-job rows for the dprf top admin view
                       "jobs": self.scheduler.summaries(),
+                      # sliding-window device-busy per worker + the
+                      # live per-engine roofline fraction (dprf top
+                      # folds both into its header line)
+                      "busy": busy,
+                      "roofline": roofline,
                       "quarantined": sorted(self.quarantined)}
         return {"ok": True, "spans": spans, "leases": leases,
                 "status": status, "cursor": cursor, "resync": resync}
@@ -599,6 +631,14 @@ class CoordinatorState:
             from dprf_tpu.jobs.build import build_job_runtime
             builder = build_job_runtime
         with self.lock:
+            # a table wedged at the cap with TTL-expired terminal
+            # jobs un-wedges HERE (force bypasses the GC's rate
+            # limiter), before the capacity gate rejects the tenant
+            for gone in self.scheduler.maybe_gc(
+                    keep=(self.default_job_id,),
+                    force=self.scheduler.full()):
+                if self.on_job_event:
+                    self.on_job_event("gc", gone)
             # capacity gate BEFORE the expensive build: a full table
             # must not cost target parsing, generator construction,
             # or per-job metric registration per rejected attempt
@@ -1157,6 +1197,10 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
         "dprf_worker_idle_seconds",
         "seconds this worker held no submitted unit between sweeps "
         "(pipeline drained: the device idles while RPCs fly)")
+    # sampled per-phase attribution (telemetry/perf.py): every Nth
+    # unit runs the serial synced probe; its phase spans ship back
+    # with the complete report like any other worker span
+    sampler = perf_mod.PerfSampler(registry=m, recorder=tracer)
     adaptive = None
     if depth is None:
         adaptive = AdaptiveDepth(pipeline_depth())
@@ -1359,9 +1403,11 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                             # work to hide them behind)
                             c_idle.inc(time.monotonic() - idle_mark)
                             idle_mark = None
+                        probe = ((sampler, tid) if sampler.take()
+                                 else None)
                         pipe.submit(unit,
                                     meta=(tid, lease_sid, ship, job, w),
-                                    worker=w)
+                                    worker=w, probe=probe)
                         cur = None
                 if len(pipe) == 0:
                     if stop_seen:
@@ -1401,12 +1447,20 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                 m_cands.inc(unit.length, engine=eng_name, device=device)
                 # ts backdates to t_submit, so consecutive sweep spans
                 # OVERLAP when the loop pipelines (the invariant
-                # tools/trace_overlap.py checks)
+                # tools/trace_overlap.py checks).  A probed unit's
+                # sweep span carries the pre-allocated id its phase
+                # children parent onto, and ships them along.
+                psid = getattr(pending, "sweep_span", None)
+                pspans = getattr(pending, "phase_spans", None)
+                if pspans:
+                    ship.extend(pspans)
                 ev = tracer.record("sweep", dur=unit_s, trace=tid,
                                    parent=lease_sid, proc=worker_id,
+                                   span=psid,
                                    unit=unit.unit_id, job=job,
                                    length=unit.length,
-                                   hits=len(hits))
+                                   hits=len(hits),
+                                   probed=psid is not None)
                 if ev:
                     ship.append(ev)
                 payload = [{"target": h.target_index,

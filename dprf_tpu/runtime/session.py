@@ -145,6 +145,12 @@ class SessionJournal:
         silently resume sweeping."""
         self._emit({"type": "job_state", "id": job_id, "state": state})
 
+    def record_job_gc(self, job_id: str) -> None:
+        """Journal an age-based job reap (DPRF_JOB_TTL_S): a restart
+        must not resurrect a job the GC already dropped -- load()
+        removes the job's records when it sees this line."""
+        self._emit({"type": "job_gc", "id": job_id})
+
     def record_tuning(self, key: str, record: dict) -> None:
         """Journal a tuning decision (tune.make_key -> result record).
         The CLI resolves the batch BEFORE the journal is opened, so a
@@ -216,6 +222,11 @@ class SessionJournal:
                             str(obj["state"])
                     except (KeyError, TypeError):
                         continue
+                elif t == "job_gc":
+                    # the scheduler reaped this job (age-based GC):
+                    # drop everything journaled for it so restore
+                    # does not resurrect it (ids are never reused)
+                    jobs.pop(str(obj.get("id")), None)
                 elif t == "tune":
                     try:
                         tuning[str(obj["key"])] = dict(obj["record"])

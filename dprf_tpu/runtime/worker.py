@@ -86,6 +86,14 @@ class _ResolvedUnit:
 #: or a retrace inside it stalls every unit of every job.
 HOT_PATHS = ("MaskWorkerBase.submit",)
 
+#: `dprf check` retrace analyzer: the SAMPLED perf probe is ALLOWED
+#: to sync inside hot loops -- forced block_until_ready boundaries
+#: are how per-phase attribution stays honest, and sampling
+#: (DPRF_PERF_SAMPLE) keeps them off the steady-state path.  An
+#: explicit declaration, not a suppression comment: stale entries
+#: are findings.
+PERF_PROBE = ("dprf_tpu.telemetry.perf.probe_pending",)
+
 #: env override for the submit-ahead depth both pipelined loops run at
 PIPELINE_DEPTH_ENV = "DPRF_PIPELINE_DEPTH"
 
@@ -179,17 +187,36 @@ class UnitPipeline:
     def full(self) -> bool:
         return len(self._q) >= self.depth
 
-    def submit(self, unit, meta=None, worker=None) -> None:
+    def submit(self, unit, meta=None, worker=None, probe=None) -> None:
         """Dispatch the unit's device work now (enqueue-only for
         submit-based workers; a serial worker's process runs here) and
         queue it for a later resolve.  ``worker`` overrides the
         pipeline's default for THIS unit -- a multi-job worker loop
         routes each unit to its job's worker while sharing one
-        submit-ahead queue."""
+        submit-ahead queue.
+
+        ``probe`` = (PerfSampler, trace id) routes THIS unit through
+        the sampled per-phase sweep (telemetry/perf.py): serial and
+        synced, so the phase breakdown is honest; the resolved entry
+        carries its phase spans and the pre-allocated sweep span id.
+        The submit timestamp is taken BEFORE the dispatch so a
+        serial/probed unit's submit-to-resolve time covers its real
+        work, not just queue wait."""
         import time
-        self._q.append((unit,
-                        submit_or_process(worker or self.worker, unit),
-                        time.monotonic(), meta))
+        t0 = time.monotonic()
+        w = worker or self.worker
+        if probe is not None:
+            from dprf_tpu.telemetry.perf import (drain_backlog,
+                                                 probe_pending)
+            # the probe's first sync must measure ITS unit, not the
+            # queued units' device backlog: wait for the stream to
+            # drain first (the probe serializes anyway -- this only
+            # moves the wait out of the attributed phases)
+            drain_backlog(self._q)
+            pending = probe_pending(w, unit, probe[0], trace=probe[1])
+        else:
+            pending = submit_or_process(w, unit)
+        self._q.append((unit, pending, t0, meta))
 
     def pop(self):
         """Oldest (unit, pending, t_submit, meta); caller resolves."""

@@ -33,6 +33,15 @@ Metric names (all prefixed ``dprf_``; see README "Observability"):
   dprf_worker_idle_seconds                      seconds a worker held
                                                 no submitted unit
                                                 (device idle)
+  dprf_phase_seconds{phase,engine,job}          sampled per-phase sweep
+                                                attribution (perf.py)
+  dprf_device_busy_fraction{worker}             live sliding-window
+                                                sweep coverage
+  dprf_roofline_frac{engine}                    EWMA throughput / the
+                                                int32 roofline ceiling
+  dprf_per_chip_rate_hs / dprf_scaling_efficiency{engine}
+                                                multichip scaling bench
+  dprf_jobs_gc_total                            age-based job reaps
 
 Alongside metrics, telemetry/trace.py records per-unit lifecycle SPANS
 (the flight recorder): trace ids assigned at split time, context

@@ -1,0 +1,392 @@
+"""Performance attribution (ISSUE 9): per-phase sweep accounting,
+roofline distance, and scaling-efficiency metrics.
+
+The metrics layer says how fast the fleet sweeps and the trace layer
+says which unit ran where -- but neither can say WHERE a sweep's time
+goes.  This module splits the worker hot path into PHASES:
+
+  generate   host-side candidate material (mixed-radix digits, word
+             windows) for one dispatch
+  h2d        host->device transfer of the step arguments
+  device     the fused crack step itself (dispatch + device compute)
+  d2h        device->host result fetch + hit decode
+  verify     CPU-oracle re-hash of reported hits (coordinator side)
+
+recorded two ways: ``phase`` child spans under the unit's ``sweep``
+span (so Perfetto shows the breakdown per unit) and a
+``dprf_phase_seconds{phase,engine,job}`` histogram (so ``/metrics``
+and ``dprf report`` show fleet-wide p50/p95 per phase).
+
+Honest phase timing needs ``block_until_ready`` boundaries between
+the phases -- exactly the host syncs the retrace analyzer forbids on
+the steady-state path, because they drain the device stream.  So
+attribution is SAMPLED: ``DPRF_PERF_SAMPLE=N`` (default every 16th
+unit, 0 disables) routes one unit in N through ``probe_pending`` -- a
+serial, synced sweep of that one unit -- while every other unit runs
+the normal pipelined submit.  ``probe_pending`` is declared in the
+hot-path modules' ``PERF_PROBE`` tables, the retrace analyzer's
+explicit exemption list for deliberately-syncing sampled probes (a
+declaration, not a suppression comment).
+
+The probed sweep produces exactly the hits the normal path would:
+the phase loop is the per-batch step contract
+(``MaskWorkerBase.submit`` without super/wide fusion), decoded
+through the worker's own ``_batch_hits``/``_window_hits``.  Workers
+with a custom serial ``process`` (per-salt blocks, per-target steps)
+are probed coarsely: their whole ``process`` is one ``device`` phase,
+because re-implementing their sweep here would risk wrong hits.
+
+Also here, because bench and the live fleet must share one model:
+
+  - the per-engine ROOFLINE (BASELINE.md "MD5 kernel roofline"):
+    int32 ops/candidate over the chip's 3-6e12 int32 ops/s band ->
+    ``roofline_band_hs(engine)`` and the ``dprf_roofline_frac{engine}``
+    gauge (EWMA-smoothed per-unit throughput / the band ceiling);
+  - multichip scaling: ``dprf_scaling_efficiency{engine}`` and
+    ``dprf_per_chip_rate_hs{engine}`` published by bench's scaling
+    mode.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from dprf_tpu.telemetry import get_registry
+from dprf_tpu.utils import env as envreg
+
+#: attribution phases, in hot-path order; the ONE declaration site for
+#: the ``dprf_phase_seconds`` phase label values
+PHASES = ("generate", "h2d", "device", "d2h", "verify")
+
+#: sampling cadence knob: probe every Nth unit (0 disables)
+SAMPLE_ENV = "DPRF_PERF_SAMPLE"
+
+#: EWMA smoothing for the live roofline gauge (one unit's elapsed is
+#: noisy; the gauge should read like a rate, not a jitter plot)
+ROOFLINE_ALPHA = 0.3
+
+#: chip int32 issue band (ops/s) -- the bracketed VPU model in
+#: BASELINE.md: 1024 lanes x ~1.5 GHz x 2-4 int32 ops/lane/cycle
+CHIP_INT_OPS_BAND = (3.0e12, 6.0e12)
+
+#: int32 ops per candidate through the fused kernels (BASELINE.md
+#: roofline tables: decode + pack + rounds + compare).  Engines not
+#: listed have no published model yet -- no roofline is reported for
+#: them rather than a made-up one.
+OPS_PER_CANDIDATE = {
+    "md5": 800,        # 64 rounds ~10 ops + decode/pack/compare
+    "ntlm": 600,       # MD4: 48 rounds (+ utf16 widen in pack)
+    "md4": 600,
+    "sha1": 1000,      # 80 rounds
+    "sha256": 2000,    # 64 heavier rounds
+    "sha3-256": 10200,  # 24 rounds x ~426 uint32 ops (keccak model)
+}
+
+
+def sample_every(default: int = 16) -> int:
+    """The probe cadence: every Nth unit runs the synced phase sweep;
+    0 disables sampling entirely."""
+    n = envreg.get_int(SAMPLE_ENV, default)
+    return max(0, int(n))
+
+
+def phase_histogram(registry=None):
+    """``dprf_phase_seconds`` -- the ONE declaration site (the metrics
+    analyzer enforces single-site declarations)."""
+    return get_registry(registry).histogram(
+        "dprf_phase_seconds",
+        "seconds per attribution phase of a sampled sweep "
+        "(generate/h2d/device/d2h from probed units; verify from "
+        "every hit verification)",
+        labelnames=("phase", "engine", "job"))
+
+
+def worker_engine(worker) -> str:
+    return getattr(getattr(worker, "engine", None), "name", "unknown")
+
+
+class PerfSampler:
+    """Per-loop sampling state + the publication surface the probed
+    sweep records into.  One per run loop (local Coordinator /
+    remote worker_loop); ``take()`` answers "is THIS unit the sampled
+    one" on the configured cadence (unit 1, N+1, 2N+1, ...)."""
+
+    __slots__ = ("every", "hist", "tracer", "_n")
+
+    def __init__(self, registry=None, recorder=None,
+                 every: Optional[int] = None):
+        from dprf_tpu.telemetry.trace import get_tracer
+        self.every = sample_every() if every is None else max(0, every)
+        self.hist = phase_histogram(registry)
+        self.tracer = get_tracer(recorder)
+        self._n = 0
+
+    def take(self) -> bool:
+        if self.every <= 0:
+            return False
+        self._n += 1
+        return (self._n - 1) % self.every == 0
+
+    def observe_verify(self, seconds: float, engine: str = "unknown",
+                       job: str = "j0") -> None:
+        """The verify phase is real work on every hit batch (no forced
+        sync needed), so it is recorded unsampled."""
+        self.hist.observe(seconds, phase="verify", engine=engine,
+                          job=str(job))
+
+
+class _ProbedUnit:
+    """Resolved result of a probed sweep: quacks like PendingUnit
+    (``resolve()``), carries the phase breakdown and the spans a
+    remote worker ships with its complete report.  ``sweep_span`` is
+    the pre-allocated span id the caller must record the unit's sweep
+    span under, so the phase spans parent onto it."""
+
+    __slots__ = ("hits", "phases", "phase_spans", "sweep_span")
+
+    def __init__(self, hits, phases, phase_spans, sweep_span):
+        self.hits = hits
+        self.phases = phases
+        self.phase_spans = phase_spans
+        self.sweep_span = sweep_span
+
+    def resolve(self):
+        return self.hits
+
+
+def drain_backlog(queue) -> None:
+    """Block until every already-queued pipeline entry's device work
+    is done (its accumulated unit flag is ready), WITHOUT resolving
+    anything -- called right before a sampled probe so the probe's
+    first sync boundary attributes its own unit's work, not the
+    stream backlog the pipeline deliberately keeps full.  Entries
+    without a flag (serial workers' already-resolved units) need no
+    drain."""
+    for entry in queue:
+        flag = getattr(entry[1], "flag", None)
+        if flag is not None:
+            _block(flag)
+
+
+def _block(x) -> None:
+    try:
+        import jax
+        jax.block_until_ready(x)
+    except (ImportError, AttributeError, TypeError):
+        bur = getattr(x, "block_until_ready", None)
+        if bur is not None:
+            bur()
+
+
+def _probe_strategy(worker) -> str:
+    """Which instrumented sweep is SAFE for this worker.  Only the two
+    standard submit loops are re-implemented here; any class with its
+    own ``process`` (per-salt blocks, per-target steps, CPU oracle)
+    keeps its override and is probed coarsely."""
+    from dprf_tpu.runtime import worker as rw
+    proc = getattr(type(worker), "process", None)
+    if proc is rw.DeviceWordlistWorker.process:
+        return "wordlist"
+    if proc is rw.MaskWorkerBase.process:
+        return "digit"
+    return "coarse"
+
+
+def _probe_digit(worker, unit) -> tuple:
+    """Per-batch (base_digits, n_valid) contract with forced sync
+    boundaries between phases -- MaskWorkerBase.submit minus the
+    super/wide fusion, decoded through the worker's own _batch_hits
+    so a probed unit yields exactly the production hits."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    t = {"generate": 0.0, "h2d": 0.0, "device": 0.0, "d2h": 0.0}
+    hits: list = []
+    perf = time.perf_counter
+    for bstart in range(unit.start, unit.end, worker.stride):
+        n_valid = min(worker.stride, unit.end - bstart)
+        t0 = perf()
+        digits = np.asarray(worker.gen.digits(bstart), dtype=np.int32)
+        t1 = perf()
+        t["generate"] += t1 - t0
+        base = jax.device_put(digits)
+        _block(base)
+        nv = jnp.int32(n_valid)
+        _block(nv)
+        t2 = perf()
+        t["h2d"] += t2 - t1
+        result = worker.step(base, nv)
+        _block(result)
+        t3 = perf()
+        t["device"] += t3 - t2
+        hits.extend(worker._batch_hits(bstart, result, unit))
+        t["d2h"] += perf() - t3
+    return t, hits
+
+
+def _probe_wordlist(worker, unit) -> tuple:
+    """Word-window contract ((w0, n_valid_words) scalars): candidate
+    generation happens ON DEVICE via the rule interpreter, so the
+    generate phase is folded into ``device`` and h2d is the scalar
+    argument transfer."""
+    import jax.numpy as jnp
+
+    from dprf_tpu.runtime.worker import word_cover_range
+    t = {"generate": 0.0, "h2d": 0.0, "device": 0.0, "d2h": 0.0}
+    hits: list = []
+    perf = time.perf_counter
+    w_start, w_end = word_cover_range(unit, worker.gen.n_rules)
+    w_end = min(w_end, worker.gen.n_words)
+    ws = w_start
+    while ws < w_end:
+        nw = min(worker.word_batch, w_end - ws)
+        t0 = perf()
+        w0 = jnp.int32(ws)
+        nv = jnp.int32(nw)
+        _block((w0, nv))
+        t1 = perf()
+        t["h2d"] += t1 - t0
+        result = worker.step(w0, nv)
+        _block(result)
+        t2 = perf()
+        t["device"] += t2 - t1
+        hits.extend(worker._window_hits(ws, nw, result, unit))
+        t["d2h"] += perf() - t2
+        ws += nw
+    return t, hits
+
+
+def _probe_coarse(worker, unit) -> tuple:
+    """Fallback for workers with their own serial ``process``: one
+    honest total under ``device`` beats a wrong re-implementation of
+    a per-salt sweep."""
+    t0 = time.perf_counter()
+    hits = worker.process(unit)
+    return {"device": time.perf_counter() - t0}, hits
+
+
+def probe_phases(worker, unit) -> dict:
+    """Phase breakdown of one synced sweep, no publication -- the
+    bench-side entry (``dprf bench`` reports it as ``phases``)."""
+    strategy = _probe_strategy(worker)
+    if strategy == "wordlist":
+        phases, _ = _probe_wordlist(worker, unit)
+    elif strategy == "digit":
+        phases, _ = _probe_digit(worker, unit)
+    else:
+        phases, _ = _probe_coarse(worker, unit)
+    return phases
+
+
+def probe_pending(worker, unit, sampler: PerfSampler,
+                  trace: Optional[str] = None) -> _ProbedUnit:
+    """The SAMPLED unit's sweep: serial, with block_until_ready
+    boundaries between phases (this is the helper the hot-path
+    modules declare in ``PERF_PROBE`` -- the syncs are the point).
+    Records one ``phase`` span per phase (parented on the
+    pre-allocated sweep span id the caller records the sweep under)
+    plus the phase histogram, and returns a resolved PendingUnit
+    stand-in carrying the spans for RPC shipping."""
+    from dprf_tpu.telemetry.trace import new_span_id
+    strategy = _probe_strategy(worker)
+    if strategy == "wordlist":
+        phases, hits = _probe_wordlist(worker, unit)
+    elif strategy == "digit":
+        phases, hits = _probe_digit(worker, unit)
+    else:
+        phases, hits = _probe_coarse(worker, unit)
+    sweep_span = new_span_id()
+    engine = worker_engine(worker)
+    job = getattr(unit, "job_id", "j0")
+    spans = []
+    ts = time.time() - sum(phases.values())
+    for phase in PHASES:
+        dur = phases.get(phase)
+        if dur is None:
+            continue
+        sampler.hist.observe(dur, phase=phase, engine=engine,
+                             job=str(job))
+        ev = sampler.tracer.record(
+            "phase", dur=dur, ts=ts, trace=trace, parent=sweep_span,
+            phase=phase, unit=unit.unit_id, job=job, engine=engine)
+        ts += dur
+        if ev is not None:
+            spans.append(ev)
+    return _ProbedUnit(hits, phases, spans, sweep_span)
+
+
+# ---------------------------------------------------------------------------
+# roofline model (shared by bench and the live fleet)
+
+def roofline_band_hs(engine: str) -> Optional[tuple]:
+    """(lo, hi) H/s ceiling band for an engine, or None when no op
+    model is published for it.  md5's derived band (3.75-7.5 GH/s)
+    rounds to the documented 4-8 GH/s BASELINE.md band."""
+    if engine == "md5":
+        return (4.0e9, 8.0e9)
+    ops = OPS_PER_CANDIDATE.get(engine)
+    if not ops:
+        return None
+    lo, hi = CHIP_INT_OPS_BAND
+    return (lo / ops, hi / ops)
+
+
+def roofline_fraction(engine: str, rate_hs: float) -> Optional[float]:
+    """Conservative fraction of the roofline band (vs the HI ceiling,
+    like the driver bench's roofline_frac); None when the engine has
+    no model or the rate is not positive."""
+    band = roofline_band_hs(engine)
+    if band is None or not rate_hs or rate_hs <= 0:
+        return None
+    return rate_hs / band[1]
+
+
+def _roofline_gauge(registry=None):
+    return get_registry(registry).gauge(
+        "dprf_roofline_frac",
+        "EWMA-smoothed fraction of the per-engine int32 roofline "
+        "ceiling the observed throughput reaches (conservative: vs "
+        "the band's upper bound)", labelnames=("engine",))
+
+
+def publish_roofline(engine: str, rate_hs: float,
+                     registry=None) -> Optional[float]:
+    """Fold one throughput observation into the live roofline gauge
+    (EWMA against the gauge's current value, so per-unit jitter reads
+    as a rate).  Returns the smoothed fraction, or None when the
+    engine has no published op model."""
+    frac = roofline_fraction(engine, rate_hs)
+    if frac is None:
+        return None
+    g = _roofline_gauge(registry)
+    cur = g.value(engine=engine)
+    smoothed = frac if cur == 0 else cur + ROOFLINE_ALPHA * (frac - cur)
+    g.set(smoothed, engine=engine)
+    return smoothed
+
+
+def roofline_snapshot(registry=None) -> dict:
+    """{engine: smoothed fraction} from the live gauge (the ``dprf
+    top`` header and op_trace_tail status read this)."""
+    m = get_registry(registry).get("dprf_roofline_frac")
+    if m is None:
+        return {}
+    return {v["labels"].get("engine", "?"): v["value"]
+            for v in m.snapshot_values() if v["value"] > 0}
+
+
+def publish_scaling(engine: str, per_chip_hs: float, efficiency: float,
+                    n_devices: int, registry=None) -> None:
+    """Multichip bench publication: per-chip H/s and the 1->N scaling
+    efficiency, next to the roofline gauge -- ONE declaration site for
+    both gauges."""
+    m = get_registry(registry)
+    m.gauge("dprf_per_chip_rate_hs",
+            "per-chip throughput of the last multichip scaling bench",
+            labelnames=("engine",)).set(per_chip_hs, engine=engine)
+    m.gauge("dprf_scaling_efficiency",
+            "rate_N / (N * rate_1) of the last multichip scaling "
+            "bench", labelnames=("engine",)).set(efficiency,
+                                                 engine=engine)
+    publish_roofline(engine, per_chip_hs, registry=registry)

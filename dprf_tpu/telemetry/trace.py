@@ -55,9 +55,11 @@ from typing import Optional
 from dprf_tpu.utils import env as envreg
 
 #: the one declaration site for span names (tools/check_metrics.py
-#: enforces that every record() literal is a member)
+#: enforces that every record() literal is a member).  ``phase`` is a
+#: child of a sampled unit's ``sweep`` span: one per attribution
+#: phase (telemetry/perf.py), attrs carry which phase.
 SPAN_NAMES = ("lease", "rpc", "warmup", "sweep", "hit_verify",
-              "complete", "fail", "reissue", "park")
+              "complete", "fail", "reissue", "park", "phase")
 
 #: suffix appended to a session journal path for its span stream
 TRACE_SUFFIX = ".trace.jsonl"
@@ -91,9 +93,14 @@ MAX_ID_LEN = 64
 GUARDED_BY = {
     "TraceRecorder": {
         "_lock": ("_ring", "_fh", "_path", "_max_bytes",
-                  "_file_bytes"),
+                  "_file_bytes", "_busy"),
     },
 }
+
+#: sliding window (seconds) the live device-busy fraction is computed
+#: over, and the label-cardinality cap for its per-worker gauge
+BUSY_WINDOW_S = 60.0
+MAX_BUSY_WORKERS = 128
 
 #: `dprf check` threads analyzer: the flight-recorder stream is owned
 #: by the recorder across attach/rotate cycles and released by
@@ -153,6 +160,74 @@ def _clean_attrs(attrs) -> dict:
     return out
 
 
+class _BusyTracker:
+    """Incremental per-worker device-busy fraction over a sliding
+    window -- ``trace.overlap_report``'s union-hole math kept LIVE:
+    each sweep span folds its [ts, ts+dur) interval into the worker's
+    merged interval set, intervals older than the window are pruned,
+    and the fraction is covered / elapsed-in-window.  Driven only
+    from TraceRecorder._append under its ``_lock``."""
+
+    __slots__ = ("window", "procs")
+
+    def __init__(self, window: float = BUSY_WINDOW_S):
+        self.window = window
+        #: proc -> sorted merged [[start, end], ...] within the window
+        self.procs: dict = {}
+
+    def _label(self, proc: str) -> str:
+        if proc not in self.procs and len(self.procs) >= MAX_BUSY_WORKERS:
+            return "_overflow"
+        return proc
+
+    def observe(self, proc: str, start: float, end: float,
+                now: float) -> tuple:
+        """Fold one sweep interval in; returns (gauge label, updated
+        fraction)."""
+        proc = self._label(proc)
+        iv = self.procs.setdefault(proc, [])
+        lo, hi = 0, len(iv)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if iv[mid][0] < start:
+                lo = mid + 1
+            else:
+                hi = mid
+        i = lo
+        if i > 0 and iv[i - 1][1] >= start:
+            i -= 1
+            iv[i][1] = max(iv[i][1], end)
+        else:
+            iv.insert(i, [start, end])
+        j = i + 1
+        while j < len(iv) and iv[j][0] <= iv[i][1]:
+            iv[i][1] = max(iv[i][1], iv[j][1])
+            j += 1
+        del iv[i + 1:j]
+        return proc, self._fraction(iv, now)
+
+    def _fraction(self, iv: list, now: float) -> float:
+        """Prune to the window, then covered / elapsed where elapsed
+        runs from max(window start, first retained sweep) to now --
+        so a run younger than the window is not under-read."""
+        floor = now - self.window
+        while iv and iv[0][1] <= floor:
+            iv.pop(0)
+        if iv and iv[0][0] < floor:
+            iv[0][0] = floor
+        if not iv:
+            return 0.0
+        covered = sum(e - s for s, e in iv)
+        span = now - max(floor, iv[0][0])
+        if span <= 0:
+            return 1.0
+        return min(1.0, covered / span)
+
+    def fractions(self, now: float) -> dict:
+        return {proc: round(self._fraction(iv, now), 4)
+                for proc, iv in self.procs.items()}
+
+
 class TraceRecorder:
     """Bounded flight-recorder ring + optional JSONL stream.
 
@@ -174,26 +249,38 @@ class TraceRecorder:
         self._path: Optional[str] = None
         self._max_bytes: Optional[int] = None
         self._file_bytes = 0
+        #: live device-utilization state: sweep spans fold into a
+        #: sliding-window interval union per worker (ISSUE 9)
+        self._busy = _BusyTracker()
         from dprf_tpu.telemetry import get_registry
         self._m_spans = get_registry(registry).counter(
             "dprf_trace_spans_total",
             "lifecycle spans recorded into the flight recorder")
+        self._g_busy = get_registry(registry).gauge(
+            "dprf_device_busy_fraction",
+            "fraction of the sliding window each worker's sweep "
+            "spans cover (union holes = device idle; the live form "
+            "of tools/trace_overlap.py)", labelnames=("worker",))
 
     # -- recording -------------------------------------------------------
 
     def record(self, name: str, dur: float = 0.0, ts: Optional[float] = None,
                trace: Optional[str] = None, parent: Optional[str] = None,
-               proc: Optional[str] = None, **attrs) -> Optional[dict]:
+               proc: Optional[str] = None, span: Optional[str] = None,
+               **attrs) -> Optional[dict]:
         """Record one span; ``ts`` defaults to now - dur (i.e. the
-        caller measured ``dur`` ending now).  Returns the span dict
-        (shippable over RPC) or None when disabled."""
+        caller measured ``dur`` ending now).  ``span`` overrides the
+        generated span id -- how a sampled sweep's pre-allocated id
+        (telemetry/perf.py) lets its phase children parent onto a
+        span recorded later.  Returns the span dict (shippable over
+        RPC) or None when disabled."""
         if not self.enabled:
             return None
         if ts is None:
             ts = self._clock() - dur
         span = {"name": name, "ts": round(float(ts), 6),
                 "dur": round(float(dur), 6), "trace": trace,
-                "parent": parent, "span": new_span_id(),
+                "parent": parent, "span": span or new_span_id(),
                 "proc": proc if proc is not None else self.proc,
                 "attrs": attrs}
         self._append(span)
@@ -247,7 +334,15 @@ class TraceRecorder:
 
     def _append(self, span: dict) -> None:
         self._m_spans.inc()
+        busy = None
         with self._lock:
+            if span["name"] == "sweep" and span["dur"] > 0:
+                # live utilization: fold the sweep interval into the
+                # worker's window union (both local records and
+                # coordinator-rebased ingests land here)
+                busy = self._busy.observe(
+                    str(span.get("proc") or "?"), span["ts"],
+                    span["ts"] + span["dur"], self._clock())
             self._ring.append(span)
             if self._fh is not None:
                 try:
@@ -264,6 +359,10 @@ class TraceRecorder:
                         self._file_bytes += len(data)
                 except OSError:
                     pass   # a full disk must not kill the job
+        if busy is not None:
+            # gauge set OUTSIDE _lock: code holding _lock must never
+            # call into other locked subsystems (lock-order contract)
+            self._g_busy.set(busy[1], worker=busy[0])
 
     def _rotate_locked(self) -> None:
         """Size-cap rotation: the stream moves to ``<path>.1``
@@ -384,9 +483,18 @@ class TraceRecorder:
         out = items if idx is None else items[idx + 1:]
         return [dict(s) for s in out[:max(1, int(n))]], resync
 
+    def busy_fractions(self) -> dict:
+        """{worker: live busy fraction} over the sliding window,
+        recomputed against the current clock (so an idle fleet's
+        fractions decay between sweeps) -- the op_trace_tail status
+        payload and the ``dprf top`` header read this."""
+        with self._lock:
+            return self._busy.fractions(self._clock())
+
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
+            self._busy.procs.clear()
 
 
 #: process-wide recorder, like telemetry.DEFAULT: library code with no
@@ -614,12 +722,25 @@ def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
         if dt > 0:
             rate = f" | {max(done - s_prev.get('done', 0), 0) / dt:,.0f}/s"
     state = "FINISHED" if status.get("stop") else "running"
+    # live utilization & roofline distance (ISSUE 9): mean sweep-span
+    # window coverage across workers, and the per-engine fraction of
+    # the int32 roofline ceiling the fleet's throughput reaches
+    busy = status.get("busy") or {}
+    busy_s = ""
+    if busy:
+        busy_s = (f" | busy {100.0 * sum(busy.values()) / len(busy):.0f}%"
+                  f" ({len(busy)}w)")
+    roofline = status.get("roofline") or {}
+    roof_s = ""
+    if roofline:
+        roof_s = " | roofline " + " ".join(
+            f"{e}:{f:.2f}" for e, f in sorted(roofline.items()))
     lines.append(
         f"dprf top — {state} | found {status.get('found', 0)}"
         f"/{status.get('targets', '?')} | "
         f"{100.0 * done / total:.2f}% covered | parked "
         f"{status.get('parked', 0)} | elapsed "
-        f"{status.get('elapsed', 0.0):.0f}s{rate}")
+        f"{status.get('elapsed', 0.0):.0f}s{rate}{busy_s}{roof_s}")
     quarantined = status.get("quarantined") or []
     if quarantined:
         lines.append(f"quarantined workers: {', '.join(quarantined)}")
@@ -641,7 +762,9 @@ def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
                 f"{str(j.get('state'))[:10]:10s} {cov:>20s} "
                 f"{fnd:>7s} {j.get('outstanding', 0):>4d} "
                 f"{j.get('leases', 0):>7d}")
-    # per-worker table: current lease + the worker's most recent span
+    # per-worker table: current lease + the worker's most recent span,
+    # GROUPED by the job each worker is currently leased to (so a
+    # multi-tenant fleet reads per job), with the live busy fraction
     last_span: dict = {}
     for s in spans:
         last_span[str(s.get("proc"))] = s
@@ -649,9 +772,14 @@ def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
     workers = sorted(set(by_worker)
                      | {p for p in last_span
                         if p not in ("coordinator",)})
+    # grouping key: the worker's current job first ("-" for idle
+    # workers, sorted last), then worker id -- stable per-job blocks
+    workers.sort(key=lambda w: (
+        str((by_worker.get(w) or {}).get("job", "~")), w))
     lines.append("")
-    lines.append(f"{'WORKER':20s} {'STATE':10s} {'UNIT':>8s} "
-                 f"{'RANGE':>24s} {'LEASE':>8s} {'LAST SPAN':>10s}")
+    lines.append(f"{'WORKER':20s} {'JOB':>5s} {'STATE':10s} "
+                 f"{'UNIT':>8s} {'RANGE':>24s} {'LEASE':>8s} "
+                 f"{'BUSY':>5s} {'LAST SPAN':>10s}")
     # ages against the COORDINATOR's clock (shipped in status): the
     # spans carry its wall time, and the viewer's clock may be skewed
     now = status.get("now") or time.time()
@@ -661,16 +789,19 @@ def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
         state = s["name"] if s else ("sweep" if lease else "idle")
         # the unit column names the owning job too (unit ids are only
         # unique within a job's ledger)
-        unit = (f"{lease.get('job', '?')}#{lease['unit']}"
-                if lease else "-")
+        jid = str(lease.get("job", "?")) if lease else "-"
+        unit = f"{jid}#{lease['unit']}" if lease else "-"
         rng = (f"[{lease['start']},{lease['start'] + lease['length']})"
                if lease else "-")
         dl = _fmt_age(lease["deadline_s"]) if lease else "-"
+        b = busy.get(w)
+        b_s = f"{100.0 * b:.0f}%" if b is not None else "-"
         age = (_fmt_age(max(0.0, now - (s.get("ts", now)
                                         + s.get("dur", 0.0))))
                if s else "-")
-        lines.append(f"{w[:20]:20s} {state:10s} {unit:>8s} {rng:>24s} "
-                     f"{dl:>8s} {age:>10s}")
+        lines.append(f"{w[:20]:20s} {jid[:5]:>5s} {state:10s} "
+                     f"{unit:>8s} {rng:>24s} {dl:>8s} {b_s:>5s} "
+                     f"{age:>10s}")
     lines.append("")
     lines.append("recent spans:")
     for s in spans[-8:]:

@@ -123,6 +123,11 @@ _declare("DPRF_ASYNC_WARMUP", True, "bool",
 _declare("DPRF_NATIVE", True, "bool",
          "Native (C) wordlist scanner; 0 forces the pure-Python "
          "fallback.")
+_declare("DPRF_JOB_TTL_S", 86400.0, "float",
+         "Age-based job GC: done/cancelled jobs older than this many "
+         "seconds are reaped from the scheduler table (journaled as "
+         "job_gc records) so long-lived fleets never wedge at the "
+         "MAX_JOBS cap; 0 disables reaping.")
 _declare("DPRF_PIPELINE_DEPTH", 2, "int",
          "Units submitted ahead of the oldest unresolved one in the "
          "local and remote worker loops (1 = serial fallback).")
@@ -144,6 +149,11 @@ _declare("DPRF_TUNE_DIR", None, "path",
          "directory, else ~/.cache/dprf).")
 
 # -- observability -----------------------------------------------------------
+_declare("DPRF_PERF_SAMPLE", 16, "int",
+         "Per-phase sweep attribution cadence: every Nth unit runs a "
+         "serial, synced probe recording phase spans and the "
+         "dprf_phase_seconds histogram (telemetry/perf.py); 0 "
+         "disables sampling.")
 _declare("DPRF_JAX_PROFILE", None, "path",
          "Write a jax.profiler trace of the sweep loops to this "
          "directory (kernel-level drill-down beside the span "

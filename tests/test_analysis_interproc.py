@@ -1044,3 +1044,174 @@ def test_explain_real_repo_declares_all_tables():
         text = analysis.explain(REPO, name)
         assert "Declarations in this repo:" in text
         assert needle in text
+
+
+# ---------------------------------------------------------------------------
+# retrace: attribute-target taint (ISSUE 9 satellite) -- the device
+# value must not launder out of the taint set through `self.attr = ...`
+
+def test_retrace_attribute_target_taint_caught(tmp_path):
+    root = make_repo(tmp_path, {"dprf_tpu/hot.py": """\
+        import jax
+
+        def ident(x):
+            return x
+
+        HOT_PATHS = ("W.sweep",)
+
+        class W:
+            def __init__(self):
+                self.step = jax.jit(ident)
+
+            def sweep(self, units):
+                out = 0
+                for u in units:
+                    self._flag = self.step(u)
+                    out += int(self._flag)
+                return out
+"""})
+    f = bad(check(root, "retrace"))
+    assert len(f) == 1 and "int() on a device value" in f[0].message
+
+
+def test_retrace_attribute_flag_read_after_loop_clean(tmp_path):
+    root = make_repo(tmp_path, {"dprf_tpu/hot.py": """\
+        import jax
+
+        def ident(x):
+            return x
+
+        HOT_PATHS = ("W.sweep",)
+
+        class W:
+            def __init__(self):
+                self.step = jax.jit(ident)
+                self._flag = None
+
+            def sweep(self, units):
+                for u in units:
+                    f = self.step(u)
+                    self._flag = f if self._flag is None \
+                        else self._flag + f
+                return int(self._flag)
+"""})
+    assert bad(check(root, "retrace")) == []
+
+
+def test_retrace_attribute_truth_test_caught(tmp_path):
+    root = make_repo(tmp_path, {"dprf_tpu/hot.py": """\
+        import jax
+
+        def ident(x):
+            return x
+
+        HOT_PATHS = ("W.sweep",)
+
+        class W:
+            def __init__(self):
+                self.step = jax.jit(ident)
+
+            def sweep(self, units):
+                hits = []
+                for u in units:
+                    self._flag = self.step(u)
+                    if self._flag:
+                        hits.append(u)
+                return hits
+"""})
+    f = bad(check(root, "retrace"))
+    assert len(f) == 1 and "implicit bool()" in f[0].message
+
+
+# ---------------------------------------------------------------------------
+# retrace: PERF_PROBE declared sampled-probe exemption (ISSUE 9)
+
+def test_retrace_undeclared_probe_helper_caught(tmp_path):
+    root = make_repo(tmp_path, {"dprf_tpu/hot.py": RETRACE_HEAD + """\
+
+    HOT_PATHS = ("sweep",)
+
+    def grab(r):
+        return r.item()
+
+    def sweep(units):
+        out = 0
+        for u in units:
+            r = step(u)
+            out += grab(r)
+        return out
+"""})
+    f = bad(check(root, "retrace"))
+    assert len(f) == 1 and "syncs the device value" in f[0].message
+
+
+def test_retrace_declared_perf_probe_exempt(tmp_path):
+    root = make_repo(tmp_path, {"dprf_tpu/hot.py": RETRACE_HEAD + """\
+
+    HOT_PATHS = ("sweep",)
+    PERF_PROBE = ("grab",)
+
+    def grab(r):
+        return r.item()
+
+    def sweep(units):
+        out = 0
+        for u in units:
+            r = step(u)
+            out += grab(r)
+        return out
+"""})
+    assert bad(check(root, "retrace")) == []
+
+
+def test_retrace_dotted_perf_probe_resolves_cross_module(tmp_path):
+    root = make_repo(tmp_path, {
+        "dprf_tpu/probe_mod.py": """\
+            def grab(r):
+                return r.item()
+        """,
+        "dprf_tpu/hot.py": RETRACE_HEAD + """\
+
+    from dprf_tpu.probe_mod import grab
+
+    HOT_PATHS = ("sweep",)
+    PERF_PROBE = ("dprf_tpu.probe_mod.grab",)
+
+    def sweep(units):
+        out = 0
+        for u in units:
+            r = step(u)
+            out += grab(r)
+        return out
+"""})
+    assert bad(check(root, "retrace")) == []
+
+
+def test_retrace_stale_perf_probe_entry_is_finding(tmp_path):
+    root = make_repo(tmp_path, {"dprf_tpu/hot.py": RETRACE_HEAD + """\
+
+    HOT_PATHS = ("sweep",)
+    PERF_PROBE = ("nope",)
+
+    def sweep(units):
+        r = None
+        for u in units:
+            r = step(u)
+        return r
+"""})
+    f = bad(check(root, "retrace"))
+    assert len(f) == 1 and "stale declaration" in f[0].message
+    assert "nope" in f[0].message
+
+
+def test_retrace_probe_table_without_hot_paths_is_finding(tmp_path):
+    root = make_repo(tmp_path, {"dprf_tpu/hot.py": """\
+        HOT_PATHS = ()
+        PERF_PROBE = ("grab",)
+
+        def grab(r):
+            return r.item()
+"""})
+    f = bad(check(root, "retrace"))
+    assert len(f) == 1 and "exemption applies to nothing" \
+        in f[0].message

@@ -949,3 +949,99 @@ def test_trace_pull_cli_writes_export_compatible_file(tmp_path,
         assert any(e.get("ph") == "X" for e in events)
     finally:
         server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# age-based job GC (ISSUE 9 satellite): DPRF_JOB_TTL_S reaps terminal
+# jobs so long-lived fleets never wedge at the MAX_JOBS cap
+
+def test_job_gc_reaps_terminal_jobs_after_ttl(monkeypatch):
+    monkeypatch.setenv("DPRF_JOB_TTL_S", "100")
+    clk = [0.0]
+    reg = MetricsRegistry()
+    s = _sched(reg, clock=lambda: clk[0])
+    default = _add(s, reg)
+    a = _add(s, reg)
+    b = _add(s, reg)
+    s.cancel(a.job_id)
+    clk[0] = 50.0
+    # terminal but younger than the TTL: stays
+    assert s.maybe_gc(keep=(default.job_id,), force=True) == []
+    clk[0] = 200.0
+    reaped = s.maybe_gc(keep=(default.job_id,), force=True)
+    assert [j.job_id for j in reaped] == [a.job_id]
+    assert s.get(a.job_id) is None
+    assert s.get(b.job_id) is b          # running jobs never reaped
+    # the protected (default) job survives even terminal and ancient
+    s.cancel(default.job_id)
+    s.cancel(b.job_id)
+    clk[0] = 1000.0
+    reaped = s.maybe_gc(keep=(default.job_id,), force=True)
+    assert [j.job_id for j in reaped] == [b.job_id]
+    assert s.get(default.job_id) is default
+
+
+def test_job_gc_rate_limited_unless_forced(monkeypatch):
+    monkeypatch.setenv("DPRF_JOB_TTL_S", "10")
+    clk = [0.0]
+    reg = MetricsRegistry()
+    s = _sched(reg, clock=lambda: clk[0])
+    default = _add(s, reg)
+    a = _add(s, reg)
+    s.cancel(a.job_id)
+    clk[0] = 1.0
+    assert s.maybe_gc(keep=(default.job_id,)) == []   # young; scans
+    clk[0] = 20.0
+    # within the 30 s scan interval of the last scan: unforced no-op,
+    # forced reaps
+    assert s.maybe_gc(keep=(default.job_id,)) == []
+    reaped = s.maybe_gc(keep=(default.job_id,), force=True)
+    assert [j.job_id for j in reaped] == [a.job_id]
+
+
+def test_job_gc_disabled_with_zero_ttl(monkeypatch):
+    monkeypatch.setenv("DPRF_JOB_TTL_S", "0")
+    clk = [0.0]
+    reg = MetricsRegistry()
+    s = _sched(reg, clock=lambda: clk[0])
+    default = _add(s, reg)
+    a = _add(s, reg)
+    s.cancel(a.job_id)
+    clk[0] = 1e9
+    assert s.maybe_gc(keep=(default.job_id,), force=True) == []
+    assert s.get(a.job_id) is a
+
+
+def test_job_gc_on_lease_path_fires_journal_hook(monkeypatch):
+    monkeypatch.setenv("DPRF_JOB_TTL_S", "5")
+    reg = MetricsRegistry()
+    rec = TraceRecorder(enabled=False, registry=reg)
+    clk = [0.0]
+    sched = _sched(reg, clock=lambda: clk[0])
+    disp = _disp(reg, rec=rec)
+    events = []
+    state = CoordinatorState(
+        {"engine": "md5"}, disp, 1, registry=reg, recorder=rec,
+        scheduler=sched,
+        on_job_event=lambda kind, job: events.append((kind,
+                                                      job.job_id)))
+    tenant = _add(sched, reg, rec=rec)
+    sched.cancel(tenant.job_id)
+    clk[0] = 100.0
+    state.op_lease({"worker_id": "w0", "ahead": 1})
+    assert state.scheduler.get(tenant.job_id) is None
+    assert ("gc", tenant.job_id) in events
+
+
+def test_session_journal_job_gc_record_drops_job(tmp_path):
+    path = str(tmp_path / "s.session")
+    j = SessionJournal(path)
+    j.open({"fingerprint": "x"})
+    j.record_job("j1", {"engine": "md5"}, owner="alice")
+    j.record_job("j2", {"engine": "md5"}, owner="bob")
+    j.record_job_state("j1", "cancelled")
+    j.record_job_gc("j1")
+    j.close()
+    st = SessionJournal.load(path)
+    assert "j1" not in st.jobs          # GC'd: restore must skip it
+    assert "j2" in st.jobs

@@ -272,6 +272,12 @@ def test_distributed_reissue_stitches_both_workers_onto_one_trace(tmp_path):
     assert len(leases) == 2
     first_lease, second_lease = leases
     for s in spans:
+        if s["name"] == "phase":
+            # sampled-probe phase children parent onto their unit's
+            # SWEEP span (same proc), not the lease span directly
+            assert by_id[s["parent"]]["name"] == "sweep"
+            assert by_id[s["parent"]]["proc"] == s["proc"]
+            continue
         if s["proc"] == "wA":
             assert s["parent"] == first_lease["span"]
         if s["proc"] == "wB":

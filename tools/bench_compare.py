@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Bench regression sentinel CLI (ISSUE 9).
+
+Gate a bench measurement against the committed BENCH_r*.json
+trajectory: the baseline is the median of the last K records measured
+on the SAME device backend, with a noise tolerance derived from their
+observed run-to-run spread (never below the 10% floor).  Exit 1 on
+regression, 0 on pass/no-baseline, 2 on unusable input.
+
+Usage::
+
+    python tools/bench_compare.py --current result.json   # gate a file
+    python tools/bench_compare.py --dry                   # newest committed
+                                                          # record vs the
+                                                          # window before it
+        [--dir REPO] [--window K] [--quiet]
+
+The comparison logic lives in dprf_tpu/perfreport/compare.py, shared
+with ``dprf bench --gate``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="gate a bench result against the committed "
+        "BENCH_r*.json baseline window")
+    ap.add_argument("--current", default=None, metavar="FILE",
+                    help="bench result JSON to gate (a dprf bench "
+                    "stdout line or a driver BENCH record)")
+    ap.add_argument("--dry", action="store_true",
+                    help="gate the newest committed record against "
+                    "the window before it (no fresh measurement)")
+    ap.add_argument("--dir", default=None, metavar="REPO",
+                    help="directory holding BENCH_r*.json (default: "
+                    "the repo root this tree is installed in)")
+    ap.add_argument("--window", type=int, default=None, metavar="K")
+    ap.add_argument("--quiet", "-q", action="store_true")
+    args = ap.parse_args(argv)
+
+    from dprf_tpu.perfreport import compare
+
+    repo = args.dir or compare.repo_root()
+    window = args.window or compare.DEFAULT_WINDOW
+    if args.dry:
+        verdict = compare.gate_dry(repo, window=window)
+    elif args.current:
+        try:
+            with open(args.current, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench_compare: unreadable --current: {e}",
+                  file=sys.stderr)
+            return 2
+        if isinstance(doc, dict) and isinstance(doc.get("tail"), str):
+            doc = compare._result_from_tail(doc["tail"]) or {}
+        verdict = compare.gate_repo(doc, repo, window=window)
+    else:
+        print("bench_compare: pass --current FILE or --dry",
+              file=sys.stderr)
+        return 2
+    print(json.dumps(verdict, sort_keys=True))
+    if not args.quiet and verdict["verdict"] == "regression":
+        print(f"bench_compare: REGRESSION — current/median ratio "
+              f"{verdict['ratio']} below tolerance "
+              f"{verdict['tolerance']} (window of "
+              f"{verdict['window']})", file=sys.stderr)
+    return 1 if verdict["verdict"] == "regression" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
