@@ -12,7 +12,14 @@ a runtime ValueError, depending on which import runs first.  Rules:
      ONE call site across the package;
   2. every span-name literal passed to a ``.record("...")`` call is a
      member of ``telemetry/trace.py``'s ``SPAN_NAMES`` tuple, which
-     holds no duplicates.
+     holds no duplicates;
+  3. every metric an ALERT RULE references (ISSUE 10) -- the
+     ``DEFAULT_RULES`` literal pack in ``telemetry/alerts.py`` and
+     any ``DPRF_ALERT_RULES``-style fixture file under
+     ``tests/fixtures/alert_rules*.json`` -- names a declared
+     ``dprf_*`` metric.  A renamed metric would otherwise silently
+     disarm its rule: the alert engine evaluates "condition false"
+     against a metric that no longer exists, forever.
 """
 
 from __future__ import annotations
@@ -25,10 +32,12 @@ from dprf_tpu.analysis import Finding
 
 NAME = "metrics"
 DESCRIPTION = ("every dprf_* metric declared at one site; every span "
-               "literal is in SPAN_NAMES")
+               "literal is in SPAN_NAMES; every alert rule "
+               "references a declared metric")
 
 METRIC_METHODS = {"counter", "gauge", "histogram"}
 TRACE_REL = os.path.join("telemetry", "trace.py")
+ALERTS_REL = os.path.join("telemetry", "alerts.py")
 
 #: parse prefilter: a file with no metric/record call text cannot
 #: contribute a declaration or span use
@@ -54,6 +63,98 @@ def _scan_file(idx):
         elif node.func.attr == "record" and first is not None:
             span_uses.append((first, node.lineno))
     return decls, span_uses
+
+
+def _alert_rule_refs(idx):
+    """(rule name, metric, lineno) triples from the ``DEFAULT_RULES``
+    assignment -- a list of PURE dict literals by contract (the alert
+    engine and this check share that shape), so the AST read is
+    exact, or None when the assignment is missing."""
+    if idx is None:
+        return None
+    for node in idx.assigns:
+        if not any(isinstance(t, ast.Name) and t.id == "DEFAULT_RULES"
+                   for t in node.targets):
+            continue
+        if not isinstance(node.value, (ast.List, ast.Tuple)):
+            return None
+        out = []
+        for elt in node.value.elts:
+            if not isinstance(elt, ast.Dict):
+                continue
+            d = {}
+            for k, v in zip(elt.keys, elt.values):
+                kk = _literal(k)
+                if kk in ("name", "metric"):
+                    d[kk] = _literal(v)
+            out.append((d.get("name"), d.get("metric"), elt.lineno))
+        return out
+    return None
+
+
+def _check_alert_rules(ctx, pkg_dir: str, declared: set) -> list:
+    """Rule-pack validation (rule 3 of the module docstring): the
+    default pack in telemetry/alerts.py plus every
+    tests/fixtures/alert_rules*.json file an operator or test might
+    feed DPRF_ALERT_RULES."""
+    import json
+    out = []
+    alerts_py = os.path.join(pkg_dir, ALERTS_REL)
+    if os.path.exists(alerts_py):
+        rel = ctx.rel(alerts_py)
+        refs = _alert_rule_refs(ctx.index(alerts_py))
+        if refs is None:
+            out.append(Finding(
+                NAME, rel, 1,
+                "DEFAULT_RULES literal rule pack not found in "
+                "telemetry/alerts.py (it must stay a list of pure "
+                "dict literals so this check can read it)"))
+            refs = []
+        for rule, metric, lineno in refs:
+            if not metric:
+                out.append(Finding(
+                    NAME, rel, lineno,
+                    f"alert rule {rule!r} has no literal 'metric' "
+                    "key"))
+            elif metric not in declared:
+                out.append(Finding(
+                    NAME, rel, lineno,
+                    f"alert rule {rule!r} references metric "
+                    f"{metric!r} that no package call site declares "
+                    "-- stale or undeclared; the rule would be "
+                    "silently disarmed"))
+    fixtures = os.path.join(ctx.tests_dir, "fixtures")
+    if os.path.isdir(fixtures):
+        for fn in sorted(os.listdir(fixtures)):
+            if not (fn.startswith("alert_rules")
+                    and fn.endswith(".json")):
+                continue
+            p = os.path.join(fixtures, fn)
+            try:
+                with open(p, encoding="utf-8") as fh:
+                    doc = json.load(fh)
+            except (OSError, ValueError):
+                out.append(Finding(
+                    NAME, ctx.rel(p), 1,
+                    "alert-rules fixture does not parse as JSON"))
+                continue
+            if not isinstance(doc, list):
+                out.append(Finding(
+                    NAME, ctx.rel(p), 1,
+                    "alert-rules fixture must be a JSON list of "
+                    "rule objects"))
+                continue
+            for i, r in enumerate(doc):
+                rule = r.get("name") if isinstance(r, dict) else f"#{i}"
+                metric = (r.get("metric")
+                          if isinstance(r, dict) else None)
+                if not isinstance(metric, str) or metric not in declared:
+                    out.append(Finding(
+                        NAME, ctx.rel(p), 1,
+                        f"alert rule {rule!r} references metric "
+                        f"{metric!r} that is not a declared dprf_* "
+                        "metric"))
+    return out
 
 
 def _declared_span_names(idx):
@@ -123,4 +224,8 @@ def run(ctx) -> list:
                     NAME, rel, lineno,
                     f"span {span!r} not declared in "
                     "telemetry/trace.py SPAN_NAMES"))
+
+    # alert rules (default pack + fixture files) must reference
+    # declared metrics only
+    out.extend(_check_alert_rules(ctx, pkg_dir, set(decl_sites)))
     return out

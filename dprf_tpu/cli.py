@@ -9,7 +9,10 @@ Subcommands: crack (local job), serve + worker (distributed job:
 coordinator RPC + remote workers, runtime/rpc.py), bench, prewarm
 (ahead-of-time compile-cache population), retry-parked (admin op on a
 running coordinator), top (live fleet view from the flight recorder),
-trace export (session span stream -> Perfetto), engines, keyspace.
+health + alerts (fleet health plane: worker state machine, per-job
+SLOs, alert engine -- ISSUE 10), token (mint owner-scoped tenant
+tokens), trace export (session span stream -> Perfetto), engines,
+keyspace.
 """
 
 from __future__ import annotations
@@ -442,6 +445,38 @@ def _build_parser() -> argparse.ArgumentParser:
                      "coordinator (default: $DPRF_TOKEN)")
     tpl.add_argument("--timeout", type=float, default=30.0)
     tpl.add_argument("--quiet", "-q", action="store_true")
+
+    hl = sub.add_parser("health", help="fleet health view of a "
+                        "running coordinator: per-worker state "
+                        "machine (healthy/degraded/missing/dead), "
+                        "straggler flags, per-job SLOs (ETA, "
+                        "time-to-first-hit, stall), active alerts")
+    hl.add_argument("--json", action="store_true",
+                    help="machine-readable snapshot on stdout (the "
+                    "CI artifact format)")
+    _jobs_client_args(hl)
+
+    al = sub.add_parser("alerts", help="alert surface of a running "
+                        "coordinator: active (pending/firing) alerts "
+                        "and the recent transition history (the full "
+                        "log is the session's .alerts.jsonl)")
+    al.add_argument("--json", action="store_true",
+                    help="machine-readable alerts on stdout")
+    al.add_argument("--history", type=int, default=50, metavar="N",
+                    help="recent transition events to fetch")
+    _jobs_client_args(al)
+
+    tok = sub.add_parser("token", help="mint an owner-scoped tenant "
+                         "token from the coordinator's admin secret: "
+                         "a client authenticating with it may only "
+                         "cancel/pause/resume/pull its OWN jobs, and "
+                         "its submissions are forced to that owner")
+    tok.add_argument("--owner", required=True,
+                     help="tenant name (1-64 chars of [A-Za-z0-9_-])")
+    tok.add_argument("--token", default=None,
+                     help="the coordinator's ADMIN secret (default: "
+                     "$DPRF_TOKEN)")
+    tok.add_argument("--quiet", "-q", action="store_true")
 
     rpt = sub.add_parser("report", help="one-shot performance report "
                          "from session artifacts alone (trace JSONL "
@@ -1177,17 +1212,15 @@ def cmd_serve(args, log: Log) -> int:
     if token:
         log.info("worker authentication enabled")
     if session is not None:
-        session.open(spec.as_dict())
+        # default_job in the header lets resume fold the (now always
+        # tagged) default-job lines back into the flat fields
+        session.open(spec.as_dict(),
+                     default_job=state.default_job_id)
         # stream the fleet's lifecycle spans (incl. the ones remote
         # workers ship back) next to the journal for dprf trace export
         tracer.attach_file(session.trace_path)
-
-    def on_hit(ti, cand, plain):
-        log.info("cracked", target=hl.targets[ti].raw[:32], lane=cand)
-        if potfile is not None:
-            potfile.add(hl.targets[ti].raw, plain)
-        if session is not None:
-            session.record_hit(ti, cand, plain)
+        # alert transitions land beside them (<session>.alerts.jsonl)
+        state.alerts.attach_file(session.alerts_path)
 
     def on_progress(done, total, nfound):
         # done/total/nfound aggregate over EVERY non-cancelled job
@@ -1196,15 +1229,17 @@ def cmd_serve(args, log: Log) -> int:
                      found=nfound)
 
     # -- multi-tenant hooks (jobs/scheduler.py; all fire under
-    # state.lock, so the journal writes below serialize) -------------
+    # state.lock, so the journal writes below serialize).  ONE hit
+    # path for every job including the default (ISSUE 10: the
+    # untagged dual-write path is gone -- new journals tag every
+    # units/hit line with its job id) -------------------------------
 
     def on_job_hit(job, ti, cand, plain):
-        # the DEFAULT job's hits flow through on_hit above -- untagged
-        # journal lines, exactly the single-job format
         if job.job_id == state.default_job_id:
-            return
-        raws = job.spec.get("targets") or []
-        raw = raws[ti] if 0 <= ti < len(raws) else str(ti)
+            raw = hl.targets[ti].raw
+        else:
+            raws = job.spec.get("targets") or []
+            raw = raws[ti] if 0 <= ti < len(raws) else str(ti)
         log.info("cracked", job=job.job_id, target=str(raw)[:32],
                  lane=cand)
         if potfile is not None:
@@ -1214,9 +1249,7 @@ def cmd_serve(args, log: Log) -> int:
 
     def on_job_progress(jid, intervals):
         if session is not None:
-            session.record_units(
-                intervals,
-                job=None if jid == state.default_job_id else jid)
+            session.record_units(intervals, job=jid)
 
     def on_job_event(kind, job):
         if session is None:
@@ -1232,11 +1265,22 @@ def cmd_serve(args, log: Log) -> int:
         else:
             session.record_job_state(job.job_id, job.state)
 
-    state.on_hit = on_hit
+    def on_worker_health(tr):
+        # fleet health transitions -> {"type": "worker_health"}
+        # journal records (fired by health_tick under state.lock, so
+        # these writes serialize with the hit/progress writers)
+        log.info("worker health", worker=tr.get("worker"),
+                 frm=tr.get("from"), to=tr.get("to"))
+        if session is not None:
+            session.record_worker_health(
+                tr.get("worker"), tr.get("from"), tr.get("to"),
+                ts=tr.get("ts"), age_s=tr.get("age_s"))
+
     state.on_progress = on_progress
     state.on_job_hit = on_job_hit
     state.on_job_progress = on_job_progress
     state.on_job_event = on_job_event
+    state.on_worker_health = on_worker_health
     from dprf_tpu.runtime.coordinator import preload_potfile
     # restored hits go through the default job's hit BUFFER (not just
     # the found dict) so op_hits_pull clients see them too
@@ -1269,9 +1313,15 @@ def cmd_serve(args, log: Log) -> int:
         snap = TelemetrySnapshotter(session.telemetry_path,
                                     state.registry,
                                     interval=snapshot_interval()).start()
+    # the fleet health plane's evaluation loop (ISSUE 10): worker
+    # state machine + stragglers + per-job SLOs + alert rules, every
+    # DPRF_ALERT_EVAL_S seconds
+    from dprf_tpu.telemetry.health import HealthMonitor
+    monitor = HealthMonitor(state.health_tick).start()
     try:
         server.serve_until_done()
     finally:
+        monitor.stop()
         if snap is not None:
             snap.stop()
             log.info("telemetry snapshots written",
@@ -1291,9 +1341,7 @@ def cmd_serve(args, log: Log) -> int:
                    for j in state.scheduler.jobs()]
     if session is not None:
         for jid, intervals, _, _ in per_job:
-            session.snapshot(
-                intervals,
-                job=None if jid == state.default_job_id else jid)
+            session.snapshot(intervals, job=jid)
         session.close()
     _print_results(found, hl.targets)
     for jid, _, parked, parked_idx in per_job:
@@ -1953,6 +2001,124 @@ def cmd_report(args, log: Log) -> int:
     return 0
 
 
+def _fmt_eta(v) -> str:
+    if v is None:
+        return "?"
+    if v >= 3600:
+        return f"{v / 3600:.1f}h"
+    if v >= 120:
+        return f"{v / 60:.1f}m"
+    return f"{v:.0f}s"
+
+
+def cmd_health(args, log: Log) -> int:
+    """`dprf health --connect`: the fleet health plane's live view --
+    per-worker state machine + payloads, per-job SLOs, active alerts
+    (rpc.op_health)."""
+    import json as _json
+
+    client = _jobs_client(args, log)
+    try:
+        resp = client.call("health")
+    finally:
+        client.close()
+    workers = resp.get("workers") or {}
+    jobs = resp.get("jobs") or []
+    active = resp.get("alerts") or []
+    if args.json:
+        print(_json.dumps({"workers": workers, "jobs": jobs,
+                           "alerts": active}, sort_keys=True))
+        return 0
+    firing = [a for a in active if a.get("state") == "firing"]
+    if firing:
+        print(f"FIRING: {', '.join(a['rule'] for a in firing)}")
+    print(f"{'WORKER':20s} {'STATE':>9s} {'AGE':>6s} {'RATE':>12s} "
+          f"{'STRAG':>5s} {'ENGINE':>8s} {'Q':>3s}")
+    for w in sorted(workers):
+        rec = workers[w]
+        pl = rec.get("payload") or {}
+        rate = rec.get("rate_hs")
+        print(f"{w[:20]:20s} {str(rec.get('state'))[:9]:>9s} "
+              f"{rec.get('age_s', 0):>5.0f}s "
+              f"{(f'{rate:,.0f}/s' if rate else '-'):>12s} "
+              f"{('yes' if rec.get('straggler') else '-'):>5s} "
+              f"{str(pl.get('engine') or '-')[:8]:>8s} "
+              f"{str(pl.get('queue') if pl.get('queue') is not None else '-'):>3s}")
+    print()
+    print(f"{'JOB':6s} {'STATE':>9s} {'COVERED':>20s} {'RATE':>12s} "
+          f"{'ETA':>7s} {'TTFH':>7s} {'STALL':>5s}")
+    for j in jobs:
+        cov = f"{j.get('covered', 0)}/{j.get('total', 0)}"
+        rate = j.get("rate_ips")
+        ttfh = j.get("ttfh_s")
+        print(f"{str(j.get('job'))[:6]:6s} "
+              f"{str(j.get('state'))[:9]:>9s} {cov:>20s} "
+              f"{(f'{rate:,.0f}/s' if rate else '-'):>12s} "
+              f"{_fmt_eta(j.get('eta_s')):>7s} "
+              f"{(f'{ttfh:.1f}s' if ttfh is not None else '-'):>7s} "
+              f"{('YES' if j.get('stalled') else '-'):>5s}")
+    log.info("fleet health", workers=len(workers), jobs=len(jobs),
+             firing=len(firing))
+    return 0
+
+
+def cmd_alerts(args, log: Log) -> int:
+    """`dprf alerts --connect`: active alerts + the recent
+    pending/firing/resolved transition history (rpc.op_alerts)."""
+    import json as _json
+
+    client = _jobs_client(args, log)
+    try:
+        resp = client.call("alerts", n=args.history)
+    finally:
+        client.close()
+    active = resp.get("alerts") or []
+    history = resp.get("history") or []
+    if args.json:
+        print(_json.dumps({"alerts": active, "history": history},
+                          sort_keys=True))
+        return 0
+    if not active:
+        print("no active alerts")
+    else:
+        print(f"{'RULE':20s} {'STATE':>8s} {'SEV':>8s} {'FOR':>7s} "
+              f"{'VALUE':>10s} {'LABELS'}")
+        for a in active:
+            lv = ",".join(f"{k}={v}" for k, v in
+                          sorted((a.get("labels") or {}).items()))
+            print(f"{str(a.get('rule'))[:20]:20s} "
+                  f"{str(a.get('state')):>8s} "
+                  f"{str(a.get('severity'))[:8]:>8s} "
+                  f"{a.get('since_s', 0):>6.0f}s "
+                  f"{a.get('value', 0):>10.3g} {lv}")
+    if history:
+        print()
+        print("recent transitions:")
+        for e in history[-args.history:]:
+            lv = ",".join(str(v) for _, v in
+                          sorted((e.get("labels") or {}).items()))
+            print(f"  {e.get('rule')}({lv}) -> {e.get('state')} "
+                  f"value={e.get('value')}")
+    log.info("alerts", active=len(active), history=len(history))
+    return 0
+
+
+def cmd_token(args, log: Log) -> int:
+    """`dprf token --owner NAME`: mint a tenant token from the admin
+    secret (rpc.owner_token).  Hand the printed token to the tenant;
+    the coordinator re-derives it from the admin secret at hello, so
+    no token table exists anywhere."""
+    from dprf_tpu.runtime.rpc import owner_token
+
+    secret = args.token or envreg.get_str("DPRF_TOKEN") or None
+    if not secret:
+        log.error("minting needs the coordinator's admin secret "
+                  "(--token or $DPRF_TOKEN)")
+        return 2
+    print(owner_token(secret, args.owner))
+    return 0
+
+
 def cmd_metrics(args, log: Log) -> int:
     """Scrape a running coordinator: plain HTTP GET on the RPC port
     (no client library; works for curl/Prometheus too).  --json asks
@@ -2126,6 +2292,9 @@ _COMMANDS = {
     "retry-parked": cmd_retry_parked,
     "top": cmd_top,
     "trace": cmd_trace,
+    "health": cmd_health,
+    "alerts": cmd_alerts,
+    "token": cmd_token,
     "report": cmd_report,
     "metrics": cmd_metrics,
     "check": cmd_check,

@@ -48,6 +48,14 @@ from dprf_tpu.utils import env as envreg
 #: lease path)
 GC_CHECK_INTERVAL_S = 30.0
 
+#: per-job SLO accounting (ISSUE 10, driven by update_slos on the
+#: health-plane evaluation loop): coverage-rate EWMA smoothing, and
+#: the consecutive flat windows after which a RUNNING job counts as
+#: STALLED (the dprf_job_stalled gauge the job_stalled alert rule
+#: thresholds)
+SLO_RATE_ALPHA = 0.4
+STALL_WINDOWS = 3
+
 #: job lifecycle states
 QUEUED = "queued"
 RUNNING = "running"
@@ -74,7 +82,9 @@ class Job:
                  "verifier", "owner", "priority", "quota", "rate",
                  "state", "done_reason", "created", "found", "hits",
                  "rejected", "leases", "pass_value", "_tokens",
-                 "_token_t", "finished_at")
+                 "_token_t", "finished_at", "first_hit_at",
+                 "last_lease_at", "_slo_prev", "_slo_rate", "_slo_t",
+                 "_slo_flat")
 
     def __init__(self, job_id: str, spec: dict, dispatcher: Dispatcher,
                  n_targets: int, verifier: Optional[Callable] = None,
@@ -107,6 +117,15 @@ class Job:
         #: when the job entered a terminal state (scheduler clock) --
         #: the age-based GC's reference point
         self.finished_at: Optional[float] = None
+        #: SLO accounting (ISSUE 10, update_slos): time-to-first-hit,
+        #: lease-wait, and the coverage-rate EWMA the per-job ETA and
+        #: stall detector derive from
+        self.first_hit_at: Optional[float] = None
+        self.last_lease_at: Optional[float] = None
+        self._slo_prev = 0
+        self._slo_rate: Optional[float] = None
+        self._slo_t: Optional[float] = None
+        self._slo_flat = 0
 
     @property
     def weight(self) -> float:
@@ -179,6 +198,29 @@ class JobScheduler:
             "dprf_jobs_gc_total",
             "terminal jobs reaped by the age-based GC "
             "(DPRF_JOB_TTL_S)")
+        # per-job SLO surface (ISSUE 10): published by update_slos on
+        # the health-plane evaluation loop, consumed by the alert
+        # engine's job_stalled rule and the dprf health CLI
+        self._g_eta = m.gauge(
+            "dprf_job_eta_seconds",
+            "remaining keyspace / the coverage-rate EWMA: when this "
+            "job finishes at the current fleet pace",
+            labelnames=("job",))
+        self._g_stalled = m.gauge(
+            "dprf_job_stalled",
+            "1 when a RUNNING job's coverage stayed flat for "
+            "STALL_WINDOWS consecutive evaluation windows",
+            labelnames=("job",))
+        self._g_ttfh = m.gauge(
+            "dprf_job_ttfh_seconds",
+            "time from job admission to its first verified hit",
+            labelnames=("job",))
+        self._h_lease_wait = m.histogram(
+            "dprf_job_lease_wait_seconds",
+            "interval between consecutive lease grants to a job "
+            "(from admission, for the first) -- fair-share latency, "
+            "p95 readable from the buckets",
+            labelnames=("job",))
         self._refresh_states()
 
     # -- registry --------------------------------------------------------
@@ -297,6 +339,14 @@ class JobScheduler:
                 self._refresh_states()
             best.pass_value += 1.0 / best.weight
             best.leases += 1
+            # lease-wait SLO: how long this job sat between grants
+            # (fair-share latency a tenant actually feels)
+            self._h_lease_wait.observe(
+                max(0.0, now - (best.last_lease_at
+                                if best.last_lease_at is not None
+                                else best.created)),
+                job=best.job_id)
+            best.last_lease_at = now
             if best.rate is not None:
                 best._tokens -= 1.0
             out.append((best, unit))
@@ -343,6 +393,9 @@ class JobScheduler:
                    plaintext: bytes) -> bool:
         new = job.record_hit(target_index, cand_index, plaintext)
         if new:
+            if job.first_hit_at is None:
+                # time-to-first-hit SLO anchor (update_slos publishes)
+                job.first_hit_at = self._clock()
             self._m_job_hits.inc(job=job.job_id)
             self.refresh_job_state(job)
         return new
@@ -363,6 +416,86 @@ class JobScheduler:
             return
         job.finished_at = self._clock()
         self._refresh_states()
+
+    # -- per-job SLOs (ISSUE 10) ------------------------------------------
+
+    def update_slos(self) -> None:
+        """One SLO accounting pass, driven by the health-plane
+        evaluation loop (CoordinatorState.health_tick, under the
+        owner's lock like every other scheduler call): fold each
+        job's coverage delta into its rate EWMA, publish the derived
+        ETA, time-to-first-hit, and the STALL flag -- coverage flat
+        for STALL_WINDOWS consecutive windows while RUNNING (the
+        "job stalled" first-class condition)."""
+        now = self._clock()
+        for j in self._jobs.values():
+            if j.first_hit_at is not None:
+                # published even for terminal jobs: a job that cracked
+                # everything instantly still has a TTFH worth reading
+                self._g_ttfh.set(j.first_hit_at - j.created,
+                                 job=j.job_id)
+            if j.terminal():
+                # clear the live-progress gauges: a cancelled job must
+                # not advertise a frozen ETA/stall forever on /metrics
+                if j._slo_flat:
+                    j._slo_flat = 0
+                    self._g_stalled.set(0, job=j.job_id)
+                if j._slo_rate is not None:
+                    j._slo_rate = None
+                    self._g_eta.set(0, job=j.job_id)
+                continue
+            covered = j.covered()
+            if j._slo_t is None:
+                j._slo_t = now
+                j._slo_prev = covered
+                continue
+            dt = now - j._slo_t
+            if dt <= 0:
+                continue
+            delta = covered - j._slo_prev
+            rate = delta / dt
+            j._slo_rate = (rate if j._slo_rate is None
+                           else j._slo_rate
+                           + SLO_RATE_ALPHA * (rate - j._slo_rate))
+            j._slo_prev = covered
+            j._slo_t = now
+            total = j.dispatcher.progress()[1]
+            if j._slo_rate and j._slo_rate > 0:
+                self._g_eta.set(max(0.0, (total - covered)
+                                    / j._slo_rate), job=j.job_id)
+            # a PAUSED job's flat coverage is policy, not a stall
+            j._slo_flat = (j._slo_flat + 1
+                           if j.state == RUNNING and delta <= 0
+                           else 0)
+            self._g_stalled.set(
+                1 if j._slo_flat >= STALL_WINDOWS else 0,
+                job=j.job_id)
+
+    def slo_summaries(self) -> list:
+        """Per-job SLO rows for op_health / `dprf health`."""
+        out = []
+        for j in self._jobs.values():
+            covered, total = j.dispatcher.progress()
+            # terminal jobs have no live rate/ETA to report (their
+            # gauges are cleared by update_slos for the same reason)
+            rate = None if j.terminal() else j._slo_rate
+            eta = None
+            if j.terminal():
+                eta = None
+            elif total <= covered:
+                eta = 0.0
+            elif rate and rate > 0:
+                eta = round((total - covered) / rate, 1)
+            out.append({
+                "job": j.job_id, "owner": j.owner, "state": j.state,
+                "covered": covered, "total": total,
+                "rate_ips": round(rate, 3) if rate else None,
+                "eta_s": eta,
+                "stalled": j._slo_flat >= STALL_WINDOWS,
+                "ttfh_s": (round(j.first_hit_at - j.created, 3)
+                           if j.first_hit_at is not None else None),
+                "found": len(j.found), "targets": j.n_targets})
+        return out
 
     # -- admin -----------------------------------------------------------
 

@@ -140,6 +140,46 @@ def _throughput(spans: list, snapshot: Optional[dict]) -> dict:
     return out
 
 
+def _health_section(session_path: str, journal) -> Optional[dict]:
+    """Fleet health post-mortem (ISSUE 10): fold the session's
+    ``.alerts.jsonl`` transition stream and the journal's
+    ``worker_health`` records into fired-per-rule counts, the alerts
+    that never resolved, and each worker's final state.  None when
+    the session left neither artifact (pre-health sessions)."""
+    from dprf_tpu.telemetry.alerts import alerts_path, load_alerts
+    events = load_alerts(alerts_path(session_path))
+    health_events = (journal.health_events or []) if journal else []
+    if not events and not health_events:
+        return None
+    fired: dict = {}
+    last_state: dict = {}    # (rule, label key) -> last event
+    for e in events:
+        key = (str(e.get("rule")),
+               tuple(sorted((e.get("labels") or {}).items())))
+        last_state[key] = e
+        if e.get("state") == "firing":
+            fired[key[0]] = fired.get(key[0], 0) + 1
+    # only FIRING counts as unresolved: a trailing "pending" event
+    # usually means the condition cleared before the sustain window
+    # (the engine drops those silently), and reporting it would be a
+    # false post-mortem signal
+    unresolved = sorted({
+        f"{k[0]}({','.join(str(v) for _, v in k[1])})"
+        if k[1] else k[0]
+        for k, e in last_state.items()
+        if e.get("state") == "firing"})
+    workers: dict = {}
+    for h in health_events:
+        w = h.get("worker")
+        if w is not None:
+            workers[str(w)] = str(h.get("to"))
+    return {"alert_events": len(events),
+            "fired": fired,
+            "unresolved": unresolved,
+            "worker_transitions": len(health_events),
+            "workers": workers}
+
+
 def _fair_share(spans: list, journal) -> list:
     """Per-job lease share vs fair-share weight, from the lease spans
     and the journal's job records (the default job's priority is 1
@@ -218,6 +258,7 @@ def build_report(session_path: str) -> Optional[dict]:
         "pipeline_depth": (float(depth_vals[-1]["value"])
                            if depth_vals else None),
         "fair_share": _fair_share(spans, journal),
+        "health": _health_section(session_path, journal),
     }
 
 
@@ -277,6 +318,25 @@ def render_report(doc: dict) -> str:
            if cc.get("hit_rate") is not None else ""))
     if doc.get("pipeline_depth") is not None:
         lines.append(f"pipeline depth {doc['pipeline_depth']:.0f}")
+    health = doc.get("health")
+    if health:
+        lines.append("")
+        lines.append("fleet health & alerts")
+        fired = health.get("fired") or {}
+        if fired:
+            for rule in sorted(fired):
+                lines.append(f"  fired {rule:24s} x{fired[rule]}")
+        else:
+            lines.append(f"  no alerts fired "
+                         f"({health.get('alert_events', 0)} events)")
+        unresolved = health.get("unresolved") or []
+        if unresolved:
+            lines.append("  UNRESOLVED at shutdown: "
+                         + ", ".join(unresolved))
+        workers = health.get("workers") or {}
+        for w in sorted(workers):
+            lines.append(f"  worker {w:20s} last transition -> "
+                         f"{workers[w]}")
     fs = doc.get("fair_share") or []
     if len(fs) > 1:
         lines.append("")

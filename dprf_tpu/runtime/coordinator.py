@@ -164,8 +164,12 @@ class Coordinator:
         if self.potfile is not None:
             self.potfile.add(target.raw, hit.plaintext)
         if self.session is not None:
+            # job-tagged unconditionally (ISSUE 10): the journal's
+            # header names this id as default_job, so resume folds
+            # these lines back into the flat fields
             self.session.record_hit(hit.target_index, hit.cand_index,
-                                    hit.plaintext)
+                                    hit.plaintext,
+                                    job=self.dispatcher.job_id)
         return True
 
     #: default units dispatched ahead of the oldest unresolved one
@@ -207,7 +211,8 @@ class Coordinator:
             warmup_async()
         ensure_warm = getattr(self.worker, "ensure_warm", None)
         if self.session is not None:
-            self.session.open(self.spec.as_dict())
+            self.session.open(self.spec.as_dict(),
+                              default_job=self.dispatcher.job_id)
         # Submit-ahead FIFO (shared with the remote worker_loop):
         # device work for every queued unit is already dispatched;
         # resolving the head overlaps its readback latency with the
@@ -320,7 +325,8 @@ class Coordinator:
                 self.dispatcher.complete(unit.unit_id, elapsed=unit_s)
                 if self.session is not None:
                     self.session.record_units(
-                        self.dispatcher.completed_intervals())
+                        self.dispatcher.completed_intervals(),
+                        job=self.dispatcher.job_id)
                 now = time.perf_counter()
                 if self.progress_cb and now - last_report >= self.progress_interval:
                     last_report = now
@@ -332,7 +338,9 @@ class Coordinator:
             # Snapshot in finally: a Ctrl-C mid-job must not lose up to
             # snapshot_every-1 units of journaled coverage.
             if self.session is not None:
-                self.session.snapshot(self.dispatcher.completed_intervals())
+                self.session.snapshot(
+                    self.dispatcher.completed_intervals(),
+                    job=self.dispatcher.job_id)
                 self.session.close()
         elapsed = time.perf_counter() - t0
         done, total = self.dispatcher.progress()

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import hmac as hmac_mod
 import json
+import re
 import secrets
 import socket
 import socketserver
@@ -49,6 +50,8 @@ from dprf_tpu.runtime.worker import Hit
 from dprf_tpu.runtime.workunit import WorkUnit
 from dprf_tpu.telemetry import declare_job_metrics, get_registry
 from dprf_tpu.telemetry import perf as perf_mod
+from dprf_tpu.telemetry.alerts import AlertEngine
+from dprf_tpu.telemetry.health import HealthRegistry, heartbeat_interval
 from dprf_tpu.telemetry.trace import get_tracer, jax_profile_ctx
 
 MAX_LINE = 64 << 20   # hashlists can be large; candidates never cross
@@ -201,6 +204,19 @@ class CoordinatorState:
         #: SAME one the Dispatcher records into so the timeline is
         #: whole (both default to the process-wide recorder)
         self.tracer = get_tracer(recorder)
+        #: fleet health plane (ISSUE 10): worker state machine +
+        #: straggler detection fed by op_heartbeat and the
+        #: lease/complete traffic; evaluated by health_tick on the
+        #: DPRF_ALERT_EVAL_S loop (cli.cmd_serve's HealthMonitor)
+        self.health = HealthRegistry(registry=registry)
+        #: declarative alert rules over the same registry; pending ->
+        #: firing -> resolved lifecycle served via op_alerts
+        self.alerts = AlertEngine(registry=registry)
+        #: (transition dict) hook: cmd_serve journals each fleet
+        #: health transition as a {"type": "worker_health"} record;
+        #: fired by health_tick UNDER the lock so the journal writes
+        #: serialize with the hit/progress writers
+        self.on_worker_health: Optional[Callable] = None
         m = self.registry
         #: verify-phase attribution (telemetry/perf.py): the oracle
         #: re-hash cost of every hit batch, labeled per job
@@ -219,7 +235,9 @@ class CoordinatorState:
             "unverifiable hits")
         self._g_seen = m.gauge(
             "dprf_worker_last_seen_timestamp",
-            "unix time of each worker's last lease/complete",
+            "unix time of each worker's last lease/complete/"
+            "heartbeat (ISSUE 10: heartbeats widened this beyond "
+            "lease holders)",
             labelnames=("worker",))
         self._g_targets.set(n_targets)
         self._g_found.set(0)
@@ -242,6 +260,23 @@ class CoordinatorState:
                 and self._g_seen.child_count() >= self.MAX_WORKER_LABELS):
             wid = "_overflow"
         self._g_seen.set(time.time(), worker=wid)
+
+    def health_tick(self) -> None:
+        """One fleet-health evaluation pass (ISSUE 10), driven by the
+        HealthMonitor loop every ``DPRF_ALERT_EVAL_S`` seconds: age
+        the worker state machine + straggler detection, update the
+        per-job SLO gauges, journal the drained transitions, then run
+        the alert rules against the registry.  Lock discipline: the
+        health registry and alert engine evaluate under their OWN
+        locks (never nested inside ours); only the scheduler pass and
+        the journaling callback take ``self.lock``."""
+        transitions = self.health.evaluate()
+        with self.lock:
+            self.scheduler.update_slos()
+            if self.on_worker_health:
+                for tr in transitions:
+                    self.on_worker_health(tr)
+        self.alerts.evaluate()
 
     def refresh_found_gauge(self) -> None:
         """Re-sync dprf_targets_found/_total after out-of-band
@@ -276,12 +311,20 @@ class CoordinatorState:
 
     # -- RPC ops ---------------------------------------------------------
 
-    def op_hello(self, msg: dict) -> dict:
+    def op_hello(self, msg: dict,
+                 auth_owner: Optional[str] = None) -> dict:
         # the default job + its scheduler id: a multi-job worker seeds
         # its per-job worker cache with this one and fetches further
-        # specs through op_job_status as their units arrive
+        # specs through op_job_status as their units arrive.  The
+        # echoed owner is the identity the handler loop AUTHENTICATED
+        # this connection as -- the client's claim (msg["owner"]
+        # rides the auth handshake) is confirmed only when the hmac
+        # over the owner-derived token proved it; on an open or
+        # admin connection there is no tenant scoping, so the echo
+        # is None no matter what the client claimed.
         return {"ok": True, "job": self.job,
-                "job_id": self.default_job_id}
+                "job_id": self.default_job_id,
+                "owner": auth_owner if msg.get("owner") else None}
 
     def op_lease(self, msg: dict) -> dict:
         """Hand out the next unit(s), fair-share-selected ACROSS jobs
@@ -296,7 +339,14 @@ class CoordinatorState:
             pull = self._pull_epoch
             if self._stopped():
                 return {"unit": None, "stop": True, "pull": pull}
-            wid = str(msg.get("worker_id", "?"))
+            raw_wid = msg.get("worker_id")
+            wid = str(raw_wid) if raw_wid is not None else "?"
+            if raw_wid is not None:
+                # any lease poll is a sign of life for the health
+                # plane (the idle-aware heartbeat contract: flowing
+                # traffic makes explicit beats redundant); the
+                # registry caps its own id cardinality
+                self.health.observe(wid)
             if wid in self.quarantined:
                 return {"unit": None, "stop": False,
                         "quarantined": True, "pull": pull}
@@ -491,6 +541,11 @@ class CoordinatorState:
                     # units are NOT counted -- the range is (re)swept by
                     # the live holder, whose complete counts it once
                     self._touch_worker(wid)
+                    # feed the straggler detector: this worker's
+                    # per-unit throughput EWMA (telemetry/health.py)
+                    self.health.observe(
+                        wid, rate_hs=(unit.length / elapsed
+                                      if elapsed else None))
                     self._m_cands.inc(unit.length,
                                       engine=job.spec.get("engine", "?"),
                                       device="remote")
@@ -526,6 +581,51 @@ class CoordinatorState:
                     else None)
         return {"ok": True}
 
+    # -- fleet health plane (ISSUE 10) -------------------------------------
+
+    def op_heartbeat(self, msg: dict) -> dict:
+        """Worker liveness + capability beacon.  Sent on the
+        idle-aware ``DPRF_HEARTBEAT_S`` cadence (worker_loop): only
+        when the main connection has been quiet for a beat --
+        lease/complete traffic already counts as contact.  The
+        payload (device kind, pipeline depth, queue depth, recent
+        H/s, last error) is client-controlled and sanitized by the
+        health registry; this op also touches the last-seen gauge,
+        fixing its old lease-holders-only blind spot."""
+        raw = msg.get("worker_id")
+        if raw is None:
+            return {"ok": False}
+        wid = str(raw)
+        self.health.observe(wid, payload=msg.get("payload"))
+        self._touch_worker(wid)
+        return {"ok": True}
+
+    def op_health(self, msg: dict) -> dict:
+        """Fleet health snapshot for ``dprf health --connect``: every
+        tracked worker's state-machine record, the per-job SLO rows
+        (ETA / time-to-first-hit / stall flag), and the active
+        alerts.  The health/alert reads run under their own locks,
+        never nested inside ours."""
+        workers = self.health.snapshot()
+        active = self.alerts.active()
+        with self.lock:
+            slos = self.scheduler.slo_summaries()
+        return {"ok": True, "workers": workers, "jobs": slos,
+                "alerts": active, "now": time.time()}
+
+    def op_alerts(self, msg: dict) -> dict:
+        """Alert surface for ``dprf alerts --connect``: the active
+        (pending/firing) set plus the recent transition history the
+        engine keeps in memory (the full log is the session's
+        ``.alerts.jsonl``)."""
+        try:
+            n = int(msg.get("n", 200))
+        except (TypeError, ValueError):
+            n = 200
+        return {"ok": True, "alerts": self.alerts.active(),
+                "history": self.alerts.history(n),
+                "now": time.time()}
+
     def op_trace_tail(self, msg: dict) -> dict:
         """Flight-recorder read for ``dprf top``: the most recent
         spans plus the live lease table and job status -- everything a
@@ -554,6 +654,11 @@ class CoordinatorState:
         # outside the state lock (the recorder has its own)
         busy = self.tracer.busy_fractions()
         roofline = perf_mod.roofline_snapshot(self.registry)
+        # fleet health plane (ISSUE 10): per-worker state for the
+        # HEALTH column + the firing alerts for the header line --
+        # both read under their own locks
+        health_states = self.health.states()
+        firing = self.alerts.firing_names()
         with self.lock:
             done, total = self.scheduler.progress()
             leases = []
@@ -577,6 +682,10 @@ class CoordinatorState:
                       # folds both into its header line)
                       "busy": busy,
                       "roofline": roofline,
+                      # worker health states + firing alerts (the
+                      # dprf top HEALTH column and header line)
+                      "health": health_states,
+                      "alerts": firing,
                       "quarantined": sorted(self.quarantined)}
         return {"ok": True, "spans": spans, "leases": leases,
                 "status": status, "cursor": cursor, "resync": resync}
@@ -619,12 +728,27 @@ class CoordinatorState:
 
     # -- multi-tenant job admin (jobs/scheduler.py) -----------------------
 
-    def op_job_submit(self, msg: dict) -> dict:
+    @staticmethod
+    def _owner_denied(job, auth_owner: Optional[str]) -> Optional[dict]:
+        """Owner enforcement (ISSUE 10 satellite): a connection
+        authenticated with an owner-scoped token (``dprf token``) may
+        only act on that owner's jobs; the admin token (and the open
+        protocol) is exempt (auth_owner None)."""
+        if auth_owner is not None and job.owner != auth_owner:
+            return {"error": f"job {job.job_id} belongs to owner "
+                    f"{job.owner!r}; this token is scoped to "
+                    f"{auth_owner!r}"}
+        return None
+
+    def op_job_submit(self, msg: dict,
+                      auth_owner: Optional[str] = None) -> dict:
         """Admit a new job to the scheduler.  The spec is rebuilt
         server-side (jobs/build.py): targets parsed, generator built,
         fingerprint recomputed -- a submission is DATA, never trusted
         structure.  The expensive build runs OUTSIDE the lock against
-        a pre-reserved job id."""
+        a pre-reserved job id.  An owner-token connection's
+        submission is FORCED to its authenticated owner -- the msg
+        field cannot impersonate another tenant."""
         spec = msg.get("spec")
         builder = self.job_builder
         if builder is None:
@@ -653,7 +777,8 @@ class CoordinatorState:
                 recorder=self.tracer, lease_timeout=lease_timeout)
         except (ValueError, OSError, KeyError, TypeError) as e:
             return {"error": f"job rejected: {e}"}
-        owner = str(msg.get("owner") or "?")
+        owner = (auth_owner if auth_owner is not None
+                 else str(msg.get("owner") or "?"))
         try:
             priority = max(1, int(msg.get("priority") or 1))
         except (TypeError, ValueError):
@@ -700,35 +825,48 @@ class CoordinatorState:
             return {"ok": True, "job": job.summary(),
                     "spec": job.spec}
 
-    def op_job_cancel(self, msg: dict) -> dict:
+    def op_job_cancel(self, msg: dict,
+                      auth_owner: Optional[str] = None) -> dict:
         with self.lock:
-            job = self.scheduler.cancel(self._job_arg(msg) or "")
+            jid = self._job_arg(msg) or ""
+            job = self.scheduler.get(jid) if jid else None
             if job is None:
                 return {"error": f"unknown job {msg.get('job')!r}"}
+            denied = self._owner_denied(job, auth_owner)
+            if denied is not None:
+                return denied
+            self.scheduler.cancel(jid)
             summary = job.summary()
             if self.on_job_event:
                 self.on_job_event("cancel", job)
         return {"ok": True, "job": summary}
 
-    def op_job_pause(self, msg: dict) -> dict:
+    def op_job_pause(self, msg: dict,
+                     auth_owner: Optional[str] = None) -> dict:
         resume = bool(msg.get("resume"))
         with self.lock:
-            job = self.scheduler.pause(self._job_arg(msg) or "",
-                                       resume=resume)
+            jid = self._job_arg(msg) or ""
+            job = self.scheduler.get(jid) if jid else None
             if job is None:
                 return {"error": f"unknown job {msg.get('job')!r}"}
+            denied = self._owner_denied(job, auth_owner)
+            if denied is not None:
+                return denied
+            self.scheduler.pause(jid, resume=resume)
             summary = job.summary()
             if self.on_job_event:
                 self.on_job_event("resume" if resume else "pause",
                                   job)
         return {"ok": True, "job": summary}
 
-    def op_hits_pull(self, msg: dict) -> dict:
+    def op_hits_pull(self, msg: dict,
+                     auth_owner: Optional[str] = None) -> dict:
         """Cursor-based per-job hit delivery: the submitting client
         polls with its last cursor and receives only NEW hits -- the
         multi-tenant replacement for scraping the single global found
         set.  The cursor is the hit sequence number; hits never
-        reorder, so a client can resume from any cursor."""
+        reorder, so a client can resume from any cursor.  An
+        owner-token connection can only pull its OWN jobs' hits."""
         try:
             cursor = max(0, int(msg.get("cursor") or 0))
         except (TypeError, ValueError):
@@ -737,6 +875,9 @@ class CoordinatorState:
             job = self.scheduler.get(self._job_arg(msg))
             if job is None:
                 return {"error": f"unknown job {msg.get('job')!r}"}
+            denied = self._owner_denied(job, auth_owner)
+            if denied is not None:
+                return denied
             hits = [dict(h) for h in job.hits[cursor:]]
             return {"ok": True, "hits": hits,
                     "cursor": cursor + len(hits),
@@ -747,6 +888,14 @@ class CoordinatorState:
         j = msg.get("job")
         return str(j) if j is not None else None
     _job_arg._holds_lock = "lock"   # callers hold self.lock
+
+    #: ops the handler loop passes the connection's authenticated
+    #: owner to (owner-scoped tenant tokens; see owner_token above)
+    op_hello._wants_owner = True
+    op_job_submit._wants_owner = True
+    op_job_cancel._wants_owner = True
+    op_job_pause._wants_owner = True
+    op_hits_pull._wants_owner = True
 
     # -- incident-response trace collection -------------------------------
 
@@ -803,6 +952,42 @@ def challenge_response(token: str, nonce_hex: str) -> str:
                         "sha256").hexdigest()
 
 
+# ---------------------------------------------------------------------------
+# owner-scoped tenant tokens (ISSUE 10 satellite of a ROADMAP item)
+
+#: owner tokens are self-describing: ``ot1.<owner>.<mac>`` where the
+#: mac is derived from the coordinator's ADMIN secret -- so the
+#: coordinator can verify any tenant's token without a token table,
+#: and the auth layer knows WHO connected, not just that someone did
+OWNER_TOKEN_PREFIX = "ot1."
+_OWNER_RE = re.compile(r"^[A-Za-z0-9_-]{1,64}$")
+
+
+def owner_token(secret: str, owner: str) -> str:
+    """Mint a tenant token from the coordinator's admin secret
+    (``dprf token --owner``).  A connection authenticated with it is
+    scoped to ``owner``: the owner-enforcing job ops
+    (cancel/pause/resume/hits_pull) only act on that owner's jobs,
+    and a submission's owner field is forced to it.  The admin secret
+    itself stays exempt (owner None = admin)."""
+    if not _OWNER_RE.match(owner or ""):
+        raise ValueError(
+            "owner must be 1-64 chars of [A-Za-z0-9_-] "
+            f"(got {owner!r})")
+    mac = hmac_mod.new(secret.encode(),
+                       b"dprf-owner:" + owner.encode(),
+                       "sha256").hexdigest()[:32]
+    return f"{OWNER_TOKEN_PREFIX}{owner}.{mac}"
+
+
+def token_owner(token: Optional[str]) -> Optional[str]:
+    """The owner a token is scoped to; None for admin/plain tokens."""
+    if not token or not token.startswith(OWNER_TOKEN_PREFIX):
+        return None
+    owner = token[len(OWNER_TOKEN_PREFIX):].split(".", 1)[0]
+    return owner or None
+
+
 class _Handler(socketserver.StreamRequestHandler):
     #: failed auth attempts before the connection is dropped
     MAX_AUTH_FAILURES = 3
@@ -846,6 +1031,13 @@ class _Handler(socketserver.StreamRequestHandler):
         nonce = secrets.token_hex(16)      # challenge, rotated per failure
         auth_failures = 0
         authed = state.token is None
+        #: owner this connection authenticated AS (owner-scoped
+        #: tenant tokens, ISSUE 10): None = admin token or open
+        #: protocol -- exempt from the per-owner job-op checks
+        conn_owner: Optional[str] = None
+        #: the token string this connection's hmacs are keyed with
+        #: (the owner-DERIVED token for tenant connections)
+        conn_token = state.token
         while True:
             try:
                 line = self.rfile.readline(MAX_LINE)
@@ -869,9 +1061,21 @@ class _Handler(socketserver.StreamRequestHandler):
             if not authed:
                 if msg.get("op") == "hello":
                     mac = msg.get("hmac")
+                    # a hello naming an owner authenticates against
+                    # the owner-DERIVED token (owner_token): the
+                    # coordinator needs no token table, and a valid
+                    # mac proves both the secret chain AND the owner
+                    # identity in one step
+                    owner = msg.get("owner")
+                    owner = (owner if isinstance(owner, str)
+                             and _OWNER_RE.match(owner) else None)
+                    expect = (owner_token(state.token, owner)
+                              if owner else state.token)
                     if (isinstance(mac, str) and hmac_mod.compare_digest(
-                            mac, challenge_response(state.token, nonce))):
+                            mac, challenge_response(expect, nonce))):
                         authed = True      # fall through to op_hello
+                        conn_owner = owner
+                        conn_token = expect
                     else:
                         # a fresh nonce per attempt: a failed guess
                         # teaches nothing about the next challenge
@@ -904,17 +1108,25 @@ class _Handler(socketserver.StreamRequestHandler):
                 resp = {"error": f"unknown op {msg.get('op')!r}"}
             else:
                 try:
-                    resp = op(msg)
+                    if getattr(op, "_wants_owner", False):
+                        # owner-scoped job ops receive the identity
+                        # this CONNECTION authenticated as -- never a
+                        # spoofable message field
+                        resp = op(msg, auth_owner=conn_owner)
+                    else:
+                        resp = op(msg)
                 except Exception as e:       # defensive: never kill server
                     resp = {"error": f"{type(e).__name__}: {e}"}
             if (msg.get("op") == "hello" and state.token
                     and isinstance(msg.get("cnonce"), str)):
                 # mutual auth: prove WE know the token over the
                 # client's nonce, so a worker with --token refuses a
-                # spoofed coordinator (and the job it would hand out)
+                # spoofed coordinator (and the job it would hand out).
+                # Keyed with the CONNECTION's token: a tenant client
+                # verifies with its owner-derived token
                 try:
                     resp["coordinator_hmac"] = challenge_response(
-                        state.token, msg["cnonce"])
+                        conn_token, msg["cnonce"])
                 except ValueError:
                     resp = {"error": "bad cnonce (want hex)"}
             try:
@@ -999,6 +1211,10 @@ class CoordinatorClient:
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._fh = self._sock.makefile("rb")
         self._token = token
+        #: owner an ``ot1.`` tenant token is scoped to (None for the
+        #: admin secret): sent with hello so the coordinator keys the
+        #: challenge against the owner-derived token
+        self._owner = token_owner(token)
 
     def clone(self) -> "CoordinatorClient":
         """A second authenticated connection to the same coordinator
@@ -1022,12 +1238,12 @@ class CoordinatorClient:
         must in turn prove it knows the token over OUR nonce (mutual
         auth): a spoofed coordinator cannot hand this worker a job."""
         cnonce = secrets.token_hex(16)
-        resp = self.call("hello", cnonce=cnonce)
+        resp = self.call("hello", cnonce=cnonce, owner=self._owner)
         if resp.get("challenge"):
             if not self._token:
                 raise RpcError(
                     "coordinator requires authentication; pass --token")
-            resp = self.call("hello", cnonce=cnonce,
+            resp = self.call("hello", cnonce=cnonce, owner=self._owner,
                              hmac=challenge_response(
                                  self._token, resp["challenge"]))
             if resp.get("challenge"):
@@ -1226,6 +1442,47 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
     lease_q: list = []    # leased-but-not-yet-submitted batch remainder
     pull_seen = 0     # last trace-pull epoch this worker answered
 
+    # idle-aware heartbeats (ISSUE 10): an explicit op_heartbeat goes
+    # out only when the MAIN connection has been quiet for a whole
+    # DPRF_HEARTBEAT_S beat -- lease round trips already count as
+    # contact on the coordinator's health plane, so a busy loop never
+    # pays the extra RPC.  The payload is this worker's live
+    # capability/health record (device kind, pipeline depth, queue
+    # depth, recent H/s, last async-send error).
+    hb_s = heartbeat_interval()
+    t_contact = time.monotonic()
+    rate_ewma: Optional[float] = None
+    chips: list = []      # lazily probed on the first beat
+
+    def _chip_count() -> Optional[int]:
+        if not chips:
+            try:
+                import jax
+                chips.append(jax.local_device_count())
+            except Exception:   # noqa: BLE001 -- jax-less host
+                chips.append(None)
+        return chips[0]
+
+    def maybe_heartbeat() -> None:
+        nonlocal t_contact
+        if hb_s <= 0 or time.monotonic() - t_contact < hb_s:
+            return
+        t_contact = time.monotonic()
+        eng_name, dev = _labels_of(worker)
+        err = (str(sender.error)[:200]
+               if sender is not None and sender.error is not None
+               else None)
+        try:
+            client.call("heartbeat", worker_id=worker_id,
+                        payload={"engine": eng_name, "device": dev,
+                                 "chips": _chip_count(),
+                                 "depth": pipe.depth,
+                                 "queue": len(pipe),
+                                 "rate_hs": rate_ewma,
+                                 "error": err})
+        except Exception:   # noqa: BLE001 -- best-effort beacon; a
+            pass            # dead link surfaces on the next lease
+
     def _worker_of(job_id):
         if worker_for is None or job_id is None:
             return worker
@@ -1294,6 +1551,7 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                             "reported hits repeatedly failed oracle "
                             "verification (divergent device path?)")
                     lease_rtt = time.monotonic() - t_lease
+                    t_contact = time.monotonic()  # lease = contact
                     if adaptive is not None:
                         adaptive.observe_rtt(lease_rtt)
                     pull = resp.get("pull")
@@ -1324,6 +1582,10 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                         if len(pipe) == 0:
                             if stop_seen:
                                 break
+                            # nothing leasable and nothing queued:
+                            # this is exactly when the coordinator
+                            # would otherwise go blind on us
+                            maybe_heartbeat()
                             time.sleep(idle_sleep)
                             continue
                     first = True
@@ -1439,6 +1701,14 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                     t_last_resolve = None
                 if adaptive is not None:
                     adaptive.observe_unit(elapsed_report)
+                if elapsed_report > 0:
+                    # recent-throughput EWMA for the heartbeat payload
+                    inst = unit.length / elapsed_report
+                    rate_ewma = (inst if rate_ewma is None
+                                 else rate_ewma + 0.3 * (inst - rate_ewma))
+                # a long sweep keeps the main connection quiet for its
+                # whole duration: beat here if it starved the cadence
+                maybe_heartbeat()
                 # the histogram gets the same per-unit cost: observing
                 # unit_s here would inflate dprf_unit_seconds ~depth x
                 # under pipelining with no throughput change

@@ -12,16 +12,23 @@ pure functions of their index range, so the journal is just:
       autotune result) -- a resumed job reuses the recorded batch even
       when the machine's persistent tune cache is gone
 
-Multi-tenant serve plane (ISSUE 8): a coordinator carries MANY jobs,
-so the journal grew per-job records.  ``units`` and ``hit`` lines may
-carry a ``"job": "<id>"`` tag; untagged lines belong to the DEFAULT
-job (the one in the header) -- full backward compatibility with
-single-job journals.  Scheduler-submitted jobs add:
+Multi-tenant serve plane (ISSUE 8, tagging finalized in ISSUE 10): a
+coordinator carries MANY jobs, so ``units`` and ``hit`` lines carry a
+``"job": "<id>"`` tag -- new sessions tag EVERY line, including the
+default job's (the header records ``default_job`` so load() folds its
+lines back into the flat fields).  Untagged lines from pre-tagging
+journals still read as the default job on restore; the dual write
+path (untagged default + tagged tenants) is gone.  Scheduler-submitted
+jobs add:
 
   {"type": "job", "id": j, "spec": {...}, "owner": o, "priority": p,
    "quota": q, "rate": r}                    a submitted job's identity
   {"type": "job_state", "id": j, "state": s} pause/cancel survives
                                              a coordinator restart
+  {"type": "worker_health", "worker": w, "from": s, "to": s}
+                                             fleet health transitions
+                                             (ISSUE 10; diagnostics,
+                                             never resume state)
 
 Coverage is re-snapshotted (merged intervals) every `snapshot_every`
 completions, so the file stays small and resume cost is O(intervals),
@@ -47,6 +54,9 @@ class SessionState:
     #:  "completed", "hits"} -- the DEFAULT job stays in the flat
     #: fields above, exactly as single-job journals always read
     jobs: dict = dataclasses.field(default_factory=dict)
+    #: worker_health transition records (ISSUE 10), in journal order:
+    #: post-mortem material for `dprf report`, never resume state
+    health_events: list = dataclasses.field(default_factory=list)
 
 
 #: `dprf check` threads analyzer: the journal stream is owned by the
@@ -83,13 +93,25 @@ class SessionJournal:
         from dprf_tpu.telemetry.trace import trace_path
         return trace_path(self.path)
 
+    @property
+    def alerts_path(self) -> str:
+        """Where this session's alert-event stream lives
+        (telemetry/alerts.py) -- fourth member of the journal family:
+        the pending/firing/resolved transitions `dprf report` folds
+        into its health section."""
+        from dprf_tpu.telemetry.alerts import alerts_path
+        return alerts_path(self.path)
+
     # -- writing ---------------------------------------------------------
 
-    def open(self, spec: dict) -> None:
+    def open(self, spec: dict, default_job: str = "j0") -> None:
         fresh = not os.path.exists(self.path)
         self._fh = open(self.path, "a", encoding="utf-8")
         if fresh:
-            self._emit({"type": "header", "spec": spec})
+            # default_job lets load() fold the (now always tagged)
+            # default-job lines back into the flat resume fields
+            self._emit({"type": "header", "spec": spec,
+                        "default_job": default_job})
         for obj in self._pending:
             self._emit(obj)
         self._pending = []
@@ -145,6 +167,21 @@ class SessionJournal:
         silently resume sweeping."""
         self._emit({"type": "job_state", "id": job_id, "state": state})
 
+    def record_worker_health(self, worker: str, frm: str, to: str,
+                             ts=None, age_s=None) -> None:
+        """Journal one fleet-health transition (ISSUE 10:
+        healthy/degraded/missing/dead) -- the post-mortem record of
+        when the fleet decayed, paired with the `.alerts.jsonl`
+        stream.  Diagnostics only; load() never replays these into
+        resume state."""
+        obj = {"type": "worker_health", "worker": worker,
+               "from": frm, "to": to}
+        if ts is not None:
+            obj["ts"] = ts
+        if age_s is not None:
+            obj["age_s"] = age_s
+        self._emit(obj)
+
     def record_job_gc(self, job_id: str) -> None:
         """Journal an age-based job reap (DPRF_JOB_TTL_S): a restart
         must not resurrect a job the GC already dropped -- load()
@@ -175,6 +212,12 @@ class SessionJournal:
             return None
         spec, completed, hits, tuning = {}, [], [], {}
         jobs: dict = {}
+        health_events: list = []
+        # new sessions tag EVERY units/hit line (ISSUE 10); lines
+        # tagged with the header's default job id fold back into the
+        # flat fields, exactly where untagged (pre-tagging) lines of
+        # old journals always landed
+        default_jid = "j0"
 
         def job_rec(jid: str) -> dict:
             return jobs.setdefault(jid, {
@@ -195,17 +238,22 @@ class SessionJournal:
                 jid = obj.get("job")
                 if t == "header":
                     spec = obj["spec"]
+                    dj = obj.get("default_job")
+                    if isinstance(dj, str) and dj:
+                        default_jid = dj
                 elif t == "units":
                     iv = [(s, e) for s, e in obj["intervals"]]
-                    if jid is None:
+                    if jid is None or str(jid) == default_jid:
                         completed = iv
                     else:
                         job_rec(str(jid))["completed"] = iv
                 elif t == "hit":
-                    if jid is None:
+                    if jid is None or str(jid) == default_jid:
                         hits.append(obj)
                     else:
                         job_rec(str(jid))["hits"].append(obj)
+                elif t == "worker_health":
+                    health_events.append(obj)
                 elif t == "job":
                     try:
                         r = job_rec(str(obj["id"]))
@@ -233,7 +281,8 @@ class SessionJournal:
                     except (KeyError, TypeError, ValueError):
                         continue    # malformed tune line: ignore
         return SessionState(spec=spec, completed=completed, hits=hits,
-                            tuning=tuning, jobs=jobs)
+                            tuning=tuning, jobs=jobs,
+                            health_events=health_events)
 
 
 def job_fingerprint(engine: str, attack: str, keyspace: int,

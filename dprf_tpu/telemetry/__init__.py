@@ -42,6 +42,17 @@ Metric names (all prefixed ``dprf_``; see README "Observability"):
   dprf_per_chip_rate_hs / dprf_scaling_efficiency{engine}
                                                 multichip scaling bench
   dprf_jobs_gc_total                            age-based job reaps
+  dprf_worker_health_state{worker}              health state machine
+                                                (telemetry/health.py)
+  dprf_worker_straggler / dprf_worker_rate_hs{worker}
+                                                straggler detection
+  dprf_job_eta_seconds / dprf_job_ttfh_seconds / dprf_job_stalled{job}
+                                                per-job SLOs
+  dprf_job_lease_wait_seconds{job}              fair-share latency
+  dprf_alerts_firing{rule} / dprf_alerts_fired_total{rule}
+                                                alert engine
+                                                (telemetry/alerts.py)
+  dprf_trace_spans_dropped_total                dropped/lost spans
 
 Alongside metrics, telemetry/trace.py records per-unit lifecycle SPANS
 (the flight recorder): trace ids assigned at split time, context

@@ -1,0 +1,455 @@
+"""Alert engine (ISSUE 10): declarative rules over the metrics
+registry, evaluated in-process on the ``DPRF_ALERT_EVAL_S`` loop.
+
+A rule is data -- metric selector + comparison + threshold + a
+sustained ``for_s`` window::
+
+    {"name": "worker_missing", "metric": "dprf_worker_health_state",
+     "op": ">=", "threshold": 2, "for_s": 10, "severity": "critical",
+     "summary": "worker silent past the missing threshold"}
+
+``metric`` names a declared ``dprf_*`` metric; evaluation is PER
+LABEL CHILD (so ``worker_missing`` fires once per silent worker, not
+once for the fleet), optionally filtered by a ``labels`` subset.
+``rate: true`` compares the per-second DELTA of a counter between
+evaluation passes instead of its absolute value -- the
+compile-miss-storm / reissue-storm / trace-drop detectors.  The
+``DEFAULT_RULES`` pack below ships the conditions the ISSUE names;
+``DPRF_ALERT_RULES`` points at a JSON file of additional rules (the
+`dprf check` metrics analyzer validates every referenced metric name
+against the declared registry, so a renamed metric breaks the build,
+not the pager).
+
+Lifecycle per (rule, label set): condition true -> PENDING; still
+true after ``for_s`` -> FIRING; condition false for ``clear_s``
+(default ``for_s`` -- the flap suppressor: a brief dip neither
+resolves nor re-fires) -> RESOLVED.  A pending alert whose condition
+clears before firing is dropped silently.  Every transition is an
+EVENT: appended to a bounded in-memory history (served by
+``op_alerts`` / ``dprf alerts``), streamed to the size-capped
+``<session>.alerts.jsonl`` (``DPRF_ALERTS_MAX_BYTES``, ``.1``
+rotation like every other session stream), and mirrored in the
+``dprf_alerts_firing{rule}`` gauge / ``dprf_alerts_fired_total``
+counter.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from dprf_tpu.telemetry import get_registry
+from dprf_tpu.utils import env as envreg
+
+#: suffix appended to a session journal path for its alert stream
+ALERTS_SUFFIX = ".alerts.jsonl"
+
+#: alert lifecycle states
+PENDING = "pending"
+FIRING = "firing"
+RESOLVED = "resolved"
+
+#: events kept in memory for op_alerts (the file holds the full log)
+HISTORY_MAX = 256
+
+#: comparison operators a rule may use
+OPS = {
+    ">": lambda v, t: v > t,
+    ">=": lambda v, t: v >= t,
+    "<": lambda v, t: v < t,
+    "<=": lambda v, t: v <= t,
+    "==": lambda v, t: v == t,
+}
+
+#: the default rule pack -- PURE LITERALS: the `dprf check` metrics
+#: analyzer reads this assignment from the AST and verifies every
+#: ``metric`` is a declared dprf_* name (a renamed metric would
+#: otherwise silently disarm the rule forever)
+DEFAULT_RULES = [
+    {"name": "worker_missing", "metric": "dprf_worker_health_state",
+     "op": ">=", "threshold": 2, "for_s": 5.0, "severity": "critical",
+     "summary": "worker silent past the missing threshold (its "
+                "leases will expire and reissue)"},
+    {"name": "straggler", "metric": "dprf_worker_straggler",
+     "op": ">=", "threshold": 1, "for_s": 15.0,
+     "severity": "warning",
+     "summary": "worker throughput far below the fleet's robust "
+                "median (MAD z-score)"},
+    {"name": "job_stalled", "metric": "dprf_job_stalled",
+     "op": ">=", "threshold": 1, "for_s": 10.0,
+     "severity": "critical",
+     "summary": "job coverage flat across consecutive evaluation "
+                "windows while running"},
+    {"name": "compile_miss_storm",
+     "metric": "dprf_compile_cache_misses_total", "rate": True,
+     "op": ">", "threshold": 0.2, "for_s": 20.0,
+     "severity": "warning",
+     "summary": "sustained compile-cache misses: the fleet is "
+                "recompiling instead of hashing (cold cache image? "
+                "shape churn?)"},
+    {"name": "reissue_storm", "metric": "dprf_units_reissued_total",
+     "labels": {"reason": "lease_expired"}, "rate": True,
+     "op": ">", "threshold": 0.5, "for_s": 20.0,
+     "severity": "warning",
+     "summary": "sustained lease expiries: workers are dying or "
+                "stalling mid-unit"},
+    {"name": "unit_failure_rate",
+     "metric": "dprf_units_reissued_total",
+     "labels": {"reason": "failed"}, "rate": True,
+     "op": ">", "threshold": 0.5, "for_s": 20.0,
+     "severity": "warning",
+     "summary": "sustained unit failures: a poisoned range or a "
+                "crashing worker build"},
+    {"name": "trace_drops",
+     "metric": "dprf_trace_spans_dropped_total", "rate": True,
+     "op": ">", "threshold": 0.0, "for_s": 5.0,
+     "severity": "warning",
+     "summary": "flight-recorder spans are being dropped (ingest "
+                "bound exceeded, or the trace stream stopped "
+                "writing)"},
+]
+
+#: lock-discipline declaration (`dprf check` locks analyzer): the
+#: engine is evaluated by the monitor thread and read by RPC handler
+#: threads (op_alerts/op_trace_tail); all mutable state moves under
+#: ``_lock``.  File writes happen under it too -- the TraceRecorder
+#: precedent -- and never call into other locked subsystems.
+GUARDED_BY = {
+    "AlertEngine": {
+        "_lock": ("_alerts", "_history", "_prev", "_path",
+                  "_max_bytes", "eval_seconds", "evals"),
+    },
+}
+
+
+def alerts_path(session_path: str) -> str:
+    """Alert-stream location for a session journal path (idempotent,
+    like trace_path)."""
+    if session_path.endswith(ALERTS_SUFFIX):
+        return session_path
+    return session_path + ALERTS_SUFFIX
+
+
+def alerts_max_bytes() -> Optional[int]:
+    from dprf_tpu.telemetry.snapshot import cap_bytes
+    return cap_bytes(envreg.get_int("DPRF_ALERTS_MAX_BYTES"))
+
+
+def eval_interval(default: float = 5.0) -> float:
+    v = envreg.get_float("DPRF_ALERT_EVAL_S", default)
+    return max(0.25, float(v or default))
+
+
+class AlertRule:
+    """One validated rule (see the module docstring for the wire
+    shape).  ``clear_s`` defaults to ``for_s``: the resolve hold that
+    suppresses flapping."""
+
+    __slots__ = ("name", "metric", "op", "threshold", "for_s",
+                 "clear_s", "labels", "rate", "severity", "summary")
+
+    def __init__(self, name: str, metric: str, op: str = ">",
+                 threshold: float = 0.0, for_s: float = 0.0,
+                 clear_s: Optional[float] = None, labels=None,
+                 rate: bool = False, severity: str = "warning",
+                 summary: str = ""):
+        if not name or not metric:
+            raise ValueError("alert rule needs 'name' and 'metric'")
+        if op not in OPS:
+            raise ValueError(
+                f"alert rule {name!r}: unknown op {op!r} "
+                f"(have: {sorted(OPS)})")
+        self.name = str(name)
+        self.metric = str(metric)
+        self.op = op
+        self.threshold = float(threshold)
+        self.for_s = max(0.0, float(for_s))
+        self.clear_s = (self.for_s if clear_s is None
+                        else max(0.0, float(clear_s)))
+        self.labels = dict(labels) if labels else {}
+        self.rate = bool(rate)
+        self.severity = str(severity)
+        self.summary = str(summary)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AlertRule":
+        if not isinstance(d, dict):
+            raise ValueError("alert rule must be a JSON object")
+        known = {"name", "metric", "op", "threshold", "for_s",
+                 "clear_s", "labels", "rate", "severity", "summary"}
+        junk = set(d) - known
+        if junk:
+            raise ValueError(
+                f"alert rule {d.get('name')!r}: unknown keys "
+                f"{sorted(junk)}")
+        return cls(**{k: v for k, v in d.items()})
+
+
+def load_rules(path: Optional[str] = None) -> list:
+    """The default pack plus the ``DPRF_ALERT_RULES`` file (a JSON
+    list of rule objects); a file rule with a default-pack name
+    REPLACES that default (operator tuning beats shipped
+    thresholds).  Raises ValueError on a malformed file -- a silently
+    dropped rule pack is exactly the failure mode an alert engine
+    must not have."""
+    if path is None:
+        path = envreg.get_path("DPRF_ALERT_RULES")
+    rules = {r["name"]: AlertRule.from_dict(r) for r in DEFAULT_RULES}
+    if path:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ValueError(f"DPRF_ALERT_RULES file {path!r}: {e}")
+        if not isinstance(doc, list):
+            raise ValueError(
+                f"DPRF_ALERT_RULES file {path!r}: want a JSON list "
+                "of rule objects")
+        for d in doc:
+            r = AlertRule.from_dict(d)
+            rules[r.name] = r
+    return list(rules.values())
+
+
+def load_alerts(path: str) -> list:
+    """Read an alert-event stream back (rotated ``.1`` part first,
+    torn tail lines skipped) -- the ``dprf report`` health section's
+    input."""
+    events = []
+    for p in (path + ".1", path):
+        if not os.path.exists(p):
+            continue
+        try:
+            with open(p, encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        e = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(e, dict) and e.get("rule"):
+                        events.append(e)
+        except OSError:
+            continue
+    return events
+
+
+class _AlertState:
+    """Lifecycle state for one (rule, label set)."""
+
+    __slots__ = ("rule", "labels", "state", "since", "fired_at",
+                 "clear_since", "value")
+
+    def __init__(self, rule: AlertRule, labels: dict, now: float):
+        self.rule = rule
+        self.labels = labels
+        self.state = PENDING
+        self.since = now
+        self.fired_at: Optional[float] = None
+        self.clear_since: Optional[float] = None
+        self.value = 0.0
+
+    def as_dict(self, now: float) -> dict:
+        return {"rule": self.rule.name, "state": self.state,
+                "labels": dict(self.labels),
+                "severity": self.rule.severity,
+                "summary": self.rule.summary,
+                "value": round(self.value, 6),
+                "threshold": self.rule.threshold,
+                "since_s": round(max(0.0, now - self.since), 3)}
+
+
+class AlertEngine:
+    """Rules + lifecycle state + the event stream.  ``evaluate()`` is
+    the only mutator (one caller: the health monitor loop, or a test
+    driving it directly); reads come from RPC handler threads."""
+
+    def __init__(self, rules=None, registry=None, clock=None,
+                 wall=None):
+        self.rules = list(rules) if rules is not None else load_rules()
+        self.registry = get_registry(registry)
+        self._clock = clock or time.monotonic
+        self._wall = wall or time.time
+        self._lock = threading.Lock()
+        self._alerts: dict = {}     # (rule name, label key) -> state
+        self._history: deque = deque(maxlen=HISTORY_MAX)
+        self._prev: dict = {}       # rate rules: key -> (value, t)
+        self._path: Optional[str] = None
+        self._max_bytes: Optional[int] = None
+        #: cumulative evaluation cost -- the <=2% overhead assertion's
+        #: measured quantity (tests/test_health.py)
+        self.eval_seconds = 0.0
+        self.evals = 0
+        m = self.registry
+        self._g_firing = m.gauge(
+            "dprf_alerts_firing",
+            "alerts currently in the firing state, per rule",
+            labelnames=("rule",))
+        self._m_fired = m.counter(
+            "dprf_alerts_fired_total",
+            "pending->firing transitions, per rule",
+            labelnames=("rule",))
+
+    # -- event stream ----------------------------------------------------
+
+    def attach_file(self, path: str,
+                    max_bytes: Optional[int] = None) -> "AlertEngine":
+        """Stream subsequent alert events to a JSONL file (the
+        session's ``.alerts.jsonl``), size-capped like the telemetry
+        and trace streams."""
+        with self._lock:
+            self._path = path
+            self._max_bytes = max_bytes
+        return self
+
+    def _emit(self, event: dict) -> None:
+        """Append one event to history + the stream.  Alert
+        transitions are rare (human-scale), so the stream opens per
+        event -- no held handle, no release discipline to audit."""
+        from dprf_tpu.telemetry.snapshot import rotate_if_over
+        self._history.append(event)
+        if self._path is None:
+            return
+        data = json.dumps(event, separators=(",", ":"),
+                          default=str) + "\n"
+        cap = (alerts_max_bytes() if self._max_bytes is None
+               else self._max_bytes)
+        try:
+            rotate_if_over(self._path, len(data), cap)
+            with open(self._path, "a", encoding="utf-8") as fh:
+                fh.write(data)
+        except OSError:
+            pass   # a full disk must not kill the serve plane
+    _emit._holds_lock = "_lock"
+
+    def _event(self, st: _AlertState, state: str) -> dict:
+        return {"ts": round(self._wall(), 3), "rule": st.rule.name,
+                "state": state, "labels": dict(st.labels),
+                "severity": st.rule.severity,
+                "summary": st.rule.summary,
+                "value": round(st.value, 6),
+                "threshold": st.rule.threshold}
+    _event._holds_lock = "_lock"
+
+    # -- evaluation ------------------------------------------------------
+
+    def _conditions(self, rule: AlertRule, now: float) -> dict:
+        """{label key tuple: (labels dict, value, condition bool)}
+        for one rule against the live registry.  Rate rules need two
+        sightings of a child before they can report a condition."""
+        out: dict = {}
+        metric = self.registry.get(rule.metric)
+        if metric is None:
+            return out
+        for v in metric.snapshot_values():
+            labels = v.get("labels") or {}
+            if any(labels.get(k) != str(val)
+                   for k, val in rule.labels.items()):
+                continue
+            # histograms have no single value to threshold; rules
+            # target counters and gauges
+            if "value" not in v:
+                continue
+            value = float(v["value"])
+            key = tuple(sorted(labels.items()))
+            if rule.rate:
+                prev = self._prev.get((rule.name, key))
+                self._prev[(rule.name, key)] = (value, now)
+                if prev is None or now <= prev[1]:
+                    continue
+                value = (value - prev[0]) / (now - prev[1])
+            out[key] = (labels, value, OPS[rule.op](value,
+                                                   rule.threshold))
+        return out
+    _conditions._holds_lock = "_lock"
+
+    def evaluate(self) -> list:
+        """One pass over every rule; returns the transition events it
+        emitted (also appended to history / the stream)."""
+        t0 = time.perf_counter()
+        now = self._clock()
+        events = []
+        firing_count: dict = {}
+        with self._lock:
+            for rule in self.rules:
+                for key, (labels, value, cond) in \
+                        self._conditions(rule, now).items():
+                    akey = (rule.name, key)
+                    st = self._alerts.get(akey)
+                    if cond:
+                        if st is None:
+                            st = self._alerts[akey] = _AlertState(
+                                rule, labels, now)
+                            st.value = value
+                            events.append(self._event(st, PENDING))
+                        st.value = value
+                        st.clear_since = None
+                        if (st.state == PENDING
+                                and now - st.since >= rule.for_s):
+                            st.state = FIRING
+                            st.fired_at = now
+                            st.since = now
+                            self._m_fired.inc(rule=rule.name)
+                            events.append(self._event(st, FIRING))
+                    elif st is not None:
+                        st.value = value
+                        if st.state == PENDING:
+                            # never fired: drop silently (no resolve
+                            # event for an alert nobody was shown)
+                            del self._alerts[akey]
+                        else:
+                            if st.clear_since is None:
+                                st.clear_since = now
+                            if now - st.clear_since >= rule.clear_s:
+                                # the flap suppressor: the condition
+                                # stayed false for the whole hold
+                                events.append(self._event(st,
+                                                          RESOLVED))
+                                del self._alerts[akey]
+            for akey, st in self._alerts.items():
+                if st.state == FIRING:
+                    firing_count[akey[0]] = \
+                        firing_count.get(akey[0], 0) + 1
+            for e in events:
+                self._emit(e)
+            self.eval_seconds += time.perf_counter() - t0
+            self.evals += 1
+        for rule in self.rules:
+            self._g_firing.set(firing_count.get(rule.name, 0),
+                               rule=rule.name)
+        return events
+
+    # -- reads -----------------------------------------------------------
+
+    def active(self) -> list:
+        """Every pending/firing alert, firing first."""
+        now = self._clock()
+        with self._lock:
+            out = [st.as_dict(now) for st in self._alerts.values()]
+        out.sort(key=lambda a: (a["state"] != FIRING, a["rule"]))
+        return out
+
+    def firing_names(self) -> list:
+        """Compact "rule(label values)" strings for the ``dprf top``
+        header line."""
+        out = []
+        with self._lock:
+            for st in self._alerts.values():
+                if st.state != FIRING:
+                    continue
+                lv = ",".join(str(v) for _, v in
+                              sorted(st.labels.items()))
+                out.append(f"{st.rule.name}({lv})" if lv
+                           else st.rule.name)
+        return sorted(out)
+
+    def history(self, n: int = HISTORY_MAX) -> list:
+        with self._lock:
+            items = list(self._history)
+        return items[-max(1, int(n)):]

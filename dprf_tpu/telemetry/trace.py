@@ -256,6 +256,15 @@ class TraceRecorder:
         self._m_spans = get_registry(registry).counter(
             "dprf_trace_spans_total",
             "lifecycle spans recorded into the flight recorder")
+        #: the trace_drops alert condition (telemetry/alerts.py): a
+        #: sustained nonzero rate means the timeline is lying by
+        #: omission -- spans over the ingest bound, failing
+        #: sanitization, or lost to a dead stream write
+        self._m_dropped = get_registry(registry).counter(
+            "dprf_trace_spans_dropped_total",
+            "spans dropped at remote ingest (over the per-message "
+            "bound or failing sanitization) or lost to a failed "
+            "trace-stream write")
         self._g_busy = get_registry(registry).gauge(
             "dprf_device_busy_fraction",
             "fraction of the sliding window each worker's sweep "
@@ -308,17 +317,21 @@ class TraceRecorder:
         if isinstance(sent_at, (int, float)):
             offset = self._clock() - float(sent_at)
         n = 0
-        for s in spans[:limit if limit is not None
-                       else MAX_INGEST_SPANS]:
+        bound = limit if limit is not None else MAX_INGEST_SPANS
+        dropped = max(0, len(spans) - bound)
+        for s in spans[:bound]:
             if not isinstance(s, dict):
+                dropped += 1
                 continue
             name = s.get("name")
             if not isinstance(name, str) or name not in SPAN_NAMES:
+                dropped += 1
                 continue
             try:
                 ts = float(s.get("ts", 0.0))
                 dur = float(s.get("dur", 0.0))
             except (TypeError, ValueError):
+                dropped += 1
                 continue
             clean = {"name": name, "ts": round(ts + offset, 6),
                      "dur": round(dur, 6),
@@ -330,11 +343,14 @@ class TraceRecorder:
                      "attrs": _clean_attrs(s.get("attrs"))}
             self._append(clean)
             n += 1
+        if dropped:
+            self._m_dropped.inc(dropped)
         return n
 
     def _append(self, span: dict) -> None:
         self._m_spans.inc()
         busy = None
+        lost_write = False
         with self._lock:
             if span["name"] == "sweep" and span["dur"] > 0:
                 # live utilization: fold the sweep interval into the
@@ -358,11 +374,16 @@ class TraceRecorder:
                         self._fh.flush()
                         self._file_bytes += len(data)
                 except OSError:
-                    pass   # a full disk must not kill the job
+                    # a full disk must not kill the job, but a span
+                    # the stream lost is a drop the alert engine
+                    # should see (counted below, outside the lock)
+                    lost_write = True
         if busy is not None:
             # gauge set OUTSIDE _lock: code holding _lock must never
             # call into other locked subsystems (lock-order contract)
             self._g_busy.set(busy[1], worker=busy[0])
+        if lost_write:
+            self._m_dropped.inc()
 
     def _rotate_locked(self) -> None:
         """Size-cap rotation: the stream moves to ``<path>.1``
@@ -744,6 +765,12 @@ def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
     quarantined = status.get("quarantined") or []
     if quarantined:
         lines.append(f"quarantined workers: {', '.join(quarantined)}")
+    # fleet health plane (ISSUE 10): firing alerts lead the frame --
+    # an operator watching top must not need a second terminal to
+    # learn the fleet is on fire
+    firing = status.get("alerts") or []
+    if firing:
+        lines.append(f"FIRING ALERTS: {', '.join(firing)}")
     # per-job table (multi-tenant serve plane): one row per scheduler
     # job once the coordinator holds more than the default job
     jobs = status.get("jobs") or []
@@ -769,7 +796,13 @@ def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
     for s in spans:
         last_span[str(s.get("proc"))] = s
     by_worker = {str(l.get("worker")): l for l in leases}
+    # workers known only to the health plane (heartbeating while
+    # holding no lease -- or missing/dead) still get a row: a silent
+    # worker that vanished from the lease table is exactly the one
+    # the operator is looking for
+    health = status.get("health") or {}
     workers = sorted(set(by_worker)
+                     | set(health)
                      | {p for p in last_span
                         if p not in ("coordinator",)})
     # grouping key: the worker's current job first ("-" for idle
@@ -779,7 +812,7 @@ def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
     lines.append("")
     lines.append(f"{'WORKER':20s} {'JOB':>5s} {'STATE':10s} "
                  f"{'UNIT':>8s} {'RANGE':>24s} {'LEASE':>8s} "
-                 f"{'BUSY':>5s} {'LAST SPAN':>10s}")
+                 f"{'BUSY':>5s} {'HEALTH':>8s} {'LAST SPAN':>10s}")
     # ages against the COORDINATOR's clock (shipped in status): the
     # spans carry its wall time, and the viewer's clock may be skewed
     now = status.get("now") or time.time()
@@ -796,12 +829,13 @@ def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
         dl = _fmt_age(lease["deadline_s"]) if lease else "-"
         b = busy.get(w)
         b_s = f"{100.0 * b:.0f}%" if b is not None else "-"
+        hw = str(health.get(w) or "-")[:8]
         age = (_fmt_age(max(0.0, now - (s.get("ts", now)
                                         + s.get("dur", 0.0))))
                if s else "-")
         lines.append(f"{w[:20]:20s} {jid[:5]:>5s} {state:10s} "
                      f"{unit:>8s} {rng:>24s} {dl:>8s} {b_s:>5s} "
-                     f"{age:>10s}")
+                     f"{hw:>8s} {age:>10s}")
     lines.append("")
     lines.append("recent spans:")
     for s in spans[-8:]:

@@ -149,6 +149,26 @@ _declare("DPRF_TUNE_DIR", None, "path",
          "directory, else ~/.cache/dprf).")
 
 # -- observability -----------------------------------------------------------
+_declare("DPRF_ALERT_EVAL_S", 5.0, "float",
+         "Seconds between fleet-health/alert evaluation passes "
+         "(worker state machine, straggler detection, per-job SLOs, "
+         "alert rules -- telemetry/health.py + telemetry/alerts.py).")
+_declare("DPRF_ALERT_RULES", None, "path",
+         "JSON file of extra alert rules loaded next to the default "
+         "pack (list of rule objects; see README 'Fleet health & "
+         "alerts').  `dprf check` validates every referenced metric "
+         "name against the declared dprf_* registry.")
+_declare("DPRF_ALERTS_MAX_BYTES", 4 << 20, "int",
+         "Size cap for the session alert-event JSONL "
+         "(<session>.alerts.jsonl) before it rotates to '.1' (0 "
+         "disables the cap).")
+_declare("DPRF_HEARTBEAT_S", 10.0, "float",
+         "Worker heartbeat cadence: a remote worker sends "
+         "op_heartbeat when its main connection has been quiet this "
+         "long (lease/complete traffic counts as contact); the "
+         "coordinator's health state machine ages workers in "
+         "multiples of this interval.  0 disables explicit "
+         "heartbeats.")
 _declare("DPRF_PERF_SAMPLE", 16, "int",
          "Per-phase sweep attribution cadence: every Nth unit runs a "
          "serial, synced probe recording phase spans and the "
