@@ -132,6 +132,11 @@ def _build_parser() -> argparse.ArgumentParser:
                        "distributed job (workers connect with "
                        "`dprf worker`)")
     _add_job_args(s)
+    s.add_argument("--devices", type=int, default=1,
+                   help="ask each worker to shard the job's units over "
+                   "N of its local chips (the wire job carries the "
+                   "request; a worker's own --devices overrides, and "
+                   "hosts with fewer chips degrade to what they have)")
     s.add_argument("--bind", default="127.0.0.1:41715",
                    metavar="HOST:PORT",
                    help="listen address; the protocol is unauthenticated "
@@ -148,8 +153,11 @@ def _build_parser() -> argparse.ArgumentParser:
     w.add_argument("--connect", required=True, metavar="HOST:PORT")
     w.add_argument("--device", default="tpu",
                    choices=sorted(_DEVICE_ALIASES))
-    w.add_argument("--devices", type=int, default=1,
-                   help="shard each unit over N local chips")
+    w.add_argument("--devices", type=int, default=None,
+                   help="shard each unit over N local chips (overrides "
+                   "a job's own devices request, including an explicit "
+                   "1 to pin this worker to a single chip; default: "
+                   "honor the job)")
     w.add_argument("--id", default=None, help="worker id for the lease "
                    "ledger (default: host:pid)")
     w.add_argument("--batch", type=int, default=None,
@@ -315,6 +323,10 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="device batch size shipped to workers "
                      f"(default: {DEFAULT_BATCH})")
     jsb.add_argument("--hit-cap", type=int, default=64)
+    jsb.add_argument("--devices", type=int, default=1,
+                     help="ask workers to shard this job's units over "
+                     "N of their local chips (unified sharded "
+                     "runtime; a worker's own --devices overrides)")
     jsb.add_argument("--owner", default=None,
                      help="tenant name recorded on the job (default: "
                      "$USER)")
@@ -741,6 +753,18 @@ def _select_worker(engine_name: str, device: str, attack: str, gen,
             dev_engine = get_engine(engine_name, device="jax")
         except KeyError:
             pass
+    if dev_engine is not None and n_devices > 1:
+        import jax as _jax
+        have = len(_jax.devices())
+        if have < n_devices:
+            # a serve-plane job may request more chips than this host
+            # has: degrade to the local mesh instead of refusing the
+            # job's leases (coverage is keyspace-indexed, so any
+            # device count sweeps the same units)
+            log.warn("host has fewer devices than requested; "
+                     "clamping the mesh", requested=n_devices,
+                     have=have)
+            n_devices = have
     if dev_engine is not None and n_devices > 1:
         smaker = maker_name.replace("make_", "make_sharded_")
         if callable(getattr(dev_engine, smaker, None)):
@@ -1192,6 +1216,10 @@ def cmd_serve(args, log: Log) -> int:
         "unit_size": unit_size,
         "batch": batch,
         "hit_cap": args.hit_cap,
+        # sharding request: workers build the job's worker over N of
+        # their local chips through the unified sharded runtime (their
+        # own --devices flag overrides)
+        "devices": max(1, getattr(args, "devices", 1) or 1),
         "fingerprint": spec.fingerprint,
     }
 
@@ -1403,9 +1431,14 @@ def cmd_worker(args, log: Log) -> int:
                 f"local job {jid} disagrees with coordinator "
                 "(different wordlist/rules file content on this "
                 f"host?): ours={ours} theirs={spec['fingerprint']}")
+        # the worker's own --devices wins (including an explicit 1 --
+        # pin to a single chip); otherwise honor the job's sharding
+        # request (serve/jobs submit carry "devices")
+        n_dev = (args.devices if args.devices
+                 else int(spec.get("devices") or 1))
         w = _select_worker(spec["engine"], device, spec["attack"], gen,
                            targets, args.batch or spec["batch"],
-                           spec["hit_cap"], engine, args.devices, log)
+                           spec["hit_cap"], engine, n_dev, log)
         # overlapped warmup: the step compile runs while leases
         # round-trip to the coordinator; worker_loop joins it before
         # the first dispatch
@@ -1507,9 +1540,14 @@ def cmd_bench(args, log: Log) -> int:
                             seconds=args.seconds, impl=args.impl, log=log)
     if args.gate:
         # regression sentinel: the verdict rides the result JSON (CI
-        # parses it) and a regression exits non-zero
+        # parses it) and a regression exits non-zero.  Scaling mode
+        # gates against the SCALING_r*.json efficiency trajectory, so
+        # a multichip regression alarms exactly like a throughput one.
+        pattern = (compare_mod.SCALING_PATTERN if args.devices > 1
+                   else "BENCH_r*.json")
         res["gate"] = compare_mod.gate_repo(res, baseline_dir,
-                                            window=args.gate_window)
+                                            window=args.gate_window,
+                                            pattern=pattern)
     print(json.dumps(res))
     if args.gate and res["gate"]["verdict"] == "regression":
         log.error("bench gate: REGRESSION vs the baseline window",
@@ -1797,6 +1835,7 @@ def _jobs_submit(client, args, log: Log) -> int:
         "unit_seconds": args.unit_seconds,
         "batch": args.batch or DEFAULT_BATCH,
         "hit_cap": args.hit_cap,
+        "devices": max(1, args.devices or 1),
     }
     owner = args.owner or os.environ.get("USER") or "?"
     resp = client.call("job_submit", spec=spec, owner=owner,

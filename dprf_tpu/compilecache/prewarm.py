@@ -263,8 +263,11 @@ def _build_worker(spec: PrewarmSpec):
         gen = MaskGenerator(spec.mask)
         maker_name = "make_mask_worker"
     if spec.devices > 1:
-        # sharded (multi-chip mesh) step shape, through the same
-        # factory a `--devices N` job selects
+        # sharded (multi-chip mesh) shape through the UNIFIED sharded
+        # runtime (parallel/sharded.py) -- the same engine factory
+        # path a `--devices N` job selects, so the cached programs
+        # (per-batch step AND the capped superstep big units dispatch)
+        # are exactly the ones a job warms
         import jax
         have = len(jax.devices())
         if have < spec.devices:

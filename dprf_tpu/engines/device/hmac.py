@@ -109,48 +109,18 @@ def make_hmac_wordlist_step(engine, gen, word_batch: int,
 
 def make_sharded_hmac_mask_step(engine, gen, mesh, batch_per_device: int,
                                 hit_capacity: int = 64):
-    from jax import lax
-    from jax.sharding import PartitionSpec as P
+    """Multi-chip variant through the ONE sharded runtime."""
+    from dprf_tpu.parallel.sharded import make_sharded_pertarget_step
 
-    from dprf_tpu.parallel.mesh import SHARD_AXIS, shard_map
-
-    flat = gen.flat_charsets
-    length = gen.length
-    B = batch_per_device
     algo, key_is_pass = engine._algo, engine._key_is_pass
     big_endian = not engine.little_endian
 
-    def shard_fn(base_digits, n_valid, salt, salt_len, target):
-        dev = lax.axis_index(SHARD_AXIS)
-        offset = (dev * B).astype(jnp.int32)
-        cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
-        lengths = jnp.full((B,), length, jnp.int32)
-        digest = _hmac_digest(algo, key_is_pass, cand, lengths,
-                              salt, salt_len, big_endian)
-        lane_global = offset + jnp.arange(B, dtype=jnp.int32)
-        found = cmp_ops.compare_single(digest, target) & \
-            (lane_global < n_valid)
-        count, lanes, tpos = cmp_ops.compact_hits(
-            found, jnp.zeros((B,), jnp.int32), hit_capacity)
-        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
-        total = lax.psum(count, SHARD_AXIS)
-        return (total[None],
-                lax.all_gather(count, SHARD_AXIS),
-                lax.all_gather(lanes, SHARD_AXIS),
-                lax.all_gather(tpos, SHARD_AXIS))
+    def digest_fn(cand, lens, salt, salt_len):
+        return _hmac_digest(algo, key_is_pass, cand, lens, salt,
+                            salt_len, big_endian)
 
-    sharded = shard_map(
-        shard_fn, mesh=mesh, in_specs=(P(),) * 5,
-        out_specs=(P(), P(), P(), P()), check_vma=False)
-
-    @jax.jit
-    def step(base_digits, n_valid, salt, salt_len, target):
-        total, counts, lanes, tpos = sharded(base_digits, n_valid, salt,
-                                             salt_len, target)
-        return total[0], counts, lanes, tpos
-
-    step.super_batch = mesh.devices.size * B
-    return step
+    return make_sharded_pertarget_step(gen, mesh, batch_per_device,
+                                       digest_fn, 2, hit_capacity)
 
 
 class HmacMaskWorker(SaltedMaskWorker):

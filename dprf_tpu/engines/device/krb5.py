@@ -271,14 +271,14 @@ class ShardedKrb5MaskWorker(ShardedPhpassMaskWorker):
                  batch_per_device: int = 1 << 16, hit_capacity: int = 64,
                  oracle=None):
         from dprf_tpu.parallel.sharded import \
-            make_sharded_pertarget_mask_step
+            make_sharded_pertarget_step
         self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
         self.mesh = mesh
         self.batch = self.stride = mesh.devices.size * batch_per_device
         self._targs = _targs(self.targets)
         if gen.length > 27:
             raise ValueError("krb5 etype-23 passwords cap at 27 chars")
-        self.step = make_sharded_pertarget_mask_step(
+        self.step = make_sharded_pertarget_step(
             gen, mesh, batch_per_device, krb5_filter_batch, N_PARAMS,
             hit_capacity)
 

@@ -393,11 +393,11 @@ class ShardedKrb5AesMaskWorker(ShardedPhpassMaskWorker):
                  batch_per_device: int = 1 << 11, hit_capacity: int = 64,
                  oracle=None):
         from dprf_tpu.parallel.sharded import \
-            make_sharded_pertarget_mask_step
+            make_sharded_pertarget_step
         self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
         self.mesh = mesh
         self.batch = self.stride = mesh.devices.size * batch_per_device
-        self._steps = [make_sharded_pertarget_mask_step(
+        self._steps = [make_sharded_pertarget_step(
             gen, mesh, batch_per_device,
             make_krb5aes_filter(t.params,
                                 getattr(engine, "iterations", 4096)),

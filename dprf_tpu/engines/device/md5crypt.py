@@ -194,50 +194,19 @@ def make_md5crypt_wordlist_step(gen, word_batch: int,
 def make_sharded_md5crypt_mask_step(gen, mesh, batch_per_device: int,
                                     hit_capacity: int = 64,
                                     magic: bytes = b"$1$"):
-    from jax.sharding import PartitionSpec as P
+    """Multi-chip variant through the ONE sharded runtime."""
+    from dprf_tpu.parallel.sharded import make_sharded_pertarget_step
 
-    from dprf_tpu.parallel.mesh import SHARD_AXIS, shard_map
-
-    flat = gen.flat_charsets
-    length = gen.length
-    if length > MAX_PASS_LEN:
+    if gen.length > MAX_PASS_LEN:
         raise ValueError(
-            f"candidates of {length} bytes exceed this engine's "
+            f"candidates of {gen.length} bytes exceed this engine's "
             f"{MAX_PASS_LEN}-byte single-block budget")
-    B = batch_per_device
 
-    def shard_fn(base_digits, n_valid, salt, salt_len, target):
-        dev = lax.axis_index(SHARD_AXIS)
-        offset = (dev * B).astype(jnp.int32)
-        cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
-        lens = jnp.full((B,), length, jnp.int32)
-        digest = md5crypt_digest_batch(cand, lens, salt, salt_len,
-                                       magic)
-        lane_global = offset + jnp.arange(B, dtype=jnp.int32)
-        found = cmp_ops.compare_single(digest, target) & \
-            (lane_global < n_valid)
-        cnt, lanes, tpos = cmp_ops.compact_hits(
-            found, jnp.zeros((B,), jnp.int32), hit_capacity)
-        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
-        total = lax.psum(cnt, SHARD_AXIS)
-        # replicated hit buffers (see parallel/sharded.py)
-        return (total[None],
-                lax.all_gather(cnt, SHARD_AXIS),
-                lax.all_gather(lanes, SHARD_AXIS),
-                lax.all_gather(tpos, SHARD_AXIS))
+    def digest_fn(cand, lens, salt, salt_len):
+        return md5crypt_digest_batch(cand, lens, salt, salt_len, magic)
 
-    sharded = shard_map(
-        shard_fn, mesh=mesh, in_specs=(P(),) * 5,
-        out_specs=(P(), P(), P(), P()), check_vma=False)
-
-    @jax.jit
-    def step(base_digits, n_valid, salt, salt_len, target):
-        total, counts, lanes, tpos = sharded(base_digits, n_valid, salt,
-                                             salt_len, target)
-        return total[0], counts, lanes, tpos
-
-    step.super_batch = mesh.devices.size * B
-    return step
+    return make_sharded_pertarget_step(gen, mesh, batch_per_device,
+                                       digest_fn, 2, hit_capacity)
 
 
 def _md5crypt_targs(targets):

@@ -128,47 +128,11 @@ def make_mscache_wordlist_step(gen, word_batch: int, v2: bool,
 
 def make_sharded_mscache_mask_step(gen, mesh, batch_per_device: int,
                                    v2: bool, hit_capacity: int = 64):
-    from jax import lax
-    from jax.sharding import PartitionSpec as P
+    """Multi-chip variant through the ONE sharded runtime."""
+    from dprf_tpu.parallel.sharded import make_sharded_pertarget_step
 
-    from dprf_tpu.parallel.mesh import SHARD_AXIS, shard_map
-
-    flat = gen.flat_charsets
-    length = gen.length
-    B = batch_per_device
-    digest = _digest_fn(v2)
-
-    def shard_fn(base_digits, n_valid, salt, salt_len, iterations,
-                 target):
-        dev = lax.axis_index(SHARD_AXIS)
-        offset = (dev * B).astype(jnp.int32)
-        cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
-        lengths = jnp.full((B,), length, jnp.int32)
-        d = digest(cand, lengths, salt, salt_len, iterations)
-        lane_global = offset + jnp.arange(B, dtype=jnp.int32)
-        found = cmp_ops.compare_single(d, target) & \
-            (lane_global < n_valid)
-        count, lanes, tpos = cmp_ops.compact_hits(
-            found, jnp.zeros((B,), jnp.int32), hit_capacity)
-        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
-        total = lax.psum(count, SHARD_AXIS)
-        return (total[None],
-                lax.all_gather(count, SHARD_AXIS),
-                lax.all_gather(lanes, SHARD_AXIS),
-                lax.all_gather(tpos, SHARD_AXIS))
-
-    sharded = shard_map(
-        shard_fn, mesh=mesh, in_specs=(P(),) * 6,
-        out_specs=(P(), P(), P(), P()), check_vma=False)
-
-    @jax.jit
-    def step(base_digits, n_valid, salt, salt_len, iterations, target):
-        total, counts, lanes, tpos = sharded(
-            base_digits, n_valid, salt, salt_len, iterations, target)
-        return total[0], counts, lanes, tpos
-
-    step.super_batch = mesh.devices.size * B
-    return step
+    return make_sharded_pertarget_step(gen, mesh, batch_per_device,
+                                       _digest_fn(v2), 3, hit_capacity)
 
 
 class _MsCacheInvokeMixin:

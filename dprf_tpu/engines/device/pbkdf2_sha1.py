@@ -174,7 +174,7 @@ class ShardedPbkdf2Sha1MaskWorker(ShardedPhpassMaskWorker):
                  batch_per_device: int = 1 << 12, hit_capacity: int = 64,
                  oracle=None):
         from dprf_tpu.parallel.sharded import \
-            make_sharded_pertarget_mask_step
+            make_sharded_pertarget_step
         self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
         self.mesh = mesh
         self.batch = self.stride = mesh.devices.size * batch_per_device
@@ -192,7 +192,7 @@ class ShardedPbkdf2Sha1MaskWorker(ShardedPhpassMaskWorker):
             return pbkdf2_sha1_runtime_salt(key, salt, salt_len,
                                             iterations, dk_words)
 
-        self.step = make_sharded_pertarget_mask_step(
+        self.step = make_sharded_pertarget_step(
             gen, mesh, batch_per_device, digest_fn, 3, hit_capacity)
 
 

@@ -318,7 +318,7 @@ class ShardedPdfMaskWorker(ShardedPhpassMaskWorker):
                  batch_per_device: int = 1 << 14, hit_capacity: int = 64,
                  oracle=None):
         from dprf_tpu.parallel.sharded import \
-            make_sharded_pertarget_mask_step
+            make_sharded_pertarget_step
         self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
         self.mesh = mesh
         self.batch = self.stride = mesh.devices.size * batch_per_device
@@ -328,7 +328,7 @@ class ShardedPdfMaskWorker(ShardedPhpassMaskWorker):
             rev = 2 if t.params["rev"] == 2 else 3
             kind = (rev, t.params["key_len"])
             if kind not in by_kind:
-                by_kind[kind] = make_sharded_pertarget_mask_step(
+                by_kind[kind] = make_sharded_pertarget_step(
                     gen, mesh, batch_per_device, _filter_for(*kind),
                     2 if rev == 2 else 3, hit_capacity)
             params, tw = _target_args(t)

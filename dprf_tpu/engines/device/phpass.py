@@ -106,7 +106,7 @@ def make_pertarget_wordlist_step(gen, word_batch: int, digest_fn,
     on-device scaffold (packed-wordlist slice -> rule expansion ->
     digest -> compare -> compact) with the engine's math injected as
     `digest_fn(cand, lens, *params)` — the same contract as
-    parallel/sharded.make_sharded_pertarget_mask_step, so an engine
+    parallel/sharded.make_sharded_pertarget_step, so an engine
     writes its filter once for both.  The LAST step argument is the
     target word vector: step(w0, n_valid_words, *params, target)."""
     from dprf_tpu.ops.rules_pipeline import expand_rules
@@ -145,15 +145,15 @@ def make_phpass_wordlist_step(gen, word_batch: int, hit_capacity: int = 64):
 
 def make_sharded_phpass_mask_step(gen, mesh, batch_per_device: int,
                                   hit_capacity: int = 64):
-    """Multi-chip variant: the generic per-target sharded step driving
-    phpass_digest_batch (salt, count params)."""
-    from dprf_tpu.parallel.sharded import make_sharded_pertarget_mask_step
+    """Multi-chip variant: the unified sharded runtime's per-target
+    step driving phpass_digest_batch (salt, count params)."""
+    from dprf_tpu.parallel.sharded import make_sharded_pertarget_step
 
     if gen.length > MAX_PASS_LEN:
         raise ValueError(
             f"candidates of {gen.length} bytes exceed this engine's "
             f"{MAX_PASS_LEN}-byte single-block budget")
-    return make_sharded_pertarget_mask_step(
+    return make_sharded_pertarget_step(
         gen, mesh, batch_per_device, phpass_digest_batch, 2,
         hit_capacity)
 
@@ -278,6 +278,11 @@ class PhpassWordlistWorker(_PhpassWorkerBase):
 
 
 class ShardedPhpassMaskWorker(PhpassMaskWorker):
+    """Per-target sweep over the unified sharded runtime.  Submit-
+    based: ALL (target, batch) dispatches enqueue up front with one
+    device-accumulated flag, so the remote worker loop pipelines
+    sharded per-target units exactly like the fast-hash paths."""
+
     def __init__(self, engine, gen, targets, mesh,
                  batch_per_device: int = 1 << 13, hit_capacity: int = 64,
                  oracle=None):
@@ -289,33 +294,46 @@ class ShardedPhpassMaskWorker(PhpassMaskWorker):
         self.step = make_sharded_phpass_mask_step(
             gen, mesh, batch_per_device, hit_capacity)
 
-    def process(self, unit: WorkUnit) -> list[Hit]:
-        hits: list[Hit] = []
+    def submit(self, unit: WorkUnit):
+        from dprf_tpu.runtime.worker import PendingUnit
+        queued = []
+        flag = None
         for ti in range(len(self.targets)):
             targ = self._targs[ti]
-            queued = []
             for bstart in range(unit.start, unit.end, self.stride):
                 n_valid = min(self.stride, unit.end - bstart)
                 base = jnp.asarray(self.gen.digits(bstart),
                                    dtype=jnp.int32)
-                queued.append((bstart, self.step(
-                    base, jnp.int32(n_valid), *targ)))
-            for bstart, (total, counts, lanes, _) in queued:
-                if int(total) == 0:
-                    continue
-                if (np.asarray(counts) > self.hit_capacity).any():
-                    hits.extend(self._rescan(
-                        bstart, min(bstart + self.stride, unit.end), ti))
-                    continue
-                for lane in np.asarray(lanes).ravel():
-                    if lane < 0:
-                        continue
-                    gidx = bstart + int(lane)
-                    hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
+                result = self.step(base, jnp.int32(n_valid), *targ)
+                # device-accumulated unit flag (total is psum'd)
+                f = result[0]
+                flag = f if flag is None else flag + f
+                queued.append(("pshard", (ti, bstart), result))
+        if flag is not None and hasattr(flag, "copy_to_host_async"):
+            flag.copy_to_host_async()
+        return PendingUnit(self, unit, queued, flag)
+
+    def _decode_queued(self, kind: str, start, result,
+                       unit: WorkUnit) -> list[Hit]:
+        ti, bstart = start
+        total, counts, lanes, _ = result
+        if int(total) == 0:
+            return []
+        if (np.asarray(counts) > lanes.shape[-1]).any():
+            return self._rescan(
+                bstart, min(bstart + self.stride, unit.end), ti)
+        hits: list[Hit] = []
+        for lane in np.asarray(lanes).ravel():
+            if lane < 0:
+                continue
+            gidx = bstart + int(lane)
+            hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
         return hits
-    # this sweep overlaps internally (queue-then-decode); an
-    # inherited submit() would bypass the override
-    process._serial_only = True
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        return self.submit(unit).resolve()
+
+    process._submit_based = True   # safe to pipeline via submit()
 
 
 @register("phpass", device="jax")

@@ -144,54 +144,25 @@ def make_salted_wordlist_step(engine, gen, word_batch: int, order: str,
 
 def make_sharded_salted_mask_step(engine, gen, mesh, batch_per_device: int,
                                   order: str, hit_capacity: int = 64):
-    """Multi-chip salted mask step: the usual keyspace-DP shape
-    (lane-slice per chip, psum'd count, replicated hit buffers)."""
-    from jax import lax
-    from jax.sharding import PartitionSpec as P
+    """Multi-chip salted mask step through the ONE sharded runtime:
+    only the salt-concat digest math lives here."""
+    from dprf_tpu.parallel.sharded import make_sharded_pertarget_step
 
-    from dprf_tpu.parallel.mesh import SHARD_AXIS, shard_map
-
-    flat = gen.flat_charsets
     length = gen.length
-    B = batch_per_device
     pre = engine.pre_salt
     mult = engine.length_multiplier
     sw = engine.salt_width
 
-    def shard_fn(base_digits, n_valid, salt, salt_len, target):
-        dev = lax.axis_index(SHARD_AXIS)
-        offset = (dev * B).astype(jnp.int32)
-        cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
+    def digest_fn(cand, lens, salt, salt_len):
         if pre is not None:
             cand = pre(cand)
         byts, lengths = _salted_concat(cand, length * mult, salt,
-                                       salt_len, order, B, sw)
-        digest = engine.digest_packed(engine.pack_varlen(byts, lengths))
-        lane_global = offset + jnp.arange(B, dtype=jnp.int32)
-        found = cmp_ops.compare_single(digest, target) & \
-            (lane_global < n_valid)
-        count, lanes, tpos = cmp_ops.compact_hits(
-            found, jnp.zeros((B,), jnp.int32), hit_capacity)
-        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
-        total = lax.psum(count, SHARD_AXIS)
-        # replicated hit buffers (see parallel/sharded.py)
-        return (total[None],
-                lax.all_gather(count, SHARD_AXIS),
-                lax.all_gather(lanes, SHARD_AXIS),
-                lax.all_gather(tpos, SHARD_AXIS))
+                                       salt_len, order, cand.shape[0],
+                                       sw)
+        return engine.digest_packed(engine.pack_varlen(byts, lengths))
 
-    sharded = shard_map(
-        shard_fn, mesh=mesh, in_specs=(P(),) * 5,
-        out_specs=(P(), P(), P(), P()), check_vma=False)
-
-    @jax.jit
-    def step(base_digits, n_valid, salt, salt_len, target):
-        total, counts, lanes, tpos = sharded(base_digits, n_valid, salt,
-                                             salt_len, target)
-        return total[0], counts, lanes, tpos
-
-    step.super_batch = mesh.devices.size * B
-    return step
+    return make_sharded_pertarget_step(gen, mesh, batch_per_device,
+                                       digest_fn, 2, hit_capacity)
 
 
 class _SaltedWorkerBase:
@@ -608,11 +579,15 @@ class ShardedSaltedMaskWorker(SaltedMaskWorker):
             engine, gen, mesh, batch_per_device, engine.order,
             hit_capacity)
 
-    def process(self, unit: WorkUnit) -> list[Hit]:
-        hits: list[Hit] = []
+    def submit(self, unit: WorkUnit):
+        """Submit-based per-target sweep (unified sharded runtime):
+        ALL (target, batch) dispatches enqueue up front with one
+        device-accumulated flag, so the remote worker loop pipelines
+        sharded salted units like the fast-hash paths."""
+        from dprf_tpu.runtime.worker import PendingUnit
+        queued = []
+        flag = None
         for ti in range(len(self.targets)):
-            queued = []
-            flag = None
             for bstart in range(unit.start, unit.end, self.stride):
                 n_valid = min(self.stride, unit.end - bstart)
                 base = jnp.asarray(self.gen.digits(bstart),
@@ -621,27 +596,34 @@ class ShardedSaltedMaskWorker(SaltedMaskWorker):
                 # device-accumulated unit flag (total is psum'd)
                 f = self._batch_flag(result)
                 flag = f if flag is None else flag + f
-                queued.append((bstart, result))
-            if flag is None or int(flag) == 0:
+                queued.append(("salt-shard", (ti, bstart), result))
+        if flag is not None and hasattr(flag, "copy_to_host_async"):
+            flag.copy_to_host_async()
+        return PendingUnit(self, unit, queued, flag)
+
+    def _decode_queued(self, kind: str, start, result,
+                       unit: WorkUnit) -> list[Hit]:
+        ti, bstart = start
+        total, counts, lanes, _ = result
+        if int(total) == 0:
+            return []
+        if (np.asarray(counts) > lanes.shape[-1]).any():
+            return self._rescan(
+                bstart, min(bstart + self.stride, unit.end), ti)
+        hits: list[Hit] = []
+        for lane in np.asarray(lanes).ravel():
+            if lane < 0:
                 continue
-            for bstart, (total, counts, lanes, _) in queued:
-                if int(total) == 0:
-                    continue
-                if (np.asarray(counts) > self.hit_capacity).any():
-                    hits.extend(self._rescan(
-                        bstart, min(bstart + self.stride, unit.end), ti))
-                    continue
-                for lane in np.asarray(lanes).ravel():
-                    if lane < 0:
-                        continue
-                    gidx = bstart + int(lane)
-                    plain = self.gen.candidate(gidx)
-                    if self._accept(ti, gidx, plain):
-                        hits.append(Hit(ti, gidx, plain))
+            gidx = bstart + int(lane)
+            plain = self.gen.candidate(gidx)
+            if self._accept(ti, gidx, plain):
+                hits.append(Hit(ti, gidx, plain))
         return hits
-    # this sweep overlaps internally (queue-then-decode); an
-    # inherited submit() would bypass the override
-    process._serial_only = True
+
+    def process(self, unit: WorkUnit) -> list[Hit]:
+        return self.submit(unit).resolve()
+
+    process._submit_based = True   # safe to pipeline via submit()
 
 
 class _SaltedDeviceMixin:

@@ -264,11 +264,11 @@ class ShardedSevenZipMaskWorker(ShardedPhpassMaskWorker):
                  batch_per_device: int = 1 << 10, hit_capacity: int = 64,
                  oracle=None):
         from dprf_tpu.parallel.sharded import \
-            make_sharded_pertarget_mask_step
+            make_sharded_pertarget_step
         self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
         self.mesh = mesh
         self.batch = self.stride = mesh.devices.size * batch_per_device
-        self._steps = [make_sharded_pertarget_mask_step(
+        self._steps = [make_sharded_pertarget_step(
             gen, mesh, batch_per_device,
             make_7z_filter(gen.length, t.params), 0, hit_capacity)
             for t in self.targets]

@@ -318,7 +318,7 @@ class ShardedSha512cryptMaskWorker(ShardedPhpassMaskWorker):
                  batch_per_device: int = 1 << 11, hit_capacity: int = 64,
                  oracle=None):
         from dprf_tpu.parallel.sharded import \
-            make_sharded_pertarget_mask_step
+            make_sharded_pertarget_step
         self._setup_sweep(engine, gen, targets, hit_capacity, oracle)
         self.mesh = mesh
         self.batch = self.stride = mesh.devices.size * batch_per_device
@@ -327,7 +327,7 @@ class ShardedSha512cryptMaskWorker(ShardedPhpassMaskWorker):
             raise ValueError(
                 f"candidates of {gen.length} bytes exceed this engine's "
                 f"{MAX_PASS_LEN}-byte single-block budget")
-        self.step = make_sharded_pertarget_mask_step(
+        self.step = make_sharded_pertarget_step(
             gen, mesh, batch_per_device, sha512crypt_digest_batch, 3,
             hit_capacity)
 

@@ -133,6 +133,9 @@ def build_job_runtime(spec: dict, job_id: str, log=None,
         "unit_seconds": unit_seconds,
         "batch": batch,
         "hit_cap": hit_cap,
+        # sharding request: workers shard this job's units over N of
+        # their local chips (cli.cmd_worker; their --devices overrides)
+        "devices": max(1, int(spec.get("devices") or 1)),
         "fingerprint": fingerprint,
     }
     return wire_job, dispatcher, targets, verifier

@@ -81,21 +81,16 @@ def make_sharded_combinator_crack_step(
         engine, gen, targets: Union[jnp.ndarray, cmp_ops.TargetTable],
         mesh, batch_per_device: int, hit_capacity: int = 64,
         widen_utf16: bool = False):
-    """Multi-chip combinator step; same output contract as
-    parallel/sharded.make_sharded_mask_crack_step (replicated buffers).
-    """
-    from jax.sharding import PartitionSpec as P
-
+    """Multi-chip combinator step through the ONE sharded runtime
+    (parallel/sharded.py): only the per-shard compute lives here."""
     from dprf_tpu.ops import pack as pack_ops
-    from dprf_tpu.parallel.mesh import SHARD_AXIS, shard_map
+    from dprf_tpu.parallel.sharded import make_sharded_step
 
     lbuf, llens, rbuf, rlens = map(jnp.asarray, gen.tables())
     multi = isinstance(targets, cmp_ops.TargetTable)
     B = batch_per_device
 
-    def shard_fn(base_digits, n_valid):
-        dev = lax.axis_index(SHARD_AXIS)
-        offset = (dev * B).astype(jnp.int32)
+    def compute(offset, base_digits, n_valid):
         cand, lengths, fits = _decode_combine(
             gen, lbuf, llens, rbuf, rlens, base_digits, B,
             lane_offset=offset)
@@ -108,26 +103,10 @@ def make_sharded_combinator_crack_step(
         else:
             found = cmp_ops.compare_single(digest, targets)
             tpos = jnp.zeros((B,), jnp.int32)
-        lane_global = offset + jnp.arange(B, dtype=jnp.int32)
-        found = found & fits & (lane_global < n_valid)
-        count, lanes, tpos = cmp_ops.compact_hits(found, tpos,
-                                                  hit_capacity)
-        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
-        total = lax.psum(count, SHARD_AXIS)
-        # replicated hit buffers (see parallel/sharded.py)
-        return (total[None],
-                lax.all_gather(count, SHARD_AXIS),
-                lax.all_gather(lanes, SHARD_AXIS),
-                lax.all_gather(tpos, SHARD_AXIS))
+        lane = offset + jnp.arange(B, dtype=jnp.int32)
+        return found & fits & (lane < n_valid), tpos
 
-    sharded = shard_map(
-        shard_fn, mesh=mesh, in_specs=(P(), P()),
-        out_specs=(P(), P(), P(), P()), check_vma=False)
-
-    @jax.jit
-    def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray):
-        total, counts, lanes, tpos = sharded(base_digits, n_valid)
-        return total[0], counts, lanes, tpos
-
-    step.super_batch = mesh.devices.size * B
+    step = make_sharded_step(compute, mesh, B, 2,
+                             hit_capacity=hit_capacity)
+    step.super_batch = step.super_span
     return step

@@ -106,15 +106,20 @@ def make_sharded_wordlist_crack_step(
         targets: Union[jnp.ndarray, cmp_ops.TargetTable],
         mesh: Mesh, word_batch: int, hit_capacity: int = 64,
         widen_utf16: bool = False):
-    """Multi-chip variant: chip c expands+hashes words
-    [w0 + c*word_batch, w0 + (c+1)*word_batch).
+    """Multi-chip variant through the ONE sharded runtime
+    (parallel/sharded.py): chip c expands+hashes words
+    [w0 + offset, w0 + offset + word_batch) with the word cursor
+    advancing ON DEVICE across superstep iterations.
 
     Returns step(w0 int32, n_valid_words int32) ->
         (total int32, counts int32[n_dev], lanes int32[n_dev, cap],
-         tpos int32[n_dev, cap]); lanes are flat indices into the
-    *super-batch* candidate block, i.e. r*(n_dev*B) + (global word lane).
+         tpos int32[n_dev, cap]); lanes are window-relative KEYSPACE
+    offsets (relative to ``w0 * n_rules``): the runtime's globalize
+    hook maps each rule-major flat lane r*B + b to
+    ``(offset + b) * n_rules + r``, so the host decode is simply
+    ``w0 * n_rules + lane``.
     """
-    from dprf_tpu.parallel.mesh import SHARD_AXIS, shard_map
+    from dprf_tpu.parallel.sharded import make_sharded_step
 
     n_dev = mesh.devices.size
     B, L = word_batch, gen.max_len
@@ -126,43 +131,25 @@ def make_sharded_wordlist_crack_step(
     R = len(rules)
     multi = isinstance(targets, cmp_ops.TargetTable)
 
-    def shard_fn(w0, n_valid_words):
-        dev = lax.axis_index(SHARD_AXIS)
-        my_w0 = w0 + (dev * B).astype(jnp.int32)
+    def compute(offset, w0, n_valid_words):
+        my_w0 = (w0 + offset).astype(jnp.int32)
         wslice = lax.dynamic_slice(words_dev, (my_w0, 0), (B, L))
         lslice = lax.dynamic_slice(lens_dev, (my_w0,), (B,))
-        word_lane = (dev * B).astype(jnp.int32) + jnp.arange(B, dtype=jnp.int32)
+        word_lane = offset + jnp.arange(B, dtype=jnp.int32)
         base_valid = word_lane < n_valid_words
         digest, cv = _expand_and_digest(engine, rules, wslice, lslice,
                                         base_valid, L, widen_utf16)
         found, tpos = _compare(digest, targets, multi)
-        count, lanes, tpos = cmp_ops.compact_hits(
-            found & cv, tpos, hit_capacity)
-        # local flat lane r*B + b -> super-batch flat lane
-        # r*(n_dev*B) + dev*B + b, preserving -1 padding.
-        r = lanes // B
-        b = lanes % B
-        glanes = r * (n_dev * B) + dev * B + b
-        lanes = jnp.where(lanes >= 0, glanes, lanes)
-        total = lax.psum(count, SHARD_AXIS)
-        # replicated hit buffers (see parallel/sharded.py): every host
-        # of a multi-host mesh can read them from local devices
-        return (total[None],
-                lax.all_gather(count, SHARD_AXIS),
-                lax.all_gather(lanes, SHARD_AXIS),
-                lax.all_gather(tpos, SHARD_AXIS))
+        return found & cv, tpos
 
-    sharded = shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P(), P()),
-        out_specs=(P(), P(), P(), P()),
-        check_vma=False)
+    def globalize(lane, offset):
+        # rule-major flat lane r*B + b -> window-relative keyspace
+        # offset (offset + b) * R + r
+        return (offset + lane % B) * R + lane // B
 
-    @jax.jit
-    def step(w0: jnp.ndarray, n_valid_words: jnp.ndarray):
-        total, counts, lanes, tpos = sharded(w0, n_valid_words)
-        return total[0], counts, lanes, tpos
-
-    step.super_words = n_dev * B
+    step = make_sharded_step(compute, mesh, B, 2,
+                             hit_capacity=hit_capacity,
+                             globalize=globalize)
+    step.super_words = step.super_span
     step.n_rules = R
     return step

@@ -1,13 +1,17 @@
-"""Multi-chip execution: mesh construction + sharded crack steps.
+"""Multi-chip execution: mesh construction + the ONE sharded runtime.
 
 Parallelism in this domain is pure data parallelism over the keyspace
 (SURVEY.md section 1): every chip owns a contiguous lane range of each
 super-batch, decodes/hashes/compares locally, and only fixed-size hit
-buffers plus a psum'd hit count cross chip boundaries (over ICI).
+buffers plus a psum'd hit count cross chip boundaries (over ICI) --
+once per superstep, not per batch (parallel/sharded.py).
 """
 
 from dprf_tpu.parallel.mesh import make_mesh
-from dprf_tpu.parallel.sharded import make_sharded_mask_crack_step
+from dprf_tpu.parallel.sharded import (make_sharded_mask_step,
+                                       make_sharded_pertarget_step,
+                                       make_sharded_step)
 from dprf_tpu.parallel.worker import ShardedMaskWorker
 
-__all__ = ["make_mesh", "make_sharded_mask_crack_step", "ShardedMaskWorker"]
+__all__ = ["make_mesh", "make_sharded_step", "make_sharded_mask_step",
+           "make_sharded_pertarget_step", "ShardedMaskWorker"]

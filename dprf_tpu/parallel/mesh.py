@@ -1,9 +1,12 @@
 """Device mesh construction for keyspace-parallel cracking.
 
 The framework's only sharded axis is the keyspace (candidate-index)
-dimension, so every mesh is 1-D with a single ``shard`` axis.  On a pod
-slice the axis rides ICI; across hosts, `jax.distributed` + the same
-mesh spans DCN with no code changes (XLA places the collectives).
+dimension, so every mesh is 1-D with a single ``candidates`` axis
+(``PartitionSpec('candidates')`` is the whole sharding story -- see
+parallel/sharded.py, the one runtime every sharded step goes through).
+On a pod slice the axis rides ICI; across hosts, `jax.distributed` +
+the same mesh spans DCN with no code changes (XLA places the
+collectives).
 """
 
 from __future__ import annotations
@@ -13,7 +16,7 @@ from typing import Optional, Sequence
 import jax
 from jax.sharding import Mesh
 
-SHARD_AXIS = "shard"
+SHARD_AXIS = "candidates"
 
 
 def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):

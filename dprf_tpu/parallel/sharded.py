@@ -1,112 +1,190 @@
-"""The multi-chip fused crack step: shard_map over the keyspace mesh.
+"""ONE mesh-native sharded runtime: every multi-chip crack step is the
+same ``shard_map`` program over the 1-D ``candidates`` mesh axis, built
+here from a per-shard *compute* callback.
 
-Each chip owns a contiguous `batch_per_device`-lane slice of every
-super-batch: chip c decodes candidates ``base + c*batch_per_device ..
-base + (c+1)*batch_per_device``, hashes and compares them locally, and
-compacts its own fixed-size hit buffer.  The only cross-chip traffic is
-one scalar `psum` of the per-chip hit counts (rides ICI); hit buffers
-come back per-shard, so host-side traffic stays O(capacity * n_dev)
-regardless of keyspace size.
+The runtime owns everything that used to be copy-pasted across the
+per-engine ``make_sharded_*`` factories (mask / combinator / wordlist /
+per-target-salted): the ``lax.axis_index`` lane-slice bookkeeping, hit
+compaction, lane globalization, and the collective round.  An engine
+contributes ONLY its math -- a ``compute(offset, *step_args) ->
+(found, payload)`` callback over its shard's lane slice -- and gets two
+programs back:
 
-This is the framework's full distributed step (SURVEY.md section 1: the
-domain's parallelism is data parallelism over candidate-index ranges --
-there are no layers/sequences to shard, so the keyspace axis is the
-whole story).
+* the **per-batch step** (``step(*args)``), keeping the historical
+  ``(total, counts[n_dev], lanes[n_dev, cap], tpos[n_dev, cap])``
+  contract with replicated hit buffers (multi-host addressable); and
+* the **superstep** (``step.superstep(inner)``), the tentpole program:
+  ONE dispatch covers ``inner`` consecutive batches.  Candidates are
+  generated **on device** per shard from ``base + shard offset`` (the
+  only host->device traffic is the tiny base argument -- a digit
+  vector or a scalar window start -- so the packed candidate tensor
+  never materializes on host and the per-sweep ``h2d`` phase collapses
+  to ~0), hits accumulate in a fixed ``hit_capacity`` **device-resident
+  buffer** carried through the loop, and exactly ONE ``psum`` +
+  ``all_gather`` round runs per superstep instead of one per batch.
+
+Hit-buffer lane values are *window-relative*: the keyspace offset of
+the hit inside the dispatched window (for wordlist steps, relative to
+``w0 * n_rules``).  A window is bounded to int32 by the callers'
+``ops/superstep.max_inner`` budget, so huge keyspaces never force
+64-bit lane math on device; the host adds the unit base.  A shard
+whose window collects more than ``hit_capacity`` hits reports the true
+count (the buffer truncates, the count does not), and the workers
+redrive the window through the per-batch program -- same overflow
+discipline as the wide/scan paths.
 """
 
 from __future__ import annotations
 
-from typing import Union
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
-from dprf_tpu.generators.mask import MaskGenerator
 from dprf_tpu.ops import compare as cmp_ops
 from dprf_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
 
-def make_sharded_pertarget_mask_step(gen, mesh, batch_per_device: int,
-                                     digest_fn, n_params: int,
-                                     hit_capacity: int = 64):
-    """Generic multi-chip mask step for per-target-sweep engines
-    (phpass/crypt-family/pbkdf2 style): chip c owns lane slice
-    [c*B, (c+1)*B); `digest_fn(cand, lens, *params)` computes the
-    digest words; the LAST step argument is the target word vector.
+def _append_hits(carry, found, payload, rel, capacity: int):
+    """Fold one shard-batch's matches into the device-resident hit
+    buffer carried across a superstep.  ``rel`` maps each local lane
+    to its window-relative value; slots past ``capacity`` drop (the
+    count keeps the truth, so overflow is detectable on drain)."""
+    count, lanes_buf, pay_buf = carry
+    c, lanes, pay = cmp_ops.compact_hits(found, payload, capacity)
+    ok = lanes >= 0
+    rel_lanes = jnp.where(ok, jnp.take(rel, jnp.maximum(lanes, 0)), -1)
+    slots = jnp.where(ok, count + jnp.arange(capacity, dtype=jnp.int32),
+                      capacity)
+    lanes_buf = lanes_buf.at[slots].set(rel_lanes, mode="drop")
+    pay_buf = pay_buf.at[slots].set(pay, mode="drop")
+    return count + c, lanes_buf, pay_buf
 
-    step(base_digits, n_valid, *params, target) ->
-        (total, counts[n_dev], lanes[n_dev, cap] super-batch-global, _)
-    with replicated hit buffers (see module docstring).
+
+def make_sharded_step(compute: Callable, mesh, span_per_shard: int,
+                      n_args: int, hit_capacity: int = 64,
+                      globalize: Optional[Callable] = None):
+    """Build the unified sharded step from a per-shard compute.
+
+    compute(offset, *step_args) -> (found bool[K], payload int32[K]):
+    the engine's whole per-shard pipeline (decode -> digest -> compare,
+    **including validity masking against its n_valid argument**) over
+    the lane block starting at window-relative offset ``offset``
+    (int32, traced; in span units -- keyspace lanes for mask-style
+    steps, words for wordlist steps).
+
+    span_per_shard: span units one shard covers per batch; one step
+    call covers ``n_dev * span_per_shard`` (``step.super_span``).
+
+    globalize(local_lane, offset) -> window-relative lane value stored
+    in the hit buffer (default ``offset + local_lane``; the wordlist
+    step maps its rule-major flat lanes to keyspace offsets here).
+
+    Returns the jitted per-batch step with attributes ``super_span``,
+    ``hit_capacity``, ``n_devices`` and ``superstep(inner)`` (cached
+    jitted superstep programs -- one per power-of-two ``inner``).
+    """
+    n_dev = mesh.devices.size
+    span_step = n_dev * span_per_shard
+    if globalize is None:
+        def globalize(lane, offset):
+            return lane + offset
+
+    def _program(inner: int):
+        def shard_fn(*args):
+            dev = lax.axis_index(SHARD_AXIS)
+            init = (jnp.int32(0),
+                    jnp.full((hit_capacity,), -1, jnp.int32),
+                    jnp.full((hit_capacity,), -1, jnp.int32))
+
+            def body(i, carry):
+                offset = (i * span_step
+                          + dev * span_per_shard).astype(jnp.int32)
+                found, payload = compute(offset, *args)
+                lanes = jnp.arange(found.shape[0], dtype=jnp.int32)
+                rel = globalize(lanes, offset)
+                return _append_hits(carry, found, payload, rel,
+                                    hit_capacity)
+
+            if inner == 1:
+                count, lanes, payload = body(jnp.int32(0), init)
+            else:
+                count, lanes, payload = lax.fori_loop(0, inner, body,
+                                                      init)
+            # the ONE collective round of the dispatch: a scalar psum
+            # for the unit flag plus all_gathers of the fixed-size
+            # buffers, so the outputs are REPLICATED -- on a multi-host
+            # mesh every process reads the full buffers from its local
+            # devices (per-shard outputs would only be addressable on
+            # the owning host).
+            total = lax.psum(count, SHARD_AXIS)
+            return (total[None],
+                    lax.all_gather(count, SHARD_AXIS),
+                    lax.all_gather(lanes, SHARD_AXIS),
+                    lax.all_gather(payload, SHARD_AXIS))
+
+        sharded = shard_map(
+            shard_fn, mesh=mesh, in_specs=(P(),) * n_args,
+            out_specs=(P(), P(), P(), P()), check_vma=False)
+
+        @jax.jit
+        def step(*args):
+            total, counts, lanes, payload = sharded(*args)
+            return total[0], counts, lanes, payload
+
+        return step
+
+    step = _program(1)
+    programs = {1: step}
+
+    def superstep(inner: int):
+        """The fused program covering ``inner`` consecutive batches in
+        one dispatch (one collective round, device-resident hit
+        accumulation).  Cached per inner -- callers pick power-of-two
+        sizes so the compile count stays log-bounded."""
+        p = programs.get(inner)
+        if p is None:
+            p = programs[inner] = _program(inner)
+        return p
+
+    step.superstep = superstep
+    step.super_span = span_step
+    step.hit_capacity = hit_capacity
+    step.n_devices = n_dev
+    return step
+
+
+# ---------------------------------------------------------------------------
+# compute builders: the per-family math the runtime wraps.  Wordlist
+# and combinator computes live next to their single-device twins
+# (ops/rules_pipeline.py, ops/combine.py); these two cover every
+# digest_candidates engine and the whole per-target salted family.
+
+def make_sharded_mask_step(engine, gen, targets, mesh,
+                           batch_per_device: int, hit_capacity: int = 64,
+                           widen_utf16: bool = False):
+    """Mask attack through the unified runtime: any engine exposing
+    ``digest_candidates`` (single- or multi-target).
+
+    step(base_digits int32[L], n_valid int32) ->
+        (total, counts[n_dev], lanes[n_dev, cap], tpos[n_dev, cap])
+    with window-relative lanes; ``step.superstep(inner)`` fuses inner
+    batches per dispatch (on-device generation via ``decode_batch``'s
+    traced lane_offset -- no host digits per batch, no reshard).
     """
     flat = gen.flat_charsets
     length = gen.length
     B = batch_per_device
-
-    def shard_fn(base_digits, n_valid, *args):
-        *params, target = args
-        dev = lax.axis_index(SHARD_AXIS)
-        offset = (dev * B).astype(jnp.int32)
-        cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
-        lens = jnp.full((B,), length, jnp.int32)
-        digest = digest_fn(cand, lens, *params)
-        lane_global = offset + jnp.arange(B, dtype=jnp.int32)
-        found = cmp_ops.compare_single(digest, target) & \
-            (lane_global < n_valid)
-        cnt, lanes, tpos = cmp_ops.compact_hits(
-            found, jnp.zeros((B,), jnp.int32), hit_capacity)
-        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
-        total = lax.psum(cnt, SHARD_AXIS)
-        return (total[None],
-                lax.all_gather(cnt, SHARD_AXIS),
-                lax.all_gather(lanes, SHARD_AXIS),
-                lax.all_gather(tpos, SHARD_AXIS))
-
-    sharded = shard_map(
-        shard_fn, mesh=mesh, in_specs=(P(),) * (3 + n_params),
-        out_specs=(P(), P(), P(), P()), check_vma=False)
-
-    @jax.jit
-    def step(base_digits, n_valid, *args):
-        total, counts, lanes, tpos = sharded(base_digits, n_valid, *args)
-        return total[0], counts, lanes, tpos
-
-    step.super_batch = mesh.devices.size * B
-    return step
-
-
-def make_sharded_mask_crack_step(
-        engine, gen: MaskGenerator,
-        targets: Union[jnp.ndarray, cmp_ops.TargetTable],
-        mesh: Mesh, batch_per_device: int, hit_capacity: int = 64,
-        widen_utf16: bool = False):
-    """Build the jitted multi-chip fused step for a mask attack.
-
-    Returns step(base_digits int32[L], n_valid int32) ->
-        (total int32,                       # psum'd hit count, replicated
-         counts int32[n_dev],               # per-chip hit counts
-         lanes int32[n_dev, cap],           # global super-batch lane idx, -1 pad
-         tpos  int32[n_dev, cap])           # sorted-table pos (multi-target)
-
-    The super-batch is ``n_dev * batch_per_device`` lanes starting at the
-    unit's base index; `n_valid` counts valid lanes over the whole
-    super-batch.
-    """
-    flat = gen.flat_charsets
-    length = gen.length
     multi = isinstance(targets, cmp_ops.TargetTable)
-    n_dev = mesh.devices.size
-    batch = batch_per_device
 
-    def shard_fn(base_digits: jnp.ndarray, n_valid: jnp.ndarray):
-        dev = lax.axis_index(SHARD_AXIS)
-        offset = (dev * batch).astype(jnp.int32)
-        cand = gen.decode_batch(base_digits, flat, batch, lane_offset=offset)
+    def compute(offset, base_digits, n_valid):
+        cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
         if widen_utf16:
             cand = jnp.reshape(
                 jnp.stack([cand, jnp.zeros_like(cand)], axis=-1),
-                (batch, 2 * length))
+                (B, 2 * length))
             digest = engine.digest_candidates(cand, 2 * length)
         else:
             digest = engine.digest_candidates(cand, length)
@@ -114,33 +192,41 @@ def make_sharded_mask_crack_step(
             found, tpos = cmp_ops.compare_multi(digest, targets)
         else:
             found = cmp_ops.compare_single(digest, targets)
-            tpos = jnp.zeros((batch,), jnp.int32)
-        lane_global = offset + jnp.arange(batch, dtype=jnp.int32)
-        found = found & (lane_global < n_valid)
-        count, lanes, tpos = cmp_ops.compact_hits(found, tpos, hit_capacity)
-        # Local lane -> super-batch lane (keep -1 padding).
-        lanes = jnp.where(lanes >= 0, lanes + offset, lanes)
-        total = lax.psum(count, SHARD_AXIS)
-        # Hit buffers are all_gathered to every shard (a few hundred
-        # bytes over ICI) so the outputs are REPLICATED: on a multi-host
-        # mesh every process can read the full buffers from its local
-        # devices -- per-shard outputs would only be addressable on the
-        # host that owns the shard.
-        return (total[None],
-                lax.all_gather(count, SHARD_AXIS),
-                lax.all_gather(lanes, SHARD_AXIS),
-                lax.all_gather(tpos, SHARD_AXIS))
+            tpos = jnp.zeros((B,), jnp.int32)
+        lane = offset + jnp.arange(B, dtype=jnp.int32)
+        return found & (lane < n_valid), tpos
 
-    sharded = shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P(), P()),
-        out_specs=(P(), P(), P(), P()),
-        check_vma=False)
+    step = make_sharded_step(compute, mesh, B, 2,
+                             hit_capacity=hit_capacity)
+    step.super_batch = step.super_span
+    return step
 
-    @jax.jit
-    def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray):
-        total, counts, lanes, tpos = sharded(base_digits, n_valid)
-        return total[0], counts, lanes, tpos
 
-    step.super_batch = n_dev * batch
+def make_sharded_pertarget_step(gen, mesh, batch_per_device: int,
+                                digest_fn, n_params: int,
+                                hit_capacity: int = 64):
+    """Per-target-sweep engines (phpass / crypt family / pbkdf2 /
+    mscache / hmac / salted / krb5 style) through the unified runtime:
+    ``digest_fn(cand, lens, *params)`` computes the digest words; the
+    LAST step argument is the target word vector.
+
+    step(base_digits, n_valid, *params, target) ->
+        (total, counts[n_dev], lanes[n_dev, cap], _)
+    """
+    flat = gen.flat_charsets
+    length = gen.length
+    B = batch_per_device
+
+    def compute(offset, base_digits, n_valid, *args):
+        *params, target = args
+        cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
+        lens = jnp.full((B,), length, jnp.int32)
+        digest = digest_fn(cand, lens, *params)
+        lane = offset + jnp.arange(B, dtype=jnp.int32)
+        found = cmp_ops.compare_single(digest, target) & (lane < n_valid)
+        return found, jnp.zeros((B,), jnp.int32)
+
+    step = make_sharded_step(compute, mesh, B, 3 + n_params,
+                             hit_capacity=hit_capacity)
+    step.super_batch = step.super_span
     return step

@@ -33,7 +33,12 @@ NOISE_FLOOR = 0.10
 #: same-device records needed before the gate may fail anything
 MIN_BASELINE = 2
 
-_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+#: committed multichip scaling records (bare run_scaling result
+#: JSON; value = efficiency fraction) -- gated exactly like the
+#: throughput trajectory, via the same gate() math
+SCALING_PATTERN = "SCALING_r*.json"
 
 
 def _result_from_tail(tail: str) -> Optional[dict]:
@@ -137,16 +142,19 @@ def gate(current: dict, baseline: list, window: int = DEFAULT_WINDOW,
 
 
 def gate_repo(current: dict, repo_dir: str,
-              window: int = DEFAULT_WINDOW) -> dict:
-    return gate(current, load_bench_records(repo_dir), window=window)
+              window: int = DEFAULT_WINDOW,
+              pattern: str = "BENCH_r*.json") -> dict:
+    return gate(current, load_bench_records(repo_dir, pattern=pattern),
+                window=window)
 
 
-def gate_dry(repo_dir: str, window: int = DEFAULT_WINDOW) -> dict:
+def gate_dry(repo_dir: str, window: int = DEFAULT_WINDOW,
+             pattern: str = "BENCH_r*.json") -> dict:
     """CI mode: gate the NEWEST committed record against the window
     before it -- no fresh measurement needed (the committed
     trajectory audits itself).  Adds ``current_round``/``current_hs``
     so the verdict is self-describing."""
-    recs = load_bench_records(repo_dir)
+    recs = load_bench_records(repo_dir, pattern=pattern)
     if not recs:
         return {"verdict": "no-baseline", "median_hs": None,
                 "tolerance": None, "ratio": None, "window": 0,

@@ -184,11 +184,17 @@ def _probe_strategy(worker) -> str:
     standard submit loops are re-implemented here; any class with its
     own ``process`` (per-salt blocks, per-target steps, CPU oracle)
     keeps its override and is probed coarsely."""
+    from dprf_tpu.parallel import worker as pw
     from dprf_tpu.runtime import worker as rw
     proc = getattr(type(worker), "process", None)
     if proc is rw.DeviceWordlistWorker.process:
         return "wordlist"
     if proc is rw.MaskWorkerBase.process:
+        return "digit"
+    if proc is pw.ShardedMaskWorker.process:
+        # same per-batch (base_digits, n_valid) contract + _batch_hits
+        # decode; probing it per stride makes the sharded path's ~zero
+        # h2d visible in the phase report
         return "digit"
     return "coarse"
 

@@ -114,6 +114,12 @@ _declare("DPRF_SCRYPT_MEM", 4 << 30, "int",
 _declare("DPRF_SUPERSTEP", True, "bool",
          "Super-dispatch (multi-chunk scan loops fused into one "
          "dispatch); 0 falls back to per-batch dispatches.")
+_declare("DPRF_SHARD_SUPER_CAP", 256, "int",
+         "Batches fused into ONE sharded superstep dispatch "
+         "(parallel/sharded.py; clamped to a power of two, and the "
+         "int32 window budget still applies on top).  Each distinct "
+         "power-of-two size compiles its own program, so the compile "
+         "cache stays log-bounded.")
 
 # -- runtime / distributed ---------------------------------------------------
 _declare("DPRF_ASYNC_WARMUP", True, "bool",

@@ -23,7 +23,7 @@ from dprf_tpu.generators.mask import MaskGenerator
 from dprf_tpu.ops import compare as cmp_ops
 from dprf_tpu.ops.pipeline import make_mask_crack_step, target_words
 from dprf_tpu.parallel import (ShardedMaskWorker, make_mesh,
-                               make_sharded_mask_crack_step)
+                               make_sharded_mask_step)
 from dprf_tpu.runtime.workunit import WorkUnit
 
 
@@ -40,7 +40,7 @@ def _ntlm(pw: bytes) -> bytes:
 
 def test_mesh_shape(mesh):
     assert mesh.devices.shape == (8,)
-    assert mesh.axis_names == ("shard",)
+    assert mesh.axis_names == ("candidates",)
 
 
 def test_sharded_md5_finds_planted_password(mesh):
@@ -49,7 +49,7 @@ def test_sharded_md5_finds_planted_password(mesh):
     idx = gen.index_of(pw)
     tgt = target_words(hashlib.md5(pw).digest(), little_endian=True)
     engine = get_engine("md5", device="jax")
-    step = make_sharded_mask_crack_step(engine, gen, tgt, mesh,
+    step = make_sharded_mask_step(engine, gen, tgt, mesh,
                                         batch_per_device=1024)
     super_batch = 8 * 1024
     bstart = (idx // super_batch) * super_batch
@@ -73,7 +73,7 @@ def test_sharded_matches_single_device_step(mesh):
     digests = [hashlib.md5(gen.candidate(i)).digest() for i in plant_idx]
     table = cmp_ops.make_target_table(digests, little_endian=True)
 
-    sh_step = make_sharded_mask_crack_step(engine, gen, table, mesh,
+    sh_step = make_sharded_mask_step(engine, gen, table, mesh,
                                            batch_per_device=512)
     single = make_mask_crack_step(engine, gen, table, batch=super_batch)
 
@@ -98,7 +98,7 @@ def test_sharded_respects_n_valid(mesh):
     engine = get_engine("md5", device="jax")
     idx = gen.index_of(b"777")
     tgt = target_words(hashlib.md5(b"777").digest(), little_endian=True)
-    step = make_sharded_mask_crack_step(engine, gen, tgt, mesh,
+    step = make_sharded_mask_step(engine, gen, tgt, mesh,
                                         batch_per_device=128)
     base = jnp.asarray(gen.digits(0), dtype=jnp.int32)
     total, *_ = step(base, jnp.int32(idx))       # 777 is lane idx: excluded
@@ -262,12 +262,12 @@ import numpy as np
 from dprf_tpu.engines import get_engine
 from dprf_tpu.generators.mask import MaskGenerator
 from dprf_tpu.ops.pipeline import target_words
-from dprf_tpu.parallel import make_mesh, make_sharded_mask_crack_step
+from dprf_tpu.parallel import make_mesh, make_sharded_mask_step
 gen = MaskGenerator("?l?l?l")
 pw = b"fox"
 idx = gen.index_of(pw)
 tgt = target_words(hashlib.md5(pw).digest(), little_endian=True)
-step = make_sharded_mask_crack_step(get_engine("md5", device="jax"),
+step = make_sharded_mask_step(get_engine("md5", device="jax"),
                                     gen, tgt, make_mesh(8), 64)
 base = jnp.asarray(gen.digits(0), dtype=jnp.int32)
 for bstart in range(0, gen.keyspace, 512):

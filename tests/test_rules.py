@@ -414,8 +414,9 @@ def test_sharded_wordlist_step():
         for lane in np.asarray(lanes).ravel():
             if lane < 0:
                 continue
-            r, bglob = divmod(int(lane), super_words)
-            found.add((w0 + bglob) * 2 + r)
+            # lanes are window-relative keyspace offsets (one runtime
+            # convention; parallel/sharded.py)
+            found.add(w0 * 2 + int(lane))
     assert found == plant_idx
 
 

@@ -1,0 +1,183 @@
+"""The unified sharded runtime (parallel/sharded.py): superstep
+semantics, shard-boundary hit parity, overflow redrive, and resume /
+re-split of a sharded session under a DIFFERENT device count.
+
+The per-batch compat contract is covered by tests/test_parallel.py;
+this file exercises what the runtime added -- on-device candidate
+generation across fused windows, the device-resident hit buffer, and
+the one-collective-per-superstep discipline -- at hit-placement edges
+(shard boundaries, window boundaries, the last keyspace index).
+"""
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+# device-pipeline compiles: full suite / tier-1, excluded from the <5-min
+# smoke tier (tools/check_markers.py enforces an explicit tier decision)
+pytestmark = pytest.mark.compileheavy
+
+from dprf_tpu.engines import get_engine
+from dprf_tpu.engines.base import Target
+from dprf_tpu.generators.mask import MaskGenerator
+from dprf_tpu.parallel import make_mesh
+from dprf_tpu.parallel.worker import ShardedMaskWorker, shard_super_cap
+from dprf_tpu.runtime.dispatcher import Dispatcher
+from dprf_tpu.runtime.worker import CpuWorker, submit_or_process
+from dprf_tpu.runtime.workunit import WorkUnit
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest should fake 8 CPU devices"
+    return make_mesh(8)
+
+
+def _md5_targets(gen, idxs):
+    return [Target(str(i), hashlib.md5(gen.candidate(i)).digest())
+            for i in idxs]
+
+
+def _cpu_hits(gen, targets, unit):
+    return sorted((h.target_index, h.cand_index, h.plaintext)
+                  for h in CpuWorker(get_engine("md5", device="cpu"),
+                                     gen, targets).process(unit))
+
+
+def test_superstep_hits_at_every_boundary(mesh):
+    """One unit big enough to fuse superstep windows plus a per-batch
+    remainder; plants sit at shard boundaries, window boundaries, and
+    the LAST keyspace index -- the sharded sweep must equal the CPU
+    oracle exactly."""
+    gen = MaskGenerator("?l?l?l?l")        # 456976
+    B = 1024
+    stride = 8 * B
+    plant = [0, B - 1, B, stride - 1, stride,           # shard edges
+             8 * stride - 1, 8 * stride,                # window edge
+             gen.keyspace - 1]                          # last index
+    targets = _md5_targets(gen, plant)
+    w = ShardedMaskWorker(get_engine("md5", device="jax"), gen, targets,
+                          mesh, batch_per_device=B, hit_capacity=16)
+    unit = WorkUnit(0, 0, gen.keyspace)
+    pend = w.submit(unit)
+    kinds = [k for k, _, _ in pend.queued]
+    # the tentpole path really ran: fused windows AND a remainder
+    assert "sshard" in kinds
+    got = sorted((h.target_index, h.cand_index, h.plaintext)
+                 for h in pend.resolve())
+    assert got == _cpu_hits(gen, targets, unit)
+    assert [g[1] for g in got] == plant
+
+
+def test_superstep_single_collective_shape(mesh):
+    """A superstep dispatch returns ONE replicated result tuple for
+    the whole window (count/lanes/tpos per shard, window-relative
+    lanes) -- not one per batch."""
+    from dprf_tpu.parallel.sharded import make_sharded_mask_step
+    from dprf_tpu.ops.pipeline import target_words
+    gen = MaskGenerator("?l?l?l?l")
+    step = make_sharded_mask_step(
+        get_engine("md5", device="jax"), gen,
+        target_words(hashlib.md5(gen.candidate(12345)).digest(),
+                     little_endian=True),
+        mesh, 512)
+    ss = step.superstep(4)
+    window = 4 * step.super_batch
+    total, counts, lanes, tpos = ss(
+        jnp.asarray(gen.digits(0), dtype=jnp.int32), jnp.int32(window))
+    assert int(total) == 1
+    assert counts.shape == (8,) and lanes.shape == (8, 64)
+    lanes_np = np.asarray(lanes)
+    assert list(lanes_np[lanes_np >= 0]) == [12345]   # window-relative
+    # cached program identity: same inner -> same compiled callable
+    assert step.superstep(4) is ss
+
+
+def test_superstep_overflow_redrives_exactly(mesh):
+    """A shard whose window collects more hits than hit_capacity
+    truncates the buffer but keeps the true count; the worker must
+    redrive the window per-batch and report every hit exactly once."""
+    gen = MaskGenerator("?d?d?d?d?d")       # 100000
+    B = 128
+    stride = 8 * B
+    # 6 plants inside shard 0's lane slices of the first window (> cap)
+    plant = [0, 3, 7, stride + 1, 2 * stride + 2, 3 * stride + 5,
+             gen.keyspace - 1]
+    targets = _md5_targets(gen, plant)
+    w = ShardedMaskWorker(get_engine("md5", device="jax"), gen, targets,
+                          mesh, batch_per_device=B, hit_capacity=2,
+                          oracle=get_engine("md5", device="cpu"))
+    unit = WorkUnit(0, 0, gen.keyspace)
+    hits = w.process(unit)
+    assert sorted(h.cand_index for h in hits) == plant
+    assert len(hits) == len(set(h.cand_index for h in hits))
+
+
+def test_resume_resplit_under_different_device_count(mesh):
+    """A sharded session interrupted mid-sweep resumes under a
+    DIFFERENT device count (8 -> 2) and a different unit size with
+    exact coverage and no overlap -- coverage is keyspace-indexed, so
+    the mesh width is a per-run execution detail."""
+    gen = MaskGenerator("?d?d?d?d")         # 10000
+    plant = [0, 1234, 4999, 5000, 7777, gen.keyspace - 1]
+    targets = _md5_targets(gen, plant)
+    eng = get_engine("md5", device="jax")
+
+    hits = []
+    disp = Dispatcher(gen.keyspace, 2000)
+    w8 = ShardedMaskWorker(eng, gen, targets, mesh,
+                           batch_per_device=128, hit_capacity=16)
+    for _ in range(3):                      # interrupt after 3 units
+        unit = disp.lease("w8")
+        hits.extend(submit_or_process(w8, unit).resolve())
+        disp.complete(unit.unit_id, worker_id="w8")
+    completed = disp.completed_intervals()
+    assert sum(e - s for s, e in completed) == 6000
+
+    # resume: different unit size AND a 2-device mesh
+    disp2 = Dispatcher.from_completed(gen.keyspace, 1536, completed)
+    w2 = ShardedMaskWorker(eng, gen, targets, make_mesh(2),
+                           batch_per_device=128, hit_capacity=16)
+    swept = []
+    while True:
+        unit = disp2.lease("w2")
+        if unit is None:
+            break
+        swept.append((unit.start, unit.end))
+        hits.extend(submit_or_process(w2, unit).resolve())
+        disp2.complete(unit.unit_id, worker_id="w2")
+    assert disp2.done()
+    # resumed units never re-sweep covered ranges (no overlap)
+    for s, e in swept:
+        for cs, ce in completed:
+            assert e <= cs or s >= ce, (swept, completed)
+    # exact coverage: union of both phases is the whole keyspace
+    assert sum(e - s for s, e in disp2.completed_intervals()) \
+        == gen.keyspace
+    assert sorted(h.cand_index for h in hits) == plant
+    assert len(hits) == len(set(h.cand_index for h in hits))
+
+
+def test_pertarget_sharded_workers_pipeline(mesh):
+    """The per-target sharded workers are submit-based now: submit()
+    enqueues every (target, batch) dispatch with ONE device-
+    accumulated flag, so the remote worker loop pipelines them."""
+    from dprf_tpu.engines.device.phpass import ShardedPhpassMaskWorker
+    from dprf_tpu.engines.device.salted import ShardedSaltedMaskWorker
+    for cls in (ShardedPhpassMaskWorker, ShardedSaltedMaskWorker,
+                ShardedMaskWorker):
+        assert getattr(cls.process, "_submit_based", False), cls
+        assert "submit" in cls.__dict__ or any(
+            "submit" in b.__dict__ for b in cls.__mro__[1:]), cls
+
+
+def test_shard_super_cap_knob(monkeypatch):
+    monkeypatch.setenv("DPRF_SHARD_SUPER_CAP", "100")
+    assert shard_super_cap() == 64          # power-of-two clamp
+    monkeypatch.setenv("DPRF_SHARD_SUPER_CAP", "junk")
+    assert shard_super_cap() == 256         # registry default
+    monkeypatch.setenv("DPRF_SHARD_SUPER_CAP", "1")
+    assert shard_super_cap() == 2           # floor: fusing needs >= 2

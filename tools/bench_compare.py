@@ -43,6 +43,10 @@ def main(argv=None) -> int:
     ap.add_argument("--dir", default=None, metavar="REPO",
                     help="directory holding BENCH_r*.json (default: "
                     "the repo root this tree is installed in)")
+    ap.add_argument("--scaling", action="store_true",
+                    help="gate against the committed SCALING_r*.json "
+                    "trajectory (multichip efficiency records) instead "
+                    "of the BENCH throughput records")
     ap.add_argument("--window", type=int, default=None, metavar="K")
     ap.add_argument("--quiet", "-q", action="store_true")
     args = ap.parse_args(argv)
@@ -51,8 +55,9 @@ def main(argv=None) -> int:
 
     repo = args.dir or compare.repo_root()
     window = args.window or compare.DEFAULT_WINDOW
+    pattern = compare.SCALING_PATTERN if args.scaling else "BENCH_r*.json"
     if args.dry:
-        verdict = compare.gate_dry(repo, window=window)
+        verdict = compare.gate_dry(repo, window=window, pattern=pattern)
     elif args.current:
         try:
             with open(args.current, encoding="utf-8") as fh:
@@ -63,7 +68,8 @@ def main(argv=None) -> int:
             return 2
         if isinstance(doc, dict) and isinstance(doc.get("tail"), str):
             doc = compare._result_from_tail(doc["tail"]) or {}
-        verdict = compare.gate_repo(doc, repo, window=window)
+        verdict = compare.gate_repo(doc, repo, window=window,
+                                    pattern=pattern)
     else:
         print("bench_compare: pass --current FILE or --dry",
               file=sys.stderr)
