@@ -76,6 +76,27 @@ def _compile_fields(cache: str, seconds: float, warm_s=None) -> dict:
     return out
 
 
+def _introspection_fields(engine: str, rate: float) -> dict:
+    """Device-introspection fields every bench result carries (ISSUE
+    13): the run's peak device-memory footprint -- the allocator's
+    measured high-water mark where the backend has one, else the
+    largest analyzed program footprint, tagged by ``peak_hbm_source``
+    -- and the roofline fraction from the XLA-derived op model alone.
+    The regression sentinel gates ``peak_hbm_bytes`` alongside
+    throughput (perfreport/compare.py); records measured before ISSUE
+    13 lack the field and gate as no-baseline, never as a crash."""
+    from dprf_tpu.telemetry import devstats
+    from dprf_tpu.telemetry import perf as perf_mod
+    from dprf_tpu.telemetry import programs as programs_mod
+    programs_mod.analyze_pending()    # outside every timed window
+    devstats.poll()
+    peak, source = devstats.peak_hbm_bytes()
+    frac = perf_mod.analyzed_roofline_fraction(engine, rate)
+    return {"peak_hbm_bytes": peak,
+            "peak_hbm_source": source,
+            "analyzed_roofline": round(frac, 4) if frac else None}
+
+
 def _tuned_or(batch, engine: str, device: str, fallback: int,
               attack: str = "mask", extras=None) -> tuple:
     """Bench-side ``--batch auto``: (resolved batch, tuned flag).  An
@@ -310,6 +331,12 @@ def run_bench(engine: str = "md5", device: str = "jax",
             fn2 = make_looped_step(step2, inner) if inner > 1 else step2
             warm_s = _timed_aot_compile(fn2, base0, jnp.int32(batch))
         compile_fields = _compile_fields(obs.cache, obs.seconds, warm_s)
+        # program-registry capture (ISSUE 13): bench compiles outside
+        # the worker factories, so it registers its step itself;
+        # analysis runs in _introspection_fields after the timed loop
+        from dprf_tpu.telemetry import programs as programs_mod
+        programs_mod.register_program(engine, "mask", batch, step=step,
+                                      args=(base0, jnp.int32(batch)))
         # per-phase attribution of one production dispatch (outside
         # the timed window; the step is already compiled)
         phases = _step_phases(gen, step, batch)
@@ -377,6 +404,7 @@ def run_bench(engine: str = "md5", device: str = "jax",
         "compile_s": round(compile_s, 1),
         "phases": phases,
         **compile_fields,
+        **_introspection_fields(engine, rate),
     }, mode="bench")
 
 
@@ -478,6 +506,14 @@ def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
     solo_builds = [build([d]) for d in devices[:n_devices]]
     compile_mesh = warm([mesh_build], "mesh")
     compile_ind = warm(solo_builds, "independent")
+    # program-registry capture of the mesh program (ISSUE 13); the
+    # lower() is a cached trace after warm(), analysis runs after the
+    # timed windows in _introspection_fields
+    from dprf_tpu.telemetry import programs as programs_mod
+    programs_mod.register_program(
+        engine, "mask+sharded", mesh_build[1], step=mesh_build[0],
+        args=(jnp.asarray(gen.digits(0), dtype=jnp.int32),
+              jnp.int32(mesh_build[1])))
     # the mesh and independent windows ALTERNATE (3 rounds each) so
     # slow drift on the host -- thermal throttling, background load on
     # a shared box -- hits both sides of the efficiency ratio equally
@@ -536,6 +572,10 @@ def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
         "phases": phases,
         "h2d_share": round(phases.get("h2d", 0.0) / total_s, 6),
         "device": platform,
+        # roofline is a PER-CHIP quantity: the aggregate mesh rate
+        # against the single-chip ceiling would read ~n_devices-fold
+        # over unity
+        **_introspection_fields(engine, many["rate"] / n_devices),
     }
     if platform != "tpu":
         out["note"] = (
@@ -738,4 +778,5 @@ def run_config(config: int, device: str = "jax", seconds: float = 5.0,
         "compile_s": round(compile_s, 1),
         "phases": phases,
         **_compile_fields(compile_cache, compile_s),
+        **_introspection_fields(engine_name, tested / elapsed),
     }, mode="config")

@@ -147,6 +147,11 @@ def _build_parser() -> argparse.ArgumentParser:
     s.add_argument("--token", default=None,
                    help="shared secret workers must prove on connect "
                    "(default: $DPRF_TOKEN; unset = unauthenticated)")
+    s.add_argument("--owner-quota", action="append", default=None,
+                   metavar="OWNER=N",
+                   help="per-owner AGGREGATE sweep quota (repeatable): "
+                   "cap the keyspace indices all of OWNER's jobs may "
+                   "sweep combined, enforced on submit and on lease")
 
     w = sub.add_parser("worker", help="process WorkUnits for a "
                        "`dprf serve` coordinator")
@@ -220,7 +225,16 @@ def _build_parser() -> argparse.ArgumentParser:
     tn = sub.add_parser("tune", help="autotune the device batch size "
                         "for an engine and record it in the tuning "
                         "cache (consumed by `--batch auto` and bench)")
-    tn.add_argument("--engine", "-m", required=True)
+    tn.add_argument("--engine", "-m", default=None,
+                    help="engine to tune (required unless --all)")
+    tn.add_argument("--all", action="store_true",
+                    help="sweep EVERY registered device engine (mask "
+                    "attack) to pre-populate the tuning cache for a "
+                    "fleet image; engines whose targets need real "
+                    "salts/params are reported as skipped (tune them "
+                    "individually with --hashfile).  Analyzed program "
+                    "costs (telemetry/programs.py) are recorded as a "
+                    "side effect of every rung")
     tn.add_argument("--device", default="tpu",
                     choices=sorted(_DEVICE_ALIASES))
     tn.add_argument("--mask", default="?a?a?a?a?a?a?a?a",
@@ -502,6 +516,17 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="machine-readable report on stdout instead "
                      "of the text rendering")
     rpt.add_argument("--quiet", "-q", action="store_true")
+
+    pg = sub.add_parser("programs", help="compiled-program table of a "
+                        "running coordinator: XLA-derived flops, "
+                        "bytes accessed, and peak device memory per "
+                        "executable -- the coordinator's own compile "
+                        "sites plus the records workers ship in "
+                        "heartbeats (op_programs RPC)")
+    pg.add_argument("--json", action="store_true",
+                    help="machine-readable program records on stdout "
+                    "(the CI artifact format)")
+    _jobs_client_args(pg)
 
     mt = sub.add_parser("metrics", help="scrape a running coordinator's "
                         "/metrics endpoint (Prometheus text format)")
@@ -897,6 +922,7 @@ def _setup_job(args, device: str, log: Log,
     kw = {} if lease_timeout is None else {"lease_timeout": lease_timeout}
     unit_seconds = getattr(args, "unit_seconds", 0) or 0
     if unit_seconds > 0:
+        from dprf_tpu.telemetry import devstats
         from dprf_tpu.tune import AdaptiveUnitSizer
         # wordlist units stay word-aligned even when adaptively sized,
         # so no candidate is rehashed at unit boundaries
@@ -905,7 +931,14 @@ def _setup_job(args, device: str, log: Log,
             unit_size, target_seconds=unit_seconds, align=align,
             # an explicit tiny --unit-size is a floor the sizer must
             # respect, not round up away from
-            min_unit=max(align, min(unit_size, 1 << 10)))
+            min_unit=max(align, min(unit_size, 1 << 10)),
+            # OOM-headroom signal at the right ALTITUDE: the local
+            # crack path hashes in THIS process, so local devstats is
+            # the worker's own allocator; a serve coordinator's units
+            # run on REMOTE workers, whose headroom arrives per-worker
+            # through heartbeats (rpc.op_heartbeat) instead
+            headroom_fn=(devstats.headroom_frac
+                         if lease_timeout is None else None))
     # --skip/--limit restrict THIS run's sweep by pre-marking the
     # excluded ranges done (run-scoped: not part of the job identity,
     # exactly like resuming a partially-covered session)
@@ -1129,12 +1162,17 @@ def _crack_single(args, device: str, log: Log):
         log.info("pre-cracked targets", count=len(coord.found))
 
     snap = None
+    devstats_poller = None
     if session is not None:
         from dprf_tpu.telemetry import (DEFAULT as _registry,
                                         TelemetrySnapshotter,
                                         snapshot_interval)
         snap = TelemetrySnapshotter(session.telemetry_path, _registry,
                                     interval=snapshot_interval()).start()
+        # HBM gauges ride the same snapshots (ISSUE 13); no-op
+        # ticks on backends without memory stats
+        from dprf_tpu.telemetry.devstats import DevstatsPoller
+        devstats_poller = DevstatsPoller(registry=_registry).start()
     try:
         if args.profile:
             # jax.profiler.trace captures device + host timelines for
@@ -1146,6 +1184,8 @@ def _crack_single(args, device: str, log: Log):
         else:
             result = coord.run()
     finally:
+        if devstats_poller is not None:
+            devstats_poller.stop()
         if snap is not None:
             snap.stop()
             log.info("telemetry snapshots written",
@@ -1174,6 +1214,18 @@ def _crack_single(args, device: str, log: Log):
 def _parse_hostport(s: str) -> tuple:
     host, _, port = s.rpartition(":")
     return host or "127.0.0.1", int(port)
+
+
+def _parse_owner_quotas(specs) -> dict:
+    """--owner-quota OWNER=N (repeatable) -> {owner: int} for the
+    scheduler's per-owner aggregate caps."""
+    out: dict = {}
+    for s in specs or ():
+        owner, _, n = s.partition("=")
+        if not owner or not n:
+            raise ValueError(f"--owner-quota wants OWNER=N, got {s!r}")
+        out[owner] = max(0, int(n))
+    return out
 
 
 def cmd_serve(args, log: Log) -> int:
@@ -1235,7 +1287,9 @@ def cmd_serve(args, log: Log) -> int:
     from dprf_tpu.telemetry.trace import get_tracer
     token = args.token or envreg.get_str("DPRF_TOKEN") or None
     state = CoordinatorState(job, dispatcher, len(hl.targets),
-                             verifier=verify_hit, token=token)
+                             verifier=verify_hit, token=token,
+                             owner_quotas=_parse_owner_quotas(
+                                 getattr(args, "owner_quota", None)))
     tracer = get_tracer()
     if token:
         log.info("worker authentication enabled")
@@ -1346,10 +1400,16 @@ def cmd_serve(args, log: Log) -> int:
     # DPRF_ALERT_EVAL_S seconds
     from dprf_tpu.telemetry.health import HealthMonitor
     monitor = HealthMonitor(state.health_tick).start()
+    # device-memory polling (ISSUE 13): HBM gauges for /metrics, the
+    # telemetry snapshots, and `dprf report`'s memory section; a
+    # backend without memory stats makes every tick a no-op
+    from dprf_tpu.telemetry.devstats import DevstatsPoller
+    devstats_poller = DevstatsPoller(registry=state.registry).start()
     try:
         server.serve_until_done()
     finally:
         monitor.stop()
+        devstats_poller.stop()
         if snap is not None:
             snap.stop()
             log.info("telemetry snapshots written",
@@ -1557,27 +1617,20 @@ def cmd_bench(args, log: Log) -> int:
     return 0
 
 
-def cmd_tune(args, log: Log) -> int:
-    """Sweep the batch ladder for one engine through the REAL worker
-    path and record the winner in the persistent tuning cache, where
-    `--batch auto` jobs and bench warm-start from it."""
-    import json as _json
-
+def _tune_one(engine_name: str, args, device: str, log: Log) -> dict:
+    """Sweep one engine's batch ladder and record the winner; returns
+    the result JSON dict.  Raises ValueError for engines this
+    invocation cannot tune (salted targets without --hashfile, every
+    rung failing) -- `--all` reports those as skipped."""
     from dprf_tpu import tune as tune_mod
     from dprf_tpu.tune import geometric_ladder, record_tuned_batch, sweep
 
-    from dprf_tpu import compilecache
-
-    device = _DEVICE_ALIASES[args.device]
-    if args.tune_dir:
-        os.environ["DPRF_TUNE_DIR"] = args.tune_dir
-    compilecache.enable(log=log)
-    oracle = get_engine(args.engine, device="cpu")
+    oracle = get_engine(engine_name, device="cpu")
     gen = MaskGenerator(args.mask)
     if args.hashfile:
         hl = _load_targets(oracle, args.hashfile, log)
         if hl is None:
-            return 2
+            raise ValueError("no valid targets in hashfile")
         targets = hl.targets
     else:
         try:
@@ -1585,42 +1638,89 @@ def cmd_tune(args, log: Log) -> int:
             # not cracks
             targets = [oracle.parse_target("ff" * oracle.digest_size)]
         except Exception:
-            log.error("this engine's targets need salts/params; pass "
-                      "--hashfile with real target lines to tune "
-                      "against", engine=args.engine)
-            return 2
+            raise ValueError(
+                "targets need salts/params; pass --hashfile with real "
+                "target lines to tune against") from None
 
     def make_worker(batch: int):
         if device == "cpu":
             return CpuWorker(oracle, gen, targets, chunk=batch)
-        return _select_worker(args.engine, device, "mask", gen, targets,
+        return _select_worker(engine_name, device, "mask", gen, targets,
                               batch, args.hit_cap, oracle, 1, log)
 
     ladder = geometric_ladder(args.min_batch, args.max_batch,
                               args.ladder_factor)
-    log.info("tuning", engine=args.engine, device=device,
+    log.info("tuning", engine=engine_name, device=device,
              ladder=",".join(str(b) for b in ladder))
     result = sweep(make_worker, gen.keyspace, ladder,
                    probe_seconds=args.seconds,
                    compile_budget_s=args.compile_budget, log=log)
     extras = _tune_extras("mask", hit_cap=args.hit_cap)
-    path = record_tuned_batch(args.engine, "mask", device, result,
+    path = record_tuned_batch(engine_name, "mask", device, result,
                               extras=extras)
-    log.info("tuned", batch=result.batch,
+    log.info("tuned", engine=engine_name, batch=result.batch,
              rate=f"{result.rate_hs:,.0f}/s", cache=path)
-    print(_json.dumps({
-        "engine": args.engine,
+    return {
+        "engine": engine_name,
         "device": device,
-        "env": tune_mod.env_fingerprint(args.engine, device),
-        "key": tune_mod.make_key(args.engine, attack="mask",
+        "env": tune_mod.env_fingerprint(engine_name, device),
+        "key": tune_mod.make_key(engine_name, attack="mask",
                                  device=device, **extras),
         "batch": result.batch,
         "rate_hs": result.rate_hs,
         "compile_s": round(result.compile_s, 3),
         "swept": [p.as_dict() for p in result.swept],
         "cache": path,
+    }
+
+
+def cmd_tune(args, log: Log) -> int:
+    """Sweep the batch ladder through the REAL worker path and record
+    the winner in the persistent tuning cache, where `--batch auto`
+    jobs and bench warm-start from it.  ``--all`` sweeps every
+    registered device engine (the fleet-image pre-population pass);
+    analyzed program costs land in the program registry as a side
+    effect of each rung (telemetry/programs.py)."""
+    import json as _json
+
+    from dprf_tpu import compilecache
+
+    if not args.all and not args.engine:
+        log.error("pass --engine NAME (or --all to sweep every "
+                  "registered engine)")
+        return 2
+    device = _DEVICE_ALIASES[args.device]
+    if args.tune_dir:
+        os.environ["DPRF_TUNE_DIR"] = args.tune_dir
+    compilecache.enable(log=log)
+    if not args.all:
+        try:
+            print(_json.dumps(_tune_one(args.engine, args, device, log)))
+        except ValueError as e:
+            log.error(str(e), engine=args.engine)
+            return 2
+        return 0
+    # --all: one sweep per registered engine; a skipped or failed
+    # engine is a report line, never the end of the fleet bake
+    results, skipped = [], []
+    names = sorted(engine_names("jax" if device == "jax" else "cpu"))
+    for name in names:
+        try:
+            results.append(_tune_one(name, args, device, log))
+        except Exception as e:   # noqa: BLE001 -- per-engine isolation
+            log.warn("tune skipped", engine=name, error=str(e))
+            skipped.append({"engine": name, "error": str(e)})
+    from dprf_tpu.telemetry import programs as programs_mod
+    programs_mod.analyze_pending()
+    print(_json.dumps({
+        "tuned": len(results),
+        "skipped": len(skipped),
+        "engines": len(names),
+        "programs_analyzed": len(programs_mod.get_programs().snapshot()),
+        "results": results,
+        "skips": skipped,
     }))
-    return 0
+    return 0 if results else 1
 
 
 def cmd_prewarm(args, log: Log) -> int:
@@ -2158,6 +2258,28 @@ def cmd_token(args, log: Log) -> int:
     return 0
 
 
+def cmd_programs(args, log: Log) -> int:
+    """`dprf programs --connect`: the fleet's compiled-program table
+    (op_programs) -- XLA-derived cost/memory per executable, merged
+    from the coordinator's compile sites and worker heartbeats."""
+    import json as _json
+
+    from dprf_tpu.telemetry import programs as programs_mod
+
+    client = _jobs_client(args, log)
+    try:
+        resp = client.call("programs")
+    finally:
+        client.close()
+    records = resp.get("programs") or []
+    if args.json:
+        print(_json.dumps(records, sort_keys=True))
+    else:
+        print(programs_mod.render_table(records))
+    log.info("programs", records=len(records))
+    return 0
+
+
 def cmd_metrics(args, log: Log) -> int:
     """Scrape a running coordinator: plain HTTP GET on the RPC port
     (no client library; works for curl/Prometheus too).  --json asks
@@ -2335,6 +2457,7 @@ _COMMANDS = {
     "alerts": cmd_alerts,
     "token": cmd_token,
     "report": cmd_report,
+    "programs": cmd_programs,
     "metrics": cmd_metrics,
     "check": cmd_check,
     "show": cmd_show,

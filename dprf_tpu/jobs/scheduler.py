@@ -27,6 +27,12 @@ Limits:
     second's worth, minimum 1).  The cheap fleet-protection knob: a
     low-priority bulk job can be pinned to a trickle no matter how
     idle the fleet is.
+  - ``owner_quotas`` (ISSUE 13 satellite): per-OWNER aggregate sweep
+    caps enforced across every job the owner holds -- on submit
+    (``owner_quota_error``) and on lease (``_leasable``), so a tenant
+    cannot dodge its cap by splitting work over many jobs.  An
+    owner-capped job stays RUNNING (like pause, raising the quota is
+    operator action the fleet keeps polling for).
 
 Thread model: the scheduler is driven entirely under the caller's lock
 (rpc.CoordinatorState.lock) -- same contract as the Dispatcher it
@@ -182,11 +188,17 @@ class JobScheduler:
     #: is bounded by this, not by client behavior)
     MAX_JOBS = 64
 
-    def __init__(self, registry=None, clock=None):
+    def __init__(self, registry=None, clock=None, owner_quotas=None):
         self._jobs: dict = {}            # job_id -> Job, insert-ordered
         self._next_id = 0
         self._clock = clock or time.monotonic
         self._gc_next = 0.0
+        #: per-OWNER aggregate sweep quotas (ISSUE 13 satellite):
+        #: {owner: max keyspace indices the owner's jobs may sweep,
+        #: summed across all of them}.  Enforced on submit
+        #: (owner_quota_error) and on lease (_leasable) -- a tenant
+        #: cannot dodge its cap by splitting work over many jobs.
+        self.owner_quotas: dict = dict(owner_quotas or {})
         m = get_registry(registry)
         self._g_jobs = m.gauge(
             "dprf_jobs", "jobs known to the scheduler, by state",
@@ -293,12 +305,42 @@ class JobScheduler:
     def jobs(self) -> list:
         return list(self._jobs.values())
 
+    # -- per-owner aggregate quotas (ISSUE 13 satellite) -------------------
+
+    def owner_swept(self, owner: str) -> int:
+        """Indices covered plus outstanding across ALL of an owner's
+        non-cancelled jobs -- the quantity the aggregate quota caps
+        (same swept-or-leased accounting as the per-job quota, so a
+        deep pipeline cannot overshoot it by a fleet's worth)."""
+        return sum(j.swept_or_leased() for j in self._jobs.values()
+                   if j.owner == owner and j.state != CANCELLED)
+
+    def _owner_capped(self, owner: str) -> bool:
+        quota = self.owner_quotas.get(owner)
+        return quota is not None and self.owner_swept(owner) >= quota
+
+    def owner_quota_error(self, owner: str) -> Optional[str]:
+        """Submit-time admission check: a rejection string when the
+        owner's aggregate quota is already consumed, else None (the
+        expensive server-side build should not even start)."""
+        quota = self.owner_quotas.get(owner)
+        if quota is None:
+            return None
+        swept = self.owner_swept(owner)
+        if swept < quota:
+            return None
+        return (f"owner {owner!r} aggregate quota exhausted "
+                f"({swept}/{quota} indices swept or leased across "
+                "its jobs)")
+
     # -- lease-time selection --------------------------------------------
 
     def _leasable(self, job: Job, now: float) -> bool:
         if not job.runnable():
             return False
         if job.quota is not None and job.swept_or_leased() >= job.quota:
+            return False
+        if self._owner_capped(job.owner):
             return False
         if not job.dispatcher.leasable():
             return False

@@ -82,15 +82,24 @@ class _ShardedSuperstepMixin:
             return
         from dprf_tpu.compilecache import compile_observer
         args = self.warmup_args()
+        lowered = lower(*args)
         with compile_observer(getattr(self.engine, "name",
                                       "unknown")) as obs:
-            lower(*args).compile()
+            compiled = lowered.compile()
         self.xla_compile_seconds = (
             getattr(self, "xla_compile_seconds", 0.0) + obs.seconds)
         self.compile_seconds = (
             getattr(self, "compile_seconds", 0.0) + obs.seconds)
         if obs.cache == "miss":
             self.compile_cache = "miss"
+        # the superstep's own program record (telemetry/programs.py):
+        # one dispatch covers inner * stride candidates, so its
+        # per-candidate costs show what the fusion amortizes
+        from dprf_tpu.telemetry import programs as programs_mod
+        programs_mod.register_program(
+            getattr(self.engine, "name", "unknown"),
+            self.ATTACK + "+super", inner * self.stride,
+            compiled=compiled, lowered=lowered)
 
 
 class ShardedMaskWorker(_ShardedSuperstepMixin, MaskWorkerBase):

@@ -16,6 +16,14 @@ own observed run-to-run relative spread -- a trajectory that jitters
 one should.  Fewer than MIN_BASELINE comparable records is verdict
 ``no-baseline`` (pass): the sentinel refuses to alarm on data it
 does not have.
+
+Peak device memory gates alongside throughput (ISSUE 13): a record's
+``peak_hbm_bytes`` RISING past the baseline window's median by more
+than the tolerance is a regression exactly like a throughput dip --
+the HBM budget is a perf resource here (probe tables, superstep
+buffers), and a silent 30% memory growth is tomorrow's OOM.  Records
+measured before the introspection plane lack the field and the
+memory sub-gate reports ``no-baseline`` for them, never a crash.
 """
 
 from __future__ import annotations
@@ -104,41 +112,87 @@ def _comparable(current: dict, rec: dict) -> bool:
     return True
 
 
+def _window_stats(vals: list, noise_floor: float) -> tuple:
+    """(median, tolerance) of a sorted baseline window: the tolerance
+    is the larger of the noise floor and the window's own observed
+    run-to-run relative spread."""
+    n = len(vals)
+    median = (vals[n // 2] if n % 2
+              else 0.5 * (vals[n // 2 - 1] + vals[n // 2]))
+    spread = (vals[-1] - vals[0]) / median if median > 0 else 0.0
+    return median, max(float(noise_floor), spread)
+
+
+def _memory_gate(current: dict, comp: list, window: int,
+                 noise_floor: float) -> dict:
+    """Peak-HBM sub-gate (ISSUE 13): a memory regression is the
+    current ``peak_hbm_bytes`` rising ABOVE the baseline window's
+    median by more than the tolerance -- the mirror image of the
+    throughput rule.  Records measured before the introspection plane
+    lack the field entirely and gate as ``no-baseline`` (pass): the
+    sentinel refuses to alarm on data it does not have."""
+    def _peak(rec) -> float:
+        v = rec.get("peak_hbm_bytes")
+        return float(v) if isinstance(v, (int, float)) and v > 0 \
+            else 0.0
+
+    value = _peak(current)
+    base = [r for r in comp if _peak(r) > 0]
+    base = base[-max(1, int(window)):]
+    if len(base) < MIN_BASELINE or value <= 0:
+        return {"verdict": "no-baseline", "median_bytes": None,
+                "tolerance": None, "ratio": None,
+                "window": len(base)}
+    median, tolerance = _window_stats(
+        sorted(_peak(r) for r in base), noise_floor)
+    ratio = value / median if median > 0 else 0.0
+    verdict = "regression" if ratio > 1.0 + tolerance else "pass"
+    return {"verdict": verdict,
+            "median_bytes": median,
+            "tolerance": round(tolerance, 4),
+            "ratio": round(ratio, 4),
+            "window": len(base)}
+
+
 def gate(current: dict, baseline: list, window: int = DEFAULT_WINDOW,
          noise_floor: float = NOISE_FLOOR) -> dict:
     """Gate verdict for ``current`` (a bench result dict with
     ``value`` and ``device``) against the ``baseline`` record list.
 
     Returns {"verdict": "pass"|"regression"|"no-baseline",
-    "median_hs", "tolerance", "ratio", "window", "baseline_rounds"}.
+    "median_hs", "tolerance", "ratio", "window", "baseline_rounds",
+    "memory"}.  The ``memory`` sub-verdict gates ``peak_hbm_bytes``
+    the same way (regression = peak RISING past the window's band);
+    either side regressing makes the overall verdict a regression.
     """
     value = float(current.get("value") or 0.0)
     comp = [r for r in baseline if _comparable(current, r)
             and float(r.get("value") or 0) > 0]
+    memory = _memory_gate(current, comp, window, noise_floor)
     comp = comp[-max(1, int(window)):]
     if len(comp) < MIN_BASELINE or value <= 0:
-        return {"verdict": "no-baseline",
+        return {"verdict": ("regression"
+                            if memory["verdict"] == "regression"
+                            else "no-baseline"),
                 "median_hs": None, "tolerance": None, "ratio": None,
                 "window": len(comp),
                 "baseline_rounds": [r["round"] for r in comp
-                                    if "round" in r]}
-    vals = sorted(float(r["value"]) for r in comp)
-    n = len(vals)
-    median = (vals[n // 2] if n % 2
-              else 0.5 * (vals[n // 2 - 1] + vals[n // 2]))
-    # observed run-to-run spread of the window itself: a trajectory
-    # that jitters must widen its own alarm band
-    spread = (vals[-1] - vals[0]) / median if median > 0 else 0.0
-    tolerance = max(float(noise_floor), spread)
+                                    if "round" in r],
+                "memory": memory}
+    median, tolerance = _window_stats(
+        sorted(float(r["value"]) for r in comp), noise_floor)
     ratio = value / median if median > 0 else 0.0
     verdict = "regression" if ratio < 1.0 - tolerance else "pass"
+    if memory["verdict"] == "regression":
+        verdict = "regression"
     return {"verdict": verdict,
             "median_hs": median,
             "tolerance": round(tolerance, 4),
             "ratio": round(ratio, 4),
             "window": len(comp),
             "baseline_rounds": [r["round"] for r in comp
-                                if "round" in r]}
+                                if "round" in r],
+            "memory": memory}
 
 
 def gate_repo(current: dict, repo_dir: str,
@@ -158,7 +212,10 @@ def gate_dry(repo_dir: str, window: int = DEFAULT_WINDOW,
     if not recs:
         return {"verdict": "no-baseline", "median_hs": None,
                 "tolerance": None, "ratio": None, "window": 0,
-                "baseline_rounds": []}
+                "baseline_rounds": [],
+                "memory": {"verdict": "no-baseline",
+                           "median_bytes": None, "tolerance": None,
+                           "ratio": None, "window": 0}}
     current, prior = recs[-1], recs[:-1]
     out = gate(current, prior, window=window)
     out["current_round"] = current.get("round")

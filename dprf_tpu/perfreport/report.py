@@ -180,6 +180,38 @@ def _health_section(session_path: str, journal) -> Optional[dict]:
             "workers": workers}
 
 
+def _memory_section(snapshot: Optional[dict]) -> Optional[dict]:
+    """Device memory & program costs (ISSUE 13), reconstructed from
+    the session's telemetry snapshots alone: the HBM gauges the
+    devstats poller wrote (absent on backends without memory stats),
+    the per-program peak-bytes gauge, and the analyzed-vs-hand
+    roofline divergence cross-check.  None when the session recorded
+    none of them (pre-introspection sessions)."""
+    devices = {}
+    for name, field in (("dprf_hbm_bytes_in_use", "in_use"),
+                        ("dprf_hbm_bytes_limit", "limit"),
+                        ("dprf_hbm_bytes_peak", "peak")):
+        for v in _metric_values(snapshot, name):
+            dev = (v.get("labels") or {}).get("device", "?")
+            devices.setdefault(dev, {})[field] = int(
+                v.get("value") or 0)
+    programs = []
+    for v in _metric_values(snapshot, "dprf_program_peak_bytes"):
+        lv = v.get("labels") or {}
+        programs.append({"engine": lv.get("engine", "?"),
+                         "attack": lv.get("attack", "?"),
+                         "peak_bytes": int(v.get("value") or 0)})
+    programs.sort(key=lambda p: (p["engine"], p["attack"]))
+    divergence = {}
+    for v in _metric_values(snapshot, "dprf_roofline_model_divergence"):
+        eng = (v.get("labels") or {}).get("engine", "?")
+        divergence[eng] = round(float(v.get("value") or 0.0), 3)
+    if not devices and not programs and not divergence:
+        return None
+    return {"devices": devices, "programs": programs,
+            "model_divergence": divergence}
+
+
 def _fair_share(spans: list, journal) -> list:
     """Per-job lease share vs fair-share weight, from the lease spans
     and the journal's job records (the default job's priority is 1
@@ -259,6 +291,7 @@ def build_report(session_path: str) -> Optional[dict]:
                            if depth_vals else None),
         "fair_share": _fair_share(spans, journal),
         "health": _health_section(session_path, journal),
+        "memory": _memory_section(last),
     }
 
 
@@ -337,6 +370,29 @@ def render_report(doc: dict) -> str:
         for w in sorted(workers):
             lines.append(f"  worker {w:20s} last transition -> "
                          f"{workers[w]}")
+    memory = doc.get("memory")
+    if memory:
+        lines.append("")
+        lines.append("device memory & program costs")
+        for dev in sorted(memory.get("devices") or {}):
+            rec = memory["devices"][dev]
+
+            def _mb(k):
+                v = rec.get(k)
+                return f"{v / (1 << 20):,.0f}M" if v else "-"
+
+            lines.append(f"  {dev:12s} in_use {_mb('in_use'):>9s}  "
+                         f"peak {_mb('peak'):>9s}  "
+                         f"limit {_mb('limit'):>9s}")
+        for p in memory.get("programs") or ():
+            lines.append(
+                f"  program {p['engine']:12s} {p['attack']:12s} "
+                f"peak {p['peak_bytes'] / (1 << 20):,.1f}M")
+        div = memory.get("model_divergence") or {}
+        for eng in sorted(div):
+            flag = "  (>2x: MODEL DRIFT)" if div[eng] > 2 else ""
+            lines.append(f"  roofline model divergence {eng}: "
+                         f"{div[eng]:.2f}x{flag}")
     fs = doc.get("fair_share") or []
     if len(fs) > 1:
         lines.append("")

@@ -50,6 +50,7 @@ from dprf_tpu.runtime.worker import Hit
 from dprf_tpu.runtime.workunit import WorkUnit
 from dprf_tpu.telemetry import declare_job_metrics, get_registry
 from dprf_tpu.telemetry import perf as perf_mod
+from dprf_tpu.telemetry import programs as programs_mod
 from dprf_tpu.telemetry.alerts import AlertEngine
 from dprf_tpu.telemetry.health import HealthRegistry, heartbeat_interval
 from dprf_tpu.telemetry.trace import get_tracer, jax_profile_ctx
@@ -147,7 +148,8 @@ class CoordinatorState:
                  on_job_event: Optional[Callable] = None,
                  on_job_progress: Optional[Callable] = None,
                  owner: str = "local", priority: int = 1,
-                 quota: Optional[int] = None):
+                 quota: Optional[int] = None,
+                 owner_quotas: Optional[dict] = None):
         from dprf_tpu.jobs.scheduler import JobScheduler
         self.job = job                    # serializable job description
         self.dispatcher = dispatcher
@@ -188,7 +190,8 @@ class CoordinatorState:
         #: epoch ships its LOCAL ring back via op_trace_push
         self._pull_epoch = 0
         self.scheduler = scheduler if scheduler is not None \
-            else JobScheduler(registry=registry)
+            else JobScheduler(registry=registry,
+                              owner_quotas=owner_quotas)
         default = self.scheduler.add(
             job, dispatcher, n_targets, verifier=verifier,
             owner=owner, priority=priority, quota=quota,
@@ -212,6 +215,11 @@ class CoordinatorState:
         #: declarative alert rules over the same registry; pending ->
         #: firing -> resolved lifecycle served via op_alerts
         self.alerts = AlertEngine(registry=registry)
+        #: compiled-program registry (ISSUE 13): the coordinator's own
+        #: compile sites land here, and op_heartbeat merges the
+        #: records workers ship -- op_programs serves the fleet view.
+        #: Has its own lock (never touched under self.lock).
+        self.programs = programs_mod.get_programs()
         #: (transition dict) hook: cmd_serve journals each fleet
         #: health transition as a {"type": "worker_health"} record;
         #: fired by health_tick UNDER the lock so the journal writes
@@ -596,9 +604,46 @@ class CoordinatorState:
         if raw is None:
             return {"ok": False}
         wid = str(raw)
-        self.health.observe(wid, payload=msg.get("payload"))
+        payload = msg.get("payload")
+        self.health.observe(wid, payload=payload)
         self._touch_worker(wid)
+        # compiled-program records the worker analyzed since its last
+        # beat (ISSUE 13): bounded, sanitized, fingerprint-deduped --
+        # how the coordinator's op_programs table covers programs that
+        # only ever compiled on worker hosts
+        self.programs.ingest(msg.get("programs"), proc=wid)
+        # THIS worker's free-HBM fraction feeds the adaptive unit
+        # sizers (per-worker: the coordinator's own allocator says
+        # nothing about a remote chip); junk payloads read as no
+        # signal, never as a poisoned estimate
+        frac = None
+        if isinstance(payload, dict):
+            limit, use = payload.get("hbm_limit"), \
+                payload.get("hbm_in_use")
+            if (isinstance(limit, (int, float)) and limit > 0
+                    and isinstance(use, (int, float))
+                    and not isinstance(limit, bool)
+                    and not isinstance(use, bool)):
+                frac = max(0.0, 1.0 - use / limit)
+        if frac is not None:
+            with self.lock:
+                for j in self.scheduler.jobs():
+                    if j.terminal():
+                        continue
+                    observe = getattr(
+                        getattr(j.dispatcher, "sizer", None),
+                        "observe_headroom", None)
+                    if observe is not None:
+                        observe(wid, frac)
         return {"ok": True}
+
+    def op_programs(self, msg: dict) -> dict:
+        """Compiled-program table for ``dprf programs --connect``:
+        every analyzed executable this coordinator knows -- its own
+        compile sites plus the records workers shipped in heartbeats
+        -- with XLA-derived flops/bytes/peak-memory per program."""
+        return {"ok": True, "programs": self.programs.snapshot(),
+                "now": time.time()}
 
     def op_health(self, msg: dict) -> dict:
         """Fleet health snapshot for ``dprf health --connect``: every
@@ -659,6 +704,11 @@ class CoordinatorState:
         # both read under their own locks
         health_states = self.health.states()
         firing = self.alerts.firing_names()
+        # device memory view (ISSUE 13): per-worker HBM use for the
+        # MEM column and the fleet total for the header -- from the
+        # heartbeat payloads, so a CPU-only fleet simply shows none
+        mem = self.health.mem_by_worker()
+        hbm = self.health.hbm_totals()
         with self.lock:
             done, total = self.scheduler.progress()
             leases = []
@@ -686,6 +736,10 @@ class CoordinatorState:
                       # dprf top HEALTH column and header line)
                       "health": health_states,
                       "alerts": firing,
+                      # per-worker HBM use + the fleet total (the
+                      # dprf top MEM column and HBM header field)
+                      "mem": mem,
+                      "hbm": hbm,
                       "quarantined": sorted(self.quarantined)}
         return {"ok": True, "spans": spans, "leases": leases,
                 "status": status, "cursor": cursor, "resync": resync}
@@ -769,6 +823,15 @@ class CoordinatorState:
             if self.scheduler.full():
                 return {"error": "job rejected: job table full "
                         f"({self.scheduler.MAX_JOBS} jobs)"}
+            # per-owner aggregate quota (ISSUE 13 satellite): an owner
+            # whose cap is already consumed is rejected at admission,
+            # before the build -- the lease path enforces the same cap
+            # for jobs admitted before the quota filled
+            claimed = (auth_owner if auth_owner is not None
+                       else str(msg.get("owner") or "?"))
+            quota_err = self.scheduler.owner_quota_error(claimed)
+            if quota_err is not None:
+                return {"error": f"job rejected: {quota_err}"}
             jid = self.scheduler.reserve_id()
             lease_timeout = self.dispatcher.lease_timeout
         try:
@@ -1453,6 +1516,7 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
     t_contact = time.monotonic()
     rate_ewma: Optional[float] = None
     chips: list = []      # lazily probed on the first beat
+    prog_seq = [0]        # newest program-registry seq already shipped
 
     def _chip_count() -> Optional[int]:
         if not chips:
@@ -1472,14 +1536,34 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
         err = (str(sender.error)[:200]
                if sender is not None and sender.error is not None
                else None)
+        payload = {"engine": eng_name, "device": dev,
+                   "chips": _chip_count(),
+                   "depth": pipe.depth,
+                   "queue": len(pipe),
+                   "rate_hs": rate_ewma,
+                   "error": err}
+        # device introspection rides the beat (ISSUE 13): HBM totals
+        # in the payload (fleet memory headroom on the coordinator's
+        # health plane) and the program records analyzed since the
+        # last beat.  The deferred analysis runs HERE -- the beat only
+        # fires when the loop has been quiet, so the cache-served
+        # recompile it may trigger never delays a dispatch.
+        try:
+            from dprf_tpu.telemetry import devstats
+            programs_mod.analyze_pending()
+            hbm = devstats.summary()
+            if hbm is not None:
+                payload["hbm_in_use"] = hbm["in_use"]
+                payload["hbm_limit"] = hbm["limit"]
+                payload["hbm_peak"] = hbm["peak"]
+        except Exception:   # noqa: BLE001 -- introspection is
+            pass            # best-effort, never loop state
+        records, newest = programs_mod.get_programs().records_since(
+            prog_seq[0])
         try:
             client.call("heartbeat", worker_id=worker_id,
-                        payload={"engine": eng_name, "device": dev,
-                                 "chips": _chip_count(),
-                                 "depth": pipe.depth,
-                                 "queue": len(pipe),
-                                 "rate_hs": rate_ewma,
-                                 "error": err})
+                        payload=payload, programs=records)
+            prog_seq[0] = newest
         except Exception:   # noqa: BLE001 -- best-effort beacon; a
             pass            # dead link surfaces on the next lease
 

@@ -296,6 +296,11 @@ class MaskWorkerBase:
     ``_batch_hits`` to decode one step result.
     """
 
+    #: attack shape this worker family's program registry records
+    #: carry (telemetry/programs.py); wordlist/combinator subclasses
+    #: override
+    ATTACK = "mask"
+
     def _setup_targets(self, engine, gen, targets: Sequence[Target],
                        hit_capacity: int, oracle: Optional[HashEngine]):
         from dprf_tpu.ops import compare as cmp_ops
@@ -359,6 +364,20 @@ class MaskWorkerBase:
         #: cache served this step (bench and prewarm report it)
         self.compile_cache = obs.cache
         self._warmed = True
+        # register the compiled program for XLA-derived introspection
+        # (telemetry/programs.py).  Registration only -- the analysis
+        # (a cache-served recompile + cost/memory read) is deferred to
+        # an off-hot-path consumer (warmup_async's background thread,
+        # the heartbeat loop, tune, bench, `dprf programs`).
+        self._register_program(args)
+
+    def _register_program(self, args: tuple, compiled=None,
+                          lowered=None) -> None:
+        from dprf_tpu.telemetry import programs as programs_mod
+        programs_mod.register_program(
+            getattr(self.engine, "name", "unknown"), self.ATTACK,
+            int(getattr(self, "stride", 0) or 0), step=self.step,
+            args=args, compiled=compiled, lowered=lowered)
 
     def aot_compile(self) -> None:
         """Compile the step WITHOUT dispatching (``dprf prewarm``):
@@ -382,12 +401,17 @@ class MaskWorkerBase:
         trace_s = time.perf_counter() - t0
         with compile_observer(getattr(self.engine, "name",
                                       "unknown")) as obs:
-            lowered.compile()
+            compiled = lowered.compile()
         #: the XLA compile alone -- what the persistent cache
         #: eliminates (trace/lower cost is irreducible host Python)
         self.xla_compile_seconds = obs.seconds
         self.compile_seconds = trace_s + obs.seconds
         self.compile_cache = obs.cache
+        # the Compiled object is in hand here: analysis is a ~ms read,
+        # so prewarm's program table fills with no extra compile; the
+        # Lowered rides along for the real module fingerprint
+        self._register_program(args, compiled=compiled,
+                               lowered=lowered)
 
     def warmup_async(self):
         """Overlapped warmup: start warmup() on a background thread so
@@ -413,6 +437,17 @@ class MaskWorkerBase:
             except BaseException as e:   # noqa: BLE001 -- re-raised
                 # by ensure_warm on the caller's thread
                 self._warm_error = e
+                return
+            # deferred program analysis on the SAME background thread:
+            # the recompile it triggers is persistent-cache-served (the
+            # warmup above just populated the cache) and overlaps job
+            # setup exactly like the warmup did.  Best-effort: the
+            # analyzed roofline is observability, never job state.
+            try:
+                from dprf_tpu.telemetry import programs as programs_mod
+                programs_mod.analyze_pending()
+            except Exception:   # noqa: BLE001
+                pass
 
         t = threading.Thread(target=_run, name="dprf-warmup",
                              daemon=True)
@@ -704,6 +739,8 @@ class WordlistWorkerBase(MaskWorkerBase):
     device and sharded wordlist workers.  Subclasses set
     ``self.word_batch`` (words per step, = the step's flat-lane stride
     divisor) before using these."""
+
+    ATTACK = "wordlist"
 
     def warmup_args(self) -> tuple:
         """Wordlist steps take (word-window start, n_valid words) --
@@ -1044,6 +1081,8 @@ class DeviceCombinatorWorker(MaskWorkerBase):
     """Fused-pipeline worker for combinator / hybrid attacks: same
     (base_digits, n_valid) step contract as the mask workers (the
     combinator keyspace is a 2-digit mixed-radix system)."""
+
+    ATTACK = "combinator"
 
     def __init__(self, engine, gen, targets: Sequence[Target],
                  batch: int = 1 << 18, hit_capacity: int = 64,

@@ -53,6 +53,12 @@ Metric names (all prefixed ``dprf_``; see README "Observability"):
                                                 alert engine
                                                 (telemetry/alerts.py)
   dprf_trace_spans_dropped_total                dropped/lost spans
+  dprf_hbm_bytes_in_use/_limit/_peak{device}    device allocator
+                                                memory (devstats.py)
+  dprf_program_peak_bytes{engine,attack}        analyzed per-dispatch
+                                                footprint (programs.py)
+  dprf_roofline_model_divergence{engine}        analyzed-vs-hand op
+                                                model cross-check
 
 Alongside metrics, telemetry/trace.py records per-unit lifecycle SPANS
 (the flight recorder): trace ids assigned at split time, context

@@ -1,0 +1,214 @@
+"""Device-memory accounting (ISSUE 13): ``device.memory_stats()``
+polled into HBM gauges, plus the OOM-headroom estimate the sizing
+layers consult.
+
+Nothing in the stack observed device memory before this module, even
+though the next scenario axes (10^6-10^7-target device-resident probe
+tables, superstep ``hit_capacity`` fusion) are fundamentally
+HBM-budget problems.  jax exposes the allocator's live counters on
+real devices as ``device.memory_stats()`` (``bytes_in_use``,
+``bytes_limit``, ``peak_bytes_in_use``); CPU/interpret backends return
+None -- the GRACEFUL-NONE contract every reader here keeps: a backend
+without stats publishes nothing and every derived estimate returns
+None, never a made-up number.
+
+Surfaces:
+
+  - ``poll()``            one pass over ``jax.local_devices()`` into
+        ``dprf_hbm_bytes_in_use/_limit/_peak{device}``; returns the
+        per-device snapshot dict ({} off-HBM backends).
+  - ``DevstatsPoller``    background loop on the ``DPRF_DEVSTATS_POLL_S``
+        cadence (TelemetrySnapshotter shape: daemon thread, Event
+        wait, stop() joins; 0 disables) -- started by serve/crack so
+        the session telemetry snapshots carry the HBM timeline.
+  - ``summary()``         host totals for the worker heartbeat payload
+        (hbm_in_use / hbm_limit / hbm_peak) and the ``dprf top``
+        header.
+  - ``headroom_frac()``   free fraction of the HBM limit -- the
+        OOM-headroom estimate: the adaptive unit sizer halves its next
+        units under ``LOW_HEADROOM_FRAC`` and the tune ladder stops
+        climbing when a projected program footprint exceeds the free
+        bytes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from dprf_tpu.telemetry import get_registry
+from dprf_tpu.utils import env as envreg
+
+POLL_ENV = "DPRF_DEVSTATS_POLL_S"
+
+#: free-HBM fraction under which the adaptive unit sizer halves its
+#: next units (tune/unit_sizer.py): cheap insurance against sizing
+#: into an allocator already near its ceiling
+LOW_HEADROOM_FRAC = 0.10
+
+#: memory_stats keys -> our gauge suffixes (allocator counters differ
+#: slightly across backends; missing keys simply publish nothing)
+_STAT_KEYS = (("bytes_in_use", "in_use"),
+              ("bytes_limit", "limit"),
+              ("peak_bytes_in_use", "peak"))
+
+
+def poll_interval(default: float = 15.0) -> float:
+    v = envreg.get_float(POLL_ENV, default)
+    return max(0.0, float(v or 0.0))
+
+
+def device_memory_stats() -> dict:
+    """{device label: {in_use, limit, peak}} over the local devices;
+    {} when jax is absent or no device reports memory stats (the CPU
+    backend's documented None)."""
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:   # noqa: BLE001 -- jax-less host
+        return {}
+    out = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:   # noqa: BLE001 -- backend without the API
+            stats = None
+        if not isinstance(stats, dict):
+            continue
+        rec = {}
+        for theirs, ours in _STAT_KEYS:
+            v = stats.get(theirs)
+            if isinstance(v, (int, float)):
+                rec[ours] = int(v)
+        if rec:
+            out[f"{d.platform}:{d.id}"] = rec
+    return out
+
+
+def _hbm_gauges(registry=None) -> tuple:
+    """ONE declaration site for the three HBM gauges."""
+    m = get_registry(registry)
+    return (
+        m.gauge("dprf_hbm_bytes_in_use",
+                "device allocator bytes currently in use "
+                "(device.memory_stats; absent on backends without "
+                "memory accounting)", labelnames=("device",)),
+        m.gauge("dprf_hbm_bytes_limit",
+                "device allocator byte limit (the HBM budget every "
+                "probe-table / superstep sizing decision is against)",
+                labelnames=("device",)),
+        m.gauge("dprf_hbm_bytes_peak",
+                "high-water mark of device allocator bytes in use",
+                labelnames=("device",)),
+    )
+
+
+def poll(registry=None) -> dict:
+    """One polling pass: publish the gauges, return the snapshot."""
+    snap = device_memory_stats()
+    if not snap:
+        return snap
+    g_use, g_limit, g_peak = _hbm_gauges(registry)
+    for dev, rec in snap.items():
+        if "in_use" in rec:
+            g_use.set(rec["in_use"], device=dev)
+        if "limit" in rec:
+            g_limit.set(rec["limit"], device=dev)
+        if "peak" in rec:
+            g_peak.set(rec["peak"], device=dev)
+    return snap
+
+
+def summary(snap: Optional[dict] = None) -> Optional[dict]:
+    """Host totals {in_use, limit, peak} summed over devices, or None
+    on a backend without memory stats (heartbeat payload / top
+    header)."""
+    if snap is None:
+        snap = device_memory_stats()
+    if not snap:
+        return None
+    out = {"in_use": 0, "limit": 0, "peak": 0}
+    for rec in snap.values():
+        for k in out:
+            out[k] += rec.get(k, 0)
+    return out
+
+
+def bytes_free(snap: Optional[dict] = None) -> Optional[int]:
+    """limit - in_use summed over devices; None without stats."""
+    s = summary(snap)
+    if s is None or not s.get("limit"):
+        return None
+    return max(0, s["limit"] - s["in_use"])
+
+
+def headroom_frac(snap: Optional[dict] = None) -> Optional[float]:
+    """Free fraction of the HBM limit (the OOM-headroom estimate);
+    None on backends without memory stats -- callers treat None as
+    'no signal', never as 'plenty free'."""
+    s = summary(snap)
+    if s is None or not s.get("limit"):
+        return None
+    return max(0.0, 1.0 - s["in_use"] / s["limit"])
+
+
+def peak_hbm_bytes() -> tuple:
+    """(peak bytes, source) for a bench result: the allocator's
+    measured high-water mark when the backend has one, else the
+    largest ANALYZED program footprint (telemetry/programs.py) as a
+    model-derived stand-in, else (None, None).  The source tag keeps
+    the two honest in the trajectory."""
+    s = summary()
+    if s is not None and s.get("peak"):
+        return s["peak"], "memory_stats"
+    from dprf_tpu.telemetry import programs as programs_mod
+    peak = programs_mod.get_programs().peak_bytes()
+    if peak:
+        return peak, "program_analysis"
+    return None, None
+
+
+class DevstatsPoller:
+    """Background HBM polling loop (TelemetrySnapshotter shape).  A
+    no-stats backend makes every tick a cheap no-op; interval 0 (the
+    knob) makes start() a no-op entirely."""
+
+    def __init__(self, registry=None, interval: Optional[float] = None):
+        self.registry = registry
+        self.interval = (poll_interval() if interval is None
+                         else max(0.0, float(interval)))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                poll(self.registry)
+            except Exception:   # noqa: BLE001 -- diagnostics only;
+                continue        # a poll failure must not kill the loop
+
+    def start(self) -> "DevstatsPoller":
+        if self.interval <= 0:
+            return self
+        if self._thread is None:
+            poll(self.registry)          # one immediate sample
+            self._thread = threading.Thread(target=self._run,
+                                            daemon=True,
+                                            name="dprf-devstats")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        try:
+            poll(self.registry)          # final sample for the journal
+        except Exception:   # noqa: BLE001 -- shutdown path
+            pass
+
+
+__all__ = ["DevstatsPoller", "LOW_HEADROOM_FRAC", "POLL_ENV",
+           "bytes_free", "device_memory_stats", "headroom_frac",
+           "peak_hbm_bytes", "poll", "poll_interval", "summary"]

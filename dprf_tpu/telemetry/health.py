@@ -74,9 +74,13 @@ STRAGGLER_FLOOR_FRAC = 0.5
 #: throughput EWMA smoothing for the per-worker rate estimate
 RATE_ALPHA = 0.3
 
-#: heartbeat payload sanitization (client-controlled data)
+#: heartbeat payload sanitization (client-controlled data).  The hbm_*
+#: fields are the worker's device-memory totals (telemetry/devstats
+#: summary; ISSUE 13) -- how the coordinator sees fleet HBM headroom
+#: without a second RPC.
 PAYLOAD_KEYS = ("engine", "device", "chips", "depth", "queue",
-                "rate_hs", "error")
+                "rate_hs", "error", "hbm_in_use", "hbm_limit",
+                "hbm_peak")
 MAX_PAYLOAD_STR = 200
 
 #: lock-discipline declaration (`dprf check` locks analyzer): observe
@@ -306,6 +310,42 @@ class HealthRegistry:
         with self._lock:
             return {w.worker: w.as_dict(now)
                     for w in self._workers.values()}
+
+    def mem_by_worker(self) -> dict:
+        """{worker: hbm bytes in use} from the heartbeat payloads
+        (ISSUE 13) -- the ``dprf top`` MEM column; workers on
+        backends without memory stats simply have no entry."""
+        with self._lock:
+            out = {}
+            for w in self._workers.values():
+                v = w.payload.get("hbm_in_use")
+                if isinstance(v, (int, float)) and not isinstance(
+                        v, bool):
+                    out[w.worker] = int(v)
+            return out
+
+    def hbm_totals(self) -> Optional[dict]:
+        """Fleet HBM headroom summed over LIVE (healthy/degraded)
+        workers' heartbeat payloads: {in_use, limit, workers}; None
+        when no worker reported memory stats -- exactly the
+        coordinator-side view the capability payload exists for."""
+        with self._lock:
+            use = limit = n = 0
+            for w in self._workers.values():
+                if w.state > DEGRADED:
+                    continue
+                lv = w.payload.get("hbm_limit")
+                uv = w.payload.get("hbm_in_use")
+                if not isinstance(lv, (int, float)) or isinstance(
+                        lv, bool) or lv <= 0:
+                    continue
+                limit += int(lv)
+                use += int(uv) if isinstance(uv, (int, float)) \
+                    and not isinstance(uv, bool) else 0
+                n += 1
+            if n == 0:
+                return None
+            return {"in_use": use, "limit": limit, "workers": n}
 
 
 class HealthMonitor:

@@ -38,10 +38,14 @@ because re-implementing their sweep here would risk wrong hits.
 
 Also here, because bench and the live fleet must share one model:
 
-  - the per-engine ROOFLINE (BASELINE.md "MD5 kernel roofline"):
-    int32 ops/candidate over the chip's 3-6e12 int32 ops/s band ->
-    ``roofline_band_hs(engine)`` and the ``dprf_roofline_frac{engine}``
-    gauge (EWMA-smoothed per-unit throughput / the band ceiling);
+  - the per-engine ROOFLINE: ops/candidate over the chip's 3-6e12
+    int32 ops/s band -> ``roofline_band_hs(engine)`` and the
+    ``dprf_roofline_frac{engine}`` gauge (EWMA-smoothed per-unit
+    throughput / the band ceiling).  ISSUE 13: the op model is
+    XLA-DERIVED (telemetry/programs.py analyzed flops per candidate,
+    covering every engine that compiles a step); the hand table
+    survives as a cross-check, with analyzed-vs-hand drift published
+    as ``dprf_roofline_model_divergence{engine}``;
   - multichip scaling: ``dprf_scaling_efficiency{engine}`` and
     ``dprf_per_chip_rate_hs{engine}`` published by bench's scaling
     mode.
@@ -70,10 +74,14 @@ ROOFLINE_ALPHA = 0.3
 #: BASELINE.md: 1024 lanes x ~1.5 GHz x 2-4 int32 ops/lane/cycle
 CHIP_INT_OPS_BAND = (3.0e12, 6.0e12)
 
-#: int32 ops per candidate through the fused kernels (BASELINE.md
-#: roofline tables: decode + pack + rounds + compare).  Engines not
-#: listed have no published model yet -- no roofline is reported for
-#: them rather than a made-up one.
+#: HAND roofline models (BASELINE.md tables: decode + pack + rounds +
+#: compare) -- DEMOTED to a cross-check by ISSUE 13: the live model is
+#: the XLA-derived one (telemetry/programs.py: optimized-HLO flops per
+#: candidate, captured at every compile site), which covers EVERY
+#: engine that compiles a step.  These five hand values remain only to
+#: sanity-check the analyzed numbers (divergence beyond
+#: MODEL_DIVERGENCE_MAX publishes dprf_roofline_model_divergence) and
+#: as the fallback when analysis never ran in this process.
 OPS_PER_CANDIDATE = {
     "md5": 800,        # 64 rounds ~10 ops + decode/pack/compare
     "ntlm": 600,       # MD4: 48 rounds (+ utf16 widen in pack)
@@ -325,15 +333,53 @@ def probe_pending(worker, unit, sampler: PerfSampler,
 # ---------------------------------------------------------------------------
 # roofline model (shared by bench and the live fleet)
 
+#: analyzed-vs-hand ratio beyond which the cross-check alarms (the
+#: dprf_roofline_model_divergence gauge carries the ratio either way;
+#: this is the level the README documents as "one of the models is
+#: wrong")
+MODEL_DIVERGENCE_MAX = 2.0
+
+
+def _divergence_gauge(registry=None):
+    return get_registry(registry).gauge(
+        "dprf_roofline_model_divergence",
+        "max(analyzed, hand) / min(analyzed, hand) ops-per-candidate "
+        "ratio between the XLA-derived roofline model and the hand "
+        "table (cross-check engines only; > 2 means one model is "
+        "wrong)", labelnames=("engine",))
+
+
+def ops_per_candidate(engine: str, registry=None) -> Optional[float]:
+    """The engine's roofline op model: the XLA-DERIVED value
+    (telemetry/programs.py: optimized flops / candidates per dispatch)
+    when a compiled program was analyzed in this process, else the
+    hand table.  When BOTH exist the divergence ratio is published so
+    a drifted hand model (or a mis-captured program) surfaces on
+    /metrics instead of silently skewing every roofline fraction.
+    Returns None only when the engine compiled nothing here AND has no
+    hand entry -- there is no silent per-engine skip list anymore."""
+    from dprf_tpu.telemetry import programs as programs_mod
+    analyzed = programs_mod.analyzed_ops_per_candidate(engine)
+    hand = OPS_PER_CANDIDATE.get(engine)
+    if analyzed and hand:
+        ratio = max(analyzed, hand) / min(analyzed, hand)
+        _divergence_gauge(registry).set(ratio, engine=engine)
+    return analyzed or hand
+
+
 def roofline_band_hs(engine: str) -> Optional[tuple]:
-    """(lo, hi) H/s ceiling band for an engine, or None when no op
-    model is published for it.  md5's derived band (3.75-7.5 GH/s)
-    rounds to the documented 4-8 GH/s BASELINE.md band."""
-    if engine == "md5":
-        return (4.0e9, 8.0e9)
-    ops = OPS_PER_CANDIDATE.get(engine)
+    """(lo, hi) H/s ceiling band for an engine, or None when neither
+    an analyzed program nor a hand model exists.  The analyzed model
+    wins (see ops_per_candidate); md5's documented 4-8 GH/s
+    BASELINE.md band applies only on the hand-model fallback, so the
+    committed trajectory stays readable next to the derived one."""
+    ops = ops_per_candidate(engine)
     if not ops:
         return None
+    from dprf_tpu.telemetry import programs as programs_mod
+    if engine == "md5" and not \
+            programs_mod.analyzed_ops_per_candidate(engine):
+        return (4.0e9, 8.0e9)
     lo, hi = CHIP_INT_OPS_BAND
     return (lo / ops, hi / ops)
 
@@ -346,6 +392,19 @@ def roofline_fraction(engine: str, rate_hs: float) -> Optional[float]:
     if band is None or not rate_hs or rate_hs <= 0:
         return None
     return rate_hs / band[1]
+
+
+def analyzed_roofline_fraction(engine: str,
+                               rate_hs: float) -> Optional[float]:
+    """Roofline fraction from the XLA-DERIVED model ALONE (no hand
+    fallback): what bench reports as ``analyzed_roofline`` so the
+    trajectory can tell a compiler-derived fraction from a hand-table
+    one.  None when no program of this engine was analyzed here."""
+    from dprf_tpu.telemetry import programs as programs_mod
+    ops = programs_mod.analyzed_ops_per_candidate(engine)
+    if not ops or not rate_hs or rate_hs <= 0:
+        return None
+    return rate_hs / (CHIP_INT_OPS_BAND[1] / ops)
 
 
 def _roofline_gauge(registry=None):

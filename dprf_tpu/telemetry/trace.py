@@ -726,6 +726,15 @@ def _fmt_age(s: float) -> str:
     return f"{s / 60:.1f}m"
 
 
+def _fmt_bytes(v) -> str:
+    if not isinstance(v, (int, float)) or v <= 0:
+        return "-"
+    for unit, div in (("G", 1 << 30), ("M", 1 << 20), ("K", 1 << 10)):
+        if v >= div:
+            return f"{v / div:.1f}{unit}"
+    return str(int(v))
+
+
 def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
     """One frame of the ``dprf top`` live view from an op_trace_tail
     response.  ``prev`` is (monotonic_time, status) of the previous
@@ -756,12 +765,21 @@ def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
     if roofline:
         roof_s = " | roofline " + " ".join(
             f"{e}:{f:.2f}" for e, f in sorted(roofline.items()))
+    # fleet HBM header (ISSUE 13): summed worker memory from the
+    # heartbeat payloads; absent on fleets without memory stats
+    hbm = status.get("hbm") or {}
+    hbm_s = ""
+    if hbm.get("limit"):
+        hbm_s = (f" | hbm {_fmt_bytes(hbm.get('in_use', 0))}"
+                 f"/{_fmt_bytes(hbm['limit'])}"
+                 f" ({hbm.get('workers', 0)}w)")
     lines.append(
         f"dprf top — {state} | found {status.get('found', 0)}"
         f"/{status.get('targets', '?')} | "
         f"{100.0 * done / total:.2f}% covered | parked "
         f"{status.get('parked', 0)} | elapsed "
-        f"{status.get('elapsed', 0.0):.0f}s{rate}{busy_s}{roof_s}")
+        f"{status.get('elapsed', 0.0):.0f}s{rate}{busy_s}{roof_s}"
+        f"{hbm_s}")
     quarantined = status.get("quarantined") or []
     if quarantined:
         lines.append(f"quarantined workers: {', '.join(quarantined)}")
@@ -809,10 +827,12 @@ def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
     # workers, sorted last), then worker id -- stable per-job blocks
     workers.sort(key=lambda w: (
         str((by_worker.get(w) or {}).get("job", "~")), w))
+    mem = status.get("mem") or {}
     lines.append("")
     lines.append(f"{'WORKER':20s} {'JOB':>5s} {'STATE':10s} "
                  f"{'UNIT':>8s} {'RANGE':>24s} {'LEASE':>8s} "
-                 f"{'BUSY':>5s} {'HEALTH':>8s} {'LAST SPAN':>10s}")
+                 f"{'BUSY':>5s} {'MEM':>6s} {'HEALTH':>8s} "
+                 f"{'LAST SPAN':>10s}")
     # ages against the COORDINATOR's clock (shipped in status): the
     # spans carry its wall time, and the viewer's clock may be skewed
     now = status.get("now") or time.time()
@@ -830,12 +850,13 @@ def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
         b = busy.get(w)
         b_s = f"{100.0 * b:.0f}%" if b is not None else "-"
         hw = str(health.get(w) or "-")[:8]
+        m_s = _fmt_bytes(mem.get(w))
         age = (_fmt_age(max(0.0, now - (s.get("ts", now)
                                         + s.get("dur", 0.0))))
                if s else "-")
         lines.append(f"{w[:20]:20s} {jid[:5]:>5s} {state:10s} "
                      f"{unit:>8s} {rng:>24s} {dl:>8s} {b_s:>5s} "
-                     f"{hw:>8s} {age:>10s}")
+                     f"{m_s:>6s} {hw:>8s} {age:>10s}")
     lines.append("")
     lines.append("recent spans:")
     for s in spans[-8:]:

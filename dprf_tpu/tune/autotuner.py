@@ -105,6 +105,44 @@ def _probe_rate(worker, keyspace: int, seconds: float,
     return n / elapsed
 
 
+def _over_hbm_headroom(worker, batch: int, rest: list, log=None) -> bool:
+    """OOM-headroom guard for the ladder (ISSUE 13): analyze the
+    rung's just-compiled program (recording its cost/memory into the
+    program registry is the tune side effect `dprf tune --all` banks
+    on), then project the NEXT rung's device footprint by scaling this
+    rung's analyzed peak bytes -- a projection past the allocator's
+    free bytes stops the climb BEFORE the allocation failure, which on
+    some backends wedges the process rather than raising cleanly.
+    Backends without memory stats (CPU) return None free bytes and
+    never stop the ladder."""
+    from dprf_tpu.telemetry import devstats
+    from dprf_tpu.telemetry import programs as programs_mod
+    programs_mod.analyze_pending()
+    if not rest or batch <= 0:
+        return False
+    free = devstats.bytes_free()
+    if free is None:
+        return False
+    eng = getattr(getattr(worker, "engine", None), "name", None)
+    if eng is None:
+        return False        # no identity: never project from an
+        # unrelated engine's programs
+    # THIS rung's program only: other shapes (a bench program, another
+    # attack, a bigger batch from an earlier run) scale differently
+    # and would stop the ladder on someone else's footprint
+    peak = programs_mod.get_programs().peak_bytes_for(eng, batch)
+    if not peak:
+        return False
+    projected = peak * (rest[0] / batch)
+    if projected <= free:
+        return False
+    if log:
+        log.warn("tune rung projects past free device memory; "
+                 "stopping ladder", next_batch=rest[0],
+                 projected_bytes=int(projected), free_bytes=free)
+    return True
+
+
 def sweep(make_worker: Callable[[int], object], keyspace: int,
           ladder: Optional[List[int]] = None, *,
           probe_seconds: float = 1.0, compile_budget_s: float = 120.0,
@@ -126,7 +164,7 @@ def sweep(make_worker: Callable[[int], object], keyspace: int,
     swept: List[Probe] = []
     best: Optional[Probe] = None
     stall = 0
-    for batch in ladder:
+    for i, batch in enumerate(ladder):
         try:
             entries0 = compilecache.entry_count()
             t0 = clock()
@@ -174,6 +212,8 @@ def sweep(make_worker: Callable[[int], object], keyspace: int,
         improved = best is None or rate > best.rate_hs * (1.0 + improve_eps)
         if best is None or rate > best.rate_hs:
             best = p
+        if _over_hbm_headroom(worker, batch, ladder[i + 1:], log=log):
+            break                # next rung projects past free HBM
         if improved:
             stall = 0
         else:

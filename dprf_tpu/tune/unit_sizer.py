@@ -45,7 +45,8 @@ class AdaptiveUnitSizer:
 
     def __init__(self, initial: int, target_seconds: float = 20.0,
                  min_unit: int = 1 << 10, max_unit: int = 1 << 28,
-                 align: int = 1, alpha: float = 0.4, registry=None):
+                 align: int = 1, alpha: float = 0.4, registry=None,
+                 headroom_fn=None):
         if initial <= 0:
             raise ValueError("initial unit size must be positive")
         if target_seconds <= 0:
@@ -58,6 +59,16 @@ class AdaptiveUnitSizer:
         self.min_unit = max(self.align, int(min_unit))
         self.max_unit = max(self.min_unit, int(max_unit))
         self.alpha = alpha
+        #: OOM-headroom estimate (ISSUE 13).  The signal must match
+        #: the ALTITUDE: the local-crack path (worker in THIS process)
+        #: wires ``headroom_fn=devstats.headroom_frac``; the serve
+        #: plane instead feeds each remote worker's heartbeat-reported
+        #: HBM through ``observe_headroom`` -- the coordinator's own
+        #: allocator state says nothing about a worker's.  Default
+        #: None = no headroom behavior until a caller wires a signal.
+        self._headroom_fn = headroom_fn
+        #: per-worker free fraction from heartbeats (serve plane)
+        self._headroom: dict[str, float] = {}
         self._rates: dict[str, float] = {}
         #: per-worker recent-failure score (fail() or lease expiry);
         #: decays by one per successful completion
@@ -105,6 +116,17 @@ class AdaptiveUnitSizer:
             elif f:
                 del self._failures[worker_id]
 
+    def observe_headroom(self, worker_id: str,
+                         frac: Optional[float]) -> None:
+        """Fold one worker's reported free-HBM fraction in (the serve
+        plane's heartbeat path); None clears the worker's entry (a
+        backend that stopped reporting is 'no signal', not 'full')."""
+        with self._lock:
+            if frac is None:
+                self._headroom.pop(worker_id, None)
+            else:
+                self._headroom[worker_id] = max(0.0, float(frac))
+
     def observe_failure(self, worker_id: str) -> None:
         """One failed attempt / lease expiry (reported by the
         Dispatcher's requeue path): the worker's next units halve per
@@ -126,13 +148,31 @@ class AdaptiveUnitSizer:
         """Unit length for this worker's next lease: EWMA rate x the
         target seconds, halved per recent failure, clamped and
         alignment-rounded.  A worker with no history gets the
-        configured initial size (the first unit is the measurement)."""
+        configured initial size (the first unit is the measurement).
+
+        OOM headroom (ISSUE 13): when THIS worker's device allocator
+        reports under LOW_HEADROOM_FRAC of its limit free -- its own
+        heartbeat report on the serve plane, the local devstats
+        callable on the in-process path -- the next unit halves too:
+        a longer unit holds more queued dispatches (and their
+        super-step buffers) live at once, and shrinking units is the
+        one lever this layer has before the allocator ceiling.  No
+        signal (no stats backend, no report) changes nothing."""
+        from dprf_tpu.telemetry.devstats import LOW_HEADROOM_FRAC
         with self._lock:
             rate = self._rates.get(worker_id)
             fails = self._failures.get(worker_id, 0)
+            headroom = self._headroom.get(worker_id)
         size = (self.initial if rate is None
                 else int(rate * self.target_seconds))
         size >>= min(fails, self.MAX_PENALTY_BITS)
+        if headroom is None and self._headroom_fn is not None:
+            try:
+                headroom = self._headroom_fn()
+            except Exception:   # noqa: BLE001 -- an estimate, never
+                headroom = None              # a gate
+        if headroom is not None and headroom < LOW_HEADROOM_FRAC:
+            size >>= 1
         size = self._clamp(size)
         self._g_size.set(size)
         return size

@@ -155,6 +155,16 @@ _declare("DPRF_TUNE_DIR", None, "path",
          "directory, else ~/.cache/dprf).")
 
 # -- observability -----------------------------------------------------------
+_declare("DPRF_DEVSTATS_POLL_S", 15.0, "float",
+         "Seconds between device-memory polls (telemetry/devstats.py: "
+         "device.memory_stats() -> dprf_hbm_bytes_in_use/_limit/_peak "
+         "gauges; backends without memory stats publish nothing); 0 "
+         "disables the background poller.")
+_declare("DPRF_PROGRAM_ANALYSIS", True, "bool",
+         "XLA-derived program introspection (telemetry/programs.py): "
+         "compiled steps register their cost_analysis/memory_analysis "
+         "record, feeding the analyzed roofline and the program "
+         "registry; 0 is the kill switch (hand roofline models only).")
 _declare("DPRF_ALERT_EVAL_S", 5.0, "float",
          "Seconds between fleet-health/alert evaluation passes "
          "(worker state machine, straggler detection, per-job SLOs, "

@@ -133,6 +133,57 @@ def test_quota_counts_outstanding_and_stops_leasing():
     assert s.lease_many("w0", 1) == []
 
 
+def test_owner_aggregate_quota_caps_leases_across_jobs():
+    """ISSUE 13 satellite: one owner's cap binds the SUM of its jobs'
+    swept-or-leased indices -- splitting work over two jobs buys the
+    owner nothing, and other owners keep leasing."""
+    reg = MetricsRegistry()
+    s = JobScheduler(registry=reg, owner_quotas={"alice": 150})
+    a1 = _add(s, reg, owner="alice")
+    a2 = _add(s, reg, owner="alice")
+    b = _add(s, reg, owner="bob")
+    pairs = s.lease_many("w0", 12)
+    by_owner: dict = {}
+    for job, _ in pairs:
+        by_owner[job.owner] = by_owner.get(job.owner, 0) + 1
+    # alice: first lease takes aggregate to 100 (< 150, still
+    # leasable), second to 200 (capped); bob is unaffected
+    assert by_owner["alice"] == 2
+    assert by_owner["bob"] == 10        # bob's whole keyspace
+    assert s.owner_swept("alice") == 200
+    assert s.owner_quota_error("alice") is not None
+    assert s.owner_quota_error("bob") is None
+    assert {a1.job_id, a2.job_id} >= {
+        j.job_id for j, _ in pairs if j.owner == "alice"}
+    assert b.leases == 10
+
+
+def test_owner_quota_rejects_submit_before_the_build():
+    """The admission gate fires before the expensive server-side
+    build: a capped owner's submission must not even construct the
+    job runtime."""
+    reg = MetricsRegistry()
+    disp = Dispatcher(KEYSPACE, UNIT, registry=reg)
+    state = CoordinatorState({"engine": "md5"}, disp, 1, registry=reg,
+                             owner_quotas={"alice": 0})
+
+    def exploding_builder(*a, **kw):
+        raise AssertionError("build ran past the owner-quota gate")
+
+    state.job_builder = exploding_builder
+    resp = state.op_job_submit({"spec": {}, "owner": "alice"})
+    assert "quota" in resp["error"]
+    # an uncapped owner reaches the builder (and its real errors)
+    def ok_builder(spec, jid, **kw):
+        d = Dispatcher(KEYSPACE, UNIT, registry=reg, job_id=jid)
+        return {"engine": "md5", "keyspace": KEYSPACE,
+                "fingerprint": "f"}, d, ["t0"], None
+
+    state.job_builder = ok_builder
+    resp = state.op_job_submit({"spec": {}, "owner": "bob"})
+    assert resp.get("ok")
+
+
 def test_rate_token_bucket_throttles_leases():
     clock = [0.0]
     reg = MetricsRegistry()

@@ -271,7 +271,18 @@ def test_busy_fraction_prunes_outside_window():
 # ---------------------------------------------------------------------------
 # roofline model + gauges
 
-def test_roofline_band_and_fraction():
+def _no_analyzed_model(monkeypatch):
+    """Pin the HAND-model fallback: earlier tests in the session may
+    have warmed real workers, landing XLA-derived records in the
+    process-global program registry (ISSUE 13) -- these tests assert
+    the hand table's band, so the analyzed model must read absent."""
+    from dprf_tpu.telemetry import programs
+    monkeypatch.setattr(programs, "analyzed_ops_per_candidate",
+                        lambda engine, programs=None: None)
+
+
+def test_roofline_band_and_fraction(monkeypatch):
+    _no_analyzed_model(monkeypatch)
     lo, hi = perf.roofline_band_hs("md5")
     assert (lo, hi) == (4.0e9, 8.0e9)        # documented band
     assert perf.roofline_fraction("md5", 4.0e9) == pytest.approx(0.5)
@@ -281,7 +292,24 @@ def test_roofline_band_and_fraction():
     assert perf.roofline_fraction("bcrypt", 1e9) is None
 
 
-def test_publish_roofline_smooths_and_snapshots():
+def test_roofline_prefers_analyzed_model(monkeypatch):
+    """ISSUE 13: an analyzed program's flops/candidate beats the hand
+    table, and covers engines the table never listed."""
+    from dprf_tpu.telemetry import programs
+    monkeypatch.setattr(programs, "analyzed_ops_per_candidate",
+                        lambda engine, programs=None: 1500.0)
+    assert perf.ops_per_candidate("sha512") == 1500.0
+    assert perf.roofline_band_hs("sha512") == pytest.approx(
+        (3.0e12 / 1500, 6.0e12 / 1500))
+    # md5's documented hand band yields to the derived one too
+    assert perf.roofline_band_hs("md5") == pytest.approx(
+        (3.0e12 / 1500, 6.0e12 / 1500))
+    assert perf.analyzed_roofline_fraction(
+        "md5", 2.0e9) == pytest.approx(2.0e9 / (6.0e12 / 1500))
+
+
+def test_publish_roofline_smooths_and_snapshots(monkeypatch):
+    _no_analyzed_model(monkeypatch)
     reg = MetricsRegistry()
     f1 = perf.publish_roofline("md5", 4.0e9, registry=reg)
     assert f1 == pytest.approx(0.5)          # first sample unsmoothed

@@ -73,6 +73,91 @@ def test_sweep_picks_fastest_batch_and_stops_on_saturation():
     assert res.rate_hs == pytest.approx(8e3, rel=0.01)
 
 
+def test_sweep_hbm_headroom_guard_stops_the_ladder(monkeypatch):
+    """ISSUE 13: a projected next-rung footprint past the device's
+    free bytes stops the climb before the allocation failure; a
+    backend without memory stats (free None) never stops it."""
+    from dprf_tpu.tune import autotuner
+    clk = FakeClock()
+
+    class FakeEngine:
+        name = "md5"
+
+    def make_worker(batch):
+        w = FakeWorker(clk, 1e3, compile_s=0.1, stride=batch)
+        w.engine = FakeEngine()
+        return w
+
+    # analyzed footprint: 1 KiB/candidate at the current rung; free
+    # HBM fits 2048 candidates -- the 4096 rung must not build
+    class FakeProgs:
+        def peak_bytes_for(self, engine, batch):
+            assert engine == "md5"
+            return batch * 1024         # this rung's own footprint
+
+        def analyze_pending(self):
+            return 0
+
+    monkeypatch.setattr(autotuner, "_over_hbm_headroom",
+                        autotuner._over_hbm_headroom)
+    from dprf_tpu.telemetry import devstats, programs
+    monkeypatch.setattr(devstats, "bytes_free",
+                        lambda snap=None: 2048 * 1024)
+    monkeypatch.setattr(programs, "get_programs",
+                        lambda programs=None: FakeProgs())
+    res = sweep(make_worker, keyspace=1 << 40,
+                ladder=[1024, 4096, 16384], probe_seconds=1.0,
+                clock=clk)
+    assert [p.batch for p in res.swept] == [1024]
+    # no memory stats -> the ladder runs to saturation/patience
+    monkeypatch.setattr(devstats, "bytes_free", lambda snap=None: None)
+    clk2 = FakeClock()
+
+    def make_worker2(batch):
+        return FakeWorker(clk2, 1e3, compile_s=0.1, stride=batch)
+
+    res2 = sweep(make_worker2, keyspace=1 << 40,
+                 ladder=[1024, 4096, 16384], probe_seconds=1.0,
+                 clock=clk2)
+    assert len(res2.swept) == 3
+
+
+def test_tune_all_sweeps_registered_engines(monkeypatch, capsys):
+    """`dprf tune --all` (ISSUE 13 satellite): every registered
+    engine is attempted, failures are per-engine skips, and one JSON
+    summary lands on stdout."""
+    import dprf_tpu.cli as cli_mod
+
+    swept_engines = []
+
+    def fake_tune_one(engine_name, args, device, log):
+        if engine_name == "sha256":
+            raise ValueError("boom")
+        swept_engines.append(engine_name)
+        return {"engine": engine_name, "batch": 4096, "rate_hs": 1e6}
+
+    monkeypatch.setattr(cli_mod, "_tune_one", fake_tune_one)
+    monkeypatch.setattr(cli_mod, "engine_names",
+                        lambda dev: ["md5", "sha256", "ntlm"])
+    rc = cli_mod.cmd_tune(
+        cli_mod._build_parser().parse_args(["tune", "--all", "-q"]),
+        __import__("dprf_tpu.utils.logging",
+                   fromlist=["Log"]).Log(quiet=True))
+    out = capsys.readouterr().out
+    doc = json.loads(out.strip().splitlines()[-1])
+    assert rc == 0
+    assert doc["tuned"] == 2 and doc["skipped"] == 1
+    assert doc["skips"][0]["engine"] == "sha256"
+    assert sorted(swept_engines) == ["md5", "ntlm"]
+
+
+def test_tune_requires_engine_or_all():
+    import dprf_tpu.cli as cli_mod
+    from dprf_tpu.utils.logging import Log
+    args = cli_mod._build_parser().parse_args(["tune", "-q"])
+    assert cli_mod.cmd_tune(args, Log(quiet=True)) == 2
+
+
 def test_sweep_compile_budget_stops_the_ladder():
     clk = FakeClock()
     rate = _rates({256: 1e3, 1024: 2e3, 4096: 4e3, 16384: 8e3})
