@@ -20,6 +20,14 @@ a runtime ValueError, depending on which import runs first.  Rules:
      ``dprf_*`` metric.  A renamed metric would otherwise silently
      disarm its rule: the alert engine evaluates "condition false"
      against a metric that no longer exists, forever.
+  4. every ``jax.profiler`` trace call (``.start_trace(`` /
+     ``.stop_trace(`` / ``jax.profiler.trace(``) lives in
+     ``telemetry/profiler.py`` (ISSUE 15): jax allows ONE active
+     trace per process, so every starter must go through
+     ProfileCapture's single-flight guard -- a raw call elsewhere
+     is exactly the ``--profile``-vs-``DPRF_JAX_PROFILE`` collision
+     the guard exists to prevent.  One-declaration-site discipline,
+     same as metrics and spans.
 """
 
 from __future__ import annotations
@@ -33,16 +41,24 @@ from dprf_tpu.analysis import Finding
 NAME = "metrics"
 DESCRIPTION = ("every dprf_* metric declared at one site; every span "
                "literal is in SPAN_NAMES; every alert rule "
-               "references a declared metric")
+               "references a declared metric; jax.profiler calls "
+               "only in telemetry/profiler.py")
 
 METRIC_METHODS = {"counter", "gauge", "histogram"}
 TRACE_REL = os.path.join("telemetry", "trace.py")
 ALERTS_REL = os.path.join("telemetry", "alerts.py")
+PROFILER_REL = os.path.join("telemetry", "profiler.py")
+
+#: profiler-trace attribute calls that must not exist outside the
+#: single-flight owner (rule 4): start/stop are unambiguous; a bare
+#: ``.trace(`` only counts when called on something named "profiler"
+PROFILER_METHODS = {"start_trace", "stop_trace"}
 
 #: parse prefilter: a file with no metric/record call text cannot
 #: contribute a declaration or span use
 _RELEVANT_RE = re.compile(
     r"\.(?:counter|gauge|histogram|record)\s*\(")
+_PROFILER_RE = re.compile(r"\.(?:start_trace|stop_trace|trace)\s*\(")
 
 
 def _literal(node):
@@ -157,6 +173,54 @@ def _check_alert_rules(ctx, pkg_dir: str, declared: set) -> list:
     return out
 
 
+def _profiler_calls(idx):
+    """(description, lineno) for every jax.profiler trace call in a
+    file (rule 4): start/stop_trace attribute calls, plus ``.trace(``
+    called on something named ``profiler``."""
+    out = []
+    for node in idx.calls:
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        if f.attr in PROFILER_METHODS:
+            out.append((f.attr, node.lineno))
+        elif f.attr == "trace":
+            v = f.value
+            name = (v.attr if isinstance(v, ast.Attribute)
+                    else v.id if isinstance(v, ast.Name) else None)
+            if name == "profiler":
+                out.append(("profiler.trace", node.lineno))
+    return out
+
+
+def _check_profiler_discipline(ctx, pkg_dir: str) -> list:
+    """Rule 4: every jax.profiler trace call lives in
+    telemetry/profiler.py -- the single-flight capture owner."""
+    out = []
+    profiler_rel = ctx.rel(os.path.join(pkg_dir, PROFILER_REL))
+    for path in (ctx.package_files() + ctx.root_files()
+                 + ctx.tools_files()):
+        try:
+            if not _PROFILER_RE.search(ctx.source(path)):
+                continue
+        except OSError:
+            continue
+        rel = ctx.rel(path)
+        if rel == profiler_rel:
+            continue
+        idx = ctx.index(path)
+        if idx is None:
+            continue
+        for what, lineno in _profiler_calls(idx):
+            out.append(Finding(
+                NAME, rel, lineno,
+                f"jax.profiler call ({what}) outside "
+                "telemetry/profiler.py -- jax allows ONE active "
+                "trace; route captures through ProfileCapture's "
+                "single-flight guard (session/begin_window)"))
+    return out
+
+
 def _declared_span_names(idx):
     """The SPAN_NAMES tuple, or None when the assignment is missing."""
     if idx is None:
@@ -228,4 +292,6 @@ def run(ctx) -> list:
     # alert rules (default pack + fixture files) must reference
     # declared metrics only
     out.extend(_check_alert_rules(ctx, pkg_dir, set(decl_sites)))
+    # jax.profiler calls only in the single-flight owner (ISSUE 15)
+    out.extend(_check_profiler_discipline(ctx, pkg_dir))
     return out

@@ -528,6 +528,52 @@ def _build_parser() -> argparse.ArgumentParser:
                     "(the CI artifact format)")
     _jobs_client_args(pg)
 
+    pf = sub.add_parser("profile", help="kernel-level profiling "
+                        "(telemetry/profiler.py): analyze a "
+                        "jax.profiler capture dir dependency-free "
+                        "(top device ops, compute/collective/copy "
+                        "fractions, generate/hash/compare phases), "
+                        "or capture a bounded window on a live fleet "
+                        "worker over RPC")
+    pf.add_argument("target", nargs="?", default=None,
+                    help="local mode: a capture dir (the --profile / "
+                    "DPRF_JAX_PROFILE output) or a "
+                    "perfetto_trace.json.gz file")
+    pf.add_argument("--engine", "-m", default=None,
+                    help="engine whose declared PROFILE_PHASES "
+                    "patterns map device ops to generate/hash/"
+                    "compare")
+    pf.add_argument("--connect", default=None, metavar="HOST:PORT",
+                    help="capture mode: request one bounded capture "
+                    "window on a worker and pull back the analyzed "
+                    "summary (the raw trace stays on the worker "
+                    "host; its path rides the summary)")
+    pf.add_argument("--worker", default=None, metavar="W",
+                    help="worker id to capture on (default: the "
+                    "slowest live worker)")
+    pf.add_argument("--seconds", type=float, default=None,
+                    help="capture window length (default: "
+                    "$DPRF_PROFILE_SECONDS)")
+    pf.add_argument("--wait", type=float, default=180.0, metavar="S",
+                    help="seconds to wait for the worker to push its "
+                    "summary before giving up (a cold worker first "
+                    "warms the profiler's import stack off its sweep "
+                    "path, then sweeps through the window)")
+    pf.add_argument("--fetch", action="store_true",
+                    help="no new capture: print the summaries the "
+                    "coordinator already holds (incl. "
+                    "alert-triggered auto-captures)")
+    pf.add_argument("--top", type=int, default=20, metavar="N",
+                    help="top-ops table length (local mode)")
+    pf.add_argument("--json", action="store_true",
+                    help="machine-readable summary on stdout (the "
+                    "CI artifact format)")
+    pf.add_argument("--token", default=None,
+                    help="shared secret for an authenticated "
+                    "coordinator (default: $DPRF_TOKEN)")
+    pf.add_argument("--timeout", type=float, default=30.0)
+    pf.add_argument("--quiet", "-q", action="store_true")
+
     mt = sub.add_parser("metrics", help="scrape a running coordinator's "
                         "/metrics endpoint (Prometheus text format)")
     mt.add_argument("--connect", required=True, metavar="HOST:PORT",
@@ -1175,12 +1221,17 @@ def _crack_single(args, device: str, log: Log):
         devstats_poller = DevstatsPoller(registry=_registry).start()
     try:
         if args.profile:
-            # jax.profiler.trace captures device + host timelines for
-            # every step the coordinator drives (SURVEY.md section 5).
-            import jax
-            with jax.profiler.trace(args.profile):
+            # jax.profiler capture of every step the coordinator
+            # drives, through the single-flight ProfileCapture (a
+            # DPRF_JAX_PROFILE env trace on the same process degrades
+            # to a logged no-op instead of a crash); analyze with
+            # `dprf profile DIR`
+            from dprf_tpu.telemetry import profiler as profiler_mod
+            with profiler_mod.get_profiler().session(
+                    args.profile, owner="cli", log=log):
                 result = coord.run()
-            log.info("profile written", dir=args.profile)
+            log.info("profile written (analyze with `dprf profile`)",
+                     dir=args.profile)
         else:
             result = coord.run()
     finally:
@@ -1358,11 +1409,20 @@ def cmd_serve(args, log: Log) -> int:
                 tr.get("worker"), tr.get("from"), tr.get("to"),
                 ts=tr.get("ts"), age_s=tr.get("age_s"))
 
+    def on_profile(worker, summary):
+        # kernel-profile summaries -> {"type": "profile"} journal
+        # records (fired under state.lock by op_profile_push, so the
+        # writes serialize with the other journal writers); `dprf
+        # report` renders them post-mortem
+        if session is not None:
+            session.record_profile(worker, summary)
+
     state.on_progress = on_progress
     state.on_job_hit = on_job_hit
     state.on_job_progress = on_job_progress
     state.on_job_event = on_job_event
     state.on_worker_health = on_worker_health
+    state.on_profile = on_profile
     from dprf_tpu.runtime.coordinator import preload_potfile
     # restored hits go through the default job's hit BUFFER (not just
     # the found dict) so op_hits_pull clients see them too
@@ -1578,8 +1638,12 @@ def cmd_bench(args, log: Log) -> int:
     compilecache.enable(log=log)
     ctx = contextlib.nullcontext()
     if args.profile:
-        import jax
-        ctx = jax.profiler.trace(args.profile)
+        # kernel profile of the measurement window, through the
+        # single-flight capture owner; the analyzed top-ops +
+        # fractions fold into the result JSON below
+        from dprf_tpu.telemetry import profiler as profiler_mod
+        ctx = profiler_mod.get_profiler().session(
+            args.profile, owner="bench", log=log)
     with ctx:
         if args.devices > 1:
             from dprf_tpu.bench import run_scaling
@@ -1598,6 +1662,25 @@ def cmd_bench(args, log: Log) -> int:
                             device=_DEVICE_ALIASES[args.device],
                             mask=args.mask, batch=args.batch,
                             seconds=args.seconds, impl=args.impl, log=log)
+    if args.profile:
+        # fold the kernel view into the BENCH record: top ops,
+        # class fractions, phase split, and the measured-vs-analyzed
+        # cost divergence (the bench knows its candidate count).
+        # --config/--devices results carry the engine + "tested"
+        # count instead of the single-run batch fields
+        cands = res.get("batches", 0) * res.get("batch", 0) \
+            * max(1, res.get("inner", 1)) or res.get("tested", 0)
+        summary = profiler_mod.analyze_trace(
+            args.profile, engine=res.get("engine") or args.engine,
+            candidates=cands or None)
+        res["profile"] = {
+            "top_ops": (summary.get("top_ops") or [])[:10],
+            "fractions": summary.get("fractions"),
+            "phases": summary.get("phases"),
+            "device_s": summary.get("device_s"),
+            "divergence": summary.get("divergence"),
+            "error": summary.get("error"),
+        }
     if args.gate:
         # regression sentinel: the verdict rides the result JSON (CI
         # parses it) and a regression exits non-zero.  Scaling mode
@@ -2280,6 +2363,92 @@ def cmd_programs(args, log: Log) -> int:
     return 0
 
 
+def cmd_profile(args, log: Log) -> int:
+    """`dprf profile`: kernel-level profiling (ISSUE 15).  Local mode
+    analyzes an existing capture (dependency-free perfetto parse);
+    --connect requests a bounded capture window on a fleet worker
+    over op_profile and polls until the analyzed summary arrives."""
+    import json as _json
+
+    from dprf_tpu.telemetry import profiler as profiler_mod
+
+    if args.connect:
+        return _profile_connect(args, log, profiler_mod, _json)
+    if not args.target:
+        log.error("profile: give a capture dir / trace file to "
+                  "analyze, or --connect for a live capture")
+        return 2
+    doc = profiler_mod.analyze_trace(args.target, engine=args.engine,
+                                     top=args.top)
+    if args.json:
+        print(_json.dumps(doc, sort_keys=True))
+    else:
+        print(profiler_mod.render_summary(doc))
+    return 1 if doc.get("error") else 0
+
+
+def _profile_connect(args, log: Log, profiler_mod, _json) -> int:
+    """The capture+pull flow: op_profile request -> the worker's next
+    lease/heartbeat carries the window -> it sweeps through the
+    window, analyzes locally, pushes the summary -> we poll the
+    coordinator's summary table for our request id."""
+    import time as _time
+
+    client = _jobs_client(args, log)
+    try:
+        if args.fetch:
+            resp = client.call("profile", worker=args.worker)
+            summaries = resp.get("summaries") or {}
+            if args.json:
+                print(_json.dumps(summaries, sort_keys=True))
+            else:
+                for w in sorted(summaries):
+                    for s in summaries[w]:
+                        print(f"--- {w}")
+                        print(profiler_mod.render_summary(s))
+            log.info("profile summaries",
+                     workers=len(summaries))
+            return 0
+        resp = client.call("profile", action="request",
+                           worker=args.worker, seconds=args.seconds)
+        rid = resp.get("request_id")
+        worker = resp.get("worker")
+        log.info("capture requested", worker=worker, request=rid)
+        deadline = _time.monotonic() + max(1.0, args.wait)
+        summary = None
+        while _time.monotonic() < deadline:
+            try:
+                st = client.call("profile", worker=worker)
+            except (OSError, RpcError):
+                # the serve session can legitimately end mid-poll
+                # (short job: the drain's read-grace covers the
+                # normal push->read window, but a killed or crashed
+                # coordinator shouldn't turn into a CLI traceback)
+                log.warn("coordinator went away mid-poll",
+                         worker=worker, request=rid)
+                break
+            for s in (st.get("summaries") or {}).get(worker, []):
+                if s.get("request_id") == rid:
+                    summary = s
+                    break
+            if summary is not None:
+                break
+            _time.sleep(0.5)
+    finally:
+        client.close()
+    if summary is None:
+        log.error("no summary arrived in time (worker still "
+                  "compiling/warming the profiler deps, dead, or "
+                  "never leasing?)", worker=worker,
+                  waited=f"{args.wait:.0f}s")
+        return 1
+    if args.json:
+        print(_json.dumps(summary, sort_keys=True))
+    else:
+        print(profiler_mod.render_summary(summary))
+    return 1 if summary.get("error") else 0
+
+
 def cmd_metrics(args, log: Log) -> int:
     """Scrape a running coordinator: plain HTTP GET on the RPC port
     (no client library; works for curl/Prometheus too).  --json asks
@@ -2458,6 +2627,7 @@ _COMMANDS = {
     "token": cmd_token,
     "report": cmd_report,
     "programs": cmd_programs,
+    "profile": cmd_profile,
     "metrics": cmd_metrics,
     "check": cmd_check,
     "show": cmd_show,

@@ -30,7 +30,12 @@ every execution path and makes its behavior observable:
                           image (the ``dprf prewarm`` subcommand; see
                           compilecache/prewarm.py).
 
-Classification: a compile that wrote new entries into the cache dir is
+Classification: on jaxes with the ``jax_explain_cache_misses`` config
+(``explain_capable``), the observer captures the compiler's own
+per-compile "Persistent compilation cache hit/MISS" log lines -- the
+EXACT classification (ISSUE 15).  The heuristic below stays the
+fallback for windows the watch saw nothing in and for older jaxes: a
+compile that wrote new entries into the cache dir is
 a miss (exact -- JAX persists every compile at these thresholds); one
 that wrote nothing and finished under the cold-compile floor
 (``$DPRF_COMPILE_COLD_FLOOR_S``, default 5 s) is a hit.  A no-write
@@ -44,6 +49,7 @@ their wall time says nothing about the compile.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -67,12 +73,16 @@ DEFAULT_COLD_FLOOR_S = 5.0
 
 _lock = threading.Lock()
 _state: dict = {"dir": None}
+#: exact-classifier log-watch bookkeeping (ISSUE 15): refcounted
+#: install of the jax._src.compiler capture handler, so nested
+#: observers restore the logger's level/propagate exactly once
+_watch_state: dict = {"count": 0, "saved": None}
 
 #: `dprf check` locks analyzer: module-global cache state, written by
 #: enable()/disable() and read from every compile site -- the serve
 #: plane calls those from multiple threads.
 GUARDED_BY = {
-    "<module>": {"_lock": ("_state",)},
+    "<module>": {"_lock": ("_state", "_watch_state")},
 }
 
 
@@ -228,6 +238,78 @@ def classify_delta(entries_before: Optional[int],
     return "hit"
 
 
+# ---------------------------------------------------------------------------
+# exact hit/miss classification from the compiler's own log lines
+# (ISSUE 15 satellite; closes the carried ROADMAP follow-up)
+
+#: the logger jax's compile_or_get_cached path logs one line per
+#: compile to: "Persistent compilation cache hit for '<module>'" /
+#: "PERSISTENT COMPILATION CACHE MISS for '<module>'"
+_JAX_COMPILER_LOGGER = "jax._src.compiler"
+_HIT_MSG = "Persistent compilation cache hit"
+_MISS_MSG = "PERSISTENT COMPILATION CACHE MISS"
+
+
+def explain_capable() -> bool:
+    """Newer-jax capability probe: the ``jax_explain_cache_misses``
+    config option landed alongside the per-compile persistent-cache
+    log lines this classifier captures (0.4.x era).  When absent, the
+    entry-delta + wall-floor heuristic below stays the classifier."""
+    try:
+        import jax
+        return hasattr(jax.config, "jax_explain_cache_misses")
+    except Exception:   # noqa: BLE001 -- jax-less host
+        return False
+
+
+class _CacheLogWatch(logging.Handler):
+    """Captures the compiler's per-compile hit/miss log lines for one
+    observed window -- the EXACT classification (one line per XLA
+    compile, emitted by the cache layer itself), replacing the
+    entry-delta + wall-floor guess whenever it saw anything."""
+
+    def __init__(self):
+        super().__init__(level=logging.DEBUG)
+        self.hits = 0
+        self.misses = 0
+
+    def emit(self, record: logging.LogRecord) -> None:
+        msg = record.msg if isinstance(record.msg, str) else \
+            str(record.msg)
+        if _HIT_MSG in msg:
+            self.hits += 1
+        elif _MISS_MSG in msg:
+            self.misses += 1
+
+
+def _watch_install(watch: _CacheLogWatch) -> None:
+    """Attach a watch to the compiler logger.  The hit line logs at
+    DEBUG unless ``jax_log_compiles`` is on, so the logger is dropped
+    to DEBUG with propagation OFF for the window (the records land in
+    our handler, not on the operator's console); the refcount restores
+    both exactly once when the last nested observer exits."""
+    logger = logging.getLogger(_JAX_COMPILER_LOGGER)
+    with _lock:
+        if _watch_state["count"] == 0:
+            _watch_state["saved"] = (logger.level, logger.propagate)
+            if logger.getEffectiveLevel() > logging.DEBUG:
+                logger.setLevel(logging.DEBUG)
+            logger.propagate = False
+        _watch_state["count"] += 1
+    logger.addHandler(watch)
+
+
+def _watch_remove(watch: _CacheLogWatch) -> None:
+    logger = logging.getLogger(_JAX_COMPILER_LOGGER)
+    logger.removeHandler(watch)
+    with _lock:
+        _watch_state["count"] -= 1
+        if _watch_state["count"] == 0 and _watch_state["saved"]:
+            logger.setLevel(_watch_state["saved"][0])
+            logger.propagate = _watch_state["saved"][1]
+            _watch_state["saved"] = None
+
+
 def compile_histogram(registry=None):
     """ONE declaration site for dprf_compile_seconds (worker warmup,
     bench, and prewarm all publish through here, so the label set can
@@ -270,12 +352,20 @@ class compile_observer:
     entering -- argument materialization can itself write tiny cache
     entries, which would misread a hit as a miss.
 
+    Classification prefers the EXACT per-compile log lines the cache
+    layer itself emits (``explain_capable`` jaxes; ISSUE 15): a
+    window whose watch saw any line classifies from it alone -- any
+    miss makes the window a miss, hits-only is a hit.  A window the
+    watch saw nothing in (every executable already live in jax's
+    in-memory cache, or an older jax) falls back to the entry-delta +
+    wall-floor heuristic.
+
     Attributes after exit: ``seconds``, ``cache``.  Nothing is
     published when the body raises (a failed compile is not a compile
     cost, it is an error the caller handles)."""
 
     __slots__ = ("engine", "registry", "publish", "seconds", "cache",
-                 "_t0", "_before")
+                 "_t0", "_before", "_watch")
 
     def __init__(self, engine: str, registry=None, publish: bool = True):
         self.engine = engine
@@ -283,18 +373,28 @@ class compile_observer:
         self.publish = publish
         self.seconds = 0.0
         self.cache = "off"
+        self._watch: Optional[_CacheLogWatch] = None
 
     def __enter__(self) -> "compile_observer":
+        if enabled() and explain_capable():
+            self._watch = _CacheLogWatch()
+            _watch_install(self._watch)
         self._before = entry_count()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
         self.seconds = time.perf_counter() - self._t0
+        watch, self._watch = self._watch, None
+        if watch is not None:
+            _watch_remove(watch)
         if exc_type is not None:
             return False
-        self.cache = classify_compile(self.seconds, self._before,
-                                      entry_count())
+        if watch is not None and (watch.hits or watch.misses):
+            self.cache = "miss" if watch.misses else "hit"
+        else:
+            self.cache = classify_compile(self.seconds, self._before,
+                                          entry_count())
         if self.publish:
             observe_compile(self.engine, self.seconds, self.cache,
                             registry=self.registry)
@@ -305,4 +405,5 @@ __all__ = ["CACHE_DIR_ENV", "DISABLE_ENV", "COLD_FLOOR_ENV",
            "DEFAULT_COLD_FLOOR_S", "cache_dir", "classify_compile",
            "classify_delta", "cold_floor_s", "compile_histogram",
            "compile_observer", "default_cache_dir", "disable",
-           "enable", "enabled", "entry_count", "observe_compile"]
+           "enable", "enabled", "entry_count", "explain_capable",
+           "observe_compile"]

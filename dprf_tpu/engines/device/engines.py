@@ -82,6 +82,18 @@ class JaxEngineBase(GenericWorkerFactories, DeviceHashEngine, HashEngine):
     #: single-block packing limit (55 for 64-byte blocks; 111 for the
     #: SHA-512 family's 128-byte blocks)
     _block_limit = 55
+    #: kernel-profile phase mapping (ISSUE 15): substring patterns
+    #: matched against device-op names in a jax.profiler capture,
+    #: merged OVER telemetry/profiler.py's defaults -- how the
+    #: analyzer splits a dispatch's device time into the
+    #: generate/hash/compare sub-phases.  Engines whose compiled step
+    #: carries distinctive op names (a Pallas custom-call, a
+    #: scan-looped compress) refine this per class.
+    PROFILE_PHASES: dict = {
+        "generate": ("decode_batch", "mixed_radix"),
+        "compare": ("compare_digests", "target_table", "bloom"),
+        "hash": ("digest_packed", "pack_fixed", "pack_varlen"),
+    }
 
     # -- device path -----------------------------------------------------
 
@@ -219,6 +231,12 @@ class JaxMd5Engine(JaxEngineBase):
     digest_size = 16
     digest_words = 4
     little_endian = True
+    #: the md5 compress body fuses under names carrying the jitted
+    #: scope ("md5") on TPU; the Pallas path shows as a custom-call
+    PROFILE_PHASES = {
+        **JaxEngineBase.PROFILE_PHASES,
+        "hash": ("md5",) + JaxEngineBase.PROFILE_PHASES["hash"],
+    }
 
     def digest_packed(self, blocks: jnp.ndarray,
                       lengths=None) -> jnp.ndarray:

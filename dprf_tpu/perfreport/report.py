@@ -212,6 +212,31 @@ def _memory_section(snapshot: Optional[dict]) -> Optional[dict]:
             "model_divergence": divergence}
 
 
+def _profile_section(journal) -> Optional[list]:
+    """Kernel-profile captures (ISSUE 15): the ``{"type":
+    "profile"}`` summaries the serve plane journaled when workers
+    pushed their on-demand / alert-triggered capture windows.  None
+    when the session recorded none (pre-profiling sessions, or
+    nothing ever fired)."""
+    records = (journal.profiles or []) if journal else []
+    if not records:
+        return None
+    out = []
+    for r in records:
+        s = r.get("summary") or {}
+        out.append({"worker": str(r.get("worker", "?")),
+                    "trigger": s.get("trigger"),
+                    "ts": s.get("ts"),
+                    "engine": s.get("engine"),
+                    "device_s": s.get("device_s"),
+                    "fractions": s.get("fractions"),
+                    "phases": s.get("phases"),
+                    "top_ops": (s.get("top_ops") or [])[:5],
+                    "divergence": s.get("divergence"),
+                    "error": s.get("error")})
+    return out
+
+
 def _fair_share(spans: list, journal) -> list:
     """Per-job lease share vs fair-share weight, from the lease spans
     and the journal's job records (the default job's priority is 1
@@ -292,6 +317,7 @@ def build_report(session_path: str) -> Optional[dict]:
         "fair_share": _fair_share(spans, journal),
         "health": _health_section(session_path, journal),
         "memory": _memory_section(last),
+        "profiles": _profile_section(journal),
     }
 
 
@@ -393,6 +419,28 @@ def render_report(doc: dict) -> str:
             flag = "  (>2x: MODEL DRIFT)" if div[eng] > 2 else ""
             lines.append(f"  roofline model divergence {eng}: "
                          f"{div[eng]:.2f}x{flag}")
+    profiles = doc.get("profiles") or []
+    if profiles:
+        lines.append("")
+        lines.append("kernel profile (captured windows)")
+        for p in profiles:
+            head = (f"  {p['worker']:20s} trigger "
+                    f"{p.get('trigger') or '?':12s}")
+            if p.get("error"):
+                lines.append(head + f" FAILED: {p['error']}")
+                continue
+            fr = p.get("fractions") or {}
+            head += (f" device {p.get('device_s') or 0.0:.4f}s  "
+                     f"compute {100 * fr.get('compute', 0.0):.0f}% "
+                     f"coll {100 * fr.get('collective', 0.0):.0f}% "
+                     f"copy {100 * fr.get('copy', 0.0):.0f}%")
+            d = p.get("divergence")
+            if d:
+                head += f"  divergence {d:.2f}x"
+            lines.append(head)
+            for op in (p.get("top_ops") or [])[:3]:
+                lines.append(f"      {op.get('self_s', 0.0):>9.4f}s  "
+                             f"{str(op.get('name'))[:56]}")
     fs = doc.get("fair_share") or []
     if len(fs) > 1:
         lines.append("")

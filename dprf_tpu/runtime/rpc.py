@@ -50,6 +50,7 @@ from dprf_tpu.runtime.worker import Hit
 from dprf_tpu.runtime.workunit import WorkUnit
 from dprf_tpu.telemetry import declare_job_metrics, get_registry
 from dprf_tpu.telemetry import perf as perf_mod
+from dprf_tpu.telemetry import profiler as profiler_mod
 from dprf_tpu.telemetry import programs as programs_mod
 from dprf_tpu.telemetry.alerts import AlertEngine
 from dprf_tpu.telemetry.health import HealthRegistry, heartbeat_interval
@@ -79,10 +80,41 @@ GUARDED_BY = {
     "CoordinatorState": {
         "lock": ("found", "dispatcher", "scheduler", "rejected",
                  "worker_rejects", "unit_reject_workers",
-                 "quarantined", "_pull_epoch"),
+                 "quarantined", "_pull_epoch", "_profile_requests",
+                 "_profile_summaries", "_profile_seq",
+                 "_profile_last", "_profile_inflight",
+                 "_profile_unread"),
     },
     "_CompletionSender": {"<atomic>": ("error", "stop_seen")},
 }
+
+#: kernel-profile summaries retained per worker (op_profile serves
+#: the newest first; older captures live in the session journal)
+PROFILE_SUMMARIES_PER_WORKER = 4
+
+#: a pending capture request nobody picked up (worker named wrong,
+#: dead, or never leasing) expires after this long -- the table stays
+#: bounded and a stale entry can't suppress that worker's future
+#: auto-captures forever
+PROFILE_REQUEST_TTL_S = 600.0
+
+#: a DELIVERED capture request whose summary never came back (worker
+#: died mid-capture) expires after this long; until then the serve
+#: drain loop keeps the RPC plane up so a capture racing the job's
+#: end can still land its push
+PROFILE_INFLIGHT_TTL_S = 180.0
+
+#: an UNDELIVERED request holds the serve drain only this long: its
+#: target either leases within seconds (delivery moves it to the
+#: inflight ledger) or already exited -- the full request TTL would
+#: pin a finished serve for minutes on a dead target
+PROFILE_QUEUED_DRAIN_S = 30.0
+
+#: a landed-but-unread summary holds the serve drain this long: the
+#: requester polls op_profile every ~0.5 s, so without this grace the
+#: drain could break between the worker's push and the poller's next
+#: read and the CLI would hit a closed socket instead of its summary
+PROFILE_READ_GRACE_S = 10.0
 
 #: resource-ownership declarations (`dprf check` threads analyzer):
 #: every socket/stream attribute acquired outside a ``with`` names
@@ -225,6 +257,28 @@ class CoordinatorState:
         #: fired by health_tick UNDER the lock so the journal writes
         #: serialize with the hit/progress writers
         self.on_worker_health: Optional[Callable] = None
+        #: kernel-profiling plane (ISSUE 15): pending capture
+        #: requests per worker (delivered on the next lease/heartbeat
+        #: response), the sanitized summaries workers pushed back,
+        #: and the auto-capture cooldown ledger
+        self._profile_requests: dict = {}
+        self._profile_summaries: dict = {}
+        self._profile_seq = 0
+        self._profile_last: dict = {}
+        #: delivered-but-unanswered capture requests ({id: delivered
+        #: monotonic ts}): serve's drain loop waits on these so a
+        #: capture racing job-end can land; TTL-expired by the prune
+        self._profile_inflight: dict = {}
+        #: per-worker monotonic ts of a summary push nobody has read
+        #: yet: holds the serve drain for a short grace so the
+        #: requester's next poll can collect it (cleared only for the
+        #: workers a read actually shipped -- a filtered poll for
+        #: worker A must not drop worker B's grace)
+        self._profile_unread: dict = {}
+        #: (worker, summary) hook: cmd_serve journals each pushed
+        #: capture as a {"type": "profile"} record; fired UNDER the
+        #: lock like the other journaling hooks
+        self.on_profile: Optional[Callable] = None
         m = self.registry
         #: verify-phase attribution (telemetry/perf.py): the oracle
         #: re-hash cost of every hit batch, labeled per job
@@ -284,7 +338,112 @@ class CoordinatorState:
             if self.on_worker_health:
                 for tr in transitions:
                     self.on_worker_health(tr)
-        self.alerts.evaluate()
+        events = self.alerts.evaluate()
+        # alert-triggered kernel profiling (ISSUE 15): a straggler or
+        # stalled-job alert FIRING requests one bounded capture window
+        # on the implicated worker, cooldown-rate-limited
+        self._maybe_autoprofile(events)
+
+    def _maybe_autoprofile(self, events: list) -> None:
+        """Queue a capture request for each newly-firing straggler /
+        job_stalled alert (``DPRF_AUTOPROFILE``): the straggler rule
+        names its worker in the labels; a stalled job implicates the
+        fleet's slowest live worker.  One request per cooldown window
+        (``DPRF_PROFILE_COOLDOWN_S``, global AND per worker) -- a
+        flapping fleet must not spend its cycles profiling itself."""
+        if not profiler_mod.autoprofile_enabled():
+            return
+        fired = [e for e in events
+                 if e.get("state") == "firing"
+                 and e.get("rule") in ("straggler", "job_stalled")]
+        if not fired:
+            return
+        cooldown = profiler_mod.cooldown_s()
+        now = time.monotonic()
+        from dprf_tpu.utils.logging import DEFAULT as log
+        # resolved OUTSIDE self.lock: slowest_worker takes the health
+        # registry's own lock, and health_tick's contract is that the
+        # two are acquired sequentially, never nested
+        slowest = (self.health.slowest_worker()
+                   if any("worker" not in (e.get("labels") or {})
+                          for e in fired) else None)
+        with self.lock:
+            self._prune_profile_requests(now)
+            for e in fired:
+                worker = (e.get("labels") or {}).get("worker")
+                if worker is None:
+                    worker = slowest
+                if worker is None or worker in self._profile_requests:
+                    continue
+                if len(self._profile_requests) >= self.MAX_WORKER_LABELS:
+                    break       # table bound; entries expire by TTL
+                last = max((self._profile_last.get("_global", 0.0),
+                            self._profile_last.get(str(worker), 0.0)))
+                if last and now - last < cooldown:
+                    continue
+                self._profile_seq += 1
+                self._profile_requests[str(worker)] = {
+                    "id": self._profile_seq,
+                    "seconds": profiler_mod.default_window_s(),
+                    "trigger": str(e.get("rule")),
+                    "queued_at": now}
+                self._profile_last["_global"] = now
+                self._profile_last[str(worker)] = now
+                log.info("auto-capture requested", worker=worker,
+                         rule=e.get("rule"))
+
+    def _prune_profile_requests(self, now: float) -> None:
+        """Expire pending capture requests nobody picked up inside
+        the TTL (dead / misnamed / never-leasing workers) and
+        delivered requests whose summary never came back: keeps the
+        client-fed tables bounded, unsticks auto-capture, and
+        unblocks the serve drain loop."""
+        stale = [w for w, r in self._profile_requests.items()
+                 if now - r.get("queued_at", now)
+                 > PROFILE_REQUEST_TTL_S]
+        for w in stale:
+            del self._profile_requests[w]
+        dead = [rid for rid, ts in self._profile_inflight.items()
+                if now - ts > PROFILE_INFLIGHT_TTL_S]
+        for rid in dead:
+            del self._profile_inflight[rid]
+        unread = [w for w, ts in self._profile_unread.items()
+                  if now - ts > PROFILE_READ_GRACE_S]
+        for w in unread:
+            del self._profile_unread[w]
+    _prune_profile_requests._holds_lock = "lock"
+
+    def _profile_request_for(self, wid: str) -> Optional[dict]:
+        """Pop the pending capture request riding out on this
+        worker's next lease/heartbeat response (None for most)."""
+        if not self._profile_requests:
+            return None
+        req = self._profile_requests.pop(wid, None)
+        if req is None:
+            return None
+        self._profile_inflight[req["id"]] = time.monotonic()
+        req = dict(req)
+        req.pop("queued_at", None)    # coordinator-clock bookkeeping
+        return req
+    _profile_request_for._holds_lock = "lock"
+
+    def profile_pending(self) -> bool:
+        """True while a capture request is delivered but unanswered
+        (inside its TTL), or queued and young enough that delivery is
+        still plausible: the serve drain loop keeps the RPC plane up
+        for these, so a capture racing the job's last units can still
+        land its summary."""
+        with self.lock:
+            now = time.monotonic()
+            self._prune_profile_requests(now)
+            if self._profile_inflight:
+                return True
+            if any(now - ts < PROFILE_READ_GRACE_S
+                   for ts in self._profile_unread.values()):
+                return True
+            return any(now - r.get("queued_at", now)
+                       < PROFILE_QUEUED_DRAIN_S
+                       for r in self._profile_requests.values())
 
     def refresh_found_gauge(self) -> None:
         """Re-sync dprf_targets_found/_total after out-of-band
@@ -358,6 +517,10 @@ class CoordinatorState:
             if wid in self.quarantined:
                 return {"unit": None, "stop": False,
                         "quarantined": True, "pull": pull}
+            # pending kernel-profile request rides the lease response
+            # (ISSUE 15); one dict probe for the common no-request
+            # case, so the lease path pays nothing when idle
+            prof_req = self._profile_request_for(wid)
             try:
                 ahead = int(msg.get("ahead", 1))
             except (TypeError, ValueError):
@@ -384,9 +547,12 @@ class CoordinatorState:
                 # nothing leasable right now; workers retry unless NO
                 # non-terminal job could ever lease again (a paused
                 # job keeps the fleet polling for its resume)
-                return {"unit": None,
+                resp = {"unit": None,
                         "stop": self.scheduler.idle_stop(),
                         "pull": pull}
+                if prof_req is not None:
+                    resp["profile"] = prof_req
+                return resp
             # liveness gauge only for ids that actually HOLD a lease:
             # worker_id is client-controlled, and a label child lives
             # forever, so polls with throwaway ids must not grow the
@@ -406,6 +572,8 @@ class CoordinatorState:
                     e["trace"] = {"trace": ctx[0], "span": ctx[1]}
                 entries.append(e)
             resp = {"unit": entries[0], "units": entries, "pull": pull}
+            if prof_req is not None:
+                resp["profile"] = prof_req
             if "trace" in entries[0]:
                 # legacy single-unit clients read a top-level context
                 resp["trace"] = entries[0]["trace"]
@@ -635,6 +803,122 @@ class CoordinatorState:
                         "observe_headroom", None)
                     if observe is not None:
                         observe(wid, frac)
+        # a pending capture request also rides the heartbeat response
+        # (ISSUE 15): an idle worker beats, never leases -- it must
+        # still be profilable
+        with self.lock:
+            prof_req = self._profile_request_for(wid)
+        resp = {"ok": True}
+        if prof_req is not None:
+            resp["profile"] = prof_req
+        return resp
+
+    # -- kernel-profiling plane (ISSUE 15) ---------------------------------
+
+    def op_profile(self, msg: dict) -> dict:
+        """``dprf profile --connect``: request one bounded capture
+        window on a worker (``action: "request"``; the request rides
+        that worker's next lease/heartbeat response, the raw trace
+        stays on the worker host) and read back the sanitized
+        summaries workers pushed (the default action)."""
+        if msg.get("action") == "request":
+            worker = msg.get("worker")
+            seconds = msg.get("seconds")
+            if not (isinstance(seconds, (int, float))
+                    and not isinstance(seconds, bool) and seconds > 0):
+                seconds = profiler_mod.default_window_s()
+            if worker is None:
+                # no target named: the slowest live worker is the one
+                # an operator profiling a misbehaving fleet wants
+                worker = self.health.slowest_worker()
+                if worker is None:
+                    states = self.health.states()
+                    live = [w for w, s in states.items()
+                            if s in ("healthy", "degraded")]
+                    worker = live[0] if live else None
+            if worker is None:
+                return {"error": "no live worker to profile (name "
+                        "one with worker=)"}
+            with self.lock:
+                self._prune_profile_requests(time.monotonic())
+                existing = self._profile_requests.get(str(worker))
+                if existing is not None:
+                    # a request for this worker is already queued:
+                    # share its id instead of orphaning it (the
+                    # earlier requester's poll would never resolve)
+                    return {"ok": True, "request_id": existing["id"],
+                            "worker": str(worker), "pending": True}
+                if (len(self._profile_requests)
+                        >= self.MAX_WORKER_LABELS):
+                    # worker names are client-controlled: bound the
+                    # pending table like the summary/label tables
+                    return {"error": "too many pending capture "
+                            "requests; wait for deliveries or the "
+                            "TTL"}
+                self._profile_seq += 1
+                rid = self._profile_seq
+                self._profile_requests[str(worker)] = {
+                    "id": rid, "seconds": float(seconds),
+                    "trigger": "manual",
+                    "queued_at": time.monotonic()}
+            return {"ok": True, "request_id": rid,
+                    "worker": str(worker)}
+        want = msg.get("worker")
+        with self.lock:
+            # a poller waiting on ONE request names its worker: ship
+            # that bucket alone, not the whole fleet's table (1024
+            # workers x 4 summaries x 20 ops, every 0.5 s poll)
+            summaries = {w: list(s) for w, s in
+                         self._profile_summaries.items()
+                         if want is None or w == str(want)}
+            for w in summaries:           # read happened: drop grace
+                self._profile_unread.pop(w, None)
+            # queued_at is coordinator-local monotonic bookkeeping,
+            # meaningless on any other host: never on the wire
+            pending = {w: {k: v for k, v in r.items()
+                           if k != "queued_at"}
+                       for w, r in self._profile_requests.items()}
+        return {"ok": True, "summaries": summaries,
+                "pending": pending, "now": time.time()}
+
+    def op_profile_push(self, msg: dict) -> dict:
+        """A worker shipping its finished capture window's summary:
+        sanitized + bounded exactly like spans and heartbeat
+        payloads (client-controlled), stored newest-first per worker,
+        and journaled as a ``{"type": "profile"}`` record via the
+        cmd_serve hook."""
+        raw = msg.get("worker_id")
+        if raw is None:
+            return {"ok": False}
+        wid = str(raw)
+        summary = profiler_mod.sanitize_summary(msg.get("summary"))
+        if summary is None:
+            return {"ok": False}
+        self.health.observe(wid)
+        with self.lock:
+            rid = summary.get("request_id")
+            if rid is not None:
+                self._profile_inflight.pop(rid, None)
+            self._profile_unread[wid] = time.monotonic()
+            bucket = self._profile_summaries.setdefault(wid, [])
+            bucket.insert(0, summary)
+            del bucket[PROFILE_SUMMARIES_PER_WORKER:]
+            if len(self._profile_summaries) > self.MAX_WORKER_LABELS:
+                # ids are client-controlled; drop the oldest worker's
+                # bucket rather than growing without bound
+                oldest = min(
+                    self._profile_summaries,
+                    key=lambda w: self._profile_summaries[w][0].get(
+                        "ts") or 0)
+                if oldest != wid:
+                    self._profile_summaries.pop(oldest, None)
+            if self.on_profile:
+                self.on_profile(wid, summary)
+        from dprf_tpu.utils.logging import DEFAULT as log
+        log.info("kernel profile received", worker=wid,
+                 trigger=summary.get("trigger"),
+                 device_s=summary.get("device_s"),
+                 error=summary.get("error"))
         return {"ok": True}
 
     def op_programs(self, msg: dict) -> dict:
@@ -709,6 +993,9 @@ class CoordinatorState:
         # heartbeat payloads, so a CPU-only fleet simply shows none
         mem = self.health.mem_by_worker()
         hbm = self.health.hbm_totals()
+        # last-capture-per-worker fallback from heartbeat payloads
+        # (env-local captures that never pushed a summary)
+        prof_hb = self.health.profile_by_worker()
         with self.lock:
             done, total = self.scheduler.progress()
             leases = []
@@ -740,6 +1027,17 @@ class CoordinatorState:
                       # dprf top MEM column and HBM header field)
                       "mem": mem,
                       "hbm": hbm,
+                      # last kernel capture per worker (ISSUE 15):
+                      # the dprf top PROF column reads age + trigger;
+                      # pushed summaries win over the heartbeat
+                      # payload's self-reported captures -- but an
+                      # in-band ERROR push carries no ts, and must
+                      # not blank a worker's known last-capture age
+                      "profiles": {**prof_hb, **{
+                          w: {"ts": b[0].get("ts"),
+                              "trigger": b[0].get("trigger")}
+                          for w, b in self._profile_summaries.items()
+                          if b and b[0].get("ts") is not None}},
                       "quarantined": sorted(self.quarantined)}
         return {"ok": True, "spans": spans, "leases": leases,
                 "status": status, "cursor": cursor, "resync": resync}
@@ -1224,9 +1522,13 @@ class CoordinatorServer:
         """Run until the job finishes, then keep serving until every
         outstanding lease resolves (workers mid-unit must be able to
         report their final hits and see the stop flag -- a fixed grace
-        window would race against unit processing time).  `drain` caps
-        the wait so a worker that died holding a lease can't pin the
-        server forever."""
+        window would race against unit processing time) AND every
+        in-flight kernel-profile capture lands or expires (a capture
+        racing the job's last units stops + analyzes on the worker
+        for seconds after the final complete; vanishing now would
+        lose its push).  `drain` caps the wait so a worker that died
+        holding a lease can't pin the server forever; dead captures
+        expire on their own PROFILE_INFLIGHT_TTL_S."""
         t = threading.Thread(target=self._srv.serve_forever,
                              kwargs={"poll_interval": 0.1}, daemon=True)
         t.start()
@@ -1242,7 +1544,8 @@ class CoordinatorServer:
                     self.state.scheduler.reap_expired()
                     outstanding = \
                         self.state.scheduler.total_outstanding()
-                if outstanding == 0:
+                if outstanding == 0 \
+                        and not self.state.profile_pending():
                     break
                 time.sleep(poll)
             time.sleep(poll)   # let final responses flush
@@ -1480,6 +1783,50 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
     # unit runs the serial synced probe; its phase spans ship back
     # with the complete report like any other worker span
     sampler = perf_mod.PerfSampler(registry=m, recorder=tracer)
+    # kernel-profiling plane (ISSUE 15): on-demand bounded capture
+    # windows requested over lease/heartbeat responses.  The loop
+    # keeps sweeping while the trace records; poll_profile() is ONE
+    # attribute read when no window is active -- the zero-overhead
+    # contract for the steady-state path.
+    prof = profiler_mod.get_profiler()
+    swept = [0]      # cumulative resolved candidates (window counter)
+
+    def push_profile(summary: dict) -> None:
+        # best-effort on the MAIN connection, like trace_push: a
+        # dead link surfaces on the next lease anyway
+        try:
+            client.call("profile_push", worker_id=worker_id,
+                        summary=summary)
+        except Exception:   # noqa: BLE001 -- diagnostics only
+            pass
+
+    def begin_profile(req) -> None:
+        if not isinstance(req, dict):
+            return
+        seconds = req.get("seconds")
+        ok = prof.begin_window(
+            seconds if isinstance(seconds, (int, float))
+            and not isinstance(seconds, bool) else None,
+            trigger=str(req.get("trigger") or "manual"),
+            engine=_labels_of(worker)[0],
+            request_id=req.get("id"),
+            counter_fn=lambda: swept[0], log=log)
+        if not ok:
+            # single-flight collision (--profile / DPRF_JAX_PROFILE
+            # already tracing): report it in-band, not silently
+            push_profile({"schema": profiler_mod.SUMMARY_SCHEMA,
+                          "request_id": req.get("id"),
+                          "trigger": str(req.get("trigger")
+                                         or "manual"),
+                          "engine": _labels_of(worker)[0],
+                          "error": "capture busy "
+                          f"(active: {prof.busy()})"})
+
+    def poll_profile() -> None:
+        s = prof.poll()
+        if s is not None:
+            push_profile(s)
+
     adaptive = None
     if depth is None:
         adaptive = AdaptiveDepth(pipeline_depth())
@@ -1542,6 +1889,13 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                    "queue": len(pipe),
                    "rate_hs": rate_ewma,
                    "error": err}
+        # last kernel capture on THIS host (ISSUE 15): age + trigger
+        # ride the beat so `dprf top` can show them per worker even
+        # for env-local captures that never pushed a summary
+        last_prof = prof.last_summary()
+        if last_prof is not None:
+            payload["profile_ts"] = last_prof.get("ts")
+            payload["profile_trigger"] = last_prof.get("trigger")
         # device introspection rides the beat (ISSUE 13): HBM totals
         # in the payload (fleet memory headroom on the coordinator's
         # health plane) and the program records analyzed since the
@@ -1561,11 +1915,14 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
         records, newest = programs_mod.get_programs().records_since(
             prog_seq[0])
         try:
-            client.call("heartbeat", worker_id=worker_id,
-                        payload=payload, programs=records)
+            resp = client.call("heartbeat", worker_id=worker_id,
+                               payload=payload, programs=records)
             prog_seq[0] = newest
         except Exception:   # noqa: BLE001 -- best-effort beacon; a
-            pass            # dead link surfaces on the next lease
+            return          # dead link surfaces on the next lease
+        # an idle worker never leases: capture requests must be able
+        # to ride the heartbeat response too
+        begin_profile(resp.get("profile"))
 
     def _worker_of(job_id):
         if worker_for is None or job_id is None:
@@ -1642,6 +1999,7 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                     if isinstance(pull, int) and pull > pull_seen:
                         pull_seen = pull
                         push_ring()
+                    begin_profile(resp.get("profile"))
                     entries = resp.get("units")
                     if entries is None:
                         # pre-lease-ahead coordinator: single unit with
@@ -1670,6 +2028,7 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                             # this is exactly when the coordinator
                             # would otherwise go blind on us
                             maybe_heartbeat()
+                            poll_profile()
                             time.sleep(idle_sleep)
                             continue
                     first = True
@@ -1764,6 +2123,7 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                     (tid, lease_sid, ship, job, w) = cur
                 hits = pending.resolve()
                 cur = None
+                swept[0] += unit.length
                 now = time.monotonic()
                 unit_s = now - t_submit
                 # steady-state per-unit cost for the ADAPTIVE SIZER:
@@ -1793,6 +2153,9 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                 # a long sweep keeps the main connection quiet for its
                 # whole duration: beat here if it starved the cadence
                 maybe_heartbeat()
+                # an elapsed capture window stops + analyzes + ships
+                # here (one attribute read when no window is active)
+                poll_profile()
                 # the histogram gets the same per-unit cost: observing
                 # unit_s here would inflate dprf_unit_seconds ~depth x
                 # under pipelining with no throughput change
@@ -1872,5 +2235,23 @@ def worker_loop(client: CoordinatorClient, worker, worker_id: str,
                 pass
         raise
     finally:
+        # a capture window still in flight on a CLEAN stop gets a
+        # bounded grace to finish + push, and summaries that already
+        # finished but were never drained (the background analysis
+        # landed between the last poll and the stop) ship too: the
+        # job's last unit landing mid-window would otherwise kill
+        # the capture silently and the requester waits out its full
+        # --wait.  Error exits skip the grace (the connection is
+        # gone; a push can't land).  finish_now with nothing in
+        # flight is one lock probe, so the idle exit pays nothing.
+        if stop_seen:
+            for _ in range(profiler_mod.HISTORY_MAX):
+                s = prof.finish_now()
+                if s is None:
+                    break
+                push_profile(s)
+        # whatever remains must not outlive the loop (the profiler
+        # slot would stay taken for the process lifetime)
+        prof.abort_window()
         if sender is not None:
             sender.close()

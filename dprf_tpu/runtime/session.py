@@ -57,6 +57,10 @@ class SessionState:
     #: worker_health transition records (ISSUE 10), in journal order:
     #: post-mortem material for `dprf report`, never resume state
     health_events: list = dataclasses.field(default_factory=list)
+    #: kernel-profile capture summaries (ISSUE 15), in journal order:
+    #: {"worker", "summary"} -- the `dprf report` kernel-profile
+    #: section's input, never resume state
+    profiles: list = dataclasses.field(default_factory=list)
 
 
 #: `dprf check` threads analyzer: the journal stream is owned by the
@@ -182,6 +186,15 @@ class SessionJournal:
             obj["age_s"] = age_s
         self._emit(obj)
 
+    def record_profile(self, worker: str, summary: dict) -> None:
+        """Journal one kernel-profile capture summary (ISSUE 15: the
+        sanitized result a worker pushed after an on-demand or
+        alert-triggered window).  Diagnostics only -- `dprf report`
+        renders these; load() never replays them into resume
+        state."""
+        self._emit({"type": "profile", "worker": worker,
+                    "summary": summary})
+
     def record_job_gc(self, job_id: str) -> None:
         """Journal an age-based job reap (DPRF_JOB_TTL_S): a restart
         must not resurrect a job the GC already dropped -- load()
@@ -213,6 +226,7 @@ class SessionJournal:
         spec, completed, hits, tuning = {}, [], [], {}
         jobs: dict = {}
         health_events: list = []
+        profiles: list = []
         # new sessions tag EVERY units/hit line (ISSUE 10); lines
         # tagged with the header's default job id fold back into the
         # flat fields, exactly where untagged (pre-tagging) lines of
@@ -254,6 +268,9 @@ class SessionJournal:
                         job_rec(str(jid))["hits"].append(obj)
                 elif t == "worker_health":
                     health_events.append(obj)
+                elif t == "profile":
+                    if isinstance(obj.get("summary"), dict):
+                        profiles.append(obj)
                 elif t == "job":
                     try:
                         r = job_rec(str(obj["id"]))
@@ -282,7 +299,8 @@ class SessionJournal:
                         continue    # malformed tune line: ignore
         return SessionState(spec=spec, completed=completed, hits=hits,
                             tuning=tuning, jobs=jobs,
-                            health_events=health_events)
+                            health_events=health_events,
+                            profiles=profiles)
 
 
 def job_fingerprint(engine: str, attack: str, keyspace: int,

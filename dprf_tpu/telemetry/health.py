@@ -80,7 +80,7 @@ RATE_ALPHA = 0.3
 #: without a second RPC.
 PAYLOAD_KEYS = ("engine", "device", "chips", "depth", "queue",
                 "rate_hs", "error", "hbm_in_use", "hbm_limit",
-                "hbm_peak")
+                "hbm_peak", "profile_ts", "profile_trigger")
 MAX_PAYLOAD_STR = 200
 
 #: lock-discipline declaration (`dprf check` locks analyzer): observe
@@ -310,6 +310,34 @@ class HealthRegistry:
         with self._lock:
             return {w.worker: w.as_dict(now)
                     for w in self._workers.values()}
+
+    def slowest_worker(self) -> Optional[str]:
+        """The live (healthy/degraded) worker with the lowest
+        throughput EWMA -- who a stalled-job alert implicates when no
+        label names a worker (the auto-capture target)."""
+        with self._lock:
+            live = [w for w in self._workers.values()
+                    if w.state <= DEGRADED and w.rate_hs is not None
+                    and w.worker != "_overflow"]
+            if not live:
+                return None
+            return min(live, key=lambda w: w.rate_hs).worker
+
+    def profile_by_worker(self) -> dict:
+        """{worker: {"ts", "trigger"}} from the heartbeat payloads
+        (ISSUE 15): each worker's last kernel capture, including
+        env-local ones that never pushed a summary -- the fallback
+        half of the ``dprf top`` PROF column."""
+        with self._lock:
+            out = {}
+            for w in self._workers.values():
+                ts = w.payload.get("profile_ts")
+                if isinstance(ts, (int, float)) and not isinstance(
+                        ts, bool):
+                    out[w.worker] = {
+                        "ts": ts,
+                        "trigger": w.payload.get("profile_trigger")}
+            return out
 
     def mem_by_worker(self) -> dict:
         """{worker: hbm bytes in use} from the heartbeat payloads

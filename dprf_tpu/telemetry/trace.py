@@ -69,8 +69,6 @@ ENABLE_ENV = "DPRF_TRACE"
 #: size cap for the trace JSONL stream (rotated to `.1` when exceeded)
 MAX_BYTES_ENV = "DPRF_TRACE_MAX_BYTES"
 DEFAULT_MAX_BYTES = 16 << 20
-#: opt-in: wrap sweep loops in a jax.profiler trace written here
-PROFILE_ENV = "DPRF_JAX_PROFILE"
 
 #: span-id namespace: a per-process random prefix + a cheap counter --
 #: unique across the fleet without paying a uuid4 per span
@@ -828,11 +826,16 @@ def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
     workers.sort(key=lambda w: (
         str((by_worker.get(w) or {}).get("job", "~")), w))
     mem = status.get("mem") or {}
+    # kernel-profiling plane (ISSUE 15): last capture per worker --
+    # the coordinator's pushed-summary table, with the heartbeat
+    # payload's profile_ts/profile_trigger as the fallback for
+    # env-local captures that never pushed
+    profiles = status.get("profiles") or {}
     lines.append("")
     lines.append(f"{'WORKER':20s} {'JOB':>5s} {'STATE':10s} "
                  f"{'UNIT':>8s} {'RANGE':>24s} {'LEASE':>8s} "
                  f"{'BUSY':>5s} {'MEM':>6s} {'HEALTH':>8s} "
-                 f"{'LAST SPAN':>10s}")
+                 f"{'PROF':>14s} {'LAST SPAN':>10s}")
     # ages against the COORDINATOR's clock (shipped in status): the
     # spans carry its wall time, and the viewer's clock may be skewed
     now = status.get("now") or time.time()
@@ -851,12 +854,18 @@ def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
         b_s = f"{100.0 * b:.0f}%" if b is not None else "-"
         hw = str(health.get(w) or "-")[:8]
         m_s = _fmt_bytes(mem.get(w))
+        p = profiles.get(w)
+        p_ts, p_trig = ((p.get("ts"), p.get("trigger"))
+                        if isinstance(p, dict) else (None, None))
+        prof = (f"{_fmt_age(max(0.0, now - p_ts))}/"
+                f"{str(p_trig or '?')[:8]}"
+                if isinstance(p_ts, (int, float)) else "-")
         age = (_fmt_age(max(0.0, now - (s.get("ts", now)
                                         + s.get("dur", 0.0))))
                if s else "-")
         lines.append(f"{w[:20]:20s} {jid[:5]:>5s} {state:10s} "
                      f"{unit:>8s} {rng:>24s} {dl:>8s} {b_s:>5s} "
-                     f"{m_s:>6s} {hw:>8s} {age:>10s}")
+                     f"{m_s:>6s} {hw:>8s} {prof:>14s} {age:>10s}")
     lines.append("")
     lines.append("recent spans:")
     for s in spans[-8:]:
@@ -870,44 +879,11 @@ def render_top(resp: dict, prev: Optional[tuple] = None) -> str:
 # ---------------------------------------------------------------------------
 # opt-in jax.profiler wrapping of sweep loops
 
-class _SafeProfile:
-    """Context manager around jax.profiler.trace that degrades to a
-    no-op (with a logged warning) instead of killing the job when the
-    profiler cannot start -- e.g. a trace is already active because the
-    run was also launched with ``--profile``."""
-
-    def __init__(self, directory: str, log=None):
-        self._dir = directory
-        self._log = log
-        self._cm = None
-
-    def __enter__(self):
-        try:
-            import jax
-            self._cm = jax.profiler.trace(self._dir)
-            self._cm.__enter__()
-        except Exception as e:   # noqa: BLE001 -- diagnostics only
-            self._cm = None
-            if self._log is not None:
-                self._log.warn("DPRF_JAX_PROFILE trace failed to start",
-                               dir=self._dir, error=str(e))
-        return self
-
-    def __exit__(self, *exc):
-        if self._cm is not None:
-            try:
-                self._cm.__exit__(*exc)
-            except Exception:    # noqa: BLE001
-                pass
-        return False
-
-
 def jax_profile_ctx(log=None):
     """``DPRF_JAX_PROFILE=<dir>``: a jax.profiler trace context for a
-    sweep loop (kernel-level drill-down next to the span timeline);
-    a null context when unset."""
-    import contextlib
-    d = envreg.get_path(PROFILE_ENV)
-    if not d:
-        return contextlib.nullcontext()
-    return _SafeProfile(d, log=log)
+    sweep loop, now owned by telemetry/profiler.py's single-flight
+    ProfileCapture (jax allows ONE active trace; the ``--profile``
+    flag and on-demand capture windows share the same slot).  Kept
+    here as a re-export for the loop call sites."""
+    from dprf_tpu.telemetry import profiler as profiler_mod
+    return profiler_mod.jax_profile_ctx(log=log)

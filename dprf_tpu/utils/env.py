@@ -193,7 +193,37 @@ _declare("DPRF_PERF_SAMPLE", 16, "int",
 _declare("DPRF_JAX_PROFILE", None, "path",
          "Write a jax.profiler trace of the sweep loops to this "
          "directory (kernel-level drill-down beside the span "
-         "timeline).")
+         "timeline; routed through telemetry/profiler.py's "
+         "single-flight capture guard).")
+_declare("DPRF_AUTOPROFILE", True, "bool",
+         "Alert-triggered kernel profiling: when a straggler or "
+         "job_stalled alert FIRES, the coordinator's health tick "
+         "requests one bounded jax.profiler capture window on the "
+         "implicated worker (telemetry/profiler.py), rate-limited by "
+         "DPRF_PROFILE_COOLDOWN_S; 0 disables auto-capture (manual "
+         "`dprf profile --connect` still works).")
+_declare("DPRF_PROFILE_COOLDOWN_S", 600.0, "float",
+         "Minimum seconds between alert-triggered profile captures "
+         "(global and per worker): a flapping fleet must not spend "
+         "its cycles profiling itself.")
+_declare("DPRF_PROFILE_SECONDS", 3.0, "float",
+         "Default capture-window length for on-demand kernel "
+         "profiles (`dprf profile --connect`, alert-triggered "
+         "auto-capture): the worker keeps sweeping while the "
+         "jax.profiler trace records, then stops and analyzes.")
+_declare("DPRF_PROFILE_KEEP", 4, "int",
+         "Capture dirs retained per profile root (oldest deleted "
+         "first): bounded disk for repeated on-demand captures; 0 "
+         "disables the reaper.")
+_declare("DPRF_PROFILE_MAX_BYTES", 64 << 20, "int",
+         "Per-capture raw-artifact size cap: a capture whose "
+         "directory exceeds this drops its .xplane.pb bulk (the "
+         "analyzed perfetto JSON is kept); 0 disables the cap.")
+_declare("DPRF_PROFILE_DIR", None, "path",
+         "Where a remote worker writes its on-demand capture dirs "
+         "(raw traces stay on the worker host; the summary names "
+         "the path).  Default: a per-process dir under the system "
+         "temp root.")
 _declare("DPRF_TELEMETRY_INTERVAL", 30.0, "float",
          "Seconds between telemetry snapshot lines.")
 _declare("DPRF_TELEMETRY_MAX_BYTES", 16 << 20, "int",

@@ -546,6 +546,43 @@ def test_metrics_undeclared_span_caught(tmp_path):
     assert "span 'bogus' not declared" in f[0].message
 
 
+def test_metrics_profiler_call_outside_owner_caught(tmp_path):
+    """Rule 4 (ISSUE 15): jax.profiler trace calls outside
+    telemetry/profiler.py are findings -- jax allows ONE active
+    trace, so every starter must share ProfileCapture's slot."""
+    root = make_repo(tmp_path, {
+        "dprf_tpu/telemetry/profiler.py": """\
+            def owner(directory):
+                import jax
+                jax.profiler.start_trace(directory)
+                jax.profiler.stop_trace()
+""",
+        "dprf_tpu/rogue.py": """\
+            def rogue(directory):
+                import jax
+                jax.profiler.start_trace(directory)
+                with jax.profiler.trace(directory):
+                    pass
+                jax.profiler.stop_trace()
+"""})
+    f = bad(check(root, "metrics"))
+    assert len(f) == 3
+    assert all(x.path.endswith("rogue.py") for x in f)
+    assert {x.line for x in f} == {3, 4, 6}
+
+
+def test_metrics_profiler_unrelated_trace_calls_clean(tmp_path):
+    """A clean twin: ``.trace(`` on anything NOT named profiler (span
+    recorders, loggers) never matches rule 4."""
+    root = make_repo(tmp_path, {
+        "dprf_tpu/spans.py": """\
+            def fine(recorder, directory):
+                with recorder.trace(directory):
+                    pass
+"""})
+    assert bad(check(root, "metrics")) == []
+
+
 def test_worker_contract_violations_caught(tmp_path):
     root = make_repo(tmp_path, {
         "dprf_tpu/w.py": """\
