@@ -488,6 +488,11 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--write-env-docs", action="store_true",
                    help="regenerate the README env-knob table from "
                    "the utils/env.py registry, then run the checks")
+    p.add_argument("--fix-skeletons", action="store_true",
+                   help="after the checks run, print GUARDED_BY / "
+                   "RELEASES declaration skeletons for undeclared "
+                   "lock owners and the threads findings' undeclared "
+                   "resources (paste-ready; nothing written to disk)")
     args = p.parse_args(argv)
 
     if args.list:
@@ -527,6 +532,15 @@ def main(argv: Optional[list] = None) -> int:
     except ValueError as e:
         print(f"dprf check: {e}", file=sys.stderr)
         return 2
+
+    if args.fix_skeletons:
+        from dprf_tpu.analysis import skeletons
+        text = skeletons.render(ctx, findings)
+        if text:
+            print(text)
+        else:
+            print("fix-skeletons: every lock owner and acquired "
+                  "resource is already declared", file=sys.stderr)
 
     bad = unsuppressed(findings)
     shown = findings if args.show_suppressed else bad

@@ -1,0 +1,233 @@
+"""``dprf check --fix-skeletons``: declaration skeletons for the
+locks/threads analyzers' tables.
+
+The locks analyzer verifies the GUARDED_BY tables a module DECLARES
+but stays silent about lock-owning classes that never declared one --
+a new class with a ``threading.Lock()`` in ``__init__`` (the
+TargetStore ingest layer was the motivating case) silently opts out
+of the race detector.  The threads analyzer does raise a finding for
+undeclared acquired resources, but leaves writing the table to the
+reader.  This emitter closes both gaps mechanically:
+
+* **GUARDED_BY skeletons** -- its own scan: every class assigning a
+  ``threading.Lock`` / ``RLock`` / ``Condition`` to an attribute in
+  ``__init__`` while no module-level GUARDED_BY entry names the class.
+  The guarded-attr tuple is pre-filled with the attributes the class
+  actually assigns under ``with self.<lock>:`` blocks (the analyzer's
+  own evidence of intent), or left empty with a TODO marker.
+
+* **RELEASES skeletons** -- parsed from the threads findings of the
+  run that just completed (the ``... holds an acquired resource but
+  is not declared in a module-level RELEASES table`` message), with
+  the releaser slot pre-filled when the class has an obvious
+  shutdown-shaped method.
+
+Output is paste-ready source grouped per module, on stdout; nothing
+is written to disk -- the declarations belong next to the class, and
+deciding WHAT a lock guards is still the author's job.  The emitted
+skeleton makes the class visible to the analyzers, which then verify
+the actual discipline.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Optional
+
+#: threading constructors whose product is a guard the locks analyzer
+#: can track (mirrors analysis/locks.py's notion of a lock attr)
+LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+#: method names that look like a class's shutdown path -- the
+#: pre-filled releaser suggestion for RELEASES skeletons
+RELEASER_HINTS = ("close", "shutdown", "stop", "server_close",
+                  "terminate", "__exit__")
+
+_RELEASES_FINDING = re.compile(
+    r"^(\w+)\.(\w+) holds an acquired resource but is not declared "
+    r"in a module-level RELEASES table")
+
+
+def _ctor_name(call: ast.AST) -> Optional[str]:
+    """'Lock' for ``threading.Lock()`` / ``Lock()`` style calls."""
+    if not isinstance(call, ast.Call):
+        return None
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        name = fn.attr
+    elif isinstance(fn, ast.Name):
+        name = fn.id
+    else:
+        return None
+    return name if name in LOCK_CTORS else None
+
+
+def _self_attr(target: ast.AST) -> Optional[str]:
+    if (isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"):
+        return target.attr
+    return None
+
+
+def _declared_classes(tree: ast.AST) -> set:
+    """Class names any module-level GUARDED_BY literal already
+    covers (malformed literals are the locks analyzer's problem)."""
+    out: set = set()
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "GUARDED_BY"
+                        for t in node.targets)):
+            continue
+        try:
+            spec = ast.literal_eval(node.value)
+        except (ValueError, SyntaxError):
+            continue
+        if isinstance(spec, dict):
+            out.update(k for k in spec if isinstance(k, str))
+    return out
+
+
+def _init_locks(cls: ast.ClassDef) -> list:
+    """[(attr, line)] for every lock-like ctor assigned to a self
+    attribute in ``__init__``."""
+    out = []
+    for node in cls.body:
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name == "__init__"):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            if _ctor_name(sub.value) is None:
+                continue
+            for t in sub.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    out.append((attr, sub.lineno))
+    return out
+
+
+def _guarded_candidates(cls: ast.ClassDef, lock_attr: str) -> list:
+    """Attributes the class assigns inside ``with self.<lock_attr>:``
+    blocks -- the evidence-based pre-fill for the guarded tuple."""
+    found: list = []
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.With):
+            continue
+        if not any(_self_attr(item.context_expr) == lock_attr
+                   for item in node.items):
+            continue
+        for sub in node.body:
+            for inner in ast.walk(sub):
+                targets = []
+                if isinstance(inner, ast.Assign):
+                    targets = inner.targets
+                elif isinstance(inner, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [inner.target]
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is not None and attr not in found:
+                        found.append(attr)
+    return found
+
+
+def _method_names(cls: ast.ClassDef) -> set:
+    return {n.name for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _guarded_by_skeletons(ctx) -> dict:
+    """{rel_path: [skeleton text]} for undeclared lock owners."""
+    out: dict = {}
+    for path in ctx.package_files():
+        tree = ctx.tree(path)
+        if tree is None:
+            continue
+        declared = _declared_classes(tree)
+        idx = ctx.index(path)
+        for cls in idx.classes:
+            if cls.name in declared:
+                continue
+            locks = _init_locks(cls)
+            if not locks:
+                continue
+            entries = []
+            for attr, _line in locks:
+                guarded = _guarded_candidates(cls, attr)
+                if guarded:
+                    tup = ("(" + ", ".join(f'"{g}"' for g in guarded)
+                           + ("," if len(guarded) == 1 else "") + ")")
+                    note = ""
+                else:
+                    tup = "()"
+                    note = ("   # TODO: list the attrs "
+                            f"{attr!r} guards")
+                entries.append(f'        "{attr}": {tup},{note}')
+            text = ("GUARDED_BY = {\n"
+                    + f'    "{cls.name}": {{\n'
+                    + "\n".join(entries)
+                    + "\n    },\n}")
+            out.setdefault(ctx.rel(path), []).append(
+                f"# class {cls.name} (line {cls.lineno})\n{text}")
+    return out
+
+
+def _releases_skeletons(ctx, findings) -> dict:
+    """{rel_path: [skeleton text]} from the threads analyzer's
+    undeclared-resource findings of the run that just completed."""
+    grouped: dict = {}
+    for f in findings:
+        if f.check != "threads" or f.suppressed:
+            continue
+        m = _RELEASES_FINDING.match(f.message)
+        if not m:
+            continue
+        cls_name, attr = m.group(1), m.group(2)
+        grouped.setdefault(f.path, {}).setdefault(
+            cls_name, []).append(attr)
+    out: dict = {}
+    for rel, classes in grouped.items():
+        # resolve releaser hints from the class body when parseable
+        abspath = os.path.join(ctx.root, rel)
+        methods: dict = {}
+        tree = ctx.tree(abspath)
+        if tree is not None:
+            for cls in ctx.index(abspath).classes:
+                methods[cls.name] = _method_names(cls)
+        entries = []
+        for cls_name in sorted(classes):
+            hint = next((h for h in RELEASER_HINTS
+                         if h in methods.get(cls_name, ())),
+                        None)
+            rel_lines = []
+            for attr in sorted(set(classes[cls_name])):
+                val = (f'"{hint}"' if hint
+                       else '"<releaser method>"   # TODO')
+                rel_lines.append(f'        "{attr}": {val},')
+            entries.append(f'    "{cls_name}": {{\n'
+                           + "\n".join(rel_lines) + "\n    },")
+        out[rel] = ["RELEASES = {\n" + "\n".join(entries) + "\n}"]
+    return out
+
+
+def render(ctx, findings) -> str:
+    """The full paste-ready skeleton report for one completed run;
+    empty string when every lock owner and resource holder is already
+    declared."""
+    guarded = _guarded_by_skeletons(ctx)
+    releases = _releases_skeletons(ctx, findings)
+    if not guarded and not releases:
+        return ""
+    out = ["# declaration skeletons (dprf check --fix-skeletons)",
+           "# paste next to the named class, then fill the TODOs:",
+           "# the tables make the class VISIBLE to the analyzers,",
+           "# which then verify the actual discipline.", ""]
+    for rel in sorted(set(guarded) | set(releases)):
+        out.append(f"# ---- {rel}")
+        for block in guarded.get(rel, []) + releases.get(rel, []):
+            out.append(block)
+            out.append("")
+    return "\n".join(out).rstrip() + "\n"

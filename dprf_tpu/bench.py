@@ -408,6 +408,117 @@ def run_bench(engine: str = "md5", device: str = "jax",
     }, mode="bench")
 
 
+def run_targets_sweep(engine: str = "md5", mask: str = "?a?a?a?a?a?a",
+                      sizes=(1_000, 10_000, 100_000, 1_000_000),
+                      batch="auto", seconds: float = 3.0,
+                      log=None) -> dict:
+    """Target-set-size sweep through the probe-table step (ISSUE 16):
+    the per-candidate cost of cracking against N digests must stay
+    FLAT as N grows 10^3 -> 10^6 (10^7-ready on real silicon -- the
+    sizes knob; the CPU backend caps at 10^6 to keep CI honest).
+
+    Each size builds its device-resident probe table (blocked Bloom +
+    sorted exact-verify buckets, dprf_tpu/targets/probe.py) from
+    synthetic unmatchable digests and times the SAME fused mask step
+    a real bulk job dispatches.  ``value`` is the H/s at the LARGEST
+    size, so the gated trajectory number dips if the table ever stops
+    being O(1) per candidate; ``flat_ratio`` (cost at max N / cost at
+    min N) is the direct flatness assertion CI checks against 1.3x.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dprf_tpu import compilecache
+    from dprf_tpu.compilecache import compile_observer
+    from dprf_tpu.targets import build_probe_table
+    from dprf_tpu.telemetry import programs as programs_mod
+    from dprf_tpu.utils.sync import hard_sync
+
+    batch, tuned = _tuned_or(batch, engine, "jax", 1 << 18,
+                             extras={"hit_cap": 64})
+    compilecache.enable(log=log)
+    gen = MaskGenerator(mask)
+    eng = get_engine(engine, device="jax")
+    sizes = sorted(int(s) for s in sizes)
+    rng = np.random.default_rng(0x7A17)
+
+    per_size = []
+    compile_fields: dict = {}
+    for n_targets in sizes:
+        # synthetic random digests: unmatchable in practice, and the
+        # probe step's cost does not depend on whether probes hit
+        words = rng.integers(0, 2**32, size=(n_targets,
+                                             eng.digest_size // 4),
+                             dtype=np.uint32)
+        digests = [w.tobytes() for w in words]
+        ptable = build_probe_table(
+            digests, little_endian=eng.little_endian, log=log)
+        step = make_mask_crack_step(
+            eng, gen, ptable, batch,
+            widen_utf16=getattr(eng, "widen_utf16", False))
+        base0 = jnp.asarray(gen.digits(0), dtype=jnp.int32)
+        t0 = time.perf_counter()
+        with compile_observer(engine) as obs:
+            hard_sync(step(base0, jnp.int32(batch)))
+        compile_s = time.perf_counter() - t0
+        if n_targets == sizes[-1]:
+            # registry capture for the largest table's program (the
+            # one a 10^6-target job runs); analysis happens in
+            # _introspection_fields after the timed windows
+            programs_mod.register_program(
+                engine, "mask+probe", batch, step=step,
+                args=(base0, jnp.int32(batch)))
+            compile_fields = _compile_fields(obs.cache, obs.seconds)
+        if log:
+            log.info("targets sweep compiled", targets=n_targets,
+                     mode=ptable.mode, table_mb=round(
+                         ptable.nbytes / 2**20, 3),
+                     seconds=f"{compile_s:.1f}", cache=obs.cache)
+        n, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            last = None
+            for _ in range(8):       # bounded queue depth
+                base = jnp.asarray(gen.digits(
+                    (n * batch) % max(gen.keyspace - batch, 1)),
+                    dtype=jnp.int32)
+                last = step(base, jnp.int32(batch))
+                n += 1
+            hard_sync(last)
+        elapsed = time.perf_counter() - t0
+        rate = n * batch / elapsed
+        per_size.append({
+            "targets": n_targets,
+            "rate_hs": rate,
+            "s_per_cand": 1.0 / rate,
+            "mode": ptable.mode,
+            "table_bytes": ptable.nbytes,
+            "fp_est": ptable.fp_est,
+            "compile_s": round(compile_s, 1),
+        })
+
+    flat_ratio = (per_size[-1]["s_per_cand"]
+                  / per_size[0]["s_per_cand"])
+    rate_max = per_size[-1]["rate_hs"]
+    platform = jax.devices()[0].platform
+    return _publish({
+        "metric": (f"{engine} probe-table H/s at "
+                   f"{sizes[-1]:.0e} targets"),
+        "value": rate_max,
+        "unit": "H/s",
+        "engine": engine,
+        "mask": mask,
+        "device": platform,
+        "batch": batch,
+        "tuned": tuned,
+        "sizes": sizes,
+        "per_size": per_size,
+        # per-candidate flatness: the O(1) claim, machine-checkable
+        "flat_ratio": round(flat_ratio, 4),
+        **compile_fields,
+        **_introspection_fields(engine, rate_max),
+    }, mode="targets")
+
+
 def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
                 n_devices: int = 8, batch_per_device="auto",
                 seconds: float = 5.0, inner: int = 8, log=None) -> dict:

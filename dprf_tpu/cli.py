@@ -54,7 +54,14 @@ def _add_job_args(c, with_hashfile: bool = True) -> None:
     c.add_argument("attack_arg", help="mask string (mask attack) or "
                    "wordlist path (wordlist attack)")
     if with_hashfile:
-        c.add_argument("hashfile", help="file of target hashes")
+        c.add_argument("hashfile", nargs="?", default=None,
+                       help="file of target hashes (or use "
+                       "--targets-file)")
+    c.add_argument("--targets-file", default=None, metavar="FILE",
+                   help="bulk target list (hashcat-style hash[:salt] "
+                   "lines; deduped, malformed lines reported; >= "
+                   "DPRF_TARGETS_PROBE_MIN targets use the "
+                   "device-resident probe table)")
     c.add_argument("--engine", "-m", required=True,
                    help="hash algorithm (see `dprf engines`)")
     c.add_argument("--device", default="tpu", choices=sorted(_DEVICE_ALIASES),
@@ -198,6 +205,16 @@ def _build_parser() -> argparse.ArgumentParser:
                    "and N chips and report per-chip rate + efficiency")
     b.add_argument("--bcrypt-cost", type=int, default=12,
                    help="cost for --config 4 (lower it off-TPU)")
+    b.add_argument("--targets-sweep", action="store_true",
+                   help="target-set-size sweep: measure the probe-"
+                   "table step's per-candidate cost across growing "
+                   "target counts (--targets-sizes) and report the "
+                   "flatness ratio; --gate compares against the "
+                   "TARGETS_r*.json trajectory")
+    b.add_argument("--targets-sizes", default="1000,10000,100000,1000000",
+                   metavar="N,N,...", help="comma-separated target "
+                   "counts for --targets-sweep (10^7-ready on real "
+                   "silicon; the CPU backend default caps at 10^6)")
     b.add_argument("--unit-strides", type=int, default=1, metavar="K",
                    help="--config mode: device batches per WorkUnit; "
                    "real Dispatcher units span many batches, and over "
@@ -322,7 +339,14 @@ def _build_parser() -> argparse.ArgumentParser:
                           "COORDINATOR host (it rebuilds and "
                           "fingerprints the job before admitting it)")
     jsb.add_argument("attack_arg", help="mask string or wordlist path")
-    jsb.add_argument("hashfile", help="file of target hashes")
+    jsb.add_argument("hashfile", nargs="?", default=None,
+                     help="file of target hashes (or use "
+                     "--targets-file)")
+    jsb.add_argument("--targets-file", default=None, metavar="FILE",
+                     help="bulk target list (hashcat-style hash[:salt] "
+                     "lines); parsed and deduped locally, shipped with "
+                     "a fingerprint the coordinator's rebuild must "
+                     "match")
     jsb.add_argument("--engine", "-m", required=True)
     jsb.add_argument("-a", "--attack", default="mask",
                      choices=["mask", "wordlist", "combinator",
@@ -615,6 +639,11 @@ def _build_parser() -> argparse.ArgumentParser:
     ck.add_argument("--write-env-docs", action="store_true",
                     help="regenerate the README env-knob table from "
                     "the utils/env.py registry, then run the checks")
+    ck.add_argument("--fix-skeletons", action="store_true",
+                    help="emit GUARDED_BY / RELEASES declaration "
+                    "skeletons for the lock and resource findings the "
+                    "locks/threads checks raise, ready to paste next "
+                    "to the offending class")
     ck.add_argument("--quiet", "-q", action="store_true")
 
     e = sub.add_parser("engines", help="list available engines")
@@ -870,6 +899,29 @@ def _load_targets(engine, hashfile: str, log: Log):
     return hl
 
 
+def _load_job_targets(args, engine, log: Log):
+    """Resolve the job's target set from the hashfile positional or
+    the bulk ``--targets-file`` ingest path; returns an object with a
+    ``.targets`` list (HashlistResult or TargetStore) or None on a
+    fatal, already-logged error."""
+    tf = getattr(args, "targets_file", None)
+    if tf is not None:
+        if args.hashfile is not None:
+            log.error("pass a hashfile positional OR --targets-file, "
+                      "not both")
+            return None
+        from dprf_tpu.targets import TargetStore
+        store = TargetStore.from_file(engine, tf, log=log)
+        if not store.targets:
+            log.error("no valid targets in targets file", path=tf)
+            return None
+        return store
+    if args.hashfile is None:
+        log.error("no target hashes: pass a hashfile or --targets-file")
+        return None
+    return _load_targets(engine, args.hashfile, log)
+
+
 def _setup_session(args, spec, log: Log):
     """Returns (session, completed, restored_hits, tuning, jobs) or
     None on conflict; ``jobs`` is the journal's scheduler-submitted
@@ -943,7 +995,7 @@ def _setup_job(args, device: str, log: Log,
     logged).  Single source of truth for the fingerprint and session
     wiring, so local and distributed jobs can never diverge."""
     engine = get_engine(args.engine, device="cpu")   # parser/oracle always CPU
-    hl = _load_targets(engine, args.hashfile, log)
+    hl = _load_job_targets(args, engine, log)
     if hl is None:
         return None
 
@@ -1645,7 +1697,14 @@ def cmd_bench(args, log: Log) -> int:
         ctx = profiler_mod.get_profiler().session(
             args.profile, owner="bench", log=log)
     with ctx:
-        if args.devices > 1:
+        if args.targets_sweep:
+            from dprf_tpu.bench import run_targets_sweep
+            sizes = [int(s) for s in
+                     args.targets_sizes.split(",") if s.strip()]
+            res = run_targets_sweep(engine=args.engine, mask=args.mask,
+                                    sizes=sizes, batch=args.batch,
+                                    seconds=args.seconds, log=log)
+        elif args.devices > 1:
             from dprf_tpu.bench import run_scaling
             res = run_scaling(engine=args.engine, mask=args.mask,
                               n_devices=args.devices,
@@ -1686,8 +1745,12 @@ def cmd_bench(args, log: Log) -> int:
         # parses it) and a regression exits non-zero.  Scaling mode
         # gates against the SCALING_r*.json efficiency trajectory, so
         # a multichip regression alarms exactly like a throughput one.
-        pattern = (compare_mod.SCALING_PATTERN if args.devices > 1
-                   else "BENCH_r*.json")
+        if args.targets_sweep:
+            pattern = compare_mod.TARGETS_PATTERN
+        elif args.devices > 1:
+            pattern = compare_mod.SCALING_PATTERN
+        else:
+            pattern = "BENCH_r*.json"
         res["gate"] = compare_mod.gate_repo(res, baseline_dir,
                                             window=args.gate_window,
                                             pattern=pattern)
@@ -2002,9 +2065,28 @@ def cmd_jobs(args, log: Log) -> int:
 def _jobs_submit(client, args, log: Log) -> int:
     import json as _json
 
-    with open(args.hashfile, encoding="utf-8",
-              errors="replace") as fh:
-        lines = [ln.strip() for ln in fh if ln.strip()]
+    tf = getattr(args, "targets_file", None)
+    targets_fingerprint = None
+    if tf is not None:
+        if args.hashfile is not None:
+            log.error("pass a hashfile positional OR --targets-file, "
+                      "not both")
+            return 2
+        from dprf_tpu.targets import TargetStore
+        store = TargetStore.from_file(
+            get_engine(args.engine, device="cpu"), tf, log=log)
+        if not store.targets:
+            log.error("no valid targets in targets file", path=tf)
+            return 2
+        lines = store.lines()
+        targets_fingerprint = store.fingerprint
+    elif args.hashfile is None:
+        log.error("no target hashes: pass a hashfile or --targets-file")
+        return 2
+    else:
+        with open(args.hashfile, encoding="utf-8",
+                  errors="replace") as fh:
+            lines = [ln.strip() for ln in fh if ln.strip()]
     spec = {
         "engine": args.engine,
         "attack": args.attack,
@@ -2014,6 +2096,7 @@ def _jobs_submit(client, args, log: Log) -> int:
         "rules": args.rules,
         "markov": args.markov,
         "targets": lines,
+        "targets_fingerprint": targets_fingerprint,
         "unit_size": args.unit_size,
         "unit_seconds": args.unit_seconds,
         "batch": args.batch or DEFAULT_BATCH,
@@ -2522,7 +2605,8 @@ def cmd_check(args, log: Log) -> int:
         argv += ["--skip", v]
     if args.explain:
         argv += ["--explain", args.explain]
-    for flag in ("json", "list", "show_suppressed", "write_env_docs"):
+    for flag in ("json", "list", "show_suppressed", "write_env_docs",
+                 "fix_skeletons"):
         if getattr(args, flag):
             argv.append("--" + flag.replace("_", "-"))
     return analysis.main(argv)

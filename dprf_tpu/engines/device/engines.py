@@ -138,10 +138,17 @@ class JaxEngineBase(GenericWorkerFactories, DeviceHashEngine, HashEngine):
         job degrades to the generic XLA pipeline with a loud warning.
         """
         from dprf_tpu.ops.pallas_mask import kernel_eligible, pallas_mode
+        from dprf_tpu.targets import probe as probe_mod
         from dprf_tpu.utils.logging import DEFAULT as log
         mode = pallas_mode()
-        if mode is not None and not kernel_eligible(self.name, gen,
-                                                    len(targets)):
+        if mode is not None and probe_mod.probe_eligible(targets, self):
+            # bulk lists route to the probe-table worker: the Pallas
+            # multi-target kernel replicates a per-set bitmap whose
+            # cost grows with N, exactly what the probe table removes
+            log.info("bulk target list routes to the probe-table XLA "
+                     "pipeline", engine=self.name, targets=len(targets))
+        elif mode is not None and not kernel_eligible(self.name, gen,
+                                                      len(targets)):
             # weak-spot visibility: `--impl auto` users otherwise can't
             # tell which path ran without reading the result JSON
             log.info("pallas kernel not eligible for this job; "

@@ -79,6 +79,17 @@ def build_job_runtime(spec: dict, job_id: str, log=None,
             f"submitted fingerprint {theirs!r} disagrees with the "
             f"coordinator's rebuild {fingerprint!r} (divergent "
             "wordlist/rules/stats content on this host?)")
+    their_targets = spec.get("targets_fingerprint")
+    if their_targets is not None:
+        from dprf_tpu.targets import TargetStore
+        store = TargetStore(engine, hl.targets, hl.skipped,
+                            hl.duplicates)
+        if their_targets != store.fingerprint:
+            raise ValueError(
+                f"submitted targets fingerprint {their_targets!r} "
+                f"disagrees with the coordinator's rebuild "
+                f"{store.fingerprint!r} (target lines corrupted or "
+                "reordered with losses in transit?)")
     unit_size = _cli._align_unit_size(
         int(spec.get("unit_size") or DEFAULT_UNIT_SIZE), attack, gen)
     try:

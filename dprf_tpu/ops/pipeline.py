@@ -30,13 +30,18 @@ def make_mask_crack_step(engine, gen: MaskGenerator,
     """Build the jitted fused step for a mask attack.
 
     engine: a DeviceHashEngine (jax device variant).
-    targets: uint32[W] single target words, or a TargetTable.
+    targets: uint32[W] single target words, a TargetTable, or a
+        targets.probe.ProbeTable (bulk lists; see dprf_tpu/targets/).
     Returns step(base_digits int32[L], n_valid int32) ->
         (count int32, lanes int32[cap], target_pos int32[cap]).
     """
+    from dprf_tpu.targets import probe as probe_mod
+
     flat = gen.flat_charsets
     length = gen.length
     multi = isinstance(targets, cmp_ops.TargetTable)
+    probe = isinstance(targets, probe_mod.ProbeTable)
+    survivors = probe_mod.survivor_cap(targets, batch) if probe else 0
 
     @jax.jit
     def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray):
@@ -49,13 +54,16 @@ def make_mask_crack_step(engine, gen: MaskGenerator,
         else:
             words = engine.pack(cand, length)
         digest = engine.digest_packed(words)
+        valid = jnp.arange(batch, dtype=jnp.int32) < n_valid
+        if probe:
+            return probe_mod.probe_hits(digest, targets, valid,
+                                        hit_capacity, survivors)
         if multi:
             found, tpos = cmp_ops.compare_multi(digest, targets)
         else:
             found = cmp_ops.compare_single(digest, targets)
             tpos = jnp.zeros((batch,), jnp.int32)
-        found = found & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
-        return cmp_ops.compact_hits(found, tpos, hit_capacity)
+        return cmp_ops.compact_hits(found & valid, tpos, hit_capacity)
 
     return step
 

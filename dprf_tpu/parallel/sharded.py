@@ -173,11 +173,51 @@ def make_sharded_mask_step(engine, gen, targets, mesh,
     with window-relative lanes; ``step.superstep(inner)`` fuses inner
     batches per dispatch (on-device generation via ``decode_batch``'s
     traced lane_offset -- no host digits per batch, no reshard).
+
+    Bulk lists arrive as a ``targets.probe.ProbeTable``: its Bloom
+    bitmap and exact-verify buckets are closure constants of the
+    shard function, so they ride through every superstep as
+    REPLICATED device state (no per-dispatch transfer).  Lanes the
+    device cannot verify exactly -- the host-verify layout, or a
+    survivor-buffer overflow -- come back with target pos ==
+    num_targets (out of range), which the workers' lane decode
+    resolves with one oracle hash each.
     """
+    from dprf_tpu.targets import probe as probe_mod
+
     flat = gen.flat_charsets
     length = gen.length
     B = batch_per_device
     multi = isinstance(targets, cmp_ops.TargetTable)
+    probe = isinstance(targets, probe_mod.ProbeTable)
+    survivors = probe_mod.survivor_cap(targets, B) if probe else 0
+    sentinel = targets.num_targets if probe else 0
+
+    def _probe_compute(digest, maybe):
+        if targets.table is None:
+            # host-verify layout: every Bloom survivor goes back
+            # sentinel-tagged; the worker resolves each on the host
+            return maybe, jnp.full((B,), sentinel, jnp.int32)
+        n_maybe = maybe.sum(dtype=jnp.int32)
+        slot = jnp.cumsum(maybe.astype(jnp.int32)) - 1
+        slot = jnp.where(maybe, slot, survivors)
+        surv = jnp.full((survivors,), -1, jnp.int32).at[slot].set(
+            jnp.arange(B, dtype=jnp.int32), mode="drop")
+        found_s, tpos_s = cmp_ops.compare_multi(
+            digest[jnp.maximum(surv, 0)], targets.table)
+        found_s = found_s & (surv >= 0)
+        back = jnp.where(surv >= 0, surv, B)
+        verified = jnp.zeros((B,), bool).at[back].set(
+            found_s, mode="drop")
+        tpos = jnp.zeros((B,), jnp.int32).at[back].set(
+            tpos_s, mode="drop")
+        # a survivor overflow could hide a real hit past the buffer:
+        # degrade THIS batch to sentinel-tagged maybes instead
+        overflow = n_maybe > survivors
+        found = jnp.where(overflow, maybe, verified)
+        tpos = jnp.where(overflow,
+                         jnp.full((B,), sentinel, jnp.int32), tpos)
+        return found, tpos
 
     def compute(offset, base_digits, n_valid):
         cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)
@@ -188,12 +228,16 @@ def make_sharded_mask_step(engine, gen, targets, mesh,
             digest = engine.digest_candidates(cand, 2 * length)
         else:
             digest = engine.digest_candidates(cand, length)
+        lane = offset + jnp.arange(B, dtype=jnp.int32)
+        if probe:
+            return _probe_compute(
+                digest, probe_mod.bloom_maybe(digest, targets)
+                & (lane < n_valid))
         if multi:
             found, tpos = cmp_ops.compare_multi(digest, targets)
         else:
             found = cmp_ops.compare_single(digest, targets)
             tpos = jnp.zeros((B,), jnp.int32)
-        lane = offset + jnp.arange(B, dtype=jnp.int32)
         return found & (lane < n_valid), tpos
 
     step = make_sharded_step(compute, mesh, B, 2,

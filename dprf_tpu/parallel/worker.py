@@ -103,14 +103,20 @@ class _ShardedSuperstepMixin:
 
 
 class ShardedMaskWorker(_ShardedSuperstepMixin, MaskWorkerBase):
-    """Fused-pipeline worker spread over a device mesh."""
+    """Fused-pipeline worker spread over a device mesh.
+
+    Bulk target lists (>= DPRF_TARGETS_PROBE_MIN) swap the replicated
+    compare table for the probe table (dprf_tpu/targets/): the sharded
+    step builder carries it as replicated device state through
+    supersteps, so probe_ok is set here."""
 
     def __init__(self, engine, gen, targets: Sequence[Target], mesh,
                  batch_per_device: int = 1 << 18, hit_capacity: int = 64,
                  oracle: Optional[HashEngine] = None):
         from dprf_tpu.parallel.sharded import make_sharded_mask_step
 
-        tgt = self._setup_targets(engine, gen, targets, hit_capacity, oracle)
+        tgt = self._setup_targets(engine, gen, targets, hit_capacity,
+                                  oracle, probe_ok=True)
         self.mesh = mesh
         self.step = make_sharded_mask_step(
             engine, gen, tgt, mesh, batch_per_device, hit_capacity,

@@ -48,6 +48,11 @@ _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 #: throughput trajectory, via the same gate() math
 SCALING_PATTERN = "SCALING_r*.json"
 
+#: committed target-set-size sweep records (bare run_targets_sweep
+#: result JSON; value = H/s at the LARGEST target count, so a probe
+#: table that stops being O(1) per candidate dips the gated number)
+TARGETS_PATTERN = "TARGETS_r*.json"
+
 
 def _result_from_tail(tail: str) -> Optional[dict]:
     """The LAST JSON object line in a driver record's tail -- the

@@ -302,7 +302,8 @@ class MaskWorkerBase:
     ATTACK = "mask"
 
     def _setup_targets(self, engine, gen, targets: Sequence[Target],
-                       hit_capacity: int, oracle: Optional[HashEngine]):
+                       hit_capacity: int, oracle: Optional[HashEngine],
+                       probe_ok: bool = False):
         from dprf_tpu.ops import compare as cmp_ops
         from dprf_tpu.ops.pipeline import target_words
 
@@ -313,6 +314,10 @@ class MaskWorkerBase:
         self.oracle = oracle
         digests = [t.digest for t in self.targets]
         self.multi = len(digests) > 1
+        if self.multi and probe_ok:
+            ptable = self._setup_probe(digests)
+            if ptable is not None:
+                return ptable
         if self.multi:
             table = cmp_ops.make_target_table(
                 digests, little_endian=engine.little_endian)
@@ -320,6 +325,41 @@ class MaskWorkerBase:
             return table
         self._order = np.zeros(1, dtype=np.int64)
         return target_words(digests[0], engine.little_endian)
+
+    def _setup_probe(self, digests: list):
+        """Bulk target lists (>= DPRF_TARGETS_PROBE_MIN digests) get
+        the O(1)-per-candidate probe table (dprf_tpu/targets/) instead
+        of the replicated compare table; a build failure falls back to
+        the replicated path loudly.  Only workers whose step builder
+        understands a ProbeTable pass probe_ok=True."""
+        from dprf_tpu.targets import probe as probe_mod
+        from dprf_tpu.utils.logging import DEFAULT as log
+        if not probe_mod.probe_eligible(self.targets, self.engine):
+            return None
+        try:
+            ptable = probe_mod.build_probe_table(
+                digests, little_endian=self.engine.little_endian,
+                log=log)
+        except Exception as e:    # noqa: BLE001 -- degrade, not die
+            log.warn("probe-table build failed; falling back to the "
+                     "replicated compare table",
+                     targets=len(digests), error=str(e))
+            return None
+        if ptable.mode == probe_mod.MODE_HOST_VERIFY \
+                and self.oracle is None:
+            # every survivor needs a host hash in this layout; without
+            # an oracle the worker could never confirm a single hit
+            log.warn("host-verify probe table needs an oracle engine; "
+                     "falling back to the replicated compare table",
+                     targets=len(digests))
+            return None
+        self._digest_map = {t.digest: i
+                            for i, t in enumerate(self.targets)}
+        self._order = ptable.order
+        # distinct program-registry label: the probe step's roofline
+        # is a different program from the replicated-compare step's
+        self.ATTACK = self.ATTACK + "+probe"
+        return ptable
 
     def warmup_args(self) -> tuple:
         """The step arguments a zero-work warmup dispatch uses -- same
@@ -677,15 +717,34 @@ class MaskWorkerBase:
             lambda bstart, row: self._batch_hits(bstart, row, unit))
 
     def _decode_lanes(self, bstart: int, lanes_np, tpos_np) -> list[Hit]:
-        """Hit-buffer arrays -> Hit records (lane -1 = unused slot)."""
+        """Hit-buffer arrays -> Hit records (lane -1 = unused slot).
+
+        Probe-table steps emit an OUT-OF-RANGE target pos for lanes
+        the device did not verify exactly (the degraded host-verify
+        layout, or a sharded survivor-buffer overflow): those lanes
+        are Bloom survivors, not confirmed hits, and resolve here
+        with one oracle hash each -- false positives drop (the
+        PallasMaskWorker multi-target maybe idiom)."""
         hits = []
         for lane, tp in zip(lanes_np, tpos_np):
             if lane < 0:
                 continue
             gidx = bstart + int(lane)
+            if self.multi and not 0 <= int(tp) < len(self._order):
+                hits.extend(self._verify_probe_lane(gidx))
+                continue
             ti = int(self._order[int(tp)]) if self.multi else 0
             hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
         return hits
+
+    def _verify_probe_lane(self, gidx: int) -> list[Hit]:
+        if self.oracle is None:
+            raise RuntimeError(
+                "unverified probe-table survivor and no oracle engine "
+                "to resolve it with")
+        plain = self.gen.candidate(gidx)
+        ti = self._digest_map.get(self.oracle.hash_batch([plain])[0])
+        return [Hit(ti, gidx, plain)] if ti is not None else []
 
     def _rescan(self, bstart: int, unit: WorkUnit,
                 window: int = 0) -> list[Hit]:
@@ -1098,14 +1157,19 @@ class DeviceCombinatorWorker(MaskWorkerBase):
 
 
 class DeviceMaskWorker(MaskWorkerBase):
-    """Fused-pipeline worker for mask attacks on fast (unsalted) hashes."""
+    """Fused-pipeline worker for mask attacks on fast (unsalted) hashes.
+
+    Bulk target lists (>= DPRF_TARGETS_PROBE_MIN) swap the replicated
+    compare table for the probe table (dprf_tpu/targets/): the step
+    builder understands a ProbeTable, so probe_ok is set here."""
 
     def __init__(self, engine, gen, targets: Sequence[Target],
                  batch: int = 1 << 18, hit_capacity: int = 64,
                  oracle: Optional[HashEngine] = None):
         from dprf_tpu.ops.pipeline import make_mask_crack_step
 
-        tgt = self._setup_targets(engine, gen, targets, hit_capacity, oracle)
+        tgt = self._setup_targets(engine, gen, targets, hit_capacity,
+                                  oracle, probe_ok=True)
         self.batch = self.stride = batch
         self.step = make_mask_crack_step(
             engine, gen, tgt, batch, hit_capacity,

@@ -1,0 +1,20 @@
+"""Multi-target probe tables: bulk (10^6-10^7 hash) recovery support.
+
+`probe` builds the device-resident Bloom-prefilter + exact-verify
+structure the mask workers swap in when the target count crosses
+DPRF_TARGETS_PROBE_MIN; `store` is the hashlist ingest layer behind
+`dprf crack --targets-file` and the jobs-submit spec key.
+"""
+
+from dprf_tpu.targets.probe import (MODE_DEVICE, MODE_HOST_VERIFY,
+                                    ProbeTable, bloom_maybe,
+                                    build_probe_table, byte_budget,
+                                    probe_eligible, probe_hits,
+                                    survivor_cap)
+from dprf_tpu.targets.store import TargetStore
+
+__all__ = [
+    "MODE_DEVICE", "MODE_HOST_VERIFY", "ProbeTable", "TargetStore",
+    "bloom_maybe", "build_probe_table", "byte_budget",
+    "probe_eligible", "probe_hits", "survivor_cap",
+]

@@ -1,0 +1,258 @@
+"""Device-resident probe tables: O(1)-per-candidate multi-target compare.
+
+The replicated compare path (ops/compare.make_target_table) keeps every
+target digest in one sorted device array and runs a searchsorted per
+candidate -- right for the 10^3-hash list, but the bulk-recovery
+scenario ("here are millions of leaked hashes") needs per-candidate
+cost independent of N.  The probe table gets there in two stages:
+
+  1. a blocked Bloom prefilter: one 512-bit block (16 uint32 words)
+     per candidate, k double-hashed bit probes derived from the first
+     two digest words -- constant work per candidate, sized on the
+     host from N and a false-positive budget (DPRF_TARGETS_FP_BUDGET);
+  2. the rare prefilter survivors are compacted into a small fixed
+     buffer and verified EXACTLY against the sorted digest table --
+     the same maybe-then-oracle discipline the krb5 DER prefilter
+     uses, so a false positive can never surface as a hit.
+
+Survivor-buffer overflow inflates the reported count past the lane
+buffer, which lands in the workers' existing hit_capacity
+rescan/redrive machinery; correctness never depends on the filter.
+
+Sizing consults the devstats HBM-headroom plane before building: a
+table that will not fit its byte budget degrades to the bloom-only
+HOST-VERIFY layout (survivor lanes return to the host, one oracle
+hash each) instead of OOMing the device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from dprf_tpu.ops import compare as cmp_ops
+
+#: words per Bloom block: 16 x uint32 = 512 bits, one lane-width row --
+#: all k probes of a candidate land in the same block, so the gather
+#: footprint per candidate is constant regardless of bitmap size
+BLOCK_WORDS = 16
+BLOCK_BITS = BLOCK_WORDS * 32
+
+#: Knuth multiplicative constant spreading digest word0 over blocks
+_GOLDEN = 0x9E3779B1
+
+_MAX_K = 8
+#: smallest bitmap a degraded (host-verify) table keeps: 8 KiB
+_MIN_BITS = 1 << 16
+
+MODE_DEVICE = "device"
+MODE_HOST_VERIFY = "host-verify"
+
+
+@dataclasses.dataclass(frozen=True)
+class ProbeTable:
+    """Host-built, device-resident multi-target probe structure."""
+
+    bits: jnp.ndarray        # uint32[n_blocks * BLOCK_WORDS] bitmap
+    block_bits: int          # log2(n_blocks); static
+    k: int                   # bit probes per digest; static
+    #: exact-verify buckets (device mode); None in host-verify mode
+    table: Optional[cmp_ops.TargetTable]
+    order: np.ndarray        # host: sorted pos -> original target idx
+    num_targets: int
+    mode: str                # MODE_DEVICE | MODE_HOST_VERIFY
+    fp_est: float            # analytic false-positive rate of `bits`
+    nbytes: int              # device bytes: bitmap + exact table
+
+
+def _pow2ceil(x: int) -> int:
+    return 1 << max(0, int(x) - 1).bit_length() if x > 1 else 1
+
+
+def _geometry(n: int, m_bits: int):
+    """(k, fp_est) for n keys in an m_bits bitmap."""
+    k = int(round(m_bits / n * math.log(2)))
+    k = min(max(k, 1), _MAX_K)
+    fp_est = (1.0 - math.exp(-k * n / m_bits)) ** k
+    return k, fp_est
+
+
+def byte_budget() -> Optional[int]:
+    """Device-byte cap for a probe table, or None when unbounded.
+    DPRF_TARGETS_MAX_BYTES wins when set; otherwise a fraction
+    (DPRF_TARGETS_HEADROOM_FRAC) of the devstats free-HBM reading.
+    Backends without memory stats (CPU) give no signal -> no cap."""
+    from dprf_tpu.telemetry import devstats
+    from dprf_tpu.utils import env as envreg
+    hard = envreg.get_int("DPRF_TARGETS_MAX_BYTES")
+    if hard and hard > 0:
+        return hard
+    free = devstats.bytes_free()
+    if free is None:
+        return None
+    frac = envreg.get_float("DPRF_TARGETS_HEADROOM_FRAC")
+    return int(free * min(max(frac, 0.0), 1.0))
+
+
+def probe_eligible(targets: Sequence, engine=None) -> bool:
+    """Should this target list use the probe-table path?  Needs enough
+    targets to beat the replicated compare (DPRF_TARGETS_PROBE_MIN),
+    uniform unsalted digests, and at least two uint32 words for the
+    double-hashed probes."""
+    from dprf_tpu.utils import env as envreg
+    floor = envreg.get_int("DPRF_TARGETS_PROBE_MIN")
+    if floor <= 0 or len(targets) < floor:
+        return False
+    if engine is not None and getattr(engine, "salted", False):
+        return False
+    dlen = len(targets[0].digest)
+    if dlen < 8 or dlen % 4:
+        return False
+    return all(len(t.digest) == dlen and not t.params for t in targets)
+
+
+def build_probe_table(digests: Sequence[bytes],
+                      little_endian: bool = True,
+                      fp_budget: Optional[float] = None,
+                      max_bytes: Optional[int] = None,
+                      log=None) -> ProbeTable:
+    """N raw digests -> a ProbeTable sized for the fp budget and the
+    device byte budget (see module docstring for the degrade rule)."""
+    from dprf_tpu.utils import env as envreg
+    n = len(digests)
+    if n == 0:
+        raise ValueError("empty target list")
+    dlen = len(digests[0])
+    if dlen < 8 or dlen % 4:
+        raise ValueError(
+            "probe tables need digests of >= 2 whole uint32 words")
+    if any(len(d) != dlen for d in digests):
+        raise ValueError("inconsistent digest sizes in target list")
+    fp = fp_budget if fp_budget is not None else \
+        envreg.get_float("DPRF_TARGETS_FP_BUDGET")
+    fp = min(max(fp, 1e-9), 0.5)
+    m_bits = max(BLOCK_BITS, _pow2ceil(int(math.ceil(
+        -n * math.log(fp) / (math.log(2) ** 2)))))
+    budget = max_bytes if max_bytes is not None else byte_budget()
+    exact_bytes = n * dlen + n * 4       # words[T,W] + first[T]
+    mode = MODE_DEVICE
+    if budget is not None and m_bits // 8 + exact_bytes > budget:
+        # the exact table is what dominates at 10^7 targets; shed it
+        # and shrink the bitmap until it fits -- never OOM the device
+        mode = MODE_HOST_VERIFY
+        while m_bits > _MIN_BITS and m_bits // 8 > budget:
+            m_bits //= 2
+    k, fp_est = _geometry(n, m_bits)
+
+    rows = np.frombuffer(
+        b"".join(digests),
+        dtype="<u4" if little_endian else ">u4").reshape(n, dlen // 4)
+    h1 = rows[:, 0].astype(np.uint64)
+    h2 = (rows[:, 1].astype(np.uint64) | 1)
+    n_blocks = m_bits // BLOCK_BITS
+    block_bits = n_blocks.bit_length() - 1
+    if block_bits:
+        block = ((h1 * _GOLDEN) & 0xFFFFFFFF) >> np.uint64(
+            32 - block_bits)
+    else:
+        block = np.zeros(n, dtype=np.uint64)
+    words = np.zeros(m_bits // 32, dtype=np.uint32)
+    for j in range(k):
+        g = (h1 + (2 * j + 1) * h2) & 0xFFFFFFFF
+        bit = g & (BLOCK_BITS - 1)
+        w = (block * BLOCK_WORDS + (bit >> np.uint64(5))).astype(np.int64)
+        np.bitwise_or.at(
+            words, w,
+            np.uint32(1) << (bit & np.uint64(31)).astype(np.uint32))
+
+    table = None
+    order = np.arange(n, dtype=np.int64)
+    if mode == MODE_DEVICE:
+        table = cmp_ops.make_target_table(
+            list(digests), little_endian=little_endian)
+        order = table.order
+    nbytes = words.nbytes + (exact_bytes if table is not None else 0)
+    if log is not None:
+        log.info("built probe table", targets=n, mode=mode,
+                 bits=m_bits, k=k, fp=round(fp_est, 8),
+                 mbytes=round(nbytes / 1e6, 3))
+    return ProbeTable(bits=jnp.asarray(words), block_bits=block_bits,
+                      k=k, table=table, order=order, num_targets=n,
+                      mode=mode, fp_est=fp_est, nbytes=nbytes)
+
+
+def bloom_maybe(digest: jnp.ndarray, pt: ProbeTable) -> jnp.ndarray:
+    """uint32[B, W] candidate digests -> bool[B] "possibly a target".
+
+    Per candidate: one multiplicative block pick from word0, then k
+    double-hashed bit tests inside that single 512-bit block -- the
+    whole prefilter is a constant number of ops in N."""
+    h1 = digest[:, 0]
+    h2 = digest[:, 1] | jnp.uint32(1)
+    if pt.block_bits:
+        base = ((h1 * jnp.uint32(_GOLDEN))
+                >> (32 - pt.block_bits)).astype(jnp.int32) * BLOCK_WORDS
+    else:
+        base = jnp.zeros(digest.shape[0], jnp.int32)
+    maybe = jnp.ones(digest.shape[0], dtype=bool)
+    for j in range(pt.k):
+        g = h1 + jnp.uint32(2 * j + 1) * h2
+        bit = g & jnp.uint32(BLOCK_BITS - 1)
+        w = base + (bit >> 5).astype(jnp.int32)
+        mask = jnp.left_shift(jnp.uint32(1), bit & jnp.uint32(31))
+        maybe = maybe & ((pt.bits[w] & mask) != 0)
+    return maybe
+
+
+def survivor_cap(pt: ProbeTable, batch: int) -> int:
+    """Fixed survivor-buffer length for a batch-lane step: ~4x the
+    expected false-positive count plus slack for real hits, clamped to
+    [64, 8192]; DPRF_TARGETS_SURVIVOR_CAP overrides."""
+    from dprf_tpu.utils import env as envreg
+    fixed = envreg.get_int("DPRF_TARGETS_SURVIVOR_CAP")
+    if fixed and fixed > 0:
+        return fixed
+    want = int(4 * batch * pt.fp_est) + 64
+    return min(max(_pow2ceil(want), 64), 8192)
+
+
+def probe_hits(digest: jnp.ndarray, pt: ProbeTable,
+               valid: jnp.ndarray, hit_capacity: int,
+               survivors: int):
+    """Digests -> the workers' (count, lanes, tpos) hit-buffer shape.
+
+    Device mode: Bloom survivors compact into a `survivors`-slot
+    buffer, their digests are re-gathered and verified exactly against
+    the sorted table, and true hits compact into the hit_capacity
+    buffer.  A survivor overflow (n_maybe > survivors) could hide a
+    real hit, so the count is inflated past the lane buffer and the
+    callers' existing overflow rescan/redrive path re-covers the
+    window exactly.
+
+    Host-verify mode (no exact table on device): the lane buffer IS
+    the survivor buffer (tpos all -1) and count is the survivor count;
+    the worker verifies each lane with one oracle hash.  Overflow
+    falls out of the same count > capacity comparison."""
+    nlanes = digest.shape[0]
+    lane = jnp.arange(nlanes, dtype=jnp.int32)
+    maybe = bloom_maybe(digest, pt) & valid
+    n_maybe = maybe.sum(dtype=jnp.int32)
+    slot = jnp.cumsum(maybe.astype(jnp.int32)) - 1
+    slot = jnp.where(maybe, slot, survivors)
+    surv = jnp.full((survivors,), -1, jnp.int32).at[slot].set(
+        lane, mode="drop")
+    if pt.table is None:
+        return n_maybe, surv, jnp.full((survivors,), -1, jnp.int32)
+    sdig = digest[jnp.maximum(surv, 0)]
+    found, tpos = cmp_ops.compare_multi(sdig, pt.table)
+    found = found & (surv >= 0)
+    count, slots, tpos = cmp_ops.compact_hits(found, tpos, hit_capacity)
+    lanes = jnp.where(slots >= 0, surv[jnp.maximum(slots, 0)],
+                      jnp.int32(-1))
+    count = jnp.where(n_maybe <= survivors, count,
+                      jnp.int32(hit_capacity) + n_maybe)
+    return count, lanes, tpos

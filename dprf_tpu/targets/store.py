@@ -1,0 +1,96 @@
+"""TargetStore: bulk hashlist ingest for multi-target jobs.
+
+One object owning what `dprf crack --targets-file` and the `jobs
+submit` spec key both need from a hashcat-style `hash[:salt]` file:
+the parsed/deduped Target list (utils/hashlist.py does the per-line
+work), a malformed-line report, and a content fingerprint that is
+stable across line order and duplicates -- so a worker host rebuilding
+the job from shipped lines (jobs/build.py) can prove it holds the
+same target set the submitter hashed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Optional, Sequence
+
+from dprf_tpu.utils.hashlist import parse_lines
+
+GUARDED_BY = {
+    "TargetStore": {"_lock": ("_fingerprint",)},
+}
+
+
+class TargetStore:
+    """Parsed target set + ingest report + cached fingerprint."""
+
+    def __init__(self, engine, targets: Sequence, skipped=(),
+                 duplicates: int = 0, source: Optional[str] = None):
+        self.engine = engine
+        self.targets = list(targets)
+        self.skipped = list(skipped)     # (line_no, text, error)
+        self.duplicates = int(duplicates)
+        self.source = source
+        self._lock = threading.Lock()
+        self._fingerprint: Optional[str] = None
+
+    @classmethod
+    def from_lines(cls, engine, lines: Sequence[str],
+                   source: Optional[str] = None,
+                   log=None) -> "TargetStore":
+        hl = parse_lines(engine, lines)
+        store = cls(engine, hl.targets, hl.skipped, hl.duplicates,
+                    source=source)
+        if log is not None:
+            for no, _text, err in hl.skipped:
+                log.warn("targets file: skipping malformed line",
+                         source=source or "<lines>", line=no,
+                         error=err)
+            log.info("loaded target set", source=source or "<lines>",
+                     targets=len(store.targets),
+                     duplicates=store.duplicates,
+                     malformed=len(store.skipped))
+        return store
+
+    @classmethod
+    def from_file(cls, engine, path: str, log=None) -> "TargetStore":
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            lines = fh.readlines()
+        return cls.from_lines(engine, lines, source=path, log=log)
+
+    def __len__(self) -> int:
+        return len(self.targets)
+
+    def lines(self) -> list:
+        """The deduped target lines, ready to ship as a job spec's
+        `targets` list (the coordinator re-parses them)."""
+        return [t.raw for t in self.targets]
+
+    @property
+    def fingerprint(self) -> str:
+        """sha256 over the engine name and the SORTED raw target
+        lines: line order and dropped duplicates do not change it, a
+        different target set always does."""
+        with self._lock:
+            if self._fingerprint is None:
+                h = hashlib.sha256()
+                h.update(getattr(self.engine, "name",
+                                 "?").encode("utf-8"))
+                for raw in sorted(t.raw for t in self.targets):
+                    h.update(b"\x00")
+                    h.update(raw.encode("utf-8", errors="replace"))
+                self._fingerprint = h.hexdigest()
+            return self._fingerprint
+
+    def report(self) -> dict:
+        """Ingest summary for logs / the jobs-submit reply."""
+        return {
+            "targets": len(self.targets),
+            "duplicates": self.duplicates,
+            "malformed": [
+                {"line": no, "error": err}
+                for no, _text, err in self.skipped],
+            "fingerprint": self.fingerprint,
+            "source": self.source,
+        }

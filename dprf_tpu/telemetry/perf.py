@@ -349,22 +349,63 @@ def _divergence_gauge(registry=None):
         "wrong)", labelnames=("engine",))
 
 
+#: profiler-measured device seconds per candidate, per engine -- the
+#: LAST resort of the roofline model chain.  Programs whose optimized
+#: HLO reports no flop count (gather/bitwise-only pipelines like the
+#: probe-table step) never produce an analyzed value, and new kernels
+#: have no hand entry; a measured capture window still lets them
+#: publish dprf_roofline_frac instead of dropping off the plane.
+_MEASURED_SPC: dict = {}
+
+
+def record_measured_cost(engine: str, seconds_per_candidate: float,
+                         registry=None) -> None:
+    """Record a profiler-measured device-seconds/candidate observation
+    (telemetry/profiler.py's trace analysis calls this for every
+    engine a capture window attributed device time to).  Published as
+    a gauge so the fallback model is inspectable on /metrics."""
+    if not seconds_per_candidate or seconds_per_candidate <= 0:
+        return
+    _MEASURED_SPC[engine] = float(seconds_per_candidate)
+    get_registry(registry).gauge(
+        "dprf_measured_spc",
+        "profiler-measured device seconds per candidate (roofline "
+        "fallback model for programs with no analyzed flop count and "
+        "no hand entry)", labelnames=("engine",)).set(
+            seconds_per_candidate, engine=engine)
+
+
+def measured_ops_per_candidate(engine: str) -> Optional[float]:
+    """Measured-cost fallback op model: device-s/candidate scaled by
+    the band CEILING, i.e. "if the chip issued at peak, this is what
+    the kernel's time is worth in ops".  Conservative by construction
+    -- the implied roofline fraction of the measured rate itself is
+    <= 1 -- and only consulted when neither an analyzed program nor a
+    hand entry exists."""
+    spc = _MEASURED_SPC.get(engine)
+    if not spc:
+        return None
+    return spc * CHIP_INT_OPS_BAND[1]
+
+
 def ops_per_candidate(engine: str, registry=None) -> Optional[float]:
     """The engine's roofline op model: the XLA-DERIVED value
     (telemetry/programs.py: optimized flops / candidates per dispatch)
     when a compiled program was analyzed in this process, else the
-    hand table.  When BOTH exist the divergence ratio is published so
-    a drifted hand model (or a mis-captured program) surfaces on
-    /metrics instead of silently skewing every roofline fraction.
-    Returns None only when the engine compiled nothing here AND has no
-    hand entry -- there is no silent per-engine skip list anymore."""
+    hand table, else the profiler-measured device-s/cand fallback
+    (``record_measured_cost``).  When analyzed AND hand exist the
+    divergence ratio is published so a drifted hand model (or a
+    mis-captured program) surfaces on /metrics instead of silently
+    skewing every roofline fraction.  Returns None only when the
+    engine compiled nothing here, has no hand entry, AND was never
+    covered by a profiler capture window."""
     from dprf_tpu.telemetry import programs as programs_mod
     analyzed = programs_mod.analyzed_ops_per_candidate(engine)
     hand = OPS_PER_CANDIDATE.get(engine)
     if analyzed and hand:
         ratio = max(analyzed, hand) / min(analyzed, hand)
         _divergence_gauge(registry).set(ratio, engine=engine)
-    return analyzed or hand
+    return analyzed or hand or measured_ops_per_candidate(engine)
 
 
 def roofline_band_hs(engine: str) -> Optional[tuple]:

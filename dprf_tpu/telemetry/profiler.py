@@ -487,6 +487,12 @@ def analyze_trace(path: str, engine: Optional[str] = None,
         if engine:
             out["divergence"] = publish_divergence(
                 engine, spc, registry=registry)
+            # feed the roofline fallback chain: programs whose HLO
+            # reports no flop count (probe-table steps) get an op
+            # model from this measured cost (perf.ops_per_candidate)
+            from dprf_tpu.telemetry import perf as perf_mod
+            perf_mod.record_measured_cost(engine, spc,
+                                          registry=registry)
     return out
 
 

@@ -154,6 +154,29 @@ _declare("DPRF_TUNE_DIR", None, "path",
          "Tuning-cache directory (default: the session journal's "
          "directory, else ~/.cache/dprf).")
 
+# -- multi-target probe tables -----------------------------------------------
+_declare("DPRF_TARGETS_FP_BUDGET", 1e-4, "float",
+         "Bloom false-positive budget the probe-table builder sizes "
+         "its blocked bitmap against (dprf_tpu/targets/probe.py); "
+         "smaller budgets spend more HBM on prefilter bits in "
+         "exchange for fewer exact-verify survivors.")
+_declare("DPRF_TARGETS_HEADROOM_FRAC", 0.5, "float",
+         "Fraction of the devstats free-HBM reading a probe table may "
+         "occupy; a table over the budget degrades to the bloom-only "
+         "host-verify layout instead of OOMing the device.")
+_declare("DPRF_TARGETS_MAX_BYTES", 0, "int",
+         "Hard byte cap for the device probe table (bloom bitmap + "
+         "exact-verify digest buckets); 0 means devstats-derived "
+         "headroom only.")
+_declare("DPRF_TARGETS_PROBE_MIN", 4096, "int",
+         "Target count at which mask workers switch from the "
+         "replicated compare_multi table to the probe-table path "
+         "(Bloom prefilter + bucketed exact verify).")
+_declare("DPRF_TARGETS_SURVIVOR_CAP", 0, "int",
+         "Fixed per-batch survivor-buffer length for prefilter "
+         "survivors awaiting exact verify; 0 sizes it from the "
+         "batch and the built table's false-positive estimate.")
+
 # -- observability -----------------------------------------------------------
 _declare("DPRF_DEVSTATS_POLL_S", 15.0, "float",
          "Seconds between device-memory polls (telemetry/devstats.py: "

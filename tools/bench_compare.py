@@ -47,6 +47,10 @@ def main(argv=None) -> int:
                     help="gate against the committed SCALING_r*.json "
                     "trajectory (multichip efficiency records) instead "
                     "of the BENCH throughput records")
+    ap.add_argument("--targets", action="store_true",
+                    help="gate against the committed TARGETS_r*.json "
+                    "trajectory (probe-table target-set-size sweep "
+                    "records) instead of the BENCH throughput records")
     ap.add_argument("--window", type=int, default=None, metavar="K")
     ap.add_argument("--quiet", "-q", action="store_true")
     args = ap.parse_args(argv)
@@ -55,7 +59,12 @@ def main(argv=None) -> int:
 
     repo = args.dir or compare.repo_root()
     window = args.window or compare.DEFAULT_WINDOW
-    pattern = compare.SCALING_PATTERN if args.scaling else "BENCH_r*.json"
+    if args.targets:
+        pattern = compare.TARGETS_PATTERN
+    elif args.scaling:
+        pattern = compare.SCALING_PATTERN
+    else:
+        pattern = "BENCH_r*.json"
     if args.dry:
         verdict = compare.gate_dry(repo, window=window, pattern=pattern)
     elif args.current:
