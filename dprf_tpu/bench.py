@@ -92,6 +92,16 @@ def _introspection_fields(engine: str, rate: float) -> dict:
     devstats.poll()
     peak, source = devstats.peak_hbm_bytes()
     frac = perf_mod.analyzed_roofline_fraction(engine, rate)
+    if frac is None and rate > 0 \
+            and perf_mod.ops_per_candidate(engine) is None:
+        # roofline-fallback seeding: engines whose optimized HLO
+        # reports no flop count (gather/bitwise-only pipelines) and
+        # have no hand entry would otherwise publish NO roofline at
+        # all -- seed the measured-cost model from this bench's own
+        # steady-state rate so the live fleet gets a dprf_roofline_frac
+        # gauge (a later profiler capture window overwrites it with a
+        # device-attributed measurement)
+        perf_mod.record_measured_cost(engine, 1.0 / rate)
     return {"peak_hbm_bytes": peak,
             "peak_hbm_source": source,
             "analyzed_roofline": round(frac, 4) if frac else None}
@@ -521,7 +531,9 @@ def run_targets_sweep(engine: str = "md5", mask: str = "?a?a?a?a?a?a",
 
 def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
                 n_devices: int = 8, batch_per_device="auto",
-                seconds: float = 5.0, inner: int = 8, log=None) -> dict:
+                seconds: float = 5.0, inner: int = 8,
+                impl: str = "auto", ablate: bool = False,
+                log=None) -> dict:
     """Scaling-efficiency mode over the ONE sharded runtime
     (parallel/sharded.py): superstep dispatches -- candidates
     generated on device per shard, device-resident hit buffer, one
@@ -552,12 +564,23 @@ def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
     per-batch compat program).  The per-dispatch phase split rides
     along as ``phases``: with on-device generation, ``h2d`` is one
     digit vector per window and its share should read ~0.
+
+    ``impl``: "xla" pins the generic sharded pipeline, "pallas" pins
+    the fused Pallas shard-compute (kernel bodies generate + hash +
+    compare per shard -- parallel/sharded.make_sharded_kernel_mask_step),
+    "auto" takes the kernel when this backend/engine is eligible.
+    ``ablate`` adds a per-batch (inner=1) mesh window after the main
+    measurement and reports ``superstep_speedup`` -- the ISSUE 18
+    dispatch-fusion ablation, measured on the same devices in the same
+    process.
     """
     import jax
     import jax.numpy as jnp
 
+    from dprf_tpu.ops import pallas_mask
     from dprf_tpu.parallel.mesh import make_mesh
-    from dprf_tpu.parallel.sharded import make_sharded_mask_step
+    from dprf_tpu.parallel.sharded import (make_sharded_kernel_mask_step,
+                                           make_sharded_mask_step)
 
     batch_per_device, tuned = _tuned_or(batch_per_device, engine, "jax",
                                         1 << 20,
@@ -575,14 +598,34 @@ def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
     inner = max(1, int(inner))
     widen = getattr(eng, "widen_utf16", False)
 
+    kmode = pallas_mask.pallas_mode()
+    eligible = (kmode is not None and engine in pallas_mask.CORES
+                and pallas_mask.kernel_eligible(engine, gen, 1))
+    if impl == "pallas" and not eligible:
+        raise ValueError(
+            "--impl pallas: sharded kernel compute not available here "
+            "(needs a kernel-capable engine and DPRF_PALLAS on/auto-TPU)")
+    use_kernel = impl == "pallas" or (impl == "auto" and eligible)
+    if use_kernel:
+        # shard batches are tile-quantized on the kernel path
+        tile = pallas_mask.SUB * 128
+        batch_per_device = max(tile,
+                               (batch_per_device // tile) * tile)
+
     from dprf_tpu.utils.sync import hard_sync
 
-    def build(devs):
-        step = make_sharded_mask_step(
-            eng, gen, tgt, make_mesh(devices=list(devs)),
-            batch_per_device, widen_utf16=widen)
-        fn = step.superstep(inner) if inner > 1 else step
-        return fn, step.super_batch * inner
+    def build(devs, inner_n=None):
+        inner_n = inner if inner_n is None else inner_n
+        m = make_mesh(devices=list(devs))
+        if use_kernel:
+            step = make_sharded_kernel_mask_step(
+                engine, gen, tgt, m, batch_per_device,
+                interpret=bool(kmode.get("interpret", False)))
+        else:
+            step = make_sharded_mask_step(
+                eng, gen, tgt, m, batch_per_device, widen_utf16=widen)
+        fn = step.superstep(inner_n) if inner_n > 1 else step
+        return fn, step.super_batch * inner_n
 
     def dispatch(fn, span, k):
         base = jnp.asarray(
@@ -644,6 +687,14 @@ def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
                    "compile_s": round(compile_ind, 1)}
     w, t = window(solo_builds[:1], budget)
     one = {"rate": w / t}
+    # superstep-vs-per-batch ablation (same devices, same process):
+    # the fusion win of draining `inner` batches per collective round
+    perbatch_rate = None
+    if ablate and inner > 1:
+        pb_build = build(devices[:n_devices], inner_n=1)
+        warm([pb_build], "per-batch")
+        w, t = window([pb_build], budget)
+        perbatch_rate = w / t
     # per-dispatch phase attribution of the mesh runtime (outside the
     # timed windows, compiled already): with on-device generation the
     # h2d phase is one tiny digit-vector transfer per window
@@ -671,6 +722,7 @@ def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
         "tuned": tuned,
         "inner": inner,
         "superstep": inner > 1,
+        "impl": "pallas" if use_kernel else "xla",
         "baseline": "independent",
         "rate_1chip": one["rate"],
         "rate_ndev": many["rate"],
@@ -688,6 +740,9 @@ def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
         # over unity
         **_introspection_fields(engine, many["rate"] / n_devices),
     }
+    if perbatch_rate:
+        out["rate_ndev_perbatch"] = perbatch_rate
+        out["superstep_speedup"] = round(many["rate"] / perbatch_rate, 4)
     if platform != "tpu":
         out["note"] = (
             "virtual CPU mesh: the 'devices' share the host cores, so "

@@ -203,6 +203,12 @@ def _build_parser() -> argparse.ArgumentParser:
     b.add_argument("--devices", type=int, default=1, metavar="N",
                    help="scaling mode: measure the sharded step at 1 "
                    "and N chips and report per-chip rate + efficiency")
+    b.add_argument("--inner", type=int, default=8, metavar="K",
+                   help="scaling mode: batches fused per superstep "
+                   "dispatch (1 = the per-batch compat program)")
+    b.add_argument("--ablate", action="store_true",
+                   help="scaling mode: also time a per-batch (inner=1) "
+                   "mesh window and report superstep_speedup")
     b.add_argument("--bcrypt-cost", type=int, default=12,
                    help="cost for --config 4 (lower it off-TPU)")
     b.add_argument("--targets-sweep", action="store_true",
@@ -271,6 +277,24 @@ def _build_parser() -> argparse.ArgumentParser:
                     metavar="S", help="skip rungs whose warmup/compile "
                     "exceeds S seconds (and stop climbing)")
     tn.add_argument("--hit-cap", type=int, default=64)
+    tn.add_argument("--attack", default="mask",
+                    choices=("mask", "wordlist", "combinator"),
+                    help="attack shape to tune; wordlist/combinator "
+                    "probe over a synthetic in-memory word source "
+                    "(bench config 3's trick), so the sweep measures "
+                    "the device pipeline, never file I/O")
+    tn.add_argument("--rungs", default="batch",
+                    choices=("batch", "inner", "sub"),
+                    help="quantity to sweep: the device batch ladder "
+                    "(default), the multi-batch superstep `inner` "
+                    "fusion window, or the Pallas kernel tile size "
+                    "(sublanes per tile)")
+    tn.add_argument("--rules", default="best64",
+                    help="builtin rule set shaping --attack wordlist "
+                    "probes")
+    tn.add_argument("--words", type=int, default=1 << 14,
+                    help="synthetic word-source size for "
+                    "wordlist/combinator tuning probes")
     tn.add_argument("--tune-dir", default=None,
                     help="cache directory (default: $DPRF_TUNE_DIR or "
                     "~/.cache/dprf)")
@@ -832,6 +856,24 @@ def _align_unit_size(unit_size: int, attack: str, gen) -> int:
     return max(gen.n_rules, (unit_size // gen.n_rules) * gen.n_rules)
 
 
+def _apply_tuned_inner(worker, engine_name: str, attack: str, gen,
+                       hit_cap: int, log: Log):
+    """Warm-start the multi-batch superstep fusion window from a
+    `dprf tune --rungs inner` record.  SUPER_CAP bounds a worker's
+    _super_inner window, so the instance override takes effect without
+    touching the DPRF_SUPER_CAP env knob; a cache miss (or a worker
+    with no superstep) leaves the default standing."""
+    from dprf_tpu import tune as tune_mod
+    inner = tune_mod.lookup_tuned_value(
+        engine_name, "inner", attack=attack, device="jax",
+        extras=_tune_extras(attack, hit_cap=hit_cap,
+                            n_rules=getattr(gen, "n_rules", None)))
+    if inner and hasattr(worker, "SUPER_CAP"):
+        worker.SUPER_CAP = int(inner)
+        log.info("tuned superstep window", inner=int(inner))
+    return worker
+
+
 def _select_worker(engine_name: str, device: str, attack: str, gen,
                    targets, batch: int, hit_cap: int, oracle, n_devices: int,
                    log: Log):
@@ -873,14 +915,19 @@ def _select_worker(engine_name: str, device: str, attack: str, gen,
             log.info("mesh", devices=n_devices)
             per_dev = (max(1, batch // gen.n_rules)
                        if attack == "wordlist" else batch)
-            return getattr(dev_engine, smaker)(
-                gen, targets, mesh, per_dev,
-                hit_capacity=hit_cap, oracle=oracle)
+            return _apply_tuned_inner(
+                getattr(dev_engine, smaker)(
+                    gen, targets, mesh, per_dev,
+                    hit_capacity=hit_cap, oracle=oracle),
+                engine_name, attack, gen, hit_cap, log)
         log.warn("engine has no multi-chip pipeline; using one chip",
                  engine=engine_name)
     if dev_engine is not None and callable(getattr(dev_engine, maker_name, None)):
-        return getattr(dev_engine, maker_name)(
-            gen, targets, batch=batch, hit_capacity=hit_cap, oracle=oracle)
+        return _apply_tuned_inner(
+            getattr(dev_engine, maker_name)(
+                gen, targets, batch=batch, hit_capacity=hit_cap,
+                oracle=oracle),
+            engine_name, attack, gen, hit_cap, log)
     if device == "jax":
         log.warn("no jax engine for algorithm/attack; using cpu oracle",
                  engine=engine_name)
@@ -1709,7 +1756,9 @@ def cmd_bench(args, log: Log) -> int:
             res = run_scaling(engine=args.engine, mask=args.mask,
                               n_devices=args.devices,
                               batch_per_device=args.batch,
-                              seconds=args.seconds, log=log)
+                              seconds=args.seconds, inner=args.inner,
+                              impl=args.impl, ablate=args.ablate,
+                              log=log)
         elif args.config is not None:
             res = run_config(args.config,
                              device=_DEVICE_ALIASES[args.device],
@@ -1763,16 +1812,48 @@ def cmd_bench(args, log: Log) -> int:
     return 0
 
 
-def _tune_one(engine_name: str, args, device: str, log: Log) -> dict:
-    """Sweep one engine's batch ladder and record the winner; returns
-    the result JSON dict.  Raises ValueError for engines this
-    invocation cannot tune (salted targets without --hashfile, every
-    rung failing) -- `--all` reports those as skipped."""
-    from dprf_tpu import tune as tune_mod
-    from dprf_tpu.tune import geometric_ladder, record_tuned_batch, sweep
+def _tune_generator(attack: str, args):
+    """Generator shaping a tuning probe.  wordlist/combinator reuse
+    bench's synthetic in-memory word source (config 3's trick) so the
+    sweep measures the device pipeline, not disk I/O; the source is
+    deterministic, so cache records stay comparable across runs."""
+    if attack == "mask":
+        return MaskGenerator(args.mask)
+    from dprf_tpu.bench import _synthetic_words
+    if attack == "wordlist":
+        from dprf_tpu.generators.wordlist import WordlistRulesGenerator
+        from dprf_tpu.rules.parser import load_rules
+        return WordlistRulesGenerator(_synthetic_words(args.words),
+                                      load_rules(args.rules),
+                                      max_len=24)
+    from dprf_tpu.generators.combinator import CombinatorGenerator
+    words = _synthetic_words(args.words)
+    return CombinatorGenerator(words, words, max_len=24)
 
+
+#: superstep `inner` fusion-window rungs (dprf tune --rungs inner) --
+#: unordered knob values, so sweep_values probes them all
+_INNER_RUNGS = (4, 8, 16, 32, 64, 128, 256)
+#: Pallas kernel tile-size rungs (sublanes per tile; tile = sub * 128)
+_SUB_RUNGS = (8, 16, 32, 64, 128)
+
+
+def _tune_one(engine_name: str, args, device: str, log: Log) -> dict:
+    """Sweep one engine's rungs and record the winner; returns the
+    result JSON dict.  ``--rungs batch`` climbs the geometric batch
+    ladder; ``--rungs inner`` sweeps the multi-batch superstep fusion
+    window (workers' SUPER_CAP); ``--rungs sub`` sweeps the Pallas
+    kernel tile size.  Raises ValueError for engines this invocation
+    cannot tune (salted targets without --hashfile, every rung
+    failing) -- `--all` reports those as skipped."""
+    from dprf_tpu import tune as tune_mod
+    from dprf_tpu.tune import (geometric_ladder, record_tuned_batch,
+                               record_tuned_value, sweep, sweep_values)
+
+    attack = getattr(args, "attack", "mask")
+    rungs = getattr(args, "rungs", "batch")
     oracle = get_engine(engine_name, device="cpu")
-    gen = MaskGenerator(args.mask)
+    gen = _tune_generator(attack, args)
     if args.hashfile:
         hl = _load_targets(oracle, args.hashfile, log)
         if hl is None:
@@ -1788,36 +1869,115 @@ def _tune_one(engine_name: str, args, device: str, log: Log) -> dict:
                 "targets need salts/params; pass --hashfile with real "
                 "target lines to tune against") from None
 
+    extras = _tune_extras(attack, hit_cap=args.hit_cap,
+                          n_rules=getattr(gen, "n_rules", None))
+
     def make_worker(batch: int):
         if device == "cpu":
             return CpuWorker(oracle, gen, targets, chunk=batch)
-        return _select_worker(engine_name, device, "mask", gen, targets,
+        return _select_worker(engine_name, device, attack, gen, targets,
                               batch, args.hit_cap, oracle, 1, log)
 
-    ladder = geometric_ladder(args.min_batch, args.max_batch,
-                              args.ladder_factor)
-    log.info("tuning", engine=engine_name, device=device,
-             ladder=",".join(str(b) for b in ladder))
-    result = sweep(make_worker, gen.keyspace, ladder,
-                   probe_seconds=args.seconds,
-                   compile_budget_s=args.compile_budget, log=log)
-    extras = _tune_extras("mask", hit_cap=args.hit_cap)
-    path = record_tuned_batch(engine_name, "mask", device, result,
-                              extras=extras)
-    log.info("tuned", engine=engine_name, batch=result.batch,
+    knob = None
+    if rungs == "batch":
+        ladder = geometric_ladder(args.min_batch, args.max_batch,
+                                  args.ladder_factor)
+        log.info("tuning", engine=engine_name, device=device,
+                 attack=attack,
+                 ladder=",".join(str(b) for b in ladder))
+        result = sweep(make_worker, gen.keyspace, ladder,
+                       probe_seconds=args.seconds,
+                       compile_budget_s=args.compile_budget, log=log)
+        path = record_tuned_batch(engine_name, attack, device, result,
+                                  extras=extras)
+        key = tune_mod.make_key(engine_name, attack=attack,
+                                device=device, **extras)
+    else:
+        knob = rungs
+        # knob sweeps run at the already-tuned (or default) batch, so
+        # the winner composes with a prior `--rungs batch` record;
+        # --max-batch still caps it (CI smokes keep probe units small)
+        batch = min(args.max_batch,
+                    tune_mod.lookup_tuned_batch(
+                        engine_name, attack=attack, device=device,
+                        extras=extras)
+                    or DEFAULT_BATCH)
+        if rungs == "inner":
+            values = [v for v in _INNER_RUNGS]
+
+            def mk_inner(v: int):
+                w = make_worker(batch)
+                # SUPER_CAP bounds _super_inner's window; the instance
+                # override beats the class default / env knob for this
+                # probe only
+                w.SUPER_CAP = int(v)
+                return w
+
+            log.info("tuning", engine=engine_name, device=device,
+                     attack=attack, knob="inner", batch=batch,
+                     values=",".join(str(v) for v in values))
+            result = sweep_values(
+                mk_inner, values, gen.keyspace,
+                probe_seconds=args.seconds,
+                compile_budget_s=args.compile_budget,
+                unit_strides=max(values), log=log, label="inner")
+        else:                    # rungs == "sub"
+            if attack != "mask" or device == "cpu":
+                raise ValueError("--rungs sub tunes the Pallas mask "
+                                 "kernel tile; use --attack mask with "
+                                 "a device backend")
+            from dprf_tpu.ops.pallas_mask import pallas_mode
+            mode = pallas_mode()
+            if mode is None:
+                raise ValueError("Pallas kernels unavailable on this "
+                                 "backend (see DPRF_PALLAS)")
+            try:
+                dev_engine = get_engine(engine_name, device="jax")
+            except KeyError:
+                raise ValueError(
+                    f"no jax engine named {engine_name!r}") from None
+            from dprf_tpu.runtime.worker import PallasMaskWorker
+            values = [v for v in _SUB_RUNGS if v * 128 <= batch]
+
+            def mk_sub(v: int):
+                w = PallasMaskWorker(dev_engine, gen, targets,
+                                     batch=batch,
+                                     hit_capacity=args.hit_cap,
+                                     oracle=oracle, sub=v, **mode)
+                w.warmup()
+                return w
+
+            log.info("tuning", engine=engine_name, device=device,
+                     attack=attack, knob="sub", batch=batch,
+                     values=",".join(str(v) for v in values))
+            result = sweep_values(
+                mk_sub, values, gen.keyspace,
+                probe_seconds=args.seconds,
+                compile_budget_s=args.compile_budget, log=log,
+                label="sub")
+        path = record_tuned_value(engine_name, knob, attack, device,
+                                  result, extras=extras)
+        key = tune_mod.make_key(engine_name, attack=attack,
+                                device=device, knob=knob, **extras)
+    log.info("tuned", engine=engine_name,
+             **{knob or "batch": result.batch},
              rate=f"{result.rate_hs:,.0f}/s", cache=path)
-    return {
+    out = {
         "engine": engine_name,
         "device": device,
+        "attack": attack,
         "env": tune_mod.env_fingerprint(engine_name, device),
-        "key": tune_mod.make_key(engine_name, attack="mask",
-                                 device=device, **extras),
+        "key": key,
         "batch": result.batch,
         "rate_hs": result.rate_hs,
         "compile_s": round(result.compile_s, 3),
         "swept": [p.as_dict() for p in result.swept],
         "cache": path,
     }
+    if knob:
+        out["knob"] = knob
+        out["value"] = result.batch
+    return out
 
 
 def cmd_tune(args, log: Log) -> int:

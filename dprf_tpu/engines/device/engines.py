@@ -48,7 +48,46 @@ class GenericWorkerFactories:
     def make_sharded_mask_worker(self, gen, targets, mesh,
                                  batch_per_device: int, hit_capacity: int,
                                  oracle=None):
+        """Sharded mask worker; kernel-capable jobs run the FUSED
+        PALLAS KERNEL as the per-shard compute (parallel/sharded.
+        make_sharded_kernel_mask_step) -- the single-chip
+        make_mask_worker routing ladder at mesh scale, with the XLA
+        sharded runtime as the not-eligible / build-failure fallback.
+        Bulk lists (probe_eligible) stay on the XLA probe-table
+        compute; the in-kernel blocked probe covers 2..MAX_TARGETS
+        and needs an oracle to verify its sentinel survivors."""
+        from dprf_tpu.ops.pallas_mask import kernel_eligible, pallas_mode
         from dprf_tpu.parallel.worker import ShardedMaskWorker
+        from dprf_tpu.targets import probe as probe_mod
+        from dprf_tpu.utils.logging import DEFAULT as log
+        mode = pallas_mode()
+        if mode is not None and probe_mod.probe_eligible(targets, self):
+            log.info("bulk target list routes to the sharded "
+                     "probe-table XLA pipeline", engine=self.name,
+                     targets=len(targets))
+        elif mode is not None and not kernel_eligible(self.name, gen,
+                                                      len(targets)):
+            log.info("pallas kernel not eligible for this sharded "
+                     "job; using the XLA pipeline", engine=self.name,
+                     targets=len(targets))
+        elif mode is not None and len(targets) > 1 and oracle is None:
+            log.info("sharded multi-target kernel needs an oracle to "
+                     "verify probe survivors; using the XLA pipeline",
+                     engine=self.name, targets=len(targets))
+        elif mode is not None:
+            try:
+                worker = ShardedMaskWorker(
+                    self, gen, targets, mesh,
+                    batch_per_device=batch_per_device,
+                    hit_capacity=hit_capacity, oracle=oracle,
+                    kernel=dict(mode))
+                worker.warmup()
+                return worker
+            except Exception as e:
+                log.warn("sharded kernel compute failed to "
+                         "build/compile; falling back to the XLA "
+                         "pipeline", engine=self.name,
+                         error=f"{type(e).__name__}: {e}")
         return ShardedMaskWorker(self, gen, targets, mesh,
                                  batch_per_device=batch_per_device,
                                  hit_capacity=hit_capacity, oracle=oracle)
@@ -159,11 +198,17 @@ class JaxEngineBase(GenericWorkerFactories, DeviceHashEngine, HashEngine):
                      "verify Bloom maybes; using the XLA pipeline",
                      engine=self.name, targets=len(targets))
         elif mode is not None:
+            from dprf_tpu import tune as tune_mod
             from dprf_tpu.runtime.worker import PallasMaskWorker
+            # tuned tile size (dprf tune --rungs sub): a cache miss
+            # returns None and the kernel default stands
+            sub = tune_mod.lookup_tuned_value(
+                self.name, "sub", attack="mask",
+                extras={"hit_cap": int(hit_capacity)})
             try:
                 worker = PallasMaskWorker(self, gen, targets, batch=batch,
                                           hit_capacity=hit_capacity,
-                                          oracle=oracle, **mode)
+                                          oracle=oracle, sub=sub, **mode)
                 worker.warmup()
                 return worker
             except Exception as e:

@@ -54,9 +54,12 @@ def make_combinator_crack_step(engine, gen,
     (count, lanes int32[cap], tpos int32[cap]) -- the DeviceMaskWorker
     contract, so the standard worker machinery drives it unchanged."""
     from dprf_tpu.ops import pack as pack_ops
+    from dprf_tpu.targets import probe as probe_mod
 
     lbuf, llens, rbuf, rlens = map(jnp.asarray, gen.tables())
     multi = isinstance(targets, cmp_ops.TargetTable)
+    probe = isinstance(targets, probe_mod.ProbeTable)
+    survivors = probe_mod.survivor_cap(targets, batch) if probe else 0
 
     @jax.jit
     def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray):
@@ -66,13 +69,16 @@ def make_combinator_crack_step(engine, gen,
             cand = pack_ops.utf16le_widen(cand)
             lengths = lengths * 2
         digest = engine.digest_candidates(cand, lengths)
+        valid = fits & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
+        if probe:
+            return probe_mod.probe_hits(digest, targets, valid,
+                                        hit_capacity, survivors)
         if multi:
             found, tpos = cmp_ops.compare_multi(digest, targets)
         else:
             found = cmp_ops.compare_single(digest, targets)
             tpos = jnp.zeros((batch,), jnp.int32)
-        found = found & fits & (jnp.arange(batch, dtype=jnp.int32) < n_valid)
-        return cmp_ops.compact_hits(found, tpos, hit_capacity)
+        return cmp_ops.compact_hits(found & valid, tpos, hit_capacity)
 
     return step
 
@@ -84,11 +90,15 @@ def make_sharded_combinator_crack_step(
     """Multi-chip combinator step through the ONE sharded runtime
     (parallel/sharded.py): only the per-shard compute lives here."""
     from dprf_tpu.ops import pack as pack_ops
-    from dprf_tpu.parallel.sharded import make_sharded_step
+    from dprf_tpu.parallel.sharded import (make_sharded_step,
+                                           probe_lane_compare)
+    from dprf_tpu.targets import probe as probe_mod
 
     lbuf, llens, rbuf, rlens = map(jnp.asarray, gen.tables())
     multi = isinstance(targets, cmp_ops.TargetTable)
+    probe = isinstance(targets, probe_mod.ProbeTable)
     B = batch_per_device
+    _probe_compute = probe_lane_compare(targets, B) if probe else None
 
     def compute(offset, base_digits, n_valid):
         cand, lengths, fits = _decode_combine(
@@ -98,13 +108,17 @@ def make_sharded_combinator_crack_step(
             cand = pack_ops.utf16le_widen(cand)
             lengths = lengths * 2
         digest = engine.digest_candidates(cand, lengths)
+        lane = offset + jnp.arange(B, dtype=jnp.int32)
+        valid = fits & (lane < n_valid)
+        if probe:
+            return _probe_compute(
+                digest, probe_mod.bloom_maybe(digest, targets) & valid)
         if multi:
             found, tpos = cmp_ops.compare_multi(digest, targets)
         else:
             found = cmp_ops.compare_single(digest, targets)
             tpos = jnp.zeros((B,), jnp.int32)
-        lane = offset + jnp.arange(B, dtype=jnp.int32)
-        return found & fits & (lane < n_valid), tpos
+        return found & valid, tpos
 
     step = make_sharded_step(compute, mesh, B, 2,
                              hit_capacity=hit_capacity)

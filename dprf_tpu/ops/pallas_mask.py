@@ -90,6 +90,14 @@ SET_SIZE = 1024
 #: set: ceil(N/1024) * 8 gathers per tile).
 MAX_TARGETS = 8192
 
+#: 128-block groups the in-kernel blocked-probe bitmap may span.  The
+#: kernel gathers a lane's 512-bit block with one take_along_axis per
+#: (group, word) pair, so groups bound both the gather count (16 per
+#: group) and the bitmap footprint (64 KiB at 8 groups) -- VMEM-small
+#: and constant in N.  At MAX_TARGETS the capped bitmap still reaches
+#: the DPRF_PALLAS_PROBE_FP budget (~4e-8 analytic at 8192 keys).
+KERNEL_PROBE_GROUPS = 8
+
 
 def check_batch(batch: int, sub: int) -> int:
     """Shared guard for every packed-output mask kernel factory
@@ -360,6 +368,94 @@ def _probe_bits(digest, p: int):
     return bits & jnp.uint32(0xFFF)
 
 
+def kernel_probe_rows(twords: np.ndarray, fp: Optional[float] = None):
+    """Target digest words uint32[N, W] -> the PR 14 blocked-Bloom
+    probe bitmap in the kernel's lane-major layout.
+
+    The bit layout is targets/probe.bloom_fill -- the SAME bits the XLA
+    ProbeTable path sets -- transposed so the BLOCK index runs along
+    the 128-lane axis: row g*BLOCK_WORDS + w, lane b holds word w of
+    block g*128 + b.  A lane's whole 512-bit block then gathers with
+    one take_along_axis per (group, word) pair, the proven S-box
+    idiom, and the k double-hashed probes resolve inside registers.
+
+    Sized by DPRF_PALLAS_PROBE_FP (NOT the XLA path's
+    DPRF_TARGETS_FP_BUDGET): a superstep window drains through a tiny
+    device-resident hit buffer, so false maybes must be rare per
+    *window*, not merely per batch.  Capped at KERNEL_PROBE_GROUPS
+    groups so the gather tree stays bounded.
+
+    Returns (rows uint32[n_grp * BLOCK_WORDS, 128], block_bits, k,
+    n_grp, fp_est)."""
+    from dprf_tpu.targets import probe as probe_mod
+    if fp is None:
+        fp = envreg.get_float("DPRF_PALLAS_PROBE_FP")
+    n = int(twords.shape[0])
+    max_bits = KERNEL_PROBE_GROUPS * 128 * probe_mod.BLOCK_BITS
+    m_bits, k, fp_est = probe_mod.kernel_bloom_geometry(n, fp, max_bits)
+    words = probe_mod.bloom_fill(np.ascontiguousarray(twords), m_bits, k)
+    bw = probe_mod.BLOCK_WORDS
+    n_blocks = m_bits // probe_mod.BLOCK_BITS
+    block_bits = n_blocks.bit_length() - 1
+    n_grp = max(1, n_blocks // 128)
+    if n_blocks < 128:
+        # pad to one full 128-block group: block indices stay below
+        # n_blocks, so the zero lanes are never addressed
+        pad = np.zeros(128 * bw, np.uint32)
+        pad[:words.size] = words
+        words = pad
+    rows = words.reshape(n_grp, 128, bw).transpose(0, 2, 1)
+    return (np.ascontiguousarray(rows).reshape(n_grp * bw, 128),
+            block_bits, k, n_grp, fp_est)
+
+
+def probe_block_found(digest, rows, valid, block_bits: int, k: int,
+                      n_grp: int, shape):
+    """In-kernel blocked-Bloom probe over kernel_probe_rows state: a
+    lane survives iff all k double-hashed bits of its block are set.
+    Real hits always survive (their bits were set from the matching
+    target's own digest words); the caller treats survivors as
+    sentinel-tagged maybes and verifies each with one host oracle
+    hash, so a false positive can never surface as a hit."""
+    from dprf_tpu.targets.probe import BLOCK_BITS, BLOCK_WORDS, _GOLDEN
+    h1 = digest[0]
+    h2 = digest[1] | jnp.uint32(1)
+    # the alternating probe pairs of targets/probe.bloom_fill
+    h3 = digest[2] if len(digest) > 3 else h1
+    h4 = (digest[3] | jnp.uint32(1)) if len(digest) > 3 else h2
+    if block_bits:
+        block = ((h1 * jnp.uint32(_GOLDEN))
+                 >> jnp.uint32(32 - block_bits)).astype(jnp.int32)
+    else:
+        block = jnp.zeros(shape, jnp.int32)
+    lane_idx = block & 127
+    grp = block >> 7
+    # gather the lane's full 512-bit block: one per-sublane gather per
+    # (group, word), selected by the lane's group index
+    bw = []
+    for w in range(BLOCK_WORDS):
+        acc = None
+        for g in range(n_grp):
+            row = jnp.broadcast_to(rows[g * BLOCK_WORDS + w][None, :],
+                                   shape)
+            got = jnp.take_along_axis(row, lane_idx, axis=1)
+            acc = got if acc is None else jnp.where(grp == g, got, acc)
+        bw.append(acc)
+    found = valid
+    for j in range(k):
+        i = j >> 1
+        a, b = (h3, h4) if j & 1 else (h1, h2)
+        g = a + jnp.uint32(2 * i + 1) * b
+        bit = g & jnp.uint32(BLOCK_BITS - 1)
+        widx = (bit >> jnp.uint32(5)).astype(jnp.int32)
+        word = bw[0]
+        for w in range(1, BLOCK_WORDS):
+            word = jnp.where(widx == w, bw[w], word)
+        found = found & (((word >> (bit & jnp.uint32(31)))
+                          & jnp.uint32(1)) == 1)
+    return found
+
+
 # piecewise charset lookup shared with the generator's XLA mux
 _decode_byte = segment_mux
 
@@ -436,12 +532,23 @@ def _pack_message(byts, length: int, shape, big_endian: bool,
 
 
 def _build_kernel_body(engine_name: str, radices, seg_tables, length: int,
-                       target, sub: int):
-    """The kernel math as a PURE function of (pid, base digits, n_valid)
-    -> (count, hit_lane) scalars.  Shared verbatim by the pallas_call
-    wrapper (TPU) and by emulate_mask_kernel (eager CPU validation --
-    XLA:CPU cannot compile the statically-unrolled SHA-256 graph in
-    reasonable time, so correctness tests drive this body op-by-op)."""
+                       target, sub: int, probe=None):
+    """The kernel math as a PURE function of (pid, base digits, n_valid
+    [, offset]) -> (count, hit_lane) scalars.  Shared verbatim by the
+    pallas_call wrapper (TPU) and by emulate_mask_kernel (eager CPU
+    validation -- XLA:CPU cannot compile the statically-unrolled
+    SHA-256 graph in reasonable time, so correctness tests drive this
+    body op-by-op).
+
+    probe: None for the per-set Bloom prefilter, or the
+    (block_bits, k, n_grp) geometry from kernel_probe_rows -- the
+    multi-target compare then runs the blocked probe (`tables` holds
+    the probe rows) and every survivor is a sentinel maybe.
+
+    An `offset` scalar (the sharded/superstep window start) shifts
+    both the decoded keyspace index and the validity bound, so ONE
+    compiled kernel serves every window of a superstep; hit_lane stays
+    tile-relative (the caller adds tile * pid + offset back)."""
     core, n_words, big_endian, widen = CORES[engine_name]
     tile = sub * 128
     target = np.asarray(target)
@@ -457,23 +564,30 @@ def _build_kernel_body(engine_name: str, radices, seg_tables, length: int,
             raise ValueError(f"{engine_name}: expected {n_words} "
                              "target words")
 
-    def kernel_body(pid, base, n_valid, tables=None, luts=None):
+    def kernel_body(pid, base, n_valid, tables=None, luts=None,
+                    offset=None):
         shape = (sub, 128)
         lane = (jax.lax.broadcasted_iota(jnp.int32, shape, 0) * 128
                 + jax.lax.broadcasted_iota(jnp.int32, shape, 1))
         # The base index of this *tile* is folded into the scalar side
-        # (pid * tile) before vector carry propagation.
-        carry = lane + pid * tile
+        # (pid * tile, plus the window offset) before vector carry
+        # propagation.
+        gidx = lane + pid * tile
+        if offset is not None:
+            gidx = gidx + offset
         byts = decode_candidate_bytes(radices, seg_tables, length,
-                                      base, carry, luts)
+                                      base, gidx, luts)
         m = _pack_message(byts, length, shape, big_endian, widen,
                           32 if engine_name in WIDE_BLOCK else 16)
         digest = core(m, shape)
-        valid = (lane + pid * tile) < n_valid
+        valid = gidx < n_valid
         if not multi:
             found = valid
             for got, want in zip(digest, tw):
                 found = found & (got == jnp.uint32(want))
+        elif probe is not None:
+            found = probe_block_found(digest, tables, valid, *probe,
+                                      shape)
         else:
             found = bloom_found(digest, tables, valid, n_sets, shape)
         count = jnp.sum(found.astype(jnp.int32))
@@ -487,14 +601,16 @@ def _build_kernel_body(engine_name: str, radices, seg_tables, length: int,
 
 def _build_kernel(engine_name: str, radices, seg_tables, length: int,
                   target, sub: int, multi: bool = False,
-                  has_lut: bool = False):
+                  has_lut: bool = False, with_offset: bool = False,
+                  probe=None):
     """pallas_call kernel wrapper around the pure body.  Optional
     positional inputs follow (base, n_valid) in a fixed order: the
-    Bloom tables (multi-target), then the charset LUT rows (masks with
-    positions past the segment budget -- pallas_call forbids captured
-    vector constants, so the LUT is a real input)."""
+    window offset scalar (sharded/superstep callers), then the Bloom
+    or probe tables (multi-target), then the charset LUT rows (masks
+    with positions past the segment budget -- pallas_call forbids
+    captured vector constants, so the LUT is a real input)."""
     body = _build_kernel_body(engine_name, radices, seg_tables, length,
-                              target, sub)
+                              target, sub, probe=probe)
 
     # Mosaic requires output blocks of (8k, 128m) lanes (or whole-array),
     # so the two per-tile scalars are packed into one int32 --
@@ -505,10 +621,13 @@ def _build_kernel(engine_name: str, radices, seg_tables, length: int,
     def kernel(base_ref, nvalid_ref, *rest):
         out_ref = rest[-1]
         extras = list(rest[:-1])
+        offset_ref = extras.pop(0) if with_offset else None
         tables_ref = extras.pop(0) if multi else None
         luts_ref = extras.pop(0) if has_lut else None
-        count, hit_lane = body(pl.program_id(0), base_ref,
-                               nvalid_ref[0], tables_ref, luts_ref)
+        count, hit_lane = body(
+            pl.program_id(0), base_ref, nvalid_ref[0], tables_ref,
+            luts_ref,
+            offset_ref[0] if offset_ref is not None else None)
         packed = (count << 16) | (hit_lane + 1)
         out_ref[...] = jnp.full((8, 128), packed, jnp.int32)
 
@@ -517,25 +636,40 @@ def _build_kernel(engine_name: str, radices, seg_tables, length: int,
 
 def emulate_mask_kernel(engine_name: str, gen, target_words: np.ndarray,
                         batch: int, base_digits, n_valid: int,
-                        sub: int = SUB):
+                        sub: int = SUB, offset: int = 0,
+                        probe_fp: Optional[float] = None):
     """Run the kernel body eagerly (no pallas_call, no jit) over every
     grid cell; returns (counts int32[G,1], hit_lanes int32[G,1]) with
-    the exact layout pallas_call produces.  Test/validation vehicle."""
+    the exact layout pallas_call produces.  Test/validation vehicle.
+
+    offset / probe_fp mirror make_mask_pallas_fn's with_offset and
+    probe-compare modes, so the sharded kernel bodies validate through
+    the same eager loop off-TPU."""
     tile = sub * 128
     if batch % tile:
         raise ValueError(f"batch {batch} not a multiple of tile {tile}")
     target_words = np.asarray(target_words)
     multi = target_words.ndim == 2 and target_words.shape[0] > 1
-    tables = jnp.asarray(bloom_tables(target_words)) if multi else None
+    probe = None
+    if multi and probe_fp is not None:
+        rows, block_bits, k, n_grp, _ = kernel_probe_rows(
+            target_words, probe_fp)
+        tables = jnp.asarray(rows)
+        probe = (block_bits, k, n_grp)
+    else:
+        tables = (jnp.asarray(bloom_tables(target_words))
+                  if multi else None)
     seg_tables, luts_np = position_tables(gen.charsets)
     luts = jnp.asarray(luts_np) if luts_np is not None else None
     body = _build_kernel_body(engine_name, gen.radices, seg_tables,
-                              gen.length, target_words, sub)
+                              gen.length, target_words, sub,
+                              probe=probe)
     base = jnp.asarray(base_digits, jnp.int32)
+    off = jnp.int32(offset) if offset else None
     counts, lanes = [], []
     for pid in range(batch // tile):
         c, l = body(jnp.int32(pid), base, jnp.int32(n_valid), tables,
-                    luts)
+                    luts, off)
         counts.append(int(c))
         lanes.append(int(l))
     return (np.asarray(counts, np.int32)[:, None],
@@ -544,15 +678,24 @@ def emulate_mask_kernel(engine_name: str, gen, target_words: np.ndarray,
 
 def make_mask_pallas_fn(engine_name: str, gen, target_words: np.ndarray,
                         batch: int, sub: int = SUB,
-                        interpret: bool = False):
-    """Build fn(base_digits int32[L], n_valid int32[1]) ->
-    (counts int32[G, 1], hit_lanes int32[G, 1]) over a `batch`-lane
-    sweep.  batch must be a multiple of sub*128.
+                        interpret: bool = False,
+                        with_offset: bool = False,
+                        probe_fp: Optional[float] = None):
+    """Build fn(base_digits int32[L], n_valid int32[1][, offset
+    int32[1]]) -> (counts int32[G, 1], hit_lanes int32[G, 1]) over a
+    `batch`-lane sweep.  batch must be a multiple of sub*128.
 
     target_words uint32[W] (single target: counts are exact hit counts)
     or uint32[N, W] (multi target: counts are Bloom maybe-counts; see
     reduce_tile_maybes for the caller contract).
-    """
+
+    with_offset adds the traced window-start scalar (SMEM, like
+    n_valid): candidates decode from base + offset + lane and validity
+    checks against the WINDOW n_valid, so sharded shards and superstep
+    iterations reuse one compiled kernel.  probe_fp switches the
+    multi-target compare to the blocked-probe bitmap
+    (kernel_probe_rows): counts become probe maybe-counts at that fp
+    budget."""
     tile = sub * 128
     grid = check_batch(batch, sub)
     target_words = np.asarray(target_words)
@@ -563,16 +706,26 @@ def make_mask_pallas_fn(engine_name: str, gen, target_words: np.ndarray,
                          "use the XLA path")
     seg_tables, luts_np = position_tables(gen.charsets)
     has_lut = luts_np is not None
+    probe = None
+    if multi and probe_fp is not None:
+        tables, block_bits, k, n_grp, _ = kernel_probe_rows(
+            target_words, probe_fp)
+        probe = (block_bits, k, n_grp)
+    elif multi:
+        tables = bloom_tables(target_words)
     kernel = _build_kernel(engine_name, gen.radices, seg_tables,
                            gen.length, target_words, sub, multi=multi,
-                           has_lut=has_lut)
+                           has_lut=has_lut, with_offset=with_offset,
+                           probe=probe)
     L = gen.length
     in_specs = [
         pl.BlockSpec((L,), lambda i: (0,), memory_space=pltpu.SMEM),
         pl.BlockSpec((1,), lambda i: (0,), memory_space=pltpu.SMEM),
     ]
+    if with_offset:
+        in_specs.append(
+            pl.BlockSpec((1,), lambda i: (0,), memory_space=pltpu.SMEM))
     if multi:
-        tables = bloom_tables(target_words)
         R = tables.shape[0]
         in_specs.append(pl.BlockSpec((R, 128), lambda i: (0, 0)))
     if has_lut:
@@ -592,8 +745,11 @@ def make_mask_pallas_fn(engine_name: str, gen, target_words: np.ndarray,
     tables_dev = jnp.asarray(tables) if multi else None
     luts_dev = jnp.asarray(luts_np) if has_lut else None
 
-    def fn(base_digits, n_valid):
+    def fn(base_digits, n_valid, offset=None):
         args = [base_digits, n_valid]
+        if with_offset:
+            args.append(jnp.zeros((1,), jnp.int32)
+                        if offset is None else offset)
         if multi:
             args.append(tables_dev)
         if has_lut:
@@ -608,18 +764,40 @@ def make_mask_pallas_fn(engine_name: str, gen, target_words: np.ndarray,
 def make_pallas_mask_crack_step(engine_name: str, gen,
                                 target_words: np.ndarray, batch: int,
                                 hit_capacity: int = 64,
-                                interpret: bool = False):
+                                interpret: bool = False,
+                                with_offset: bool = False,
+                                sub: Optional[int] = None):
     """Drop-in replacement for ops/pipeline.make_mask_crack_step on the
     single-target kernel path: step(base_digits, n_valid) ->
-    (count, lanes, tpos)."""
+    (count, lanes, tpos).
+
+    with_offset appends a traced window-start argument --
+    step(base_digits, n_valid, offset) -- with lanes still
+    batch-relative, so ops/superstep.make_loop_super_step can fuse
+    `inner` invocations of ONE compiled kernel per dispatch.  `sub`
+    overrides the tile sublane count (the `dprf tune` tile rung)."""
     if engine_name not in CORES:
         from dprf_tpu.ops import pallas_ext
         return pallas_ext.make_ext_mask_crack_step(
             engine_name, gen, target_words, batch, hit_capacity,
             interpret=interpret)
-    tile = SUB * 128
+    sub = SUB if sub is None else sub
+    tile = sub * 128
     fn = make_mask_pallas_fn(engine_name, gen, target_words, batch,
-                             interpret=interpret)
+                             sub=sub, interpret=interpret,
+                             with_offset=with_offset)
+
+    if with_offset:
+        @jax.jit
+        def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray,
+                 offset: jnp.ndarray):
+            counts, hit_lanes = fn(
+                base_digits.astype(jnp.int32),
+                jnp.reshape(n_valid, (1,)).astype(jnp.int32),
+                jnp.reshape(offset, (1,)).astype(jnp.int32))
+            return reduce_tile_hits(counts, hit_lanes, hit_capacity,
+                                    tile)
+        return step
 
     @jax.jit
     def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray):
@@ -634,7 +812,9 @@ def make_pallas_multi_crack_step(engine_name: str, gen,
                                  target_words: np.ndarray, batch: int,
                                  hit_capacity: int = 64,
                                  rescan_capacity: int = 16,
-                                 interpret: bool = False):
+                                 interpret: bool = False,
+                                 with_offset: bool = False,
+                                 sub: Optional[int] = None):
     """Multi-target kernel step: step(base_digits, n_valid) ->
     (n_single, maybe_lanes int32[hit_capacity],
      n_collided, collided_tiles int32[rescan_capacity]).
@@ -645,15 +825,32 @@ def make_pallas_multi_crack_step(engine_name: str, gen,
     exactly rescanned over its TILE-candidate range.  n_single >
     hit_capacity or n_collided > rescan_capacity means the whole batch
     needs the exact rescan (astronomically rare at the Bloom FP rates
-    documented at SET_SIZE)."""
+    documented at SET_SIZE).
+
+    with_offset / sub: as make_pallas_mask_crack_step (loop-superstep
+    fusion and the tune tile rung)."""
     if engine_name not in CORES:
         from dprf_tpu.ops import pallas_ext
         return pallas_ext.make_ext_multi_crack_step(
             engine_name, gen, target_words, batch, hit_capacity,
             rescan_capacity, interpret=interpret)
-    tile = SUB * 128
+    sub = SUB if sub is None else sub
+    tile = sub * 128
     fn = make_mask_pallas_fn(engine_name, gen, target_words, batch,
-                             interpret=interpret)
+                             sub=sub, interpret=interpret,
+                             with_offset=with_offset)
+
+    if with_offset:
+        @jax.jit
+        def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray,
+                 offset: jnp.ndarray):
+            counts, hit_lanes = fn(
+                base_digits.astype(jnp.int32),
+                jnp.reshape(n_valid, (1,)).astype(jnp.int32),
+                jnp.reshape(offset, (1,)).astype(jnp.int32))
+            return reduce_tile_maybes(counts, hit_lanes, hit_capacity,
+                                      rescan_capacity, tile)
+        return step
 
     @jax.jit
     def step(base_digits: jnp.ndarray, n_valid: jnp.ndarray):
@@ -684,6 +881,67 @@ def reduce_tile_maybes(counts: jnp.ndarray, hit_lanes: jnp.ndarray,
     _, ctiles, _ = cmp_ops.compact_hits(collided, jnp.zeros_like(c),
                                         rescan_capacity)
     return n_single, maybe_lanes, n_collided, ctiles
+
+
+def make_shard_mask_compute(engine_name: str, gen,
+                            target_words: np.ndarray,
+                            batch_per_device: int, hit_capacity: int,
+                            sub: Optional[int] = None,
+                            interpret: bool = False,
+                            probe_fp: Optional[float] = None):
+    """The fused kernel as a sharded compute callback: the tentpole
+    bridge between this module and parallel/sharded.make_sharded_step.
+
+    compute(offset, base_digits, n_valid) ->
+        (found bool[G], payload int32[G], rel int32[G], count int32)
+
+    -- the runtime's TILE-compute contract: per-grid-cell hit flags,
+    window-relative hit lanes (offset + tile start + in-tile lane),
+    and the authoritative count.  Candidate generation happens ON
+    DEVICE inside the kernel from base + shard/window offset, so a
+    sharded superstep's only host traffic is the base digit vector.
+
+    Single target: found marks exactly-one-hit tiles; payload is tpos
+    0.  Multi target (2..MAX_TARGETS): the compare is the blocked
+    PR 14 probe bitmap (kernel_probe_rows) and every surviving lane
+    comes back SENTINEL-tagged (payload == n_targets, out of range) --
+    the workers' lane decode verifies each with one oracle hash.  Any
+    tile holding 2+ hits/maybes can only report one lane, so the
+    count is inflated past hit_capacity and the workers' existing
+    overflow redrive re-covers the window exactly."""
+    if engine_name not in CORES:
+        raise ValueError(f"{engine_name}: sharded kernel computes "
+                         "cover the CORES engines only")
+    sub = SUB if sub is None else sub
+    tile = sub * 128
+    grid = check_batch(batch_per_device, sub)
+    target_words = np.asarray(target_words)
+    multi = target_words.ndim == 2 and target_words.shape[0] > 1
+    sentinel = int(target_words.shape[0]) if multi else 0
+    fn = make_mask_pallas_fn(
+        engine_name, gen, target_words, batch_per_device, sub=sub,
+        interpret=interpret, with_offset=True,
+        probe_fp=(probe_fp if probe_fp is not None
+                  else envreg.get_float("DPRF_PALLAS_PROBE_FP"))
+        if multi else None)
+    tile_starts = jnp.arange(grid, dtype=jnp.int32) * tile
+
+    def compute(offset, base_digits, n_valid):
+        counts, hit_lanes = fn(
+            base_digits.astype(jnp.int32),
+            jnp.reshape(n_valid, (1,)).astype(jnp.int32),
+            jnp.reshape(offset, (1,)).astype(jnp.int32))
+        c = counts[:, 0]
+        found = c == 1
+        rel = offset + tile_starts + hit_lanes[:, 0]
+        payload = jnp.full((grid,), sentinel, jnp.int32)
+        count = jnp.sum(c) + jnp.where(
+            jnp.any(c > 1), jnp.int32(hit_capacity + 1), 0)
+        return found, payload, rel, count
+
+    compute.tile = tile
+    compute.grid = grid
+    return compute
 
 
 def reduce_tile_hits(counts: jnp.ndarray, hit_lanes: jnp.ndarray,

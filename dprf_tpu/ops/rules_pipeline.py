@@ -80,6 +80,8 @@ def make_wordlist_crack_step(
     """Returns step(w0 int32, n_valid_words int32) ->
     (count int32, lanes int32[cap], tpos int32[cap]); lanes are flat
     r*B+b indices into the step's candidate block."""
+    from dprf_tpu.targets import probe as probe_mod
+
     B, L = word_batch, gen.max_len
     words_np, lens_np = gen.packed_words(pad_to=B,
                                          min_size=gen.n_words + B - 1)
@@ -87,6 +89,9 @@ def make_wordlist_crack_step(
     lens_dev = jnp.asarray(lens_np)
     rules = gen.rules
     multi = isinstance(targets, cmp_ops.TargetTable)
+    probe = isinstance(targets, probe_mod.ProbeTable)
+    survivors = (probe_mod.survivor_cap(targets, B * len(rules))
+                 if probe else 0)
 
     @jax.jit
     def step(w0: jnp.ndarray, n_valid_words: jnp.ndarray):
@@ -95,6 +100,12 @@ def make_wordlist_crack_step(
         base_valid = jnp.arange(B, dtype=jnp.int32) < n_valid_words
         digest, cv = _expand_and_digest(engine, rules, wslice, lslice,
                                         base_valid, L, widen_utf16)
+        if probe:
+            # bulk lists: Bloom-prefilter + on-device exact verify over
+            # the rule-expanded block; lanes keep the same rule-major
+            # flat indices the compact path emits
+            return probe_mod.probe_hits(digest, targets, cv,
+                                        hit_capacity, survivors)
         found, tpos = _compare(digest, targets, multi)
         return cmp_ops.compact_hits(found & cv, tpos, hit_capacity)
 
@@ -119,7 +130,9 @@ def make_sharded_wordlist_crack_step(
     ``(offset + b) * n_rules + r``, so the host decode is simply
     ``w0 * n_rules + lane``.
     """
-    from dprf_tpu.parallel.sharded import make_sharded_step
+    from dprf_tpu.parallel.sharded import (make_sharded_step,
+                                           probe_lane_compare)
+    from dprf_tpu.targets import probe as probe_mod
 
     n_dev = mesh.devices.size
     B, L = word_batch, gen.max_len
@@ -130,6 +143,9 @@ def make_sharded_wordlist_crack_step(
     rules = gen.rules
     R = len(rules)
     multi = isinstance(targets, cmp_ops.TargetTable)
+    probe = isinstance(targets, probe_mod.ProbeTable)
+    _probe_compute = (probe_lane_compare(targets, R * B)
+                      if probe else None)
 
     def compute(offset, w0, n_valid_words):
         my_w0 = (w0 + offset).astype(jnp.int32)
@@ -139,6 +155,9 @@ def make_sharded_wordlist_crack_step(
         base_valid = word_lane < n_valid_words
         digest, cv = _expand_and_digest(engine, rules, wslice, lslice,
                                         base_valid, L, widen_utf16)
+        if probe:
+            return _probe_compute(
+                digest, probe_mod.bloom_maybe(digest, targets) & cv)
         found, tpos = _compare(digest, targets, multi)
         return found & cv, tpos
 

@@ -90,3 +90,92 @@ def make_super_step(step, inner: int, batch: int, flag_fn=None):
         return acc, outs
 
     return super_step
+
+
+def make_loop_super_step(step, inner: int, batch: int, groups):
+    """The KERNEL-path superstep: a scalar/small-buffer-carry
+    ``fori_loop`` over an OFFSET-AWARE per-batch step, fusing ``inner``
+    batches into one dispatch with device-resident hit accumulation --
+    the sharded runtime's superstep discipline brought to the
+    single-chip Pallas path.
+
+    Why not make_super_step: the scan shape re-traces the step per
+    iteration with a fresh leading argument, and scan-of-pallas_call
+    wedged the TPU compile helper (TPU_PROBE_LOG_r04 round 4b).  Here
+    ONE compiled kernel is invoked ``inner`` times with only the
+    window offset varying (the proven bench fori_loop shape, carrying
+    a few hundred int32s instead of stacked per-batch outputs), and
+    per-batch hits fold into fixed window-relative buffers on device.
+
+    step(x, n_valid, offset) -> tuple of scalars and buffers; `groups`
+    describes the accumulation, one entry per (count, buffer) pair:
+
+        (count_idx, buf_idx, payload_idx | None, scale, capacity)
+
+    - out[count_idx]: the batch's authoritative count (may exceed the
+      batch buffer on collision/overflow -- the inflation survives
+      accumulation, so window drains keep the exact-redrive
+      discipline);
+    - out[buf_idx]: compacted indices, valid entries first, -1
+      padding; iteration i's entries are globalized by ``+ i * scale``
+      (scale = batch for lane buffers, grid for tile buffers);
+    - out[payload_idx]: optional same-shape payload riding along;
+    - capacity: the WINDOW buffer length for this group.
+
+    Returns super_step(x, n_valid_total) -> the step's output tuple
+    shape with window-relative buffers -- decodable exactly like a
+    wide-mode result.  n_valid_total is the whole window's bound; the
+    offset-aware step masks validity globally, so partial tails are
+    exact without per-iteration clips.
+    """
+    if inner < 1:
+        raise ValueError("inner must be >= 1")
+    if inner * batch > INT32_BUDGET:
+        raise ValueError(
+            f"inner*batch = {inner * batch} overflows int32 lane "
+            f"arithmetic (max {INT32_BUDGET}); lower inner")
+
+    @jax.jit
+    def super_step(x, n_valid):
+        n_valid = jnp.asarray(n_valid, jnp.int32)
+        init = []
+        for (_, _, pi, _, cap) in groups:
+            init.append(jnp.int32(0))
+            init.append(jnp.full((cap,), -1, jnp.int32))
+            if pi is not None:
+                init.append(jnp.full((cap,), -1, jnp.int32))
+        init = tuple(init)
+
+        def body(i, carry):
+            out = step(x, n_valid, (i * batch).astype(jnp.int32))
+            new, at = [], 0
+            for (ci, bi, pi, scale, cap) in groups:
+                count, buf = carry[at], carry[at + 1]
+                c_i = out[ci].astype(jnp.int32)
+                idx_i = out[bi]
+                ok = idx_i >= 0
+                rel = jnp.where(ok, idx_i + i * jnp.int32(scale), -1)
+                slots = jnp.where(
+                    ok, count + jnp.arange(idx_i.shape[0],
+                                           dtype=jnp.int32), cap)
+                new.append(count + c_i)
+                new.append(buf.at[slots].set(rel, mode="drop"))
+                at += 2
+                if pi is not None:
+                    pay = carry[at]
+                    new.append(pay.at[slots].set(out[pi], mode="drop"))
+                    at += 1
+            return tuple(new)
+
+        fin = lax.fori_loop(0, inner, body, init)
+        out, at = {}, 0
+        for (ci, bi, pi, _, _) in groups:
+            out[ci] = fin[at]
+            out[bi] = fin[at + 1]
+            at += 2
+            if pi is not None:
+                out[pi] = fin[at]
+                at += 1
+        return tuple(out[k] for k in sorted(out))
+
+    return super_step

@@ -47,11 +47,17 @@ from dprf_tpu.ops import compare as cmp_ops
 from dprf_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
 
-def _append_hits(carry, found, payload, rel, capacity: int):
+def _append_hits(carry, found, payload, rel, capacity: int,
+                 true_count=None):
     """Fold one shard-batch's matches into the device-resident hit
     buffer carried across a superstep.  ``rel`` maps each local lane
     to its window-relative value; slots past ``capacity`` drop (the
-    count keeps the truth, so overflow is detectable on drain)."""
+    count keeps the truth, so overflow is detectable on drain).
+
+    ``true_count`` overrides the compacted count when the compute
+    itself is the authority -- the TILE-compute (kernel) contract,
+    where per-tile collisions inflate the count past the buffer so
+    the drain path redrives the window exactly."""
     count, lanes_buf, pay_buf = carry
     c, lanes, pay = cmp_ops.compact_hits(found, payload, capacity)
     ok = lanes >= 0
@@ -60,6 +66,7 @@ def _append_hits(carry, found, payload, rel, capacity: int):
                       capacity)
     lanes_buf = lanes_buf.at[slots].set(rel_lanes, mode="drop")
     pay_buf = pay_buf.at[slots].set(pay, mode="drop")
+    c = c if true_count is None else true_count
     return count + c, lanes_buf, pay_buf
 
 
@@ -74,6 +81,17 @@ def make_sharded_step(compute: Callable, mesh, span_per_shard: int,
     the lane block starting at window-relative offset ``offset``
     (int32, traced; in span units -- keyspace lanes for mask-style
     steps, words for wordlist steps).
+
+    A compute may instead return the TILE-compute 4-tuple
+    ``(found bool[G], payload int32[G], rel int32[G], count int32)``
+    (the fused Pallas kernel contract, ops/pallas_mask.
+    make_shard_mask_compute): ``rel`` carries each element's
+    window-relative lane directly (the kernel reports one hit lane
+    per grid cell, not per lane) and ``count`` is the authoritative
+    hit count -- inflated past ``hit_capacity`` when a tile held more
+    hits than it can report, landing in the workers' existing
+    overflow redrive.  The arity is inspected at trace time, so
+    legacy 2-tuple computes are untouched.
 
     span_per_shard: span units one shard covers per batch; one step
     call covers ``n_dev * span_per_shard`` (``step.super_span``).
@@ -102,11 +120,17 @@ def make_sharded_step(compute: Callable, mesh, span_per_shard: int,
             def body(i, carry):
                 offset = (i * span_step
                           + dev * span_per_shard).astype(jnp.int32)
-                found, payload = compute(offset, *args)
-                lanes = jnp.arange(found.shape[0], dtype=jnp.int32)
-                rel = globalize(lanes, offset)
+                out = compute(offset, *args)
+                if len(out) == 4:          # TILE-compute (kernel) path
+                    found, payload, rel, true_count = out
+                else:
+                    found, payload = out
+                    lanes = jnp.arange(found.shape[0], dtype=jnp.int32)
+                    rel = globalize(lanes, offset)
+                    true_count = None
                 return _append_hits(carry, found, payload, rel,
-                                    hit_capacity)
+                                    hit_capacity,
+                                    true_count=true_count)
 
             if inner == 1:
                 count, lanes, payload = body(jnp.int32(0), init)
@@ -162,6 +186,81 @@ def make_sharded_step(compute: Callable, mesh, span_per_shard: int,
 # (ops/rules_pipeline.py, ops/combine.py); these two cover every
 # digest_candidates engine and the whole per-target salted family.
 
+def probe_lane_compare(targets, n_lanes: int):
+    """Shared probe-table verify stage for sharded computes: build
+    ``fn(digest, maybe) -> (found, tpos)`` over an ``n_lanes``-lane
+    digest block, where ``maybe`` is the (validity-masked) Bloom
+    survivor mask.  Used by the mask, wordlist, and combinator
+    computes so the survivor-compaction / sentinel discipline exists
+    exactly once.
+
+    Device layout: survivors compact into a fixed buffer, their
+    digests re-gather and verify exactly against the sorted table; a
+    survivor overflow could hide a real hit past the buffer, so THAT
+    batch degrades to sentinel-tagged maybes.  Host-verify layout
+    (no exact table on device): every survivor goes back
+    sentinel-tagged (tpos == num_targets, out of range) and the
+    workers resolve each with one oracle hash."""
+    survivors = 0
+    if targets.table is not None:
+        from dprf_tpu.targets import probe as probe_mod
+        survivors = probe_mod.survivor_cap(targets, n_lanes)
+    sentinel = targets.num_targets
+
+    def fn(digest, maybe):
+        if targets.table is None:
+            return maybe, jnp.full((n_lanes,), sentinel, jnp.int32)
+        n_maybe = maybe.sum(dtype=jnp.int32)
+        slot = jnp.cumsum(maybe.astype(jnp.int32)) - 1
+        slot = jnp.where(maybe, slot, survivors)
+        surv = jnp.full((survivors,), -1, jnp.int32).at[slot].set(
+            jnp.arange(n_lanes, dtype=jnp.int32), mode="drop")
+        found_s, tpos_s = cmp_ops.compare_multi(
+            digest[jnp.maximum(surv, 0)], targets.table)
+        found_s = found_s & (surv >= 0)
+        back = jnp.where(surv >= 0, surv, n_lanes)
+        verified = jnp.zeros((n_lanes,), bool).at[back].set(
+            found_s, mode="drop")
+        tpos = jnp.zeros((n_lanes,), jnp.int32).at[back].set(
+            tpos_s, mode="drop")
+        overflow = n_maybe > survivors
+        found = jnp.where(overflow, maybe, verified)
+        tpos = jnp.where(overflow,
+                         jnp.full((n_lanes,), sentinel, jnp.int32),
+                         tpos)
+        return found, tpos
+
+    return fn
+
+
+def make_sharded_kernel_mask_step(engine_name: str, gen,
+                                  target_words, mesh,
+                                  batch_per_device: int,
+                                  hit_capacity: int = 64,
+                                  sub=None, interpret: bool = False,
+                                  probe_fp: Optional[float] = None):
+    """Mask attack with the FUSED PALLAS KERNEL as the per-shard
+    compute: the whole decode -> hash -> compare(+probe) chain runs
+    in VMEM per shard, and the sharded superstep drives it with
+    on-device generation from ``base + shard/window offset``.
+
+    Same step/superstep contract as make_sharded_mask_step; the hit
+    payload is tpos 0 (single target) or the SENTINEL num_targets
+    (multi target -- every kernel-probe survivor is host-verified
+    with one oracle hash, see ops/pallas_mask.make_shard_mask_compute).
+    batch_per_device must be tile-aligned (check_batch enforces)."""
+    from dprf_tpu.ops import pallas_mask
+
+    compute = pallas_mask.make_shard_mask_compute(
+        engine_name, gen, target_words, batch_per_device, hit_capacity,
+        sub=sub, interpret=interpret, probe_fp=probe_fp)
+    step = make_sharded_step(compute, mesh, batch_per_device, 2,
+                             hit_capacity=hit_capacity)
+    step.super_batch = step.super_span
+    step.tile = compute.tile
+    return step
+
+
 def make_sharded_mask_step(engine, gen, targets, mesh,
                            batch_per_device: int, hit_capacity: int = 64,
                            widen_utf16: bool = False):
@@ -190,34 +289,7 @@ def make_sharded_mask_step(engine, gen, targets, mesh,
     B = batch_per_device
     multi = isinstance(targets, cmp_ops.TargetTable)
     probe = isinstance(targets, probe_mod.ProbeTable)
-    survivors = probe_mod.survivor_cap(targets, B) if probe else 0
-    sentinel = targets.num_targets if probe else 0
-
-    def _probe_compute(digest, maybe):
-        if targets.table is None:
-            # host-verify layout: every Bloom survivor goes back
-            # sentinel-tagged; the worker resolves each on the host
-            return maybe, jnp.full((B,), sentinel, jnp.int32)
-        n_maybe = maybe.sum(dtype=jnp.int32)
-        slot = jnp.cumsum(maybe.astype(jnp.int32)) - 1
-        slot = jnp.where(maybe, slot, survivors)
-        surv = jnp.full((survivors,), -1, jnp.int32).at[slot].set(
-            jnp.arange(B, dtype=jnp.int32), mode="drop")
-        found_s, tpos_s = cmp_ops.compare_multi(
-            digest[jnp.maximum(surv, 0)], targets.table)
-        found_s = found_s & (surv >= 0)
-        back = jnp.where(surv >= 0, surv, B)
-        verified = jnp.zeros((B,), bool).at[back].set(
-            found_s, mode="drop")
-        tpos = jnp.zeros((B,), jnp.int32).at[back].set(
-            tpos_s, mode="drop")
-        # a survivor overflow could hide a real hit past the buffer:
-        # degrade THIS batch to sentinel-tagged maybes instead
-        overflow = n_maybe > survivors
-        found = jnp.where(overflow, maybe, verified)
-        tpos = jnp.where(overflow,
-                         jnp.full((B,), sentinel, jnp.int32), tpos)
-        return found, tpos
+    _probe_compute = probe_lane_compare(targets, B) if probe else None
 
     def compute(offset, base_digits, n_valid):
         cand = gen.decode_batch(base_digits, flat, B, lane_offset=offset)

@@ -108,19 +108,63 @@ class ShardedMaskWorker(_ShardedSuperstepMixin, MaskWorkerBase):
     Bulk target lists (>= DPRF_TARGETS_PROBE_MIN) swap the replicated
     compare table for the probe table (dprf_tpu/targets/): the sharded
     step builder carries it as replicated device state through
-    supersteps, so probe_ok is set here."""
+    supersteps, so probe_ok is set here.
+
+    ``kernel`` (a dict of ops/pallas_mask options: ``sub``,
+    ``interpret``, ``probe_fp``; an empty dict takes every default)
+    swaps the XLA compute for the FUSED PALLAS KERNEL per shard
+    (parallel/sharded.make_sharded_kernel_mask_step): candidates
+    generate, hash, and compare(+probe) in VMEM, the host ships one
+    digit vector per superstep window.  Multi-target kernel hits come
+    back SENTINEL-tagged (in-kernel blocked-probe survivors), so an
+    oracle engine is required to verify them."""
 
     def __init__(self, engine, gen, targets: Sequence[Target], mesh,
                  batch_per_device: int = 1 << 18, hit_capacity: int = 64,
-                 oracle: Optional[HashEngine] = None):
-        from dprf_tpu.parallel.sharded import make_sharded_mask_step
+                 oracle: Optional[HashEngine] = None,
+                 kernel: Optional[dict] = None):
+        from dprf_tpu.parallel.sharded import (
+            make_sharded_kernel_mask_step, make_sharded_mask_step)
 
-        tgt = self._setup_targets(engine, gen, targets, hit_capacity,
-                                  oracle, probe_ok=True)
-        self.mesh = mesh
-        self.step = make_sharded_mask_step(
-            engine, gen, tgt, mesh, batch_per_device, hit_capacity,
-            widen_utf16=getattr(engine, "widen_utf16", False))
+        if kernel is None:
+            tgt = self._setup_targets(engine, gen, targets, hit_capacity,
+                                      oracle, probe_ok=True)
+            self.mesh = mesh
+            self.step = make_sharded_mask_step(
+                engine, gen, tgt, mesh, batch_per_device, hit_capacity,
+                widen_utf16=getattr(engine, "widen_utf16", False))
+        else:
+            from dprf_tpu.ops.pallas_mask import SUB
+
+            # the kernel compares against raw target words (exact or
+            # blocked-probe), never the XLA table/probe structures
+            tgt = self._setup_targets(engine, gen, targets, hit_capacity,
+                                      oracle)
+            self.ATTACK = self.ATTACK + "+kernel"
+            if self.multi:
+                if oracle is None:
+                    raise ValueError(
+                        "sharded kernel compute with multiple targets "
+                        "needs an oracle engine to verify probe "
+                        "survivors")
+                dt = "<u4" if engine.little_endian else ">u4"
+                twords = np.stack([np.frombuffer(t.digest, dtype=dt)
+                                   .astype(np.uint32)
+                                   for t in self.targets])
+                self._digest_map = {t.digest: i
+                                    for i, t in enumerate(self.targets)}
+            else:
+                twords = np.asarray(tgt)
+            sub = kernel.get("sub") or SUB
+            tile = sub * 128
+            batch_per_device = max(tile,
+                                   (batch_per_device // tile) * tile)
+            self.mesh = mesh
+            self.step = make_sharded_kernel_mask_step(
+                engine.name, gen, twords, mesh, batch_per_device,
+                hit_capacity, sub=sub,
+                interpret=bool(kernel.get("interpret", False)),
+                probe_fp=kernel.get("probe_fp"))
         self.super_batch = self.stride = self.step.super_batch
         #: instance override of MaskWorkerBase.SUPER_CAP: the sharded
         #: superstep has its own fusion knob
@@ -215,7 +259,7 @@ class ShardedCombinatorWorker(ShardedMaskWorker):
             make_sharded_combinator_crack_step)
 
         tgt = self._setup_targets(engine, gen, targets, hit_capacity,
-                                  oracle)
+                                  oracle, probe_ok=True)
         self.mesh = mesh
         self.step = make_sharded_combinator_crack_step(
             engine, gen, tgt, mesh, batch_per_device, hit_capacity,
@@ -243,7 +287,8 @@ class ShardedWordlistWorker(_ShardedSuperstepMixin, WordlistWorkerBase):
         from dprf_tpu.ops.rules_pipeline import (
             make_sharded_wordlist_crack_step)
 
-        tgt = self._setup_targets(engine, gen, targets, hit_capacity, oracle)
+        tgt = self._setup_targets(engine, gen, targets, hit_capacity,
+                                  oracle, probe_ok=True)
         self.mesh = mesh
         self.step = make_sharded_wordlist_crack_step(
             engine, gen, tgt, mesh, word_batch_per_device, hit_capacity,
@@ -335,6 +380,11 @@ class ShardedWordlistWorker(_ShardedSuperstepMixin, WordlistWorkerBase):
                 continue
             gidx = base + int(lane)
             if not unit.start <= gidx < unit.end:
+                continue
+            if self.multi and not 0 <= int(tp) < len(self._order):
+                # probe-table survivor left unverified on device (see
+                # sharded.probe_lane_compare): one oracle hash each
+                hits.extend(self._verify_probe_lane(gidx))
                 continue
             ti = int(self._order[int(tp)]) if self.multi else 0
             hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))

@@ -529,10 +529,15 @@ class MaskWorkerBase:
     #: program shape as a plain batch, just a longer (sequential) grid
     #: -- the only fused shape proven on the axon TPU backend, where a
     #: scan-wrapped pallas_call wedged the remote compile helper
-    #: (TPU_PROBE_LOG_r04.md, round-4b finding).  Pallas workers set
-    #: "wide"; kernels pay no extra HBM for it (tile state is VMEM,
-    #: raw output is batch/4 bytes), unlike the XLA steps whose
-    #: materialized candidate blocks scale with batch.
+    #: (TPU_PROBE_LOG_r04.md, round-4b finding).  "loop" is the
+    #: kernel superstep: a scalar/small-buffer-carry fori_loop over
+    #: ONE offset-aware compiled kernel (ops/superstep.
+    #: make_loop_super_step) -- the sharded runtime's superstep shape
+    #: on a single chip; it degrades loop -> wide -> per-batch.
+    #: Pallas workers set "loop" or "wide"; kernels pay no extra HBM
+    #: for either (tile state is VMEM, raw output is batch/4 bytes),
+    #: unlike the XLA steps whose materialized candidate blocks scale
+    #: with batch.
     SUPER_MODE = "scan"
 
     def _super_batch(self) -> int:
@@ -574,6 +579,50 @@ class MaskWorkerBase:
         contract is the per-batch step's exactly, with hit capacities
         scaled up by batch // self.stride (shape-derived at decode)."""
         raise NotImplementedError
+
+    def _make_loop_parts(self, inner: int):
+        """(offset-aware per-batch step, accumulation groups) for
+        ops/superstep.make_loop_super_step, or None when this worker
+        has no loop program.  Loop-capable subclasses (SUPER_MODE ==
+        "loop") override; the step must be built with the WINDOW
+        buffer capacities so its overflow/collision inflation exceeds
+        the window buffers too."""
+        return None
+
+    def _loop_step(self, inner: int):
+        from dprf_tpu.ops.superstep import make_loop_super_step
+        cache = getattr(self, "_loop_cache", None)
+        if cache is None:
+            cache = self._loop_cache = {}
+        entry = cache.get(inner)
+        if entry is None:
+            parts = self._make_loop_parts(inner)
+            if parts is None:
+                return None
+            step, groups = parts
+            entry = cache[inner] = make_loop_super_step(
+                step, inner, self._super_batch(), groups)
+        return entry
+
+    def _loop_dispatch(self, inner: int, base, n_valid):
+        """One loop-superstep dispatch (SUPER_MODE == "loop"), or None
+        to degrade to the WIDE path.  The loop program is the proven
+        fori_loop-of-one-kernel shape (bench inner-loop, sharded
+        superstep); a backend that rejects it still gets wide's
+        single-pallas_call program before falling to per-batch."""
+        import jax.numpy as jnp
+        try:
+            ls = self._loop_step(inner)
+            if ls is None:
+                self._loop_disabled = True
+                return None
+            return ls(base, jnp.int32(n_valid))
+        except Exception as e:        # noqa: BLE001 -- compiler errors
+            from dprf_tpu.utils.logging import DEFAULT as log
+            self._loop_disabled = True
+            log.warn("loop super-step program failed to build; falling "
+                     "back to wide dispatch", inner=inner, error=str(e))
+            return None
 
     def _wide_step(self, sbatch: int):
         cache = getattr(self, "_wide_cache", None)
@@ -639,8 +688,12 @@ class MaskWorkerBase:
         # fall back to PER-BATCH dispatch, never to the scan wrapper:
         # on the backend that just rejected the wide shape, scan-of-
         # pallas_call is the shape that silently wedges the compile
-        # helper (TPU_PROBE_LOG_r04.md round-4b)
-        wide = self.SUPER_MODE == "wide"
+        # helper (TPU_PROBE_LOG_r04.md round-4b).  "loop" tries the
+        # fori_loop superstep first and degrades loop -> wide ->
+        # per-batch; a loop result decodes exactly like a wide one
+        # (window-relative buffers), so it queues under the same kind.
+        loop = self.SUPER_MODE == "loop"
+        wide = loop or self.SUPER_MODE == "wide"
         fuse = not (wide and getattr(self, "_wide_disabled", False))
         while fuse:
             # _super_inner's max_inner(stride) budget bounds the wide
@@ -652,7 +705,11 @@ class MaskWorkerBase:
             sstride = inner * self.stride
             if wide:
                 base = jnp.asarray(self.gen.digits(pos), dtype=jnp.int32)
-                result = self._wide_dispatch(sstride, base, sstride)
+                result = None
+                if loop and not getattr(self, "_loop_disabled", False):
+                    result = self._loop_dispatch(inner, base, sstride)
+                if result is None:
+                    result = self._wide_dispatch(sstride, base, sstride)
                 if result is None:
                     break                  # degraded to per-batch
                 f = self._batch_flag(result)
@@ -819,6 +876,12 @@ class WordlistWorkerBase(MaskWorkerBase):
                                          lane_wb or self.word_batch, R)
             if not unit.start <= gidx < unit.end:
                 continue
+            if self.multi and not 0 <= int(tp) < len(self._order):
+                # probe-table survivor the device did not verify
+                # exactly (host-verify layout / survivor overflow):
+                # one oracle hash resolves it, false positives drop
+                hits.extend(self._verify_probe_lane(gidx))
+                continue
             ti = int(self._order[int(tp)]) if self.multi else 0
             hits.append(Hit(ti, gidx, self.gen.candidate(gidx)))
         return hits
@@ -850,7 +913,8 @@ class DeviceWordlistWorker(WordlistWorkerBase):
                  oracle: Optional[HashEngine] = None):
         from dprf_tpu.ops.rules_pipeline import make_wordlist_crack_step
 
-        tgt = self._setup_targets(engine, gen, targets, hit_capacity, oracle)
+        tgt = self._setup_targets(engine, gen, targets, hit_capacity,
+                                  oracle, probe_ok=True)
         self.word_batch = max(1, batch // gen.n_rules)
         self.stride = self.word_batch * gen.n_rules
         self.step = make_wordlist_crack_step(
@@ -1047,18 +1111,23 @@ class PallasMaskWorker(MaskWorkerBase):
     """
 
     RESCAN_CAPACITY = 16
-    SUPER_MODE = "wide"
+    SUPER_MODE = "loop"
 
     def __init__(self, engine, gen, targets: Sequence[Target],
                  batch: int = 1 << 18, hit_capacity: int = 64,
                  oracle: Optional[HashEngine] = None,
-                 interpret: bool = False):
-        from dprf_tpu.ops.pallas_mask import TILE
+                 interpret: bool = False,
+                 sub: Optional[int] = None):
+        from dprf_tpu.ops.pallas_mask import SUB
 
         tgt = self._setup_targets(engine, gen, targets, hit_capacity, oracle)
-        batch = max(TILE, (batch // TILE) * TILE)
+        # sub: sublanes per kernel tile (the `dprf tune` tile rung);
+        # default is the DPRF_PALLAS_SUB knob
+        self._sub = SUB if sub is None else sub
+        tile = self._sub * 128
+        batch = max(tile, (batch // tile) * tile)
         self.batch = self.stride = batch
-        self._tile = TILE
+        self._tile = tile
         self._interpret = interpret
         if self.multi:
             if oracle is None:
@@ -1092,10 +1161,45 @@ class PallasMaskWorker(MaskWorkerBase):
                        min(self.RESCAN_CAPACITY * scale, 256))
             return make_pallas_multi_crack_step(
                 self.engine.name, self.gen, self._twords, batch, cap,
-                rcap, interpret=self._interpret)
+                rcap, interpret=self._interpret, sub=self._sub)
         return make_pallas_mask_crack_step(
             self.engine.name, self.gen, self._twords, batch, cap,
-            interpret=self._interpret)
+            interpret=self._interpret, sub=self._sub)
+
+    def _make_loop_parts(self, inner: int):
+        """Offset-aware per-batch kernel step + accumulation groups
+        for the loop superstep: ONE compiled kernel invoked `inner`
+        times per dispatch (the TPU-proven fori_loop shape), with hits
+        folding into window-relative device buffers.
+
+        The step is built at the per-batch lane count but with the
+        WINDOW hit capacities (wide's cap-scaling policy), so the
+        in-kernel collision sentinel -- count = capacity + 1 -- lands
+        past the window buffer too and the wide-path overflow redrive
+        applies unchanged."""
+        from dprf_tpu.ops.pallas_mask import (CORES,
+                                              make_pallas_mask_crack_step,
+                                              make_pallas_multi_crack_step)
+        if self.engine.name not in CORES:
+            return None   # pallas_ext steps have no offset argument
+        cap = max(self.hit_capacity,
+                  min(self.hit_capacity * inner, 1024))
+        grid = self.batch // self._tile
+        if self.multi:
+            rcap = max(self.RESCAN_CAPACITY,
+                       min(self.RESCAN_CAPACITY * inner, 256))
+            step = make_pallas_multi_crack_step(
+                self.engine.name, self.gen, self._twords, self.batch,
+                cap, rcap, interpret=self._interpret,
+                with_offset=True, sub=self._sub)
+            # maybe lanes globalize by the batch stride, collided
+            # tiles by the per-batch grid length
+            return step, ((0, 1, None, self.batch, cap),
+                          (2, 3, None, grid, rcap))
+        step = make_pallas_mask_crack_step(
+            self.engine.name, self.gen, self._twords, self.batch, cap,
+            interpret=self._interpret, with_offset=True, sub=self._sub)
+        return step, ((0, 1, 2, self.batch, cap),)
 
     def _batch_flag(self, result):
         if not self.multi:
@@ -1149,7 +1253,7 @@ class DeviceCombinatorWorker(MaskWorkerBase):
         from dprf_tpu.ops.combine import make_combinator_crack_step
 
         tgt = self._setup_targets(engine, gen, targets, hit_capacity,
-                                  oracle)
+                                  oracle, probe_ok=True)
         self.batch = self.stride = batch
         self.step = make_combinator_crack_step(
             engine, gen, tgt, batch, hit_capacity,

@@ -115,6 +115,59 @@ def probe_eligible(targets: Sequence, engine=None) -> bool:
     return all(len(t.digest) == dlen and not t.params for t in targets)
 
 
+def bloom_fill(rows: np.ndarray, m_bits: int, k: int) -> np.ndarray:
+    """uint32[N, W>=2] digest words -> the blocked-Bloom bitmap as
+    uint32[m_bits // 32].  This is the ONE definition of the bit
+    layout: one 512-bit block per key picked by a multiplicative hash
+    of word0, then k double-hashed probes inside the block.  Both the
+    XLA-path ProbeTable and the Pallas in-kernel probe rows are filled
+    through here, so the host builder and the kernel can never drift
+    on which bit means what."""
+    W = rows.shape[1]
+    h1 = rows[:, 0].astype(np.uint64)
+    h2 = (rows[:, 1].astype(np.uint64) | 1)
+    # probes alternate between TWO independent double-hash pairs
+    # (words 0/1 and words 2/3): inside one 512-bit block a single
+    # pair carries only ~17 bits of entropy, so a lone progression
+    # floors the false-positive rate near n_keys * 2^-17 no matter
+    # how many probes run; requiring both pairs to collide squares
+    # that floor away (every fast-hash digest has >= 4 words).
+    h3 = rows[:, 2].astype(np.uint64) if W > 3 else h1
+    h4 = (rows[:, 3].astype(np.uint64) | 1) if W > 3 else h2
+    n_blocks = m_bits // BLOCK_BITS
+    block_bits = n_blocks.bit_length() - 1
+    if block_bits:
+        block = ((h1 * _GOLDEN) & 0xFFFFFFFF) >> np.uint64(
+            32 - block_bits)
+    else:
+        block = np.zeros(len(rows), dtype=np.uint64)
+    words = np.zeros(m_bits // 32, dtype=np.uint32)
+    for j in range(k):
+        i = j >> 1
+        a, b = (h3, h4) if j & 1 else (h1, h2)
+        g = (a + (2 * i + 1) * b) & 0xFFFFFFFF
+        bit = g & (BLOCK_BITS - 1)
+        w = (block * BLOCK_WORDS + (bit >> np.uint64(5))).astype(np.int64)
+        np.bitwise_or.at(
+            words, w,
+            np.uint32(1) << (bit & np.uint64(31)).astype(np.uint32))
+    return words
+
+
+def kernel_bloom_geometry(n: int, fp: float, max_bits: int):
+    """(m_bits, k, fp_est) for an in-kernel probe bitmap: sized for the
+    fp budget like build_probe_table, but capped at ``max_bits`` (the
+    kernel gathers its block via a bounded per-group select tree, so
+    the bitmap must stay VMEM-small -- the fp estimate reports what the
+    cap actually buys)."""
+    fp = min(max(fp, 1e-9), 0.5)
+    m_bits = max(BLOCK_BITS, _pow2ceil(int(math.ceil(
+        -n * math.log(fp) / (math.log(2) ** 2)))))
+    m_bits = min(m_bits, _pow2ceil(max_bits))
+    k, fp_est = _geometry(n, m_bits)
+    return m_bits, k, fp_est
+
+
 def build_probe_table(digests: Sequence[bytes],
                       little_endian: bool = True,
                       fp_budget: Optional[float] = None,
@@ -151,23 +204,8 @@ def build_probe_table(digests: Sequence[bytes],
     rows = np.frombuffer(
         b"".join(digests),
         dtype="<u4" if little_endian else ">u4").reshape(n, dlen // 4)
-    h1 = rows[:, 0].astype(np.uint64)
-    h2 = (rows[:, 1].astype(np.uint64) | 1)
-    n_blocks = m_bits // BLOCK_BITS
-    block_bits = n_blocks.bit_length() - 1
-    if block_bits:
-        block = ((h1 * _GOLDEN) & 0xFFFFFFFF) >> np.uint64(
-            32 - block_bits)
-    else:
-        block = np.zeros(n, dtype=np.uint64)
-    words = np.zeros(m_bits // 32, dtype=np.uint32)
-    for j in range(k):
-        g = (h1 + (2 * j + 1) * h2) & 0xFFFFFFFF
-        bit = g & (BLOCK_BITS - 1)
-        w = (block * BLOCK_WORDS + (bit >> np.uint64(5))).astype(np.int64)
-        np.bitwise_or.at(
-            words, w,
-            np.uint32(1) << (bit & np.uint64(31)).astype(np.uint32))
+    words = bloom_fill(rows, m_bits, k)
+    block_bits = (m_bits // BLOCK_BITS).bit_length() - 1
 
     table = None
     order = np.arange(n, dtype=np.int64)
@@ -191,8 +229,12 @@ def bloom_maybe(digest: jnp.ndarray, pt: ProbeTable) -> jnp.ndarray:
     Per candidate: one multiplicative block pick from word0, then k
     double-hashed bit tests inside that single 512-bit block -- the
     whole prefilter is a constant number of ops in N."""
+    W = digest.shape[1]
     h1 = digest[:, 0]
     h2 = digest[:, 1] | jnp.uint32(1)
+    # the alternating probe pairs of bloom_fill (the ONE bit layout)
+    h3 = digest[:, 2] if W > 3 else h1
+    h4 = (digest[:, 3] | jnp.uint32(1)) if W > 3 else h2
     if pt.block_bits:
         base = ((h1 * jnp.uint32(_GOLDEN))
                 >> (32 - pt.block_bits)).astype(jnp.int32) * BLOCK_WORDS
@@ -200,7 +242,9 @@ def bloom_maybe(digest: jnp.ndarray, pt: ProbeTable) -> jnp.ndarray:
         base = jnp.zeros(digest.shape[0], jnp.int32)
     maybe = jnp.ones(digest.shape[0], dtype=bool)
     for j in range(pt.k):
-        g = h1 + jnp.uint32(2 * j + 1) * h2
+        i = j >> 1
+        a, b = (h3, h4) if j & 1 else (h1, h2)
+        g = a + jnp.uint32(2 * i + 1) * b
         bit = g & jnp.uint32(BLOCK_BITS - 1)
         w = base + (bit >> 5).astype(jnp.int32)
         mask = jnp.left_shift(jnp.uint32(1), bit & jnp.uint32(31))

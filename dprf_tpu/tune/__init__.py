@@ -25,7 +25,7 @@ from __future__ import annotations
 from typing import Optional
 
 from dprf_tpu.tune.autotuner import (Probe, TuneResult, geometric_ladder,
-                                     sweep)
+                                     sweep, sweep_values)
 from dprf_tpu.tune.cache import (TuningCache, cache_path, default_cache,
                                  engine_rev, env_fingerprint, make_key,
                                  tune_dir)
@@ -95,8 +95,47 @@ def record_tuned_batch(engine: str, attack: str, device: str,
     return cache.path
 
 
+def lookup_tuned_value(engine: str, knob: str, attack: str = "mask",
+                       device: str = "jax",
+                       session_path: Optional[str] = None,
+                       extras: Optional[dict] = None) -> Optional[int]:
+    """Environment-validated lookup of a tuned KNOB value (superstep
+    ``inner`` window, kernel ``sub`` tile size, ...): the value rides
+    in the record's ``batch`` field (sweep_values keeps one record
+    schema for every tuned quantity) under a key forked by
+    ``knob=<name>``.  Returns the value or None -- never raises, so a
+    broken cache reads as a miss and the caller's default stands."""
+    try:
+        cache = default_cache(session_path)
+        entry = cache.get(
+            make_key(engine, attack=attack, device=device, knob=knob,
+                     **(extras or {})),
+            env_fingerprint(engine, device))
+        if not entry:
+            return None
+        value = int(entry["batch"])
+        return value if value > 0 else None
+    except Exception:
+        return None
+
+
+def record_tuned_value(engine: str, knob: str, attack: str, device: str,
+                       result: TuneResult,
+                       session_path: Optional[str] = None,
+                       extras: Optional[dict] = None) -> str:
+    """Persist a sweep_values result under the ``knob=<name>``-forked
+    key; returns the cache file path written.  The consuming lookup
+    (lookup_tuned_value) must pass the same knob/extras."""
+    cache = default_cache(session_path)
+    cache.put(make_key(engine, attack=attack, device=device, knob=knob,
+                       **(extras or {})),
+              result.as_record(), env_fingerprint(engine, device))
+    return cache.path
+
+
 __all__ = ["AdaptiveUnitSizer", "Probe", "TuneResult", "TuningCache",
            "cache_path", "default_cache", "engine_rev",
            "env_fingerprint", "geometric_ladder", "lookup_tuned_batch",
-           "make_key", "publish_tuned_batch", "record_tuned_batch",
-           "sweep", "tune_dir"]
+           "lookup_tuned_value", "make_key", "publish_tuned_batch",
+           "record_tuned_batch", "record_tuned_value", "sweep",
+           "sweep_values", "tune_dir"]

@@ -83,14 +83,16 @@ def geometric_ladder(lo: int = 1 << 14, hi: int = 1 << 22,
 
 
 def _probe_rate(worker, keyspace: int, seconds: float,
-                clock: Callable[[], float]) -> float:
-    """Steady-state H/s: process whole units (one worker stride each,
-    the production dispatch granularity) until the window closes.
-    Always at least one unit, so an injected/fake clock cannot starve
-    the measurement."""
+                clock: Callable[[], float],
+                unit_strides: int = 1) -> float:
+    """Steady-state H/s: process whole units (``unit_strides`` worker
+    strides each -- 1 is the production dispatch granularity; value
+    sweeps over superstep knobs pass more so the fused window actually
+    engages) until the window closes.  Always at least one unit, so an
+    injected/fake clock cannot starve the measurement."""
     stride = (getattr(worker, "stride", None)
               or getattr(worker, "chunk", None) or 2048)
-    unit_len = max(1, min(int(stride), keyspace))
+    unit_len = max(1, min(int(stride) * max(1, unit_strides), keyspace))
     n, start = 0, 0
     t0 = clock()
     while True:
@@ -223,5 +225,71 @@ def sweep(make_worker: Callable[[int], object], keyspace: int,
     if best is None:
         errs = "; ".join(p.error or "?" for p in swept) or "empty ladder"
         raise ValueError(f"batch autotune failed on every rung ({errs})")
+    return TuneResult(best.batch, best.rate_hs, best.compile_s, swept,
+                      source="swept")
+
+
+def sweep_values(make_worker: Callable[[int], object], values: List[int],
+                 keyspace: int, *, probe_seconds: float = 1.0,
+                 compile_budget_s: float = 120.0, unit_strides: int = 1,
+                 clock: Callable[[], float] = time.perf_counter,
+                 log=None, label: str = "value") -> TuneResult:
+    """Measure each candidate KNOB value through ``make_worker(value)``
+    and return the fastest -- the generic rung sweep behind the
+    superstep ``inner`` window and kernel tile-size tunes.
+
+    Unlike sweep()'s geometric batch ladder, the values are unordered
+    knob settings with no bigger-fails-harder monotonicity, so every
+    value is probed: a rung that fails to build is recorded and
+    SKIPPED, never a ladder stop.  The winning value rides in the
+    TuneResult/Probe ``batch`` field (one cache record schema for
+    every tuned quantity); ``unit_strides`` sizes the probe WorkUnits
+    so multi-batch fusion actually engages during measurement."""
+    from dprf_tpu import compilecache
+
+    compilecache.enable(log=log)
+    swept: List[Probe] = []
+    best: Optional[Probe] = None
+    for v in values:
+        try:
+            entries0 = compilecache.entry_count()
+            t0 = clock()
+            worker = make_worker(v)
+            stride = (getattr(worker, "stride", None)
+                      or getattr(worker, "chunk", None) or 2048)
+            worker.process(WorkUnit(-1, 0, max(1, min(
+                int(stride) * max(1, unit_strides), keyspace))))
+            compile_s = max(clock() - t0,
+                            getattr(worker, "compile_seconds", 0.0))
+            rung_cache = compilecache.classify_delta(
+                entries0, compilecache.entry_count())
+        except Exception as e:   # noqa: BLE001 -- compiler/alloc errors
+            swept.append(Probe(v, 0.0, 0.0,
+                               error=f"{type(e).__name__}: {e}"))
+            if log:
+                log.warn("tune rung failed to build; skipping",
+                         **{label: v}, error=str(e))
+            continue
+        if compile_s > compile_budget_s:
+            swept.append(Probe(v, 0.0, compile_s,
+                               error="over compile budget",
+                               cache=rung_cache))
+            if log:
+                log.warn("tune rung over compile budget; skipping",
+                         **{label: v}, compile_s=f"{compile_s:.1f}",
+                         budget_s=compile_budget_s)
+            continue
+        rate = _probe_rate(worker, keyspace, probe_seconds, clock,
+                           unit_strides=unit_strides)
+        p = Probe(v, rate, compile_s, cache=rung_cache)
+        swept.append(p)
+        if log:
+            log.info("tune rung", **{label: v}, rate=f"{rate:,.0f}/s",
+                     compile_s=f"{compile_s:.2f}", cache=rung_cache)
+        if best is None or rate > best.rate_hs:
+            best = p
+    if best is None:
+        errs = "; ".join(p.error or "?" for p in swept) or "no values"
+        raise ValueError(f"value sweep failed on every rung ({errs})")
     return TuneResult(best.batch, best.rate_hs, best.compile_s, swept,
                       source="swept")

@@ -94,6 +94,13 @@ _declare("DPRF_PALLAS", "auto", "str",
          "Pallas kernel routing: '0' disables, '1' forces (interpret "
          "mode off-TPU, for tests), 'auto' uses kernels on real TPU "
          "only.")
+_declare("DPRF_PALLAS_PROBE_FP", 1e-7, "float",
+         "False-positive budget for the IN-KERNEL blocked probe "
+         "bitmap (sharded/multi-target mask kernels).  Much tighter "
+         "than DPRF_TARGETS_FP_BUDGET: kernel survivors drain through "
+         "a tiny device-resident hit buffer per superstep window and "
+         "cost one host oracle hash each, so false maybes must be "
+         "rare per window, not merely per batch.")
 _declare("DPRF_PALLAS_SUB", 128, "int",
          "Mask-attack Pallas kernels: sublanes per grid cell (tile = "
          "SUB*128 lanes).  Tuned on TPU v5 lite; tests pin 32.")
