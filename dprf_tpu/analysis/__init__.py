@@ -1,7 +1,7 @@
 """``dprf check``: the unified static-analysis suite (ISSUE 6, made
 interprocedural in ISSUE 7).
 
-One runner, eight analyzers, zero runtime dependencies -- the layer
+One runner, nine analyzers, zero runtime dependencies -- the layer
 that turns this repo's recurring concurrent/protocol/config bug
 classes into lint failures instead of loopback-test flakes:
 
@@ -30,6 +30,10 @@ classes into lint failures instead of loopback-test flakes:
                     loops declared in HOT_PATHS tables, jit entries
                     resolved through the call graph
                     (analysis/retrace.py)
+  coverage-events   every range-mutating site in the
+                    COVERAGE_EVENT_SITES manifest calls the coverage
+                    ledger event API; event literals in EVENT_NAMES
+                    (analysis/coverage_events.py)
 
 The shared interprocedural machinery -- whole-package call graph,
 type resolution, per-function summaries, transitive closure -- lives
@@ -234,11 +238,11 @@ class AnalysisContext:
 def _plugins() -> dict:
     """name -> module (imported lazily so a syntax error in one
     analyzer doesn't take the whole runner down at import time)."""
-    from dprf_tpu.analysis import (envknobs, locks, markers, metrics,
-                                   protocol, retrace, threads,
-                                   worker_contract)
+    from dprf_tpu.analysis import (coverage_events, envknobs, locks,
+                                   markers, metrics, protocol,
+                                   retrace, threads, worker_contract)
     mods = (markers, metrics, worker_contract, locks, protocol,
-            envknobs, threads, retrace)
+            envknobs, threads, retrace, coverage_events)
     return {m.NAME: m for m in mods}
 
 

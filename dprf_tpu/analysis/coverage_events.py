@@ -1,0 +1,223 @@
+"""Coverage event-site discipline (ISSUE 19).
+
+The bug class this makes impossible: a refactor moves or adds a code
+path that mutates a unit's index range -- a new redrive, a different
+resplit, a fresh submit loop -- and forgets to tell the coverage
+ledger.  The audit plane then swears coverage is complete while
+candidates silently leak.  Rules:
+
+  1. every event-name literal passed to a ``<...>.coverage.event(``
+     or ``coverage.note(`` call is a member of
+     ``telemetry/coverage.py``'s ``EVENT_NAMES`` tuple (which holds
+     no duplicates), and the name argument IS a literal -- a computed
+     event name can't be audited statically;
+  2. every ``(file, function)`` entry in ``COVERAGE_EVENT_SITES`` --
+     the declared manifest of range-mutating sites -- exists, and
+     EVERY function definition with that name in that file contains
+     at least one event/note call (two classes sharing a method name
+     must both report);
+  3. the manifest is exhaustive: a package function OUTSIDE
+     telemetry/coverage.py that calls the event API but is not
+     declared in ``COVERAGE_EVENT_SITES`` is a finding -- new sites
+     must be declared, so reviewers see coverage-plane changes in the
+     one place ``--explain coverage-events`` renders.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from dprf_tpu.analysis import Finding
+
+NAME = "coverage-events"
+DESCRIPTION = ("every declared range-mutating site calls the coverage "
+               "ledger event API; every event literal is in "
+               "EVENT_NAMES; every caller is declared in "
+               "COVERAGE_EVENT_SITES")
+
+DECL_TABLES = ("EVENT_NAMES", "COVERAGE_EVENT_SITES")
+
+COVERAGE_REL = os.path.join("telemetry", "coverage.py")
+
+#: parse prefilter: files without event/note call text can't matter
+_RELEVANT_RE = re.compile(r"coverage\.event\s*\(|coverage\.note\s*\(|"
+                          r"\.event\s*\(")
+
+
+def _literal(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _tuple_of_str(node):
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = [_literal(e) for e in node.elts]
+    return out if all(v is not None for v in out) else None
+
+
+def _declared(idx):
+    """(EVENT_NAMES list | None, COVERAGE_EVENT_SITES list | None)
+    from coverage.py's module-level assignments."""
+    names = sites = None
+    if idx is None:
+        return None, None
+    for node in idx.assigns:
+        for t in node.targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if t.id == "EVENT_NAMES":
+                names = _tuple_of_str(node.value)
+            elif t.id == "COVERAGE_EVENT_SITES":
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    sites = []
+                    for elt in node.value.elts:
+                        pair = _tuple_of_str(elt)
+                        sites.append(tuple(pair)
+                                     if pair and len(pair) == 2
+                                     else None)
+    return names, sites
+
+
+def _receiver_name(func: ast.Attribute):
+    """Trailing name of the call receiver: ``self.coverage.event`` ->
+    'coverage', ``coverage.note`` -> 'coverage'."""
+    v = func.value
+    if isinstance(v, ast.Attribute):
+        return v.attr
+    if isinstance(v, ast.Name):
+        return v.id
+    return None
+
+
+def _event_calls(body) -> list:
+    """(event literal | None, lineno) for every ledger/note call in a
+    function body, SKIPPING nested defs (a nested function is its own
+    site for the manifest check)."""
+    out = []
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in ("event", "note")
+                    and _receiver_name(f) == "coverage"):
+                first = node.args[0] if node.args else None
+                out.append((_literal(first), node.lineno))
+        for v in ast.iter_child_nodes(node):
+            stack.append(v)
+    return out
+
+
+def run(ctx) -> list:
+    out = []
+    cov_py = os.path.join(ctx.package_dir, COVERAGE_REL)
+    if not os.path.exists(cov_py):
+        # a tree without the coverage module (fixture repos) has no
+        # audit plane to keep honest -- nothing to check
+        return out
+    cov_rel = ctx.rel(cov_py)
+    names, sites = _declared(ctx.index(cov_py))
+    if names is None:
+        out.append(Finding(
+            NAME, cov_rel, 1,
+            "EVENT_NAMES literal tuple not found in "
+            "telemetry/coverage.py (it must stay a pure tuple of "
+            "string literals so this check can read it)"))
+        names = []
+    elif len(set(names)) != len(names):
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        out.append(Finding(
+            NAME, cov_rel, 1,
+            f"duplicate EVENT_NAMES entries: {dupes}"))
+    if sites is None:
+        out.append(Finding(
+            NAME, cov_rel, 1,
+            "COVERAGE_EVENT_SITES literal tuple not found in "
+            "telemetry/coverage.py (the manifest of range-mutating "
+            "sites this check enforces)"))
+        sites = []
+    if any(s is None for s in sites):
+        out.append(Finding(
+            NAME, cov_rel, 1,
+            "COVERAGE_EVENT_SITES entries must be literal "
+            "(file, function) string pairs"))
+        sites = [s for s in sites if s is not None]
+    declared = set(sites)
+    allowed = set(names)
+
+    # file -> {function name -> [(def lineno, had_call)]}
+    seen_sites: dict = {}
+    for path in ctx.package_files():
+        try:
+            if not _RELEVANT_RE.search(ctx.source(path)):
+                continue
+        except OSError:
+            continue
+        rel = ctx.rel(path)
+        idx = ctx.index(path)
+        if idx is None:
+            continue
+        for fn in idx.functions:
+            calls = _event_calls(fn.body)
+            if not calls:
+                continue
+            seen_sites.setdefault(rel, {}).setdefault(
+                fn.name, []).append(fn.lineno)
+            for lit, lineno in calls:
+                if lit is None:
+                    out.append(Finding(
+                        NAME, rel, lineno,
+                        "coverage event name must be a string "
+                        "literal -- a computed name can't be "
+                        "statically audited"))
+                elif lit not in allowed:
+                    out.append(Finding(
+                        NAME, rel, lineno,
+                        f"coverage event {lit!r} not declared in "
+                        "telemetry/coverage.py EVENT_NAMES"))
+            # rule 3: the manifest must name every calling site
+            if (rel != cov_rel and (rel, fn.name) not in declared):
+                out.append(Finding(
+                    NAME, rel, fn.lineno,
+                    f"function {fn.name!r} calls the coverage event "
+                    "API but is not declared in "
+                    "COVERAGE_EVENT_SITES -- declare the site in "
+                    "telemetry/coverage.py"))
+
+    # rule 2: every declared site exists and every same-named def
+    # in that file actually reports
+    for file_rel, func in sorted(declared):
+        path = os.path.join(ctx.root, file_rel)
+        if not os.path.exists(path):
+            out.append(Finding(
+                NAME, cov_rel, 1,
+                f"COVERAGE_EVENT_SITES names missing file "
+                f"{file_rel!r}"))
+            continue
+        idx = ctx.index(path)
+        if idx is None:
+            continue
+        defs = [fn for fn in idx.functions if fn.name == func]
+        if not defs:
+            out.append(Finding(
+                NAME, file_rel, 1,
+                f"COVERAGE_EVENT_SITES names {func!r} but no such "
+                "function is defined here -- stale manifest entry"))
+            continue
+        reported = seen_sites.get(file_rel, {}).get(func, [])
+        for fn in defs:
+            if fn.lineno not in reported:
+                out.append(Finding(
+                    NAME, file_rel, fn.lineno,
+                    f"{func!r} is a declared coverage event site but "
+                    "this definition never calls "
+                    "coverage.event()/coverage.note() -- a range "
+                    "mutation the audit plane cannot see"))
+    return out

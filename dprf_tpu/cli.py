@@ -565,6 +565,20 @@ def _build_parser() -> argparse.ArgumentParser:
                      "of the text rendering")
     rpt.add_argument("--quiet", "-q", action="store_true")
 
+    aud = sub.add_parser("audit", help="coverage audit from session "
+                         "artifacts alone (perfreport/audit.py): "
+                         "rebuild per-job coverage from journal "
+                         "snapshots (fraction, gaps, digest "
+                         "re-check), replay trace complete spans for "
+                         "double-covered candidates, prove hits were "
+                         "found exactly once -- exit 0 on verdict "
+                         "clean, 3 otherwise")
+    aud.add_argument("session", help="session journal path")
+    aud.add_argument("--json", action="store_true",
+                     help="machine-readable audit on stdout instead "
+                     "of the text rendering")
+    aud.add_argument("--quiet", "-q", action="store_true")
+
     pg = sub.add_parser("programs", help="compiled-program table of a "
                         "running coordinator: XLA-derived flops, "
                         "bytes accessed, and peak device memory per "
@@ -970,14 +984,17 @@ def _load_job_targets(args, engine, log: Log):
 
 
 def _setup_session(args, spec, log: Log):
-    """Returns (session, completed, restored_hits, tuning, jobs) or
-    None on conflict; ``jobs`` is the journal's scheduler-submitted
-    job records (multi-tenant serve resume, jobs/build.restore_jobs)."""
+    """Returns (session, completed, restored_hits, tuning, jobs,
+    digest) or None on conflict; ``jobs`` is the journal's
+    scheduler-submitted job records (multi-tenant serve resume,
+    jobs/build.restore_jobs) and ``digest`` is the journal's coverage
+    digest for the default job's restored intervals (ISSUE 19)."""
     session = None
     completed: list = []
     restored_hits: list = []
     tuning: dict = {}
     jobs: dict = {}
+    digest = None
     if args.session:
         session = SessionJournal(args.session)
         prior = SessionJournal.load(args.session)
@@ -994,6 +1011,7 @@ def _setup_session(args, spec, log: Log):
                 restored_hits = prior.hits
                 tuning = prior.tuning
                 jobs = prior.jobs
+                digest = prior.coverage.get(prior.default_job)
                 done = sum(e - s for s, e in completed)
                 log.info("resuming session", covered=done,
                          hits=len(restored_hits), jobs=len(jobs))
@@ -1001,7 +1019,7 @@ def _setup_session(args, spec, log: Log):
             log.error("session file exists; pass --restore to resume "
                       "or remove it", path=args.session)
             return None
-    return session, completed, restored_hits, tuning, jobs
+    return session, completed, restored_hits, tuning, jobs, digest
 
 
 def _print_results(found: dict, targets) -> None:
@@ -1062,7 +1080,8 @@ def _setup_job(args, device: str, log: Log,
     sess = _setup_session(args, spec, log)
     if sess is None:
         return None
-    session, completed, restored_hits, tuning, restored_jobs = sess
+    (session, completed, restored_hits, tuning, restored_jobs,
+     restored_digest) = sess
 
     kw = {} if lease_timeout is None else {"lease_timeout": lease_timeout}
     unit_seconds = getattr(args, "unit_seconds", 0) or 0
@@ -1101,8 +1120,19 @@ def _setup_job(args, device: str, log: Log,
                  "in this session; resume without them will NOT sweep "
                  "the excluded ranges")
     if restricted:
-        dispatcher = Dispatcher.from_completed(
-            gen.keyspace, unit_size, restricted, **kw)
+        # the journal's digest describes the RESTORED intervals only:
+        # --skip/--limit append synthetic covered ranges, which would
+        # (correctly) rebuild to a different digest -- so the check
+        # only arms on a pure resume
+        expect = (restored_digest
+                  if not skip and limit is None else None)
+        try:
+            dispatcher = Dispatcher.from_completed(
+                gen.keyspace, unit_size, restricted,
+                expect_digest=expect, **kw)
+        except ValueError as e:
+            log.error("refusing to resume", error=str(e))
+            return None
     else:
         dispatcher = Dispatcher(gen.keyspace, unit_size, **kw)
     return _JobSetup(engine, hl, gen, max_len, unit_size, spec,
@@ -1479,9 +1509,9 @@ def cmd_serve(args, log: Log) -> int:
         if session is not None:
             session.record_hit(ti, cand, plain, job=job.job_id)
 
-    def on_job_progress(jid, intervals):
+    def on_job_progress(jid, intervals, digest=None):
         if session is not None:
-            session.record_units(intervals, job=jid)
+            session.record_units(intervals, job=jid, digest=digest)
 
     def on_job_event(kind, job):
         if session is None:
@@ -1584,14 +1614,15 @@ def cmd_serve(args, log: Log) -> int:
         summaries = state.scheduler.summaries()
         per_job = [(j.job_id, j.dispatcher.completed_intervals(),
                     j.dispatcher.parked_count(),
-                    j.dispatcher.parked_indices())
+                    j.dispatcher.parked_indices(),
+                    j.dispatcher.coverage_digest())
                    for j in state.scheduler.jobs()]
     if session is not None:
-        for jid, intervals, _, _ in per_job:
-            session.snapshot(intervals, job=jid)
+        for jid, intervals, _, _, digest in per_job:
+            session.snapshot(intervals, job=jid, digest=digest)
         session.close()
     _print_results(found, hl.targets)
-    for jid, _, parked, parked_idx in per_job:
+    for jid, _, parked, parked_idx, _ in per_job:
         if parked:
             log.warn("job finished with POISONED units parked; their "
                      "ranges were NOT swept", job=jid, parked=parked,
@@ -2466,6 +2497,27 @@ def cmd_report(args, log: Log) -> int:
     return 0
 
 
+def cmd_audit(args, log: Log) -> int:
+    """`dprf audit SESSION`: reconstruct the coverage story from the
+    session's artifacts (perfreport/audit.py) and gate on it -- exit
+    0 only when the verdict is clean, so CI and the chaos harness can
+    use the exit code directly."""
+    import json as _json
+
+    from dprf_tpu.perfreport import build_audit, render_audit
+
+    doc = build_audit(args.session)
+    if doc is None:
+        log.error("no session artifacts found (journal or "
+                  ".trace.jsonl)", session=args.session)
+        return 2
+    if args.json:
+        print(_json.dumps(doc, sort_keys=True))
+    else:
+        print(render_audit(doc))
+    return 0 if doc["verdict"] == "clean" else 3
+
+
 def _fmt_eta(v) -> str:
     if v is None:
         return "?"
@@ -2870,6 +2922,7 @@ _COMMANDS = {
     "alerts": cmd_alerts,
     "token": cmd_token,
     "report": cmd_report,
+    "audit": cmd_audit,
     "programs": cmd_programs,
     "profile": cmd_profile,
     "metrics": cmd_metrics,

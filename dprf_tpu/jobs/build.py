@@ -30,14 +30,17 @@ DEFAULT_HIT_CAP = 64
 
 def build_job_runtime(spec: dict, job_id: str, log=None,
                       lease_timeout: float = 300.0, registry=None,
-                      recorder=None, completed=None):
+                      recorder=None, completed=None,
+                      expect_digest=None):
     """Wire spec -> (wire_job, dispatcher, targets, verifier).
 
     Raises ValueError on a malformed spec (missing keys, unparsable
     targets, generator construction failure, or a client-supplied
     fingerprint that disagrees with the server-side rebuild).
     ``completed`` (resume): prior coverage intervals the dispatcher is
-    rebuilt around.
+    rebuilt around; ``expect_digest`` is the journal's coverage digest
+    for them -- the rebuilt ledger must reproduce it (ISSUE 19), or
+    the resume is refused rather than sweeping around silent holes.
     """
     from dprf_tpu import cli as _cli
     from dprf_tpu import get_engine
@@ -113,7 +116,8 @@ def build_job_runtime(spec: dict, job_id: str, log=None,
             registry=registry)
     if completed:
         dispatcher = Dispatcher.from_completed(
-            gen.keyspace, unit_size, list(completed), **kw)
+            gen.keyspace, unit_size, list(completed),
+            expect_digest=expect_digest, **kw)
     else:
         dispatcher = Dispatcher(gen.keyspace, unit_size, **kw)
 
@@ -172,7 +176,8 @@ def restore_jobs(state, jobs: dict, log=None,
             wire, dispatcher, targets, verifier = build_job_runtime(
                 spec, jid, log=log, lease_timeout=lease_timeout,
                 registry=state.registry, recorder=state.tracer,
-                completed=rec.get("completed") or ())
+                completed=rec.get("completed") or (),
+                expect_digest=rec.get("coverage_digest"))
         except (ValueError, OSError, KeyError) as e:
             log.warn("journaled job failed to rebuild; skipping",
                      job=jid, error=str(e))

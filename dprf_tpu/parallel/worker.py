@@ -27,6 +27,7 @@ from dprf_tpu.engines.base import HashEngine, Target
 from dprf_tpu.runtime.worker import (Hit, MaskWorkerBase, PendingUnit,
                                      WordlistWorkerBase, word_cover_range)
 from dprf_tpu.runtime.workunit import WorkUnit
+from dprf_tpu.telemetry import coverage
 
 #: `dprf check` retrace analyzer: the sharded per-window dispatch
 #: loops.  Everything submit() enqueues rides the device stream; a
@@ -194,6 +195,11 @@ class ShardedMaskWorker(_ShardedSuperstepMixin, MaskWorkerBase):
             f = self._batch_flag(result)
             flag = f if flag is None else flag + f
             queued.append(("sshard", (pos, window), result))
+            # coverage note (ISSUE 19): superstep windows must tile
+            # the unit exactly -- one cheap note per multi-million-
+            # candidate window lets the auditor check that
+            coverage.note("window", pos, pos + window,
+                          unit=unit.unit_id, kind="sshard")
             pos += window
         for bstart in range(pos, unit.end, self.stride):
             n_valid = min(self.stride, unit.end - bstart)
@@ -202,6 +208,8 @@ class ShardedMaskWorker(_ShardedSuperstepMixin, MaskWorkerBase):
             f = self._batch_flag(result)
             flag = f if flag is None else flag + f
             queued.append(("batch", bstart, result))
+            coverage.note("window", bstart, bstart + n_valid,
+                          unit=unit.unit_id, kind="batch")
         if flag is not None and hasattr(flag, "copy_to_host_async"):
             flag.copy_to_host_async()
         return PendingUnit(self, unit, queued, flag)
@@ -322,6 +330,11 @@ class ShardedWordlistWorker(_ShardedSuperstepMixin, WordlistWorkerBase):
             f = self._batch_flag(result)
             flag = f if flag is None else flag + f
             queued.append(("wshard", (ws, nw), result))
+            # coverage note (ISSUE 19): word-window tiling evidence,
+            # in candidate-index coordinates
+            coverage.note("window", ws * self.gen.n_rules,
+                          (ws + nw) * self.gen.n_rules,
+                          unit=unit.unit_id, kind="wshard")
             ws += nw
         while ws < w_end:
             nw = min(self.super_words, w_end - ws)
@@ -332,6 +345,9 @@ class ShardedWordlistWorker(_ShardedSuperstepMixin, WordlistWorkerBase):
             f = self._batch_flag(result)
             flag = f if flag is None else flag + f
             queued.append(("wshard", (ws, nw), result))
+            coverage.note("window", ws * self.gen.n_rules,
+                          (ws + nw) * self.gen.n_rules,
+                          unit=unit.unit_id, kind="wwindow")
             ws += nw
         if flag is not None and hasattr(flag, "copy_to_host_async"):
             flag.copy_to_host_async()
@@ -397,6 +413,12 @@ class ShardedWordlistWorker(_ShardedSuperstepMixin, WordlistWorkerBase):
         import jax.numpy as jnp
         hits: list[Hit] = []
         end = ws + nw
+        # coverage note (ISSUE 19): the overflowed superstep window
+        # goes back through per-window dispatch -- deliberate
+        # re-coverage, in candidate-index coordinates
+        R = self.gen.n_rules
+        coverage.note("redrive", max(unit.start, ws * R),
+                      min(unit.end, end * R), unit=unit.unit_id)
         w = ws
         while w < end:
             n = min(self.super_words, end - w)

@@ -13,12 +13,18 @@ Two consumers of the artifacts the runtime already writes:
     busy fraction, compile-cache hit rate, pipeline depth, per-job
     fair-share actual-vs-weight) ENTIRELY from session artifacts (the
     trace JSONL, telemetry snapshots, and the journal), so a
-    post-mortem needs no live coordinator.
+    post-mortem needs no live coordinator;
+  - ``perfreport.audit`` -- ``dprf audit SESSION`` (ISSUE 19):
+    rebuild the coverage story (fraction, gaps, digests, trace-replay
+    overlaps, exactly-once hits) from artifacts alone and render a
+    clean/incomplete/dirty verdict.
 """
 
+from dprf_tpu.perfreport.audit import build_audit, render_audit
 from dprf_tpu.perfreport.compare import (gate, latest_record,
                                          load_bench_records)
 from dprf_tpu.perfreport.report import build_report, render_report
 
 __all__ = ["gate", "latest_record", "load_bench_records",
-           "build_report", "render_report"]
+           "build_report", "render_report", "build_audit",
+           "render_audit"]

@@ -1,0 +1,247 @@
+"""``dprf audit SESSION``: offline coverage reconstruction from
+session artifacts alone (ISSUE 19).
+
+The live coverage ledger (telemetry/coverage.py) watches a run from
+inside; this is the outside check -- given only the journal family a
+session leaves behind, rebuild the coverage story and judge it:
+
+  - the journal's ``units`` snapshots are the coverage AUTHORITY:
+    merged intervals per job, rebuilt into an IntervalSet, measured
+    against the job's declared keyspace (gaps, fraction), and
+    re-digested -- the rebuilt digest must match the digest the
+    snapshot itself carried, exactly as a resume must
+    (``Dispatcher.from_completed``);
+  - the trace stream's ``complete`` spans (which carry each unit's
+    ``start``/``length`` since ISSUE 19) REPLAY coverage event by
+    event: any index completed twice in the replay is a
+    double-covered candidate the stale-lease guard should have
+    stopped.  The trace file is bounded (rotation), so a missing span
+    is never evidence of a problem -- only a positive overlap is;
+  - the journal's ``hit`` records prove each cracked target was found
+    exactly once (the coordinator dedupes before journaling, so a
+    duplicate here means the exactly-once invariant broke upstream).
+
+Verdict: ``dirty`` on any positive evidence (digest mismatch, replay
+overlap, duplicate hits), else ``incomplete`` when a job's covered
+fraction is below 1.0 (nothing wrong -- the run just stopped early or
+cracked out), else ``clean``.  The chaos harness
+(dprf_tpu/testing/chaos.py) gates on ``clean``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from dprf_tpu.telemetry.coverage import (IntervalSet, coverage_digest,
+                                         max_gaps)
+from dprf_tpu.telemetry.trace import load_trace, trace_path
+
+
+def _replay_trace(spans: list) -> dict:
+    """job id -> {covered: IntervalSet, completes, overlap} replayed
+    from the ``complete`` spans' ranges, in span order.
+
+    ``restore`` spans (``Dispatcher.from_completed``) mark a
+    coordinator-restart GENERATION boundary: the first restore after
+    any complete resets the job's covered set, and the restore batch
+    seeds it with what the journal had actually snapshotted.  A
+    crash-restart legitimately re-sweeps ranges completed after the
+    last snapshot -- only re-coverage WITHIN a generation (or of a
+    range the restore itself seeded) is double coverage.  The
+    ``overlap`` count is cumulative across generations."""
+    replay: dict = {}
+    in_restore: dict = {}    # job id -> currently inside restore batch
+
+    def _job(jid: str) -> dict:
+        return replay.setdefault(jid, {"covered": IntervalSet(),
+                                       "completes": 0, "overlap": 0})
+
+    for s in spans:
+        name = s.get("name")
+        if name not in ("complete", "restore"):
+            continue
+        a = s.get("attrs") or {}
+        try:
+            start = int(a["start"])
+            length = int(a["length"])
+        except (KeyError, TypeError, ValueError):
+            continue   # pre-ISSUE-19 span without a range: no evidence
+        jid = str(a.get("job", "j0"))
+        r = _job(jid)
+        if name == "restore":
+            if not in_restore.get(jid):
+                in_restore[jid] = True
+                r["covered"] = IntervalSet()
+            r["covered"].add(start, start + length)
+            continue
+        in_restore[jid] = False
+        r["completes"] += 1
+        r["overlap"] += length - r["covered"].add(start, start + length)
+    return replay
+
+
+def _dupe_hits(hits: list) -> int:
+    """Hit records whose (target, candidate index) already appeared --
+    each is one hit found MORE than once."""
+    seen: set = set()
+    dupes = 0
+    for h in hits:
+        key = (h.get("target"), h.get("index"))
+        if key in seen:
+            dupes += 1
+        else:
+            seen.add(key)
+    return dupes
+
+
+def _audit_job(jid: str, keyspace: Optional[int], intervals: list,
+               digest_journal: Optional[str], hits: list,
+               replay: Optional[dict]) -> dict:
+    iv = IntervalSet(intervals)
+    covered = iv.covered()
+    doc: dict = {
+        "job": jid,
+        "keyspace": keyspace,
+        "covered": covered,
+        "fraction": (round(covered / keyspace, 6)
+                     if keyspace else None),
+        "gaps": (iv.gaps(keyspace)[:max_gaps()] if keyspace else []),
+        "gap_total": (keyspace - covered if keyspace else None),
+        "digest_journal": digest_journal,
+        # re-digest the journaled intervals: must reproduce the digest
+        # the snapshot carried (the live ledger's digest at write time)
+        "digest_rebuilt": (coverage_digest(keyspace, intervals)
+                           if keyspace else None),
+        "hits": len(hits),
+        "hit_dupes": _dupe_hits(hits),
+        "trace_completes": 0,
+        "trace_overlap": 0,
+        "trace_covered": 0,
+    }
+    doc["digest_match"] = (
+        None if not digest_journal or not doc["digest_rebuilt"]
+        else digest_journal == doc["digest_rebuilt"])
+    if replay is not None:
+        doc["trace_completes"] = replay["completes"]
+        doc["trace_overlap"] = replay["overlap"]
+        doc["trace_covered"] = replay["covered"].covered()
+    return doc
+
+
+def _job_problems(j: dict) -> list:
+    out = []
+    if j["digest_match"] is False:
+        out.append(
+            f"job {j['job']}: journaled coverage digest "
+            f"{j['digest_journal']} does not match the rebuild "
+            f"{j['digest_rebuilt']} (torn or edited journal)")
+    if j["trace_overlap"]:
+        out.append(
+            f"job {j['job']}: trace replay double-covered "
+            f"{j['trace_overlap']} candidate(s) across "
+            f"{j['trace_completes']} completions (stale lease past "
+            "the guard, or a planted double-lease)")
+    if j["hit_dupes"]:
+        out.append(
+            f"job {j['job']}: {j['hit_dupes']} hit record(s) "
+            "duplicate an earlier (target, index) -- hits must be "
+            "found exactly once")
+    return out
+
+
+def build_audit(session_path: str) -> Optional[dict]:
+    """The machine-readable audit, or None when the session left no
+    artifacts at all."""
+    from dprf_tpu.runtime.session import SessionJournal
+    journal = (SessionJournal.load(session_path)
+               if os.path.exists(session_path) else None)
+    spans = load_trace(trace_path(session_path))
+    if journal is None and not spans:
+        return None
+    replay = _replay_trace(spans)
+    jobs: list = []
+    if journal is not None:
+        default_jid = journal.default_job
+        ks = journal.spec.get("keyspace") if journal.spec else None
+        ks = int(ks) if ks else None
+        jobs.append(_audit_job(
+            default_jid, ks, journal.completed,
+            journal.coverage.get(default_jid), journal.hits,
+            replay.pop(default_jid, None)))
+        for jid in sorted(journal.jobs):
+            rec = journal.jobs[jid]
+            spec = rec.get("spec") or {}
+            jks = spec.get("keyspace")
+            jobs.append(_audit_job(
+                jid, int(jks) if jks else None,
+                rec.get("completed") or [],
+                rec.get("coverage_digest"), rec.get("hits") or [],
+                replay.pop(jid, None)))
+    # complete spans for jobs the journal never snapshotted still
+    # carry overlap evidence (e.g. a journal lost to the fault being
+    # audited)
+    for jid in sorted(replay):
+        jobs.append(_audit_job(jid, None, [], None, [], replay[jid]))
+    problems: list = []
+    for j in jobs:
+        problems.extend(_job_problems(j))
+    if problems:
+        verdict = "dirty"
+    elif any(j["fraction"] is not None and j["fraction"] < 1.0
+             for j in jobs):
+        verdict = "incomplete"
+    else:
+        verdict = "clean"
+    return {
+        "session": session_path,
+        "jobs": jobs,
+        "spans": len(spans),
+        "problems": problems,
+        "verdict": verdict,
+    }
+
+
+def render_audit(doc: dict) -> str:
+    """The human half: a sectioned text audit (stdout of ``dprf
+    audit``; the CI audit tier uploads it as an artifact)."""
+    lines = [f"dprf audit — {doc['session']}",
+             f"{len(doc['jobs'])} job(s) | {doc['spans']} trace "
+             f"spans | verdict {doc['verdict'].upper()}"]
+    for j in doc["jobs"]:
+        lines.append("")
+        lines.append(f"job {j['job']}")
+        if j["keyspace"]:
+            frac = j["fraction"]
+            lines.append(f"  keyspace   {j['keyspace']:,}")
+            lines.append(f"  covered    {j['covered']:,}"
+                         + (f"  ({100 * frac:.2f}%)"
+                            if frac is not None else ""))
+            gap = j["gap_total"] or 0
+            if gap:
+                shown = ", ".join(f"[{s},{e})" for s, e in j["gaps"])
+                lines.append(f"  GAPS       {gap:,} candidate(s): "
+                             f"{shown}")
+        else:
+            lines.append(f"  covered    {j['covered']:,} "
+                         "(keyspace not journaled)")
+        if j["digest_journal"]:
+            mark = {True: "match", False: "MISMATCH",
+                    None: "n/a"}[j["digest_match"]]
+            lines.append(f"  digest     journal {j['digest_journal']} "
+                         f"| rebuilt {j['digest_rebuilt']} "
+                         f"[{mark}]")
+        if j["trace_completes"]:
+            lines.append(
+                f"  trace      {j['trace_completes']} completion "
+                f"span(s), {j['trace_covered']:,} candidates, "
+                f"{j['trace_overlap']} double-covered")
+        lines.append(f"  hits       {j['hits']}"
+                     + (f"  ({j['hit_dupes']} DUPLICATE)"
+                        if j["hit_dupes"] else ""))
+    if doc["problems"]:
+        lines.append("")
+        lines.append("problems")
+        for p in doc["problems"]:
+            lines.append(f"  - {p}")
+    return "\n".join(lines)

@@ -47,21 +47,36 @@ def _counter_total(snapshot, name: str, **labels) -> float:
 
 
 def _phase_stats(spans: list, sample_scale: float = 1.0) -> dict:
-    """phase -> {count, p50_s, p95_s, total_s, share}.  The
-    generate/h2d/device/d2h durations come from SAMPLED probes (every
-    Nth unit) while ``verify`` comes from every hit batch's
+    """phase -> {count, p50_s, p95_s, total_s, share, per_cand_ns}.
+    The generate/h2d/device/d2h durations come from SAMPLED probes
+    (every Nth unit) while ``verify`` comes from every hit batch's
     hit_verify span, so the share denominator scales the sampled
     totals by the observed cadence (units / probed units) -- without
     it, verify's share would inflate by the sampling factor.
-    ``total_s``/p50/p95/count stay the observed values."""
+    ``total_s``/p50/p95/count stay the observed values.
+
+    ``per_cand_ns`` divides each phase's observed time by the
+    candidates its probed units actually hashed (the ``cands`` attr
+    the probe records since ISSUE 19).  A Pallas superstep unit runs
+    many inner batches per probe while the baseline probes one batch,
+    so raw per-unit totals are incomparable across ``--impl``; the
+    per-candidate cost is the number that lines up."""
     by_phase: dict = {}
+    cands_by_phase: dict = {}
     for s in spans:
         if s.get("name") != "phase":
             continue
-        ph = (s.get("attrs") or {}).get("phase")
+        a = s.get("attrs") or {}
+        ph = a.get("phase")
         if ph:
             by_phase.setdefault(str(ph), []).append(
                 float(s.get("dur", 0.0)))
+            try:
+                cands_by_phase[str(ph)] = (
+                    cands_by_phase.get(str(ph), 0)
+                    + int(a.get("cands") or 0))
+            except (TypeError, ValueError):
+                pass
     # hit_verify spans carry the verify cost for EVERY hit batch
     for s in spans:
         if s.get("name") == "hit_verify":
@@ -79,11 +94,14 @@ def _phase_stats(spans: list, sample_scale: float = 1.0) -> dict:
         durs = sorted(by_phase.get(ph, ()))
         if not durs:
             continue
+        cands = cands_by_phase.get(ph, 0)
         out[ph] = {"count": len(durs),
                    "p50_s": round(_pct(durs, 0.50), 6),
                    "p95_s": round(_pct(durs, 0.95), 6),
                    "total_s": round(sum(durs), 6),
-                   "share": round(scaled(ph) / total_all, 4)}
+                   "share": round(scaled(ph) / total_all, 4),
+                   "per_cand_ns": (round(sum(durs) / cands * 1e9, 3)
+                                   if cands else None)}
     return out
 
 
@@ -237,6 +255,26 @@ def _profile_section(journal) -> Optional[list]:
     return out
 
 
+def _coverage_section(session_path: str) -> Optional[dict]:
+    """Coverage audit summary (ISSUE 19): the offline auditor's
+    per-job fraction / overlap / gap / digest-match rows plus its
+    verdict, so the perf report answers "did we actually try
+    everything?" next to "how fast?".  None when the auditor finds no
+    artifacts (the full story lives in ``dprf audit``)."""
+    from dprf_tpu.perfreport.audit import build_audit
+    doc = build_audit(session_path)
+    if doc is None:
+        return None
+    jobs = [{"job": j["job"],
+             "fraction": j["fraction"],
+             "gap_total": j["gap_total"],
+             "overlap": j["trace_overlap"],
+             "digest_match": j["digest_match"],
+             "hit_dupes": j["hit_dupes"]}
+            for j in doc["jobs"]]
+    return {"verdict": doc["verdict"], "jobs": jobs}
+
+
 def _fair_share(spans: list, journal) -> list:
     """Per-job lease share vs fair-share weight, from the lease spans
     and the journal's job records (the default job's priority is 1
@@ -315,6 +353,7 @@ def build_report(session_path: str) -> Optional[dict]:
         "pipeline_depth": (float(depth_vals[-1]["value"])
                            if depth_vals else None),
         "fair_share": _fair_share(spans, journal),
+        "coverage": _coverage_section(session_path),
         "health": _health_section(session_path, journal),
         "memory": _memory_section(last),
         "profiles": _profile_section(journal),
@@ -351,17 +390,39 @@ def render_report(doc: dict) -> str:
         lines.append("")
         lines.append("phase breakdown (sampled probes)")
         lines.append(f"  {'PHASE':9s} {'COUNT':>6s} {'P50':>10s} "
-                     f"{'P95':>10s} {'TOTAL':>10s} {'SHARE':>6s}")
+                     f"{'P95':>10s} {'TOTAL':>10s} {'SHARE':>6s} "
+                     f"{'PER-CAND':>10s}")
         for ph in PHASES:
             st = phases.get(ph)
             if not st:
                 continue
+            pc = st.get("per_cand_ns")
             lines.append(
                 f"  {ph:9s} {st['count']:>6d} "
                 f"{st['p50_s'] * 1e3:>8.2f}ms "
                 f"{st['p95_s'] * 1e3:>8.2f}ms "
                 f"{st['total_s']:>9.3f}s "
-                f"{100 * st['share']:>5.1f}%")
+                f"{100 * st['share']:>5.1f}% "
+                + (f"{pc:>8.2f}ns" if pc is not None
+                   else f"{'-':>10s}"))
+    cov = doc.get("coverage")
+    if cov:
+        lines.append("")
+        lines.append(f"coverage (audit verdict "
+                     f"{cov['verdict'].upper()})")
+        for j in cov.get("jobs") or ():
+            frac = j.get("fraction")
+            gap = j.get("gap_total")
+            mark = {True: "match", False: "MISMATCH",
+                    None: "n/a"}[j.get("digest_match")]
+            lines.append(
+                f"  {j['job'][:10]:10s} fraction "
+                + (f"{frac:.4f}" if frac is not None else "   n/a")
+                + f"  gaps {gap if gap is not None else '?'}"
+                + f"  overlap {j.get('overlap', 0)}"
+                + f"  digest {mark}"
+                + (f"  hit dupes {j['hit_dupes']}"
+                   if j.get("hit_dupes") else ""))
     busy = doc.get("busy") or {}
     if busy:
         lines.append("")

@@ -46,6 +46,10 @@ class JobResult:
     #: units parked by the dispatcher's retry cap (poisoned ranges the
     #: run could not cover; 0 on a healthy job)
     parked: int = 0
+    #: order-independent digest of the covered index set (ISSUE 19):
+    #: what the final journal snapshot recorded; `dprf audit` must
+    #: rebuild the same value from the session artifacts alone
+    coverage_digest: str = ""
 
     @property
     def rate(self) -> float:
@@ -326,7 +330,8 @@ class Coordinator:
                 if self.session is not None:
                     self.session.record_units(
                         self.dispatcher.completed_intervals(),
-                        job=self.dispatcher.job_id)
+                        job=self.dispatcher.job_id,
+                        digest=self.dispatcher.coverage_digest())
                 now = time.perf_counter()
                 if self.progress_cb and now - last_report >= self.progress_interval:
                     last_report = now
@@ -340,11 +345,13 @@ class Coordinator:
             if self.session is not None:
                 self.session.snapshot(
                     self.dispatcher.completed_intervals(),
-                    job=self.dispatcher.job_id)
+                    job=self.dispatcher.job_id,
+                    digest=self.dispatcher.coverage_digest())
                 self.session.close()
         elapsed = time.perf_counter() - t0
         done, total = self.dispatcher.progress()
         return JobResult(found=dict(self.found), tested=done - tested0,
                          elapsed=elapsed,
                          exhausted=self.dispatcher.exhausted(),
-                         parked=self.dispatcher.parked_count())
+                         parked=self.dispatcher.parked_count(),
+                         coverage_digest=self.dispatcher.coverage_digest())

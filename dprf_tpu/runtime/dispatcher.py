@@ -43,6 +43,7 @@ from typing import Callable, Optional
 
 from dprf_tpu.runtime.workunit import WorkUnit
 from dprf_tpu.telemetry import get_registry
+from dprf_tpu.telemetry.coverage import CoverageLedger, IntervalSet
 from dprf_tpu.telemetry.trace import get_tracer, new_trace_id, span_id
 
 #: lock-discipline declaration (`dprf check` locks analyzer): the
@@ -55,65 +56,9 @@ from dprf_tpu.telemetry.trace import get_tracer, new_trace_id, span_id
 #: Coordinator drives its Dispatcher from one thread; no lock needed.)
 GUARDED_BY = {"Dispatcher": {"<extern>": ()}}
 
-
-class IntervalSet:
-    """Sorted, merged set of [start, end) integer intervals."""
-
-    def __init__(self, intervals=()):
-        self._iv: list[list] = []
-        for s, e in intervals:
-            self.add(s, e)
-
-    def add(self, start: int, end: int) -> None:
-        if end <= start:
-            return
-        iv = self._iv
-        # binary search for insertion point by start
-        lo, hi = 0, len(iv)
-        while lo < hi:
-            mid = (lo + hi) // 2
-            if iv[mid][0] < start:
-                lo = mid + 1
-            else:
-                hi = mid
-        # merge with predecessor if touching
-        i = lo
-        if i > 0 and iv[i - 1][1] >= start:
-            i -= 1
-            iv[i][1] = max(iv[i][1], end)
-        else:
-            iv.insert(i, [start, end])
-        # absorb successors
-        j = i + 1
-        while j < len(iv) and iv[j][0] <= iv[i][1]:
-            iv[i][1] = max(iv[i][1], iv[j][1])
-            j += 1
-        del iv[i + 1:j]
-
-    def covered(self) -> int:
-        return sum(e - s for s, e in self._iv)
-
-    def contains_range(self, start: int, end: int) -> bool:
-        for s, e in self._iv:
-            if s <= start and end <= e:
-                return True
-        return False
-
-    def gaps(self, upto: int) -> list[tuple]:
-        """Uncovered ranges within [0, upto)."""
-        out, prev = [], 0
-        for s, e in self._iv:
-            if s >= upto:
-                break
-            if s > prev:
-                out.append((prev, min(s, upto)))
-            prev = max(prev, e)
-        if prev < upto:
-            out.append((prev, upto))
-        return out
-
-    def intervals(self) -> list[tuple]:
-        return [(s, e) for s, e in self._iv]
+#: re-export: the one interval implementation lives with the coverage
+#: ledger now (telemetry/coverage.py); existing importers keep working
+__all__ = ["Dispatcher", "IntervalSet"]
 
 
 class Dispatcher:
@@ -189,22 +134,51 @@ class Dispatcher:
         self._g_keyspace.set(keyspace, job=job_id)
         self._g_covered.set(0, job=job_id)
         self._g_parked.set(0, job=job_id)
+        #: coverage audit plane (ISSUE 19): every range-mutating
+        #: lifecycle step below feeds this ledger through its one
+        #: event API; it detects overlaps at insert, reports gaps
+        #: against the keyspace, and carries the coverage digest
+        self.coverage = CoverageLedger(keyspace, job_id=job_id,
+                                       registry=registry)
 
     # -- construction from a resume journal ------------------------------
 
     @classmethod
     def from_completed(cls, keyspace: int, unit_size: int,
-                       completed: list, **kw) -> "Dispatcher":
+                       completed: list,
+                       expect_digest: Optional[str] = None,
+                       **kw) -> "Dispatcher":
         d = cls(keyspace, unit_size, **kw)
         for s, e in completed:
             d._done.add(s, e)
+            d.coverage.event("restore", s, e)
+            # restore spans mark a GENERATION boundary in the trace
+            # stream and seed the new generation's covered set: the
+            # offline replay (perfreport/audit.py) resets on them, so
+            # a crash-restart legitimately re-sweeping ranges the
+            # journal had not snapshotted yet is not misread as
+            # double coverage -- while a true within-generation
+            # double-complete still is
+            d.tracer.record("restore", proc="coordinator",
+                            job=d.job_id, start=s, length=e - s)
         d._g_covered.set(d._done.covered(), job=d.job_id)
         frontier = max((e for _, e in completed), default=0)
         for s, e in d._done.gaps(frontier):
             # re-split big gaps into unit-sized pieces
+            d.coverage.event("resplit", s, e)
             for u in range(s, e, unit_size):
                 d._pending.append(d._make_unit(u, min(unit_size, e - u)))
         d._next_start = frontier
+        if expect_digest and d.coverage.digest() != expect_digest:
+            # the PR 14 fingerprint discipline applied to coverage
+            # state: a journal whose intervals do not reproduce the
+            # digest it recorded describes a DIFFERENT sweep -- a
+            # resume from it would punch silent coverage holes
+            raise ValueError(
+                "coverage digest mismatch on resume: journal recorded "
+                f"{expect_digest} but its intervals rebuild to "
+                f"{d.coverage.digest()} -- the journal is torn or "
+                "edited; refusing to resume over silent holes")
         return d
 
     def _make_unit(self, start: int, length: int) -> WorkUnit:
@@ -214,6 +188,7 @@ class Dispatcher:
         # the unit's whole lifecycle -- every lease, failure, reissue,
         # wherever it lands -- shares this one trace id
         self._trace_ids[u.unit_id] = new_trace_id()
+        self.coverage.event("split", u.start, u.end, unit=u.unit_id)
         return u
 
     def trace_context(self, unit_id: int) -> Optional[tuple]:
@@ -250,6 +225,8 @@ class Dispatcher:
         self._outstanding[unit.unit_id] = (
             unit, worker_id, self._clock() + self.lease_timeout,
             span_id(lease_span))
+        self.coverage.event("lease", unit.start, unit.end,
+                            unit=unit.unit_id)
         self._m_leased.inc(job=self.job_id)
         self._g_outstanding.set(len(self._outstanding),
                                 job=self.job_id)
@@ -300,15 +277,21 @@ class Dispatcher:
         del self._outstanding[unit_id]
         unit, worker_id, _, lease_sid = entry
         self._done.add(unit.start, unit.end)
+        self.coverage.event("complete", unit.start, unit.end,
+                            unit=unit_id)
         self._retries.pop(unit_id, None)
         if self.sizer is not None and elapsed is not None:
             # throughput report feeds the ADAPTIVE sizer: the next unit
             # this worker leases is sized toward the target seconds
             self.sizer.observe(worker_id, unit.length, elapsed)
+        # the span carries the unit's RANGE so the offline auditor
+        # (perfreport/audit.py) can replay coverage from the trace
+        # stream alone and cross-check it against the journal
         self.tracer.record(
             "complete", trace=self._trace_ids.pop(unit_id, None),
             parent=lease_sid, proc="coordinator", worker=worker_id,
-            unit=unit_id, job=self.job_id, elapsed_s=elapsed)
+            unit=unit_id, job=self.job_id, elapsed_s=elapsed,
+            start=unit.start, length=unit.length)
         self._m_completed.inc(job=self.job_id)
         self._g_covered.set(self._done.covered(), job=self.job_id)
         self._g_outstanding.set(len(self._outstanding),
@@ -340,6 +323,10 @@ class Dispatcher:
         tid = self._trace_ids.get(unit.unit_id)
         if (self.max_unit_retries is not None
                 and n >= self.max_unit_retries):
+            # parked ranges stay LIVE on the coverage ledger:
+            # accounted, intentionally unreachable -- never a gap
+            self.coverage.event("park", unit.start, unit.end,
+                                unit=unit.unit_id)
             self._parked.append(unit)
             self._parked_len += unit.length
             self._m_poisoned.inc(job=self.job_id)
@@ -353,6 +340,8 @@ class Dispatcher:
                      unit=unit.unit_id, start=unit.start,
                      length=unit.length, attempts=n, reason=reason)
         else:
+            self.coverage.event("reissue", unit.start, unit.end,
+                                unit=unit.unit_id)
             self._pending.append(unit)
             self.tracer.record("reissue", trace=tid, parent=lease_sid,
                                proc="coordinator", unit=unit.unit_id,
@@ -373,6 +362,8 @@ class Dispatcher:
             return False   # reissued to another worker: stale report
         del self._outstanding[unit_id]
         unit, holder, _, lease_sid = entry
+        self.coverage.event("fail", unit.start, unit.end,
+                            unit=unit_id)
         self.tracer.record("fail",
                            trace=self._trace_ids.get(unit_id),
                            parent=lease_sid, proc="coordinator",
@@ -423,6 +414,12 @@ class Dispatcher:
     def completed_intervals(self) -> list[tuple]:
         return self._done.intervals()
 
+    def coverage_digest(self) -> str:
+        """Order-independent digest of the covered set -- journaled
+        with units snapshots and carried by JobResult; a resume must
+        rebuild the same digest from the journaled intervals."""
+        return self.coverage.digest()
+
     def outstanding_count(self) -> int:
         return len(self._outstanding)
 
@@ -445,6 +442,7 @@ class Dispatcher:
         guard, so nothing lands after this."""
         self._pending.clear()
         self._outstanding.clear()
+        self.coverage.event("abandon")
         self._g_outstanding.set(0, job=self.job_id)
 
     def parked_count(self) -> int:
@@ -470,6 +468,8 @@ class Dispatcher:
         n = len(self._parked)
         for unit in self._parked:
             self._retries.pop(unit.unit_id, None)
+            self.coverage.event("unpark", unit.start, unit.end,
+                                unit=unit.unit_id)
             self._pending.append(unit)
             self.tracer.record("reissue",
                                trace=self._trace_ids.get(unit.unit_id),

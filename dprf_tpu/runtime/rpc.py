@@ -195,8 +195,9 @@ class CoordinatorState:
         #: (kind, Job) for job lifecycle events ("submit", "cancel",
         #: "pause", "resume") -- how the serve front-end journals them
         self.on_job_event = on_job_event
-        #: (job_id, completed_intervals) after every landed complete:
-        #: the per-job session-journal hook (tagged ``units`` records)
+        #: (job_id, completed_intervals, coverage_digest) after every
+        #: landed complete: the per-job session-journal hook (tagged
+        #: ``units`` records, digest riding each snapshot -- ISSUE 19)
         self.on_job_progress = on_job_progress
         #: spec -> (wire_job, dispatcher, targets, verifier) for
         #: op_job_submit; defaults to jobs.build.build_job_runtime
@@ -700,6 +701,15 @@ class CoordinatorState:
                              "from several workers; range may hold an "
                              "unrecovered crack", unit=unit_id,
                              job=job.job_id, workers=len(rejecters))
+                    if unit is not None:
+                        # coverage ledger marker (ISSUE 19): the range
+                        # counts as covered below, but the audit trail
+                        # must show it was force-completed over
+                        # unverifiable reports -- the one place a
+                        # "covered" range may still hide a crack
+                        job.dispatcher.coverage.event(
+                            "force_complete", unit.start, unit.end,
+                            unit=unit_id, workers=len(rejecters))
                     self.scheduler.complete(job, unit_id,
                                             worker_id=guard)
                 else:
@@ -710,7 +720,8 @@ class CoordinatorState:
                 if completed and self.on_job_progress:
                     self.on_job_progress(
                         job.job_id,
-                        job.dispatcher.completed_intervals())
+                        job.dispatcher.completed_intervals(),
+                        job.dispatcher.coverage_digest())
                 if completed and unit is not None:
                     # liveness only for completions of real leases (see
                     # op_lease on label cardinality); stale or rejected

@@ -6,7 +6,10 @@ incomplete units").  No device state is ever checkpointed -- units are
 pure functions of their index range, so the journal is just:
 
   {"type": "header", "spec": {...}}          job identity (guards resume)
-  {"type": "units", "intervals": [[s,e],..]} completed-coverage snapshot
+  {"type": "units", "intervals": [[s,e],..],
+   "digest": "<hex>"}                        completed-coverage snapshot
+      (digest: order-independent coverage digest of the intervals,
+      ISSUE 19 -- resume and `dprf audit` must reproduce it)
   {"type": "hit", "target": t, "index": i, "plaintext": hex}
   {"type": "tune", "key": k, "record": {...}} tuning decision (batch
       autotune result) -- a resumed job reuses the recorded batch even
@@ -61,6 +64,15 @@ class SessionState:
     #: {"worker", "summary"} -- the `dprf report` kernel-profile
     #: section's input, never resume state
     profiles: list = dataclasses.field(default_factory=list)
+    #: coverage digests (ISSUE 19), job id -> digest hex from the
+    #: LAST units snapshot that carried one; the default job's lands
+    #: under the header's default id.  Resume verifies the rebuilt
+    #: ledger reproduces it (Dispatcher.from_completed expect_digest)
+    #: and `dprf audit` checks it against the artifact replay.
+    coverage: dict = dataclasses.field(default_factory=dict)
+    #: the header's default job id -- the key the default job's
+    #: coverage digest lands under
+    default_job: str = "j0"
 
 
 #: `dprf check` threads analyzer: the journal stream is owned by the
@@ -132,7 +144,8 @@ class SessionJournal:
         return obj
 
     def record_units(self, intervals: list,
-                     job: Optional[str] = None) -> None:
+                     job: Optional[str] = None,
+                     digest: Optional[str] = None) -> None:
         # the snapshot counter is PER JOB: with one shared counter, a
         # job whose completions never land on the threshold crossing
         # would go unjournaled until shutdown -- a crash would lose
@@ -140,15 +153,21 @@ class SessionJournal:
         n = self._since_snapshot.get(job, 0) + 1
         if n >= self.snapshot_every:
             self._since_snapshot[job] = 0
-            self.snapshot(intervals, job=job)
+            self.snapshot(intervals, job=job, digest=digest)
         else:
             self._since_snapshot[job] = n
 
     def snapshot(self, intervals: list,
-                 job: Optional[str] = None) -> None:
-        self._emit(self._tag(
-            {"type": "units",
-             "intervals": [[s, e] for s, e in intervals]}, job))
+                 job: Optional[str] = None,
+                 digest: Optional[str] = None) -> None:
+        obj = {"type": "units",
+               "intervals": [[s, e] for s, e in intervals]}
+        if digest:
+            # coverage digest rides the snapshot it describes (ISSUE
+            # 19): resume rebuilds the ledger from these intervals and
+            # must reproduce the digest, or the journal is torn
+            obj["digest"] = digest
+        self._emit(self._tag(obj, job))
 
     def record_hit(self, target_index: int, cand_index: int,
                    plaintext: bytes, job: Optional[str] = None) -> None:
@@ -227,6 +246,7 @@ class SessionJournal:
         jobs: dict = {}
         health_events: list = []
         profiles: list = []
+        coverage: dict = {}
         # new sessions tag EVERY units/hit line (ISSUE 10); lines
         # tagged with the header's default job id fold back into the
         # flat fields, exactly where untagged (pre-tagging) lines of
@@ -237,7 +257,7 @@ class SessionJournal:
             return jobs.setdefault(jid, {
                 "spec": None, "owner": "?", "priority": 1,
                 "quota": None, "rate": None, "state": None,
-                "completed": [], "hits": []})
+                "completed": [], "hits": [], "coverage_digest": None})
 
         with open(path, encoding="utf-8") as fh:
             for line in fh:
@@ -257,10 +277,23 @@ class SessionJournal:
                         default_jid = dj
                 elif t == "units":
                     iv = [(s, e) for s, e in obj["intervals"]]
-                    if jid is None or str(jid) == default_jid:
+                    key = default_jid if jid is None else str(jid)
+                    dg = obj.get("digest")
+                    if not (isinstance(dg, str) and dg):
+                        dg = None
+                    if key == default_jid:
                         completed = iv
                     else:
-                        job_rec(str(jid))["completed"] = iv
+                        r = job_rec(key)
+                        r["completed"] = iv
+                        r["coverage_digest"] = dg
+                    if dg is not None:
+                        # last snapshot wins, matching the intervals
+                        coverage[key] = dg
+                    else:
+                        # a later digest-less snapshot supersedes the
+                        # intervals the stale digest described
+                        coverage.pop(key, None)
                 elif t == "hit":
                     if jid is None or str(jid) == default_jid:
                         hits.append(obj)
@@ -300,7 +333,8 @@ class SessionJournal:
         return SessionState(spec=spec, completed=completed, hits=hits,
                             tuning=tuning, jobs=jobs,
                             health_events=health_events,
-                            profiles=profiles)
+                            profiles=profiles, coverage=coverage,
+                            default_job=default_jid)
 
 
 def job_fingerprint(engine: str, attack: str, keyspace: int,

@@ -20,6 +20,7 @@ import numpy as np
 from dprf_tpu.engines.base import HashEngine, Target
 from dprf_tpu.generators.base import CandidateGenerator
 from dprf_tpu.runtime.workunit import WorkUnit
+from dprf_tpu.telemetry import coverage
 
 
 @dataclasses.dataclass(frozen=True)
@@ -814,6 +815,10 @@ class MaskWorkerBase:
                 f"hit buffer overflow (> {self.hit_capacity}) and no "
                 "oracle engine to rescan with; raise hit_capacity")
         end = min(bstart + (window or self.stride), unit.end)
+        # coverage note (ISSUE 19): the exact rescan RE-sweeps this
+        # range -- the audit trail must show the second pass was
+        # deliberate, not a double-lease
+        coverage.note("rescan", bstart, end, unit=unit.unit_id)
         sub = WorkUnit(-1, bstart, end - bstart)
         return CpuWorker(self.oracle, self.gen, self.targets).process(sub)
 
@@ -842,6 +847,9 @@ class MaskWorkerBase:
         import jax.numpy as jnp
         hits: list[Hit] = []
         end = min(bstart + window, unit.end)
+        # coverage note (ISSUE 19): this window re-runs per-batch on
+        # device -- deliberate re-coverage, visible to the auditor
+        coverage.note("redrive", bstart, end, unit=unit.unit_id)
         for bs in range(bstart, end, self.stride):
             nv = min(self.stride, end - bs)
             base = jnp.asarray(self.gen.digits(bs), dtype=jnp.int32)
@@ -894,6 +902,9 @@ class WordlistWorkerBase(MaskWorkerBase):
         R = self.gen.n_rules
         start = max(unit.start, ws * R)
         end = min(unit.end, (ws + nw) * R)
+        # coverage note (ISSUE 19): exact host re-sweep of the
+        # overflowed word window, in candidate-index coordinates
+        coverage.note("rescan", start, end, unit=unit.unit_id)
         sub = WorkUnit(-1, start, end - start)
         return CpuWorker(self.oracle, self.gen, self.targets).process(sub)
 
@@ -1011,6 +1022,11 @@ class DeviceWordlistWorker(WordlistWorkerBase):
         import jax.numpy as jnp
         hits: list[Hit] = []
         end = ws + nw
+        # coverage note (ISSUE 19): candidate-index coordinates of the
+        # word window going back through per-batch dispatch
+        R = self.gen.n_rules
+        coverage.note("redrive", max(unit.start, ws * R),
+                      min(unit.end, end * R), unit=unit.unit_id)
         w = ws
         while w < end:
             n = min(self.word_batch, end - w)

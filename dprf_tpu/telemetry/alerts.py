@@ -111,6 +111,12 @@ DEFAULT_RULES = [
      "summary": "flight-recorder spans are being dropped (ingest "
                 "bound exceeded, or the trace stream stopped "
                 "writing)"},
+    {"name": "coverage_gap", "metric": "dprf_job_coverage_gap_total",
+     "op": ">", "threshold": 0, "for_s": 5.0, "severity": "critical",
+     "summary": "keyspace indices LOST from the coverage ledger "
+                "(neither covered, live on a unit, nor unsplit) -- "
+                "candidates are being skipped; audit the session "
+                "with `dprf audit`"},
 ]
 
 #: lock-discipline declaration (`dprf check` locks analyzer): the

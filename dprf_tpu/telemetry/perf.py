@@ -149,15 +149,27 @@ class _ProbedUnit:
     (``resolve()``), carries the phase breakdown and the spans a
     remote worker ships with its complete report.  ``sweep_span`` is
     the pre-allocated span id the caller must record the unit's sweep
-    span under, so the phase spans parent onto it."""
+    span under, so the phase spans parent onto it.
 
-    __slots__ = ("hits", "phases", "phase_spans", "sweep_span")
+    ``cands``/``batches`` (ISSUE 19 satellite): how many candidates
+    the probed sweep covered, over how many dispatches.  A fused
+    (loop-superstep / coarse) probe books its whole window as ONE
+    ``device`` sample while the per-batch probe books one unit of many
+    small dispatches -- so raw phase seconds are not comparable across
+    ``--impl`` variants.  The counts ride the phase spans and let
+    `dprf report` normalize to per-candidate phase cost."""
 
-    def __init__(self, hits, phases, phase_spans, sweep_span):
+    __slots__ = ("hits", "phases", "phase_spans", "sweep_span",
+                 "cands", "batches")
+
+    def __init__(self, hits, phases, phase_spans, sweep_span,
+                 cands=0, batches=0):
         self.hits = hits
         self.phases = phases
         self.phase_spans = phase_spans
         self.sweep_span = sweep_span
+        self.cands = cands
+        self.batches = batches
 
     def resolve(self):
         return self.hits
@@ -217,6 +229,7 @@ def _probe_digit(worker, unit) -> tuple:
     import numpy as np
     t = {"generate": 0.0, "h2d": 0.0, "device": 0.0, "d2h": 0.0}
     hits: list = []
+    batches = 0
     perf = time.perf_counter
     for bstart in range(unit.start, unit.end, worker.stride):
         n_valid = min(worker.stride, unit.end - bstart)
@@ -236,7 +249,8 @@ def _probe_digit(worker, unit) -> tuple:
         t["device"] += t3 - t2
         hits.extend(worker._batch_hits(bstart, result, unit))
         t["d2h"] += perf() - t3
-    return t, hits
+        batches += 1
+    return t, hits, unit.length, batches
 
 
 def _probe_wordlist(worker, unit) -> tuple:
@@ -249,6 +263,7 @@ def _probe_wordlist(worker, unit) -> tuple:
     from dprf_tpu.runtime.worker import word_cover_range
     t = {"generate": 0.0, "h2d": 0.0, "device": 0.0, "d2h": 0.0}
     hits: list = []
+    batches = 0
     perf = time.perf_counter
     w_start, w_end = word_cover_range(unit, worker.gen.n_rules)
     w_end = min(w_end, worker.gen.n_words)
@@ -268,16 +283,23 @@ def _probe_wordlist(worker, unit) -> tuple:
         hits.extend(worker._window_hits(ws, nw, result, unit))
         t["d2h"] += perf() - t2
         ws += nw
-    return t, hits
+        batches += 1
+    # the sweep covers whole word windows; out-of-unit hits are
+    # filtered, but the device DID hash the covering lanes
+    return t, hits, (w_end - w_start) * worker.gen.n_rules, batches
 
 
 def _probe_coarse(worker, unit) -> tuple:
     """Fallback for workers with their own serial ``process``: one
     honest total under ``device`` beats a wrong re-implementation of
-    a per-salt sweep."""
+    a per-salt sweep.  A fused (loop-superstep) process books the
+    WHOLE unit as one device sample, so the candidate count riding
+    the probe is what keeps its phase cost comparable to the
+    per-batch probes (per-candidate normalization in `dprf
+    report`)."""
     t0 = time.perf_counter()
     hits = worker.process(unit)
-    return {"device": time.perf_counter() - t0}, hits
+    return {"device": time.perf_counter() - t0}, hits, unit.length, 1
 
 
 def probe_phases(worker, unit) -> dict:
@@ -285,11 +307,11 @@ def probe_phases(worker, unit) -> dict:
     bench-side entry (``dprf bench`` reports it as ``phases``)."""
     strategy = _probe_strategy(worker)
     if strategy == "wordlist":
-        phases, _ = _probe_wordlist(worker, unit)
+        phases, _, _, _ = _probe_wordlist(worker, unit)
     elif strategy == "digit":
-        phases, _ = _probe_digit(worker, unit)
+        phases, _, _, _ = _probe_digit(worker, unit)
     else:
-        phases, _ = _probe_coarse(worker, unit)
+        phases, _, _, _ = _probe_coarse(worker, unit)
     return phases
 
 
@@ -305,11 +327,11 @@ def probe_pending(worker, unit, sampler: PerfSampler,
     from dprf_tpu.telemetry.trace import new_span_id
     strategy = _probe_strategy(worker)
     if strategy == "wordlist":
-        phases, hits = _probe_wordlist(worker, unit)
+        phases, hits, cands, batches = _probe_wordlist(worker, unit)
     elif strategy == "digit":
-        phases, hits = _probe_digit(worker, unit)
+        phases, hits, cands, batches = _probe_digit(worker, unit)
     else:
-        phases, hits = _probe_coarse(worker, unit)
+        phases, hits, cands, batches = _probe_coarse(worker, unit)
     sweep_span = new_span_id()
     engine = worker_engine(worker)
     job = getattr(unit, "job_id", "j0")
@@ -321,13 +343,19 @@ def probe_pending(worker, unit, sampler: PerfSampler,
             continue
         sampler.hist.observe(dur, phase=phase, engine=engine,
                              job=str(job))
+        # cands/batches ride every phase span (ISSUE 19 satellite):
+        # `dprf report` divides phase seconds by candidates probed, so
+        # a coarse fused probe (whole window = ONE device sample) and
+        # the per-batch probes stay comparable across --impl variants
         ev = sampler.tracer.record(
             "phase", dur=dur, ts=ts, trace=trace, parent=sweep_span,
-            phase=phase, unit=unit.unit_id, job=job, engine=engine)
+            phase=phase, unit=unit.unit_id, job=job, engine=engine,
+            cands=cands, batches=batches)
         ts += dur
         if ev is not None:
             spans.append(ev)
-    return _ProbedUnit(hits, phases, spans, sweep_span)
+    return _ProbedUnit(hits, phases, spans, sweep_span,
+                       cands=cands, batches=batches)
 
 
 # ---------------------------------------------------------------------------

@@ -59,7 +59,8 @@ from dprf_tpu.utils import env as envreg
 #: child of a sampled unit's ``sweep`` span: one per attribution
 #: phase (telemetry/perf.py), attrs carry which phase.
 SPAN_NAMES = ("lease", "rpc", "warmup", "sweep", "hit_verify",
-              "complete", "fail", "reissue", "park", "phase")
+              "complete", "fail", "reissue", "park", "phase",
+              "restore")
 
 #: suffix appended to a session journal path for its span stream
 TRACE_SUFFIX = ".trace.jsonl"

@@ -1,0 +1,12 @@
+"""Fault-injection harnesses (ISSUE 19).
+
+Not shipped runtime -- these drive the REAL coordinator-side pieces
+(dispatcher, session journal, trace recorder, coverage ledger) through
+failure schedules no polite test reaches, then hand the wreckage to
+the offline auditor (``dprf audit``) and gate on its verdict.  The CI
+``audit`` tier and tests/test_chaos.py are the consumers.
+"""
+
+from dprf_tpu.testing.chaos import FAULTS, run_chaos
+
+__all__ = ["FAULTS", "run_chaos"]

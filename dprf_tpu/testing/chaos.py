@@ -1,0 +1,351 @@
+"""Chaos harness: inject coordinator-plane faults mid-sweep, then
+prove the coverage story survived (ISSUE 19).
+
+The harness runs a whole "crack" in process against the REAL pieces a
+coordinator is made of -- Dispatcher (lease/complete/reissue/park),
+SessionJournal (units snapshots + coverage digests + hits),
+TraceRecorder (lifecycle spans), CoverageLedger -- with the sweep
+itself simulated: "hashing" a unit means checking which planted
+candidate indices fall inside its range, so the run is deterministic,
+hardware-free, and finishes in well under a second.  What is NOT
+simulated is everything this PR audits: the unit lifecycle, the
+journal stream, and the ledger.
+
+Faults injected (``FAULTS``), each on a unit carrying a planted hit
+so the exactly-once invariant is exercised through every path:
+
+  - ``worker_kill``      a worker leases a unit and dies silently;
+                         the unit is still outstanding at ...
+  - ``coordinator_restart``  the journal is closed mid-sweep, loaded
+                         back, and the dispatcher rebuilt with
+                         ``from_completed(expect_digest=...)`` -- the
+                         journaled digest must verify, and ...
+  - ``resplit``          ... the un-covered remainder (including the
+                         dead worker's unit) is resplit into fresh
+                         units;
+  - ``lease_expiry``     a worker goes quiet holding a lease; the
+                         fake clock advances past the timeout and the
+                         reaper reissues the unit;
+  - ``stale_complete``   the quiet worker comes BACK after the unit
+                         was reissued and completed by another -- its
+                         late completion must bounce off the
+                         stale-lease guard (a dropped/duplicated
+                         completion RPC), and its duplicate hit
+                         sighting must be deduped;
+  - ``poison_park``      a unit fails repeatedly until parked, then a
+                         ``retry_parked`` admin op requeues it and it
+                         finally lands.
+
+After the sweep drains, the harness snapshots the journal and runs
+the OFFLINE auditor (perfreport/audit.py) over the artifacts.  The
+gate is the auditor's verdict plus the harness's own live checks:
+fraction 1.0, zero overlap, zero gaps, every planted hit found
+exactly once, every stale report rejected.  ``main()`` is the CI
+``audit`` tier entry point (exit 0 iff clean).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+from typing import Optional
+
+from dprf_tpu.runtime.dispatcher import Dispatcher
+from dprf_tpu.runtime.session import SessionJournal
+from dprf_tpu.telemetry.registry import MetricsRegistry
+from dprf_tpu.telemetry.trace import TraceRecorder
+
+FAULTS = ("worker_kill", "coordinator_restart", "resplit",
+          "lease_expiry", "stale_complete", "poison_park")
+
+#: parked after this many failures -- keeps poison_park quick
+MAX_RETRIES = 2
+
+#: loop backstop: the schedule converges in ~60 iterations; hitting
+#: this means a fault path wedged the sweep, which IS a finding
+MAX_STEPS = 10_000
+
+
+class _Clock:
+    """Manual monotonic clock: lease expiry on demand, no sleeping."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class _Run:
+    """One chaos sweep's mutable state (split out so the restart
+    fault can tear half of it down and rebuild it)."""
+
+    def __init__(self, session_path: str, keyspace: int,
+                 unit_size: int, lease_timeout: float) -> None:
+        self.session_path = session_path
+        self.keyspace = keyspace
+        self.unit_size = unit_size
+        self.lease_timeout = lease_timeout
+        self.clock = _Clock()
+        self.registry = MetricsRegistry()
+        self.recorder = TraceRecorder(proc="coordinator",
+                                      enabled=True,
+                                      registry=self.registry)
+        self.spec = {"engine": "chaos", "attack": "mask",
+                     "keyspace": keyspace}
+        self.journal: Optional[SessionJournal] = None
+        self.dispatcher: Optional[Dispatcher] = None
+        self.found: dict = {}       # target -> index (exactly-once)
+        self.injected: list = []
+        self.violations: list = []
+
+    # -- coordinator lifecycle -------------------------------------------
+
+    def boot(self) -> None:
+        """Fresh coordinator: new journal + dispatcher over the whole
+        keyspace."""
+        self.journal = SessionJournal(self.session_path,
+                                      snapshot_every=4)
+        self.journal.open(self.spec)
+        self.recorder.attach_file(self.journal.trace_path)
+        self.dispatcher = Dispatcher(
+            self.keyspace, self.unit_size,
+            lease_timeout=self.lease_timeout, clock=self.clock,
+            registry=self.registry, recorder=self.recorder,
+            max_unit_retries=MAX_RETRIES)
+
+    def restart(self) -> None:
+        """The coordinator_restart fault: drop the live dispatcher
+        (outstanding leases and all), close the journal, load it
+        back, and rebuild -- the journaled digest must verify against
+        the rebuilt ledger, and every un-journaled range (including
+        units that were leased out when the lights went off) must be
+        resplit into fresh pending units."""
+        self.journal.close()
+        self.recorder.detach_file()
+        state = SessionJournal.load(self.session_path)
+        self.journal = SessionJournal(self.session_path,
+                                      snapshot_every=4)
+        self.journal.open(self.spec)
+        self.recorder.attach_file(self.journal.trace_path)
+        self.dispatcher = Dispatcher.from_completed(
+            self.keyspace, self.unit_size, state.completed,
+            expect_digest=state.coverage.get(state.default_job),
+            lease_timeout=self.lease_timeout, clock=self.clock,
+            registry=self.registry, recorder=self.recorder,
+            max_unit_retries=MAX_RETRIES)
+        # the hit ledger survives the restart the same way the
+        # coordinator's does: replayed from the journal
+        self.found = {h["target"]: h["index"] for h in state.hits}
+
+    # -- the simulated worker --------------------------------------------
+
+    def sweep_hits(self, unit, plants: dict) -> list:
+        """(target, index) planted inside the unit's range -- the
+        whole 'device' side of this harness."""
+        return [(t, idx) for t, idx in plants.items()
+                if unit.start <= idx < unit.end]
+
+    def land(self, unit, worker: str, plants: dict) -> bool:
+        """A worker's completion report: mark the unit done, journal
+        coverage + any NEW hits (the coordinator's dedupe -- a hit
+        re-sighted by a redundant sweep is dropped, not re-recorded)."""
+        ok = self.dispatcher.complete(unit.unit_id, elapsed=0.01,
+                                      worker_id=worker)
+        if not ok:
+            return False
+        self.journal.record_units(
+            self.dispatcher.completed_intervals(),
+            digest=self.dispatcher.coverage_digest())
+        for t, idx in self.sweep_hits(unit, plants):
+            if t not in self.found:
+                self.found[t] = idx
+                self.journal.record_hit(t, idx, f"pw{t}".encode())
+        return True
+
+
+def run_chaos(session_path: str, keyspace: int = 20_000,
+              unit_size: int = 512, n_hits: int = 4,
+              lease_timeout: float = 30.0) -> dict:
+    """Run the full fault schedule over a small keyspace; returns the
+    result dict (verdict, fraction, per-fault record, violations).
+    Artifacts are left at ``session_path`` (+ .trace.jsonl) so ``dprf
+    audit`` can be pointed at the wreckage afterwards."""
+    run = _Run(session_path, keyspace, unit_size, lease_timeout)
+    run.boot()
+    # planted hits, spread so the fault-carrying units each hold one
+    plants = {t: (t + 1) * keyspace // (n_hits + 1)
+              for t in range(n_hits)}
+    kill_idx = plants.get(0, keyspace // 5)
+    stale_idx = plants.get(1, 2 * keyspace // 5)
+    park_idx = plants.get(2, 3 * keyspace // 5)
+
+    # restart when the sweep reaches the midpoint between the kill
+    # and stale plants -- after worker_kill, before lease_expiry --
+    # so the schedule holds at any keyspace/unit_size shape
+    restart_idx = (kill_idx + stale_idx) // 2
+
+    killed = restarted = parked_retried = False
+    stale: Optional[dict] = None    # {"uid", "worker"} once injected
+    park_fails = 0
+    completes = 0
+    leases = 0
+
+    for _ in range(MAX_STEPS):
+        d = run.dispatcher
+        # while a stale report is pending, the reissued unit is the
+        # next lease out -- hand it to a DIFFERENTLY-named worker so
+        # the late report exercises the lease-moved guard
+        worker = ("w-rescue" if stale is not None
+                  else f"w-{leases % 2}")
+        unit = d.lease(worker_id=worker)
+        leases += 1
+        if unit is None:
+            if d.parked_count() and not parked_retried:
+                # the admin op: fresh retry budget for poisoned units
+                parked_retried = True
+                d.retry_parked()
+                run.injected.append("poison_park")
+                continue
+            if d.outstanding_count():
+                # quiet workers: let their leases expire and reap
+                run.clock.advance(run.lease_timeout + 1.0)
+                continue
+            break    # drained: nothing pending, outstanding, parked
+        uid = unit.unit_id
+
+        if not killed and unit.start <= kill_idx < unit.end:
+            # worker_kill: "w-dead" holds the lease and says nothing
+            # more; resolved by restart-resplit or the reaper below
+            killed = True
+            run.injected.append("worker_kill")
+            continue
+
+        if (not restarted and killed
+                and unit.start <= restart_idx < unit.end):
+            # coordinator_restart (+ resplit): current lease and the
+            # dead worker's unit are both lost with the process
+            restarted = True
+            run.injected.extend(["coordinator_restart", "resplit"])
+            run.restart()
+            continue
+
+        if (restarted and stale is None
+                and unit.start <= stale_idx < unit.end):
+            # lease_expiry: this worker goes quiet mid-unit; the
+            # reaper will reissue after the clock advance
+            stale = {"uid": uid, "worker": worker, "unit": unit}
+            run.injected.append("lease_expiry")
+            run.clock.advance(run.lease_timeout + 1.0)
+            continue
+
+        if stale is not None and uid == stale["uid"]:
+            # the reissued unit is now leased to a rescue worker --
+            # and the quiet worker's completion RPC finally arrives
+            # FIRST: the lease moved, so the stale-lease guard must
+            # drop it, and its duplicate hit sighting must dedupe
+            if run.dispatcher.complete(uid, elapsed=0.01,
+                                       worker_id=stale["worker"]):
+                run.violations.append(
+                    f"stale completion of unit {uid} accepted -- "
+                    "double coverage")
+            if not run.land(unit, "w-rescue", plants):
+                run.violations.append(
+                    f"rescue completion of unit {uid} rejected")
+            for t, idx in run.sweep_hits(stale["unit"], plants):
+                if t not in run.found:
+                    run.violations.append(
+                        f"hit {t} lost in stale-complete path")
+            run.injected.append("stale_complete")
+            stale = None
+            completes += 1
+            continue
+
+        if (restarted and park_fails < MAX_RETRIES
+                and not parked_retried
+                and unit.start <= park_idx < unit.end):
+            # poison_park: fail until the retry budget parks it; the
+            # retry_parked branch above requeues it later
+            park_fails += 1
+            d.fail(uid, worker_id=worker)
+            continue
+
+        if not run.land(unit, worker, plants):
+            run.violations.append(
+                f"live completion of unit {uid} rejected")
+        completes += 1
+    else:
+        run.violations.append(
+            f"sweep did not drain within {MAX_STEPS} steps")
+
+    d = run.dispatcher
+    run.journal.snapshot(d.completed_intervals(),
+                         digest=d.coverage_digest())
+    run.journal.close()
+    run.recorder.detach_file()
+
+    for name in FAULTS:
+        if name not in run.injected:
+            run.violations.append(f"fault {name} never injected")
+    if len(run.found) != n_hits:
+        run.violations.append(
+            f"{len(run.found)}/{n_hits} planted hits found")
+
+    from dprf_tpu.perfreport.audit import build_audit
+    audit = build_audit(session_path)
+    ledger = d.coverage
+    result = {
+        "session": session_path,
+        "keyspace": keyspace,
+        "faults": run.injected,
+        "completes": completes,
+        "fraction": ledger.fraction(),
+        "overlap": ledger.overlap_total,
+        "gap_total": ledger.gap_total(),
+        "digest": d.coverage_digest(),
+        "hits_planted": n_hits,
+        "hits_found": len(run.found),
+        "violations": run.violations,
+        "audit_verdict": audit["verdict"] if audit else "missing",
+        "audit_problems": audit["problems"] if audit else [],
+    }
+    result["clean"] = (not run.violations
+                       and result["audit_verdict"] == "clean"
+                       and result["fraction"] >= 1.0
+                       and result["overlap"] == 0
+                       and result["gap_total"] == 0)
+    return result
+
+
+def main(argv=None) -> int:
+    """CI audit-tier entry point: run the schedule, print the result
+    as JSON, exit 0 iff the auditor-backed gate is clean."""
+    import argparse
+    p = argparse.ArgumentParser(
+        description="coverage chaos harness (ISSUE 19)")
+    p.add_argument("--session", default=None,
+                   help="session journal path (default: a temp dir; "
+                   "artifacts are LEFT for `dprf audit`)")
+    p.add_argument("--keyspace", type=int, default=20_000)
+    p.add_argument("--unit-size", type=int, default=512)
+    args = p.parse_args(argv)
+    session = args.session
+    if session is None:
+        session = os.path.join(
+            tempfile.mkdtemp(prefix="dprf-chaos-"), "chaos.session")
+    else:
+        os.makedirs(os.path.dirname(os.path.abspath(session)),
+                    exist_ok=True)
+    result = run_chaos(session, keyspace=args.keyspace,
+                       unit_size=args.unit_size)
+    print(json.dumps(result, sort_keys=True))
+    return 0 if result["clean"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -185,6 +185,16 @@ _declare("DPRF_TARGETS_SURVIVOR_CAP", 0, "int",
          "batch and the built table's false-positive estimate.")
 
 # -- observability -----------------------------------------------------------
+_declare("DPRF_COVERAGE", True, "bool",
+         "Coverage audit plane (telemetry/coverage.py): per-job "
+         "gap/overlap ledger, coverage gauges, and worker-side "
+         "redrive/window notes; 0 is the kill switch (coverage "
+         "digests still compute -- resume correctness must not "
+         "depend on a telemetry knob).")
+_declare("DPRF_COVERAGE_MAX_GAPS", 64, "int",
+         "Cap on the gap intervals the coverage ledger, `dprf "
+         "audit`, and the report's Coverage section enumerate (the "
+         "totals stay exact; only the listed ranges truncate).")
 _declare("DPRF_DEVSTATS_POLL_S", 15.0, "float",
          "Seconds between device-memory polls (telemetry/devstats.py: "
          "device.memory_stats() -> dprf_hbm_bytes_in_use/_limit/_peak "

@@ -748,6 +748,81 @@ def test_cli_json_and_exit_codes(tmp_path):
     assert proc.returncode == 2
 
 
+# ---------------------------------------------------------------------------
+# coverage-events: event-site manifest discipline (ISSUE 19)
+
+COVERAGE_DECL = """\
+    EVENT_NAMES = ("split", "complete", "redrive")
+
+    COVERAGE_EVENT_SITES = (
+        ("dprf_tpu/disp.py", "complete"),
+        ("dprf_tpu/disp.py", "fail"),
+    )
+"""
+
+
+def test_coverage_events_violations_caught(tmp_path):
+    root = make_repo(tmp_path, {
+        "dprf_tpu/telemetry/coverage.py": COVERAGE_DECL,
+        "dprf_tpu/disp.py": """\
+            from dprf_tpu.telemetry import coverage
+
+            class D:
+                def complete(self, s, e):
+                    # undeclared event literal
+                    self.coverage.event("explode", s, e)
+
+                def fail(self, s, e):
+                    # declared site that never calls the API
+                    return (s, e)
+
+                def reissue(self, s, e):
+                    # caller missing from the manifest
+                    self.coverage.event("split", s, e)
+
+                def redrive(self, s, e, name):
+                    # computed name: statically unauditable
+                    coverage.note(name, s, e)
+"""})
+    # the computed-name call draws two findings: unauditable literal
+    # AND an undeclared calling site
+    msgs = [x.message for x in bad(check(root, "coverage-events"))]
+    assert len(msgs) == 5, msgs
+    assert any("'explode' not declared" in m for m in msgs)
+    assert any("never calls" in m for m in msgs)
+    assert any("'reissue'" in m and "not declared in" in m
+               for m in msgs)
+    assert any("'redrive'" in m and "not declared in" in m
+               for m in msgs)
+    assert any("string literal" in m for m in msgs)
+
+
+def test_coverage_events_clean_twin(tmp_path):
+    root = make_repo(tmp_path, {
+        "dprf_tpu/telemetry/coverage.py": COVERAGE_DECL,
+        "dprf_tpu/disp.py": """\
+            class D:
+                def complete(self, s, e):
+                    self.coverage.event("complete", s, e)
+
+                def fail(self, s, e):
+                    self.coverage.event("split", s, e)
+"""})
+    assert bad(check(root, "coverage-events")) == []
+
+
+def test_coverage_events_stale_manifest_entry(tmp_path):
+    root = make_repo(tmp_path, {
+        "dprf_tpu/telemetry/coverage.py": COVERAGE_DECL,
+        "dprf_tpu/disp.py": """\
+            class D:
+                def complete(self, s, e):
+                    self.coverage.event("complete", s, e)
+"""})
+    f = bad(check(root, "coverage-events"))
+    assert len(f) == 1 and "no such function" in f[0].message
+
+
 def test_run_for_conftest_formats_failures(tmp_path):
     root = make_repo(tmp_path, {
         "dprf_tpu/w.py": """\
@@ -761,14 +836,15 @@ def test_run_for_conftest_formats_failures(tmp_path):
 
 
 def test_real_repo_is_clean_and_fast():
-    """The acceptance criterion: all eight analyzers over the whole
+    """The acceptance criterion: all nine analyzers over the whole
     package, zero unsuppressed findings, comfortably inside the 5 s
     CLI budget on the 2-core box."""
     t0 = time.monotonic()
     findings, ran = analysis.run(REPO)
     elapsed = time.monotonic() - t0
     assert ran == {"markers", "metrics", "worker-contract", "locks",
-                   "protocol", "env-knobs", "threads", "retrace"}
+                   "protocol", "env-knobs", "threads", "retrace",
+                   "coverage-events"}
     assert bad(findings) == [], "\n".join(
         f.render() for f in bad(findings))
     # every suppression carries a reason (reasonless ones would be

@@ -1,0 +1,337 @@
+"""Coverage audit plane (ISSUE 19): IntervalSet semantics, the
+order-independent coverage digest, the CoverageLedger's
+gap/overlap/partition invariants, the worker-side note() API, the
+dispatcher digest round-trip (resume refuses a torn journal), and the
+offline auditor's sensitivity -- a planted gap, a planted
+double-complete, and a tampered digest must each be flagged.
+"""
+
+import itertools
+
+import pytest
+
+from dprf_tpu.perfreport.audit import build_audit, render_audit
+from dprf_tpu.runtime.dispatcher import Dispatcher
+from dprf_tpu.runtime.session import SessionJournal
+from dprf_tpu.telemetry import coverage
+from dprf_tpu.telemetry.coverage import (CoverageLedger, IntervalSet,
+                                         coverage_digest)
+from dprf_tpu.telemetry.registry import MetricsRegistry
+from dprf_tpu.telemetry.trace import TraceRecorder
+
+pytestmark = [pytest.mark.smoke, pytest.mark.audit]
+
+
+@pytest.fixture(autouse=True)
+def _clean_notes():
+    coverage.reset_notes()
+    yield
+    coverage.install_collector(None)
+    coverage.reset_notes()
+
+
+def _ledger(keyspace, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return CoverageLedger(keyspace, **kw)
+
+
+# -- IntervalSet ------------------------------------------------------------
+
+def test_intervalset_add_returns_newly_covered():
+    iv = IntervalSet()
+    assert iv.add(0, 100) == 100
+    assert iv.add(50, 150) == 50          # half was already covered
+    assert iv.add(20, 80) == 0            # fully inside
+    assert iv.add(150, 200) == 50         # touching: merges
+    assert iv.intervals() == [(0, 200)]
+    assert iv.covered() == 200
+
+
+def test_intervalset_gaps_and_contains():
+    iv = IntervalSet([(10, 20), (40, 50)])
+    assert iv.gaps(60) == [(0, 10), (20, 40), (50, 60)]
+    assert iv.gaps(15) == [(0, 10)]
+    assert iv.contains_range(12, 18)
+    assert not iv.contains_range(15, 45)
+
+
+# -- the digest -------------------------------------------------------------
+
+def test_digest_order_independent():
+    parts = [(0, 100), (300, 400), (100, 200)]
+    digests = {coverage_digest(1000, p)
+               for p in itertools.permutations(parts)}
+    assert len(digests) == 1
+    # pre-merged journal form digests identically
+    assert coverage_digest(1000, [(0, 200), (300, 400)]) in digests
+    # different covered set, or different keyspace: different digest
+    assert coverage_digest(1000, [(0, 200)]) not in digests
+    assert coverage_digest(999, parts) not in digests
+
+
+# -- the ledger -------------------------------------------------------------
+
+def test_ledger_clean_lifecycle():
+    led = _ledger(300)
+    for uid, (s, e) in enumerate([(0, 100), (100, 200), (200, 300)]):
+        led.event("split", s, e, unit=uid)
+        led.event("lease", s, e, unit=uid)
+        led.event("complete", s, e, unit=uid)
+    assert led.fraction() == 1.0
+    assert led.gaps() == [] and led.gap_total() == 0
+    assert led.overlap_total == 0
+    assert led.counts["complete"] == 3
+    assert led.summary()["digest"] == coverage_digest(300, [(0, 300)])
+
+
+def test_ledger_flags_planted_gap():
+    """A unit completed over HALF its range loses the other half from
+    every population -- the exact loss the gap gauge and the
+    coverage_gap alert exist to surface."""
+    led = _ledger(100)
+    led.event("split", 0, 100, unit=0)
+    led.event("complete", 0, 50, unit=0)   # planted: half went missing
+    assert led.gaps() == [(50, 100)]
+    assert led.gap_total() == 50
+
+
+def test_ledger_flags_planted_double_cover():
+    led = _ledger(200)
+    led.event("split", 0, 100, unit=0)
+    led.event("split", 100, 200, unit=1)
+    led.event("complete", 0, 100, unit=0)
+    # planted double-lease aftermath: unit 1 reports unit 0's range
+    led.event("complete", 0, 100, unit=1)
+    assert led.overlap_total == 100
+    assert led.gaps() == [(100, 200)]      # unit 1's real range: lost
+
+
+def test_ledger_abandon_freezes_gap_reporting():
+    led = _ledger(100)
+    led.event("split", 0, 50, unit=0)
+    led.event("abandon")
+    assert led.abandoned and led.gaps() == []
+
+
+def test_disabled_ledger_still_digests(monkeypatch):
+    monkeypatch.setenv("DPRF_COVERAGE", "0")
+    led = _ledger(100)
+    led.event("split", 0, 100, unit=0)
+    led.event("complete", 0, 100, unit=0)
+    assert led.counts["complete"] == 0     # accounting is off...
+    # ...but digests stay live: resume correctness must not depend on
+    # a telemetry knob (this digest is of the EMPTY covered set)
+    assert led.digest() == coverage_digest(100, [])
+
+
+def test_event_rejects_undeclared_name():
+    led = _ledger(10)
+    with pytest.raises(ValueError):
+        led.event("bogus", 0, 10)
+    with pytest.raises(ValueError):
+        coverage.note("bogus", 0, 10)
+
+
+# -- worker-side notes ------------------------------------------------------
+
+def test_note_counters_and_collector():
+    got = []
+    coverage.install_collector(
+        lambda name, s, e, attrs: got.append((name, s, e, attrs)))
+    coverage.note("window", 0, 512, unit=7, kind="sshard")
+    coverage.note("redrive", 128, 256, unit=7)
+    n = coverage.notes()
+    assert n["window"] == 1 and n["redrive"] == 1
+    assert got == [("window", 0, 512, {"unit": 7, "kind": "sshard"}),
+                   ("redrive", 128, 256, {"unit": 7})]
+
+
+def test_note_disabled_is_silent(monkeypatch):
+    monkeypatch.setenv("DPRF_COVERAGE", "0")
+    got = []
+    coverage.install_collector(lambda *a: got.append(a))
+    coverage.note("window", 0, 512, unit=1)
+    assert coverage.notes()["window"] == 0 and got == []
+
+
+# -- dispatcher round-trip --------------------------------------------------
+
+def _drain(disp, worker="w"):
+    while True:
+        u = disp.lease(worker)
+        if u is None:
+            break
+        disp.complete(u.unit_id, worker_id=worker)
+
+
+def test_dispatcher_digest_roundtrip_and_refusal():
+    reg = MetricsRegistry()
+    d = Dispatcher(1000, 100, registry=reg)
+    _drain(d)
+    dg = d.coverage_digest()
+    assert dg == coverage_digest(1000, d.completed_intervals())
+    # an honest resume reproduces the digest
+    d2 = Dispatcher.from_completed(1000, 100, d.completed_intervals(),
+                                   expect_digest=dg,
+                                   registry=MetricsRegistry())
+    assert d2.coverage_digest() == dg
+    # a torn journal (intervals edited, digest stale) is refused
+    with pytest.raises(ValueError, match="refusing to resume"):
+        Dispatcher.from_completed(1000, 100, [(0, 500)],
+                                  expect_digest=dg,
+                                  registry=MetricsRegistry())
+
+
+def test_resume_resplit_redrive_same_unit():
+    """The nastiest interval path: a unit is completed, the journal
+    misses it (crash), resume RESPLITS its range into a fresh unit,
+    the fresh unit overflows and REDRIVES a window -- coverage must
+    come out exact with the overlap visible nowhere (the ledger was
+    rebuilt without the lost completion) and the redrive note clipped
+    inside the resplit unit."""
+    reg = MetricsRegistry()
+    d = Dispatcher(1000, 100, registry=reg)
+    units = [d.lease("w") for _ in range(4)]
+    for u in units[:3]:
+        d.complete(u.unit_id, worker_id="w")
+    # crash: the journal only ever saw the first two completions
+    journaled = [(0, 200)]
+    d2 = Dispatcher.from_completed(
+        1000, 100, journaled,
+        expect_digest=coverage_digest(1000, journaled),
+        registry=MetricsRegistry())
+    # the un-journaled third unit's range is pending again
+    got = []
+    coverage.install_collector(
+        lambda name, s, e, attrs: got.append((name, s, e)))
+    seen = IntervalSet(journaled)
+    while True:
+        u = d2.lease("w")
+        if u is None:
+            break
+        if u.start <= 250 < u.end:
+            # the resplit unit re-running [200, 300): its worker
+            # overflows and redrives a sub-window
+            coverage.note("redrive", u.start + 10, u.end - 10,
+                          unit=u.unit_id)
+        seen.add(u.start, u.end)
+        d2.complete(u.unit_id, worker_id="w")
+    assert d2.coverage.fraction() == 1.0
+    assert d2.coverage.gap_total() == 0
+    assert d2.coverage.overlap_total == 0
+    assert seen.intervals() == [(0, 1000)]
+    assert ("redrive", 210, 290) in got
+    assert d2.coverage_digest() == coverage_digest(1000, [(0, 1000)])
+
+
+# -- offline auditor sensitivity --------------------------------------------
+
+def _session(tmp_path, name="s.session", keyspace=1000):
+    j = SessionJournal(str(tmp_path / name), snapshot_every=2)
+    j.open({"engine": "md5", "attack": "mask", "keyspace": keyspace})
+    return j
+
+
+def test_auditor_flags_planted_gap(tmp_path):
+    j = _session(tmp_path)
+    iv = [(0, 400), (500, 1000)]          # planted: [400, 500) lost
+    j.snapshot(iv, digest=coverage_digest(1000, iv))
+    j.close()
+    doc = build_audit(j.path)
+    assert doc["verdict"] == "incomplete"
+    row = doc["jobs"][0]
+    assert row["gap_total"] == 100
+    assert row["gaps"] == [(400, 500)]
+    assert row["digest_match"] is True
+    assert "GAPS" in render_audit(doc)
+
+
+def test_auditor_flags_planted_double_complete(tmp_path):
+    """A double-lease that lands twice shows up in the trace replay
+    as double-covered candidates -- dirty, even though the journal's
+    interval set looks complete."""
+    j = _session(tmp_path)
+    rec = TraceRecorder(enabled=True, proc="coordinator",
+                        registry=MetricsRegistry())
+    rec.attach_file(j.trace_path)
+    rec.record("complete", start=0, length=500, job="j0")
+    rec.record("complete", start=500, length=500, job="j0")
+    rec.record("complete", start=200, length=300, job="j0")  # planted
+    rec.detach_file()
+    j.snapshot([(0, 1000)], digest=coverage_digest(1000, [(0, 1000)]))
+    j.close()
+    doc = build_audit(j.path)
+    assert doc["verdict"] == "dirty"
+    assert doc["jobs"][0]["trace_overlap"] == 300
+    assert any("double-covered" in p for p in doc["problems"])
+
+
+def test_auditor_flags_tampered_digest(tmp_path):
+    j = _session(tmp_path)
+    j.snapshot([(0, 1000)], digest=coverage_digest(1000, [(0, 900)]))
+    j.close()
+    doc = build_audit(j.path)
+    assert doc["verdict"] == "dirty"
+    assert doc["jobs"][0]["digest_match"] is False
+    assert any("does not match" in p for p in doc["problems"])
+
+
+def test_auditor_flags_duplicate_hits(tmp_path):
+    j = _session(tmp_path)
+    j.record_hit(0, 123, b"pw")
+    j.record_hit(0, 123, b"pw")            # planted: found twice
+    j.snapshot([(0, 1000)], digest=coverage_digest(1000, [(0, 1000)]))
+    j.close()
+    doc = build_audit(j.path)
+    assert doc["verdict"] == "dirty"
+    assert doc["jobs"][0]["hit_dupes"] == 1
+    assert any("exactly once" in p for p in doc["problems"])
+
+
+def test_auditor_restart_generation_not_flagged(tmp_path):
+    """A crash-restart legitimately re-sweeps ranges completed after
+    the last journal snapshot; the restore-span generation boundary
+    keeps the replay from misreading that as double coverage --
+    while a double WITHIN the new generation still flags."""
+    j = _session(tmp_path)
+    rec = TraceRecorder(enabled=True, proc="coordinator",
+                        registry=MetricsRegistry())
+    rec.attach_file(j.trace_path)
+    rec.record("complete", start=0, length=500, job="j0")
+    rec.record("complete", start=500, length=300, job="j0")  # unsnapshotted
+    # restart: the journal only snapshotted [0, 500)
+    rec.record("restore", start=0, length=500, job="j0")
+    rec.record("complete", start=500, length=300, job="j0")  # legit resweep
+    rec.record("complete", start=800, length=200, job="j0")
+    rec.detach_file()
+    j.snapshot([(0, 1000)], digest=coverage_digest(1000, [(0, 1000)]))
+    j.close()
+    doc = build_audit(j.path)
+    assert doc["jobs"][0]["trace_overlap"] == 0
+    assert doc["verdict"] == "clean"
+    # but re-covering a range the restore itself seeded IS dirty
+    rec.attach_file(j.trace_path)
+    rec.record("complete", start=100, length=50, job="j0")
+    rec.detach_file()
+    doc = build_audit(j.path)
+    assert doc["jobs"][0]["trace_overlap"] == 50
+    assert doc["verdict"] == "dirty"
+
+
+def test_ledger_event_overhead_budget():
+    """The ledger must stay far under the <=2% H/s budget: a sweep's
+    worth of events (split+lease+complete per unit) has to be cheap.
+    Loose wall-clock bound -- this is a tripwire for an accidental
+    O(n^2) (e.g. re-scanning the interval list per insert), not a
+    benchmark."""
+    import time
+    led = _ledger(10_000_000)
+    t0 = time.perf_counter()
+    for uid in range(10_000):
+        s = uid * 1000
+        led.event("split", s, s + 1000, unit=uid)
+        led.event("lease", s, s + 1000, unit=uid)
+        led.event("complete", s, s + 1000, unit=uid)
+    dt = time.perf_counter() - t0
+    assert led.fraction() == 1.0
+    assert dt < 2.0, f"30k ledger events took {dt:.2f}s"

@@ -727,8 +727,10 @@ def test_per_job_resume_exact_coverage_after_restart(tmp_path):
     eng, gen, targets, job = _mask_job("?d?d?d", [b"999"])
     state, server, disp, rec, reg = _serve(job, gen, targets)
     # cmd_serve's journaling hooks, wired the same way
-    state.on_job_progress = lambda jid, iv: session.record_units(
-        iv, job=None if jid == state.default_job_id else jid)
+    state.on_job_progress = lambda jid, iv, dg=None: \
+        session.record_units(
+            iv, job=None if jid == state.default_job_id else jid,
+            digest=dg)
     state.on_job_hit = (
         lambda j, ti, cand, plain: session.record_hit(
             ti, cand, plain, job=j.job_id)
