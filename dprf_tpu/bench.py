@@ -529,6 +529,153 @@ def run_targets_sweep(engine: str = "md5", mask: str = "?a?a?a?a?a?a",
     }, mode="targets")
 
 
+def _ttfh_first_hit(order, worker, keyspace: int, unit_size: int):
+    """Drive a fresh Dispatcher + worker until the first hit: returns
+    (candidates_tried, wall_seconds).  Candidate counting is exact --
+    units are leased low-start-first, and the hit's position within
+    its unit comes back through the order's own point map, so the
+    number measures the DISPATCH order, not the sweep chunking."""
+    from dprf_tpu.runtime.dispatcher import Dispatcher
+    from dprf_tpu.runtime.worker import submit_or_process
+
+    disp = Dispatcher(keyspace, unit_size, order=order)
+    tested = 0
+    t0 = time.perf_counter()
+    while True:
+        unit = disp.lease()
+        if unit is None:
+            raise RuntimeError(
+                "ttfh: keyspace exhausted without a hit -- planted "
+                "targets unreachable (bijection or oracle broken)")
+        hits = submit_or_process(worker, unit).resolve()
+        disp.complete(unit.unit_id)
+        if hits:
+            pos = min((order.index_to_rank(h.cand_index)
+                       if order is not None else h.cand_index)
+                      for h in hits) - unit.start
+            return tested + pos + 1, time.perf_counter() - t0
+        tested += unit.length
+
+
+def _ttfh_steady_rate(worker, start: int, n_units: int,
+                      unit_size: int) -> float:
+    """Equal-work steady-state H/s: sweep n_units fixed spans (no
+    early exit) through the worker's process path.  Ordered and
+    linear runs get the SAME numeric spans, so the delta is exactly
+    the rank->index decode + run-decomposition overhead."""
+    from dprf_tpu.runtime.worker import submit_or_process
+    from dprf_tpu.runtime.workunit import WorkUnit
+
+    t0 = time.perf_counter()
+    for u in range(n_units):
+        submit_or_process(worker, WorkUnit(
+            -(u + 1), start + u * unit_size, unit_size)).resolve()
+    return n_units * unit_size / (time.perf_counter() - t0)
+
+
+def run_ttfh(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
+             plants: int = 4, split: int = 2, log=None) -> dict:
+    """Time-to-first-hit: rank-ordered vs linear dispatch (ISSUE 20).
+
+    Plants passwords at KNOWN Markov ranks -- prefix digit vectors
+    with a small frequency-level sum but a nonzero leading level,
+    the shape real passwords take once charsets are frequency-
+    reordered (probable everywhere, top-probable nowhere) -- then
+    cracks the same job twice through the real Dispatcher + oracle
+    worker path: once leasing low RANKS first (MarkovOrder +
+    OrderedWorker), once in plain index order.  ``value`` is the
+    candidates-to-first-hit SPEEDUP (linear / ordered, higher
+    better); ``penalty`` is the steady-state H/s cost of rank
+    decoding, from equal-work sweeps over a mid-rank region (where
+    blocks scatter in index space -- near rank 0 the runs coalesce
+    and would flatter the decode).  CPU-oracle by design: the
+    ordering win is a dispatch property, not a backend property, so
+    CI gates it without silicon.
+    """
+    from dprf_tpu.generators.order import MarkovOrder
+    from dprf_tpu.runtime.worker import CpuWorker, OrderedWorker
+
+    oracle = get_engine(engine, device="cpu")
+    if oracle.salted:
+        raise ValueError(
+            "ttfh bench plants bare digests; use an unsalted engine")
+    gen = MaskGenerator(mask)
+    if gen.keyspace > (1 << 25) or len(gen.radices) <= split:
+        # the linear sweep must REACH its first hit in CI time: the
+        # bench-wide ?a^8 default is a device-scale keyspace, so the
+        # ttfh mode substitutes an oracle-scale mask
+        mask = "?l?l?l?l?l"
+        gen = MaskGenerator(mask)
+        if log:
+            log.info("ttfh: substituting oracle-scale mask", mask=mask)
+    order = MarkovOrder(gen.radices, split=split)
+    block = order.block
+    r1 = gen.radices[1] if split > 1 else 1
+
+    # plants: leading level 1+i (never 0 -- a level-0 start is found
+    # instantly in BOTH orders), small second level, low suffix
+    # offset.  Known ranks by construction: plant 0 sits in prefix
+    # block 2 of rank order but block 1*r1 of index order.
+    plants = max(1, min(int(plants), 8))
+    plant_indices = []
+    for i in range(plants):
+        d0 = min(1 + i, gen.radices[0] - 1)
+        d1 = (3 * i) % min(4, r1) if split > 1 else 0
+        pidx = d0 * r1 + d1 if split > 1 else d0
+        for r in gen.radices[2:split]:
+            pidx *= r
+        plant_indices.append(pidx * block + (1237 * (i + 1)) % block)
+    plains = [gen.candidate(ix) for ix in plant_indices]
+    targets = [oracle.parse_target(d.hex())
+               for d in oracle.hash_batch(plains)]
+
+    unit_size = 2 * block
+    linear_worker = CpuWorker(oracle, gen, targets)
+    ordered_worker = OrderedWorker(CpuWorker(oracle, gen, targets),
+                                   order)
+    cands_lin, wall_lin = _ttfh_first_hit(None, linear_worker,
+                                          gen.keyspace, unit_size)
+    cands_ord, wall_ord = _ttfh_first_hit(order, ordered_worker,
+                                          gen.keyspace, unit_size)
+    speedup = cands_lin / cands_ord
+    if log:
+        log.info("ttfh first hit", ordered=cands_ord, linear=cands_lin,
+                 speedup=f"{speedup:.1f}x")
+
+    steady_units = 6
+    steady_start = min(20 * unit_size,
+                       gen.keyspace - steady_units * unit_size)
+    hs_lin = _ttfh_steady_rate(linear_worker, steady_start,
+                               steady_units, unit_size)
+    hs_ord = _ttfh_steady_rate(ordered_worker, steady_start,
+                               steady_units, unit_size)
+    penalty = max(0.0, 1.0 - hs_ord / hs_lin)
+
+    return _publish({
+        "metric": (f"{engine} candidates-to-first-hit speedup, "
+                   "markov rank order vs linear"),
+        "value": round(speedup, 4),
+        "unit": "x",
+        "engine": engine,
+        "mask": mask,
+        "device": "cpu",
+        "plants": plants,
+        "planted": [{"index": ix, "rank": order.index_to_rank(ix)}
+                    for ix in plant_indices],
+        "split": order.split,
+        "block": order.block,
+        "unit_size": unit_size,
+        "ordered": {"candidates_to_first_hit": cands_ord,
+                    "first_hit_s": round(wall_ord, 4),
+                    "steady_hs": round(hs_ord, 1)},
+        "linear": {"candidates_to_first_hit": cands_lin,
+                   "first_hit_s": round(wall_lin, 4),
+                   "steady_hs": round(hs_lin, 1)},
+        # steady-state H/s cost of rank decoding (acceptance: <0.10)
+        "penalty": round(penalty, 4),
+    }, mode="ttfh")
+
+
 def run_scaling(engine: str = "md5", mask: str = "?a?a?a?a?a?a?a?a",
                 n_devices: int = 8, batch_per_device="auto",
                 seconds: float = 5.0, inner: int = 8,

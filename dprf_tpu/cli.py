@@ -77,6 +77,13 @@ def _add_job_args(c, with_hashfile: bool = True) -> None:
     c.add_argument("--markov", default=None, metavar="STATS",
                    help="mask attacks: visit each position's charset in "
                    "trained-frequency order (stats from `dprf markov`)")
+    c.add_argument("--order", default="index",
+                   choices=["index", "markov"],
+                   help="candidate enumeration order: 'index' sweeps "
+                   "the keyspace linearly; 'markov' (requires "
+                   "--markov) dispatches probability-ranked units "
+                   "first to minimize time-to-first-hit (DPRF_ORDER_* "
+                   "knobs shape the rank blocks)")
     for i in range(1, 5):
         c.add_argument(f"--custom{i}", default=None,
                        help=f"custom charset ?{i}")
@@ -221,6 +228,15 @@ def _build_parser() -> argparse.ArgumentParser:
                    metavar="N,N,...", help="comma-separated target "
                    "counts for --targets-sweep (10^7-ready on real "
                    "silicon; the CPU backend default caps at 10^6)")
+    b.add_argument("--ttfh", action="store_true",
+                   help="time-to-first-hit mode: crack planted "
+                   "passwords under rank-ordered (--order markov) vs "
+                   "linear dispatch and report the candidates-to-"
+                   "first-hit speedup plus the steady-state H/s "
+                   "penalty; --gate compares against the "
+                   "TTFH_r*.json trajectory")
+    b.add_argument("--plants", type=int, default=4, metavar="N",
+                   help="--ttfh: planted passwords per run")
     b.add_argument("--unit-strides", type=int, default=1, metavar="K",
                    help="--config mode: device batches per WorkUnit; "
                    "real Dispatcher units span many batches, and over "
@@ -377,6 +393,12 @@ def _build_parser() -> argparse.ArgumentParser:
                               "hybrid-wm", "hybrid-mw"])
     jsb.add_argument("--rules", default=None)
     jsb.add_argument("--markov", default=None, metavar="STATS")
+    jsb.add_argument("--order", default="index",
+                     choices=["index", "markov"],
+                     help="candidate dispatch order: 'markov' leases "
+                     "probability-ranked spans first (needs --markov "
+                     "stats; the coordinator resolves and pins the "
+                     "bijection split on the wire job)")
     for i in range(1, 5):
         jsb.add_argument(f"--custom{i}", default=None)
     jsb.add_argument("--unit-size", type=int, default=1 << 22)
@@ -1037,7 +1059,11 @@ class _JobSetup:
 
     def __init__(self, engine, hl, gen, max_len, unit_size, spec,
                  session, completed, restored_hits, dispatcher,
-                 tuning=None, restored_jobs=None):
+                 tuning=None, restored_jobs=None, order=None):
+        #: rank<->index bijection (generators/order.py) or None: the
+        #: dispatcher leases rank spans, so the worker must be wrapped
+        #: in an OrderedWorker before it sees a unit
+        self.order = order
         self.engine = engine
         self.hl = hl
         self.gen = gen
@@ -1070,6 +1096,22 @@ def _setup_job(args, device: str, log: Log,
                                            markov=getattr(args, "markov",
                                                           None))
     unit_size = _align_unit_size(args.unit_size, args.attack, gen)
+
+    order = None
+    if (getattr(args, "order", "index") or "index") != "index":
+        if not getattr(args, "markov", None):
+            log.error("--order markov requires --markov stats: the "
+                      "rank order ranks trained-frequency levels")
+            return None
+        from dprf_tpu.generators.order import build_order
+        try:
+            order = build_order(args.order, gen)
+        except ValueError as e:
+            log.error("cannot build candidate order", error=str(e))
+            return None
+        log.info("rank-ordered dispatch", order=order.kind,
+                 split=order.split, blocks=order.blocks,
+                 block=order.block)
 
     spec = JobSpec(engine=engine.name, device=device, attack=args.attack,
                    attack_arg=args.attack_arg, keyspace=gen.keyspace,
@@ -1109,11 +1151,17 @@ def _setup_job(args, device: str, log: Log,
     skip = min(getattr(args, "skip", 0) or 0, gen.keyspace)
     limit = getattr(args, "limit", None)
     restricted = list(completed)
+    # under --order, skip/limit count candidates in the order they
+    # are TRIED (ranks); the exclusions are mapped to their index
+    # image because the journal -- and from_completed -- speak index
     if skip:
-        restricted.append((0, skip))
+        restricted.extend(order.index_spans(0, skip) if order
+                          else [(0, skip)])
         log.info("skipping keyspace prefix", skip=skip)
     if limit is not None and skip + limit < gen.keyspace:
-        restricted.append((skip + limit, gen.keyspace))
+        restricted.extend(
+            order.index_spans(skip + limit, gen.keyspace) if order
+            else [(skip + limit, gen.keyspace)])
         log.info("limiting sweep", limit=limit)
     if (skip or limit is not None) and session is not None:
         log.warn("--skip/--limit ranges will be journaled as covered "
@@ -1129,15 +1177,17 @@ def _setup_job(args, device: str, log: Log,
         try:
             dispatcher = Dispatcher.from_completed(
                 gen.keyspace, unit_size, restricted,
-                expect_digest=expect, **kw)
+                expect_digest=expect, order=order, **kw)
         except ValueError as e:
             log.error("refusing to resume", error=str(e))
             return None
     else:
-        dispatcher = Dispatcher(gen.keyspace, unit_size, **kw)
+        dispatcher = Dispatcher(gen.keyspace, unit_size, order=order,
+                                **kw)
     return _JobSetup(engine, hl, gen, max_len, unit_size, spec,
                      session, completed, restored_hits, dispatcher,
-                     tuning=tuning, restored_jobs=restored_jobs)
+                     tuning=tuning, restored_jobs=restored_jobs,
+                     order=order)
 
 
 def _tune_extras(attack: str, hit_cap=None, n_rules=None) -> dict:
@@ -1304,6 +1354,12 @@ def _crack_single(args, device: str, log: Log):
     worker = _select_worker(args.engine, device, args.attack, gen,
                             hl.targets, batch, args.hit_cap,
                             engine, args.devices, log)
+    if job.order is not None:
+        # rank-ordered dispatch: unit spans are ranks; the wrapper
+        # decodes each into contiguous index runs before the (device
+        # or CPU) worker's unchanged index-space sweep
+        from dprf_tpu.runtime.worker import OrderedWorker
+        worker = OrderedWorker(worker, job.order)
     # Overlapped warmup: start the step compile now on a background
     # thread so it runs while the potfile preloads, the session
     # restores, and the coordinator takes its first leases; the
@@ -1448,6 +1504,11 @@ def cmd_serve(args, log: Log) -> int:
         "unit_size": unit_size,
         "batch": batch,
         "hit_cap": args.hit_cap,
+        # candidate order + the resolved bijection split (pinned here
+        # so workers can never fork the rank<->index map on divergent
+        # DPRF_ORDER_* environments)
+        "order": job_setup.order.kind if job_setup.order else "index",
+        "order_split": job_setup.order.split if job_setup.order else 0,
         # sharding request: workers build the job's worker over N of
         # their local chips through the unified sharded runtime (their
         # own --devices flag overrides)
@@ -1689,6 +1750,17 @@ def cmd_worker(args, log: Log) -> int:
         w = _select_worker(spec["engine"], device, spec["attack"], gen,
                            targets, args.batch or spec["batch"],
                            spec["hit_cap"], engine, n_dev, log)
+        if (spec.get("order") or "index") != "index":
+            # rank-ordered job: rebuild the EXACT bijection from the
+            # wire spec (kind + pinned split -- local DPRF_ORDER_*
+            # knobs must not fork the map) and decode leased rank
+            # spans before the index-space sweep
+            from dprf_tpu.generators.order import build_order
+            from dprf_tpu.runtime.worker import OrderedWorker
+            order = build_order(spec["order"], gen,
+                                split=int(spec.get("order_split") or 0)
+                                or None)
+            w = OrderedWorker(w, order)
         # overlapped warmup: the step compile runs while leases
         # round-trip to the coordinator; worker_loop joins it before
         # the first dispatch
@@ -1753,9 +1825,12 @@ def cmd_bench(args, log: Log) -> int:
 
     baseline_dir = args.baseline_dir or compare_mod.repo_root()
     if args.gate_dry:
-        # CI mode: audit the committed trajectory, measure nothing
-        verdict = compare_mod.gate_dry(baseline_dir,
-                                       window=args.gate_window)
+        # CI mode: audit the committed trajectory, measure nothing.
+        # --ttfh redirects the audit at the TTFH_r*.json records
+        verdict = compare_mod.gate_dry(
+            baseline_dir, window=args.gate_window,
+            pattern=(compare_mod.TTFH_PATTERN if args.ttfh
+                     else "BENCH_r*.json"))
         print(json.dumps({"gate": verdict}))
         if verdict["verdict"] == "regression":
             log.error("bench gate: REGRESSION in the committed "
@@ -1775,7 +1850,11 @@ def cmd_bench(args, log: Log) -> int:
         ctx = profiler_mod.get_profiler().session(
             args.profile, owner="bench", log=log)
     with ctx:
-        if args.targets_sweep:
+        if args.ttfh:
+            from dprf_tpu.bench import run_ttfh
+            res = run_ttfh(engine=args.engine, mask=args.mask,
+                           plants=args.plants, log=log)
+        elif args.targets_sweep:
             from dprf_tpu.bench import run_targets_sweep
             sizes = [int(s) for s in
                      args.targets_sizes.split(",") if s.strip()]
@@ -1825,7 +1904,9 @@ def cmd_bench(args, log: Log) -> int:
         # parses it) and a regression exits non-zero.  Scaling mode
         # gates against the SCALING_r*.json efficiency trajectory, so
         # a multichip regression alarms exactly like a throughput one.
-        if args.targets_sweep:
+        if args.ttfh:
+            pattern = compare_mod.TTFH_PATTERN
+        elif args.targets_sweep:
             pattern = compare_mod.TARGETS_PATTERN
         elif args.devices > 1:
             pattern = compare_mod.SCALING_PATTERN
@@ -2286,6 +2367,7 @@ def _jobs_submit(client, args, log: Log) -> int:
                     for i, v in _customs(args).items()},
         "rules": args.rules,
         "markov": args.markov,
+        "order": getattr(args, "order", "index"),
         "targets": lines,
         "targets_fingerprint": targets_fingerprint,
         "unit_size": args.unit_size,

@@ -1,0 +1,321 @@
+"""Rank-ordered keyspace dispatch: the rank<->index bijection (ISSUE 20).
+
+The Dispatcher splits, leases, resumes, and re-splits in **rank
+space** -- rank 0 is the candidate the attack should try FIRST -- while
+workers keep decoding by **index** (the mixed-radix position the
+generator's device decode understands).  The bridge is a per-generator
+``RankOrder``: an exact bijection between the two spaces plus the
+interval calculus the dispatcher and journal need:
+
+  - ``rank_to_index`` / ``index_to_rank``: the point maps;
+  - ``index_spans(rank_start, rank_end)``: a rank interval as
+    CONTIGUOUS index runs, in rank order -- what an OrderedWorker
+    (runtime/worker.py) submits through the unchanged device pipeline
+    (each run flows through the existing ``digits(base) + offset``
+    decode, so sharded supersteps and Pallas kernels never see ranks);
+  - ``index_image`` / ``rank_image``: canonical merged interval-set
+    images -- journal snapshots and coverage digests canonicalize over
+    the index image of the dispatcher's rank intervals, so
+    exactly-once coverage and digest-checked resume survive
+    reordering (a journal is always written in index space; the same
+    sweep digests identically under any order).
+
+``MarkovOrder`` is the first real ordering (OMEN-style): it composes
+with a Markov-reordered ``MaskGenerator`` (generators/markov.py), whose
+per-position charsets are already sorted by trained frequency -- so a
+position's DIGIT is its frequency LEVEL, and the candidates most
+likely overall are the ones with the smallest level SUM.  Enumerating
+exact level-sum order over all positions would shatter every rank
+interval into single indices; instead the order splits the mask into a
+leading **prefix** (the k most-significant positions, ranked by
+ascending level sum, ties lexicographic) and a **suffix block** (the
+remaining positions, swept in plain index order within each prefix).
+``rank = prefix_rank * B + suffix_offset`` with ``B = prod(radices[k:])``
+keeps every rank interval inside a block one contiguous index run --
+device batches stay dense -- while the prefix ranking still front-loads
+the probable region of the keyspace: position 0 dominates real-world
+structure, which is exactly what per-position Markov stats capture.
+
+The split point k is chosen from two knobs (or pinned explicitly --
+the wire job carries it, so a fleet can never fork the bijection on
+divergent env):
+
+  - ``DPRF_ORDER_BLOCK_MIN``: minimum suffix block size, so device
+    batches/supersteps stay within blocks (steady-state H/s penalty
+    bounded by the per-submit overhead amortized over >= this many
+    candidates);
+  - ``DPRF_ORDER_PREFIX_MAX``: maximum number of prefix blocks, so
+    the index image of a rank interval -- and with it every journal
+    snapshot and resume -- stays a bounded number of runs.
+
+Prefix rank<->vector conversion is a standard DP unranking over
+bounded compositions (count vectors below a level sum, then peel
+positions); O(k * max_radix) per conversion, nothing materialized.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from dprf_tpu.utils import env as envreg
+
+#: split-choice knobs (see module docstring); read via envreg getters
+BLOCK_MIN_ENV = "DPRF_ORDER_BLOCK_MIN"
+PREFIX_MAX_ENV = "DPRF_ORDER_PREFIX_MAX"
+
+#: order kinds accepted on the wire / CLI ("index" = no reordering)
+ORDER_KINDS = ("index", "markov")
+
+
+def _merge(spans: list) -> list:
+    """Sorted, merged [start, end) tuples from arbitrary spans."""
+    out: list = []
+    for s, e in sorted(spans):
+        if e <= s:
+            continue
+        if out and s <= out[-1][1]:
+            if e > out[-1][1]:
+                out[-1] = (out[-1][0], e)
+        else:
+            out.append((s, e))
+    return out
+
+
+class IdentityOrder:
+    """rank == index: wordlist/combinator order (until PRINCE lands),
+    and the explicit ``--order index`` default.  ``build_order``
+    returns None for it so nothing gets wrapped, but tests and the
+    chaos harness use it to exercise order-generic code paths."""
+
+    kind = "index"
+    split = 0
+
+    def __init__(self, keyspace: int):
+        self.keyspace = int(keyspace)
+
+    def rank_to_index(self, rank: int) -> int:
+        return rank
+
+    def index_to_rank(self, index: int) -> int:
+        return index
+
+    def index_spans(self, rank_start: int, rank_end: int) -> list:
+        return ([(rank_start, rank_end)]
+                if rank_end > rank_start else [])
+
+    def index_image(self, intervals) -> list:
+        return _merge(list(intervals))
+
+    def rank_image(self, intervals) -> list:
+        return _merge(list(intervals))
+
+
+class MarkovOrder:
+    """Level-sum block-permutation order over a mixed-radix keyspace.
+
+    ``radices`` are the generator's per-position charset sizes with
+    position 0 MOST significant (MaskGenerator.digits order).  The
+    contract is compositional: digit value == probability level, which
+    holds exactly when the generator's charsets were reordered by
+    trained frequency (``MaskGenerator(mask, markov_counts=...)``).
+    The bijection itself is valid for any radices -- it is just a
+    permutation of [0, keyspace) -- so a mis-trained model can cost
+    time-to-first-hit, never coverage.
+    """
+
+    kind = "markov"
+
+    def __init__(self, radices: Sequence[int],
+                 split: Optional[int] = None):
+        self.radices = tuple(int(r) for r in radices)
+        if not self.radices or any(r < 1 for r in self.radices):
+            raise ValueError("radices must be positive and non-empty")
+        self.keyspace = 1
+        for r in self.radices:
+            self.keyspace *= r
+        n = len(self.radices)
+        if split is not None:
+            k = int(split)
+            if not 1 <= k <= n:
+                raise ValueError(
+                    f"order split {k} outside [1, {n}] for a "
+                    f"{n}-position mask")
+        else:
+            block_min = max(1, envreg.get_int(BLOCK_MIN_ENV))
+            prefix_max = max(1, envreg.get_int(PREFIX_MAX_ENV))
+            k, block = n, 1
+            while k > 1 and (block < block_min
+                             or self._prefix_prod(k) > prefix_max):
+                k -= 1
+                block *= self.radices[k]
+        #: prefix length: positions [0, k) are rank-ordered, the
+        #: suffix [k, n) sweeps in index order within each block
+        self.split = k
+        #: suffix block size B: rank = prefix_rank * B + offset
+        self.block = 1
+        for r in self.radices[k:]:
+            self.block *= r
+        #: number of prefix blocks (prefix keyspace)
+        self.blocks = self.keyspace // self.block
+        # DP table over bounded compositions of the prefix:
+        # _count[p][L] = digit vectors for positions p..k-1 summing to
+        # exactly L.  Row p has sum(r[i]-1 for i in p..k-1)+1 entries.
+        counts = [[1]]
+        for p in range(k - 1, -1, -1):
+            nxt = counts[0]
+            radix = self.radices[p]
+            row = [0] * (len(nxt) + radix - 1)
+            for d in range(radix):
+                for L, c in enumerate(nxt):
+                    row[d + L] += c
+            counts.insert(0, row)
+        self._count = counts
+        #: cumulative prefix ranks below each level sum:
+        #: _cum[s] = # of prefix vectors with level sum < s
+        cum = [0]
+        for c in counts[0]:
+            cum.append(cum[-1] + c)
+        self._cum = cum
+
+    def _prefix_prod(self, k: int) -> int:
+        p = 1
+        for r in self.radices[:k]:
+            p *= r
+        return p
+
+    # -- prefix rank <-> digit vector (DP unranking) ---------------------
+
+    def _prefix_digits_of_rank(self, prank: int) -> list:
+        cum = self._cum
+        # level sum s: cum[s] <= prank < cum[s+1] (binary search)
+        lo, hi = 0, len(cum) - 1
+        while lo + 1 < hi:
+            mid = (lo + hi) // 2
+            if cum[mid] <= prank:
+                lo = mid
+            else:
+                hi = mid
+        s = lo
+        rem = prank - cum[s]
+        digits = []
+        for p in range(self.split):
+            row = self._count[p + 1]
+            for d in range(min(self.radices[p] - 1, s) + 1):
+                c = row[s - d] if s - d < len(row) else 0
+                if rem < c:
+                    digits.append(d)
+                    s -= d
+                    break
+                rem -= c
+        return digits
+
+    def _prefix_rank_of_digits(self, digits: Sequence[int]) -> int:
+        s = sum(digits)
+        rank = self._cum[s]
+        rem = s
+        for p, dp in enumerate(digits):
+            row = self._count[p + 1]
+            for d in range(dp):
+                if 0 <= rem - d < len(row):
+                    rank += row[rem - d]
+            rem -= dp
+        return rank
+
+    def _prefix_digits_of_index(self, pidx: int) -> list:
+        out = [0] * self.split
+        for p in range(self.split - 1, -1, -1):
+            pidx, out[p] = divmod(pidx, self.radices[p])
+        return out
+
+    def _prefix_index_of_digits(self, digits: Sequence[int]) -> int:
+        idx = 0
+        for p, d in enumerate(digits):
+            idx = idx * self.radices[p] + d
+        return idx
+
+    # -- the point maps --------------------------------------------------
+
+    def rank_to_index(self, rank: int) -> int:
+        if not 0 <= rank < self.keyspace:
+            raise IndexError(
+                f"rank {rank} outside keyspace {self.keyspace}")
+        prank, off = divmod(rank, self.block)
+        digits = self._prefix_digits_of_rank(prank)
+        return self._prefix_index_of_digits(digits) * self.block + off
+
+    def index_to_rank(self, index: int) -> int:
+        if not 0 <= index < self.keyspace:
+            raise IndexError(
+                f"index {index} outside keyspace {self.keyspace}")
+        pidx, off = divmod(index, self.block)
+        digits = self._prefix_digits_of_index(pidx)
+        return self._prefix_rank_of_digits(digits) * self.block + off
+
+    # -- the interval calculus -------------------------------------------
+
+    def index_spans(self, rank_start: int, rank_end: int) -> list:
+        """The rank interval as contiguous [start, end) index runs, in
+        RANK order (adjacent runs coalesced): what a worker sweeps, in
+        the order the dispatcher meant.  At most one run per prefix
+        block touched."""
+        out: list = []
+        r = rank_start
+        while r < rank_end:
+            prank, off = divmod(r, self.block)
+            take = min(rank_end - r, self.block - off)
+            digits = self._prefix_digits_of_rank(prank)
+            s = (self._prefix_index_of_digits(digits) * self.block
+                 + off)
+            if out and out[-1][1] == s:
+                out[-1] = (out[-1][0], s + take)
+            else:
+                out.append((s, s + take))
+            r += take
+        return out
+
+    def index_image(self, intervals) -> list:
+        """Canonical (sorted, merged) index-space image of rank-space
+        intervals -- the journal/digest form."""
+        spans: list = []
+        for s, e in intervals:
+            spans.extend(self.index_spans(s, e))
+        return _merge(spans)
+
+    def rank_image(self, intervals) -> list:
+        """Canonical rank-space image of index-space intervals -- the
+        resume direction (journaled index intervals back into the
+        dispatcher's rank ledger).  Exact inverse of index_image."""
+        spans: list = []
+        for s, e in intervals:
+            i = s
+            while i < e:
+                pidx, off = divmod(i, self.block)
+                take = min(e - i, self.block - off)
+                digits = self._prefix_digits_of_index(pidx)
+                rs = (self._prefix_rank_of_digits(digits) * self.block
+                      + off)
+                spans.append((rs, rs + take))
+                i += take
+        return _merge(spans)
+
+
+def build_order(kind: Optional[str], gen,
+                split: Optional[int] = None):
+    """The one order factory: an order kind from the CLI/wire spec plus
+    the (already Markov-reordered, when applicable) generator.  Returns
+    None for identity order -- the fast path: nothing is wrapped, the
+    dispatcher ledger IS index space, and journals stay byte-identical
+    to pre-order runs."""
+    if kind in (None, "", "index"):
+        return None
+    if kind == "markov":
+        radices = getattr(gen, "radices", None)
+        if radices is None:
+            raise ValueError(
+                "--order markov needs a mask generator (per-position "
+                "radices); wordlist/combinator attacks run in index "
+                "order until PRINCE lands")
+        return MarkovOrder(radices, split=split)
+    raise ValueError(
+        f"unknown candidate order {kind!r} (choices: "
+        f"{', '.join(ORDER_KINDS)})")

@@ -93,6 +93,24 @@ def build_job_runtime(spec: dict, job_id: str, log=None,
                 f"disagrees with the coordinator's rebuild "
                 f"{store.fingerprint!r} (target lines corrupted or "
                 "reordered with losses in transit?)")
+    from dprf_tpu.generators.order import build_order
+    order_kind = str(spec.get("order") or "index")
+    if order_kind != "index" and not spec.get("markov"):
+        raise ValueError(
+            "--order markov needs trained stats (submit with "
+            "--markov): without frequency-reordered charsets the rank "
+            "order is meaningless")
+    try:
+        order_split = (int(spec["order_split"])
+                       if spec.get("order_split") else None)
+    except (TypeError, ValueError):
+        order_split = None
+    # the coordinator resolves the split ONCE (env knobs or the
+    # client's explicit value) and pins it on the wire job below, so
+    # every worker rebuilds the identical bijection regardless of its
+    # own environment
+    order = build_order(order_kind, gen, split=order_split)
+
     unit_size = _cli._align_unit_size(
         int(spec.get("unit_size") or DEFAULT_UNIT_SIZE), attack, gen)
     try:
@@ -102,7 +120,7 @@ def build_job_runtime(spec: dict, job_id: str, log=None,
     hit_cap = int(spec.get("hit_cap") or DEFAULT_HIT_CAP)
 
     kw = {"lease_timeout": lease_timeout, "registry": registry,
-          "recorder": recorder, "job_id": job_id}
+          "recorder": recorder, "job_id": job_id, "order": order}
     try:
         unit_seconds = float(spec.get("unit_seconds", 20.0))
     except (TypeError, ValueError):
@@ -148,6 +166,10 @@ def build_job_runtime(spec: dict, job_id: str, log=None,
         "unit_seconds": unit_seconds,
         "batch": batch,
         "hit_cap": hit_cap,
+        # candidate order + the resolved bijection split: workers
+        # rebuild the rank<->index map from these two fields alone
+        "order": order_kind,
+        "order_split": order.split if order is not None else 0,
         # sharding request: workers shard this job's units over N of
         # their local chips (cli.cmd_worker; their --devices overrides)
         "devices": max(1, int(spec.get("devices") or 1)),

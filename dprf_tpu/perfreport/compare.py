@@ -53,6 +53,13 @@ SCALING_PATTERN = "SCALING_r*.json"
 #: table that stops being O(1) per candidate dips the gated number)
 TARGETS_PATTERN = "TARGETS_r*.json"
 
+#: committed time-to-first-hit records (bare run_ttfh result JSON;
+#: value = candidates-to-first-hit SPEEDUP of rank-ordered over
+#: linear dispatch, so an ordering regression -- a broken bijection,
+#: a scheduler that stops leasing low ranks first -- dips the gated
+#: number exactly like a throughput loss)
+TTFH_PATTERN = "TTFH_r*.json"
+
 
 def _result_from_tail(tail: str) -> Optional[dict]:
     """The LAST JSON object line in a driver record's tail -- the

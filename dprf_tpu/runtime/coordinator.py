@@ -193,9 +193,14 @@ class Coordinator:
         for hit in hits:
             rejected |= not self._record(hit)
         if rejected:
-            from dprf_tpu.runtime.worker import CpuWorker
+            from dprf_tpu.runtime.worker import CpuWorker, OrderedWorker
             rescan = CpuWorker(self.oracle, self.worker.gen,
                                self.worker.targets)
+            order = getattr(self.worker, "order", None)
+            if order is not None:
+                # rank-ordered job: the unit's span is ranks, and the
+                # rescan must decode it through the same bijection
+                rescan = OrderedWorker(rescan, order)
             for hit in rescan.process(unit):
                 self._record(hit)   # oracle-produced: verifies trivially
 

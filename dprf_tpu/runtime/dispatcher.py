@@ -37,13 +37,14 @@ workers so their spans stitch onto the same timeline.
 
 from __future__ import annotations
 
+import heapq
 import time
-from collections import deque
 from typing import Callable, Optional
 
 from dprf_tpu.runtime.workunit import WorkUnit
 from dprf_tpu.telemetry import get_registry
-from dprf_tpu.telemetry.coverage import CoverageLedger, IntervalSet
+from dprf_tpu.telemetry.coverage import (CoverageLedger, IntervalSet,
+                                         coverage_digest)
 from dprf_tpu.telemetry.trace import get_tracer, new_trace_id, span_id
 
 #: lock-discipline declaration (`dprf check` locks analyzer): the
@@ -69,12 +70,20 @@ class Dispatcher:
                  clock: Optional[Callable[[], float]] = None,
                  registry=None, sizer=None,
                  max_unit_retries: Optional[int] = 5,
-                 recorder=None, job_id: str = "j0"):
+                 recorder=None, job_id: str = "j0", order=None):
         if unit_size <= 0:
             raise ValueError("unit_size must be positive")
         self.keyspace = keyspace
         self.unit_size = unit_size
         self.lease_timeout = lease_timeout
+        #: rank<->index bijection (generators/order.py) or None for
+        #: identity.  With an order, EVERY position in this ledger --
+        #: unit spans, the done set, the split frontier, gaps -- is a
+        #: RANK; the split frontier advancing is what makes low ranks
+        #: (probable candidates) go out first.  Only the journal-facing
+        #: views (completed_intervals, coverage_digest) translate to
+        #: index space, so session artifacts stay order-independent.
+        self.order = order
         #: the job this ledger belongs to (multi-tenant serve plane,
         #: jobs/scheduler.py): every unit-lifecycle metric and span
         #: this dispatcher records carries it, so per-job observability
@@ -89,7 +98,11 @@ class Dispatcher:
         self._clock = clock or time.monotonic
         self._next_start = 0
         self._next_id = 0
-        self._pending: deque[WorkUnit] = deque()
+        #: min-heap of (start, unit_id, unit): reissues and resume
+        #: resplits lease LOWEST RANK FIRST -- under an order, pending
+        #:  units always hold the most probable uncovered candidates,
+        #: so they must beat the frontier, not queue behind it
+        self._pending: list[tuple] = []
         #: id -> (unit, worker, deadline, lease span id)
         self._outstanding: dict[int, tuple] = {}
         self._retries: dict[int, int] = {}         # id -> failed attempts
@@ -139,7 +152,7 @@ class Dispatcher:
         #: event API; it detects overlaps at insert, reports gaps
         #: against the keyspace, and carries the coverage digest
         self.coverage = CoverageLedger(keyspace, job_id=job_id,
-                                       registry=registry)
+                                       registry=registry, order=order)
 
     # -- construction from a resume journal ------------------------------
 
@@ -149,6 +162,12 @@ class Dispatcher:
                        expect_digest: Optional[str] = None,
                        **kw) -> "Dispatcher":
         d = cls(keyspace, unit_size, **kw)
+        if d.order is not None:
+            # the journal records INDEX intervals (order-independent
+            # session artifacts); fold them back through the bijection
+            # so the rank-space ledger resumes -- and resplits below
+            # the rank frontier -- exactly where the sweep stopped
+            completed = d.order.rank_image(completed)
         for s, e in completed:
             d._done.add(s, e)
             d.coverage.event("restore", s, e)
@@ -167,9 +186,11 @@ class Dispatcher:
             # re-split big gaps into unit-sized pieces
             d.coverage.event("resplit", s, e)
             for u in range(s, e, unit_size):
-                d._pending.append(d._make_unit(u, min(unit_size, e - u)))
+                unit = d._make_unit(u, min(unit_size, e - u))
+                heapq.heappush(d._pending,
+                               (unit.start, unit.unit_id, unit))
         d._next_start = frontier
-        if expect_digest and d.coverage.digest() != expect_digest:
+        if expect_digest and d.coverage_digest() != expect_digest:
             # the PR 14 fingerprint discipline applied to coverage
             # state: a journal whose intervals do not reproduce the
             # digest it recorded describes a DIFFERENT sweep -- a
@@ -177,13 +198,15 @@ class Dispatcher:
             raise ValueError(
                 "coverage digest mismatch on resume: journal recorded "
                 f"{expect_digest} but its intervals rebuild to "
-                f"{d.coverage.digest()} -- the journal is torn or "
+                f"{d.coverage_digest()} -- the journal is torn or "
                 "edited; refusing to resume over silent holes")
         return d
 
     def _make_unit(self, start: int, length: int) -> WorkUnit:
         u = WorkUnit(self._next_id, start, length,
-                     job_id=self.job_id)
+                     job_id=self.job_id,
+                     order=(self.order.kind if self.order is not None
+                            else "index"))
         self._next_id += 1
         # the unit's whole lifecycle -- every lease, failure, reissue,
         # wherever it lands -- shares this one trace id
@@ -207,7 +230,7 @@ class Dispatcher:
         (either exhausted, or all remaining work is outstanding)."""
         self.reap_expired()
         if self._pending:
-            unit = self._pending.popleft()
+            unit = heapq.heappop(self._pending)[2]
         elif self._next_start < self.keyspace:
             size = (self.sizer.next_size(worker_id)
                     if self.sizer is not None else self.unit_size)
@@ -342,7 +365,8 @@ class Dispatcher:
         else:
             self.coverage.event("reissue", unit.start, unit.end,
                                 unit=unit.unit_id)
-            self._pending.append(unit)
+            heapq.heappush(self._pending,
+                           (unit.start, unit.unit_id, unit))
             self.tracer.record("reissue", trace=tid, parent=lease_sid,
                                proc="coordinator", unit=unit.unit_id,
                                job=self.job_id, worker=worker_id,
@@ -412,13 +436,22 @@ class Dispatcher:
         return self._done.covered(), self.keyspace
 
     def completed_intervals(self) -> list[tuple]:
+        """The covered set in INDEX space -- the journal/snapshot form.
+        Under an order this is the index image of the rank-space done
+        set, so the session artifacts a sweep leaves behind are
+        identical no matter what order produced them."""
+        if self.order is not None:
+            return self.order.index_image(self._done.intervals())
         return self._done.intervals()
 
     def coverage_digest(self) -> str:
         """Order-independent digest of the covered set -- journaled
         with units snapshots and carried by JobResult; a resume must
-        rebuild the same digest from the journaled intervals."""
-        return self.coverage.digest()
+        rebuild the same digest from the journaled intervals.
+        Computed from the dispatcher's own done set (canonicalized to
+        index space), so it never depends on the DPRF_COVERAGE
+        telemetry knob."""
+        return coverage_digest(self.keyspace, self.completed_intervals())
 
     def outstanding_count(self) -> int:
         return len(self._outstanding)
@@ -470,7 +503,8 @@ class Dispatcher:
             self._retries.pop(unit.unit_id, None)
             self.coverage.event("unpark", unit.start, unit.end,
                                 unit=unit.unit_id)
-            self._pending.append(unit)
+            heapq.heappush(self._pending,
+                           (unit.start, unit.unit_id, unit))
             self.tracer.record("reissue",
                                trace=self._trace_ids.get(unit.unit_id),
                                proc="coordinator", unit=unit.unit_id,

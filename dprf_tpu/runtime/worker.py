@@ -288,6 +288,62 @@ class CpuWorker:
     process._serial_only = True
 
 
+class _MultiPending:
+    """Pending handle over several sub-unit pendings (one per
+    contiguous index run of a rank-ordered unit); resolve() drains
+    them oldest-first, so device readbacks overlap later runs'
+    compute exactly like the unit pipeline does across units."""
+
+    __slots__ = ("_pendings",)
+
+    def __init__(self, pendings):
+        self._pendings = pendings
+
+    def resolve(self) -> list["Hit"]:
+        hits: list[Hit] = []
+        for p in self._pendings:
+            hits.extend(p.resolve())
+        return hits
+
+
+class OrderedWorker:
+    """Rank-space adapter over any worker: the dispatcher's unit spans
+    are RANKS (generators/order.py); this wrapper decodes each leased
+    span into its contiguous index runs and submits every run through
+    the wrapped worker's unchanged index-space path -- the device
+    pipeline (fused steps, sharded supersteps, Pallas kernels) never
+    sees a rank.  Runs are submitted in rank order, so the most
+    probable candidates are swept (and their hits surface) first even
+    within one unit.  Sub-units reuse the parent's unit id and job id:
+    coverage accounting stays per leased unit, and every Hit carries
+    its index-space cand_index exactly as before."""
+
+    def __init__(self, worker, order):
+        self._worker = worker
+        #: the job's rank<->index bijection; the coordinator's rescan
+        #: path (Coordinator._finish_unit) re-wraps its CPU oracle
+        #: worker with this same object
+        self.order = order
+
+    def submit(self, unit: WorkUnit) -> "_MultiPending":
+        subs = []
+        for s, e in self.order.index_spans(unit.start, unit.end):
+            subs.append(submit_or_process(
+                self._worker, WorkUnit(unit.unit_id, s, e - s,
+                                       job_id=unit.job_id)))
+        return _MultiPending(subs)
+
+    def process(self, unit: WorkUnit) -> list["Hit"]:
+        return self.submit(unit).resolve()
+
+    process._submit_based = True
+
+    def __getattr__(self, name):
+        # everything else (gen, targets, warmup_async, engine,
+        # compile_seconds...) is the wrapped worker's business
+        return getattr(self._worker, name)
+
+
 class MaskWorkerBase:
     """Shared machinery for fused-pipeline mask workers.
 

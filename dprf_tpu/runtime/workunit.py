@@ -26,6 +26,11 @@ class WorkUnit:
     #: complete, and journal record routes by (job_id, unit_id).  The
     #: default matches the single-job Dispatcher's default ledger id.
     job_id: str = "j0"
+    #: enumeration order of the span (generators/order.py kinds):
+    #: "index" means start/length ARE keyspace indices; any other kind
+    #: means they are RANKS and a worker must decode the span through
+    #: the job's rank<->index bijection before sweeping
+    order: str = "index"
 
     @property
     def end(self) -> int:

@@ -262,9 +262,18 @@ class CoverageLedger:
     """
 
     def __init__(self, keyspace: int, job_id: str = "j0",
-                 registry=None, enabled: Optional[bool] = None):
+                 registry=None, enabled: Optional[bool] = None,
+                 order=None):
         self.keyspace = int(keyspace)
         self.job_id = job_id
+        #: rank<->index bijection of the owning dispatcher (or None =
+        #: identity).  The ledger's interval arithmetic runs in the
+        #: dispatcher's native space -- under an order that is RANK
+        #: space, where exactly-once is the same invariant (a bijection
+        #: preserves overlaps and gaps) -- and only digest()/
+        #: covered_intervals() translate to the canonical index image
+        #: the journal and `dprf audit` compare against.
+        self.order = order
         self.enabled = (coverage_enabled() if enabled is None
                         else enabled)
         self._covered = IntervalSet()
@@ -375,12 +384,17 @@ class CoverageLedger:
         return sum(e - s for s, e in self.gaps())
 
     def digest(self) -> str:
-        """Digest of the covered set; computed even when disabled (the
-        resume rebuild check must not depend on a telemetry knob)."""
-        return coverage_digest(self.keyspace,
-                               self._covered.intervals())
+        """Digest of the covered set over its canonical INDEX image;
+        computed even when disabled (the resume rebuild check must not
+        depend on a telemetry knob)."""
+        return coverage_digest(self.keyspace, self.covered_intervals())
 
     def covered_intervals(self) -> list[tuple]:
+        """Covered set in index space (the journal-comparable form);
+        lazily translated -- the hot event path never pays for the
+        bijection."""
+        if self.order is not None:
+            return self.order.index_image(self._covered.intervals())
         return self._covered.intervals()
 
     def live_units(self) -> dict:

@@ -86,11 +86,16 @@ class _Run:
     fault can tear half of it down and rebuild it)."""
 
     def __init__(self, session_path: str, keyspace: int,
-                 unit_size: int, lease_timeout: float) -> None:
+                 unit_size: int, lease_timeout: float,
+                 order=None) -> None:
         self.session_path = session_path
         self.keyspace = keyspace
         self.unit_size = unit_size
         self.lease_timeout = lease_timeout
+        #: rank<->index bijection (generators/order.py) or None; when
+        #: set, every dispatcher runs in rank space and the harness
+        #: proves the SAME exactly-once story under reordering
+        self.order = order
         self.clock = _Clock()
         self.registry = MetricsRegistry()
         self.recorder = TraceRecorder(proc="coordinator",
@@ -114,7 +119,7 @@ class _Run:
         self.journal.open(self.spec)
         self.recorder.attach_file(self.journal.trace_path)
         self.dispatcher = Dispatcher(
-            self.keyspace, self.unit_size,
+            self.keyspace, self.unit_size, order=self.order,
             lease_timeout=self.lease_timeout, clock=self.clock,
             registry=self.registry, recorder=self.recorder,
             max_unit_retries=MAX_RETRIES)
@@ -136,6 +141,7 @@ class _Run:
         self.dispatcher = Dispatcher.from_completed(
             self.keyspace, self.unit_size, state.completed,
             expect_digest=state.coverage.get(state.default_job),
+            order=self.order,
             lease_timeout=self.lease_timeout, clock=self.clock,
             registry=self.registry, recorder=self.recorder,
             max_unit_retries=MAX_RETRIES)
@@ -147,9 +153,16 @@ class _Run:
 
     def sweep_hits(self, unit, plants: dict) -> list:
         """(target, index) planted inside the unit's range -- the
-        whole 'device' side of this harness."""
-        return [(t, idx) for t, idx in plants.items()
-                if unit.start <= idx < unit.end]
+        whole 'device' side of this harness.  Unit spans are RANKS
+        under an order, so membership goes through the bijection's
+        point map, exactly like an OrderedWorker's decode does."""
+        out = []
+        for t, idx in plants.items():
+            pos = (self.order.index_to_rank(idx)
+                   if self.order is not None else idx)
+            if unit.start <= pos < unit.end:
+                out.append((t, idx))
+        return out
 
     def land(self, unit, worker: str, plants: dict) -> bool:
         """A worker's completion report: mark the unit done, journal
@@ -169,21 +182,54 @@ class _Run:
         return True
 
 
+def _chaos_order(kind: str, keyspace: int):
+    """The harness's rank order: a MarkovOrder over a synthetic
+    mixed-radix factorization of the keyspace (hardware-free, no
+    generator needed).  Built directly -- not via build_order -- so
+    the chaos schedule can pin a split with a nontrivial block."""
+    if kind in (None, "", "index"):
+        return None
+    from dprf_tpu.generators.order import MarkovOrder
+    radices, k = [], keyspace
+    while k % 10 == 0 and k > 10 and len(radices) < 3:
+        radices.append(10)
+        k //= 10
+    if len(radices) < 2 or k < 2:
+        raise ValueError(
+            f"--order markov chaos needs a keyspace divisible by 100 "
+            f"with a cofactor >= 2, got {keyspace}")
+    return MarkovOrder((k, *radices), split=2)
+
+
 def run_chaos(session_path: str, keyspace: int = 20_000,
               unit_size: int = 512, n_hits: int = 4,
-              lease_timeout: float = 30.0) -> dict:
+              lease_timeout: float = 30.0,
+              order: str = "index") -> dict:
     """Run the full fault schedule over a small keyspace; returns the
     result dict (verdict, fraction, per-fault record, violations).
     Artifacts are left at ``session_path`` (+ .trace.jsonl) so ``dprf
-    audit`` can be pointed at the wreckage afterwards."""
-    run = _Run(session_path, keyspace, unit_size, lease_timeout)
+    audit`` can be pointed at the wreckage afterwards.
+
+    ``order="markov"`` reruns the identical schedule in RANK space:
+    the dispatcher leases rank spans, plants are journaled as
+    indices, and restart-resume rides the rank_image of the
+    journal's index intervals -- exactly-once must hold bit-for-bit
+    under reordering."""
+    ord_obj = _chaos_order(order, keyspace)
+    run = _Run(session_path, keyspace, unit_size, lease_timeout,
+               order=ord_obj)
     run.boot()
-    # planted hits, spread so the fault-carrying units each hold one
-    plants = {t: (t + 1) * keyspace // (n_hits + 1)
-              for t in range(n_hits)}
-    kill_idx = plants.get(0, keyspace // 5)
-    stale_idx = plants.get(1, 2 * keyspace // 5)
-    park_idx = plants.get(2, 3 * keyspace // 5)
+    # planted hits, spread so the fault-carrying units each hold one.
+    # The schedule MARKS are positions along the dispatch axis (ranks
+    # under an order); each plant's journaled identity is its INDEX,
+    # like a production hit's cand_index
+    marks = {t: (t + 1) * keyspace // (n_hits + 1)
+             for t in range(n_hits)}
+    plants = ({t: ord_obj.rank_to_index(m) for t, m in marks.items()}
+              if ord_obj is not None else dict(marks))
+    kill_idx = marks.get(0, keyspace // 5)
+    stale_idx = marks.get(1, 2 * keyspace // 5)
+    park_idx = marks.get(2, 3 * keyspace // 5)
 
     # restart when the sweep reaches the midpoint between the kill
     # and stale plants -- after worker_kill, before lease_expiry --
@@ -302,6 +348,7 @@ def run_chaos(session_path: str, keyspace: int = 20_000,
     result = {
         "session": session_path,
         "keyspace": keyspace,
+        "order": order or "index",
         "faults": run.injected,
         "completes": completes,
         "fraction": ledger.fraction(),
@@ -333,6 +380,11 @@ def main(argv=None) -> int:
                    "artifacts are LEFT for `dprf audit`)")
     p.add_argument("--keyspace", type=int, default=20_000)
     p.add_argument("--unit-size", type=int, default=512)
+    p.add_argument("--order", default="index",
+                   choices=["index", "markov"],
+                   help="run the schedule in rank space (markov): "
+                   "same faults, same exactly-once gate, dispatched "
+                   "through the rank<->index bijection")
     args = p.parse_args(argv)
     session = args.session
     if session is None:
@@ -342,7 +394,7 @@ def main(argv=None) -> int:
         os.makedirs(os.path.dirname(os.path.abspath(session)),
                     exist_ok=True)
     result = run_chaos(session, keyspace=args.keyspace,
-                       unit_size=args.unit_size)
+                       unit_size=args.unit_size, order=args.order)
     print(json.dumps(result, sort_keys=True))
     return 0 if result["clean"] else 1
 

@@ -141,6 +141,19 @@ _declare("DPRF_JOB_TTL_S", 86400.0, "float",
          "seconds are reaped from the scheduler table (journaled as "
          "job_gc records) so long-lived fleets never wedge at the "
          "MAX_JOBS cap; 0 disables reaping.")
+_declare("DPRF_ORDER_BLOCK_MIN", 1 << 16, "int",
+         "Rank-ordered dispatch (--order markov): minimum suffix "
+         "block size the order's prefix/suffix split preserves, so "
+         "device batches and supersteps sweep contiguous index runs "
+         "at least this long (bounds the steady-state H/s penalty of "
+         "reordering).  An explicit per-job split pins the geometry "
+         "instead; the wire job always carries the resolved split.")
+_declare("DPRF_ORDER_PREFIX_MAX", 1 << 16, "int",
+         "Rank-ordered dispatch: maximum number of rank-ordered "
+         "prefix blocks, bounding how many index runs one rank "
+         "interval can shatter into (journal snapshots, coverage "
+         "digests, and resume all canonicalize over the index image "
+         "of rank intervals).")
 _declare("DPRF_PIPELINE_DEPTH", 2, "int",
          "Units submitted ahead of the oldest unresolved one in the "
          "local and remote worker loops (1 = serial fallback).")

@@ -51,6 +51,11 @@ def main(argv=None) -> int:
                     help="gate against the committed TARGETS_r*.json "
                     "trajectory (probe-table target-set-size sweep "
                     "records) instead of the BENCH throughput records")
+    ap.add_argument("--ttfh", action="store_true",
+                    help="gate against the committed TTFH_r*.json "
+                    "trajectory (time-to-first-hit speedup of rank-"
+                    "ordered over linear dispatch) instead of the "
+                    "BENCH throughput records")
     ap.add_argument("--window", type=int, default=None, metavar="K")
     ap.add_argument("--quiet", "-q", action="store_true")
     args = ap.parse_args(argv)
@@ -59,7 +64,9 @@ def main(argv=None) -> int:
 
     repo = args.dir or compare.repo_root()
     window = args.window or compare.DEFAULT_WINDOW
-    if args.targets:
+    if args.ttfh:
+        pattern = compare.TTFH_PATTERN
+    elif args.targets:
         pattern = compare.TARGETS_PATTERN
     elif args.scaling:
         pattern = compare.SCALING_PATTERN
